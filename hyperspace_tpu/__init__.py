@@ -1,0 +1,36 @@
+"""hyperspace_tpu — a TPU-native data-lake indexing framework.
+
+A ground-up re-design of the capabilities of microsoft/hyperspace (an indexing
+subsystem for Apache Spark) for TPU hardware: covering indexes are built with
+JAX/XLA (hash-partition + sort-within-bucket on device, bucket exchange over
+ICI via shard_map collectives), queries are transparently rewritten to probe
+HBM-resident bucketed columnar indexes, and data-skipping sketches are computed
+as on-device reductions — while the operation log and the Parquet index layout
+live on the TPU-VM host filesystem, mirroring the reference's on-disk
+contracts (_hyperspace_log, v__=N version dirs).
+"""
+
+from .config import Conf, HyperspaceConf  # noqa: F401
+from .exceptions import HyperspaceException, NoChangesException  # noqa: F401
+from .index.constants import IndexConstants, States  # noqa: F401
+from .schema import Field, Schema  # noqa: F401
+
+__version__ = "0.1.0"
+
+
+def __getattr__(name):
+    # Lazy imports to keep `import hyperspace_tpu` light and cycle-free.
+    try:
+        if name in ("Hyperspace", "IndexConfig"):
+            from . import api
+            return getattr(api, name)
+        if name == "Session":
+            from .session import Session
+            return Session
+        if name in ("col", "lit"):
+            from .plan import expr as _expr
+            return getattr(_expr, name)
+    except ImportError as e:
+        raise AttributeError(
+            f"module {__name__!r} attribute {name!r} is unavailable: {e}") from e
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -1,0 +1,151 @@
+"""Runtime configuration system.
+
+Mirrors the reference's conf-string approach (util/HyperspaceConf.scala:26-118,
+util/CacheWithTransform.scala): every knob is a string conf read lazily per
+call, so values are runtime-changeable; derived values are cached keyed on the
+raw conf string.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Generic, Optional, TypeVar
+
+from .index.constants import IndexConstants
+
+T = TypeVar("T")
+
+
+class Conf:
+    """A mutable string-keyed configuration map (the SparkConf analogue)."""
+
+    def __init__(self, initial: Optional[Dict[str, str]] = None):
+        self._conf: Dict[str, str] = dict(initial or {})
+
+    def set(self, key: str, value: Any) -> "Conf":
+        self._conf[key] = str(value)
+        return self
+
+    def get(self, key: str, default: Optional[str] = None) -> Optional[str]:
+        return self._conf.get(key, default)
+
+    def unset(self, key: str) -> None:
+        self._conf.pop(key, None)
+
+    def contains(self, key: str) -> bool:
+        return key in self._conf
+
+    def copy(self) -> "Conf":
+        return Conf(dict(self._conf))
+
+    def as_dict(self) -> Dict[str, str]:
+        return dict(self._conf)
+
+
+class CacheWithTransform(Generic[T]):
+    """Caches ``transform(raw)`` and re-derives when the raw conf string changes.
+
+    Parity: util/CacheWithTransform.scala:1-45.
+    """
+
+    def __init__(self, load_func: Callable[[], str], transform: Callable[[str], T]):
+        self._load_func = load_func
+        self._transform = transform
+        self._cached_raw: Optional[str] = None
+        self._cached_value: Optional[T] = None
+
+    def load(self) -> T:
+        raw = self._load_func()
+        if self._cached_raw is None or raw != self._cached_raw:
+            self._cached_raw = raw
+            self._cached_value = self._transform(raw)
+        return self._cached_value  # type: ignore[return-value]
+
+
+class HyperspaceConf:
+    """Typed accessors over a :class:`Conf` (util/HyperspaceConf.scala:26-118)."""
+
+    def __init__(self, conf: Conf):
+        self._conf = conf
+
+    @property
+    def conf(self) -> Conf:
+        return self._conf
+
+    def system_path(self) -> str:
+        path = self._conf.get(IndexConstants.INDEX_SYSTEM_PATH)
+        if not path:
+            raise ValueError(
+                f"Config '{IndexConstants.INDEX_SYSTEM_PATH}' is not set; it must point at "
+                "the root directory under which indexes are stored.")
+        return path
+
+    def num_bucket_count(self) -> int:
+        return int(
+            self._conf.get(
+                IndexConstants.INDEX_NUM_BUCKETS,
+                str(IndexConstants.INDEX_NUM_BUCKETS_DEFAULT)))
+
+    def hybrid_scan_enabled(self) -> bool:
+        return self._get_bool(
+            IndexConstants.INDEX_HYBRID_SCAN_ENABLED,
+            IndexConstants.INDEX_HYBRID_SCAN_ENABLED_DEFAULT)
+
+    def hybrid_scan_deleted_ratio_threshold(self) -> float:
+        return float(
+            self._conf.get(
+                IndexConstants.INDEX_HYBRID_SCAN_DELETED_RATIO_THRESHOLD,
+                IndexConstants.INDEX_HYBRID_SCAN_DELETED_RATIO_THRESHOLD_DEFAULT))
+
+    def hybrid_scan_appended_ratio_threshold(self) -> float:
+        return float(
+            self._conf.get(
+                IndexConstants.INDEX_HYBRID_SCAN_APPENDED_RATIO_THRESHOLD,
+                IndexConstants.INDEX_HYBRID_SCAN_APPENDED_RATIO_THRESHOLD_DEFAULT))
+
+    def use_bucket_spec_for_filter_rule(self) -> bool:
+        return self._get_bool(
+            IndexConstants.INDEX_FILTER_RULE_USE_BUCKET_SPEC,
+            IndexConstants.INDEX_FILTER_RULE_USE_BUCKET_SPEC_DEFAULT)
+
+    def index_lineage_enabled(self) -> bool:
+        return self._get_bool(
+            IndexConstants.INDEX_LINEAGE_ENABLED,
+            IndexConstants.INDEX_LINEAGE_ENABLED_DEFAULT)
+
+    def optimize_file_size_threshold(self) -> int:
+        return int(
+            self._conf.get(
+                IndexConstants.OPTIMIZE_FILE_SIZE_THRESHOLD,
+                str(IndexConstants.OPTIMIZE_FILE_SIZE_THRESHOLD_DEFAULT)))
+
+    def index_cache_expiry_seconds(self) -> int:
+        return int(
+            self._conf.get(
+                IndexConstants.INDEX_CACHE_EXPIRY_DURATION_SECONDS,
+                IndexConstants.INDEX_CACHE_EXPIRY_DURATION_SECONDS_DEFAULT))
+
+    def event_logger_class(self) -> Optional[str]:
+        return self._conf.get(IndexConstants.EVENT_LOGGER_CLASS)
+
+    def file_based_source_builders(self) -> str:
+        return self._conf.get(
+            IndexConstants.FILE_BASED_SOURCE_BUILDERS,
+            "hyperspace_tpu.sources.default.DefaultFileBasedSourceBuilder")
+
+    def globbing_patterns(self) -> list:
+        raw = self._conf.get(IndexConstants.GLOBBING_PATTERN_KEY, "")
+        return [p.strip() for p in raw.split(",") if p.strip()]
+
+    def tpu_execution_enabled(self) -> bool:
+        return self._get_bool(
+            IndexConstants.TPU_EXECUTION_ENABLED,
+            IndexConstants.TPU_EXECUTION_ENABLED_DEFAULT)
+
+    def build_rows_per_shard(self) -> int:
+        return int(
+            self._conf.get(
+                IndexConstants.TPU_BUILD_ROWS_PER_SHARD,
+                IndexConstants.TPU_BUILD_ROWS_PER_SHARD_DEFAULT))
+
+    def _get_bool(self, key: str, default: str) -> bool:
+        return (self._conf.get(key, default) or "").strip().lower() == "true"

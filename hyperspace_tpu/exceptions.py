@@ -1,0 +1,12 @@
+"""Framework exceptions (parity: HyperspaceException.scala, actions/package.scala)."""
+
+from __future__ import annotations
+
+
+class HyperspaceException(Exception):
+    """Base exception for all framework errors."""
+
+
+class NoChangesException(HyperspaceException):
+    """Raised by actions when there is nothing to do; aborts the transaction
+    as a no-op (reference: actions/Action.scala NoChangesException handling)."""

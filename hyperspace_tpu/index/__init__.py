@@ -1,0 +1,8 @@
+from .constants import IndexConstants, STABLE_STATES, States  # noqa: F401
+from .data_manager import IndexDataManager  # noqa: F401
+from .log_entry import (  # noqa: F401
+    Content, CoveringIndex, DataSkippingIndex, Directory, FileIdTracker, FileInfo, Hdfs,
+    IndexLogEntry, LogEntry, LogicalPlanFingerprint, Relation, Signature, Sketch, Source,
+    SourcePlan, Update)
+from .log_manager import IndexLogManager  # noqa: F401
+from .path_resolver import PathResolver  # noqa: F401

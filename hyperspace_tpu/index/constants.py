@@ -1,0 +1,107 @@
+"""Framework-wide constants: action states, config keys, on-disk layout names.
+
+Parity reference: /root/reference src/main/scala/com/microsoft/hyperspace/actions/Constants.scala
+and index/IndexConstants.scala (keys renamed from ``spark.hyperspace.*`` to
+``hyperspace.*`` since there is no Spark session here).
+"""
+
+from __future__ import annotations
+
+
+class States:
+    """Index lifecycle states (reference: actions/Constants.scala:19-31)."""
+
+    ACTIVE = "ACTIVE"
+    CREATING = "CREATING"
+    DELETING = "DELETING"
+    DELETED = "DELETED"
+    REFRESHING = "REFRESHING"
+    VACUUMING = "VACUUMING"
+    RESTORING = "RESTORING"
+    OPTIMIZING = "OPTIMIZING"
+    DOESNOTEXIST = "DOESNOTEXIST"
+    CANCELLING = "CANCELLING"
+
+
+STABLE_STATES = frozenset({States.ACTIVE, States.DELETED, States.DOESNOTEXIST})
+
+
+class IndexConstants:
+    """Config keys + defaults (reference: index/IndexConstants.scala:21-116)."""
+
+    INDEXES_DIR = "indexes"
+
+    # Root ("system") path under which all indexes live.
+    INDEX_SYSTEM_PATH = "hyperspace.system.path"
+
+    INDEX_NUM_BUCKETS = "hyperspace.index.numBuckets"
+    INDEX_NUM_BUCKETS_DEFAULT = 200
+
+    INDEX_HYBRID_SCAN_ENABLED = "hyperspace.index.hybridscan.enabled"
+    INDEX_HYBRID_SCAN_ENABLED_DEFAULT = "false"
+
+    INDEX_HYBRID_SCAN_DELETED_RATIO_THRESHOLD = "hyperspace.index.hybridscan.maxDeletedRatio"
+    INDEX_HYBRID_SCAN_DELETED_RATIO_THRESHOLD_DEFAULT = "0.2"
+
+    INDEX_HYBRID_SCAN_APPENDED_RATIO_THRESHOLD = "hyperspace.index.hybridscan.maxAppendedRatio"
+    INDEX_HYBRID_SCAN_APPENDED_RATIO_THRESHOLD_DEFAULT = "0.3"
+
+    INDEX_FILTER_RULE_USE_BUCKET_SPEC = "hyperspace.index.filterRule.useBucketSpec"
+    INDEX_FILTER_RULE_USE_BUCKET_SPEC_DEFAULT = "false"
+
+    INDEX_CACHE_EXPIRY_DURATION_SECONDS = "hyperspace.index.cache.expiryDurationInSeconds"
+    INDEX_CACHE_EXPIRY_DURATION_SECONDS_DEFAULT = "300"
+
+    # Operation log layout.
+    HYPERSPACE_LOG = "_hyperspace_log"
+    LATEST_STABLE_LOG_NAME = "latestStable"
+    INDEX_VERSION_DIRECTORY_PREFIX = "v__"
+
+    # Explain display modes.
+    DISPLAY_MODE = "hyperspace.explain.displayMode"
+    HIGHLIGHT_BEGIN_TAG = "hyperspace.explain.displayMode.highlight.beginTag"
+    HIGHLIGHT_END_TAG = "hyperspace.explain.displayMode.highlight.endTag"
+
+    class DisplayMode:
+        CONSOLE = "console"
+        PLAIN_TEXT = "plaintext"
+        HTML = "html"
+
+    DATA_FILE_NAME_ID = "_data_file_id"
+    INDEX_LINEAGE_ENABLED = "hyperspace.index.lineage.enabled"
+    INDEX_LINEAGE_ENABLED_DEFAULT = "false"
+
+    REFRESH_MODE_INCREMENTAL = "incremental"
+    REFRESH_MODE_FULL = "full"
+    REFRESH_MODE_QUICK = "quick"
+    REFRESH_MODES = (REFRESH_MODE_INCREMENTAL, REFRESH_MODE_FULL, REFRESH_MODE_QUICK)
+
+    OPTIMIZE_FILE_SIZE_THRESHOLD = "hyperspace.index.optimize.fileSizeThreshold"
+    OPTIMIZE_FILE_SIZE_THRESHOLD_DEFAULT = 256 * 1024 * 1024
+    OPTIMIZE_MODE_QUICK = "quick"
+    OPTIMIZE_MODE_FULL = "full"
+    OPTIMIZE_MODES = (OPTIMIZE_MODE_QUICK, OPTIMIZE_MODE_FULL)
+
+    UNKNOWN_FILE_ID = -1
+
+    # JSON property names used in index metadata.
+    LINEAGE_PROPERTY = "lineage"
+    HAS_PARQUET_AS_SOURCE_FORMAT_PROPERTY = "hasParquetAsSourceFormat"
+    HYPERSPACE_VERSION_PROPERTY = "hyperspaceVersion"
+    INDEX_LOG_VERSION = "indexLogVersion"
+
+    GLOBBING_PATTERN_KEY = "hyperspace.source.globbingPattern"
+
+    # Pluggable class names (comma separated), mirrors
+    # spark.hyperspace.index.sources.fileBasedBuilders and
+    # spark.hyperspace.index.signatureProviders.
+    FILE_BASED_SOURCE_BUILDERS = "hyperspace.index.sources.fileBasedBuilders"
+    EVENT_LOGGER_CLASS = "hyperspace.eventLoggerClass"
+
+    # TPU-native execution knobs (no reference analogue: the reference delegates
+    # execution to Spark; these control the XLA/Pallas execution path).
+    TPU_EXECUTION_ENABLED = "hyperspace.tpu.execution.enabled"
+    TPU_EXECUTION_ENABLED_DEFAULT = "true"
+    TPU_BUILD_ROWS_PER_SHARD = "hyperspace.tpu.build.rowsPerShard"
+    TPU_BUILD_ROWS_PER_SHARD_DEFAULT = str(8 * 1024 * 1024)
+    TPU_MESH_SHAPE = "hyperspace.tpu.mesh"

@@ -1,0 +1,114 @@
+"""Versioned operation log with optimistic concurrency.
+
+Parity reference: index/IndexLogManager.scala:33-185. Layout under an index's
+root path:
+
+    <indexPath>/_hyperspace_log/<id>        — JSON log entry, immutable
+    <indexPath>/_hyperspace_log/latestStable — copy of the latest stable entry
+
+``write_log`` refuses to overwrite an existing id (temp file + atomic
+create-if-absent), which is how concurrent actions detect conflicts.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List, Optional
+
+from ..util import file_utils, json_utils
+from .constants import IndexConstants, STABLE_STATES, States
+from .log_entry import IndexLogEntry
+
+
+class IndexLogManager:
+    def __init__(self, index_path: str):
+        self._index_path = index_path
+        self._log_path = os.path.join(index_path, IndexConstants.HYPERSPACE_LOG)
+        self._latest_stable_path = os.path.join(
+            self._log_path, IndexConstants.LATEST_STABLE_LOG_NAME)
+
+    @property
+    def index_path(self) -> str:
+        return self._index_path
+
+    def _path_from_id(self, log_id: int) -> str:
+        return os.path.join(self._log_path, str(log_id))
+
+    def _get_log_at(self, path: str) -> Optional[IndexLogEntry]:
+        if not os.path.exists(path):
+            return None
+        return IndexLogEntry.from_json(file_utils.read_contents(path))
+
+    def get_log(self, log_id: int) -> Optional[IndexLogEntry]:
+        return self._get_log_at(self._path_from_id(log_id))
+
+    def get_latest_id(self) -> Optional[int]:
+        if not os.path.isdir(self._log_path):
+            return None
+        ids = [int(name) for name in os.listdir(self._log_path) if name.isdigit()]
+        return max(ids) if ids else None
+
+    def get_latest_log(self) -> Optional[IndexLogEntry]:
+        latest = self.get_latest_id()
+        return self.get_log(latest) if latest is not None else None
+
+    def get_latest_stable_log(self) -> Optional[IndexLogEntry]:
+        """Latest entry in a STABLE state; falls back to a backward scan past a
+        broken tail (reference: IndexLogManager.scala:93-117)."""
+        log = self._get_log_at(self._latest_stable_path)
+        if log is not None and log.state not in STABLE_STATES:
+            # A stale/invalid latestStable (e.g. crash between write_log and
+            # create_latest_stable_log); fall back to the backward scan.
+            log = None
+        if log is None:
+            latest = self.get_latest_id()
+            if latest is not None:
+                for log_id in range(latest, -1, -1):
+                    entry = self.get_log(log_id)
+                    if entry is not None and entry.state in STABLE_STATES:
+                        return entry
+                    if entry is not None and entry.state in (
+                            States.CREATING, States.VACUUMING):
+                        # Logs before a CREATING/VACUUMING entry are unrelated.
+                        return None
+            return None
+        return log
+
+    def get_index_versions(self, states: List[str]) -> List[int]:
+        """Index log versions whose state is in ``states``, newest first,
+        stopping at the most recent CREATING/VACUUMING boundary."""
+        latest = self.get_latest_id()
+        if latest is None:
+            return []
+        versions: List[int] = []
+        for log_id in range(latest, -1, -1):
+            entry = self.get_log(log_id)
+            if entry is None:
+                continue
+            if entry.state in states:
+                versions.append(entry.log_version)
+            if entry.state in (States.CREATING, States.VACUUMING) and log_id != latest:
+                break
+        return versions
+
+    def create_latest_stable_log(self, log_id: int) -> bool:
+        entry = self.get_log(log_id)
+        if entry is None or entry.state not in STABLE_STATES:
+            return False
+        file_utils.atomic_overwrite(
+            self._latest_stable_path, json_utils.to_json(entry.to_json_dict()))
+        return True
+
+    def delete_latest_stable_log(self) -> bool:
+        try:
+            if os.path.exists(self._latest_stable_path):
+                os.unlink(self._latest_stable_path)
+            return True
+        except OSError:
+            return False
+
+    def write_log(self, log_id: int, entry: IndexLogEntry) -> bool:
+        """Write entry at ``log_id`` iff that id doesn't exist yet."""
+        entry.id = log_id
+        return file_utils.atomic_create(
+            self._path_from_id(log_id), json_utils.to_json(entry.to_json_dict()))

@@ -1,0 +1,24 @@
+"""Resolves index names to paths under the system path.
+
+Parity reference: index/PathResolver.scala:39.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import TYPE_CHECKING
+
+if TYPE_CHECKING:
+    from ..config import HyperspaceConf
+
+
+class PathResolver:
+    def __init__(self, conf: "HyperspaceConf"):
+        self._conf = conf
+
+    @property
+    def system_path(self) -> str:
+        return self._conf.system_path()
+
+    def get_index_path(self, name: str) -> str:
+        return os.path.join(self.system_path, name)

@@ -1,0 +1,1 @@
+from . import file_utils, hashing, json_utils  # noqa: F401

@@ -1,0 +1,11 @@
+"""Hashing helpers (parity: util/HashingUtils.scala — md5-based fingerprints)."""
+
+from __future__ import annotations
+
+import hashlib
+from typing import Any
+
+
+def md5_hex(value: Any) -> str:
+    """md5 hex digest of ``str(value)`` (reference: HashingUtils.md5Hex)."""
+    return hashlib.md5(str(value).encode("utf-8")).hexdigest()

@@ -1,0 +1,21 @@
+"""JSON (de)serialization for the metadata model.
+
+Parity: util/JsonUtils.scala. The reference uses Jackson with a custom Scala
+module; here every metadata class implements ``to_json_dict`` /
+``from_json_dict`` and this module handles the envelope.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any
+
+
+def to_json(obj: Any, indent: int = 2) -> str:
+    if hasattr(obj, "to_json_dict"):
+        obj = obj.to_json_dict()
+    return json.dumps(obj, indent=indent, sort_keys=False)
+
+
+def from_json(text: str) -> Any:
+    return json.loads(text)

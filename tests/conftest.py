@@ -1,0 +1,26 @@
+"""Test harness configuration.
+
+Tests run on a virtual 8-device CPU mesh (the reference validates its
+distribution semantics on single-process Spark local[4]; our equivalent is
+XLA's host-platform device virtualization — see SURVEY.md §4). The real-TPU
+path is exercised by bench.py, not the unit tests.
+
+Env vars must be set before jax is imported anywhere.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture()
+def tmp_system_path(tmp_path):
+    """A fresh hyperspace system path per test."""
+    p = tmp_path / "indexes"
+    p.mkdir()
+    return str(p)

@@ -1,0 +1,140 @@
+"""IndexLogEntry JSON round-trip + FileIdTracker tests.
+
+Parity: reference IndexLogEntryTest.scala / FileIdTrackerTest.scala.
+"""
+
+import pytest
+
+from hyperspace_tpu.exceptions import HyperspaceException
+from hyperspace_tpu.index.constants import IndexConstants, States
+from hyperspace_tpu.index.log_entry import (
+    Content, CoveringIndex, DataSkippingIndex, Directory, FileIdTracker, FileInfo, Hdfs,
+    IndexLogEntry, LogicalPlanFingerprint, Relation, Signature, Sketch, Source, SourcePlan,
+    Update)
+from hyperspace_tpu.schema import Field, Schema
+
+
+def make_content(prefix, names, tracker=None, sizes=None):
+    files = [FileInfo(n, (sizes or {}).get(n, 10), 100, i) for i, n in enumerate(names)]
+    root = Directory("/", [], [Directory(prefix.strip("/"), files, [])])
+    return Content(root)
+
+
+def make_entry(name="idx1", state=States.ACTIVE):
+    schema = Schema([Field("a", "int64", False), Field("b", "string")])
+    ci = CoveringIndex(["a"], ["b"], schema, 8, {IndexConstants.LINEAGE_PROPERTY: "true"})
+    src_content = make_content("/data", ["f1.parquet", "f2.parquet"])
+    rel = Relation(["/data"], Hdfs(src_content), schema, "parquet", {"opt": "1"})
+    fingerprint = LogicalPlanFingerprint(
+        [Signature("FileBasedSignatureProvider", "abc123")])
+    source = Source(SourcePlan([rel], fingerprint))
+    idx_content = make_content("/indexes/idx1/v__=0", ["part0.parquet", "part1.parquet"])
+    entry = IndexLogEntry.create(name, ci, idx_content, source, {})
+    entry.state = state
+    entry.id = 1
+    return entry
+
+
+class TestIndexLogEntry:
+    def test_json_round_trip(self):
+        entry = make_entry()
+        text = entry.to_json()
+        back = IndexLogEntry.from_json(text)
+        assert back.name == entry.name
+        assert back.state == States.ACTIVE
+        assert back.id == 1
+        assert back.derivedDataset.indexed_columns == ["a"]
+        assert back.derivedDataset.included_columns == ["b"]
+        assert back.derivedDataset.num_buckets == 8
+        assert back.schema.names == ["a", "b"]
+        assert back.relation.fileFormat == "parquet"
+        assert back.relation.options == {"opt": "1"}
+        assert back.signature.signatures[0].value == "abc123"
+        assert back.has_lineage_column()
+        assert back.properties[IndexConstants.HYPERSPACE_VERSION_PROPERTY]
+        # Round-trip again for stability.
+        assert IndexLogEntry.from_json(back.to_json()).to_json() == text
+
+    def test_dataskipping_round_trip(self):
+        schema = Schema([Field("file_id", "int64", False), Field("min_a", "int64")])
+        ds = DataSkippingIndex([Sketch("MinMax", "a"), Sketch("BloomFilter", "b")], schema)
+        entry = make_entry()
+        entry.derivedDataset = ds
+        back = IndexLogEntry.from_json(entry.to_json())
+        assert back.derivedDataset.kind == "DataSkippingIndex"
+        assert [s.kind for s in back.derivedDataset.sketches] == ["MinMax", "BloomFilter"]
+        assert back.derivedDataset.indexed_columns == ["a", "b"]
+
+    def test_file_info_equality_ignores_id(self):
+        a = FileInfo("f", 1, 2, 10)
+        b = FileInfo("f", 1, 2, 99)
+        assert a == b and hash(a) == hash(b)
+        assert a != FileInfo("f", 1, 3, 10)
+
+    def test_content_files_and_fileinfos(self):
+        c = make_content("/data", ["f1", "f2"])
+        assert sorted(c.files) == ["/data/f1", "/data/f2"]
+        infos = c.file_infos
+        assert {f.name for f in infos} == {"/data/f1", "/data/f2"}
+
+    def test_update_round_trip(self):
+        entry = make_entry()
+        appended = make_content("/data", ["f3"])
+        entry.relation.data.update = Update(appendedFiles=appended)
+        back = IndexLogEntry.from_json(entry.to_json())
+        assert {f.name for f in back.appended_files} == {"/data/f3"}
+        assert back.deleted_files == set()
+
+    def test_directory_merge(self):
+        d1 = Directory("/", [], [Directory("a", [FileInfo("x", 1, 1, 0)], [])])
+        d2 = Directory("/", [], [Directory("a", [FileInfo("y", 1, 1, 1)], []),
+                                 Directory("b", [FileInfo("z", 1, 1, 2)], [])])
+        merged = d1.merge(d2)
+        names = {d.name for d in merged.subDirs}
+        assert names == {"a", "b"}
+        a = next(d for d in merged.subDirs if d.name == "a")
+        assert {f.name for f in a.files} == {"x", "y"}
+
+    def test_directory_merge_name_mismatch(self):
+        with pytest.raises(HyperspaceException):
+            Directory("a").merge(Directory("b"))
+
+
+class TestFileIdTracker:
+    def test_add_file_assigns_sequential_ids(self):
+        t = FileIdTracker()
+        assert t.add_file("/p/f1", 10, 100) == 0
+        assert t.add_file("/p/f2", 10, 100) == 1
+        # Same triple → same id.
+        assert t.add_file("/p/f1", 10, 100) == 0
+        # Changed mtime → new id.
+        assert t.add_file("/p/f1", 10, 101) == 2
+        assert t.max_file_id == 2
+
+    def test_add_file_info_conflict(self):
+        t = FileIdTracker()
+        t.add_file_info({FileInfo("/p/f1", 10, 100, 5)})
+        assert t.max_file_id == 5
+        with pytest.raises(HyperspaceException):
+            t.add_file_info({FileInfo("/p/f1", 10, 100, 6)})
+
+    def test_add_file_info_unknown_id(self):
+        t = FileIdTracker()
+        with pytest.raises(HyperspaceException):
+            t.add_file_info({FileInfo("/p/f1", 10, 100, IndexConstants.UNKNOWN_FILE_ID)})
+
+
+class TestDirectoryFromLeafFiles:
+    def test_tree_structure(self, tmp_path):
+        (tmp_path / "a").mkdir()
+        (tmp_path / "a" / "f1.parquet").write_text("x" * 10)
+        (tmp_path / "a" / "f2.parquet").write_text("y" * 20)
+        (tmp_path / "b").mkdir()
+        (tmp_path / "b" / "f3.parquet").write_text("z" * 30)
+        tracker = FileIdTracker()
+        content = Content.from_directory(str(tmp_path), tracker)
+        files = sorted(content.files)
+        assert [f.split("/")[-1] for f in files] == ["f1.parquet", "f2.parquet", "f3.parquet"]
+        sizes = {f.name.split("/")[-1]: f.size for f in content.file_infos}
+        assert sizes == {"f1.parquet": 10, "f2.parquet": 20, "f3.parquet": 30}
+        assert tracker.max_file_id == 2

@@ -1,0 +1,80 @@
+"""IndexLogManager semantics (parity: IndexLogManagerImplTest.scala)."""
+
+import os
+
+from hyperspace_tpu.index.constants import States
+from hyperspace_tpu.index.data_manager import IndexDataManager
+from hyperspace_tpu.index.log_manager import IndexLogManager
+
+from test_log_entry import make_entry
+
+
+class TestIndexLogManager:
+    def test_write_and_get(self, tmp_path):
+        mgr = IndexLogManager(str(tmp_path))
+        entry = make_entry(state=States.CREATING)
+        assert mgr.write_log(0, entry)
+        got = mgr.get_log(0)
+        assert got is not None and got.state == States.CREATING and got.id == 0
+
+    def test_write_existing_id_fails(self, tmp_path):
+        mgr = IndexLogManager(str(tmp_path))
+        assert mgr.write_log(0, make_entry())
+        assert not mgr.write_log(0, make_entry())
+
+    def test_latest_id(self, tmp_path):
+        mgr = IndexLogManager(str(tmp_path))
+        assert mgr.get_latest_id() is None
+        for i in (0, 1, 2):
+            assert mgr.write_log(i, make_entry())
+        assert mgr.get_latest_id() == 2
+        assert mgr.get_latest_log().id == 2
+
+    def test_latest_stable_backward_scan(self, tmp_path):
+        mgr = IndexLogManager(str(tmp_path))
+        e0 = make_entry(state=States.CREATING)
+        e1 = make_entry(state=States.ACTIVE)
+        e2 = make_entry(state=States.REFRESHING)
+        for i, e in enumerate([e0, e1, e2]):
+            assert mgr.write_log(i, e)
+        stable = mgr.get_latest_stable_log()
+        assert stable is not None and stable.state == States.ACTIVE and stable.id == 1
+
+    def test_latest_stable_stops_at_creating(self, tmp_path):
+        mgr = IndexLogManager(str(tmp_path))
+        assert mgr.write_log(0, make_entry(state=States.CREATING))
+        assert mgr.get_latest_stable_log() is None
+
+    def test_create_latest_stable_log(self, tmp_path):
+        mgr = IndexLogManager(str(tmp_path))
+        assert mgr.write_log(0, make_entry(state=States.ACTIVE))
+        assert mgr.create_latest_stable_log(0)
+        stable = mgr.get_latest_stable_log()
+        assert stable.state == States.ACTIVE
+        # Non-stable id refused.
+        assert mgr.write_log(1, make_entry(state=States.REFRESHING))
+        assert not mgr.create_latest_stable_log(1)
+        assert mgr.delete_latest_stable_log()
+        # Falls back to backward scan after deletion.
+        assert mgr.get_latest_stable_log().id == 0
+
+    def test_get_index_versions(self, tmp_path):
+        mgr = IndexLogManager(str(tmp_path))
+        e0 = make_entry(state=States.ACTIVE).with_log_version(0)
+        e1 = make_entry(state=States.REFRESHING).with_log_version(1)
+        e2 = make_entry(state=States.ACTIVE).with_log_version(1)
+        for i, e in enumerate([e0, e1, e2]):
+            assert mgr.write_log(i, e)
+        assert mgr.get_index_versions([States.ACTIVE]) == [1, 0]
+
+
+class TestIndexDataManager:
+    def test_versions(self, tmp_path):
+        mgr = IndexDataManager(str(tmp_path))
+        assert mgr.get_latest_version_id() is None
+        os.makedirs(mgr.get_path(0))
+        os.makedirs(mgr.get_path(3))
+        assert mgr.get_all_version_ids() == [0, 3]
+        assert mgr.get_latest_version_id() == 3
+        mgr.delete(3)
+        assert mgr.get_latest_version_id() == 0
