@@ -2,8 +2,10 @@
 
 Mirrors the reference's conf-string approach (util/HyperspaceConf.scala:26-118,
 util/CacheWithTransform.scala): every knob is a string conf read lazily per
-call, so values are runtime-changeable; derived values are cached keyed on the
-raw conf string.
+call, so values are runtime-changeable. Expensive derived values (e.g. the
+source-provider manager built from a class-name list) go through
+CacheWithTransform, which re-derives only when the raw conf string changes;
+cheap scalar accessors just re-parse per call.
 """
 
 from __future__ import annotations

@@ -1,0 +1,14 @@
+"""Execution engine package.
+
+x64 is enabled globally: index keys are int64 in the lake formats we mirror
+(TPC-H orderkeys overflow int32 at scale) and aggregate accumulation is
+float64 for parity with CPU engines. XLA lowers 64-bit ops on TPU; narrow
+dtypes are used wherever the data allows (see columnar.py int32 narrowing).
+"""
+
+import jax
+
+jax.config.update("jax_enable_x64", True)
+
+from .columnar import Column, Table, read_parquet, write_parquet  # noqa: F401,E402
+from .executor import execute  # noqa: F401,E402

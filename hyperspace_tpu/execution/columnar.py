@@ -1,0 +1,356 @@
+"""Device-resident columnar tables.
+
+This is the TPU-native data representation the whole engine computes over:
+every column is a fixed-width JAX array in HBM. Variable-length strings are
+dictionary-encoded **order-preserving** at the host→device boundary (codes
+compare like the strings they stand for, so range predicates and sorts work
+directly on codes — SURVEY §7 hard-part #2). Dates are int32 days; decimals
+become float64.
+
+Host↔device crossings happen only at parquet read/write and at collect().
+"""
+
+from __future__ import annotations
+
+import datetime
+from dataclasses import dataclass, field as dc_field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pyarrow as pa
+import pyarrow.compute as pc
+import pyarrow.parquet as pq
+
+from ..exceptions import HyperspaceException
+from ..schema import BOOL, DATE, FLOAT32, FLOAT64, INT32, INT64, STRING, Field, Schema
+
+_DEVICE_DTYPE = {
+    INT32: jnp.int32,
+    INT64: jnp.int64,
+    FLOAT32: jnp.float32,
+    FLOAT64: jnp.float64,
+    BOOL: jnp.bool_,
+    DATE: jnp.int32,
+    STRING: jnp.int32,  # dictionary codes.
+}
+
+
+@dataclass
+class Column:
+    """One device column: values (or dictionary codes) + optional validity."""
+
+    dtype: str  # logical type name from schema.py
+    data: jax.Array
+    validity: Optional[jax.Array] = None  # bool, True = valid; None = all valid
+    dictionary: Optional[np.ndarray] = None  # sorted unique strings (host)
+
+    def __post_init__(self):
+        if self.dtype == STRING and self.dictionary is None:
+            raise HyperspaceException("STRING columns require a dictionary")
+
+    def __len__(self):
+        return int(self.data.shape[0])
+
+    @property
+    def has_nulls(self) -> bool:
+        return self.validity is not None
+
+    def take(self, indices) -> "Column":
+        return Column(self.dtype, jnp.take(self.data, indices, axis=0),
+                      None if self.validity is None
+                      else jnp.take(self.validity, indices, axis=0),
+                      self.dictionary)
+
+    def filter(self, mask) -> "Column":
+        return Column(self.dtype, self.data[mask],
+                      None if self.validity is None else self.validity[mask],
+                      self.dictionary)
+
+    def slice(self, start: int, stop: int) -> "Column":
+        return Column(self.dtype, self.data[start:stop],
+                      None if self.validity is None else self.validity[start:stop],
+                      self.dictionary)
+
+
+@dataclass
+class Table:
+    """An ordered set of equal-length device columns."""
+
+    columns: Dict[str, Column]
+
+    def __post_init__(self):
+        lengths = {len(c) for c in self.columns.values()}
+        if len(lengths) > 1:
+            raise HyperspaceException(f"Ragged table: column lengths {lengths}")
+
+    @property
+    def num_rows(self) -> int:
+        if not self.columns:
+            return 0
+        return len(next(iter(self.columns.values())))
+
+    @property
+    def names(self) -> List[str]:
+        return list(self.columns.keys())
+
+    def column(self, name: str) -> Column:
+        if name not in self.columns:
+            raise HyperspaceException(
+                f"Unknown column '{name}'; available: {self.names}")
+        return self.columns[name]
+
+    def schema(self) -> Schema:
+        return Schema([Field(n, c.dtype, c.has_nulls)
+                       for n, c in self.columns.items()])
+
+    def select(self, names: Sequence[str]) -> "Table":
+        return Table({n: self.column(n) for n in names})
+
+    def take(self, indices) -> "Table":
+        return Table({n: c.take(indices) for n, c in self.columns.items()})
+
+    def filter(self, mask) -> "Table":
+        return Table({n: c.filter(mask) for n, c in self.columns.items()})
+
+    def slice(self, start: int, stop: int) -> "Table":
+        return Table({n: c.slice(start, stop) for n, c in self.columns.items()})
+
+    def with_column(self, name: str, col: Column) -> "Table":
+        out = dict(self.columns)
+        out[name] = col
+        return Table(out)
+
+    def rename(self, mapping: Dict[str, str]) -> "Table":
+        return Table({mapping.get(n, n): c for n, c in self.columns.items()})
+
+    @staticmethod
+    def concat(tables: Sequence["Table"]) -> "Table":
+        """Union of schema-aligned tables; string dictionaries are re-unified."""
+        tables = [t for t in tables if t.num_rows > 0] or list(tables[:1])
+        if len(tables) == 1:
+            return tables[0]
+        first = tables[0]
+        out: Dict[str, Column] = {}
+        for name in first.names:
+            cols = [t.column(name) for t in tables]
+            dtype = cols[0].dtype
+            if any(c.dtype != dtype for c in cols):
+                raise HyperspaceException(f"concat dtype mismatch on '{name}'")
+            if dtype == STRING:
+                out[name] = _concat_string_columns(cols)
+            else:
+                data = jnp.concatenate([c.data for c in cols])
+                validity = None
+                if any(c.validity is not None for c in cols):
+                    validity = jnp.concatenate([
+                        c.validity if c.validity is not None
+                        else jnp.ones(len(c), dtype=jnp.bool_) for c in cols])
+                out[name] = Column(dtype, data, validity)
+        return Table(out)
+
+    # ------------------------------------------------------------------
+    # Host boundary.
+    # ------------------------------------------------------------------
+
+    def to_arrow(self) -> pa.Table:
+        arrays = []
+        for name, col in self.columns.items():
+            np_data = np.asarray(jax.device_get(col.data))
+            np_valid = (np.asarray(jax.device_get(col.validity))
+                        if col.validity is not None else None)
+            mask = None if np_valid is None else ~np_valid
+            if col.dtype == STRING:
+                codes = np_data
+                safe = np.where(codes >= 0, codes, 0)
+                values = col.dictionary[safe] if len(col.dictionary) else \
+                    np.array([""] * len(codes), dtype=object)
+                arr = pa.array(values, type=pa.string(),
+                               mask=mask if mask is not None else (codes < 0))
+            elif col.dtype == DATE:
+                arr = pa.array(np_data.astype("int32"), type=pa.int32(), mask=mask)
+                arr = arr.cast(pa.date32())
+            elif col.dtype == BOOL:
+                arr = pa.array(np_data.astype(bool), mask=mask)
+            else:
+                arr = pa.array(np_data, mask=mask)
+            arrays.append((name, arr))
+        return pa.table(dict(arrays))
+
+    def to_pandas(self):
+        return self.to_arrow().to_pandas()
+
+    @staticmethod
+    def from_arrow(table: pa.Table) -> "Table":
+        cols: Dict[str, Column] = {}
+        for name in table.column_names:
+            cols[name] = _encode_arrow_column(table.column(name))
+        return Table(cols)
+
+
+# ---------------------------------------------------------------------------
+# Encoding.
+# ---------------------------------------------------------------------------
+
+def _encode_arrow_column(chunked: pa.ChunkedArray) -> Column:
+    t = chunked.type
+    if pa.types.is_dictionary(t):
+        chunked = chunked.cast(t.value_type)
+        t = t.value_type
+    if pa.types.is_string(t) or pa.types.is_large_string(t):
+        return _encode_string(chunked)
+    combined = chunked.combine_chunks() if chunked.num_chunks != 1 else chunked.chunk(0)
+    null_count = combined.null_count
+    if pa.types.is_date32(t):
+        np_data = combined.cast(pa.int32()).to_numpy(zero_copy_only=False)
+        dtype = DATE
+    elif pa.types.is_decimal(t):
+        np_data = combined.cast(pa.float64()).to_numpy(zero_copy_only=False)
+        dtype = FLOAT64
+    elif pa.types.is_timestamp(t):
+        np_data = combined.cast(pa.int64()).to_numpy(zero_copy_only=False)
+        dtype = INT64
+    elif pa.types.is_boolean(t):
+        np_data = combined.to_numpy(zero_copy_only=False)
+        dtype = BOOL
+    elif pa.types.is_integer(t):
+        wide = combined.cast(pa.int64()).to_numpy(zero_copy_only=False)
+        if t.bit_width <= 32:
+            np_data, dtype = wide.astype(np.int32), INT32
+        else:
+            np_data, dtype = wide, INT64
+    elif pa.types.is_floating(t):
+        np_data = combined.to_numpy(zero_copy_only=False)
+        dtype = FLOAT32 if t.bit_width == 32 else FLOAT64
+    else:
+        raise HyperspaceException(f"Unsupported arrow type: {t}")
+
+    validity = None
+    if null_count:
+        valid_np = ~np.asarray(combined.is_null())
+        fill = 0
+        np_data = np.where(valid_np, np.nan_to_num(np_data, nan=fill)
+                           if np_data.dtype.kind == "f" else np_data, fill)
+        validity = jnp.asarray(valid_np)
+    target = _DEVICE_DTYPE[dtype]
+    return Column(dtype, jnp.asarray(np.ascontiguousarray(np_data), dtype=target),
+                  validity)
+
+
+def _encode_string(chunked: pa.ChunkedArray) -> Column:
+    """Order-preserving dictionary encoding: codes sort like the strings."""
+    combined = chunked.combine_chunks() if chunked.num_chunks != 1 else chunked.chunk(0)
+    uniques = pc.unique(combined.drop_null())
+    dictionary = np.sort(np.asarray(uniques).astype(str)) if len(uniques) else \
+        np.array([], dtype=str)
+    values = np.asarray(combined.fill_null("")).astype(str)
+    codes = np.searchsorted(dictionary, values).astype(np.int32) \
+        if len(dictionary) else np.zeros(len(values), np.int32)
+    validity = None
+    if combined.null_count:
+        valid_np = ~np.asarray(combined.is_null())
+        codes = np.where(valid_np, codes, -1).astype(np.int32)
+        validity = jnp.asarray(valid_np)
+    return Column(STRING, jnp.asarray(codes), validity, dictionary)
+
+
+def _concat_string_columns(cols: List[Column]) -> Column:
+    """Re-unify dictionaries so codes stay order-preserving across parts."""
+    merged = np.unique(np.concatenate([c.dictionary for c in cols])) \
+        if any(len(c.dictionary) for c in cols) else np.array([], dtype=str)
+    datas, validities, any_valid = [], [], False
+    for c in cols:
+        remap = np.searchsorted(merged, c.dictionary).astype(np.int32) \
+            if len(c.dictionary) else np.zeros(0, np.int32)
+        remap_dev = jnp.asarray(remap)
+        codes = jnp.where(c.data >= 0,
+                          jnp.take(remap_dev, jnp.maximum(c.data, 0)), -1) \
+            if len(remap) else c.data
+        datas.append(codes)
+        v = c.validity if c.validity is not None else jnp.ones(len(c), jnp.bool_)
+        validities.append(v)
+        any_valid = any_valid or c.validity is not None
+    data = jnp.concatenate(datas)
+    validity = jnp.concatenate(validities) if any_valid else None
+    return Column(STRING, data, validity, merged)
+
+
+# ---------------------------------------------------------------------------
+# Parquet IO.
+# ---------------------------------------------------------------------------
+
+def read_parquet(files: Sequence[str], columns: Optional[Sequence[str]] = None,
+                 fmt: str = "parquet") -> Table:
+    if not files:
+        raise HyperspaceException("read_parquet: no files")
+    if fmt == "parquet":
+        at = pq.read_table(list(files), columns=list(columns) if columns else None)
+    elif fmt == "csv":
+        import pyarrow.csv as pa_csv
+        tables = [pa_csv.read_csv(f) for f in files]
+        at = pa.concat_tables(tables)
+        if columns:
+            at = at.select(list(columns))
+    else:
+        raise HyperspaceException(f"Unsupported format: {fmt}")
+    return Table.from_arrow(at)
+
+
+def write_parquet(table: Table, path: str, row_group_size: Optional[int] = None) -> None:
+    pq.write_table(table.to_arrow(), path, row_group_size=row_group_size)
+
+
+def dictionaries_equal(a: Optional[np.ndarray], b: Optional[np.ndarray]) -> bool:
+    return a is b or (a is not None and b is not None
+                      and len(a) == len(b) and bool(np.array_equal(a, b)))
+
+
+def translate_codes(target_dictionary: np.ndarray, col: Column):
+    """Re-map a STRING column's codes into ``target_dictionary``'s code space.
+
+    Strings absent from the target dictionary map to -2, which equals no
+    valid code (and no null code, -1) — equality against translated codes is
+    therefore exact. Shared by cross-dictionary comparisons and string-key
+    joins.
+    """
+    src = col.dictionary
+    if len(src) == 0:
+        return jnp.full(col.data.shape, -2, jnp.int32)
+    if len(target_dictionary) == 0:
+        return jnp.full(col.data.shape, -2, jnp.int32)
+    pos = np.searchsorted(target_dictionary, src)
+    pos_c = np.clip(pos, 0, len(target_dictionary) - 1)
+    present = (pos < len(target_dictionary)) & (target_dictionary[pos_c] == src)
+    mapping = np.where(present, pos_c, -2).astype(np.int32)
+    mapping_dev = jnp.asarray(mapping)
+    return jnp.where(col.data >= 0,
+                     jnp.take(mapping_dev, jnp.maximum(col.data, 0)), -2)
+
+
+def literal_to_device(value, dtype: str, dictionary: Optional[np.ndarray]):
+    """Encode a python literal for comparison against a device column.
+
+    For STRING columns returns ``(lo, hi)`` searchsorted bounds into the
+    dictionary: lo == searchsorted(dict, v, 'left'), hi == 'right' — every
+    comparison op can be phrased over codes with these two ints (see
+    ops/kernels.py:compare_literal).
+    """
+    if dtype == STRING:
+        if dictionary is None:
+            raise HyperspaceException("string literal against non-string column")
+        v = str(value)
+        lo = int(np.searchsorted(dictionary, v, side="left"))
+        hi = int(np.searchsorted(dictionary, v, side="right"))
+        return lo, hi
+    if dtype == DATE:
+        if isinstance(value, str):
+            value = datetime.date.fromisoformat(value)
+        if isinstance(value, datetime.date):
+            return int((value - datetime.date(1970, 1, 1)).days)
+        return int(value)
+    if dtype == BOOL:
+        return bool(value)
+    if dtype in (FLOAT32, FLOAT64):
+        return float(value)
+    return int(value)

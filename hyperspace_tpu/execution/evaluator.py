@@ -1,0 +1,207 @@
+"""Vectorized expression evaluation over device tables.
+
+Null semantics follow SQL-for-filters: a comparison touching a null evaluates
+to null, and Filter keeps only rows whose predicate is true-and-valid. We
+track validity alongside values and fold it in at mask time.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..exceptions import HyperspaceException
+from ..plan import expr as E
+from ..schema import BOOL, DATE, FLOAT64, INT64, STRING
+from .columnar import (Column, Table, dictionaries_equal, literal_to_device,
+                       translate_codes)
+
+_COMPARISONS = (E.EqualTo, E.LessThan, E.LessThanOrEqual, E.GreaterThan,
+                E.GreaterThanOrEqual)
+
+
+def eval_predicate_mask(table: Table, condition: E.Expr) -> jnp.ndarray:
+    """Boolean keep-mask for a filter condition."""
+    col = eval_expr(table, condition)
+    if col.dtype != BOOL:
+        raise HyperspaceException(f"Filter condition is not boolean: {condition!r}")
+    mask = col.data
+    if col.validity is not None:
+        mask = mask & col.validity
+    return mask
+
+
+def eval_expr(table: Table, e: E.Expr) -> Column:
+    if isinstance(e, E.Col):
+        return table.column(e.column)
+    if isinstance(e, E.Alias):
+        return eval_expr(table, e.child)
+    if isinstance(e, E.Lit):
+        raise HyperspaceException(
+            "Bare literals must appear inside a comparison/arithmetic expression")
+    if isinstance(e, _COMPARISONS):
+        return _eval_comparison(table, e)
+    if isinstance(e, (E.And, E.Or)):
+        left = eval_expr(table, e.left)
+        right = eval_expr(table, e.right)
+        # Kleene 3-valued logic: TRUE OR NULL = TRUE, FALSE AND NULL = FALSE.
+        lv = left.validity if left.validity is not None \
+            else jnp.ones(len(left), jnp.bool_)
+        rv = right.validity if right.validity is not None \
+            else jnp.ones(len(right), jnp.bool_)
+        lt, lf = lv & left.data, lv & ~left.data
+        rt, rf = rv & right.data, rv & ~right.data
+        if isinstance(e, E.And):
+            true, false = lt & rt, lf | rf
+        else:
+            true, false = lt | rt, lf & rf
+        known = true | false
+        validity = None if (left.validity is None and right.validity is None) \
+            else known
+        return Column(BOOL, true, validity)
+    if isinstance(e, E.Not):
+        c = eval_expr(table, e.child)
+        return Column(BOOL, ~c.data, c.validity)
+    if isinstance(e, E.In):
+        return _eval_in(table, e)
+    if isinstance(e, (E.Add, E.Subtract, E.Multiply, E.Divide)):
+        return _eval_arith(table, e)
+    raise HyperspaceException(f"Cannot evaluate expression: {e!r}")
+
+
+def _merge_validity(a, b):
+    if a is None:
+        return b
+    if b is None:
+        return a
+    return a & b
+
+
+def _eval_comparison(table: Table, e) -> Column:
+    left, right = e.left, e.right
+    flipped = False
+    if isinstance(left, E.Lit) and not isinstance(right, E.Lit):
+        left, right = right, left
+        flipped = True
+    if isinstance(right, E.Lit):
+        col = eval_expr(table, left)
+        op = _op_name(e, flipped)
+        data = compare_literal(col, op, right.value)
+        return Column(BOOL, data, col.validity)
+    # column vs column.
+    lc = eval_expr(table, left)
+    rc = eval_expr(table, right)
+    ld, rd = _align_for_compare(lc, rc, type(e).__name__)
+    op = _op_name(e, False)
+    data = {
+        "EqualTo": lambda: ld == rd,
+        "LessThan": lambda: ld < rd,
+        "LessThanOrEqual": lambda: ld <= rd,
+        "GreaterThan": lambda: ld > rd,
+        "GreaterThanOrEqual": lambda: ld >= rd,
+    }[op]()
+    return Column(BOOL, data, _merge_validity(lc.validity, rc.validity))
+
+
+def _op_name(e, flipped: bool) -> str:
+    name = type(e).__name__
+    if not flipped:
+        return name
+    return {
+        "EqualTo": "EqualTo",
+        "LessThan": "GreaterThan",
+        "LessThanOrEqual": "GreaterThanOrEqual",
+        "GreaterThan": "LessThan",
+        "GreaterThanOrEqual": "LessThanOrEqual",
+    }[name]
+
+
+def compare_literal(col: Column, op: str, value) -> jnp.ndarray:
+    """Compare a device column against a host literal.
+
+    Strings use searchsorted (lo, hi) bounds into the order-preserving
+    dictionary, so every op is an integer comparison on codes.
+    """
+    if col.dtype == STRING:
+        lo, hi = literal_to_device(value, STRING, col.dictionary)
+        codes = col.data
+        if op == "EqualTo":
+            if lo == hi:  # literal not present.
+                return jnp.zeros(codes.shape[0], jnp.bool_)
+            return codes == lo
+        if op == "LessThan":
+            return codes < lo
+        if op == "LessThanOrEqual":
+            return codes < hi
+        if op == "GreaterThan":
+            return codes >= hi
+        if op == "GreaterThanOrEqual":
+            return codes >= lo
+        raise HyperspaceException(f"Unknown op {op}")
+    lit = literal_to_device(value, col.dtype, None)
+    data = col.data
+    return {
+        "EqualTo": lambda: data == lit,
+        "LessThan": lambda: data < lit,
+        "LessThanOrEqual": lambda: data <= lit,
+        "GreaterThan": lambda: data > lit,
+        "GreaterThanOrEqual": lambda: data >= lit,
+    }[op]()
+
+
+def _align_for_compare(lc: Column, rc: Column, op_name: str):
+    if lc.dtype == STRING or rc.dtype == STRING:
+        if lc.dtype != STRING or rc.dtype != STRING:
+            raise HyperspaceException("Cannot compare string with non-string")
+        if dictionaries_equal(lc.dictionary, rc.dictionary):
+            return lc.data, rc.data
+        if op_name != "EqualTo":
+            raise HyperspaceException(
+                "Ordering comparison across different string dictionaries "
+                "is not supported yet")
+        return lc.data, translate_codes(lc.dictionary, rc)
+    return lc.data, rc.data
+
+
+def _eval_in(table: Table, e: E.In) -> Column:
+    col = eval_expr(table, e.value)
+    values = [opt.value for opt in e.options]
+    if not values:
+        return Column(BOOL, jnp.zeros(len(col), jnp.bool_), col.validity)
+    mask = compare_literal(col, "EqualTo", values[0])
+    for v in values[1:]:
+        mask = mask | compare_literal(col, "EqualTo", v)
+    return Column(BOOL, mask, col.validity)
+
+
+def _eval_arith(table: Table, e) -> Column:
+    def operand(x) -> Tuple:
+        if isinstance(x, E.Lit):
+            return None, x.value
+        c = eval_expr(table, x)
+        if c.dtype == STRING:
+            raise HyperspaceException("Arithmetic on string column")
+        return c, None
+
+    lcol, lval = operand(e.left)
+    rcol, rval = operand(e.right)
+    if lcol is None and rcol is None:
+        raise HyperspaceException("Arithmetic between two literals")
+    ld = lcol.data if lcol is not None else lval
+    rd = rcol.data if rcol is not None else rval
+    if isinstance(e, E.Add):
+        data = ld + rd
+    elif isinstance(e, E.Subtract):
+        data = ld - rd
+    elif isinstance(e, E.Multiply):
+        data = ld * rd
+    else:
+        data = jnp.asarray(ld, jnp.float64) / rd
+    validity = _merge_validity(
+        lcol.validity if lcol is not None else None,
+        rcol.validity if rcol is not None else None)
+    dtype = FLOAT64 if jnp.issubdtype(data.dtype, jnp.floating) else INT64
+    data = data.astype(jnp.float64 if dtype == FLOAT64 else jnp.int64)
+    return Column(dtype, data, validity)

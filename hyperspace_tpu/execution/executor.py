@@ -1,0 +1,343 @@
+"""Eager columnar plan executor.
+
+Executes a logical plan bottom-up over device-resident tables. Each operator
+is a fused XLA computation (jit happens inside the kernels); host↔device
+traffic is limited to parquet IO, and the two architecturally-required scalar
+syncs (join output size, group count) noted in ops/kernels.py.
+
+The reference delegates all of this to Spark's execution engine; this module
+is its TPU-native replacement (SURVEY §2 "the JVM/Spark execution engine
+itself ... is the part the new framework replaces with XLA/Pallas kernels").
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Set, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..exceptions import HyperspaceException
+from ..ops import kernels
+from ..plan import expr as E
+from ..plan.nodes import (Aggregate, BucketUnion, Filter, IndexScan, Join, Limit,
+                          LogicalPlan, Project, Scan, Sort, Union)
+from ..schema import BOOL, DATE, FLOAT64, INT32, INT64, STRING
+from .columnar import (Column, Table, dictionaries_equal, read_parquet,
+                       translate_codes)
+from .evaluator import eval_expr, eval_predicate_mask
+
+
+def execute(plan: LogicalPlan) -> Table:
+    return _execute(plan, needed=None)
+
+
+def _execute(plan: LogicalPlan, needed: Optional[Set[str]]) -> Table:
+    if isinstance(plan, Scan):
+        return _execute_scan(plan, needed)
+    if isinstance(plan, IndexScan):
+        return _execute_index_scan(plan, needed)
+    if isinstance(plan, Filter):
+        child_needed = None if needed is None else \
+            needed | set(plan.condition.references)
+        table = _execute(plan.child, child_needed)
+        mask = eval_predicate_mask(table, plan.condition)
+        return table.filter(mask)
+    if isinstance(plan, Project):
+        child_needed = set()
+        for e in plan.exprs:
+            child_needed.update(e.references)
+        table = _execute(plan.child, child_needed)
+        return Table({e.name: eval_expr(table, e) for e in plan.exprs})
+    if isinstance(plan, Join):
+        return _execute_join(plan, needed)
+    if isinstance(plan, Aggregate):
+        child_needed = set(plan.group_cols)
+        for a in plan.aggs:
+            child_needed.update(a.references)
+        table = _execute(plan.child, child_needed)
+        return _execute_aggregate(plan, table)
+    if isinstance(plan, Sort):
+        child_needed = None if needed is None else \
+            needed | {c for c, _ in plan.orders}
+        table = _execute(plan.child, child_needed)
+        return _execute_sort(plan, table)
+    if isinstance(plan, Limit):
+        table = _execute(plan.child, needed)
+        return table.slice(0, min(plan.n, table.num_rows))
+    if isinstance(plan, (Union, BucketUnion)):
+        tables = [_execute(c, needed) for c in plan.children]
+        aligned = [t.select(tables[0].names) for t in tables]
+        return Table.concat(aligned)
+    raise HyperspaceException(f"Cannot execute plan node {plan.node_name}")
+
+
+def _execute_scan(plan: Scan, needed: Optional[Set[str]]) -> Table:
+    relation = plan.relation
+    cols = None
+    if needed is not None:
+        cols = [n for n in relation.schema.names if n in needed]
+        if not cols:  # e.g. count(*) over no particular column.
+            cols = [relation.schema.names[0]]
+    files = relation.all_files()
+    if not files:
+        raise HyperspaceException(f"No files for relation {relation.describe()}")
+    return read_parquet(files, cols, relation.file_format)
+
+
+def _execute_index_scan(plan: IndexScan, needed: Optional[Set[str]]) -> Table:
+    from ..index.constants import IndexConstants
+
+    entry = plan.index_entry
+    index_files = sorted(entry.content.files)
+    schema_names = entry.schema.names
+    cols = None
+    if needed is not None:
+        cols = [n for n in schema_names if n in needed]
+        if not cols:
+            cols = [schema_names[0]]
+        if plan.deleted_file_ids and IndexConstants.DATA_FILE_NAME_ID not in cols:
+            cols = cols + [IndexConstants.DATA_FILE_NAME_ID]
+    table = read_parquet(index_files, cols)
+    if plan.deleted_file_ids:
+        lineage = table.column(IndexConstants.DATA_FILE_NAME_ID)
+        deleted = jnp.asarray(
+            np.sort(np.asarray(plan.deleted_file_ids, dtype=np.int64)))
+        keep = ~kernels.isin_sorted(lineage.data.astype(jnp.int64), deleted)
+        table = table.filter(keep)
+    if plan.appended_files:
+        appended = read_parquet(
+            plan.appended_files,
+            [c for c in (cols or schema_names)
+             if c != IndexConstants.DATA_FILE_NAME_ID])
+        if IndexConstants.DATA_FILE_NAME_ID in (cols or schema_names) \
+                and IndexConstants.DATA_FILE_NAME_ID not in appended.names:
+            fill = Column(INT64, jnp.full(appended.num_rows,
+                                          IndexConstants.UNKNOWN_FILE_ID, jnp.int64))
+            appended = appended.with_column(IndexConstants.DATA_FILE_NAME_ID, fill)
+        table = Table.concat([table, appended.select(table.names)])
+    drop_lineage = (needed is not None
+                    and IndexConstants.DATA_FILE_NAME_ID in table.names
+                    and IndexConstants.DATA_FILE_NAME_ID not in needed)
+    if drop_lineage:
+        table = table.select([n for n in table.names
+                              if n != IndexConstants.DATA_FILE_NAME_ID])
+    return table
+
+
+# ---------------------------------------------------------------------------
+# Join.
+# ---------------------------------------------------------------------------
+
+def _join_key_arrays(left: Table, right: Table,
+                     pairs: List[Tuple[str, str]]):
+    """Device key arrays for the join, in a shared comparable space."""
+    if len(pairs) == 1:
+        lname, rname = pairs[0]
+        lc, rc = left.column(lname), right.column(rname)
+        if lc.dtype == STRING or rc.dtype == STRING:
+            if lc.dtype != rc.dtype:
+                raise HyperspaceException("Join key type mismatch")
+            return _string_join_keys(lc, rc)
+        return lc.data, rc.data
+    if len(pairs) == 2:
+        lks, rks = [], []
+        for lname, rname in pairs:
+            lc, rc = left.column(lname), right.column(rname)
+            if lc.dtype not in (INT32, DATE) or rc.dtype not in (INT32, DATE):
+                raise HyperspaceException(
+                    "Multi-column joins currently require int32/date keys")
+            lks.append(lc.data)
+            rks.append(rc.data)
+        return (kernels.pack2_int32(lks[0], lks[1]),
+                kernels.pack2_int32(rks[0], rks[1]))
+    raise HyperspaceException("Joins on >2 key columns not supported yet")
+
+
+def _string_join_keys(lc: Column, rc: Column):
+    if dictionaries_equal(lc.dictionary, rc.dictionary):
+        return lc.data, rc.data
+    return lc.data, translate_codes(lc.dictionary, rc)
+
+
+def _execute_join(plan: Join, needed: Optional[Set[str]]) -> Table:
+    pairs = E.extract_equi_join_keys(plan.condition)
+    if pairs is None:
+        raise HyperspaceException(
+            f"Only conjunctive equi-joins are supported; got {plan.condition!r}")
+    left_names = set(plan.left.schema.names)
+    right_names = set(plan.right.schema.names)
+    # Normalize each pair to (left column, right column).
+    norm: List[Tuple[str, str]] = []
+    for a, b in pairs:
+        if a in left_names and b in right_names:
+            norm.append((a, b))
+        elif b in left_names and a in right_names:
+            norm.append((b, a))
+        else:
+            raise HyperspaceException(
+                f"Join keys ({a}, {b}) do not split across the two sides")
+    lneed = None if needed is None else \
+        {n for n in needed if n in left_names} | {p[0] for p in norm}
+    rneed = None if needed is None else \
+        {n for n in needed if n in right_names} | {p[1] for p in norm}
+    left = _execute(plan.left, lneed)
+    right = _execute(plan.right, rneed)
+
+    lkeys, rkeys = _join_key_arrays(left, right, norm)
+    # Inner join: drop null keys up front.
+    lvalid = _keys_validity(left, [p[0] for p in norm])
+    if lvalid is not None:
+        left = left.filter(lvalid)
+        lkeys = lkeys[lvalid]
+    rvalid = _keys_validity(right, [p[1] for p in norm])
+    if rvalid is not None:
+        right = right.filter(rvalid)
+        rkeys = rkeys[rvalid]
+
+    order = kernels.lex_sort_indices([rkeys])
+    right_sorted = right.take(order)
+    rkeys_sorted = jnp.take(rkeys, order)
+    li, ri = kernels.merge_join_indices(lkeys, rkeys_sorted)
+    out = {}
+    taken_left = left.take(li)
+    taken_right = right_sorted.take(ri)
+    for n in plan.schema.names:
+        # Children were column-pruned; emit only the materialized subset.
+        if n in taken_left.columns:
+            out[n] = taken_left.columns[n]
+        elif n in taken_right.columns:
+            out[n] = taken_right.columns[n]
+    return Table(out)
+
+
+def _keys_validity(table: Table, names: Sequence[str]):
+    v = None
+    for n in names:
+        c = table.column(n)
+        cv = c.validity
+        if c.dtype == STRING and cv is None:
+            pass
+        if cv is not None:
+            v = cv if v is None else (v & cv)
+    return v
+
+
+# ---------------------------------------------------------------------------
+# Aggregate / Sort.
+# ---------------------------------------------------------------------------
+
+def _execute_aggregate(plan: Aggregate, table: Table) -> Table:
+    if not plan.group_cols:
+        return _execute_global_aggregate(plan, table)
+    key_cols = [table.column(g) for g in plan.group_cols]
+    for g, c in zip(plan.group_cols, key_cols):
+        if c.validity is not None:
+            raise HyperspaceException(
+                f"Grouping on nullable column '{g}' not supported yet")
+    order = kernels.lex_sort_indices([c.data for c in key_cols])
+    sorted_table = table.take(order)
+    sorted_keys = [sorted_table.column(g).data for g in plan.group_cols]
+    gids, num_groups = kernels.group_ids_from_sorted(sorted_keys)
+    if num_groups == 0:
+        return Table({f.name: Column(f.dtype,
+                                     jnp.zeros(0, _np_dtype_for(f.dtype)),
+                                     None,
+                                     _dict_for(table, f.name))
+                      for f in plan.schema.fields})
+    firsts = kernels.segment_first_index(gids, num_groups)
+    out = {}
+    for g in plan.group_cols:
+        out[g] = sorted_table.column(g).take(firsts)
+    for agg in plan.aggs:
+        out[agg.name] = _eval_agg(agg, sorted_table, gids, num_groups)
+    return Table(out)
+
+
+def _np_dtype_for(dtype: str):
+    return {INT32: jnp.int32, INT64: jnp.int64, "float32": jnp.float32,
+            FLOAT64: jnp.float64, BOOL: jnp.bool_, DATE: jnp.int32,
+            STRING: jnp.int32}[dtype]
+
+
+def _dict_for(table: Table, name: str):
+    if name in table.columns and table.columns[name].dtype == STRING:
+        return table.columns[name].dictionary
+    return None
+
+
+def _eval_agg(agg: E.Expr, sorted_table: Table, gids, num_groups: int) -> Column:
+    while isinstance(agg, E.Alias):
+        agg = agg.child
+    if not isinstance(agg, E.AggExpr):
+        raise HyperspaceException(f"Aggregate list requires agg functions; got {agg!r}")
+    if isinstance(agg, E.Count):
+        if agg.child is None:
+            data = kernels.segment_count(gids, num_groups)
+        else:
+            child = eval_expr(sorted_table, agg.child)
+            data = kernels.segment_count(gids, num_groups, child.validity)
+        return Column(INT64, data)
+    child = eval_expr(sorted_table, agg.child)
+    if child.dtype == STRING and not isinstance(agg, (E.Min, E.Max)):
+        raise HyperspaceException("sum/avg over string column")
+    values = child.data
+    validity = child.validity
+    # SQL semantics: a group with no valid values aggregates to NULL.
+    out_validity = None
+    if validity is not None:
+        out_validity = kernels.segment_count(gids, num_groups, validity) > 0
+    if isinstance(agg, (E.Sum, E.Avg)):
+        acc = values.astype(jnp.float64) if jnp.issubdtype(values.dtype, jnp.floating) \
+            else values.astype(jnp.int64)
+        if validity is not None:
+            acc = jnp.where(validity, acc, 0)
+        sums = kernels.segment_sum(acc, gids, num_groups)
+        if isinstance(agg, E.Sum):
+            dtype = FLOAT64 if jnp.issubdtype(sums.dtype, jnp.floating) else INT64
+            return Column(dtype, sums, out_validity)
+        counts = kernels.segment_count(gids, num_groups, validity)
+        return Column(FLOAT64, sums.astype(jnp.float64) /
+                      jnp.maximum(counts, 1).astype(jnp.float64), out_validity)
+    if isinstance(agg, E.Min):
+        vals = values if validity is None else \
+            jnp.where(validity, values, _max_sentinel(values.dtype))
+        return Column(child.dtype, kernels.segment_min(vals, gids, num_groups),
+                      out_validity, child.dictionary)
+    if isinstance(agg, E.Max):
+        vals = values if validity is None else \
+            jnp.where(validity, values, _min_sentinel(values.dtype))
+        return Column(child.dtype, kernels.segment_max(vals, gids, num_groups),
+                      out_validity, child.dictionary)
+    raise HyperspaceException(f"Unknown aggregate {agg!r}")
+
+
+def _max_sentinel(dtype):
+    return jnp.array(jnp.finfo(dtype).max if jnp.issubdtype(dtype, jnp.floating)
+                     else jnp.iinfo(dtype).max, dtype)
+
+
+def _min_sentinel(dtype):
+    return jnp.array(jnp.finfo(dtype).min if jnp.issubdtype(dtype, jnp.floating)
+                     else jnp.iinfo(dtype).min, dtype)
+
+
+def _execute_global_aggregate(plan: Aggregate, table: Table) -> Table:
+    gids = jnp.zeros(table.num_rows, jnp.int32)
+    out = {}
+    for agg in plan.aggs:
+        out[agg.name] = _eval_agg(agg, table, gids, 1)
+    return Table(out)
+
+
+def _execute_sort(plan: Sort, table: Table) -> Table:
+    keys, ascending = [], []
+    for name, asc in plan.orders:
+        c = table.column(name)
+        if c.validity is not None:
+            raise HyperspaceException(
+                f"Sorting on nullable column '{name}' not supported yet")
+        keys.append(c.data)
+        ascending.append(asc)
+    order = kernels.lex_sort_indices(keys, ascending)
+    return table.take(order)

@@ -1,0 +1,83 @@
+"""Logical-plan signature providers: index validity fingerprints.
+
+Parity reference: index/LogicalPlanSignatureProvider.scala:63-96,
+FileBasedSignatureProvider.scala:30, PlanSignatureProvider.scala:29,
+IndexSignatureProvider.scala:35.
+
+An index is applicable to a plan iff the plan's fingerprint (as computed by
+the provider recorded in the index's metadata) matches the fingerprint stored
+at index creation. Pluggable by dotted class path.
+"""
+
+from __future__ import annotations
+
+import importlib
+from typing import Optional
+
+from ..exceptions import HyperspaceException
+from ..util import hashing
+
+
+class LogicalPlanSignatureProvider:
+    def name(self) -> str:
+        return f"{type(self).__module__}.{type(self).__qualname__}"
+
+    def signature(self, plan) -> Optional[str]:
+        """Fingerprint of the plan, or None if this provider can't handle it."""
+        raise NotImplementedError
+
+    @staticmethod
+    def create(name: Optional[str] = None) -> "LogicalPlanSignatureProvider":
+        if name is None:
+            return IndexSignatureProvider()
+        short = {
+            "FileBasedSignatureProvider": FileBasedSignatureProvider,
+            "PlanSignatureProvider": PlanSignatureProvider,
+            "IndexSignatureProvider": IndexSignatureProvider,
+        }
+        if name in short:
+            return short[name]()
+        module_name, _, cls_name = name.rpartition(".")
+        if cls_name in short:
+            return short[cls_name]()
+        try:
+            cls = getattr(importlib.import_module(module_name), cls_name)
+            return cls()
+        except (ImportError, AttributeError, ValueError) as e:
+            raise HyperspaceException(f"Unknown signature provider: {name}") from e
+
+
+class FileBasedSignatureProvider(LogicalPlanSignatureProvider):
+    """md5 over each source file's (size, mtime, path), combined across all
+    file-based relation leaves of the plan."""
+
+    def signature(self, plan) -> Optional[str]:
+        parts = []
+        for leaf in plan.collect_leaves():
+            relation = getattr(leaf, "relation", None)
+            if relation is None:
+                return None
+            for path, size, mtime in relation.all_file_infos():
+                parts.append(f"{size}{mtime}{path}")
+        if not parts:
+            return None
+        return hashing.md5_hex("".join(parts))
+
+
+class PlanSignatureProvider(LogicalPlanSignatureProvider):
+    """md5 over the plan's operator node names (structure fingerprint)."""
+
+    def signature(self, plan) -> Optional[str]:
+        return hashing.md5_hex("".join(plan.node_names_preorder()))
+
+
+class IndexSignatureProvider(LogicalPlanSignatureProvider):
+    """File-based + plan signatures combined — the default provider
+    (parity: IndexSignatureProvider.scala:35)."""
+
+    def signature(self, plan) -> Optional[str]:
+        fb = FileBasedSignatureProvider().signature(plan)
+        if fb is None:
+            return None
+        ps = PlanSignatureProvider().signature(plan)
+        return hashing.md5_hex(fb + ps)

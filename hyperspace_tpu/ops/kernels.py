@@ -1,0 +1,214 @@
+"""Core XLA compute kernels the engine is built from.
+
+Everything here is jit-friendly (static shapes, no Python control flow on
+traced values) except where a host sync is architecturally required (dynamic
+result sizes: join output length, group count) — those sync points are single
+scalars and are marked HOST SYNC.
+
+These are the TPU-native equivalents of the distributed primitives catalogued
+in SURVEY §2: hash-repartition (bucket_ids), sort-within-bucket
+(lex_sort_indices), shuffle-free merge join (merge_join_indices over
+co-partitioned buckets), and the lineage anti-filter (isin_sorted).
+"""
+
+from __future__ import annotations
+
+import zlib
+from functools import partial
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..exceptions import HyperspaceException
+from ..schema import BOOL, DATE, FLOAT32, FLOAT64, INT32, INT64, STRING
+
+_M32 = np.uint32(0xFFFFFFFF)  # numpy scalar: no device alloc at import time
+
+
+# ---------------------------------------------------------------------------
+# Hashing (bucket assignment). murmur3-finalizer avalanche over uint32 lanes.
+# ---------------------------------------------------------------------------
+
+def _fmix32(x: jax.Array) -> jax.Array:
+    x = x & _M32
+    x = x ^ (x >> 16)
+    x = (x * np.uint32(0x85EBCA6B)) & _M32
+    x = x ^ (x >> 13)
+    x = (x * np.uint32(0xC2B2AE35)) & _M32
+    x = x ^ (x >> 16)
+    return x
+
+
+def hash32_values(data: jax.Array, dtype: str,
+                  dictionary: Optional[np.ndarray] = None) -> jax.Array:
+    """Stable 32-bit hash of a column's *values* (not its encoding).
+
+    For strings the hash is computed from the dictionary entries' bytes on
+    host (crc32) and gathered by code on device — so two tables with
+    different dictionaries hash equal strings equally, which is what makes
+    bucket co-partitioning work across index/source/appended data.
+    """
+    if dtype == STRING:
+        if dictionary is None:
+            raise HyperspaceException("hash32 of string column requires dictionary")
+        host_hashes = np.array(
+            [zlib.crc32(s.encode("utf-8")) for s in dictionary], dtype=np.uint32) \
+            if len(dictionary) else np.zeros(1, np.uint32)
+        table = jnp.asarray(host_hashes)
+        codes = jnp.clip(data, 0, max(len(dictionary) - 1, 0))
+        return _fmix32(jnp.take(table, codes))
+    if dtype in (INT32, DATE):
+        return _fmix32(data.astype(jnp.uint32))
+    if dtype == INT64:
+        u = data.astype(jnp.uint64)
+        lo = (u & np.uint64(0xFFFFFFFF)).astype(jnp.uint32)
+        hi = (u >> np.uint64(32)).astype(jnp.uint32)
+        return _fmix32(lo ^ (hi * np.uint32(0x9E3779B9)))
+    if dtype == BOOL:
+        return _fmix32(data.astype(jnp.uint32))
+    if dtype == FLOAT32:
+        return _fmix32(jax.lax.bitcast_convert_type(data, jnp.uint32))
+    if dtype == FLOAT64:
+        bits = jax.lax.bitcast_convert_type(data, jnp.uint64)
+        lo = (bits & np.uint64(0xFFFFFFFF)).astype(jnp.uint32)
+        hi = (bits >> np.uint64(32)).astype(jnp.uint32)
+        return _fmix32(lo ^ (hi * np.uint32(0x9E3779B9)))
+    raise HyperspaceException(f"Cannot hash dtype {dtype}")
+
+
+def hash_combine(h1: jax.Array, h2: jax.Array) -> jax.Array:
+    """Boost-style combiner over uint32."""
+    return (h1 ^ ((h2 + np.uint32(0x9E3779B9) + (h1 << 6) + (h1 >> 2)) & _M32)) & _M32
+
+
+def bucket_ids(hashes: jax.Array, num_buckets: int) -> jax.Array:
+    return (hashes % np.uint32(num_buckets)).astype(jnp.int32)
+
+
+# ---------------------------------------------------------------------------
+# Sorting.
+# ---------------------------------------------------------------------------
+
+def _sort_key_view(data: jax.Array, ascending: bool) -> jax.Array:
+    """Transform a key column so ascending lax.sort realizes the requested
+    direction (numeric negate; safe for codes/int/float w/o NaN)."""
+    if ascending:
+        return data
+    if data.dtype == jnp.bool_:
+        return ~data
+    return -data
+
+
+def lex_sort_indices(keys: Sequence[jax.Array],
+                     ascending: Optional[Sequence[bool]] = None) -> jax.Array:
+    """Indices that stably sort by keys[0], then keys[1], ... (lexicographic).
+
+    lax.sort sorts by the leading operands; we append iota as the payload.
+    """
+    if ascending is None:
+        ascending = [True] * len(keys)
+    n = int(keys[0].shape[0])
+    iota = jnp.arange(n, dtype=jnp.int32)
+    operands = [_sort_key_view(k, a) for k, a in zip(keys, ascending)] + [iota]
+    out = jax.lax.sort(operands, num_keys=len(keys), is_stable=True)
+    return out[-1]
+
+
+# ---------------------------------------------------------------------------
+# Merge join over sorted keys.
+# ---------------------------------------------------------------------------
+
+def merge_join_indices(left_keys: jax.Array, right_keys_sorted: jax.Array
+                       ) -> Tuple[jax.Array, jax.Array]:
+    """Inner equi-join: for each left row, all matching right rows.
+
+    ``right_keys_sorted`` must be ascending. Returns (left_idx, right_idx)
+    gather indices. Output length is data-dependent → one scalar HOST SYNC.
+    """
+    lo = jnp.searchsorted(right_keys_sorted, left_keys, side="left")
+    hi = jnp.searchsorted(right_keys_sorted, left_keys, side="right")
+    counts = (hi - lo).astype(jnp.int32)
+    total = int(jnp.sum(counts))  # HOST SYNC (single scalar).
+    return _expand_matches(counts, lo, total)
+
+
+@partial(jax.jit, static_argnames=("total",))
+def _expand_matches(counts: jax.Array, lo: jax.Array, total: int
+                    ) -> Tuple[jax.Array, jax.Array]:
+    n_left = counts.shape[0]
+    left_idx = jnp.repeat(jnp.arange(n_left, dtype=jnp.int32), counts,
+                          total_repeat_length=total)
+    starts = jnp.cumsum(counts) - counts
+    base = jnp.repeat(starts.astype(jnp.int32), counts, total_repeat_length=total)
+    within = jnp.arange(total, dtype=jnp.int32) - base
+    right_idx = jnp.repeat(lo.astype(jnp.int32), counts,
+                           total_repeat_length=total) + within
+    return left_idx, right_idx
+
+
+def pack2_int32(a: jax.Array, b: jax.Array) -> jax.Array:
+    """Pack two int32 key columns into one int64 composite key."""
+    return (a.astype(jnp.int64) << np.int64(32)) | (
+        b.astype(jnp.int64) & np.int64(0xFFFFFFFF))
+
+
+# ---------------------------------------------------------------------------
+# Grouping / segmented aggregation (over sorted group keys).
+# ---------------------------------------------------------------------------
+
+def group_ids_from_sorted(keys: Sequence[jax.Array]) -> Tuple[jax.Array, int]:
+    """Segment ids for rows already sorted by ``keys``.
+
+    Returns (group_id per row, number of groups). One scalar HOST SYNC.
+    """
+    n = int(keys[0].shape[0])
+    if n == 0:
+        return jnp.zeros(0, jnp.int32), 0
+    change = jnp.zeros(n, dtype=jnp.bool_)
+    for k in keys:
+        change = change | jnp.concatenate(
+            [jnp.zeros(1, jnp.bool_), k[1:] != k[:-1]])
+    gids = jnp.cumsum(change.astype(jnp.int32))
+    num_groups = int(gids[-1]) + 1  # HOST SYNC (single scalar).
+    return gids, num_groups
+
+
+def segment_sum(data: jax.Array, gids: jax.Array, num_groups: int) -> jax.Array:
+    return jax.ops.segment_sum(data, gids, num_segments=num_groups)
+
+
+def segment_count(gids: jax.Array, num_groups: int,
+                  validity: Optional[jax.Array] = None) -> jax.Array:
+    ones = jnp.ones(gids.shape[0], jnp.int64) if validity is None \
+        else validity.astype(jnp.int64)
+    return jax.ops.segment_sum(ones, gids, num_segments=num_groups)
+
+
+def segment_min(data: jax.Array, gids: jax.Array, num_groups: int) -> jax.Array:
+    return jax.ops.segment_min(data, gids, num_segments=num_groups)
+
+
+def segment_max(data: jax.Array, gids: jax.Array, num_groups: int) -> jax.Array:
+    return jax.ops.segment_max(data, gids, num_segments=num_groups)
+
+
+def segment_first_index(gids: jax.Array, num_groups: int) -> jax.Array:
+    """Index of each group's first row (rows sorted by group key)."""
+    n = gids.shape[0]
+    return jax.ops.segment_min(jnp.arange(n, dtype=jnp.int32), gids,
+                               num_segments=num_groups)
+
+
+# ---------------------------------------------------------------------------
+# Membership (lineage anti-filter: Not(In(lineage, deletedIds))).
+# ---------------------------------------------------------------------------
+
+def isin_sorted(data: jax.Array, sorted_values: jax.Array) -> jax.Array:
+    """Vectorized membership of ``data`` in ascending ``sorted_values``."""
+    if sorted_values.shape[0] == 0:
+        return jnp.zeros(data.shape[0], jnp.bool_)
+    pos = jnp.searchsorted(sorted_values, data)
+    pos = jnp.clip(pos, 0, sorted_values.shape[0] - 1)
+    return jnp.take(sorted_values, pos) == data

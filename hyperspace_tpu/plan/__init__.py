@@ -1,0 +1,4 @@
+from . import expr  # noqa: F401
+from .nodes import (  # noqa: F401
+    Aggregate, BucketSpec, BucketUnion, Filter, IndexScan, Join, Limit, LogicalPlan,
+    Project, Scan, Sort, Union, infer_dtype)

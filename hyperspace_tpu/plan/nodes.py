@@ -1,0 +1,379 @@
+"""Logical plan IR.
+
+The reference piggybacks on Spark Catalyst's LogicalPlan; this is our small,
+columnar equivalent. Nodes carry resolved schemas (analysis happens at
+construction). The rewrite rules (rules/) pattern-match these nodes exactly
+the way the reference matches Scan→Filter(→Project) and Join subtrees.
+"""
+
+from __future__ import annotations
+
+import datetime
+from dataclasses import dataclass
+from typing import List, Optional, Sequence, Tuple
+
+from ..exceptions import HyperspaceException
+from ..schema import BOOL, DATE, FLOAT64, INT64, STRING, Field, Schema
+from . import expr as E
+
+
+def infer_dtype(e: E.Expr, schema: Schema) -> str:
+    if isinstance(e, E.Col):
+        return schema.field(e.column).dtype
+    if isinstance(e, E.Alias):
+        return infer_dtype(e.child, schema)
+    if isinstance(e, E.Lit):
+        v = e.value
+        if isinstance(v, bool):
+            return BOOL
+        if isinstance(v, int):
+            return INT64
+        if isinstance(v, float):
+            return FLOAT64
+        if isinstance(v, datetime.date):
+            return DATE
+        if isinstance(v, str):
+            return STRING
+        raise HyperspaceException(f"Cannot infer type of literal {v!r}")
+    if isinstance(e, (E.EqualTo, E.LessThan, E.LessThanOrEqual, E.GreaterThan,
+                      E.GreaterThanOrEqual, E.And, E.Or, E.Not, E.In)):
+        return BOOL
+    if isinstance(e, (E.Add, E.Subtract, E.Multiply)):
+        kinds = {infer_dtype(c, schema) for c in e.children}
+        return FLOAT64 if (FLOAT64 in kinds or "float32" in kinds) else INT64
+    if isinstance(e, E.Divide):
+        return FLOAT64
+    if isinstance(e, E.Count):
+        return INT64
+    if isinstance(e, E.Avg):
+        return FLOAT64
+    if isinstance(e, E.Sum):
+        child = infer_dtype(e.child, schema)
+        return FLOAT64 if child in (FLOAT64, "float32") else INT64
+    if isinstance(e, (E.Min, E.Max)):
+        return infer_dtype(e.child, schema)
+    raise HyperspaceException(f"Cannot infer type of {e!r}")
+
+
+class LogicalPlan:
+    """Base node. ``schema`` is the resolved output schema."""
+
+    @property
+    def children(self) -> List["LogicalPlan"]:
+        return []
+
+    @property
+    def schema(self) -> Schema:
+        raise NotImplementedError
+
+    @property
+    def node_name(self) -> str:
+        return type(self).__name__
+
+    def collect_leaves(self) -> List["LogicalPlan"]:
+        if not self.children:
+            return [self]
+        out = []
+        for c in self.children:
+            out.extend(c.collect_leaves())
+        return out
+
+    def transform_up(self, fn) -> "LogicalPlan":
+        new_children = [c.transform_up(fn) for c in self.children]
+        node = self.with_children(new_children) if new_children != self.children else self
+        return fn(node)
+
+    def with_children(self, children: List["LogicalPlan"]) -> "LogicalPlan":
+        if children:
+            raise HyperspaceException(f"{self.node_name} has no children to replace")
+        return self
+
+    def simple_string(self) -> str:
+        return self.node_name
+
+    def tree_string(self, depth: int = 0) -> str:
+        lines = ["  " * depth + ("+- " if depth else "") + self.simple_string()]
+        for c in self.children:
+            lines.append(c.tree_string(depth + 1))
+        return "\n".join(lines)
+
+    # Plan-node names feed the PlanSignatureProvider fingerprint.
+    def node_names_preorder(self) -> List[str]:
+        out = [self.node_name]
+        for c in self.children:
+            out.extend(c.node_names_preorder())
+        return out
+
+
+class Scan(LogicalPlan):
+    """Leaf: scan a file-based relation (LogicalRelation analogue)."""
+
+    def __init__(self, relation):
+        self.relation = relation  # sources.FileBasedRelation
+
+    @property
+    def schema(self) -> Schema:
+        return self.relation.schema
+
+    def simple_string(self) -> str:
+        return f"Scan {self.relation.describe()}"
+
+
+class IndexScan(LogicalPlan):
+    """Leaf: scan the bucketed files of a covering index version.
+
+    This is the analogue of the reference's IndexHadoopFsRelation swap
+    (rules/RuleUtils.scala:253): instead of the source files, read the
+    index's own parquet files, optionally exposing the bucket spec so joins
+    can go shuffle-free and filters can prune buckets.
+    """
+
+    def __init__(self, index_entry, schema: Schema, use_bucket_spec: bool = False,
+                 deleted_file_ids: Optional[Sequence[int]] = None,
+                 appended_files: Optional[Sequence[str]] = None):
+        self.index_entry = index_entry
+        self._schema = schema
+        self.use_bucket_spec = use_bucket_spec
+        # Hybrid Scan state: rows from these source-file ids must be masked
+        # out (deleted) and these source files merged in (appended).
+        self.deleted_file_ids = list(deleted_file_ids or [])
+        self.appended_files = list(appended_files or [])
+
+    @property
+    def schema(self) -> Schema:
+        return self._schema
+
+    def simple_string(self) -> str:
+        e = self.index_entry
+        extra = ""
+        if self.deleted_file_ids or self.appended_files:
+            extra = (f", hybrid(+{len(self.appended_files)} appended,"
+                     f" -{len(self.deleted_file_ids)} deleted files)")
+        return (f"IndexScan Hyperspace(Type: {e.derivedDataset.kind_abbr}, "
+                f"Name: {e.name}, LogVersion: {e.log_version}{extra})")
+
+
+class Filter(LogicalPlan):
+    def __init__(self, condition: E.Expr, child: LogicalPlan):
+        for ref in condition.references:
+            if ref not in child.schema:
+                raise HyperspaceException(
+                    f"Filter references unknown column '{ref}'; "
+                    f"available: {child.schema.names}")
+        self.condition = condition
+        self.child = child
+
+    @property
+    def children(self) -> List[LogicalPlan]:
+        return [self.child]
+
+    def with_children(self, children):
+        return Filter(self.condition, children[0])
+
+    @property
+    def schema(self) -> Schema:
+        return self.child.schema
+
+    def simple_string(self) -> str:
+        return f"Filter ({self.condition!r})"
+
+
+class Project(LogicalPlan):
+    def __init__(self, exprs: Sequence[E.Expr], child: LogicalPlan):
+        self.exprs = [E.Col(e) if isinstance(e, str) else e for e in exprs]
+        for e in self.exprs:
+            for ref in e.references:
+                if ref not in child.schema:
+                    raise HyperspaceException(
+                        f"Project references unknown column '{ref}'; "
+                        f"available: {child.schema.names}")
+        self.child = child
+        names = [e.name for e in self.exprs]
+        if len(set(names)) != len(names):
+            raise HyperspaceException(f"Duplicate output columns in project: {names}")
+        self._schema = Schema(
+            [Field(e.name, infer_dtype(e, child.schema)) for e in self.exprs])
+
+    @property
+    def children(self) -> List[LogicalPlan]:
+        return [self.child]
+
+    def with_children(self, children):
+        return Project(self.exprs, children[0])
+
+    @property
+    def schema(self) -> Schema:
+        return self._schema
+
+    def simple_string(self) -> str:
+        return f"Project [{', '.join(e.name for e in self.exprs)}]"
+
+
+class Join(LogicalPlan):
+    def __init__(self, left: LogicalPlan, right: LogicalPlan, condition: E.Expr,
+                 join_type: str = "inner"):
+        if join_type not in ("inner",):
+            raise HyperspaceException(f"Unsupported join type: {join_type}")
+        overlap = set(left.schema.names) & set(right.schema.names)
+        if overlap:
+            raise HyperspaceException(
+                f"Ambiguous join output columns {sorted(overlap)}; "
+                "rename before joining")
+        # Validate references resolve against the combined schema.
+        combined = list(left.schema.fields) + list(right.schema.fields)
+        names = {f.name for f in combined}
+        for ref in condition.references:
+            if ref not in names:
+                raise HyperspaceException(f"Join condition references unknown '{ref}'")
+        self.left = left
+        self.right = right
+        self.condition = condition
+        self.join_type = join_type
+        self._schema = Schema(combined)
+
+    @property
+    def children(self) -> List[LogicalPlan]:
+        return [self.left, self.right]
+
+    def with_children(self, children):
+        return Join(children[0], children[1], self.condition, self.join_type)
+
+    @property
+    def schema(self) -> Schema:
+        return self._schema
+
+    def simple_string(self) -> str:
+        return f"Join {self.join_type} ({self.condition!r})"
+
+
+class Aggregate(LogicalPlan):
+    def __init__(self, group_cols: Sequence[str], aggs: Sequence[E.Expr],
+                 child: LogicalPlan):
+        self.group_cols = list(group_cols)
+        self.aggs = list(aggs)
+        for g in self.group_cols:
+            if g not in child.schema:
+                raise HyperspaceException(f"Group column '{g}' not in {child.schema.names}")
+        self.child = child
+        fields = [child.schema.field(g) for g in self.group_cols]
+        for a in self.aggs:
+            fields.append(Field(a.name, infer_dtype(a, child.schema)))
+        self._schema = Schema(fields)
+
+    @property
+    def children(self) -> List[LogicalPlan]:
+        return [self.child]
+
+    def with_children(self, children):
+        return Aggregate(self.group_cols, self.aggs, children[0])
+
+    @property
+    def schema(self) -> Schema:
+        return self._schema
+
+    def simple_string(self) -> str:
+        return (f"Aggregate [{', '.join(self.group_cols)}] "
+                f"[{', '.join(a.name for a in self.aggs)}]")
+
+
+class Sort(LogicalPlan):
+    def __init__(self, orders: Sequence[Tuple[str, bool]], child: LogicalPlan):
+        # orders: (column, ascending)
+        self.orders = [(c, asc) for c, asc in orders]
+        for c, _ in self.orders:
+            if c not in child.schema:
+                raise HyperspaceException(f"Sort column '{c}' not in {child.schema.names}")
+        self.child = child
+
+    @property
+    def children(self) -> List[LogicalPlan]:
+        return [self.child]
+
+    def with_children(self, children):
+        return Sort(self.orders, children[0])
+
+    @property
+    def schema(self) -> Schema:
+        return self.child.schema
+
+    def simple_string(self) -> str:
+        parts = [f"{c} {'ASC' if a else 'DESC'}" for c, a in self.orders]
+        return f"Sort [{', '.join(parts)}]"
+
+
+class Limit(LogicalPlan):
+    def __init__(self, n: int, child: LogicalPlan):
+        self.n = n
+        self.child = child
+
+    @property
+    def children(self) -> List[LogicalPlan]:
+        return [self.child]
+
+    def with_children(self, children):
+        return Limit(self.n, children[0])
+
+    @property
+    def schema(self) -> Schema:
+        return self.child.schema
+
+    def simple_string(self) -> str:
+        return f"Limit {self.n}"
+
+
+class BucketUnion(LogicalPlan):
+    """Partition-aligned union of bucketed outputs (reference:
+    plans/logical/BucketUnion.scala:31). On TPU this is a pure concatenation
+    of shard-aligned arrays — no collective needed (SURVEY §5)."""
+
+    def __init__(self, children: List[LogicalPlan], bucket_spec):
+        if not children:
+            raise HyperspaceException("BucketUnion requires children")
+        first = children[0].schema.names
+        for c in children[1:]:
+            if c.schema.names != first:
+                raise HyperspaceException("BucketUnion children must share schema")
+        self._children = children
+        self.bucket_spec = bucket_spec
+
+    @property
+    def children(self) -> List[LogicalPlan]:
+        return list(self._children)
+
+    def with_children(self, children):
+        return BucketUnion(children, self.bucket_spec)
+
+    @property
+    def schema(self) -> Schema:
+        return self._children[0].schema
+
+
+class Union(LogicalPlan):
+    def __init__(self, children: List[LogicalPlan]):
+        if not children:
+            raise HyperspaceException("Union requires children")
+        first = children[0].schema.names
+        for c in children[1:]:
+            if c.schema.names != first:
+                raise HyperspaceException("Union children must share schema")
+        self._children = children
+
+    @property
+    def children(self) -> List[LogicalPlan]:
+        return list(self._children)
+
+    def with_children(self, children):
+        return Union(children)
+
+    @property
+    def schema(self) -> Schema:
+        return self._children[0].schema
+
+
+@dataclass(frozen=True)
+class BucketSpec:
+    """Bucketing metadata carried by index scans (Spark BucketSpec analogue)."""
+
+    num_buckets: int
+    bucket_column_names: Tuple[str, ...]
+    sort_column_names: Tuple[str, ...]

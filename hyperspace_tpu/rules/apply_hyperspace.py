@@ -1,0 +1,7 @@
+"""Entry point of the rewrite batch (placeholder until rules land)."""
+
+from __future__ import annotations
+
+
+def apply_hyperspace(session, plan):
+    return plan

@@ -1,0 +1,231 @@
+"""Session + DataFrame API.
+
+The SparkSession analogue: holds the conf, the pluggable source-provider
+manager, and the optimizer-rule batch that `enable_hyperspace()` injects
+(parity: package.scala:35-75 — the reference splices JoinIndexRule ::
+FilterIndexRule into experimentalMethods.extraOptimizations).
+
+DataFrames are thin wrappers over the logical plan IR; `collect()` runs
+analysis → (hyperspace rewrite if enabled) → the XLA executor.
+"""
+
+from __future__ import annotations
+
+import importlib
+from typing import Dict, List, Optional, Sequence, Tuple, Union as TUnion
+
+from .config import Conf, HyperspaceConf
+from .exceptions import HyperspaceException
+from .plan import expr as E
+from .plan.nodes import (Aggregate, Filter, Join, Limit, LogicalPlan, Project,
+                         Scan, Sort)
+from .schema import Schema
+from .sources.interfaces import FileBasedSourceProviderManager
+
+
+class Session:
+    def __init__(self, conf: Optional[Dict[str, str]] = None,
+                 system_path: Optional[str] = None):
+        self.conf = Conf(conf)
+        if system_path is not None:
+            from .index.constants import IndexConstants
+            self.conf.set(IndexConstants.INDEX_SYSTEM_PATH, system_path)
+        self.hs_conf = HyperspaceConf(self.conf)
+        self._hyperspace_enabled = False
+        self._event_logger = None
+        from .config import CacheWithTransform
+        self._provider_manager_cache = CacheWithTransform(
+            self.hs_conf.file_based_source_builders, self._build_provider_manager)
+
+    @property
+    def read(self) -> "DataFrameReader":
+        # Fresh reader per access so option() calls don't leak across reads.
+        return DataFrameReader(self)
+
+    # ------------------------------------------------------------------
+    # Source providers (parity: FileBasedSourceProviderManager.buildProviders).
+    # ------------------------------------------------------------------
+
+    @property
+    def source_provider_manager(self) -> FileBasedSourceProviderManager:
+        # Re-derived only when the conf string changes (CacheWithTransform).
+        return self._provider_manager_cache.load()
+
+    @staticmethod
+    def _build_provider_manager(raw: str) -> FileBasedSourceProviderManager:
+        providers = []
+        for name in raw.split(","):
+            name = name.strip()
+            module_name, _, cls_name = name.rpartition(".")
+            try:
+                cls = getattr(importlib.import_module(module_name), cls_name)
+            except (ImportError, AttributeError) as e:
+                raise HyperspaceException(f"Cannot load source builder {name}") from e
+            providers.append(cls())
+        return FileBasedSourceProviderManager(providers)
+
+    # ------------------------------------------------------------------
+    # Hyperspace enable/disable (parity: package.scala:35-75).
+    # ------------------------------------------------------------------
+
+    def enable_hyperspace(self) -> "Session":
+        self._hyperspace_enabled = True
+        return self
+
+    def disable_hyperspace(self) -> "Session":
+        self._hyperspace_enabled = False
+        return self
+
+    def is_hyperspace_enabled(self) -> bool:
+        return self._hyperspace_enabled
+
+    # ------------------------------------------------------------------
+    # Execution.
+    # ------------------------------------------------------------------
+
+    def optimize(self, plan: LogicalPlan) -> LogicalPlan:
+        """Apply the hyperspace rewrite batch if enabled."""
+        if not self._hyperspace_enabled:
+            return plan
+        from .rules.apply_hyperspace import apply_hyperspace
+        return apply_hyperspace(self, plan)
+
+    def execute(self, plan: LogicalPlan):
+        from .execution import execute as run
+        return run(self.optimize(plan))
+
+    def create_dataframe(self, plan: LogicalPlan) -> "DataFrame":
+        return DataFrame(self, plan)
+
+
+class DataFrameReader:
+    def __init__(self, session: Session):
+        self._session = session
+        self._options: Dict[str, str] = {}
+
+    def option(self, key: str, value) -> "DataFrameReader":
+        self._options[key] = str(value)
+        return self
+
+    def parquet(self, *paths: str) -> "DataFrame":
+        return self.format("parquet").load(*paths)
+
+    def csv(self, *paths: str) -> "DataFrame":
+        return self.format("csv").load(*paths)
+
+    def format(self, fmt: str) -> "_FormattedReader":
+        return _FormattedReader(self._session, fmt, dict(self._options))
+
+
+class _FormattedReader:
+    def __init__(self, session: Session, fmt: str, options: Dict[str, str]):
+        self._session = session
+        self._fmt = fmt
+        self._options = options
+
+    def load(self, *paths: str) -> "DataFrame":
+        relation = self._session.source_provider_manager.build_relation(
+            list(paths), self._fmt, self._options)
+        return DataFrame(self._session, Scan(relation))
+
+
+class DataFrame:
+    def __init__(self, session: Session, plan: LogicalPlan):
+        self.session = session
+        self.plan = plan
+
+    # ------------------------------------------------------------------
+    # Transformations.
+    # ------------------------------------------------------------------
+
+    @property
+    def schema(self) -> Schema:
+        return self.plan.schema
+
+    @property
+    def columns(self) -> List[str]:
+        return self.plan.schema.names
+
+    def filter(self, condition: E.Expr) -> "DataFrame":
+        return DataFrame(self.session, Filter(condition, self.plan))
+
+    where = filter
+
+    def select(self, *exprs: TUnion[str, E.Expr]) -> "DataFrame":
+        flat: List[TUnion[str, E.Expr]] = []
+        for e in exprs:
+            if isinstance(e, (list, tuple)):
+                flat.extend(e)
+            else:
+                flat.append(e)
+        return DataFrame(self.session, Project(flat, self.plan))
+
+    def join(self, other: "DataFrame", on: E.Expr, how: str = "inner") -> "DataFrame":
+        return DataFrame(self.session, Join(self.plan, other.plan, on, how))
+
+    def group_by(self, *cols: str) -> "GroupedData":
+        return GroupedData(self, list(cols))
+
+    groupBy = group_by
+
+    def agg(self, *aggs: E.Expr) -> "DataFrame":
+        return DataFrame(self.session, Aggregate([], list(aggs), self.plan))
+
+    def sort(self, *orders) -> "DataFrame":
+        normalized: List[Tuple[str, bool]] = []
+        for o in orders:
+            if isinstance(o, str):
+                normalized.append((o, True))
+            else:
+                normalized.append(o)  # (name, ascending)
+        return DataFrame(self.session, Sort(normalized, self.plan))
+
+    order_by = sort
+    orderBy = sort
+
+    def limit(self, n: int) -> "DataFrame":
+        return DataFrame(self.session, Limit(n, self.plan))
+
+    # ------------------------------------------------------------------
+    # Actions.
+    # ------------------------------------------------------------------
+
+    def execute(self):
+        """Run the (possibly rewritten) plan; returns the device Table."""
+        return self.session.execute(self.plan)
+
+    def optimized_plan(self) -> LogicalPlan:
+        return self.session.optimize(self.plan)
+
+    def to_arrow(self):
+        return self.execute().to_arrow()
+
+    def to_pandas(self):
+        return self.execute().to_pandas()
+
+    def collect(self) -> List[tuple]:
+        table = self.to_arrow()
+        return [tuple(d.values()) for d in table.to_pylist()]
+
+    def count(self) -> int:
+        return self.execute().num_rows
+
+    def explain(self, verbose: bool = False) -> str:
+        text = self.plan.tree_string()
+        if self.session.is_hyperspace_enabled():
+            text += "\n\n== Optimized (hyperspace) ==\n" + \
+                self.optimized_plan().tree_string()
+        return text
+
+
+class GroupedData:
+    def __init__(self, df: DataFrame, group_cols: List[str]):
+        self._df = df
+        self._group_cols = group_cols
+
+    def agg(self, *aggs: E.Expr) -> DataFrame:
+        return DataFrame(self._df.session,
+                         Aggregate(self._group_cols, list(aggs), self._df.plan))
+
+    def count(self) -> DataFrame:
+        return self.agg(E.Count(None))

@@ -1,0 +1,3 @@
+from .default import DefaultFileBasedRelation, DefaultFileBasedSourceBuilder  # noqa: F401
+from .interfaces import (  # noqa: F401
+    FileBasedRelation, FileBasedSourceProvider, FileBasedSourceProviderManager)

@@ -1,0 +1,103 @@
+"""Default file-based source provider: parquet (+ csv) directories on the
+host filesystem.
+
+Parity reference: sources/default/DefaultFileBasedSource.scala:37 and
+DefaultFileBasedRelation.scala:38 — supported formats, signature computed from
+the file listing, glob-pattern validation.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional, Sequence
+
+import pyarrow.dataset as pa_ds
+import pyarrow.parquet as pq
+
+from ..exceptions import HyperspaceException
+from ..schema import Schema
+from ..util import file_utils, hashing
+from .interfaces import FileBasedRelation, FileBasedSourceProvider
+
+SUPPORTED_FORMATS = ("parquet", "csv")
+
+
+class DefaultFileBasedRelation(FileBasedRelation):
+    def __init__(self, paths: Sequence[str], fmt: str = "parquet",
+                 options: Optional[Dict[str, str]] = None,
+                 schema: Optional[Schema] = None):
+        if fmt not in SUPPORTED_FORMATS:
+            raise HyperspaceException(f"Unsupported format: {fmt}")
+        self._root_paths = [os.path.abspath(p) for p in paths]
+        self._format = fmt
+        self._options = dict(options or {})
+        self._schema = schema
+        self._files: Optional[List[str]] = None
+
+    @property
+    def root_paths(self) -> List[str]:
+        return list(self._root_paths)
+
+    @property
+    def file_format(self) -> str:
+        return self._format
+
+    @property
+    def options(self) -> Dict[str, str]:
+        return dict(self._options)
+
+    @property
+    def schema(self) -> Schema:
+        if self._schema is None:
+            files = self.all_files()
+            if not files:
+                raise HyperspaceException(
+                    f"No data files under {self._root_paths}")
+            if self._format == "parquet":
+                self._schema = Schema.from_arrow(pq.read_schema(files[0]))
+            else:
+                ds = pa_ds.dataset(files[0], format=self._format)
+                self._schema = Schema.from_arrow(ds.schema)
+        return self._schema
+
+    def all_files(self) -> List[str]:
+        if self._files is None:
+            out: List[str] = []
+            suffix = "." + self._format
+            for root in self._root_paths:
+                if os.path.isfile(root):
+                    out.append(os.path.abspath(root))
+                    continue
+                for f in file_utils.list_leaf_files(root):
+                    if f.endswith(suffix):
+                        out.append(f)
+            self._files = sorted(out)
+        return list(self._files)
+
+    def signature(self) -> str:
+        """Fingerprint input: concatenated (size, mtime, path) per file
+        (parity: DefaultFileBasedRelation signature semantics)."""
+        parts = []
+        for path, size, mtime in self.all_file_infos():
+            parts.append(f"{size}{mtime}{path}")
+        return hashing.md5_hex("".join(parts))
+
+    def refresh(self) -> "DefaultFileBasedRelation":
+        return DefaultFileBasedRelation(
+            self._root_paths, self._format, self._options, schema=None)
+
+
+class DefaultFileBasedSourceBuilder(FileBasedSourceProvider):
+    """The provider the conf points at by default."""
+
+    def get_relation(self, plan_leaf) -> Optional[FileBasedRelation]:
+        relation = getattr(plan_leaf, "relation", None)
+        if isinstance(relation, DefaultFileBasedRelation):
+            return relation
+        return None
+
+    def build_relation(self, paths: Sequence[str], fmt: str,
+                       options: Dict[str, str]) -> Optional[FileBasedRelation]:
+        if fmt in SUPPORTED_FORMATS:
+            return DefaultFileBasedRelation(paths, fmt, options)
+        return None

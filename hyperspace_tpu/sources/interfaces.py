@@ -1,0 +1,123 @@
+"""Source abstraction: pluggable relation providers.
+
+Parity reference: sources/interfaces.scala:43-270 (FileBasedRelation,
+FileBasedSourceProvider, FileBasedRelationMetadata) and
+sources/FileBasedSourceProviderManager.scala:38-172.
+
+A relation describes a file-based dataset (root paths + format + schema) and
+exposes everything the rules/actions need: file listing, fingerprint input,
+lineage pairs, and a way to reload ("refresh") for refresh actions.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from ..exceptions import HyperspaceException
+from ..schema import Schema
+from ..util import file_utils
+
+
+class FileBasedRelation:
+    """Abstract relation over lake files."""
+
+    @property
+    def root_paths(self) -> List[str]:
+        raise NotImplementedError
+
+    @property
+    def schema(self) -> Schema:
+        raise NotImplementedError
+
+    @property
+    def file_format(self) -> str:
+        raise NotImplementedError
+
+    @property
+    def options(self) -> Dict[str, str]:
+        return {}
+
+    def all_files(self) -> List[str]:
+        """All leaf data files, absolute paths, deterministic order."""
+        raise NotImplementedError
+
+    def all_file_infos(self) -> List[Tuple[str, int, int]]:
+        """(path, size, mtime_ms) for each file in all_files()."""
+        return [file_utils.file_info_triple(p) for p in self.all_files()]
+
+    def signature(self) -> str:
+        """Relation fingerprint input (provider-specific)."""
+        raise NotImplementedError
+
+    @property
+    def partition_schema(self) -> Schema:
+        return Schema([])
+
+    @property
+    def partition_base_paths(self) -> List[str]:
+        return list(self.root_paths)
+
+    def describe(self) -> str:
+        return f"{self.file_format} {','.join(self.root_paths)}"
+
+    def lineage_pairs(self, file_id_tracker) -> List[Tuple[str, int]]:
+        """(file path, file id) pairs for the lineage column build
+        (parity: interfaces.scala lineagePairs)."""
+        return [(p, file_id_tracker.add_file(p, size, mtime))
+                for p, size, mtime in self.all_file_infos()]
+
+    def refresh(self) -> "FileBasedRelation":
+        """Re-list the underlying files (for refresh actions)."""
+        raise NotImplementedError
+
+
+class FileBasedSourceProvider:
+    """Builds relations it understands; returns None for ones it doesn't."""
+
+    def name(self) -> str:
+        return type(self).__name__
+
+    def get_relation(self, plan_leaf) -> Optional[FileBasedRelation]:
+        """If the leaf Scan's relation belongs to this provider, return it."""
+        raise NotImplementedError
+
+    def build_relation(self, paths: Sequence[str], fmt: str,
+                       options: Dict[str, str]) -> Optional[FileBasedRelation]:
+        raise NotImplementedError
+
+
+class FileBasedSourceProviderManager:
+    """Runs each provider in turn; exactly one must answer
+    (parity: FileBasedSourceProviderManager.scala:106-155)."""
+
+    def __init__(self, providers: List[FileBasedSourceProvider]):
+        if not providers:
+            raise HyperspaceException("At least one source provider is required.")
+        self._providers = providers
+
+    @property
+    def providers(self) -> List[FileBasedSourceProvider]:
+        return list(self._providers)
+
+    def _run(self, fn_name: str, *args):
+        answers = []
+        for p in self._providers:
+            result = getattr(p, fn_name)(*args)
+            if result is not None:
+                answers.append((p, result))
+        if len(answers) != 1:
+            raise HyperspaceException(
+                f"Exactly one provider must respond to {fn_name}; "
+                f"got {len(answers)} of {len(self._providers)}.")
+        return answers[0][1]
+
+    def get_relation(self, plan_leaf) -> FileBasedRelation:
+        return self._run("get_relation", plan_leaf)
+
+    def build_relation(self, paths: Sequence[str], fmt: str,
+                       options: Dict[str, str]) -> FileBasedRelation:
+        return self._run("build_relation", paths, fmt, options)
+
+    def is_supported_relation(self, plan_leaf) -> bool:
+        answers = [p.get_relation(plan_leaf) for p in self._providers]
+        return sum(1 for a in answers if a is not None) == 1

@@ -10,10 +10,18 @@ Env vars must be set before jax is imported anywhere.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Hard override: the ambient environment pins JAX_PLATFORMS=axon (the real
+# TPU tunnel); tests must run CPU-only with 8 virtual devices. jax is
+# pre-imported by sitecustomize, so update its config too — env alone is
+# captured before conftest runs.
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 

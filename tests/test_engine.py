@@ -1,0 +1,262 @@
+"""Execution engine correctness vs a pandas oracle.
+
+The reference's most valuable test pattern is disable-and-compare (index
+result == no-index result); before indexes exist, the engine itself needs an
+independent oracle — pandas plays that role here (SURVEY §7 hard-part #5).
+"""
+
+import datetime
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+import hyperspace_tpu as hst
+from hyperspace_tpu.plan.expr import Count, Sum, avg, col, count, max_, min_, sum_
+
+
+@pytest.fixture(scope="module")
+def sample_dir(tmp_path_factory):
+    """A small orders/lineitem-like pair of parquet datasets."""
+    rng = np.random.default_rng(42)
+    root = tmp_path_factory.mktemp("data")
+    n_orders, n_items = 500, 2000
+    orders = pd.DataFrame({
+        "o_orderkey": np.arange(n_orders, dtype=np.int64),
+        "o_custkey": rng.integers(0, 100, n_orders).astype(np.int64),
+        "o_totalprice": np.round(rng.uniform(10, 1000, n_orders), 2),
+        "o_orderdate": [datetime.date(1995, 1, 1) + datetime.timedelta(days=int(d))
+                        for d in rng.integers(0, 365, n_orders)],
+        "o_orderpriority": rng.choice(
+            ["1-URGENT", "2-HIGH", "3-MEDIUM", "4-NOT SPECIFIED", "5-LOW"], n_orders),
+    })
+    lineitem = pd.DataFrame({
+        "l_orderkey": rng.integers(0, n_orders, n_items).astype(np.int64),
+        "l_partkey": rng.integers(0, 200, n_items).astype(np.int64),
+        "l_quantity": rng.integers(1, 50, n_items).astype(np.int64),
+        "l_extendedprice": np.round(rng.uniform(100, 10000, n_items), 2),
+        "l_discount": np.round(rng.uniform(0, 0.1, n_items), 2),
+        "l_shipdate": [datetime.date(1995, 1, 1) + datetime.timedelta(days=int(d))
+                       for d in rng.integers(0, 365, n_items)],
+        "l_returnflag": rng.choice(["A", "N", "R"], n_items),
+    })
+    for name, df in [("orders", orders), ("lineitem", lineitem)]:
+        d = root / name
+        d.mkdir()
+        # Two files each, to exercise multi-file scans.
+        half = len(df) // 2
+        pq.write_table(pa.Table.from_pandas(df.iloc[:half]), d / "part0.parquet")
+        pq.write_table(pa.Table.from_pandas(df.iloc[half:]), d / "part1.parquet")
+    return {"root": root, "orders": orders, "lineitem": lineitem}
+
+
+@pytest.fixture()
+def session(sample_dir, tmp_system_path):
+    return hst.Session(system_path=tmp_system_path)
+
+
+def sorted_df(df):
+    out = df.sort_values(list(df.columns)).reset_index(drop=True)
+    return out
+
+
+def assert_frames_match(actual: pd.DataFrame, expected: pd.DataFrame):
+    actual = sorted_df(actual)
+    expected = sorted_df(expected)
+    assert list(actual.columns) == list(expected.columns)
+    assert len(actual) == len(expected)
+    for c in actual.columns:
+        a, e = actual[c].to_numpy(), expected[c].to_numpy()
+        if a.dtype.kind == "f" or e.dtype.kind == "f":
+            np.testing.assert_allclose(a.astype(float), e.astype(float), rtol=1e-9)
+        else:
+            assert (a == e).all(), f"column {c} differs"
+
+
+class TestScanFilterProject:
+    def test_full_scan(self, session, sample_dir):
+        df = session.read.parquet(str(sample_dir["root"] / "orders"))
+        out = df.to_pandas()
+        exp = sample_dir["orders"].copy()
+        out["o_orderdate"] = pd.to_datetime(out["o_orderdate"]).dt.date
+        assert_frames_match(out, exp)
+
+    def test_int_filter(self, session, sample_dir):
+        df = session.read.parquet(str(sample_dir["root"] / "lineitem"))
+        out = df.filter(col("l_quantity") >= 40).select(
+            "l_orderkey", "l_quantity").to_pandas()
+        li = sample_dir["lineitem"]
+        exp = li[li.l_quantity >= 40][["l_orderkey", "l_quantity"]]
+        assert_frames_match(out, exp)
+
+    def test_date_range_filter(self, session, sample_dir):
+        df = session.read.parquet(str(sample_dir["root"] / "lineitem"))
+        lo, hi = datetime.date(1995, 3, 1), datetime.date(1995, 6, 30)
+        out = df.filter(col("l_shipdate").between(lo, hi)) \
+            .select("l_orderkey", "l_shipdate").to_pandas()
+        out["l_shipdate"] = pd.to_datetime(out["l_shipdate"]).dt.date
+        li = sample_dir["lineitem"]
+        exp = li[(li.l_shipdate >= lo) & (li.l_shipdate <= hi)][
+            ["l_orderkey", "l_shipdate"]]
+        assert_frames_match(out, exp)
+
+    def test_string_equality_and_range(self, session, sample_dir):
+        df = session.read.parquet(str(sample_dir["root"] / "orders"))
+        out = df.filter(col("o_orderpriority") == "2-HIGH") \
+            .select("o_orderkey").to_pandas()
+        od = sample_dir["orders"]
+        exp = od[od.o_orderpriority == "2-HIGH"][["o_orderkey"]]
+        assert_frames_match(out, exp)
+        # Range over strings (order-preserving codes).
+        out2 = df.filter(col("o_orderpriority") < "3-MEDIUM") \
+            .select("o_orderkey").to_pandas()
+        exp2 = od[od.o_orderpriority < "3-MEDIUM"][["o_orderkey"]]
+        assert_frames_match(out2, exp2)
+
+    def test_string_literal_not_present(self, session, sample_dir):
+        df = session.read.parquet(str(sample_dir["root"] / "orders"))
+        assert df.filter(col("o_orderpriority") == "9-NOPE").count() == 0
+
+    def test_in_and_or(self, session, sample_dir):
+        df = session.read.parquet(str(sample_dir["root"] / "lineitem"))
+        cond = col("l_returnflag").isin(["A", "R"]) & \
+            ((col("l_quantity") < 5) | (col("l_quantity") > 45))
+        out = df.filter(cond).select("l_orderkey", "l_quantity").to_pandas()
+        li = sample_dir["lineitem"]
+        exp = li[li.l_returnflag.isin(["A", "R"])
+                 & ((li.l_quantity < 5) | (li.l_quantity > 45))][
+            ["l_orderkey", "l_quantity"]]
+        assert_frames_match(out, exp)
+
+    def test_arithmetic_projection(self, session, sample_dir):
+        df = session.read.parquet(str(sample_dir["root"] / "lineitem"))
+        revenue = (col("l_extendedprice") * (1 - col("l_discount"))).alias("revenue")
+        out = df.select(col("l_orderkey"), revenue).to_pandas()
+        li = sample_dir["lineitem"]
+        exp = pd.DataFrame({
+            "l_orderkey": li.l_orderkey,
+            "revenue": li.l_extendedprice * (1 - li.l_discount)})
+        assert_frames_match(out, exp)
+
+
+class TestJoin:
+    def test_equi_join(self, session, sample_dir):
+        orders = session.read.parquet(str(sample_dir["root"] / "orders"))
+        lineitem = session.read.parquet(str(sample_dir["root"] / "lineitem"))
+        joined = lineitem.join(orders, on=col("l_orderkey") == col("o_orderkey"))
+        out = joined.select("l_orderkey", "o_custkey", "l_quantity").to_pandas()
+        li, od = sample_dir["lineitem"], sample_dir["orders"]
+        exp = li.merge(od, left_on="l_orderkey", right_on="o_orderkey")[
+            ["l_orderkey", "o_custkey", "l_quantity"]]
+        assert_frames_match(out, exp)
+
+    def test_join_then_aggregate(self, session, sample_dir):
+        orders = session.read.parquet(str(sample_dir["root"] / "orders"))
+        lineitem = session.read.parquet(str(sample_dir["root"] / "lineitem"))
+        joined = lineitem.join(orders, on=col("l_orderkey") == col("o_orderkey"))
+        out = joined.group_by("o_custkey").agg(
+            sum_(col("l_quantity")).alias("total_qty")).to_pandas()
+        li, od = sample_dir["lineitem"], sample_dir["orders"]
+        merged = li.merge(od, left_on="l_orderkey", right_on="o_orderkey")
+        exp = merged.groupby("o_custkey", as_index=False).agg(
+            total_qty=("l_quantity", "sum"))
+        assert_frames_match(out, exp)
+
+    def test_string_key_join_different_dictionaries(self, session, tmp_path):
+        t1 = pd.DataFrame({"k1": ["a", "b", "c", "d"], "v1": [1, 2, 3, 4]})
+        t2 = pd.DataFrame({"k2": ["b", "c", "e"], "v2": [20, 30, 50]})
+        pq.write_table(pa.Table.from_pandas(t1), tmp_path / "t1.parquet")
+        pq.write_table(pa.Table.from_pandas(t2), tmp_path / "t2.parquet")
+        d1 = session.read.parquet(str(tmp_path / "t1.parquet"))
+        d2 = session.read.parquet(str(tmp_path / "t2.parquet"))
+        out = d1.join(d2, on=col("k1") == col("k2")) \
+            .select("k1", "v1", "v2").to_pandas()
+        exp = t1.merge(t2, left_on="k1", right_on="k2")[["k1", "v1", "v2"]]
+        assert_frames_match(out, exp)
+
+
+class TestAggregateSortLimit:
+    def test_group_by_multiple_aggs(self, session, sample_dir):
+        df = session.read.parquet(str(sample_dir["root"] / "lineitem"))
+        out = df.group_by("l_returnflag").agg(
+            sum_(col("l_quantity")).alias("sum_qty"),
+            avg(col("l_extendedprice")).alias("avg_price"),
+            min_(col("l_shipdate")).alias("min_date"),
+            max_(col("l_shipdate")).alias("max_date"),
+            count(col("l_orderkey")).alias("n"),
+        ).to_pandas()
+        out["min_date"] = pd.to_datetime(out["min_date"]).dt.date
+        out["max_date"] = pd.to_datetime(out["max_date"]).dt.date
+        li = sample_dir["lineitem"]
+        exp = li.groupby("l_returnflag", as_index=False).agg(
+            sum_qty=("l_quantity", "sum"),
+            avg_price=("l_extendedprice", "mean"),
+            min_date=("l_shipdate", "min"),
+            max_date=("l_shipdate", "max"),
+            n=("l_orderkey", "count"))
+        assert_frames_match(out, exp)
+
+    def test_multi_column_group(self, session, sample_dir):
+        df = session.read.parquet(str(sample_dir["root"] / "lineitem"))
+        out = df.group_by("l_returnflag", "l_partkey").agg(
+            sum_(col("l_quantity")).alias("q")).to_pandas()
+        li = sample_dir["lineitem"]
+        exp = li.groupby(["l_returnflag", "l_partkey"], as_index=False).agg(
+            q=("l_quantity", "sum"))
+        assert_frames_match(out, exp)
+
+    def test_global_aggregate(self, session, sample_dir):
+        df = session.read.parquet(str(sample_dir["root"] / "lineitem"))
+        out = df.agg(sum_(col("l_quantity")).alias("s"),
+                     count(col("l_quantity")).alias("n")).to_pandas()
+        li = sample_dir["lineitem"]
+        assert out["s"][0] == li.l_quantity.sum()
+        assert out["n"][0] == len(li)
+
+    def test_sort_desc_limit(self, session, sample_dir):
+        df = session.read.parquet(str(sample_dir["root"] / "orders"))
+        out = df.select("o_orderkey", "o_totalprice") \
+            .sort(("o_totalprice", False)).limit(10).to_pandas()
+        od = sample_dir["orders"]
+        exp = od.nlargest(10, "o_totalprice")[["o_orderkey", "o_totalprice"]] \
+            .reset_index(drop=True)
+        np.testing.assert_allclose(out["o_totalprice"], exp["o_totalprice"])
+
+    def test_sort_by_string(self, session, sample_dir):
+        df = session.read.parquet(str(sample_dir["root"] / "orders"))
+        out = df.select("o_orderpriority", "o_orderkey") \
+            .sort("o_orderpriority", "o_orderkey").to_pandas()
+        od = sample_dir["orders"]
+        exp = od[["o_orderpriority", "o_orderkey"]].sort_values(
+            ["o_orderpriority", "o_orderkey"]).reset_index(drop=True)
+        assert list(out["o_orderkey"]) == list(exp["o_orderkey"])
+
+
+class TestQ3Shape:
+    def test_tpch_q3_like(self, session, sample_dir):
+        """The BASELINE config #2 query shape end-to-end (no index yet)."""
+        orders = session.read.parquet(str(sample_dir["root"] / "orders"))
+        lineitem = session.read.parquet(str(sample_dir["root"] / "lineitem"))
+        cutoff = datetime.date(1995, 6, 15)
+        q = (lineitem.filter(col("l_shipdate") > cutoff)
+             .join(orders.filter(col("o_orderdate") < cutoff),
+                   on=col("l_orderkey") == col("o_orderkey"))
+             .group_by("l_orderkey", "o_orderdate")
+             .agg(sum_((col("l_extendedprice") * (1 - col("l_discount"))))
+                  .alias("revenue"))
+             .sort(("revenue", False), "o_orderdate")
+             .limit(10))
+        out = q.to_pandas()
+        li, od = sample_dir["lineitem"], sample_dir["orders"]
+        li_f = li[li.l_shipdate > cutoff]
+        od_f = od[od.o_orderdate < cutoff]
+        merged = li_f.merge(od_f, left_on="l_orderkey", right_on="o_orderkey")
+        merged["revenue"] = merged.l_extendedprice * (1 - merged.l_discount)
+        exp = merged.groupby(["l_orderkey", "o_orderdate"], as_index=False).agg(
+            revenue=("revenue", "sum")).sort_values(
+            ["revenue", "o_orderdate"], ascending=[False, True]).head(10) \
+            .reset_index(drop=True)
+        np.testing.assert_allclose(out["revenue"], exp["revenue"], rtol=1e-9)
+        assert list(out["l_orderkey"]) == list(exp["l_orderkey"])

@@ -1,0 +1,93 @@
+"""Signature provider + source provider tests.
+
+Parity: FileBasedSignatureProviderTest / IndexSignatureProviderTest.
+"""
+
+import os
+import time
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+import hyperspace_tpu as hst
+from hyperspace_tpu.exceptions import HyperspaceException
+from hyperspace_tpu.index.signatures import (
+    FileBasedSignatureProvider, IndexSignatureProvider, LogicalPlanSignatureProvider,
+    PlanSignatureProvider)
+from hyperspace_tpu.plan.expr import col
+from hyperspace_tpu.plan.nodes import Filter, Scan
+from hyperspace_tpu.sources.default import DefaultFileBasedRelation
+
+
+@pytest.fixture()
+def data_dir(tmp_path):
+    df = pd.DataFrame({"a": np.arange(10, dtype=np.int64), "b": list("abcdefghij")})
+    d = tmp_path / "t"
+    d.mkdir()
+    pq.write_table(pa.Table.from_pandas(df), d / "p0.parquet")
+    return d
+
+
+class TestSignatureProviders:
+    def test_file_based_stable(self, data_dir):
+        plan = Scan(DefaultFileBasedRelation([str(data_dir)]))
+        p = FileBasedSignatureProvider()
+        s1, s2 = p.signature(plan), p.signature(plan)
+        assert s1 == s2 and s1 is not None
+
+    def test_file_based_changes_on_file_change(self, data_dir):
+        plan = Scan(DefaultFileBasedRelation([str(data_dir)]))
+        s1 = FileBasedSignatureProvider().signature(plan)
+        # Append a new file → different signature (fresh relation, re-listed).
+        df = pd.DataFrame({"a": [99], "b": ["z"]})
+        pq.write_table(pa.Table.from_pandas(df), data_dir / "p1.parquet")
+        plan2 = Scan(DefaultFileBasedRelation([str(data_dir)]))
+        s2 = FileBasedSignatureProvider().signature(plan2)
+        assert s1 != s2
+
+    def test_plan_signature_reflects_structure(self, data_dir):
+        scan = Scan(DefaultFileBasedRelation([str(data_dir)]))
+        s_scan = PlanSignatureProvider().signature(scan)
+        s_filter = PlanSignatureProvider().signature(Filter(col("a") > 3, scan))
+        assert s_scan != s_filter
+
+    def test_index_signature_combines(self, data_dir):
+        plan = Scan(DefaultFileBasedRelation([str(data_dir)]))
+        combined = IndexSignatureProvider().signature(plan)
+        fb = FileBasedSignatureProvider().signature(plan)
+        assert combined is not None and combined != fb
+
+    def test_create_by_name(self):
+        p = LogicalPlanSignatureProvider.create("IndexSignatureProvider")
+        assert isinstance(p, IndexSignatureProvider)
+        p2 = LogicalPlanSignatureProvider.create(
+            "hyperspace_tpu.index.signatures.PlanSignatureProvider")
+        assert isinstance(p2, PlanSignatureProvider)
+        with pytest.raises(HyperspaceException):
+            LogicalPlanSignatureProvider.create("no.such.Provider")
+
+
+class TestDefaultSource:
+    def test_all_files_and_schema(self, data_dir):
+        rel = DefaultFileBasedRelation([str(data_dir)])
+        files = rel.all_files()
+        assert len(files) == 1 and files[0].endswith("p0.parquet")
+        assert rel.schema.names == ["a", "b"]
+
+    def test_lineage_pairs(self, data_dir):
+        from hyperspace_tpu.index.log_entry import FileIdTracker
+        rel = DefaultFileBasedRelation([str(data_dir)])
+        tracker = FileIdTracker()
+        pairs = rel.lineage_pairs(tracker)
+        assert len(pairs) == 1 and pairs[0][1] == 0
+
+    def test_provider_manager_exactly_one(self, data_dir, tmp_system_path):
+        session = hst.Session(system_path=tmp_system_path)
+        mgr = session.source_provider_manager
+        rel = mgr.build_relation([str(data_dir)], "parquet", {})
+        assert isinstance(rel, DefaultFileBasedRelation)
+        with pytest.raises(HyperspaceException):
+            mgr.build_relation([str(data_dir)], "avro", {})
