@@ -1,0 +1,3 @@
+from .action import Action  # noqa: F401
+from .create import CreateAction, CreateActionBase  # noqa: F401
+from .lifecycle import CancelAction, DeleteAction, RestoreAction, VacuumAction  # noqa: F401

@@ -1,0 +1,235 @@
+"""CreateAction: validate + build a covering index on device.
+
+Parity reference: actions/CreateAction.scala:29-86 (validation: supported
+relation, resolvable columns, name free) and actions/CreateActionBase.scala
+(write pipeline: project indexed+included columns, optional lineage column,
+repartition by indexed columns, bucketed+sorted write; log-entry assembly
+with source fingerprint).
+
+TPU-native differences: the repartition+sort runs as one XLA program
+(ops/index_build.py) instead of a Spark shuffle; lineage ids are attached as
+a device column built from per-file row counts instead of a broadcast join
+over input_file_name().
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List, Optional
+
+import jax.numpy as jnp
+import numpy as np
+import pyarrow.parquet as pq
+
+from ..exceptions import HyperspaceException
+from ..execution.columnar import Column, Table, read_parquet, write_parquet
+from ..index.constants import IndexConstants, States
+from ..index.data_manager import IndexDataManager
+from ..index.log_entry import (Content, CoveringIndex, Directory, FileIdTracker,
+                               Hdfs, IndexLogEntry, LogicalPlanFingerprint,
+                               Relation, Signature, Source, SourcePlan)
+from ..index.log_manager import IndexLogManager
+from ..index.signatures import IndexSignatureProvider
+from ..ops import index_build
+from ..plan.nodes import Scan
+from ..schema import INT64, Field, Schema
+from ..telemetry.events import CreateActionEvent
+from ..util.resolver import resolve_all
+from .action import Action
+
+
+class CreateActionBase(Action):
+    """Shared machinery for create + full/incremental refresh."""
+
+    def __init__(self, session, log_manager: IndexLogManager,
+                 data_manager: IndexDataManager):
+        super().__init__(session, log_manager)
+        self.data_manager = data_manager
+
+    # ------------------------------------------------------------------
+    # Build pipeline.
+    # ------------------------------------------------------------------
+
+    def _num_buckets(self) -> int:
+        return self.session.hs_conf.num_bucket_count()
+
+    def _lineage_enabled(self) -> bool:
+        return self.session.hs_conf.index_lineage_enabled()
+
+    def _load_projected(self, relation, indexed: List[str], included: List[str],
+                        file_id_tracker: FileIdTracker,
+                        files: Optional[List[str]] = None) -> Table:
+        """Read only the index columns; attach the lineage column when
+        enabled (file id per row, from per-file row counts)."""
+        cols = indexed + included
+        files = list(files) if files is not None else relation.all_files()
+        table = read_parquet(files, cols, relation.file_format)
+        if self._lineage_enabled():
+            counts = [pq.ParquetFile(f).metadata.num_rows for f in files] \
+                if relation.file_format == "parquet" else None
+            if counts is None:
+                raise HyperspaceException(
+                    "Lineage requires parquet sources in this version")
+            ids = [file_id_tracker.add_file(
+                *_file_triple(f)) for f in files]
+            lineage = np.repeat(np.asarray(ids, np.int64),
+                                np.asarray(counts, np.int64))
+            table = table.with_column(
+                IndexConstants.DATA_FILE_NAME_ID,
+                Column(INT64, jnp.asarray(lineage)))
+        return table
+
+    def _write_index_files(self, table: Table, indexed: List[str],
+                           version: int) -> str:
+        """Hash-partition + sort on device, then one parquet per bucket."""
+        num_buckets = self._num_buckets()
+        row_group_size = self.session.hs_conf.index_row_group_size()
+        sorted_table, bounds = index_build.build_sorted_buckets(
+            table, indexed, num_buckets)
+        out_dir = self.data_manager.get_path(version)
+        os.makedirs(out_dir, exist_ok=True)
+        for b in range(num_buckets):
+            lo, hi = int(bounds[b]), int(bounds[b + 1])
+            if hi <= lo:
+                continue  # empty buckets produce no file.
+            write_parquet(sorted_table.slice(lo, hi),
+                          os.path.join(out_dir, index_build.bucket_file_name(b)),
+                          row_group_size=row_group_size)
+        return out_dir
+
+    # ------------------------------------------------------------------
+    # Log entry assembly (parity: CreateActionBase.getIndexLogEntry).
+    # ------------------------------------------------------------------
+
+    def _index_properties(self, relation) -> dict:
+        props = {}
+        if self._lineage_enabled():
+            props[IndexConstants.LINEAGE_PROPERTY] = "true"
+        if relation.file_format == "parquet":
+            props[IndexConstants.HAS_PARQUET_AS_SOURCE_FORMAT_PROPERTY] = "true"
+        return props
+
+    def _build_entry(self, name: str, relation, plan, indexed: List[str],
+                     included: List[str], index_schema: Schema,
+                     file_id_tracker: FileIdTracker,
+                     index_content: Content) -> IndexLogEntry:
+        source_content = Content.from_leaf_files(
+            relation.all_files(), file_id_tracker)
+        rel_meta = Relation(
+            rootPaths=relation.root_paths,
+            data=Hdfs(source_content),
+            dataSchema=relation.schema,
+            fileFormat=relation.file_format,
+            options=relation.options)
+        provider = IndexSignatureProvider()
+        sig_value = provider.signature(plan)
+        fingerprint = LogicalPlanFingerprint(
+            [Signature(provider.name(), sig_value)])
+        source = Source(SourcePlan([rel_meta], fingerprint))
+        derived = CoveringIndex(
+            indexed_columns=indexed, included_columns=included,
+            schema=index_schema, num_buckets=self._num_buckets(),
+            properties=self._index_properties(relation))
+        return IndexLogEntry.create(name, derived, index_content, source, {})
+
+
+def _file_triple(path: str):
+    from ..util.file_utils import file_info_triple
+    return file_info_triple(path)
+
+
+class CreateAction(CreateActionBase):
+    transient_state = States.CREATING
+    final_state = States.ACTIVE
+
+    def __init__(self, session, df, index_config, log_manager: IndexLogManager,
+                 data_manager: IndexDataManager):
+        super().__init__(session, log_manager, data_manager)
+        self.df = df
+        self.index_config = index_config
+        self._entry: Optional[IndexLogEntry] = None
+        self._resolved: Optional[tuple] = None
+
+    # ------------------------------------------------------------------
+    # Validation (parity: CreateAction.scala:44-77).
+    # ------------------------------------------------------------------
+
+    def validate(self) -> None:
+        plan = self.df.plan
+        if not isinstance(plan, Scan):
+            raise HyperspaceException(
+                "Only creating an index over a plain scan of a file-based "
+                "relation is supported (no filters/joins under createIndex)")
+        if not self.session.source_provider_manager.is_supported_relation(plan):
+            raise HyperspaceException(
+                f"Relation is not supported: {plan.relation.describe()}")
+        self._resolve_columns()
+        latest = self.log_manager.get_latest_log()
+        if latest is not None and latest.state != States.DOESNOTEXIST:
+            raise HyperspaceException(
+                f"Another index with name {self.index_config.index_name} already exists")
+
+    def _resolve_columns(self):
+        if self._resolved is None:
+            schema_names = self.df.plan.schema.names
+            indexed = resolve_all(schema_names, self.index_config.indexed_columns)
+            included = resolve_all(schema_names, self.index_config.included_columns)
+            dup = set(indexed) & set(included)
+            if dup:
+                raise HyperspaceException(
+                    f"Columns in both indexed and included: {sorted(dup)}")
+            self._resolved = (indexed, included)
+        return self._resolved
+
+    # ------------------------------------------------------------------
+    # Work.
+    # ------------------------------------------------------------------
+
+    def op(self) -> None:
+        indexed, included = self._resolve_columns()
+        relation = self.df.plan.relation
+        tracker = FileIdTracker()
+        table = self._load_projected(relation, indexed, included, tracker)
+        self._write_index_files(table, indexed, version=0)
+        # Assemble the final entry now that index files exist.
+        index_content = Content.from_directory(
+            self.data_manager.get_path(0), tracker)
+        index_schema = Schema(
+            [self.df.plan.schema.field(c) for c in indexed + included])
+        if self._lineage_enabled():
+            index_schema = index_schema.append(
+                Field(IndexConstants.DATA_FILE_NAME_ID, INT64, False))
+        self._entry = self._build_entry(
+            self.index_config.index_name, relation, self.df.plan, indexed,
+            included, index_schema, tracker, index_content)
+        self._entry = self._entry.with_log_version(0)
+
+    @property
+    def log_entry(self) -> IndexLogEntry:
+        if self._entry is not None:
+            return self._entry
+        # begin() runs before op(): write a minimal placeholder entry.
+        indexed, included = self._resolve_columns()
+        relation = self.df.plan.relation
+        tracker = FileIdTracker()
+        source_content = Content.from_leaf_files(relation.all_files(), tracker)
+        index_schema = Schema(
+            [self.df.plan.schema.field(c) for c in indexed + included])
+        rel_meta = Relation(relation.root_paths, Hdfs(source_content),
+                            relation.schema, relation.file_format, relation.options)
+        provider = IndexSignatureProvider()
+        fingerprint = LogicalPlanFingerprint(
+            [Signature(provider.name(), provider.signature(self.df.plan))])
+        derived = CoveringIndex(indexed, included, index_schema,
+                                self._num_buckets(),
+                                self._index_properties(relation))
+        placeholder = Content(root=Directory("/"))
+        entry = IndexLogEntry.create(
+            self.index_config.index_name, derived, placeholder,
+            Source(SourcePlan([rel_meta], fingerprint)), {})
+        return entry.with_log_version(0)
+
+    def event(self, message: str) -> CreateActionEvent:
+        return CreateActionEvent(
+            message=message, index_name=self.index_config.index_name,
+            index_config=self.index_config)

@@ -1,0 +1,100 @@
+"""User API facade.
+
+Parity reference: Hyperspace.scala:26-196 (createIndex/deleteIndex/
+restoreIndex/vacuumIndex/refreshIndex/optimizeIndex/cancel/indexes/index/
+explain) and IndexConfig.scala. Per-session context (manager instances) is
+held on the facade, mirroring HyperspaceContext (Hyperspace.scala:169-196).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import List, Optional, Sequence
+
+from .exceptions import HyperspaceException
+from .index.constants import IndexConstants
+
+
+@dataclass(frozen=True)
+class IndexConfig:
+    """Covering-index specification (parity: IndexConfig.scala)."""
+
+    index_name: str
+    indexed_columns: List[str]
+    included_columns: List[str] = field(default_factory=list)
+
+    def __post_init__(self):
+        if not self.index_name:
+            raise HyperspaceException("Index name cannot be empty")
+        if not self.indexed_columns:
+            raise HyperspaceException("Indexed columns cannot be empty")
+        lowered = [c.lower() for c in
+                   list(self.indexed_columns) + list(self.included_columns)]
+        if len(set(lowered)) != len(lowered):
+            raise HyperspaceException(
+                "Duplicate columns across indexed/included lists")
+
+
+class Hyperspace:
+    def __init__(self, session):
+        self.session = session
+        self.index_manager = session.index_collection_manager
+
+    # ------------------------------------------------------------------
+    # CRUD.
+    # ------------------------------------------------------------------
+
+    def create_index(self, df, index_config: IndexConfig) -> None:
+        self.index_manager.create(df, index_config)
+
+    def delete_index(self, index_name: str) -> None:
+        self.index_manager.delete(index_name)
+
+    def restore_index(self, index_name: str) -> None:
+        self.index_manager.restore(index_name)
+
+    def vacuum_index(self, index_name: str) -> None:
+        self.index_manager.vacuum(index_name)
+
+    def refresh_index(self, index_name: str,
+                      mode: str = IndexConstants.REFRESH_MODE_FULL) -> None:
+        self.index_manager.refresh(index_name, mode)
+
+    def optimize_index(self, index_name: str,
+                       mode: str = IndexConstants.OPTIMIZE_MODE_QUICK) -> None:
+        self.index_manager.optimize(index_name, mode)
+
+    def cancel(self, index_name: str) -> None:
+        self.index_manager.cancel(index_name)
+
+    # ------------------------------------------------------------------
+    # Introspection.
+    # ------------------------------------------------------------------
+
+    def indexes(self):
+        """Summary DataFrame of all indexes (parity: hs.indexes)."""
+        return self.index_manager.indexes()
+
+    def index(self, index_name: str):
+        """Extended stats for one index (parity: hs.index(name))."""
+        import pandas as pd
+        from .index.statistics import IndexStatistics
+        entry = self.index_manager.get_index(index_name)
+        if entry is None:
+            raise HyperspaceException(f"Index with name {index_name} could not be found.")
+        return pd.DataFrame([IndexStatistics.from_entry(entry).to_extended_row()])
+
+    def explain(self, df, verbose: bool = False, redirect_func=None) -> str:
+        from .plananalysis.explain import explain_string
+        text = explain_string(self.session, df.plan, verbose=verbose)
+        if redirect_func is not None:
+            redirect_func(text)
+        return text
+
+    # CamelCase aliases for drop-in parity with the reference's API.
+    createIndex = create_index
+    deleteIndex = delete_index
+    restoreIndex = restore_index
+    vacuumIndex = vacuum_index
+    refreshIndex = refresh_index
+    optimizeIndex = optimize_index

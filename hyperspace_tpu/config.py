@@ -120,6 +120,12 @@ class HyperspaceConf:
                 IndexConstants.OPTIMIZE_FILE_SIZE_THRESHOLD,
                 str(IndexConstants.OPTIMIZE_FILE_SIZE_THRESHOLD_DEFAULT)))
 
+    def index_row_group_size(self) -> int:
+        return int(
+            self._conf.get(
+                IndexConstants.INDEX_ROW_GROUP_SIZE,
+                str(IndexConstants.INDEX_ROW_GROUP_SIZE_DEFAULT)))
+
     def index_cache_expiry_seconds(self) -> int:
         return int(
             self._conf.get(

@@ -76,9 +76,17 @@ class Column:
 
 @dataclass
 class Table:
-    """An ordered set of equal-length device columns."""
+    """An ordered set of equal-length device columns.
+
+    ``bucket_order`` is a physical-layout hint: ``(num_buckets, key_cols)``
+    means rows are grouped by ascending bucket id (hash of key_cols) and
+    sorted by key_cols within each bucket — the covering-index invariant.
+    The join path uses it to skip re-sorting (shuffle-free SMJ analogue).
+    Operations that permute or merge rows must drop it.
+    """
 
     columns: Dict[str, Column]
+    bucket_order: Optional[Tuple[int, Tuple[str, ...]]] = None
 
     def __post_init__(self):
         lengths = {len(c) for c in self.columns.values()}
@@ -105,25 +113,38 @@ class Table:
         return Schema([Field(n, c.dtype, c.has_nulls)
                        for n, c in self.columns.items()])
 
+    def _keep_order(self, names: Sequence[str]) -> Optional[Tuple]:
+        if self.bucket_order and all(k in names for k in self.bucket_order[1]):
+            return self.bucket_order
+        return None
+
     def select(self, names: Sequence[str]) -> "Table":
-        return Table({n: self.column(n) for n in names})
+        return Table({n: self.column(n) for n in names},
+                     bucket_order=self._keep_order(names))
 
     def take(self, indices) -> "Table":
         return Table({n: c.take(indices) for n, c in self.columns.items()})
 
     def filter(self, mask) -> "Table":
-        return Table({n: c.filter(mask) for n, c in self.columns.items()})
+        # A subsequence of bucket-ordered rows is still bucket-ordered.
+        return Table({n: c.filter(mask) for n, c in self.columns.items()},
+                     bucket_order=self.bucket_order)
 
     def slice(self, start: int, stop: int) -> "Table":
-        return Table({n: c.slice(start, stop) for n, c in self.columns.items()})
+        return Table({n: c.slice(start, stop) for n, c in self.columns.items()},
+                     bucket_order=self.bucket_order)
 
     def with_column(self, name: str, col: Column) -> "Table":
         out = dict(self.columns)
         out[name] = col
-        return Table(out)
+        return Table(out, bucket_order=self.bucket_order)
 
     def rename(self, mapping: Dict[str, str]) -> "Table":
-        return Table({mapping.get(n, n): c for n, c in self.columns.items()})
+        order = self.bucket_order
+        if order:
+            order = (order[0], tuple(mapping.get(k, k) for k in order[1]))
+        return Table({mapping.get(n, n): c for n, c in self.columns.items()},
+                     bucket_order=order)
 
     @staticmethod
     def concat(tables: Sequence["Table"]) -> "Table":
@@ -281,11 +302,12 @@ def _concat_string_columns(cols: List[Column]) -> Column:
 # ---------------------------------------------------------------------------
 
 def read_parquet(files: Sequence[str], columns: Optional[Sequence[str]] = None,
-                 fmt: str = "parquet") -> Table:
+                 fmt: str = "parquet", filters=None) -> Table:
     if not files:
         raise HyperspaceException("read_parquet: no files")
     if fmt == "parquet":
-        at = pq.read_table(list(files), columns=list(columns) if columns else None)
+        at = pq.read_table(list(files), columns=list(columns) if columns else None,
+                           filters=filters)
     elif fmt == "csv":
         import pyarrow.csv as pa_csv
         tables = [pa_csv.read_csv(f) for f in files]
@@ -299,6 +321,16 @@ def read_parquet(files: Sequence[str], columns: Optional[Sequence[str]] = None,
 
 def write_parquet(table: Table, path: str, row_group_size: Optional[int] = None) -> None:
     pq.write_table(table.to_arrow(), path, row_group_size=row_group_size)
+
+
+def empty_table(schema: "Schema") -> Table:
+    cols = {}
+    for f in schema.fields:
+        dictionary = np.array([], dtype=str) if f.dtype == STRING else None
+        cols[f.name] = Column(f.dtype,
+                              jnp.zeros(0, _DEVICE_DTYPE[f.dtype]),
+                              None, dictionary)
+    return Table(cols)
 
 
 def dictionaries_equal(a: Optional[np.ndarray], b: Optional[np.ndarray]) -> bool:

@@ -26,6 +26,7 @@ from ..schema import BOOL, DATE, FLOAT64, INT32, INT64, STRING
 from .columnar import (Column, Table, dictionaries_equal, read_parquet,
                        translate_codes)
 from .evaluator import eval_expr, eval_predicate_mask
+from .pushdown import pushable_filter
 
 
 def execute(plan: LogicalPlan) -> Table:
@@ -40,7 +41,17 @@ def _execute(plan: LogicalPlan, needed: Optional[Set[str]]) -> Table:
     if isinstance(plan, Filter):
         child_needed = None if needed is None else \
             needed | set(plan.condition.references)
-        table = _execute(plan.child, child_needed)
+        if isinstance(plan.child, (Scan, IndexScan)):
+            # Push row-group-prunable conjuncts into the parquet read.
+            pa_filter = pushable_filter(plan.condition, plan.child.schema)
+            if isinstance(plan.child, Scan):
+                table = _execute_scan(plan.child, child_needed, pa_filter)
+            else:
+                buckets = _equality_bucket_subset(plan.child, plan.condition)
+                table = _execute_index_scan(plan.child, child_needed, pa_filter,
+                                            bucket_subset=buckets)
+        else:
+            table = _execute(plan.child, child_needed)
         mask = eval_predicate_mask(table, plan.condition)
         return table.filter(mask)
     if isinstance(plan, Project):
@@ -48,7 +59,19 @@ def _execute(plan: LogicalPlan, needed: Optional[Set[str]]) -> Table:
         for e in plan.exprs:
             child_needed.update(e.references)
         table = _execute(plan.child, child_needed)
-        return Table({e.name: eval_expr(table, e) for e in plan.exprs})
+        out = Table({e.name: eval_expr(table, e) for e in plan.exprs})
+        # Pass-through column projections keep the bucket-order invariant.
+        bo = table.bucket_order
+        if bo:
+            name_map = {}
+            for e in plan.exprs:
+                inner = e.child if isinstance(e, E.Alias) else e
+                if isinstance(inner, E.Col):
+                    name_map.setdefault(inner.column, e.name)
+            if all(k in name_map for k in bo[1]):
+                out = Table(out.columns,
+                            bucket_order=(bo[0], tuple(name_map[k] for k in bo[1])))
+        return out
     if isinstance(plan, Join):
         return _execute_join(plan, needed)
     if isinstance(plan, Aggregate):
@@ -72,7 +95,8 @@ def _execute(plan: LogicalPlan, needed: Optional[Set[str]]) -> Table:
     raise HyperspaceException(f"Cannot execute plan node {plan.node_name}")
 
 
-def _execute_scan(plan: Scan, needed: Optional[Set[str]]) -> Table:
+def _execute_scan(plan: Scan, needed: Optional[Set[str]],
+                  pa_filter=None) -> Table:
     relation = plan.relation
     cols = None
     if needed is not None:
@@ -82,14 +106,84 @@ def _execute_scan(plan: Scan, needed: Optional[Set[str]]) -> Table:
     files = relation.all_files()
     if not files:
         raise HyperspaceException(f"No files for relation {relation.describe()}")
-    return read_parquet(files, cols, relation.file_format)
+    if relation.file_format != "parquet":
+        pa_filter = None
+    return read_parquet(files, cols, relation.file_format, filters=pa_filter)
 
 
-def _execute_index_scan(plan: IndexScan, needed: Optional[Set[str]]) -> Table:
+def _equality_bucket_subset(plan: IndexScan, condition) -> Optional[Set[int]]:
+    """Bucket pruning: equality/IN predicates on the first indexed column pin
+    the buckets a matching row can live in (the reference's
+    INDEX_FILTER_RULE_USE_BUCKET_SPEC behavior — Spark prunes bucket files;
+    we prune before IO)."""
+    if not plan.use_bucket_spec:
+        return None
+    entry = plan.index_entry
+    # The bucket id combines the hashes of ALL indexed columns (index_build.
+    # bucket_ids_for), so pruning needs an equality constraint on every one.
+    from .columnar import literal_to_device
+    per_column_hashes = []
+    for name in entry.indexed_columns:
+        if name not in entry.schema:
+            return None
+        dtype = entry.schema.field(name).dtype
+        values = None
+        for conjunct in E.split_conjunctive_predicates(condition):
+            vals = _equality_values(conjunct, name)
+            if vals is not None:
+                values = vals if values is None else (values & vals)
+        if values is None or len(values) > 16:
+            return None
+        hashes = []
+        for v in values:
+            if dtype == STRING:
+                hashes.append(kernels.hash32_value_host(str(v), dtype))
+            else:
+                hashes.append(kernels.hash32_value_host(
+                    literal_to_device(v, dtype, None), dtype))
+        per_column_hashes.append(hashes)
+
+    combos = [None]
+    for hashes in per_column_hashes:
+        combos = [kernels.hash_combine_host(c, h) if c is not None else h
+                  for c in combos for h in hashes]
+        if len(combos) > 256:
+            return None
+    return {c % entry.num_buckets for c in combos}
+
+
+def _equality_values(conjunct, column: str):
+    if isinstance(conjunct, E.EqualTo):
+        left, right = conjunct.left, conjunct.right
+        if isinstance(left, E.Lit) and isinstance(right, E.Col):
+            left, right = right, left
+        if isinstance(left, E.Col) and left.column == column \
+                and isinstance(right, E.Lit):
+            return {right.value}
+    if isinstance(conjunct, E.In) and isinstance(conjunct.value, E.Col) \
+            and conjunct.value.column == column:
+        if all(isinstance(o, E.Lit) for o in conjunct.options):
+            return {o.value for o in conjunct.options}
+    return None
+
+
+def _execute_index_scan(plan: IndexScan, needed: Optional[Set[str]],
+                        pa_filter=None,
+                        bucket_subset: Optional[Set[int]] = None) -> Table:
     from ..index.constants import IndexConstants
+    from ..ops.index_build import bucket_id_from_file
 
     entry = plan.index_entry
     index_files = sorted(entry.content.files)
+    if bucket_subset is not None:
+        index_files = [f for f in index_files
+                       if bucket_id_from_file(f) in bucket_subset]
+        if not index_files and not plan.appended_files:
+            from .columnar import empty_table
+            out_schema = plan.schema if needed is None else \
+                plan.schema.select([n for n in plan.schema.names if n in needed]
+                                   or [plan.schema.names[0]])
+            return empty_table(out_schema)
     schema_names = entry.schema.names
     cols = None
     if needed is not None:
@@ -98,7 +192,18 @@ def _execute_index_scan(plan: IndexScan, needed: Optional[Set[str]]) -> Table:
             cols = [schema_names[0]]
         if plan.deleted_file_ids and IndexConstants.DATA_FILE_NAME_ID not in cols:
             cols = cols + [IndexConstants.DATA_FILE_NAME_ID]
-    table = read_parquet(index_files, cols)
+    if not index_files:
+        from .columnar import empty_table
+        table = empty_table(entry.schema.select(cols or entry.schema.names))
+    else:
+        table = read_parquet(index_files, cols, filters=pa_filter)
+    if entry.derivedDataset.kind == "CoveringIndex" and not plan.appended_files \
+            and all(c in table.names for c in entry.indexed_columns):
+        # Physical layout invariant: files are read in bucket order and rows
+        # are sorted by the indexed columns within each bucket. Downstream
+        # joins exploit this to skip re-sorting. (Subsequent filters keep it.)
+        table = Table(table.columns, bucket_order=(
+            entry.num_buckets, tuple(entry.indexed_columns)))
     if plan.deleted_file_ids:
         lineage = table.column(IndexConstants.DATA_FILE_NAME_ID)
         deleted = jnp.asarray(
@@ -195,10 +300,23 @@ def _execute_join(plan: Join, needed: Optional[Set[str]]) -> Table:
         right = right.filter(rvalid)
         rkeys = rkeys[rvalid]
 
-    order = kernels.lex_sort_indices([rkeys])
-    right_sorted = right.take(order)
-    rkeys_sorted = jnp.take(rkeys, order)
-    li, ri = kernels.merge_join_indices(lkeys, rkeys_sorted)
+    # Shuffle-free path: a side that carries the covering-index bucket order
+    # on its join key is already sorted by (bucket, key) — probe it directly
+    # instead of re-sorting (the TPU analogue of Spark consuming bucketSpec
+    # for a zero-exchange sort-merge join, JoinIndexRule.scala:64-78).
+    fast = _bucketed_merge_keys(left, right, norm, lkeys, rkeys)
+    if fast is not None:
+        lcomp, rcomp, swapped = fast
+        if swapped:
+            left, right = right, left
+            lcomp, rcomp = rcomp, lcomp
+        li, ri = kernels.merge_join_indices(lcomp, rcomp)
+        right_sorted = right
+    else:
+        order = kernels.lex_sort_indices([rkeys])
+        right_sorted = right.take(order)
+        rkeys_sorted = jnp.take(rkeys, order)
+        li, ri = kernels.merge_join_indices(lkeys, rkeys_sorted)
     out = {}
     taken_left = left.take(li)
     taken_right = right_sorted.take(ri)
@@ -209,6 +327,50 @@ def _execute_join(plan: Join, needed: Optional[Set[str]]) -> Table:
         elif n in taken_right.columns:
             out[n] = taken_right.columns[n]
     return Table(out)
+
+
+def _bucketed_merge_keys(left: Table, right: Table, norm, lkeys, rkeys):
+    """If one side is bucket-ordered on its single join key (covering-index
+    layout), build composite (bucket, key) probe keys so the merge join can
+    run without sorting that side. Returns (left_comp, right_comp, swapped)
+    or None.
+
+    Requires an integer-family key that fits in 32 bits (packed with the
+    bucket id into one int64); the general path handles the rest.
+    """
+    if len(norm) != 1:
+        return None
+    lname, rname = norm[0]
+    lcol, rcol = left.column(lname), right.column(rname)
+    if lcol.dtype not in (INT32, INT64, DATE) or rcol.dtype != lcol.dtype:
+        return None
+
+    def ordered_on(table: Table, name: str):
+        return table.bucket_order is not None and table.bucket_order[1] == (name,)
+
+    if ordered_on(right, rname):
+        swapped = False
+        num_buckets = right.bucket_order[0]
+    elif ordered_on(left, lname):
+        swapped = True
+        num_buckets = left.bucket_order[0]
+    else:
+        return None
+    # Keys must fit int32 for the (bucket << 32 | biased key) packing. One
+    # fused reduction + single host sync covers both arrays.
+    to_check = [a for a in (lkeys, rkeys) if a.dtype == jnp.int64 and a.shape[0]]
+    if to_check:
+        extreme = int(jnp.maximum(*[jnp.max(jnp.abs(a)) for a in to_check])
+                      if len(to_check) == 2 else jnp.max(jnp.abs(to_check[0])))
+        if extreme >= 2 ** 31 or extreme < 0:  # < 0: abs(int64 min) overflow.
+            return None
+
+    def composite(col: Column, keys):
+        h = kernels.hash32_values(keys, col.dtype)
+        b = kernels.bucket_ids(h, num_buckets)
+        return kernels.pack2_int32(b, keys.astype(jnp.int32))
+
+    return composite(lcol, lkeys), composite(rcol, rkeys), swapped
 
 
 def _keys_validity(table: Table, names: Sequence[str]):

@@ -1,0 +1,46 @@
+"""TTL cache for index metadata (parity: index/Cache.scala:23,
+CachingIndexCollectionManager.scala:61-124, IndexCacheFactory.scala)."""
+
+from __future__ import annotations
+
+import time
+from typing import Generic, Optional, TypeVar
+
+T = TypeVar("T")
+
+
+class Cache(Generic[T]):
+    def get(self) -> Optional[T]:
+        raise NotImplementedError
+
+    def set(self, entry: T) -> None:
+        raise NotImplementedError
+
+    def clear(self) -> None:
+        raise NotImplementedError
+
+
+class CreationTimeBasedIndexCache(Cache[T]):
+    """Entries expire ``expiry_seconds`` after they were cached."""
+
+    def __init__(self, expiry_seconds_fn):
+        # Callable so the TTL tracks the live conf value.
+        self._expiry_seconds_fn = expiry_seconds_fn
+        self._entry: Optional[T] = None
+        self._cached_at: float = 0.0
+
+    def get(self) -> Optional[T]:
+        if self._entry is None:
+            return None
+        if time.time() - self._cached_at > self._expiry_seconds_fn():
+            self.clear()
+            return None
+        return self._entry
+
+    def set(self, entry: T) -> None:
+        self._entry = entry
+        self._cached_at = time.time()
+
+    def clear(self) -> None:
+        self._entry = None
+        self._cached_at = 0.0

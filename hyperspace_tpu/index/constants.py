@@ -98,6 +98,13 @@ class IndexConstants:
     FILE_BASED_SOURCE_BUILDERS = "hyperspace.index.sources.fileBasedBuilders"
     EVENT_LOGGER_CLASS = "hyperspace.eventLoggerClass"
 
+    # Parquet row-group size for index files: smaller groups → finer
+    # row-group pruning on the sorted indexed columns (the reference leans on
+    # Spark's parquet writer defaults; we make it a first-class knob because
+    # pruning granularity is the filter-path win).
+    INDEX_ROW_GROUP_SIZE = "hyperspace.index.rowGroupSize"
+    INDEX_ROW_GROUP_SIZE_DEFAULT = 65536
+
     # TPU-native execution knobs (no reference analogue: the reference delegates
     # execution to Spark; these control the XLA/Pallas execution path).
     TPU_EXECUTION_ENABLED = "hyperspace.tpu.execution.enabled"

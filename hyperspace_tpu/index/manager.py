@@ -1,0 +1,221 @@
+"""IndexManager interface + implementations.
+
+Parity reference: index/IndexManager.scala:24-125 (the CRUD contract),
+index/IndexCollectionManager.scala:28-196 (dispatch to actions with
+per-index log/data managers; list indexes by scanning the system path),
+index/CachingIndexCollectionManager.scala:38-170 (TTL cache over getIndexes,
+cleared on any mutation).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List, Optional
+
+from ..exceptions import HyperspaceException
+from .cache import CreationTimeBasedIndexCache
+from .constants import IndexConstants, States
+from .data_manager import IndexDataManager
+from .log_entry import IndexLogEntry
+from .log_manager import IndexLogManager
+from .path_resolver import PathResolver
+
+
+class IndexManager:
+    """The CRUD contract (reference: IndexManager.scala:24-125)."""
+
+    def create(self, df, index_config) -> None:
+        raise NotImplementedError
+
+    def delete(self, index_name: str) -> None:
+        raise NotImplementedError
+
+    def restore(self, index_name: str) -> None:
+        raise NotImplementedError
+
+    def vacuum(self, index_name: str) -> None:
+        raise NotImplementedError
+
+    def refresh(self, index_name: str, mode: str) -> None:
+        raise NotImplementedError
+
+    def optimize(self, index_name: str, mode: str) -> None:
+        raise NotImplementedError
+
+    def cancel(self, index_name: str) -> None:
+        raise NotImplementedError
+
+    def indexes(self):
+        """User-facing statistics rows as a pandas DataFrame."""
+        raise NotImplementedError
+
+    def get_indexes(self, states: Optional[List[str]] = None) -> List[IndexLogEntry]:
+        raise NotImplementedError
+
+    def get_index(self, index_name: str) -> Optional[IndexLogEntry]:
+        raise NotImplementedError
+
+    def get_index_versions(self, index_name: str,
+                           states: List[str]) -> List[int]:
+        raise NotImplementedError
+
+
+class IndexCollectionManager(IndexManager):
+    def __init__(self, session):
+        self.session = session
+        self._path_resolver = PathResolver(session.hs_conf)
+
+    # ------------------------------------------------------------------
+    # Helpers (parity: IndexCollectionManager.withLogManager).
+    # ------------------------------------------------------------------
+
+    def _index_path(self, name: str) -> str:
+        return self._path_resolver.get_index_path(name)
+
+    def _log_manager(self, name: str, must_exist: bool = True) -> IndexLogManager:
+        path = self._index_path(name)
+        if must_exist and not os.path.isdir(path):
+            raise HyperspaceException(f"Index with name {name} could not be found.")
+        return IndexLogManager(path)
+
+    def _data_manager(self, name: str) -> IndexDataManager:
+        return IndexDataManager(self._index_path(name))
+
+    # ------------------------------------------------------------------
+    # CRUD dispatch.
+    # ------------------------------------------------------------------
+
+    def create(self, df, index_config) -> None:
+        from ..actions.create import CreateAction
+        name = index_config.index_name
+        log_mgr = self._log_manager(name, must_exist=False)
+        CreateAction(self.session, df, index_config, log_mgr,
+                     self._data_manager(name)).run()
+
+    def delete(self, index_name: str) -> None:
+        from ..actions.lifecycle import DeleteAction
+        DeleteAction(self.session, self._log_manager(index_name)).run()
+
+    def restore(self, index_name: str) -> None:
+        from ..actions.lifecycle import RestoreAction
+        RestoreAction(self.session, self._log_manager(index_name)).run()
+
+    def vacuum(self, index_name: str) -> None:
+        from ..actions.lifecycle import VacuumAction
+        VacuumAction(self.session, self._log_manager(index_name),
+                     self._data_manager(index_name)).run()
+
+    def cancel(self, index_name: str) -> None:
+        from ..actions.lifecycle import CancelAction
+        CancelAction(self.session, self._log_manager(index_name)).run()
+
+    def refresh(self, index_name: str, mode: str = "full") -> None:
+        if mode not in IndexConstants.REFRESH_MODES:
+            raise HyperspaceException(
+                f"Unsupported refresh mode: {mode}; "
+                f"choose from {IndexConstants.REFRESH_MODES}")
+        from ..actions.refresh import (RefreshAction, RefreshIncrementalAction,
+                                       RefreshQuickAction)
+        cls = {
+            IndexConstants.REFRESH_MODE_FULL: RefreshAction,
+            IndexConstants.REFRESH_MODE_INCREMENTAL: RefreshIncrementalAction,
+            IndexConstants.REFRESH_MODE_QUICK: RefreshQuickAction,
+        }[mode]
+        cls(self.session, self._log_manager(index_name),
+            self._data_manager(index_name)).run()
+
+    def optimize(self, index_name: str, mode: str = "quick") -> None:
+        from ..actions.optimize import OptimizeAction
+        if mode not in IndexConstants.OPTIMIZE_MODES:
+            raise HyperspaceException(
+                f"Unsupported optimize mode: {mode}; "
+                f"choose from {IndexConstants.OPTIMIZE_MODES}")
+        OptimizeAction(self.session, self._log_manager(index_name),
+                       self._data_manager(index_name), mode).run()
+
+    # ------------------------------------------------------------------
+    # Listing.
+    # ------------------------------------------------------------------
+
+    def _index_names(self) -> List[str]:
+        system_path = self._path_resolver.system_path
+        if not os.path.isdir(system_path):
+            return []
+        return sorted(
+            n for n in os.listdir(system_path)
+            if os.path.isdir(os.path.join(system_path, n, IndexConstants.HYPERSPACE_LOG)))
+
+    def get_indexes(self, states: Optional[List[str]] = None) -> List[IndexLogEntry]:
+        out = []
+        for name in self._index_names():
+            entry = IndexLogManager(
+                os.path.join(self._path_resolver.system_path, name)).get_latest_log()
+            if entry is not None and (states is None or entry.state in states):
+                out.append(entry)
+        return out
+
+    def get_index(self, index_name: str) -> Optional[IndexLogEntry]:
+        if index_name not in self._index_names():
+            return None
+        return self._log_manager(index_name).get_latest_log()
+
+    def get_index_versions(self, index_name: str, states: List[str]) -> List[int]:
+        return self._log_manager(index_name).get_index_versions(states)
+
+    def indexes(self):
+        from .statistics import IndexStatistics
+        import pandas as pd
+        rows = [IndexStatistics.from_entry(e).to_row()
+                for e in self.get_indexes()
+                if e.state != States.DOESNOTEXIST]
+        return pd.DataFrame(rows, columns=IndexStatistics.SUMMARY_COLUMNS)
+
+
+class CachingIndexCollectionManager(IndexCollectionManager):
+    """TTL-cached getIndexes; every mutation clears the cache
+    (parity: CachingIndexCollectionManager.scala:38-124)."""
+
+    def __init__(self, session):
+        super().__init__(session)
+        self._cache = CreationTimeBasedIndexCache(
+            session.hs_conf.index_cache_expiry_seconds)
+
+    def clear_cache(self) -> None:
+        self._cache.clear()
+
+    def get_indexes(self, states: Optional[List[str]] = None) -> List[IndexLogEntry]:
+        if states is None:
+            return super().get_indexes(None)
+        all_entries = self._cache.get()
+        if all_entries is None:
+            all_entries = super().get_indexes(None)
+            self._cache.set(all_entries)
+        return [e for e in all_entries if e.state in states]
+
+    def create(self, df, index_config) -> None:
+        self.clear_cache()
+        super().create(df, index_config)
+
+    def delete(self, index_name: str) -> None:
+        self.clear_cache()
+        super().delete(index_name)
+
+    def restore(self, index_name: str) -> None:
+        self.clear_cache()
+        super().restore(index_name)
+
+    def vacuum(self, index_name: str) -> None:
+        self.clear_cache()
+        super().vacuum(index_name)
+
+    def refresh(self, index_name: str, mode: str = "full") -> None:
+        self.clear_cache()
+        super().refresh(index_name, mode)
+
+    def optimize(self, index_name: str, mode: str = "quick") -> None:
+        self.clear_cache()
+        super().optimize(index_name, mode)
+
+    def cancel(self, index_name: str) -> None:
+        self.clear_cache()
+        super().cancel(index_name)

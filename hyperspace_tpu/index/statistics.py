@@ -1,0 +1,82 @@
+"""User-facing index statistics rows (parity: index/IndexStatistics.scala:43-196)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List
+
+from .constants import IndexConstants
+from .log_entry import IndexLogEntry
+
+
+@dataclass
+class IndexStatistics:
+    name: str
+    indexed_columns: List[str]
+    included_columns: List[str]
+    num_buckets: int
+    schema_json: str
+    index_location: str
+    state: str
+    lineage_enabled: bool
+    source_file_count: int
+    source_size_bytes: int
+    index_file_count: int
+    index_size_bytes: int
+    appended_file_count: int
+    deleted_file_count: int
+    index_content_paths: List[str]
+
+    SUMMARY_COLUMNS = ["name", "indexedColumns", "includedColumns", "numBuckets",
+                       "schema", "indexLocation", "state"]
+
+    @staticmethod
+    def from_entry(entry: IndexLogEntry) -> "IndexStatistics":
+        import json
+        content_files = entry.content.files
+        # Index location = common version dir prefix of the newest files.
+        location = ""
+        if content_files:
+            import os
+            location = os.path.dirname(sorted(content_files)[-1])
+        return IndexStatistics(
+            name=entry.name,
+            indexed_columns=list(entry.indexed_columns),
+            included_columns=list(entry.included_columns),
+            num_buckets=entry.num_buckets,
+            schema_json=json.dumps(entry.schema.to_json_dict()),
+            index_location=location,
+            state=entry.state,
+            lineage_enabled=entry.has_lineage_column(),
+            source_file_count=len(entry.source_file_info_set),
+            source_size_bytes=entry.source_files_size_in_bytes,
+            index_file_count=len(entry.content.file_infos),
+            index_size_bytes=entry.index_files_size_in_bytes,
+            appended_file_count=len(entry.appended_files),
+            deleted_file_count=len(entry.deleted_files),
+            index_content_paths=sorted({p.rsplit("/", 1)[0] for p in content_files}))
+
+    def to_row(self) -> Dict:
+        return {
+            "name": self.name,
+            "indexedColumns": self.indexed_columns,
+            "includedColumns": self.included_columns,
+            "numBuckets": self.num_buckets,
+            "schema": self.schema_json,
+            "indexLocation": self.index_location,
+            "state": self.state,
+        }
+
+    def to_extended_row(self) -> Dict:
+        row = self.to_row()
+        row.update({
+            "lineageEnabled": self.lineage_enabled,
+            "sourceFileCount": self.source_file_count,
+            "sourceSizeBytes": self.source_size_bytes,
+            "indexFileCount": self.index_file_count,
+            "indexSizeBytes": self.index_size_bytes,
+            "appendedFileCount": self.appended_file_count,
+            "deletedFileCount": self.deleted_file_count,
+            "indexContentPaths": self.index_content_paths,
+        })
+        return row

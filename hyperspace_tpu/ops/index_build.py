@@ -1,0 +1,70 @@
+"""Covering-index build pipeline: hash-partition + sort-within-bucket on device.
+
+This is the TPU-native replacement for the reference's index-creation Spark
+job — ``df.repartition(numBuckets, indexedCols)`` followed by a bucketed,
+sorted write (reference: actions/CreateActionBase.scala:111-181,
+index/DataFrameWriterExtensions.scala:50-68). Instead of a network shuffle,
+the whole dataset is bucket-assigned with a murmur-style hash and sorted by
+(bucket, indexed columns) in one fused XLA program; the distributed variant
+(parallel/distributed_build.py) shards rows over the mesh and exchanges
+buckets with an all-to-all over ICI.
+
+The single-scalar host reads here are bucket boundaries, needed to slice the
+sorted array into per-bucket parquet files at the host DMA boundary.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..exceptions import HyperspaceException
+from ..execution.columnar import Table
+from . import kernels
+
+
+def bucket_ids_for(table: Table, indexed_cols: Sequence[str],
+                   num_buckets: int) -> jax.Array:
+    """Bucket id per row: combined value-stable hash of the indexed columns
+    modulo num_buckets (parity with the repartition-by-key semantics)."""
+    h = None
+    for name in indexed_cols:
+        col = table.column(name)
+        ch = kernels.hash32_values(col.data, col.dtype, col.dictionary)
+        h = ch if h is None else kernels.hash_combine(h, ch)
+    return kernels.bucket_ids(h, num_buckets)
+
+
+def build_sorted_buckets(table: Table, indexed_cols: Sequence[str],
+                         num_buckets: int) -> Tuple[Table, np.ndarray]:
+    """Sort all rows by (bucket id, indexed columns); return the sorted table
+    and per-bucket boundary offsets (len num_buckets+1, host numpy).
+
+    Rows within each bucket end up sorted by the indexed columns — exactly
+    the invariant the shuffle-free merge join and bucket-pruned filter scan
+    rely on.
+    """
+    bids = bucket_ids_for(table, indexed_cols, num_buckets)
+    sort_keys = [bids] + [table.column(c).data for c in indexed_cols]
+    perm = kernels.lex_sort_indices(sort_keys)
+    sorted_table = table.take(perm)
+    sorted_bids = jnp.take(bids, perm)
+    boundaries = jnp.searchsorted(
+        sorted_bids, jnp.arange(num_buckets + 1, dtype=sorted_bids.dtype))
+    return sorted_table, np.asarray(jax.device_get(boundaries))
+
+
+def bucket_file_name(bucket: int) -> str:
+    """One file per bucket (bucket id recoverable from the name, mirroring
+    Spark's BucketingUtils suffix convention)."""
+    return f"part-{bucket:05d}.parquet"
+
+
+def bucket_id_from_file(path: str) -> Optional[int]:
+    import os
+    import re
+    m = re.match(r"part-(\d{5})", os.path.basename(path))
+    return int(m.group(1)) if m else None

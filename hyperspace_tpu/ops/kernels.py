@@ -78,6 +78,46 @@ def hash32_values(data: jax.Array, dtype: str,
     raise HyperspaceException(f"Cannot hash dtype {dtype}")
 
 
+def _fmix32_host(x: int) -> int:
+    """Host mirror of _fmix32 for single literals (bucket pruning)."""
+    x &= 0xFFFFFFFF
+    x ^= x >> 16
+    x = (x * 0x85EBCA6B) & 0xFFFFFFFF
+    x ^= x >> 13
+    x = (x * 0xC2B2AE35) & 0xFFFFFFFF
+    x ^= x >> 16
+    return x
+
+
+def hash_combine_host(h1: int, h2: int) -> int:
+    """Host mirror of hash_combine."""
+    return (h1 ^ ((h2 + 0x9E3779B9 + ((h1 << 6) & 0xFFFFFFFF) + (h1 >> 2))
+                  & 0xFFFFFFFF)) & 0xFFFFFFFF
+
+
+def hash32_value_host(value, dtype: str) -> int:
+    """Host-side hash of one literal, identical to hash32_values on device.
+    Used to compute the bucket a literal lands in (bucket pruning)."""
+    import struct
+
+    if dtype == STRING:
+        return _fmix32_host(zlib.crc32(str(value).encode("utf-8")))
+    if dtype in (INT32, DATE, BOOL):
+        return _fmix32_host(int(value) & 0xFFFFFFFF)
+    if dtype == INT64:
+        u = int(value) & 0xFFFFFFFFFFFFFFFF
+        lo, hi = u & 0xFFFFFFFF, u >> 32
+        return _fmix32_host((lo ^ ((hi * 0x9E3779B9) & 0xFFFFFFFF)) & 0xFFFFFFFF)
+    if dtype == FLOAT32:
+        bits = struct.unpack("<I", struct.pack("<f", float(value)))[0]
+        return _fmix32_host(bits)
+    if dtype == FLOAT64:
+        bits = struct.unpack("<Q", struct.pack("<d", float(value)))[0]
+        lo, hi = bits & 0xFFFFFFFF, bits >> 32
+        return _fmix32_host((lo ^ ((hi * 0x9E3779B9) & 0xFFFFFFFF)) & 0xFFFFFFFF)
+    raise HyperspaceException(f"Cannot hash dtype {dtype}")
+
+
 def hash_combine(h1: jax.Array, h2: jax.Array) -> jax.Array:
     """Boost-style combiner over uint32."""
     return (h1 ^ ((h2 + np.uint32(0x9E3779B9) + (h1 << 6) + (h1 >> 2)) & _M32)) & _M32
@@ -149,9 +189,15 @@ def _expand_matches(counts: jax.Array, lo: jax.Array, total: int
 
 
 def pack2_int32(a: jax.Array, b: jax.Array) -> jax.Array:
-    """Pack two int32 key columns into one int64 composite key."""
-    return (a.astype(jnp.int64) << np.int64(32)) | (
-        b.astype(jnp.int64) & np.int64(0xFFFFFFFF))
+    """Pack two int32 key columns into one int64 composite key.
+
+    ``b`` is sign-biased (XOR 0x80000000) so the packed composite orders the
+    same as (a asc, b signed-asc) — without the bias, negative ``b`` values
+    sort above positive ones in the low 32 bits and break the merge join's
+    sortedness precondition.
+    """
+    b_biased = (b.astype(jnp.int64) ^ np.int64(0x80000000)) & np.int64(0xFFFFFFFF)
+    return (a.astype(jnp.int64) << np.int64(32)) | b_biased
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,3 @@
+from .distributed_build import distributed_build_sorted_buckets  # noqa: F401
+from .mesh import (DATA_AXIS, bucket_owner, device_bucket_range, make_mesh,  # noqa: F401
+                   replicated, row_sharding)

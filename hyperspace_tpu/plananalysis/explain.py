@@ -1,0 +1,71 @@
+"""Explain API: diff the plan with and without hyperspace rules.
+
+Parity reference: plananalysis/PlanAnalyzer.scala:36-120 — builds two
+executions (rules enabled/disabled), highlights the differing subtrees, and
+lists the indexes the rewritten plan uses.
+"""
+
+from __future__ import annotations
+
+from ..plan.nodes import IndexScan, LogicalPlan
+
+
+def _used_indexes(plan: LogicalPlan):
+    out = []
+    for leaf in plan.collect_leaves():
+        if isinstance(leaf, IndexScan):
+            e = leaf.index_entry
+            out.append(f"{e.name} (Type: {e.derivedDataset.kind_abbr}, "
+                       f"LogVersion: {e.log_version})")
+    return out
+
+
+def explain_string(session, plan: LogicalPlan, verbose: bool = False) -> str:
+    was_enabled = session.is_hyperspace_enabled()
+    try:
+        session.enable_hyperspace()
+        with_index = session.optimize(plan)
+    finally:
+        if not was_enabled:
+            session.disable_hyperspace()
+
+    lines = []
+    lines.append("=" * 60)
+    lines.append("Plan with indexes:")
+    lines.append("=" * 60)
+    lines.append(with_index.tree_string())
+    lines.append("")
+    lines.append("=" * 60)
+    lines.append("Plan without indexes:")
+    lines.append("=" * 60)
+    lines.append(plan.tree_string())
+    lines.append("")
+    lines.append("=" * 60)
+    lines.append("Indexes used:")
+    lines.append("=" * 60)
+    used = _used_indexes(with_index)
+    lines.extend(used if used else ["<none>"])
+    if verbose:
+        lines.append("")
+        lines.append("=" * 60)
+        lines.append("Physical operator stats:")
+        lines.append("=" * 60)
+        before = _count_nodes(plan)
+        after = _count_nodes(with_index)
+        for name in sorted(set(before) | set(after)):
+            b, a = before.get(name, 0), after.get(name, 0)
+            if b != a:
+                lines.append(f"{name}: {b} -> {a}")
+    return "\n".join(lines)
+
+
+def _count_nodes(plan: LogicalPlan):
+    counts = {}
+
+    def rec(node):
+        counts[node.node_name] = counts.get(node.node_name, 0) + 1
+        for c in node.children:
+            rec(c)
+
+    rec(plan)
+    return counts

@@ -1,0 +1,4 @@
+from .apply_hyperspace import apply_hyperspace  # noqa: F401
+from .filter_rule import FilterIndexRule  # noqa: F401
+from .join_rule import JoinIndexRule  # noqa: F401
+from .rankers import FilterIndexRanker, JoinIndexRanker  # noqa: F401

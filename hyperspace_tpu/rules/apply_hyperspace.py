@@ -1,7 +1,29 @@
-"""Entry point of the rewrite batch (placeholder until rules land)."""
+"""The hyperspace rewrite batch.
+
+Parity reference: package.scala:35-46 — enableHyperspace injects the batch
+``JoinIndexRule :: FilterIndexRule`` into the optimizer; ApplyHyperspace
+(rules/ApplyHyperspace.scala:103) is the next-gen single entry point that
+collects candidate indexes once per plan. We follow the same order: join
+rewrites first (they constrain both sides), then filter rewrites.
+"""
 
 from __future__ import annotations
 
+from typing import List
 
-def apply_hyperspace(session, plan):
+from ..index.constants import States
+from ..index.log_entry import IndexLogEntry
+from ..plan.nodes import LogicalPlan
+
+
+def active_indexes(session) -> List[IndexLogEntry]:
+    """ACTIVE indexes from the session's shared caching index manager."""
+    return session.index_collection_manager.get_indexes([States.ACTIVE])
+
+
+def apply_hyperspace(session, plan: LogicalPlan) -> LogicalPlan:
+    from .filter_rule import FilterIndexRule
+    from .join_rule import JoinIndexRule
+    plan = JoinIndexRule().apply(session, plan)
+    plan = FilterIndexRule().apply(session, plan)
     return plan

@@ -1,0 +1,71 @@
+"""Column pruning: narrow each subtree to the columns its ancestors need.
+
+The reference's rules run inside Spark's optimizer *after* ColumnPruning has
+already narrowed join sides to the referenced columns — JoinIndexRule's
+coverage check (getUsableIndexes) depends on that. This pass is our
+equivalent: it inserts Projects at the top of join inputs (and below
+aggregates/projects) so the hyperspace rules see the true referenced-column
+sets. Executor-level IO pruning exists independently; this pass is about
+making rule decisions correct.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Set
+
+from ..plan.nodes import (Aggregate, BucketUnion, Filter, IndexScan, Join, Limit,
+                          LogicalPlan, Project, Scan, Sort, Union)
+
+
+def prune_columns(plan: LogicalPlan, required: Optional[Set[str]] = None
+                  ) -> LogicalPlan:
+    if required is None:
+        required = set(plan.schema.names)
+
+    if isinstance(plan, (Scan, IndexScan)):
+        return plan
+    if isinstance(plan, Project):
+        child_req: Set[str] = set()
+        for e in plan.exprs:
+            child_req.update(e.references)
+        return Project(plan.exprs, prune_columns(plan.child, child_req))
+    if isinstance(plan, Filter):
+        child_req = required | set(plan.condition.references)
+        return Filter(plan.condition, prune_columns(plan.child, child_req))
+    if isinstance(plan, Aggregate):
+        child_req = set(plan.group_cols)
+        for a in plan.aggs:
+            child_req.update(a.references)
+        return Aggregate(plan.group_cols, plan.aggs,
+                         prune_columns(plan.child, child_req))
+    if isinstance(plan, Sort):
+        child_req = required | {c for c, _ in plan.orders}
+        return Sort(plan.orders, prune_columns(plan.child, child_req))
+    if isinstance(plan, Limit):
+        return Limit(plan.n, prune_columns(plan.child, required))
+    if isinstance(plan, (Union, BucketUnion)):
+        children = [prune_columns(c, set(required)) for c in plan.children]
+        return plan.with_children(children)
+    if isinstance(plan, Join):
+        cond_refs = set(plan.condition.references)
+        left_names = set(plan.left.schema.names)
+        right_names = set(plan.right.schema.names)
+        lreq = (required | cond_refs) & left_names
+        rreq = (required | cond_refs) & right_names
+        left = prune_columns(plan.left, lreq)
+        right = prune_columns(plan.right, rreq)
+        left = _narrow(left, lreq)
+        right = _narrow(right, rreq)
+        return Join(left, right, plan.condition, plan.join_type)
+    return plan
+
+
+def _narrow(plan: LogicalPlan, required: Set[str]) -> LogicalPlan:
+    """Insert a Project if the plan outputs more than required."""
+    names = plan.schema.names
+    keep = [n for n in names if n in required]
+    if len(keep) == len(names) or not keep:
+        return plan
+    if isinstance(plan, Project):
+        return Project([e for e in plan.exprs if e.name in required], plan.child)
+    return Project(keep, plan)

@@ -1,0 +1,77 @@
+"""FilterIndexRule: rewrite Scan→Filter(→Project) to probe a covering index.
+
+Parity reference: rules/FilterIndexRule.scala:38-197. Applicability
+(indexCoversPlan, FilterIndexRule.scala:144-155):
+
+  1. the index's *first* indexed column appears in the filter predicate
+     (the sort order within buckets makes that column cheap to probe), and
+  2. the index covers every column the plan touches (project + filter).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from ..index.constants import States
+from ..index.log_entry import IndexLogEntry
+from ..plan.nodes import Filter, LogicalPlan, Project, Scan
+from ..telemetry.events import HyperspaceIndexUsageEvent
+from ..telemetry.logging import get_logger
+from .rankers import FilterIndexRanker
+from .rule_utils import (collect_filter_project_columns, get_candidate_indexes,
+                         get_relation, transform_plan_to_use_index)
+
+
+def _extract_filter_node(plan: LogicalPlan):
+    """Match Project(Filter(Scan)) / Filter(Scan); returns (scan, filter) or
+    None (parity: ExtractFilterNode, FilterIndexRule.scala:165)."""
+    node = plan
+    if isinstance(node, Project):
+        node = node.child
+    if not isinstance(node, Filter):
+        return None
+    if not isinstance(node.child, Scan):
+        return None
+    return node.child, node
+
+
+def index_covers_plan(entry: IndexLogEntry, project_cols: List[str],
+                      filter_cols: List[str]) -> bool:
+    first_indexed = entry.indexed_columns[0]
+    if first_indexed not in filter_cols:
+        return False
+    covered = set(entry.indexed_columns) | set(entry.included_columns)
+    return set(project_cols) | set(filter_cols) <= covered
+
+
+class FilterIndexRule:
+    name = "FilterIndexRule"
+
+    def apply(self, session, plan: LogicalPlan) -> LogicalPlan:
+        matched = _extract_filter_node(plan)
+        if matched is None:
+            return plan
+        scan, _ = matched
+        relation = get_relation(session, scan)
+        if relation is None:
+            return plan
+
+        project_cols, filter_cols = collect_filter_project_columns(plan)
+        if not filter_cols:
+            return plan
+
+        from .apply_hyperspace import active_indexes
+        candidates = [e for e in active_indexes(session)
+                      if index_covers_plan(e, project_cols, filter_cols)]
+        candidates = get_candidate_indexes(session, candidates, scan)
+        best = FilterIndexRanker.rank(session, relation, candidates)
+        if best is None:
+            return plan
+
+        use_bucket_spec = session.hs_conf.use_bucket_spec_for_filter_rule()
+        new_plan = transform_plan_to_use_index(session, best, plan, use_bucket_spec)
+        get_logger(session.hs_conf.event_logger_class()).log_event(
+            HyperspaceIndexUsageEvent(
+                index_names=[best.name], plan_string=new_plan.tree_string(),
+                message="Filter index applied."))
+        return new_plan

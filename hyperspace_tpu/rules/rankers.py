@@ -1,0 +1,60 @@
+"""Index rankers: pick the best candidate(s).
+
+Parity reference: rankers/FilterIndexRanker.scala:43 (Hybrid Scan → max
+common source bytes, else min index size; ties broken lexicographically by
+name) and rankers/JoinIndexRanker.scala:52 (prefer equal bucket counts, then
+more buckets, then more common source bytes).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+from ..index.log_entry import IndexLogEntry
+from .rule_utils import common_source_bytes
+
+
+class FilterIndexRanker:
+    @staticmethod
+    def rank(session, relation, candidates: List[IndexLogEntry]
+             ) -> Optional[IndexLogEntry]:
+        if not candidates:
+            return None
+        if session.hs_conf.hybrid_scan_enabled():
+            return max(candidates,
+                       key=lambda e: (common_source_bytes(e, relation),
+                                      _neg_name(e.name)))
+        return min(candidates,
+                   key=lambda e: (e.index_files_size_in_bytes, e.name))
+
+
+def _neg_name(name: str):
+    # max() with lexicographically-smallest-name tiebreak.
+    return tuple(-ord(c) for c in name)
+
+
+class JoinIndexRanker:
+    @staticmethod
+    def rank(session, left_relation, right_relation,
+             pairs: List[Tuple[IndexLogEntry, IndexLogEntry]]
+             ) -> Optional[Tuple[IndexLogEntry, IndexLogEntry]]:
+        if not pairs:
+            return None
+        hybrid = session.hs_conf.hybrid_scan_enabled()
+
+        def score(pair):
+            l, r = pair
+            equal_buckets = 1 if l.num_buckets == r.num_buckets else 0
+            more_buckets = l.num_buckets + r.num_buckets
+            common = 0
+            if hybrid:
+                common = (common_source_bytes(l, left_relation)
+                          + common_source_bytes(r, right_relation))
+            return (equal_buckets, more_buckets, common,
+                    _neg_names(l.name, r.name))
+
+        return max(pairs, key=score)
+
+
+def _neg_names(a: str, b: str):
+    return tuple(-ord(c) for c in a + "\x00" + b)

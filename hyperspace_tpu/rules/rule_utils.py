@@ -1,0 +1,176 @@
+"""Shared rule machinery: candidate selection + plan transformation.
+
+Parity reference: rules/RuleUtils.scala:52-569.
+
+- ``get_candidate_indexes``: signature match in the common case; with Hybrid
+  Scan enabled, file-overlap selection bounded by appended/deleted byte-ratio
+  thresholds (RuleUtils.scala:52-190).
+- ``transform_plan_to_use_index``: swap the source Scan for an IndexScan —
+  index-only scan when the file sets match exactly, otherwise a Hybrid Scan
+  (appended files merged in, deleted rows masked via the lineage column)
+  (RuleUtils.scala:193-567). On TPU the BucketUnion of index + re-bucketed
+  appended rows is a shard-aligned concatenation (SURVEY §5 item 3).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+from ..index.constants import IndexConstants
+from ..index.log_entry import FileInfo, IndexLogEntry
+from ..index.signatures import LogicalPlanSignatureProvider
+from ..plan.nodes import Filter, IndexScan, LogicalPlan, Project, Scan
+from ..schema import Schema
+from ..util import file_utils
+
+
+def get_relation(session, plan: LogicalPlan):
+    """The single supported file-based relation leaf of a linear plan, or
+    None (parity: RuleUtils.getRelation — exactly one relation required)."""
+    leaves = plan.collect_leaves()
+    if len(leaves) != 1 or not isinstance(leaves[0], Scan):
+        return None
+    if not session.source_provider_manager.is_supported_relation(leaves[0]):
+        return None
+    return leaves[0].relation
+
+
+def _plan_signature(entry: IndexLogEntry, scan: Scan) -> Optional[str]:
+    recorded = entry.signature.signatures
+    if not recorded:
+        return None
+    provider = LogicalPlanSignatureProvider.create(recorded[0].provider)
+    return provider.signature(scan)
+
+
+def _current_file_infos(relation) -> List[FileInfo]:
+    return [FileInfo(p, size, mtime, IndexConstants.UNKNOWN_FILE_ID)
+            for p, size, mtime in relation.all_file_infos()]
+
+
+def get_candidate_indexes(session, indexes: List[IndexLogEntry],
+                          scan: Scan) -> List[IndexLogEntry]:
+    """Indexes applicable to this scan. Signature equality, or — with Hybrid
+    Scan on — bounded file-overlap."""
+    hybrid = session.hs_conf.hybrid_scan_enabled()
+    out = []
+    for entry in indexes:
+        if not hybrid:
+            sig = _plan_signature(entry, scan)
+            recorded = entry.signature.signatures[0].value \
+                if entry.signature.signatures else None
+            if sig is not None and recorded is not None and sig == recorded:
+                out.append(entry)
+            continue
+        ok, _, _ = hybrid_scan_file_diff(session, entry, scan.relation)
+        if ok:
+            out.append(entry)
+    return out
+
+
+def hybrid_scan_file_diff(session, entry: IndexLogEntry, relation
+                          ) -> Tuple[bool, List[FileInfo], List[FileInfo]]:
+    """(applicable?, appended files, deleted files) under Hybrid Scan rules
+    (parity: RuleUtils.scala:96-160)."""
+    current = set(_current_file_infos(relation))
+    logged = entry.source_file_info_set
+    common = current & logged
+    if not common:
+        return False, [], []
+    appended = sorted(current - logged, key=lambda f: f.name)
+    deleted = sorted(logged - common, key=lambda f: f.name)
+    if deleted and not entry.has_lineage_column():
+        return False, [], []
+    common_bytes = sum(f.size for f in common)
+    appended_bytes = sum(f.size for f in appended)
+    deleted_bytes = sum(f.size for f in deleted)
+    appended_ratio = appended_bytes / (appended_bytes + common_bytes) \
+        if appended_bytes else 0.0
+    deleted_ratio = deleted_bytes / (deleted_bytes + common_bytes) \
+        if deleted_bytes else 0.0
+    if appended_ratio > session.hs_conf.hybrid_scan_appended_ratio_threshold():
+        return False, [], []
+    if deleted_ratio > session.hs_conf.hybrid_scan_deleted_ratio_threshold():
+        return False, [], []
+    return True, appended, deleted
+
+
+def common_source_bytes(entry: IndexLogEntry, relation) -> int:
+    current = set(_current_file_infos(relation))
+    return sum(f.size for f in (current & entry.source_file_info_set))
+
+
+def index_scan_schema(entry: IndexLogEntry) -> Schema:
+    """The index schema exposed to the plan (lineage column hidden)."""
+    names = [n for n in entry.schema.names
+             if n != IndexConstants.DATA_FILE_NAME_ID]
+    return entry.schema.select(names)
+
+
+def transform_plan_to_use_index(session, entry: IndexLogEntry,
+                                plan: LogicalPlan,
+                                use_bucket_spec: bool) -> LogicalPlan:
+    """Replace the plan's Scan leaf with an IndexScan over ``entry``.
+
+    Exact-match source → index-only scan; otherwise Hybrid Scan state
+    (appended file paths + deleted file ids) is attached to the IndexScan
+    and realized by the executor (concat + lineage mask).
+    """
+
+    def replace(node: LogicalPlan) -> LogicalPlan:
+        if isinstance(node, Scan):
+            appended_paths: List[str] = []
+            deleted_ids: List[int] = []
+            if session.hs_conf.hybrid_scan_enabled():
+                ok, appended, deleted = hybrid_scan_file_diff(
+                    session, entry, node.relation)
+                if ok:
+                    appended_paths = [f.name for f in appended]
+                    if deleted:
+                        by_key = {(f.name, f.size, f.modifiedTime): f.id
+                                  for f in entry.source_file_info_set}
+                        deleted_ids = [
+                            by_key[(f.name, f.size, f.modifiedTime)]
+                            for f in deleted]
+            return IndexScan(entry, index_scan_schema(entry),
+                             use_bucket_spec=use_bucket_spec,
+                             deleted_file_ids=deleted_ids,
+                             appended_files=appended_paths)
+        return node
+
+    return plan.transform_up(replace)
+
+
+def is_plan_linear(plan: LogicalPlan) -> bool:
+    """Scan/Filter/Project chain with single children all the way down
+    (parity: JoinIndexRule.isPlanLinear)."""
+    node = plan
+    while True:
+        if isinstance(node, Scan):
+            return True
+        if not isinstance(node, (Filter, Project)):
+            return False
+        children = node.children
+        if len(children) != 1:
+            return False
+        node = children[0]
+
+
+def collect_filter_project_columns(plan: LogicalPlan) -> Tuple[List[str], List[str]]:
+    """(project/output columns, filter columns) referenced by a linear plan."""
+    project_cols: List[str] = []
+    filter_cols: List[str] = []
+    node = plan
+    saw_project = False
+    while not isinstance(node, Scan):
+        if isinstance(node, Project):
+            if not saw_project:
+                for e in node.exprs:
+                    project_cols.extend(e.references)
+                saw_project = True
+        elif isinstance(node, Filter):
+            filter_cols.extend(node.condition.references)
+        node = node.children[0]
+    if not saw_project:
+        project_cols = list(plan.schema.names)
+    return project_cols, filter_cols

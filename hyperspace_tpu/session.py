@@ -36,6 +36,16 @@ class Session:
         from .config import CacheWithTransform
         self._provider_manager_cache = CacheWithTransform(
             self.hs_conf.file_based_source_builders, self._build_provider_manager)
+        self._index_collection_manager = None
+
+    @property
+    def index_collection_manager(self):
+        """The per-session caching index manager (HyperspaceContext parity:
+        rules and the user facade share one instance + one cache)."""
+        if self._index_collection_manager is None:
+            from .index.manager import CachingIndexCollectionManager
+            self._index_collection_manager = CachingIndexCollectionManager(self)
+        return self._index_collection_manager
 
     @property
     def read(self) -> "DataFrameReader":
@@ -84,7 +94,10 @@ class Session:
     # ------------------------------------------------------------------
 
     def optimize(self, plan: LogicalPlan) -> LogicalPlan:
-        """Apply the hyperspace rewrite batch if enabled."""
+        """General optimizations (column pruning), then the hyperspace
+        rewrite batch if enabled."""
+        from .rules.column_pruning import prune_columns
+        plan = prune_columns(plan)
         if not self._hyperspace_enabled:
             return plan
         from .rules.apply_hyperspace import apply_hyperspace

@@ -1,0 +1,6 @@
+from .events import (  # noqa: F401
+    CancelActionEvent, CreateActionEvent, DeleteActionEvent, HyperspaceEvent,
+    HyperspaceIndexUsageEvent, OptimizeActionEvent, RefreshActionEvent,
+    RefreshIncrementalActionEvent, RefreshQuickActionEvent, RestoreActionEvent,
+    VacuumActionEvent)
+from .logging import EventLogger, HyperspaceEventLogging, NoOpEventLogger, get_logger  # noqa: F401

@@ -1,0 +1,86 @@
+"""Typed telemetry event model.
+
+Parity reference: telemetry/HyperspaceEvent.scala:28-156 — one event class
+per action (start/success/failure carried in ``message``/``emitted_on``), plus
+an index-usage event emitted by the rewrite rules.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+
+@dataclass
+class HyperspaceEvent:
+    """Base event. ``app_id`` identifies the session; ``message`` carries
+    RUNNING/SUCCESS/FAILURE details."""
+
+    app_id: str = ""
+    message: str = ""
+    emitted_on_ms: int = field(default_factory=lambda: int(time.time() * 1000))
+
+    @property
+    def event_name(self) -> str:
+        return type(self).__name__
+
+
+@dataclass
+class HyperspaceIndexCRUDEvent(HyperspaceEvent):
+    index_name: str = ""
+    log_entry_json: Optional[str] = None
+
+
+@dataclass
+class CreateActionEvent(HyperspaceIndexCRUDEvent):
+    index_config: Optional[object] = None
+
+
+@dataclass
+class DeleteActionEvent(HyperspaceIndexCRUDEvent):
+    pass
+
+
+@dataclass
+class RestoreActionEvent(HyperspaceIndexCRUDEvent):
+    pass
+
+
+@dataclass
+class VacuumActionEvent(HyperspaceIndexCRUDEvent):
+    pass
+
+
+@dataclass
+class CancelActionEvent(HyperspaceIndexCRUDEvent):
+    pass
+
+
+@dataclass
+class RefreshActionEvent(HyperspaceIndexCRUDEvent):
+    pass
+
+
+@dataclass
+class RefreshIncrementalActionEvent(HyperspaceIndexCRUDEvent):
+    pass
+
+
+@dataclass
+class RefreshQuickActionEvent(HyperspaceIndexCRUDEvent):
+    pass
+
+
+@dataclass
+class OptimizeActionEvent(HyperspaceIndexCRUDEvent):
+    pass
+
+
+@dataclass
+class HyperspaceIndexUsageEvent(HyperspaceEvent):
+    """Emitted when a rewrite rule applies indexes to a plan
+    (parity: rules/FilterIndexRule.scala:69-78)."""
+
+    index_names: List[str] = field(default_factory=list)
+    plan_string: str = ""

@@ -1,0 +1,45 @@
+"""Column-name resolution (parity: util/ResolverUtils.scala:44-162).
+
+Resolves user-provided column names against a schema case-insensitively (or
+sensitively, per conf). Nested-field flattening (``a.b.c`` →
+``__hs_nested.a.b.c``) is part of the reference contract; our engine's
+schemas are flat, so the prefix constant exists but nested inputs are
+rejected explicitly rather than mis-resolved.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+from ..exceptions import HyperspaceException
+
+NESTED_FIELD_PREFIX = "__hs_nested."
+
+
+def resolve(available: Sequence[str], requested: str,
+            case_sensitive: bool = False) -> Optional[str]:
+    """Resolve one name; returns the schema's spelling or None."""
+    if "." in requested:
+        raise HyperspaceException(
+            f"Nested column '{requested}' is not supported yet "
+            f"(flat schemas only; reserved prefix {NESTED_FIELD_PREFIX!r})")
+    if case_sensitive:
+        return requested if requested in available else None
+    matches = [a for a in available if a.lower() == requested.lower()]
+    if len(matches) > 1:
+        raise HyperspaceException(
+            f"Ambiguous column '{requested}' matches {matches}")
+    return matches[0] if matches else None
+
+
+def resolve_all(available: Sequence[str], requested: Sequence[str],
+                case_sensitive: bool = False) -> List[str]:
+    """Resolve all names or raise naming the first failure."""
+    out = []
+    for r in requested:
+        resolved = resolve(available, r, case_sensitive)
+        if resolved is None:
+            raise HyperspaceException(
+                f"Column '{r}' could not be resolved; available: {list(available)}")
+        out.append(resolved)
+    return out

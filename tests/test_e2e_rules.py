@@ -1,0 +1,316 @@
+"""End-to-end index lifecycle + rewrite tests.
+
+Parity: E2EHyperspaceRulesTest.scala (the reference's backbone suite) — the
+core oracle is disable-and-compare: query results with hyperspace enabled
+(index used) must equal results with it disabled (source scanned).
+"""
+
+import datetime
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+import hyperspace_tpu as hst
+from hyperspace_tpu.api import Hyperspace, IndexConfig
+from hyperspace_tpu.exceptions import HyperspaceException
+from hyperspace_tpu.index.constants import IndexConstants, States
+from hyperspace_tpu.plan.expr import col, sum_
+from hyperspace_tpu.plan.nodes import IndexScan
+
+
+def write_sample(root, name, df, parts=2):
+    d = root / name
+    d.mkdir(parents=True, exist_ok=True)
+    step = max(1, len(df) // parts)
+    for i in range(parts):
+        chunk = df.iloc[i * step:(i + 1) * step if i < parts - 1 else len(df)]
+        pq.write_table(pa.Table.from_pandas(chunk.reset_index(drop=True)),
+                       d / f"part{i}.parquet")
+    return str(d)
+
+
+@pytest.fixture()
+def env(tmp_path):
+    rng = np.random.default_rng(0)
+    n = 2000
+    lineitem = pd.DataFrame({
+        "l_orderkey": rng.integers(0, 500, n).astype(np.int64),
+        "l_quantity": rng.integers(1, 50, n).astype(np.int64),
+        "l_extendedprice": np.round(rng.uniform(100, 10000, n), 2),
+        "l_discount": np.round(rng.uniform(0, 0.1, n), 2),
+        "l_shipdate": [datetime.date(1995, 1, 1) + datetime.timedelta(days=int(d))
+                       for d in rng.integers(0, 365, n)],
+    })
+    orders = pd.DataFrame({
+        "o_orderkey": np.arange(500, dtype=np.int64),
+        "o_custkey": rng.integers(0, 100, 500).astype(np.int64),
+        "o_orderdate": [datetime.date(1995, 1, 1) + datetime.timedelta(days=int(d))
+                        for d in rng.integers(0, 365, 500)],
+    })
+    li_path = write_sample(tmp_path, "lineitem", lineitem)
+    od_path = write_sample(tmp_path, "orders", orders)
+    session = hst.Session(system_path=str(tmp_path / "indexes"))
+    session.conf.set(IndexConstants.INDEX_NUM_BUCKETS, 8)
+    return dict(session=session, hs=Hyperspace(session),
+                li_path=li_path, od_path=od_path,
+                lineitem=lineitem, orders=orders, tmp=tmp_path)
+
+
+def uses_index(df, name):
+    plan = df.optimized_plan()
+    return any(isinstance(l, IndexScan) and l.index_entry.name == name
+               for l in plan.collect_leaves())
+
+
+def check_disable_and_compare(session, df):
+    """The reference's core oracle (E2EHyperspaceRulesTest.verifyIndexUsage)."""
+    session.enable_hyperspace()
+    with_index = df.to_pandas()
+    session.disable_hyperspace()
+    without = df.to_pandas()
+    session.enable_hyperspace()
+    a = with_index.sort_values(list(with_index.columns)).reset_index(drop=True)
+    b = without.sort_values(list(without.columns)).reset_index(drop=True)
+    pd.testing.assert_frame_equal(a, b, check_dtype=False)
+    return with_index
+
+
+class TestFilterIndexE2E:
+    def test_filter_rewrite_and_results(self, env):
+        session, hs = env["session"], env["hs"]
+        df = session.read.parquet(env["li_path"])
+        hs.create_index(df, IndexConfig(
+            "filterIdx", ["l_shipdate"], ["l_orderkey", "l_quantity"]))
+        q = df.filter(col("l_shipdate") > datetime.date(1995, 7, 1)) \
+            .select("l_orderkey", "l_quantity")
+        session.enable_hyperspace()
+        assert uses_index(q, "filterIdx")
+        check_disable_and_compare(session, q)
+
+    def test_not_used_when_not_covering(self, env):
+        session, hs = env["session"], env["hs"]
+        df = session.read.parquet(env["li_path"])
+        hs.create_index(df, IndexConfig("smallIdx", ["l_shipdate"], ["l_orderkey"]))
+        session.enable_hyperspace()
+        # l_extendedprice is not covered → no rewrite.
+        q = df.filter(col("l_shipdate") > datetime.date(1995, 7, 1)) \
+            .select("l_orderkey", "l_extendedprice")
+        assert not uses_index(q, "smallIdx")
+
+    def test_not_used_when_filter_not_on_first_indexed(self, env):
+        session, hs = env["session"], env["hs"]
+        df = session.read.parquet(env["li_path"])
+        hs.create_index(df, IndexConfig(
+            "orderIdx", ["l_shipdate"], ["l_quantity"]))
+        session.enable_hyperspace()
+        q = df.filter(col("l_quantity") > 10).select("l_quantity")
+        assert not uses_index(q, "orderIdx")
+
+    def test_case_insensitive_columns(self, env):
+        session, hs = env["session"], env["hs"]
+        df = session.read.parquet(env["li_path"])
+        hs.create_index(df, IndexConfig(
+            "caseIdx", ["L_SHIPDATE"], ["L_ORDERKEY"]))
+        entry = hs.index_manager.get_index("caseIdx")
+        assert entry.indexed_columns == ["l_shipdate"]
+        session.enable_hyperspace()
+        q = df.filter(col("l_shipdate") > datetime.date(1995, 7, 1)) \
+            .select("l_orderkey")
+        assert uses_index(q, "caseIdx")
+
+    def test_signature_mismatch_after_source_change(self, env):
+        session, hs = env["session"], env["hs"]
+        df = session.read.parquet(env["li_path"])
+        hs.create_index(df, IndexConfig("sigIdx", ["l_shipdate"], ["l_orderkey"]))
+        # Append a new source file → signature changes → index not used.
+        extra = env["lineitem"].iloc[:5]
+        pq.write_table(pa.Table.from_pandas(extra.reset_index(drop=True)),
+                       env["tmp"] / "lineitem" / "extra.parquet")
+        session.enable_hyperspace()
+        fresh = session.read.parquet(env["li_path"])
+        q = fresh.filter(col("l_shipdate") > datetime.date(1995, 7, 1)) \
+            .select("l_orderkey")
+        assert not uses_index(q, "sigIdx")
+        # But results still correct (scan path).
+        check_disable_and_compare(session, q)
+
+
+class TestJoinIndexE2E:
+    def test_join_rewrite_and_results(self, env):
+        session, hs = env["session"], env["hs"]
+        li = session.read.parquet(env["li_path"])
+        od = session.read.parquet(env["od_path"])
+        hs.create_index(li, IndexConfig(
+            "liJoinIdx", ["l_orderkey"],
+            ["l_extendedprice", "l_discount", "l_shipdate"]))
+        hs.create_index(od, IndexConfig(
+            "odJoinIdx", ["o_orderkey"], ["o_custkey", "o_orderdate"]))
+        q = (li.filter(col("l_shipdate") > datetime.date(1995, 6, 1))
+             .join(od, on=col("l_orderkey") == col("o_orderkey"))
+             .group_by("o_custkey")
+             .agg(sum_(col("l_extendedprice") * (1 - col("l_discount")))
+                  .alias("revenue")))
+        session.enable_hyperspace()
+        assert uses_index(q, "liJoinIdx") and uses_index(q, "odJoinIdx")
+        check_disable_and_compare(session, q)
+
+    def test_join_no_compatible_pair(self, env):
+        session, hs = env["session"], env["hs"]
+        li = session.read.parquet(env["li_path"])
+        od = session.read.parquet(env["od_path"])
+        hs.create_index(li, IndexConfig("liOnly", ["l_orderkey"], ["l_quantity"]))
+        session.enable_hyperspace()
+        q = li.join(od, on=col("l_orderkey") == col("o_orderkey")) \
+            .select("l_quantity", "o_custkey")
+        assert not uses_index(q, "liOnly")
+
+
+class TestLifecycleE2E:
+    def test_delete_restore_vacuum(self, env):
+        session, hs = env["session"], env["hs"]
+        df = session.read.parquet(env["li_path"])
+        hs.create_index(df, IndexConfig("lcIdx", ["l_shipdate"], ["l_orderkey"]))
+        q = df.filter(col("l_shipdate") > datetime.date(1995, 7, 1)) \
+            .select("l_orderkey")
+        session.enable_hyperspace()
+        assert uses_index(q, "lcIdx")
+
+        hs.delete_index("lcIdx")
+        assert hs.index_manager.get_index("lcIdx").state == States.DELETED
+        assert not uses_index(q, "lcIdx")
+
+        hs.restore_index("lcIdx")
+        assert hs.index_manager.get_index("lcIdx").state == States.ACTIVE
+        assert uses_index(q, "lcIdx")
+
+        hs.delete_index("lcIdx")
+        hs.vacuum_index("lcIdx")
+        assert hs.index_manager.get_index("lcIdx").state == States.DOESNOTEXIST
+        # Data dirs physically removed.
+        from hyperspace_tpu.index.data_manager import IndexDataManager
+        dm = IndexDataManager(str(env["tmp"] / "indexes" / "lcIdx"))
+        assert dm.get_all_version_ids() == []
+
+    def test_vacuum_requires_deleted(self, env):
+        session, hs = env["session"], env["hs"]
+        df = session.read.parquet(env["li_path"])
+        hs.create_index(df, IndexConfig("vIdx", ["l_shipdate"], ["l_orderkey"]))
+        with pytest.raises(HyperspaceException):
+            hs.vacuum_index("vIdx")
+
+    def test_create_duplicate_name_fails(self, env):
+        session, hs = env["session"], env["hs"]
+        df = session.read.parquet(env["li_path"])
+        hs.create_index(df, IndexConfig("dupIdx", ["l_shipdate"], ["l_orderkey"]))
+        with pytest.raises(HyperspaceException):
+            hs.create_index(df, IndexConfig("dupIdx", ["l_shipdate"], ["l_orderkey"]))
+
+    def test_create_bad_column_fails(self, env):
+        session, hs = env["session"], env["hs"]
+        df = session.read.parquet(env["li_path"])
+        with pytest.raises(HyperspaceException):
+            hs.create_index(df, IndexConfig("badIdx", ["no_such_col"], []))
+
+    def test_indexes_listing(self, env):
+        session, hs = env["session"], env["hs"]
+        df = session.read.parquet(env["li_path"])
+        hs.create_index(df, IndexConfig("listIdx", ["l_shipdate"], ["l_orderkey"]))
+        listing = hs.indexes()
+        assert list(listing["name"]) == ["listIdx"]
+        assert listing["state"][0] == States.ACTIVE
+        assert listing["numBuckets"][0] == 8
+        stats = hs.index("listIdx")
+        assert stats["sourceFileCount"][0] == 2
+        assert stats["indexFileCount"][0] > 0
+
+    def test_explain_mentions_index(self, env):
+        session, hs = env["session"], env["hs"]
+        df = session.read.parquet(env["li_path"])
+        hs.create_index(df, IndexConfig("expIdx", ["l_shipdate"], ["l_orderkey"]))
+        q = df.filter(col("l_shipdate") > datetime.date(1995, 7, 1)) \
+            .select("l_orderkey")
+        text = hs.explain(q, verbose=True)
+        assert "expIdx" in text and "Indexes used" in text
+
+
+class TestIndexData:
+    def test_bucket_files_sorted_and_bucketed(self, env):
+        """Index parquet layout invariant: one file per non-empty bucket,
+        rows within a bucket sorted by the indexed column."""
+        session, hs = env["session"], env["hs"]
+        df = session.read.parquet(env["li_path"])
+        hs.create_index(df, IndexConfig("bIdx", ["l_orderkey"], ["l_quantity"]))
+        from hyperspace_tpu.ops.index_build import bucket_id_from_file
+        entry = hs.index_manager.get_index("bIdx")
+        files = sorted(entry.content.files)
+        assert 0 < len(files) <= 8
+        for f in files:
+            b = bucket_id_from_file(f)
+            assert b is not None and 0 <= b < 8
+            t = pq.read_table(f)
+            keys = t.column("l_orderkey").to_pylist()
+            assert keys == sorted(keys)
+        total = sum(pq.read_table(f).num_rows for f in files)
+        assert total == len(env["lineitem"])
+
+
+class TestFastPathCorrectness:
+    """Regressions for the shuffle-free join fast path + bucket pruning."""
+
+    def test_join_negative_keys(self, env, tmp_path):
+        session, hs = env["session"], env["hs"]
+        rng = np.random.default_rng(5)
+        t1 = pd.DataFrame({"k1": rng.integers(-50, 50, 400).astype(np.int64),
+                           "v1": np.arange(400, dtype=np.int64)})
+        t2 = pd.DataFrame({"k2": np.arange(-50, 50, dtype=np.int64),
+                           "v2": np.arange(100, dtype=np.int64)})
+        p1 = write_sample(tmp_path, "neg1", t1)
+        p2 = write_sample(tmp_path, "neg2", t2)
+        d1, d2 = session.read.parquet(p1), session.read.parquet(p2)
+        hs.create_index(d1, IndexConfig("negIdx1", ["k1"], ["v1"]))
+        hs.create_index(d2, IndexConfig("negIdx2", ["k2"], ["v2"]))
+        q = d1.join(d2, on=col("k1") == col("k2")).select("k1", "v1", "v2")
+        session.enable_hyperspace()
+        assert uses_index(q, "negIdx1") and uses_index(q, "negIdx2")
+        out = check_disable_and_compare(session, q)
+        exp = t1.merge(t2, left_on="k1", right_on="k2")
+        assert len(out) == len(exp)
+
+    def test_bucket_pruning_multi_column_index(self, env, tmp_path):
+        session, hs = env["session"], env["hs"]
+        session.conf.set(IndexConstants.INDEX_FILTER_RULE_USE_BUCKET_SPEC, "true")
+        rng = np.random.default_rng(6)
+        t = pd.DataFrame({"a": rng.integers(0, 10, 500).astype(np.int64),
+                          "b": rng.integers(0, 10, 500).astype(np.int64),
+                          "v": np.arange(500, dtype=np.int64)})
+        p = write_sample(tmp_path, "mc", t)
+        d = session.read.parquet(p)
+        hs.create_index(d, IndexConfig("mcIdx", ["a", "b"], ["v"]))
+        session.enable_hyperspace()
+        # Equality on only the first indexed column: bucket pruning must NOT
+        # drop rows (bucket is a hash of both columns).
+        q = d.filter(col("a") == 7).select("a", "b", "v")
+        assert uses_index(q, "mcIdx")
+        out = check_disable_and_compare(session, q)
+        assert len(out) == (t.a == 7).sum()
+        # Equality on both columns: pruning may engage, results still equal.
+        q2 = d.filter((col("a") == 7) & (col("b") == 3)).select("v")
+        out2 = check_disable_and_compare(session, q2)
+        assert len(out2) == ((t.a == 7) & (t.b == 3)).sum()
+        session.conf.set(IndexConstants.INDEX_FILTER_RULE_USE_BUCKET_SPEC, "false")
+
+    def test_bucket_pruning_equality_single(self, env):
+        session, hs = env["session"], env["hs"]
+        session.conf.set(IndexConstants.INDEX_FILTER_RULE_USE_BUCKET_SPEC, "true")
+        df = session.read.parquet(env["li_path"])
+        hs.create_index(df, IndexConfig("eqIdx", ["l_orderkey"], ["l_quantity"]))
+        session.enable_hyperspace()
+        q = df.filter(col("l_orderkey") == 42).select("l_orderkey", "l_quantity")
+        assert uses_index(q, "eqIdx")
+        out = check_disable_and_compare(session, q)
+        li = env["lineitem"]
+        assert len(out) == (li.l_orderkey == 42).sum()
+        session.conf.set(IndexConstants.INDEX_FILTER_RULE_USE_BUCKET_SPEC, "false")

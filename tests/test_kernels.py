@@ -1,0 +1,80 @@
+"""Kernel-level tests: hashing stability, sort, join expansion, membership."""
+
+import datetime
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from hyperspace_tpu.ops import kernels
+from hyperspace_tpu.schema import DATE, FLOAT64, INT32, INT64, STRING
+
+
+class TestHashing:
+    @pytest.mark.parametrize("dtype,values", [
+        (INT32, np.array([0, 1, -5, 2**31 - 1, -2**31], np.int32)),
+        (INT64, np.array([0, 1, -5, 2**62, -2**62, 123456789012345], np.int64)),
+        (DATE, np.array([0, 9131, -365], np.int32)),
+        (FLOAT64, np.array([0.0, 1.5, -3.25, 1e300], np.float64)),
+    ])
+    def test_host_matches_device(self, dtype, values):
+        device = np.asarray(jax.device_get(
+            kernels.hash32_values(jnp.asarray(values), dtype)))
+        host = [kernels.hash32_value_host(int(v) if dtype != FLOAT64 else float(v),
+                                          dtype) for v in values]
+        np.testing.assert_array_equal(device, np.asarray(host, np.uint32))
+
+    def test_host_matches_device_strings(self):
+        dictionary = np.array(sorted(["apple", "banana", "cherry"]))
+        codes = jnp.asarray(np.array([0, 1, 2, 1], np.int32))
+        device = np.asarray(jax.device_get(
+            kernels.hash32_values(codes, STRING, dictionary)))
+        host = [kernels.hash32_value_host(dictionary[c], STRING)
+                for c in [0, 1, 2, 1]]
+        np.testing.assert_array_equal(device, np.asarray(host, np.uint32))
+
+    def test_bucket_distribution_roughly_uniform(self):
+        keys = jnp.arange(100000, dtype=jnp.int64)
+        h = kernels.hash32_values(keys, INT64)
+        b = np.asarray(jax.device_get(kernels.bucket_ids(h, 32)))
+        counts = np.bincount(b, minlength=32)
+        assert counts.min() > 0.8 * counts.mean()
+        assert counts.max() < 1.2 * counts.mean()
+
+
+class TestSortJoin:
+    def test_lex_sort_multi_key_desc(self):
+        a = jnp.asarray(np.array([2, 1, 2, 1], np.int64))
+        b = jnp.asarray(np.array([1.0, 2.0, 3.0, 4.0]))
+        perm = np.asarray(jax.device_get(
+            kernels.lex_sort_indices([a, b], [True, False])))
+        assert list(perm) == [3, 1, 2, 0]
+
+    def test_merge_join_duplicates(self):
+        left = jnp.asarray(np.array([1, 2, 2, 5], np.int64))
+        right = jnp.asarray(np.array([2, 2, 3, 5, 5, 5], np.int64))
+        li, ri = kernels.merge_join_indices(left, right)
+        pairs = sorted(zip(np.asarray(li).tolist(), np.asarray(ri).tolist()))
+        # left row 1 (key 2) matches right rows 0,1; left row 2 likewise;
+        # left row 3 (key 5) matches right rows 3,4,5.
+        assert pairs == [(1, 0), (1, 1), (2, 0), (2, 1), (3, 3), (3, 4), (3, 5)]
+
+    def test_merge_join_empty(self):
+        li, ri = kernels.merge_join_indices(
+            jnp.zeros(0, jnp.int64), jnp.zeros(0, jnp.int64))
+        assert li.shape == (0,) and ri.shape == (0,)
+
+    def test_isin_sorted(self):
+        data = jnp.asarray(np.array([1, 4, 7, 9], np.int64))
+        vals = jnp.asarray(np.array([4, 9], np.int64))
+        mask = np.asarray(jax.device_get(kernels.isin_sorted(data, vals)))
+        assert list(mask) == [False, True, False, True]
+
+
+class TestGrouping:
+    def test_group_ids(self):
+        keys = jnp.asarray(np.array([1, 1, 2, 2, 2, 9], np.int64))
+        gids, n = kernels.group_ids_from_sorted([keys])
+        assert n == 3
+        assert list(np.asarray(gids)) == [0, 0, 1, 1, 1, 2]
