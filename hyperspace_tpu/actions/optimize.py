@@ -1,0 +1,122 @@
+"""OptimizeAction: compact small index files, one file per bucket.
+
+Parity reference: actions/OptimizeAction.scala:58-172. Partitions the index's
+files into small (< ``hyperspace.index.optimize.fileSizeThreshold``, quick
+mode) vs all (full mode) candidates, skips buckets that already hold a single
+candidate file, and rewrites each remaining bucket's candidate rows —
+re-sorted by the indexed columns on device — into one file at a new data
+version. Untouched files keep their place in the merged content.
+
+This is the action that restores the one-sorted-file-per-bucket layout
+invariant after incremental refreshes, re-enabling the executor's
+shuffle-free bucketed merge join fast path.
+"""
+
+from __future__ import annotations
+
+from collections import defaultdict
+from typing import Dict, List, Optional, Tuple
+
+from ..exceptions import HyperspaceException, NoChangesException
+from ..execution.columnar import read_parquet, write_parquet
+from ..index.constants import IndexConstants, States
+from ..index.log_entry import Content, FileIdTracker, FileInfo, IndexLogEntry
+from ..ops import index_build, kernels
+from ..telemetry.events import OptimizeActionEvent
+from .refresh import ExistingIndexActionBase
+
+import os
+
+
+class OptimizeAction(ExistingIndexActionBase):
+    transient_state = States.OPTIMIZING
+    final_state = States.ACTIVE
+
+    def __init__(self, session, log_manager, data_manager, mode: str):
+        super().__init__(session, log_manager, data_manager)
+        self.mode = mode
+        self._partition: Optional[Tuple[Dict[int, List[FileInfo]],
+                                        List[FileInfo]]] = None
+
+    # ------------------------------------------------------------------
+    # Candidate selection (parity: OptimizeAction.filesToOptimize).
+    # ------------------------------------------------------------------
+
+    def _files_to_optimize(self) -> Tuple[Dict[int, List[FileInfo]],
+                                          List[FileInfo]]:
+        """(bucket → files to compact, files left untouched)."""
+        if self._partition is not None:
+            return self._partition
+        threshold = self.session.hs_conf.optimize_file_size_threshold()
+        by_bucket: Dict[int, List[FileInfo]] = defaultdict(list)
+        skipped: List[FileInfo] = []
+        for info in sorted(self.previous_entry.content.file_infos,
+                           key=lambda f: f.name):
+            bucket = index_build.bucket_id_from_file(info.name)
+            small = self.mode == IndexConstants.OPTIMIZE_MODE_FULL \
+                or info.size < threshold
+            if bucket is None or not small:
+                skipped.append(info)
+            else:
+                by_bucket[bucket].append(info)
+        # Single-candidate buckets have nothing to merge.
+        compact = {}
+        for bucket, files in by_bucket.items():
+            if len(files) > 1:
+                compact[bucket] = files
+            else:
+                skipped.extend(files)
+        self._partition = (compact, skipped)
+        return self._partition
+
+    def validate(self) -> None:
+        latest = self.log_manager.get_latest_log()
+        if latest is None or latest.state != States.ACTIVE:
+            raise HyperspaceException(
+                f"Optimize is only supported in {States.ACTIVE} state; "
+                f"found {latest.state if latest else 'no log'}")
+        if self.previous_entry.derivedDataset.kind != "CoveringIndex":
+            raise HyperspaceException(
+                "Optimize is only supported on covering indexes.")
+        compact, _ = self._files_to_optimize()
+        if not compact:
+            raise NoChangesException(
+                "Optimize aborted as no optimizable index files smaller than "
+                f"{self.session.hs_conf.optimize_file_size_threshold()} found.")
+
+    # ------------------------------------------------------------------
+    # Work: per-bucket merge + rewrite.
+    # ------------------------------------------------------------------
+
+    def op(self) -> None:
+        prev = self.previous_entry
+        compact, skipped = self._files_to_optimize()
+        version = self._new_version()
+        out_dir = self.data_manager.get_path(version)
+        os.makedirs(out_dir, exist_ok=True)
+        row_group_size = self.session.hs_conf.index_row_group_size()
+        new_paths: List[str] = []
+        for bucket in sorted(compact):
+            files = [f.name for f in compact[bucket]]
+            table = read_parquet(files, list(prev.schema.names))
+            # Restore the within-bucket sort order over the indexed columns.
+            perm = kernels.lex_sort_indices(
+                [table.column(c).data for c in prev.indexed_columns])
+            out_path = os.path.join(
+                out_dir, index_build.bucket_file_name(bucket))
+            write_parquet(table.take(perm), out_path,
+                          row_group_size=row_group_size)
+            new_paths.append(out_path)
+
+        tracker = FileIdTracker()
+        tracker.add_file_info(prev.source_file_info_set)
+        final_paths = [f.name for f in skipped] + new_paths
+        index_content = Content.from_leaf_files(final_paths, tracker)
+        entry = IndexLogEntry.create(
+            prev.name, prev.derivedDataset, index_content, prev.source,
+            {k: v for k, v in prev.properties.items()})
+        self._entry = entry.with_log_version(version)
+
+    def event(self, message: str) -> OptimizeActionEvent:
+        return OptimizeActionEvent(message=message,
+                                   index_name=self.previous_entry.name)

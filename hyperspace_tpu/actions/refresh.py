@@ -1,0 +1,284 @@
+"""Refresh actions: full rebuild, incremental append/delete, quick metadata.
+
+Parity reference: actions/RefreshActionBase.scala:37-155 (reloaded source +
+file diffs), RefreshAction.scala:33-59 (full rebuild at a new data version),
+RefreshIncrementalAction.scala:47-147 (index only appended files; drop rows
+from deleted files via the lineage column), RefreshQuickAction.scala:32-80
+(metadata-only: record appended/deleted in the log entry, defer the work to
+Hybrid Scan at query time).
+
+TPU-native notes: the incremental append path reuses the device build
+pipeline (hash → bucket → sort) with the *previous entry's* bucket count so
+the appended index files stay bucket-aligned with the existing ones; deletes
+rebuild from masked index rows (a vectorized isin on the lineage column)
+rather than a row-by-row anti-join.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..exceptions import HyperspaceException, NoChangesException
+from ..execution.columnar import Table, read_parquet
+from ..index.constants import IndexConstants, States
+from ..index.log_entry import (Content, Directory, FileIdTracker, FileInfo,
+                               IndexLogEntry, Update)
+from ..ops import kernels
+from ..plan.nodes import Scan
+from ..telemetry.events import (RefreshActionEvent,
+                                RefreshIncrementalActionEvent,
+                                RefreshQuickActionEvent)
+from .create import CreateActionBase
+
+
+class ExistingIndexActionBase(CreateActionBase):
+    """Base for actions over an already-created index (refresh, optimize):
+    resolves the previous stable entry and follows ITS bucketing/lineage
+    settings, and allocates the next immutable data-version directory."""
+
+    def __init__(self, session, log_manager, data_manager):
+        super().__init__(session, log_manager, data_manager)
+        self._entry: Optional[IndexLogEntry] = None
+        self._previous: Optional[IndexLogEntry] = None
+
+    @property
+    def previous_entry(self) -> IndexLogEntry:
+        if self._previous is None:
+            entry = self.log_manager.get_latest_stable_log()
+            if entry is None:
+                raise HyperspaceException("Could not read latest stable log")
+            self._previous = entry
+        return self._previous
+
+    def _num_buckets(self) -> int:
+        return self.previous_entry.num_buckets
+
+    def _lineage_enabled(self) -> bool:
+        return self.previous_entry.has_lineage_column()
+
+    def _new_version(self) -> int:
+        latest = self.data_manager.get_latest_version_id()
+        return 0 if latest is None else latest + 1
+
+    @property
+    def log_entry(self) -> IndexLogEntry:
+        if self._entry is not None:
+            return self._entry
+        # begin() runs before op(): the previous entry is the placeholder.
+        return self.previous_entry
+
+
+def content_from_file_infos(infos: List[FileInfo]) -> Optional[Content]:
+    """A Content over already-known FileInfos (no stat calls — the files may
+    no longer exist, e.g. deleted source files recorded by quick refresh)."""
+    if not infos:
+        return None
+    return Content(Directory("/", files=sorted(infos, key=lambda f: f.name)))
+
+
+class RefreshActionBase(ExistingIndexActionBase):
+    """Shared refresh machinery: previous entry + reloaded relation + diffs."""
+
+    transient_state = States.REFRESHING
+    final_state = States.ACTIVE
+
+    def __init__(self, session, log_manager, data_manager):
+        super().__init__(session, log_manager, data_manager)
+        self._relation = None
+        self._diff: Optional[Tuple[List[FileInfo], List[FileInfo]]] = None
+
+    @property
+    def relation(self):
+        """The source relation re-listed now (parity: RefreshActionBase.df —
+        the reference reloads the DataFrame from the logged relation)."""
+        if self._relation is None:
+            rel = self.previous_entry.relation
+            self._relation = self.session.source_provider_manager.build_relation(
+                rel.rootPaths, rel.fileFormat, rel.options)
+        return self._relation
+
+    @property
+    def indexed_columns(self) -> List[str]:
+        return self.previous_entry.indexed_columns
+
+    @property
+    def included_columns(self) -> List[str]:
+        return self.previous_entry.included_columns
+
+    # ------------------------------------------------------------------
+    # File diffs (parity: RefreshActionBase.scala:125-149).
+    # ------------------------------------------------------------------
+
+    def _file_diff(self) -> Tuple[List[FileInfo], List[FileInfo]]:
+        """(appended, deleted) vs the files recorded in the previous entry."""
+        if self._diff is None:
+            current = {FileInfo(p, size, mtime)
+                       for p, size, mtime in self.relation.all_file_infos()}
+            logged = self.previous_entry.source_file_info_set
+            appended = sorted(current - logged, key=lambda f: f.name)
+            deleted = sorted(logged - current, key=lambda f: f.name)
+            self._diff = (appended, deleted)
+        return self._diff
+
+    @property
+    def appended_files(self) -> List[FileInfo]:
+        return self._file_diff()[0]
+
+    @property
+    def deleted_files(self) -> List[FileInfo]:
+        return self._file_diff()[1]
+
+    def _seeded_tracker(self) -> FileIdTracker:
+        """Tracker pre-loaded with the previous source file ids so unchanged
+        files keep their lineage ids and appended files get fresh ones."""
+        tracker = FileIdTracker()
+        tracker.add_file_info(self.previous_entry.source_file_info_set)
+        return tracker
+
+    def validate(self) -> None:
+        latest = self.log_manager.get_latest_log()
+        if latest is None or latest.state != States.ACTIVE:
+            raise HyperspaceException(
+                f"Refresh is only supported in {States.ACTIVE} state; "
+                f"found {latest.state if latest else 'no log'}")
+        if not self.appended_files and not self.deleted_files:
+            raise NoChangesException(
+                "Refresh aborted as no source data change found.")
+
+    def _rebuilt_entry(self, tracker: FileIdTracker, index_content: Content,
+                       version: int) -> IndexLogEntry:
+        """A fresh entry over the *current* relation state."""
+        prev = self.previous_entry
+        index_schema = prev.schema
+        entry = self._build_entry(
+            prev.name, self.relation, Scan(self.relation),
+            list(prev.indexed_columns), list(prev.included_columns),
+            index_schema, tracker, index_content)
+        return entry.with_log_version(version)
+
+
+class RefreshAction(RefreshActionBase):
+    """Full refresh: rebuild the entire index from the current source at a
+    new data version (parity: RefreshAction.scala:33-59)."""
+
+    def op(self) -> None:
+        tracker = FileIdTracker()
+        table = self._load_projected(
+            self.relation, self.indexed_columns, self.included_columns, tracker)
+        version = self._new_version()
+        out_dir = self._write_index_files(table, self.indexed_columns, version)
+        index_content = Content.from_directory(out_dir, tracker)
+        self._entry = self._rebuilt_entry(tracker, index_content, version)
+
+    def event(self, message: str) -> RefreshActionEvent:
+        return RefreshActionEvent(message=message,
+                                  index_name=self.previous_entry.name)
+
+
+class RefreshIncrementalAction(RefreshActionBase):
+    """Incremental refresh (parity: RefreshIncrementalAction.scala:47-147):
+
+    - appends only: build bucket-aligned index files over just the appended
+      source files at a new version; final content = old ∪ new files. Buckets
+      may then hold several files each (compacted later by optimize).
+    - with deletes: read the old index rows, mask out rows whose lineage id
+      is in the deleted set, merge with the appended rows, and rebuild — the
+      new version holds the whole index again (one sorted file per bucket).
+    """
+
+    def validate(self) -> None:
+        super().validate()
+        if self.deleted_files and not self.previous_entry.has_lineage_column():
+            raise HyperspaceException(
+                "Index refresh (to handle deleted source data) is only "
+                "supported on an index with lineage.")
+
+    def _deleted_ids(self) -> List[int]:
+        by_key = {(f.name, f.size, f.modifiedTime): f.id
+                  for f in self.previous_entry.source_file_info_set}
+        return [by_key[(f.name, f.size, f.modifiedTime)]
+                for f in self.deleted_files]
+
+    def op(self) -> None:
+        prev = self.previous_entry
+        tracker = self._seeded_tracker()
+        appended_paths = [f.name for f in self.appended_files]
+        version = self._new_version()
+
+        if self.deleted_files:
+            # Masked old rows ∪ appended rows → full rebuild at new version.
+            old = read_parquet(sorted(prev.content.files),
+                               list(prev.schema.names))
+            lineage = old.column(IndexConstants.DATA_FILE_NAME_ID)
+            deleted = jnp.asarray(
+                np.sort(np.asarray(self._deleted_ids(), dtype=np.int64)))
+            old = old.filter(
+                ~kernels.isin_sorted(lineage.data.astype(jnp.int64), deleted))
+            parts = [old]
+            if appended_paths:
+                appended = self._load_projected(
+                    self.relation, self.indexed_columns, self.included_columns,
+                    tracker, files=appended_paths)
+                parts.append(appended.select(old.names))
+            table = Table.concat(parts) if len(parts) > 1 else parts[0]
+            out_dir = self._write_index_files(
+                table, self.indexed_columns, version)
+            index_content = Content.from_directory(out_dir, tracker)
+        else:
+            appended = self._load_projected(
+                self.relation, self.indexed_columns, self.included_columns,
+                tracker, files=appended_paths)
+            out_dir = self._write_index_files(
+                appended, self.indexed_columns, version)
+            index_content = prev.content.merge(
+                Content.from_directory(out_dir, tracker))
+
+        self._entry = self._rebuilt_entry(tracker, index_content, version)
+
+    def event(self, message: str) -> RefreshIncrementalActionEvent:
+        return RefreshIncrementalActionEvent(
+            message=message, index_name=self.previous_entry.name)
+
+
+class RefreshQuickAction(RefreshActionBase):
+    """Quick refresh: metadata-only. Records the appended/deleted file sets in
+    the log entry's source Update and leaves the index data untouched; Hybrid
+    Scan applies the delta at query time (parity: RefreshQuickAction.scala:
+    32-80)."""
+
+    def validate(self) -> None:
+        super().validate()
+        # Deletes recorded without lineage would make the index permanently
+        # inapplicable (hybrid scan rejects deletes on lineage-less indexes);
+        # fail loudly like the incremental path (RefreshQuickAction.scala:54).
+        if self.deleted_files and not self.previous_entry.has_lineage_column():
+            raise HyperspaceException(
+                "Index refresh (to handle deleted source data) is only "
+                "supported on an index with lineage.")
+
+    def op(self) -> None:
+        pass  # metadata-only by design.
+
+    @property
+    def log_entry(self) -> IndexLogEntry:
+        if self._entry is None:
+            prev = self.previous_entry
+            tracker = self._seeded_tracker()
+            appended_infos = [
+                FileInfo(f.name, f.size, f.modifiedTime,
+                         tracker.add_file(f.name, f.size, f.modifiedTime))
+                for f in self.appended_files]
+            # Deleted files keep their recorded ids; they can't be stat'ed.
+            update = Update(
+                appendedFiles=content_from_file_infos(appended_infos),
+                deletedFiles=content_from_file_infos(list(self.deleted_files)))
+            prev.relation.data.update = update
+            self._entry = prev.with_log_version(prev.log_version)
+        return self._entry
+
+    def event(self, message: str) -> RefreshQuickActionEvent:
+        return RefreshQuickActionEvent(
+            message=message, index_name=self.previous_entry.name)

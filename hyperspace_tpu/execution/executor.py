@@ -174,10 +174,17 @@ def _execute_index_scan(plan: IndexScan, needed: Optional[Set[str]],
     from ..ops.index_build import bucket_id_from_file
 
     entry = plan.index_entry
-    index_files = sorted(entry.content.files)
+    # Read index files grouped by bucket id: after an incremental refresh a
+    # bucket's rows can span several version dirs, and bucket-grouped order
+    # is what downstream bucket-aware operators expect.
+    keyed = sorted(((bucket_id_from_file(f), f)
+                    for f in entry.content.files),
+                   key=lambda t: (t[0] is None, t[0] or 0, t[1]))
+    index_files = [f for _, f in keyed]
+    buckets_have_single_file = len({b for b, _ in keyed}) == len(keyed) \
+        and all(b is not None for b, _ in keyed)
     if bucket_subset is not None:
-        index_files = [f for f in index_files
-                       if bucket_id_from_file(f) in bucket_subset]
+        index_files = [f for b, f in keyed if b in bucket_subset]
         if not index_files and not plan.appended_files:
             from .columnar import empty_table
             out_schema = plan.schema if needed is None else \
@@ -198,6 +205,7 @@ def _execute_index_scan(plan: IndexScan, needed: Optional[Set[str]],
     else:
         table = read_parquet(index_files, cols, filters=pa_filter)
     if entry.derivedDataset.kind == "CoveringIndex" and not plan.appended_files \
+            and buckets_have_single_file \
             and all(c in table.names for c in entry.indexed_columns):
         # Physical layout invariant: files are read in bucket order and rows
         # are sorted by the indexed columns within each bucket. Downstream

@@ -1,0 +1,279 @@
+"""Refresh (full/incremental/quick) + optimize lifecycle tests.
+
+Parity: RefreshIndexTest.scala, OptimizeActionTest semantics, and the Hybrid
+Scan interplay with quick refresh. Core oracle throughout is
+disable-and-compare (results with the refreshed index == source-scan results).
+"""
+
+import datetime
+import os
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+import hyperspace_tpu as hst
+from hyperspace_tpu.api import Hyperspace, IndexConfig
+from hyperspace_tpu.exceptions import HyperspaceException
+from hyperspace_tpu.index.constants import IndexConstants, States
+from hyperspace_tpu.ops.index_build import bucket_id_from_file
+from hyperspace_tpu.plan.expr import col
+from hyperspace_tpu.plan.nodes import IndexScan
+
+
+def write_sample(root, name, df, parts=2):
+    d = root / name
+    d.mkdir(parents=True, exist_ok=True)
+    step = max(1, len(df) // parts)
+    for i in range(parts):
+        chunk = df.iloc[i * step:(i + 1) * step if i < parts - 1 else len(df)]
+        pq.write_table(pa.Table.from_pandas(chunk.reset_index(drop=True)),
+                       d / f"part{i}.parquet")
+    return str(d)
+
+
+def make_df(n=800, seed=0):
+    rng = np.random.default_rng(seed)
+    return pd.DataFrame({
+        "k": rng.integers(0, 200, n).astype(np.int64),
+        "v": rng.integers(0, 1000, n).astype(np.int64),
+        "d": [datetime.date(1995, 1, 1) + datetime.timedelta(days=int(x))
+              for x in rng.integers(0, 365, n)],
+    })
+
+
+@pytest.fixture()
+def env(tmp_path):
+    base = make_df()
+    path = write_sample(tmp_path, "data", base)
+    session = hst.Session(system_path=str(tmp_path / "indexes"))
+    session.conf.set(IndexConstants.INDEX_NUM_BUCKETS, 4)
+    return dict(session=session, hs=Hyperspace(session), path=path,
+                base=base, tmp=tmp_path)
+
+
+def uses_index(df, name):
+    return any(isinstance(l, IndexScan) and l.index_entry.name == name
+               for l in df.optimized_plan().collect_leaves())
+
+
+def check_disable_and_compare(session, df):
+    session.enable_hyperspace()
+    with_index = df.to_pandas()
+    session.disable_hyperspace()
+    without = df.to_pandas()
+    session.enable_hyperspace()
+    a = with_index.sort_values(list(with_index.columns)).reset_index(drop=True)
+    b = without.sort_values(list(without.columns)).reset_index(drop=True)
+    pd.testing.assert_frame_equal(a, b, check_dtype=False)
+    return with_index
+
+
+def append_file(env, df, name="extra.parquet"):
+    pq.write_table(pa.Table.from_pandas(df.reset_index(drop=True)),
+                   env["tmp"] / "data" / name)
+
+
+class TestRefreshFull:
+    def test_full_refresh_after_append(self, env):
+        session, hs = env["session"], env["hs"]
+        df = session.read.parquet(env["path"])
+        hs.create_index(df, IndexConfig("fIdx", ["k"], ["v"]))
+        extra = make_df(100, seed=9)
+        append_file(env, extra)
+
+        fresh = session.read.parquet(env["path"])
+        q = fresh.filter(col("k") == 11).select("k", "v")
+        session.enable_hyperspace()
+        assert not uses_index(q, "fIdx")  # stale signature.
+
+        hs.refresh_index("fIdx", "full")
+        entry = hs.index_manager.get_index("fIdx")
+        assert entry.state == States.ACTIVE
+        assert entry.log_version == 1  # new data version dir.
+        assert uses_index(q, "fIdx")
+        out = check_disable_and_compare(session, q)
+        all_rows = pd.concat([env["base"], extra])
+        assert len(out) == (all_rows.k == 11).sum()
+
+    def test_refresh_no_changes_is_noop(self, env):
+        session, hs = env["session"], env["hs"]
+        df = session.read.parquet(env["path"])
+        hs.create_index(df, IndexConfig("nIdx", ["k"], ["v"]))
+        before = hs.index_manager.get_index("nIdx")
+        hs.refresh_index("nIdx", "full")  # NoChangesException → quiet no-op.
+        after = hs.index_manager.get_index("nIdx")
+        assert after.id == before.id and after.state == States.ACTIVE
+
+    def test_refresh_nonexistent_index_fails(self, env):
+        hs = env["hs"]
+        with pytest.raises(HyperspaceException):
+            hs.refresh_index("ghost", "full")
+
+    def test_refresh_bad_mode_fails(self, env):
+        session, hs = env["session"], env["hs"]
+        df = session.read.parquet(env["path"])
+        hs.create_index(df, IndexConfig("mIdx", ["k"], ["v"]))
+        with pytest.raises(HyperspaceException):
+            hs.refresh_index("mIdx", "sideways")
+
+
+class TestRefreshIncremental:
+    def test_incremental_append_only(self, env):
+        session, hs = env["session"], env["hs"]
+        df = session.read.parquet(env["path"])
+        hs.create_index(df, IndexConfig("iIdx", ["k"], ["v"]))
+        extra = make_df(150, seed=10)
+        append_file(env, extra)
+        hs.refresh_index("iIdx", "incremental")
+
+        entry = hs.index_manager.get_index("iIdx")
+        assert entry.state == States.ACTIVE
+        # Old + new index files coexist; buckets may hold several files.
+        versions = {f.split("v__=")[1].split(os.sep)[0]
+                    for f in entry.content.files}
+        assert versions == {"0", "1"}
+
+        fresh = session.read.parquet(env["path"])
+        q = fresh.filter(col("k") == 11).select("k", "v")
+        session.enable_hyperspace()
+        assert uses_index(q, "iIdx")
+        out = check_disable_and_compare(session, q)
+        all_rows = pd.concat([env["base"], extra])
+        assert len(out) == (all_rows.k == 11).sum()
+
+    def test_incremental_with_deletes_requires_lineage(self, env):
+        session, hs = env["session"], env["hs"]
+        df = session.read.parquet(env["path"])
+        hs.create_index(df, IndexConfig("delIdx", ["k"], ["v"]))
+        os.remove(os.path.join(env["path"], "part0.parquet"))
+        with pytest.raises(HyperspaceException, match="lineage"):
+            hs.refresh_index("delIdx", "incremental")
+
+    def test_incremental_with_deletes(self, env):
+        session, hs = env["session"], env["hs"]
+        session.conf.set(IndexConstants.INDEX_LINEAGE_ENABLED, "true")
+        df = session.read.parquet(env["path"])
+        hs.create_index(df, IndexConfig("linIdx", ["k"], ["v"]))
+        # Delete one source file, append another.
+        os.remove(os.path.join(env["path"], "part0.parquet"))
+        extra = make_df(120, seed=11)
+        append_file(env, extra)
+        hs.refresh_index("linIdx", "incremental")
+
+        entry = hs.index_manager.get_index("linIdx")
+        assert entry.state == States.ACTIVE
+
+        fresh = session.read.parquet(env["path"])
+        q = fresh.filter(col("k") < 40).select("k", "v")
+        session.enable_hyperspace()
+        assert uses_index(q, "linIdx")
+        out = check_disable_and_compare(session, q)
+        # part0 held the first half of base.
+        kept = env["base"].iloc[len(env["base"]) // 2:]
+        all_rows = pd.concat([kept, extra])
+        assert len(out) == (all_rows.k < 40).sum()
+
+
+class TestRefreshQuick:
+    def test_quick_refresh_deletes_require_lineage(self, env):
+        session, hs = env["session"], env["hs"]
+        df = session.read.parquet(env["path"])
+        hs.create_index(df, IndexConfig("qnIdx", ["k"], ["v"]))
+        os.remove(os.path.join(env["path"], "part0.parquet"))
+        with pytest.raises(HyperspaceException, match="lineage"):
+            hs.refresh_index("qnIdx", "quick")
+
+    def test_quick_refresh_records_update_and_hybrid_scan_answers(self, env):
+        session, hs = env["session"], env["hs"]
+        session.conf.set(IndexConstants.INDEX_LINEAGE_ENABLED, "true")
+        df = session.read.parquet(env["path"])
+        hs.create_index(df, IndexConfig("qIdx", ["k"], ["v"]))
+        os.remove(os.path.join(env["path"], "part1.parquet"))
+        extra = make_df(60, seed=12)
+        append_file(env, extra)
+        hs.refresh_index("qIdx", "quick")
+
+        entry = hs.index_manager.get_index("qIdx")
+        assert entry.state == States.ACTIVE
+        assert len(entry.appended_files) == 1
+        assert len(entry.deleted_files) == 1
+        # Index data untouched: only v__=0 files.
+        assert all("v__=0" in f for f in entry.content.files)
+
+        session.conf.set(IndexConstants.INDEX_HYBRID_SCAN_ENABLED, "true")
+        # Generous thresholds: the deltas here are large fractions.
+        session.conf.set(
+            IndexConstants.INDEX_HYBRID_SCAN_APPENDED_RATIO_THRESHOLD, "0.99")
+        session.conf.set(
+            IndexConstants.INDEX_HYBRID_SCAN_DELETED_RATIO_THRESHOLD, "0.99")
+        fresh = session.read.parquet(env["path"])
+        q = fresh.filter(col("k") < 40).select("k", "v")
+        session.enable_hyperspace()
+        assert uses_index(q, "qIdx")
+        out = check_disable_and_compare(session, q)
+        kept = env["base"].iloc[:len(env["base"]) // 2]
+        all_rows = pd.concat([kept, extra])
+        assert len(out) == (all_rows.k < 40).sum()
+
+
+class TestOptimize:
+    def test_optimize_compacts_to_one_file_per_bucket(self, env):
+        session, hs = env["session"], env["hs"]
+        df = session.read.parquet(env["path"])
+        hs.create_index(df, IndexConfig("oIdx", ["k"], ["v"]))
+        # Two incremental refreshes → up to 3 files per bucket.
+        for seed in (20, 21):
+            append_file(env, make_df(100, seed=seed), f"x{seed}.parquet")
+            hs.refresh_index("oIdx", "incremental")
+        entry = hs.index_manager.get_index("oIdx")
+        buckets = [bucket_id_from_file(f) for f in entry.content.files]
+        assert len(buckets) > len(set(buckets))  # multi-file buckets exist.
+
+        hs.optimize_index("oIdx", "quick")
+        entry = hs.index_manager.get_index("oIdx")
+        buckets = [bucket_id_from_file(f) for f in entry.content.files]
+        assert len(buckets) == len(set(buckets))  # compacted.
+
+        # Rows within each compacted file are sorted by the indexed column.
+        for f in entry.content.files:
+            keys = pq.read_table(f).column("k").to_pylist()
+            assert keys == sorted(keys)
+
+        fresh = session.read.parquet(env["path"])
+        q = fresh.filter(col("k") == 11).select("k", "v")
+        session.enable_hyperspace()
+        assert uses_index(q, "oIdx")
+        check_disable_and_compare(session, q)
+
+    def test_optimize_noop_when_single_files(self, env):
+        session, hs = env["session"], env["hs"]
+        df = session.read.parquet(env["path"])
+        hs.create_index(df, IndexConfig("o1Idx", ["k"], ["v"]))
+        before = hs.index_manager.get_index("o1Idx")
+        hs.optimize_index("o1Idx", "quick")  # nothing to compact → no-op.
+        after = hs.index_manager.get_index("o1Idx")
+        assert after.id == before.id
+
+    def test_optimize_quick_skips_large_files(self, env):
+        session, hs = env["session"], env["hs"]
+        session.conf.set(IndexConstants.OPTIMIZE_FILE_SIZE_THRESHOLD, 1)
+        df = session.read.parquet(env["path"])
+        hs.create_index(df, IndexConfig("bigIdx", ["k"], ["v"]))
+        append_file(env, make_df(100, seed=22))
+        hs.refresh_index("bigIdx", "incremental")
+        before = hs.index_manager.get_index("bigIdx")
+        hs.optimize_index("bigIdx", "quick")  # all files above 1 byte → no-op.
+        after = hs.index_manager.get_index("bigIdx")
+        assert after.id == before.id
+        # full mode compacts regardless of size.
+        hs.optimize_index("bigIdx", "full")
+        entry = hs.index_manager.get_index("bigIdx")
+        buckets = [bucket_id_from_file(f) for f in entry.content.files]
+        assert len(buckets) == len(set(buckets))
+        fresh = session.read.parquet(env["path"])
+        q = fresh.filter(col("k") == 3).select("k", "v")
+        session.enable_hyperspace()
+        check_disable_and_compare(session, q)
