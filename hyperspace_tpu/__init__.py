@@ -21,7 +21,8 @@ __version__ = "0.1.0"
 def __getattr__(name):
     # Lazy imports to keep `import hyperspace_tpu` light and cycle-free.
     try:
-        if name in ("Hyperspace", "IndexConfig"):
+        if name in ("Hyperspace", "IndexConfig", "DataSkippingIndexConfig",
+                    "MinMaxSketch", "BloomFilterSketch"):
             from . import api
             return getattr(api, name)
         if name == "Session":

@@ -109,10 +109,8 @@ class CreateActionBase(Action):
             props[IndexConstants.HAS_PARQUET_AS_SOURCE_FORMAT_PROPERTY] = "true"
         return props
 
-    def _build_entry(self, name: str, relation, plan, indexed: List[str],
-                     included: List[str], index_schema: Schema,
-                     file_id_tracker: FileIdTracker,
-                     index_content: Content) -> IndexLogEntry:
+    def _build_source(self, relation, plan,
+                      file_id_tracker: FileIdTracker) -> Source:
         source_content = Content.from_leaf_files(
             relation.all_files(), file_id_tracker)
         rel_meta = Relation(
@@ -125,7 +123,13 @@ class CreateActionBase(Action):
         sig_value = provider.signature(plan)
         fingerprint = LogicalPlanFingerprint(
             [Signature(provider.name(), sig_value)])
-        source = Source(SourcePlan([rel_meta], fingerprint))
+        return Source(SourcePlan([rel_meta], fingerprint))
+
+    def _build_entry(self, name: str, relation, plan, indexed: List[str],
+                     included: List[str], index_schema: Schema,
+                     file_id_tracker: FileIdTracker,
+                     index_content: Content) -> IndexLogEntry:
+        source = self._build_source(relation, plan, file_id_tracker)
         derived = CoveringIndex(
             indexed_columns=indexed, included_columns=included,
             schema=index_schema, num_buckets=self._num_buckets(),

@@ -1,0 +1,275 @@
+"""Data-skipping index actions: create + refresh of per-file sketch tables.
+
+No direct reference parity: the mounted snapshot has no DataSkippingIndex
+(SURVEY.md version note); this implements the BASELINE.json target capability
+in the same action/log framework as the covering index. The sketch table is
+one row per source data file:
+
+    _file (string, full path) | _file_id (int64)
+    | minmax__<col>__min / minmax__<col>__max   (source column type)
+    | bloom__<col>                              (binary packed bitset)
+
+stored as a single parquet file per index data version. Sketch values are
+computed as device reductions (ops/sketches.py); the table itself is tiny
+(one row per file) and lives host-side at plan time.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional
+
+import pyarrow as pa
+import pyarrow.parquet as pq
+
+from ..exceptions import HyperspaceException, NoChangesException
+from ..execution.columnar import read_parquet
+from ..index.constants import IndexConstants, States
+from ..index.log_entry import (Content, DataSkippingIndex, FileIdTracker,
+                               FileInfo, IndexLogEntry, Sketch)
+from ..ops import sketches as sk
+from ..plan.nodes import Scan
+from ..schema import INT64, STRING, Field, Schema
+from ..telemetry.events import (CreateActionEvent, RefreshActionEvent,
+                                RefreshIncrementalActionEvent)
+from ..util.resolver import resolve_all
+from .create import CreateActionBase
+from .refresh import ExistingIndexActionBase, RefreshActionBase
+
+SKETCH_FILE_NAME = "sketches.parquet"
+FILE_COL = "_file"
+FILE_ID_COL = "_file_id"
+
+
+def minmax_cols(column: str) -> tuple:
+    return f"minmax__{column}__min", f"minmax__{column}__max"
+
+
+def bloom_col(column: str) -> str:
+    return f"bloom__{column}"
+
+
+def build_sketch_rows(relation, sketch_list: List[Sketch],
+                      files: List[str], tracker: FileIdTracker) -> Dict[str, list]:
+    """One sketch row per file; device reductions per (file, sketch)."""
+    needed = sorted({s.column for s in sketch_list})
+    rows: Dict[str, list] = {FILE_COL: [], FILE_ID_COL: []}
+    for s in sketch_list:
+        if s.kind == "MinMax":
+            lo, hi = minmax_cols(s.column)
+            rows[lo] = []
+            rows[hi] = []
+        elif s.kind == "BloomFilter":
+            rows[bloom_col(s.column)] = []
+        else:
+            raise HyperspaceException(f"Unknown sketch kind: {s.kind}")
+    from ..util.file_utils import file_info_triple
+    for path in files:
+        table = read_parquet([path], needed, relation.file_format)
+        rows[FILE_COL].append(path)
+        rows[FILE_ID_COL].append(tracker.add_file(*file_info_triple(path)))
+        for s in sketch_list:
+            col = table.column(s.column)
+            if s.kind == "MinMax":
+                lo, hi = minmax_cols(s.column)
+                mn, mx = sk.minmax_values(col)
+                rows[lo].append(mn)
+                rows[hi].append(mx)
+            else:
+                num_bits = int(s.properties["numBits"])
+                num_hashes = int(s.properties["numHashes"])
+                rows[bloom_col(s.column)].append(
+                    sk.bloom_build(col, num_bits, num_hashes).tobytes())
+    return rows
+
+
+def sketch_arrow_schema(relation_schema: Schema,
+                        sketch_list: List[Sketch]) -> pa.Schema:
+    fields = [pa.field(FILE_COL, pa.string()),
+              pa.field(FILE_ID_COL, pa.int64())]
+    for s in sketch_list:
+        if s.kind == "MinMax":
+            src = relation_schema.field(s.column)
+            arrow_t = Schema([src]).to_arrow().field(0).type
+            lo, hi = minmax_cols(s.column)
+            fields.append(pa.field(lo, arrow_t))
+            fields.append(pa.field(hi, arrow_t))
+        else:
+            fields.append(pa.field(bloom_col(s.column), pa.binary()))
+    return pa.schema(fields)
+
+
+def write_sketch_table(rows: Dict[str, list], arrow_schema: pa.Schema,
+                       out_dir: str) -> str:
+    os.makedirs(out_dir, exist_ok=True)
+    table = pa.table({f.name: pa.array(rows[f.name], type=f.type)
+                      for f in arrow_schema}, schema=arrow_schema)
+    path = os.path.join(out_dir, SKETCH_FILE_NAME)
+    pq.write_table(table, path)
+    return path
+
+
+def logical_sketch_schema(relation_schema: Schema,
+                          sketch_list: List[Sketch]) -> Schema:
+    """The part of the sketch table describable in the logical type system
+    (bloom binary columns are carried by sketch properties instead)."""
+    fields = [Field(FILE_COL, STRING, False), Field(FILE_ID_COL, INT64, False)]
+    for s in sketch_list:
+        if s.kind == "MinMax":
+            src = relation_schema.field(s.column)
+            lo, hi = minmax_cols(s.column)
+            fields.append(Field(lo, src.dtype, True))
+            fields.append(Field(hi, src.dtype, True))
+    return Schema(fields)
+
+
+class CreateDataSkippingAction(CreateActionBase):
+    transient_state = States.CREATING
+    final_state = States.ACTIVE
+
+    def __init__(self, session, df, index_config, log_manager, data_manager):
+        super().__init__(session, log_manager, data_manager)
+        self.df = df
+        self.index_config = index_config
+        self._entry: Optional[IndexLogEntry] = None
+        self._sketches: Optional[List[Sketch]] = None
+
+    def _resolved_sketches(self) -> List[Sketch]:
+        if self._sketches is None:
+            names = self.df.plan.schema.names
+            out = []
+            for spec in self.index_config.sketches:
+                column = resolve_all(names, [spec.column])[0]
+                out.append(Sketch(spec.kind, column, spec.properties()))
+            self._sketches = out
+        return self._sketches
+
+    def validate(self) -> None:
+        plan = self.df.plan
+        if not isinstance(plan, Scan):
+            raise HyperspaceException(
+                "Only creating an index over a plain scan of a file-based "
+                "relation is supported")
+        if not self.session.source_provider_manager.is_supported_relation(plan):
+            raise HyperspaceException(
+                f"Relation is not supported: {plan.relation.describe()}")
+        self._resolved_sketches()
+        latest = self.log_manager.get_latest_log()
+        if latest is not None and latest.state != States.DOESNOTEXIST:
+            raise HyperspaceException(
+                f"Another index with name {self.index_config.index_name} "
+                "already exists")
+
+    def op(self) -> None:
+        relation = self.df.plan.relation
+        sketch_list = self._resolved_sketches()
+        tracker = FileIdTracker()
+        rows = build_sketch_rows(relation, sketch_list,
+                                 relation.all_files(), tracker)
+        out_dir = self.data_manager.get_path(0)
+        write_sketch_table(
+            rows, sketch_arrow_schema(relation.schema, sketch_list), out_dir)
+        index_content = Content.from_directory(out_dir, tracker)
+        derived = DataSkippingIndex(
+            sketches=sketch_list,
+            schema=logical_sketch_schema(relation.schema, sketch_list))
+        source = self._build_source(relation, self.df.plan, tracker)
+        entry = IndexLogEntry.create(
+            self.index_config.index_name, derived, index_content, source, {})
+        self._entry = entry.with_log_version(0)
+
+    @property
+    def log_entry(self) -> IndexLogEntry:
+        if self._entry is not None:
+            return self._entry
+        relation = self.df.plan.relation
+        sketch_list = self._resolved_sketches()
+        tracker = FileIdTracker()
+        derived = DataSkippingIndex(
+            sketches=sketch_list,
+            schema=logical_sketch_schema(relation.schema, sketch_list))
+        from ..index.log_entry import Directory
+        placeholder = Content(root=Directory("/"))
+        source = self._build_source(relation, self.df.plan, tracker)
+        entry = IndexLogEntry.create(
+            self.index_config.index_name, derived, placeholder, source, {})
+        return entry.with_log_version(0)
+
+    def event(self, message: str) -> CreateActionEvent:
+        return CreateActionEvent(
+            message=message, index_name=self.index_config.index_name,
+            index_config=self.index_config)
+
+
+class RefreshDataSkippingAction(RefreshActionBase):
+    """Full refresh of a data-skipping index: rebuild the whole sketch table
+    over the current file listing at a new data version."""
+
+    def op(self) -> None:
+        prev = self.previous_entry
+        tracker = FileIdTracker()
+        sketch_list = prev.derivedDataset.sketches
+        rows = build_sketch_rows(self.relation, sketch_list,
+                                 self.relation.all_files(), tracker)
+        version = self._new_version()
+        out_dir = self.data_manager.get_path(version)
+        write_sketch_table(
+            rows, sketch_arrow_schema(self.relation.schema, sketch_list),
+            out_dir)
+        index_content = Content.from_directory(out_dir, tracker)
+        source = self._build_source(self.relation, Scan(self.relation), tracker)
+        entry = IndexLogEntry.create(
+            prev.name, prev.derivedDataset, index_content, source, {})
+        self._entry = entry.with_log_version(version)
+
+    def event(self, message: str) -> RefreshActionEvent:
+        return RefreshActionEvent(message=message,
+                                  index_name=self.previous_entry.name)
+
+
+class RefreshDataSkippingIncrementalAction(RefreshDataSkippingAction):
+    """Incremental refresh: keep sketch rows of unchanged files, drop rows of
+    deleted files, sketch only the appended files. (Sketch rows are keyed by
+    file, so deletes never require lineage here.)"""
+
+    def op(self) -> None:
+        prev = self.previous_entry
+        tracker = self._seeded_tracker()
+        sketch_list = prev.derivedDataset.sketches
+        deleted_names = {f.name for f in self.deleted_files}
+        old = pq.read_table(_sketch_file(prev))
+        keep_mask = [name not in deleted_names
+                     for name in old.column(FILE_COL).to_pylist()]
+        kept = old.filter(pa.array(keep_mask))
+        arrow_schema = sketch_arrow_schema(self.relation.schema, sketch_list)
+        new_rows = build_sketch_rows(
+            self.relation, sketch_list,
+            [f.name for f in self.appended_files], tracker)
+        appended_tbl = pa.table(
+            {f.name: pa.array(new_rows[f.name], type=f.type)
+             for f in arrow_schema}, schema=arrow_schema)
+        merged = pa.concat_tables([kept.cast(arrow_schema), appended_tbl])
+
+        version = self._new_version()
+        out_dir = self.data_manager.get_path(version)
+        os.makedirs(out_dir, exist_ok=True)
+        pq.write_table(merged, os.path.join(out_dir, SKETCH_FILE_NAME))
+        index_content = Content.from_directory(out_dir, tracker)
+        source = self._build_source(self.relation, Scan(self.relation), tracker)
+        entry = IndexLogEntry.create(
+            prev.name, prev.derivedDataset, index_content, source, {})
+        self._entry = entry.with_log_version(version)
+
+    def event(self, message: str) -> RefreshIncrementalActionEvent:
+        return RefreshIncrementalActionEvent(
+            message=message, index_name=self.previous_entry.name)
+
+
+def _sketch_file(entry: IndexLogEntry) -> str:
+    files = [f for f in entry.content.files
+             if os.path.basename(f) == SKETCH_FILE_NAME]
+    if len(files) != 1:
+        raise HyperspaceException(
+            f"Data-skipping index {entry.name} must have exactly one sketch "
+            f"table file; found {len(files)}")
+    return files[0]

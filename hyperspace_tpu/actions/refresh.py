@@ -197,10 +197,9 @@ class RefreshIncrementalAction(RefreshActionBase):
                 "supported on an index with lineage.")
 
     def _deleted_ids(self) -> List[int]:
-        by_key = {(f.name, f.size, f.modifiedTime): f.id
-                  for f in self.previous_entry.source_file_info_set}
-        return [by_key[(f.name, f.size, f.modifiedTime)]
-                for f in self.deleted_files]
+        # deleted_files are the logged FileInfos (set difference preserves
+        # them), so their recorded lineage ids are already populated.
+        return [f.id for f in self.deleted_files]
 
     def op(self) -> None:
         prev = self.previous_entry

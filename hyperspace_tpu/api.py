@@ -35,6 +35,71 @@ class IndexConfig:
                 "Duplicate columns across indexed/included lists")
 
 
+@dataclass(frozen=True)
+class SketchSpec:
+    """One data-skipping sketch over one column (capability of later
+    reference versions; see SURVEY.md version note and ops/sketches.py)."""
+
+    kind: str
+    column: str
+
+    def properties(self) -> dict:
+        return {}
+
+
+@dataclass(frozen=True)
+class MinMaxSketch(SketchSpec):
+    kind: str = field(default="MinMax", init=False)
+
+    def __init__(self, column: str):
+        object.__setattr__(self, "column", column)
+        object.__setattr__(self, "kind", "MinMax")
+
+
+@dataclass(frozen=True)
+class BloomFilterSketch(SketchSpec):
+    """Bloom membership sketch; sized from (expected_items, fpp)."""
+
+    kind: str = field(default="BloomFilter", init=False)
+    fpp: float = 0.01
+    expected_items: int = 100_000
+
+    def __init__(self, column: str, fpp: float = 0.01,
+                 expected_items: int = 100_000):
+        object.__setattr__(self, "column", column)
+        object.__setattr__(self, "kind", "BloomFilter")
+        object.__setattr__(self, "fpp", fpp)
+        object.__setattr__(self, "expected_items", expected_items)
+
+    def properties(self) -> dict:
+        from .ops.sketches import bloom_parameters
+        num_bits, num_hashes = bloom_parameters(self.expected_items, self.fpp)
+        return {"numBits": str(num_bits), "numHashes": str(num_hashes),
+                "fpp": str(self.fpp),
+                "expectedItems": str(self.expected_items)}
+
+
+@dataclass(frozen=True)
+class DataSkippingIndexConfig:
+    """Data-skipping index specification: per-source-file sketches."""
+
+    index_name: str
+    sketches: List[SketchSpec]
+
+    def __post_init__(self):
+        if not self.index_name:
+            raise HyperspaceException("Index name cannot be empty")
+        if not self.sketches:
+            raise HyperspaceException("At least one sketch is required")
+        seen = set()
+        for s in self.sketches:
+            key = (s.kind, s.column.lower())
+            if key in seen:
+                raise HyperspaceException(
+                    f"Duplicate sketch {s.kind} on column {s.column}")
+            seen.add(key)
+
+
 class Hyperspace:
     def __init__(self, session):
         self.session = session

@@ -105,7 +105,10 @@ def _execute_scan(plan: Scan, needed: Optional[Set[str]],
             cols = [relation.schema.names[0]]
     files = relation.all_files()
     if not files:
-        raise HyperspaceException(f"No files for relation {relation.describe()}")
+        # A data-skipping rewrite can prune every file; the scan is empty.
+        from .columnar import empty_table
+        return empty_table(relation.schema.select(cols)
+                           if cols is not None else relation.schema)
     if relation.file_format != "parquet":
         pa_filter = None
     return read_parquet(files, cols, relation.file_format, filters=pa_filter)

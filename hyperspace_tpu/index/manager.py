@@ -86,11 +86,17 @@ class IndexCollectionManager(IndexManager):
     # ------------------------------------------------------------------
 
     def create(self, df, index_config) -> None:
-        from ..actions.create import CreateAction
+        from ..api import DataSkippingIndexConfig
         name = index_config.index_name
         log_mgr = self._log_manager(name, must_exist=False)
-        CreateAction(self.session, df, index_config, log_mgr,
-                     self._data_manager(name)).run()
+        if isinstance(index_config, DataSkippingIndexConfig):
+            from ..actions.create_skipping import CreateDataSkippingAction
+            action_cls = CreateDataSkippingAction
+        else:
+            from ..actions.create import CreateAction
+            action_cls = CreateAction
+        action_cls(self.session, df, index_config, log_mgr,
+                   self._data_manager(name)).run()
 
     def delete(self, index_name: str) -> None:
         from ..actions.lifecycle import DeleteAction
@@ -114,15 +120,31 @@ class IndexCollectionManager(IndexManager):
             raise HyperspaceException(
                 f"Unsupported refresh mode: {mode}; "
                 f"choose from {IndexConstants.REFRESH_MODES}")
-        from ..actions.refresh import (RefreshAction, RefreshIncrementalAction,
-                                       RefreshQuickAction)
-        cls = {
-            IndexConstants.REFRESH_MODE_FULL: RefreshAction,
-            IndexConstants.REFRESH_MODE_INCREMENTAL: RefreshIncrementalAction,
-            IndexConstants.REFRESH_MODE_QUICK: RefreshQuickAction,
-        }[mode]
-        cls(self.session, self._log_manager(index_name),
-            self._data_manager(index_name)).run()
+        log_mgr = self._log_manager(index_name)
+        latest = log_mgr.get_latest_stable_log()
+        if latest is not None \
+                and latest.derivedDataset.kind == "DataSkippingIndex":
+            from ..actions.create_skipping import (
+                RefreshDataSkippingAction, RefreshDataSkippingIncrementalAction)
+            if mode == IndexConstants.REFRESH_MODE_QUICK:
+                raise HyperspaceException(
+                    "Quick refresh is not supported for data-skipping "
+                    "indexes; use full or incremental.")
+            cls = {
+                IndexConstants.REFRESH_MODE_FULL: RefreshDataSkippingAction,
+                IndexConstants.REFRESH_MODE_INCREMENTAL:
+                    RefreshDataSkippingIncrementalAction,
+            }[mode]
+        else:
+            from ..actions.refresh import (RefreshAction,
+                                           RefreshIncrementalAction,
+                                           RefreshQuickAction)
+            cls = {
+                IndexConstants.REFRESH_MODE_FULL: RefreshAction,
+                IndexConstants.REFRESH_MODE_INCREMENTAL: RefreshIncrementalAction,
+                IndexConstants.REFRESH_MODE_QUICK: RefreshQuickAction,
+            }[mode]
+        cls(self.session, log_mgr, self._data_manager(index_name)).run()
 
     def optimize(self, index_name: str, mode: str = "quick") -> None:
         from ..actions.optimize import OptimizeAction

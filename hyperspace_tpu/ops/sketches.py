@@ -1,0 +1,120 @@
+"""Data-skipping sketch builders: per-source-file MinMax and BloomFilter.
+
+Capability note: sketch-based data skipping does not exist in the mounted
+reference snapshot (SURVEY.md version note — `DataSkippingIndex` landed in
+later Hyperspace versions); it is a target capability per BASELINE.json.
+The design slots into the reference's metadata model exactly where its
+`derivedDataset.kind` field anticipates it (index/IndexLogEntry.scala:349).
+
+TPU-native: both sketches are built as one-pass device reductions over each
+file's column — min/max via jnp reductions, bloom membership via the same
+murmur-style value hash the bucket exchange uses (ops/kernels.py) with
+double hashing to derive k probe positions, scattered into a bit array on
+device. Probing at plan time is host-side (one literal vs a few thousand
+sketch rows — no device roundtrip is worth it).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..exceptions import HyperspaceException
+from ..execution.columnar import Column
+from ..schema import DATE, STRING
+from . import kernels
+
+# Second hash for double hashing: mix of the first with a golden-ratio salt
+# (device and host mirrors must match bit-for-bit).
+_SALT = 0x9E3779B9
+
+
+def _h2_device(h1: jax.Array) -> jax.Array:
+    return kernels._fmix32(h1 ^ np.uint32(_SALT))
+
+
+def _h2_host(h1: int) -> int:
+    return kernels._fmix32_host(h1 ^ _SALT)
+
+
+def bloom_parameters(expected_items: int, fpp: float) -> Tuple[int, int]:
+    """Classic (num_bits, num_hashes) sizing for a target false-positive
+    rate. Bits are rounded up to a byte multiple for packing."""
+    if not (0.0 < fpp < 1.0):
+        raise HyperspaceException(f"fpp must be in (0, 1); got {fpp}")
+    n = max(int(expected_items), 1)
+    m = max(8, int(math.ceil(-n * math.log(fpp) / (math.log(2) ** 2))))
+    m = ((m + 7) // 8) * 8
+    k = max(1, int(round(m / n * math.log(2))))
+    return m, k
+
+
+def bloom_build(col: Column, num_bits: int, num_hashes: int) -> np.ndarray:
+    """Build a bloom bitset over the column's valid values on device.
+    Returns the packed bits as host uint8 (num_bits/8 bytes)."""
+    h1 = kernels.hash32_values(col.data, col.dtype, col.dictionary)
+    h2 = _h2_device(h1)
+    i = jnp.arange(num_hashes, dtype=jnp.uint32)[:, None]
+    pos = ((h1[None, :] + i * h2[None, :]) % np.uint32(num_bits)).astype(jnp.int32)
+    if col.validity is not None:
+        # Null rows scatter onto an overflow bit that is sliced away.
+        pos = jnp.where(col.validity[None, :], pos, num_bits)
+    bits = jnp.zeros(num_bits + 1, jnp.bool_).at[pos.reshape(-1)].set(True)
+    return np.packbits(np.asarray(jax.device_get(bits[:num_bits])))
+
+
+def bloom_might_contain(packed: np.ndarray, value, dtype: str,
+                        num_bits: int, num_hashes: int) -> bool:
+    """Host-side membership probe for one literal (mirrors bloom_build)."""
+    h1 = kernels.hash32_value_host(value, dtype)
+    h2 = _h2_host(h1)
+    bits = np.unpackbits(np.frombuffer(packed, dtype=np.uint8),
+                         count=num_bits)
+    for i in range(num_hashes):
+        # Mirror the device's wrapping uint32 arithmetic exactly.
+        if not bits[((h1 + i * h2) & 0xFFFFFFFF) % num_bits]:
+            return False
+    return True
+
+
+def minmax_values(col: Column) -> Tuple[Optional[object], Optional[object]]:
+    """(min, max) of the column's valid values as host python objects in the
+    column's logical domain (dates as datetime.date, strings as str).
+    Returns (None, None) when every row is null."""
+    import datetime
+
+    data = col.data
+    if col.validity is not None:
+        n_valid = int(jnp.sum(col.validity))
+        if n_valid == 0:
+            return None, None
+        lo_sent = _max_sentinel(data.dtype)
+        hi_sent = _min_sentinel(data.dtype)
+        mn = jnp.min(jnp.where(col.validity, data, lo_sent))
+        mx = jnp.max(jnp.where(col.validity, data, hi_sent))
+    else:
+        if data.shape[0] == 0:
+            return None, None
+        mn, mx = jnp.min(data), jnp.max(data)
+    mn, mx = jax.device_get((mn, mx))
+    if col.dtype == STRING:
+        return str(col.dictionary[int(mn)]), str(col.dictionary[int(mx)])
+    if col.dtype == DATE:
+        epoch = datetime.date(1970, 1, 1)
+        return (epoch + datetime.timedelta(days=int(mn)),
+                epoch + datetime.timedelta(days=int(mx)))
+    return mn.item(), mx.item()
+
+
+def _max_sentinel(dtype):
+    return jnp.array(jnp.finfo(dtype).max if jnp.issubdtype(dtype, jnp.floating)
+                     else jnp.iinfo(dtype).max, dtype)
+
+
+def _min_sentinel(dtype):
+    return jnp.array(jnp.finfo(dtype).min if jnp.issubdtype(dtype, jnp.floating)
+                     else jnp.iinfo(dtype).min, dtype)

@@ -22,8 +22,12 @@ def active_indexes(session) -> List[IndexLogEntry]:
 
 
 def apply_hyperspace(session, plan: LogicalPlan) -> LogicalPlan:
+    from .data_skipping_rule import DataSkippingIndexRule
     from .filter_rule import FilterIndexRule
     from .join_rule import JoinIndexRule
     plan = JoinIndexRule().apply(session, plan)
     plan = FilterIndexRule().apply(session, plan)
+    # Data skipping last: it only narrows Scan leaves the covering rules
+    # left in place (the covering rewrite is the better win when it applies).
+    plan = DataSkippingIndexRule().apply(session, plan)
     return plan

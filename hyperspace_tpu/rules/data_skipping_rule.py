@@ -1,0 +1,232 @@
+"""DataSkippingIndexRule: prune a scan's file list using per-file sketches.
+
+No parity in the mounted reference snapshot (DataSkippingIndex landed in
+later Hyperspace versions — SURVEY.md version note); behaviorally this is
+the later reference's ApplyDataSkippingIndex: the source relation is kept,
+but its file listing is narrowed to the files whose sketches cannot refute
+the filter predicate. Covering-index rules run first; this rule only touches
+Scan leaves they left in place.
+
+Sketch probing is host-side numpy over the (one row per file) sketch table;
+unknown predicate shapes conservatively keep all files.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List, Optional, Sequence
+
+import numpy as np
+import pyarrow.parquet as pq
+
+from ..index.log_entry import IndexLogEntry, Sketch
+from ..ops import sketches as sk
+from ..plan import expr as E
+from ..plan.nodes import Filter, LogicalPlan, Scan
+from ..telemetry.events import HyperspaceIndexUsageEvent
+from ..telemetry.logging import get_logger
+from .rule_utils import _plan_signature, get_relation
+
+
+class DataSkippingIndexRule:
+    name = "DataSkippingIndexRule"
+
+    def apply(self, session, plan: LogicalPlan) -> LogicalPlan:
+        from .apply_hyperspace import active_indexes
+        candidates = [e for e in active_indexes(session)
+                      if e.derivedDataset.kind == "DataSkippingIndex"]
+        if not candidates:
+            return plan
+
+        applied: List[str] = []
+
+        def rewrite(node: LogicalPlan) -> LogicalPlan:
+            if isinstance(node, Filter) and isinstance(node.child, Scan):
+                pruned = self._try_prune(session, node.child, node.condition,
+                                         candidates, applied)
+                if pruned is not None:
+                    return Filter(node.condition, pruned)
+            return node
+
+        new_plan = plan.transform_up(rewrite)
+        if applied:
+            get_logger(session.hs_conf.event_logger_class()).log_event(
+                HyperspaceIndexUsageEvent(
+                    index_names=sorted(set(applied)),
+                    plan_string=new_plan.tree_string(),
+                    message="Data skipping index applied."))
+        return new_plan
+
+    def _try_prune(self, session, scan: Scan, condition: E.Expr,
+                   candidates: List[IndexLogEntry],
+                   applied: List[str]) -> Optional[Scan]:
+        relation = get_relation(session, scan)
+        if relation is None:
+            return None
+        all_files = relation.all_files()
+        keep = np.ones(len(all_files), dtype=bool)
+        hit_names: List[str] = []
+        for entry in candidates:
+            sig = _plan_signature(entry, scan)
+            recorded = entry.signature.signatures[0].value \
+                if entry.signature.signatures else None
+            if sig is None or recorded is None or sig != recorded:
+                continue
+            verdict = evaluate_sketch_predicate(entry, condition, all_files,
+                                                relation.schema)
+            if verdict is not None:
+                keep &= verdict
+                hit_names.append(entry.name)
+        if not hit_names or keep.all():
+            return None  # nothing pruned → no rewrite, no usage event.
+        applied.extend(hit_names)
+        kept_files = [f for f, k in zip(all_files, keep) if k]
+        return Scan(relation.with_files(kept_files))
+
+
+def evaluate_sketch_predicate(entry: IndexLogEntry, condition: E.Expr,
+                              all_files: Sequence[str],
+                              relation_schema) -> Optional[np.ndarray]:
+    """Per-file keep mask from the entry's sketch table, or None when the
+    predicate has no evaluable conjunct."""
+    table = _load_sketch_table(entry)
+    by_file = {name: i for i, name in enumerate(table["_file"])}
+    n_sketch = len(table["_file"])
+
+    sketch_by_col = {}
+    for s in entry.derivedDataset.sketches:
+        sketch_by_col.setdefault(s.column, []).append(s)
+
+    mask_rows: Optional[np.ndarray] = None
+    for conjunct in E.split_conjunctive_predicates(condition):
+        verdict = _eval_node(conjunct, table, sketch_by_col, relation_schema,
+                             n_sketch)
+        if verdict is not None:
+            mask_rows = verdict if mask_rows is None else (mask_rows & verdict)
+    if mask_rows is None:
+        return None
+
+    # Map sketch-row verdicts onto the scan's file list; files without a
+    # sketch row (shouldn't happen on signature match) are kept.
+    out = np.ones(len(all_files), dtype=bool)
+    for i, f in enumerate(all_files):
+        j = by_file.get(f)
+        if j is not None:
+            out[i] = bool(mask_rows[j])
+    return out
+
+
+def _eval_node(e: E.Expr, table, sketch_by_col, relation_schema,
+               n: int) -> Optional[np.ndarray]:
+    """Keep mask over sketch rows for one predicate node; None = unknown."""
+    if isinstance(e, E.And):
+        l = _eval_node(e.left, table, sketch_by_col, relation_schema, n)
+        r = _eval_node(e.right, table, sketch_by_col, relation_schema, n)
+        if l is None:
+            return r
+        if r is None:
+            return l
+        return l & r
+    if isinstance(e, E.Or):
+        l = _eval_node(e.left, table, sketch_by_col, relation_schema, n)
+        r = _eval_node(e.right, table, sketch_by_col, relation_schema, n)
+        if l is None or r is None:
+            return None  # one side unprunable → the OR can't prune.
+        return l | r
+    if isinstance(e, E.In) and isinstance(e.value, E.Col) \
+            and all(isinstance(o, E.Lit) for o in e.options):
+        verdicts = [_eval_compare(e.value.column, "EqualTo", o.value, table,
+                                  sketch_by_col, relation_schema, n)
+                    for o in e.options]
+        if any(v is None for v in verdicts) or not verdicts:
+            return None
+        out = verdicts[0]
+        for v in verdicts[1:]:
+            out = out | v
+        return out
+    if isinstance(e, (E.EqualTo, E.LessThan, E.LessThanOrEqual,
+                      E.GreaterThan, E.GreaterThanOrEqual)):
+        left, right = e.left, e.right
+        flipped = False
+        if isinstance(left, E.Lit) and isinstance(right, E.Col):
+            left, right = right, left
+            flipped = True
+        if not (isinstance(left, E.Col) and isinstance(right, E.Lit)):
+            return None
+        op = type(e).__name__
+        if flipped:
+            op = {"EqualTo": "EqualTo", "LessThan": "GreaterThan",
+                  "LessThanOrEqual": "GreaterThanOrEqual",
+                  "GreaterThan": "LessThan",
+                  "GreaterThanOrEqual": "LessThanOrEqual"}[op]
+        return _eval_compare(left.column, op, right.value, table,
+                             sketch_by_col, relation_schema, n)
+    return None
+
+
+def _eval_compare(column: str, op: str, value, table, sketch_by_col,
+                  relation_schema, n: int) -> Optional[np.ndarray]:
+    from ..actions.create_skipping import bloom_col, minmax_cols
+
+    sketches: List[Sketch] = sketch_by_col.get(column, [])
+    if not sketches:
+        return None
+    out: Optional[np.ndarray] = None
+
+    def apply_mask(m: np.ndarray):
+        nonlocal out
+        out = m if out is None else (out & m)
+
+    for s in sketches:
+        if s.kind == "MinMax":
+            lo_name, hi_name = minmax_cols(column)
+            lo, hi = table[lo_name], table[hi_name]
+            m = np.ones(n, dtype=bool)
+            for i in range(n):
+                if lo[i] is None or hi[i] is None:
+                    continue  # all-null file: only IS NULL could match; keep.
+                if op == "EqualTo":
+                    m[i] = lo[i] <= value <= hi[i]
+                elif op == "LessThan":
+                    m[i] = lo[i] < value
+                elif op == "LessThanOrEqual":
+                    m[i] = lo[i] <= value
+                elif op == "GreaterThan":
+                    m[i] = hi[i] > value
+                elif op == "GreaterThanOrEqual":
+                    m[i] = hi[i] >= value
+            apply_mask(m)
+        elif s.kind == "BloomFilter" and op == "EqualTo":
+            dtype = relation_schema.field(column).dtype
+            num_bits = int(s.properties["numBits"])
+            num_hashes = int(s.properties["numHashes"])
+            bits_rows = table[bloom_col(column)]
+            m = np.array([
+                sk.bloom_might_contain(
+                    np.frombuffer(b, dtype=np.uint8), value, dtype,
+                    num_bits, num_hashes) if b is not None else True
+                for b in bits_rows], dtype=bool)
+            apply_mask(m)
+    return out
+
+
+# Tiny per-entry cache keyed on (index name, log id): sketch tables are small
+# and reread per query otherwise.
+_SKETCH_CACHE: dict = {}
+
+
+def _load_sketch_table(entry: IndexLogEntry) -> dict:
+    from ..actions.create_skipping import SKETCH_FILE_NAME
+
+    key = (entry.name, entry.id)
+    cached = _SKETCH_CACHE.get(key)
+    if cached is not None:
+        return cached
+    files = [f for f in entry.content.files
+             if os.path.basename(f) == SKETCH_FILE_NAME]
+    t = pq.read_table(files[0])
+    table = {name: t.column(name).to_pylist() for name in t.column_names}
+    if len(_SKETCH_CACHE) >= 8:  # keep at most a handful of entries alive.
+        _SKETCH_CACHE.pop(next(iter(_SKETCH_CACHE)))
+    _SKETCH_CACHE[key] = table
+    return table

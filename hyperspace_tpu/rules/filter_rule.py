@@ -62,7 +62,8 @@ class FilterIndexRule:
 
         from .apply_hyperspace import active_indexes
         candidates = [e for e in active_indexes(session)
-                      if index_covers_plan(e, project_cols, filter_cols)]
+                      if e.derivedDataset.kind == "CoveringIndex"
+                      and index_covers_plan(e, project_cols, filter_cols)]
         candidates = get_candidate_indexes(session, candidates, scan)
         best = FilterIndexRanker.rank(session, relation, candidates)
         if best is None:

@@ -136,6 +136,8 @@ class JoinIndexRule:
         from .apply_hyperspace import active_indexes
         out = []
         for entry in active_indexes(session):
+            if entry.derivedDataset.kind != "CoveringIndex":
+                continue
             if sorted(entry.indexed_columns) != sorted(join_cols):
                 continue
             covered = set(entry.indexed_columns) | set(entry.included_columns)

@@ -86,6 +86,12 @@ class DefaultFileBasedRelation(FileBasedRelation):
         return DefaultFileBasedRelation(
             self._root_paths, self._format, self._options, schema=None)
 
+    def with_files(self, files) -> "DefaultFileBasedRelation":
+        pruned = DefaultFileBasedRelation(
+            list(files), self._format, self._options, schema=self.schema)
+        pruned._files = sorted(os.path.abspath(f) for f in files)
+        return pruned
+
 
 class DefaultFileBasedSourceBuilder(FileBasedSourceProvider):
     """The provider the conf points at by default."""

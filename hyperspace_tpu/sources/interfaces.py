@@ -70,6 +70,11 @@ class FileBasedRelation:
         """Re-list the underlying files (for refresh actions)."""
         raise NotImplementedError
 
+    def with_files(self, files: Sequence[str]) -> "FileBasedRelation":
+        """A copy of this relation restricted to ``files`` (data-skipping
+        scan pruning). Schema is preserved even when files is empty."""
+        raise NotImplementedError
+
 
 class FileBasedSourceProvider:
     """Builds relations it understands; returns None for ones it doesn't."""
