@@ -156,6 +156,23 @@ class Hyperspace:
             redirect_func(text)
         return text
 
+    def why_not(self, df, index_name: Optional[str] = None) -> str:
+        """Report why each index was (not) applied to this query plan.
+
+        Built on the whyNot reason tagging of the next-gen rule framework
+        (parity: FILTER_REASONS, rules/IndexFilter.scala:41-52 and
+        index/IndexLogEntryTags.scala:57-63); reasons are always collected
+        here regardless of ``hyperspace.index.filterReason.enabled``.
+        """
+        from .rules.apply_hyperspace import apply_hyperspace
+        from .rules.column_pruning import prune_columns
+        from .rules.index_filters import ReasonCollector
+        # silent: a diagnostic pass must not emit index-usage telemetry or
+        # clobber the reasons of the last real optimize pass.
+        ctx = ReasonCollector(enabled=True, silent=True)
+        apply_hyperspace(self.session, prune_columns(df.plan), ctx)
+        return ctx.format(index_name)
+
     # CamelCase aliases for drop-in parity with the reference's API.
     createIndex = create_index
     deleteIndex = delete_index
@@ -163,3 +180,4 @@ class Hyperspace:
     vacuumIndex = vacuum_index
     refreshIndex = refresh_index
     optimizeIndex = optimize_index
+    whyNot = why_not

@@ -109,6 +109,16 @@ class HyperspaceConf:
             IndexConstants.INDEX_FILTER_RULE_USE_BUCKET_SPEC,
             IndexConstants.INDEX_FILTER_RULE_USE_BUCKET_SPEC_DEFAULT)
 
+    def filter_reason_enabled(self) -> bool:
+        return self._get_bool(
+            IndexConstants.INDEX_FILTER_REASON_ENABLED,
+            IndexConstants.INDEX_FILTER_REASON_ENABLED_DEFAULT)
+
+    def score_based_optimizer_enabled(self) -> bool:
+        return self._get_bool(
+            IndexConstants.SCORE_BASED_OPTIMIZER_ENABLED,
+            IndexConstants.SCORE_BASED_OPTIMIZER_ENABLED_DEFAULT)
+
     def index_lineage_enabled(self) -> bool:
         return self._get_bool(
             IndexConstants.INDEX_LINEAGE_ENABLED,

@@ -49,6 +49,18 @@ class IndexConstants:
     INDEX_FILTER_RULE_USE_BUCKET_SPEC = "hyperspace.index.filterRule.useBucketSpec"
     INDEX_FILTER_RULE_USE_BUCKET_SPEC_DEFAULT = "false"
 
+    # whyNot reason collection (parity: the FILTER_REASONS tag machinery,
+    # rules/IndexFilter.scala:37-52; collection is off by default because
+    # building reason strings costs time on the optimize path).
+    INDEX_FILTER_REASON_ENABLED = "hyperspace.index.filterReason.enabled"
+    INDEX_FILTER_REASON_ENABLED_DEFAULT = "false"
+
+    # Score-based index selection (parity: ApplyHyperspace.scala:69-101 —
+    # the reference ships the optimizer as a NoOpRule placeholder; ours is
+    # complete and on by default, with the legacy rule order as fallback).
+    SCORE_BASED_OPTIMIZER_ENABLED = "hyperspace.optimizer.scoreBased.enabled"
+    SCORE_BASED_OPTIMIZER_ENABLED_DEFAULT = "true"
+
     INDEX_CACHE_EXPIRY_DURATION_SECONDS = "hyperspace.index.cache.expiryDurationInSeconds"
     INDEX_CACHE_EXPIRY_DURATION_SECONDS_DEFAULT = "300"
 

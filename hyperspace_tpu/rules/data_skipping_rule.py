@@ -23,15 +23,13 @@ from ..index.log_entry import IndexLogEntry, Sketch
 from ..ops import sketches as sk
 from ..plan import expr as E
 from ..plan.nodes import Filter, LogicalPlan, Scan
-from ..telemetry.events import HyperspaceIndexUsageEvent
-from ..telemetry.logging import get_logger
 from .rule_utils import _plan_signature, get_relation
 
 
 class DataSkippingIndexRule:
     name = "DataSkippingIndexRule"
 
-    def apply(self, session, plan: LogicalPlan) -> LogicalPlan:
+    def apply(self, session, plan: LogicalPlan, ctx=None) -> LogicalPlan:
         from .apply_hyperspace import active_indexes
         candidates = [e for e in active_indexes(session)
                       if e.derivedDataset.kind == "DataSkippingIndex"]
@@ -43,23 +41,24 @@ class DataSkippingIndexRule:
         def rewrite(node: LogicalPlan) -> LogicalPlan:
             if isinstance(node, Filter) and isinstance(node.child, Scan):
                 pruned = self._try_prune(session, node.child, node.condition,
-                                         candidates, applied)
+                                         candidates, applied, ctx)
                 if pruned is not None:
                     return Filter(node.condition, pruned)
             return node
 
         new_plan = plan.transform_up(rewrite)
         if applied:
-            get_logger(session.hs_conf.event_logger_class()).log_event(
-                HyperspaceIndexUsageEvent(
-                    index_names=sorted(set(applied)),
-                    plan_string=new_plan.tree_string(),
-                    message="Data skipping index applied."))
+            from .rule_utils import log_index_usage
+            log_index_usage(session, ctx, sorted(set(applied)),
+                            new_plan.tree_string(),
+                            "Data skipping index applied.")
+            if ctx is not None:
+                ctx.applied.extend(sorted(set(applied)))
         return new_plan
 
     def _try_prune(self, session, scan: Scan, condition: E.Expr,
                    candidates: List[IndexLogEntry],
-                   applied: List[str]) -> Optional[Scan]:
+                   applied: List[str], ctx=None) -> Optional[Scan]:
         relation = get_relation(session, scan)
         if relation is None:
             return None
@@ -71,6 +70,10 @@ class DataSkippingIndexRule:
             recorded = entry.signature.signatures[0].value \
                 if entry.signature.signatures else None
             if sig is None or recorded is None or sig != recorded:
+                if ctx is not None:
+                    ctx.add("SOURCE_DATA_CHANGED", entry,
+                            "Source fingerprint mismatch; refresh the "
+                            "data-skipping index.")
                 continue
             verdict = evaluate_sketch_predicate(entry, condition, all_files,
                                                 relation.schema)

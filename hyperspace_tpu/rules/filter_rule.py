@@ -6,20 +6,23 @@ Parity reference: rules/FilterIndexRule.scala:38-197. Applicability
   1. the index's *first* indexed column appears in the filter predicate
      (the sort order within buckets makes that column cheap to probe), and
   2. the index covers every column the plan touches (project + filter).
+
+``try_rewrite_filter`` is the shared core used both by this legacy-style rule
+and by the score-based optimizer (rules/disabled/FilterIndexRule.scala:34-144
+filter-chain semantics), with whyNot reasons recorded into a ReasonCollector.
 """
 
 from __future__ import annotations
 
-from typing import List, Optional
+from typing import List, Optional, Tuple
 
-from ..index.constants import States
 from ..index.log_entry import IndexLogEntry
 from ..plan.nodes import Filter, LogicalPlan, Project, Scan
-from ..telemetry.events import HyperspaceIndexUsageEvent
-from ..telemetry.logging import get_logger
+from .index_filters import ReasonCollector
 from .rankers import FilterIndexRanker
 from .rule_utils import (collect_filter_project_columns, get_candidate_indexes,
-                         get_relation, transform_plan_to_use_index)
+                         get_relation, log_index_usage,
+                         transform_plan_to_use_index)
 
 
 def _extract_filter_node(plan: LogicalPlan):
@@ -35,44 +38,71 @@ def _extract_filter_node(plan: LogicalPlan):
     return node.child, node
 
 
-def index_covers_plan(entry: IndexLogEntry, project_cols: List[str],
-                      filter_cols: List[str]) -> bool:
-    first_indexed = entry.indexed_columns[0]
-    if first_indexed not in filter_cols:
-        return False
-    covered = set(entry.indexed_columns) | set(entry.included_columns)
-    return set(project_cols) | set(filter_cols) <= covered
+def try_rewrite_filter(session, plan: LogicalPlan,
+                       ctx: Optional[ReasonCollector] = None,
+                       candidates_for=None
+                       ) -> Optional[Tuple[LogicalPlan, IndexLogEntry]]:
+    """Attempt the filter-index rewrite at this plan root. Returns
+    (new plan, applied index) or None; filter-out reasons go to ``ctx``."""
+    ctx = ctx or ReasonCollector(enabled=False)
+    matched = _extract_filter_node(plan)
+    if matched is None:
+        return None
+    scan, _ = matched
+    relation = get_relation(session, scan)
+    if relation is None:
+        return None
+
+    project_cols, filter_cols = collect_filter_project_columns(plan)
+    if not filter_cols:
+        return None
+
+    from .apply_hyperspace import active_indexes
+    if candidates_for is not None:
+        pool = candidates_for(scan)
+    else:
+        pool = get_candidate_indexes(
+            session, active_indexes(session), scan, ctx)
+
+    candidates = []
+    for e in pool:
+        if e.derivedDataset.kind != "CoveringIndex":
+            continue
+        if e.indexed_columns[0] not in filter_cols:
+            ctx.add("NO_FIRST_INDEXED_COL_COND", e,
+                    f"The first indexed column '{e.indexed_columns[0]}' does "
+                    f"not appear in the filter condition columns {sorted(set(filter_cols))}.")
+            continue
+        covered = set(e.indexed_columns) | set(e.included_columns)
+        missing = (set(project_cols) | set(filter_cols)) - covered
+        if missing:
+            ctx.add("MISSING_REQUIRED_COL", e,
+                    f"Index does not cover required columns {sorted(missing)}.")
+            continue
+        candidates.append(e)
+
+    best = FilterIndexRanker.rank(session, relation, candidates)
+    if best is None:
+        return None
+    for e in candidates:
+        if e is not best:
+            ctx.add("ANOTHER_INDEX_APPLIED", e,
+                    f"Another candidate index '{best.name}' was ranked higher.")
+
+    use_bucket_spec = session.hs_conf.use_bucket_spec_for_filter_rule()
+    new_plan = transform_plan_to_use_index(session, best, plan, use_bucket_spec)
+    return new_plan, best
 
 
 class FilterIndexRule:
     name = "FilterIndexRule"
 
-    def apply(self, session, plan: LogicalPlan) -> LogicalPlan:
-        matched = _extract_filter_node(plan)
-        if matched is None:
+    def apply(self, session, plan: LogicalPlan,
+              ctx: Optional[ReasonCollector] = None) -> LogicalPlan:
+        result = try_rewrite_filter(session, plan, ctx)
+        if result is None:
             return plan
-        scan, _ = matched
-        relation = get_relation(session, scan)
-        if relation is None:
-            return plan
-
-        project_cols, filter_cols = collect_filter_project_columns(plan)
-        if not filter_cols:
-            return plan
-
-        from .apply_hyperspace import active_indexes
-        candidates = [e for e in active_indexes(session)
-                      if e.derivedDataset.kind == "CoveringIndex"
-                      and index_covers_plan(e, project_cols, filter_cols)]
-        candidates = get_candidate_indexes(session, candidates, scan)
-        best = FilterIndexRanker.rank(session, relation, candidates)
-        if best is None:
-            return plan
-
-        use_bucket_spec = session.hs_conf.use_bucket_spec_for_filter_rule()
-        new_plan = transform_plan_to_use_index(session, best, plan, use_bucket_spec)
-        get_logger(session.hs_conf.event_logger_class()).log_event(
-            HyperspaceIndexUsageEvent(
-                index_names=[best.name], plan_string=new_plan.tree_string(),
-                message="Filter index applied."))
+        new_plan, best = result
+        log_index_usage(session, ctx, [best.name], new_plan.tree_string(),
+                        "Filter index applied.")
         return new_plan

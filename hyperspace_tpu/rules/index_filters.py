@@ -1,0 +1,148 @@
+"""Next-gen rule framework: index filter chain with "whyNot" reason tagging.
+
+Parity reference: rules/IndexFilter.scala:30-204 (IndexFilter /
+SourcePlanIndexFilter / QueryPlanIndexFilter / IndexRankFilter, withFilterReasonTag),
+rules/ApplyHyperspace.scala:34-101 (CandidateIndexCollector: per-source-relation
+chain ColumnSchemaFilter -> FileSignatureFilter), and the FILTER_REASONS tag
+(index/IndexLogEntryTags.scala:57-63).
+
+Reasons are collected into a per-optimization :class:`ReasonCollector` instead
+of mutable tags on the log entry (entries here are immutable dataclasses); the
+session keeps the collector of the last rewrite for the ``whyNot`` API.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from ..index.log_entry import IndexLogEntry
+from ..plan.nodes import LogicalPlan, Scan
+
+
+@dataclass(frozen=True)
+class FilterReason:
+    """One recorded reason why an index was filtered out of a plan rewrite
+    (parity: the FILTER_REASONS tag values, IndexFilter.scala:41-52)."""
+
+    code: str
+    index_name: str
+    message: str
+
+    def __str__(self) -> str:
+        return f"[{self.index_name}] {self.code}: {self.message}"
+
+
+class ReasonCollector:
+    """Accumulates FilterReasons during one rewrite pass. ``enabled`` mirrors
+    the reference conf ``spark.hyperspace.index.filterReason.enabled`` — when
+    off, reason strings are never materialized (IndexFilter.scala:37-39)."""
+
+    def __init__(self, enabled: bool = True, silent: bool = False):
+        self.enabled = enabled
+        # ``silent`` suppresses index-usage telemetry for diagnostic passes
+        # (why_not) that optimize a plan without executing it.
+        self.silent = silent
+        self.reasons: List[FilterReason] = []
+        # Indexes that were actually applied somewhere in the final plan.
+        self.applied: List[str] = []
+
+    def add(self, code: str, entry: IndexLogEntry, message: str) -> None:
+        self.add_name(code, entry.name, message)
+
+    def add_name(self, code: str, index_name: str, message: str) -> None:
+        if self.enabled:
+            reason = FilterReason(code, index_name, message)
+            # The optimizer scores overlapping patterns (e.g. Filter(Scan)
+            # and Project(Filter(Scan))) — record each distinct reason once.
+            if reason not in self.reasons:
+                self.reasons.append(reason)
+
+    def for_index(self, index_name: str) -> List[FilterReason]:
+        return [r for r in self.reasons if r.index_name == index_name]
+
+    def format(self, index_name: Optional[str] = None) -> str:
+        applied = sorted(set(self.applied))
+        if index_name is not None:
+            if index_name in applied:
+                return f"Index '{index_name}' was applied."
+            rows = self.for_index(index_name)
+            if not rows:
+                return f"No reasons recorded for index '{index_name}'."
+            return "\n".join(str(r) for r in rows)
+        # Exploratory scoring can record transient failure reasons for an
+        # index that the chosen plan ultimately uses — don't report those.
+        rows = [r for r in self.reasons if r.index_name not in applied]
+        lines = [str(r) for r in rows]
+        if applied:
+            lines.append("Applied indexes: " + ", ".join(applied))
+        return "\n".join(lines) if lines else "No reason recorded."
+
+
+class SourcePlanIndexFilter:
+    """Filters candidates using only the source relation (parity:
+    IndexFilter.scala:117 SourcePlanIndexFilter)."""
+
+    def apply(self, session, scan: Scan, indexes: List[IndexLogEntry],
+              ctx: ReasonCollector) -> List[IndexLogEntry]:
+        raise NotImplementedError
+
+
+class ColumnSchemaFilter(SourcePlanIndexFilter):
+    """Keep indexes whose indexed + included columns all exist in the
+    relation's schema (parity: rules/IndexFilter... ColumnSchemaFilter,
+    ApplyHyperspace.scala:44-52)."""
+
+    def apply(self, session, scan: Scan, indexes, ctx):
+        available = {n.lower() for n in scan.relation.schema.names}
+        out = []
+        for entry in indexes:
+            needed = list(entry.indexed_columns) + list(entry.included_columns)
+            missing = [c for c in needed if c.lower() not in available]
+            if missing:
+                ctx.add("COL_SCHEMA_MISMATCH", entry,
+                        f"Index columns {missing} not found in source schema "
+                        f"{sorted(scan.relation.schema.names)}.")
+                continue
+            out.append(entry)
+        return out
+
+
+class FileSignatureFilter(SourcePlanIndexFilter):
+    """Keep indexes whose recorded source fingerprint matches the current
+    relation — exactly, or within the Hybrid Scan appended/deleted thresholds
+    when Hybrid Scan is on (parity: FileSignatureFilter,
+    ApplyHyperspace.scala:54-67 + RuleUtils.scala:52-160). Delegates to the
+    single implementation in rule_utils.get_candidate_indexes."""
+
+    def apply(self, session, scan: Scan, indexes, ctx):
+        from .rule_utils import get_candidate_indexes
+        return get_candidate_indexes(session, indexes, scan, ctx)
+
+
+class CandidateIndexCollector:
+    """Initial per-source-relation candidate selection: the chain
+    ColumnSchemaFilter -> FileSignatureFilter applied to every supported Scan
+    leaf (parity: ApplyHyperspace.scala:34-67 CandidateIndexCollector)."""
+
+    filters = (ColumnSchemaFilter(), FileSignatureFilter())
+
+    @classmethod
+    def collect(cls, session, plan: LogicalPlan,
+                indexes: List[IndexLogEntry], ctx: ReasonCollector
+                ) -> Dict[int, Tuple[Scan, List[IndexLogEntry]]]:
+        """Map of id(scan-leaf) -> (scan, surviving candidate indexes)."""
+        out: Dict[int, Tuple[Scan, List[IndexLogEntry]]] = {}
+        for leaf in plan.collect_leaves():
+            if not isinstance(leaf, Scan):
+                continue
+            if not session.source_provider_manager.is_supported_relation(leaf):
+                continue
+            remaining = list(indexes)
+            for f in cls.filters:
+                if not remaining:
+                    break
+                remaining = f.apply(session, leaf, remaining, ctx)
+            if remaining:
+                out[id(leaf)] = (leaf, remaining)
+        return out

@@ -17,143 +17,184 @@ Parity reference: rules/JoinIndexRule.scala:53-532. Applicability:
 Both sides are then rewritten with use_bucket_spec=True: co-partitioned
 buckets (same hash, same count) let the executor merge per bucket with zero
 exchange — the TPU analogue of presenting bucketSpec to Spark's SMJ.
+
+``try_rewrite_join`` is the shared core used both by the legacy-style rule and
+the score-based optimizer (rules/disabled/JoinIndexRule.scala:45-618 filter
+chain), recording whyNot reasons into a ReasonCollector.
 """
 
 from __future__ import annotations
 
-from itertools import permutations
 from typing import Dict, List, Optional, Tuple
 
 from ..index.log_entry import IndexLogEntry
 from ..plan import expr as E
 from ..plan.nodes import Join, LogicalPlan, Scan
-from ..telemetry.events import HyperspaceIndexUsageEvent
-from ..telemetry.logging import get_logger
+from .index_filters import ReasonCollector
 from .rankers import JoinIndexRanker
 from .rule_utils import (collect_filter_project_columns, get_candidate_indexes,
-                         get_relation, is_plan_linear,
+                         get_relation, is_plan_linear, log_index_usage,
                          transform_plan_to_use_index)
+
+
+def _column_mapping(join: Join, pairs) -> Optional[Tuple[List[str], List[str]]]:
+    """Normalize pairs to (left cols, right cols); require a 1:1 mapping
+    (parity: ensureAttributeRequirements, JoinIndexRule.scala:234)."""
+    left_names = set(join.left.schema.names)
+    right_names = set(join.right.schema.names)
+    l_cols, r_cols = [], []
+    for a, b in pairs:
+        if a in left_names and b in right_names:
+            l_cols.append(a)
+            r_cols.append(b)
+        elif b in left_names and a in right_names:
+            l_cols.append(b)
+            r_cols.append(a)
+        else:
+            return None
+    # 1:1: no left column maps to two right columns or vice versa.
+    l_to_r: Dict[str, str] = {}
+    r_to_l: Dict[str, str] = {}
+    for l, r in zip(l_cols, r_cols):
+        if l_to_r.get(l, r) != r or r_to_l.get(r, l) != l:
+            return None
+        l_to_r[l] = r
+        r_to_l[r] = l
+    # De-dup repeated pairs while preserving order.
+    seen = set()
+    uniq_l, uniq_r = [], []
+    for l, r in zip(l_cols, r_cols):
+        if (l, r) not in seen:
+            seen.add((l, r))
+            uniq_l.append(l)
+            uniq_r.append(r)
+    return uniq_l, uniq_r
+
+
+def _usable_indexes(session, side_plan: LogicalPlan, scan: Scan,
+                    join_cols: List[str], ctx: ReasonCollector,
+                    candidates_for=None) -> List[IndexLogEntry]:
+    """Indexes on this side whose indexed columns are exactly the join
+    columns (any order) and which cover all referenced columns (parity:
+    getUsableIndexes, JoinIndexRule.scala:449)."""
+    project_cols, filter_cols = collect_filter_project_columns(side_plan)
+    referenced = set(project_cols) | set(filter_cols) | set(join_cols)
+
+    from .apply_hyperspace import active_indexes
+    if candidates_for is not None:
+        pool = candidates_for(scan)
+    else:
+        pool = get_candidate_indexes(session, active_indexes(session), scan,
+                                     ctx)
+
+    out = []
+    for entry in pool:
+        if entry.derivedDataset.kind != "CoveringIndex":
+            continue
+        if sorted(entry.indexed_columns) != sorted(join_cols):
+            ctx.add("NOT_ALL_JOIN_COL_INDEXED", entry,
+                    f"Indexed columns {list(entry.indexed_columns)} are not "
+                    f"exactly the join columns {sorted(join_cols)}.")
+            continue
+        covered = set(entry.indexed_columns) | set(entry.included_columns)
+        if not referenced <= covered:
+            ctx.add("MISSING_REQUIRED_COL", entry,
+                    f"Index does not cover required columns "
+                    f"{sorted(referenced - covered)}.")
+            continue
+        out.append(entry)
+    return out
+
+
+def _compatible_pairs(l_usable, r_usable, col_map: Dict[str, str]
+                      ) -> List[Tuple[IndexLogEntry, IndexLogEntry]]:
+    """Pairs whose indexed-column order matches under the mapping
+    (parity: getCompatibleIndexPairs/isCompatible, JoinIndexRule.scala:484)."""
+    out = []
+    for le in l_usable:
+        mapped = [col_map[c] for c in le.indexed_columns]
+        for re_ in r_usable:
+            if list(re_.indexed_columns) == mapped:
+                out.append((le, re_))
+    return out
+
+
+def try_rewrite_join(session, join: Join,
+                     ctx: Optional[ReasonCollector] = None,
+                     candidates_for=None
+                     ) -> Optional[Tuple[LogicalPlan,
+                                         Tuple[IndexLogEntry, IndexLogEntry]]]:
+    """Attempt the shuffle-free-join rewrite of this Join node. Returns
+    (new plan, (left index, right index)) or None."""
+    ctx = ctx or ReasonCollector(enabled=False)
+    if join.join_type != "inner":
+        return None
+    pairs = E.extract_equi_join_keys(join.condition)
+    if not pairs:
+        return None
+    if not (is_plan_linear(join.left) and is_plan_linear(join.right)):
+        return None
+    l_rel = get_relation(session, join.left.collect_leaves()[0])
+    r_rel = get_relation(session, join.right.collect_leaves()[0])
+    if l_rel is None or r_rel is None:
+        return None
+
+    mapping = _column_mapping(join, pairs)
+    if mapping is None:
+        return None
+    l_cols, r_cols = mapping
+
+    l_scan = join.left.collect_leaves()[0]
+    r_scan = join.right.collect_leaves()[0]
+    l_usable = _usable_indexes(session, join.left, l_scan, l_cols, ctx,
+                               candidates_for)
+    r_usable = _usable_indexes(session, join.right, r_scan, r_cols, ctx,
+                               candidates_for)
+    if not l_usable or not r_usable:
+        return None
+
+    col_map = dict(zip(l_cols, r_cols))
+    compatible = _compatible_pairs(l_usable, r_usable, col_map)
+    if not compatible:
+        for e in l_usable + r_usable:
+            ctx.add("NO_AVAIL_JOIN_INDEX_PAIR", e,
+                    "No compatible index pair: indexed-column order does not "
+                    "match the other side's under the join-column mapping.")
+        return None
+    best = JoinIndexRanker.rank(session, l_rel, r_rel, compatible)
+    if best is None:
+        return None
+    l_entry, r_entry = best
+    for le, re_ in compatible:
+        for e in (le, re_):
+            if e is not l_entry and e is not r_entry:
+                ctx.add("ANOTHER_INDEX_APPLIED", e,
+                        f"Pair ('{l_entry.name}', '{r_entry.name}') was "
+                        "ranked higher.")
+
+    new_left = transform_plan_to_use_index(
+        session, l_entry, join.left, use_bucket_spec=True)
+    new_right = transform_plan_to_use_index(
+        session, r_entry, join.right, use_bucket_spec=True)
+    return (Join(new_left, new_right, join.condition, join.join_type),
+            (l_entry, r_entry))
 
 
 class JoinIndexRule:
     name = "JoinIndexRule"
 
-    def apply(self, session, plan: LogicalPlan) -> LogicalPlan:
+    def apply(self, session, plan: LogicalPlan,
+              ctx: Optional[ReasonCollector] = None) -> LogicalPlan:
         def rewrite(node: LogicalPlan) -> LogicalPlan:
             if isinstance(node, Join):
-                out = self._try_rewrite_join(session, node)
+                out = try_rewrite_join(session, node, ctx)
                 if out is not None:
-                    return out
+                    new_plan, (l_entry, r_entry) = out
+                    log_index_usage(session, ctx,
+                                    [l_entry.name, r_entry.name],
+                                    node.simple_string(),
+                                    "Join index applied.")
+                    return new_plan
             return node
 
         return plan.transform_up(rewrite)
-
-    def _try_rewrite_join(self, session, join: Join) -> Optional[LogicalPlan]:
-        if join.join_type != "inner":
-            return None
-        pairs = E.extract_equi_join_keys(join.condition)
-        if not pairs:
-            return None
-        if not (is_plan_linear(join.left) and is_plan_linear(join.right)):
-            return None
-        l_rel = get_relation(session, join.left.collect_leaves()[0])
-        r_rel = get_relation(session, join.right.collect_leaves()[0])
-        if l_rel is None or r_rel is None:
-            return None
-
-        mapping = self._column_mapping(join, pairs)
-        if mapping is None:
-            return None
-        l_cols, r_cols = mapping
-
-        l_scan = join.left.collect_leaves()[0]
-        r_scan = join.right.collect_leaves()[0]
-        l_usable = self._usable_indexes(session, join.left, l_scan, l_cols)
-        r_usable = self._usable_indexes(session, join.right, r_scan, r_cols)
-        if not l_usable or not r_usable:
-            return None
-
-        col_map = dict(zip(l_cols, r_cols))
-        compatible = self._compatible_pairs(l_usable, r_usable, col_map)
-        best = JoinIndexRanker.rank(session, l_rel, r_rel, compatible)
-        if best is None:
-            return None
-        l_entry, r_entry = best
-
-        new_left = transform_plan_to_use_index(
-            session, l_entry, join.left, use_bucket_spec=True)
-        new_right = transform_plan_to_use_index(
-            session, r_entry, join.right, use_bucket_spec=True)
-        get_logger(session.hs_conf.event_logger_class()).log_event(
-            HyperspaceIndexUsageEvent(
-                index_names=[l_entry.name, r_entry.name],
-                plan_string=join.simple_string(),
-                message="Join index applied."))
-        return Join(new_left, new_right, join.condition, join.join_type)
-
-    # ------------------------------------------------------------------
-
-    def _column_mapping(self, join: Join, pairs) -> Optional[Tuple[List[str], List[str]]]:
-        """Normalize pairs to (left cols, right cols); require a 1:1 mapping
-        (parity: ensureAttributeRequirements)."""
-        left_names = set(join.left.schema.names)
-        right_names = set(join.right.schema.names)
-        l_cols, r_cols = [], []
-        for a, b in pairs:
-            if a in left_names and b in right_names:
-                l_cols.append(a)
-                r_cols.append(b)
-            elif b in left_names and a in right_names:
-                l_cols.append(b)
-                r_cols.append(a)
-            else:
-                return None
-        # 1:1: no left column maps to two right columns or vice versa.
-        l_to_r: Dict[str, str] = {}
-        r_to_l: Dict[str, str] = {}
-        for l, r in zip(l_cols, r_cols):
-            if l_to_r.get(l, r) != r or r_to_l.get(r, l) != l:
-                return None
-            l_to_r[l] = r
-            r_to_l[r] = l
-        # De-dup repeated pairs while preserving order.
-        seen = set()
-        uniq_l, uniq_r = [], []
-        for l, r in zip(l_cols, r_cols):
-            if (l, r) not in seen:
-                seen.add((l, r))
-                uniq_l.append(l)
-                uniq_r.append(r)
-        return uniq_l, uniq_r
-
-    def _usable_indexes(self, session, side_plan: LogicalPlan, scan: Scan,
-                        join_cols: List[str]) -> List[IndexLogEntry]:
-        """Indexes on this side whose indexed columns are exactly the join
-        columns (any order) and which cover all referenced columns."""
-        project_cols, filter_cols = collect_filter_project_columns(side_plan)
-        referenced = set(project_cols) | set(filter_cols) | set(join_cols)
-
-        from .apply_hyperspace import active_indexes
-        out = []
-        for entry in active_indexes(session):
-            if entry.derivedDataset.kind != "CoveringIndex":
-                continue
-            if sorted(entry.indexed_columns) != sorted(join_cols):
-                continue
-            covered = set(entry.indexed_columns) | set(entry.included_columns)
-            if not referenced <= covered:
-                continue
-            out.append(entry)
-        return get_candidate_indexes(session, out, scan)
-
-    def _compatible_pairs(self, l_usable, r_usable, col_map: Dict[str, str]
-                          ) -> List[Tuple[IndexLogEntry, IndexLogEntry]]:
-        """Pairs whose indexed-column order matches under the mapping
-        (parity: getCompatibleIndexPairs/isCompatible)."""
-        out = []
-        for le in l_usable:
-            mapped = [col_map[c] for c in le.indexed_columns]
-            for re_ in r_usable:
-                if list(re_.indexed_columns) == mapped:
-                    out.append((le, re_))
-        return out

@@ -24,6 +24,20 @@ from ..schema import Schema
 from ..util import file_utils
 
 
+def log_index_usage(session, ctx, index_names: List[str], plan_string: str,
+                    message: str) -> None:
+    """Emit an index-usage telemetry event unless this is a silent
+    (diagnostic, e.g. why_not) pass — the single enforcement point of the
+    'diagnostic passes emit no telemetry' invariant."""
+    if ctx is not None and getattr(ctx, "silent", False):
+        return
+    from ..telemetry.events import HyperspaceIndexUsageEvent
+    from ..telemetry.logging import get_logger
+    get_logger(session.hs_conf.event_logger_class()).log_event(
+        HyperspaceIndexUsageEvent(index_names=index_names,
+                                  plan_string=plan_string, message=message))
+
+
 def get_relation(session, plan: LogicalPlan):
     """The single supported file-based relation leaf of a linear plan, or
     None (parity: RuleUtils.getRelation — exactly one relation required)."""
@@ -49,9 +63,11 @@ def _current_file_infos(relation) -> List[FileInfo]:
 
 
 def get_candidate_indexes(session, indexes: List[IndexLogEntry],
-                          scan: Scan) -> List[IndexLogEntry]:
+                          scan: Scan, ctx=None) -> List[IndexLogEntry]:
     """Indexes applicable to this scan. Signature equality, or — with Hybrid
-    Scan on — bounded file-overlap."""
+    Scan on — bounded file-overlap. ``ctx`` (a ReasonCollector) records why
+    stale indexes were dropped (parity: FileSignatureFilter,
+    ApplyHyperspace.scala:54-67)."""
     hybrid = session.hs_conf.hybrid_scan_enabled()
     out = []
     for entry in indexes:
@@ -61,10 +77,21 @@ def get_candidate_indexes(session, indexes: List[IndexLogEntry],
                 if entry.signature.signatures else None
             if sig is not None and recorded is not None and sig == recorded:
                 out.append(entry)
+            elif ctx is not None:
+                ctx.add("SOURCE_DATA_CHANGED", entry,
+                        "Source fingerprint mismatch (files were added, "
+                        "removed, or modified since the index was built); "
+                        "enable hybrid scan or refresh the index.")
             continue
-        ok, _, _ = hybrid_scan_file_diff(session, entry, scan.relation)
+        ok, appended, deleted = hybrid_scan_file_diff(
+            session, entry, scan.relation)
         if ok:
             out.append(entry)
+        elif ctx is not None:
+            ctx.add("SOURCE_DATA_CHANGED", entry,
+                    f"Hybrid Scan not applicable: {len(appended)} appended"
+                    f" / {len(deleted)} deleted files exceed thresholds, "
+                    "no common files, or deletes without lineage.")
     return out
 
 

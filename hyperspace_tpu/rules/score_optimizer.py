@@ -1,0 +1,183 @@
+"""Score-based index plan optimizer (next-gen rule framework, complete).
+
+Parity reference: rules/ApplyHyperspace.scala:69-101
+(ScoreBasedIndexPlanOptimizer — the reference ships it as a placeholder with
+only NoOpRule registered; here it is the fully-working version the design
+anticipates, with the disabled filter-chain rules re-enabled:
+rules/disabled/JoinIndexRule.scala:45-618 and
+rules/disabled/FilterIndexRule.scala:34-144).
+
+Each HyperspaceRule proposes a rewrite of a plan node together with a score;
+the optimizer recurses over the tree (memoized) and picks, at every node, the
+max of (best rewrite at this node) vs (sum of the children's best scores).
+Scores follow the reference's scale (disabled/FilterIndexRule.scala:166-188,
+disabled/JoinIndexRule.scala:668-698): a filter rewrite is worth
+50 × (common-bytes / relation-bytes); a join rewrite 70 per side — so a join
+rewrite (up to 140) beats filter-rewriting both sides (up to 100)."""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+from ..index.log_entry import IndexLogEntry
+from ..plan.nodes import Join, LogicalPlan, Scan
+from .index_filters import ReasonCollector
+from .rule_utils import common_source_bytes, get_relation
+
+
+def _coverage_ratio(session, entry: IndexLogEntry, relation,
+                    cache: Optional[dict] = None) -> float:
+    """Fraction of the relation's current bytes covered by the index — 1.0
+    when the source is unchanged, lower under Hybrid Scan with appends
+    (parity: commonBytes / allFileSizeInBytes in the reference's scores).
+
+    ``cache`` (one per optimizer pass) memoizes the per-(entry, relation)
+    ratio so repeated rule invocations don't re-list the relation's files."""
+    key = (entry.name, entry.log_version, id(relation))
+    if cache is not None and key in cache:
+        return cache[key]
+    total = sum(size for _, size, _ in relation.all_file_infos())
+    ratio = 1.0 if total <= 0 else \
+        min(1.0, common_source_bytes(entry, relation) / total)
+    if cache is not None:
+        cache[key] = ratio
+    return ratio
+
+
+class HyperspaceRule:
+    """A candidate-plan rewrite with a score (parity:
+    rules/HyperspaceRule.scala:27-83)."""
+
+    name = "HyperspaceRule"
+
+    def apply(self, session, plan: LogicalPlan, candidates, ctx, cache=None
+              ) -> Tuple[Optional[LogicalPlan], float]:
+        """Return (rewritten plan, score>0) or (None, 0.0) if inapplicable.
+        ``candidates`` maps id(scan) -> (scan, [indexes]) from
+        CandidateIndexCollector; ``cache`` memoizes per-relation file stats
+        for the duration of one optimizer pass."""
+        raise NotImplementedError
+
+
+class NoOpRule(HyperspaceRule):
+    """Keeps the plan as-is (parity: NoOpRule, rules/HyperspaceRule.scala:83)."""
+
+    name = "NoOpRule"
+
+    def apply(self, session, plan, candidates, ctx, cache=None):
+        return None, 0.0
+
+
+def _candidates_for(candidates):
+    def lookup(scan: Scan) -> List[IndexLogEntry]:
+        entry = candidates.get(id(scan))
+        return entry[1] if entry else []
+    return lookup
+
+
+class FilterIndexRuleNG(HyperspaceRule):
+    """Filter rewrite as a scored rule. Score: 50 × covered-bytes ratio
+    (parity: rules/disabled/FilterIndexRule.scala:124-144 FilterRankFilter)."""
+
+    name = "FilterIndexRule"
+
+    def apply(self, session, plan, candidates, ctx, cache=None):
+        from .filter_rule import try_rewrite_filter
+        result = try_rewrite_filter(session, plan, ctx,
+                                    candidates_for=_candidates_for(candidates))
+        if result is None:
+            return None, 0.0
+        new_plan, entry = result
+        scan = plan.collect_leaves()[0]
+        relation = get_relation(session, scan)
+        score = 50.0 * _coverage_ratio(session, entry, relation, cache)
+        return new_plan, score
+
+
+class JoinIndexRuleNG(HyperspaceRule):
+    """Join rewrite as a scored rule. Score: 70 × covered-bytes ratio per
+    side, summed (parity: rules/disabled/JoinIndexRule.scala:668-698)."""
+
+    name = "JoinIndexRule"
+
+    def apply(self, session, plan, candidates, ctx, cache=None):
+        if not isinstance(plan, Join):
+            return None, 0.0
+        from .join_rule import try_rewrite_join
+        result = try_rewrite_join(session, plan, ctx,
+                                  candidates_for=_candidates_for(candidates))
+        if result is None:
+            return None, 0.0
+        new_plan, (l_entry, r_entry) = result
+        l_rel = get_relation(session, plan.left.collect_leaves()[0])
+        r_rel = get_relation(session, plan.right.collect_leaves()[0])
+        score = (70.0 * _coverage_ratio(session, l_entry, l_rel, cache)
+                 + 70.0 * _coverage_ratio(session, r_entry, r_rel, cache))
+        return new_plan, score
+
+
+class ScoreBasedIndexPlanOptimizer:
+    """Recursive, memoized, score-maximizing index selection (parity:
+    ApplyHyperspace.scala:69-101)."""
+
+    def __init__(self, rules: Optional[List[HyperspaceRule]] = None):
+        self.rules = rules or [JoinIndexRuleNG(), FilterIndexRuleNG(),
+                               NoOpRule()]
+
+    def apply(self, session, plan: LogicalPlan, candidates,
+              ctx: ReasonCollector) -> LogicalPlan:
+        from .apply_hyperspace import _applied_index_names
+
+        memo: Dict[int, Tuple[LogicalPlan, float]] = {}
+        file_stats_cache: Dict = {}
+
+        def rec(node: LogicalPlan) -> Tuple[LogicalPlan, float]:
+            cached = memo.get(id(node))
+            if cached is not None:
+                return cached
+
+            # Option A: keep this node, recurse into children.
+            children = node.children
+            if children:
+                rec_children = [rec(c) for c in children]
+                base_plan = node.with_children([p for p, _ in rec_children])
+                base_score = sum(s for _, s in rec_children)
+            else:
+                base_plan, base_score = node, 0.0
+
+            # Option B: a rule rewrite rooted at this node (the rewrite
+            # consumes the whole subtree, e.g. both join sides). Usage
+            # telemetry for the winning plan is emitted by apply_hyperspace
+            # once the search is over — rewrites scored here may lose to a
+            # higher-scoring rewrite further up the tree.
+            alternatives = [(base_plan, base_score)]
+            best_plan, best_score = base_plan, base_score
+            for rule in self.rules:
+                rewritten, score = rule.apply(session, node, candidates, ctx,
+                                              file_stats_cache)
+                if rewritten is None:
+                    continue
+                alternatives.append((rewritten, score))
+                if score > best_score:
+                    best_plan, best_score = rewritten, score
+
+            # Indexes used only in out-scored alternatives get a whyNot
+            # reason — otherwise "why wasn't my filter index used" has no
+            # answer when a join rewrite won the subtree.
+            if ctx.enabled and len(alternatives) > 1:
+                winner_names = set(_applied_index_names(best_plan))
+                for alt_plan, alt_score in alternatives:
+                    if alt_plan is best_plan:
+                        continue
+                    for name in set(_applied_index_names(alt_plan)) - winner_names:
+                        ctx.add_name(
+                            "OUTSCORED", name,
+                            f"A rewrite using this index scored "
+                            f"{alt_score:.0f}, below the chosen plan's "
+                            f"{best_score:.0f}.")
+
+            memo[id(node)] = (best_plan, best_score)
+            return best_plan, best_score
+
+        final_plan, _ = rec(plan)
+        return final_plan

@@ -33,6 +33,8 @@ class Session:
         self.hs_conf = HyperspaceConf(self.conf)
         self._hyperspace_enabled = False
         self._event_logger = None
+        # whyNot reasons of the most recent hyperspace rewrite pass.
+        self._last_reason_collector = None
         from .config import CacheWithTransform
         self._provider_manager_cache = CacheWithTransform(
             self.hs_conf.file_based_source_builders, self._build_provider_manager)

@@ -1,0 +1,181 @@
+"""Next-gen rule framework tests: CandidateIndexCollector filter chain,
+whyNot reason tagging, and the score-based index plan optimizer.
+
+Parity: CandidateIndexCollectorTest / the disabled filter-chain suites
+(src/test/scala/.../index/rules/) and the FILTER_REASONS tag semantics
+(rules/IndexFilter.scala:41-52).
+"""
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+import hyperspace_tpu as hst
+from hyperspace_tpu.api import Hyperspace, IndexConfig
+from hyperspace_tpu.index.constants import IndexConstants
+from hyperspace_tpu.plan.expr import col
+from hyperspace_tpu.plan.nodes import IndexScan, Join
+from hyperspace_tpu.rules.apply_hyperspace import active_indexes
+from hyperspace_tpu.rules.index_filters import (CandidateIndexCollector,
+                                                ReasonCollector)
+
+
+def write_parquet(root, name, df, parts=2):
+    d = root / name
+    d.mkdir(parents=True, exist_ok=True)
+    step = max(1, len(df) // parts)
+    for i in range(parts):
+        chunk = df.iloc[i * step:(i + 1) * step if i < parts - 1 else len(df)]
+        pq.write_table(pa.Table.from_pandas(chunk.reset_index(drop=True)),
+                       d / f"part{i}.parquet")
+    return str(d)
+
+
+@pytest.fixture()
+def env(tmp_path):
+    rng = np.random.default_rng(7)
+    n = 1000
+    left = pd.DataFrame({
+        "k": rng.integers(0, 100, n).astype(np.int64),
+        "a": rng.integers(0, 1000, n).astype(np.int64),
+        "b": np.round(rng.uniform(0, 1, n), 3),
+    })
+    right = pd.DataFrame({
+        "k2": np.arange(100, dtype=np.int64),
+        "c": rng.integers(0, 10, 100).astype(np.int64),
+    })
+    l_path = write_parquet(tmp_path, "left", left)
+    r_path = write_parquet(tmp_path, "right", right)
+    session = hst.Session(system_path=str(tmp_path / "indexes"))
+    session.conf.set(IndexConstants.INDEX_NUM_BUCKETS, 4)
+    return dict(session=session, hs=Hyperspace(session), l_path=l_path,
+                r_path=r_path, left=left, right=right, tmp=tmp_path)
+
+
+def scans_of(plan):
+    return [l for l in plan.collect_leaves() if isinstance(l, IndexScan)]
+
+
+class TestCandidateIndexCollector:
+    def test_column_schema_filter_drops_wrong_schema(self, env):
+        session, hs = env["session"], env["hs"]
+        ldf = session.read.parquet(env["l_path"])
+        rdf = session.read.parquet(env["r_path"])
+        hs.create_index(ldf, IndexConfig("li", ["k"], ["a"]))
+        hs.create_index(rdf, IndexConfig("ri", ["k2"], ["c"]))
+
+        ctx = ReasonCollector(enabled=True)
+        out = CandidateIndexCollector.collect(
+            session, ldf.plan, active_indexes(session), ctx)
+        assert len(out) == 1
+        (_, cands), = out.values()
+        assert [e.name for e in cands] == ["li"]
+        # ri was dropped for schema mismatch, with a recorded reason.
+        assert any(r.code == "COL_SCHEMA_MISMATCH" and r.index_name == "ri"
+                   for r in ctx.reasons)
+
+    def test_file_signature_filter_drops_stale_index(self, env):
+        session, hs = env["session"], env["hs"]
+        ldf = session.read.parquet(env["l_path"])
+        hs.create_index(ldf, IndexConfig("li", ["k"], ["a"]))
+        # Append a file -> fingerprint mismatch (hybrid scan off).
+        extra = pd.DataFrame({"k": [1], "a": [2], "b": [0.5]})
+        pq.write_table(pa.Table.from_pandas(extra),
+                       env["tmp"] / "left" / "extra.parquet")
+
+        ldf2 = session.read.parquet(env["l_path"])
+        ctx = ReasonCollector(enabled=True)
+        out = CandidateIndexCollector.collect(
+            session, ldf2.plan, active_indexes(session), ctx)
+        assert not out
+        assert any(r.code == "SOURCE_DATA_CHANGED" for r in ctx.reasons)
+
+
+class TestScoreBasedOptimizer:
+    def test_filter_rewrite_applied(self, env):
+        session, hs = env["session"], env["hs"]
+        ldf = session.read.parquet(env["l_path"])
+        hs.create_index(ldf, IndexConfig("li", ["k"], ["a"]))
+        session.enable_hyperspace()
+        q = ldf.filter(col("k") == 5).select("k", "a")
+        assert scans_of(q.optimized_plan())
+        expected = env["left"].query("k == 5")[["k", "a"]]
+        got = q.to_arrow().to_pandas()
+        assert sorted(got["a"]) == sorted(expected["a"])
+
+    def test_join_preferred_over_two_filters(self, env):
+        """A join rewrite (score 140) must beat filter-rewriting each side
+        (2 x 50) when both are possible."""
+        session, hs = env["session"], env["hs"]
+        ldf = session.read.parquet(env["l_path"])
+        rdf = session.read.parquet(env["r_path"])
+        hs.create_index(ldf, IndexConfig("lj", ["k"], ["a"]))
+        hs.create_index(rdf, IndexConfig("rj", ["k2"], ["c"]))
+        session.enable_hyperspace()
+
+        q = (ldf.filter(col("k") > 10)
+             .join(rdf.filter(col("k2") > 10), on=col("k") == col("k2"))
+             .select("k", "a", "c"))
+        plan = q.optimized_plan()
+        idx_scans = scans_of(plan)
+        assert len(idx_scans) == 2
+        assert all(s.use_bucket_spec for s in idx_scans), \
+            "join rewrite (bucketed) should win over per-side filter rewrites"
+
+        # Disable-and-compare oracle.
+        got = q.to_arrow().to_pandas().sort_values(["k", "a", "c"]
+                                                   ).reset_index(drop=True)
+        session.disable_hyperspace()
+        want = q.to_arrow().to_pandas().sort_values(["k", "a", "c"]
+                                                    ).reset_index(drop=True)
+        pd.testing.assert_frame_equal(got, want)
+
+    def test_score_based_matches_legacy(self, env):
+        session, hs = env["session"], env["hs"]
+        ldf = session.read.parquet(env["l_path"])
+        hs.create_index(ldf, IndexConfig("li", ["k"], ["a", "b"]))
+        session.enable_hyperspace()
+        q = ldf.filter(col("k") < 20).select("k", "b")
+
+        ng = q.optimized_plan().tree_string()
+        session.conf.set(IndexConstants.SCORE_BASED_OPTIMIZER_ENABLED, "false")
+        legacy = q.optimized_plan().tree_string()
+        assert ng == legacy
+
+
+class TestWhyNot:
+    def test_why_not_reports_reasons(self, env):
+        session, hs = env["session"], env["hs"]
+        ldf = session.read.parquet(env["l_path"])
+        hs.create_index(ldf, IndexConfig("li", ["k"], ["a"]))
+
+        # Query filters on a non-first-indexed column -> not applied.
+        q = ldf.filter(col("a") == 3).select("k", "a")
+        text = hs.why_not(q)
+        assert "NO_FIRST_INDEXED_COL_COND" in text
+        assert "li" in text
+
+        # Query the index does not cover -> missing-column reason.
+        q2 = ldf.filter(col("k") == 3).select("k", "b")
+        text2 = hs.why_not(q2, index_name="li")
+        assert "MISSING_REQUIRED_COL" in text2
+
+        # An applied query reports the application.
+        q3 = ldf.filter(col("k") == 3).select("k", "a")
+        assert "Applied indexes: li" in hs.why_not(q3)
+
+    def test_reason_collection_off_by_default(self, env):
+        session, hs = env["session"], env["hs"]
+        ldf = session.read.parquet(env["l_path"])
+        hs.create_index(ldf, IndexConfig("li", ["k"], ["a"]))
+        session.enable_hyperspace()
+        q = ldf.filter(col("a") == 3).select("k", "a")
+        q.optimized_plan()
+        ctx = session._last_reason_collector
+        assert ctx is not None and not ctx.reasons  # off by default
+
+        session.conf.set(IndexConstants.INDEX_FILTER_REASON_ENABLED, "true")
+        q.optimized_plan()
+        assert session._last_reason_collector.reasons
