@@ -204,6 +204,11 @@ class Table:
 
     @staticmethod
     def from_arrow(table: pa.Table) -> "Table":
+        # Struct columns are flattened into dotted leaf names ("a.b.c") so
+        # only fixed-width flat arrays reach the device (see
+        # Schema.from_arrow).
+        while any(pa.types.is_struct(f.type) for f in table.schema):
+            table = table.flatten()
         cols: Dict[str, Column] = {}
         for name in table.column_names:
             cols[name] = _encode_arrow_column(table.column(name))
@@ -306,8 +311,26 @@ def read_parquet(files: Sequence[str], columns: Optional[Sequence[str]] = None,
     if not files:
         raise HyperspaceException("read_parquet: no files")
     if fmt == "parquet":
-        at = pq.read_table(list(files), columns=list(columns) if columns else None,
-                           filters=filters)
+        read_cols = list(columns) if columns else None
+        flatten_select = None
+        if columns:
+            top_level = set(pq.read_schema(files[0]).names)
+            if any(c not in top_level for c in columns):
+                # Dotted struct leaves: read each leaf's root struct column,
+                # flatten after read, then select the exact leaves (pyarrow's
+                # columns= would select nested leaves but rename them to the
+                # leaf's own name, losing the dotted path).
+                roots = []
+                for c in columns:
+                    root = c if c in top_level else c.split(".", 1)[0]
+                    if root not in roots:
+                        roots.append(root)
+                read_cols, flatten_select = roots, list(columns)
+        at = pq.read_table(list(files), columns=read_cols, filters=filters)
+        if flatten_select is not None:
+            while any(pa.types.is_struct(f.type) for f in at.schema):
+                at = at.flatten()
+            at = at.select(flatten_select)
     elif fmt == "csv":
         import pyarrow.csv as pa_csv
         tables = [pa_csv.read_csv(f) for f in files]

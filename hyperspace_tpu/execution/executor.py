@@ -42,8 +42,12 @@ def _execute(plan: LogicalPlan, needed: Optional[Set[str]]) -> Table:
         child_needed = None if needed is None else \
             needed | set(plan.condition.references)
         if isinstance(plan.child, (Scan, IndexScan)):
-            # Push row-group-prunable conjuncts into the parquet read.
-            pa_filter = pushable_filter(plan.condition, plan.child.schema)
+            # Push row-group-prunable conjuncts into the parquet read. A
+            # source scan's struct leaves aren't physical columns, so dotted
+            # names can't be pushed there (index files store them flat).
+            pa_filter = pushable_filter(
+                plan.condition, plan.child.schema,
+                allow_nested=isinstance(plan.child, IndexScan))
             if isinstance(plan.child, Scan):
                 table = _execute_scan(plan.child, child_needed, pa_filter)
             else:

@@ -49,7 +49,16 @@ def _literal(value, column: str, schema: Schema):
     return value
 
 
-def _translate(e: E.Expr, schema: Schema):
+def _translate(e: E.Expr, schema: Schema, allow_nested: bool):
+    def field(column: str):
+        # A dotted name is a flattened struct leaf; in source files the
+        # physical column is the root struct, and pc.field("a.b") raises
+        # "No match for FieldRef" against it. Index files store leaves as
+        # flat dotted-named columns, so there the reference is valid.
+        if "." in column and not allow_nested:
+            return None
+        return pc.field(column)
+
     if isinstance(e, _CMP_TYPES):
         op = type(e).__name__
         left, right = e.left, e.right
@@ -57,32 +66,41 @@ def _translate(e: E.Expr, schema: Schema):
             left, right = right, left
             op = _FLIP[op]
         if isinstance(left, E.Col) and isinstance(right, E.Lit):
-            return _OPS[op](pc.field(left.column),
-                            _literal(right.value, left.column, schema))
+            f = field(left.column)
+            if f is None:
+                return None
+            return _OPS[op](f, _literal(right.value, left.column, schema))
         return None
     if isinstance(e, E.In) and isinstance(e.value, E.Col):
         values = [_literal(o.value, e.value.column, schema)
                   for o in e.options if isinstance(o, E.Lit)]
         if len(values) == len(e.options):
-            return pc.field(e.value.column).isin(values)
+            f = field(e.value.column)
+            if f is None:
+                return None
+            return f.isin(values)
         return None
     if isinstance(e, E.Or):
-        l, r = _translate(e.left, schema), _translate(e.right, schema)
+        l = _translate(e.left, schema, allow_nested)
+        r = _translate(e.right, schema, allow_nested)
         if l is not None and r is not None:
             return l | r
         return None
     return None
 
 
-def pushable_filter(condition: E.Expr, schema: Schema) -> Optional[pc.Expression]:
+def pushable_filter(condition: E.Expr, schema: Schema,
+                    allow_nested: bool = True) -> Optional[pc.Expression]:
     """AND of the translatable conjuncts, or None.
 
     Pushing a subset of conjuncts is sound: each is a necessary condition,
-    and the full device filter still runs afterward.
+    and the full device filter still runs afterward. ``allow_nested=False``
+    excludes dotted (struct-leaf) columns — required for source scans, where
+    the physical parquet column is the root struct.
     """
     out = None
     for conjunct in E.split_conjunctive_predicates(condition):
-        t = _translate(conjunct, schema)
+        t = _translate(conjunct, schema, allow_nested)
         if t is not None:
             out = t if out is None else (out & t)
     return out

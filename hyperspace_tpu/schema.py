@@ -109,9 +109,21 @@ class Schema:
 
     @staticmethod
     def from_arrow(arrow_schema: pa.Schema) -> "Schema":
+        """Struct fields are flattened recursively into dotted leaf names
+        (``a.b.c``) — nested data never reaches the device as structs; each
+        leaf is an independent flat column (parity with the reference's
+        nested-field flattening, util/ResolverUtils.scala:112-162, minus its
+        ``__hs_nested.`` storage prefix, which Spark needed only because
+        Catalyst attribute names cannot contain dots)."""
         fields = []
-        for f in arrow_schema:
+
+        def add(prefix: str, f) -> None:
             t = f.type
+            name = f"{prefix}{f.name}"
+            if pa.types.is_struct(t):
+                for sub in t:
+                    add(f"{name}.", sub)
+                return
             if pa.types.is_dictionary(t):
                 t = t.value_type
             if pa.types.is_decimal(t):
@@ -121,6 +133,9 @@ class Schema:
             elif t in _ARROW_TO_LOGICAL:
                 logical = _ARROW_TO_LOGICAL[t]
             else:
-                raise ValueError(f"Unsupported arrow type for field {f.name}: {t}")
-            fields.append(Field(f.name, logical, f.nullable))
+                raise ValueError(f"Unsupported arrow type for field {name}: {t}")
+            fields.append(Field(name, logical, f.nullable))
+
+        for f in arrow_schema:
+            add("", f)
         return Schema(fields)

@@ -1,10 +1,13 @@
 """Column-name resolution (parity: util/ResolverUtils.scala:44-162).
 
 Resolves user-provided column names against a schema case-insensitively (or
-sensitively, per conf). Nested-field flattening (``a.b.c`` →
-``__hs_nested.a.b.c``) is part of the reference contract; our engine's
-schemas are flat, so the prefix constant exists but nested inputs are
-rejected explicitly rather than mis-resolved.
+sensitively, per conf). Nested fields are supported natively: schemas flatten
+struct leaves into dotted names at the IO boundary (schema.Schema.from_arrow),
+so ``a.b.c`` resolves like any flat name. The reference instead rewrites
+nested fields to prefixed flat columns (``__hs_nested.a.b.c``,
+util/ResolverUtils.scala:112-162) because Catalyst attribute names cannot
+contain dots — a constraint our engine does not have; the prefix constant is
+kept for readers of the reference's on-disk metadata.
 """
 
 from __future__ import annotations
@@ -16,13 +19,14 @@ from ..exceptions import HyperspaceException
 NESTED_FIELD_PREFIX = "__hs_nested."
 
 
+def is_nested(name: str) -> bool:
+    """A dotted name denotes a flattened struct leaf."""
+    return "." in name
+
+
 def resolve(available: Sequence[str], requested: str,
             case_sensitive: bool = False) -> Optional[str]:
     """Resolve one name; returns the schema's spelling or None."""
-    if "." in requested:
-        raise HyperspaceException(
-            f"Nested column '{requested}' is not supported yet "
-            f"(flat schemas only; reserved prefix {NESTED_FIELD_PREFIX!r})")
     if case_sensitive:
         return requested if requested in available else None
     matches = [a for a in available if a.lower() == requested.lower()]
