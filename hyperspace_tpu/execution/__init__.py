@@ -6,9 +6,27 @@ float64 for parity with CPU engines. XLA lowers 64-bit ops on TPU; narrow
 dtypes are used wherever the data allows (see columnar.py int32 narrowing).
 """
 
+import os
+
 import jax
 
 jax.config.update("jax_enable_x64", True)
+
+# Persistent XLA compilation cache: index-build and scan programs are
+# recompiled per (kernel, shape) otherwise — on TPU a cold compile is tens of
+# seconds, so caching across processes is what makes repeated builds/queries
+# (and repeated bench runs) cheap. Opt out with HST_XLA_CACHE=off.
+if os.environ.get("HST_XLA_CACHE", "on") != "off":
+    try:
+        _cache_dir = os.environ.get(
+            "HST_XLA_CACHE_DIR",
+            os.path.join(os.path.expanduser("~"), ".cache", "hyperspace_tpu",
+                         "xla"))
+        os.makedirs(_cache_dir, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", _cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    except Exception:
+        pass  # older jax without these knobs: in-process cache only.
 
 from .columnar import Column, Table, read_parquet, write_parquet  # noqa: F401,E402
 from .executor import execute  # noqa: F401,E402

@@ -44,6 +44,10 @@ def eval_expr(table: Table, e: E.Expr) -> Column:
     if isinstance(e, _COMPARISONS):
         return _eval_comparison(table, e)
     if isinstance(e, (E.And, E.Or)):
+        if isinstance(e, E.And):
+            fused = _try_fused_range(table, e)
+            if fused is not None:
+                return fused
         left = eval_expr(table, e.left)
         right = eval_expr(table, e.right)
         # Kleene 3-valued logic: TRUE OR NULL = TRUE, FALSE AND NULL = FALSE.
@@ -69,6 +73,41 @@ def eval_expr(table: Table, e: E.Expr) -> Column:
     if isinstance(e, (E.Add, E.Subtract, E.Multiply, E.Divide)):
         return _eval_arith(table, e)
     raise HyperspaceException(f"Cannot evaluate expression: {e!r}")
+
+
+_RANGE_LO = (E.GreaterThan, E.GreaterThanOrEqual)
+_RANGE_HI = (E.LessThan, E.LessThanOrEqual)
+
+
+def _try_fused_range(table: Table, e: "E.And") -> Optional[Column]:
+    """BETWEEN fast path: And(col >(=) lo, col <(=) hi) over one 32-bit
+    column evaluates as a single fused Pallas range kernel on TPU (one HBM
+    pass instead of two compare passes + an AND)."""
+    from ..ops import pallas_kernels
+
+    if not pallas_kernels.enabled():
+        return None
+    lo_cmp, hi_cmp = e.left, e.right
+    if isinstance(lo_cmp, _RANGE_HI) and isinstance(hi_cmp, _RANGE_LO):
+        lo_cmp, hi_cmp = hi_cmp, lo_cmp
+    if not (isinstance(lo_cmp, _RANGE_LO) and isinstance(hi_cmp, _RANGE_HI)):
+        return None
+    if not (isinstance(lo_cmp.left, E.Col) and isinstance(hi_cmp.left, E.Col)
+            and isinstance(lo_cmp.right, E.Lit)
+            and isinstance(hi_cmp.right, E.Lit)
+            and lo_cmp.left.column == hi_cmp.left.column):
+        return None
+    col = table.column(lo_cmp.left.column)
+    if col.dtype == STRING or col.data.shape[0] == 0 \
+            or col.data.dtype not in (jnp.int32, jnp.float32, jnp.uint32):
+        return None
+    lo = literal_to_device(lo_cmp.right.value, col.dtype, None)
+    hi = literal_to_device(hi_cmp.right.value, col.dtype, None)
+    mask = pallas_kernels.fused_range_mask(
+        col.data, lo, hi,
+        lo_incl=isinstance(lo_cmp, E.GreaterThanOrEqual),
+        hi_incl=isinstance(hi_cmp, E.LessThanOrEqual))
+    return Column(BOOL, mask, col.validity)
 
 
 def _merge_validity(a, b):
@@ -142,6 +181,13 @@ def compare_literal(col: Column, op: str, value) -> jnp.ndarray:
         raise HyperspaceException(f"Unknown op {op}")
     lit = literal_to_device(value, col.dtype, None)
     data = col.data
+    # 32-bit lanes: one-pass fused Pallas compare on TPU.
+    from ..ops import pallas_kernels
+    if (pallas_kernels.enabled() and data.shape[0] > 0
+            and data.dtype in (jnp.int32, jnp.float32, jnp.uint32)):
+        sym = {"EqualTo": "==", "LessThan": "<", "LessThanOrEqual": "<=",
+               "GreaterThan": ">", "GreaterThanOrEqual": ">="}[op]
+        return pallas_kernels.fused_compare_mask(data, sym, lit)
     return {
         "EqualTo": lambda: data == lit,
         "LessThan": lambda: data < lit,

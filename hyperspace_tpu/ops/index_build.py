@@ -29,7 +29,21 @@ from . import kernels
 def bucket_ids_for(table: Table, indexed_cols: Sequence[str],
                    num_buckets: int) -> jax.Array:
     """Bucket id per row: combined value-stable hash of the indexed columns
-    modulo num_buckets (parity with the repartition-by-key semantics)."""
+    modulo num_buckets (parity with the repartition-by-key semantics).
+
+    On TPU the fold→avalanche→combine→mod chain runs as one fused Pallas
+    kernel (single HBM pass over all indexed columns); the jnp fallback is
+    semantically identical.
+    """
+    from . import pallas_kernels
+
+    if pallas_kernels.enabled():
+        folded = []
+        for name in indexed_cols:
+            col = table.column(name)
+            folded.append(kernels.fold_u32(col.data, col.dtype, col.dictionary))
+        _, bids = pallas_kernels.fused_hash_bucket(folded, num_buckets)
+        return bids
     h = None
     for name in indexed_cols:
         col = table.column(name)
@@ -47,13 +61,22 @@ def build_sorted_buckets(table: Table, indexed_cols: Sequence[str],
     the invariant the shuffle-free merge join and bucket-pruned filter scan
     rely on.
     """
+    from . import pallas_kernels
+
     bids = bucket_ids_for(table, indexed_cols, num_buckets)
     sort_keys = [bids] + [table.column(c).data for c in indexed_cols]
     perm = kernels.lex_sort_indices(sort_keys)
     sorted_table = table.take(perm)
-    sorted_bids = jnp.take(bids, perm)
-    boundaries = jnp.searchsorted(
-        sorted_bids, jnp.arange(num_buckets + 1, dtype=sorted_bids.dtype))
+    if pallas_kernels.enabled():
+        # Boundary offsets from the per-bucket histogram (one pass over the
+        # unsorted bids) instead of a searchsorted over the sorted copy.
+        counts = pallas_kernels.bucket_histogram(bids, num_buckets)
+        boundaries = jnp.concatenate(
+            [jnp.zeros(1, counts.dtype), jnp.cumsum(counts)])
+    else:
+        sorted_bids = jnp.take(bids, perm)
+        boundaries = jnp.searchsorted(
+            sorted_bids, jnp.arange(num_buckets + 1, dtype=sorted_bids.dtype))
     return sorted_table, np.asarray(jax.device_get(boundaries))
 
 

@@ -41,14 +41,14 @@ def _fmix32(x: jax.Array) -> jax.Array:
     return x
 
 
-def hash32_values(data: jax.Array, dtype: str,
-                  dictionary: Optional[np.ndarray] = None) -> jax.Array:
-    """Stable 32-bit hash of a column's *values* (not its encoding).
+def fold_u32(data: jax.Array, dtype: str,
+             dictionary: Optional[np.ndarray] = None) -> jax.Array:
+    """Value-stable fold of a column into pre-avalanche uint32 words.
 
-    For strings the hash is computed from the dictionary entries' bytes on
-    host (crc32) and gathered by code on device — so two tables with
-    different dictionaries hash equal strings equally, which is what makes
-    bucket co-partitioning work across index/source/appended data.
+    This is the 64→32-bit (and string→crc) part of hash32_values, split out
+    so the Pallas fused hash+bucket kernel (pallas_kernels.fused_hash_bucket)
+    can consume the same fold and produce bit-identical hashes: the kernel
+    applies the murmur finalizer to exactly these words.
     """
     if dtype == STRING:
         if dictionary is None:
@@ -58,24 +58,34 @@ def hash32_values(data: jax.Array, dtype: str,
             if len(dictionary) else np.zeros(1, np.uint32)
         table = jnp.asarray(host_hashes)
         codes = jnp.clip(data, 0, max(len(dictionary) - 1, 0))
-        return _fmix32(jnp.take(table, codes))
-    if dtype in (INT32, DATE):
-        return _fmix32(data.astype(jnp.uint32))
+        return jnp.take(table, codes)
+    if dtype in (INT32, DATE, BOOL):
+        return data.astype(jnp.uint32)
     if dtype == INT64:
         u = data.astype(jnp.uint64)
         lo = (u & np.uint64(0xFFFFFFFF)).astype(jnp.uint32)
         hi = (u >> np.uint64(32)).astype(jnp.uint32)
-        return _fmix32(lo ^ (hi * np.uint32(0x9E3779B9)))
-    if dtype == BOOL:
-        return _fmix32(data.astype(jnp.uint32))
+        return lo ^ (hi * np.uint32(0x9E3779B9))
     if dtype == FLOAT32:
-        return _fmix32(jax.lax.bitcast_convert_type(data, jnp.uint32))
+        return jax.lax.bitcast_convert_type(data, jnp.uint32)
     if dtype == FLOAT64:
         bits = jax.lax.bitcast_convert_type(data, jnp.uint64)
         lo = (bits & np.uint64(0xFFFFFFFF)).astype(jnp.uint32)
         hi = (bits >> np.uint64(32)).astype(jnp.uint32)
-        return _fmix32(lo ^ (hi * np.uint32(0x9E3779B9)))
+        return lo ^ (hi * np.uint32(0x9E3779B9))
     raise HyperspaceException(f"Cannot hash dtype {dtype}")
+
+
+def hash32_values(data: jax.Array, dtype: str,
+                  dictionary: Optional[np.ndarray] = None) -> jax.Array:
+    """Stable 32-bit hash of a column's *values* (not its encoding).
+
+    For strings the hash is computed from the dictionary entries' bytes on
+    host (crc32) and gathered by code on device — so two tables with
+    different dictionaries hash equal strings equally, which is what makes
+    bucket co-partitioning work across index/source/appended data.
+    """
+    return _fmix32(fold_u32(data, dtype, dictionary))
 
 
 def _fmix32_host(x: int) -> int:

@@ -1,0 +1,412 @@
+"""Pallas TPU kernels for the hot single-pass ops.
+
+These fuse the per-row work of the index-build and scan paths into single
+HBM-read kernels, where the pure-jnp formulations would each materialize
+intermediates (per-column hashes, combined hash, compare masks) in HBM:
+
+- ``fused_hash_bucket``: murmur-finalizer avalanche of every indexed column's
+  pre-folded u32 words + boost-combine across columns + mod num_buckets, one
+  pass. TPU-native core of the reference's ``repartition(numBuckets, cols)``
+  (actions/CreateActionBase.scala:118-121).
+- ``fused_compare_mask`` / ``fused_range_mask``: predicate evaluation for
+  filter scans — one read of the column, no intermediate compare results.
+- ``masked_minmax``: MinMax sketch build (data-skipping) in one reduction
+  pass with a validity mask.
+- ``bucket_histogram``: per-bucket row counts, used for the bucket boundary
+  offsets of the sorted index build.
+
+All kernels operate on 32-bit lanes (int32/uint32/float32); 64-bit columns
+are folded to u32 words *outside* the kernel (see kernels.fold_u32) — TPU
+VPUs are 32-bit-lane machines and the fold is where 64-bit semantics live.
+On non-TPU backends the kernels run in interpret mode (tests) or the caller
+falls back to the pure-jnp path (default on CPU: interpret mode is slow).
+"""
+
+from __future__ import annotations
+
+import os
+from functools import partial
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_LANES = 128
+_BLK_ROWS = 256          # (256, 128) i32 block = 128 KiB in VMEM.
+_HIST_BLK_ROWS = 32      # histogram materializes a (rows*128, nb) one-hot.
+
+_M32 = np.uint32(0xFFFFFFFF)
+
+# Index-map constants must stay i32: under jax_enable_x64 a bare Python 0 is
+# traced as i64, which Mosaic cannot legalize in block index maps.
+_Z = np.int32(0)
+
+# ---------------------------------------------------------------------------
+# Enablement. "auto" → real kernels on TPU, pure-jnp fallback elsewhere;
+# "on" → also on CPU via interpret mode (tests); "off" → never.
+# ---------------------------------------------------------------------------
+
+_mode: Optional[str] = None
+
+
+def set_mode(mode: str) -> None:
+    """'auto' | 'on' | 'off' (overrides env HST_PALLAS)."""
+    global _mode
+    if mode not in ("auto", "on", "off"):
+        raise ValueError(f"bad pallas mode {mode!r}")
+    _mode = mode
+
+
+def _get_mode() -> str:
+    return _mode if _mode is not None else os.environ.get("HST_PALLAS", "auto")
+
+
+def _on_tpu() -> bool:
+    try:
+        return jax.default_backend() == "tpu"
+    except Exception:
+        return False
+
+
+def enabled() -> bool:
+    mode = _get_mode()
+    if mode == "off":
+        return False
+    if mode == "on":
+        return True
+    return _on_tpu()
+
+
+def _interpret() -> bool:
+    return not _on_tpu()
+
+
+# ---------------------------------------------------------------------------
+# Shape plumbing: 1-D column -> padded (rows, 128) tiles and back.
+# ---------------------------------------------------------------------------
+
+def _pad_2d(x: jax.Array, blk_rows: int, fill) -> Tuple[jax.Array, int]:
+    """Pad a 1-D array to a multiple of blk_rows*128 and reshape to
+    (rows, 128). Returns (tiles, original length)."""
+    n = x.shape[0]
+    chunk = blk_rows * _LANES
+    padded = max(((n + chunk - 1) // chunk) * chunk, chunk)
+    if padded != n:
+        x = jnp.concatenate(
+            [x, jnp.full(padded - n, fill, dtype=x.dtype)])
+    return x.reshape(-1, _LANES), n
+
+
+def _unpad(tiles: jax.Array, n: int) -> jax.Array:
+    return tiles.reshape(-1)[:n]
+
+
+# ---------------------------------------------------------------------------
+# fused hash + bucket id.
+# ---------------------------------------------------------------------------
+
+def _fmix32(x):
+    x = x ^ (x >> 16)
+    x = x * np.uint32(0x85EBCA6B)
+    x = x ^ (x >> 13)
+    x = x * np.uint32(0xC2B2AE35)
+    x = x ^ (x >> 16)
+    return x
+
+
+def _hash_bucket_kernel(*refs, ncols: int, num_buckets: int):
+    word_refs, hash_ref, bid_ref = refs[:ncols], refs[ncols], refs[ncols + 1]
+    h = _fmix32(word_refs[0][:])
+    for c in range(1, ncols):
+        hc = _fmix32(word_refs[c][:])
+        # boost hash_combine (kernels.hash_combine semantics).
+        h = h ^ (hc + np.uint32(0x9E3779B9) + (h << 6) + (h >> 2))
+    hash_ref[:] = h
+    bid_ref[:] = (h % np.uint32(num_buckets)).astype(jnp.int32)
+
+
+def fused_hash_bucket(folded: Sequence[jax.Array], num_buckets: int
+                      ) -> Tuple[jax.Array, jax.Array]:
+    """One-pass (combined hash, bucket id) from pre-folded u32 columns.
+
+    ``folded[c]`` is column c's value-stable u32 fold (kernels.fold_u32);
+    results match kernels.hash32_values + hash_combine + bucket_ids exactly.
+    Each column is its own input ref (no stacked copy in HBM).
+    """
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    ncols = len(folded)
+    n = folded[0].shape[0]
+    tiles = [_pad_2d(f.astype(jnp.uint32), _BLK_ROWS, 0)[0] for f in folded]
+    rows = tiles[0].shape[0]
+    grid = (rows // _BLK_ROWS,)
+
+    hashes, bids = pl.pallas_call(
+        partial(_hash_bucket_kernel, ncols=ncols, num_buckets=num_buckets),
+        grid=grid,
+        in_specs=[pl.BlockSpec((_BLK_ROWS, _LANES), lambda i: (i, _Z),
+                               memory_space=pltpu.VMEM)] * ncols,
+        out_specs=[
+            pl.BlockSpec((_BLK_ROWS, _LANES), lambda i: (i, _Z),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((_BLK_ROWS, _LANES), lambda i: (i, _Z),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((rows, _LANES), jnp.uint32),
+            jax.ShapeDtypeStruct((rows, _LANES), jnp.int32),
+        ],
+        interpret=_interpret(),
+    )(*tiles)
+    return _unpad(hashes, n), _unpad(bids, n)
+
+
+# ---------------------------------------------------------------------------
+# fused predicate masks.
+# ---------------------------------------------------------------------------
+
+_OPS = ("==", "!=", "<", "<=", ">", ">=")
+
+
+def _compare_kernel(x_ref, lit_ref, out_ref, *, op: str):
+    x = x_ref[:]
+    v = lit_ref[0, 0]
+    if op == "==":
+        m = x == v
+    elif op == "!=":
+        m = x != v
+    elif op == "<":
+        m = x < v
+    elif op == "<=":
+        m = x <= v
+    elif op == ">":
+        m = x > v
+    else:
+        m = x >= v
+    out_ref[:] = m
+
+
+def fused_compare_mask(x: jax.Array, op: str, value) -> jax.Array:
+    """Elementwise ``x <op> value`` mask in one pass (32-bit dtypes)."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    if op not in _OPS:
+        raise ValueError(f"bad op {op!r}")
+    tiles, n = _pad_2d(x, _BLK_ROWS, 0)
+    rows = tiles.shape[0]
+    lit = jnp.array([[value]], dtype=x.dtype)
+    out = pl.pallas_call(
+        partial(_compare_kernel, op=op),
+        grid=(rows // _BLK_ROWS,),
+        in_specs=[
+            pl.BlockSpec((_BLK_ROWS, _LANES), lambda i: (i, _Z),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1), lambda i: (_Z, _Z),
+                         memory_space=pltpu.SMEM),
+        ],
+        out_specs=pl.BlockSpec((_BLK_ROWS, _LANES), lambda i: (i, _Z),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((rows, _LANES), jnp.bool_),
+        interpret=_interpret(),
+    )(tiles, lit)
+    return _unpad(out, n)
+
+
+def _range_kernel(x_ref, lo_ref, hi_ref, out_ref, *, lo_incl: bool,
+                  hi_incl: bool):
+    x = x_ref[:]
+    lo, hi = lo_ref[0, 0], hi_ref[0, 0]
+    ml = (x >= lo) if lo_incl else (x > lo)
+    mh = (x <= hi) if hi_incl else (x < hi)
+    out_ref[:] = ml & mh
+
+
+def fused_range_mask(x: jax.Array, lo, hi, lo_incl: bool = True,
+                     hi_incl: bool = True) -> jax.Array:
+    """``lo <(=) x <(=) hi`` in one pass — the BETWEEN hot path."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    tiles, n = _pad_2d(x, _BLK_ROWS, 0)
+    rows = tiles.shape[0]
+    lo_a = jnp.array([[lo]], dtype=x.dtype)
+    hi_a = jnp.array([[hi]], dtype=x.dtype)
+    out = pl.pallas_call(
+        partial(_range_kernel, lo_incl=lo_incl, hi_incl=hi_incl),
+        grid=(rows // _BLK_ROWS,),
+        in_specs=[
+            pl.BlockSpec((_BLK_ROWS, _LANES), lambda i: (i, _Z),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1), lambda i: (_Z, _Z), memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, 1), lambda i: (_Z, _Z), memory_space=pltpu.SMEM),
+        ],
+        out_specs=pl.BlockSpec((_BLK_ROWS, _LANES), lambda i: (i, _Z),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((rows, _LANES), jnp.bool_),
+        interpret=_interpret(),
+    )(tiles, lo_a, hi_a)
+    return _unpad(out, n)
+
+
+# ---------------------------------------------------------------------------
+# masked min/max reduction (MinMax sketch build).
+# ---------------------------------------------------------------------------
+
+def _minmax_kernel(x_ref, valid_ref, min_ref, max_ref, *, lo_sent, hi_sent):
+    import jax.experimental.pallas as pl
+
+    step = pl.program_id(0)
+    x = x_ref[:]
+    v = valid_ref[:]
+    blk_min = jnp.min(jnp.where(v, x, hi_sent))
+    blk_max = jnp.max(jnp.where(v, x, lo_sent))
+
+    @pl.when(step == 0)
+    def _():
+        min_ref[0, 0] = blk_min
+        max_ref[0, 0] = blk_max
+
+    @pl.when(step != 0)
+    def _():
+        min_ref[0, 0] = jnp.minimum(min_ref[0, 0], blk_min)
+        max_ref[0, 0] = jnp.maximum(max_ref[0, 0], blk_max)
+
+
+def _minmax_nomask_kernel(x_ref, n_ref, min_ref, max_ref, *, lo_sent,
+                          hi_sent):
+    import jax.experimental.pallas as pl
+
+    step = pl.program_id(0)
+    x = x_ref[:]
+    # Validity derived in-kernel from the global lane index (no mask array
+    # streamed from HBM): only the padded tail is invalid.
+    base = step * np.int32(_BLK_ROWS * _LANES)
+    ridx = jax.lax.broadcasted_iota(jnp.int32, (_BLK_ROWS, _LANES), 0)
+    lidx = jax.lax.broadcasted_iota(jnp.int32, (_BLK_ROWS, _LANES), 1)
+    v = (base + ridx * np.int32(_LANES) + lidx) < n_ref[0, 0]
+    blk_min = jnp.min(jnp.where(v, x, hi_sent))
+    blk_max = jnp.max(jnp.where(v, x, lo_sent))
+
+    @pl.when(step == 0)
+    def _():
+        min_ref[0, 0] = blk_min
+        max_ref[0, 0] = blk_max
+
+    @pl.when(step != 0)
+    def _():
+        min_ref[0, 0] = jnp.minimum(min_ref[0, 0], blk_min)
+        max_ref[0, 0] = jnp.maximum(max_ref[0, 0], blk_max)
+
+
+def masked_minmax(x: jax.Array, valid: Optional[jax.Array] = None
+                  ) -> Tuple[jax.Array, jax.Array]:
+    """(min, max) over valid lanes in one pass. Returns device scalars;
+    all-invalid input yields (dtype max, dtype min) sentinels.
+
+    With ``valid=None`` (no nulls — the common sketch-build case) no mask
+    array is streamed: tail validity is computed from lane indices in-kernel.
+    """
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    if jnp.issubdtype(x.dtype, jnp.floating):
+        info = jnp.finfo(x.dtype)
+    else:
+        info = jnp.iinfo(x.dtype)
+    lo_sent = np.asarray(info.min, dtype=x.dtype)
+    hi_sent = np.asarray(info.max, dtype=x.dtype)
+
+    n = x.shape[0]
+    tiles, _ = _pad_2d(x, _BLK_ROWS, hi_sent)
+    rows = tiles.shape[0]
+    scalar_out = [
+        pl.BlockSpec((1, 1), lambda i: (_Z, _Z), memory_space=pltpu.SMEM),
+        pl.BlockSpec((1, 1), lambda i: (_Z, _Z), memory_space=pltpu.SMEM),
+    ]
+    out_shape = [
+        jax.ShapeDtypeStruct((1, 1), x.dtype),
+        jax.ShapeDtypeStruct((1, 1), x.dtype),
+    ]
+    if valid is None:
+        mn, mx = pl.pallas_call(
+            partial(_minmax_nomask_kernel, lo_sent=lo_sent, hi_sent=hi_sent),
+            grid=(rows // _BLK_ROWS,),
+            in_specs=[
+                pl.BlockSpec((_BLK_ROWS, _LANES), lambda i: (i, _Z),
+                             memory_space=pltpu.VMEM),
+                pl.BlockSpec((1, 1), lambda i: (_Z, _Z),
+                             memory_space=pltpu.SMEM),
+            ],
+            out_specs=scalar_out,
+            out_shape=out_shape,
+            interpret=_interpret(),
+        )(tiles, jnp.array([[n]], dtype=jnp.int32))
+        return mn[0, 0], mx[0, 0]
+    vtiles, _ = _pad_2d(valid, _BLK_ROWS, False)
+    mn, mx = pl.pallas_call(
+        partial(_minmax_kernel, lo_sent=lo_sent, hi_sent=hi_sent),
+        grid=(rows // _BLK_ROWS,),
+        in_specs=[
+            pl.BlockSpec((_BLK_ROWS, _LANES), lambda i: (i, _Z),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((_BLK_ROWS, _LANES), lambda i: (i, _Z),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=scalar_out,
+        out_shape=out_shape,
+        interpret=_interpret(),
+    )(tiles, vtiles)
+    return mn[0, 0], mx[0, 0]
+
+
+# ---------------------------------------------------------------------------
+# bucket histogram (radix-partition planning).
+# ---------------------------------------------------------------------------
+
+def _hist_kernel(bid_ref, out_ref, *, num_buckets: int):
+    import jax.experimental.pallas as pl
+
+    step = pl.program_id(0)
+    bids = bid_ref[:]
+
+    # At step 0 the output block is uninitialized; multiply the previous
+    # value by 0 instead of branching (lax.cond over ref reads recurses in
+    # the Mosaic lowering).
+    keep = jnp.where(step == 0, jnp.int32(0), jnp.int32(1))
+    one = jnp.ones(bids.shape, jnp.float32)
+    zero = jnp.zeros(bids.shape, jnp.float32)
+
+    def body(b, _):
+        # f32 accumulator: integer jnp.sum promotes through int64 under
+        # jax_enable_x64, which Mosaic cannot lower; f32 is exact for block
+        # counts (block ≤ 2^24 lanes).
+        cnt = jnp.sum(jnp.where(bids == b, one, zero)).astype(jnp.int32)
+        out_ref[0, b] = out_ref[0, b] * keep + cnt
+        return jnp.int32(0)
+
+    jax.lax.fori_loop(jnp.int32(0), jnp.int32(num_buckets), body,
+                      jnp.int32(0))
+
+
+def bucket_histogram(bids: jax.Array, num_buckets: int) -> jax.Array:
+    """Row count per bucket id. bids: int32[n] in [0, num_buckets)."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    tiles, _ = _pad_2d(bids.astype(jnp.int32), _HIST_BLK_ROWS,
+                       np.int32(-1))  # -1 matches no bucket.
+    rows = tiles.shape[0]
+    out = pl.pallas_call(
+        partial(_hist_kernel, num_buckets=num_buckets),
+        grid=(rows // _HIST_BLK_ROWS,),
+        in_specs=[pl.BlockSpec((_HIST_BLK_ROWS, _LANES), lambda i: (i, _Z),
+                               memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec((1, num_buckets), lambda i: (_Z, _Z),
+                               memory_space=pltpu.SMEM),
+        out_shape=jax.ShapeDtypeStruct((1, num_buckets), jnp.int32),
+        interpret=_interpret(),
+    )(tiles)
+    return out[0]

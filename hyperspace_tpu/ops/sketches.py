@@ -87,19 +87,30 @@ def minmax_values(col: Column) -> Tuple[Optional[object], Optional[object]]:
     Returns (None, None) when every row is null."""
     import datetime
 
+    from . import pallas_kernels
+
     data = col.data
+    # 32-bit lanes go through the fused one-pass Pallas reduction on TPU.
+    use_pallas = (pallas_kernels.enabled() and data.shape[0] > 0
+                  and data.dtype in (jnp.int32, jnp.float32))
     if col.validity is not None:
         n_valid = int(jnp.sum(col.validity))
         if n_valid == 0:
             return None, None
-        lo_sent = _max_sentinel(data.dtype)
-        hi_sent = _min_sentinel(data.dtype)
-        mn = jnp.min(jnp.where(col.validity, data, lo_sent))
-        mx = jnp.max(jnp.where(col.validity, data, hi_sent))
+        if use_pallas:
+            mn, mx = pallas_kernels.masked_minmax(data, col.validity)
+        else:
+            lo_sent = _max_sentinel(data.dtype)
+            hi_sent = _min_sentinel(data.dtype)
+            mn = jnp.min(jnp.where(col.validity, data, lo_sent))
+            mx = jnp.max(jnp.where(col.validity, data, hi_sent))
     else:
         if data.shape[0] == 0:
             return None, None
-        mn, mx = jnp.min(data), jnp.max(data)
+        if use_pallas:
+            mn, mx = pallas_kernels.masked_minmax(data)
+        else:
+            mn, mx = jnp.min(data), jnp.max(data)
     mn, mx = jax.device_get((mn, mx))
     if col.dtype == STRING:
         return str(col.dictionary[int(mn)]), str(col.dictionary[int(mx)])

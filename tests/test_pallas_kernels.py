@@ -1,0 +1,204 @@
+"""Pallas kernel equivalence tests.
+
+On CPU the kernels run in interpret mode (forced via set_mode("on")); each
+test asserts bit-identical results against the pure-jnp reference path, so
+the TPU kernels are validated for semantics here and for speed on hardware
+by bench.py.
+"""
+
+import datetime
+
+import jax.numpy as jnp
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from hyperspace_tpu.execution.columnar import Table
+from hyperspace_tpu.ops import index_build, kernels, pallas_kernels, sketches
+
+
+@pytest.fixture()
+def pallas_on():
+    pallas_kernels.set_mode("on")
+    yield
+    pallas_kernels.set_mode("auto")
+
+
+def _rand_table(n=1000, seed=0):
+    rng = np.random.default_rng(seed)
+    return Table.from_arrow(pa.table({
+        "i64": pa.array(rng.integers(-10**12, 10**12, n, dtype=np.int64)),
+        "i32": pa.array(rng.integers(-10**6, 10**6, n).astype(np.int32)),
+        "f64": pa.array(rng.uniform(-1e6, 1e6, n)),
+        "s": pa.array(rng.choice(["x", "y", "zz", "w"], n)),
+        "d": pa.array((rng.integers(0, 20000, n)).astype(np.int32),
+                      type=pa.int32()).cast(pa.date32()),
+    }))
+
+
+class TestFusedHashBucket:
+    @pytest.mark.parametrize("cols", [["i64"], ["i32"], ["s"],
+                                      ["i64", "s"], ["d", "i32", "f64"]])
+    def test_matches_jnp_path(self, pallas_on, cols):
+        t = _rand_table()
+        got = np.asarray(index_build.bucket_ids_for(t, cols, 37))
+        pallas_kernels.set_mode("off")
+        want = np.asarray(index_build.bucket_ids_for(t, cols, 37))
+        np.testing.assert_array_equal(got, want)
+
+    def test_hash_matches_hash32_values(self, pallas_on):
+        t = _rand_table(500)
+        col = t.column("i64")
+        folded = [kernels.fold_u32(col.data, col.dtype, col.dictionary)]
+        h, bids = pallas_kernels.fused_hash_bucket(folded, 16)
+        want = np.asarray(kernels.hash32_values(col.data, col.dtype))
+        np.testing.assert_array_equal(np.asarray(h), want)
+        np.testing.assert_array_equal(
+            np.asarray(bids), want % np.uint32(16))
+
+    def test_non_multiple_of_block(self, pallas_on):
+        # Exercise padding: n far from a (256*128) boundary and tiny n.
+        for n in (3, 130, 32769):
+            x = jnp.arange(n, dtype=jnp.int32)
+            folded = [kernels.fold_u32(x, "int32")]
+            h, bids = pallas_kernels.fused_hash_bucket(folded, 8)
+            assert h.shape[0] == n and bids.shape[0] == n
+            want = np.asarray(kernels.hash32_values(x, "int32"))
+            np.testing.assert_array_equal(np.asarray(h), want)
+
+
+class TestFusedCompare:
+    @pytest.mark.parametrize("op,sym", [
+        ("EqualTo", "=="), ("LessThan", "<"), ("LessThanOrEqual", "<="),
+        ("GreaterThan", ">"), ("GreaterThanOrEqual", ">=")])
+    def test_compare_literal_dispatch(self, pallas_on, op, sym):
+        from hyperspace_tpu.execution.evaluator import compare_literal
+
+        t = _rand_table(777)
+        col = t.column("i32")
+        got = np.asarray(compare_literal(col, op, 1234))
+        pallas_kernels.set_mode("off")
+        want = np.asarray(compare_literal(col, op, 1234))
+        np.testing.assert_array_equal(got, want)
+
+    def test_range_mask(self, pallas_on):
+        x = jnp.asarray(np.random.default_rng(1).integers(0, 100, 5000)
+                        .astype(np.int32))
+        for lo_i in (True, False):
+            for hi_i in (True, False):
+                got = np.asarray(
+                    pallas_kernels.fused_range_mask(x, 20, 60, lo_i, hi_i))
+                ml = (x >= 20) if lo_i else (x > 20)
+                mh = (x <= 60) if hi_i else (x < 60)
+                np.testing.assert_array_equal(got, np.asarray(ml & mh))
+
+
+class TestMaskedMinMax:
+    def test_minmax_with_validity(self, pallas_on):
+        rng = np.random.default_rng(2)
+        x = jnp.asarray(rng.uniform(-50, 50, 3000).astype(np.float32))
+        valid = jnp.asarray(rng.random(3000) > 0.3)
+        mn, mx = pallas_kernels.masked_minmax(x, valid)
+        xs = np.asarray(x)[np.asarray(valid)]
+        assert float(mn) == xs.min()
+        assert float(mx) == xs.max()
+
+    def test_minmax_values_dispatch_date(self, pallas_on):
+        t = _rand_table(400)
+        col = t.column("d")
+        got = sketches.minmax_values(col)
+        pallas_kernels.set_mode("off")
+        want = sketches.minmax_values(col)
+        assert got == want
+        assert isinstance(got[0], datetime.date)
+
+    def test_minmax_values_dispatch_int32_nulls(self, pallas_on):
+        arr = pa.array([5, None, -7, 3, None], type=pa.int32())
+        t = Table.from_arrow(pa.table({"v": arr}))
+        assert sketches.minmax_values(t.column("v")) == (-7, 5)
+
+
+class TestHistogram:
+    def test_counts(self, pallas_on):
+        rng = np.random.default_rng(3)
+        bids = jnp.asarray(rng.integers(0, 13, 10_000).astype(np.int32))
+        got = np.asarray(pallas_kernels.bucket_histogram(bids, 13))
+        want = np.bincount(np.asarray(bids), minlength=13)
+        np.testing.assert_array_equal(got, want)
+
+    def test_empty_tail_not_counted(self, pallas_on):
+        bids = jnp.asarray(np.array([0, 1, 2], dtype=np.int32))
+        got = np.asarray(pallas_kernels.bucket_histogram(bids, 4))
+        np.testing.assert_array_equal(got, [1, 1, 1, 0])
+
+
+class TestFusedBetween:
+    def test_between_dispatches_to_range_kernel(self, pallas_on):
+        """And(col >= lo, col <= hi) over a date column must give the same
+        mask with the fused kernel as with the two-compare fallback."""
+        from hyperspace_tpu.execution.evaluator import eval_predicate_mask
+        from hyperspace_tpu.plan.expr import col
+
+        t = _rand_table(3000)
+        epoch = datetime.date(1970, 1, 1)
+        cond = col("d").between(epoch + datetime.timedelta(days=5000),
+                                epoch + datetime.timedelta(days=15000))
+        got = np.asarray(eval_predicate_mask(t, cond))
+        pallas_kernels.set_mode("off")
+        want = np.asarray(eval_predicate_mask(t, cond))
+        np.testing.assert_array_equal(got, want)
+        assert want.any() and not want.all()
+
+    def test_between_with_nulls_matches(self, pallas_on):
+        from hyperspace_tpu.execution.evaluator import eval_predicate_mask
+        from hyperspace_tpu.plan.expr import col
+
+        arr = pa.array([1, None, 7, 12, None, 5], type=pa.int32())
+        t = Table.from_arrow(pa.table({"v": arr}))
+        cond = col("v").between(2, 10)
+        got = np.asarray(eval_predicate_mask(t, cond))
+        pallas_kernels.set_mode("off")
+        want = np.asarray(eval_predicate_mask(t, cond))
+        np.testing.assert_array_equal(got, want)
+
+    def test_boundaries_from_histogram(self, pallas_on):
+        """build_sorted_buckets boundary offsets must be identical with the
+        histogram path (pallas) and the searchsorted path (fallback)."""
+        t = _rand_table(4000)
+        _, got = index_build.build_sorted_buckets(t, ["i64"], 16)
+        pallas_kernels.set_mode("off")
+        _, want = index_build.build_sorted_buckets(t, ["i64"], 16)
+        np.testing.assert_array_equal(got, want)
+
+
+class TestEndToEndWithPallas:
+    def test_index_query_equivalence(self, pallas_on, tmp_system_path, tmp_path):
+        """Full create-index → rewritten query with pallas forced on; results
+        must equal the non-indexed scan (the disable-and-compare oracle)."""
+        import pyarrow.parquet as pq
+
+        import hyperspace_tpu as hst
+        from hyperspace_tpu.api import Hyperspace, IndexConfig
+        from hyperspace_tpu.plan.expr import col
+
+        rng = np.random.default_rng(5)
+        data_dir = tmp_path / "data"
+        data_dir.mkdir()
+        pq.write_table(pa.table({
+            "k": pa.array(rng.integers(0, 50, 2000).astype(np.int64)),
+            "v": pa.array(rng.uniform(0, 1, 2000)),
+        }), str(data_dir / "part0.parquet"))
+
+        session = hst.Session(system_path=tmp_system_path)
+        hs = Hyperspace(session)
+        df = session.read.parquet(str(data_dir))
+        hs.create_index(df, IndexConfig("pidx", ["k"], ["v"]))
+
+        q = df.filter(col("k") == 7).select("k", "v")
+        session.enable_hyperspace()
+        with_idx = q.to_arrow().sort_by([("k", "ascending"), ("v", "ascending")])
+        assert any("IndexScan" in l.simple_string()
+                   for l in q.optimized_plan().collect_leaves())
+        session.disable_hyperspace()
+        without = q.to_arrow().sort_by([("k", "ascending"), ("v", "ascending")])
+        assert with_idx.equals(without)
