@@ -63,10 +63,11 @@ class CreateActionBase(Action):
         enabled (file id per row, from per-file row counts)."""
         cols = indexed + included
         files = list(files) if files is not None else relation.all_files()
-        table = read_parquet(files, cols, relation.file_format)
+        data_fmt = getattr(relation, "data_file_format", relation.file_format)
+        table = read_parquet(files, cols, data_fmt)
         if self._lineage_enabled():
             counts = [pq.ParquetFile(f).metadata.num_rows for f in files] \
-                if relation.file_format == "parquet" else None
+                if data_fmt == "parquet" else None
             if counts is None:
                 raise HyperspaceException(
                     "Lineage requires parquet sources in this version")
@@ -101,13 +102,20 @@ class CreateActionBase(Action):
     # Log entry assembly (parity: CreateActionBase.getIndexLogEntry).
     # ------------------------------------------------------------------
 
-    def _index_properties(self, relation) -> dict:
+    def _base_index_properties(self, relation) -> dict:
         props = {}
         if self._lineage_enabled():
             props[IndexConstants.LINEAGE_PROPERTY] = "true"
-        if relation.file_format == "parquet":
+        if getattr(relation, "data_file_format",
+                   relation.file_format) == "parquet":
             props[IndexConstants.HAS_PARQUET_AS_SOURCE_FORMAT_PROPERTY] = "true"
         return props
+
+    def _index_properties(self, relation) -> dict:
+        # Source-specific enrichment (e.g. delta version history keyed by the
+        # final log version this action will commit).
+        return relation.enrich_index_properties(
+            self._base_index_properties(relation), self.end_id)
 
     def _build_source(self, relation, plan,
                       file_id_tracker: FileIdTracker) -> Source:

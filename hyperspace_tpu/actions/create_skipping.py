@@ -65,7 +65,9 @@ def build_sketch_rows(relation, sketch_list: List[Sketch],
             raise HyperspaceException(f"Unknown sketch kind: {s.kind}")
     from ..util.file_utils import file_info_triple
     for path in files:
-        table = read_parquet([path], needed, relation.file_format)
+        table = read_parquet([path], needed,
+                             getattr(relation, "data_file_format",
+                                     relation.file_format))
         rows[FILE_COL].append(path)
         rows[FILE_ID_COL].append(tracker.add_file(*file_info_triple(path)))
         for s in sketch_list:

@@ -63,6 +63,14 @@ class ExistingIndexActionBase(CreateActionBase):
         latest = self.data_manager.get_latest_version_id()
         return 0 if latest is None else latest + 1
 
+    def _base_index_properties(self, relation) -> dict:
+        """Carry forward the previous entry's properties (e.g. the delta
+        version history accumulates across refreshes) before recomputing the
+        standard ones."""
+        props = dict(self.previous_entry.derivedDataset.properties)
+        props.update(super()._base_index_properties(relation))
+        return props
+
     @property
     def log_entry(self) -> IndexLogEntry:
         if self._entry is not None:
@@ -93,11 +101,14 @@ class RefreshActionBase(ExistingIndexActionBase):
     @property
     def relation(self):
         """The source relation re-listed now (parity: RefreshActionBase.df —
-        the reference reloads the DataFrame from the logged relation)."""
+        the reference reloads the DataFrame from the logged relation).
+        ``refresh()`` strips version pinning (versionAsOf/snapshotId) so an
+        index created over a time-traveled read tracks the live table."""
         if self._relation is None:
             rel = self.previous_entry.relation
-            self._relation = self.session.source_provider_manager.build_relation(
+            built = self.session.source_provider_manager.build_relation(
                 rel.rootPaths, rel.fileFormat, rel.options)
+            self._relation = built.refresh()
         return self._relation
 
     @property

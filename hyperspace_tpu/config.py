@@ -148,7 +148,9 @@ class HyperspaceConf:
     def file_based_source_builders(self) -> str:
         return self._conf.get(
             IndexConstants.FILE_BASED_SOURCE_BUILDERS,
-            "hyperspace_tpu.sources.default.DefaultFileBasedSourceBuilder")
+            "hyperspace_tpu.sources.default.DefaultFileBasedSourceBuilder,"
+            "hyperspace_tpu.sources.delta.DeltaLakeSourceBuilder,"
+            "hyperspace_tpu.sources.iceberg.IcebergSourceBuilder")
 
     def globbing_patterns(self) -> list:
         raw = self._conf.get(IndexConstants.GLOBBING_PATTERN_KEY, "")

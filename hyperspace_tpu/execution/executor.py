@@ -113,9 +113,10 @@ def _execute_scan(plan: Scan, needed: Optional[Set[str]],
         from .columnar import empty_table
         return empty_table(relation.schema.select(cols)
                            if cols is not None else relation.schema)
-    if relation.file_format != "parquet":
+    fmt = getattr(relation, "data_file_format", relation.file_format)
+    if fmt != "parquet":
         pa_filter = None
-    return read_parquet(files, cols, relation.file_format, filters=pa_filter)
+    return read_parquet(files, cols, fmt, filters=pa_filter)
 
 
 def _equality_bucket_subset(plan: IndexScan, condition) -> Optional[Set[int]]:

@@ -81,6 +81,11 @@ class IndexCollectionManager(IndexManager):
     def _data_manager(self, name: str) -> IndexDataManager:
         return IndexDataManager(self._index_path(name))
 
+    def log_manager_for(self, name: str) -> IndexLogManager:
+        """Public accessor for an index's op-log manager (used by the
+        versioned-source rules for time-travel index version selection)."""
+        return self._log_manager(name)
+
     # ------------------------------------------------------------------
     # CRUD dispatch.
     # ------------------------------------------------------------------

@@ -1,0 +1,10 @@
+"""Versioned lakehouse table formats (transaction-logged parquet tables).
+
+Two formats mirror the reference's two lake integrations:
+- ``delta``: commit-log tables (hyperspace_tpu.lake.delta.DeltaTable) — the
+  Delta Lake analogue (reference: sources/delta/).
+- ``iceberg``: snapshot/manifest tables (hyperspace_tpu.lake.iceberg) — the
+  Iceberg analogue (reference: sources/iceberg/).
+"""
+
+from .delta import DeltaTable  # noqa: F401

@@ -1,0 +1,249 @@
+"""Commit-log versioned parquet tables — the Delta Lake analogue.
+
+A table directory holds parquet part files plus a ``_delta_log/`` of
+newline-delimited-JSON commit files, one per version::
+
+    <table>/part-<uuid>.parquet
+    <table>/_delta_log/00000000000000000000.json   (version 0)
+    <table>/_delta_log/00000000000000000001.json   (version 1)
+
+Each commit file is a list of actions: ``metaData`` (schema), ``add`` (a data
+file enters the table), ``remove`` (a file leaves), ``commitInfo``
+(operation tag + timestamp). A snapshot at version v is the fold of all
+actions in commits 0..v. Commits are written create-exclusive (O_EXCL) so
+concurrent writers conflict instead of clobbering — the same optimistic
+protocol the index op log uses (index/log_manager.py).
+
+This module is the storage layer only; query/index integration lives in
+sources/delta.py (reference behavior mirrored there:
+sources/delta/DeltaLakeFileBasedSource.scala:40, DeltaLakeRelation.scala:34).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+import uuid
+from typing import Dict, List, Optional, Tuple
+
+import pyarrow as pa
+import pyarrow.parquet as pq
+
+from ..exceptions import HyperspaceException
+
+LOG_DIR = "_delta_log"
+
+
+class DeltaConcurrentModificationException(HyperspaceException):
+    pass
+
+
+def _commit_path(table_path: str, version: int) -> str:
+    return os.path.join(table_path, LOG_DIR, f"{version:020d}.json")
+
+
+class Snapshot:
+    """Resolved state of a table at one version."""
+
+    def __init__(self, table_path: str, version: int,
+                 files: Dict[str, dict], schema_str: Optional[str]):
+        self.table_path = table_path
+        self.version = version
+        self._files = files              # rel path -> add-action payload
+        self.schema_string = schema_str
+
+    @property
+    def file_paths(self) -> List[str]:
+        return sorted(os.path.join(self.table_path, p) for p in self._files)
+
+    @property
+    def file_infos(self) -> List[Tuple[str, int, int]]:
+        """(abs path, size, modificationTime ms) straight from the log — no
+        filesystem stat needed (the lake metadata is authoritative)."""
+        out = []
+        for rel in sorted(self._files):
+            a = self._files[rel]
+            out.append((os.path.join(self.table_path, rel),
+                        int(a.get("size", 0)),
+                        int(a.get("modificationTime", 0))))
+        return out
+
+    def arrow_schema(self) -> Optional[pa.Schema]:
+        if self.schema_string is None:
+            return None
+        import pyarrow.ipc as ipc
+        import base64
+        buf = base64.b64decode(self.schema_string)
+        return ipc.read_schema(pa.BufferReader(buf))
+
+
+class DeltaTable:
+    """Reader/writer for commit-log tables."""
+
+    def __init__(self, path: str):
+        self.path = os.path.abspath(path)
+
+    # -- log plumbing ------------------------------------------------------
+
+    def _log_versions(self) -> List[int]:
+        log_dir = os.path.join(self.path, LOG_DIR)
+        if not os.path.isdir(log_dir):
+            return []
+        out = []
+        for name in os.listdir(log_dir):
+            if name.endswith(".json"):
+                try:
+                    out.append(int(name[:-5]))
+                except ValueError:
+                    continue
+        return sorted(out)
+
+    def exists(self) -> bool:
+        return bool(self._log_versions())
+
+    def latest_version(self) -> int:
+        versions = self._log_versions()
+        if not versions:
+            raise HyperspaceException(f"Not a delta table: {self.path}")
+        return versions[-1]
+
+    def _read_commit(self, version: int) -> List[dict]:
+        with open(_commit_path(self.path, version)) as f:
+            return [json.loads(line) for line in f if line.strip()]
+
+    def _write_commit(self, version: int, actions: List[dict]) -> None:
+        log_dir = os.path.join(self.path, LOG_DIR)
+        os.makedirs(log_dir, exist_ok=True)
+        path = _commit_path(self.path, version)
+        try:
+            fd = os.open(path, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+        except FileExistsError:
+            raise DeltaConcurrentModificationException(
+                f"Version {version} of {self.path} was committed concurrently")
+        with os.fdopen(fd, "w") as f:
+            for a in actions:
+                f.write(json.dumps(a) + "\n")
+
+    # -- snapshots ---------------------------------------------------------
+
+    def snapshot(self, version: Optional[int] = None) -> Snapshot:
+        versions = self._log_versions()
+        if not versions:
+            raise HyperspaceException(f"Not a delta table: {self.path}")
+        if version is None:
+            version = versions[-1]
+        if version not in versions:
+            raise HyperspaceException(
+                f"Version {version} does not exist for {self.path} "
+                f"(available: {versions[0]}..{versions[-1]})")
+        files: Dict[str, dict] = {}
+        schema_str = None
+        for v in versions:
+            if v > version:
+                break
+            for action in self._read_commit(v):
+                if "add" in action:
+                    files[action["add"]["path"]] = action["add"]
+                elif "remove" in action:
+                    files.pop(action["remove"]["path"], None)
+                elif "metaData" in action:
+                    schema_str = action["metaData"].get("schemaString",
+                                                        schema_str)
+        return Snapshot(self.path, version, files, schema_str)
+
+    def history(self) -> List[dict]:
+        out = []
+        for v in self._log_versions():
+            for action in self._read_commit(v):
+                if "commitInfo" in action:
+                    info = dict(action["commitInfo"])
+                    info["version"] = v
+                    out.append(info)
+        return out
+
+    # -- writes ------------------------------------------------------------
+
+    @staticmethod
+    def _schema_string(schema: pa.Schema) -> str:
+        import base64
+        return base64.b64encode(schema.serialize().to_pybytes()).decode()
+
+    def _write_parts(self, table: pa.Table, max_rows_per_file: Optional[int]
+                     ) -> List[dict]:
+        os.makedirs(self.path, exist_ok=True)
+        adds = []
+        n = table.num_rows
+        chunk = max_rows_per_file or max(n, 1)
+        offset = 0
+        while offset == 0 or offset < n:
+            part = table.slice(offset, chunk)
+            rel = f"part-{uuid.uuid4().hex}.parquet"
+            abs_path = os.path.join(self.path, rel)
+            pq.write_table(part, abs_path)
+            st = os.stat(abs_path)
+            adds.append({"add": {
+                "path": rel, "size": st.st_size,
+                "modificationTime": int(st.st_mtime * 1000),
+                "dataChange": True}})
+            offset += chunk
+            if n == 0:
+                break
+        return adds
+
+    def create(self, table: pa.Table,
+               max_rows_per_file: Optional[int] = None) -> int:
+        """Create version 0. Fails if the table already exists."""
+        if self.exists():
+            raise HyperspaceException(f"Delta table already exists: {self.path}")
+        actions = [{"metaData": {"id": uuid.uuid4().hex,
+                                 "schemaString": self._schema_string(table.schema),
+                                 "partitionColumns": []}}]
+        actions += self._write_parts(table, max_rows_per_file)
+        actions.append({"commitInfo": {"operation": "WRITE",
+                                       "timestamp": int(time.time() * 1000)}})
+        self._write_commit(0, actions)
+        return 0
+
+    def append(self, table: pa.Table,
+               max_rows_per_file: Optional[int] = None) -> int:
+        version = self.latest_version() + 1
+        actions = self._write_parts(table, max_rows_per_file)
+        actions.append({"commitInfo": {"operation": "APPEND",
+                                       "timestamp": int(time.time() * 1000)}})
+        self._write_commit(version, actions)
+        return version
+
+    def remove_files(self, abs_paths: List[str]) -> int:
+        """Remove data files from the table (file-granularity delete)."""
+        snap = self.snapshot()
+        version = snap.version + 1
+        actions = []
+        for p in abs_paths:
+            rel = os.path.relpath(os.path.abspath(p), self.path)
+            if rel not in snap._files:
+                raise HyperspaceException(f"{p} is not part of {self.path}")
+            actions.append({"remove": {"path": rel,
+                                       "deletionTimestamp": int(time.time() * 1000),
+                                       "dataChange": True}})
+        actions.append({"commitInfo": {"operation": "DELETE",
+                                       "timestamp": int(time.time() * 1000)}})
+        self._write_commit(version, actions)
+        return version
+
+    def overwrite(self, table: pa.Table,
+                  max_rows_per_file: Optional[int] = None) -> int:
+        snap = self.snapshot()
+        version = snap.version + 1
+        actions = [{"remove": {"path": rel,
+                               "deletionTimestamp": int(time.time() * 1000),
+                               "dataChange": True}}
+                   for rel in sorted(snap._files)]
+        actions.append({"metaData": {"id": uuid.uuid4().hex,
+                                     "schemaString": self._schema_string(table.schema),
+                                     "partitionColumns": []}})
+        actions += self._write_parts(table, max_rows_per_file)
+        actions.append({"commitInfo": {"operation": "OVERWRITE",
+                                       "timestamp": int(time.time() * 1000)}})
+        self._write_commit(version, actions)
+        return version

@@ -62,6 +62,28 @@ def _current_file_infos(relation) -> List[FileInfo]:
             for p, size, mtime in relation.all_file_infos()]
 
 
+def resolve_time_travel_entry(session, entry: IndexLogEntry, relation
+                              ) -> IndexLogEntry:
+    """For versioned sources (delta/iceberg analogues), swap the latest index
+    entry for the log version built closest to the *scanned* table version
+    (parity: DeltaLakeRelation.closestIndex:187 — time-travel-aware index
+    selection). Non-versioned relations pass through unchanged."""
+    closest_fn = getattr(relation, "closest_index_log_version", None)
+    if closest_fn is None:
+        return entry
+    # History pairs are keyed by op-log id (entry.id), the version an
+    # action's final commit was written at.
+    target = closest_fn(entry.derivedDataset.properties)
+    if target is None or target == entry.id:
+        return entry
+    from ..index.constants import States
+    older = session.index_collection_manager.log_manager_for(
+        entry.name).get_log(target)
+    if older is not None and older.state == States.ACTIVE:
+        return older
+    return entry
+
+
 def get_candidate_indexes(session, indexes: List[IndexLogEntry],
                           scan: Scan, ctx=None) -> List[IndexLogEntry]:
     """Indexes applicable to this scan. Signature equality, or — with Hybrid
@@ -71,6 +93,7 @@ def get_candidate_indexes(session, indexes: List[IndexLogEntry],
     hybrid = session.hs_conf.hybrid_scan_enabled()
     out = []
     for entry in indexes:
+        entry = resolve_time_travel_entry(session, entry, scan.relation)
         if not hybrid:
             sig = _plan_signature(entry, scan)
             recorded = entry.signature.signatures[0].value \

@@ -128,6 +128,23 @@ class DataFrameReader:
     def csv(self, *paths: str) -> "DataFrame":
         return self.format("csv").load(*paths)
 
+    def delta(self, path: str, version_as_of: Optional[int] = None
+              ) -> "DataFrame":
+        """Read a commit-log versioned table (lake/delta.py), optionally
+        time-traveling to an older version."""
+        reader = self.format("delta")
+        if version_as_of is not None:
+            reader._options["versionAsOf"] = str(version_as_of)
+        return reader.load(path)
+
+    def iceberg(self, path: str, snapshot_id: Optional[int] = None
+                ) -> "DataFrame":
+        """Read a snapshot/manifest versioned table (lake/iceberg.py)."""
+        reader = self.format("iceberg")
+        if snapshot_id is not None:
+            reader._options["snapshotId"] = str(snapshot_id)
+        return reader.load(path)
+
     def format(self, fmt: str) -> "_FormattedReader":
         return _FormattedReader(self._session, fmt, dict(self._options))
 

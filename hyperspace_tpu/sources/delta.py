@@ -1,0 +1,187 @@
+"""Delta-analogue source provider: versioned commit-log tables.
+
+Reference behavior mirrored (sources/delta/DeltaLakeFileBasedSource.scala:40,
+DeltaLakeRelation.scala:34,187,152, DeltaLakeRelationMetadata.scala:25,45):
+
+- signature = table version + path (no per-file hashing — the commit log
+  version already fingerprints the file set);
+- ``versionAsOf`` time-travel reads;
+- index creation/refresh records a ``deltaVersionHistory`` property
+  ("indexLogVer:deltaVer,…") via ``enrich_index_properties``;
+- ``closest_index_log_version`` picks the index log version whose recorded
+  delta version is nearest to the scanned snapshot (time-travel-aware index
+  selection, DeltaLakeRelation.closestIndex semantics).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from ..exceptions import HyperspaceException
+from ..lake.delta import DeltaTable, Snapshot
+from ..schema import Schema
+from ..util import hashing
+from .interfaces import FileBasedRelation, FileBasedSourceProvider
+
+DELTA_VERSION_HISTORY_PROPERTY = "deltaVersionHistory"
+VERSION_AS_OF_OPTION = "versionAsOf"
+
+
+class DeltaLakeRelation(FileBasedRelation):
+    def __init__(self, path: str, options: Optional[Dict[str, str]] = None,
+                 snapshot: Optional[Snapshot] = None):
+        self._path = os.path.abspath(path)
+        self._options = dict(options or {})
+        self._table = DeltaTable(self._path)
+        if snapshot is None:
+            version = self._options.get(VERSION_AS_OF_OPTION)
+            snapshot = self._table.snapshot(
+                int(version) if version is not None else None)
+        self._snapshot = snapshot
+        self._schema: Optional[Schema] = None
+
+    # -- identity ----------------------------------------------------------
+
+    @property
+    def root_paths(self) -> List[str]:
+        return [self._path]
+
+    @property
+    def file_format(self) -> str:
+        return "delta"
+
+    @property
+    def data_file_format(self) -> str:
+        return "parquet"
+
+    @property
+    def options(self) -> Dict[str, str]:
+        return dict(self._options)
+
+    @property
+    def delta_version(self) -> int:
+        return self._snapshot.version
+
+    def describe(self) -> str:
+        return f"delta {self._path}@v{self._snapshot.version}"
+
+    # -- files & schema ----------------------------------------------------
+
+    @property
+    def schema(self) -> Schema:
+        if self._schema is None:
+            arrow = self._snapshot.arrow_schema()
+            if arrow is None:
+                import pyarrow.parquet as pq
+                files = self.all_files()
+                if not files:
+                    raise HyperspaceException(
+                        f"Empty delta table without schema: {self._path}")
+                arrow = pq.read_schema(files[0])
+            self._schema = Schema.from_arrow(arrow)
+        return self._schema
+
+    def all_files(self) -> List[str]:
+        return self._snapshot.file_paths
+
+    def all_file_infos(self) -> List[Tuple[str, int, int]]:
+        # Sizes/mtimes come from the commit log, not a filesystem walk.
+        return self._snapshot.file_infos
+
+    def signature(self) -> str:
+        """Table version + path — the commit log version is the fingerprint
+        (reference: DeltaLakeFileBasedSource signature semantics)."""
+        return hashing.md5_hex(f"{self._snapshot.version}{self._path}")
+
+    def refresh(self) -> "DeltaLakeRelation":
+        opts = {k: v for k, v in self._options.items()
+                if k != VERSION_AS_OF_OPTION}
+        return DeltaLakeRelation(self._path, opts)
+
+    def with_files(self, files: Sequence[str]) -> "DeltaLakeRelation":
+        pruned_set = {os.path.abspath(f) for f in files}
+        snap = self._snapshot
+        kept = {rel: a for rel, a in snap._files.items()
+                if os.path.join(self._path, rel) in pruned_set}
+        pruned = DeltaLakeRelation(
+            self._path, self._options,
+            snapshot=Snapshot(self._path, snap.version, kept,
+                              snap.schema_string))
+        pruned._schema = self._schema
+        return pruned
+
+    # -- index metadata hooks ---------------------------------------------
+
+    def enrich_index_properties(self, props: Dict[str, str],
+                                index_log_version: int) -> Dict[str, str]:
+        """Append (index log version → delta version) to the history property
+        (reference: DeltaLakeRelationMetadata.enrichIndexProperties)."""
+        out = dict(props)
+        history = out.get(DELTA_VERSION_HISTORY_PROPERTY, "")
+        pair = f"{index_log_version}:{self._snapshot.version}"
+        out[DELTA_VERSION_HISTORY_PROPERTY] = \
+            f"{history},{pair}" if history else pair
+        return out
+
+    @staticmethod
+    def parse_version_history(props: Dict[str, str]) -> List[Tuple[int, int]]:
+        """[(index log version, delta version), ...] from the property."""
+        raw = props.get(DELTA_VERSION_HISTORY_PROPERTY, "")
+        out = []
+        for pair in raw.split(","):
+            if ":" in pair:
+                a, b = pair.split(":", 1)
+                out.append((int(a), int(b)))
+        return out
+
+    def closest_index_log_version(self, props: Dict[str, str]
+                                  ) -> Optional[int]:
+        """The index log version whose recorded delta version is nearest to
+        this snapshot's version, or None when the *latest* history entry
+        already covers it. Prefers the latest version ≤ the scanned snapshot
+        (an index of a *future* table version contains rows the snapshot
+        must not see, so it only ties in via Hybrid Scan deletes); falls
+        back to the overall nearest (reference:
+        DeltaLakeRelation.closestIndex:187).
+
+        Returning None (not the latest pair's log id) matters: actions that
+        don't re-enrich the history (optimize, quick refresh) commit newer
+        ACTIVE log ids than the last recorded pair, and swapping back to the
+        recorded id would silently discard their work."""
+        history = self.parse_version_history(props)
+        if not history:
+            return None
+        at_or_before = [(lv, dv) for lv, dv in history
+                        if dv <= self._snapshot.version]
+        if at_or_before:
+            chosen = max(at_or_before, key=lambda p: (p[1], p[0]))
+        else:
+            chosen = min(history,
+                         key=lambda p: (abs(p[1] - self._snapshot.version),
+                                        -p[0]))
+        latest_dv = max(dv for _, dv in history)
+        if chosen[1] == latest_dv:
+            return None  # the current entry (possibly newer id) covers it.
+        return chosen[0]
+
+
+class DeltaLakeSourceBuilder(FileBasedSourceProvider):
+    """Provider answering for ``format("delta")`` loads and delta Scan
+    leaves (reference: sources/delta/DeltaLakeFileBasedSource.scala:40)."""
+
+    def get_relation(self, plan_leaf) -> Optional[FileBasedRelation]:
+        relation = getattr(plan_leaf, "relation", None)
+        if isinstance(relation, DeltaLakeRelation):
+            return relation
+        return None
+
+    def build_relation(self, paths: Sequence[str], fmt: str,
+                       options: Dict[str, str]) -> Optional[FileBasedRelation]:
+        if fmt != "delta":
+            return None
+        if len(paths) != 1:
+            raise HyperspaceException(
+                "Delta tables are single-rooted; got "
+                f"{len(paths)} paths")
+        return DeltaLakeRelation(paths[0], options)

@@ -34,6 +34,12 @@ class FileBasedRelation:
         raise NotImplementedError
 
     @property
+    def data_file_format(self) -> str:
+        """Physical format of the leaf files (versioned table formats are
+        logical wrappers over parquet parts)."""
+        return self.file_format
+
+    @property
     def options(self) -> Dict[str, str]:
         return {}
 
@@ -69,6 +75,13 @@ class FileBasedRelation:
     def refresh(self) -> "FileBasedRelation":
         """Re-list the underlying files (for refresh actions)."""
         raise NotImplementedError
+
+    def enrich_index_properties(self, props: Dict[str, str],
+                                index_log_version: int) -> Dict[str, str]:
+        """Provider hook: add source-specific properties to an index log
+        entry at create/refresh time (parity: FileBasedRelationMetadata.
+        enrichIndexProperties — e.g. the delta version history)."""
+        return props
 
     def with_files(self, files: Sequence[str]) -> "FileBasedRelation":
         """A copy of this relation restricted to ``files`` (data-skipping
