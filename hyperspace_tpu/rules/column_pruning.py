@@ -36,8 +36,10 @@ def prune_columns(plan: LogicalPlan, required: Optional[Set[str]] = None
         child_req = set(plan.group_cols)
         for a in plan.aggs:
             child_req.update(a.references)
-        return Aggregate(plan.group_cols, plan.aggs,
-                         prune_columns(plan.child, child_req))
+        # Narrow like Spark's ColumnPruning does under Aggregate — the
+        # FilterIndexRule coverage check sees only the referenced columns.
+        child = _narrow(prune_columns(plan.child, child_req), child_req)
+        return Aggregate(plan.group_cols, plan.aggs, child)
     if isinstance(plan, Sort):
         child_req = required | {c for c, _ in plan.orders}
         return Sort(plan.orders, prune_columns(plan.child, child_req))

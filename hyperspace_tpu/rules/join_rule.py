@@ -32,9 +32,9 @@ from ..plan import expr as E
 from ..plan.nodes import Join, LogicalPlan, Scan
 from .index_filters import ReasonCollector
 from .rankers import JoinIndexRanker
-from .rule_utils import (collect_filter_project_columns, get_candidate_indexes,
+from .rule_utils import (collect_base_references, get_candidate_indexes,
                          get_relation, is_plan_linear, log_index_usage,
-                         transform_plan_to_use_index)
+                         output_to_base_mapping, transform_plan_to_use_index)
 
 
 def _column_mapping(join: Join, pairs) -> Optional[Tuple[List[str], List[str]]]:
@@ -76,9 +76,13 @@ def _usable_indexes(session, side_plan: LogicalPlan, scan: Scan,
                     candidates_for=None) -> List[IndexLogEntry]:
     """Indexes on this side whose indexed columns are exactly the join
     columns (any order) and which cover all referenced columns (parity:
-    getUsableIndexes, JoinIndexRule.scala:449)."""
-    project_cols, filter_cols = collect_filter_project_columns(side_plan)
-    referenced = set(project_cols) | set(filter_cols) | set(join_cols)
+    getUsableIndexes, JoinIndexRule.scala:449). ``join_cols`` and the
+    coverage set are both in base-relation namespace (alias renames
+    resolved)."""
+    base_refs = collect_base_references(side_plan)
+    if base_refs is None:
+        return []
+    referenced = base_refs | set(join_cols)
 
     from .apply_hyperspace import active_indexes
     if candidates_for is not None:
@@ -143,6 +147,30 @@ def try_rewrite_join(session, join: Join,
     if mapping is None:
         return None
     l_cols, r_cols = mapping
+
+    # Trace output names to base relation columns (Alias renames — e.g.
+    # self-joins — keep working; computed join keys disqualify the side).
+    l_base = output_to_base_mapping(join.left)
+    r_base = output_to_base_mapping(join.right)
+    if l_base is None or r_base is None:
+        return None
+    l_cols = [l_base.get(c) for c in l_cols]
+    r_cols = [r_base.get(c) for c in r_cols]
+    if any(c is None for c in l_cols) or any(c is None for c in r_cols):
+        return None
+    # Re-establish the dedup + 1:1 invariant in base space: two alias pairs
+    # of the same base pair collapse to one; conflicting base mappings
+    # disqualify the join.
+    base_pairs = list(dict.fromkeys(zip(l_cols, r_cols)))
+    l_to_r: Dict[str, str] = {}
+    r_to_l: Dict[str, str] = {}
+    for l, r in base_pairs:
+        if l_to_r.get(l, r) != r or r_to_l.get(r, l) != l:
+            return None
+        l_to_r[l] = r
+        r_to_l[r] = l
+    l_cols = [p[0] for p in base_pairs]
+    r_cols = [p[1] for p in base_pairs]
 
     l_scan = join.left.collect_leaves()[0]
     r_scan = join.right.collect_leaves()[0]

@@ -19,6 +19,7 @@ from typing import List, Optional, Tuple
 from ..index.constants import IndexConstants
 from ..index.log_entry import FileInfo, IndexLogEntry
 from ..index.signatures import LogicalPlanSignatureProvider
+from ..plan import expr as E
 from ..plan.nodes import Filter, IndexScan, LogicalPlan, Project, Scan
 from ..schema import Schema
 from ..util import file_utils
@@ -204,6 +205,63 @@ def is_plan_linear(plan: LogicalPlan) -> bool:
         if len(children) != 1:
             return False
         node = children[0]
+
+
+def _walk_base_references(plan: LogicalPlan):
+    """(output name → base column map, all base columns the chain reads)
+    for a linear Scan/Filter/Project chain, tracing Alias renames level by
+    level so every node's references are translated through the mapping *at
+    its depth*. Computed expressions map to None as outputs (not direct base
+    attributes — parity with JoinIndexRule.scala:234; Spark gets this from
+    exprIds) but their inputs still count toward the read set. Returns None
+    for non-linear plans."""
+    if isinstance(plan, Scan):
+        return {n: n for n in plan.schema.names}, set()
+    if isinstance(plan, Filter):
+        walked = _walk_base_references(plan.child)
+        if walked is None:
+            return None
+        mapping, refs = walked
+        refs = set(refs)
+        for r in plan.condition.references:
+            base = mapping.get(r)
+            if base is not None:
+                refs.add(base)
+        return mapping, refs
+    if isinstance(plan, Project):
+        walked = _walk_base_references(plan.child)
+        if walked is None:
+            return None
+        mapping, refs = walked
+        refs = set(refs)
+        out = {}
+        for e in plan.exprs:
+            for r in e.references:
+                base = mapping.get(r)
+                if base is not None:
+                    refs.add(base)
+            inner = e.child if isinstance(e, E.Alias) else e
+            out[e.name] = mapping.get(inner.column) \
+                if isinstance(inner, E.Col) else None
+        return out, refs
+    return None
+
+
+def output_to_base_mapping(plan: LogicalPlan) -> Optional[dict]:
+    """Output column name → base relation column through a linear chain."""
+    walked = _walk_base_references(plan)
+    return None if walked is None else walked[0]
+
+
+def collect_base_references(plan: LogicalPlan) -> Optional[set]:
+    """Every base relation column a linear chain reads plus its direct base
+    outputs — the coverage-check input, all in base namespace. None for
+    non-linear plans."""
+    walked = _walk_base_references(plan)
+    if walked is None:
+        return None
+    mapping, refs = walked
+    return refs | {b for b in mapping.values() if b is not None}
 
 
 def collect_filter_project_columns(plan: LogicalPlan) -> Tuple[List[str], List[str]]:

@@ -1,0 +1,201 @@
+"""TPC-H / TPC-DS schema registration + representative query plans.
+
+The reference registers all TPC-DS tables as schema-only external tables and
+diffs normalized physical plans against approved golden files
+(goldstandard/PlanStabilitySuite.scala:84, TPCDSBase.scala:1-570). Here the
+tables are registered as deterministic tiny parquet datasets (fixed seed,
+fixed content) so the rewrite rules, rankers, and hybrid-scan candidacy run
+exactly as in production, and the *optimized logical plan* strings are the
+stability surface.
+"""
+
+from __future__ import annotations
+
+import datetime
+import os
+from typing import Dict
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+
+# ---------------------------------------------------------------------------
+# Schemas. Canonical column subsets (full column lists for the queried
+# tables; types follow the spec: identifiers int64, money float64,
+# dates date32, flags dictionary strings).
+# ---------------------------------------------------------------------------
+
+_EPOCH = datetime.date(1970, 1, 1)
+
+
+def _dates(rng, n, lo=8000, hi=11000):
+    return pa.array((rng.integers(lo, hi, n)).astype(np.int32),
+                    type=pa.int32()).cast(pa.date32())
+
+
+def _tpch_tables(rng) -> Dict[str, pa.Table]:
+    n_li, n_od, n_pt = 120, 40, 25
+    return {
+        "lineitem": pa.table({
+            "l_orderkey": pa.array(rng.integers(0, n_od, n_li).astype(np.int64)),
+            "l_partkey": pa.array(rng.integers(0, n_pt, n_li).astype(np.int64)),
+            "l_quantity": pa.array(rng.integers(1, 50, n_li).astype(np.int64)),
+            "l_extendedprice": pa.array(np.round(rng.uniform(900, 105000, n_li), 2)),
+            "l_discount": pa.array(np.round(rng.uniform(0, 0.1, n_li), 2)),
+            "l_tax": pa.array(np.round(rng.uniform(0, 0.08, n_li), 2)),
+            "l_returnflag": pa.array(rng.choice(["A", "N", "R"], n_li)),
+            "l_linestatus": pa.array(rng.choice(["O", "F"], n_li)),
+            "l_shipdate": _dates(rng, n_li),
+            "l_shipmode": pa.array(rng.choice(["MAIL", "SHIP", "AIR", "TRUCK"], n_li)),
+        }),
+        "orders": pa.table({
+            "o_orderkey": pa.array(np.arange(n_od, dtype=np.int64)),
+            "o_custkey": pa.array(rng.integers(0, 20, n_od).astype(np.int64)),
+            "o_orderstatus": pa.array(rng.choice(["O", "F", "P"], n_od)),
+            "o_totalprice": pa.array(np.round(rng.uniform(1000, 400000, n_od), 2)),
+            "o_orderdate": _dates(rng, n_od),
+            "o_orderpriority": pa.array(rng.choice(
+                ["1-URGENT", "2-HIGH", "3-MEDIUM", "4-NOT SPECIFIED", "5-LOW"], n_od)),
+            "o_shippriority": pa.array(np.zeros(n_od, dtype=np.int32)),
+        }),
+        "part": pa.table({
+            "p_partkey": pa.array(np.arange(n_pt, dtype=np.int64)),
+            "p_brand": pa.array(rng.choice(["Brand#11", "Brand#23", "Brand#45"], n_pt)),
+            "p_container": pa.array(rng.choice(["SM BOX", "MED BOX", "LG BOX"], n_pt)),
+            "p_size": pa.array(rng.integers(1, 50, n_pt).astype(np.int64)),
+        }),
+    }
+
+
+def _tpcds_tables(rng) -> Dict[str, pa.Table]:
+    n_sr, n_dd, n_cu, n_st = 90, 60, 30, 6
+    return {
+        "store_returns": pa.table({
+            "sr_returned_date_sk": pa.array(rng.integers(0, n_dd, n_sr).astype(np.int64)),
+            "sr_customer_sk": pa.array(rng.integers(0, n_cu, n_sr).astype(np.int64)),
+            "sr_store_sk": pa.array(rng.integers(0, n_st, n_sr).astype(np.int64)),
+            "sr_return_amt": pa.array(np.round(rng.uniform(1, 2000, n_sr), 2)),
+        }),
+        "date_dim": pa.table({
+            "d_date_sk": pa.array(np.arange(n_dd, dtype=np.int64)),
+            "d_year": pa.array((2000 + (np.arange(n_dd) % 3)).astype(np.int64)),
+            "d_moy": pa.array((1 + (np.arange(n_dd) % 12)).astype(np.int64)),
+        }),
+        "customer": pa.table({
+            "c_customer_sk": pa.array(np.arange(n_cu, dtype=np.int64)),
+            "c_customer_id": pa.array([f"C{i:08d}" for i in range(n_cu)]),
+        }),
+        "store": pa.table({
+            "s_store_sk": pa.array(np.arange(n_st, dtype=np.int64)),
+            "s_state": pa.array(rng.choice(["TN", "CA"], n_st)),
+        }),
+    }
+
+
+def register_tables(session, root: str) -> Dict[str, "object"]:
+    """Write the deterministic datasets (once per directory) and return
+    name → DataFrame."""
+    rng = np.random.default_rng(42)
+    tables = {**_tpch_tables(rng), **_tpcds_tables(rng)}
+    dfs = {}
+    for name, tbl in tables.items():
+        d = os.path.join(root, name)
+        if not os.path.isdir(d):
+            os.makedirs(d)
+            pq.write_table(tbl, os.path.join(d, "part0.parquet"))
+        dfs[name] = session.read.parquet(d)
+    return dfs
+
+
+# ---------------------------------------------------------------------------
+# Indexes the enabled suite creates (covering the query set below).
+# ---------------------------------------------------------------------------
+
+def index_configs():
+    from hyperspace_tpu.api import IndexConfig
+    return [
+        IndexConfig("li_ok_idx", ["l_orderkey"],
+                    ["l_extendedprice", "l_discount", "l_shipdate"]),
+        IndexConfig("od_ok_idx", ["o_orderkey"],
+                    ["o_custkey", "o_orderdate", "o_shippriority"]),
+        IndexConfig("li_ship_idx", ["l_shipdate"],
+                    ["l_discount", "l_quantity", "l_extendedprice"]),
+        IndexConfig("sr_cust_idx", ["sr_customer_sk"],
+                    ["sr_store_sk", "sr_return_amt", "sr_returned_date_sk"]),
+        IndexConfig("li_pk_idx", ["l_partkey"], ["l_quantity"]),
+    ]
+
+INDEXED_TABLES = {"li_ok_idx": "lineitem", "od_ok_idx": "orders",
+                  "li_ship_idx": "lineitem", "sr_cust_idx": "store_returns",
+                  "li_pk_idx": "lineitem"}
+
+
+# ---------------------------------------------------------------------------
+# Query set. TPC-H/TPC-DS shaped plans in the DataFrame API (no SQL parser
+# yet — the stability surface is the optimized plan, which is what the
+# reference's golden files capture too).
+# ---------------------------------------------------------------------------
+
+def queries(dfs):
+    from hyperspace_tpu.plan.expr import avg, col, count, sum_
+
+    li, od, pt = dfs["lineitem"], dfs["orders"], dfs["part"]
+    sr, dd, cu = dfs["store_returns"], dfs["date_dim"], dfs["customer"]
+
+    d = datetime.date
+    q = {}
+
+    # TPC-H Q1: pricing summary report.
+    q["tpch_q1"] = (
+        li.filter(col("l_shipdate") <= d(1998, 9, 2))
+        .group_by("l_returnflag", "l_linestatus")
+        .agg(sum_(col("l_quantity")).alias("sum_qty"),
+             sum_(col("l_extendedprice")).alias("sum_base_price"),
+             sum_(col("l_extendedprice") * (1 - col("l_discount"))).alias("sum_disc_price"),
+             avg(col("l_quantity")).alias("avg_qty"),
+             count(col("l_quantity")).alias("count_order"))
+        .sort("l_returnflag", "l_linestatus"))
+
+    # TPC-H Q3: shipping priority (the BASELINE join query).
+    cutoff = d(1995, 3, 15)
+    q["tpch_q3"] = (
+        li.filter(col("l_shipdate") > cutoff)
+        .join(od.filter(col("o_orderdate") < cutoff),
+              on=col("l_orderkey") == col("o_orderkey"))
+        .group_by("l_orderkey", "o_orderdate", "o_shippriority")
+        .agg(sum_(col("l_extendedprice") * (1 - col("l_discount"))).alias("revenue"))
+        .sort(("revenue", False), "o_orderdate").limit(10))
+
+    # TPC-H Q6: forecasting revenue change.
+    q["tpch_q6"] = (
+        li.filter(col("l_shipdate").between(d(1994, 1, 1), d(1994, 12, 31))
+                  & col("l_discount").between(0.05, 0.07)
+                  & (col("l_quantity") < 24))
+        .agg(sum_(col("l_extendedprice") * col("l_discount")).alias("revenue")))
+
+    # TPC-H Q12-lite: shipmode priority counts.
+    q["tpch_q12"] = (
+        li.filter(col("l_shipmode").isin(["MAIL", "SHIP"])
+                  & col("l_shipdate").between(d(1994, 1, 1), d(1994, 12, 31)))
+        .join(od, on=col("l_orderkey") == col("o_orderkey"))
+        .group_by("l_shipmode")
+        .agg(count(col("o_orderkey")).alias("n"))
+        .sort("l_shipmode"))
+
+    # TPC-DS Q1-like: customers with large returns per store.
+    q["tpcds_q1_like"] = (
+        sr.join(dd.filter(col("d_year") == 2000),
+                on=col("sr_returned_date_sk") == col("d_date_sk"))
+        .group_by("sr_customer_sk", "sr_store_sk")
+        .agg(sum_(col("sr_return_amt")).alias("total_return"))
+        .join(cu, on=col("sr_customer_sk") == col("c_customer_sk"))
+        .sort(("total_return", False)).limit(20))
+
+    # Self-join over the same indexed key (reference E2E covers self-join).
+    q["self_join"] = (
+        li.select("l_orderkey", "l_discount")
+        .join(li.select(col("l_orderkey").alias("r_orderkey"),
+                        col("l_extendedprice")),
+              on=col("l_orderkey") == col("r_orderkey")))
+
+    return q

@@ -1,0 +1,104 @@
+"""Join-rule alias/base-namespace regression tests (multi-Project chains,
+filters above renames, duplicate alias pairs)."""
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+import hyperspace_tpu as hst
+from hyperspace_tpu.api import Hyperspace, IndexConfig
+from hyperspace_tpu.index.constants import IndexConstants
+from hyperspace_tpu.plan.expr import col
+from hyperspace_tpu.plan.nodes import IndexScan
+
+
+@pytest.fixture()
+def env(tmp_system_path, tmp_path):
+    rng = np.random.default_rng(7)
+    n = 600
+    d1 = tmp_path / "t1"
+    d2 = tmp_path / "t2"
+    d1.mkdir(), d2.mkdir()
+    pq.write_table(pa.table({
+        "a": pa.array(rng.integers(0, 30, n).astype(np.int32)),
+        "b": pa.array(rng.uniform(0, 1, n)),
+        "c": pa.array(rng.uniform(0, 1, n)),
+    }), str(d1 / "p.parquet"))
+    pq.write_table(pa.table({
+        "k": pa.array(np.arange(40, dtype=np.int32)),
+        "v": pa.array(rng.uniform(0, 1, 40)),
+    }), str(d2 / "p.parquet"))
+    session = hst.Session(system_path=tmp_system_path)
+    session.conf.set(IndexConstants.INDEX_NUM_BUCKETS, 4)
+    hs = Hyperspace(session)
+    df1 = session.read.parquet(str(d1))
+    df2 = session.read.parquet(str(d2))
+    hs.create_index(df1, IndexConfig("i1", ["a"], ["b"]))
+    hs.create_index(df2, IndexConfig("i2", ["k"], ["v"]))
+    session.enable_hyperspace()
+    return session, df1, df2
+
+
+def _key(t):
+    return t.sort_by([(c, "ascending") for c in t.column_names])
+
+
+def _oracle(session, q):
+    with_idx = _key(q.to_arrow())
+    session.disable_hyperspace()
+    without = _key(q.to_arrow())
+    session.enable_hyperspace()
+    assert with_idx.equals(without)
+
+
+def _leaves(q):
+    return [l for l in q.optimized_plan().collect_leaves()
+            if isinstance(l, IndexScan)]
+
+
+class TestJoinAliasHandling:
+    def test_stacked_projects_skip_not_crash(self, env):
+        """An inner Project reading a non-covered column must skip the
+        rewrite cleanly (it used to raise during plan rebuilding)."""
+        session, df1, df2 = env
+        q = df1.select("a", "c").select("a") \
+            .join(df2, on=col("a") == col("k"))
+        plan = q.optimized_plan()  # must not raise.
+        assert not any(isinstance(l, IndexScan) for l in plan.collect_leaves())
+        _oracle(session, q)
+
+    def test_stacked_projects_rewrite_when_covered(self, env):
+        session, df1, df2 = env
+        q = df1.select("a", "b").select("a") \
+            .join(df2, on=col("a") == col("k"))
+        assert len(_leaves(q)) == 2
+        _oracle(session, q)
+
+    def test_filter_above_alias_is_covered(self, env):
+        """Filter over the renamed column: coverage must translate x→a."""
+        session, df1, df2 = env
+        q = df1.select(col("a").alias("x"), col("b")) \
+            .filter(col("x") > 5) \
+            .join(df2, on=col("x") == col("k"))
+        assert len(_leaves(q)) == 2
+        _oracle(session, q)
+
+    def test_duplicate_alias_pairs_collapse(self, env):
+        """Two alias pairs of one base pair must still rewrite (dedup in
+        base space)."""
+        session, df1, df2 = env
+        left = df1.select(col("a").alias("x"), col("a").alias("y"),
+                          col("b"))
+        right = df2.select(col("k").alias("u"), col("k").alias("w"),
+                           col("v"))
+        q = left.join(right, on=(col("x") == col("u")) & (col("y") == col("w")))
+        assert len(_leaves(q)) == 2
+        _oracle(session, q)
+
+    def test_computed_join_key_disqualifies(self, env):
+        session, df1, df2 = env
+        q = df1.select((col("a") * 1).alias("x"), col("b")) \
+            .join(df2, on=col("x") == col("k"))
+        assert not _leaves(q)
+        _oracle(session, q)

@@ -1,0 +1,119 @@
+"""Golden-file plan-stability tests.
+
+Parity with the reference's goldstandard/PlanStabilitySuite.scala:84: run a
+fixed TPC-H/TPC-DS-shaped query set, normalize the optimized plan (strip
+temp paths and other run-dependent tokens), and diff against approved golden
+files — once with hyperspace disabled, once with indexes created + enabled.
+
+Regenerate after an intentional plan change with:
+
+    GENERATE_GOLDEN_FILES=1 python -m pytest tests/test_plan_stability.py
+"""
+
+import os
+import re
+
+import pytest
+
+import hyperspace_tpu as hst
+from hyperspace_tpu.api import Hyperspace
+from hyperspace_tpu.index.constants import IndexConstants
+
+from goldstandard import tpc
+
+GOLDEN_DIR = os.path.join(os.path.dirname(__file__), "resources",
+                          "golden_plans")
+GENERATE = os.environ.get("GENERATE_GOLDEN_FILES") == "1"
+
+
+def normalize_plan(s: str) -> str:
+    """Strip run-dependent tokens: absolute temp paths and log versions
+    (parity: the reference strips expr ids and locations)."""
+    s = re.sub(r"(?:/[\w.\-]+)*/(?:data|indexes)/", "<root>/", s)
+    s = re.sub(r"LogVersion: \d+", "LogVersion: <v>", s)
+    return s.rstrip() + "\n"
+
+
+@pytest.fixture(scope="module")
+def harness(tmp_path_factory):
+    root = tmp_path_factory.mktemp("tpc")
+    session = hst.Session(system_path=str(root / "indexes"))
+    session.conf.set(IndexConstants.INDEX_NUM_BUCKETS, 4)
+    dfs = tpc.register_tables(session, str(root / "data"))
+    hs = Hyperspace(session)
+    for cfg in tpc.index_configs():
+        hs.create_index(dfs[tpc.INDEXED_TABLES[cfg.index_name]], cfg)
+    return session, tpc.queries(dfs)
+
+
+def _check(mode: str, name: str, plan_str: str):
+    path = os.path.join(GOLDEN_DIR, mode, f"{name}.txt")
+    actual = normalize_plan(plan_str)
+    if GENERATE:
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        with open(path, "w") as f:
+            f.write(actual)
+        return
+    assert os.path.isfile(path), \
+        f"Missing golden file {path}; regenerate with GENERATE_GOLDEN_FILES=1"
+    with open(path) as f:
+        expected = f.read()
+    assert actual == expected, (
+        f"Optimized plan for {name} ({mode}) changed.\n--- expected ---\n"
+        f"{expected}\n--- actual ---\n{actual}\n"
+        "If intentional, regenerate with GENERATE_GOLDEN_FILES=1")
+
+
+@pytest.mark.parametrize("name", ["tpch_q1", "tpch_q3", "tpch_q6", "tpch_q12",
+                                  "tpcds_q1_like", "self_join"])
+class TestPlanStability:
+    def test_disabled(self, harness, name):
+        session, queries = harness
+        session.disable_hyperspace()
+        _check("disabled", name, queries[name].optimized_plan().tree_string())
+
+    def test_enabled(self, harness, name):
+        session, queries = harness
+        session.enable_hyperspace()
+        _check("enabled", name, queries[name].optimized_plan().tree_string())
+
+    def test_enabled_equals_disabled_answers(self, harness, name):
+        """The disable-and-compare oracle over the whole golden query set.
+        Float columns compare with tolerance: the index path sums rows in
+        bucket-sorted order, so f64 aggregates differ by ~1 ulp (the
+        reference's checkAnswer tolerates doubles the same way)."""
+        import numpy as np
+        import pyarrow as pa
+
+        session, queries = harness
+        q = queries[name]
+        session.enable_hyperspace()
+        with_idx = q.to_arrow()
+        session.disable_hyperspace()
+        without = q.to_arrow()
+        key = lambda t: t.sort_by([(c, "ascending") for c in t.column_names])
+        a, b = key(with_idx), key(without)
+        assert a.column_names == b.column_names and a.num_rows == b.num_rows
+        for col_name in a.column_names:
+            ca, cb = a.column(col_name), b.column(col_name)
+            if pa.types.is_floating(ca.type):
+                np.testing.assert_allclose(
+                    ca.to_numpy(zero_copy_only=False),
+                    cb.to_numpy(zero_copy_only=False), rtol=1e-9)
+            else:
+                assert ca.equals(cb), f"column {col_name} differs"
+
+
+class TestExpectedRewrites:
+    """Pin which queries must (not) be rewritten — a reviewable summary of
+    the rewrite surface, independent of the golden text."""
+
+    EXPECT = {"tpch_q1": False, "tpch_q3": True, "tpch_q6": True,
+              "tpch_q12": False, "tpcds_q1_like": False, "self_join": True}
+
+    def test_rewrite_expectations(self, harness):
+        session, queries = harness
+        session.enable_hyperspace()
+        got = {name: "IndexScan" in q.optimized_plan().tree_string()
+               for name, q in queries.items()}
+        assert got == self.EXPECT
