@@ -1,0 +1,273 @@
+"""Native host-ops runtime: builds and binds hst_native.cpp via ctypes.
+
+The shared library is compiled once per source hash into
+``~/.cache/hyperspace_tpu/native/`` (g++ -O3) and loaded with ctypes; when
+no compiler is available (or HST_NATIVE=off), every entry point falls back
+to a vectorized numpy implementation with identical semantics, so callers
+use this module unconditionally.
+
+Entry points (all host-side scan-planning hot loops):
+
+- ``bloom_probe_many``: one literal against many per-file bloom bitsets.
+- ``minmax_prune``: one comparison literal against per-file (min, max) rows.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+from typing import List, Optional
+
+import numpy as np
+
+_SRC = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                    "hst_native.cpp")
+
+_lib = None
+_lib_tried = False
+
+
+def _cache_dir() -> str:
+    return os.environ.get(
+        "HST_NATIVE_CACHE_DIR",
+        os.path.join(os.path.expanduser("~"), ".cache", "hyperspace_tpu",
+                     "native"))
+
+
+def _build() -> Optional[ctypes.CDLL]:
+    with open(_SRC, "rb") as f:
+        src = f.read()
+    tag = hashlib.md5(src).hexdigest()[:16]
+    out_dir = _cache_dir()
+    so_path = os.path.join(out_dir, f"hst_native_{tag}.so")
+    if not os.path.isfile(so_path):
+        os.makedirs(out_dir, exist_ok=True)
+        tmp = so_path + f".tmp{os.getpid()}"
+        cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", _SRC,
+               "-o", tmp]
+        try:
+            subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        except Exception:
+            return None
+        os.replace(tmp, so_path)  # atomic: concurrent builders converge.
+    lib = ctypes.CDLL(so_path)
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+    i32p = ctypes.POINTER(ctypes.c_int32)
+    i64p = ctypes.POINTER(ctypes.c_int64)
+    f64p = ctypes.POINTER(ctypes.c_double)
+    lib.hst_bloom_probe_many.argtypes = [
+        u8p, ctypes.c_int64, ctypes.c_int64, u8p, i32p, ctypes.c_int32, u8p]
+    lib.hst_bloom_probe_many.restype = None
+    lib.hst_minmax_prune_f64.argtypes = [
+        f64p, f64p, u8p, ctypes.c_int64, ctypes.c_double, ctypes.c_int32, u8p]
+    lib.hst_minmax_prune_f64.restype = None
+    lib.hst_minmax_prune_i64.argtypes = [
+        i64p, i64p, u8p, ctypes.c_int64, ctypes.c_int64, ctypes.c_int32, u8p]
+    lib.hst_minmax_prune_i64.restype = None
+    return lib
+
+
+def get_lib() -> Optional[ctypes.CDLL]:
+    global _lib, _lib_tried
+    if not _lib_tried:
+        _lib_tried = True
+        if os.environ.get("HST_NATIVE", "on") != "off":
+            try:
+                _lib = _build()
+            except Exception:
+                _lib = None
+    return _lib
+
+
+def available() -> bool:
+    return get_lib() is not None
+
+
+_OPS = {"EqualTo": 0, "LessThan": 1, "LessThanOrEqual": 2,
+        "GreaterThan": 3, "GreaterThanOrEqual": 4}
+
+
+def _as_u8p(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
+
+
+# ---------------------------------------------------------------------------
+# Bloom probe: one literal vs many per-file bitsets.
+# ---------------------------------------------------------------------------
+
+def bloom_positions(value, dtype: str, num_bits: int,
+                    num_hashes: int) -> np.ndarray:
+    """The literal's k probe positions, mirroring ops/sketches.py double
+    hashing (wrapping uint32 arithmetic)."""
+    from ..ops import kernels
+    from ..ops.sketches import _h2_host
+
+    h1 = kernels.hash32_value_host(value, dtype)
+    h2 = _h2_host(h1)
+    return np.array([((h1 + i * h2) & 0xFFFFFFFF) % num_bits
+                     for i in range(num_hashes)], dtype=np.int32)
+
+
+def bloom_probe_many(bits_rows: List[Optional[bytes]], value, dtype: str,
+                     num_bits: int, num_hashes: int) -> np.ndarray:
+    """keep-mask over files: False where the bitset proves the literal
+    absent. Missing bitsets (None) keep the file."""
+    n = len(bits_rows)
+    stride = num_bits // 8
+    positions = bloom_positions(value, dtype, num_bits, num_hashes)
+    buf = np.zeros((n, stride), dtype=np.uint8)
+    valid = np.zeros(n, dtype=np.uint8)
+    for i, b in enumerate(bits_rows):
+        if b is not None:
+            row = np.frombuffer(b, dtype=np.uint8)
+            buf[i, :row.shape[0]] = row[:stride]
+            valid[i] = 1
+    lib = get_lib()
+    out = np.zeros(n, dtype=np.uint8)
+    if lib is not None:
+        lib.hst_bloom_probe_many(
+            _as_u8p(buf), stride, n, _as_u8p(valid),
+            positions.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            len(positions), _as_u8p(out))
+        return out.astype(bool)
+    # numpy fallback: gather each position's byte, test the MSB-first bit.
+    keep = np.ones(n, dtype=bool)
+    for p in positions:
+        byte = buf[:, p >> 3]
+        keep &= ((byte >> (7 - (p & 7))) & 1).astype(bool)
+    return keep | ~valid.astype(bool)
+
+
+# ---------------------------------------------------------------------------
+# MinMax prune: one comparison vs many per-file (min, max) rows.
+# ---------------------------------------------------------------------------
+
+_I64_MAX = 2**63 - 1
+_I64_MIN = -(2**63)
+
+
+def _int_domain_literal(op: str, value):
+    """Rewrite ``col <op> value`` for an integer-domain column into an exact
+    int64 form. Returns one of:
+
+    - (op, int_value): the (possibly transformed) comparison;
+    - ("ALL", None): the predicate keeps every file;
+    - ("NONE", None): no stats-backed file can match (all-null files are
+      still kept by the caller — only IS NULL matches them).
+
+    Handles fractional float literals (col < 5.5 ⇔ col <= 5) and literals
+    outside int64 range (which would otherwise wrap through c_int64)."""
+    import math
+
+    if isinstance(value, float):
+        if math.isnan(value):
+            return "NONE", None
+        if math.isinf(value):
+            up = value > 0
+            keep_all = (op in ("LessThan", "LessThanOrEqual")) == up
+            return ("ALL", None) if keep_all else ("NONE", None)
+        if not float(value).is_integer():
+            if op == "EqualTo":
+                return "NONE", None  # no integer equals a fractional.
+            if op in ("LessThan", "LessThanOrEqual"):
+                bound = math.floor(value)           # col <= floor(v)
+                if bound > _I64_MAX:
+                    return "ALL", None
+                if bound < _I64_MIN:
+                    return "NONE", None
+                return "LessThanOrEqual", bound
+            bound = math.floor(value) + 1           # col >= floor(v)+1
+            if bound < _I64_MIN:
+                return "ALL", None
+            if bound > _I64_MAX:
+                return "NONE", None
+            return "GreaterThanOrEqual", bound
+    v = int(value)
+    if v > _I64_MAX:
+        return ("ALL", None) if op in ("LessThan", "LessThanOrEqual") \
+            else ("NONE", None)
+    if v < _I64_MIN:
+        return ("ALL", None) if op in ("GreaterThan", "GreaterThanOrEqual") \
+            else ("NONE", None)
+    return op, v
+
+
+def minmax_prune(lo_rows: List, hi_rows: List, op: str, value, dtype: str
+                 ) -> Optional[np.ndarray]:
+    """keep-mask over files for ``col <op> value`` given per-file min/max.
+    Returns None when the dtype isn't supported natively (caller falls back
+    to the generic Python path — e.g. strings)."""
+    import datetime
+    import math
+
+    from ..schema import BOOL, DATE, FLOAT32, FLOAT64, INT32, INT64
+
+    n = len(lo_rows)
+    has = np.array([l is not None and h is not None
+                    for l, h in zip(lo_rows, hi_rows)], dtype=np.uint8)
+
+    def fill(rows, np_dtype, conv):
+        a = np.zeros(n, dtype=np_dtype)
+        for i, r in enumerate(rows):
+            if has[i]:
+                a[i] = conv(r)
+        return a
+
+    lib = get_lib()
+    out = np.zeros(n, dtype=np.uint8)
+    if dtype in (INT32, INT64, BOOL, DATE):
+        if dtype == DATE:
+            epoch = datetime.date(1970, 1, 1)
+            conv = lambda v: (v - epoch).days
+            v = conv(value)
+        else:
+            conv = int
+            op, v = _int_domain_literal(op, value)
+            if op == "ALL":
+                return np.ones(n, dtype=bool)
+            if op == "NONE":
+                return ~has.astype(bool)  # only all-null files survive.
+        op_code = _OPS[op]
+        lo = fill(lo_rows, np.int64, conv)
+        hi = fill(hi_rows, np.int64, conv)
+        if lib is not None:
+            lib.hst_minmax_prune_i64(
+                lo.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+                hi.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+                _as_u8p(has), n, v, op_code, _as_u8p(out))
+            return out.astype(bool)
+        return _np_prune(lo, hi, has, v, op_code)
+    if dtype in (FLOAT32, FLOAT64):
+        try:
+            v = float(value)
+        except OverflowError:
+            v = math.inf if value > 0 else -math.inf
+        if math.isnan(v):
+            return ~has.astype(bool)
+        op_code = _OPS[op]
+        lo = fill(lo_rows, np.float64, float)
+        hi = fill(hi_rows, np.float64, float)
+        if lib is not None:
+            lib.hst_minmax_prune_f64(
+                lo.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+                hi.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+                _as_u8p(has), n, v, op_code, _as_u8p(out))
+            return out.astype(bool)
+        return _np_prune(lo, hi, has, v, op_code)
+    return None
+
+
+def _np_prune(lo, hi, has, v, op_code) -> np.ndarray:
+    if op_code == 0:
+        keep = (lo <= v) & (v <= hi)
+    elif op_code == 1:
+        keep = lo < v
+    elif op_code == 2:
+        keep = lo <= v
+    elif op_code == 3:
+        keep = hi > v
+    else:
+        keep = hi >= v
+    return keep | ~has.astype(bool)

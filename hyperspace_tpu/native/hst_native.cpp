@@ -1,0 +1,79 @@
+// Native host-side scan-planning kernels.
+//
+// Scan planning probes per-file sketches (MinMax ranges, bloom bitsets) for
+// every candidate file of a query — a host-side hot loop at lake scale
+// (thousands of files x predicates), independent of the TPU compute path.
+// The reference delegates this class of work to the JVM; here it is C++
+// loaded via ctypes (hyperspace_tpu/native/__init__.py), with semantics
+// mirroring the Python/numpy implementations bit-for-bit:
+//
+// - bloom bitsets are np.packbits layout (MSB-first within each byte);
+// - probe positions are precomputed by the caller with the same wrapping
+//   uint32 double-hashing as ops/sketches.py (device/host mirrored);
+// - comparison ops are encoded 0..4 = between/lt/le/gt/ge.
+
+#include <cstdint>
+
+extern "C" {
+
+// Probe n_filters equal-size packed bitsets for one literal whose k bit
+// positions are precomputed. valid[i]==0 means "no sketch for this file"
+// (missing bitset) -> keep (out=1).
+void hst_bloom_probe_many(const uint8_t* bits, int64_t stride_bytes,
+                          int64_t n_filters, const uint8_t* valid,
+                          const int32_t* positions, int32_t n_pos,
+                          uint8_t* out) {
+  for (int64_t f = 0; f < n_filters; ++f) {
+    if (!valid[f]) {
+      out[f] = 1;
+      continue;
+    }
+    const uint8_t* b = bits + f * stride_bytes;
+    uint8_t keep = 1;
+    for (int32_t i = 0; i < n_pos; ++i) {
+      const int32_t p = positions[i];
+      if (!((b[p >> 3] >> (7 - (p & 7))) & 1)) {
+        keep = 0;
+        break;
+      }
+    }
+    out[f] = keep;
+  }
+}
+
+// op: 0 = equality probe (lo <= v <= hi), 1 = '<' (lo < v),
+//     2 = '<=' (lo <= v), 3 = '>' (hi > v), 4 = '>=' (hi >= v).
+// has[i]==0 -> all-null file stats: keep (out=1), matching the Python path.
+#define MINMAX_PRUNE_IMPL(T)                                          \
+  for (int64_t i = 0; i < n; ++i) {                                   \
+    if (!has[i]) {                                                    \
+      out[i] = 1;                                                     \
+      continue;                                                       \
+    }                                                                 \
+    const T l = lo[i];                                                \
+    const T h = hi[i];                                                \
+    uint8_t keep = 1;                                                 \
+    switch (op) {                                                     \
+      case 0: keep = (l <= value) && (value <= h); break;             \
+      case 1: keep = l < value; break;                                \
+      case 2: keep = l <= value; break;                               \
+      case 3: keep = h > value; break;                                \
+      case 4: keep = h >= value; break;                               \
+      default: keep = 1; break;                                       \
+    }                                                                 \
+    out[i] = keep;                                                    \
+  }
+
+void hst_minmax_prune_f64(const double* lo, const double* hi,
+                          const uint8_t* has, int64_t n, double value,
+                          int32_t op, uint8_t* out) {
+  MINMAX_PRUNE_IMPL(double)
+}
+
+void hst_minmax_prune_i64(const int64_t* lo, const int64_t* hi,
+                          const uint8_t* has, int64_t n, int64_t value,
+                          int32_t op, uint8_t* out) {
+  MINMAX_PRUNE_IMPL(int64_t)
+}
+
+}  // extern "C"

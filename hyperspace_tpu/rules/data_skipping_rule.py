@@ -20,7 +20,6 @@ import numpy as np
 import pyarrow.parquet as pq
 
 from ..index.log_entry import IndexLogEntry, Sketch
-from ..ops import sketches as sk
 from ..plan import expr as E
 from ..plan.nodes import Filter, LogicalPlan, Scan
 from .rule_utils import _plan_signature, get_relation
@@ -180,35 +179,39 @@ def _eval_compare(column: str, op: str, value, table, sketch_by_col,
         nonlocal out
         out = m if out is None else (out & m)
 
+    from .. import native
+
     for s in sketches:
         if s.kind == "MinMax":
             lo_name, hi_name = minmax_cols(column)
             lo, hi = table[lo_name], table[hi_name]
-            m = np.ones(n, dtype=bool)
-            for i in range(n):
-                if lo[i] is None or hi[i] is None:
-                    continue  # all-null file: only IS NULL could match; keep.
-                if op == "EqualTo":
-                    m[i] = lo[i] <= value <= hi[i]
-                elif op == "LessThan":
-                    m[i] = lo[i] < value
-                elif op == "LessThanOrEqual":
-                    m[i] = lo[i] <= value
-                elif op == "GreaterThan":
-                    m[i] = hi[i] > value
-                elif op == "GreaterThanOrEqual":
-                    m[i] = hi[i] >= value
+            dtype = relation_schema.field(column).dtype
+            # Native (or vectorized) prune over all files in one call; the
+            # generic Python loop remains for unsupported dtypes (strings).
+            m = native.minmax_prune(lo, hi, op, value, dtype)
+            if m is None:
+                m = np.ones(n, dtype=bool)
+                for i in range(n):
+                    if lo[i] is None or hi[i] is None:
+                        continue  # all-null file: only IS NULL matches; keep.
+                    if op == "EqualTo":
+                        m[i] = lo[i] <= value <= hi[i]
+                    elif op == "LessThan":
+                        m[i] = lo[i] < value
+                    elif op == "LessThanOrEqual":
+                        m[i] = lo[i] <= value
+                    elif op == "GreaterThan":
+                        m[i] = hi[i] > value
+                    elif op == "GreaterThanOrEqual":
+                        m[i] = hi[i] >= value
             apply_mask(m)
         elif s.kind == "BloomFilter" and op == "EqualTo":
             dtype = relation_schema.field(column).dtype
             num_bits = int(s.properties["numBits"])
             num_hashes = int(s.properties["numHashes"])
             bits_rows = table[bloom_col(column)]
-            m = np.array([
-                sk.bloom_might_contain(
-                    np.frombuffer(b, dtype=np.uint8), value, dtype,
-                    num_bits, num_hashes) if b is not None else True
-                for b in bits_rows], dtype=bool)
+            m = native.bloom_probe_many(bits_rows, value, dtype,
+                                        num_bits, num_hashes)
             apply_mask(m)
     return out
 

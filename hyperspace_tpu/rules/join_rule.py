@@ -37,38 +37,37 @@ from .rule_utils import (collect_base_references, get_candidate_indexes,
                          output_to_base_mapping, transform_plan_to_use_index)
 
 
-def _column_mapping(join: Join, pairs) -> Optional[Tuple[List[str], List[str]]]:
-    """Normalize pairs to (left cols, right cols); require a 1:1 mapping
-    (parity: ensureAttributeRequirements, JoinIndexRule.scala:234)."""
-    left_names = set(join.left.schema.names)
-    right_names = set(join.right.schema.names)
-    l_cols, r_cols = [], []
-    for a, b in pairs:
-        if a in left_names and b in right_names:
-            l_cols.append(a)
-            r_cols.append(b)
-        elif b in left_names and a in right_names:
-            l_cols.append(b)
-            r_cols.append(a)
-        else:
-            return None
-    # 1:1: no left column maps to two right columns or vice versa.
+def _ensure_one_to_one(pairs) -> Optional[Tuple[List[str], List[str]]]:
+    """Order-preserving dedup of (l, r) pairs + 1:1 check: no left column may
+    map to two right columns or vice versa (parity:
+    ensureAttributeRequirements, JoinIndexRule.scala:234). Applied once in
+    output namespace and again after base-column translation."""
     l_to_r: Dict[str, str] = {}
     r_to_l: Dict[str, str] = {}
-    for l, r in zip(l_cols, r_cols):
+    uniq: List[Tuple[str, str]] = []
+    for l, r in pairs:
         if l_to_r.get(l, r) != r or r_to_l.get(r, l) != l:
             return None
+        if l not in l_to_r:
+            uniq.append((l, r))
         l_to_r[l] = r
         r_to_l[r] = l
-    # De-dup repeated pairs while preserving order.
-    seen = set()
-    uniq_l, uniq_r = [], []
-    for l, r in zip(l_cols, r_cols):
-        if (l, r) not in seen:
-            seen.add((l, r))
-            uniq_l.append(l)
-            uniq_r.append(r)
-    return uniq_l, uniq_r
+    return [p[0] for p in uniq], [p[1] for p in uniq]
+
+
+def _column_mapping(join: Join, pairs) -> Optional[Tuple[List[str], List[str]]]:
+    """Normalize pairs to (left cols, right cols) under a 1:1 mapping."""
+    left_names = set(join.left.schema.names)
+    right_names = set(join.right.schema.names)
+    sided = []
+    for a, b in pairs:
+        if a in left_names and b in right_names:
+            sided.append((a, b))
+        elif b in left_names and a in right_names:
+            sided.append((b, a))
+        else:
+            return None
+    return _ensure_one_to_one(sided)
 
 
 def _usable_indexes(session, side_plan: LogicalPlan, scan: Scan,
@@ -161,16 +160,10 @@ def try_rewrite_join(session, join: Join,
     # Re-establish the dedup + 1:1 invariant in base space: two alias pairs
     # of the same base pair collapse to one; conflicting base mappings
     # disqualify the join.
-    base_pairs = list(dict.fromkeys(zip(l_cols, r_cols)))
-    l_to_r: Dict[str, str] = {}
-    r_to_l: Dict[str, str] = {}
-    for l, r in base_pairs:
-        if l_to_r.get(l, r) != r or r_to_l.get(r, l) != l:
-            return None
-        l_to_r[l] = r
-        r_to_l[r] = l
-    l_cols = [p[0] for p in base_pairs]
-    r_cols = [p[1] for p in base_pairs]
+    based = _ensure_one_to_one(zip(l_cols, r_cols))
+    if based is None:
+        return None
+    l_cols, r_cols = based
 
     l_scan = join.left.collect_leaves()[0]
     r_scan = join.right.collect_leaves()[0]

@@ -1,0 +1,179 @@
+"""Native host-ops tests: the C++ library must agree bit-for-bit with the
+numpy fallback and the original per-row Python probes."""
+
+import datetime
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from hyperspace_tpu import native
+from hyperspace_tpu.execution.columnar import Table
+from hyperspace_tpu.ops import sketches
+from hyperspace_tpu.schema import DATE, FLOAT64, INT64, STRING
+
+
+@pytest.fixture(scope="module")
+def lib_available():
+    if not native.available():
+        pytest.skip("no C++ toolchain available")
+
+
+def _bloom_rows(n_filters=40, num_bits=256, num_hashes=4, seed=0):
+    """Per-filter bitsets built by the real device/host builder."""
+    rng = np.random.default_rng(seed)
+    rows, contents = [], []
+    for i in range(n_filters):
+        vals = rng.integers(0, 1000, 20).astype(np.int64)
+        t = Table.from_arrow(pa.table({"v": pa.array(vals)}))
+        rows.append(sketches.bloom_build(
+            t.column("v"), num_bits, num_hashes).tobytes())
+        contents.append(set(vals.tolist()))
+    return rows, contents
+
+
+class TestBloomProbeMany:
+    def test_native_matches_reference_probe(self, lib_available):
+        rows, contents = _bloom_rows()
+        for value in (3, 57, 999, 123456):
+            got = native.bloom_probe_many(rows, value, INT64, 256, 4)
+            want = np.array([
+                sketches.bloom_might_contain(
+                    np.frombuffer(b, np.uint8), value, INT64, 256, 4)
+                for b in rows])
+            np.testing.assert_array_equal(got, want)
+            # No false negatives ever.
+            present = np.array([value in c for c in contents])
+            assert np.all(got[present])
+
+    def test_none_rows_kept(self, lib_available):
+        rows, _ = _bloom_rows(n_filters=5)
+        rows[2] = None
+        got = native.bloom_probe_many(rows, 1, INT64, 256, 4)
+        assert got[2]
+
+    def test_fallback_agrees_with_native(self, lib_available, monkeypatch):
+        rows, _ = _bloom_rows(n_filters=16, seed=3)
+        with_native = native.bloom_probe_many(rows, 57, INT64, 256, 4)
+        monkeypatch.setattr(native, "_lib", None)
+        monkeypatch.setattr(native, "_lib_tried", True)
+        without = native.bloom_probe_many(rows, 57, INT64, 256, 4)
+        np.testing.assert_array_equal(with_native, without)
+
+
+class TestMinMaxPrune:
+    CASES = [
+        ("EqualTo", 5), ("LessThan", 5), ("LessThanOrEqual", 1),
+        ("GreaterThan", 9), ("GreaterThanOrEqual", 10)]
+
+    def test_int_semantics(self, lib_available):
+        lo = [1, None, 5, 8]
+        hi = [4, None, 9, 10]
+        for op, v in self.CASES:
+            got = native.minmax_prune(lo, hi, op, v, INT64)
+            assert got is not None and got[1]  # all-null row always kept.
+            for i in (0, 2, 3):
+                if op == "EqualTo":
+                    want = lo[i] <= v <= hi[i]
+                elif op == "LessThan":
+                    want = lo[i] < v
+                elif op == "LessThanOrEqual":
+                    want = lo[i] <= v
+                elif op == "GreaterThan":
+                    want = hi[i] > v
+                else:
+                    want = hi[i] >= v
+                assert got[i] == want, (op, v, i)
+
+    def test_date_and_float(self, lib_available):
+        d = datetime.date
+        got = native.minmax_prune(
+            [d(2020, 1, 1), d(2021, 1, 1)], [d(2020, 6, 1), d(2021, 6, 1)],
+            "EqualTo", d(2020, 3, 1), DATE)
+        np.testing.assert_array_equal(got, [True, False])
+        got = native.minmax_prune([0.5, 2.5], [1.0, 3.0],
+                                  "LessThan", 0.9, FLOAT64)
+        np.testing.assert_array_equal(got, [True, False])
+
+    def test_string_unsupported(self):
+        assert native.minmax_prune(["a"], ["b"], "EqualTo", "a", STRING) is None
+
+    def test_fractional_literal_on_int_column(self):
+        """col < 5.5 must keep a file with min=5 (rows with value 5 match);
+        int() truncation would wrongly prune it."""
+        got = native.minmax_prune([5], [9], "LessThan", 5.5, INT64)
+        np.testing.assert_array_equal(got, [True])
+        got = native.minmax_prune([-9], [-4], "GreaterThan", -4.5, INT64)
+        np.testing.assert_array_equal(got, [True])
+        # Fractional equality matches no integer: prune stats-backed files,
+        # keep all-null ones.
+        got = native.minmax_prune([5, None], [9, None], "EqualTo", 5.5, INT64)
+        np.testing.assert_array_equal(got, [False, True])
+        # Fractional bounds that exclude: col < 4.5 ⇔ col <= 4 prunes min=5.
+        got = native.minmax_prune([5], [9], "LessThan", 4.5, INT64)
+        np.testing.assert_array_equal(got, [False])
+
+    def test_out_of_int64_range_literals(self):
+        """Literals beyond int64 must not wrap through c_int64."""
+        got = native.minmax_prune([5], [9], "LessThan", 2**63, INT64)
+        np.testing.assert_array_equal(got, [True])
+        got = native.minmax_prune([5], [9], "GreaterThan", 2**63, INT64)
+        np.testing.assert_array_equal(got, [False])
+        got = native.minmax_prune([5, None], [9, None], "EqualTo", 2**70,
+                                  INT64)
+        np.testing.assert_array_equal(got, [False, True])
+        got = native.minmax_prune([5], [9], "GreaterThan", -(2**70), INT64)
+        np.testing.assert_array_equal(got, [True])
+        got = native.minmax_prune([5], [9], "LessThan", float("inf"), INT64)
+        np.testing.assert_array_equal(got, [True])
+        got = native.minmax_prune([5], [9], "GreaterThan", float("inf"),
+                                  INT64)
+        np.testing.assert_array_equal(got, [False])
+
+    def test_fallback_agrees(self, lib_available, monkeypatch):
+        rng = np.random.default_rng(1)
+        lo = rng.integers(0, 50, 200).tolist()
+        hi = [l + int(d) for l, d in zip(lo, rng.integers(0, 30, 200))]
+        for op, v in self.CASES:
+            with_native = native.minmax_prune(lo, hi, op, v * 3, INT64)
+            monkeypatch.setattr(native, "_lib", None)
+            monkeypatch.setattr(native, "_lib_tried", True)
+            without = native.minmax_prune(lo, hi, op, v * 3, INT64)
+            monkeypatch.undo()
+            np.testing.assert_array_equal(with_native, without)
+
+
+class TestDataSkippingWithNative:
+    def test_e2e_prune_same_with_and_without_native(
+            self, lib_available, tmp_system_path, tmp_path, monkeypatch):
+        import pyarrow.parquet as pq
+
+        import hyperspace_tpu as hst
+        from hyperspace_tpu.api import (DataSkippingIndexConfig, Hyperspace,
+                                        MinMaxSketch, BloomFilterSketch)
+        from hyperspace_tpu.plan.expr import col
+
+        d = tmp_path / "t"
+        d.mkdir()
+        for i in range(6):
+            pq.write_table(pa.table({
+                "k": pa.array(np.arange(i * 100, (i + 1) * 100, dtype=np.int64)),
+                "v": pa.array(np.random.default_rng(i).uniform(0, 1, 100)),
+            }), str(d / f"p{i}.parquet"))
+        session = hst.Session(system_path=tmp_system_path)
+        hs = Hyperspace(session)
+        df = session.read.parquet(str(d))
+        hs.create_index(df, DataSkippingIndexConfig(
+            "sk", [MinMaxSketch("k"), BloomFilterSketch("k")]))
+        session.enable_hyperspace()
+        q = df.filter(col("k") == 250).select("k", "v")
+        native_plan = q.optimized_plan().tree_string()
+        res_native = q.to_arrow()
+        monkeypatch.setattr(native, "_lib", None)
+        monkeypatch.setattr(native, "_lib_tried", True)
+        fallback_plan = q.optimized_plan().tree_string()
+        res_fallback = q.to_arrow()
+        assert native_plan == fallback_plan
+        assert res_native.equals(res_fallback)
+        session.disable_hyperspace()
+        assert res_native.equals(q.to_arrow())
