@@ -25,6 +25,9 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 
+OD_PARTS = 16  # orders part files (skipping granularity).
+
+
 def make_tpch_like(root: str, scale: float, seed: int = 0):
     """Deterministic TPC-H-shaped lineitem + orders parquet datasets."""
     import numpy as np
@@ -34,15 +37,21 @@ def make_tpch_like(root: str, scale: float, seed: int = 0):
     rng = np.random.default_rng(seed)
     n_li = max(int(6_000_000 * scale), 10_000)
     n_od = max(n_li // 4, 2_500)
+    n_pt = max(n_li // 30, 200)
 
     # Days since unix epoch (date32 semantics).
     base = (datetime.date(1992, 1, 1) - datetime.date(1970, 1, 1)).days
     od_dir = os.path.join(root, "orders")
     li_dir = os.path.join(root, "lineitem")
+    pt_dir = os.path.join(root, "part")
     os.makedirs(od_dir)
     os.makedirs(li_dir)
+    os.makedirs(pt_dir)
 
-    o_orderdate = (rng.integers(0, 2400, n_od) + base).astype(np.int32)
+    # Orders arrive time-ordered (sorted by o_orderdate before splitting):
+    # each part file covers a date range, which is what makes per-file
+    # MinMax sketches prunable — the data-skipping benchmark shape.
+    o_orderdate = np.sort(rng.integers(0, 2400, n_od) + base).astype(np.int32)
     orders = pa.table({
         "o_orderkey": pa.array(np.arange(n_od, dtype=np.int64)),
         "o_custkey": pa.array(rng.integers(0, max(n_od // 10, 1), n_od).astype(np.int64)),
@@ -50,16 +59,18 @@ def make_tpch_like(root: str, scale: float, seed: int = 0):
         "o_shippriority": pa.array(np.zeros(n_od, dtype=np.int32)),
     })
     n_parts = 4
-    step = n_od // n_parts
-    for i in range(n_parts):
-        lo, hi = i * step, (i + 1) * step if i < n_parts - 1 else n_od
+    step = n_od // OD_PARTS
+    for i in range(OD_PARTS):
+        lo, hi = i * step, (i + 1) * step if i < OD_PARTS - 1 else n_od
         pq.write_table(orders.slice(lo, hi - lo),
-                       os.path.join(od_dir, f"part{i}.parquet"))
+                       os.path.join(od_dir, f"part{i:02d}.parquet"))
 
     l_orderkey = rng.integers(0, n_od, n_li).astype(np.int64)
     l_shipdate = (rng.integers(0, 2520, n_li) + base).astype(np.int32)
     lineitem = pa.table({
         "l_orderkey": pa.array(l_orderkey),
+        "l_partkey": pa.array(rng.integers(0, n_pt, n_li).astype(np.int64)),
+        "l_quantity": pa.array(rng.integers(1, 51, n_li).astype(np.int64)),
         "l_extendedprice": pa.array(np.round(rng.uniform(900, 105000, n_li), 2)),
         "l_discount": pa.array(np.round(rng.uniform(0, 0.1, n_li), 2)),
         "l_shipdate": pa.array(l_shipdate, type=pa.int32()).cast(pa.date32()),
@@ -69,7 +80,17 @@ def make_tpch_like(root: str, scale: float, seed: int = 0):
         lo, hi = i * step, (i + 1) * step if i < n_parts - 1 else n_li
         pq.write_table(lineitem.slice(lo, hi - lo),
                        os.path.join(li_dir, f"part{i}.parquet"))
-    return li_dir, od_dir, n_li, n_od
+
+    part = pa.table({
+        "p_partkey": pa.array(np.arange(n_pt, dtype=np.int64)),
+        "p_brand": pa.array(rng.choice(
+            ["Brand#11", "Brand#23", "Brand#34", "Brand#45", "Brand#52"], n_pt)),
+        "p_container": pa.array(rng.choice(
+            ["SM BOX", "MED BOX", "LG BOX", "SM CASE", "MED CASE",
+             "LG CASE", "JUMBO PKG"], n_pt)),
+    })
+    pq.write_table(part, os.path.join(pt_dir, "part0.parquet"))
+    return li_dir, od_dir, pt_dir, n_li, n_od
 
 
 def build_filter_query(session, li_dir: str):
@@ -99,6 +120,37 @@ def build_q3(session, li_dir: str, od_dir: str):
             .limit(10))
 
 
+def build_q17(session, li_dir: str, pt_dir: str):
+    """TPC-H Q17 shape (small-quantity-order revenue): the correlated avg
+    subquery becomes a group-by + rejoin in the DataFrame IR."""
+    from hyperspace_tpu.plan.expr import avg, col, sum_
+
+    li = session.read.parquet(li_dir)
+    pt = session.read.parquet(pt_dir)
+    thr = (li.group_by("l_partkey")
+           .agg(avg(col("l_quantity")).alias("avg_qty"))
+           .select(col("l_partkey").alias("t_partkey"),
+                   (col("avg_qty") * 0.2).alias("qty_thr")))
+    return (li.join(pt.filter((col("p_brand") == "Brand#23")
+                              & (col("p_container") == "MED BOX")),
+                    on=col("l_partkey") == col("p_partkey"))
+            .join(thr, on=col("l_partkey") == col("t_partkey"))
+            .filter(col("l_quantity") < col("qty_thr"))
+            .agg(sum_(col("l_extendedprice")).alias("price_sum"))
+            .select((col("price_sum") / 7.0).alias("avg_yearly")))
+
+
+def build_skipping_query(session, od_dir: str):
+    """Month-range scan over the time-ordered orders files: per-file MinMax
+    sketches prune most of the 16 parts."""
+    from hyperspace_tpu.plan.expr import col
+
+    od = session.read.parquet(od_dir)
+    return od.filter(col("o_orderdate").between(
+        datetime.date(1994, 6, 1), datetime.date(1994, 7, 31))) \
+        .select("o_orderkey", "o_custkey")
+
+
 def timed_best(fn, repeats: int) -> float:
     best = float("inf")
     for _ in range(repeats):
@@ -122,7 +174,7 @@ def main():
 
     root = tempfile.mkdtemp(prefix="hs_bench_")
     try:
-        li_dir, od_dir, n_li, n_od = make_tpch_like(root, args.scale)
+        li_dir, od_dir, pt_dir, n_li, n_od = make_tpch_like(root, args.scale)
         session = hst.Session(system_path=os.path.join(root, "indexes"))
         session.conf.set(IndexConstants.INDEX_NUM_BUCKETS, 32)
         hs = Hyperspace(session)
@@ -162,30 +214,56 @@ def main():
         build_all()
         build_s = time.perf_counter() - t0
 
+        # Q17 covering indexes + the data-skipping index on time-ordered
+        # orders (BASELINE configs #3-#4: sketch-based skipping).
+        from hyperspace_tpu.api import (DataSkippingIndexConfig,
+                                        MinMaxSketch)
+        pt = session.read.parquet(pt_dir)
+        hs.create_index(pt, IndexConfig(
+            "pt_idx", ["p_partkey"], ["p_brand", "p_container"]))
+        hs.create_index(li, IndexConfig(
+            "li_pk_idx", ["l_partkey"], ["l_quantity", "l_extendedprice"]))
+        hs.create_index(od, DataSkippingIndexConfig(
+            "od_skip", [MinMaxSketch("o_orderdate")]))
+
         fq = build_filter_query(session, li_dir)
         q3 = build_q3(session, li_dir, od_dir)
+        q17 = build_q17(session, li_dir, pt_dir)
+        sq = build_skipping_query(session, od_dir)
 
         # Warm up both paths (compile caches) + sanity-check rewrites.
         session.enable_hyperspace()
-        for q, name in ((fq, "filter query"), (q3, "Q3")):
+        for q, name in ((fq, "filter query"), (q3, "Q3"), (q17, "Q17")):
             assert any("IndexScan" in l.simple_string()
                        for l in q.optimized_plan().collect_leaves()), \
                 f"{name} was not rewritten to use an index"
             q.to_arrow()
+        skip_leaves = sq.optimized_plan().collect_leaves()
+        skip_kept = min(len(l.relation.all_files()) for l in skip_leaves)
+        assert skip_kept < OD_PARTS, "data-skipping pruned nothing"
+        sq.to_arrow()
         session.disable_hyperspace()
         fq.to_arrow()
         q3.to_arrow()
+        q17.to_arrow()
+        sq.to_arrow()
 
         # ---- timed runs ----
         session.disable_hyperspace()
         f_scan_s = timed_best(lambda: fq.to_arrow(), args.repeats)
         q3_scan_s = timed_best(lambda: q3.to_arrow(), args.repeats)
+        q17_scan_s = timed_best(lambda: q17.to_arrow(), args.repeats)
+        sq_scan_s = timed_best(lambda: sq.to_arrow(), args.repeats)
         session.enable_hyperspace()
         f_idx_s = timed_best(lambda: fq.to_arrow(), args.repeats)
         q3_idx_s = timed_best(lambda: q3.to_arrow(), args.repeats)
+        q17_idx_s = timed_best(lambda: q17.to_arrow(), args.repeats)
+        sq_idx_s = timed_best(lambda: sq.to_arrow(), args.repeats)
 
         f_speedup = f_scan_s / f_idx_s if f_idx_s > 0 else float("inf")
         q3_speedup = q3_scan_s / q3_idx_s if q3_idx_s > 0 else float("inf")
+        q17_speedup = q17_scan_s / q17_idx_s if q17_idx_s > 0 else float("inf")
+        sq_speedup = sq_scan_s / sq_idx_s if sq_idx_s > 0 else float("inf")
         import jax
         result = {
             "metric": "tpch_filter_wallclock_speedup_indexed_vs_scan",
@@ -197,6 +275,12 @@ def main():
             "q3_speedup": round(q3_speedup, 3),
             "q3_scan_s": round(q3_scan_s, 4),
             "q3_indexed_s": round(q3_idx_s, 4),
+            "q17_speedup": round(q17_speedup, 3),
+            "q17_scan_s": round(q17_scan_s, 4),
+            "q17_indexed_s": round(q17_idx_s, 4),
+            "skipping_speedup": round(sq_speedup, 3),
+            "skipping_files_kept": skip_kept,
+            "skipping_files_total": OD_PARTS,
             "index_build_s": round(build_s, 3),
             "index_build_cold_s": round(cold_build_s, 3),
             "index_build_scope": "warm rebuild of all 3 indexes (cold pass incl. compiles reported separately)",

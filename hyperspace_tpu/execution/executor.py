@@ -211,7 +211,20 @@ def _execute_index_scan(plan: IndexScan, needed: Optional[Set[str]],
         from .columnar import empty_table
         table = empty_table(entry.schema.select(cols or entry.schema.names))
     else:
-        table = read_parquet(index_files, cols, filters=pa_filter)
+        from . import index_cache
+        if index_cache.enabled():
+            # HBM-resident path: cache the *unfiltered* read (the Filter
+            # node above always re-evaluates its mask on device, so skipping
+            # the parquet-level pushdown is purely an IO trade).
+            key = (entry.id, entry.name, tuple(index_files),
+                   tuple(cols) if cols is not None else None)
+            cache = index_cache.get_cache()
+            table = cache.get(key)
+            if table is None:
+                table = read_parquet(index_files, cols)
+                cache.put(key, table)
+        else:
+            table = read_parquet(index_files, cols, filters=pa_filter)
     if entry.derivedDataset.kind == "CoveringIndex" and not plan.appended_files \
             and buckets_have_single_file \
             and all(c in table.names for c in entry.indexed_columns):

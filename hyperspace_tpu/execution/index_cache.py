@@ -1,0 +1,89 @@
+"""HBM-resident index table cache.
+
+The covering index's value on TPU is being *resident*: once a query touches
+an index version, its columns stay on device and every later query probes
+HBM directly instead of re-reading bucket parquet files from the lake (the
+design target: filter pushdown and shuffle-free joins probe an HBM-resident
+columnar index). Source scans are deliberately NOT cached — the index is
+the derived, optimized structure; the lake is the cold path.
+
+Keys are (entry id, file tuple, column tuple): index data versions are
+immutable on disk (index/IndexDataManager versioned dirs), so a key can
+never go stale — rebuilds/refreshes produce new file paths and the old
+entries age out of the LRU.
+
+Knobs (env, not session conf — the executor is session-free by design):
+  HST_INDEX_CACHE=off         disable
+  HST_INDEX_CACHE_BYTES=N     capacity (default 4 GiB; TPU v5e has 16 GiB)
+"""
+
+from __future__ import annotations
+
+import os
+from collections import OrderedDict
+from typing import Optional, Tuple
+
+from .columnar import Table
+
+
+def _table_nbytes(table: Table) -> int:
+    total = 0
+    for col in table.columns.values():
+        total += col.data.size * col.data.dtype.itemsize
+        if col.validity is not None:
+            total += col.validity.size
+    return total
+
+
+class IndexTableCache:
+    def __init__(self, max_bytes: int):
+        self.max_bytes = max_bytes
+        self._entries: "OrderedDict[Tuple, Tuple[Table, int]]" = OrderedDict()
+        self._bytes = 0
+        self.hits = 0
+        self.misses = 0
+
+    def get(self, key: Tuple) -> Optional[Table]:
+        hit = self._entries.get(key)
+        if hit is None:
+            self.misses += 1
+            return None
+        self._entries.move_to_end(key)
+        self.hits += 1
+        return hit[0]
+
+    def put(self, key: Tuple, table: Table) -> None:
+        nbytes = _table_nbytes(table)
+        if nbytes > self.max_bytes:
+            return  # larger than the whole cache: don't thrash.
+        old = self._entries.pop(key, None)
+        if old is not None:
+            self._bytes -= old[1]
+        self._entries[key] = (table, nbytes)
+        self._bytes += nbytes
+        while self._bytes > self.max_bytes and len(self._entries) > 1:
+            _, (_, evicted) = self._entries.popitem(last=False)
+            self._bytes -= evicted
+
+    def clear(self) -> None:
+        self._entries.clear()
+        self._bytes = 0
+
+    @property
+    def nbytes(self) -> int:
+        return self._bytes
+
+
+_cache: Optional[IndexTableCache] = None
+
+
+def enabled() -> bool:
+    return os.environ.get("HST_INDEX_CACHE", "on") != "off"
+
+
+def get_cache() -> IndexTableCache:
+    global _cache
+    if _cache is None:
+        _cache = IndexTableCache(int(os.environ.get(
+            "HST_INDEX_CACHE_BYTES", str(4 << 30))))
+    return _cache

@@ -1,3 +1,5 @@
 from .distributed_build import distributed_build_sorted_buckets  # noqa: F401
+from .distributed_query import (distributed_join_agg,  # noqa: F401
+                                distributed_range_agg)
 from .mesh import (DATA_AXIS, bucket_owner, device_bucket_range, make_mesh,  # noqa: F401
                    replicated, row_sharding)

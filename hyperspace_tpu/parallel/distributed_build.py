@@ -25,7 +25,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh, PartitionSpec as P
 
 from ..exceptions import HyperspaceException
 from ..execution.columnar import Column, Table
@@ -132,11 +132,12 @@ def distributed_build_sorted_buckets(
     Retries with doubled capacity on exchange overflow (skewed buckets,
     SURVEY §7 hard-part #3).
     """
+    from .mesh import pad_and_shard
+
     mesh = mesh or make_mesh()
     n_dev = mesh.devices.size
     rows = table.num_rows
     shard_rows = -(-max(rows, 1) // n_dev)  # ceil.
-    padded = shard_rows * n_dev
 
     arrays, dict_tables = {}, {}
     key_dtypes = []
@@ -146,11 +147,7 @@ def distributed_build_sorted_buckets(
             raise HyperspaceException(
                 f"Distributed build over nullable column '{name}' is not "
                 "supported yet")
-        pad_width = padded - rows
-        data = jnp.concatenate(
-            [col.data, jnp.zeros((pad_width,) + col.data.shape[1:],
-                                 col.data.dtype)]) if pad_width else col.data
-        arrays[name] = data
+        arrays[name] = col.data
         if col.dtype == STRING:
             import zlib
             hashes = np.array([zlib.crc32(s.encode("utf-8"))
@@ -160,12 +157,7 @@ def distributed_build_sorted_buckets(
     for c in indexed_cols:
         key_dtypes.append(table.column(c).dtype)
 
-    valid = jnp.concatenate([jnp.ones(rows, jnp.bool_),
-                             jnp.zeros(padded - rows, jnp.bool_)])
-
-    sharding = NamedSharding(mesh, P(DATA_AXIS))
-    arrays = {n: jax.device_put(a, sharding) for n, a in arrays.items()}
-    valid = jax.device_put(valid, sharding)
+    arrays, valid = pad_and_shard(mesh, arrays, rows)
 
     # cap == shard_rows always suffices (a device can send at most its whole
     # shard to one destination), so escalation terminates.

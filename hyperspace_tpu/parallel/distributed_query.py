@@ -1,0 +1,161 @@
+"""Distributed query execution over the device mesh.
+
+The consumer side of the distributed build (SURVEY §2 distributed
+primitives 5–6): queries run SPMD over row shards with XLA collectives —
+``psum`` over ICI — instead of a network shuffle:
+
+- ``distributed_range_agg``: filter (range predicate) + aggregate in one
+  shard_map program; each device masks its shard and contributes partial
+  sums/counts, one psum returns replicated scalars (the TPC-H Q6 shape).
+- ``distributed_join_agg``: inner equi-join + aggregate over two tables
+  bucket-co-partitioned by the SAME key hash (e.g. two
+  distributed_build_sorted_buckets outputs): equal keys live on the same
+  device on both sides, so each device merge-joins locally (searchsorted
+  over its re-sorted shard, prefix-sum segment totals) and a single psum
+  combines — the shuffle-free sort-merge-join aggregate (the Q3/Q17 inner
+  shape) with zero row movement.
+
+All shapes are static; join results are aggregated on device (count, left-
+and right-value sums) rather than materialized, so no variable-length
+output crosses the shard_map boundary.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..exceptions import HyperspaceException
+from ..execution.columnar import Table
+from .mesh import DATA_AXIS, make_mesh, pad_and_shard
+
+
+@partial(jax.jit, static_argnames=("mesh", "value_names", "lo_incl",
+                                   "hi_incl"))
+def _range_agg(filter_data, valid, lo, hi, values, *, mesh: Mesh,
+               value_names: Tuple[str, ...], lo_incl: bool, hi_incl: bool):
+    def per_device(fd, v, lo, hi, vals):
+        ml = (fd >= lo) if lo_incl else (fd > lo)
+        mh = (fd <= hi) if hi_incl else (fd < hi)
+        m = ml & mh & v
+        count = jax.lax.psum(jnp.sum(m.astype(jnp.int64)), DATA_AXIS)
+        sums = {name: jax.lax.psum(
+            jnp.sum(jnp.where(m, vals[name], 0)), DATA_AXIS)
+            for name in value_names}
+        return count, sums
+
+    return jax.shard_map(
+        per_device, mesh=mesh,
+        in_specs=(P(DATA_AXIS), P(DATA_AXIS), P(), P(), P(DATA_AXIS)),
+        out_specs=(P(), P()), check_vma=False)(
+            filter_data, valid, lo, hi, values)
+
+
+def distributed_range_agg(table: Table, filter_col: str, lo, hi,
+                          value_cols: Tuple[str, ...] = (),
+                          mesh: Optional[Mesh] = None,
+                          lo_incl: bool = True, hi_incl: bool = True):
+    """count + per-column sums of rows with ``lo <(=) filter_col <(=) hi``,
+    computed SPMD over the mesh. Returns (count, {col: sum})."""
+    mesh = mesh or make_mesh()
+    fcol = table.column(filter_col)
+    if fcol.validity is not None:
+        raise HyperspaceException("distributed_range_agg: nullable filter "
+                                  "column not supported yet")
+    arrays = {"__f": fcol.data}
+    for c in value_cols:
+        col = table.column(c)
+        if col.validity is not None:
+            raise HyperspaceException(
+                f"distributed_range_agg: nullable value column '{c}' not "
+                "supported yet")
+        arrays[c] = col.data
+    sharded, valid = pad_and_shard(mesh, arrays, table.num_rows)
+    fd = sharded.pop("__f")
+    lo_a = jnp.asarray(lo, fd.dtype)
+    hi_a = jnp.asarray(hi, fd.dtype)
+    count, sums = _range_agg(fd, valid, lo_a, hi_a, sharded, mesh=mesh,
+                             value_names=tuple(value_cols),
+                             lo_incl=lo_incl, hi_incl=hi_incl)
+    return int(count), {k: v for k, v in sums.items()}
+
+
+@partial(jax.jit, static_argnames=("mesh",))
+def _join_agg(lk, lv_valid, lval, rk, rv_valid, rval, *, mesh: Mesh):
+    def per_device(lk, lvalid, lval, rk, rvalid, rval):
+        # Local re-sort of the right shard by pure key (device-local order
+        # after the bucket exchange is (bucket, key); searchsorted needs key
+        # order). Invalid rows get the max-value sentinel and, via the
+        # valid-first tiebreak, sort strictly after every valid row — so
+        # valid rows occupy [0, n_valid) and clamping the probe bounds to
+        # n_valid keeps a legitimate sentinel-valued key from matching the
+        # padding (no overcount even for key == iinfo.max).
+        from ..ops import kernels
+
+        if jnp.issubdtype(rk.dtype, jnp.floating):
+            sentinel = jnp.asarray(jnp.finfo(rk.dtype).max, rk.dtype)
+        else:
+            sentinel = jnp.asarray(jnp.iinfo(rk.dtype).max, rk.dtype)
+        rk_eff = jnp.where(rvalid, rk, sentinel)
+        order = kernels.lex_sort_indices(
+            [rk_eff, (~rvalid).astype(jnp.int32)])
+        n_valid = jnp.sum(rvalid.astype(jnp.int32))
+        rk_sorted = jnp.take(rk_eff, order)
+        rval_sorted = jnp.where(jnp.take(rvalid, order),
+                                jnp.take(rval, order), 0)
+        prefix = jnp.concatenate(
+            [jnp.zeros(1, rval_sorted.dtype), jnp.cumsum(rval_sorted)])
+
+        lo = jnp.minimum(jnp.searchsorted(rk_sorted, lk, side="left"),
+                         n_valid)
+        hi = jnp.minimum(jnp.searchsorted(rk_sorted, lk, side="right"),
+                         n_valid)
+        counts = jnp.where(lvalid, (hi - lo).astype(jnp.int64), 0)
+        pair_count = jax.lax.psum(jnp.sum(counts), DATA_AXIS)
+        # Sum of left values over all join pairs: multiplicity × value.
+        left_sum = jax.lax.psum(
+            jnp.sum(counts.astype(lval.dtype) * jnp.where(lvalid, lval, 0)),
+            DATA_AXIS)
+        # Sum of right values over all join pairs: per-left segment totals.
+        seg = jnp.take(prefix, hi) - jnp.take(prefix, lo)
+        right_sum = jax.lax.psum(jnp.sum(jnp.where(lvalid, seg, 0)),
+                                 DATA_AXIS)
+        return pair_count, left_sum, right_sum
+
+    return jax.shard_map(
+        per_device, mesh=mesh,
+        in_specs=(P(DATA_AXIS),) * 6,
+        out_specs=(P(), P(), P()), check_vma=False)(
+            lk, lv_valid, lval, rk, rv_valid, rval)
+
+
+def distributed_join_agg(left: Table, left_valid, right: Table, right_valid,
+                         key: str, left_value: str, right_value: str,
+                         mesh: Optional[Mesh] = None):
+    """Inner-join aggregate over two bucket-co-partitioned sharded tables
+    (outputs of distributed_build_sorted_buckets over the same mesh and
+    bucket count, keyed on ``key``): returns
+
+        (pair count, sum(left_value over pairs), sum(right_value over pairs))
+
+    with zero inter-device row movement — co-partitioning makes every join
+    match device-local; one psum combines the partial aggregates."""
+    mesh = mesh or make_mesh()
+    for t, cols in ((left, (key, left_value)), (right, (key, right_value))):
+        for c in cols:
+            if t.column(c).validity is not None:
+                raise HyperspaceException(
+                    f"distributed_join_agg: nullable column '{c}' not "
+                    "supported yet (SQL null-key semantics)")
+    lk = left.column(key).data
+    rk = right.column(key).data
+    lval = left.column(left_value).data
+    rval = right.column(right_value).data
+    count, lsum, rsum = _join_agg(lk, left_valid, lval, rk, right_valid,
+                                  rval, mesh=mesh)
+    return int(count), np.asarray(lsum).item(), np.asarray(rsum).item()

@@ -31,6 +31,29 @@ def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 
 
+def pad_and_shard(mesh: Mesh, arrays: dict, rows: int) -> tuple:
+    """Zero-pad each 1-D-leading array to a device multiple, build the
+    validity mask, and device_put everything row-sharded over the data axis.
+    Returns (sharded arrays dict, sharded valid mask). The single shared
+    recipe for putting host rows onto the mesh (build + query sides)."""
+    import jax.numpy as jnp
+
+    n_dev = mesh.devices.size
+    shard = -(-max(rows, 1) // n_dev)  # ceil.
+    padded = shard * n_dev
+    out = {}
+    for name, a in arrays.items():
+        if padded != rows:
+            a = jnp.concatenate(
+                [a, jnp.zeros((padded - rows,) + a.shape[1:], a.dtype)])
+        out[name] = a
+    valid = jnp.concatenate([jnp.ones(rows, jnp.bool_),
+                             jnp.zeros(padded - rows, jnp.bool_)])
+    sharding = row_sharding(mesh)
+    return ({n: jax.device_put(a, sharding) for n, a in out.items()},
+            jax.device_put(valid, sharding))
+
+
 def device_bucket_range(device_index: int, n_devices: int,
                         num_buckets: int) -> tuple:
     """Contiguous bucket range [lo, hi) owned by a device."""

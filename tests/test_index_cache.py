@@ -1,0 +1,114 @@
+"""HBM-resident index cache tests."""
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+import hyperspace_tpu as hst
+from hyperspace_tpu.api import Hyperspace, IndexConfig
+from hyperspace_tpu.execution import index_cache
+from hyperspace_tpu.execution.index_cache import IndexTableCache
+from hyperspace_tpu.execution.columnar import Column, Table
+from hyperspace_tpu.index.constants import IndexConstants
+from hyperspace_tpu.plan.expr import col
+
+
+@pytest.fixture(autouse=True)
+def fresh_cache():
+    index_cache.get_cache().clear()
+    yield
+    index_cache.get_cache().clear()
+
+
+def _table(n):
+    import jax.numpy as jnp
+    return Table({"x": Column("int64", jnp.arange(n))})
+
+
+class TestLru:
+    def test_hit_returns_same_object(self):
+        c = IndexTableCache(1 << 20)
+        t = _table(10)
+        c.put(("k",), t)
+        assert c.get(("k",)) is t
+        assert (c.hits, c.misses) == (1, 0)
+
+    def test_eviction_by_bytes(self):
+        c = IndexTableCache(max_bytes=3 * 800)  # 100 int64 rows = 800 B.
+        for i in range(5):
+            c.put((i,), _table(100))
+        assert c.get((0,)) is None and c.get((1,)) is None
+        assert c.get((4,)) is not None
+        assert c.nbytes <= 3 * 800
+
+    def test_oversized_entry_skipped(self):
+        c = IndexTableCache(max_bytes=100)
+        c.put(("big",), _table(1000))
+        assert c.get(("big",)) is None
+        assert c.nbytes == 0
+
+
+class TestExecutorIntegration:
+    @pytest.fixture()
+    def env(self, tmp_system_path, tmp_path):
+        rng = np.random.default_rng(0)
+        d = tmp_path / "t"
+        d.mkdir()
+        pq.write_table(pa.table({
+            "k": pa.array(rng.integers(0, 50, 1200).astype(np.int64)),
+            "v": pa.array(rng.uniform(0, 1, 1200)),
+        }), str(d / "p.parquet"))
+        session = hst.Session(system_path=tmp_system_path)
+        session.conf.set(IndexConstants.INDEX_NUM_BUCKETS, 4)
+        hs = Hyperspace(session)
+        df = session.read.parquet(str(d))
+        hs.create_index(df, IndexConfig("cix", ["k"], ["v"]))
+        session.enable_hyperspace()
+        return session, df
+
+    def test_second_query_hits_cache(self, env):
+        session, df = env
+        q = df.filter(col("k") > 10).select("k", "v")
+        cache = index_cache.get_cache()
+        r1 = q.to_arrow()
+        misses_after_first = cache.misses
+        assert misses_after_first >= 1
+        r2 = q.to_arrow()
+        assert cache.hits >= 1
+        assert cache.misses == misses_after_first
+        assert r1.equals(r2)
+
+    def test_results_match_disabled_cache(self, env, monkeypatch):
+        session, df = env
+        q = df.filter(col("k").between(5, 25)).select("k", "v")
+        key = lambda t: t.sort_by([(c, "ascending") for c in t.column_names])
+        warm = key(q.to_arrow())
+        warm2 = key(q.to_arrow())  # cached path.
+        monkeypatch.setenv("HST_INDEX_CACHE", "off")
+        cold = key(q.to_arrow())
+        assert warm.equals(cold) and warm2.equals(cold)
+        session.disable_hyperspace()
+        assert key(q.to_arrow()).equals(cold)
+
+    def test_refresh_uses_new_key(self, env, tmp_path):
+        """After incremental refresh, queries read the new file set (no
+        stale cache hits — the key includes the file tuple)."""
+        session, df = env
+        hs = Hyperspace(session)
+        q = df.filter(col("k") > 10).select("k", "v")
+        before = q.to_arrow()
+        rng = np.random.default_rng(1)
+        pq.write_table(pa.table({
+            "k": pa.array(rng.integers(0, 50, 300).astype(np.int64)),
+            "v": pa.array(rng.uniform(0, 1, 300)),
+        }), str(tmp_path / "t" / "p2.parquet"))
+        hs.refresh_index("cix", "incremental")
+        # Re-list the source (the old DataFrame pins its file listing).
+        df2 = session.read.parquet(str(tmp_path / "t"))
+        q2 = df2.filter(col("k") > 10).select("k", "v")
+        after = q2.to_arrow()
+        assert after.num_rows > before.num_rows
+        session.disable_hyperspace()
+        key = lambda t: t.sort_by([(c, "ascending") for c in t.column_names])
+        assert key(after).equals(key(q2.to_arrow()))

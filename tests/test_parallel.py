@@ -104,3 +104,95 @@ class TestDistributedBuild:
         assert int(valid_np.sum()) == n
         b = np.asarray(jax.device_get(bids))[valid_np]
         assert len(np.unique(b)) == 1
+
+
+class TestDistributedQuery:
+    def test_range_agg_matches_pandas(self, mesh):
+        from hyperspace_tpu.parallel import distributed_range_agg
+
+        table, df = make_table(2000, seed=5)
+        count, sums = distributed_range_agg(
+            table, "k", 50, 120, ("v",), mesh)
+        want = df[(df.k >= 50) & (df.k <= 120)]
+        assert count == len(want)
+        np.testing.assert_allclose(float(sums["v"]), want.v.sum(), rtol=1e-12)
+
+    def test_range_agg_exclusive_bounds(self, mesh):
+        from hyperspace_tpu.parallel import distributed_range_agg
+
+        table, df = make_table(700, seed=6)
+        count, _ = distributed_range_agg(
+            table, "k", 50, 120, (), mesh, lo_incl=False, hi_incl=False)
+        assert count == len(df[(df.k > 50) & (df.k < 120)])
+
+    def test_join_agg_copartitioned(self, mesh):
+        """Full pipeline: distributed build of both sides, then the
+        shuffle-free co-partitioned join aggregate; totals must match the
+        pandas join."""
+        from hyperspace_tpu.parallel import distributed_join_agg
+
+        rng = np.random.default_rng(7)
+        n_l, n_r, nb = 1500, 400, 16
+        ldf = pd.DataFrame({"k": rng.integers(0, 120, n_l).astype(np.int64),
+                            "lv": rng.uniform(0, 10, n_l)})
+        rdf = pd.DataFrame({"k": rng.integers(0, 120, n_r).astype(np.int64),
+                            "rv": rng.uniform(0, 10, n_r)})
+        lt, lvalid, _ = distributed_build_sorted_buckets(
+            Table.from_arrow(pa.Table.from_pandas(ldf)), ["k"], nb, mesh)
+        rt, rvalid, _ = distributed_build_sorted_buckets(
+            Table.from_arrow(pa.Table.from_pandas(rdf)), ["k"], nb, mesh)
+        count, lsum, rsum = distributed_join_agg(
+            lt, lvalid, rt, rvalid, "k", "lv", "rv", mesh)
+        joined = ldf.merge(rdf, on="k")
+        assert count == len(joined)
+        np.testing.assert_allclose(lsum, joined.lv.sum(), rtol=1e-9)
+        np.testing.assert_allclose(rsum, joined.rv.sum(), rtol=1e-9)
+
+    def test_join_agg_empty_matches(self, mesh):
+        from hyperspace_tpu.parallel import distributed_join_agg
+
+        ldf = pd.DataFrame({"k": np.arange(0, 50, dtype=np.int64),
+                            "lv": np.ones(50)})
+        rdf = pd.DataFrame({"k": np.arange(100, 120, dtype=np.int64),
+                            "rv": np.ones(20)})
+        lt, lvalid, _ = distributed_build_sorted_buckets(
+            Table.from_arrow(pa.Table.from_pandas(ldf)), ["k"], 8, mesh)
+        rt, rvalid, _ = distributed_build_sorted_buckets(
+            Table.from_arrow(pa.Table.from_pandas(rdf)), ["k"], 8, mesh)
+        count, lsum, rsum = distributed_join_agg(
+            lt, lvalid, rt, rvalid, "k", "lv", "rv", mesh)
+        assert (count, lsum, rsum) == (0, 0.0, 0.0)
+
+    def test_join_agg_rejects_nullable_key(self, mesh):
+        from hyperspace_tpu.exceptions import HyperspaceException
+        from hyperspace_tpu.parallel import distributed_join_agg
+
+        lt = Table.from_arrow(pa.table({
+            "k": pa.array([1, None, 3], type=pa.int64()),
+            "lv": pa.array([1.0, 2.0, 3.0])}))
+        rt = Table.from_arrow(pa.table({
+            "k": pa.array([1, 2, 3], type=pa.int64()),
+            "rv": pa.array([1.0, 2.0, 3.0])}))
+        valid = jnp.ones(3, jnp.bool_)
+        with pytest.raises(HyperspaceException, match="nullable"):
+            distributed_join_agg(lt, valid, rt, valid, "k", "lv", "rv", mesh)
+
+    def test_join_agg_sentinel_valued_key(self, mesh):
+        """A legitimate key equal to int64 max must not match padding rows."""
+        from hyperspace_tpu.parallel import distributed_join_agg
+
+        imax = np.iinfo(np.int64).max
+        ldf = pd.DataFrame({"k": np.array([imax, 5, imax], dtype=np.int64),
+                            "lv": np.array([1.0, 2.0, 3.0])})
+        rdf = pd.DataFrame({"k": np.array([imax, 7], dtype=np.int64),
+                            "rv": np.array([10.0, 20.0])})
+        lt, lvalid, _ = distributed_build_sorted_buckets(
+            Table.from_arrow(pa.Table.from_pandas(ldf)), ["k"], 8, mesh)
+        rt, rvalid, _ = distributed_build_sorted_buckets(
+            Table.from_arrow(pa.Table.from_pandas(rdf)), ["k"], 8, mesh)
+        count, lsum, rsum = distributed_join_agg(
+            lt, lvalid, rt, rvalid, "k", "lv", "rv", mesh)
+        joined = ldf.merge(rdf, on="k")
+        assert count == len(joined) == 2
+        np.testing.assert_allclose(lsum, joined.lv.sum())
+        np.testing.assert_allclose(rsum, joined.rv.sum())
