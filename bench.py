@@ -18,6 +18,7 @@ import datetime
 import json
 import os
 import shutil
+import subprocess
 import sys
 import tempfile
 import time
@@ -26,6 +27,56 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 
 OD_PARTS = 16  # orders part files (skipping granularity).
+
+# Mutable result dict: every phase writes what it measured as soon as it has
+# it, so a later-phase failure still yields a meaningful partial JSON line
+# (VERDICT r1 #1: BENCH_r01 died rc=1 with zero output).
+RESULT: dict = {
+    "metric": "tpch_filter_wallclock_speedup_indexed_vs_scan",
+    "value": 0.0,
+    "unit": "x",
+    "vs_baseline": 0.0,
+    "errors": [],
+}
+
+
+def _emit_and_exit(code: int = 0) -> None:
+    print(json.dumps(RESULT))
+    sys.stdout.flush()
+    sys.exit(code)
+
+
+def _ensure_backend(timeout_s: float) -> bool:
+    """Probe the ambient JAX backend in a subprocess (it can hang or die at
+    init — BENCH_r01's failure mode: rc=1 UNAVAILABLE; in other sandboxes it
+    hangs indefinitely). Returns True if the ambient backend works, False if
+    the caller must fall back to CPU.
+
+    NOTE the fallback mechanism: setting JAX_PLATFORMS=cpu in the env is NOT
+    honored once the axon plugin site is on PYTHONPATH — only an in-process
+    ``jax.config.update("jax_platforms", "cpu")`` takes effect (verified
+    empirically; tests/conftest.py relies on the same)."""
+    probe = ("import jax; d = jax.devices(); "
+             "import jax.numpy as jnp; jnp.arange(8).sum().block_until_ready(); "
+             "print(d[0])")
+    platform = os.environ.get("JAX_PLATFORMS", "")
+    try:
+        out = subprocess.run(
+            [sys.executable, "-c", probe], capture_output=True,
+            text=True, timeout=timeout_s)
+        if out.returncode == 0:
+            RESULT["backend_probe"] = out.stdout.strip().splitlines()[-1]
+            return True
+        err_tail = (out.stderr or "").strip().splitlines()[-1:]
+        RESULT["errors"].append(
+            f"backend probe (JAX_PLATFORMS={platform!r}) "
+            f"rc={out.returncode}: {err_tail}")
+    except subprocess.TimeoutExpired:
+        RESULT["errors"].append(
+            f"backend probe (JAX_PLATFORMS={platform!r}) timed out "
+            f"after {timeout_s:.0f}s")
+    RESULT["backend_fallback"] = "cpu"
+    return False
 
 
 def make_tpch_like(root: str, scale: float, seed: int = 0):
@@ -160,139 +211,187 @@ def timed_best(fn, repeats: int) -> float:
     return best
 
 
+def _phase(name: str):
+    """Decorator-less phase guard: returns True if fn ran clean. Failures
+    are recorded in RESULT["errors"] and the bench continues."""
+    class _Ctx:
+        def __enter__(self):
+            return self
+
+        def __exit__(self, et, ev, tb):
+            if et is not None and issubclass(et, Exception):
+                import traceback
+                tail = traceback.format_exception_only(et, ev)[-1].strip()
+                RESULT["errors"].append(f"phase {name}: {tail}")
+                return True  # swallow; later phases still run
+            return False  # KeyboardInterrupt/SystemExit propagate
+    return _Ctx()
+
+
 def main():
     parser = argparse.ArgumentParser()
     parser.add_argument("--scale", type=float,
                         default=float(os.environ.get("BENCH_SCALE", "0.05")))
     parser.add_argument("--repeats", type=int, default=3)
     parser.add_argument("--keep", action="store_true")
+    parser.add_argument("--backend-timeout", type=float, default=float(
+        os.environ.get("BENCH_BACKEND_TIMEOUT", "300")))
     args = parser.parse_args()
+    RESULT["scale"] = args.scale
 
-    import hyperspace_tpu as hst
-    from hyperspace_tpu.api import Hyperspace, IndexConfig
-    from hyperspace_tpu.index.constants import IndexConstants
+    backend_ok = _ensure_backend(args.backend_timeout)
+
+    try:
+        import jax
+        if not backend_ok:
+            jax.config.update("jax_platforms", "cpu")
+        import hyperspace_tpu as hst
+        from hyperspace_tpu.api import Hyperspace, IndexConfig
+        from hyperspace_tpu.index.constants import IndexConstants
+        RESULT["device"] = str(jax.devices()[0])
+        RESULT["backend"] = jax.default_backend()
+    except Exception as e:
+        RESULT["errors"].append(f"backend init: {type(e).__name__}: {e}")
+        _emit_and_exit(0)
+
+    # Pallas kernels: verify they compile under Mosaic AND match the jnp
+    # reference on this backend; auto-disable (fall back to jnp) otherwise.
+    with _phase("pallas_self_check"):
+        from hyperspace_tpu.ops import pallas_kernels
+        chk = pallas_kernels.self_check(auto_disable=True)
+        RESULT["pallas"] = {k: v for k, v in chk.items()}
 
     root = tempfile.mkdtemp(prefix="hs_bench_")
+    session = None
     try:
-        li_dir, od_dir, pt_dir, n_li, n_od = make_tpch_like(root, args.scale)
-        session = hst.Session(system_path=os.path.join(root, "indexes"))
-        session.conf.set(IndexConstants.INDEX_NUM_BUCKETS, 32)
-        hs = Hyperspace(session)
-
-        li = session.read.parquet(li_dir)
-        od = session.read.parquet(od_dir)
+        with _phase("datagen"):
+            li_dir, od_dir, pt_dir, n_li, n_od = make_tpch_like(
+                root, args.scale)
+            RESULT["lineitem_rows"] = n_li
+            session = hst.Session(system_path=os.path.join(root, "indexes"))
+            session.conf.set(IndexConstants.INDEX_NUM_BUCKETS, 32)
+            hs = Hyperspace(session)
+            li = session.read.parquet(li_dir)
+            od = session.read.parquet(od_dir)
+        if session is None:
+            _emit_and_exit(0)
 
         # ---- index build (the BASELINE "index build time" metric) ----
-        row_group = max(4096, int(n_li / 32 / 8))
-        session.conf.set(IndexConstants.INDEX_ROW_GROUP_SIZE, row_group)
+        with _phase("index_build"):
+            row_group = max(4096, int(n_li / 32 / 8))
+            session.conf.set(IndexConstants.INDEX_ROW_GROUP_SIZE, row_group)
 
-        def build_all():
+            def build_all():
+                hs.create_index(li, IndexConfig(
+                    "li_idx", ["l_orderkey"],
+                    ["l_extendedprice", "l_discount", "l_shipdate"]))
+                hs.create_index(od, IndexConfig(
+                    "od_idx", ["o_orderkey"],
+                    ["o_custkey", "o_orderdate", "o_shippriority"]))
+                # Filter index: fewer, larger buckets → more prunable groups.
+                session.conf.set(IndexConstants.INDEX_NUM_BUCKETS, 8)
+                hs.create_index(li, IndexConfig(
+                    "li_ship_idx", ["l_shipdate"],
+                    ["l_orderkey", "l_extendedprice"]))
+                session.conf.set(IndexConstants.INDEX_NUM_BUCKETS, 32)
+
+            # Cold pass compiles the build programs; timed pass measures
+            # steady-state build throughput (comparable to the JVM
+            # baseline's warmed executors).
+            t0 = time.perf_counter()
+            build_all()
+            cold_build_s = time.perf_counter() - t0
+            RESULT["index_build_cold_s"] = round(cold_build_s, 3)
+            for name in ("li_idx", "od_idx", "li_ship_idx"):
+                hs.delete_index(name)
+                hs.vacuum_index(name)
+            t0 = time.perf_counter()
+            build_all()
+            build_s = time.perf_counter() - t0
+            RESULT["index_build_s"] = round(build_s, 3)
+            RESULT["index_build_scope"] = (
+                "warm rebuild of all 3 indexes (cold pass incl. compiles "
+                "reported separately)")
+            RESULT["build_rows_per_s"] = round(n_li / build_s, 1)
+
+        if "index_build_s" not in RESULT:
+            _emit_and_exit(0)
+
+        with _phase("aux_indexes"):
+            # Q17 covering indexes + the data-skipping index on the
+            # time-ordered orders (BASELINE configs #3-#4).
+            from hyperspace_tpu.api import (DataSkippingIndexConfig,
+                                            MinMaxSketch)
+            pt = session.read.parquet(pt_dir)
+            hs.create_index(pt, IndexConfig(
+                "pt_idx", ["p_partkey"], ["p_brand", "p_container"]))
             hs.create_index(li, IndexConfig(
-                "li_idx", ["l_orderkey"],
-                ["l_extendedprice", "l_discount", "l_shipdate"]))
-            hs.create_index(od, IndexConfig(
-                "od_idx", ["o_orderkey"],
-                ["o_custkey", "o_orderdate", "o_shippriority"]))
-            # Filter index: fewer, larger buckets → more row groups to prune.
-            session.conf.set(IndexConstants.INDEX_NUM_BUCKETS, 8)
-            hs.create_index(li, IndexConfig(
-                "li_ship_idx", ["l_shipdate"],
-                ["l_orderkey", "l_extendedprice"]))
-            session.conf.set(IndexConstants.INDEX_NUM_BUCKETS, 32)
+                "li_pk_idx", ["l_partkey"], ["l_quantity", "l_extendedprice"]))
+            hs.create_index(od, DataSkippingIndexConfig(
+                "od_skip", [MinMaxSketch("o_orderdate")]))
 
-        # Cold pass compiles the build programs (XLA/Pallas per shape — cached
-        # persistently via HST_XLA_CACHE); timed pass measures steady-state
-        # build throughput, the quantity comparable to the JVM baseline's
-        # warmed executors.
-        t0 = time.perf_counter()
-        build_all()
-        cold_build_s = time.perf_counter() - t0
-        for name in ("li_idx", "od_idx", "li_ship_idx"):
-            hs.delete_index(name)
-            hs.vacuum_index(name)
-        t0 = time.perf_counter()
-        build_all()
-        build_s = time.perf_counter() - t0
+        queries = {}
+        with _phase("plan_queries"):
+            queries["filter"] = build_filter_query(session, li_dir)
+            queries["q3"] = build_q3(session, li_dir, od_dir)
+            queries["q17"] = build_q17(session, li_dir, pt_dir)
+            queries["skipping"] = build_skipping_query(session, od_dir)
 
-        # Q17 covering indexes + the data-skipping index on time-ordered
-        # orders (BASELINE configs #3-#4: sketch-based skipping).
-        from hyperspace_tpu.api import (DataSkippingIndexConfig,
-                                        MinMaxSketch)
-        pt = session.read.parquet(pt_dir)
-        hs.create_index(pt, IndexConfig(
-            "pt_idx", ["p_partkey"], ["p_brand", "p_container"]))
-        hs.create_index(li, IndexConfig(
-            "li_pk_idx", ["l_partkey"], ["l_quantity", "l_extendedprice"]))
-        hs.create_index(od, DataSkippingIndexConfig(
-            "od_skip", [MinMaxSketch("o_orderdate")]))
+        rewrite_ok = {}
+        with _phase("rewrite_checks"):
+            session.enable_hyperspace()
+            for name in ("filter", "q3", "q17"):
+                q = queries.get(name)
+                if q is None:
+                    continue
+                rewrite_ok[name] = any(
+                    "IndexScan" in l.simple_string()
+                    for l in q.optimized_plan().collect_leaves())
+                if not rewrite_ok[name]:
+                    RESULT["errors"].append(
+                        f"{name} was not rewritten to use an index")
+            sq = queries.get("skipping")
+            if sq is not None:
+                skip_leaves = sq.optimized_plan().collect_leaves()
+                skip_kept = min(
+                    len(l.relation.all_files()) for l in skip_leaves)
+                RESULT["skipping_files_kept"] = skip_kept
+                RESULT["skipping_files_total"] = OD_PARTS
+                rewrite_ok["skipping"] = skip_kept < OD_PARTS
+                if not rewrite_ok["skipping"]:
+                    RESULT["errors"].append("data-skipping pruned nothing")
+            session.disable_hyperspace()
 
-        fq = build_filter_query(session, li_dir)
-        q3 = build_q3(session, li_dir, od_dir)
-        q17 = build_q17(session, li_dir, pt_dir)
-        sq = build_skipping_query(session, od_dir)
+        # ---- timed runs (per query: warm both paths, then time both) ----
+        speedups = {}
+        for name, q in queries.items():
+            if q is None or not rewrite_ok.get(name, False):
+                continue  # no rewrite → enabled/disabled runs are the same
+                # plan; timing them would report a fake ~1.0x with rc=0.
+            with _phase(f"time_{name}"):
+                session.enable_hyperspace()
+                q.to_arrow()  # warm indexed path
+                session.disable_hyperspace()
+                q.to_arrow()  # warm scan path
+                scan_s = timed_best(lambda: q.to_arrow(), args.repeats)
+                session.enable_hyperspace()
+                idx_s = timed_best(lambda: q.to_arrow(), args.repeats)
+                session.disable_hyperspace()
+                sp = scan_s / idx_s if idx_s > 0 else float("inf")
+                speedups[name] = sp
+                RESULT[f"{name}_scan_s"] = round(scan_s, 4)
+                RESULT[f"{name}_indexed_s"] = round(idx_s, 4)
+                if name != "filter":
+                    RESULT[f"{name}_speedup"] = round(sp, 3)
 
-        # Warm up both paths (compile caches) + sanity-check rewrites.
-        session.enable_hyperspace()
-        for q, name in ((fq, "filter query"), (q3, "Q3"), (q17, "Q17")):
-            assert any("IndexScan" in l.simple_string()
-                       for l in q.optimized_plan().collect_leaves()), \
-                f"{name} was not rewritten to use an index"
-            q.to_arrow()
-        skip_leaves = sq.optimized_plan().collect_leaves()
-        skip_kept = min(len(l.relation.all_files()) for l in skip_leaves)
-        assert skip_kept < OD_PARTS, "data-skipping pruned nothing"
-        sq.to_arrow()
-        session.disable_hyperspace()
-        fq.to_arrow()
-        q3.to_arrow()
-        q17.to_arrow()
-        sq.to_arrow()
-
-        # ---- timed runs ----
-        session.disable_hyperspace()
-        f_scan_s = timed_best(lambda: fq.to_arrow(), args.repeats)
-        q3_scan_s = timed_best(lambda: q3.to_arrow(), args.repeats)
-        q17_scan_s = timed_best(lambda: q17.to_arrow(), args.repeats)
-        sq_scan_s = timed_best(lambda: sq.to_arrow(), args.repeats)
-        session.enable_hyperspace()
-        f_idx_s = timed_best(lambda: fq.to_arrow(), args.repeats)
-        q3_idx_s = timed_best(lambda: q3.to_arrow(), args.repeats)
-        q17_idx_s = timed_best(lambda: q17.to_arrow(), args.repeats)
-        sq_idx_s = timed_best(lambda: sq.to_arrow(), args.repeats)
-
-        f_speedup = f_scan_s / f_idx_s if f_idx_s > 0 else float("inf")
-        q3_speedup = q3_scan_s / q3_idx_s if q3_idx_s > 0 else float("inf")
-        q17_speedup = q17_scan_s / q17_idx_s if q17_idx_s > 0 else float("inf")
-        sq_speedup = sq_scan_s / sq_idx_s if sq_idx_s > 0 else float("inf")
-        import jax
-        result = {
-            "metric": "tpch_filter_wallclock_speedup_indexed_vs_scan",
-            "value": round(f_speedup, 3),
-            "unit": "x",
-            "vs_baseline": round(f_speedup, 3),
-            "filter_scan_s": round(f_scan_s, 4),
-            "filter_indexed_s": round(f_idx_s, 4),
-            "q3_speedup": round(q3_speedup, 3),
-            "q3_scan_s": round(q3_scan_s, 4),
-            "q3_indexed_s": round(q3_idx_s, 4),
-            "q17_speedup": round(q17_speedup, 3),
-            "q17_scan_s": round(q17_scan_s, 4),
-            "q17_indexed_s": round(q17_idx_s, 4),
-            "skipping_speedup": round(sq_speedup, 3),
-            "skipping_files_kept": skip_kept,
-            "skipping_files_total": OD_PARTS,
-            "index_build_s": round(build_s, 3),
-            "index_build_cold_s": round(cold_build_s, 3),
-            "index_build_scope": "warm rebuild of all 3 indexes (cold pass incl. compiles reported separately)",
-            "lineitem_rows": n_li,
-            "build_rows_per_s": round(n_li / build_s, 1),
-            "scale": args.scale,
-            "device": str(jax.devices()[0]),
-        }
-        print(json.dumps(result))
+        if "filter" in speedups:
+            RESULT["value"] = round(speedups["filter"], 3)
+            RESULT["vs_baseline"] = round(speedups["filter"], 3)
     finally:
         if not args.keep:
             shutil.rmtree(root, ignore_errors=True)
+
+    _emit_and_exit(0)
 
 
 if __name__ == "__main__":

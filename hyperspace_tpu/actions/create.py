@@ -82,13 +82,23 @@ class CreateActionBase(Action):
 
     def _write_index_files(self, table: Table, indexed: List[str],
                            version: int) -> str:
-        """Hash-partition + sort on device, then one parquet per bucket."""
+        """Hash-partition + sort on device, then one parquet per bucket.
+
+        When >1 device is visible the build runs over the whole mesh
+        (radix partition + all-to-all bucket exchange + per-device sort,
+        parallel/distributed_build.py) — the product-path analogue of the
+        reference's always-distributed Spark build
+        (actions/CreateActionBase.scala:118-121)."""
         num_buckets = self._num_buckets()
         row_group_size = self.session.hs_conf.index_row_group_size()
-        sorted_table, bounds = index_build.build_sorted_buckets(
-            table, indexed, num_buckets)
         out_dir = self.data_manager.get_path(version)
         os.makedirs(out_dir, exist_ok=True)
+        if self._use_mesh_build(table):
+            self._write_index_files_distributed(
+                table, indexed, num_buckets, out_dir, row_group_size)
+            return out_dir
+        sorted_table, bounds = index_build.build_sorted_buckets(
+            table, indexed, num_buckets)
         for b in range(num_buckets):
             lo, hi = int(bounds[b]), int(bounds[b + 1])
             if hi <= lo:
@@ -97,6 +107,55 @@ class CreateActionBase(Action):
                           os.path.join(out_dir, index_build.bucket_file_name(b)),
                           row_group_size=row_group_size)
         return out_dir
+
+    def _use_mesh_build(self, table: Table) -> bool:
+        import jax
+        return (self.session.hs_conf.distributed_enabled()
+                and len(jax.devices()) > 1
+                and table.num_rows > 0
+                and not any(table.column(n).has_nulls for n in table.names))
+
+    def _write_index_files_distributed(self, table: Table, indexed: List[str],
+                                       num_buckets: int, out_dir: str,
+                                       row_group_size: int) -> None:
+        """Mesh build: after the exchange, device i holds exactly the buckets
+        in its contiguous range, each sorted by the indexed columns — so the
+        per-bucket parquet write is a straight per-shard slice (no second
+        shuffle, matching the one-file-per-bucket layout of the
+        single-device path)."""
+        import jax
+        from ..parallel.distributed_build import distributed_build_sorted_buckets
+        from ..parallel.mesh import make_mesh
+
+        mesh = make_mesh()
+        n_dev = mesh.devices.size
+        out, valid, bids = distributed_build_sorted_buckets(
+            table, indexed, num_buckets, mesh)
+        # One host fetch for the whole result (per-bucket slicing below is
+        # pure numpy — no per-bucket device transfers).
+        bids_h = np.asarray(jax.device_get(bids))
+        host_cols = {
+            name: Column(c.dtype, np.asarray(jax.device_get(c.data)),
+                         None, c.dictionary)
+            for name, c in ((n, out.column(n)) for n in out.names)}
+        n_padded = bids_h.shape[0]
+        shard = n_padded // n_dev
+        for d in range(n_dev):
+            sb = bids_h[d * shard:(d + 1) * shard]
+            # Within a shard: valid rows first (bucket ids ascending), then
+            # padding rows carrying the sentinel id == num_buckets — so the
+            # shard is globally ascending and searchsorted yields bounds.
+            bounds = np.searchsorted(sb, np.arange(num_buckets + 1))
+            for b in range(num_buckets):
+                lo, hi = int(bounds[b]), int(bounds[b + 1])
+                if hi <= lo:
+                    continue
+                part = Table({n: c.slice(d * shard + lo, d * shard + hi)
+                              for n, c in host_cols.items()})
+                write_parquet(
+                    part,
+                    os.path.join(out_dir, index_build.bucket_file_name(b)),
+                    row_group_size=row_group_size)
 
     # ------------------------------------------------------------------
     # Log entry assembly (parity: CreateActionBase.getIndexLogEntry).

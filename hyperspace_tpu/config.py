@@ -161,6 +161,11 @@ class HyperspaceConf:
             IndexConstants.TPU_EXECUTION_ENABLED,
             IndexConstants.TPU_EXECUTION_ENABLED_DEFAULT)
 
+    def distributed_enabled(self) -> bool:
+        return self._get_bool(
+            IndexConstants.TPU_DISTRIBUTED_ENABLED,
+            IndexConstants.TPU_DISTRIBUTED_ENABLED_DEFAULT)
+
     def build_rows_per_shard(self) -> int:
         return int(
             self._conf.get(

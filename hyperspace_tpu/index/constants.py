@@ -124,3 +124,9 @@ class IndexConstants:
     TPU_BUILD_ROWS_PER_SHARD = "hyperspace.tpu.build.rowsPerShard"
     TPU_BUILD_ROWS_PER_SHARD_DEFAULT = str(8 * 1024 * 1024)
     TPU_MESH_SHAPE = "hyperspace.tpu.mesh"
+    # When >1 device is visible, index builds run over the whole mesh
+    # (all-to-all bucket exchange, parallel/distributed_build.py) — the
+    # analogue of the reference's always-distributed Spark build
+    # (actions/CreateActionBase.scala:118-121). "true" | "false".
+    TPU_DISTRIBUTED_ENABLED = "hyperspace.tpu.distributed.enabled"
+    TPU_DISTRIBUTED_ENABLED_DEFAULT = "true"
