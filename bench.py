@@ -56,10 +56,15 @@ def _ensure_backend(timeout_s: float) -> bool:
     honored once the axon plugin site is on PYTHONPATH — only an in-process
     ``jax.config.update("jax_platforms", "cpu")`` takes effect (verified
     empirically; tests/conftest.py relies on the same)."""
+    platform = os.environ.get("JAX_PLATFORMS", "")
+    if platform.strip().lower() == "cpu":
+        # CPU explicitly requested: no point probing the ambient backend
+        # (and the env var alone would not even be honored — see below).
+        RESULT["backend_fallback"] = "cpu"
+        return False
     probe = ("import jax; d = jax.devices(); "
              "import jax.numpy as jnp; jnp.arange(8).sum().block_until_ready(); "
              "print(d[0])")
-    platform = os.environ.get("JAX_PLATFORMS", "")
     try:
         out = subprocess.run(
             [sys.executable, "-c", probe], capture_output=True,
@@ -258,8 +263,7 @@ def main():
     # reference on this backend; auto-disable (fall back to jnp) otherwise.
     with _phase("pallas_self_check"):
         from hyperspace_tpu.ops import pallas_kernels
-        chk = pallas_kernels.self_check(auto_disable=True)
-        RESULT["pallas"] = {k: v for k, v in chk.items()}
+        RESULT["pallas"] = pallas_kernels.self_check(auto_disable=True)
 
     root = tempfile.mkdtemp(prefix="hs_bench_")
     session = None

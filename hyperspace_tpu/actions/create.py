@@ -99,13 +99,8 @@ class CreateActionBase(Action):
             return out_dir
         sorted_table, bounds = index_build.build_sorted_buckets(
             table, indexed, num_buckets)
-        for b in range(num_buckets):
-            lo, hi = int(bounds[b]), int(bounds[b + 1])
-            if hi <= lo:
-                continue  # empty buckets produce no file.
-            write_parquet(sorted_table.slice(lo, hi),
-                          os.path.join(out_dir, index_build.bucket_file_name(b)),
-                          row_group_size=row_group_size)
+        _write_bucket_files(sorted_table, bounds, 0, num_buckets, out_dir,
+                            row_group_size)
         return out_dir
 
     def _use_mesh_build(self, table: Table) -> bool:
@@ -138,6 +133,7 @@ class CreateActionBase(Action):
             name: Column(c.dtype, np.asarray(jax.device_get(c.data)),
                          None, c.dictionary)
             for name, c in ((n, out.column(n)) for n in out.names)}
+        host_table = Table(host_cols)
         n_padded = bids_h.shape[0]
         shard = n_padded // n_dev
         for d in range(n_dev):
@@ -146,16 +142,8 @@ class CreateActionBase(Action):
             # padding rows carrying the sentinel id == num_buckets — so the
             # shard is globally ascending and searchsorted yields bounds.
             bounds = np.searchsorted(sb, np.arange(num_buckets + 1))
-            for b in range(num_buckets):
-                lo, hi = int(bounds[b]), int(bounds[b + 1])
-                if hi <= lo:
-                    continue
-                part = Table({n: c.slice(d * shard + lo, d * shard + hi)
-                              for n, c in host_cols.items()})
-                write_parquet(
-                    part,
-                    os.path.join(out_dir, index_build.bucket_file_name(b)),
-                    row_group_size=row_group_size)
+            _write_bucket_files(host_table, bounds, d * shard, num_buckets,
+                                out_dir, row_group_size)
 
     # ------------------------------------------------------------------
     # Log entry assembly (parity: CreateActionBase.getIndexLogEntry).
@@ -207,6 +195,20 @@ class CreateActionBase(Action):
 def _file_triple(path: str):
     from ..util.file_utils import file_info_triple
     return file_info_triple(path)
+
+
+def _write_bucket_files(table: Table, bounds, base: int, num_buckets: int,
+                        out_dir: str, row_group_size: int) -> None:
+    """One parquet per non-empty bucket from bucket-contiguous rows.
+    ``bounds[b]``..``bounds[b+1]`` (plus ``base``) delimit bucket b; the
+    single shared layout rule for the single-device and mesh builds."""
+    for b in range(num_buckets):
+        lo, hi = int(bounds[b]), int(bounds[b + 1])
+        if hi <= lo:
+            continue  # empty buckets produce no file.
+        write_parquet(table.slice(base + lo, base + hi),
+                      os.path.join(out_dir, index_build.bucket_file_name(b)),
+                      row_group_size=row_group_size)
 
 
 class CreateAction(CreateActionBase):

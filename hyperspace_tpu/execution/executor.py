@@ -12,6 +12,7 @@ itself ... is the part the new framework replaces with XLA/Pallas kernels").
 
 from __future__ import annotations
 
+import contextvars
 from typing import List, Optional, Sequence, Set, Tuple
 
 import jax.numpy as jnp
@@ -29,8 +30,18 @@ from .evaluator import eval_expr, eval_predicate_mask
 from .pushdown import pushable_filter
 
 
-def execute(plan: LogicalPlan) -> Table:
-    return _execute(plan, needed=None)
+# Session for the in-flight execution: the SPMD dispatch reads its conf
+# (distributed on/off) without threading a parameter through the recursion.
+_SESSION: contextvars.ContextVar = contextvars.ContextVar(
+    "hst_executing_session", default=None)
+
+
+def execute(plan: LogicalPlan, session=None) -> Table:
+    token = _SESSION.set(session)
+    try:
+        return _execute(plan, needed=None)
+    finally:
+        _SESSION.reset(token)
 
 
 def _execute(plan: LogicalPlan, needed: Optional[Set[str]]) -> Table:
@@ -79,6 +90,13 @@ def _execute(plan: LogicalPlan, needed: Optional[Set[str]]) -> Table:
     if isinstance(plan, Join):
         return _execute_join(plan, needed)
     if isinstance(plan, Aggregate):
+        # Multi-device product path: run eligible aggregation subtrees SPMD
+        # over the mesh (execution/spmd.py); fall back on any mismatch.
+        from . import spmd
+        spmd_result = spmd.try_execute_aggregate(plan, _SESSION.get(),
+                                                 _execute)
+        if spmd_result is not None:
+            return spmd_result
         child_needed = set(plan.group_cols)
         for a in plan.aggs:
             child_needed.update(a.references)

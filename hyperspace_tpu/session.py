@@ -107,7 +107,7 @@ class Session:
 
     def execute(self, plan: LogicalPlan):
         from .execution import execute as run
-        return run(self.optimize(plan))
+        return run(self.optimize(plan), session=self)
 
     def create_dataframe(self, plan: LogicalPlan) -> "DataFrame":
         return DataFrame(self, plan)
