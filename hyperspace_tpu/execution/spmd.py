@@ -318,8 +318,10 @@ def _dict_fingerprint(dic: Optional[np.ndarray]):
     if dic is None:
         return None
     # Dictionaries are trace-time constants (translate tables, literal
-    # bounds); they must participate in the compile-cache key.
-    return (len(dic), hash(tuple(dic.tolist())))
+    # bounds); they must participate in the compile-cache key by *content*
+    # (not a hash of the content) so __eq__ compares real values and a
+    # hash collision can never alias two distinct compiled programs.
+    return tuple(dic.tolist())
 
 
 def _run(plan: Aggregate, executor) -> Table:
@@ -345,22 +347,34 @@ def _run(plan: Aggregate, executor) -> Table:
         col_meta[name] = (c.dtype, c.dictionary, c.validity is not None)
     sharded, valid = pad_and_shard(mesh, stream_arrays, leaf_table.num_rows)
 
-    # Prepare broadcast join sides; extend col_meta with their columns.
+    # Prepare broadcast join sides while walking the stage chain in order
+    # over zero-length columns (the evaluator propagates dtype/dictionary/
+    # nullability exactly as the traced per-device program will). The join
+    # prep therefore sees the stream key's *post-stage* metadata — a
+    # Project below the Join that redefines the key name (cast, computed
+    # expression, dictionary change) feeds the broadcast side the same
+    # dtype/dictionary the traced probe will use, never stale leaf meta.
     joins: Dict[int, Tuple[Tuple[str, str], _BroadcastSide]] = {}
     bcast_arrays: Dict[str, jax.Array] = {}
+    tiny = {n: Column(dt, jnp.zeros(0, _DEVICE_DTYPE[dt]),
+                      jnp.zeros(0, jnp.bool_) if nul else None, dic)
+            for n, (dt, dic, nul) in col_meta.items()}
     for i, (kind, node) in enumerate(stages):
-        if kind != "join":
+        if kind == "filter":
+            continue
+        if kind == "project":
+            t = Table(tiny)
+            tiny = {e.name: eval_expr(t, e) for e in node.exprs}
             continue
         pairs = _normalized_join_pairs(node)
         if len(pairs) != 1:
             raise _Unsupported("multi-key broadcast join")
         lname, rname = pairs[0]
-        if lname not in col_meta:
-            raise _Unsupported("computed stream join key")
-        ldt, ldic, _ = col_meta[lname]
-        lprobe = Column(ldt, jnp.zeros(0, _DEVICE_DTYPE[ldt]), None, ldic)
+        if lname not in tiny:
+            raise _Unsupported(f"unknown stream join key {lname}")
+        lc = tiny[lname]
         right_table = executor(node.right, right_needed[i])
-        side = _prepare_broadcast(right_table, rname, lprobe)
+        side = _prepare_broadcast(right_table, rname, lc)
         joins[i] = (pairs[0], side)
         bcast_arrays[f"k:{i}"] = side.keys
         for n in side.table.names:
@@ -369,12 +383,18 @@ def _run(plan: Aggregate, executor) -> Table:
                 bcast_arrays[f"b:{i}:{n}"] = rc.data
                 if rc.validity is not None:
                     bcast_arrays[f"bv:{i}:{n}"] = rc.validity
+                tiny[n] = Column(rc.dtype,
+                                 jnp.zeros(0, _DEVICE_DTYPE[rc.dtype]),
+                                 jnp.zeros(0, jnp.bool_)
+                                 if rc.validity is not None else None,
+                                 rc.dictionary)
             col_meta[n] = (rc.dtype, rc.dictionary, rc.validity is not None)
-
-    # Final-schema metadata: walk the stage chain over zero-length columns
-    # (the evaluator propagates dtype/dictionary/nullability exactly as the
-    # traced per-device program will).
-    final_meta = _final_meta(stages, joins, col_meta)
+        if rname in node.schema.names and rname not in tiny:
+            # Matched rows: right key == left key by definition.
+            tiny[rname] = Column(lc.dtype, lc.data, lc.validity,
+                                 lc.dictionary)
+    final_meta = {n: (c.dtype, c.dictionary, c.validity is not None)
+                  for n, c in tiny.items()}
 
     def probe(e: E.Expr) -> Column:
         tiny = {n: Column(dt, jnp.zeros(0, _DEVICE_DTYPE[dt]),
@@ -404,36 +424,6 @@ def _run(plan: Aggregate, executor) -> Table:
         table = _merge_global(out, agg_specs, final_meta)
     DISPATCH_COUNT += 1
     return table
-
-
-def _final_meta(stages, joins, leaf_meta):
-    """(dtype, dictionary, nullable) per column in the post-stage name
-    space, derived by running the evaluator over zero-length columns."""
-    tiny = {n: Column(dt, jnp.zeros(0, _DEVICE_DTYPE[dt]),
-                      jnp.zeros(0, jnp.bool_) if nul else None, dic)
-            for n, (dt, dic, nul) in leaf_meta.items()}
-    for i, (kind, node) in enumerate(stages):
-        if kind == "filter":
-            continue
-        if kind == "project":
-            t = Table(tiny)
-            tiny = {e.name: eval_expr(t, e) for e in node.exprs}
-            continue
-        (lname, rname), side = joins[i]
-        lc = tiny[lname]
-        for n in side.table.names:
-            if n == rname:
-                continue
-            rc = side.table.column(n)
-            tiny[n] = Column(rc.dtype, jnp.zeros(0, _DEVICE_DTYPE[rc.dtype]),
-                             jnp.zeros(0, jnp.bool_)
-                             if rc.validity is not None else None,
-                             rc.dictionary)
-        if rname in node.schema.names and rname not in tiny:
-            tiny[rname] = Column(lc.dtype, lc.data, lc.validity,
-                                 lc.dictionary)
-    return {n: (c.dtype, c.dictionary, c.validity is not None)
-            for n, c in tiny.items()}
 
 
 class _StageDescr:
@@ -653,8 +643,14 @@ def _merge_grouped(out, agg_specs, group_cols: List[str], col_meta) -> Table:
     # (the single-device path also emits groups key-sorted).
     sort_cols: List[np.ndarray] = []
     for f, k in zip(flags, keys):
-        sort_cols.append(k)
+        # Flag before key: np.lexsort makes the *last* key primary, and
+        # sort_cols is reversed below, so per group column the null-flag
+        # must precede the value to be the more significant key — matching
+        # the per-device (flag, data) sort order (null-first, since null
+        # rows carry flag 0 and value 0, and negative values sort after
+        # the null group only when the flag dominates).
         sort_cols.append(f)
+        sort_cols.append(k)
     order = np.lexsort(tuple(reversed(sort_cols))) if sort_cols else \
         np.arange(len(sel))
     keys = [k[order] for k in keys]

@@ -156,6 +156,22 @@ class TestBroadcastJoin:
             .agg(sum_(col("o_pri")).alias("so"), count(None).alias("n")))
         assert_tables_equal(d, s)
 
+    def test_project_redefined_join_key(self, session, lineitem_dir,
+                                        orders_dir):
+        # A Project below the Join that *redefines* the stream join key
+        # (computed expression under the same name) must feed the join
+        # prep the post-project metadata, not stale leaf metadata.
+        li = session.read.parquet(lineitem_dir)
+        od = session.read.parquet(orders_dir)
+        d, s = run_both(
+            session,
+            lambda: li.select((col("l_orderkey") + 0).alias("l_orderkey"),
+                              "l_price")
+            .join(od, on=col("l_orderkey") == col("o_orderkey"))
+            .agg(sum_(col("l_price")).alias("sp"),
+                 sum_(col("o_pri")).alias("so"), count(None).alias("n")))
+        assert_tables_equal(d, s, float_cols=("sp",))
+
     def test_many_to_many_falls_back(self, session, lineitem_dir):
         # Self-join on a non-unique key: the broadcast m:1 requirement
         # fails, the SPMD path declines, and the single-device executor
@@ -231,6 +247,42 @@ class TestNullables:
         nn = ref[~ref.g.isna()].sort_values("g")
         assert got["g"][1:] == [int(x) for x in nn["g"]]
         assert got["n"][1:] == [int(x) for x in nn["n"]]
+        assert np.allclose(got["sw"][1:], nn["sw"].to_numpy())
+
+    def test_nullable_group_key_negative_values(self, session, tmp_path):
+        # Null group must sort FIRST even with negative keys present (nulls
+        # are encoded as value 0 on device; only the null-flag being the
+        # more significant sort key keeps them ahead of negatives in the
+        # host merge).
+        rng = np.random.default_rng(14)
+        n = 3000
+        g = rng.integers(-10, 10, n).astype(np.int64)
+        null_at = rng.random(n) < 0.1
+        t = pa.table({
+            "g": pa.array([None if m else int(x)
+                           for x, m in zip(g, null_at)], type=pa.int64()),
+            "w": rng.uniform(0, 1, n),
+        })
+        d = tmp_path / "neg_nulls"
+        d.mkdir()
+        pq.write_table(t, str(d / "part0.parquet"))
+        df = session.read.parquet(str(d))
+        before = spmd.DISPATCH_COUNT
+        out = (df.group_by("g")
+               .agg(sum_(col("w")).alias("sw"), count(None).alias("n"))
+               ).to_arrow()
+        assert spmd.DISPATCH_COUNT > before
+        got = out.to_pydict()
+        assert got["g"][0] is None, "null group must come first"
+        assert got["g"][1:] == sorted(got["g"][1:])
+        pdf = t.to_pandas()
+        ref = (pdf.groupby("g", dropna=False)
+               .agg(sw=("w", "sum"), n=("w", "size")).reset_index())
+        ref_null = ref[ref.g.isna()]
+        assert got["n"][0] == int(ref_null["n"].iloc[0])
+        assert abs(got["sw"][0] - float(ref_null["sw"].iloc[0])) < 1e-9
+        nn = ref[~ref.g.isna()].sort_values("g")
+        assert got["g"][1:] == [int(x) for x in nn["g"]]
         assert np.allclose(got["sw"][1:], nn["sw"].to_numpy())
 
 
