@@ -22,6 +22,7 @@ import subprocess
 import sys
 import tempfile
 import time
+from typing import List, Optional
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
@@ -46,11 +47,44 @@ def _emit_and_exit(code: int = 0) -> None:
     sys.exit(code)
 
 
+# Staged backend probe (VERDICT r2 #1: two rounds of probe timeouts with the
+# evidence thrown away). Each stage prints a sentinel as it completes, so a
+# hang is attributable to the *first stage whose sentinel is missing*; on
+# timeout the killed child's partial stdout/stderr are recorded, not dropped.
+_PROBE_SCRIPT = r"""
+import sys, time
+t0 = time.time()
+def stage(name, extra=""):
+    print(f"STAGE {name} ok +{time.time()-t0:.1f}s {extra}", flush=True)
+import jax
+stage("import", f"jax={jax.__version__}")
+try:
+    import jaxlib
+    stage("jaxlib", f"jaxlib={jaxlib.__version__}")
+except Exception as e:  # version info is best-effort
+    print(f"jaxlib version unavailable: {e}", flush=True)
+d = jax.devices()
+stage("devices", f"{d}")
+import jax.numpy as jnp
+jnp.arange(8).sum().block_until_ready()
+stage("tiny_op")
+a = jnp.ones((256, 256), jnp.bfloat16)
+(a @ a).block_until_ready()
+stage("matmul", f"platform={d[0].platform}")
+print(f"PROBE_OK {d[0]}", flush=True)
+"""
+
+
+def _tail(text: Optional[str], n: int = 12) -> List[str]:
+    return (text or "").strip().splitlines()[-n:]
+
+
 def _ensure_backend(timeout_s: float) -> bool:
     """Probe the ambient JAX backend in a subprocess (it can hang or die at
     init — BENCH_r01's failure mode: rc=1 UNAVAILABLE; in other sandboxes it
     hangs indefinitely). Returns True if the ambient backend works, False if
-    the caller must fall back to CPU.
+    the caller must fall back to CPU. Retries once: TPU runtime attach
+    through the tunnel has been observed to fail transiently.
 
     NOTE the fallback mechanism: setting JAX_PLATFORMS=cpu in the env is NOT
     honored once the axon plugin site is on PYTHONPATH — only an in-process
@@ -62,24 +96,34 @@ def _ensure_backend(timeout_s: float) -> bool:
         # (and the env var alone would not even be honored — see below).
         RESULT["backend_fallback"] = "cpu"
         return False
-    probe = ("import jax; d = jax.devices(); "
-             "import jax.numpy as jnp; jnp.arange(8).sum().block_until_ready(); "
-             "print(d[0])")
-    try:
-        out = subprocess.run(
-            [sys.executable, "-c", probe], capture_output=True,
-            text=True, timeout=timeout_s)
-        if out.returncode == 0:
+    for attempt in range(2):
+        t0 = time.perf_counter()
+        try:
+            out = subprocess.run(
+                [sys.executable, "-c", _PROBE_SCRIPT], capture_output=True,
+                text=True, timeout=timeout_s)
+        except subprocess.TimeoutExpired as e:
+            # The killed child's partial output IS the diagnosis: the last
+            # STAGE line printed tells which init step hung.
+            so = e.stdout.decode() if isinstance(e.stdout, bytes) else e.stdout
+            se = e.stderr.decode() if isinstance(e.stderr, bytes) else e.stderr
+            RESULT["errors"].append(
+                f"backend probe attempt {attempt + 1} "
+                f"(JAX_PLATFORMS={platform!r}) timed out after "
+                f"{timeout_s:.0f}s; stdout tail={_tail(so)}; "
+                f"stderr tail={_tail(se)}")
+            continue
+        stages = [l for l in out.stdout.splitlines()
+                  if l.startswith("STAGE ")]
+        if out.returncode == 0 and "PROBE_OK" in out.stdout:
             RESULT["backend_probe"] = out.stdout.strip().splitlines()[-1]
+            RESULT["backend_probe_stages"] = stages
+            RESULT["backend_probe_s"] = round(time.perf_counter() - t0, 1)
             return True
-        err_tail = (out.stderr or "").strip().splitlines()[-1:]
         RESULT["errors"].append(
-            f"backend probe (JAX_PLATFORMS={platform!r}) "
-            f"rc={out.returncode}: {err_tail}")
-    except subprocess.TimeoutExpired:
-        RESULT["errors"].append(
-            f"backend probe (JAX_PLATFORMS={platform!r}) timed out "
-            f"after {timeout_s:.0f}s")
+            f"backend probe attempt {attempt + 1} "
+            f"(JAX_PLATFORMS={platform!r}) rc={out.returncode}; "
+            f"stages={stages}; stderr tail={_tail(out.stderr)}")
     RESULT["backend_fallback"] = "cpu"
     return False
 
@@ -216,21 +260,95 @@ def timed_best(fn, repeats: int) -> float:
     return best
 
 
+# Path of the partial-result spill file (watchdog mode): the child rewrites
+# it after every phase, so a hard device hang still leaves an attributable
+# JSON trail for the parent to emit.
+_PARTIAL_PATH: Optional[str] = None
+
+
+def _spill_partial() -> None:
+    if _PARTIAL_PATH:
+        try:
+            with open(_PARTIAL_PATH, "w") as f:
+                json.dump(RESULT, f)
+        except OSError:
+            pass
+
+
 def _phase(name: str):
     """Decorator-less phase guard: returns True if fn ran clean. Failures
     are recorded in RESULT["errors"] and the bench continues."""
     class _Ctx:
         def __enter__(self):
+            RESULT["phase_current"] = name
+            _spill_partial()
             return self
 
         def __exit__(self, et, ev, tb):
             if et is not None and issubclass(et, Exception):
                 import traceback
-                tail = traceback.format_exception_only(et, ev)[-1].strip()
-                RESULT["errors"].append(f"phase {name}: {tail}")
+                # Record the *last frames*, not just the message: JAX wraps
+                # device errors in a traceback-filtering notice whose final
+                # line says nothing (observed on the first real-TPU run).
+                lines = [l.rstrip() for l in
+                         traceback.format_exception(et, ev, tb)]
+                RESULT["errors"].append(
+                    f"phase {name}: " + " | ".join(lines[-8:])[-2000:])
+                _spill_partial()
                 return True  # swallow; later phases still run
+            RESULT.pop("phase_current", None)
+            _spill_partial()
             return False  # KeyboardInterrupt/SystemExit propagate
     return _Ctx()
+
+
+def _run_with_watchdog(argv: List[str], total_timeout: float) -> int:
+    """Re-run this script as a supervised child. A TPU runtime hang cannot
+    be interrupted from Python (the blocked C call never returns to the
+    signal handler), so the ONE-JSON-line contract is enforced from outside:
+    on child timeout the parent emits the child's last spilled partial
+    RESULT, annotated with the phase it hung in."""
+    import tempfile as _tf
+    fd, partial = _tf.mkstemp(prefix="hs_bench_partial_", suffix=".json")
+    os.close(fd)
+    env = dict(os.environ)
+    env["BENCH_CHILD_PARTIAL"] = partial
+    try:
+        out = subprocess.run(
+            [sys.executable, os.path.abspath(__file__)] + argv,
+            env=env, timeout=total_timeout, capture_output=True, text=True)
+        last = (out.stdout or "").strip().splitlines()
+        if out.returncode == 0 and last:
+            print(last[-1])
+            return 0
+        # Child died without printing: recover its spilled partial state.
+        try:
+            with open(partial) as f:
+                RESULT.update(json.load(f))
+        except (OSError, ValueError):
+            pass
+        RESULT["errors"].append(
+            f"bench child rc={out.returncode}; "
+            f"stderr tail={_tail(out.stderr)}")
+    except subprocess.TimeoutExpired as e:
+        try:
+            with open(partial) as f:
+                RESULT.update(json.load(f))
+        except (OSError, ValueError):
+            pass
+        so = e.stdout.decode() if isinstance(e.stdout, bytes) else e.stdout
+        se = e.stderr.decode() if isinstance(e.stderr, bytes) else e.stderr
+        RESULT["errors"].append(
+            f"bench child timed out after {total_timeout:.0f}s in phase "
+            f"{RESULT.get('phase_current', '?')!r}; stdout tail={_tail(so)}; "
+            f"stderr tail={_tail(se)}")
+    finally:
+        try:
+            os.unlink(partial)
+        except OSError:
+            pass
+    print(json.dumps(RESULT))
+    return 0
 
 
 def main():
@@ -240,9 +358,18 @@ def main():
     parser.add_argument("--repeats", type=int, default=3)
     parser.add_argument("--keep", action="store_true")
     parser.add_argument("--backend-timeout", type=float, default=float(
-        os.environ.get("BENCH_BACKEND_TIMEOUT", "300")))
+        os.environ.get("BENCH_BACKEND_TIMEOUT", "540")))
+    parser.add_argument("--total-timeout", type=float, default=float(
+        os.environ.get("BENCH_TOTAL_TIMEOUT", "3300")))
+    parser.add_argument("--no-watchdog", action="store_true")
     args = parser.parse_args()
     RESULT["scale"] = args.scale
+
+    global _PARTIAL_PATH
+    _PARTIAL_PATH = os.environ.get("BENCH_CHILD_PARTIAL")
+    if _PARTIAL_PATH is None and not args.no_watchdog:
+        child_argv = sys.argv[1:] + ["--no-watchdog"]
+        sys.exit(_run_with_watchdog(child_argv, args.total_timeout))
 
     backend_ok = _ensure_backend(args.backend_timeout)
 
@@ -255,6 +382,12 @@ def main():
         from hyperspace_tpu.index.constants import IndexConstants
         RESULT["device"] = str(jax.devices()[0])
         RESULT["backend"] = jax.default_backend()
+        RESULT["jax_version"] = jax.__version__
+        try:
+            import jaxlib
+            RESULT["jaxlib_version"] = jaxlib.__version__
+        except Exception:
+            pass
     except Exception as e:
         RESULT["errors"].append(f"backend init: {type(e).__name__}: {e}")
         _emit_and_exit(0)

@@ -105,10 +105,16 @@ class CreateActionBase(Action):
 
     def _use_mesh_build(self, table: Table) -> bool:
         import jax
-        return (self.session.hs_conf.distributed_enabled()
-                and len(jax.devices()) > 1
-                and table.num_rows > 0
-                and not any(table.column(n).has_nulls for n in table.names))
+        if not self.session.hs_conf.distributed_enabled():
+            return False
+        if len(jax.devices()) <= 1:
+            return False
+        if table.num_rows == 0:
+            from ..telemetry.logging import emit_distributed_fallback
+            emit_distributed_fallback(self.session, "index_build",
+                                      "empty source table")
+            return False
+        return True
 
     def _write_index_files_distributed(self, table: Table, indexed: List[str],
                                        num_buckets: int, out_dir: str,
@@ -131,7 +137,9 @@ class CreateActionBase(Action):
         bids_h = np.asarray(jax.device_get(bids))
         host_cols = {
             name: Column(c.dtype, np.asarray(jax.device_get(c.data)),
-                         None, c.dictionary)
+                         None if c.validity is None
+                         else np.asarray(jax.device_get(c.validity)),
+                         c.dictionary)
             for name, c in ((n, out.column(n)) for n in out.names)}
         host_table = Table(host_cols)
         n_padded = bids_h.shape[0]

@@ -297,13 +297,40 @@ def _join_key_arrays(left: Table, right: Table,
         for lname, rname in pairs:
             lc, rc = left.column(lname), right.column(rname)
             if lc.dtype not in (INT32, DATE) or rc.dtype not in (INT32, DATE):
-                raise HyperspaceException(
-                    "Multi-column joins currently require int32/date keys")
+                break
             lks.append(lc.data)
             rks.append(rc.data)
-        return (kernels.pack2_int32(lks[0], lks[1]),
-                kernels.pack2_int32(rks[0], rks[1]))
-    raise HyperspaceException("Joins on >2 key columns not supported yet")
+        else:
+            return (kernels.pack2_int32(lks[0], lks[1]),
+                    kernels.pack2_int32(rks[0], rks[1]))
+    # General N-key path, any key dtypes: dense-rank the union of key
+    # tuples so both sides join on one int32 rank column (equal tuples ↔
+    # equal ranks). One extra lex-sort over left+right keys, no host sync.
+    n_left = left.num_rows
+    union_keys = []
+    for lname, rname in pairs:
+        lc, rc = left.column(lname), right.column(rname)
+        union_keys.append(_comparable_concat(lc, rc))
+    ranks = kernels.dense_rank(union_keys)
+    return ranks[:n_left], ranks[n_left:]
+
+
+def _comparable_concat(lc: Column, rc: Column) -> jnp.ndarray:
+    """Concatenated (left ++ right) key values in one comparable space."""
+    if (lc.dtype == STRING) != (rc.dtype == STRING):
+        raise HyperspaceException("Join key type mismatch")
+    if lc.dtype == STRING:
+        ldata, rdata = _string_join_keys(lc, rc)
+        return jnp.concatenate([ldata, rdata])
+    int_family = (INT32, INT64, DATE, BOOL)
+    if lc.dtype in int_family and rc.dtype in int_family:
+        return jnp.concatenate([lc.data.astype(jnp.int64),
+                                rc.data.astype(jnp.int64)])
+    if lc.dtype in (FLOAT64, "float32") and rc.dtype in (FLOAT64, "float32"):
+        return jnp.concatenate([lc.data.astype(jnp.float64),
+                                rc.data.astype(jnp.float64)])
+    raise HyperspaceException(
+        f"Join key type mismatch: {lc.dtype} vs {rc.dtype}")
 
 
 def _string_join_keys(lc: Column, rc: Column):
@@ -436,17 +463,29 @@ def _keys_validity(table: Table, names: Sequence[str]):
 # Aggregate / Sort.
 # ---------------------------------------------------------------------------
 
+def _null_aware_keys(c: Column) -> List[jnp.ndarray]:
+    """Comparison keys for one column treating null as its own value that
+    sorts before every real value: a (validity-flag, null-masked data) pair
+    when nullable, just the data otherwise. The single encoding shared by
+    sort, group-by, and the SPMD path's per-device order (spmd.py)."""
+    if c.validity is None:
+        return [c.data]
+    return [c.validity.astype(jnp.int32),  # null(0) sorts first
+            jnp.where(c.validity, c.data, jnp.zeros((), c.data.dtype))]
+
+
+def _group_sort_keys(cols: Sequence[Column]) -> List[jnp.ndarray]:
+    return [k for c in cols for k in _null_aware_keys(c)]
+
+
 def _execute_aggregate(plan: Aggregate, table: Table) -> Table:
     if not plan.group_cols:
         return _execute_global_aggregate(plan, table)
     key_cols = [table.column(g) for g in plan.group_cols]
-    for g, c in zip(plan.group_cols, key_cols):
-        if c.validity is not None:
-            raise HyperspaceException(
-                f"Grouping on nullable column '{g}' not supported yet")
-    order = kernels.lex_sort_indices([c.data for c in key_cols])
+    order = kernels.lex_sort_indices(_group_sort_keys(key_cols))
     sorted_table = table.take(order)
-    sorted_keys = [sorted_table.column(g).data for g in plan.group_cols]
+    sorted_keys = _group_sort_keys(
+        [sorted_table.column(g) for g in plan.group_cols])
     gids, num_groups = kernels.group_ids_from_sorted(sorted_keys)
     if num_groups == 0:
         return Table({f.name: Column(f.dtype,
@@ -542,11 +581,11 @@ def _execute_global_aggregate(plan: Aggregate, table: Table) -> Table:
 def _execute_sort(plan: Sort, table: Table) -> Table:
     keys, ascending = [], []
     for name, asc in plan.orders:
-        c = table.column(name)
-        if c.validity is not None:
-            raise HyperspaceException(
-                f"Sorting on nullable column '{name}' not supported yet")
-        keys.append(c.data)
-        ascending.append(asc)
+        # SQL order-by null placement (Spark default): NULLS FIRST when
+        # ascending, NULLS LAST when descending — sorting the null-aware
+        # (flag, data) keys in the requested direction realizes both.
+        for k in _null_aware_keys(table.column(name)):
+            keys.append(k)
+            ascending.append(asc)
     order = kernels.lex_sort_indices(keys, ascending)
     return table.take(order)

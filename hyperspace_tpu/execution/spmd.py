@@ -36,8 +36,8 @@ Execution model — mask-based streaming, never row compaction:
 Null semantics match the single-device executor: filters keep
 true-and-valid rows, inner-join null keys never match, aggregates skip
 invalid values, and nullable group keys treat null as its own group
-(null-first in the output order — a capability the single-device path
-does not have yet).
+(null-first in the output order, the same encoding the single-device
+path uses — executor._null_aware_keys).
 """
 
 from __future__ import annotations
@@ -310,7 +310,9 @@ def try_execute_aggregate(plan: Aggregate, session,
         if len(jax.devices()) < 2:
             return None
         return _run(plan, executor)
-    except _Unsupported:
+    except _Unsupported as e:
+        from ..telemetry.logging import emit_distributed_fallback
+        emit_distributed_fallback(session, "spmd_query", str(e))
         return None
 
 

@@ -198,6 +198,34 @@ def _expand_matches(counts: jax.Array, lo: jax.Array, total: int
     return left_idx, right_idx
 
 
+def change_mask(sorted_keys: Sequence[jax.Array]) -> jax.Array:
+    """True where a row's key tuple differs from the previous row's (rows
+    already sorted by the keys); row 0 is False."""
+    n = int(sorted_keys[0].shape[0])
+    change = jnp.zeros(n, dtype=jnp.bool_)
+    for k in sorted_keys:
+        change = change | jnp.concatenate(
+            [jnp.zeros(1, jnp.bool_), k[1:] != k[:-1]])
+    return change
+
+
+def dense_rank(keys: Sequence[jax.Array]) -> jax.Array:
+    """Dense rank of each row's key *tuple* in lexicographic order.
+
+    Equal tuples get equal ranks and ranks are order-preserving, so a
+    multi-column equi-join reduces to a single int32-key join on the ranks
+    of the two sides' concatenated key columns. Fully on device — no host
+    sync (the consumer never needs the rank count).
+    """
+    n = int(keys[0].shape[0])
+    if n == 0:
+        return jnp.zeros(0, jnp.int32)
+    order = lex_sort_indices(keys)
+    change = change_mask([jnp.take(k, order) for k in keys])
+    gids = jnp.cumsum(change.astype(jnp.int32))
+    return jnp.zeros(n, jnp.int32).at[order].set(gids)
+
+
 def pack2_int32(a: jax.Array, b: jax.Array) -> jax.Array:
     """Pack two int32 key columns into one int64 composite key.
 
@@ -222,11 +250,7 @@ def group_ids_from_sorted(keys: Sequence[jax.Array]) -> Tuple[jax.Array, int]:
     n = int(keys[0].shape[0])
     if n == 0:
         return jnp.zeros(0, jnp.int32), 0
-    change = jnp.zeros(n, dtype=jnp.bool_)
-    for k in keys:
-        change = change | jnp.concatenate(
-            [jnp.zeros(1, jnp.bool_), k[1:] != k[:-1]])
-    gids = jnp.cumsum(change.astype(jnp.int32))
+    gids = jnp.cumsum(change_mask(keys).astype(jnp.int32))
     num_groups = int(gids[-1]) + 1  # HOST SYNC (single scalar).
     return gids, num_groups
 

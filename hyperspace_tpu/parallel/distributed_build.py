@@ -139,21 +139,23 @@ def distributed_build_sorted_buckets(
     rows = table.num_rows
     shard_rows = -(-max(rows, 1) // n_dev)  # ceil.
 
+    # Column data is shipped under "d:<name>"; a nullable column's validity
+    # bitmap rides the same exchange under "v:<name>" (null rows keep their
+    # deterministic fill value for hashing/sorting — identical to the
+    # single-device build's encoding, so both layouts agree).
     arrays, dict_tables = {}, {}
     key_dtypes = []
     for name in table.names:
         col = table.column(name)
+        arrays[f"d:{name}"] = col.data
         if col.validity is not None:
-            raise HyperspaceException(
-                f"Distributed build over nullable column '{name}' is not "
-                "supported yet")
-        arrays[name] = col.data
+            arrays[f"v:{name}"] = col.validity
         if col.dtype == STRING:
             import zlib
             hashes = np.array([zlib.crc32(s.encode("utf-8"))
                                for s in col.dictionary], dtype=np.uint32) \
                 if len(col.dictionary) else np.zeros(1, np.uint32)
-            dict_tables[name] = jnp.asarray(hashes)
+            dict_tables[f"d:{name}"] = jnp.asarray(hashes)
     for c in indexed_cols:
         key_dtypes.append(table.column(c).dtype)
 
@@ -166,14 +168,14 @@ def distributed_build_sorted_buckets(
         out, out_valid, out_bids, overflow = _exchange_and_sort(
             arrays, valid, dict_tables,
             num_buckets=num_buckets, n_dev=n_dev, cap=cap,
-            key_names=tuple(indexed_cols), key_dtypes=tuple(key_dtypes),
-            mesh=mesh)
+            key_names=tuple(f"d:{c}" for c in indexed_cols),
+            key_dtypes=tuple(key_dtypes), mesh=mesh)
         if not bool(overflow):
             out_cols = {}
             for name in table.names:
                 src = table.column(name)
-                out_cols[name] = Column(src.dtype, out[name],
-                                        None, src.dictionary)
+                out_cols[name] = Column(src.dtype, out[f"d:{name}"],
+                                        out.get(f"v:{name}"), src.dictionary)
             return Table(out_cols), out_valid, out_bids
         if cap >= shard_rows:
             raise HyperspaceException(
