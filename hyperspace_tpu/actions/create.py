@@ -103,6 +103,46 @@ class CreateActionBase(Action):
                             row_group_size)
         return out_dir
 
+    def _build_chunked(self, relation, indexed: List[str],
+                       included: List[str], file_id_tracker: FileIdTracker,
+                       version: int,
+                       files: Optional[List[str]] = None) -> bool:
+        """Streaming build when the source exceeds the device-footprint
+        budget (hyperspace.tpu.maxChunkRows): parquet row-groups flow
+        chunk→bucket-spill→per-bucket merge with only one chunk or bucket
+        in HBM at a time (ops/index_build.build_sorted_buckets_chunked).
+        Returns False when the in-memory path should run instead."""
+        from ..execution.columnar import parquet_row_counts
+        from ..ops.index_build import build_sorted_buckets_chunked
+
+        data_fmt = getattr(relation, "data_file_format", relation.file_format)
+        if data_fmt != "parquet":
+            return False
+        files = list(files) if files is not None else relation.all_files()
+        if not files:
+            return False
+        # Dotted struct leaves aren't physical top-level parquet columns;
+        # the streaming reader can't project them — in-memory path only.
+        physical = set(pq.read_schema(files[0]).names)
+        if any(c not in physical for c in indexed + included):
+            return False
+        chunk_rows = self.session.hs_conf.max_chunk_rows()
+        if sum(parquet_row_counts(files)) <= chunk_rows:
+            return False
+        lineage_ids = None
+        if self._lineage_enabled():
+            lineage_ids = [file_id_tracker.add_file(*_file_triple(f))
+                           for f in files]
+        out_dir = self.data_manager.get_path(version)
+        os.makedirs(out_dir, exist_ok=True)
+        build_sorted_buckets_chunked(
+            files, indexed + included, indexed,
+            self._num_buckets(), chunk_rows, out_dir,
+            self.session.hs_conf.index_row_group_size(),
+            lineage_ids=lineage_ids,
+            lineage_col=IndexConstants.DATA_FILE_NAME_ID)
+        return True
+
     def _use_mesh_build(self, table: Table) -> bool:
         import jax
         if not self.session.hs_conf.distributed_enabled():
@@ -270,8 +310,10 @@ class CreateAction(CreateActionBase):
         indexed, included = self._resolve_columns()
         relation = self.df.plan.relation
         tracker = FileIdTracker()
-        table = self._load_projected(relation, indexed, included, tracker)
-        self._write_index_files(table, indexed, version=0)
+        if not self._build_chunked(relation, indexed, included, tracker,
+                                   version=0):
+            table = self._load_projected(relation, indexed, included, tracker)
+            self._write_index_files(table, indexed, version=0)
         # Assemble the final entry now that index files exist.
         index_content = Content.from_directory(
             self.data_manager.get_path(0), tracker)

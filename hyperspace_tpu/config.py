@@ -172,5 +172,11 @@ class HyperspaceConf:
                 IndexConstants.TPU_BUILD_ROWS_PER_SHARD,
                 IndexConstants.TPU_BUILD_ROWS_PER_SHARD_DEFAULT))
 
+    def max_chunk_rows(self) -> int:
+        return int(
+            self._conf.get(
+                IndexConstants.TPU_MAX_CHUNK_ROWS,
+                IndexConstants.TPU_MAX_CHUNK_ROWS_DEFAULT))
+
     def _get_bool(self, key: str, default: str) -> bool:
         return (self._conf.get(key, default) or "").strip().lower() == "true"

@@ -342,6 +342,86 @@ def read_parquet(files: Sequence[str], columns: Optional[Sequence[str]] = None,
     return Table.from_arrow(at)
 
 
+def parquet_row_counts(files: Sequence[str]) -> List[int]:
+    """Row count per file from parquet footers (no data read)."""
+    return [pq.ParquetFile(f).metadata.num_rows for f in files]
+
+
+def iter_parquet_chunks(files: Sequence[str], columns: Optional[Sequence[str]],
+                        chunk_rows: int):
+    """Stream files as device Tables of ≤ ``chunk_rows`` rows each, yielding
+    ``(table, [(file_index, rows_from_that_file), ...])`` so callers can
+    attribute rows to source files (lineage). Row groups are the streaming
+    unit — only one chunk's arrow data is resident at a time, which is what
+    bounds the HBM footprint for data larger than device memory (SURVEY §7
+    hard-part #1)."""
+    batch: List[pa.Table] = []
+    batch_rows = 0
+    provenance: List[Tuple[int, int]] = []
+
+    def flush():
+        nonlocal batch, batch_rows, provenance
+        if not batch:
+            return None
+        at = pa.concat_tables(batch) if len(batch) > 1 else batch[0]
+        out = (Table.from_arrow(at), provenance)
+        batch, batch_rows, provenance = [], 0, []
+        return out
+
+    read_cols = list(columns) if columns else None
+    for fi, path in enumerate(files):
+        pf = pq.ParquetFile(path)
+        for rg in range(pf.num_row_groups):
+            t = pf.read_row_group(rg, columns=read_cols)
+            start = 0
+            while start < t.num_rows:
+                take = min(t.num_rows - start, chunk_rows - batch_rows)
+                batch.append(t.slice(start, take))
+                if provenance and provenance[-1][0] == fi:
+                    provenance[-1] = (fi, provenance[-1][1] + take)
+                else:
+                    provenance.append((fi, take))
+                batch_rows += take
+                start += take
+                if batch_rows >= chunk_rows:
+                    yield flush()
+    tail = flush()
+    if tail is not None:
+        yield tail
+
+
+def iter_dataset_chunks(files: Sequence[str],
+                        columns: Optional[Sequence[str]], chunk_rows: int,
+                        filters=None):
+    """Stream files as device Tables of ≤ ``chunk_rows`` rows with parquet
+    predicate pushdown: row groups whose statistics exclude the filter are
+    never decoded (the scan-side counterpart of iter_parquet_chunks, which
+    the build uses for its lineage provenance)."""
+    import pyarrow.dataset as pa_ds
+
+    expr = pq.filters_to_expression(filters) if filters is not None else None
+    ds = pa_ds.dataset(list(files), format="parquet")
+    batch: List[pa.Table] = []
+    batch_rows = 0
+    for rb in ds.scanner(columns=list(columns) if columns else None,
+                         filter=expr,
+                         batch_size=max(chunk_rows, 1)).to_batches():
+        if rb.num_rows == 0:
+            continue
+        t = pa.Table.from_batches([rb])
+        start = 0
+        while start < t.num_rows:
+            take = min(t.num_rows - start, chunk_rows - batch_rows)
+            batch.append(t.slice(start, take))
+            batch_rows += take
+            start += take
+            if batch_rows >= chunk_rows:
+                yield Table.from_arrow(pa.concat_tables(batch))
+                batch, batch_rows = [], 0
+    if batch:
+        yield Table.from_arrow(pa.concat_tables(batch))
+
+
 def write_parquet(table: Table, path: str, row_group_size: Optional[int] = None) -> None:
     pq.write_table(table.to_arrow(), path, row_group_size=row_group_size)
 

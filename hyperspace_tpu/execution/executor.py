@@ -39,6 +39,14 @@ _SESSION: contextvars.ContextVar = contextvars.ContextVar(
 def execute(plan: LogicalPlan, session=None) -> Table:
     token = _SESSION.set(session)
     try:
+        # Row-returning distributed path: a {Filter, Project, Join}* chain
+        # root (optionally under Sort/Limit) runs SPMD over the mesh, rows
+        # gathered per device (execution/spmd.py). Aggregate roots dispatch
+        # inside _execute; anything else falls through to single-device.
+        from . import spmd
+        result = spmd.try_execute_plan(plan, session, _execute)
+        if result is not None:
+            return result
         return _execute(plan, needed=None)
     finally:
         _SESSION.reset(token)
@@ -60,6 +68,10 @@ def _execute(plan: LogicalPlan, needed: Optional[Set[str]]) -> Table:
                 plan.condition, plan.child.schema,
                 allow_nested=isinstance(plan.child, IndexScan))
             if isinstance(plan.child, Scan):
+                chunked = _chunked_filtered_scan(plan.child, child_needed,
+                                                 plan.condition, pa_filter)
+                if chunked is not None:
+                    return chunked
                 table = _execute_scan(plan.child, child_needed, pa_filter)
             else:
                 buckets = _equality_bucket_subset(plan.child, plan.condition)
@@ -115,6 +127,64 @@ def _execute(plan: LogicalPlan, needed: Optional[Set[str]]) -> Table:
         aligned = [t.select(tables[0].names) for t in tables]
         return Table.concat(aligned)
     raise HyperspaceException(f"Cannot execute plan node {plan.node_name}")
+
+
+# Chunked-scan observability (mirrors ops.index_build.CHUNK_STATS): tests
+# pin the scan-side device footprint with max_device_rows.
+CHUNK_SCAN_STATS = {"max_device_rows": 0, "chunks": 0}
+
+
+def _chunked_filtered_scan(plan: Scan, needed: Optional[Set[str]],
+                           condition, pa_filter=None) -> Optional[Table]:
+    """Filter-over-scan for data larger than HBM: stream parquet chunks
+    with row-group predicate pushdown, evaluate the full mask per chunk on
+    device, and keep only survivors — the full dataset is never resident
+    at once. Returns None when the source fits the chunk budget (the
+    in-memory path is cheaper) or isn't chunkable (non-parquet, nested
+    projection)."""
+    import pyarrow.parquet as pq
+
+    from ..index.constants import IndexConstants
+    from .columnar import iter_dataset_chunks, parquet_row_counts
+
+    session = _SESSION.get()
+    chunk_rows = session.hs_conf.max_chunk_rows() if session is not None \
+        else int(IndexConstants.TPU_MAX_CHUNK_ROWS_DEFAULT)
+    relation = plan.relation
+    fmt = getattr(relation, "data_file_format", relation.file_format)
+    if fmt != "parquet":
+        return None
+    files = relation.all_files()
+    if not files:
+        return None
+    cols = None
+    if needed is not None:
+        cols = [n for n in relation.schema.names if n in needed]
+        if not cols:
+            cols = [relation.schema.names[0]]
+    try:
+        # Nested struct leaves carry dotted names that are NOT physical
+        # top-level parquet columns — those go to the in-memory reader,
+        # whose root-read+flatten path understands them.
+        physical = set(pq.read_schema(files[0]).names)
+        if cols is not None and any(c not in physical for c in cols):
+            return None
+        if sum(parquet_row_counts(files)) <= chunk_rows:
+            return None
+    except Exception:
+        return None
+    parts: List[Table] = []
+    for chunk in iter_dataset_chunks(files, cols, chunk_rows, pa_filter):
+        CHUNK_SCAN_STATS["max_device_rows"] = max(
+            CHUNK_SCAN_STATS["max_device_rows"], chunk.num_rows)
+        CHUNK_SCAN_STATS["chunks"] += 1
+        mask = eval_predicate_mask(chunk, condition)
+        parts.append(chunk.filter(mask))
+    if not parts:
+        from .columnar import empty_table
+        return empty_table(relation.schema.select(cols)
+                           if cols is not None else relation.schema)
+    return Table.concat(parts)
 
 
 def _execute_scan(plan: Scan, needed: Optional[Set[str]],
@@ -243,7 +313,7 @@ def _execute_index_scan(plan: IndexScan, needed: Optional[Set[str]],
                 cache.put(key, table)
         else:
             table = read_parquet(index_files, cols, filters=pa_filter)
-    if entry.derivedDataset.kind == "CoveringIndex" and not plan.appended_files \
+    if entry.derivedDataset.kind == "CoveringIndex" \
             and buckets_have_single_file \
             and all(c in table.names for c in entry.indexed_columns):
         # Physical layout invariant: files are read in bucket order and rows
@@ -267,7 +337,11 @@ def _execute_index_scan(plan: IndexScan, needed: Optional[Set[str]],
             fill = Column(INT64, jnp.full(appended.num_rows,
                                           IndexConstants.UNKNOWN_FILE_ID, jnp.int64))
             appended = appended.with_column(IndexConstants.DATA_FILE_NAME_ID, fill)
-        table = Table.concat([table, appended.select(table.names)])
+        merged = _merge_appended_preserving_order(entry, table, appended)
+        if merged is not None:
+            table = merged
+        else:
+            table = Table.concat([table, appended.select(table.names)])
     drop_lineage = (needed is not None
                     and IndexConstants.DATA_FILE_NAME_ID in table.names
                     and IndexConstants.DATA_FILE_NAME_ID not in needed)
@@ -275,6 +349,81 @@ def _execute_index_scan(plan: IndexScan, needed: Optional[Set[str]],
         table = table.select([n for n in table.names
                               if n != IndexConstants.DATA_FILE_NAME_ID])
     return table
+
+
+# Observability counters for the shuffle-free fast paths (tests assert the
+# path is actually taken, mirroring the reference's plan-shape assertions in
+# HybridScanSuite).
+HYBRID_MERGE_COUNT = 0   # appended rows merged without dropping bucket order
+FAST_JOIN_COUNT = 0      # joins that skipped the sort via bucket order
+
+
+def _merge_appended_preserving_order(entry, table: Table,
+                                     appended: Table) -> Optional[Table]:
+    """Hybrid Scan without losing the merge join: re-bucket the appended
+    rows on device, sort only them by (bucket, key), and two-way-merge them
+    into the already-(bucket, key)-sorted index stream — so ``bucket_order``
+    survives appends and the downstream join still skips its sort.
+
+    The TPU analogue of the reference's query-time re-bucketing of appended
+    data (RuleUtils.scala:509-567: RepartitionByExpression + BucketUnion
+    keeps the zero-exchange SMJ); here the "shuffle" is one small sort of
+    the appended rows and the union is a position-scatter merge.
+
+    Returns None (caller falls back to order-dropping concat) unless the
+    index stream carries bucket order on a single int-family key that fits
+    int32 — the same constraints the fast-join consumer has.
+    """
+    global HYBRID_MERGE_COUNT
+    from ..ops.index_build import bucket_ids_for
+
+    if table.bucket_order is None or len(entry.indexed_columns) != 1:
+        return None
+    key = entry.indexed_columns[0]
+    if key not in table.names or key not in appended.names:
+        return None
+    icol = table.column(key)
+    if icol.dtype not in (INT32, INT64, DATE):
+        return None
+    if table.num_rows == 0 or appended.num_rows == 0:
+        return None
+    appended = appended.select(table.names)
+    num_buckets = table.bucket_order[0]
+
+    # int32-fit check for the (bucket << 32 | biased key) packing — one
+    # fused reduction + host sync, mirroring _bucketed_merge_keys.
+    acol = appended.column(key)
+    to_check = [a for a in (icol.data, acol.data) if a.dtype == jnp.int64]
+    if to_check:
+        extreme = int(jnp.maximum(*[jnp.max(jnp.abs(a)) for a in to_check])
+                      if len(to_check) == 2 else jnp.max(jnp.abs(to_check[0])))
+        if extreme >= 2 ** 31 or extreme < 0:
+            return None
+
+    def composite(t: Table) -> jnp.ndarray:
+        bids = bucket_ids_for(t, [key], num_buckets)
+        return kernels.pack2_int32(bids, t.column(key).data.astype(jnp.int32))
+
+    # Sort ONLY the appended rows; the index stream is already sorted.
+    comp_a = composite(appended)
+    perm_a = kernels.lex_sort_indices([comp_a])
+    appended = appended.take(perm_a)
+    comp_a = jnp.take(comp_a, perm_a)
+    comp_i = composite(table)
+
+    # Two-way merge positions (ties: index rows first).
+    n_i, n_a = table.num_rows, appended.num_rows
+    pos_i = jnp.arange(n_i, dtype=jnp.int32) + \
+        jnp.searchsorted(comp_a, comp_i, side="left").astype(jnp.int32)
+    pos_a = jnp.arange(n_a, dtype=jnp.int32) + \
+        jnp.searchsorted(comp_i, comp_a, side="right").astype(jnp.int32)
+    union = Table.concat([table, appended])  # unifies string dictionaries
+    gather = jnp.zeros(n_i + n_a, jnp.int32) \
+        .at[jnp.concatenate([pos_i, pos_a])] \
+        .set(jnp.arange(n_i + n_a, dtype=jnp.int32))
+    merged = union.take(gather)
+    HYBRID_MERGE_COUNT += 1
+    return Table(merged.columns, bucket_order=(num_buckets, (key,)))
 
 
 # ---------------------------------------------------------------------------
@@ -380,6 +529,8 @@ def _execute_join(plan: Join, needed: Optional[Set[str]]) -> Table:
     # for a zero-exchange sort-merge join, JoinIndexRule.scala:64-78).
     fast = _bucketed_merge_keys(left, right, norm, lkeys, rkeys)
     if fast is not None:
+        global FAST_JOIN_COUNT
+        FAST_JOIN_COUNT += 1
         lcomp, rcomp, swapped = fast
         if swapped:
             left, right = right, left

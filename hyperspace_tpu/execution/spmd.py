@@ -6,25 +6,33 @@ because Spark is its engine; here eligible aggregation plans run SPMD over a
 1-D mesh with XLA collectives (psum/pmin/pmax over ICI), and everything else
 falls back to the single-device executor.
 
-Supported plan shape (checked structurally; any mismatch → fallback):
+Supported plan shapes (checked structurally; any mismatch → fallback):
 
-    Aggregate[global or grouped]
-      └─ chain of {Filter, Project, Join(broadcast m:1)}*
-           └─ Scan | IndexScan                      ← the sharded stream
+    Aggregate[global or grouped]                     (try_execute_aggregate)
+      └─ chain of {Filter, Project, Join}*
+           └─ Scan | IndexScan                       ← the sharded stream
 
-Execution model — mask-based streaming, never row compaction:
+    [Limit] [Sort] chain of {Filter, Project, Join}* (try_execute_plan —
+      └─ Scan | IndexScan                     row-returning stream queries)
+
+Execution model — mask-based streaming with static shapes throughout:
 
 - The leaf table is loaded once and row-sharded over the mesh
   (``pad_and_shard``); a boolean *keep mask* rides along instead of
   physically filtering, so every shape stays static under ``shard_map``.
-- Filters AND into the mask; Projects re-evaluate columns (the expression
-  evaluator is shape-preserving and traces cleanly per device).
-- Joins execute broadcast-style — the analogue of the reference's broadcast
-  join (SURVEY §2 distributed primitive 4): the non-stream side is
-  materialized by the normal executor, required to be unique on the join
-  key (m:1, the star-schema/foreign-key case), key-sorted, replicated to
-  every device, and probed with a per-device searchsorted; unmatched rows
-  just clear the mask. Many-to-many joins fall back.
+- Filters AND into the mask; Projects re-evaluate live columns (the
+  expression evaluator is shape-preserving and traces cleanly per device).
+- Joins pick one of two strategies per stage:
+  * broadcast (m:1): the non-stream side is materialized, required unique
+    on the key, key-sorted, replicated, and probed with a per-device
+    searchsorted. Multi-key joins probe a bit-packed composite built from
+    the broadcast side's per-column value ranges (out-of-range stream
+    values hit a sentinel that never matches).
+  * exchange (m:n): both sides are hash-routed over ICI with ONE
+    lax.all_to_all each (value-stable key hash → owner device, the
+    reference's shuffle join), then merge-joined locally into
+    capacity-bounded output slots; capacity overflow escalates ×4 and
+    recompiles, a few rungs, then falls back.
 - Global aggregates psum/pmin/pmax partial contributions (one collective
   per partial).
 - Grouped aggregates compute capacity-bounded per-device partials (local
@@ -32,6 +40,9 @@ Execution model — mask-based streaming, never row compaction:
   two-phase partial-aggregation pattern Spark applies to group-by, with
   the host merge standing in for the final shuffle (valid whenever group
   cardinality ≪ row count; capacity overflow falls back).
+- Row-returning (non-aggregate) chains return each device's columns +
+  mask; the host gathers valid rows and concatenates (Sort/Limit wrappers
+  then run on the reduced result).
 
 Null semantics match the single-device executor: filters keep
 true-and-valid rows, inner-join null keys never match, aggregates skip
@@ -121,21 +132,24 @@ def _normalized_join_pairs(join: Join) -> List[Tuple[str, str]]:
     return norm
 
 
-def _needed_per_stage(agg: Aggregate, stages):
-    """Top-down walk computing the leaf's needed column set and, per join
-    stage index, the broadcast side's needed set."""
-    needed: Set[str] = set(agg.group_cols)
-    for a in agg.aggs:
-        needed |= set(a.references)
+def _needed_per_stage(needed: Set[str], stages):
+    """Top-down walk computing the leaf's needed column set, per join stage
+    the non-stream side's needed set, and per project stage the *live*
+    output names (the traced program evaluates only those — a dead project
+    expr may reference columns that were pruned below it)."""
+    needed = set(needed)
     right_needed: Dict[int, Set[str]] = {}
+    project_live: Dict[int, frozenset] = {}
     for i in range(len(stages) - 1, -1, -1):
         kind, node = stages[i]
         if kind == "filter":
             needed = needed | set(node.condition.references)
         elif kind == "project":
+            live = {e.name for e in node.exprs if e.name in needed}
+            project_live[i] = frozenset(live)
             below: Set[str] = set()
             for e in node.exprs:
-                if e.name in needed:
+                if e.name in live:
                     below |= set(e.references)
             needed = below
         else:  # join
@@ -145,44 +159,140 @@ def _needed_per_stage(agg: Aggregate, stages):
                 {r for _, r in pairs}
             needed = {n for n in needed if n not in rnames} | \
                 {l for l, _ in pairs}
-    return needed, right_needed
+    return needed, right_needed, project_live
 
 
 # ---------------------------------------------------------------------------
-# Broadcast join side (prepared outside shard_map, replicated).
+# Join sides. Two strategies, chosen per join stage:
+#   broadcast — small m:1 side replicated to every device, probed with a
+#     searchsorted (the reference's broadcast join, primitive 4);
+#   exchange — both sides hash-routed over ICI with one all_to_all so equal
+#     keys meet on one device, then merge-joined locally (the reference's
+#     shuffle join, primitives 1+5). Handles m:n and big-big joins.
 # ---------------------------------------------------------------------------
 
 class _BroadcastSide:
     """A materialized, key-sorted, key-unique join side: ``keys`` ascending
     in the stream key's code space (null keys dropped — inner join),
-    ``table`` row-aligned with ``keys``."""
+    ``table`` row-aligned with ``keys``. ``pack`` is the multi-key
+    composite spec: a tuple of (rmin, shift, sentinel) per key column —
+    None for single-key joins."""
 
-    def __init__(self, keys: jax.Array, table: Table):
+    def __init__(self, keys: jax.Array, table: Table, pack=None):
         self.keys = keys
         self.table = table
+        self.pack = pack
 
 
-def _prepare_broadcast(right: Table, rkey: str, lcol: Column
-                       ) -> _BroadcastSide:
+class _ExchangeSide:
+    """An m:n join side sharded over the mesh for the bucket exchange.
+    ``arrays``/``valid`` are row-sharded (pad_and_shard); ``key_dtype`` is
+    the stream-code-space dtype used for value-stable routing hashes.
+    ``stream_meta`` snapshots the STREAM side's per-column metadata at this
+    stage (projects below the join may have created or redefined columns
+    that the leaf col_meta doesn't know)."""
+
+    def __init__(self, arrays: Dict[str, jax.Array], valid: jax.Array,
+                 table_meta: Dict[str, Tuple[str, Optional[np.ndarray], bool]],
+                 key_dtype: str,
+                 stream_meta: Dict[str, Tuple[str, Optional[np.ndarray], bool]]):
+        self.arrays = arrays
+        self.valid = valid
+        self.table_meta = table_meta
+        self.key_dtype = key_dtype
+        self.stream_meta = stream_meta
+
+
+def _right_key_codes(right: Table, rkey: str, lcol: Column) -> jax.Array:
+    """The right key column in the STREAM side's code space (strings are
+    translated into the stream dictionary so codes compare equal iff the
+    strings do)."""
     rc = right.column(rkey)
     if rc.dtype != lcol.dtype:
         raise _Unsupported("join key dtype mismatch")
     if rc.dtype == STRING and not dictionaries_equal(lcol.dictionary,
                                                      rc.dictionary):
-        keys = translate_codes(lcol.dictionary, rc)
+        return translate_codes(lcol.dictionary, rc)
+    return rc.data
+
+
+def _drop_null_keys(right: Table, rkeys: List[str]):
+    keep = None
+    for rk in rkeys:
+        v = right.column(rk).validity
+        if v is not None:
+            keep = v if keep is None else (keep & v)
+    if keep is not None:  # inner join: null keys never match.
+        return right.filter(keep), keep
+    return right, None
+
+
+def _prepare_broadcast(right: Table, pairs, tiny: Dict[str, Column]
+                       ) -> _BroadcastSide:
+    right, _ = _drop_null_keys(right, [r for _, r in pairs])
+    codes = [_right_key_codes(right, rname, tiny[lname])
+             for lname, rname in pairs]
+    if len(pairs) == 1:
+        keys, pack = codes[0], None
     else:
-        keys = rc.data
-    if rc.validity is not None:  # inner join: null keys never match.
-        keep = rc.validity
-        right = right.filter(keep)
-        keys = keys[keep]
+        # Multi-key composite: each key column is offset into [0, range)
+        # from the broadcast side's own min/max and bit-packed into one
+        # int64. A +1 sentinel per field encodes "stream value outside the
+        # broadcast side's range" — it can never equal a packed right key,
+        # so composite equality ⇔ per-column equality, exactly.
+        pack = []
+        shift = 0
+        packed = None
+        for c in codes:
+            c64 = c.astype(jnp.int64)
+            if c64.shape[0] == 0:
+                rmin, rmax = 0, 0
+            else:
+                rmin = int(jnp.min(c64))
+                rmax = int(jnp.max(c64))
+            span = rmax - rmin + 2  # +1 for the out-of-range sentinel
+            bits = max(int(span - 1).bit_length(), 1)
+            pack.append((rmin, shift, span - 1))
+            packed = (c64 - rmin) << shift if packed is None else \
+                packed | ((c64 - rmin) << shift)
+            shift += bits
+            if shift > 62:
+                raise _Unsupported("multi-key composite exceeds 62 bits")
+        keys = packed
+        pack = tuple(pack)
     order = kernels.lex_sort_indices([keys])
     keys = jnp.take(keys, order)
     right = right.take(order)
     # m:1 requirement — broadcast side unique on the key (one host sync).
     if keys.shape[0] > 1 and bool(jnp.any(keys[1:] == keys[:-1])):
         raise _Unsupported("broadcast join side has duplicate keys")
-    return _BroadcastSide(keys, right)
+    return _BroadcastSide(keys, right, pack)
+
+
+def _prepare_exchange(right: Table, pairs, tiny: Dict[str, Column],
+                      mesh: Mesh) -> _ExchangeSide:
+    """Shard an m:n join side over the mesh for the all-to-all route."""
+    if len(pairs) != 1:
+        raise _Unsupported("multi-key exchange join")
+    lname, rname = pairs[0]
+    lcol = tiny[lname]
+    right, _ = _drop_null_keys(right, [rname])
+    codes = _right_key_codes(right, rname, lcol)
+    if right.num_rows == 0:
+        raise _Unsupported("empty exchange side")
+    arrays: Dict[str, jax.Array] = {"k": codes}
+    meta: Dict[str, Tuple[str, Optional[np.ndarray], bool]] = {}
+    for n in right.names:
+        rc = right.column(n)
+        if n != rname:
+            arrays[f"d:{n}"] = rc.data
+            if rc.validity is not None:
+                arrays[f"v:{n}"] = rc.validity
+        meta[n] = (rc.dtype, rc.dictionary, rc.validity is not None)
+    arrays, valid = pad_and_shard(mesh, arrays, right.num_rows)
+    stream_meta = {n: (c.dtype, c.dictionary, c.validity is not None)
+                   for n, c in tiny.items()}
+    return _ExchangeSide(arrays, valid, meta, lcol.dtype, stream_meta)
 
 
 # ---------------------------------------------------------------------------
@@ -297,23 +407,99 @@ class _AggSpec:
 # Entry point.
 # ---------------------------------------------------------------------------
 
+def _spmd_eligible(session) -> bool:
+    if session is None:
+        return False
+    if not session.hs_conf.distributed_enabled():
+        return False
+    return len(jax.devices()) >= 2
+
+
+def _leaf_within_budget(root, session) -> bool:
+    """False when the stream leaf exceeds the device-footprint budget —
+    the SPMD path materializes the leaf before sharding, so oversized
+    sources must go to the chunked single-device path instead (the two
+    compose once the chunked reader learns to feed shards directly)."""
+    from .columnar import parquet_row_counts
+
+    try:
+        leaf, _ = _linearize(root)
+    except _Unsupported:
+        return True  # let the caller fail with the structural reason
+    if not isinstance(leaf, Scan):
+        return True
+    relation = leaf.relation
+    fmt = getattr(relation, "data_file_format", relation.file_format)
+    if fmt != "parquet":
+        return True
+    try:
+        total = sum(parquet_row_counts(relation.all_files()))
+    except Exception:
+        return True
+    return total <= session.hs_conf.max_chunk_rows()
+
+
 def try_execute_aggregate(plan: Aggregate, session,
                           executor: Callable) -> Optional[Table]:
     """Execute an Aggregate subtree SPMD over the mesh, or return None to
     fall back. ``executor(plan, needed)`` is the single-device recursive
-    executor, used to materialize the scan leaf and broadcast join sides."""
-    if session is None:
+    executor, used to materialize the scan leaf and join sides."""
+    if not _spmd_eligible(session):
+        return None
+    if not _leaf_within_budget(plan.child, session):
+        from ..telemetry.logging import emit_distributed_fallback
+        emit_distributed_fallback(session, "spmd_query",
+                                  "leaf exceeds device chunk budget")
         return None
     try:
-        if not session.hs_conf.distributed_enabled():
-            return None
-        if len(jax.devices()) < 2:
-            return None
         return _run(plan, executor)
     except _Unsupported as e:
         from ..telemetry.logging import emit_distributed_fallback
         emit_distributed_fallback(session, "spmd_query", str(e))
         return None
+
+
+def try_execute_plan(plan, session, executor: Callable) -> Optional[Table]:
+    """Row-returning distributed execution for non-aggregate roots: a
+    {Filter, Project, Join}* chain over a scan (optionally under Sort /
+    Limit wrappers) runs SPMD; valid rows are gathered per device and
+    concatenated on host, then the wrappers run single-device (their input
+    is already reduced). Returns None to fall back."""
+    from ..plan.nodes import Limit, Sort
+
+    if not _spmd_eligible(session):
+        return None
+    wrappers = []
+    node = plan
+    while isinstance(node, (Sort, Limit)):
+        wrappers.append(node)
+        node = node.child
+    if isinstance(node, Aggregate) or isinstance(node, (Scan, IndexScan)):
+        return None  # aggregates dispatch inside the executor; bare scans
+        # have no distributed work to do.
+    try:
+        _linearize(node)  # raises _Unsupported on non-chain shapes
+    except _Unsupported:
+        return None
+    if not _leaf_within_budget(node, session):
+        from ..telemetry.logging import emit_distributed_fallback
+        emit_distributed_fallback(session, "spmd_query",
+                                  "leaf exceeds device chunk budget")
+        return None
+    try:
+        table = _run_stream(node, executor)
+    except _Unsupported as e:
+        from ..telemetry.logging import emit_distributed_fallback
+        emit_distributed_fallback(session, "spmd_query", str(e))
+        return None
+    # Wrappers (outermost first in `wrappers`): apply innermost-out.
+    from . import executor as ex
+    for w in reversed(wrappers):
+        if isinstance(w, Sort):
+            table = ex._execute_sort(w, table)
+        else:
+            table = table.slice(0, min(w.n, table.num_rows))
+    return table
 
 
 def _dict_fingerprint(dic: Optional[np.ndarray]):
@@ -326,10 +512,45 @@ def _dict_fingerprint(dic: Optional[np.ndarray]):
     return tuple(dic.tolist())
 
 
-def _run(plan: Aggregate, executor) -> Table:
-    global DISPATCH_COUNT
-    leaf, stages = _linearize(plan.child)
-    leaf_needed, right_needed = _needed_per_stage(plan, stages)
+class _Prepared:
+    """Everything _spmd_program needs, prepared once per execution: the
+    sharded stream, replicated broadcast arrays, sharded exchange arrays,
+    join descriptors, per-stage metadata, and the final (post-stage) column
+    metadata for probing aggregate dtypes / rebuilding host tables."""
+
+    def __init__(self, mesh, n_dev, sharded, valid, bcast, xch, stages,
+                 joins, col_meta, final_meta, shard_rows, out_rows,
+                 project_live):
+        self.mesh = mesh
+        self.n_dev = n_dev
+        self.sharded = sharded
+        self.valid = valid
+        self.bcast = bcast
+        self.xch = xch
+        self.stages = stages
+        self.joins = joins
+        self.col_meta = col_meta
+        self.final_meta = final_meta
+        self.shard_rows = shard_rows
+        self.out_rows = out_rows  # per-device rows after the last stage
+        self.project_live = project_live  # stage idx -> live output names
+
+
+def _prepare(root, executor, caps: Dict[int, Tuple[int, int]]) -> _Prepared:
+    """Walk the stage chain preparing each join side. The walk runs over
+    zero-length columns (the evaluator propagates dtype/dictionary/
+    nullability exactly as the traced per-device program will), so join
+    prep sees the stream key's *post-stage* metadata — a Project below a
+    Join that redefines the key name feeds the join side the same
+    dtype/dictionary the traced probe will use, never stale leaf meta.
+
+    ``caps`` carries per-exchange-join capacities (send cap, output slots)
+    from the retry loop; empty on the first attempt (defaults computed
+    here)."""
+    leaf, stages = _linearize(root)
+    out_needed = set(root.schema.names)
+    leaf_needed, right_needed, project_live = _needed_per_stage(
+        out_needed, stages)
 
     leaf_table = executor(leaf, set(leaf_needed) if leaf_needed else None)
     if leaf_table.num_rows == 0:
@@ -338,7 +559,6 @@ def _run(plan: Aggregate, executor) -> Table:
     mesh = make_mesh()
     n_dev = mesh.devices.size
 
-    # Shard the stream columns (+ per-column validity).
     stream_arrays: Dict[str, jax.Array] = {}
     col_meta: Dict[str, Tuple[str, Optional[np.ndarray], bool]] = {}
     for name in leaf_table.names:
@@ -348,16 +568,12 @@ def _run(plan: Aggregate, executor) -> Table:
             stream_arrays[f"v:{name}"] = c.validity
         col_meta[name] = (c.dtype, c.dictionary, c.validity is not None)
     sharded, valid = pad_and_shard(mesh, stream_arrays, leaf_table.num_rows)
+    shard_rows = next(iter(sharded.values())).shape[0] // n_dev
+    out_rows = shard_rows
 
-    # Prepare broadcast join sides while walking the stage chain in order
-    # over zero-length columns (the evaluator propagates dtype/dictionary/
-    # nullability exactly as the traced per-device program will). The join
-    # prep therefore sees the stream key's *post-stage* metadata — a
-    # Project below the Join that redefines the key name (cast, computed
-    # expression, dictionary change) feeds the broadcast side the same
-    # dtype/dictionary the traced probe will use, never stale leaf meta.
-    joins: Dict[int, Tuple[Tuple[str, str], _BroadcastSide]] = {}
+    joins: Dict[int, Tuple] = {}
     bcast_arrays: Dict[str, jax.Array] = {}
+    xch_arrays: Dict[str, jax.Array] = {}
     tiny = {n: Column(dt, jnp.zeros(0, _DEVICE_DTYPE[dt]),
                       jnp.zeros(0, jnp.bool_) if nul else None, dic)
             for n, (dt, dic, nul) in col_meta.items()}
@@ -366,89 +582,203 @@ def _run(plan: Aggregate, executor) -> Table:
             continue
         if kind == "project":
             t = Table(tiny)
-            tiny = {e.name: eval_expr(t, e) for e in node.exprs}
+            live = project_live.get(i, frozenset())
+            tiny = {e.name: eval_expr(t, e) for e in node.exprs
+                    if e.name in live}
             continue
         pairs = _normalized_join_pairs(node)
-        if len(pairs) != 1:
-            raise _Unsupported("multi-key broadcast join")
-        lname, rname = pairs[0]
-        if lname not in tiny:
-            raise _Unsupported(f"unknown stream join key {lname}")
-        lc = tiny[lname]
+        for lname, _ in pairs:
+            if lname not in tiny:
+                raise _Unsupported(f"unknown stream join key {lname}")
         right_table = executor(node.right, right_needed[i])
-        side = _prepare_broadcast(right_table, rname, lc)
-        joins[i] = (pairs[0], side)
-        bcast_arrays[f"k:{i}"] = side.keys
-        for n in side.table.names:
-            rc = side.table.column(n)
-            if n != rname:
-                bcast_arrays[f"b:{i}:{n}"] = rc.data
-                if rc.validity is not None:
-                    bcast_arrays[f"bv:{i}:{n}"] = rc.validity
-                tiny[n] = Column(rc.dtype,
-                                 jnp.zeros(0, _DEVICE_DTYPE[rc.dtype]),
-                                 jnp.zeros(0, jnp.bool_)
-                                 if rc.validity is not None else None,
-                                 rc.dictionary)
-            col_meta[n] = (rc.dtype, rc.dictionary, rc.validity is not None)
-        if rname in node.schema.names and rname not in tiny:
-            # Matched rows: right key == left key by definition.
-            tiny[rname] = Column(lc.dtype, lc.data, lc.validity,
-                                 lc.dictionary)
+        try:
+            side = _prepare_broadcast(right_table, pairs, tiny)
+            joins[i] = ("b", pairs, side)
+            bcast_arrays[f"k:{i}"] = side.keys
+            for n in side.table.names:
+                rc = side.table.column(n)
+                if n not in {r for _, r in pairs}:
+                    bcast_arrays[f"b:{i}:{n}"] = rc.data
+                    if rc.validity is not None:
+                        bcast_arrays[f"bv:{i}:{n}"] = rc.validity
+        except _Unsupported:
+            # m:n (duplicate keys) → hash-route both sides over ICI and
+            # merge-join locally: the reference's shuffle join.
+            side = _prepare_exchange(right_table, pairs, tiny, mesh)
+            if i not in caps:
+                r_shard = next(iter(side.arrays.values())).shape[0] // n_dev
+                cap = min(2 * max(out_rows, r_shard) // n_dev + 1,
+                          max(out_rows, r_shard))
+                k_out = 2 * max(out_rows, r_shard)
+                caps[i] = (cap, k_out)
+            joins[i] = ("x", pairs, side)
+            for name, arr in side.arrays.items():
+                xch_arrays[f"x:{i}:{name}"] = arr
+            xch_arrays[f"x:{i}:__valid"] = side.valid
+            out_rows = caps[i][1]
+        # Post-join stream metadata: non-key right columns appear; matched
+        # rows' right key values equal the left key's.
+        rnames = {r for _, r in pairs}
+        side_meta = side.table_meta if isinstance(side, _ExchangeSide) else \
+            {n: (side.table.column(n).dtype, side.table.column(n).dictionary,
+                 side.table.column(n).validity is not None)
+             for n in side.table.names}
+        for n, (dt, dic, nul) in side_meta.items():
+            if n not in rnames:
+                tiny[n] = Column(dt, jnp.zeros(0, _DEVICE_DTYPE[dt]),
+                                 jnp.zeros(0, jnp.bool_) if nul else None,
+                                 dic)
+            col_meta[n] = (dt, dic, nul)
+        for lname, rname in pairs:
+            if rname in node.schema.names and rname not in tiny:
+                lc = tiny[lname]
+                tiny[rname] = Column(lc.dtype, lc.data, lc.validity,
+                                     lc.dictionary)
     final_meta = {n: (c.dtype, c.dictionary, c.validity is not None)
                   for n, c in tiny.items()}
+    return _Prepared(mesh, n_dev, sharded, valid, bcast_arrays, xch_arrays,
+                     stages, joins, col_meta, final_meta, shard_rows,
+                     out_rows, project_live)
+
+
+# Exchange-capacity escalation: multiply caps by 4 up to this many times
+# before falling back to single-device execution (static shapes recompile
+# per escalation, so the ladder is short).
+_MAX_CAP_RETRIES = 3
+
+
+def _out_rows(prep: _Prepared, caps: Dict[int, Tuple[int, int]]) -> int:
+    """Per-device rows after the last stage under the CURRENT caps (the
+    last exchange join's output slots, or the stream shard size)."""
+    rows = prep.shard_rows
+    for i in sorted(i for i, j in prep.joins.items() if j[0] == "x"):
+        rows = caps[i][1]
+    return rows
+
+
+def _run(plan: Aggregate, executor) -> Table:
+    global DISPATCH_COUNT
+    caps: Dict[int, Tuple[int, int]] = {}
+    # Prepared ONCE: leaf IO, join-side materialization, and sharding don't
+    # depend on caps — only the jitted program (static shapes) does, so
+    # escalation retries recompile but never redo IO.
+    prep = _prepare(plan.child, executor, caps)
 
     def probe(e: E.Expr) -> Column:
-        tiny = {n: Column(dt, jnp.zeros(0, _DEVICE_DTYPE[dt]),
-                          jnp.zeros(0, jnp.bool_) if nul else None, dic)
-                for n, (dt, dic, nul) in final_meta.items()}
-        return eval_expr(Table(tiny), e)
+        t = {n: Column(dt, jnp.zeros(0, _DEVICE_DTYPE[dt]),
+                       jnp.zeros(0, jnp.bool_) if nul else None, dic)
+             for n, (dt, dic, nul) in prep.final_meta.items()}
+        return eval_expr(Table(t), e)
 
     agg_specs = tuple(_AggSpec.build(a, probe) for a in plan.aggs)
     group_cols = tuple(plan.group_cols)
     for g in group_cols:
-        if g not in final_meta:
+        if g not in prep.final_meta:
             raise _Unsupported(f"unknown group column {g}")
-
     grouped = bool(group_cols)
-    shard_rows = next(iter(sharded.values())).shape[0] // n_dev
-    G = min(shard_rows, MAX_LOCAL_GROUPS)
+    for attempt in range(_MAX_CAP_RETRIES + 1):
+        G = min(_out_rows(prep, caps), MAX_LOCAL_GROUPS)
+        descr = _StageDescr(prep.stages, prep.joins, prep.col_meta,
+                            agg_specs, group_cols, dict(caps),
+                            prep.project_live)
+        out = _spmd_program(prep.sharded, prep.valid, prep.bcast, prep.xch,
+                            mesh=prep.mesh, descr=descr, grouped=grouped,
+                            G=G, mode="agg")
+        if _escalate_on_overflow(out, caps):
+            continue
+        if grouped:
+            if bool(np.asarray(jax.device_get(out["overflow"]))):
+                raise _Unsupported("local group capacity overflow")
+            table = _merge_grouped(out, agg_specs, list(group_cols),
+                                   prep.final_meta)
+        else:
+            table = _merge_global(out, agg_specs, prep.final_meta)
+        DISPATCH_COUNT += 1
+        return table
+    raise _Unsupported("exchange join capacity escalation exhausted")
 
-    descr = _StageDescr(stages, joins, col_meta, agg_specs, group_cols)
-    out = _spmd_program(sharded, valid, bcast_arrays, mesh=mesh,
-                        descr=descr, grouped=grouped, G=G)
 
-    if grouped:
-        if bool(np.asarray(jax.device_get(out["overflow"]))):
-            raise _Unsupported("local group capacity overflow")
-        table = _merge_grouped(out, agg_specs, list(group_cols), final_meta)
-    else:
-        table = _merge_global(out, agg_specs, final_meta)
-    DISPATCH_COUNT += 1
-    return table
+def _run_stream(root, executor) -> Table:
+    """Row-returning SPMD execution of a {Filter, Project, Join}* chain:
+    every device runs the stages on its shard, the host gathers each
+    device's valid rows and concatenates (VERDICT r3 #3a)."""
+    global DISPATCH_COUNT
+    caps: Dict[int, Tuple[int, int]] = {}
+    prep = _prepare(root, executor, caps)  # once; see _run
+    out_names = [n for n in root.schema.names if n in prep.final_meta]
+    if not out_names:
+        raise _Unsupported("no output columns")
+    out_pairs = tuple((n, prep.final_meta[n][2]) for n in out_names)
+    for attempt in range(_MAX_CAP_RETRIES + 1):
+        descr = _StageDescr(prep.stages, prep.joins, prep.col_meta,
+                            (), out_pairs, dict(caps), prep.project_live)
+        out = _spmd_program(prep.sharded, prep.valid, prep.bcast, prep.xch,
+                            mesh=prep.mesh, descr=descr, grouped=False,
+                            G=1, mode="stream")
+        if _escalate_on_overflow(out, caps):
+            continue
+        mask = np.asarray(jax.device_get(out["omask"]))
+        cols: Dict[str, Column] = {}
+        for n in out_names:
+            dt, dic, nul = prep.final_meta[n]
+            data = np.asarray(jax.device_get(out[f"o:{n}"]))[mask]
+            validity = None
+            if f"ov:{n}" in out:
+                validity = jnp.asarray(
+                    np.asarray(jax.device_get(out[f"ov:{n}"]))[mask])
+            cols[n] = Column(dt, jnp.asarray(data), validity, dic)
+        DISPATCH_COUNT += 1
+        return Table(cols)
+    raise _Unsupported("exchange join capacity escalation exhausted")
+
+
+def _escalate_on_overflow(out, caps: Dict[int, Tuple[int, int]]) -> bool:
+    """True if any exchange join overflowed its capacity; caps are bumped
+    in place for the retry."""
+    bumped = False
+    for key in out:
+        if not key.startswith("xof:"):
+            continue
+        i = int(key.split(":")[1])
+        if bool(np.asarray(jax.device_get(out[key]))):
+            cap, k_out = caps[i]
+            caps[i] = (cap * 4, k_out * 4)
+            bumped = True
+    return bumped
 
 
 class _StageDescr:
     """Static (hashable) description of the SPMD program. The hash is a
     *structural* signature so repeated executions of the same query shape
     hit the jit cache instead of recompiling; string dictionaries are part
-    of the key because they become trace-time constants."""
+    of the key because they become trace-time constants.
 
-    def __init__(self, stages, joins, col_meta, agg_specs, group_cols):
+    ``group_cols`` doubles as the output-column list in stream mode (the
+    program has no grouping there). ``caps`` maps exchange-join stage index
+    → (send capacity per destination, output slots per device)."""
+
+    def __init__(self, stages, joins, col_meta, agg_specs, group_cols,
+                 caps, project_live):
         self.stages = stages
         self.joins = joins
         self.col_meta = col_meta
         self.agg_specs = agg_specs
         self.group_cols = group_cols
-        parts: List = [group_cols]
-        for kind, node in stages:
+        self.caps = caps
+        self.project_live = project_live
+        parts: List = [group_cols, tuple(sorted(caps.items())),
+                       tuple(sorted((i, tuple(sorted(v)))
+                             for i, v in project_live.items()))]
+        for i, (kind, node) in enumerate(stages):
             if kind == "filter":
                 parts.append(("F", repr(node.condition)))
             elif kind == "project":
                 parts.append(("P", tuple(repr(e) for e in node.exprs)))
             else:
-                parts.append(("J", repr(node.condition),
-                              tuple(node.schema.names)))
+                jkind, pairs, side = joins[i]
+                pack = side.pack if isinstance(side, _BroadcastSide) else None
+                parts.append(("J", jkind, repr(node.condition),
+                              tuple(node.schema.names), pack))
         for n, (dt, dic, nul) in sorted(col_meta.items()):
             parts.append((n, dt, _dict_fingerprint(dic), nul))
         for s in agg_specs:
@@ -463,13 +793,76 @@ class _StageDescr:
         return isinstance(other, _StageDescr) and self._sig == other._sig
 
 
-@partial(jax.jit, static_argnames=("mesh", "descr", "grouped", "G"))
-def _spmd_program(sharded, valid, bcast, *, mesh: Mesh, descr: _StageDescr,
-                  grouped: bool, G: int):
+def _stream_probe_key(table: Table, pairs, pack) -> Tuple[jax.Array, jax.Array]:
+    """(probe key array, all-keys-valid mask) for a join stage. Single-key
+    joins probe the raw column; multi-key joins build the bit-packed
+    composite using the broadcast side's (rmin, shift, sentinel) spec —
+    out-of-range stream values map to the sentinel, which never matches."""
+    if pack is None:
+        lc = table.column(pairs[0][0])
+        valid = lc.validity if lc.validity is not None \
+            else jnp.ones(lc.data.shape[0], jnp.bool_)
+        return lc.data, valid
+    comp = None
+    valid = None
+    for (lname, _), (rmin, shift, sentinel) in zip(pairs, pack):
+        lc = table.column(lname)
+        c = lc.data.astype(jnp.int64)
+        code = jnp.where((c >= rmin) & (c <= rmin + sentinel - 1),
+                         c - rmin, sentinel)
+        comp = (code << shift) if comp is None else comp | (code << shift)
+        v = lc.validity
+        if v is not None:
+            valid = v if valid is None else (valid & v)
+    if valid is None:
+        valid = jnp.ones(comp.shape[0], jnp.bool_)
+    return comp, valid
+
+
+def _a2a_exchange(arrays: Dict[str, jax.Array], send_ok: jax.Array,
+                  dst: jax.Array, n_dev: int, cap: int):
+    """Route rows to their destination device with ONE lax.all_to_all.
+    ``dst`` in [0, n_dev); rows with ``send_ok`` False are dropped. Returns
+    (received arrays, received-valid mask, overflow flag) — overflow is
+    raised (pmax) when any (device, destination) block exceeds ``cap``."""
+    rows = send_ok.shape[0]
+    dst = jnp.where(send_ok, dst, n_dev)  # drop → virtual device n_dev
+    perm = kernels.lex_sort_indices([dst])
+    sorted_dst = jnp.take(dst, perm)
+    starts = jnp.searchsorted(sorted_dst,
+                              jnp.arange(n_dev + 1, dtype=sorted_dst.dtype))
+    counts = starts[1:] - starts[:-1]
+    overflow = jax.lax.pmax(jnp.any(counts > cap).astype(jnp.int32),
+                            DATA_AXIS)
+    pos = jnp.arange(rows, dtype=jnp.int32) - jnp.take(
+        starts, jnp.minimum(sorted_dst, n_dev)).astype(jnp.int32)
+    slot_ok = (pos < cap) & (sorted_dst < n_dev)
+    send_idx = jnp.where(slot_ok, sorted_dst * cap + pos, n_dev * cap)
+
+    def scatter(arr):
+        taken = jnp.take(arr, perm, axis=0)
+        buf = jnp.zeros((n_dev * cap + 1,) + arr.shape[1:], arr.dtype)
+        return buf.at[send_idx].set(taken, mode="drop")[:-1]
+
+    def a2a(x):
+        return jax.lax.all_to_all(
+            x.reshape((n_dev, cap) + x.shape[1:]), DATA_AXIS,
+            split_axis=0, concat_axis=0).reshape((n_dev * cap,) + x.shape[1:])
+
+    recv = {name: a2a(scatter(a)) for name, a in arrays.items()}
+    recv_valid = a2a(jnp.zeros(n_dev * cap + 1, jnp.bool_)
+                     .at[send_idx].set(slot_ok, mode="drop")[:-1])
+    return recv, recv_valid, overflow
+
+
+@partial(jax.jit, static_argnames=("mesh", "descr", "grouped", "G", "mode"))
+def _spmd_program(sharded, valid, bcast, xch, *, mesh: Mesh,
+                  descr: _StageDescr, grouped: bool, G: int, mode: str):
     stages, joins, col_meta = descr.stages, descr.joins, descr.col_meta
     agg_specs, group_cols = descr.agg_specs, descr.group_cols
+    n_dev = mesh.devices.size
 
-    def per_device(sharded, valid, bcast):
+    def per_device(sharded, valid, bcast, xch):
         cols = {}
         for key, arr in sharded.items():
             tag, name = key.split(":", 1)
@@ -479,17 +872,18 @@ def _spmd_program(sharded, valid, bcast, *, mesh: Mesh, descr: _StageDescr,
             cols[name] = Column(dt, arr, sharded.get(f"v:{name}"), dic)
         table = Table(cols)
         mask = valid
+        overflow_flags = {}
 
         for i, (kind, node) in enumerate(stages):
             if kind == "filter":
                 mask = mask & eval_predicate_mask(table, node.condition)
             elif kind == "project":
+                live = descr.project_live.get(i, frozenset())
                 table = Table({e.name: eval_expr(table, e)
-                               for e in node.exprs})
-            else:  # broadcast join probe
-                (lname, rname), side = joins[i]
-                lc = table.column(lname)
-                lk = lc.data
+                               for e in node.exprs if e.name in live})
+            elif joins[i][0] == "b":  # broadcast join probe
+                _, pairs, side = joins[i]
+                lk, keys_valid = _stream_probe_key(table, pairs, side.pack)
                 rkeys = bcast[f"k:{i}"]
                 n_r = rkeys.shape[0]
                 if n_r == 0:
@@ -499,12 +893,12 @@ def _spmd_program(sharded, valid, bcast, *, mesh: Mesh, descr: _StageDescr,
                     idx = jnp.searchsorted(rkeys, lk)
                     idx_c = jnp.minimum(idx, n_r - 1)
                     found = jnp.take(rkeys, idx_c) == lk
-                if lc.validity is not None:
-                    found = found & lc.validity
+                found = found & keys_valid
                 mask = mask & found
+                rnames = {r for _, r in pairs}
                 new_cols = dict(table.columns)
                 for n in side.table.names:
-                    if n == rname:
+                    if n in rnames:
                         continue
                     rc = side.table.column(n)
                     if n_r == 0:
@@ -517,11 +911,120 @@ def _spmd_program(sharded, valid, bcast, *, mesh: Mesh, descr: _StageDescr,
                         vv = (jnp.take(bcast[vkey], idx_c)
                               if vkey in bcast else None)
                     new_cols[n] = Column(rc.dtype, data, vv, rc.dictionary)
-                if rname in node.schema.names and rname not in new_cols:
-                    # Matched rows: right key == left key by definition.
-                    new_cols[rname] = Column(lc.dtype, lk, lc.validity,
-                                             lc.dictionary)
+                for lname, rname in pairs:
+                    if rname in node.schema.names and rname not in new_cols:
+                        lc = table.column(lname)
+                        # Matched rows: right key == left key by definition.
+                        new_cols[rname] = Column(lc.dtype, lc.data,
+                                                 lc.validity, lc.dictionary)
                 table = Table(new_cols)
+            else:  # exchange (m:n shuffle) join
+                _, pairs, side = joins[i]
+                lname, rname = pairs[0]
+                cap, k_out = descr.caps[i]
+                lk, keys_valid = _stream_probe_key(table, pairs, None)
+                l_ok = mask & keys_valid
+                # Routing hashes the key in the SAME code space on both
+                # sides, so equal keys land on one device. String keys are
+                # already translated into one dictionary — their codes
+                # hash as plain int32 (no dictionary needed for routing;
+                # equal codes ⇔ equal strings).
+                dtype = INT32 if side.key_dtype == STRING else side.key_dtype
+                dst_l = (kernels.hash32_values(lk, dtype)
+                         % np.uint32(n_dev)).astype(jnp.int32)
+                l_arrays = {"k": lk}
+                for n in table.names:
+                    c = table.column(n)
+                    l_arrays[f"d:{n}"] = c.data
+                    if c.validity is not None:
+                        l_arrays[f"v:{n}"] = c.validity
+                recv_l, lvalid, of_l = _a2a_exchange(
+                    l_arrays, l_ok, dst_l, n_dev, cap)
+
+                rk = xch[f"x:{i}:k"]
+                r_ok = xch[f"x:{i}:__valid"]
+                dst_r = (kernels.hash32_values(rk, dtype)
+                         % np.uint32(n_dev)).astype(jnp.int32)
+                r_arrays = {n[len(f"x:{i}:"):]: a for n, a in xch.items()
+                            if n.startswith(f"x:{i}:") and
+                            not n.endswith("__valid")}
+                recv_r, rvalid, of_r = _a2a_exchange(
+                    r_arrays, r_ok, dst_r, n_dev, cap)
+                overflow_flags[f"xof:{i}"] = jnp.maximum(of_l, of_r)
+
+                # Local merge join: right sorted (valid first, by key),
+                # invalid tail pinned to the key dtype's max so the whole
+                # array stays ascending for searchsorted; hi is clamped to
+                # the valid prefix length.
+                rkr = recv_r["k"]
+                sort_r = kernels.lex_sort_indices(
+                    [(~rvalid).astype(jnp.int32), rkr])
+                rk_sorted = jnp.take(rkr, sort_r)
+                rvalid_sorted = jnp.take(rvalid, sort_r)
+                n_valid_r = jnp.sum(rvalid.astype(jnp.int32))
+                rk_probe = jnp.where(rvalid_sorted, rk_sorted,
+                                     _max_sentinel(rk_sorted.dtype))
+                lkr = recv_l["k"]
+                lo = jnp.searchsorted(rk_probe, lkr, side="left")
+                hi = jnp.minimum(
+                    jnp.searchsorted(rk_probe, lkr, side="right"), n_valid_r)
+                counts = jnp.where(lvalid,
+                                   jnp.maximum(hi - lo, 0), 0).astype(jnp.int32)
+                total = jnp.sum(counts)
+                overflow_flags[f"xof:{i}"] = jnp.maximum(
+                    overflow_flags[f"xof:{i}"],
+                    jax.lax.pmax((total > k_out).astype(jnp.int32),
+                                 DATA_AXIS))
+                n_l = lkr.shape[0]
+                li = jnp.repeat(jnp.arange(n_l, dtype=jnp.int32), counts,
+                                total_repeat_length=k_out)
+                starts_ = jnp.cumsum(counts) - counts
+                base = jnp.repeat(starts_.astype(jnp.int32), counts,
+                                  total_repeat_length=k_out)
+                within = jnp.arange(k_out, dtype=jnp.int32) - base
+                ri = jnp.repeat(lo.astype(jnp.int32), counts,
+                                total_repeat_length=k_out) + within
+                ri = jnp.clip(ri, 0, max(rkr.shape[0] - 1, 0))
+                out_mask = jnp.arange(k_out, dtype=jnp.int32) < total
+
+                new_cols = {}
+                for n in table.names:
+                    # Stream meta snapshot from prep time: projects below
+                    # this join may have created/redefined columns the
+                    # leaf col_meta doesn't describe.
+                    dt, dic, _ = side.stream_meta[n]
+                    data = jnp.take(recv_l[f"d:{n}"], li, axis=0)
+                    vv = (jnp.take(recv_l[f"v:{n}"], li)
+                          if f"v:{n}" in recv_l else None)
+                    new_cols[n] = Column(dt, data, vv, dic)
+                rnames = {rname}
+                for n, (dt, dic, nul) in side.table_meta.items():
+                    if n in rnames:
+                        continue
+                    data = jnp.take(jnp.take(recv_r[f"d:{n}"], sort_r,
+                                             axis=0), ri, axis=0)
+                    vv = (jnp.take(jnp.take(recv_r[f"v:{n}"], sort_r), ri)
+                          if f"v:{n}" in recv_r else None)
+                    new_cols[n] = Column(dt, data, vv, dic)
+                if rname in node.schema.names and rname not in new_cols:
+                    lcm = side.stream_meta[lname]
+                    new_cols[rname] = Column(
+                        lcm[0], jnp.take(recv_l["k"], li),
+                        None, lcm[1])
+                table = Table(new_cols)
+                mask = out_mask
+
+        if mode == "stream":
+            # group_cols doubles as ((name, nullable), ...) in stream mode.
+            out = dict(overflow_flags)
+            out["omask"] = mask
+            for n, nul in group_cols:
+                c = table.column(n)
+                out[f"o:{n}"] = c.data
+                if nul:
+                    out[f"ov:{n}"] = c.validity if c.validity is not None \
+                        else jnp.ones(c.data.shape[0], jnp.bool_)
+            return out
 
         if not grouped:
             fold = {
@@ -529,7 +1032,7 @@ def _spmd_program(sharded, valid, bcast, *, mesh: Mesh, descr: _StageDescr,
                 "min": lambda v: jax.lax.pmin(jnp.min(v), DATA_AXIS),
                 "max": lambda v: jax.lax.pmax(jnp.max(v), DATA_AXIS),
             }
-            out = {}
+            out = dict(overflow_flags)
             for spec in agg_specs:
                 for k, v in spec.partials(table, mask, fold).items():
                     out[f"{spec.name}:{k}"] = v
@@ -576,6 +1079,7 @@ def _spmd_program(sharded, valid, bcast, *, mesh: Mesh, descr: _StageDescr,
             "max": lambda v: kernels.segment_max(v, gids, G),
         }
         out = {"overflow": overflow}
+        out.update(overflow_flags)
         for spec in agg_specs:
             for k, v in spec.partials(s_table, s_mask, fold).items():
                 out[f"{spec.name}:{k}"] = v
@@ -588,8 +1092,15 @@ def _spmd_program(sharded, valid, bcast, *, mesh: Mesh, descr: _StageDescr,
                          < jnp.minimum(local_groups, G))
         return out
 
-    if grouped:
-        out_specs: Dict[str, P] = {"overflow": P()}
+    xof_keys = [f"xof:{i}" for i, j in descr.joins.items() if j[0] == "x"]
+    if mode == "stream":
+        out_specs: Dict[str, P] = {"omask": P(DATA_AXIS)}
+        for n, nul in group_cols:
+            out_specs[f"o:{n}"] = P(DATA_AXIS)
+            if nul:
+                out_specs[f"ov:{n}"] = P(DATA_AXIS)
+    elif grouped:
+        out_specs = {"overflow": P()}
         for spec in agg_specs:
             for k in spec.partial_keys():
                 out_specs[f"{spec.name}:{k}"] = P(DATA_AXIS)
@@ -600,11 +1111,13 @@ def _spmd_program(sharded, valid, bcast, *, mesh: Mesh, descr: _StageDescr,
     else:
         out_specs = {f"{spec.name}:{k}": P()
                      for spec in agg_specs for k in spec.partial_keys()}
+    for k in xof_keys:
+        out_specs[k] = P()
 
     return jax.shard_map(
         per_device, mesh=mesh,
-        in_specs=(P(DATA_AXIS), P(DATA_AXIS), P()),
-        out_specs=out_specs, check_vma=False)(sharded, valid, bcast)
+        in_specs=(P(DATA_AXIS), P(DATA_AXIS), P(), P(DATA_AXIS)),
+        out_specs=out_specs, check_vma=False)(sharded, valid, bcast, xch)
 
 
 # ---------------------------------------------------------------------------

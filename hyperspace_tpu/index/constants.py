@@ -123,6 +123,12 @@ class IndexConstants:
     TPU_EXECUTION_ENABLED_DEFAULT = "true"
     TPU_BUILD_ROWS_PER_SHARD = "hyperspace.tpu.build.rowsPerShard"
     TPU_BUILD_ROWS_PER_SHARD_DEFAULT = str(8 * 1024 * 1024)
+    # Device-footprint budget: datasets whose row count exceeds this stream
+    # through the build/scan in chunks (host spill per bucket during builds,
+    # per-chunk filter evaluation during scans) instead of materializing in
+    # HBM at once — SURVEY §7 hard-part #1 (data larger than HBM).
+    TPU_MAX_CHUNK_ROWS = "hyperspace.tpu.maxChunkRows"
+    TPU_MAX_CHUNK_ROWS_DEFAULT = str(8 * 1024 * 1024)
     TPU_MESH_SHAPE = "hyperspace.tpu.mesh"
     # When >1 device is visible, index builds run over the whole mesh
     # (all-to-all bucket exchange, parallel/distributed_build.py) — the

@@ -1,0 +1,217 @@
+"""Widened SPMD coverage (VERDICT r2 #3): row-returning distributed
+filter/project/join streams, multi-key broadcast joins, co-partitioned m:n
+exchange joins under skew, and capacity escalation.
+
+Oracle pattern matches test_spmd.py: assert the SPMD path is actually taken
+(DISPATCH_COUNT advances), and results equal the single-device executor run
+via the same public API with distribution disabled.
+"""
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+import hyperspace_tpu as hst
+from hyperspace_tpu.execution import spmd
+from hyperspace_tpu.index.constants import IndexConstants
+from hyperspace_tpu.plan.expr import col, count, sum_
+
+
+@pytest.fixture()
+def session(tmp_system_path):
+    return hst.Session(system_path=tmp_system_path)
+
+
+def write_dir(tmp_path, name, table):
+    d = tmp_path / name
+    d.mkdir()
+    pq.write_table(table, str(d / "part0.parquet"))
+    return str(d)
+
+
+@pytest.fixture()
+def fact_dir(tmp_path):
+    rng = np.random.default_rng(31)
+    n = 5000
+    return write_dir(tmp_path, "fact", pa.table({
+        "k": rng.integers(0, 400, n).astype(np.int64),
+        "k2": rng.integers(0, 6, n).astype(np.int64),
+        "tag": rng.choice(["a", "b", "c"], n),
+        "v": np.round(rng.uniform(0, 100, n), 3),
+    }))
+
+
+@pytest.fixture()
+def dim_dir(tmp_path):
+    rng = np.random.default_rng(32)
+    rows = []
+    t = pa.table({
+        "dk": np.repeat(np.arange(400, dtype=np.int64), 6),
+        "dk2": np.tile(np.arange(6, dtype=np.int64), 400),
+        "dval": rng.integers(0, 50, 2400).astype(np.int64),
+    })
+    return write_dir(tmp_path, "dim", t)
+
+
+def run_both(session, make_query, sort_by):
+    before = spmd.DISPATCH_COUNT
+    dist = make_query().to_pandas()
+    assert spmd.DISPATCH_COUNT > before, "SPMD path was not taken"
+    session.conf.set(IndexConstants.TPU_DISTRIBUTED_ENABLED, "false")
+    try:
+        single = make_query().to_pandas()
+    finally:
+        session.conf.set(IndexConstants.TPU_DISTRIBUTED_ENABLED, "true")
+    a = dist.sort_values(sort_by).reset_index(drop=True)
+    b = single.sort_values(sort_by).reset_index(drop=True)
+    pd.testing.assert_frame_equal(a, b, check_dtype=False)
+    return a
+
+
+class TestRowReturningStream:
+    def test_filter_returns_rows(self, session, fact_dir):
+        f = session.read.parquet(fact_dir)
+        out = run_both(
+            session,
+            lambda: f.filter((col("k") < 50) & (col("tag") != "b"))
+                     .select("k", "v"),
+            sort_by=["k", "v"])
+        assert len(out) > 0
+
+    def test_project_expression_rows(self, session, fact_dir):
+        f = session.read.parquet(fact_dir)
+        run_both(
+            session,
+            lambda: f.filter(col("k2") == 3)
+                     .select(col("k"), (col("v") * 2 + 1).alias("vv")),
+            sort_by=["k", "vv"])
+
+    def test_filter_sort_limit_wrappers(self, session, fact_dir):
+        f = session.read.parquet(fact_dir)
+        before = spmd.DISPATCH_COUNT
+        q = (f.filter(col("k") < 100).select("k", "v")
+             .sort("k", "v").limit(20))
+        dist = q.to_pandas()
+        assert spmd.DISPATCH_COUNT > before
+        session.conf.set(IndexConstants.TPU_DISTRIBUTED_ENABLED, "false")
+        single = q.to_pandas()
+        session.conf.set(IndexConstants.TPU_DISTRIBUTED_ENABLED, "true")
+        pd.testing.assert_frame_equal(dist, single, check_dtype=False)
+
+    def test_join_returns_rows(self, session, fact_dir, tmp_path):
+        rng = np.random.default_rng(40)
+        small = write_dir(tmp_path, "small", pa.table({
+            "sk": np.arange(400, dtype=np.int64),
+            "sval": rng.integers(0, 9, 400).astype(np.int64),
+        }))
+        f = session.read.parquet(fact_dir)
+        s = session.read.parquet(small)
+        run_both(
+            session,
+            lambda: f.filter(col("k") < 120)
+                     .join(s, on=col("k") == col("sk"))
+                     .select("k", "v", "sval"),
+            sort_by=["k", "v", "sval"])
+
+    def test_nullable_output_columns(self, session, tmp_path):
+        rng = np.random.default_rng(41)
+        n = 3000
+        mask = rng.random(n) < 0.2
+        t = pa.table({
+            "a": pa.array(rng.integers(0, 50, n), type=pa.int64(),
+                          mask=mask),
+            "b": pa.array(np.arange(n, dtype=np.int64)),
+        })
+        d = write_dir(tmp_path, "nulls", t)
+        f = session.read.parquet(d)
+        out = run_both(session,
+                       lambda: f.filter(col("b") < 2000).select("a", "b"),
+                       sort_by=["b"])
+        assert out["a"].isna().sum() > 0
+
+
+class TestMultiKeyBroadcastJoin:
+    def test_two_key_join_aggregate(self, session, fact_dir, dim_dir):
+        f = session.read.parquet(fact_dir)
+        d = session.read.parquet(dim_dir)
+        run_both(
+            session,
+            lambda: f.join(d, on=(col("k") == col("dk"))
+                           & (col("k2") == col("dk2")))
+                     .group_by("dval").agg(sum_(col("v")).alias("sv"),
+                                           count(None).alias("n")),
+            sort_by=["dval"])
+
+    def test_two_key_join_rows(self, session, fact_dir, dim_dir):
+        f = session.read.parquet(fact_dir)
+        d = session.read.parquet(dim_dir)
+        run_both(
+            session,
+            lambda: f.filter(col("k") < 80)
+                     .join(d, on=(col("k") == col("dk"))
+                           & (col("k2") == col("dk2")))
+                     .select("k", "k2", "v", "dval"),
+            sort_by=["k", "k2", "v", "dval"])
+
+
+class TestExchangeJoin:
+    def test_skewed_m_n_join(self, session, tmp_path):
+        """80% of rows share one key (worst-case routing skew): capacity
+        escalation must converge and results must match."""
+        rng = np.random.default_rng(50)
+        n = 4000
+        keys = np.where(rng.random(n) < 0.8, 7,
+                        rng.integers(0, 100, n)).astype(np.int64)
+        left = write_dir(tmp_path, "l", pa.table({
+            "k": keys, "v": rng.integers(0, 10, n).astype(np.int64)}))
+        # Right side m:n but bounded fan-out (~3 dups per key), so the
+        # skewed device's join output fits within the escalation ladder.
+        m = 300
+        rkeys = rng.integers(0, 100, m).astype(np.int64)
+        right = write_dir(tmp_path, "r", pa.table({
+            "rk": rkeys, "w": rng.integers(0, 10, m).astype(np.int64)}))
+        lf = session.read.parquet(left)
+        rf = session.read.parquet(right)
+        run_both(
+            session,
+            lambda: lf.join(rf, on=col("k") == col("rk"))
+                      .group_by("k").agg(count(None).alias("n"),
+                                         sum_(col("w")).alias("sw")),
+            sort_by=["k"])
+
+    def test_m_n_join_row_returning(self, session, tmp_path):
+        rng = np.random.default_rng(51)
+        left = write_dir(tmp_path, "l2", pa.table({
+            "k": rng.integers(0, 30, 1500).astype(np.int64),
+            "v": np.arange(1500, dtype=np.int64)}))
+        right = write_dir(tmp_path, "r2", pa.table({
+            "rk": rng.integers(0, 30, 200).astype(np.int64),
+            "w": np.arange(200, dtype=np.int64)}))
+        lf = session.read.parquet(left)
+        rf = session.read.parquet(right)
+        out = run_both(
+            session,
+            lambda: lf.join(rf, on=col("k") == col("rk"))
+                      .select("k", "v", "w"),
+            sort_by=["k", "v", "w"])
+        # m:n expansion really happened (output ≫ left rows).
+        assert len(out) > 1500
+
+    def test_exchange_join_string_key(self, session, tmp_path):
+        rng = np.random.default_rng(52)
+        names = np.array([f"n{i:03d}" for i in range(40)])
+        left = write_dir(tmp_path, "l3", pa.table({
+            "k": names[rng.integers(0, 40, 2000)],
+            "v": np.arange(2000, dtype=np.int64)}))
+        right = write_dir(tmp_path, "r3", pa.table({
+            "rk": names[rng.integers(0, 40, 300)],
+            "w": np.arange(300, dtype=np.int64)}))
+        lf = session.read.parquet(left)
+        rf = session.read.parquet(right)
+        run_both(
+            session,
+            lambda: lf.join(rf, on=col("k") == col("rk"))
+                      .group_by("k").agg(count(None).alias("n")),
+            sort_by=["k"])
