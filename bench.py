@@ -351,11 +351,97 @@ def _run_with_watchdog(argv: List[str], total_timeout: float) -> int:
     return 0
 
 
+def mesh_main(args) -> None:
+    """Multi-device phase (VERDICT r2 #7): distributed build throughput and
+    SPMD Q3 vs single-device, on a virtual CPU mesh (the real chip is one
+    device; ICI-scale numbers need real multi-chip hardware — this measures
+    that the distributed paths run and what the collective overhead costs).
+    Runs in its own process: the host-platform device count must be fixed
+    before jax initializes. Prints ONE JSON line."""
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+    import hyperspace_tpu as hst
+    from hyperspace_tpu.api import Hyperspace, IndexConfig
+    from hyperspace_tpu.execution import spmd
+    from hyperspace_tpu.index.constants import IndexConstants
+    from hyperspace_tpu.parallel import distributed_build
+
+    out = {"n_devices": len(jax.devices()), "mesh_backend": "cpu",
+           "scale": args.scale}
+    root = tempfile.mkdtemp(prefix="hs_mesh_")
+    try:
+        li_dir, od_dir, _pt, n_li, _n_od = make_tpch_like(root, args.scale)
+        session = hst.Session(system_path=os.path.join(root, "indexes"))
+        session.conf.set(IndexConstants.INDEX_NUM_BUCKETS, 32)
+        hs = Hyperspace(session)
+        li = session.read.parquet(li_dir)
+
+        # Distributed build throughput (mesh path asserted via counter).
+        before = distributed_build.DISPATCH_COUNT
+        hs.create_index(li, IndexConfig(
+            "mesh_li", ["l_orderkey"], ["l_extendedprice", "l_discount"]))
+        if distributed_build.DISPATCH_COUNT == before:
+            out["errors"] = ["distributed build path was not taken"]
+        hs.delete_index("mesh_li")
+        hs.vacuum_index("mesh_li")
+        t0 = time.perf_counter()
+        hs.create_index(li, IndexConfig(
+            "mesh_li", ["l_orderkey"], ["l_extendedprice", "l_discount"]))
+        build_s = time.perf_counter() - t0
+        out["dist_build_s"] = round(build_s, 3)
+        out["dist_build_rows_per_s"] = round(n_li / build_s, 1)
+
+        # SPMD Q3 vs single-device on the same mesh (no indexes in play —
+        # this isolates the execution engine, not the rewrite).
+        q3 = build_q3(session, li_dir, od_dir)
+        before = spmd.DISPATCH_COUNT
+        q3.to_arrow()  # warm + compile
+        out["spmd_q3_dispatched"] = spmd.DISPATCH_COUNT > before
+        spmd_s = timed_best(lambda: q3.to_arrow(), args.repeats)
+        session.conf.set(IndexConstants.TPU_DISTRIBUTED_ENABLED, "false")
+        q3.to_arrow()  # warm single-device path
+        single_s = timed_best(lambda: q3.to_arrow(), args.repeats)
+        out["spmd_q3_s"] = round(spmd_s, 4)
+        out["single_q3_s"] = round(single_s, 4)
+        out["spmd_q3_speedup"] = round(single_s / spmd_s, 3) if spmd_s else 0.0
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+    print(json.dumps(out))
+
+
+def _run_mesh_phase(scale: float, timeout_s: float) -> None:
+    """Spawn the mesh phase with a virtual 8-device CPU platform (env must
+    be set before the child's jax import)."""
+    env = dict(os.environ)
+    flags = env.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        env["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
+    env.pop("BENCH_CHILD_PARTIAL", None)
+    out = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), "--mesh",
+         "--scale", str(scale)],
+        env=env, capture_output=True, text=True, timeout=timeout_s)
+    last = (out.stdout or "").strip().splitlines()
+    if out.returncode == 0 and last:
+        mesh = json.loads(last[-1])
+        RESULT["mesh"] = mesh
+        for k in ("n_devices", "dist_build_rows_per_s", "spmd_q3_speedup"):
+            if k in mesh:
+                RESULT[k] = mesh[k]
+    else:
+        RESULT["errors"].append(
+            f"mesh phase rc={out.returncode}; stderr tail={_tail(out.stderr)}")
+
+
 def main():
     parser = argparse.ArgumentParser()
     parser.add_argument("--scale", type=float,
                         default=float(os.environ.get("BENCH_SCALE", "0.05")))
     parser.add_argument("--repeats", type=int, default=3)
+    parser.add_argument("--mesh", action="store_true",
+                        help="internal: run the multi-device phase")
     parser.add_argument("--keep", action="store_true")
     parser.add_argument("--backend-timeout", type=float, default=float(
         os.environ.get("BENCH_BACKEND_TIMEOUT", "540")))
@@ -364,6 +450,10 @@ def main():
     parser.add_argument("--no-watchdog", action="store_true")
     args = parser.parse_args()
     RESULT["scale"] = args.scale
+
+    if args.mesh:
+        mesh_main(args)
+        return
 
     global _PARTIAL_PATH
     _PARTIAL_PATH = os.environ.get("BENCH_CHILD_PARTIAL")
@@ -524,6 +614,15 @@ def main():
         if "filter" in speedups:
             RESULT["value"] = round(speedups["filter"], 3)
             RESULT["vs_baseline"] = round(speedups["filter"], 3)
+
+        with _phase("mesh"):
+            # Multi-device numbers ride along at a bounded scale (the
+            # virtual CPU mesh measures path health + collective overhead,
+            # not ICI bandwidth).
+            mesh_scale = float(os.environ.get(
+                "BENCH_MESH_SCALE", str(min(args.scale, 0.05))))
+            _run_mesh_phase(mesh_scale, timeout_s=float(
+                os.environ.get("BENCH_MESH_TIMEOUT", "900")))
     finally:
         if not args.keep:
             shutil.rmtree(root, ignore_errors=True)

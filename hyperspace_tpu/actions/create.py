@@ -64,13 +64,14 @@ class CreateActionBase(Action):
         cols = indexed + included
         files = list(files) if files is not None else relation.all_files()
         data_fmt = getattr(relation, "data_file_format", relation.file_format)
-        table = read_parquet(files, cols, data_fmt)
+        from ..sources.partitions import read_relation_files
+        table = read_relation_files(relation, files, cols, data_fmt)
         if self._lineage_enabled():
-            counts = [pq.ParquetFile(f).metadata.num_rows for f in files] \
-                if data_fmt == "parquet" else None
-            if counts is None:
+            if data_fmt != "parquet":
                 raise HyperspaceException(
                     "Lineage requires parquet sources in this version")
+            from ..execution.columnar import parquet_row_counts
+            counts = parquet_row_counts(files)
             ids = [file_id_tracker.add_file(
                 *_file_triple(f)) for f in files]
             lineage = np.repeat(np.asarray(ids, np.int64),

@@ -149,9 +149,14 @@ class Hyperspace:
             raise HyperspaceException(f"Index with name {index_name} could not be found.")
         return pd.DataFrame([IndexStatistics.from_entry(entry).to_extended_row()])
 
-    def explain(self, df, verbose: bool = False, redirect_func=None) -> str:
+    def explain(self, df, verbose: bool = False, redirect_func=None,
+                mode: str = "plaintext") -> str:
+        """Explain the rewrite: lockstep plan diff with changed subtrees
+        highlighted, rendered per ``mode`` ("plaintext" | "console" |
+        "html" — parity: plananalysis/DisplayMode.scala)."""
         from .plananalysis.explain import explain_string
-        text = explain_string(self.session, df.plan, verbose=verbose)
+        text = explain_string(self.session, df.plan, verbose=verbose,
+                              mode=mode)
         if redirect_func is not None:
             redirect_func(text)
         return text

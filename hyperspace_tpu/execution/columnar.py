@@ -337,6 +337,19 @@ def read_parquet(files: Sequence[str], columns: Optional[Sequence[str]] = None,
         at = pa.concat_tables(tables)
         if columns:
             at = at.select(list(columns))
+    elif fmt == "json":
+        # Newline-delimited JSON (the reference's spark json source shape,
+        # DefaultFileBasedSource.scala:37-44).
+        import pyarrow.json as pa_json
+        tables = [pa_json.read_json(f) for f in files]
+        at = pa.concat_tables(tables)
+        if columns:
+            at = at.select(list(columns))
+    elif fmt == "orc":
+        import pyarrow.orc as pa_orc
+        tables = [pa_orc.ORCFile(f).read(
+            columns=list(columns) if columns else None) for f in files]
+        at = pa.concat_tables(tables)
     else:
         raise HyperspaceException(f"Unsupported format: {fmt}")
     return Table.from_arrow(at)
@@ -488,4 +501,9 @@ def literal_to_device(value, dtype: str, dictionary: Optional[np.ndarray]):
         return bool(value)
     if dtype in (FLOAT32, FLOAT64):
         return float(value)
+    if isinstance(value, float) and not value.is_integer():
+        # Fractional literal against an int column: int() truncation would
+        # change comparison semantics (5 < 5.5 but not 5 < int(5.5));
+        # jnp promotes the int column for the comparison instead.
+        return value
     return int(value)

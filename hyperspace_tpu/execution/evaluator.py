@@ -103,6 +103,9 @@ def _try_fused_range(table: Table, e: "E.And") -> Optional[Column]:
         return None
     lo = literal_to_device(lo_cmp.right.value, col.dtype, None)
     hi = literal_to_device(hi_cmp.right.value, col.dtype, None)
+    if jnp.issubdtype(col.data.dtype, jnp.integer) \
+            and not (isinstance(lo, int) and isinstance(hi, int)):
+        return None  # fractional bound against int data: general path
     mask = pallas_kernels.fused_range_mask(
         col.data, lo, hi,
         lo_incl=isinstance(lo_cmp, E.GreaterThanOrEqual),

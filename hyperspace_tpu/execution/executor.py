@@ -162,6 +162,12 @@ def _chunked_filtered_scan(plan: Scan, needed: Optional[Set[str]],
         cols = [n for n in relation.schema.names if n in needed]
         if not cols:
             cols = [relation.schema.names[0]]
+    # Hive partition columns live in directory names, not in the files —
+    # the streaming reader can't attach them; read_relation_files can.
+    part_names = {f.name for f in
+                  getattr(relation, "partition_fields", lambda: [])()}
+    if part_names and (cols is None or any(c in part_names for c in cols)):
+        return None
     try:
         # Nested struct leaves carry dotted names that are NOT physical
         # top-level parquet columns — those go to the in-memory reader,
@@ -204,7 +210,9 @@ def _execute_scan(plan: Scan, needed: Optional[Set[str]],
     fmt = getattr(relation, "data_file_format", relation.file_format)
     if fmt != "parquet":
         pa_filter = None
-    return read_parquet(files, cols, fmt, filters=pa_filter)
+    from ..sources.partitions import read_relation_files
+    return read_relation_files(relation, files, cols, fmt,
+                               filters=pa_filter)
 
 
 def _equality_bucket_subset(plan: IndexScan, condition) -> Optional[Set[int]]:
@@ -288,11 +296,17 @@ def _execute_index_scan(plan: IndexScan, needed: Optional[Set[str]],
                                    or [plan.schema.names[0]])
             return empty_table(out_schema)
     schema_names = entry.schema.names
-    cols = None
+    # Columns are ALWAYS explicit: index files live under "v__=<n>"
+    # directories, and pyarrow's reader hive-infers a phantom "v__"
+    # column from the path when asked for all columns (columns=None).
     if needed is not None:
         cols = [n for n in schema_names if n in needed]
         if not cols:
             cols = [schema_names[0]]
+        if plan.deleted_file_ids and IndexConstants.DATA_FILE_NAME_ID not in cols:
+            cols = cols + [IndexConstants.DATA_FILE_NAME_ID]
+    else:
+        cols = [n for n in plan.schema.names]
         if plan.deleted_file_ids and IndexConstants.DATA_FILE_NAME_ID not in cols:
             cols = cols + [IndexConstants.DATA_FILE_NAME_ID]
     if not index_files:
@@ -342,9 +356,9 @@ def _execute_index_scan(plan: IndexScan, needed: Optional[Set[str]],
             table = merged
         else:
             table = Table.concat([table, appended.select(table.names)])
-    drop_lineage = (needed is not None
-                    and IndexConstants.DATA_FILE_NAME_ID in table.names
-                    and IndexConstants.DATA_FILE_NAME_ID not in needed)
+    wanted = needed if needed is not None else set(plan.schema.names)
+    drop_lineage = (IndexConstants.DATA_FILE_NAME_ID in table.names
+                    and IndexConstants.DATA_FILE_NAME_ID not in wanted)
     if drop_lineage:
         table = table.select([n for n in table.names
                               if n != IndexConstants.DATA_FILE_NAME_ID])
@@ -629,14 +643,34 @@ def _group_sort_keys(cols: Sequence[Column]) -> List[jnp.ndarray]:
     return [k for c in cols for k in _null_aware_keys(c)]
 
 
+# Group-bys that skipped the sort because the input carried bucket order
+# on exactly the grouping keys (tests/bench assert the path is taken).
+GROUPBY_SORT_SKIPPED = 0
+
+
 def _execute_aggregate(plan: Aggregate, table: Table) -> Table:
+    global GROUPBY_SORT_SKIPPED
     if not plan.group_cols:
         return _execute_global_aggregate(plan, table)
     key_cols = [table.column(g) for g in plan.group_cols]
-    order = kernels.lex_sort_indices(_group_sort_keys(key_cols))
-    sorted_table = table.take(order)
-    sorted_keys = _group_sort_keys(
-        [sorted_table.column(g) for g in plan.group_cols])
+    bo = table.bucket_order
+    if bo is not None and set(bo[1]) == set(plan.group_cols) \
+            and all(c.validity is None for c in key_cols):
+        # Covering-index layout: rows sorted by (bucket, keys) ⇒ equal key
+        # tuples are globally contiguous (a key tuple lives in exactly one
+        # bucket), so segment detection works WITHOUT the O(n log n) sort —
+        # the group-by analogue of the shuffle-free merge join. Requires
+        # the bucket keys to be exactly the grouping keys as a SET (a
+        # subset would let one group span buckets). (Nullable keys fall
+        # through: their fill values collide with real zeros.)
+        sorted_table = table
+        sorted_keys = [c.data for c in key_cols]
+        GROUPBY_SORT_SKIPPED += 1
+    else:
+        order = kernels.lex_sort_indices(_group_sort_keys(key_cols))
+        sorted_table = table.take(order)
+        sorted_keys = _group_sort_keys(
+            [sorted_table.column(g) for g in plan.group_cols])
     gids, num_groups = kernels.group_ids_from_sorted(sorted_keys)
     if num_groups == 0:
         return Table({f.name: Column(f.dtype,

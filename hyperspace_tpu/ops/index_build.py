@@ -15,7 +15,7 @@ sorted array into per-bucket parquet files at the host DMA boundary.
 
 from __future__ import annotations
 
-from typing import List, Optional, Sequence, Tuple
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -100,50 +100,81 @@ def build_sorted_buckets_chunked(
 
     Pipeline per chunk (≤ ``chunk_rows`` rows resident on device at once):
     hash+bucket-sort the chunk (one XLA program, same kernel as the
-    in-memory build), DMA to host, slice into per-bucket *sorted runs*
-    spilled as arrow tables. After the stream: per bucket, concatenate its
-    runs, re-sort on device (bucket size ≪ dataset size), write one
-    parquet — the identical one-file-per-bucket layout and within-bucket
-    order the in-memory path produces (actions/create.py layout rule).
+    in-memory build), DMA to host, and append each bucket's slice as a row
+    group to that bucket's SPILL FILE on disk. After the stream: per
+    bucket, read its spill back, re-sort on device (bucket size ≪ dataset
+    size), write the final parquet — the identical one-file-per-bucket
+    layout and within-bucket order the in-memory path produces
+    (actions/create.py layout rule).
 
     The reference achieves the same scale via Spark's external shuffle
-    (CreateActionBase.scala:111-121); here the host filesystem plays the
-    shuffle-spill role and the device only ever sees one chunk or one
-    bucket at a time.
+    (CreateActionBase.scala:111-121); here the host filesystem genuinely
+    plays the shuffle-spill role — host RAM holds one chunk (plus write
+    buffers) and the device one chunk or one bucket at a time.
     """
+    import shutil
+    import tempfile
+
+    # NOT under out_dir: the version dir is named "v__=<n>", and pyarrow's
+    # dataset reader would hive-infer a phantom "v__" column from any file
+    # path inside it. Removed even on failure (it can hold dataset-scale
+    # bytes).
+    spill_dir = tempfile.mkdtemp(prefix="hs_build_spill_")
+    try:
+        _chunked_spill_and_merge(
+            files, columns, indexed_cols, num_buckets, chunk_rows, out_dir,
+            row_group_size, lineage_ids, lineage_col, spill_dir)
+    finally:
+        shutil.rmtree(spill_dir, ignore_errors=True)
+
+
+def _chunked_spill_and_merge(files, columns, indexed_cols, num_buckets,
+                             chunk_rows, out_dir, row_group_size,
+                             lineage_ids, lineage_col,
+                             spill_dir: str) -> None:
     import os
 
-    import pyarrow as pa
+    import pyarrow.parquet as pq
 
     from ..execution.columnar import (Column, Table, iter_parquet_chunks,
-                                      write_parquet)
+                                      read_parquet, write_parquet)
     from ..schema import INT64
 
-    spills: List[List[pa.Table]] = [[] for _ in range(num_buckets)]
-    for chunk, provenance in iter_parquet_chunks(files, columns, chunk_rows):
-        if lineage_ids is not None:
-            ids = np.concatenate([
-                np.full(cnt, lineage_ids[fi], np.int64)
-                for fi, cnt in provenance])
-            chunk = chunk.with_column(lineage_col,
-                                      Column(INT64, jnp.asarray(ids)))
-        _note_device_rows(chunk.num_rows)
-        CHUNK_STATS["chunks"] += 1
-        sorted_chunk, bounds = build_sorted_buckets(
-            chunk, indexed_cols, num_buckets)
-        at = sorted_chunk.to_arrow()
-        for b in range(num_buckets):
-            lo, hi = int(bounds[b]), int(bounds[b + 1])
-            if hi > lo:
+    writers: Dict[int, pq.ParquetWriter] = {}
+    try:
+        for chunk, provenance in iter_parquet_chunks(files, columns,
+                                                     chunk_rows):
+            if lineage_ids is not None:
+                ids = np.concatenate([
+                    np.full(cnt, lineage_ids[fi], np.int64)
+                    for fi, cnt in provenance])
+                chunk = chunk.with_column(lineage_col,
+                                          Column(INT64, jnp.asarray(ids)))
+            _note_device_rows(chunk.num_rows)
+            CHUNK_STATS["chunks"] += 1
+            sorted_chunk, bounds = build_sorted_buckets(
+                chunk, indexed_cols, num_buckets)
+            at = sorted_chunk.to_arrow()
+            for b in range(num_buckets):
+                lo, hi = int(bounds[b]), int(bounds[b + 1])
+                if hi <= lo:
+                    continue
                 run = at.slice(lo, hi - lo)
                 CHUNK_STATS["spill_bytes"] += run.nbytes
-                spills[b].append(run)
+                w = writers.get(b)
+                if w is None:
+                    w = pq.ParquetWriter(
+                        os.path.join(spill_dir, f"bucket{b:05d}.parquet"),
+                        run.schema)
+                    writers[b] = w
+                w.write_table(run)
+    finally:
+        for w in writers.values():
+            w.close()
 
-    for b, runs in enumerate(spills):
-        if not runs:
-            continue
-        merged = pa.concat_tables(runs)
-        bucket_table = Table.from_arrow(merged)
+    for b in sorted(writers):
+        spill_path = os.path.join(spill_dir, f"bucket{b:05d}.parquet")
+        bucket_table = read_parquet([spill_path])
         _note_device_rows(bucket_table.num_rows)
         keys = [bucket_table.column(c).data for c in indexed_cols]
         perm = kernels.lex_sort_indices(keys)

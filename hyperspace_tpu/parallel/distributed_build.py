@@ -120,6 +120,11 @@ def _exchange_and_sort(arrays: Dict[str, jax.Array], valid: jax.Array,
     return shard_fn(arrays, valid, dict_hash_tables)
 
 
+# Successful mesh builds in this process (bench/tests assert the
+# distributed path actually ran).
+DISPATCH_COUNT = 0
+
+
 def distributed_build_sorted_buckets(
         table: Table, indexed_cols: Sequence[str], num_buckets: int,
         mesh: Optional[Mesh] = None,
@@ -171,6 +176,8 @@ def distributed_build_sorted_buckets(
             key_names=tuple(f"d:{c}" for c in indexed_cols),
             key_dtypes=tuple(key_dtypes), mesh=mesh)
         if not bool(overflow):
+            global DISPATCH_COUNT
+            DISPATCH_COUNT += 1
             out_cols = {}
             for name in table.names:
                 src = table.column(name)

@@ -1,0 +1,86 @@
+"""Display modes for explain output.
+
+Parity reference: plananalysis/DisplayMode.scala — Console / PlainText /
+HTML renderings share one buffer protocol; each mode defines its newline
+and the begin/end tags wrapped around highlighted (changed) plan lines.
+"""
+
+from __future__ import annotations
+
+import html as _html
+from typing import List
+
+
+class DisplayMode:
+    """Rendering policy: newline + highlight delimiters."""
+
+    new_line = "\n"
+    highlight_begin = ""
+    highlight_end = ""
+
+    def escape(self, text: str) -> str:
+        return text
+
+    def wrap(self, body: str) -> str:
+        return body
+
+
+class PlainTextMode(DisplayMode):
+    """No decoration — stable output for golden files and logs."""
+
+
+class ConsoleMode(DisplayMode):
+    """ANSI highlight for terminals (changed subtrees in yellow)."""
+
+    highlight_begin = "\033[93m"
+    highlight_end = "\033[0m"
+
+
+class HTMLMode(DisplayMode):
+    """HTML rendering: escaped text, <br> newlines, <b> highlights,
+    wrapped in <pre> (parity: DisplayMode.scala HTML mode)."""
+
+    new_line = "<br>"
+    highlight_begin = "<b>"
+    highlight_end = "</b>"
+
+    def escape(self, text: str) -> str:
+        return _html.escape(text)
+
+    def wrap(self, body: str) -> str:
+        return f"<pre>{body}</pre>"
+
+
+_MODES = {
+    "plaintext": PlainTextMode,
+    "console": ConsoleMode,
+    "html": HTMLMode,
+}
+
+
+def get_mode(name) -> DisplayMode:
+    if isinstance(name, DisplayMode):
+        return name
+    cls = _MODES.get(str(name).lower())
+    if cls is None:
+        raise ValueError(
+            f"Unknown display mode {name!r}; one of {sorted(_MODES)}")
+    return cls()
+
+
+class BufferStream:
+    """Line buffer writing through a DisplayMode (parity:
+    plananalysis/BufferStream.scala)."""
+
+    def __init__(self, mode: DisplayMode):
+        self.mode = mode
+        self._lines: List[str] = []
+
+    def write_line(self, text: str = "", highlight: bool = False) -> None:
+        body = self.mode.escape(text)
+        if highlight and text.strip():
+            body = self.mode.highlight_begin + body + self.mode.highlight_end
+        self._lines.append(body)
+
+    def build(self) -> str:
+        return self.mode.wrap(self.mode.new_line.join(self._lines))

@@ -1,13 +1,18 @@
-"""Explain API: diff the plan with and without hyperspace rules.
+"""Explain API: lockstep diff of the plan with and without hyperspace rules.
 
 Parity reference: plananalysis/PlanAnalyzer.scala:36-120 — builds two
-executions (rules enabled/disabled), highlights the differing subtrees, and
-lists the indexes the rewritten plan uses.
+executions (rules enabled/disabled), walks both plans in lockstep
+highlighting the subtrees the rewrite changed, lists the indexes the
+rewritten plan uses, and renders through a pluggable display mode
+(Console / PlainText / HTML — plananalysis/DisplayMode.scala).
 """
 
 from __future__ import annotations
 
+from typing import List, Optional, Set, Tuple
+
 from ..plan.nodes import IndexScan, LogicalPlan
+from .display import BufferStream, DisplayMode, get_mode
 
 
 def _used_indexes(plan: LogicalPlan):
@@ -20,7 +25,58 @@ def _used_indexes(plan: LogicalPlan):
     return out
 
 
-def explain_string(session, plan: LogicalPlan, verbose: bool = False) -> str:
+# ---------------------------------------------------------------------------
+# Lockstep diff: mark every node inside a subtree the rewrite changed.
+# ---------------------------------------------------------------------------
+
+def _render(plan: LogicalPlan, depth: int = 0
+            ) -> List[Tuple[LogicalPlan, int, str]]:
+    rows = [(plan, depth, "  " * depth + plan.simple_string())]
+    for c in plan.children:
+        rows.extend(_render(c, depth + 1))
+    return rows
+
+
+def _mark_all(node: LogicalPlan, marks: Set[int]) -> None:
+    marks.add(id(node))
+    for c in node.children:
+        _mark_all(c, marks)
+
+
+def _diff_marks(a: LogicalPlan, b: LogicalPlan,
+                marks_a: Set[int], marks_b: Set[int]) -> None:
+    """Walk both trees in lockstep; where they diverge, highlight the whole
+    differing subtree on each side (PlanAnalyzer highlights changed
+    subtrees, not single lines)."""
+    if a.tree_string() == b.tree_string():
+        return
+    same_head = (type(a) is type(b)
+                 and a.simple_string() == b.simple_string()
+                 and len(a.children) == len(b.children))
+    if not same_head:
+        _mark_all(a, marks_a)
+        _mark_all(b, marks_b)
+        return
+    for ca, cb in zip(a.children, b.children):
+        _diff_marks(ca, cb, marks_a, marks_b)
+
+
+def _write_plan(buf: BufferStream, plan: LogicalPlan,
+                marks: Optional[Set[int]]) -> None:
+    for node, _depth, line in _render(plan):
+        buf.write_line(line,
+                       highlight=marks is not None and id(node) in marks)
+
+
+def _header(buf: BufferStream, title: str) -> None:
+    buf.write_line("=" * 60)
+    buf.write_line(title)
+    buf.write_line("=" * 60)
+
+
+def explain_string(session, plan: LogicalPlan, verbose: bool = False,
+                   mode="plaintext") -> str:
+    display: DisplayMode = get_mode(mode)
     was_enabled = session.is_hyperspace_enabled()
     try:
         session.enable_hyperspace()
@@ -29,34 +85,31 @@ def explain_string(session, plan: LogicalPlan, verbose: bool = False) -> str:
         if not was_enabled:
             session.disable_hyperspace()
 
-    lines = []
-    lines.append("=" * 60)
-    lines.append("Plan with indexes:")
-    lines.append("=" * 60)
-    lines.append(with_index.tree_string())
-    lines.append("")
-    lines.append("=" * 60)
-    lines.append("Plan without indexes:")
-    lines.append("=" * 60)
-    lines.append(plan.tree_string())
-    lines.append("")
-    lines.append("=" * 60)
-    lines.append("Indexes used:")
-    lines.append("=" * 60)
+    marks_with: Set[int] = set()
+    marks_without: Set[int] = set()
+    _diff_marks(with_index, plan, marks_with, marks_without)
+
+    buf = BufferStream(display)
+    _header(buf, "Plan with indexes:")
+    _write_plan(buf, with_index, marks_with)
+    buf.write_line()
+    _header(buf, "Plan without indexes:")
+    _write_plan(buf, plan, marks_without)
+    buf.write_line()
+    _header(buf, "Indexes used:")
     used = _used_indexes(with_index)
-    lines.extend(used if used else ["<none>"])
+    for line in (used if used else ["<none>"]):
+        buf.write_line(line)
     if verbose:
-        lines.append("")
-        lines.append("=" * 60)
-        lines.append("Physical operator stats:")
-        lines.append("=" * 60)
+        buf.write_line()
+        _header(buf, "Physical operator stats:")
         before = _count_nodes(plan)
         after = _count_nodes(with_index)
         for name in sorted(set(before) | set(after)):
             b, a = before.get(name, 0), after.get(name, 0)
             if b != a:
-                lines.append(f"{name}: {b} -> {a}")
-    return "\n".join(lines)
+                buf.write_line(f"{name}: {b} -> {a}")
+    return buf.build()
 
 
 def _count_nodes(plan: LogicalPlan):

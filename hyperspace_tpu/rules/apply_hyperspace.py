@@ -65,6 +65,13 @@ def apply_hyperspace(session, plan: LogicalPlan,
         log_index_usage(session, ctx, sorted(set(ctx.applied)),
                         plan.tree_string(), "Hyperspace indexes applied.")
 
+    # Group-by indexes: unfiltered aggregations over remaining Scan leaves
+    # probe a covering index whose bucket order lets the executor skip the
+    # group-by sort (no reference analogue — see rules/groupby_rule.py).
+    from .groupby_rule import GroupByIndexRule
+    plan = GroupByIndexRule().apply(session, plan, ctx)
+    ctx.applied = _applied_index_names(plan)
+
     # Data skipping last: it only narrows Scan leaves the covering rules
     # left in place (the covering rewrite is the better win when it applies).
     plan = DataSkippingIndexRule().apply(session, plan, ctx)

@@ -1,0 +1,120 @@
+"""GroupByIndexRule: probe a covering index under an unfiltered group-by.
+
+No direct reference analogue (the reference's FilterIndexRule requires a
+Filter node — rules/FilterIndexRule.scala:165); this rule EXCEEDS it the
+way the working score-based optimizer does: an Aggregate whose grouping
+keys equal an index's indexed columns can scan the index instead of the
+source, and the executor then skips the group-by sort entirely because the
+covering-index bucket order makes equal key tuples contiguous
+(execution/executor.py GROUPBY_SORT_SKIPPED fast path). This is what makes
+the TPC-H Q17 shape (avg-per-partkey subquery over the full fact table)
+profit from its l_partkey index.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..plan import expr as E
+from ..plan.nodes import Aggregate, Filter, LogicalPlan, Project, Scan
+from .index_filters import ReasonCollector
+from .rankers import FilterIndexRanker
+from .rule_utils import (get_candidate_indexes, get_relation,
+                         log_index_usage, transform_plan_to_use_index)
+
+
+def _chain_to_scan(node: LogicalPlan):
+    """(chain nodes top-down, scan) for a linear Project/Filter chain, or
+    None. Projects must pass the needed columns through unrenamed — an
+    alias would decouple the grouping keys from the index's columns."""
+    chain = []
+    cur = node
+    while isinstance(cur, (Project, Filter)):
+        chain.append(cur)
+        cur = cur.child
+    if not isinstance(cur, Scan):
+        return None
+    return chain, cur
+
+
+def _scan_level_needed(chain, needed) -> Optional[set]:
+    """Walk the chain top-down: filters add their references, projects must
+    pass the currently-needed names through unrenamed (an alias would
+    decouple the grouping keys from the index's columns). Returns the
+    column set needed at the scan, or None when a project renames."""
+    needed = set(needed)
+    for node in chain:
+        if isinstance(node, Filter):
+            needed |= set(node.condition.references)
+            continue
+        by_name = {e.name: e for e in node.exprs}
+        for n in needed:
+            e = by_name.get(n)
+            if e is None:
+                return None
+            inner = e.child if isinstance(e, E.Alias) else e
+            if not (isinstance(inner, E.Col) and inner.column == n):
+                return None
+    return needed
+
+
+class GroupByIndexRule:
+    name = "GroupByIndexRule"
+
+    def apply(self, session, plan: LogicalPlan,
+              ctx: Optional[ReasonCollector] = None) -> LogicalPlan:
+        from .apply_hyperspace import active_indexes
+
+        ctx = ctx or ReasonCollector(enabled=False)
+        applied = []
+
+        def rewrite(node: LogicalPlan) -> LogicalPlan:
+            if not isinstance(node, Aggregate) or not node.group_cols:
+                return node
+            matched = _chain_to_scan(node.child)
+            if matched is None:
+                return node
+            chain, scan = matched
+            relation = get_relation(session, scan)
+            if relation is None:
+                return node
+            top_needed = set(node.group_cols)
+            for a in node.aggs:
+                top_needed |= set(a.references)
+            needed = _scan_level_needed(chain, top_needed)
+            if needed is None:
+                return node
+            pool = get_candidate_indexes(
+                session, active_indexes(session), scan, ctx)
+            group_set = set(node.group_cols)
+            candidates = []
+            for e in pool:
+                if e.derivedDataset.kind != "CoveringIndex":
+                    continue
+                if set(e.indexed_columns) != group_set:
+                    ctx.add("NO_GROUPBY_KEY_MATCH", e,
+                            f"Indexed columns {e.indexed_columns} do not "
+                            f"equal grouping keys {sorted(group_set)}.")
+                    continue
+                covered = set(e.indexed_columns) | set(e.included_columns)
+                missing = needed - covered
+                if missing:
+                    ctx.add("MISSING_REQUIRED_COL", e,
+                            f"Index does not cover required columns "
+                            f"{sorted(missing)}.")
+                    continue
+                candidates.append(e)
+            best = FilterIndexRanker.rank(session, relation, candidates)
+            if best is None:
+                return node
+            new_child = transform_plan_to_use_index(
+                session, best, node.child, use_bucket_spec=True)
+            applied.append(best.name)
+            return Aggregate(node.group_cols, node.aggs, new_child)
+
+        new_plan = plan.transform_up(rewrite)
+        if applied:
+            log_index_usage(session, ctx, sorted(set(applied)),
+                            new_plan.tree_string(),
+                            "Group-by index applied.")
+        return new_plan

@@ -96,10 +96,13 @@ class Session:
     # ------------------------------------------------------------------
 
     def optimize(self, plan: LogicalPlan) -> LogicalPlan:
-        """General optimizations (column pruning), then the hyperspace
-        rewrite batch if enabled."""
+        """General optimizations (column pruning, partition pruning — both
+        always on, like Spark's own optimizer), then the hyperspace rewrite
+        batch if enabled."""
         from .rules.column_pruning import prune_columns
+        from .sources.partitions import prune_partitions
         plan = prune_columns(plan)
+        plan = prune_partitions(plan)
         if not self._hyperspace_enabled:
             return plan
         from .rules.apply_hyperspace import apply_hyperspace
@@ -127,6 +130,13 @@ class DataFrameReader:
 
     def csv(self, *paths: str) -> "DataFrame":
         return self.format("csv").load(*paths)
+
+    def json(self, *paths: str) -> "DataFrame":
+        """Newline-delimited JSON files."""
+        return self.format("json").load(*paths)
+
+    def orc(self, *paths: str) -> "DataFrame":
+        return self.format("orc").load(*paths)
 
     def delta(self, path: str, version_as_of: Optional[int] = None
               ) -> "DataFrame":

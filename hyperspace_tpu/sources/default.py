@@ -19,7 +19,10 @@ from ..schema import Schema
 from ..util import file_utils, hashing
 from .interfaces import FileBasedRelation, FileBasedSourceProvider
 
-SUPPORTED_FORMATS = ("parquet", "csv")
+# Parity: DefaultFileBasedSource.scala:37-44 supports
+# avro/csv/json/orc/parquet/text; avro and text have no pyarrow reader in
+# this image and are intentionally absent (documented gap).
+SUPPORTED_FORMATS = ("parquet", "csv", "json", "orc")
 
 
 class DefaultFileBasedRelation(FileBasedRelation):
@@ -33,6 +36,10 @@ class DefaultFileBasedRelation(FileBasedRelation):
         self._options = dict(options or {})
         self._schema = schema
         self._files: Optional[List[str]] = None
+        # Base for key=value partition parsing; with_files() keeps the
+        # original base so pruned relations still see their partitions.
+        self._partition_base = self._root_paths[0] if self._root_paths else ""
+        self._partition_fields = None
 
     @property
     def root_paths(self) -> List[str]:
@@ -49,16 +56,35 @@ class DefaultFileBasedRelation(FileBasedRelation):
     @property
     def schema(self) -> Schema:
         if self._schema is None:
-            files = self.all_files()
-            if not files:
-                raise HyperspaceException(
-                    f"No data files under {self._root_paths}")
-            if self._format == "parquet":
-                self._schema = Schema.from_arrow(pq.read_schema(files[0]))
-            else:
-                ds = pa_ds.dataset(files[0], format=self._format)
-                self._schema = Schema.from_arrow(ds.schema)
+            self._schema = self._physical_schema()
+            for f in self.partition_fields():
+                if f.name not in self._schema:
+                    self._schema = self._schema.append(f)
         return self._schema
+
+    def _physical_schema(self) -> Schema:
+        files = self.all_files()
+        if not files:
+            raise HyperspaceException(
+                f"No data files under {self._root_paths}")
+        if self._format == "parquet":
+            return Schema.from_arrow(pq.read_schema(files[0]))
+        ds = pa_ds.dataset(files[0], format=self._format)
+        return Schema.from_arrow(ds.schema)
+
+    # -- hive-partitioned directories (parity: partitionSchema /
+    # partitionBasePath, sources/interfaces.scala:43-247) --
+
+    @property
+    def partition_base_path(self) -> str:
+        return self._partition_base
+
+    def partition_fields(self):
+        if self._partition_fields is None:
+            from .partitions import infer_partition_fields
+            self._partition_fields = infer_partition_fields(
+                self._partition_base, self.all_files())
+        return list(self._partition_fields)
 
     def all_files(self) -> List[str]:
         if self._files is None:
@@ -90,6 +116,10 @@ class DefaultFileBasedRelation(FileBasedRelation):
         pruned = DefaultFileBasedRelation(
             list(files), self._format, self._options, schema=self.schema)
         pruned._files = sorted(os.path.abspath(f) for f in files)
+        pruned._partition_base = self._partition_base
+        pruned._partition_fields = self._partition_fields \
+            if self._partition_fields is not None \
+            else (self.partition_fields() or [])
         return pruned
 
 

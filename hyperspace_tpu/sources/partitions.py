@@ -1,0 +1,307 @@
+"""Hive-style partitioned directories: ``root/key=value/.../file``.
+
+Parity reference: sources/interfaces.scala:43-247 (partitionSchema /
+partitionBasePath on FileBasedRelation) and Spark's
+PartitioningAwareFileIndex, which the reference's DefaultFileBasedRelation
+delegates partition discovery + pruning to. Here the same three concerns
+are explicit host-side functions:
+
+- discovery: parse ``key=value`` path segments under the relation root into
+  typed partition fields (int64 if every value parses as an integer, date
+  for ISO dates, string otherwise);
+- materialization: partition columns are not in the data files — they are
+  attached per file as constant device columns at scan/build time;
+- pruning: partition-column conjuncts are evaluated per file at planning
+  time (always on, like Spark's native partition pruning — not gated on
+  hyperspace being enabled).
+"""
+
+from __future__ import annotations
+
+import datetime
+import os
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..plan import expr as E
+from ..schema import DATE, INT64, STRING, Field
+
+HIVE_DEFAULT_PARTITION = "__HIVE_DEFAULT_PARTITION__"
+
+
+def partition_segments(base: str, path: str) -> List[Tuple[str, str]]:
+    """(key, raw value) pairs from the path's directory levels under base."""
+    rel = os.path.relpath(os.path.dirname(os.path.abspath(path)),
+                          os.path.abspath(base))
+    out: List[Tuple[str, str]] = []
+    if rel in (".", ""):
+        return out
+    for seg in rel.split(os.sep):
+        if "=" in seg:
+            k, _, v = seg.partition("=")
+            out.append((k, v))
+    return out
+
+
+def infer_partition_fields(base: str, files: Sequence[str]
+                           ) -> List[Field]:
+    """Discover a consistent partition schema from the file paths, or []
+    when the layout isn't hive-partitioned (no key=value levels, or
+    inconsistent keys across files)."""
+    keys: Optional[List[str]] = None
+    values_by_key: Dict[str, List[str]] = {}
+    for f in files:
+        segs = partition_segments(base, f)
+        ks = [k for k, _ in segs]
+        if keys is None:
+            keys = ks
+        elif ks != keys:
+            return []  # inconsistent layout → not partition-aware
+        for k, v in segs:
+            values_by_key.setdefault(k, []).append(v)
+    if not keys:
+        return []
+    fields = []
+    for k in keys:
+        fields.append(Field(k, _infer_dtype(values_by_key[k]), False))
+    return fields
+
+
+def _infer_dtype(raw_values: Sequence[str]) -> str:
+    def is_int(v):
+        try:
+            int(v)
+            return True
+        except ValueError:
+            return False
+
+    def is_date(v):
+        try:
+            datetime.date.fromisoformat(v)
+            return True
+        except ValueError:
+            return False
+
+    vals = [v for v in raw_values if v != HIVE_DEFAULT_PARTITION]
+    if vals and all(is_int(v) for v in vals):
+        return INT64
+    if vals and all(is_date(v) for v in vals):
+        return DATE
+    return STRING
+
+
+def partition_value(raw: str, dtype: str):
+    if raw == HIVE_DEFAULT_PARTITION:
+        return None
+    if dtype == INT64:
+        return int(raw)
+    if dtype == DATE:
+        return datetime.date.fromisoformat(raw)
+    return raw
+
+
+def file_partition_values(base: str, path: str, fields: Sequence[Field]):
+    by_key = dict(partition_segments(base, path))
+    return tuple(partition_value(by_key[f.name], f.dtype) for f in fields)
+
+
+def attach_partition_columns(table, relation, files: Sequence[str],
+                             wanted: Sequence[Field],
+                             row_counts: Sequence[int]):
+    """Append constant-per-file partition columns to a device table read
+    from ``files`` (row_counts rows each, concatenated in order)."""
+    from ..execution.columnar import Column
+
+    base = relation.partition_base_path
+    counts = np.asarray(row_counts, dtype=np.int64)
+    for f in wanted:
+        per_file = [file_partition_values(base, p, [f])[0] for p in files]
+        if f.dtype == STRING:
+            uniq = sorted({v for v in per_file if v is not None})
+            dictionary = np.array(uniq, dtype=str) if uniq else \
+                np.array([], dtype=str)
+            codes = np.array([np.searchsorted(dictionary, v) if v is not None
+                              else -1 for v in per_file], np.int32)
+            data = np.repeat(codes, counts)
+            validity = None
+            if any(v is None for v in per_file):
+                validity = jnp.asarray(np.repeat(
+                    np.array([v is not None for v in per_file]), counts))
+            col = Column(STRING, jnp.asarray(data), validity, dictionary)
+        else:
+            if f.dtype == DATE:
+                epoch = datetime.date(1970, 1, 1)
+                nums = [(v - epoch).days if v is not None else 0
+                        for v in per_file]
+                np_dtype = np.int32
+            else:
+                nums = [v if v is not None else 0 for v in per_file]
+                np_dtype = np.int64
+            data = np.repeat(np.asarray(nums, np_dtype), counts)
+            validity = None
+            if any(v is None for v in per_file):
+                validity = jnp.asarray(np.repeat(
+                    np.array([v is not None for v in per_file]), counts))
+            col = Column(f.dtype, jnp.asarray(data), validity)
+        table = table.with_column(f.name, col)
+    return table
+
+
+def read_relation_files(relation, files: Sequence[str],
+                        cols: Optional[Sequence[str]], fmt: str,
+                        filters=None):
+    """Read ``files`` with partition columns attached (the single reader
+    shared by the scan executor and the index build). Non-partitioned
+    relations delegate straight to the columnar reader."""
+    from ..execution.columnar import (parquet_row_counts, read_parquet)
+
+    fields = getattr(relation, "partition_fields", lambda: [])()
+    part_names = {f.name for f in fields}
+    if not fields or (cols is not None
+                      and not any(c in part_names for c in cols)):
+        return read_parquet(files, cols, fmt, filters=filters)
+    wanted = fields if cols is None else \
+        [f for f in fields if f.name in cols]
+    phys_cols = None if cols is None else \
+        [c for c in cols if c not in part_names]
+    if phys_cols is not None and not phys_cols:
+        phys = [n for n in relation.schema.names if n not in part_names]
+        phys_cols = [phys[0]] if phys else None
+    if fmt == "parquet":
+        # One bulk read; per-file row counts come from the footers. The
+        # parquet-level filter is skipped (it would skew the counts);
+        # partition pruning has already narrowed the file list.
+        table = read_parquet(files, phys_cols, fmt)
+        counts = parquet_row_counts(files)
+        out = attach_partition_columns(table, relation, files, wanted,
+                                       counts)
+    else:
+        # Non-parquet: per-file reads so counts are known.
+        from ..execution.columnar import Table
+        parts = []
+        for f in files:
+            t = read_parquet([f], phys_cols, fmt)
+            parts.append(attach_partition_columns(t, relation, [f], wanted,
+                                                  [t.num_rows]))
+        out = Table.concat(parts)
+    if cols is not None:
+        # Drop the dummy physical column read only for its row count (a
+        # partition-columns-only projection would otherwise leak it, e.g.
+        # into index files).
+        out = out.select([c for c in cols if c in out.names])
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Planning-time pruning (always on, like Spark's native partition pruning).
+# ---------------------------------------------------------------------------
+
+def prune_partitions(plan):
+    """Narrow Filter-over-Scan leaves of partition-aware relations to the
+    files whose partition values can satisfy the filter."""
+    from ..plan.nodes import Filter, Scan
+
+    def rewrite(node):
+        if isinstance(node, Filter) and isinstance(node.child, Scan):
+            kept = _pruned_files(node.child.relation, node.condition)
+            if kept is not None:
+                return Filter(node.condition,
+                              Scan(node.child.relation.with_files(kept)))
+        return node
+
+    return plan.transform_up(rewrite)
+
+
+def _pruned_files(relation, condition) -> Optional[List[str]]:
+    fields = getattr(relation, "partition_fields", lambda: [])()
+    if not fields:
+        return None
+    by_name = {f.name: f for f in fields}
+    files = relation.all_files()
+    base = relation.partition_base_path
+    keep = np.ones(len(files), dtype=bool)
+    pruned_any = False
+    for conjunct in E.split_conjunctive_predicates(condition):
+        verdict = _eval_partition_predicate(conjunct, by_name, base, files)
+        if verdict is not None:
+            keep &= verdict
+            pruned_any = True
+    if not pruned_any or keep.all():
+        return None
+    return [f for f, k in zip(files, keep) if k]
+
+
+_FLIP = {"EqualTo": "EqualTo", "LessThan": "GreaterThan",
+         "LessThanOrEqual": "GreaterThanOrEqual",
+         "GreaterThan": "LessThan",
+         "GreaterThanOrEqual": "LessThanOrEqual"}
+
+
+def _eval_partition_predicate(e, by_name, base, files
+                              ) -> Optional[np.ndarray]:
+    """Per-file keep mask for one conjunct over partition columns only;
+    None = not a partition predicate (no pruning from this conjunct)."""
+    if isinstance(e, E.Or):
+        l = _eval_partition_predicate(e.left, by_name, base, files)
+        r = _eval_partition_predicate(e.right, by_name, base, files)
+        if l is None or r is None:
+            return None
+        return l | r
+    if isinstance(e, E.In) and isinstance(e.value, E.Col) \
+            and e.value.column in by_name \
+            and all(isinstance(o, E.Lit) for o in e.options):
+        field = by_name[e.value.column]
+        wanted = {_norm(o.value, field.dtype) for o in e.options}
+        vals = _column_values(field, base, files)
+        return np.array([v in wanted for v in vals])
+    if isinstance(e, (E.EqualTo, E.LessThan, E.LessThanOrEqual,
+                      E.GreaterThan, E.GreaterThanOrEqual)):
+        left, right = e.left, e.right
+        op = type(e).__name__
+        if isinstance(left, E.Lit) and isinstance(right, E.Col):
+            left, right = right, left
+            op = _FLIP[op]
+        if not (isinstance(left, E.Col) and isinstance(right, E.Lit)
+                and left.column in by_name):
+            return None
+        field = by_name[left.column]
+        lit = _norm(right.value, field.dtype)
+        vals = _column_values(field, base, files)
+        out = np.zeros(len(files), dtype=bool)
+        for i, v in enumerate(vals):
+            if v is None:
+                continue  # null partition never matches a comparison
+            if op == "EqualTo":
+                out[i] = v == lit
+            elif op == "LessThan":
+                out[i] = v < lit
+            elif op == "LessThanOrEqual":
+                out[i] = v <= lit
+            elif op == "GreaterThan":
+                out[i] = v > lit
+            elif op == "GreaterThanOrEqual":
+                out[i] = v >= lit
+        return out
+    return None
+
+
+def _norm(value, dtype: str):
+    if dtype == DATE and isinstance(value, str):
+        return datetime.date.fromisoformat(value)
+    if dtype == INT64 and not isinstance(value, bool):
+        # A fractional literal must NOT be truncated (int(5.5) == 5 would
+        # wrongly prune year=5 from `year < 5.5`): int/float comparisons
+        # are exact enough in Python, so keep the float.
+        if isinstance(value, float):
+            return int(value) if value.is_integer() else value
+        try:
+            return int(value)
+        except (TypeError, ValueError):
+            return value
+    return value
+
+
+def _column_values(field: Field, base: str, files: Sequence[str]):
+    return [file_partition_values(base, f, [field])[0] for f in files]

@@ -314,3 +314,22 @@ class TestFastPathCorrectness:
         li = env["lineitem"]
         assert len(out) == (li.l_orderkey == 42).sum()
         session.conf.set(IndexConstants.INDEX_FILTER_RULE_USE_BUCKET_SPEC, "false")
+
+
+class TestIndexScanProjection:
+    def test_bare_filter_query_has_no_phantom_columns(self, env):
+        """Index files live under v__=<n>/ — pyarrow hive-infers a phantom
+        v__ column when read without an explicit column list; a bare
+        (projection-less) rewritten query must not leak it."""
+        session, hs = env["session"], env["hs"]
+        hs.create_index(session.read.parquet(env["li_path"]),
+                        IndexConfig("bareIdx", ["l_orderkey"],
+                                    ["l_quantity", "l_extendedprice",
+                                     "l_discount", "l_shipdate"]))
+        session.enable_hyperspace()
+        q = session.read.parquet(env["li_path"]).filter(col("l_orderkey") == 7)
+        assert uses_index(q, "bareIdx")
+        out = q.to_pandas()
+        assert "v__" not in out.columns
+        assert sorted(out.columns) == sorted(env["lineitem"].columns)
+        check_disable_and_compare(session, q)

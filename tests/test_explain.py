@@ -1,0 +1,81 @@
+"""Explain fidelity (VERDICT r2 #10; parity: PlanAnalyzer.scala:36-120 +
+DisplayMode.scala): lockstep diff highlighting changed subtrees, display
+modes, used-index listing, operator-count diff."""
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+import hyperspace_tpu as hst
+from hyperspace_tpu.api import Hyperspace, IndexConfig
+from hyperspace_tpu.index.constants import IndexConstants
+from hyperspace_tpu.plan.expr import col
+
+
+@pytest.fixture()
+def env(tmp_path):
+    rng = np.random.default_rng(70)
+    df = pd.DataFrame({
+        "k": rng.integers(0, 100, 1000).astype(np.int64),
+        "v": rng.integers(0, 10, 1000).astype(np.int64),
+        "w": np.round(rng.uniform(0, 1, 1000), 4),
+    })
+    d = tmp_path / "data"
+    d.mkdir()
+    pq.write_table(pa.Table.from_pandas(df), d / "part0.parquet")
+    session = hst.Session(system_path=str(tmp_path / "indexes"))
+    session.conf.set(IndexConstants.INDEX_NUM_BUCKETS, 4)
+    hs = Hyperspace(session)
+    hs.create_index(session.read.parquet(str(d)),
+                    IndexConfig("expIdx", ["k"], ["v"]))
+    q = session.read.parquet(str(d)).filter(col("k") == 5).select("k", "v")
+    return dict(session=session, hs=hs, q=q)
+
+
+class TestExplain:
+    def test_plaintext_structure(self, env):
+        text = env["hs"].explain(env["q"])
+        assert "Plan with indexes:" in text
+        assert "Plan without indexes:" in text
+        assert "Indexes used:" in text
+        assert "expIdx" in text
+        # Changed-subtree highlighting is absent in plaintext (no tags).
+        assert "\033[" not in text and "<b>" not in text
+
+    def test_console_highlights_changed_subtree(self, env):
+        text = env["hs"].explain(env["q"], mode="console")
+        assert "\033[93m" in text and "\033[0m" in text
+        # The changed leaf (IndexScan on one side, Scan on the other) is
+        # highlighted; the unchanged Project/Filter headers are not.
+        hi_lines = [l for l in text.splitlines() if "\033[93m" in l]
+        assert any("IndexScan" in l for l in hi_lines)
+        assert any("Scan" in l for l in hi_lines)
+        assert not any(l.strip().startswith("\033[93mProject")
+                       for l in hi_lines)
+
+    def test_html_mode(self, env):
+        text = env["hs"].explain(env["q"], mode="html")
+        assert text.startswith("<pre>") and text.endswith("</pre>")
+        assert "<br>" in text and "<b>" in text
+
+    def test_verbose_operator_counts(self, env):
+        text = env["hs"].explain(env["q"], verbose=True)
+        assert "Physical operator stats:" in text
+        assert "IndexScan: 0 -> 1" in text
+        assert "Scan: 1 -> 0" in text
+
+    def test_no_rewrite_no_highlight(self, env):
+        session = env["session"]
+        # Query the index can't cover → identical plans, nothing marked.
+        q = session.read.parquet(
+            env["q"].plan.children[0].children[0].relation.root_paths[0]) \
+            .filter(col("w") > 0.5).select("k", "w")
+        text = env["hs"].explain(q, mode="console")
+        assert "\033[93m" not in text
+        assert "<none>" in text
+
+    def test_unknown_mode_raises(self, env):
+        with pytest.raises(Exception):
+            env["hs"].explain(env["q"], mode="nope")

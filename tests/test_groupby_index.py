@@ -1,0 +1,104 @@
+"""GroupByIndexRule + bucket-order sort-skip (the Q17 optimization).
+
+The rule rewrites an unfiltered group-by to scan a covering index whose
+indexed columns equal the grouping keys; the executor then skips the
+group-by sort because bucket order makes equal key tuples contiguous
+(executor.GROUPBY_SORT_SKIPPED). Oracle: disable-and-compare.
+"""
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+import hyperspace_tpu as hst
+from hyperspace_tpu.api import Hyperspace, IndexConfig
+from hyperspace_tpu.execution import executor
+from hyperspace_tpu.index.constants import IndexConstants
+from hyperspace_tpu.plan.expr import avg, col, count, sum_
+from hyperspace_tpu.plan.nodes import IndexScan
+
+
+@pytest.fixture()
+def env(tmp_path):
+    rng = np.random.default_rng(77)
+    n = 5000
+    df = pd.DataFrame({
+        "pk": rng.integers(0, 200, n).astype(np.int64),
+        "qty": rng.integers(1, 50, n).astype(np.int64),
+        "price": np.round(rng.uniform(10, 1000, n), 2),
+        "other": rng.integers(0, 5, n).astype(np.int64),
+    })
+    d = tmp_path / "data"
+    d.mkdir()
+    for i in range(2):
+        pq.write_table(pa.Table.from_pandas(
+            df.iloc[i * (n // 2):(i + 1) * (n // 2)].reset_index(drop=True)),
+            d / f"part{i}.parquet")
+    session = hst.Session(system_path=str(tmp_path / "indexes"))
+    session.conf.set(IndexConstants.INDEX_NUM_BUCKETS, 8)
+    # Single-device comparison: the sort-skip is a single-device fast path
+    # (the SPMD aggregate path shards and re-sorts per device regardless).
+    session.conf.set(IndexConstants.TPU_DISTRIBUTED_ENABLED, "false")
+    hs = Hyperspace(session)
+    hs.create_index(session.read.parquet(str(d)),
+                    IndexConfig("gIdx", ["pk"], ["qty", "price"]))
+    return dict(session=session, hs=hs, path=str(d), df=df)
+
+
+class TestGroupByIndexRule:
+    def test_unfiltered_groupby_rewrites_and_skips_sort(self, env):
+        session = env["session"]
+        session.enable_hyperspace()
+        q = session.read.parquet(env["path"]).group_by("pk").agg(
+            avg(col("qty")).alias("aq"), sum_(col("price")).alias("sp"))
+        plan = q.optimized_plan()
+        assert any(isinstance(l, IndexScan) and l.index_entry.name == "gIdx"
+                   for l in plan.collect_leaves()), "group-by rewrite missing"
+        before = executor.GROUPBY_SORT_SKIPPED
+        got = q.to_pandas()
+        assert executor.GROUPBY_SORT_SKIPPED > before, "sort was not skipped"
+        session.disable_hyperspace()
+        exp = q.to_pandas()
+        pd.testing.assert_frame_equal(
+            got.sort_values("pk").reset_index(drop=True),
+            exp.sort_values("pk").reset_index(drop=True), check_dtype=False)
+
+    def test_groupby_with_filter_still_skips(self, env):
+        """Filters above the index scan keep bucket order, so a filtered
+        group-by on the indexed key also skips its sort."""
+        session = env["session"]
+        session.enable_hyperspace()
+        q = (session.read.parquet(env["path"])
+             .filter(col("qty") > 10).group_by("pk")
+             .agg(count(None).alias("n")))
+        before = executor.GROUPBY_SORT_SKIPPED
+        got = q.to_pandas()
+        assert executor.GROUPBY_SORT_SKIPPED > before
+        session.disable_hyperspace()
+        exp = q.to_pandas()
+        pd.testing.assert_frame_equal(
+            got.sort_values("pk").reset_index(drop=True),
+            exp.sort_values("pk").reset_index(drop=True), check_dtype=False)
+
+    def test_uncovered_agg_column_not_rewritten(self, env):
+        session = env["session"]
+        session.enable_hyperspace()
+        q = session.read.parquet(env["path"]).group_by("pk").agg(
+            sum_(col("other")).alias("so"))  # 'other' not covered
+        assert not any(isinstance(l, IndexScan)
+                       for l in q.optimized_plan().collect_leaves())
+        # Still correct via the source scan.
+        got = q.to_pandas()
+        exp = env["df"].groupby("pk").agg(so=("other", "sum")).reset_index()
+        g = got.sort_values("pk").reset_index(drop=True)
+        assert np.array_equal(g["so"].to_numpy(), exp["so"].to_numpy())
+
+    def test_group_key_mismatch_not_rewritten(self, env):
+        session = env["session"]
+        session.enable_hyperspace()
+        q = session.read.parquet(env["path"]).group_by("other").agg(
+            count(None).alias("n"))
+        assert not any(isinstance(l, IndexScan)
+                       for l in q.optimized_plan().collect_leaves())
