@@ -430,6 +430,10 @@ def _run_mesh_phase(scale: float, timeout_s: float) -> None:
         for k in ("n_devices", "dist_build_rows_per_s", "spmd_q3_speedup"):
             if k in mesh:
                 RESULT[k] = mesh[k]
+        # Bubble child-phase errors up to the bench's own error channel —
+        # a clean-looking run must not hide "mesh path not taken".
+        for e in mesh.get("errors", []):
+            RESULT["errors"].append(f"mesh phase: {e}")
     else:
         RESULT["errors"].append(
             f"mesh phase rc={out.returncode}; stderr tail={_tail(out.stderr)}")

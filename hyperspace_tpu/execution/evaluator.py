@@ -184,10 +184,15 @@ def compare_literal(col: Column, op: str, value) -> jnp.ndarray:
         raise HyperspaceException(f"Unknown op {op}")
     lit = literal_to_device(value, col.dtype, None)
     data = col.data
-    # 32-bit lanes: one-pass fused Pallas compare on TPU.
+    # 32-bit lanes: one-pass fused Pallas compare on TPU. A fractional
+    # literal against an int column must NOT enter the fused kernel (it
+    # casts the literal to the column dtype, truncating 5.5 → 5); the jnp
+    # path below promotes the column instead.
     from ..ops import pallas_kernels
     if (pallas_kernels.enabled() and data.shape[0] > 0
-            and data.dtype in (jnp.int32, jnp.float32, jnp.uint32)):
+            and data.dtype in (jnp.int32, jnp.float32, jnp.uint32)
+            and not (jnp.issubdtype(data.dtype, jnp.integer)
+                     and not isinstance(lit, (int, bool)))):
         sym = {"EqualTo": "==", "LessThan": "<", "LessThanOrEqual": "<=",
                "GreaterThan": ">", "GreaterThanOrEqual": ">="}[op]
         return pallas_kernels.fused_compare_mask(data, sym, lit)

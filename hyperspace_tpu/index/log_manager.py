@@ -52,10 +52,23 @@ class IndexLogManager:
         latest = self.get_latest_id()
         return self.get_log(latest) if latest is not None else None
 
+    def _get_log_lenient(self, log_id: int) -> Optional[IndexLogEntry]:
+        """get_log that treats an unparseable entry (torn write from a
+        crash mid-rename window) as absent — only the recovery scan may be
+        this forgiving; normal reads should surface corruption."""
+        try:
+            return self.get_log(log_id)
+        except (ValueError, KeyError, TypeError):
+            return None
+
     def get_latest_stable_log(self) -> Optional[IndexLogEntry]:
         """Latest entry in a STABLE state; falls back to a backward scan past a
-        broken tail (reference: IndexLogManager.scala:93-117)."""
-        log = self._get_log_at(self._latest_stable_path)
+        broken tail — including an unparseable (torn) tail entry
+        (reference: IndexLogManager.scala:93-117)."""
+        try:
+            log = self._get_log_at(self._latest_stable_path)
+        except (ValueError, KeyError, TypeError):
+            log = None
         if log is not None and log.state not in STABLE_STATES:
             # A stale/invalid latestStable (e.g. crash between write_log and
             # create_latest_stable_log); fall back to the backward scan.
@@ -64,7 +77,7 @@ class IndexLogManager:
             latest = self.get_latest_id()
             if latest is not None:
                 for log_id in range(latest, -1, -1):
-                    entry = self.get_log(log_id)
+                    entry = self._get_log_lenient(log_id)
                     if entry is not None and entry.state in STABLE_STATES:
                         return entry
                     if entry is not None and entry.state in (

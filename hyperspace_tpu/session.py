@@ -96,17 +96,20 @@ class Session:
     # ------------------------------------------------------------------
 
     def optimize(self, plan: LogicalPlan) -> LogicalPlan:
-        """General optimizations (column pruning, partition pruning — both
-        always on, like Spark's own optimizer), then the hyperspace rewrite
-        batch if enabled."""
+        """General optimizations (column pruning), the hyperspace rewrite
+        batch if enabled, then partition pruning. Partition pruning is
+        always on (like Spark's native pruning) but must run AFTER the
+        index rules: it narrows a Scan's file list, and the index rules
+        fingerprint the relation's full file listing — pruning first would
+        mismatch every index signature (same ordering rule as the
+        data-skipping rule inside the batch)."""
         from .rules.column_pruning import prune_columns
         from .sources.partitions import prune_partitions
         plan = prune_columns(plan)
-        plan = prune_partitions(plan)
-        if not self._hyperspace_enabled:
-            return plan
-        from .rules.apply_hyperspace import apply_hyperspace
-        return apply_hyperspace(self, plan)
+        if self._hyperspace_enabled:
+            from .rules.apply_hyperspace import apply_hyperspace
+            plan = apply_hyperspace(self, plan)
+        return prune_partitions(plan)
 
     def execute(self, plan: LogicalPlan):
         from .execution import execute as run

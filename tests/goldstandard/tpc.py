@@ -198,4 +198,74 @@ def queries(dfs):
                         col("l_extendedprice")),
               on=col("l_orderkey") == col("r_orderkey")))
 
+    # TPC-H Q14-lite: promotion effect — date filter + part join.
+    q["tpch_q14"] = (
+        li.filter(col("l_shipdate").between(d(1995, 9, 1), d(1995, 9, 30)))
+        .join(pt, on=col("l_partkey") == col("p_partkey"))
+        .group_by("p_brand")
+        .agg(sum_(col("l_extendedprice") * (1 - col("l_discount")))
+             .alias("revenue"))
+        .sort("p_brand"))
+
+    # TPC-H Q17 shape: small-quantity avg subquery + rejoin (exercises the
+    # group-by index rewrite + sort-skip path).
+    thr = (li.group_by("l_partkey")
+           .agg(avg(col("l_quantity")).alias("avg_qty"))
+           .select(col("l_partkey").alias("t_partkey"),
+                   (col("avg_qty") * 0.2).alias("qty_thr")))
+    q["tpch_q17"] = (
+        li.join(pt.filter((col("p_brand") == "Brand#23")
+                          & (col("p_container") == "MED BOX")),
+                on=col("l_partkey") == col("p_partkey"))
+        .join(thr, on=col("l_partkey") == col("t_partkey"))
+        .filter(col("l_quantity") < col("qty_thr"))
+        .agg(sum_(col("l_extendedprice")).alias("price_sum")))
+
+    # TPC-H Q18-lite: large-volume customers (group HAVING-ish shape via
+    # join on the aggregated keys).
+    big = (li.group_by("l_orderkey")
+           .agg(sum_(col("l_quantity")).alias("total_qty"))
+           .filter(col("total_qty") > 150)
+           .select(col("l_orderkey").alias("b_orderkey"), "total_qty"))
+    q["tpch_q18"] = (
+        od.join(big, on=col("o_orderkey") == col("b_orderkey"))
+        .select("o_orderkey", "o_orderdate", "o_totalprice", "total_qty")
+        .sort(("o_totalprice", False), "o_orderdate").limit(20))
+
+    # TPC-H Q19-lite: OR-of-ANDs part/brand predicate after the join.
+    q["tpch_q19"] = (
+        li.join(pt, on=col("l_partkey") == col("p_partkey"))
+        .filter(((col("p_brand") == "Brand#11")
+                 & (col("p_container") == "SM BOX")
+                 & (col("l_quantity") <= 15))
+                | ((col("p_brand") == "Brand#45")
+                   & (col("p_container") == "LG BOX")
+                   & (col("l_quantity") >= 10)))
+        .agg(sum_(col("l_extendedprice") * (1 - col("l_discount")))
+             .alias("revenue")))
+
+    # Unfiltered group-by over an indexed key: the GroupByIndexRule shape.
+    q["groupby_index"] = (
+        li.group_by("l_partkey")
+        .agg(avg(col("l_quantity")).alias("aq"),
+             count(None).alias("n"))
+        .sort("l_partkey").limit(15))
+
+    # TPC-DS Q3-like: date_dim ⋈ store_returns with month filter.
+    q["tpcds_q3_like"] = (
+        sr.join(dd.filter((col("d_year") == 2001) & (col("d_moy") == 11)),
+                on=col("sr_returned_date_sk") == col("d_date_sk"))
+        .group_by("sr_store_sk")
+        .agg(sum_(col("sr_return_amt")).alias("ret"),
+             count(None).alias("n"))
+        .sort("sr_store_sk"))
+
+    # Multi-key join (exercises the dense-rank / packed-composite path).
+    q["multi_key_join"] = (
+        sr.join(dfs["store"], on=col("sr_store_sk") == col("s_store_sk"))
+        .join(cu, on=col("sr_customer_sk") == col("c_customer_sk"))
+        .group_by("s_state")
+        .agg(sum_(col("sr_return_amt")).alias("ret"))
+        .sort("s_state"))
+
     return q

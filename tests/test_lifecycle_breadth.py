@@ -1,0 +1,341 @@
+"""Index lifecycle breadth (VERDICT r2 #8; parity: IndexManagerTest.scala,
+821 LoC): every state transition, invalid transitions per state, refresh
+modes against source mutations, optimize modes, cancel recovery, version
+accumulation, and multi-index independence.
+"""
+
+import os
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+import hyperspace_tpu as hst
+from hyperspace_tpu.api import Hyperspace, IndexConfig
+from hyperspace_tpu.exceptions import HyperspaceException
+from hyperspace_tpu.index.constants import IndexConstants, States
+from hyperspace_tpu.index.log_manager import IndexLogManager
+from hyperspace_tpu.plan.expr import col
+from hyperspace_tpu.plan.nodes import IndexScan
+
+
+def write_sample(root, name, df, parts=3):
+    d = root / name
+    d.mkdir(parents=True, exist_ok=True)
+    step = max(1, len(df) // parts)
+    for i in range(parts):
+        chunk = df.iloc[i * step:(i + 1) * step if i < parts - 1 else len(df)]
+        pq.write_table(pa.Table.from_pandas(chunk.reset_index(drop=True)),
+                       d / f"part{i}.parquet")
+    return str(d)
+
+
+def make_df(n=600, seed=0):
+    rng = np.random.default_rng(seed)
+    return pd.DataFrame({
+        "k": rng.integers(0, 100, n).astype(np.int64),
+        "v": rng.integers(0, 1000, n).astype(np.int64),
+    })
+
+
+@pytest.fixture()
+def env(tmp_path):
+    path = write_sample(tmp_path, "data", make_df())
+    session = hst.Session(system_path=str(tmp_path / "indexes"))
+    session.conf.set(IndexConstants.INDEX_NUM_BUCKETS, 4)
+    hs = Hyperspace(session)
+    return dict(session=session, hs=hs, path=path, tmp=tmp_path)
+
+
+def state_of(env, name):
+    rows = env["hs"].indexes()
+    row = rows[rows["name"] == name]
+    return row.iloc[0]["state"] if len(row) else None
+
+
+def log_mgr(env, name) -> IndexLogManager:
+    return IndexLogManager(os.path.join(str(env["tmp"] / "indexes"), name))
+
+
+class TestStateMachine:
+    def test_full_lifecycle_walk(self, env):
+        """ACTIVE → DELETED → ACTIVE → DELETED → DOESNOTEXIST."""
+        hs, session = env["hs"], env["session"]
+        df = session.read.parquet(env["path"])
+        hs.create_index(df, IndexConfig("walk", ["k"], ["v"]))
+        assert state_of(env, "walk") == States.ACTIVE
+        hs.delete_index("walk")
+        assert state_of(env, "walk") == States.DELETED
+        hs.restore_index("walk")
+        assert state_of(env, "walk") == States.ACTIVE
+        hs.delete_index("walk")
+        hs.vacuum_index("walk")
+        assert state_of(env, "walk") in (States.DOESNOTEXIST, None)
+        # Version data dirs are gone after vacuum.
+        idx_dir = str(env["tmp"] / "indexes" / "walk")
+        assert not [d for d in os.listdir(idx_dir) if d.startswith("v__=")]
+
+    def test_recreate_after_vacuum(self, env):
+        hs, session = env["hs"], env["session"]
+        df = session.read.parquet(env["path"])
+        hs.create_index(df, IndexConfig("reuse", ["k"], ["v"]))
+        hs.delete_index("reuse")
+        hs.vacuum_index("reuse")
+        hs.create_index(df, IndexConfig("reuse", ["k"], ["v"]))
+        assert state_of(env, "reuse") == States.ACTIVE
+        session.enable_hyperspace()
+        q = df.filter(col("k") == 5).select("k", "v")
+        assert any(isinstance(l, IndexScan)
+                   for l in q.optimized_plan().collect_leaves())
+
+    def test_invalid_transitions_raise(self, env):
+        hs, session = env["hs"], env["session"]
+        df = session.read.parquet(env["path"])
+        hs.create_index(df, IndexConfig("inv", ["k"], ["v"]))
+        # restore on ACTIVE
+        with pytest.raises(HyperspaceException):
+            hs.restore_index("inv")
+        # vacuum on ACTIVE
+        with pytest.raises(HyperspaceException):
+            hs.vacuum_index("inv")
+        hs.delete_index("inv")
+        # delete on DELETED
+        with pytest.raises(HyperspaceException):
+            hs.delete_index("inv")
+        # refresh on DELETED
+        with pytest.raises(HyperspaceException):
+            hs.refresh_index("inv", "full")
+        # optimize on DELETED
+        with pytest.raises(HyperspaceException):
+            hs.optimize_index("inv", "quick")
+
+    def test_ops_on_missing_index_raise(self, env):
+        hs = env["hs"]
+        for op in (lambda: hs.delete_index("ghost"),
+                   lambda: hs.restore_index("ghost"),
+                   lambda: hs.vacuum_index("ghost"),
+                   lambda: hs.refresh_index("ghost", "full"),
+                   lambda: hs.optimize_index("ghost", "quick")):
+            with pytest.raises(HyperspaceException):
+                op()
+
+    def test_deleted_index_not_used_in_rewrite(self, env):
+        hs, session = env["hs"], env["session"]
+        df = session.read.parquet(env["path"])
+        hs.create_index(df, IndexConfig("hide", ["k"], ["v"]))
+        session.enable_hyperspace()
+        q = df.filter(col("k") == 1).select("k", "v")
+        assert any(isinstance(l, IndexScan)
+                   for l in q.optimized_plan().collect_leaves())
+        hs.delete_index("hide")
+        assert not any(isinstance(l, IndexScan)
+                       for l in q.optimized_plan().collect_leaves())
+        hs.restore_index("hide")
+        assert any(isinstance(l, IndexScan)
+                   for l in q.optimized_plan().collect_leaves())
+
+
+class TestCancelRecovery:
+    def _wedge(self, env, name, state):
+        """Simulate a crash: append a transient-state entry by hand."""
+        mgr = log_mgr(env, name)
+        latest = mgr.get_latest_log()
+        wedged = latest.with_state(state) if hasattr(latest, "with_state") \
+            else None
+        if wedged is None:
+            import copy
+            wedged = copy.deepcopy(latest)
+            wedged.state = state
+        assert mgr.write_log(mgr.get_latest_id() + 1, wedged)
+
+    def test_cancel_restores_last_stable(self, env):
+        hs, session = env["hs"], env["session"]
+        df = session.read.parquet(env["path"])
+        hs.create_index(df, IndexConfig("canc", ["k"], ["v"]))
+        self._wedge(env, "canc", States.REFRESHING)
+        hs.cancel("canc")
+        assert state_of(env, "canc") == States.ACTIVE
+        session.enable_hyperspace()
+        q = df.filter(col("k") == 2).select("k", "v")
+        assert any(isinstance(l, IndexScan)
+                   for l in q.optimized_plan().collect_leaves())
+
+    def test_cancel_on_stable_state_raises(self, env):
+        hs, session = env["hs"], env["session"]
+        df = session.read.parquet(env["path"])
+        hs.create_index(df, IndexConfig("canc2", ["k"], ["v"]))
+        with pytest.raises(HyperspaceException):
+            hs.cancel("canc2")
+
+    def test_wedged_index_not_used_until_cancel(self, env):
+        hs, session = env["hs"], env["session"]
+        df = session.read.parquet(env["path"])
+        hs.create_index(df, IndexConfig("wedge", ["k"], ["v"]))
+        self._wedge(env, "wedge", States.OPTIMIZING)
+        session.enable_hyperspace()
+        q = df.filter(col("k") == 3).select("k", "v")
+        assert not any(isinstance(l, IndexScan)
+                       for l in q.optimized_plan().collect_leaves())
+        hs.cancel("wedge")
+        assert any(isinstance(l, IndexScan)
+                   for l in q.optimized_plan().collect_leaves())
+
+
+class TestRefreshModes:
+    def _mutate_append(self, env, seed=9):
+        extra = make_df(120, seed=seed)
+        pq.write_table(pa.Table.from_pandas(extra),
+                       env["tmp"] / "data" / f"extra{seed}.parquet")
+        return extra
+
+    def test_full_refresh_after_append(self, env):
+        hs, session = env["hs"], env["session"]
+        df = session.read.parquet(env["path"])
+        hs.create_index(df, IndexConfig("rf", ["k"], ["v"]))
+        self._mutate_append(env)
+        hs.refresh_index("rf", "full")
+        session.enable_hyperspace()
+        q = session.read.parquet(env["path"]).filter(col("k") == 7) \
+            .select("k", "v")
+        assert any(isinstance(l, IndexScan)
+                   for l in q.optimized_plan().collect_leaves())
+        got = q.to_pandas()
+        session.disable_hyperspace()
+        exp = q.to_pandas()
+        pd.testing.assert_frame_equal(
+            got.sort_values(["k", "v"]).reset_index(drop=True),
+            exp.sort_values(["k", "v"]).reset_index(drop=True),
+            check_dtype=False)
+
+    def test_incremental_refresh_appends_only_new_files(self, env):
+        session = env["session"]
+        session.conf.set(IndexConstants.INDEX_LINEAGE_ENABLED, "true")
+        hs = env["hs"]
+        df = session.read.parquet(env["path"])
+        hs.create_index(df, IndexConfig("ri", ["k"], ["v"]))
+        v0_files = set(os.listdir(
+            str(env["tmp"] / "indexes" / "ri" / "v__=0")))
+        self._mutate_append(env)
+        hs.refresh_index("ri", "incremental")
+        # Incremental creates a NEW version dir holding only appended rows.
+        idx_dir = str(env["tmp"] / "indexes" / "ri")
+        versions = sorted(d for d in os.listdir(idx_dir)
+                          if d.startswith("v__="))
+        assert len(versions) >= 2
+        assert set(os.listdir(os.path.join(idx_dir, versions[0]))) == v0_files
+
+    def test_quick_refresh_is_metadata_only(self, env):
+        session = env["session"]
+        session.conf.set(IndexConstants.INDEX_HYBRID_SCAN_ENABLED, "true")
+        hs = env["hs"]
+        df = session.read.parquet(env["path"])
+        hs.create_index(df, IndexConfig("rq", ["k"], ["v"]))
+        idx_dir = str(env["tmp"] / "indexes" / "rq")
+        before = {d: set(os.listdir(os.path.join(idx_dir, d)))
+                  for d in os.listdir(idx_dir) if d.startswith("v__=")}
+        self._mutate_append(env)
+        hs.refresh_index("rq", "quick")
+        after = {d: set(os.listdir(os.path.join(idx_dir, d)))
+                 for d in os.listdir(idx_dir) if d.startswith("v__=")}
+        assert before == after  # no data written
+        entry = log_mgr(env, "rq").get_latest_stable_log()
+        assert entry.appended_files
+
+    def test_refresh_unknown_mode_raises(self, env):
+        hs, session = env["hs"], env["session"]
+        df = session.read.parquet(env["path"])
+        hs.create_index(df, IndexConfig("rm", ["k"], ["v"]))
+        with pytest.raises(HyperspaceException):
+            hs.refresh_index("rm", "sideways")
+
+
+class TestVersionsAndListing:
+    def test_versions_accumulate_across_operations(self, env):
+        hs, session = env["hs"], env["session"]
+        df = session.read.parquet(env["path"])
+        hs.create_index(df, IndexConfig("ver", ["k"], ["v"]))
+        mgr = log_mgr(env, "ver")
+        id_after_create = mgr.get_latest_id()
+        hs.delete_index("ver")
+        hs.restore_index("ver")
+        assert mgr.get_latest_id() > id_after_create
+        # Every commit is immutable history: old ids still readable.
+        for log_id in range(0, mgr.get_latest_id() + 1):
+            assert mgr.get_log(log_id) is not None
+
+    def test_listing_shows_multiple_indexes_with_states(self, env):
+        hs, session = env["hs"], env["session"]
+        df = session.read.parquet(env["path"])
+        hs.create_index(df, IndexConfig("lsA", ["k"], ["v"]))
+        hs.create_index(df, IndexConfig("lsB", ["v"], ["k"]))
+        hs.delete_index("lsB")
+        rows = hs.indexes()
+        states = dict(zip(rows["name"], rows["state"]))
+        assert states["lsA"] == States.ACTIVE
+        assert states["lsB"] == States.DELETED
+
+    def test_index_stats_surface(self, env):
+        hs, session = env["hs"], env["session"]
+        df = session.read.parquet(env["path"])
+        hs.create_index(df, IndexConfig("st", ["k"], ["v"]))
+        row = hs.index("st").iloc[0]
+        assert row["indexedColumns"] == ["k"]
+        assert row["numBuckets"] == 4
+
+    def test_operations_do_not_cross_indexes(self, env):
+        hs, session = env["hs"], env["session"]
+        df = session.read.parquet(env["path"])
+        hs.create_index(df, IndexConfig("indA", ["k"], ["v"]))
+        hs.create_index(df, IndexConfig("indB", ["v"], ["k"]))
+        hs.delete_index("indA")
+        assert state_of(env, "indB") == States.ACTIVE
+        hs.vacuum_index("indA")
+        session.enable_hyperspace()
+        q = df.filter(col("v") == 10).select("v", "k")
+        leaves = q.optimized_plan().collect_leaves()
+        assert any(isinstance(l, IndexScan)
+                   and l.index_entry.name == "indB" for l in leaves)
+
+
+class TestOptimizeModes:
+    def _fragmented_index(self, env, name):
+        """Incremental refreshes fragment bucket files across versions."""
+        session = env["session"]
+        session.conf.set(IndexConstants.INDEX_LINEAGE_ENABLED, "true")
+        hs = env["hs"]
+        df = session.read.parquet(env["path"])
+        hs.create_index(df, IndexConfig(name, ["k"], ["v"]))
+        for seed in (21, 22):
+            extra = make_df(100, seed=seed)
+            pq.write_table(pa.Table.from_pandas(extra),
+                           env["tmp"] / "data" / f"x{seed}.parquet")
+            hs.refresh_index(name, "incremental")
+        return df
+
+    def test_optimize_full_compacts_to_one_file_per_bucket(self, env):
+        hs, session = env["hs"], env["session"]
+        df = self._fragmented_index(env, "opt")
+        entry_before = log_mgr(env, "opt").get_latest_stable_log()
+        files_before = len(entry_before.content.files)
+        hs.optimize_index("opt", "full")
+        entry = log_mgr(env, "opt").get_latest_stable_log()
+        assert len(entry.content.files) <= files_before
+        by_bucket = {}
+        from hyperspace_tpu.ops.index_build import bucket_id_from_file
+        for f in entry.content.files:
+            b = bucket_id_from_file(f)
+            by_bucket.setdefault(b, []).append(f)
+        assert all(len(v) == 1 for v in by_bucket.values())
+        # Answers still correct.
+        session.enable_hyperspace()
+        q = session.read.parquet(env["path"]).filter(col("k") < 30) \
+            .select("k", "v")
+        got = q.to_pandas()
+        session.disable_hyperspace()
+        exp = q.to_pandas()
+        pd.testing.assert_frame_equal(
+            got.sort_values(["k", "v"]).reset_index(drop=True),
+            exp.sort_values(["k", "v"]).reset_index(drop=True),
+            check_dtype=False)

@@ -269,3 +269,26 @@ class TestPartitionPruningEdges:
         got = session.read.parquet(root).select("region", "year").to_pandas()
         assert sorted(got.columns) == ["region", "year"]
         assert len(got) == len(full)
+
+    def test_index_still_used_with_partition_filter(self, session, tmp_path):
+        """Partition pruning must not break index signatures: it runs
+        AFTER the rewrite batch, so an index query that ALSO filters on a
+        partition column keeps its index."""
+        root, full = write_partitioned(tmp_path)
+        hs = Hyperspace(session)
+        df = session.read.parquet(root)
+        hs.create_index(df, IndexConfig("bothIdx", ["id"],
+                                        ["amount", "region", "year"]))
+        session.enable_hyperspace()
+        q = df.filter((col("id") < 2000) & (col("region") == "emea")) \
+            .select("id", "amount", "region")
+        leaves = q.optimized_plan().collect_leaves()
+        assert any(isinstance(l, IndexScan) and l.index_entry.name == "bothIdx"
+                   for l in leaves), "partition filter killed the index"
+        got = q.to_pandas()
+        session.disable_hyperspace()
+        exp = q.to_pandas()
+        key = ["id", "amount", "region"]
+        pd.testing.assert_frame_equal(
+            got.sort_values(key).reset_index(drop=True),
+            exp.sort_values(key).reset_index(drop=True), check_dtype=False)

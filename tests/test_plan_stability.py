@@ -65,7 +65,10 @@ def _check(mode: str, name: str, plan_str: str):
 
 
 @pytest.mark.parametrize("name", ["tpch_q1", "tpch_q3", "tpch_q6", "tpch_q12",
-                                  "tpcds_q1_like", "self_join"])
+                                  "tpch_q14", "tpch_q17", "tpch_q18",
+                                  "tpch_q19", "tpcds_q1_like",
+                                  "tpcds_q3_like", "groupby_index",
+                                  "multi_key_join", "self_join"])
 class TestPlanStability:
     def test_disabled(self, harness, name):
         session, queries = harness
@@ -109,7 +112,12 @@ class TestExpectedRewrites:
     the rewrite surface, independent of the golden text."""
 
     EXPECT = {"tpch_q1": False, "tpch_q3": True, "tpch_q6": True,
-              "tpch_q12": False, "tpcds_q1_like": False, "self_join": True}
+              "tpch_q12": False, "tpch_q14": False,
+              "tpch_q17": True,  # group-by index on l_partkey (avg subquery)
+              "tpch_q18": False, "tpch_q19": False,
+              "tpcds_q1_like": False, "tpcds_q3_like": False,
+              "groupby_index": True, "multi_key_join": False,
+              "self_join": True}
 
     def test_rewrite_expectations(self, harness):
         session, queries = harness
