@@ -22,7 +22,7 @@ import numpy as np
 import pyarrow.parquet as pq
 
 from ..exceptions import HyperspaceException
-from ..execution.columnar import Column, Table, read_parquet, write_parquet
+from ..execution.columnar import Column, Table, write_parquet
 from ..index.constants import IndexConstants, States
 from ..index.data_manager import IndexDataManager
 from ..index.log_entry import (Content, CoveringIndex, Directory, FileIdTracker,

@@ -22,11 +22,11 @@ from typing import Dict, List, Optional
 import pyarrow as pa
 import pyarrow.parquet as pq
 
-from ..exceptions import HyperspaceException, NoChangesException
+from ..exceptions import HyperspaceException
 from ..execution.columnar import read_parquet
-from ..index.constants import IndexConstants, States
+from ..index.constants import States
 from ..index.log_entry import (Content, DataSkippingIndex, FileIdTracker,
-                               FileInfo, IndexLogEntry, Sketch)
+                               IndexLogEntry, Sketch)
 from ..ops import sketches as sk
 from ..plan.nodes import Scan
 from ..schema import INT64, STRING, Field, Schema
@@ -34,7 +34,7 @@ from ..telemetry.events import (CreateActionEvent, RefreshActionEvent,
                                 RefreshIncrementalActionEvent)
 from ..util.resolver import resolve_all
 from .create import CreateActionBase
-from .refresh import ExistingIndexActionBase, RefreshActionBase
+from .refresh import RefreshActionBase
 
 SKETCH_FILE_NAME = "sketches.parquet"
 FILE_COL = "_file"

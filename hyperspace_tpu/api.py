@@ -9,7 +9,7 @@ held on the facade, mirroring HyperspaceContext (Hyperspace.scala:169-196).
 from __future__ import annotations
 
 from dataclasses import dataclass, field
-from typing import List, Optional, Sequence
+from typing import List, Optional
 
 from .exceptions import HyperspaceException
 from .index.constants import IndexConstants

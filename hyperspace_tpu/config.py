@@ -172,6 +172,9 @@ class HyperspaceConf:
                 IndexConstants.TPU_BUILD_ROWS_PER_SHARD,
                 IndexConstants.TPU_BUILD_ROWS_PER_SHARD_DEFAULT))
 
+    def trace_dir(self) -> Optional[str]:
+        return self._conf.get(IndexConstants.TPU_TRACE_DIR)
+
     def max_chunk_rows(self) -> int:
         return int(
             self._conf.get(

@@ -13,7 +13,7 @@ Host↔device crossings happen only at parquet read/write and at collect().
 from __future__ import annotations
 
 import datetime
-from dataclasses import dataclass, field as dc_field
+from dataclasses import dataclass
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax

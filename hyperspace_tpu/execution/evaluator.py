@@ -10,11 +10,10 @@ from __future__ import annotations
 from typing import Optional, Tuple
 
 import jax.numpy as jnp
-import numpy as np
 
 from ..exceptions import HyperspaceException
 from ..plan import expr as E
-from ..schema import BOOL, DATE, FLOAT64, INT64, STRING
+from ..schema import BOOL, FLOAT64, INT64, STRING
 from .columnar import (Column, Table, dictionaries_equal, literal_to_device,
                        translate_codes)
 

@@ -130,6 +130,10 @@ class IndexConstants:
     TPU_MAX_CHUNK_ROWS = "hyperspace.tpu.maxChunkRows"
     TPU_MAX_CHUNK_ROWS_DEFAULT = str(8 * 1024 * 1024)
     TPU_MESH_SHAPE = "hyperspace.tpu.mesh"
+    # XLA profiler integration (SURVEY §5 tracing): when set, every plan
+    # execution runs under jax.profiler.trace writing TensorBoard-loadable
+    # traces (one subdirectory per execution) into this directory.
+    TPU_TRACE_DIR = "hyperspace.tpu.trace.dir"
     # When >1 device is visible, index builds run over the whole mesh
     # (all-to-all bucket exchange, parallel/distributed_build.py) — the
     # analogue of the reference's always-distributed Spark build

@@ -5,7 +5,6 @@ from __future__ import annotations
 from dataclasses import dataclass
 from typing import Dict, List
 
-from .constants import IndexConstants
 from .log_entry import IndexLogEntry
 
 

@@ -15,13 +15,12 @@ sorted array into per-bucket parquet files at the host DMA boundary.
 
 from __future__ import annotations
 
-from typing import Dict, List, Optional, Sequence, Tuple
+from typing import Dict, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..exceptions import HyperspaceException
 from ..execution.columnar import Table
 from . import kernels
 

@@ -13,7 +13,7 @@ and aggregate functions (Sum/Count/Min/Max/Avg).
 from __future__ import annotations
 
 import datetime
-from dataclasses import dataclass, field as dc_field
+from dataclasses import dataclass
 from typing import Any, List, Optional, Sequence, Tuple
 
 from ..exceptions import HyperspaceException

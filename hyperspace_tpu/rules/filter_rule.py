@@ -14,7 +14,7 @@ filter-chain semantics), with whyNot reasons recorded into a ReasonCollector.
 
 from __future__ import annotations
 
-from typing import List, Optional, Tuple
+from typing import Optional, Tuple
 
 from ..index.log_entry import IndexLogEntry
 from ..plan.nodes import Filter, LogicalPlan, Project, Scan

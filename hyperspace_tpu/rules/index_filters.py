@@ -13,7 +13,7 @@ session keeps the collector of the last rewrite for the ``whyNot`` API.
 
 from __future__ import annotations
 
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Dict, List, Optional, Tuple
 
 from ..index.log_entry import IndexLogEntry

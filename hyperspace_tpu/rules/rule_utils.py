@@ -22,7 +22,6 @@ from ..index.signatures import LogicalPlanSignatureProvider
 from ..plan import expr as E
 from ..plan.nodes import Filter, IndexScan, LogicalPlan, Project, Scan
 from ..schema import Schema
-from ..util import file_utils
 
 
 def log_index_usage(session, ctx, index_names: List[str], plan_string: str,

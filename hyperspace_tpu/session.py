@@ -12,7 +12,7 @@ analysis → (hyperspace rewrite if enabled) → the XLA executor.
 from __future__ import annotations
 
 import importlib
-from typing import Dict, List, Optional, Sequence, Tuple, Union as TUnion
+from typing import Dict, List, Optional, Tuple, Union as TUnion
 
 from .config import Conf, HyperspaceConf
 from .exceptions import HyperspaceException
@@ -113,7 +113,17 @@ class Session:
 
     def execute(self, plan: LogicalPlan):
         from .execution import execute as run
-        return run(self.optimize(plan), session=self)
+        optimized = self.optimize(plan)
+        trace_dir = self.hs_conf.trace_dir()
+        if trace_dir:
+            # XLA-profiler integration (SURVEY §5): device timelines for
+            # every jitted program this execution launches, viewable in
+            # TensorBoard / xprof.
+            import jax
+
+            with jax.profiler.trace(trace_dir):
+                return run(optimized, session=self)
+        return run(optimized, session=self)
 
     def create_dataframe(self, plan: LogicalPlan) -> "DataFrame":
         return DataFrame(self, plan)

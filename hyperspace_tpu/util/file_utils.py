@@ -10,7 +10,7 @@ from __future__ import annotations
 import os
 import shutil
 import uuid
-from typing import Iterator, List
+from typing import List
 
 
 def write_contents(path: str, contents: str) -> None:

@@ -1,0 +1,99 @@
+#!/usr/bin/env python
+"""Self-contained lint gate (no third-party linters in the image).
+
+The reference gates compile+test behind scalastyle (build.sbt:96-101);
+this is the equivalent style gate for CI here: every source must compile,
+carry no tabs/trailing whitespace, respect the line-length cap, and not
+import modules it never uses (package code only). Exit code 1 on any
+violation; run as `python scripts/lint.py`.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+MAX_LINE = 100
+PACKAGE_DIRS = ("hyperspace_tpu",)
+ALL_DIRS = ("hyperspace_tpu", "tests", "scripts")
+TOP_FILES = ("bench.py", "__graft_entry__.py")
+
+
+def iter_sources():
+    for d in ALL_DIRS:
+        for r, _dirs, files in os.walk(os.path.join(ROOT, d)):
+            for f in sorted(files):
+                if f.endswith(".py"):
+                    yield os.path.join(r, f)
+    for f in TOP_FILES:
+        yield os.path.join(ROOT, f)
+
+
+def unused_imports(tree: ast.AST) -> list:
+    imported = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                name = (a.asname or a.name).split(".")[0]
+                imported[name] = node.lineno
+        elif isinstance(node, ast.ImportFrom):
+            if node.module == "__future__":
+                continue
+            for a in node.names:
+                if a.name == "*":
+                    continue
+                imported[a.asname or a.name] = node.lineno
+    used = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Name):
+            used.add(node.id)
+        elif isinstance(node, ast.Attribute):
+            n = node
+            while isinstance(n, ast.Attribute):
+                n = n.value
+            if isinstance(n, ast.Name):
+                used.add(n.id)
+        elif isinstance(node, ast.Constant) and isinstance(node.value, str) \
+                and len(node.value) < 200:
+            # Forward-reference annotations ('"HyperspaceConf"') count.
+            import re
+            used.update(re.findall(r"[A-Za-z_][A-Za-z0-9_]*", node.value))
+    # Strings can reference names (docstrings citing symbols don't count,
+    # but __all__ / annotations-as-strings do); be conservative.
+    return sorted((line, name) for name, line in imported.items()
+                  if name not in used and not name.startswith("_"))
+
+
+def main() -> int:
+    problems = []
+    for path in iter_sources():
+        rel = os.path.relpath(path, ROOT)
+        with open(path, encoding="utf-8") as f:
+            text = f.read()
+        try:
+            tree = ast.parse(text, filename=rel)
+        except SyntaxError as e:
+            problems.append(f"{rel}:{e.lineno}: syntax error: {e.msg}")
+            continue
+        for i, line in enumerate(text.splitlines(), 1):
+            if "\t" in line:
+                problems.append(f"{rel}:{i}: tab character")
+            if line != line.rstrip():
+                problems.append(f"{rel}:{i}: trailing whitespace")
+            if len(line) > MAX_LINE:
+                problems.append(f"{rel}:{i}: line longer than {MAX_LINE}")
+        if any(rel.startswith(d + os.sep) for d in PACKAGE_DIRS) \
+                and os.path.basename(path) != "__init__.py":  # re-exports
+            for line, name in unused_imports(tree):
+                problems.append(f"{rel}:{line}: unused import '{name}'")
+    for p in problems:
+        print(p)
+    print(f"lint: {len(problems)} problem(s) across "
+          f"{sum(1 for _ in iter_sources())} files")
+    return 1 if problems else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

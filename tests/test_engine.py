@@ -260,3 +260,33 @@ class TestQ3Shape:
             .reset_index(drop=True)
         np.testing.assert_allclose(out["revenue"], exp["revenue"], rtol=1e-9)
         assert list(out["l_orderkey"]) == list(exp["l_orderkey"])
+
+
+class TestProfilerTrace:
+    def test_trace_dir_collects_xla_profile(self, tmp_path):
+        """hyperspace.tpu.trace.dir wraps execution in jax.profiler.trace
+        (SURVEY §5 XLA-profiler integration)."""
+        import os
+
+        import numpy as np
+        import pandas as pd
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+
+        import hyperspace_tpu as hst
+        from hyperspace_tpu.index.constants import IndexConstants
+        from hyperspace_tpu.plan.expr import col
+
+        d = tmp_path / "data"
+        d.mkdir()
+        pq.write_table(pa.Table.from_pandas(pd.DataFrame({
+            "k": np.arange(1000, dtype=np.int64)})), d / "p.parquet")
+        (tmp_path / "idx").mkdir()
+        session = hst.Session(system_path=str(tmp_path / "idx"))
+        trace_dir = str(tmp_path / "traces")
+        session.conf.set(IndexConstants.TPU_TRACE_DIR, trace_dir)
+        out = session.read.parquet(str(d)).filter(col("k") < 10).to_pandas()
+        assert len(out) == 10
+        found = [os.path.join(r, f) for r, _, fs in os.walk(trace_dir)
+                 for f in fs]
+        assert found, "no profiler trace files written"
