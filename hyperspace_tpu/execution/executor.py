@@ -565,7 +565,15 @@ def _execute_join(plan: Join, needed: Optional[Set[str]]) -> Table:
             out[n] = taken_left.columns[n]
         elif n in taken_right.columns:
             out[n] = taken_right.columns[n]
-    return Table(out)
+    # The join output follows the probe (left) side's row order
+    # (merge_join_indices emits ascending left indices), so the left
+    # side's bucket order survives — downstream group-bys on those keys
+    # can skip their sort.
+    order_out = None
+    lbo = left.bucket_order
+    if lbo is not None and all(k in out for k in lbo[1]):
+        order_out = lbo
+    return Table(out, bucket_order=order_out)
 
 
 def _bucketed_merge_keys(left: Table, right: Table, norm, lkeys, rkeys):
@@ -643,9 +651,12 @@ def _group_sort_keys(cols: Sequence[Column]) -> List[jnp.ndarray]:
     return [k for c in cols for k in _null_aware_keys(c)]
 
 
-# Group-bys that skipped the sort because the input carried bucket order
-# on exactly the grouping keys (tests/bench assert the path is taken).
+# Group-bys that avoided the full row sort (tests/bench assert the path is
+# taken): SKIPPED = bucket order covers exactly the grouping keys (single
+# pass, no sort at all); TWO_PHASE = bucket keys are a strict subset (runs
+# aggregated then only the runs sorted).
 GROUPBY_SORT_SKIPPED = 0
+GROUPBY_TWO_PHASE = 0
 
 
 def _execute_aggregate(plan: Aggregate, table: Table) -> Table:
@@ -654,18 +665,30 @@ def _execute_aggregate(plan: Aggregate, table: Table) -> Table:
         return _execute_global_aggregate(plan, table)
     key_cols = [table.column(g) for g in plan.group_cols]
     bo = table.bucket_order
+    keys_non_null = all(c.validity is None for c in key_cols)
     if bo is not None and set(bo[1]) == set(plan.group_cols) \
-            and all(c.validity is None for c in key_cols):
+            and keys_non_null:
         # Covering-index layout: rows sorted by (bucket, keys) ⇒ equal key
         # tuples are globally contiguous (a key tuple lives in exactly one
         # bucket), so segment detection works WITHOUT the O(n log n) sort —
-        # the group-by analogue of the shuffle-free merge join. Requires
-        # the bucket keys to be exactly the grouping keys as a SET (a
-        # subset would let one group span buckets). (Nullable keys fall
-        # through: their fill values collide with real zeros.)
+        # the group-by analogue of the shuffle-free merge join. (Nullable
+        # keys fall through: their fill values collide with real zeros.)
         sorted_table = table
         sorted_keys = [c.data for c in key_cols]
         GROUPBY_SORT_SKIPPED += 1
+    elif bo is not None and set(bo[1]) < set(plan.group_cols) \
+            and keys_non_null:
+        # Bucket keys are a strict SUBSET of the grouping keys (e.g. Q3:
+        # join output ordered by l_orderkey, grouped by (l_orderkey,
+        # o_orderdate, o_shippriority)): equal group tuples need not be
+        # globally contiguous, but RUNS of them are short-range — so run
+        # the two-phase partial aggregation (segment per run, then sort
+        # only the RUNS — usually ≈ the group count, vastly fewer than
+        # rows — and combine). Sort cost drops from O(n log n) rows to
+        # O(r log r) runs.
+        global GROUPBY_TWO_PHASE
+        GROUPBY_TWO_PHASE += 1
+        return _execute_aggregate_two_phase(plan, table, key_cols)
     else:
         order = kernels.lex_sort_indices(_group_sort_keys(key_cols))
         sorted_table = table.take(order)
@@ -687,6 +710,83 @@ def _execute_aggregate(plan: Aggregate, table: Table) -> Table:
     return Table(out)
 
 
+def _execute_aggregate_two_phase(plan: Aggregate, table: Table,
+                                 key_cols: List[Column]) -> Table:
+    """Run-based partial aggregation: phase 1 segments CONSECUTIVE equal
+    key tuples (no sort) and reduces each run to partials; phase 2 sorts
+    only the runs and combines duplicate tuples. All on device; output is
+    key-sorted like the main path."""
+    run_keys = [c.data for c in key_cols]
+    rids, num_runs = kernels.group_ids_from_sorted(run_keys)
+    if num_runs == 0:
+        return _execute_aggregate(
+            plan, Table(dict(table.columns)))  # empty: reuse generic path
+    firsts = kernels.segment_first_index(rids, num_runs)
+    run_vals = [jnp.take(k, firsts) for k in run_keys]
+
+    order2 = kernels.lex_sort_indices(run_vals)
+    sorted_vals = [jnp.take(v, order2) for v in run_vals]
+    gids2, num_groups = kernels.group_ids_from_sorted(sorted_vals)
+
+    def combine(run_partial, op):
+        return op(jnp.take(run_partial, order2), gids2, num_groups)
+
+    out = {}
+    firsts2 = kernels.segment_first_index(gids2, num_groups)
+    for g, sv in zip(plan.group_cols, sorted_vals):
+        src = table.column(g)
+        out[g] = Column(src.dtype, jnp.take(sv, firsts2), None,
+                        src.dictionary)
+    for agg_expr in plan.aggs:
+        agg = _unwrap_agg(agg_expr)
+        name = agg_expr.name
+        if isinstance(agg, E.Count):
+            validity = None if agg.child is None \
+                else eval_expr(table, agg.child).validity
+            run_c = kernels.segment_count(rids, num_runs, validity)
+            out[name] = Column(INT64, combine(run_c, kernels.segment_sum))
+            continue
+        child = _agg_child_column(agg, table)
+        validity = child.validity
+        out_validity = None
+        total_valid = None
+        if validity is not None or isinstance(agg, E.Avg):
+            run_valid = kernels.segment_count(rids, num_runs, validity)
+            total_valid = combine(run_valid, kernels.segment_sum)
+            if validity is not None:
+                out_validity = total_valid > 0
+        if isinstance(agg, (E.Sum, E.Avg)):
+            sums = combine(
+                kernels.segment_sum(_acc_widen(child.data, validity),
+                                    rids, num_runs),
+                kernels.segment_sum)
+            if isinstance(agg, E.Sum):
+                out[name] = Column(_sum_out_dtype(sums), sums, out_validity)
+            else:
+                out[name] = Column(
+                    FLOAT64,
+                    sums.astype(jnp.float64) /
+                    jnp.maximum(total_valid, 1).astype(jnp.float64),
+                    out_validity)
+        elif isinstance(agg, E.Min):
+            out[name] = Column(
+                child.dtype,
+                combine(kernels.segment_min(_sentinel_filled(child, "min"),
+                                            rids, num_runs),
+                        kernels.segment_min),
+                out_validity, child.dictionary)
+        elif isinstance(agg, E.Max):
+            out[name] = Column(
+                child.dtype,
+                combine(kernels.segment_max(_sentinel_filled(child, "max"),
+                                            rids, num_runs),
+                        kernels.segment_max),
+                out_validity, child.dictionary)
+        else:
+            raise HyperspaceException(f"Unknown aggregate {agg!r}")
+    return Table(out)
+
+
 def _np_dtype_for(dtype: str):
     return {INT32: jnp.int32, INT64: jnp.int64, "float32": jnp.float32,
             FLOAT64: jnp.float64, BOOL: jnp.bool_, DATE: jnp.int32,
@@ -699,11 +799,46 @@ def _dict_for(table: Table, name: str):
     return None
 
 
-def _eval_agg(agg: E.Expr, sorted_table: Table, gids, num_groups: int) -> Column:
+def _unwrap_agg(agg: E.Expr) -> E.AggExpr:
     while isinstance(agg, E.Alias):
         agg = agg.child
     if not isinstance(agg, E.AggExpr):
-        raise HyperspaceException(f"Aggregate list requires agg functions; got {agg!r}")
+        raise HyperspaceException(
+            f"Aggregate list requires agg functions; got {agg!r}")
+    return agg
+
+
+def _agg_child_column(agg: E.AggExpr, table: Table) -> Column:
+    child = eval_expr(table, agg.child)
+    if child.dtype == STRING and not isinstance(agg, (E.Min, E.Max)):
+        raise HyperspaceException("sum/avg over string column")
+    return child
+
+
+def _acc_widen(values: jnp.ndarray, validity) -> jnp.ndarray:
+    """Sum/avg accumulator: floats widen to f64, ints to i64; invalid
+    rows contribute zero."""
+    acc = values.astype(jnp.float64) \
+        if jnp.issubdtype(values.dtype, jnp.floating) \
+        else values.astype(jnp.int64)
+    return acc if validity is None else jnp.where(validity, acc, 0)
+
+
+def _sentinel_filled(child: Column, kind: str) -> jnp.ndarray:
+    """Min/max input with invalid rows pushed past every real value."""
+    if child.validity is None:
+        return child.data
+    sentinel = _max_sentinel(child.data.dtype) if kind == "min" \
+        else _min_sentinel(child.data.dtype)
+    return jnp.where(child.validity, child.data, sentinel)
+
+
+def _sum_out_dtype(sums) -> str:
+    return FLOAT64 if jnp.issubdtype(sums.dtype, jnp.floating) else INT64
+
+
+def _eval_agg(agg: E.Expr, sorted_table: Table, gids, num_groups: int) -> Column:
+    agg = _unwrap_agg(agg)
     if isinstance(agg, E.Count):
         if agg.child is None:
             data = kernels.segment_count(gids, num_groups)
@@ -711,36 +846,29 @@ def _eval_agg(agg: E.Expr, sorted_table: Table, gids, num_groups: int) -> Column
             child = eval_expr(sorted_table, agg.child)
             data = kernels.segment_count(gids, num_groups, child.validity)
         return Column(INT64, data)
-    child = eval_expr(sorted_table, agg.child)
-    if child.dtype == STRING and not isinstance(agg, (E.Min, E.Max)):
-        raise HyperspaceException("sum/avg over string column")
-    values = child.data
+    child = _agg_child_column(agg, sorted_table)
     validity = child.validity
     # SQL semantics: a group with no valid values aggregates to NULL.
     out_validity = None
     if validity is not None:
         out_validity = kernels.segment_count(gids, num_groups, validity) > 0
     if isinstance(agg, (E.Sum, E.Avg)):
-        acc = values.astype(jnp.float64) if jnp.issubdtype(values.dtype, jnp.floating) \
-            else values.astype(jnp.int64)
-        if validity is not None:
-            acc = jnp.where(validity, acc, 0)
-        sums = kernels.segment_sum(acc, gids, num_groups)
+        sums = kernels.segment_sum(_acc_widen(child.data, validity),
+                                   gids, num_groups)
         if isinstance(agg, E.Sum):
-            dtype = FLOAT64 if jnp.issubdtype(sums.dtype, jnp.floating) else INT64
-            return Column(dtype, sums, out_validity)
+            return Column(_sum_out_dtype(sums), sums, out_validity)
         counts = kernels.segment_count(gids, num_groups, validity)
         return Column(FLOAT64, sums.astype(jnp.float64) /
                       jnp.maximum(counts, 1).astype(jnp.float64), out_validity)
     if isinstance(agg, E.Min):
-        vals = values if validity is None else \
-            jnp.where(validity, values, _max_sentinel(values.dtype))
-        return Column(child.dtype, kernels.segment_min(vals, gids, num_groups),
+        return Column(child.dtype,
+                      kernels.segment_min(_sentinel_filled(child, "min"),
+                                          gids, num_groups),
                       out_validity, child.dictionary)
     if isinstance(agg, E.Max):
-        vals = values if validity is None else \
-            jnp.where(validity, values, _min_sentinel(values.dtype))
-        return Column(child.dtype, kernels.segment_max(vals, gids, num_groups),
+        return Column(child.dtype,
+                      kernels.segment_max(_sentinel_filled(child, "max"),
+                                          gids, num_groups),
                       out_validity, child.dictionary)
     raise HyperspaceException(f"Unknown aggregate {agg!r}")
 

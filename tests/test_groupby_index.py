@@ -102,3 +102,40 @@ class TestGroupByIndexRule:
             count(None).alias("n"))
         assert not any(isinstance(l, IndexScan)
                        for l in q.optimized_plan().collect_leaves())
+
+
+class TestTwoPhaseGroupBy:
+    def test_join_output_groupby_superset_skips_sort(self, env):
+        """Q3 shape: join output keeps the probe side's bucket order, and a
+        group-by on a SUPERSET of the bucket keys runs the two-phase
+        run-based aggregation instead of sorting all rows."""
+        session, hs = env["session"], env["hs"]
+        rng = np.random.default_rng(88)
+        dim = pd.DataFrame({
+            "dk": np.arange(200, dtype=np.int64),
+            "dval": rng.integers(0, 30, 200).astype(np.int64),
+        })
+        import pathlib
+        ddir = pathlib.Path(env["path"]).parent / "dim"
+        ddir.mkdir()
+        pq.write_table(pa.Table.from_pandas(dim), ddir / "p.parquet")
+        hs.create_index(session.read.parquet(str(ddir)),
+                        IndexConfig("dimIdx", ["dk"], ["dval"]))
+        session.enable_hyperspace()
+        f = session.read.parquet(env["path"])
+        dd = session.read.parquet(str(ddir))
+        q = (f.join(dd, on=col("pk") == col("dk"))
+             .group_by("pk", "dval")
+             .agg(sum_(col("price")).alias("sp"),
+                  avg(col("qty")).alias("aq"),
+                  count(None).alias("n")))
+        before = executor.GROUPBY_TWO_PHASE
+        got = q.to_pandas()
+        assert executor.GROUPBY_TWO_PHASE > before, \
+            "two-phase group-by path not taken"
+        session.disable_hyperspace()
+        exp = q.to_pandas()
+        pd.testing.assert_frame_equal(
+            got.sort_values(["pk", "dval"]).reset_index(drop=True),
+            exp.sort_values(["pk", "dval"]).reset_index(drop=True),
+            check_dtype=False)

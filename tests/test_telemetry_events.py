@@ -1,0 +1,153 @@
+"""Telemetry event breadth (parity: telemetry/HyperspaceEvent.scala:28-156 +
+the MockEventLogger installed in every reference suite): each lifecycle
+action emits start/success events through the conf-pluggable logger, a
+failed action emits a failure event, and the rewrite rules emit index-usage
+events."""
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+import hyperspace_tpu as hst
+from hyperspace_tpu.api import Hyperspace, IndexConfig
+from hyperspace_tpu.exceptions import HyperspaceException
+from hyperspace_tpu.index.constants import IndexConstants
+from hyperspace_tpu.plan.expr import col
+from hyperspace_tpu.telemetry.logging import EventLogger
+
+
+class SinkLogger(EventLogger):
+    events = []
+
+    def log_event(self, event):
+        SinkLogger.events.append(event)
+
+
+def sink():
+    """The class as the engine resolves it (module identity differs from
+    pytest's import of this file — see test_capability_cliffs)."""
+    import importlib
+    return importlib.import_module("tests.test_telemetry_events").SinkLogger
+
+
+@pytest.fixture()
+def env(tmp_path):
+    rng = np.random.default_rng(3)
+    d = tmp_path / "data"
+    d.mkdir()
+    pq.write_table(pa.Table.from_pandas(pd.DataFrame({
+        "k": rng.integers(0, 60, 500).astype(np.int64),
+        "v": rng.integers(0, 9, 500).astype(np.int64),
+    })), d / "p0.parquet")
+    session = hst.Session(system_path=str(tmp_path / "indexes"))
+    session.conf.set(IndexConstants.INDEX_NUM_BUCKETS, 4)
+    session.conf.set(IndexConstants.EVENT_LOGGER_CLASS,
+                     "tests.test_telemetry_events.SinkLogger")
+    sink().events.clear()
+    return dict(session=session, hs=Hyperspace(session), path=str(d))
+
+
+def names_of(events):
+    return [type(e).__name__ for e in events]
+
+
+def take_new(mark):
+    evs = sink().events[mark:]
+    return evs, len(sink().events)
+
+
+class TestActionEvents:
+    def test_lifecycle_emits_start_and_success_per_action(self, env):
+        hs, session = env["hs"], env["session"]
+        df = session.read.parquet(env["path"])
+        mark = 0
+
+        hs.create_index(df, IndexConfig("tIdx", ["k"], ["v"]))
+        evs, mark = take_new(mark)
+        assert names_of(evs).count("CreateActionEvent") == 2  # start+success
+        assert "started" in evs[0].message.lower()
+        assert "succeeded" in evs[-1].message.lower()
+        assert evs[0].index_name == "tIdx"
+
+        hs.delete_index("tIdx")
+        evs, mark = take_new(mark)
+        assert names_of(evs) == ["DeleteActionEvent", "DeleteActionEvent"]
+
+        hs.restore_index("tIdx")
+        evs, mark = take_new(mark)
+        assert names_of(evs) == ["RestoreActionEvent", "RestoreActionEvent"]
+
+        hs.refresh_index("tIdx", "full")
+        evs, mark = take_new(mark)
+        assert names_of(evs).count("RefreshActionEvent") == 2
+
+        hs.optimize_index("tIdx", "full")
+        evs, mark = take_new(mark)
+        assert names_of(evs).count("OptimizeActionEvent") == 2
+
+        hs.delete_index("tIdx")
+        _, mark = take_new(mark)
+        hs.vacuum_index("tIdx")
+        evs, mark = take_new(mark)
+        assert names_of(evs) == ["VacuumActionEvent", "VacuumActionEvent"]
+
+    def test_failed_action_emits_failure_event(self, env):
+        hs, session = env["hs"], env["session"]
+        df = session.read.parquet(env["path"])
+        hs.create_index(df, IndexConfig("fIdx", ["k"], ["v"]))
+        mark = len(sink().events)
+        with pytest.raises(HyperspaceException):
+            hs.create_index(df, IndexConfig("fIdx", ["k"], ["v"]))  # dup name
+        evs, _ = take_new(mark)
+        assert any("failed" in e.message.lower() for e in evs)
+
+    def test_refresh_modes_emit_distinct_event_types(self, env):
+        session = env["session"]
+        session.conf.set(IndexConstants.INDEX_LINEAGE_ENABLED, "true")
+        hs = env["hs"]
+        df = session.read.parquet(env["path"])
+        hs.create_index(df, IndexConfig("modes", ["k"], ["v"]))
+        rng = np.random.default_rng(5)
+        import pathlib
+        extra = pd.DataFrame({
+            "k": rng.integers(0, 60, 80).astype(np.int64),
+            "v": rng.integers(0, 9, 80).astype(np.int64)})
+        pq.write_table(pa.Table.from_pandas(extra),
+                       pathlib.Path(env["path"]) / "extra1.parquet")
+        mark = len(sink().events)
+        hs.refresh_index("modes", "incremental")
+        evs, mark = take_new(mark)
+        assert "RefreshIncrementalActionEvent" in names_of(evs)
+        pq.write_table(pa.Table.from_pandas(extra),
+                       pathlib.Path(env["path"]) / "extra2.parquet")
+        hs.refresh_index("modes", "quick")
+        evs, _ = take_new(mark)
+        assert "RefreshQuickActionEvent" in names_of(evs)
+
+
+class TestUsageEvents:
+    def test_rewrite_emits_index_usage_event(self, env):
+        hs, session = env["hs"], env["session"]
+        df = session.read.parquet(env["path"])
+        hs.create_index(df, IndexConfig("useIdx", ["k"], ["v"]))
+        session.enable_hyperspace()
+        mark = len(sink().events)
+        df.filter(col("k") == 3).select("k", "v").to_pandas()
+        evs, _ = take_new(mark)
+        usage = [e for e in evs
+                 if type(e).__name__ == "HyperspaceIndexUsageEvent"]
+        assert usage and "useIdx" in usage[0].index_names
+
+    def test_why_not_is_silent(self, env):
+        """Diagnostic passes must not emit usage telemetry."""
+        hs, session = env["hs"], env["session"]
+        df = session.read.parquet(env["path"])
+        hs.create_index(df, IndexConfig("silent", ["k"], ["v"]))
+        session.enable_hyperspace()
+        mark = len(sink().events)
+        hs.why_not(df.filter(col("k") == 3).select("k", "v"))
+        evs, _ = take_new(mark)
+        assert not [e for e in evs
+                    if type(e).__name__ == "HyperspaceIndexUsageEvent"]
