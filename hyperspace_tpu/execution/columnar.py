@@ -350,6 +350,22 @@ def read_parquet(files: Sequence[str], columns: Optional[Sequence[str]] = None,
         tables = [pa_orc.ORCFile(f).read(
             columns=list(columns) if columns else None) for f in files]
         at = pa.concat_tables(tables)
+    elif fmt == "text":
+        # Spark text-source semantics: one string column "value" per line,
+        # splitting ONLY on \n / \r\n (str.splitlines would also split on
+        # \x0b/  etc., silently diverging from the reference).
+        arrays = []
+        for f in files:
+            with open(f, encoding="utf-8", newline="") as fh:
+                body = fh.read()
+            lines = [l[:-1] if l.endswith("\r") else l
+                     for l in body.split("\n")]
+            if lines and lines[-1] == "":
+                lines.pop()  # trailing newline, not an empty last line
+            arrays.append(pa.array(lines, type=pa.string()))
+        at = pa.table({"value": pa.concat_arrays(arrays)})
+        if columns:
+            at = at.select(list(columns))
     else:
         raise HyperspaceException(f"Unsupported format: {fmt}")
     return Table.from_arrow(at)

@@ -151,6 +151,10 @@ class DataFrameReader:
     def orc(self, *paths: str) -> "DataFrame":
         return self.format("orc").load(*paths)
 
+    def text(self, *paths: str) -> "DataFrame":
+        """One string column "value" per line (Spark text source)."""
+        return self.format("text").load(*paths)
+
     def delta(self, path: str, version_as_of: Optional[int] = None
               ) -> "DataFrame":
         """Read a commit-log versioned table (lake/delta.py), optionally

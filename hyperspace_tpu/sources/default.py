@@ -20,9 +20,13 @@ from ..util import file_utils, hashing
 from .interfaces import FileBasedRelation, FileBasedSourceProvider
 
 # Parity: DefaultFileBasedSource.scala:37-44 supports
-# avro/csv/json/orc/parquet/text; avro and text have no pyarrow reader in
-# this image and are intentionally absent (documented gap).
-SUPPORTED_FORMATS = ("parquet", "csv", "json", "orc")
+# avro/csv/json/orc/parquet/text; avro is the one absence (no avro reader
+# in this image — documented gap).
+SUPPORTED_FORMATS = ("parquet", "csv", "json", "orc", "text")
+
+# File suffixes per format ("text" matches Spark's .txt convention too).
+_FORMAT_SUFFIXES = {fmt: ("." + fmt,) for fmt in SUPPORTED_FORMATS}
+_FORMAT_SUFFIXES["text"] = (".text", ".txt")
 
 
 class DefaultFileBasedRelation(FileBasedRelation):
@@ -69,6 +73,10 @@ class DefaultFileBasedRelation(FileBasedRelation):
                 f"No data files under {self._root_paths}")
         if self._format == "parquet":
             return Schema.from_arrow(pq.read_schema(files[0]))
+        if self._format == "text":
+            # Spark text-source schema: one non-null string column.
+            from ..schema import STRING, Field
+            return Schema([Field("value", STRING, False)])
         ds = pa_ds.dataset(files[0], format=self._format)
         return Schema.from_arrow(ds.schema)
 
@@ -89,13 +97,13 @@ class DefaultFileBasedRelation(FileBasedRelation):
     def all_files(self) -> List[str]:
         if self._files is None:
             out: List[str] = []
-            suffix = "." + self._format
+            suffixes = _FORMAT_SUFFIXES[self._format]
             for root in self._root_paths:
                 if os.path.isfile(root):
                     out.append(os.path.abspath(root))
                     continue
                 for f in file_utils.list_leaf_files(root):
-                    if f.endswith(suffix):
+                    if f.endswith(suffixes):
                         out.append(f)
             self._files = sorted(out)
         return list(self._files)

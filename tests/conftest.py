@@ -32,3 +32,23 @@ def tmp_system_path(tmp_path):
     p = tmp_path / "indexes"
     p.mkdir()
     return str(p)
+
+
+class CaptureLogger:
+    """Conf-pluggable telemetry sink collecting every event (the reference
+    test pattern: TestUtils.MockEventLogger). Point the conf at
+    "tests.conftest.CaptureLogger" and read events via capture_logger()."""
+
+    events = []
+
+    def log_event(self, event):
+        CaptureLogger.events.append(event)
+
+
+def capture_logger():
+    """The CaptureLogger class as the ENGINE sees it: get_logger imports
+    "tests.conftest" by dotted name, which is a different module object
+    from the one pytest executes this file as — events land on that class,
+    not on this module's."""
+    import importlib
+    return importlib.import_module("tests.conftest").CaptureLogger

@@ -20,28 +20,9 @@ import hyperspace_tpu as hst
 from hyperspace_tpu.api import Hyperspace, IndexConfig
 from hyperspace_tpu.index.constants import IndexConstants
 from hyperspace_tpu.plan.expr import col, count, sum_
-from hyperspace_tpu.telemetry.events import DistributedFallbackEvent
-from hyperspace_tpu.telemetry.logging import EventLogger
+from hyperspace_tpu.telemetry.events import DistributedFallbackEvent  # noqa: F401
 
-
-class CaptureLogger(EventLogger):
-    """Conf-pluggable sink collecting every event (reference test pattern:
-    TestUtils.MockEventLogger)."""
-
-    events = []
-
-    def log_event(self, event):
-        CaptureLogger.events.append(event)
-
-
-def capture_logger_cls():
-    """The CaptureLogger class as the *engine* sees it: get_logger imports
-    "tests.test_capability_cliffs" by name, which is a different module
-    object from the one pytest executes this file as — so events land on
-    that class, not this one."""
-    import importlib
-    return importlib.import_module(
-        "tests.test_capability_cliffs").CaptureLogger
+from conftest import capture_logger as capture_logger_cls
 
 
 def write_dir(tmp_path, name, table, parts=2):
@@ -267,7 +248,7 @@ class TestNullableDistributedBuild:
         cap = capture_logger_cls()
         cap.events.clear()
         session.conf.set(IndexConstants.EVENT_LOGGER_CLASS,
-                         "tests.test_capability_cliffs.CaptureLogger")
+                         "tests.conftest.CaptureLogger")
         t = pa.table({"k": pa.array([], type=pa.int64()),
                       "v": pa.array([], type=pa.float64())})
         d = tmp_path / "empty"
@@ -287,7 +268,7 @@ class TestSpmdFallbackEvent:
         cap = capture_logger_cls()
         cap.events.clear()
         session.conf.set(IndexConstants.EVENT_LOGGER_CLASS,
-                         "tests.test_capability_cliffs.CaptureLogger")
+                         "tests.conftest.CaptureLogger")
         rng = np.random.default_rng(5)
         t = pa.table({"k": rng.integers(0, 10, 100).astype(np.int64),
                       "v": rng.uniform(0, 1, 100)})

@@ -196,3 +196,20 @@ class TestDistributedQuery:
         assert count == len(joined) == 2
         np.testing.assert_allclose(lsum, joined.lv.sum())
         np.testing.assert_allclose(rsum, joined.rv.sum())
+
+
+class TestMultihost:
+    def test_single_process_noop_and_global_mesh(self):
+        """Without a coordinator the initialize is a no-op, and the global
+        mesh spans every visible device (8 on the CI virtual mesh)."""
+        import jax
+
+        from hyperspace_tpu.parallel.multihost import (global_mesh,
+                                                       initialize_multihost)
+
+        info = initialize_multihost()
+        assert info["initialized"] is False
+        assert info["process_count"] == 1
+        assert info["global_devices"] == len(jax.devices())
+        mesh = global_mesh()
+        assert mesh.devices.size == len(jax.devices())

@@ -292,3 +292,18 @@ class TestPartitionPruningEdges:
         pd.testing.assert_frame_equal(
             got.sort_values(key).reset_index(drop=True),
             exp.sort_values(key).reset_index(drop=True), check_dtype=False)
+
+
+class TestTextFormat:
+    def test_text_scan_and_filter(self, session, tmp_path):
+        d = tmp_path / "txt"
+        d.mkdir()
+        (d / "a.txt").write_text("alpha\nbravo\ncharlie\n")
+        (d / "b.txt").write_text("delta\necho\n")
+        df = session.read.text(str(d))
+        assert df.plan.schema.names == ["value"]
+        got = df.to_pandas()
+        assert sorted(got["value"]) == ["alpha", "bravo", "charlie",
+                                        "delta", "echo"]
+        f = df.filter(col("value") > "c").to_pandas()
+        assert sorted(f["value"]) == ["charlie", "delta", "echo"]

@@ -15,21 +15,9 @@ from hyperspace_tpu.api import Hyperspace, IndexConfig
 from hyperspace_tpu.exceptions import HyperspaceException
 from hyperspace_tpu.index.constants import IndexConstants
 from hyperspace_tpu.plan.expr import col
-from hyperspace_tpu.telemetry.logging import EventLogger
 
 
-class SinkLogger(EventLogger):
-    events = []
-
-    def log_event(self, event):
-        SinkLogger.events.append(event)
-
-
-def sink():
-    """The class as the engine resolves it (module identity differs from
-    pytest's import of this file — see test_capability_cliffs)."""
-    import importlib
-    return importlib.import_module("tests.test_telemetry_events").SinkLogger
+from conftest import capture_logger as sink  # noqa: E402
 
 
 @pytest.fixture()
@@ -44,7 +32,7 @@ def env(tmp_path):
     session = hst.Session(system_path=str(tmp_path / "indexes"))
     session.conf.set(IndexConstants.INDEX_NUM_BUCKETS, 4)
     session.conf.set(IndexConstants.EVENT_LOGGER_CLASS,
-                     "tests.test_telemetry_events.SinkLogger")
+                     "tests.conftest.CaptureLogger")
     sink().events.clear()
     return dict(session=session, hs=Hyperspace(session), path=str(d))
 
