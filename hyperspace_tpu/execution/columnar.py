@@ -139,6 +139,26 @@ class Table:
         out[name] = col
         return Table(out, bucket_order=self.bucket_order)
 
+    def to_host(self) -> "Table":
+        """Materialize every column as host numpy with ONE device_get over
+        the whole pytree. On a remote-attached TPU the per-transfer round
+        trip (not bandwidth) dominates, so anything that will be sliced
+        many times on the host (e.g. one parquet file per bucket) must be
+        fetched wholesale first, never slice-by-slice."""
+        import jax
+        arrays = {}
+        for n, c in self.columns.items():
+            arrays[(n, "d")] = c.data
+            if c.validity is not None:
+                arrays[(n, "v")] = c.validity
+        host = jax.device_get(arrays)
+        return Table({n: Column(c.dtype, np.asarray(host[(n, "d")]),
+                                np.asarray(host[(n, "v")])
+                                if c.validity is not None else None,
+                                c.dictionary)
+                      for n, c in self.columns.items()},
+                     bucket_order=self.bucket_order)
+
     def rename(self, mapping: Dict[str, str]) -> "Table":
         order = self.bucket_order
         if order:
@@ -176,10 +196,17 @@ class Table:
     # ------------------------------------------------------------------
 
     def to_arrow(self) -> pa.Table:
+        # Host-resident columns (e.g. after to_host()) skip device_get so
+        # per-bucket writes of a wholesale-fetched table cost zero tunnel
+        # round-trips.
+        def fetch(a):
+            return a if isinstance(a, np.ndarray) else \
+                np.asarray(jax.device_get(a))
+
         arrays = []
         for name, col in self.columns.items():
-            np_data = np.asarray(jax.device_get(col.data))
-            np_valid = (np.asarray(jax.device_get(col.validity))
+            np_data = fetch(col.data)
+            np_valid = (fetch(col.validity)
                         if col.validity is not None else None)
             mask = None if np_valid is None else ~np_valid
             if col.dtype == STRING:
@@ -351,18 +378,19 @@ def read_parquet(files: Sequence[str], columns: Optional[Sequence[str]] = None,
             columns=list(columns) if columns else None) for f in files]
         at = pa.concat_tables(tables)
     elif fmt == "text":
-        # Spark text-source semantics: one string column "value" per line,
-        # splitting ONLY on \n / \r\n (str.splitlines would also split on
-        # \x0b/  etc., silently diverging from the reference).
+        # Spark text-source semantics: one string column "value" per line.
+        # Hadoop's LineReader treats \n, \r, and \r\n all as line
+        # terminators (but NOT \x0b/\x0c etc., so str.splitlines would
+        # silently diverge from the reference).
+        import re
         arrays = []
         for f in files:
             with open(f, encoding="utf-8", newline="") as fh:
                 body = fh.read()
-            lines = [l[:-1] if l.endswith("\r") else l
-                     for l in body.split("\n")]
-            if lines and lines[-1] == "":
-                lines.pop()  # trailing newline, not an empty last line
-            arrays.append(pa.array(lines, type=pa.string()))
+            lines_ = re.split("\r\n|\r|\n", body)
+            if lines_ and lines_[-1] == "":
+                lines_.pop()  # trailing terminator, not an empty last line
+            arrays.append(pa.array(lines_, type=pa.string()))
         at = pa.table({"value": pa.concat_arrays(arrays)})
         if columns:
             at = at.select(list(columns))

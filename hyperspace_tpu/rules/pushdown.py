@@ -1,0 +1,76 @@
+"""Filter pushdown through Project — Catalyst-parity plan normalization.
+
+The reference's index rules match ``Scan → Filter (→ Project)`` shapes
+(rules/FilterIndexRule.scala:165) and get away with that narrow pattern
+ONLY because Spark's own optimizer batch (PushDownPredicate) has already
+pushed every pushable predicate below projections by the time hyperspace's
+extra rules run. Our pipeline owns the whole optimizer, so without this
+rule a query written ``select(...).where(...)`` — a Filter above a Project
+— would silently never be rewritten to an index scan while the logically
+identical ``where(...).select(...)`` would.
+
+The transform substitutes the projection's expressions into the predicate
+(all our expressions are pure, so duplication is safe), then re-parents:
+
+    Filter(cond, Project(exprs, child))
+      → Project(exprs, Filter(subst(cond), child))
+
+and recurses, so a filter sinks through arbitrarily many projections until
+it sits directly on the scan where the index rules can see it.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+from ..plan import expr as E
+from ..plan.nodes import Filter, LogicalPlan, Project
+
+
+def _substitute(e: E.Expr, mapping: Dict[str, E.Expr]) -> Optional[E.Expr]:
+    """Rebuild ``e`` with every Col reference replaced by the projection
+    expression that produces it. Returns None for expression kinds we
+    don't know how to rebuild (the filter then stays where it is)."""
+    if isinstance(e, E.Col):
+        return mapping.get(e.column, e)
+    if isinstance(e, E.Lit):
+        return e
+    if isinstance(e, E.Alias):
+        child = _substitute(e.child, mapping)
+        return None if child is None else E.Alias(child, e.alias_name)
+    if isinstance(e, E.Not):
+        child = _substitute(e.child, mapping)
+        return None if child is None else E.Not(child)
+    if isinstance(e, E.In):
+        value = _substitute(e.value, mapping)
+        options = [_substitute(o, mapping) for o in e.options]
+        if value is None or any(o is None for o in options):
+            return None
+        return E.In(value, options)
+    if isinstance(e, E._Binary):
+        left = _substitute(e.left, mapping)
+        right = _substitute(e.right, mapping)
+        if left is None or right is None:
+            return None
+        return type(e)(left, right)
+    return None  # AggExpr or future kinds: not pushable.
+
+
+def push_filters(plan: LogicalPlan) -> LogicalPlan:
+    """Bottom-up: sink every Filter below the Projects beneath it."""
+    children = plan.children
+    if children:
+        plan = plan.with_children([push_filters(c) for c in children])
+    if isinstance(plan, Filter) and isinstance(plan.child, Project):
+        proj = plan.child
+        mapping: Dict[str, E.Expr] = {}
+        for ex in proj.exprs:
+            inner = ex.child if isinstance(ex, E.Alias) else ex
+            if isinstance(inner, E.AggExpr):
+                return plan  # not a scalar projection; leave untouched
+            mapping[ex.name] = inner
+        cond = _substitute(plan.condition, mapping)
+        if cond is not None:
+            # Recurse: the sunk filter may sit above another Project.
+            return Project(proj.exprs, push_filters(Filter(cond, proj.child)))
+    return plan

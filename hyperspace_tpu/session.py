@@ -104,7 +104,12 @@ class Session:
         mismatch every index signature (same ordering rule as the
         data-skipping rule inside the batch)."""
         from .rules.column_pruning import prune_columns
+        from .rules.pushdown import push_filters
         from .sources.partitions import prune_partitions
+        # Catalyst-parity normalization first: predicates sink below
+        # projections so the index rules see Scan→Filter shapes regardless
+        # of how the user ordered select()/where().
+        plan = push_filters(plan)
         plan = prune_columns(plan)
         if self._hyperspace_enabled:
             from .rules.apply_hyperspace import apply_hyperspace

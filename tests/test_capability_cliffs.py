@@ -20,7 +20,6 @@ import hyperspace_tpu as hst
 from hyperspace_tpu.api import Hyperspace, IndexConfig
 from hyperspace_tpu.index.constants import IndexConstants
 from hyperspace_tpu.plan.expr import col, count, sum_
-from hyperspace_tpu.telemetry.events import DistributedFallbackEvent  # noqa: F401
 
 from conftest import capture_logger as capture_logger_cls
 

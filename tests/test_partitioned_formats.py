@@ -307,3 +307,14 @@ class TestTextFormat:
                                         "delta", "echo"]
         f = df.filter(col("value") > "c").to_pandas()
         assert sorted(f["value"]) == ["charlie", "delta", "echo"]
+
+    def test_text_line_terminators_match_hadoop(self, session, tmp_path):
+        """Hadoop's LineReader treats \\n, \\r, and \\r\\n all as line
+        terminators; \\x0b (vertical tab) is NOT one — it stays inside the
+        line (the str.splitlines divergence)."""
+        d = tmp_path / "txt2"
+        d.mkdir()
+        (d / "mixed.txt").write_text(
+            "one\r\ntwo\rthree\nfo\x0bur\r", newline="")
+        got = session.read.text(str(d)).to_pandas()
+        assert list(got["value"]) == ["one", "two", "three", "fo\x0bur"]

@@ -1,0 +1,124 @@
+"""Filter-pushdown normalization (rules/pushdown.py).
+
+The reference's FilterIndexRule only matches Scan→Filter(→Project)
+(FilterIndexRule.scala:165) and relies on Spark's PushDownPredicate to
+normalize plans first; these tests pin that our pipeline provides the
+same normalization — the index rewrite must not depend on whether the
+user wrote where-then-select or select-then-where.
+"""
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+import hyperspace_tpu as hst
+from hyperspace_tpu.api import Hyperspace, IndexConfig
+from hyperspace_tpu.plan import expr as E
+from hyperspace_tpu.plan.expr import col, lit
+from hyperspace_tpu.plan.nodes import Filter, IndexScan, Project, Scan
+from hyperspace_tpu.rules.pushdown import push_filters
+
+
+@pytest.fixture()
+def env(tmp_path):
+    rng = np.random.default_rng(11)
+    df = pd.DataFrame({
+        "k": rng.integers(0, 100, 20_000).astype(np.int64),
+        "v": rng.random(20_000),
+        "w": rng.integers(0, 7, 20_000).astype(np.int64),
+    })
+    d = tmp_path / "data"
+    d.mkdir()
+    pq.write_table(pa.Table.from_pandas(df), d / "p0.parquet")
+    session = hst.Session(system_path=str(tmp_path / "indexes"))
+    hs = Hyperspace(session)
+    t = session.read.parquet(str(d))
+    hs.create_index(t, IndexConfig("pd_idx", ["k"], ["v", "w"]))
+    session.enable_hyperspace()
+    return dict(session=session, t=t, df=df)
+
+
+class TestPlanShape:
+    def test_filter_sinks_below_project(self, env):
+        t = env["t"]
+        q = t.select("k", "v").where(col("k") == 5)
+        plan = q.optimized_plan()
+        assert isinstance(plan, Project)
+        assert isinstance(plan.child, Filter)
+        leaves = plan.collect_leaves()
+        assert len(leaves) == 1 and isinstance(leaves[0], IndexScan)
+
+    def test_both_orders_rewrite_identically(self, env):
+        t = env["t"]
+        q1 = t.where(col("k") == 5).select("k", "v")
+        q2 = t.select("k", "v").where(col("k") == 5)
+        l1 = q1.optimized_plan().collect_leaves()
+        l2 = q2.optimized_plan().collect_leaves()
+        assert all(isinstance(l, IndexScan) for l in l1 + l2)
+
+    def test_sinks_through_stacked_projects(self, env):
+        t = env["t"]
+        q = t.select("k", "v", "w").select("k", "v").where(col("k") == 5)
+        leaves = q.optimized_plan().collect_leaves()
+        assert len(leaves) == 1 and isinstance(leaves[0], IndexScan)
+
+    def test_alias_substitution(self, env):
+        t = env["t"]
+        q = t.select(col("k").alias("key"), col("v")).where(col("key") == 5)
+        plan = push_filters(q.plan)
+        # The filter now sits below the project, referencing the base col.
+        assert isinstance(plan, Project)
+        assert isinstance(plan.child, Filter)
+        assert plan.child.condition.references == ["k"]
+
+    def test_computed_column_substitution(self, env):
+        t = env["t"]
+        q = t.select((col("k") + lit(1)).alias("k1"), col("v")) \
+             .where(col("k1") == 6)
+        plan = push_filters(q.plan)
+        assert isinstance(plan, Project)
+        assert isinstance(plan.child, Filter)
+        assert plan.child.condition.references == ["k"]
+
+    def test_aggregate_projection_not_pushed(self, env):
+        # A filter above an Aggregate output must stay put (HAVING shape).
+        t = env["t"]
+        q = t.group_by("k").agg(E.Sum(col("v")).alias("sv")) \
+             .where(col("sv") > 1.0)
+        plan = push_filters(q.plan)
+        assert isinstance(plan, Filter)  # unchanged root
+
+
+class TestResults:
+    def _expect(self, df, k):
+        out = df[df.k == k][["k", "v"]]
+        return out.sort_values(["k", "v"]).reset_index(drop=True)
+
+    def test_select_then_where_results(self, env):
+        t, df, session = env["t"], env["df"], env["session"]
+        q = t.select("k", "v").where(col("k") == 42)
+        got = q.to_pandas().sort_values(["k", "v"]).reset_index(drop=True)
+        pd.testing.assert_frame_equal(got, self._expect(df, 42))
+        session.disable_hyperspace()
+        raw = q.to_pandas().sort_values(["k", "v"]).reset_index(drop=True)
+        pd.testing.assert_frame_equal(got, raw)
+
+    def test_alias_filter_results(self, env):
+        t, df = env["t"], env["df"]
+        q = t.select(col("k").alias("key"), col("v")).where(
+            (col("key") >= 10) & (col("key") < 13))
+        got = q.to_pandas()
+        exp = df[(df.k >= 10) & (df.k < 13)]
+        assert len(got) == len(exp)
+        assert set(got.columns) == {"key", "v"}
+
+    def test_computed_filter_results(self, env):
+        t, df = env["t"], env["df"]
+        q = t.select((col("k") * lit(2)).alias("k2"), col("w")) \
+             .where(col("k2") == 84)
+        got = q.to_pandas()
+        exp = df[df.k * 2 == 84]
+        assert len(got) == len(exp)
+        assert (got["k2"] == 84).all()
