@@ -364,6 +364,11 @@ def read_parquet(files: Sequence[str], columns: Optional[Sequence[str]] = None,
         at = pa.concat_tables(tables)
         if columns:
             at = at.select(list(columns))
+    elif fmt == "avro":
+        from ..util.avro import read_avro
+        tables = [read_avro(f, list(columns) if columns else None)
+                  for f in files]
+        at = pa.concat_tables(tables)
     elif fmt == "json":
         # Newline-delimited JSON (the reference's spark json source shape,
         # DefaultFileBasedSource.scala:37-44).

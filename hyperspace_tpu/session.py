@@ -160,6 +160,10 @@ class DataFrameReader:
         """One string column "value" per line (Spark text source)."""
         return self.format("text").load(*paths)
 
+    def avro(self, *paths: str) -> "DataFrame":
+        """Avro object container files (built-in reader, util/avro.py)."""
+        return self.format("avro").load(*paths)
+
     def delta(self, path: str, version_as_of: Optional[int] = None
               ) -> "DataFrame":
         """Read a commit-log versioned table (lake/delta.py), optionally

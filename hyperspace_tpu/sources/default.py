@@ -19,10 +19,10 @@ from ..schema import Schema
 from ..util import file_utils, hashing
 from .interfaces import FileBasedRelation, FileBasedSourceProvider
 
-# Parity: DefaultFileBasedSource.scala:37-44 supports
-# avro/csv/json/orc/parquet/text; avro is the one absence (no avro reader
-# in this image — documented gap).
-SUPPORTED_FORMATS = ("parquet", "csv", "json", "orc", "text")
+# Parity: DefaultFileBasedSource.scala:37-44 — the full format set
+# (avro via the built-in OCF reader in util/avro.py; the image ships no
+# avro library).
+SUPPORTED_FORMATS = ("parquet", "csv", "json", "orc", "text", "avro")
 
 # File suffixes per format ("text" matches Spark's .txt convention too).
 _FORMAT_SUFFIXES = {fmt: ("." + fmt,) for fmt in SUPPORTED_FORMATS}
@@ -77,6 +77,9 @@ class DefaultFileBasedRelation(FileBasedRelation):
             # Spark text-source schema: one non-null string column.
             from ..schema import STRING, Field
             return Schema([Field("value", STRING, False)])
+        if self._format == "avro":
+            from ..util.avro import read_avro_schema
+            return Schema.from_arrow(read_avro_schema(files[0]))
         ds = pa_ds.dataset(files[0], format=self._format)
         return Schema.from_arrow(ds.schema)
 
