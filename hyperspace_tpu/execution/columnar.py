@@ -63,11 +63,6 @@ class Column:
                       else jnp.take(self.validity, indices, axis=0),
                       self.dictionary)
 
-    def filter(self, mask) -> "Column":
-        return Column(self.dtype, self.data[mask],
-                      None if self.validity is None else self.validity[mask],
-                      self.dictionary)
-
     def slice(self, start: int, stop: int) -> "Column":
         return Column(self.dtype, self.data[start:stop],
                       None if self.validity is None else self.validity[start:stop],
@@ -127,7 +122,15 @@ class Table:
 
     def filter(self, mask) -> "Table":
         # A subsequence of bucket-ordered rows is still bucket-ordered.
-        return Table({n: c.filter(mask) for n, c in self.columns.items()},
+        # One flatnonzero for the whole table: per-column boolean indexing
+        # would re-run the mask→indices conversion for every column (and
+        # jax's bool-index path is markedly slower than an int gather).
+        if mask.shape[0] != self.num_rows:
+            # jnp.take clips out-of-range indices silently; fail loud here.
+            raise HyperspaceException(
+                f"filter mask length {mask.shape[0]} != rows {self.num_rows}")
+        idx = jnp.flatnonzero(mask)
+        return Table({n: c.take(idx) for n, c in self.columns.items()},
                      bucket_order=self.bucket_order)
 
     def slice(self, start: int, stop: int) -> "Table":

@@ -136,6 +136,18 @@ INDEXED_TABLES = {"li_ok_idx": "lineitem", "od_ok_idx": "orders",
 # reference's golden files capture too).
 # ---------------------------------------------------------------------------
 
+# Collection-time list of every query name below (pytest parametrizes from
+# this without building the datasets; queries() asserts it stays in sync).
+QUERY_NAMES = [
+    "tpch_q1", "tpch_q3", "tpch_q6", "tpch_q12", "tpcds_q1_like",
+    "self_join", "tpch_q14", "tpch_q17", "tpch_q18", "tpch_q19",
+    "groupby_index", "tpcds_q3_like", "multi_key_join",
+    "pushdown_select_where", "pushdown_alias", "tpch_q5_like",
+    "tpch_q10_like", "having_over_groupby", "filter_topk_rows",
+    "tpcds_q7_like", "join_on_aggregate", "in_list_indexed",
+]
+
+
 def queries(dfs):
     from hyperspace_tpu.plan.expr import avg, col, count, sum_
 
@@ -268,4 +280,82 @@ def queries(dfs):
         .agg(sum_(col("sr_return_amt")).alias("ret"))
         .sort("s_state"))
 
+    # select-then-where: the filter must sink through the projection and
+    # still hit the covering index (rules/pushdown.py surface; columns
+    # chosen to be covered by li_ship_idx so the rewrite fires).
+    q["pushdown_select_where"] = (
+        li.select("l_quantity", "l_extendedprice", "l_shipdate")
+        .where(col("l_shipdate") > d(1997, 1, 1))
+        .select("l_quantity", "l_extendedprice"))
+
+    # Pushdown through an alias: predicate names the projected alias.
+    q["pushdown_alias"] = (
+        li.select(col("l_shipdate").alias("ship"), col("l_extendedprice"))
+        .where(col("ship").between(d(1995, 1, 1), d(1995, 12, 31))))
+
+    # TPC-H Q5-like: three-table chain join, revenue by order priority.
+    q["tpch_q5_like"] = (
+        li.join(od, on=col("l_orderkey") == col("o_orderkey"))
+        .join(cu.select(col("c_customer_sk").alias("cust_sk"),
+                        "c_customer_id"),
+              on=col("o_custkey") == col("cust_sk"))
+        .group_by("o_orderpriority")
+        .agg(sum_(col("l_extendedprice") * (1 - col("l_discount")))
+             .alias("revenue"))
+        .sort("o_orderpriority"))
+
+    # TPC-H Q10-like: customer revenue from a date-bounded order window.
+    q["tpch_q10_like"] = (
+        od.filter(col("o_orderdate").between(d(1993, 10, 1), d(1994, 1, 1)))
+        .join(li, on=col("o_orderkey") == col("l_orderkey"))
+        .group_by("o_custkey")
+        .agg(sum_(col("l_extendedprice") * (1 - col("l_discount")))
+             .alias("revenue"))
+        .sort(("revenue", False)).limit(20))
+
+    # HAVING over an indexed group-by (filter above aggregate must NOT be
+    # pushed below it — the pushdown rule's stop condition).
+    q["having_over_groupby"] = (
+        li.group_by("l_partkey")
+        .agg(sum_(col("l_quantity")).alias("qty"))
+        .filter(col("qty") > 100)
+        .sort("l_partkey"))
+
+    # Row-returning filter + order + top-k, no aggregate (the plain
+    # covering-index scan path with a sort above it).
+    q["filter_topk_rows"] = (
+        li.filter(col("l_shipdate") > d(1997, 6, 1))
+        .select("l_orderkey", "l_extendedprice", "l_shipdate")
+        .sort(("l_extendedprice", False)).limit(25))
+
+    # TPC-DS Q7-like: two dimension filters on the fact scan + group-by.
+    q["tpcds_q7_like"] = (
+        sr.filter(col("sr_return_amt") > 50)
+        .join(dd.filter(col("d_moy") <= 6),
+              on=col("sr_returned_date_sk") == col("d_date_sk"))
+        .group_by("sr_customer_sk")
+        .agg(avg(col("sr_return_amt")).alias("avg_ret"),
+             count(None).alias("n"))
+        .sort("sr_customer_sk").limit(30))
+
+    # Join whose probe side is itself an aggregate over an indexed key
+    # (exercises index-assisted build under a join consumer).
+    per_store = (sr.group_by("sr_store_sk")
+                 .agg(sum_(col("sr_return_amt")).alias("store_ret"))
+                 .select(col("sr_store_sk").alias("agg_store_sk"),
+                         "store_ret"))
+    q["join_on_aggregate"] = (
+        dfs["store"].join(per_store,
+                          on=col("s_store_sk") == col("agg_store_sk"))
+        .select("s_state", "store_ret")
+        .sort(("store_ret", False)))
+
+    # IN-list predicate over the first indexed column (In → bucket-subset
+    # pruning in the index scan).
+    q["in_list_indexed"] = (
+        li.filter(col("l_orderkey").isin([1, 5, 9, 13]))
+        .select("l_orderkey", "l_extendedprice"))
+
+    assert sorted(q) == sorted(QUERY_NAMES), \
+        f"QUERY_NAMES out of sync: {sorted(set(q) ^ set(QUERY_NAMES))}"
     return q

@@ -90,4 +90,4 @@ class TestDefaultSource:
         rel = mgr.build_relation([str(data_dir)], "parquet", {})
         assert isinstance(rel, DefaultFileBasedRelation)
         with pytest.raises(HyperspaceException):
-            mgr.build_relation([str(data_dir)], "avro", {})
+            mgr.build_relation([str(data_dir)], "xml", {})
