@@ -27,7 +27,7 @@ from ..schema import BOOL, DATE, FLOAT64, INT32, INT64, STRING
 from .columnar import (Column, Table, dictionaries_equal, read_parquet,
                        translate_codes)
 from .evaluator import eval_expr, eval_predicate_mask
-from .pushdown import pushable_filter
+from .pushdown import pruned_index_read_filter, pushable_filter
 
 
 # Session for the in-flight execution: the SPMD dispatch reads its conf
@@ -75,8 +75,12 @@ def _execute(plan: LogicalPlan, needed: Optional[Set[str]]) -> Table:
                 table = _execute_scan(plan.child, child_needed, pa_filter)
             else:
                 buckets = _equality_bucket_subset(plan.child, plan.condition)
+                pruned = pruned_index_read_filter(
+                    plan.child.index_entry, plan.condition,
+                    plan.child.schema) is not None
                 table = _execute_index_scan(plan.child, child_needed, pa_filter,
-                                            bucket_subset=buckets)
+                                            bucket_subset=buckets,
+                                            prefer_pruned_read=pruned)
         else:
             table = _execute(plan.child, child_needed)
         mask = eval_predicate_mask(table, plan.condition)
@@ -273,7 +277,8 @@ def _equality_values(conjunct, column: str):
 
 def _execute_index_scan(plan: IndexScan, needed: Optional[Set[str]],
                         pa_filter=None,
-                        bucket_subset: Optional[Set[int]] = None) -> Table:
+                        bucket_subset: Optional[Set[int]] = None,
+                        prefer_pruned_read: bool = False) -> Table:
     from ..index.constants import IndexConstants
     from ..ops.index_build import bucket_id_from_file
 
@@ -314,10 +319,14 @@ def _execute_index_scan(plan: IndexScan, needed: Optional[Set[str]],
         table = empty_table(entry.schema.select(cols or entry.schema.names))
     else:
         from . import index_cache
-        if index_cache.enabled():
+        if index_cache.enabled() \
+                and not (prefer_pruned_read and pa_filter is not None):
             # HBM-resident path: cache the *unfiltered* read (the Filter
             # node above always re-evaluates its mask on device, so skipping
-            # the parquet-level pushdown is purely an IO trade).
+            # the parquet-level pushdown is purely an IO trade). Leading-
+            # indexed-column filters bypass the cache: the sorted layout
+            # makes row-group pruning read ~selectivity of the file, far
+            # cheaper than masking the whole cached table.
             key = (entry.id, entry.name, tuple(index_files),
                    tuple(cols) if cols is not None else None)
             cache = index_cache.get_cache()

@@ -89,6 +89,35 @@ def _translate(e: E.Expr, schema: Schema, allow_nested: bool):
     return None
 
 
+def filter_constrains(condition: E.Expr, schema: Schema,
+                      column: str) -> bool:
+    """True when at least one *pushable* conjunct references only
+    ``column``. Used to decide whether an index read should go through the
+    parquet reader (row-group pruning on the leading sorted column beats a
+    cached full-table device mask) instead of the HBM-resident cache."""
+    for conjunct in E.split_conjunctive_predicates(condition):
+        if conjunct.references == [column] \
+                and _translate(conjunct, schema, True) is not None:
+            return True
+    return False
+
+
+def pruned_index_read_filter(entry, condition: E.Expr,
+                             schema: Schema) -> Optional[pc.Expression]:
+    """The pa filter to read a covering index with INSTEAD of the HBM
+    cache, or None to use the cache. Policy (shared by the single-device
+    executor and the SPMD leaf load): when a pushable conjunct constrains
+    the LEADING indexed column, the within-bucket sort makes row-group
+    stats tight — a pruned parquet read costs ~selectivity of the file,
+    far cheaper than masking a cached full table."""
+    if entry.derivedDataset.kind != "CoveringIndex" \
+            or not entry.indexed_columns:
+        return None
+    if not filter_constrains(condition, schema, entry.indexed_columns[0]):
+        return None
+    return pushable_filter(condition, schema)
+
+
 def pushable_filter(condition: E.Expr, schema: Schema,
                     allow_nested: bool = True) -> Optional[pc.Expression]:
     """AND of the translatable conjuncts, or None.

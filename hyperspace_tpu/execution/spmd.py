@@ -115,6 +115,43 @@ def _linearize(plan: LogicalPlan):
             raise _Unsupported(node.node_name)
 
 
+def _load_leaf(leaf, stages, needed, executor) -> "Table":
+    """Materialize the stream leaf, pruning the read when possible.
+
+    Filter stages sitting DIRECTLY above an IndexScan leaf (before any
+    project/join stage) are necessary conditions on the raw leaf rows, so
+    their pushable conjuncts can narrow the parquet read; when one
+    constrains the leading indexed column, the within-bucket sort makes
+    row-group pruning sharp and the read bypasses the HBM cache (same
+    policy as the single-device path in executor._execute). The later
+    mask evaluation over the pruned rows is unchanged — pushdown is an
+    IO optimization, never a semantic transfer."""
+    if isinstance(leaf, IndexScan):
+        from . import executor as ex
+        from .pushdown import pruned_index_read_filter
+
+        conds = []
+        for kind, node in stages:
+            if kind != "filter":
+                break
+            conds.append(node.condition)
+        if conds:
+            combined = conds[0]
+            for c in conds[1:]:
+                combined = E.And(combined, c)
+            pa_filter = pruned_index_read_filter(
+                leaf.index_entry, combined, leaf.schema)
+            if pa_filter is not None:
+                table = ex._execute_index_scan(
+                    leaf, needed, pa_filter, prefer_pruned_read=True)
+                if table.num_rows > 0:
+                    return table
+                # Filter matched nothing: fall through to the cached full
+                # read so the SPMD stream still runs (an all-false mask)
+                # instead of a spurious single-device fallback.
+    return executor(leaf, needed)
+
+
 def _normalized_join_pairs(join: Join) -> List[Tuple[str, str]]:
     pairs = E.extract_equi_join_keys(join.condition)
     if pairs is None:
@@ -552,7 +589,9 @@ def _prepare(root, executor, caps: Dict[int, Tuple[int, int]]) -> _Prepared:
     leaf_needed, right_needed, project_live = _needed_per_stage(
         out_needed, stages)
 
-    leaf_table = executor(leaf, set(leaf_needed) if leaf_needed else None)
+    leaf_table = _load_leaf(leaf, stages,
+                            set(leaf_needed) if leaf_needed else None,
+                            executor)
     if leaf_table.num_rows == 0:
         raise _Unsupported("empty stream")
 

@@ -66,6 +66,11 @@ def _build() -> Optional[ctypes.CDLL]:
     lib.hst_minmax_prune_i64.argtypes = [
         i64p, i64p, u8p, ctypes.c_int64, ctypes.c_int64, ctypes.c_int32, u8p]
     lib.hst_minmax_prune_i64.restype = None
+    lib.hst_avro_decode_block.argtypes = [
+        u8p, ctypes.c_int64, ctypes.c_int64, ctypes.c_int32, i32p,
+        ctypes.POINTER(i64p), ctypes.POINTER(f64p), ctypes.POINTER(i32p),
+        ctypes.POINTER(u8p), i64p, ctypes.POINTER(u8p)]
+    lib.hst_avro_decode_block.restype = ctypes.c_int64
     return lib
 
 
@@ -257,6 +262,85 @@ def minmax_prune(lo_rows: List, hi_rows: List, op: str, value, dtype: str
             return out.astype(bool)
         return _np_prune(lo, hi, has, v, op_code)
     return None
+
+
+# ---------------------------------------------------------------------------
+# Avro block decode: one C++ pass over a block instead of a Python row loop.
+# ---------------------------------------------------------------------------
+
+# prim name → wire code (must match hst_native.cpp's switch).
+AVRO_PRIMS = {"boolean": 0, "int": 1, "long": 2, "float": 3, "double": 4,
+              "string": 5, "bytes": 6, "null": 7}
+
+_AVRO_ERRORS = {-1: "truncated data", -2: "bad union branch",
+                -3: "varint too long", -4: "unknown primitive"}
+
+
+def avro_decode_block(block: bytes, count: int, plans: List) -> Optional[List]:
+    """Decode one OCF block natively. ``plans`` is [(prim, null_branch)]
+    per field (null_branch None for non-nullable). Returns per-field
+    (kind, values, valid) where kind is "i" (int64 array), "d" (float64
+    array), or "s" (offsets int32 array, data bytes) — or None when the
+    native library is unavailable (caller runs the Python decoder).
+    Raises ValueError on corrupt blocks (same conditions as the Python
+    decoder's HyperspaceException paths)."""
+    lib = get_lib()
+    if lib is None or count == 0:
+        return None
+    n_fields = len(plans)
+    buf = np.frombuffer(block, dtype=np.uint8)
+    plan_arr = np.zeros(2 * n_fields, dtype=np.int32)
+    i64p = ctypes.POINTER(ctypes.c_int64)
+    f64p = ctypes.POINTER(ctypes.c_double)
+    i32p = ctypes.POINTER(ctypes.c_int32)
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+    ivals = (i64p * n_fields)()
+    dvals = (f64p * n_fields)()
+    offs = (i32p * n_fields)()
+    sdata = (u8p * n_fields)()
+    valids = (u8p * n_fields)()
+    holders = []  # (field, kind, arrays...) keeping numpy alive + for output
+    sdata_len = np.zeros(n_fields, dtype=np.int64)
+    for f, (prim, null_branch) in enumerate(plans):
+        code = AVRO_PRIMS[prim]
+        plan_arr[2 * f] = code
+        plan_arr[2 * f + 1] = -1 if null_branch is None else null_branch
+        valid = np.ones(count, dtype=np.uint8)
+        valids[f] = valid.ctypes.data_as(u8p)
+        if code in (0, 1, 2):
+            a = np.zeros(count, dtype=np.int64)
+            ivals[f] = a.ctypes.data_as(i64p)
+            holders.append(("i", a, valid))
+        elif code in (3, 4):
+            a = np.zeros(count, dtype=np.float64)
+            dvals[f] = a.ctypes.data_as(f64p)
+            holders.append(("d", a, valid))
+        elif code in (5, 6):
+            o = np.zeros(count + 1, dtype=np.int32)
+            d = np.zeros(max(len(block), 1), dtype=np.uint8)
+            offs[f] = o.ctypes.data_as(i32p)
+            sdata[f] = d.ctypes.data_as(u8p)
+            holders.append(("s", o, d, valid))
+        else:  # null type
+            a = np.zeros(count, dtype=np.int64)
+            ivals[f] = a.ctypes.data_as(i64p)
+            valid[:] = 0
+            holders.append(("i", a, valid))
+    rc = lib.hst_avro_decode_block(
+        buf.ctypes.data_as(u8p), len(block), count, n_fields,
+        plan_arr.ctypes.data_as(i32p), ivals, dvals, offs, sdata,
+        sdata_len.ctypes.data_as(i64p), valids)
+    if rc < 0:
+        raise ValueError(f"avro: {_AVRO_ERRORS.get(int(rc), rc)}")
+    out = []
+    for f, h in enumerate(holders):
+        if h[0] == "s":
+            _, o, d, valid = h
+            out.append(("s", o, bytes(d[:int(sdata_len[f])]), valid))
+        else:
+            kind, a, valid = h
+            out.append((kind, a, valid))
+    return out
 
 
 def _np_prune(lo, hi, has, v, op_code) -> np.ndarray:

@@ -212,6 +212,11 @@ def read_avro(path: str,
     fields = schema.get("fields", [])
     plans = [(f["name"], *_field_plan(f["type"])) for f in fields]
 
+    from .. import native as hst_native
+
+    native_plans = [(prim, nb) for _, prim, nb, _ in plans]
+    use_native = True
+    native_chunks: List[Tuple[int, List]] = []  # (row count, field pieces)
     cells: Dict[str, List[Any]] = {name: [] for name, *_ in plans}
     decoders = [(name, _decoder(prim), null_branch)
                 for name, prim, null_branch, _ in plans]
@@ -221,34 +226,61 @@ def read_avro(path: str,
         block = r.read(size)
         if codec == "deflate":
             block = zlib.decompress(block, -15)
-        br = _Reader(block)
-        for _ in range(count):
-            for name, dec, null_branch in decoders:
-                if null_branch is not None:
-                    branch = br.read_long()
-                    cells[name].append(
-                        None if branch == null_branch else dec(br))
-                else:
-                    cells[name].append(dec(br))
+        if count == 0:
+            # Zero-object blocks are legal (writers emit them on flush);
+            # nothing to decode, and they must NOT flip the native path
+            # off — later rows would land in `cells` and be dropped by
+            # the native-chunks assembly.
+            if r.read(16) != sync:
+                raise HyperspaceException(
+                    f"avro: sync marker mismatch in {path}")
+            continue
+        decoded = None
+        if use_native:
+            # One C++ pass per block (native/hst_native.cpp); falls back to
+            # the Python row loop only when no compiler is available.
+            try:
+                decoded = hst_native.avro_decode_block(
+                    block, count, native_plans)
+            except ValueError as e:
+                raise HyperspaceException(f"avro: {e} in {path}")
+            if decoded is None:
+                use_native = False
+        if decoded is not None:
+            native_chunks.append((count, decoded))
+        else:
+            br = _Reader(block)
+            for _ in range(count):
+                for name, dec, null_branch in decoders:
+                    if null_branch is not None:
+                        branch = br.read_long()
+                        cells[name].append(
+                            None if branch == null_branch else dec(br))
+                    else:
+                        cells[name].append(dec(br))
         if r.read(16) != sync:
             raise HyperspaceException(f"avro: sync marker mismatch in {path}")
 
     arrays = []
     names = []
-    for name, prim, null_branch, logical in plans:
+    for fi, (name, prim, null_branch, logical) in enumerate(plans):
         if columns is not None and name not in columns:
             continue
-        at = _arrow_type(prim, logical)
-        vals = cells[name]
-        if logical == "date":
-            arr = pa.array(
-                np.array([v if v is not None else 0 for v in vals],
-                         dtype="int32"),
-                type=pa.int32(),
-                mask=np.array([v is None for v in vals], dtype=bool)
-                if null_branch is not None else None).cast(pa.date32())
+        if native_chunks:
+            arr = _assemble_native(native_chunks, fi, prim, null_branch,
+                                   logical)
         else:
-            arr = pa.array(vals, type=at)
+            at = _arrow_type(prim, logical)
+            vals = cells[name]
+            if logical == "date":
+                arr = pa.array(
+                    np.array([v if v is not None else 0 for v in vals],
+                             dtype="int32"),
+                    type=pa.int32(),
+                    mask=np.array([v is None for v in vals], dtype=bool)
+                    if null_branch is not None else None).cast(pa.date32())
+            else:
+                arr = pa.array(vals, type=at)
         arrays.append(arr)
         names.append(name)
     if columns is not None:
@@ -260,6 +292,50 @@ def read_avro(path: str,
         arrays = [arrays[order[c]] for c in columns]
         names = list(columns)
     return pa.table(dict(zip(names, arrays)))
+
+
+def _assemble_native(native_chunks: List[Tuple[int, List]], fi: int,
+                     prim: str, null_branch: Optional[int],
+                     logical: Optional[str]) -> pa.Array:
+    """Arrow array for field ``fi`` from the per-block native decode
+    results (per-block pa arrays concatenated — zero Python per row)."""
+    parts = []
+    nullable = null_branch is not None
+    for count, fields in native_chunks:
+        piece = fields[fi]
+        if piece[0] == "s":
+            _, offsets, data, valid = piece
+            at = pa.utf8() if prim == "string" else pa.binary()
+            validity_buf = None
+            null_count = 0
+            if nullable:
+                null_count = int(count - valid.sum())
+                if null_count:
+                    validity_buf = pa.py_buffer(np.packbits(
+                        valid.astype(bool), bitorder="little").tobytes())
+            parts.append(pa.Array.from_buffers(
+                at, count,
+                [validity_buf, pa.py_buffer(offsets.tobytes()),
+                 pa.py_buffer(data)], null_count))
+            continue
+        kind, vals, valid = piece
+        mask = (valid == 0) if nullable else None
+        if prim == "null":
+            parts.append(pa.nulls(count))
+        elif logical == "date":
+            parts.append(pa.array(vals.astype(np.int32), type=pa.int32(),
+                                  mask=mask).cast(pa.date32()))
+        elif prim == "boolean":
+            parts.append(pa.array(vals.astype(bool), mask=mask))
+        elif prim == "int":
+            parts.append(pa.array(vals.astype(np.int32), mask=mask))
+        elif prim == "long":
+            parts.append(pa.array(vals, mask=mask))
+        elif prim == "float":
+            parts.append(pa.array(vals.astype(np.float32), mask=mask))
+        else:  # double
+            parts.append(pa.array(vals, mask=mask))
+    return pa.concat_arrays(parts) if len(parts) > 1 else parts[0]
 
 
 # ---------------------------------------------------------------------------

@@ -131,6 +131,30 @@ class TestBinaryFormat:
         assert back.column("k").to_pylist() == [k for k, _ in rows]
         assert back.column("s").to_pylist() == [s for _, s in rows]
 
+    def test_zero_row_block_mid_file(self, tmp_path):
+        """Zero-object blocks (legal, emitted by some writers on flush)
+        must not disable the native path mid-file — that silently dropped
+        every row decoded after the empty block."""
+        schema = {"type": "record", "name": "R", "fields": [
+            {"name": "k", "type": "long"}]}
+        sync = b"0123456789abcdef"
+        out = io.BytesIO()
+        out.write(b"Obj\x01")
+        out.write(_encode_long(1))
+        out.write(_encode_bytes(b"avro.schema"))
+        out.write(_encode_bytes(json.dumps(schema).encode()))
+        out.write(_encode_long(0))
+        out.write(sync)
+        for chunk in ([1, 2], [], [3, 4]):
+            body = b"".join(_encode_long(v) for v in chunk)
+            out.write(_encode_long(len(chunk)))
+            out.write(_encode_long(len(body)))
+            out.write(body)
+            out.write(sync)
+        p = tmp_path / "zb.avro"
+        p.write_bytes(out.getvalue())
+        assert read_avro(str(p)).column("k").to_pylist() == [1, 2, 3, 4]
+
     def test_zigzag_negative_longs(self, tmp_path):
         t = pa.table({"v": pa.array([0, -1, 1, -2**62, 2**62], pa.int64())})
         p = str(tmp_path / "z.avro")
@@ -197,6 +221,51 @@ class TestBinaryFormat:
         p = tmp_path / "cx.avro"
         p.write_bytes(out.getvalue())
         with pytest.raises(HyperspaceException, match="unsupported"):
+            read_avro(str(p))
+
+
+class TestNativeDecoder:
+    def test_native_and_python_decodes_identical(self, tmp_path, monkeypatch):
+        """The C++ block decoder (native/hst_native.cpp) and the Python
+        row loop must produce bit-identical tables — every type, nulls,
+        dates, strings, multi-block deflate."""
+        from hyperspace_tpu import native as hst_native
+        if not hst_native.available():
+            pytest.skip("no native toolchain")
+        t = _sample_table(n=5000, nulls=True)
+        p1 = str(tmp_path / "x.avro")
+        write_avro(t, p1)
+        p2 = str(tmp_path / "d.avro")
+        _write_deflate_ocf(p2, [(i - 100, f"s{i}") for i in range(999)])
+        native_tables = [read_avro(p1), read_avro(p2)]
+        monkeypatch.setattr(hst_native, "avro_decode_block",
+                            lambda *a, **k: None)  # force the Python loop
+        python_tables = [read_avro(p1), read_avro(p2)]
+        for nt, pt in zip(native_tables, python_tables):
+            assert nt.equals(pt)
+
+    def test_native_rejects_corrupt_block(self, tmp_path):
+        from hyperspace_tpu import native as hst_native
+        if not hst_native.available():
+            pytest.skip("no native toolchain")
+        schema = {"type": "record", "name": "R", "fields": [
+            {"name": "s", "type": "string"}]}
+        out = io.BytesIO()
+        out.write(b"Obj\x01")
+        out.write(_encode_long(1))
+        out.write(_encode_bytes(b"avro.schema"))
+        out.write(_encode_bytes(json.dumps(schema).encode()))
+        out.write(_encode_long(0))
+        sync = b"0123456789abcdef"
+        out.write(sync)
+        body = _encode_long(1000) + b"xy"  # claims 500-byte string, has 2
+        out.write(_encode_long(1))
+        out.write(_encode_long(len(body)))
+        out.write(body)
+        out.write(sync)
+        p = tmp_path / "corrupt.avro"
+        p.write_bytes(out.getvalue())
+        with pytest.raises(HyperspaceException, match="truncated"):
             read_avro(str(p))
 
 
