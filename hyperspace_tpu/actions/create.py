@@ -290,8 +290,13 @@ class CreateAction(CreateActionBase):
     def _resolve_columns(self):
         if self._resolved is None:
             schema_names = self.df.plan.schema.names
-            indexed = resolve_all(schema_names, self.index_config.indexed_columns)
-            included = resolve_all(schema_names, self.index_config.included_columns)
+            cs = self.session.hs_conf.case_sensitive()
+            indexed = resolve_all(schema_names,
+                                  self.index_config.indexed_columns,
+                                  case_sensitive=cs)
+            included = resolve_all(schema_names,
+                                   self.index_config.included_columns,
+                                   case_sensitive=cs)
             dup = set(indexed) & set(included)
             if dup:
                 raise HyperspaceException(

@@ -140,8 +140,10 @@ class CreateDataSkippingAction(CreateActionBase):
         if self._sketches is None:
             names = self.df.plan.schema.names
             out = []
+            cs = self.session.hs_conf.case_sensitive()
             for spec in self.index_config.sketches:
-                column = resolve_all(names, [spec.column])[0]
+                column = resolve_all(names, [spec.column],
+                                     case_sensitive=cs)[0]
                 out.append(Sketch(spec.kind, column, spec.properties()))
             self._sketches = out
         return self._sketches

@@ -124,6 +124,11 @@ class HyperspaceConf:
             IndexConstants.INDEX_LINEAGE_ENABLED,
             IndexConstants.INDEX_LINEAGE_ENABLED_DEFAULT)
 
+    def case_sensitive(self) -> bool:
+        return self._get_bool(
+            IndexConstants.CASE_SENSITIVE,
+            IndexConstants.CASE_SENSITIVE_DEFAULT)
+
     def optimize_file_size_threshold(self) -> int:
         return int(
             self._conf.get(

@@ -27,7 +27,7 @@ from ..schema import BOOL, DATE, FLOAT64, INT32, INT64, STRING
 from .columnar import (Column, Table, dictionaries_equal, read_parquet,
                        translate_codes)
 from .evaluator import eval_expr, eval_predicate_mask
-from .pushdown import pruned_index_read_filter, pushable_filter
+from .pushdown import prefers_pruned_read, pushable_filter
 
 
 # Session for the in-flight execution: the SPMD dispatch reads its conf
@@ -75,9 +75,8 @@ def _execute(plan: LogicalPlan, needed: Optional[Set[str]]) -> Table:
                 table = _execute_scan(plan.child, child_needed, pa_filter)
             else:
                 buckets = _equality_bucket_subset(plan.child, plan.condition)
-                pruned = pruned_index_read_filter(
-                    plan.child.index_entry, plan.condition,
-                    plan.child.schema) is not None
+                pruned = pa_filter is not None and prefers_pruned_read(
+                    plan.child.index_entry, plan.condition, plan.child.schema)
                 table = _execute_index_scan(plan.child, child_needed, pa_filter,
                                             bucket_subset=buckets,
                                             prefer_pruned_read=pruned)

@@ -102,18 +102,24 @@ def filter_constrains(condition: E.Expr, schema: Schema,
     return False
 
 
+def prefers_pruned_read(entry, condition: E.Expr, schema: Schema) -> bool:
+    """Policy (shared by the single-device executor and the SPMD leaf
+    load): when a pushable conjunct constrains the LEADING indexed
+    column, the within-bucket sort makes row-group stats tight — a
+    pruned parquet read costs ~selectivity of the file, far cheaper than
+    masking a cached full table. No expression translation happens here;
+    callers that already built a pa filter just reuse it."""
+    return (entry.derivedDataset.kind == "CoveringIndex"
+            and bool(entry.indexed_columns)
+            and filter_constrains(condition, schema,
+                                  entry.indexed_columns[0]))
+
+
 def pruned_index_read_filter(entry, condition: E.Expr,
                              schema: Schema) -> Optional[pc.Expression]:
     """The pa filter to read a covering index with INSTEAD of the HBM
-    cache, or None to use the cache. Policy (shared by the single-device
-    executor and the SPMD leaf load): when a pushable conjunct constrains
-    the LEADING indexed column, the within-bucket sort makes row-group
-    stats tight — a pruned parquet read costs ~selectivity of the file,
-    far cheaper than masking a cached full table."""
-    if entry.derivedDataset.kind != "CoveringIndex" \
-            or not entry.indexed_columns:
-        return None
-    if not filter_constrains(condition, schema, entry.indexed_columns[0]):
+    cache, or None to use the cache (see prefers_pruned_read)."""
+    if not prefers_pruned_read(entry, condition, schema):
         return None
     return pushable_filter(condition, schema)
 

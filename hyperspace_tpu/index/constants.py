@@ -104,6 +104,12 @@ class IndexConstants:
 
     GLOBBING_PATTERN_KEY = "hyperspace.source.globbingPattern"
 
+    # Column-name resolution sensitivity (parity: Spark's
+    # spark.sql.caseSensitive, which the reference's ResolverUtils reads;
+    # default false like Spark).
+    CASE_SENSITIVE = "hyperspace.caseSensitive"
+    CASE_SENSITIVE_DEFAULT = "false"
+
     # Pluggable class names (comma separated), mirrors
     # spark.hyperspace.index.sources.fileBasedBuilders and
     # spark.hyperspace.index.signatureProviders.

@@ -331,7 +331,7 @@ def avro_decode_block(block: bytes, count: int, plans: List) -> Optional[List]:
         plan_arr.ctypes.data_as(i32p), ivals, dvals, offs, sdata,
         sdata_len.ctypes.data_as(i64p), valids)
     if rc < 0:
-        raise ValueError(f"avro: {_AVRO_ERRORS.get(int(rc), rc)}")
+        raise ValueError(str(_AVRO_ERRORS.get(int(rc), rc)))
     out = []
     for f, h in enumerate(holders):
         if h[0] == "s":

@@ -103,10 +103,12 @@ static inline int read_varint(const uint8_t* buf, int64_t len, int64_t* pos,
   while (true) {
     if (*pos >= len) return -1;
     const uint8_t b = buf[(*pos)++];
+    // Guard BEFORE shifting: a shift >= 64 is UB (would silently wrap on
+    // x86 and feed a corrupted length into the memcpy bounds check).
+    if (shift >= 64) return -3;
     acc |= static_cast<uint64_t>(b & 0x7F) << shift;
     if (!(b & 0x80)) break;
     shift += 7;
-    if (shift > 70) return -3;
   }
   *out = static_cast<int64_t>(acc >> 1) ^ -static_cast<int64_t>(acc & 1);
   return 0;
@@ -177,7 +179,9 @@ int64_t hst_avro_decode_block(const uint8_t* buf, int64_t buf_len,
           int64_t n;
           const int rc = read_varint(buf, buf_len, &pos, &n);
           if (rc) return rc;
-          if (n < 0 || pos + n > buf_len) return -1;
+          // `pos + n` would overflow signed int64 for huge corrupt
+          // lengths (UB) — compare against the remaining bytes instead.
+          if (n < 0 || n > buf_len - pos) return -1;
           const int64_t at = sdata_len[f];
           __builtin_memcpy(sdata[f] + at, buf + pos, n);
           sdata_len[f] = at + n;

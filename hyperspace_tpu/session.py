@@ -55,6 +55,35 @@ class Session:
         return DataFrameReader(self)
 
     # ------------------------------------------------------------------
+    # Temp views (parity: the reference's E2E suites query indexed data
+    # through Spark views; view names are case-insensitive like Spark's).
+    # ------------------------------------------------------------------
+
+    def create_temp_view(self, name: str, df: "DataFrame",
+                         replace: bool = False) -> None:
+        key = name.lower()
+        views = getattr(self, "_temp_views", None)
+        if views is None:
+            views = self._temp_views = {}
+        if key in views and not replace:
+            raise HyperspaceException(f"Temp view already exists: {name}")
+        views[key] = df.plan
+
+    def table(self, name: str) -> "DataFrame":
+        """DataFrame over a registered temp view. The view shares the
+        underlying plan, so index rewrites (signatures are plan+file
+        based) apply exactly as they do on the original DataFrame."""
+        views = getattr(self, "_temp_views", {})
+        key = name.lower()
+        if key not in views:
+            raise HyperspaceException(f"No such temp view: {name}")
+        return DataFrame(self, views[key])
+
+    def drop_temp_view(self, name: str) -> bool:
+        views = getattr(self, "_temp_views", {})
+        return views.pop(name.lower(), None) is not None
+
+    # ------------------------------------------------------------------
     # Source providers (parity: FileBasedSourceProviderManager.buildProviders).
     # ------------------------------------------------------------------
 

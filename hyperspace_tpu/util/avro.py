@@ -313,10 +313,18 @@ def _assemble_native(native_chunks: List[Tuple[int, List]], fi: int,
                 if null_count:
                     validity_buf = pa.py_buffer(np.packbits(
                         valid.astype(bool), bitorder="little").tobytes())
-            parts.append(pa.Array.from_buffers(
+            arr = pa.Array.from_buffers(
                 at, count,
                 [validity_buf, pa.py_buffer(offsets.tobytes()),
-                 pa.py_buffer(data)], null_count))
+                 pa.py_buffer(data)], null_count)
+            if prim == "string":
+                # from_buffers does not validate UTF-8; the Python decoder
+                # raises on invalid bytes, so the native path must too.
+                try:
+                    arr.validate(full=True)
+                except pa.lib.ArrowInvalid as e:
+                    raise HyperspaceException(f"avro: invalid utf-8: {e}")
+            parts.append(arr)
             continue
         kind, vals, valid = piece
         mask = (valid == 0) if nullable else None

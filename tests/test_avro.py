@@ -202,6 +202,30 @@ class TestBinaryFormat:
         write_avro(no_null_slice, p)
         assert read_avro_schema(p).field("maybe").nullable
 
+    def test_huge_corrupt_string_length_is_loud(self, tmp_path):
+        """A crafted block claiming a string of ~INT64_MAX bytes must fail
+        cleanly (the naive `pos + n > len` bounds check overflows signed
+        int64 in C++ and would memcpy past the buffer)."""
+        schema = {"type": "record", "name": "R", "fields": [
+            {"name": "s", "type": "string"}]}
+        sync = b"0123456789abcdef"
+        out = io.BytesIO()
+        out.write(b"Obj\x01")
+        out.write(_encode_long(1))
+        out.write(_encode_bytes(b"avro.schema"))
+        out.write(_encode_bytes(json.dumps(schema).encode()))
+        out.write(_encode_long(0))
+        out.write(sync)
+        body = _encode_long(2**62) + b"xy"  # huge claimed length
+        out.write(_encode_long(1))
+        out.write(_encode_long(len(body)))
+        out.write(body)
+        out.write(sync)
+        p = tmp_path / "huge.avro"
+        p.write_bytes(out.getvalue())
+        with pytest.raises(HyperspaceException, match="truncated"):
+            read_avro(str(p))
+
     def test_bad_magic_rejected(self, tmp_path):
         p = tmp_path / "bad.avro"
         p.write_bytes(b"NOPE" + b"\x00" * 64)

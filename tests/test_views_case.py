@@ -1,0 +1,104 @@
+"""Temp views + case-sensitivity conf.
+
+Parity targets: the reference's E2E suite queries indexed data through
+views (E2EHyperspaceRulesTest), and its column resolution honors Spark's
+spark.sql.caseSensitive (ResolverUtils; default insensitive). Here views
+are session-registered plans and the conf key is hyperspace.caseSensitive.
+"""
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+import hyperspace_tpu as hst
+from hyperspace_tpu.api import Hyperspace, IndexConfig
+from hyperspace_tpu.exceptions import HyperspaceException
+from hyperspace_tpu.index.constants import IndexConstants
+from hyperspace_tpu.plan.expr import col
+from hyperspace_tpu.plan.nodes import IndexScan
+
+
+@pytest.fixture()
+def env(tmp_path):
+    rng = np.random.default_rng(21)
+    df = pd.DataFrame({
+        "Key": rng.integers(0, 200, 10_000).astype(np.int64),
+        "Val": rng.random(10_000),
+    })
+    d = tmp_path / "data"
+    d.mkdir()
+    pq.write_table(pa.Table.from_pandas(df), d / "p.parquet")
+    session = hst.Session(system_path=str(tmp_path / "indexes"))
+    session.conf.set(IndexConstants.INDEX_NUM_BUCKETS, 4)
+    return dict(session=session, hs=Hyperspace(session), path=str(d), df=df)
+
+
+class TestTempViews:
+    def test_view_roundtrip_and_drop(self, env):
+        session = env["session"]
+        t = session.read.parquet(env["path"])
+        session.create_temp_view("v1", t)
+        got = session.table("V1").to_pandas()  # names case-insensitive
+        assert len(got) == len(env["df"])
+        assert session.drop_temp_view("v1")
+        assert not session.drop_temp_view("v1")
+        with pytest.raises(HyperspaceException, match="No such temp view"):
+            session.table("v1")
+
+    def test_duplicate_view_requires_replace(self, env):
+        session = env["session"]
+        t = session.read.parquet(env["path"])
+        session.create_temp_view("v", t)
+        with pytest.raises(HyperspaceException, match="already exists"):
+            session.create_temp_view("v", t)
+        session.create_temp_view("v", t.select("Key"), replace=True)
+        assert session.table("v").to_pandas().columns.tolist() == ["Key"]
+
+    def test_index_used_through_view(self, env):
+        """The reference's view test: a query written against the view is
+        rewritten to the index built on the underlying data, and answers
+        match the no-index run."""
+        session, hs, df = env["session"], env["hs"], env["df"]
+        t = session.read.parquet(env["path"])
+        hs.create_index(t, IndexConfig("view_idx", ["Key"], ["Val"]))
+        session.create_temp_view("sales", t)
+        session.enable_hyperspace()
+        q = session.table("sales").filter(col("Key") == 7).select("Key", "Val")
+        leaves = q.optimized_plan().collect_leaves()
+        assert len(leaves) == 1 and isinstance(leaves[0], IndexScan)
+        got = q.to_pandas().sort_values(["Key", "Val"]).reset_index(drop=True)
+        session.disable_hyperspace()
+        raw = q.to_pandas().sort_values(["Key", "Val"]).reset_index(drop=True)
+        pd.testing.assert_frame_equal(got, raw)
+        assert len(got) == (df.Key == 7).sum()
+
+
+class TestCaseSensitivity:
+    def test_insensitive_by_default(self, env):
+        session, hs = env["session"], env["hs"]
+        t = session.read.parquet(env["path"])
+        # Physical columns are "Key"/"Val"; config names differ in case.
+        hs.create_index(t, IndexConfig("ci_idx", ["key"], ["VAL"]))
+        row = hs.index("ci_idx").iloc[0]
+        assert list(row["indexedColumns"]) == ["Key"]  # resolved to physical
+        assert list(row["includedColumns"]) == ["Val"]
+
+    def test_sensitive_mode_rejects_wrong_case(self, env):
+        session, hs = env["session"], env["hs"]
+        session.conf.set(IndexConstants.CASE_SENSITIVE, "true")
+        t = session.read.parquet(env["path"])
+        with pytest.raises(HyperspaceException):
+            hs.create_index(t, IndexConfig("cs_idx", ["key"], ["Val"]))
+        hs.create_index(t, IndexConfig("cs_idx", ["Key"], ["Val"]))
+        assert list(hs.index("cs_idx").iloc[0]["indexedColumns"]) == ["Key"]
+
+    def test_sensitive_mode_skipping_sketch(self, env):
+        session, hs = env["session"], env["hs"]
+        from hyperspace_tpu.api import DataSkippingIndexConfig, MinMaxSketch
+        session.conf.set(IndexConstants.CASE_SENSITIVE, "true")
+        t = session.read.parquet(env["path"])
+        with pytest.raises(HyperspaceException):
+            hs.create_index(t, DataSkippingIndexConfig(
+                "sk_idx", [MinMaxSketch("KEY")]))
