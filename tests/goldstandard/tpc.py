@@ -145,11 +145,15 @@ QUERY_NAMES = [
     "pushdown_select_where", "pushdown_alias", "tpch_q5_like",
     "tpch_q10_like", "having_over_groupby", "filter_topk_rows",
     "tpcds_q7_like", "join_on_aggregate", "in_list_indexed",
+    "minmax_aggregates", "multi_dir_sort", "string_range_scan",
+    "or_of_ranges", "count_distinct_groups", "join_chain_filters",
+    "not_in_exclusion", "proj_arith_groupby",
 ]
 
 
 def queries(dfs):
-    from hyperspace_tpu.plan.expr import avg, col, count, sum_
+    from hyperspace_tpu.plan.expr import (avg, col, count,
+                                          max_, min_, sum_)
 
     li, od, pt = dfs["lineitem"], dfs["orders"], dfs["part"]
     sr, dd, cu = dfs["store_returns"], dfs["date_dim"], dfs["customer"]
@@ -355,6 +359,70 @@ def queries(dfs):
     q["in_list_indexed"] = (
         li.filter(col("l_orderkey").isin([1, 5, 9, 13]))
         .select("l_orderkey", "l_extendedprice"))
+
+    # Min/Max aggregates (only sum/avg/count appear in the TPC shapes
+    # above); grouped on a non-indexed flag column.
+    q["minmax_aggregates"] = (
+        li.group_by("l_returnflag")
+        .agg(min_(col("l_extendedprice")).alias("lo"),
+             max_(col("l_extendedprice")).alias("hi"),
+             count(None).alias("n"))
+        .sort("l_returnflag"))
+
+    # Multi-key sort with mixed directions, no filter/aggregate.
+    q["multi_dir_sort"] = (
+        li.select("l_orderkey", "l_shipdate", "l_extendedprice")
+        .sort("l_orderkey", ("l_extendedprice", False)).limit(40))
+
+    # Range predicate over a dictionary-encoded string column.
+    q["string_range_scan"] = (
+        od.filter((col("o_orderpriority") >= "2-HIGH")
+                  & (col("o_orderpriority") < "4-NOT SPECIFIED"))
+        .select("o_orderkey", "o_orderpriority"))
+
+    # OR of two disjoint ranges on the indexed filter column.
+    d_ = datetime.date
+    q["or_of_ranges"] = (
+        li.filter(col("l_shipdate").between(d_(1993, 1, 1), d_(1993, 3, 31))
+                  | col("l_shipdate").between(d_(1997, 1, 1),
+                                              d_(1997, 3, 31)))
+        .select("l_quantity", "l_extendedprice", "l_shipdate"))
+
+    # Group count over a two-column key (count of groups per flag).
+    q["count_distinct_groups"] = (
+        li.group_by("l_returnflag", "l_linestatus")
+        .agg(count(None).alias("n"))
+        .group_by("l_returnflag")
+        .agg(count(None).alias("distinct_statuses"))
+        .sort("l_returnflag"))
+
+    # Join with independent filters on both inputs plus one above the join.
+    q["join_chain_filters"] = (
+        li.filter(col("l_quantity") > 10)
+        .join(od.filter(col("o_orderpriority") == "1-URGENT"),
+              on=col("l_orderkey") == col("o_orderkey"))
+        .filter(col("l_extendedprice") > 50_000)
+        .group_by("o_orderpriority")
+        .agg(sum_(col("l_extendedprice")).alias("rev")))
+
+    # NOT(IN(...)) exclusion on the indexed key (hybrid scan's deleted-row
+    # mask shape, as a user predicate).
+    q["not_in_exclusion"] = (
+        li.filter(~col("l_orderkey").isin([0, 1, 2, 3]))
+        .group_by("l_returnflag")
+        .agg(count(None).alias("n"))
+        .sort("l_returnflag"))
+
+    # Arithmetic projection feeding a group-by (expr columns as group key
+    # input, revenue-style derived measure).
+    q["proj_arith_groupby"] = (
+        li.select("l_returnflag",
+                  (col("l_extendedprice") * (1 - col("l_discount"))
+                   * (1 + col("l_tax"))).alias("charge"))
+        .group_by("l_returnflag")
+        .agg(sum_(col("charge")).alias("sum_charge"),
+             avg(col("charge")).alias("avg_charge"))
+        .sort("l_returnflag"))
 
     assert sorted(q) == sorted(QUERY_NAMES), \
         f"QUERY_NAMES out of sync: {sorted(set(q) ^ set(QUERY_NAMES))}"

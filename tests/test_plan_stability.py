@@ -122,7 +122,16 @@ class TestExpectedRewrites:
               "tpcds_q7_like": False, "join_on_aggregate": False,
               "tpch_q10_like": True,
               "having_over_groupby": True,  # groupby index; HAVING stays up
-              "in_list_indexed": True}
+              "in_list_indexed": True,
+              # or_of_ranges: both disjuncts constrain li_ship_idx's key
+              # and all referenced columns are covered.
+              "or_of_ranges": True,
+              # The rest miss coverage (group keys / filter columns not in
+              # any index) or have no filter/aggregate to rewrite.
+              "minmax_aggregates": False, "multi_dir_sort": False,
+              "string_range_scan": False, "count_distinct_groups": False,
+              "join_chain_filters": False, "not_in_exclusion": False,
+              "proj_arith_groupby": False}
 
     def test_rewrite_expectations(self, harness):
         session, queries = harness
