@@ -1,0 +1,210 @@
+"""Fault injection: every action type crashed mid-op must leave the index
+crash-consistent.
+
+The reference has no fault-injection framework (SURVEY §5) — its guarantees
+are structural: a crashed action leaves only a transient log state, queries
+use ACTIVE entries exclusively, `cancel()` rolls back to the last stable
+state, and data under `v__=<n>` version dirs is immutable so no partial
+write corrupts a served version (actions/Action.scala:34-103,
+CancelAction.scala). These tests make those guarantees executable for
+every mutating action by raising inside ``op()`` at the worst moment.
+"""
+
+import glob
+import os
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+import hyperspace_tpu as hst
+from hyperspace_tpu.api import Hyperspace, IndexConfig
+from hyperspace_tpu.exceptions import HyperspaceException
+from hyperspace_tpu.index.constants import IndexConstants, States
+from hyperspace_tpu.index.log_manager import IndexLogManager
+from hyperspace_tpu.plan.expr import col
+
+
+class Boom(RuntimeError):
+    pass
+
+
+def _crash(*a, **k):
+    raise Boom("injected mid-op crash")
+
+
+@pytest.fixture()
+def env(tmp_path):
+    rng = np.random.default_rng(33)
+    df = pd.DataFrame({
+        "k": rng.integers(0, 100, 8_000).astype(np.int64),
+        "v": rng.random(8_000),
+    })
+    d = tmp_path / "data"
+    d.mkdir()
+    pq.write_table(pa.Table.from_pandas(df), d / "p0.parquet")
+    session = hst.Session(system_path=str(tmp_path / "indexes"))
+    session.conf.set(IndexConstants.INDEX_NUM_BUCKETS, 4)
+    session.conf.set(IndexConstants.INDEX_LINEAGE_ENABLED, "true")
+    return dict(session=session, hs=Hyperspace(session), path=str(d),
+                df=df, sys=str(tmp_path / "indexes"), data_dir=d)
+
+
+def _log_dir(env, name):
+    return os.path.join(env["sys"], name, IndexConstants.HYPERSPACE_LOG)
+
+
+def _latest_state(env, name):
+    mgr = IndexLogManager(os.path.join(env["sys"], name))
+    entry = mgr.get_latest_log()
+    return entry.state if entry else None
+
+
+def _append_file(env, tag="extra"):
+    rng = np.random.default_rng(7)
+    t = pa.table({"k": pa.array(rng.integers(0, 100, 500).astype(np.int64)),
+                  "v": pa.array(rng.random(500))})
+    pq.write_table(t, env["data_dir"] / f"{tag}.parquet")
+
+
+class TestCreateCrash:
+    def test_crash_leaves_transient_and_invisible(self, env, monkeypatch):
+        session, hs = env["session"], env["hs"]
+        t = session.read.parquet(env["path"])
+        from hyperspace_tpu.actions import create as create_mod
+        monkeypatch.setattr(create_mod.CreateAction, "op", _crash)
+        with pytest.raises(Boom):
+            hs.create_index(t, IndexConfig("cx", ["k"], ["v"]))
+        assert _latest_state(env, "cx") == States.CREATING
+        # The wedged index is invisible to the rewrite and to ACTIVE listing.
+        session.enable_hyperspace()
+        q = t.filter(col("k") == 3)
+        assert "IndexScan" not in q.optimized_plan().tree_string()
+        assert q.to_pandas() is not None  # query still executes
+        listed = hs.indexes()
+        assert "cx" not in set(listed["name"]) or \
+            listed[listed["name"] == "cx"]["state"].iloc[0] != States.ACTIVE
+
+    def test_cancel_then_recreate_succeeds(self, env, monkeypatch):
+        session, hs = env["session"], env["hs"]
+        t = session.read.parquet(env["path"])
+        from hyperspace_tpu.actions import create as create_mod
+        monkeypatch.setattr(create_mod.CreateAction, "op", _crash)
+        with pytest.raises(Boom):
+            hs.create_index(t, IndexConfig("cy", ["k"], ["v"]))
+        monkeypatch.undo()
+        hs.cancel("cy")
+        hs.create_index(t, IndexConfig("cy", ["k"], ["v"]))
+        assert _latest_state(env, "cy") == States.ACTIVE
+        session.enable_hyperspace()
+        q = t.filter(col("k") == 3).select("k", "v")
+        assert "IndexScan" in q.optimized_plan().tree_string()
+
+
+class TestRefreshCrash:
+    @pytest.mark.parametrize("mode", ["full", "incremental", "quick"])
+    def test_crash_preserves_served_version(self, env, monkeypatch, mode):
+        """A refresh crashing mid-op must not disturb the ACTIVE version:
+        queries keep using the old index data and answers stay correct."""
+        session, hs, df = env["session"], env["hs"], env["df"]
+        t = session.read.parquet(env["path"])
+        hs.create_index(t, IndexConfig("rx", ["k"], ["v"]))
+        v_dirs_before = sorted(glob.glob(
+            os.path.join(env["sys"], "rx", "v__=*")))
+        _append_file(env)
+
+        from hyperspace_tpu.actions import refresh as refresh_mod
+        cls = {"full": refresh_mod.RefreshAction,
+               "incremental": refresh_mod.RefreshIncrementalAction,
+               "quick": refresh_mod.RefreshQuickAction}[mode]
+        monkeypatch.setattr(cls, "op", _crash)
+        with pytest.raises(Boom):
+            hs.refresh_index("rx", mode)
+        monkeypatch.undo()
+        assert _latest_state(env, "rx") == States.REFRESHING
+        # Served data untouched: the pre-crash version dirs are intact.
+        for vd in v_dirs_before:
+            assert os.path.isdir(vd)
+        # Recovery: cancel → ACTIVE again → refresh completes.
+        hs.cancel("rx")
+        assert _latest_state(env, "rx") == States.ACTIVE
+        hs.refresh_index("rx", mode)
+        assert _latest_state(env, "rx") == States.ACTIVE
+
+    def test_post_recovery_answers_match(self, env, monkeypatch):
+        session, hs, df = env["session"], env["hs"], env["df"]
+        t = session.read.parquet(env["path"])
+        hs.create_index(t, IndexConfig("rz", ["k"], ["v"]))
+        _append_file(env, "late")
+        from hyperspace_tpu.actions import refresh as refresh_mod
+        monkeypatch.setattr(refresh_mod.RefreshIncrementalAction, "op", _crash)
+        with pytest.raises(Boom):
+            hs.refresh_index("rz", "incremental")
+        monkeypatch.undo()
+        hs.cancel("rz")
+        hs.refresh_index("rz", "incremental")
+        # Disable-and-compare on the refreshed data (re-read the dir so the
+        # relation sees the appended file).
+        t2 = session.read.parquet(env["path"])
+        q = t2.filter(col("k") == 11).select("k", "v")
+        session.enable_hyperspace()
+        a = q.to_pandas().sort_values(["k", "v"]).reset_index(drop=True)
+        session.disable_hyperspace()
+        b = q.to_pandas().sort_values(["k", "v"]).reset_index(drop=True)
+        pd.testing.assert_frame_equal(a, b)
+
+
+class TestOptimizeAndLifecycleCrash:
+    def test_optimize_crash_recovers(self, env, monkeypatch):
+        session, hs = env["session"], env["hs"]
+        t = session.read.parquet(env["path"])
+        hs.create_index(t, IndexConfig("ox", ["k"], ["v"]))
+        _append_file(env)
+        hs.refresh_index("ox", "incremental")
+        from hyperspace_tpu.actions import optimize as optimize_mod
+        monkeypatch.setattr(optimize_mod.OptimizeAction, "op", _crash)
+        with pytest.raises(Boom):
+            hs.optimize_index("ox", "full")
+        monkeypatch.undo()
+        assert _latest_state(env, "ox") == States.OPTIMIZING
+        hs.cancel("ox")
+        hs.optimize_index("ox", "full")
+        assert _latest_state(env, "ox") == States.ACTIVE
+
+    def test_vacuum_crash_leaves_deleted_state(self, env, monkeypatch):
+        session, hs = env["session"], env["hs"]
+        t = session.read.parquet(env["path"])
+        hs.create_index(t, IndexConfig("vx", ["k"], ["v"]))
+        hs.delete_index("vx")
+        from hyperspace_tpu.actions import lifecycle as lc
+        monkeypatch.setattr(lc.VacuumAction, "op", _crash)
+        with pytest.raises(Boom):
+            hs.vacuum_index("vx")
+        monkeypatch.undo()
+        assert _latest_state(env, "vx") == States.VACUUMING
+        hs.cancel("vx")
+        assert _latest_state(env, "vx") == States.DELETED
+        hs.restore_index("vx")
+        assert _latest_state(env, "vx") == States.ACTIVE
+
+
+class TestConcurrentActionConflict:
+    def test_second_writer_fails_loud_and_harmless(self, env, monkeypatch):
+        """While one action holds the transient state, a second action on
+        the same index hits the op-log optimistic-concurrency check and
+        fails without touching anything (Action.scala:80 semantics)."""
+        session, hs = env["session"], env["hs"]
+        t = session.read.parquet(env["path"])
+        from hyperspace_tpu.actions import create as create_mod
+        monkeypatch.setattr(create_mod.CreateAction, "op", _crash)
+        with pytest.raises(Boom):
+            hs.create_index(t, IndexConfig("cc", ["k"], ["v"]))
+        monkeypatch.undo()
+        # The wedged CREATING state blocks a rival create until cancel.
+        with pytest.raises(HyperspaceException):
+            hs.create_index(t, IndexConfig("cc", ["k"], ["v"]))
+        hs.cancel("cc")
+        hs.create_index(t, IndexConfig("cc", ["k"], ["v"]))
+        assert _latest_state(env, "cc") == States.ACTIVE
