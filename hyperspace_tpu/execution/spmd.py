@@ -118,27 +118,27 @@ def _linearize(plan: LogicalPlan):
 def _load_leaf(leaf, stages, needed, executor) -> "Table":
     """Materialize the stream leaf, pruning the read when possible.
 
-    Filter stages sitting DIRECTLY above an IndexScan leaf (before any
-    project/join stage) are necessary conditions on the raw leaf rows, so
-    their pushable conjuncts can narrow the parquet read; when one
-    constrains the leading indexed column, the within-bucket sort makes
-    row-group pruning sharp and the read bypasses the HBM cache (same
-    policy as the single-device path in executor._execute). The later
-    mask evaluation over the pruned rows is unchanged — pushdown is an
-    IO optimization, never a semantic transfer."""
-    if isinstance(leaf, IndexScan):
+    Filter stages sitting DIRECTLY above the leaf (before any project or
+    join stage) are necessary conditions on the raw leaf rows, so their
+    pushable conjuncts can narrow the parquet read — the same IO
+    optimization the single-device Filter-over-leaf branch applies; the
+    later mask evaluation over the pruned rows is unchanged. For an
+    IndexScan leaf, a leading-indexed-column constraint additionally
+    bypasses the HBM cache (within-bucket sort makes row-group pruning
+    sharp — executor._execute's policy)."""
+    conds = []
+    for kind, node in stages:
+        if kind != "filter":
+            break
+        conds.append(node.condition)
+    if conds:
         from . import executor as ex
-        from .pushdown import pruned_index_read_filter
+        from .pushdown import pruned_index_read_filter, pushable_filter
 
-        conds = []
-        for kind, node in stages:
-            if kind != "filter":
-                break
-            conds.append(node.condition)
-        if conds:
-            combined = conds[0]
-            for c in conds[1:]:
-                combined = E.And(combined, c)
+        combined = conds[0]
+        for c in conds[1:]:
+            combined = E.And(combined, c)
+        if isinstance(leaf, IndexScan):
             pa_filter = pruned_index_read_filter(
                 leaf.index_entry, combined, leaf.schema)
             if pa_filter is not None:
@@ -149,6 +149,13 @@ def _load_leaf(leaf, stages, needed, executor) -> "Table":
                 # Filter matched nothing: fall through to the cached full
                 # read so the SPMD stream still runs (an all-false mask)
                 # instead of a spurious single-device fallback.
+        else:  # Scan: dotted struct leaves aren't physical columns there.
+            pa_filter = pushable_filter(combined, leaf.schema,
+                                        allow_nested=False)
+            if pa_filter is not None:
+                table = ex._execute_scan(leaf, needed, pa_filter)
+                if table.num_rows > 0:
+                    return table
     return executor(leaf, needed)
 
 
