@@ -36,10 +36,12 @@ Execution model — mask-based streaming with static shapes throughout:
 - Global aggregates psum/pmin/pmax partial contributions (one collective
   per partial).
 - Grouped aggregates compute capacity-bounded per-device partials (local
-  sort → segment ops into ``G`` slots) and merge them on host — the
-  two-phase partial-aggregation pattern Spark applies to group-by, with
-  the host merge standing in for the final shuffle (valid whenever group
-  cardinality ≪ row count; capacity overflow falls back).
+  sort → segment ops into ``G`` slots), then hash-route each partial
+  group to its owner device with one all_to_all and combine there — the
+  full two-phase shuffle-aggregate, entirely on device. The host receives
+  disjoint final groups and only concatenates + orders them. Owner-side
+  capacity escalates ×4 on hash skew (hard-bounded by ``n_dev*G``);
+  local-partial overflow still falls back (with a telemetry event).
 - Row-returning (non-aggregate) chains return each device's columns +
   mask; the host gathers valid rows and concatenates (Sort/Limit wrappers
   then run on the reduced result).
@@ -722,19 +724,31 @@ def _run(plan: Aggregate, executor) -> Table:
         if g not in prep.final_meta:
             raise _Unsupported(f"unknown group column {g}")
     grouped = bool(group_cols)
+    n_dev = prep.mesh.devices.size
+    G2 = 0  # sized from G on first iteration
     for attempt in range(_MAX_CAP_RETRIES + 1):
         G = min(_out_rows(prep, caps), MAX_LOCAL_GROUPS)
+        G2 = min(max(G2, G), n_dev * G)
         descr = _StageDescr(prep.stages, prep.joins, prep.col_meta,
                             agg_specs, group_cols, dict(caps),
                             prep.project_live)
         out = _spmd_program(prep.sharded, prep.valid, prep.bcast, prep.xch,
                             mesh=prep.mesh, descr=descr, grouped=grouped,
-                            G=G, mode="agg")
+                            G=G, G2=G2, mode="agg")
         if _escalate_on_overflow(out, caps):
             continue
         if grouped:
             if bool(np.asarray(jax.device_get(out["overflow"]))):
                 raise _Unsupported("local group capacity overflow")
+            if bool(np.asarray(jax.device_get(out["gmof"]))):
+                # One owner device holds more than G2 distinct groups
+                # (hash skew). The program reports the exact capacity
+                # needed, so ONE retry always succeeds — rounded up to a
+                # multiple of G to keep the jit cache coarse. (Hard bound:
+                # total groups ≤ n_dev*G.)
+                need = int(np.asarray(jax.device_get(out["gmneed"])))
+                G2 = min(max(G2 + 1, -(-need // G) * G), n_dev * G)
+                continue
             table = _merge_grouped(out, agg_specs, list(group_cols),
                                    prep.final_meta)
         else:
@@ -865,6 +879,36 @@ def _stream_probe_key(table: Table, pairs, pack) -> Tuple[jax.Array, jax.Array]:
     return comp, valid
 
 
+def _group_segments(mask, flags, datas, cap: int):
+    """Shared grouping step for the local-partial AND owner-merge phases:
+    sort rows by (masked-out last, [null-flag, value] per key column),
+    detect group boundaries, and assign capacity-bounded segment ids.
+
+    Returns (order, sorted mask, sorted flags, sorted datas, gids,
+    n_groups): ``gids`` carries ``cap`` for masked-out rows (segment ops
+    drop them); ``n_groups`` is the distinct count before clamping —
+    overflow iff > cap."""
+    sort_ops = [(~mask).astype(jnp.int32)]
+    for f, d in zip(flags, datas):
+        sort_ops.extend([f, d])
+    order = kernels.lex_sort_indices(sort_ops)
+    s_mask = jnp.take(mask, order)
+    s_flags = [jnp.take(f, order) for f in flags]
+    s_datas = [jnp.take(d, order) for d in datas]
+    n = s_mask.shape[0]
+    change = jnp.zeros(n, jnp.bool_)
+    for arr in s_flags + s_datas:
+        change = change | jnp.concatenate(
+            [jnp.zeros(1, jnp.bool_), arr[1:] != arr[:-1]])
+    first = jnp.concatenate(
+        [jnp.ones(1, jnp.bool_), jnp.zeros(n - 1, jnp.bool_)])
+    newg = s_mask & (change | first)
+    gids_raw = jnp.cumsum(newg.astype(jnp.int32)) - 1
+    gids = jnp.where(s_mask, gids_raw, cap)
+    n_groups = jnp.max(jnp.where(s_mask, gids_raw + 1, 0))
+    return order, s_mask, s_flags, s_datas, gids, n_groups
+
+
 def _a2a_exchange(arrays: Dict[str, jax.Array], send_ok: jax.Array,
                   dst: jax.Array, n_dev: int, cap: int):
     """Route rows to their destination device with ONE lax.all_to_all.
@@ -901,9 +945,11 @@ def _a2a_exchange(arrays: Dict[str, jax.Array], send_ok: jax.Array,
     return recv, recv_valid, overflow
 
 
-@partial(jax.jit, static_argnames=("mesh", "descr", "grouped", "G", "mode"))
+@partial(jax.jit,
+         static_argnames=("mesh", "descr", "grouped", "G", "G2", "mode"))
 def _spmd_program(sharded, valid, bcast, xch, *, mesh: Mesh,
-                  descr: _StageDescr, grouped: bool, G: int, mode: str):
+                  descr: _StageDescr, grouped: bool, G: int, mode: str,
+                  G2: int = 1):
     stages, joins, col_meta = descr.stages, descr.joins, descr.col_meta
     agg_specs, group_cols = descr.agg_specs, descr.group_cols
     n_dev = mesh.devices.size
@@ -1085,13 +1131,12 @@ def _spmd_program(sharded, valid, bcast, xch, *, mesh: Mesh,
             return out
 
         # ---- grouped: capacity-bounded local partials ----
-        # Sort the shard by (masked-out last, [null-first, value] per key).
+        # Null-aware (flag, data) encoding per key: null(0) sorts first.
         key_flags, key_datas = [], []
-        sort_ops = [(~mask).astype(jnp.int32)]
         for g in group_cols:
             c = table.column(g)
             if c.validity is not None:
-                flag = c.validity.astype(jnp.int32)  # null(0) sorts first
+                flag = c.validity.astype(jnp.int32)
                 data = jnp.where(c.validity, c.data,
                                  jnp.zeros((), c.data.dtype))
             else:
@@ -1099,22 +1144,9 @@ def _spmd_program(sharded, valid, bcast, xch, *, mesh: Mesh,
                 data = c.data
             key_flags.append(flag)
             key_datas.append(data)
-            sort_ops.extend([flag, data])
-        order = kernels.lex_sort_indices(sort_ops)
-        s_mask = jnp.take(mask, order)
-        s_flags = [jnp.take(f, order) for f in key_flags]
-        s_datas = [jnp.take(d, order) for d in key_datas]
+        order, s_mask, s_flags, s_datas, gids, local_groups = \
+            _group_segments(mask, key_flags, key_datas, G)
         n_rows = s_mask.shape[0]
-        change = jnp.zeros(n_rows, jnp.bool_)
-        for arr in s_flags + s_datas:
-            change = change | jnp.concatenate(
-                [jnp.zeros(1, jnp.bool_), arr[1:] != arr[:-1]])
-        first = jnp.concatenate(
-            [jnp.ones(1, jnp.bool_), jnp.zeros(n_rows - 1, jnp.bool_)])
-        newg = s_mask & (change | first)
-        gids_raw = jnp.cumsum(newg.astype(jnp.int32)) - 1
-        gids = jnp.where(s_mask, gids_raw, G)  # out-of-range → dropped
-        local_groups = jnp.max(jnp.where(s_mask, gids_raw + 1, 0))
         overflow = jax.lax.pmax((local_groups > G).astype(jnp.int32),
                                 DATA_AXIS)
 
@@ -1136,6 +1168,60 @@ def _spmd_program(sharded, valid, bcast, xch, *, mesh: Mesh,
             out[f"gf:{g}"] = jnp.take(flag, firsts)
         out["gvalid"] = (jnp.arange(G, dtype=jnp.int32)
                          < jnp.minimum(local_groups, G))
+
+        # ---- distributed final merge (the "final shuffle" on device) ----
+        # Each partial group is hash-routed to its owner device with one
+        # all_to_all and combined there, so the host receives DISJOINT
+        # final groups and merely concatenates (its reduceat degenerates
+        # to identity). cap=G can't overflow: a source device holds at
+        # most G valid partial groups total. Owner-side capacity G2
+        # escalates in _run (bounded by n_dev*G, the hard total).
+        if n_dev > 1:
+            send = {k: v for k, v in out.items()
+                    if k not in ("overflow", "gvalid")
+                    and not k.startswith("xof:")}
+            gv = out["gvalid"]
+            h = None
+            for g in group_cols:
+                dt = table.column(g).dtype
+                ch = kernels.hash32_values(
+                    out[f"g:{g}"], INT32 if dt == STRING else dt)
+                ch = kernels.hash_combine(
+                    ch, out[f"gf:{g}"].astype(jnp.uint32))
+                h = ch if h is None else kernels.hash_combine(h, ch)
+            dst = (h % np.uint32(n_dev)).astype(jnp.int32)
+            recv, rvalid, _ = _a2a_exchange(send, gv, dst, n_dev, cap=G)
+            order2, m2, sflags2, sdatas2, gids2, owned = _group_segments(
+                rvalid, [recv[f"gf:{g}"] for g in group_cols],
+                [recv[f"g:{g}"] for g in group_cols], G2)
+            nr = m2.shape[0]
+            out["gmof"] = jax.lax.pmax((owned > G2).astype(jnp.int32),
+                                       DATA_AXIS)
+            # Exact capacity an owner needs — _run retries ONCE with this
+            # (rounded up) instead of stepping blindly.
+            out["gmneed"] = jax.lax.pmax(owned, DATA_AXIS)
+            for spec in agg_specs:
+                for k in spec.partial_keys():
+                    v = jnp.take(recv[f"{spec.name}:{k}"], order2, axis=0)
+                    if k == "min":
+                        v = jnp.where(m2, v, _max_sentinel(v.dtype))
+                        merged = kernels.segment_min(v, gids2, G2)
+                    elif k == "max":
+                        v = jnp.where(m2, v, _min_sentinel(v.dtype))
+                        merged = kernels.segment_max(v, gids2, G2)
+                    else:  # sum / count merge by summation
+                        v = jnp.where(m2, v, jnp.zeros((), v.dtype))
+                        merged = kernels.segment_sum(v, gids2, G2)
+                    out[f"{spec.name}:{k}"] = merged
+            firsts2 = jnp.minimum(kernels.segment_first_index(gids2, G2),
+                                  nr - 1)
+            for g, f2, d2 in zip(group_cols, sflags2, sdatas2):
+                out[f"g:{g}"] = jnp.take(d2, firsts2)
+                out[f"gf:{g}"] = jnp.take(f2, firsts2)
+            out["gvalid"] = (jnp.arange(G2, dtype=jnp.int32)
+                             < jnp.minimum(owned, G2))
+        else:
+            out["gmof"] = jnp.zeros((), jnp.int32)
         return out
 
     xof_keys = [f"xof:{i}" for i, j in descr.joins.items() if j[0] == "x"]
@@ -1146,7 +1232,9 @@ def _spmd_program(sharded, valid, bcast, xch, *, mesh: Mesh,
             if nul:
                 out_specs[f"ov:{n}"] = P(DATA_AXIS)
     elif grouped:
-        out_specs = {"overflow": P()}
+        out_specs = {"overflow": P(), "gmof": P()}
+        if mesh.devices.size > 1:
+            out_specs["gmneed"] = P()
         for spec in agg_specs:
             for k in spec.partial_keys():
                 out_specs[f"{spec.name}:{k}"] = P(DATA_AXIS)
