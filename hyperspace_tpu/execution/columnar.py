@@ -13,6 +13,7 @@ Host↔device crossings happen only at parquet read/write and at collect().
 from __future__ import annotations
 
 import datetime
+import functools
 from dataclasses import dataclass
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -407,9 +408,22 @@ def read_parquet(files: Sequence[str], columns: Optional[Sequence[str]] = None,
     return Table.from_arrow(at)
 
 
+@functools.lru_cache(maxsize=65536)
+def _file_row_count(path: str, size: int, mtime_ns: int) -> int:
+    return pq.ParquetFile(path).metadata.num_rows
+
+
 def parquet_row_counts(files: Sequence[str]) -> List[int]:
-    """Row count per file from parquet footers (no data read)."""
-    return [pq.ParquetFile(f).metadata.num_rows for f in files]
+    """Row count per file from parquet footers (no data read). Memoized
+    per (path, size, mtime): budget checks run on every filtered scan,
+    and re-opening every footer per query would tax the hot cached path
+    (index files are immutable, so staleness means a new path/version)."""
+    import os
+    out = []
+    for f in files:
+        st = os.stat(f)
+        out.append(_file_row_count(f, st.st_size, st.st_mtime_ns))
+    return out
 
 
 def iter_parquet_chunks(files: Sequence[str], columns: Optional[Sequence[str]],

@@ -75,6 +75,11 @@ def _execute(plan: LogicalPlan, needed: Optional[Set[str]]) -> Table:
                 table = _execute_scan(plan.child, child_needed, pa_filter)
             else:
                 buckets = _equality_bucket_subset(plan.child, plan.condition)
+                chunked = _chunked_filtered_index_scan(
+                    plan.child, child_needed, plan.condition, pa_filter,
+                    bucket_subset=buckets)
+                if chunked is not None:
+                    return chunked
                 pruned = pa_filter is not None and prefers_pruned_read(
                     plan.child.index_entry, plan.condition, plan.child.schema)
                 table = _execute_index_scan(plan.child, child_needed, pa_filter,
@@ -274,10 +279,10 @@ def _equality_values(conjunct, column: str):
     return None
 
 
-def _execute_index_scan(plan: IndexScan, needed: Optional[Set[str]],
-                        pa_filter=None,
-                        bucket_subset: Optional[Set[int]] = None,
-                        prefer_pruned_read: bool = False) -> Table:
+def _index_scan_layout(plan: IndexScan, needed: Optional[Set[str]],
+                       bucket_subset: Optional[Set[int]]):
+    """File list (bucket-grouped order) + explicit read columns for an
+    index scan. Returns (index_files, cols, buckets_have_single_file)."""
     from ..index.constants import IndexConstants
     from ..ops.index_build import bucket_id_from_file
 
@@ -293,12 +298,6 @@ def _execute_index_scan(plan: IndexScan, needed: Optional[Set[str]],
         and all(b is not None for b, _ in keyed)
     if bucket_subset is not None:
         index_files = [f for b, f in keyed if b in bucket_subset]
-        if not index_files and not plan.appended_files:
-            from .columnar import empty_table
-            out_schema = plan.schema if needed is None else \
-                plan.schema.select([n for n in plan.schema.names if n in needed]
-                                   or [plan.schema.names[0]])
-            return empty_table(out_schema)
     schema_names = entry.schema.names
     # Columns are ALWAYS explicit: index files live under "v__=<n>"
     # directories, and pyarrow's reader hive-infers a phantom "v__"
@@ -307,12 +306,102 @@ def _execute_index_scan(plan: IndexScan, needed: Optional[Set[str]],
         cols = [n for n in schema_names if n in needed]
         if not cols:
             cols = [schema_names[0]]
-        if plan.deleted_file_ids and IndexConstants.DATA_FILE_NAME_ID not in cols:
-            cols = cols + [IndexConstants.DATA_FILE_NAME_ID]
     else:
         cols = [n for n in plan.schema.names]
-        if plan.deleted_file_ids and IndexConstants.DATA_FILE_NAME_ID not in cols:
-            cols = cols + [IndexConstants.DATA_FILE_NAME_ID]
+    if plan.deleted_file_ids and IndexConstants.DATA_FILE_NAME_ID not in cols:
+        cols = cols + [IndexConstants.DATA_FILE_NAME_ID]
+    return index_files, cols, buckets_have_single_file
+
+
+def _chunked_filtered_index_scan(plan: IndexScan, needed: Optional[Set[str]],
+                                 condition, pa_filter=None,
+                                 bucket_subset: Optional[Set[int]] = None
+                                 ) -> Optional[Table]:
+    """Filter-over-index-scan for indexes larger than HBM: stream the
+    bucket-ordered index files in chunks, evaluate the mask (and the
+    hybrid deleted-row mask) per chunk, keep survivors. Survivors stay in
+    bucket-grouped order, so the bucket_order invariant is preserved when
+    there are no appended files. Returns None when the index fits the
+    chunk budget (the in-memory/cached path is cheaper)."""
+    from ..index.constants import IndexConstants
+    from .columnar import (Table as T, empty_table, iter_dataset_chunks,
+                           parquet_row_counts, read_parquet)
+
+    session = _SESSION.get()
+    chunk_rows = session.hs_conf.max_chunk_rows() if session is not None \
+        else int(IndexConstants.TPU_MAX_CHUNK_ROWS_DEFAULT)
+    entry = plan.index_entry
+    index_files, cols, buckets_have_single_file = _index_scan_layout(
+        plan, needed, bucket_subset)
+    if not index_files:
+        return None
+    try:
+        if sum(parquet_row_counts(index_files)) <= chunk_rows:
+            return None
+    except Exception:
+        return None
+    lineage = IndexConstants.DATA_FILE_NAME_ID
+    wanted = needed if needed is not None else set(plan.schema.names)
+    out_cols = [c for c in cols if c != lineage or c in wanted]
+    deleted = None
+    if plan.deleted_file_ids:
+        deleted = jnp.asarray(
+            np.sort(np.asarray(plan.deleted_file_ids, dtype=np.int64)))
+    parts: List[Table] = []
+    for chunk in iter_dataset_chunks(index_files, cols, chunk_rows,
+                                     pa_filter):
+        CHUNK_SCAN_STATS["max_device_rows"] = max(
+            CHUNK_SCAN_STATS["max_device_rows"], chunk.num_rows)
+        CHUNK_SCAN_STATS["chunks"] += 1
+        mask = eval_predicate_mask(chunk, condition)
+        if deleted is not None:
+            lc = chunk.column(lineage)
+            mask = mask & ~kernels.isin_sorted(
+                lc.data.astype(jnp.int64), deleted)
+        parts.append(chunk.filter(mask))
+    if plan.appended_files:
+        app_cols = [c for c in cols if c != lineage]
+        appended = read_parquet(plan.appended_files, app_cols)
+        mask = eval_predicate_mask(appended, condition)
+        appended = appended.filter(mask)
+        if lineage in cols:
+            fill = Column(INT64, jnp.full(
+                appended.num_rows, IndexConstants.UNKNOWN_FILE_ID, jnp.int64))
+            appended = appended.with_column(lineage, fill)
+        parts.append(appended.select(cols))
+    parts = [p for p in parts if p.num_rows > 0]
+    if not parts:
+        return empty_table(entry.schema.select(out_cols))
+    table = Table.concat(parts) if len(parts) > 1 else parts[0]
+    if entry.derivedDataset.kind == "CoveringIndex" \
+            and buckets_have_single_file and not plan.appended_files \
+            and all(c in table.names for c in entry.indexed_columns):
+        # Filtered subsequence of bucket-ordered rows is still bucket-
+        # ordered (chunks stream files in bucket order; concat preserves).
+        table = T(table.columns, bucket_order=(
+            entry.num_buckets, tuple(entry.indexed_columns)))
+    if lineage in table.names and lineage not in wanted:
+        table = table.select([n for n in table.names if n != lineage])
+    return table
+
+
+def _execute_index_scan(plan: IndexScan, needed: Optional[Set[str]],
+                        pa_filter=None,
+                        bucket_subset: Optional[Set[int]] = None,
+                        prefer_pruned_read: bool = False) -> Table:
+    from ..index.constants import IndexConstants
+
+    entry = plan.index_entry
+    index_files, cols, buckets_have_single_file = _index_scan_layout(
+        plan, needed, bucket_subset)
+    schema_names = entry.schema.names
+    if not index_files and bucket_subset is not None \
+            and not plan.appended_files:
+        from .columnar import empty_table
+        out_schema = plan.schema if needed is None else \
+            plan.schema.select([n for n in plan.schema.names if n in needed]
+                               or [plan.schema.names[0]])
+        return empty_table(out_schema)
     if not index_files:
         from .columnar import empty_table
         table = empty_table(entry.schema.select(cols or entry.schema.names))

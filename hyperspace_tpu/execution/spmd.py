@@ -472,6 +472,17 @@ def _leaf_within_budget(root, session) -> bool:
         leaf, _ = _linearize(root)
     except _Unsupported:
         return True  # let the caller fail with the structural reason
+    if isinstance(leaf, IndexScan):
+        # Index leaves materialize fully too (index content PLUS any
+        # hybrid appended files) — over budget must go to the
+        # single-device chunked index scan.
+        try:
+            total = sum(parquet_row_counts(
+                list(leaf.index_entry.content.files)
+                + list(leaf.appended_files)))
+        except Exception:
+            return True
+        return total <= session.hs_conf.max_chunk_rows()
     if not isinstance(leaf, Scan):
         return True
     relation = leaf.relation
@@ -726,7 +737,9 @@ def _run(plan: Aggregate, executor) -> Table:
     grouped = bool(group_cols)
     n_dev = prep.mesh.devices.size
     G2 = 0  # sized from G on first iteration
-    for attempt in range(_MAX_CAP_RETRIES + 1):
+    cap_attempts = 0
+    gmof_retried = False
+    while True:
         G = min(_out_rows(prep, caps), MAX_LOCAL_GROUPS)
         G2 = min(max(G2, G), n_dev * G)
         descr = _StageDescr(prep.stages, prep.joins, prep.col_meta,
@@ -736,6 +749,13 @@ def _run(plan: Aggregate, executor) -> Table:
                             mesh=prep.mesh, descr=descr, grouped=grouped,
                             G=G, G2=G2, mode="agg")
         if _escalate_on_overflow(out, caps):
+            cap_attempts += 1
+            if cap_attempts > _MAX_CAP_RETRIES:
+                raise _Unsupported(
+                    "exchange join capacity escalation exhausted")
+            # New caps → new partial-group distribution; the one-shot
+            # owner-capacity retry becomes available again.
+            gmof_retried = False
             continue
         if grouped:
             if bool(np.asarray(jax.device_get(out["overflow"]))):
@@ -743,9 +763,13 @@ def _run(plan: Aggregate, executor) -> Table:
             if bool(np.asarray(jax.device_get(out["gmof"]))):
                 # One owner device holds more than G2 distinct groups
                 # (hash skew). The program reports the exact capacity
-                # needed, so ONE retry always succeeds — rounded up to a
-                # multiple of G to keep the jit cache coarse. (Hard bound:
-                # total groups ≤ n_dev*G.)
+                # needed, so ONE retry — with its own budget, not the
+                # exchange-cap one — always suffices (rounded up to a
+                # multiple of G to keep the jit cache coarse; hard bound:
+                # total groups ≤ n_dev*G).
+                if gmof_retried:
+                    raise _Unsupported("merge capacity retry failed")
+                gmof_retried = True
                 need = int(np.asarray(jax.device_get(out["gmneed"])))
                 G2 = min(max(G2 + 1, -(-need // G) * G), n_dev * G)
                 continue
@@ -755,7 +779,6 @@ def _run(plan: Aggregate, executor) -> Table:
             table = _merge_global(out, agg_specs, prep.final_meta)
         DISPATCH_COUNT += 1
         return table
-    raise _Unsupported("exchange join capacity escalation exhausted")
 
 
 def _run_stream(root, executor) -> Table:
