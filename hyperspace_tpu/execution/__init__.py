@@ -16,12 +16,35 @@ jax.config.update("jax_enable_x64", True)
 # recompiled per (kernel, shape) otherwise — on TPU a cold compile is tens of
 # seconds, so caching across processes is what makes repeated builds/queries
 # (and repeated bench runs) cheap. Opt out with HST_XLA_CACHE=off.
+#
+# The directory is keyed by a HOST CPU FINGERPRINT: XLA:CPU AOT executables
+# bake in the compile machine's features (+amx/+avx512...), and jax's cache
+# key does not include them — a container migrating to a host with fewer
+# features loads the stale executable and aborts ("Fatal Python error:
+# Aborted" in get_executable_and_time; observed in this sandbox). Separate
+# per-fingerprint dirs make migration a cold cache instead of a crash.
+def _host_fingerprint() -> str:
+    import hashlib
+    import platform
+    bits = platform.machine() + ";" + platform.processor()
+    try:
+        with open("/proc/cpuinfo") as fh:
+            for line in fh:
+                if line.startswith("flags"):
+                    bits += ";" + " ".join(sorted(line.split(":", 1)[1]
+                                                  .split()))
+                    break
+    except OSError:
+        pass
+    return hashlib.sha1(bits.encode()).hexdigest()[:12]
+
+
 if os.environ.get("HST_XLA_CACHE", "on") != "off":
     try:
         _cache_dir = os.environ.get(
             "HST_XLA_CACHE_DIR",
             os.path.join(os.path.expanduser("~"), ".cache", "hyperspace_tpu",
-                         "xla"))
+                         "xla", _host_fingerprint()))
         os.makedirs(_cache_dir, exist_ok=True)
         jax.config.update("jax_compilation_cache_dir", _cache_dir)
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)

@@ -336,7 +336,12 @@ def _chunked_filtered_index_scan(plan: IndexScan, needed: Optional[Set[str]],
     if not index_files:
         return None
     try:
-        if sum(parquet_row_counts(index_files)) <= chunk_rows:
+        # Appended files count toward the footprint too (mirrors
+        # spmd._leaf_within_budget, so a query the SPMD gate bounced here
+        # is guaranteed to take THIS path, not full materialization).
+        total = sum(parquet_row_counts(
+            index_files + list(plan.appended_files)))
+        if total <= chunk_rows:
             return None
     except Exception:
         return None
@@ -360,15 +365,22 @@ def _chunked_filtered_index_scan(plan: IndexScan, needed: Optional[Set[str]],
                 lc.data.astype(jnp.int64), deleted)
         parts.append(chunk.filter(mask))
     if plan.appended_files:
+        # Appended files stream under the same budget — they can be a
+        # sizable fraction of an over-HBM index (hybrid append ratio).
         app_cols = [c for c in cols if c != lineage]
-        appended = read_parquet(plan.appended_files, app_cols)
-        mask = eval_predicate_mask(appended, condition)
-        appended = appended.filter(mask)
-        if lineage in cols:
-            fill = Column(INT64, jnp.full(
-                appended.num_rows, IndexConstants.UNKNOWN_FILE_ID, jnp.int64))
-            appended = appended.with_column(lineage, fill)
-        parts.append(appended.select(cols))
+        for chunk in iter_dataset_chunks(list(plan.appended_files),
+                                         app_cols, chunk_rows, None):
+            CHUNK_SCAN_STATS["max_device_rows"] = max(
+                CHUNK_SCAN_STATS["max_device_rows"], chunk.num_rows)
+            CHUNK_SCAN_STATS["chunks"] += 1
+            mask = eval_predicate_mask(chunk, condition)
+            appended = chunk.filter(mask)
+            if lineage in cols:
+                fill = Column(INT64, jnp.full(
+                    appended.num_rows, IndexConstants.UNKNOWN_FILE_ID,
+                    jnp.int64))
+                appended = appended.with_column(lineage, fill)
+            parts.append(appended.select(cols))
     parts = [p for p in parts if p.num_rows > 0]
     if not parts:
         return empty_table(entry.schema.select(out_cols))

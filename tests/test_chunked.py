@@ -243,9 +243,7 @@ class TestChunkedIndexScan:
         })
         pq.write_table(pa.Table.from_pandas(extra),
                        data_dir / "extra.parquet")
-        victim = sorted(data_dir.glob("part0.parquet"))[0]
-        n_per_part = len(pq.read_table(victim))
-        victim.unlink()
+        (data_dir / "part0.parquet").unlink()
         t2 = session.read.parquet(env["path"])
         q = t2.filter(col("k") < 2500).select("k", "v")
         from hyperspace_tpu.plan.nodes import IndexScan
