@@ -474,6 +474,10 @@ def main():
     try:
         import jax
         if not backend_ok:
+            # In-process platform switch: the env var would not be honored
+            # (axon plugin captured it). The persistent-cache policy keys
+            # on the resolved backend at Session creation, so this switch
+            # also turns the crash-prone CPU cache off (execution/__init__).
             jax.config.update("jax_platforms", "cpu")
         import hyperspace_tpu as hst
         from hyperspace_tpu.api import Hyperspace, IndexConfig

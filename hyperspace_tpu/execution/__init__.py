@@ -39,8 +39,30 @@ def _host_fingerprint() -> str:
     return hashlib.sha1(bits.encode()).hexdigest()[:12]
 
 
-if os.environ.get("HST_XLA_CACHE", "on") != "off":
+# CPU-backend sessions skip the persistent cache entirely: XLA:CPU compiles
+# are sub-second (the cache buys little) and this image's cache layer has
+# crashed twice under it — an Abort loading a stale-feature AOT entry and a
+# SIGSEGV serializing a fresh one. On accelerators the compile is tens of
+# seconds and serialization is the hardened path, so the cache stays on.
+# Detection uses jax's RESOLVED backend (not the env var), so in-process
+# ``jax.config.update("jax_platforms", "cpu")`` switches — the bench's CPU
+# fallback, test conftest — are honored; it therefore runs lazily at
+# Session construction (the backend can't be queried before the caller has
+# picked a platform). HST_XLA_CACHE: "auto" (default) | "on" | "off".
+_cache_configured = False
+
+
+def ensure_compilation_cache() -> None:
+    global _cache_configured
+    if _cache_configured:
+        return
+    _cache_configured = True
+    mode = os.environ.get("HST_XLA_CACHE", "auto")
+    if mode == "off":
+        return
     try:
+        if mode == "auto" and jax.default_backend() == "cpu":
+            return
         _cache_dir = os.environ.get(
             "HST_XLA_CACHE_DIR",
             os.path.join(os.path.expanduser("~"), ".cache", "hyperspace_tpu",

@@ -273,6 +273,11 @@ def _encode_arrow_column(chunked: pa.ChunkedArray) -> Column:
         dtype = BOOL
     elif pa.types.is_integer(t):
         wide = combined.cast(pa.int64()).to_numpy(zero_copy_only=False)
+        if wide.dtype != np.int64:
+            # Nulls surface as float64 NaN here; zero them BEFORE the int
+            # cast (validity masks them below — casting NaN to int is
+            # undefined and warns).
+            wide = np.nan_to_num(wide, nan=0).astype(np.int64)
         if t.bit_width <= 32:
             np_data, dtype = wide.astype(np.int32), INT32
         else:

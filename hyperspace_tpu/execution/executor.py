@@ -367,9 +367,21 @@ def _chunked_filtered_index_scan(plan: IndexScan, needed: Optional[Set[str]],
     if plan.appended_files:
         # Appended files stream under the same budget — they can be a
         # sizable fraction of an over-HBM index (hybrid append ratio).
+        # Dotted struct leaves aren't physical top-level columns in the
+        # SOURCE files (the index stores them flat); those must go through
+        # read_parquet's root-read+flatten path, chunked by file.
         app_cols = [c for c in cols if c != lineage]
-        for chunk in iter_dataset_chunks(list(plan.appended_files),
-                                         app_cols, chunk_rows, None):
+        import pyarrow.parquet as _pq
+        physical = set(_pq.read_schema(plan.appended_files[0]).names)
+        if any(c not in physical for c in app_cols):
+            def _app_chunks():
+                for f in plan.appended_files:
+                    yield read_parquet([f], app_cols)
+            app_iter = _app_chunks()
+        else:
+            app_iter = iter_dataset_chunks(list(plan.appended_files),
+                                           app_cols, chunk_rows, None)
+        for chunk in app_iter:
             CHUNK_SCAN_STATS["max_device_rows"] = max(
                 CHUNK_SCAN_STATS["max_device_rows"], chunk.num_rows)
             CHUNK_SCAN_STATS["chunks"] += 1

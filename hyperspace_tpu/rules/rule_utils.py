@@ -150,8 +150,21 @@ def common_source_bytes(entry: IndexLogEntry, relation) -> int:
     return sum(f.size for f in (current & entry.source_file_info_set))
 
 
-def index_scan_schema(entry: IndexLogEntry) -> Schema:
-    """The index schema exposed to the plan (lineage column hidden)."""
+def index_scan_schema(entry: IndexLogEntry,
+                      like: "Schema" = None) -> Schema:
+    """The index schema exposed to the plan (lineage column hidden).
+
+    ``like``: order columns as that schema does (the replaced Scan's) —
+    the rewrite must not change the plan's output column ORDER, only its
+    physical source (a select-free query returns relation-ordered columns
+    either way; Spark keeps the original output attributes too)."""
+    if like is not None:
+        inner = set(entry.schema.names)
+        ordered = [n for n in like.names if n in inner]
+        ordered += [n for n in entry.schema.names if n not in set(ordered)]
+        names = [n for n in ordered
+                 if n != IndexConstants.DATA_FILE_NAME_ID]
+        return entry.schema.select(names)
     names = [n for n in entry.schema.names
              if n != IndexConstants.DATA_FILE_NAME_ID]
     return entry.schema.select(names)
@@ -182,7 +195,7 @@ def transform_plan_to_use_index(session, entry: IndexLogEntry,
                         deleted_ids = [
                             by_key[(f.name, f.size, f.modifiedTime)]
                             for f in deleted]
-            return IndexScan(entry, index_scan_schema(entry),
+            return IndexScan(entry, index_scan_schema(entry, node.schema),
                              use_bucket_spec=use_bucket_spec,
                              deleted_file_ids=deleted_ids,
                              appended_files=appended_paths)

@@ -26,6 +26,11 @@ from .sources.interfaces import FileBasedSourceProviderManager
 class Session:
     def __init__(self, conf: Optional[Dict[str, str]] = None,
                  system_path: Optional[str] = None):
+        # Backend-aware persistent-cache setup (no-op after the first
+        # session; initializes the jax backend, which callers that switch
+        # platforms in-process have already pinned by now).
+        from .execution import ensure_compilation_cache
+        ensure_compilation_cache()
         self.conf = Conf(conf)
         if system_path is not None:
             from .index.constants import IndexConstants

@@ -26,6 +26,17 @@ jax.config.update("jax_platforms", "cpu")
 import pytest  # noqa: E402
 
 
+@pytest.fixture(autouse=True, scope="module")
+def _clear_jax_caches_per_module():
+    """XLA:CPU segfaults deterministically once enough distinct programs
+    accumulate in one process (observed at test ~412 of the full suite,
+    inside backend_compile_and_load, at modest RSS). Dropping compiled
+    executables and trace caches per module bounds the accumulation; the
+    recompile cost is a few percent of suite time."""
+    jax.clear_caches()
+    yield
+
+
 @pytest.fixture()
 def tmp_system_path(tmp_path):
     """A fresh hyperspace system path per test."""
