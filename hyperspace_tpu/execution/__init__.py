@@ -12,10 +12,9 @@ import jax
 
 jax.config.update("jax_enable_x64", True)
 
-# Persistent XLA compilation cache: index-build and scan programs are
-# recompiled per (kernel, shape) otherwise — on TPU a cold compile is tens of
-# seconds, so caching across processes is what makes repeated builds/queries
-# (and repeated bench runs) cheap. Opt out with HST_XLA_CACHE=off.
+# Persistent XLA compilation cache for ACCELERATOR backends (see
+# ensure_compilation_cache below for the policy; CPU sessions skip it and
+# the setup runs lazily at Session construction once the backend is known).
 #
 # The directory is keyed by a HOST CPU FINGERPRINT: XLA:CPU AOT executables
 # bake in the compile machine's features (+amx/+avx512...), and jax's cache

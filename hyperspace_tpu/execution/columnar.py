@@ -272,12 +272,11 @@ def _encode_arrow_column(chunked: pa.ChunkedArray) -> Column:
         np_data = combined.to_numpy(zero_copy_only=False)
         dtype = BOOL
     elif pa.types.is_integer(t):
-        wide = combined.cast(pa.int64()).to_numpy(zero_copy_only=False)
-        if wide.dtype != np.int64:
-            # Nulls surface as float64 NaN here; zero them BEFORE the int
-            # cast (validity masks them below — casting NaN to int is
-            # undefined and warns).
-            wide = np.nan_to_num(wide, nan=0).astype(np.int64)
+        # fill_null BEFORE to_numpy: the null path otherwise round-trips
+        # through float64 (NaN-null), silently corrupting int64 values
+        # beyond ±2^53. Validity masks the filled zeros below.
+        filled = combined.fill_null(0) if null_count else combined
+        wide = filled.cast(pa.int64()).to_numpy(zero_copy_only=False)
         if t.bit_width <= 32:
             np_data, dtype = wide.astype(np.int32), INT32
         else:

@@ -369,18 +369,35 @@ def _chunked_filtered_index_scan(plan: IndexScan, needed: Optional[Set[str]],
         # sizable fraction of an over-HBM index (hybrid append ratio).
         # Dotted struct leaves aren't physical top-level columns in the
         # SOURCE files (the index stores them flat); those must go through
-        # read_parquet's root-read+flatten path, chunked by file.
+        # read_parquet's root-read+flatten path — per file, sliced to the
+        # budget. EVERY file's schema is probed (appends can carry evolved
+        # schemas), unreadable probes take the safe fallback.
         app_cols = [c for c in cols if c != lineage]
         import pyarrow.parquet as _pq
-        physical = set(_pq.read_schema(plan.appended_files[0]).names)
-        if any(c not in physical for c in app_cols):
-            def _app_chunks():
-                for f in plan.appended_files:
-                    yield read_parquet([f], app_cols)
-            app_iter = _app_chunks()
-        else:
+        try:
+            flat = all(
+                all(c in set(_pq.read_schema(f).names) for c in app_cols)
+                for f in plan.appended_files)
+        except Exception:
+            flat = False
+        if flat:
             app_iter = iter_dataset_chunks(list(plan.appended_files),
                                            app_cols, chunk_rows, None)
+        else:
+            def _app_chunks():
+                # Host-side arrow read + flatten, sliced BEFORE device
+                # conversion so HBM holds at most chunk_rows (the host
+                # holds one source file's arrow — host RAM ≫ HBM).
+                import pyarrow as _pa
+                for f in plan.appended_files:
+                    at = _pq.read_table(f)
+                    while any(_pa.types.is_struct(fld.type)
+                              for fld in at.schema):
+                        at = at.flatten()
+                    at = at.select(app_cols)
+                    for lo in range(0, at.num_rows, chunk_rows):
+                        yield Table.from_arrow(at.slice(lo, chunk_rows))
+            app_iter = _app_chunks()
         for chunk in app_iter:
             CHUNK_SCAN_STATS["max_device_rows"] = max(
                 CHUNK_SCAN_STATS["max_device_rows"], chunk.num_rows)

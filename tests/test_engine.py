@@ -290,3 +290,20 @@ class TestProfilerTrace:
         found = [os.path.join(r, f) for r, _, fs in os.walk(trace_dir)
                  for f in fs]
         assert found, "no profiler trace files written"
+
+
+class TestNullableInt64Precision:
+    def test_large_int64_with_nulls_roundtrips_exactly(self, tmp_path):
+        """A nullable int64 column must NOT round-trip through float64
+        (NaN-null): values beyond ±2^53 would silently lose precision."""
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+
+        big = 9_007_199_254_740_995  # 2^53 + 3: not float64-representable
+        t = pa.table({"v": pa.array([big, None, -big, 7], pa.int64())})
+        d = tmp_path / "p"
+        d.mkdir()
+        pq.write_table(t, d / "x.parquet")
+        session = hst.Session(system_path=str(tmp_path / "idx"))
+        got = session.read.parquet(str(d)).to_arrow()
+        assert got.column("v").to_pylist() == [big, None, -big, 7]

@@ -42,9 +42,11 @@ class TestLogIdRaces:
                  for w in range(n_writers)]
         for p in procs:
             p.start()
-        results = [q.get(timeout=60) for _ in procs]
+        # Generous timeouts: each spawned child pays a full jax import,
+        # and the suite may share the machine with a bench run.
+        results = [q.get(timeout=300) for _ in procs]
         for p in procs:
-            p.join(timeout=60)
+            p.join(timeout=300)
         winners = [w for w, ok in results if ok]
         assert len(winners) == 1, f"{len(winners)} writers claimed id 1"
         # The surviving entry is the winner's, intact.
@@ -160,3 +162,123 @@ class TestCrashRecovery:
             f.write('{"name": "torn", "state":')
         stable = mgr.get_latest_stable_log()
         assert stable is not None and stable.state == States.ACTIVE
+
+
+def _refresh_worker(root, q):
+    """Child: run an incremental refresh (slowed by op-log timing jitter is
+    unnecessary — the parent queries concurrently while this runs)."""
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import sys
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+    import hyperspace_tpu as hst
+    from hyperspace_tpu.api import Hyperspace
+
+    session = hst.Session(system_path=os.path.join(root, "indexes"))
+    try:
+        Hyperspace(session).refresh_index("rwIdx", "incremental")
+        q.put(("refresh", "ok"))
+    except Exception as e:  # pragma: no cover - diagnostic channel
+        q.put(("refresh", f"err: {e}"))
+
+
+def _parallel_create_worker(root, name, q):
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import sys
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+    import hyperspace_tpu as hst
+    from hyperspace_tpu.api import Hyperspace, IndexConfig
+
+    session = hst.Session(system_path=os.path.join(root, "indexes"))
+    try:
+        Hyperspace(session).create_index(
+            session.read.parquet(os.path.join(root, "data")),
+            IndexConfig(name, ["k"], ["v"]))
+        q.put((name, "ok"))
+    except Exception as e:
+        q.put((name, f"err: {e}"))
+
+
+class TestReaderWriterRaces:
+    """Readers must only ever see stable states: a refresh running in a
+    separate process never changes query answers mid-flight, and distinct
+    indexes create concurrently without interference (the op logs are
+    per-index — the reference's per-index IndexLogManager isolation)."""
+
+    def _seed(self, tmp_path, n=4000):
+        rng = np.random.default_rng(5)
+        data_dir = tmp_path / "data"
+        data_dir.mkdir()
+        df = pd.DataFrame({
+            "k": rng.integers(0, 60, n).astype(np.int64),
+            "v": rng.integers(0, 9, n).astype(np.int64),
+        })
+        pq.write_table(pa.Table.from_pandas(df), data_dir / "p.parquet")
+        (tmp_path / "indexes").mkdir()
+        return df
+
+    def test_queries_stable_during_refresh(self, tmp_path):
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        import hyperspace_tpu as hst
+        from hyperspace_tpu.api import Hyperspace, IndexConfig
+        from hyperspace_tpu.plan.expr import col
+
+        df = self._seed(tmp_path)
+        session = hst.Session(system_path=str(tmp_path / "indexes"))
+        hs = Hyperspace(session)
+        t = session.read.parquet(str(tmp_path / "data"))
+        hs.create_index(t, IndexConfig("rwIdx", ["k"], ["v"]))
+        # Append source data so the refresh has real work.
+        rng = np.random.default_rng(6)
+        pq.write_table(pa.Table.from_pandas(pd.DataFrame({
+            "k": rng.integers(0, 60, 1500).astype(np.int64),
+            "v": rng.integers(0, 9, 1500).astype(np.int64),
+        })), tmp_path / "data" / "extra.parquet")
+
+        ctx = mp.get_context("spawn")
+        q = ctx.Queue()
+        p = ctx.Process(target=_refresh_worker, args=(str(tmp_path), q))
+        p.start()
+        # Query repeatedly WHILE the refresh commits; with the ORIGINAL
+        # file listing the answers must be the pre-refresh ones every
+        # time (snapshot semantics: the plan's relation pins its files).
+        session.enable_hyperspace()
+        expected = (df.k == 7).sum()
+        query = t.filter(col("k") == 7).select("k", "v")
+        while p.is_alive():
+            assert len(query.to_pandas()) == expected
+        tag, status = q.get(timeout=300)
+        p.join(timeout=300)
+        assert status == "ok", status
+        # After refresh: a FRESH relation sees old+new rows, indexed.
+        t2 = session.read.parquet(str(tmp_path / "data"))
+        got = len(t2.filter(col("k") == 7).to_pandas())
+        session.disable_hyperspace()
+        raw = len(t2.filter(col("k") == 7).to_pandas())
+        assert got == raw > expected
+
+    def test_concurrent_creates_of_distinct_indexes(self, tmp_path):
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        import hyperspace_tpu as hst
+        from hyperspace_tpu.api import Hyperspace
+
+        self._seed(tmp_path)
+        ctx = mp.get_context("spawn")
+        q = ctx.Queue()
+        names = [f"pidx{i}" for i in range(3)]
+        procs = [ctx.Process(target=_parallel_create_worker,
+                             args=(str(tmp_path), n, q)) for n in names]
+        for p in procs:
+            p.start()
+        results = dict(q.get(timeout=300) for _ in procs)
+        for p in procs:
+            p.join(timeout=300)
+        assert all(results[n] == "ok" for n in names), results
+        session = hst.Session(system_path=str(tmp_path / "indexes"))
+        listing = Hyperspace(session).indexes()
+        assert set(names) <= set(listing["name"])
+        assert (listing[listing["name"].isin(names)]["state"]
+                == States.ACTIVE).all()

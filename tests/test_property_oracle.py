@@ -132,7 +132,6 @@ def _random_query(rng, t, schema):
                     aggs.append(min_(col(v)).alias("lo"))
                     aggs.append(max_(col(v)).alias("hi"))
             q = q.group_by(g).agg(*aggs)
-            names = [g] + [a.name for a in []]
     if rng.random() < 0.4:
         sortable = list(q.plan.schema.names)
         if sortable:
