@@ -13,7 +13,7 @@ itself ... is the part the new framework replaces with XLA/Pallas kernels").
 from __future__ import annotations
 
 import contextvars
-from typing import List, Optional, Sequence, Set, Tuple
+from typing import Dict, List, Optional, Sequence, Set, Tuple
 
 import jax.numpy as jnp
 import numpy as np
@@ -375,9 +375,12 @@ def _chunked_filtered_index_scan(plan: IndexScan, needed: Optional[Set[str]],
         app_cols = [c for c in cols if c != lineage]
         import pyarrow.parquet as _pq
         try:
-            flat = all(
-                all(c in set(_pq.read_schema(f).names) for c in app_cols)
-                for f in plan.appended_files)
+            flat = True
+            for f in plan.appended_files:
+                names = set(_pq.read_schema(f).names)
+                if any(c not in names for c in app_cols):
+                    flat = False
+                    break
         except Exception:
             flat = False
         if flat:
@@ -387,10 +390,17 @@ def _chunked_filtered_index_scan(plan: IndexScan, needed: Optional[Set[str]],
             def _app_chunks():
                 # Host-side arrow read + flatten, sliced BEFORE device
                 # conversion so HBM holds at most chunk_rows (the host
-                # holds one source file's arrow — host RAM ≫ HBM).
+                # holds one source file's arrow — host RAM ≫ HBM). Only
+                # the ROOT columns of the dotted leaves are read.
                 import pyarrow as _pa
                 for f in plan.appended_files:
-                    at = _pq.read_table(f)
+                    top = set(_pq.read_schema(f).names)
+                    roots = []
+                    for c in app_cols:
+                        root = c if c in top else c.split(".", 1)[0]
+                        if root not in roots:
+                            roots.append(root)
+                    at = _pq.read_table(f, columns=roots)
                     while any(_pa.types.is_struct(fld.type)
                               for fld in at.schema):
                         at = at.flatten()
@@ -664,6 +674,17 @@ def _execute_join(plan: Join, needed: Optional[Set[str]]) -> Table:
     left = _execute(plan.left, lneed)
     right = _execute(plan.right, rneed)
 
+    how = plan.join_type
+    if how == "right":
+        # right join = left join with the sides swapped: the output below
+        # is assembled by column NAME against plan.schema, so the swap is
+        # otherwise transparent.
+        left, right = right, left
+        norm = [(r, l) for l, r in norm]
+        how = "left"
+    if how in ("left", "full"):
+        return _execute_outer_join(plan, left, right, norm, how)
+
     lkeys, rkeys = _join_key_arrays(left, right, norm)
     # Inner join: drop null keys up front.
     lvalid = _keys_validity(left, [p[0] for p in norm])
@@ -712,6 +733,73 @@ def _execute_join(plan: Join, needed: Optional[Set[str]]) -> Table:
     if lbo is not None and all(k in out for k in lbo[1]):
         order_out = lbo
     return Table(out, bucket_order=order_out)
+
+
+def _null_filled_like(table: Table, n: int) -> Dict[str, Column]:
+    """n rows of every column of ``table``, all null (outer-join padding)."""
+    out = {}
+    for name, c in table.columns.items():
+        data = jnp.zeros((n,) + c.data.shape[1:], c.data.dtype)
+        out[name] = Column(c.dtype, data, jnp.zeros(n, jnp.bool_),
+                          c.dictionary)
+    return out
+
+
+def _execute_outer_join(plan: Join, left: Table, right: Table, norm,
+                        how: str) -> Table:
+    """LEFT (or FULL) outer equi-join: inner matches plus unmatched
+    preserved-side rows padded with nulls on the other side. Null join
+    keys never match (SQL semantics) — those rows are emitted as
+    unmatched. Row order: matched block first (probe order), then
+    left-unmatched, then (full) right-unmatched; bucket order does not
+    survive the concat."""
+    lkeys_all, rkeys_all = _join_key_arrays(left, right, norm)
+    lvalid = _keys_validity(left, [p[0] for p in norm])
+    rvalid = _keys_validity(right, [p[1] for p in norm])
+    l_idx = jnp.flatnonzero(lvalid) if lvalid is not None else None
+    r_idx = jnp.flatnonzero(rvalid) if rvalid is not None else None
+    lkeys = lkeys_all[l_idx] if l_idx is not None else lkeys_all
+    rkeys = rkeys_all[r_idx] if r_idx is not None else rkeys_all
+
+    order = kernels.lex_sort_indices([rkeys])
+    rkeys_sorted = jnp.take(rkeys, order)
+    li, ri, counts = kernels.merge_join_indices(lkeys, rkeys_sorted,
+                                                return_counts=True)
+    # Map subset indices back to original row positions.
+    li_orig = jnp.take(l_idx, li) if l_idx is not None else li
+    r_pos = jnp.take(r_idx, order) if r_idx is not None else order
+    ri_orig = jnp.take(r_pos, ri)
+
+    unmatched_l = jnp.flatnonzero(counts == 0)
+    unmatched_l_orig = jnp.take(l_idx, unmatched_l) \
+        if l_idx is not None else unmatched_l
+    if lvalid is not None:
+        unmatched_l_orig = jnp.concatenate(
+            [unmatched_l_orig, jnp.flatnonzero(~lvalid)])
+
+    blocks: List[Dict[str, Column]] = []
+    matched_left = left.take(li_orig)
+    matched_right = right.take(ri_orig)
+    blocks.append({**matched_left.columns, **matched_right.columns})
+    n_um_l = int(unmatched_l_orig.shape[0])  # HOST SYNC (scalar)
+    if n_um_l:
+        blocks.append({**left.take(unmatched_l_orig).columns,
+                       **_null_filled_like(right, n_um_l)})
+    if how == "full":
+        # Right rows no left row matched: mark via a hit-scatter. ~hit
+        # naturally includes null-key right rows (they never match).
+        hit = jnp.zeros(right.num_rows, jnp.bool_).at[ri_orig].set(True)
+        unmatched_r = jnp.flatnonzero(~hit)
+        n_um_r = int(unmatched_r.shape[0])  # HOST SYNC (scalar)
+        if n_um_r:
+            blocks.append({**_null_filled_like(left, n_um_r),
+                           **right.take(unmatched_r).columns})
+
+    pieces = [Table({n: b[n] for n in b}) for b in blocks]
+    ordered_names = [n for n in plan.schema.names
+                     if n in pieces[0].names]
+    out = Table.concat([p.select(ordered_names) for p in pieces])
+    return out
 
 
 def _bucketed_merge_keys(left: Table, right: Table, norm, lkeys, rkeys):

@@ -162,6 +162,8 @@ def _load_leaf(leaf, stages, needed, executor) -> "Table":
 
 
 def _normalized_join_pairs(join: Join) -> List[Tuple[str, str]]:
+    if join.join_type != "inner":
+        raise _Unsupported(f"{join.join_type} join")  # outer: single-device
     pairs = E.extract_equi_join_keys(join.condition)
     if pairs is None:
         raise _Unsupported("non-equi join")

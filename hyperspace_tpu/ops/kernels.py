@@ -170,18 +170,23 @@ def lex_sort_indices(keys: Sequence[jax.Array],
 # Merge join over sorted keys.
 # ---------------------------------------------------------------------------
 
-def merge_join_indices(left_keys: jax.Array, right_keys_sorted: jax.Array
-                       ) -> Tuple[jax.Array, jax.Array]:
+def merge_join_indices(left_keys: jax.Array, right_keys_sorted: jax.Array,
+                       return_counts: bool = False):
     """Inner equi-join: for each left row, all matching right rows.
 
     ``right_keys_sorted`` must be ascending. Returns (left_idx, right_idx)
-    gather indices. Output length is data-dependent → one scalar HOST SYNC.
+    gather indices — plus the per-left-row match counts when
+    ``return_counts`` (outer joins pad count-0 rows). Output length is
+    data-dependent → one scalar HOST SYNC.
     """
     lo = jnp.searchsorted(right_keys_sorted, left_keys, side="left")
     hi = jnp.searchsorted(right_keys_sorted, left_keys, side="right")
     counts = (hi - lo).astype(jnp.int32)
     total = int(jnp.sum(counts))  # HOST SYNC (single scalar).
-    return _expand_matches(counts, lo, total)
+    li, ri = _expand_matches(counts, lo, total)
+    if return_counts:
+        return li, ri, counts
+    return li, ri
 
 
 @partial(jax.jit, static_argnames=("total",))

@@ -212,7 +212,7 @@ class Project(LogicalPlan):
 class Join(LogicalPlan):
     def __init__(self, left: LogicalPlan, right: LogicalPlan, condition: E.Expr,
                  join_type: str = "inner"):
-        if join_type not in ("inner",):
+        if join_type not in ("inner", "left", "right", "full"):
             raise HyperspaceException(f"Unsupported join type: {join_type}")
         overlap = set(left.schema.names) & set(right.schema.names)
         if overlap:
@@ -229,6 +229,16 @@ class Join(LogicalPlan):
         self.right = right
         self.condition = condition
         self.join_type = join_type
+        # Outer joins null-fill the non-preserved side's columns.
+        if join_type != "inner":
+            from ..schema import Field
+            left_nullable = join_type in ("right", "full")
+            right_nullable = join_type in ("left", "full")
+            combined = [
+                Field(f.name, f.dtype,
+                      f.nullable or (left_nullable if f.name in
+                                     left.schema else right_nullable))
+                for f in combined]
         self._schema = Schema(combined)
 
     @property

@@ -247,7 +247,10 @@ class TestReaderWriterRaces:
         session.enable_hyperspace()
         expected = (df.k == 7).sum()
         query = t.filter(col("k") == 7).select("k", "v")
+        import time
+        deadline = time.monotonic() + 300
         while p.is_alive():
+            assert time.monotonic() < deadline, "refresh child hung"
             assert len(query.to_pandas()) == expected
         tag, status = q.get(timeout=300)
         p.join(timeout=300)

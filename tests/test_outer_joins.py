@@ -1,0 +1,150 @@
+"""Outer joins (left / right / full) in the execution engine.
+
+The reference's JoinIndexRule only rewrites INNER equi-joins (Spark
+executes the rest without indexes); since this framework ships its own
+engine, the engine itself must execute outer joins — padded with nulls on
+the non-preserved side, null join keys never matching (SQL semantics).
+Oracle: pandas merge with how= equivalents.
+"""
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+import hyperspace_tpu as hst
+from hyperspace_tpu.api import Hyperspace, IndexConfig
+from hyperspace_tpu.plan.expr import col
+
+
+@pytest.fixture()
+def env(tmp_path):
+    rng = np.random.default_rng(8)
+    n_l, n_r = 3000, 900
+    left = pd.DataFrame({
+        "lk": rng.integers(0, 1200, n_l).astype(np.int64),
+        "lv": np.round(rng.random(n_l), 4),
+        "ls": rng.choice(["x", "y", "z"], n_l),
+    })
+    # ~8% null keys on the left.
+    left.loc[rng.random(n_l) < 0.08, "lk"] = None
+    right = pd.DataFrame({
+        "rk": rng.integers(0, 1200, n_r).astype(np.int64),
+        "rw": rng.integers(0, 50, n_r).astype(np.int64),
+    })
+    right.loc[rng.random(n_r) < 0.08, "rk"] = None
+    ld, rd = tmp_path / "l", tmp_path / "r"
+    ld.mkdir(), rd.mkdir()
+    pq.write_table(pa.Table.from_pandas(left, preserve_index=False),
+                   ld / "p.parquet")
+    pq.write_table(pa.Table.from_pandas(right, preserve_index=False),
+                   rd / "p.parquet")
+    session = hst.Session(system_path=str(tmp_path / "idx"))
+    return dict(session=session, hs=Hyperspace(session),
+                l=str(ld), r=str(rd), left=left, right=right)
+
+
+def _oracle(left, right, how):
+    """SQL-semantics oracle: pandas merge treats NaN keys as EQUAL (NaN
+    joins NaN), so null-key rows are split out and handled per SQL —
+    never matching, preserved only by the outer side(s)."""
+    l_valid = left.dropna(subset=["lk"])
+    l_null = left[left["lk"].isna()]
+    r_valid = right.dropna(subset=["rk"])
+    r_null = right[right["rk"].isna()]
+    inner = l_valid.merge(r_valid, left_on="lk", right_on="rk", how="inner")
+    if how == "inner":
+        return inner
+    l_unmatched = pd.concat(
+        [l_valid[~l_valid["lk"].isin(set(r_valid["rk"]))], l_null])
+    r_unmatched = pd.concat(
+        [r_valid[~r_valid["rk"].isin(set(l_valid["lk"]))], r_null])
+    if how == "left":
+        return pd.concat([inner, l_unmatched], ignore_index=True)
+    if how == "right":
+        return pd.concat([inner, r_unmatched], ignore_index=True)
+    return pd.concat([inner, l_unmatched, r_unmatched], ignore_index=True)
+
+
+def _norm(df, cols):
+    return df[cols].sort_values(cols, na_position="first") \
+        .reset_index(drop=True).astype("object")
+
+
+def _check(engine_df, oracle_df):
+    cols = list(engine_df.columns)
+    a = _norm(engine_df, cols)
+    b = _norm(oracle_df, cols)
+    assert len(a) == len(b), (len(a), len(b))
+    for c in cols:
+        va, vb = a[c].to_numpy(), b[c].to_numpy()
+        for x, y in zip(va, vb):
+            if x is None or (isinstance(x, float) and np.isnan(x)):
+                assert y is None or (isinstance(y, float) and np.isnan(y))
+            elif isinstance(x, float):
+                assert abs(x - y) < 1e-9
+            else:
+                assert x == y, (c, x, y)
+
+
+class TestOuterJoins:
+    @pytest.mark.parametrize("how", ["left", "right", "full"])
+    def test_matches_pandas(self, env, how):
+        session = env["session"]
+        lt = session.read.parquet(env["l"])
+        rt = session.read.parquet(env["r"])
+        q = lt.join(rt, on=col("lk") == col("rk"), how=how)
+        got = q.to_pandas()
+        pandas_how = {"left": "left", "right": "right",
+                      "full": "outer"}[how]
+        exp = _oracle(env["left"], env["right"], pandas_how)
+        _check(got, exp)
+
+    def test_left_null_keys_are_preserved_unmatched(self, env):
+        session = env["session"]
+        lt = session.read.parquet(env["l"])
+        rt = session.read.parquet(env["r"])
+        q = lt.join(rt, on=col("lk") == col("rk"), how="left")
+        got = q.to_pandas()
+        n_null_keys = env["left"]["lk"].isna().sum()
+        null_rows = got[got["lk"].isna()]
+        assert len(null_rows) == n_null_keys
+        assert null_rows["rw"].isna().all()  # padded, never matched
+
+    def test_inner_unchanged(self, env):
+        session = env["session"]
+        lt = session.read.parquet(env["l"])
+        rt = session.read.parquet(env["r"])
+        q = lt.join(rt, on=col("lk") == col("rk"), how="inner")
+        got = q.to_pandas()
+        exp = _oracle(env["left"], env["right"], "inner")
+        _check(got, exp)
+
+    def test_string_payloads_and_schema_nullability(self, env):
+        session = env["session"]
+        lt = session.read.parquet(env["l"])
+        rt = session.read.parquet(env["r"])
+        q = lt.join(rt, on=col("lk") == col("rk"), how="full")
+        # Both sides' columns become nullable in the output schema.
+        sch = q.plan.schema
+        assert all(sch.field(n).nullable for n in sch.names)
+        got = q.to_pandas()
+        assert got["ls"].isna().any()  # right-unmatched rows pad left cols
+
+    def test_rule_does_not_rewrite_outer(self, env):
+        """The JoinIndexRule is inner-only (reference parity) — an outer
+        join over indexed sides must execute on the source scans."""
+        session, hs = env["session"], env["hs"]
+        lt = session.read.parquet(env["l"])
+        rt = session.read.parquet(env["r"])
+        hs.create_index(lt, IndexConfig("ol_idx", ["lk"], ["lv", "ls"]))
+        hs.create_index(rt, IndexConfig("or_idx", ["rk"], ["rw"]))
+        session.enable_hyperspace()
+        outer = lt.join(rt, on=col("lk") == col("rk"), how="left")
+        assert "IndexScan" not in outer.optimized_plan().tree_string()
+        inner = lt.join(rt, on=col("lk") == col("rk"), how="inner")
+        assert "IndexScan" in inner.optimized_plan().tree_string()
+        # And the outer result is still correct with hyperspace on.
+        got = outer.to_pandas()
+        _check(got, _oracle(env["left"], env["right"], "left"))
