@@ -36,12 +36,16 @@ Execution model — mask-based streaming with static shapes throughout:
 - Global aggregates psum/pmin/pmax partial contributions (one collective
   per partial).
 - Grouped aggregates compute capacity-bounded per-device partials (local
-  sort → segment ops into ``G`` slots), then hash-route each partial
-  group to its owner device with one all_to_all and combine there — the
-  full two-phase shuffle-aggregate, entirely on device. The host receives
-  disjoint final groups and only concatenates + orders them. Owner-side
-  capacity escalates ×4 on hash skew (hard-bounded by ``n_dev*G``);
-  local-partial overflow still falls back (with a telemetry event).
+  sort → segment ops into ``G`` slots). On real multi-chip meshes the
+  partial groups then hash-route to owner devices with one all_to_all
+  and combine there — the full two-phase shuffle-aggregate on device;
+  the host receives disjoint final groups and only concatenates + orders
+  them (owner capacity retries once with the exact reported need,
+  hard-bounded by ``n_dev*G``). On single-host CPU meshes the exchange
+  would run on the same silicon as the host merge, so the partials go
+  straight to the host merge instead (_use_routed_merge;
+  HST_SPMD_ROUTED_MERGE=on|off overrides). Local-partial overflow still
+  falls back (with a telemetry event).
 - Row-returning (non-aggregate) chains return each device's columns +
   mask; the host gathers valid rows and concatenates (Sort/Limit wrappers
   then run on the reduced result).
@@ -55,6 +59,7 @@ path uses — executor._null_aware_keys).
 
 from __future__ import annotations
 
+import os
 from functools import partial
 from typing import Callable, Dict, List, Optional, Set, Tuple
 
@@ -741,6 +746,7 @@ def _run(plan: Aggregate, executor) -> Table:
     G2 = 0  # sized from G on first iteration
     cap_attempts = 0
     gmof_retried = False
+    routed = _use_routed_merge(prep.mesh)
     while True:
         G = min(_out_rows(prep, caps), MAX_LOCAL_GROUPS)
         G2 = min(max(G2, G), n_dev * G)
@@ -749,7 +755,7 @@ def _run(plan: Aggregate, executor) -> Table:
                             prep.project_live)
         out = _spmd_program(prep.sharded, prep.valid, prep.bcast, prep.xch,
                             mesh=prep.mesh, descr=descr, grouped=grouped,
-                            G=G, G2=G2, mode="agg")
+                            G=G, G2=G2, mode="agg", routed_merge=routed)
         if _escalate_on_overflow(out, caps):
             cap_attempts += 1
             if cap_attempts > _MAX_CAP_RETRIES:
@@ -762,7 +768,7 @@ def _run(plan: Aggregate, executor) -> Table:
         if grouped:
             if bool(np.asarray(jax.device_get(out["overflow"]))):
                 raise _Unsupported("local group capacity overflow")
-            if bool(np.asarray(jax.device_get(out["gmof"]))):
+            if routed and bool(np.asarray(jax.device_get(out["gmof"]))):
                 # One owner device holds more than G2 distinct groups
                 # (hash skew). The program reports the exact capacity
                 # needed, so ONE retry — with its own budget, not the
@@ -904,6 +910,19 @@ def _stream_probe_key(table: Table, pairs, pack) -> Tuple[jax.Array, jax.Array]:
     return comp, valid
 
 
+def _use_routed_merge(mesh: Mesh) -> bool:
+    """Backend cost decision for the grouped final merge: route partial
+    groups to owner devices over the mesh collective (real multi-chip —
+    the merge then scales with devices and the host only concatenates), or
+    hand the partials straight to the host merge (single-host CPU mesh:
+    the 'devices' share the silicon the host merge runs on, so the
+    exchange is pure added work). HST_SPMD_ROUTED_MERGE=on|off overrides."""
+    mode = os.environ.get("HST_SPMD_ROUTED_MERGE", "auto")
+    if mode in ("on", "off"):
+        return mode == "on"
+    return mesh.devices.flat[0].platform != "cpu"
+
+
 def _group_segments(mask, flags, datas, cap: int):
     """Shared grouping step for the local-partial AND owner-merge phases:
     sort rows by (masked-out last, [null-flag, value] per key column),
@@ -971,10 +990,11 @@ def _a2a_exchange(arrays: Dict[str, jax.Array], send_ok: jax.Array,
 
 
 @partial(jax.jit,
-         static_argnames=("mesh", "descr", "grouped", "G", "G2", "mode"))
+         static_argnames=("mesh", "descr", "grouped", "G", "G2", "mode",
+                          "routed_merge"))
 def _spmd_program(sharded, valid, bcast, xch, *, mesh: Mesh,
                   descr: _StageDescr, grouped: bool, G: int, mode: str,
-                  G2: int = 1):
+                  G2: int = 1, routed_merge: bool = True):
     stages, joins, col_meta = descr.stages, descr.joins, descr.col_meta
     agg_specs, group_cols = descr.agg_specs, descr.group_cols
     n_dev = mesh.devices.size
@@ -1201,7 +1221,12 @@ def _spmd_program(sharded, valid, bcast, xch, *, mesh: Mesh,
         # to identity). cap=G can't overflow: a source device holds at
         # most G valid partial groups total. Owner-side capacity G2
         # escalates in _run (bounded by n_dev*G, the hard total).
-        if n_dev > 1:
+        # ``routed_merge`` is a backend cost decision made by the caller:
+        # on a VIRTUAL (single-host CPU) mesh the exchange adds work on
+        # the same silicon the host merge would use, so the partials go
+        # to the host merge instead; on real multi-chip the collective
+        # rides ICI and the host stops being the merge bottleneck.
+        if n_dev > 1 and routed_merge:
             send = {k: v for k, v in out.items()
                     if k not in ("overflow", "gvalid")
                     and not k.startswith("xof:")}
@@ -1258,7 +1283,7 @@ def _spmd_program(sharded, valid, bcast, xch, *, mesh: Mesh,
                 out_specs[f"ov:{n}"] = P(DATA_AXIS)
     elif grouped:
         out_specs = {"overflow": P(), "gmof": P()}
-        if mesh.devices.size > 1:
+        if mesh.devices.size > 1 and routed_merge:
             out_specs["gmneed"] = P()
         for spec in agg_specs:
             for k in spec.partial_keys():

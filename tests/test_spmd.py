@@ -138,7 +138,13 @@ class TestGroupedAggregate:
 class TestDistributedFinalMerge:
     """The grouped two-phase shuffle: partial groups hash-route to owner
     devices and combine there, so the host receives disjoint final groups
-    (spmd.py distributed-final-merge block)."""
+    (spmd.py distributed-final-merge block). The virtual CPU mesh defaults
+    to the host merge (cost decision, _use_routed_merge), so these tests
+    force the routed path on."""
+
+    @pytest.fixture(autouse=True)
+    def _force_routed(self, monkeypatch):
+        monkeypatch.setenv("HST_SPMD_ROUTED_MERGE", "on")
 
     def test_host_receives_disjoint_groups(self, session, lineitem_dir,
                                            monkeypatch):
