@@ -12,6 +12,7 @@ analysis → (hyperspace rewrite if enabled) → the XLA executor.
 from __future__ import annotations
 
 import importlib
+import os
 from typing import Dict, List, Optional, Tuple, Union as TUnion
 
 from .config import Conf, HyperspaceConf
@@ -318,6 +319,76 @@ class DataFrame:
             text += "\n\n== Optimized (hyperspace) ==\n" + \
                 self.optimized_plan().tree_string()
         return text
+
+    @property
+    def write(self) -> "DataFrameWriter":
+        """Write the (rewritten) query result to files — the output side
+        of the user API (Spark's df.write analogue)."""
+        return DataFrameWriter(self)
+
+
+class DataFrameWriter:
+    """Minimal writer: result → parquet/csv/json files. ``mode``:
+    "error" (default, refuse to overwrite a non-empty dir) |
+    "overwrite" | "append" (add a new part file)."""
+
+    def __init__(self, df: "DataFrame"):
+        self._df = df
+        self._mode = "error"
+
+    def mode(self, mode: str) -> "DataFrameWriter":
+        if mode not in ("error", "overwrite", "append"):
+            raise HyperspaceException(f"Unknown write mode: {mode}")
+        self._mode = mode
+        return self
+
+    # Write protocol, in this order for every format:
+    #   1. _check: cheap destination validation BEFORE the query runs
+    #      (a refused write must not pay the plan's execution cost);
+    #   2. materialize the result fully in memory;
+    #   3. _finalize: only now delete (overwrite) + create the dir — so
+    #      writing a query back over its own source is safe (the data was
+    #      already read in step 2).
+
+    def _check(self, path: str) -> None:
+        if os.path.isfile(path):
+            raise HyperspaceException(f"Path is a file, not a dir: {path}")
+        if self._mode == "error" and os.path.isdir(path) and os.listdir(path):
+            raise HyperspaceException(
+                f"Path not empty: {path} (use mode('overwrite') or "
+                "mode('append'))")
+
+    def _finalize(self, path: str) -> str:
+        import shutil
+        import uuid
+        if self._mode == "overwrite" and os.path.isdir(path):
+            shutil.rmtree(path)
+        os.makedirs(path, exist_ok=True)
+        return os.path.join(path, f"part-{uuid.uuid4().hex[:12]}")
+
+    def parquet(self, path: str) -> None:
+        from .execution.columnar import write_parquet
+        self._check(path)
+        table = self._df.execute().to_host()
+        write_parquet(table, self._finalize(path) + ".parquet")
+
+    def csv(self, path: str) -> None:
+        import pyarrow.csv as pa_csv
+        self._check(path)
+        at = self._df.to_arrow()
+        pa_csv.write_csv(at, self._finalize(path) + ".csv")
+
+    def json(self, path: str) -> None:
+        self._check(path)
+        df = self._df.to_pandas()
+        df.to_json(self._finalize(path) + ".json",
+                   orient="records", lines=True, date_format="iso")
+
+    def avro(self, path: str) -> None:
+        from .util.avro import write_avro
+        self._check(path)
+        at = self._df.to_arrow()
+        write_avro(at, self._finalize(path) + ".avro")
 
 
 class GroupedData:

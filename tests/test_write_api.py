@@ -1,0 +1,128 @@
+"""DataFrame.write: the output side of the user API (df.write analogue).
+
+Round-trips each format through the engine's own readers, honors
+error/overwrite/append modes, and writes the REWRITTEN result when
+hyperspace is enabled (the rewrite is semantics-preserving, so the bytes
+must equal the no-index run's)."""
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+import hyperspace_tpu as hst
+from hyperspace_tpu.api import Hyperspace, IndexConfig
+from hyperspace_tpu.exceptions import HyperspaceException
+from hyperspace_tpu.plan.expr import col
+
+
+@pytest.fixture()
+def env(tmp_path):
+    rng = np.random.default_rng(13)
+    df = pd.DataFrame({
+        "k": rng.integers(0, 80, 6000).astype(np.int64),
+        "v": np.round(rng.random(6000), 5),
+        "s": rng.choice(["aa", "bb", "cc"], 6000),
+    })
+    d = tmp_path / "data"
+    d.mkdir()
+    pq.write_table(pa.Table.from_pandas(df), d / "p.parquet")
+    session = hst.Session(system_path=str(tmp_path / "idx"))
+    return dict(session=session, hs=Hyperspace(session),
+                path=str(d), df=df, tmp=tmp_path)
+
+
+def _q(session, path):
+    return session.read.parquet(path).filter(col("k") < 30).select("k", "v")
+
+
+class TestWriteFormats:
+    @pytest.mark.parametrize("fmt", ["parquet", "csv", "json", "avro"])
+    def test_round_trip(self, env, fmt):
+        session = env["session"]
+        q = _q(session, env["path"])
+        out = str(env["tmp"] / f"out_{fmt}")
+        getattr(q.write, fmt)(out)
+        back = getattr(session.read, fmt)(out).to_pandas()
+        exp = q.to_pandas()
+        key = ["k", "v"]
+        pd.testing.assert_frame_equal(
+            back.sort_values(key).reset_index(drop=True).astype(
+                {"k": "int64", "v": "float64"}),
+            exp.sort_values(key).reset_index(drop=True), check_dtype=False)
+
+
+class TestWriteModes:
+    def test_error_mode_refuses_overwrite(self, env):
+        session = env["session"]
+        q = _q(session, env["path"])
+        out = str(env["tmp"] / "out")
+        q.write.parquet(out)
+        with pytest.raises(HyperspaceException, match="not empty"):
+            q.write.parquet(out)
+
+    def test_overwrite_replaces(self, env):
+        session = env["session"]
+        q = _q(session, env["path"])
+        out = str(env["tmp"] / "out")
+        q.write.parquet(out)
+        q.write.mode("overwrite").parquet(out)
+        assert session.read.parquet(out).count() == q.count()
+
+    def test_append_adds_rows(self, env):
+        session = env["session"]
+        q = _q(session, env["path"])
+        out = str(env["tmp"] / "out")
+        q.write.parquet(out)
+        q.write.mode("append").parquet(out)
+        assert session.read.parquet(out).count() == 2 * q.count()
+
+    def test_unknown_mode_raises(self, env):
+        with pytest.raises(HyperspaceException, match="Unknown write mode"):
+            _q(env["session"], env["path"]).write.mode("nope")
+
+    def test_error_mode_sees_any_contents_not_just_parts(self, env):
+        out = env["tmp"] / "occupied"
+        out.mkdir()
+        (out / "_SUCCESS").write_text("")
+        with pytest.raises(HyperspaceException, match="not empty"):
+            _q(env["session"], env["path"]).write.parquet(str(out))
+
+    def test_file_destination_is_loud(self, env):
+        f = env["tmp"] / "a_file"
+        f.write_text("x")
+        with pytest.raises(HyperspaceException, match="is a file"):
+            _q(env["session"], env["path"]).write.parquet(str(f))
+
+    def test_overwrite_own_source_is_safe(self, env):
+        """write.mode('overwrite') back onto the query's own source dir:
+        the result materializes BEFORE the deletion, so data survives."""
+        session = env["session"]
+        src = str(env["tmp"] / "self")
+        _q(session, env["path"]).write.parquet(src)
+        q2 = session.read.parquet(src).filter(col("k") < 10)
+        expected = q2.count()
+        q2.write.mode("overwrite").parquet(src)
+        assert session.read.parquet(src).count() == expected
+
+
+class TestWriteUnderRewrite:
+    def test_written_bytes_match_no_index_run(self, env):
+        session, hs = env["session"], env["hs"]
+        t = session.read.parquet(env["path"])
+        hs.create_index(t, IndexConfig("w_idx", ["k"], ["v"]))
+        q = _q(session, env["path"])
+        out_idx = str(env["tmp"] / "with_idx")
+        out_raw = str(env["tmp"] / "without")
+        session.enable_hyperspace()
+        assert "IndexScan" in q.optimized_plan().tree_string()
+        q.write.parquet(out_idx)
+        session.disable_hyperspace()
+        q.write.parquet(out_raw)
+        a = session.read.parquet(out_idx).to_pandas()
+        b = session.read.parquet(out_raw).to_pandas()
+        key = ["k", "v"]
+        pd.testing.assert_frame_equal(
+            a.sort_values(key).reset_index(drop=True),
+            b.sort_values(key).reset_index(drop=True))
