@@ -19,7 +19,7 @@ from .config import Conf, HyperspaceConf
 from .exceptions import HyperspaceException
 from .plan import expr as E
 from .plan.nodes import (Aggregate, Filter, Join, Limit, LogicalPlan, Project,
-                         Scan, Sort)
+                         Scan, Sort, Union)
 from .schema import Schema
 from .sources.interfaces import FileBasedSourceProviderManager
 
@@ -319,6 +319,51 @@ class DataFrame:
             text += "\n\n== Optimized (hyperspace) ==\n" + \
                 self.optimized_plan().tree_string()
         return text
+
+    def with_column(self, name: str, expr: E.Expr) -> "DataFrame":
+        """Add or replace a column (Spark's withColumn)."""
+        exprs = [E.Col(n) if n != name else expr.alias(name)
+                 for n in self.plan.schema.names]
+        if name not in self.plan.schema.names:
+            exprs.append(expr.alias(name))
+        return DataFrame(self.session, Project(exprs, self.plan))
+
+    withColumn = with_column
+
+    def drop(self, *names: str) -> "DataFrame":
+        keep = [n for n in self.plan.schema.names if n not in set(names)]
+        if not keep:
+            raise HyperspaceException("drop() would remove every column")
+        return DataFrame(self.session, Project(keep, self.plan))
+
+    def distinct(self) -> "DataFrame":
+        """Distinct rows, lowered onto the grouped-aggregation machinery
+        (group by every column) so it inherits the index rewrites and the
+        SPMD path."""
+        cols = list(self.plan.schema.names)
+        # Collision-proof count alias: an agg whose name matches a group
+        # column would overwrite it in the executor's output dict.
+        cnt = "__distinct_cnt"
+        while cnt in cols:
+            cnt += "_"
+        agg = Aggregate(cols, [E.Count(None).alias(cnt)], self.plan)
+        return DataFrame(self.session, Project(cols, agg))
+
+    def union(self, other: "DataFrame") -> "DataFrame":
+        if self.plan.schema.names != other.plan.schema.names:
+            raise HyperspaceException(
+                f"union() column mismatch: {self.plan.schema.names} vs "
+                f"{other.plan.schema.names}")
+        mismatched = [
+            (f.name, f.dtype, other.plan.schema.field(f.name).dtype)
+            for f in self.plan.schema.fields
+            if f.dtype != other.plan.schema.field(f.name).dtype]
+        if mismatched:
+            raise HyperspaceException(
+                f"union() dtype mismatch: {mismatched}")
+        return DataFrame(self.session, Union([self.plan, other.plan]))
+
+    unionAll = union
 
     @property
     def write(self) -> "DataFrameWriter":

@@ -1,0 +1,116 @@
+"""DataFrame surface ops: with_column / drop / distinct / union.
+
+distinct() lowers onto grouped aggregation (group by every column), so it
+inherits index rewrites and the SPMD path; union() uses the IR's Union
+node. Oracles are pandas equivalents.
+"""
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+import hyperspace_tpu as hst
+from hyperspace_tpu.exceptions import HyperspaceException
+from hyperspace_tpu.plan.expr import col, lit
+
+
+@pytest.fixture()
+def env(tmp_path):
+    rng = np.random.default_rng(17)
+    df = pd.DataFrame({
+        "k": rng.integers(0, 12, 4000).astype(np.int64),
+        "v": rng.integers(0, 5, 4000).astype(np.int64),
+        "s": rng.choice(["p", "q"], 4000),
+    })
+    d = tmp_path / "data"
+    d.mkdir()
+    pq.write_table(pa.Table.from_pandas(df), d / "p.parquet")
+    session = hst.Session(system_path=str(tmp_path / "idx"))
+    return dict(session=session, t=session.read.parquet(str(d)), df=df)
+
+
+class TestWithColumnDrop:
+    def test_with_column_adds(self, env):
+        got = env["t"].with_column("k2", col("k") * lit(2)).to_pandas()
+        assert list(got.columns) == ["k", "v", "s", "k2"]
+        assert (got["k2"] == got["k"] * 2).all()
+
+    def test_with_column_replaces_in_place(self, env):
+        got = env["t"].with_column("v", col("v") + lit(100)).to_pandas()
+        assert list(got.columns) == ["k", "v", "s"]
+        assert (got["v"] >= 100).all()
+
+    def test_drop(self, env):
+        got = env["t"].drop("s", "v").to_pandas()
+        assert list(got.columns) == ["k"]
+        with pytest.raises(HyperspaceException, match="every column"):
+            env["t"].drop("k", "v", "s")
+
+
+class TestDistinct:
+    def test_matches_pandas(self, env):
+        got = env["t"].distinct().to_pandas()
+        exp = env["df"].drop_duplicates()
+        assert len(got) == len(exp)
+        assert list(got.columns) == ["k", "v", "s"]
+        key = ["k", "v", "s"]
+        pd.testing.assert_frame_equal(
+            got.sort_values(key).reset_index(drop=True),
+            exp.sort_values(key).reset_index(drop=True))
+
+    def test_after_projection(self, env):
+        got = env["t"].select("k", "s").distinct().to_pandas()
+        exp = env["df"][["k", "s"]].drop_duplicates()
+        assert len(got) == len(exp)
+
+
+class TestUnion:
+    def test_round_trip(self, env):
+        t = env["t"]
+        a = t.filter(col("k") < 6).select("k", "v")
+        b = t.filter(col("k") >= 6).select("k", "v")
+        got = a.union(b).to_pandas()
+        assert len(got) == len(env["df"])
+
+    def test_column_mismatch_is_loud(self, env):
+        t = env["t"]
+        with pytest.raises(HyperspaceException, match="column mismatch"):
+            t.select("k").union(t.select("v"))
+
+    def test_union_then_aggregate(self, env):
+        t = env["t"]
+        u = t.select("k", "v").union(t.select("k", "v"))
+        from hyperspace_tpu.plan.expr import sum_
+        got = u.group_by("k").agg(sum_(col("v")).alias("sv")).to_pandas()
+        exp = env["df"].groupby("k", as_index=False)["v"].sum()
+        exp["v"] *= 2
+        got = got.sort_values("k").reset_index(drop=True)
+        exp = exp.sort_values("k").reset_index(drop=True)
+        np.testing.assert_array_equal(got["sv"], exp["v"])
+
+
+class TestReviewRegressions:
+    def test_distinct_with_hostile_column_name(self, tmp_path):
+        df = pd.DataFrame({"__distinct_cnt": [1, 1, 2],
+                           "v": [5, 5, 6]})
+        d = tmp_path / "h"
+        d.mkdir()
+        pq.write_table(pa.Table.from_pandas(df), d / "p.parquet")
+        session = hst.Session(system_path=str(tmp_path / "idx"))
+        got = session.read.parquet(str(d)).distinct().to_pandas()
+        assert len(got) == 2
+        assert sorted(got["__distinct_cnt"]) == [1, 2]  # real values kept
+
+    def test_union_dtype_mismatch_is_loud(self, tmp_path):
+        a = pd.DataFrame({"k": np.array([1, 2], np.int64)})
+        b = pd.DataFrame({"k": np.array(["1", "2"])})
+        da, db = tmp_path / "a", tmp_path / "b"
+        da.mkdir(), db.mkdir()
+        pq.write_table(pa.Table.from_pandas(a), da / "p.parquet")
+        pq.write_table(pa.Table.from_pandas(b), db / "p.parquet")
+        session = hst.Session(system_path=str(tmp_path / "idx"))
+        with pytest.raises(HyperspaceException, match="dtype mismatch"):
+            session.read.parquet(str(da)).union(
+                session.read.parquet(str(db)))
