@@ -147,7 +147,8 @@ QUERY_NAMES = [
     "tpcds_q7_like", "join_on_aggregate", "in_list_indexed",
     "minmax_aggregates", "multi_dir_sort", "string_range_scan",
     "or_of_ranges", "count_distinct_groups", "join_chain_filters",
-    "not_in_exclusion", "proj_arith_groupby",
+    "not_in_exclusion", "proj_arith_groupby", "distinct_flags",
+    "union_of_ranges", "left_outer_orders",
 ]
 
 
@@ -424,6 +425,28 @@ def queries(dfs):
         .agg(sum_(col("charge")).alias("sum_charge"),
              avg(col("charge")).alias("avg_charge"))
         .sort("l_returnflag"))
+
+    # Distinct rides the grouped-agg machinery (group by every column).
+    q["distinct_flags"] = (
+        li.select("l_returnflag", "l_linestatus").distinct()
+        .sort("l_returnflag", "l_linestatus"))
+
+    # Union of two disjoint filtered ranges, re-aggregated.
+    q["union_of_ranges"] = (
+        li.filter(col("l_shipdate") < d(1994, 1, 1)).select("l_orderkey",
+                                                            "l_quantity")
+        .union(li.filter(col("l_shipdate") >= d(1997, 1, 1))
+               .select("l_orderkey", "l_quantity"))
+        .group_by("l_orderkey").agg(sum_(col("l_quantity")).alias("q"))
+        .sort("l_orderkey").limit(25))
+
+    # Left outer join (engine executes it; the join rule must NOT rewrite).
+    q["left_outer_orders"] = (
+        od.select(col("o_orderkey").alias("ok"), "o_totalprice")
+        .join(li.select("l_orderkey", "l_extendedprice"),
+              on=col("ok") == col("l_orderkey"), how="left")
+        .group_by("ok").agg(count(col("l_extendedprice")).alias("n_items"))
+        .sort("ok").limit(30))
 
     assert sorted(q) == sorted(QUERY_NAMES), \
         f"QUERY_NAMES out of sync: {sorted(set(q) ^ set(QUERY_NAMES))}"

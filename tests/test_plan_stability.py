@@ -131,7 +131,11 @@ class TestExpectedRewrites:
               "minmax_aggregates": False, "multi_dir_sort": False,
               "string_range_scan": False, "count_distinct_groups": False,
               "join_chain_filters": False, "not_in_exclusion": False,
-              "proj_arith_groupby": False}
+              "proj_arith_groupby": False,
+              # New surface: distinct/union/outer shapes (no coverage or
+              # rule deliberately inner-only → no rewrites expected).
+              "distinct_flags": False, "union_of_ranges": False,
+              "left_outer_orders": False}
 
     def test_rewrite_expectations(self, harness):
         session, queries = harness
