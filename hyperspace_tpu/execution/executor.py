@@ -132,7 +132,15 @@ def _execute(plan: LogicalPlan, needed: Optional[Set[str]]) -> Table:
         return table.slice(0, min(plan.n, table.num_rows))
     if isinstance(plan, (Union, BucketUnion)):
         tables = [_execute(c, needed) for c in plan.children]
-        aligned = [t.select(tables[0].names) for t in tables]
+        # Align on the UNION's pruned output schema, not child 0's
+        # materialized columns: a child whose own filter referenced extra
+        # columns materializes a superset, and those extras differ per
+        # child (found by the property oracle's generated union shapes).
+        out_names = [n for n in plan.schema.names
+                     if needed is None or n in needed]
+        if not out_names:
+            out_names = plan.schema.names[:1]
+        aligned = [t.select(out_names) for t in tables]
         return Table.concat(aligned)
     raise HyperspaceException(f"Cannot execute plan node {plan.node_name}")
 

@@ -114,3 +114,19 @@ class TestReviewRegressions:
         with pytest.raises(HyperspaceException, match="dtype mismatch"):
             session.read.parquet(str(da)).union(
                 session.read.parquet(str(db)))
+
+
+class TestUnionPruning:
+    def test_union_children_with_different_filter_refs(self, env):
+        """Each union child materializes its own filter's columns on top of
+        the pruned need-set; the union must align on ITS output schema,
+        not child 0's superset (property-oracle regression)."""
+        from hyperspace_tpu.plan.expr import count
+        t, df = env["t"], env["df"]
+        q = (t.filter(col("s") == "p")
+             .union(t.filter(col("v") > 2))
+             .group_by("k").agg(count(None).alias("n")))
+        got = q.to_pandas().sort_values("k").reset_index(drop=True)
+        part = pd.concat([df[df.s == "p"], df[df.v > 2]])
+        exp = part.groupby("k").size().reset_index(name="n")
+        np.testing.assert_array_equal(got["n"], exp["n"])
