@@ -49,6 +49,10 @@ def bloom_col(column: str) -> str:
     return f"bloom__{column}"
 
 
+def valuelist_col(column: str) -> str:
+    return f"valuelist__{column}"
+
+
 def build_sketch_rows(relation, sketch_list: List[Sketch],
                       files: List[str], tracker: FileIdTracker) -> Dict[str, list]:
     """One sketch row per file; device reductions per (file, sketch)."""
@@ -61,6 +65,8 @@ def build_sketch_rows(relation, sketch_list: List[Sketch],
             rows[hi] = []
         elif s.kind == "BloomFilter":
             rows[bloom_col(s.column)] = []
+        elif s.kind == "ValueList":
+            rows[valuelist_col(s.column)] = []
         else:
             raise HyperspaceException(f"Unknown sketch kind: {s.kind}")
     from ..util.file_utils import file_info_triple
@@ -77,6 +83,9 @@ def build_sketch_rows(relation, sketch_list: List[Sketch],
                 mn, mx = sk.minmax_values(col)
                 rows[lo].append(mn)
                 rows[hi].append(mx)
+            elif s.kind == "ValueList":
+                rows[valuelist_col(s.column)].append(
+                    sk.value_list(col, int(s.properties["maxValues"])))
             else:
                 num_bits = int(s.properties["numBits"])
                 num_hashes = int(s.properties["numHashes"])
@@ -96,6 +105,12 @@ def sketch_arrow_schema(relation_schema: Schema,
             lo, hi = minmax_cols(s.column)
             fields.append(pa.field(lo, arrow_t))
             fields.append(pa.field(hi, arrow_t))
+        elif s.kind == "ValueList":
+            src = relation_schema.field(s.column)
+            arrow_t = Schema([src]).to_arrow().field(0).type
+            # A null list (over-cardinality file) means "no information".
+            fields.append(pa.field(valuelist_col(s.column),
+                                   pa.list_(arrow_t)))
         else:
             fields.append(pa.field(bloom_col(s.column), pa.binary()))
     return pa.schema(fields)

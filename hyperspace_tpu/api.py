@@ -80,6 +80,25 @@ class BloomFilterSketch(SketchSpec):
 
 
 @dataclass(frozen=True)
+class ValueListSketch(SketchSpec):
+    """Exact distinct-values sketch for low-cardinality columns: equality
+    and IN predicates prune a file unless the literal is IN its stored
+    value list (no false positives, unlike Bloom). Files whose cardinality
+    exceeds ``max_values`` store no list and are always kept."""
+
+    kind: str = field(default="ValueList", init=False)
+    max_values: int = 256
+
+    def __init__(self, column: str, max_values: int = 256):
+        object.__setattr__(self, "column", column)
+        object.__setattr__(self, "kind", "ValueList")
+        object.__setattr__(self, "max_values", int(max_values))
+
+    def properties(self) -> dict:
+        return {"maxValues": str(self.max_values)}
+
+
+@dataclass(frozen=True)
 class DataSkippingIndexConfig:
     """Data-skipping index specification: per-source-file sketches."""
 

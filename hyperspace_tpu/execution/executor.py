@@ -131,15 +131,20 @@ def _execute(plan: LogicalPlan, needed: Optional[Set[str]]) -> Table:
         table = _execute(plan.child, needed)
         return table.slice(0, min(plan.n, table.num_rows))
     if isinstance(plan, (Union, BucketUnion)):
-        tables = [_execute(c, needed) for c in plan.children]
         # Align on the UNION's pruned output schema, not child 0's
         # materialized columns: a child whose own filter referenced extra
         # columns materializes a superset, and those extras differ per
         # child (found by the property oracle's generated union shapes).
         out_names = [n for n in plan.schema.names
                      if needed is None or n in needed]
+        child_needed = needed
         if not out_names:
+            # count(*) shape: no column is referenced — pick one and widen
+            # the CHILD need-set so every child materializes it.
             out_names = plan.schema.names[:1]
+            child_needed = None if needed is None else \
+                needed | set(out_names)
+        tables = [_execute(c, child_needed) for c in plan.children]
         aligned = [t.select(out_names) for t in tables]
         return Table.concat(aligned)
     raise HyperspaceException(f"Cannot execute plan node {plan.node_name}")

@@ -81,6 +81,30 @@ def bloom_might_contain(packed: np.ndarray, value, dtype: str,
     return True
 
 
+def value_list(col: Column, max_values: int) -> Optional[list]:
+    """Sorted distinct valid values of the column as host python objects,
+    or None when cardinality exceeds ``max_values`` (the sketch degrades
+    to "no information" for that file — it must never prune wrongly).
+    Exact equality/IN pruning for low-cardinality categorical columns,
+    where MinMax is blunt (scattered values span the whole range)."""
+    import datetime
+
+    data = np.asarray(jax.device_get(col.data))
+    if col.validity is not None:
+        data = data[np.asarray(jax.device_get(col.validity))]
+    if data.size == 0:
+        return []
+    uniq = np.unique(data)
+    if uniq.size > max_values:
+        return None
+    if col.dtype == STRING:
+        return [str(col.dictionary[int(c)]) for c in uniq]
+    if col.dtype == DATE:
+        epoch = datetime.date(1970, 1, 1)
+        return [epoch + datetime.timedelta(days=int(d)) for d in uniq]
+    return [v.item() for v in uniq]
+
+
 def minmax_values(col: Column) -> Tuple[Optional[object], Optional[object]]:
     """(min, max) of the column's valid values as host python objects in the
     column's logical domain (dates as datetime.date, strings as str).

@@ -168,7 +168,8 @@ def _eval_node(e: E.Expr, table, sketch_by_col, relation_schema,
 
 def _eval_compare(column: str, op: str, value, table, sketch_by_col,
                   relation_schema, n: int) -> Optional[np.ndarray]:
-    from ..actions.create_skipping import bloom_col, minmax_cols
+    from ..actions.create_skipping import (bloom_col, minmax_cols,
+                                           valuelist_col)
 
     sketches: List[Sketch] = sketch_by_col.get(column, [])
     if not sketches:
@@ -204,6 +205,14 @@ def _eval_compare(column: str, op: str, value, table, sketch_by_col,
                         m[i] = hi[i] > value
                     elif op == "GreaterThanOrEqual":
                         m[i] = hi[i] >= value
+            apply_mask(m)
+        elif s.kind == "ValueList" and op == "EqualTo":
+            lists = table[valuelist_col(column)]
+            m = np.ones(n, dtype=bool)
+            for i, vals in enumerate(lists):
+                if vals is None:
+                    continue  # over-cardinality file: no information, keep
+                m[i] = value in vals  # exact membership, no false positives
             apply_mask(m)
         elif s.kind == "BloomFilter" and op == "EqualTo":
             dtype = relation_schema.field(column).dtype

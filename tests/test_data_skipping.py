@@ -323,3 +323,104 @@ class TestDataSkippingRefresh:
             "dsList", [MinMaxSketch("k"), BloomFilterSketch("v")]))
         listing = hs.indexes()
         assert "dsList" in list(listing["name"])
+
+
+class TestValueListSketch:
+    """Exact distinct-values sketch: equality/IN pruning with no false
+    positives; over-cardinality files store no list and are always kept."""
+
+    def _build(self, tmp_path, session, regions_per_file):
+        """One file per region set; a 'cat' column holds those regions."""
+        d = tmp_path / "vl"
+        d.mkdir()
+        rng = np.random.default_rng(4)
+        for i, regions in enumerate(regions_per_file):
+            n = 500
+            t = pa.table({
+                "cat": pa.array(rng.choice(regions, n)),
+                "v": pa.array(rng.integers(0, 100, n).astype(np.int64)),
+            })
+            pq.write_table(t, d / f"part{i}.parquet")
+        return str(d)
+
+    def test_equality_prunes_exactly(self, tmp_path):
+        from hyperspace_tpu.api import ValueListSketch
+        session = hst.Session(system_path=str(tmp_path / "idx"))
+        hs = Hyperspace(session)
+        path = self._build(tmp_path, session,
+                           [["ca", "wa"], ["ny", "nj"], ["tx"], ["ca", "tx"]])
+        t = session.read.parquet(path)
+        hs.create_index(t, DataSkippingIndexConfig(
+            "vl_idx", [ValueListSketch("cat")]))
+        session.enable_hyperspace()
+        q = t.filter(col("cat") == "tx")
+        leaves = q.optimized_plan().collect_leaves()
+        kept = leaves[0].relation.all_files()
+        assert len(kept) == 2  # files 2 and 3 only
+        got = q.to_pandas()
+        session.disable_hyperspace()
+        raw = q.to_pandas()
+        assert len(got) == len(raw)
+
+    def test_in_list_unions_memberships(self, tmp_path):
+        from hyperspace_tpu.api import ValueListSketch
+        session = hst.Session(system_path=str(tmp_path / "idx"))
+        hs = Hyperspace(session)
+        path = self._build(tmp_path, session,
+                           [["ca"], ["ny"], ["tx"], ["wa"]])
+        t = session.read.parquet(path)
+        hs.create_index(t, DataSkippingIndexConfig(
+            "vl_in", [ValueListSketch("cat")]))
+        session.enable_hyperspace()
+        q = t.filter(col("cat").isin(["ca", "wa"]))
+        kept = q.optimized_plan().collect_leaves()[0].relation.all_files()
+        assert len(kept) == 2
+        assert len(q.to_pandas()) == 1000  # both files fully match
+
+    def test_over_cardinality_file_never_pruned(self, tmp_path):
+        from hyperspace_tpu.api import ValueListSketch
+        session = hst.Session(system_path=str(tmp_path / "idx"))
+        hs = Hyperspace(session)
+        d = tmp_path / "big"
+        d.mkdir()
+        rng = np.random.default_rng(6)
+        # File 0: 3000 distinct ints (over max_values=64) → no list stored.
+        pq.write_table(pa.table({
+            "k": pa.array(np.arange(3000, dtype=np.int64)),
+        }), d / "wide.parquet")
+        # File 1: only {1, 2}.
+        pq.write_table(pa.table({
+            "k": pa.array(rng.choice([1, 2], 500).astype(np.int64)),
+        }), d / "narrow.parquet")
+        t = session.read.parquet(str(d))
+        hs.create_index(t, DataSkippingIndexConfig(
+            "vl_oc", [ValueListSketch("k", max_values=64)]))
+        session.enable_hyperspace()
+        # 7 is absent from BOTH files, but only narrow can prove it.
+        q = t.filter(col("k") == 7)
+        kept = q.optimized_plan().collect_leaves()[0].relation.all_files()
+        assert len(kept) == 1 and kept[0].endswith("wide.parquet")
+        got = q.to_pandas()
+        session.disable_hyperspace()
+        assert len(got) == len(q.to_pandas()) == 1
+
+    def test_int_and_date_values(self, tmp_path):
+        from hyperspace_tpu.api import ValueListSketch
+        session = hst.Session(system_path=str(tmp_path / "idx"))
+        hs = Hyperspace(session)
+        d = tmp_path / "dates"
+        d.mkdir()
+        day = lambda i: datetime.date(2024, 1, 1) + datetime.timedelta(days=i)
+        for i in range(3):
+            pq.write_table(pa.table({
+                "d": pa.array([day(i)] * 100, pa.date32()),
+                "v": pa.array(np.arange(100, dtype=np.int64)),
+            }), d / f"p{i}.parquet")
+        t = session.read.parquet(str(d))
+        hs.create_index(t, DataSkippingIndexConfig(
+            "vl_d", [ValueListSketch("d")]))
+        session.enable_hyperspace()
+        q = t.filter(col("d") == day(1))
+        kept = q.optimized_plan().collect_leaves()[0].relation.all_files()
+        assert len(kept) == 1
+        assert len(q.to_pandas()) == 100

@@ -130,3 +130,15 @@ class TestUnionPruning:
         part = pd.concat([df[df.s == "p"], df[df.v > 2]])
         exp = part.groupby("k").size().reset_index(name="n")
         np.testing.assert_array_equal(got["n"], exp["n"])
+
+    def test_global_aggregate_over_union(self, env):
+        """count(*) over a union references no columns; the union must
+        widen its children's need-set for the alignment column
+        (review regression — crashed with Unknown column)."""
+        from hyperspace_tpu.plan.expr import count
+        t, df = env["t"], env["df"]
+        q = (t.filter(col("s") == "p")
+             .union(t.filter(col("v") > 2))
+             .agg(count(None).alias("n")))
+        got = int(q.to_pandas()["n"].iloc[0])
+        assert got == int((df.s == "p").sum() + (df.v > 2).sum())
