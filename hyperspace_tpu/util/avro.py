@@ -347,7 +347,7 @@ def _assemble_native(native_chunks: List[Tuple[int, List]], fi: int,
 
 
 # ---------------------------------------------------------------------------
-# Writing (null codec; used by tests and round-trip tooling).
+# Writing (null or deflate codec; blocks of block_rows rows).
 # ---------------------------------------------------------------------------
 
 _WRITE_PLAN = {
@@ -371,8 +371,17 @@ def _write_plan_for(t: pa.DataType):
     raise HyperspaceException(f"avro: cannot write arrow type {t}")
 
 
-def write_avro(table: pa.Table, path: str) -> None:
-    """Write an arrow table as a single-block OCF file (null codec)."""
+def write_avro(table: pa.Table, path: str, codec: str = "null",
+               block_rows: int = 65536) -> None:
+    """Write an arrow table as an OCF file. ``codec``: "null" | "deflate"
+    (raw zlib per block, the spec's deflate). Rows are split into blocks
+    of ``block_rows`` so readers can stream and deflate compresses in
+    bounded windows."""
+    if codec not in ("null", "deflate"):
+        raise HyperspaceException(f"avro: unsupported codec {codec!r}")
+    if block_rows < 1:
+        raise HyperspaceException(
+            f"avro: block_rows must be >= 1, got {block_rows}")
     fields = []
     encoders = []
     for f in table.schema:
@@ -383,32 +392,39 @@ def write_avro(table: pa.Table, path: str) -> None:
         encoders.append((f.name, enc, nullable))
     schema = {"type": "record", "name": "Root", "fields": fields}
     sync = b"hyperspace_sync!"  # fixed 16-byte marker
-    body = io.BytesIO()
     cols = {name: table.column(name).to_pylist() for name, _, _ in encoders}
-    for i in range(table.num_rows):
-        for name, enc, nullable in encoders:
-            v = cols[name][i]
-            if nullable:
-                if v is None:
-                    body.write(_encode_long(0))
-                    continue
-                body.write(_encode_long(1))
-            elif v is None:
-                raise HyperspaceException(
-                    f"avro: null in non-nullable column {name}")
-            body.write(enc(v))
-    payload = body.getvalue()
+
+    def encode_block(start: int, count: int) -> bytes:
+        body = io.BytesIO()
+        for i in range(start, start + count):
+            for name, enc, nullable in encoders:
+                v = cols[name][i]
+                if nullable:
+                    if v is None:
+                        body.write(_encode_long(0))
+                        continue
+                    body.write(_encode_long(1))
+                elif v is None:
+                    raise HyperspaceException(
+                        f"avro: null in non-nullable column {name}")
+                body.write(enc(v))
+        return body.getvalue()
+
     with open(path, "wb") as fh:
         fh.write(_MAGIC)
         fh.write(_encode_long(2))
         fh.write(_encode_bytes(b"avro.schema"))
         fh.write(_encode_bytes(json.dumps(schema).encode("utf-8")))
         fh.write(_encode_bytes(b"avro.codec"))
-        fh.write(_encode_bytes(b"null"))
+        fh.write(_encode_bytes(codec.encode("utf-8")))
         fh.write(_encode_long(0))
         fh.write(sync)
-        if table.num_rows:
-            fh.write(_encode_long(table.num_rows))
-            fh.write(_encode_long(len(payload)))
-            fh.write(payload)
+        for start in range(0, table.num_rows, block_rows):
+            count = min(block_rows, table.num_rows - start)
+            block = encode_block(start, count)
+            if codec == "deflate":
+                block = zlib.compress(block)[2:-4]  # raw deflate
+            fh.write(_encode_long(count))
+            fh.write(_encode_long(len(block)))
+            fh.write(block)
             fh.write(sync)
