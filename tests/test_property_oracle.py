@@ -197,7 +197,7 @@ def _compare(a: pa.Table, b: pa.Table, ordered: bool):
 N_QUERIES = 12
 
 
-@pytest.mark.parametrize("seed", [101, 202, 303, 404, 505, 606])
+@pytest.mark.parametrize("seed", [101, 202, 303, 404, 505, 606, 707, 808])
 def test_random_queries_indexed_equals_raw(seed, tmp_path):
     rng = np.random.default_rng(seed)
     schema = _random_schema(rng)
