@@ -67,8 +67,13 @@ def _tpch_tables(rng) -> Dict[str, pa.Table]:
     }
 
 
+# Dimension cardinalities shared by both TPC-DS fact generators: store_sales
+# foreign keys must stay in range of the dimensions _tpcds_tables builds.
+N_DD, N_CU, N_ST = 60, 30, 6
+
+
 def _tpcds_tables(rng) -> Dict[str, pa.Table]:
-    n_sr, n_dd, n_cu, n_st = 90, 60, 30, 6
+    n_sr, n_dd, n_cu, n_st = 90, N_DD, N_CU, N_ST
     return {
         "store_returns": pa.table({
             "sr_returned_date_sk": pa.array(rng.integers(0, n_dd, n_sr).astype(np.int64)),
@@ -92,11 +97,36 @@ def _tpcds_tables(rng) -> Dict[str, pa.Table]:
     }
 
 
+def _tpcds_sales_tables(rng) -> Dict[str, pa.Table]:
+    """The store_sales/item fact/dim pair backing the q42/q52/q55 family.
+    Separate rng seed so the original tables' draws (and the pre-existing
+    golden files) stay byte-stable."""
+    n_ss, n_it, n_dd, n_cu, n_st = 150, 20, N_DD, N_CU, N_ST
+    return {
+        "store_sales": pa.table({
+            "ss_sold_date_sk": pa.array(rng.integers(0, n_dd, n_ss).astype(np.int64)),
+            "ss_item_sk": pa.array(rng.integers(0, n_it, n_ss).astype(np.int64)),
+            "ss_customer_sk": pa.array(rng.integers(0, n_cu, n_ss).astype(np.int64)),
+            "ss_store_sk": pa.array(rng.integers(0, n_st, n_ss).astype(np.int64)),
+            "ss_quantity": pa.array(rng.integers(1, 100, n_ss).astype(np.int64)),
+            "ss_sales_price": pa.array(np.round(rng.uniform(1, 300, n_ss), 2)),
+        }),
+        "item": pa.table({
+            "i_item_sk": pa.array(np.arange(n_it, dtype=np.int64)),
+            "i_brand": pa.array(rng.choice(
+                ["amalgimporto #1", "edu packscholar #2", "scholarbrand #3"], n_it)),
+            "i_category": pa.array(rng.choice(["Music", "Books", "Sports"], n_it)),
+            "i_current_price": pa.array(np.round(rng.uniform(1, 100, n_it), 2)),
+        }),
+    }
+
+
 def register_tables(session, root: str) -> Dict[str, "object"]:
     """Write the deterministic datasets (once per directory) and return
     name → DataFrame."""
     rng = np.random.default_rng(42)
-    tables = {**_tpch_tables(rng), **_tpcds_tables(rng)}
+    tables = {**_tpch_tables(rng), **_tpcds_tables(rng),
+              **_tpcds_sales_tables(np.random.default_rng(7))}
     dfs = {}
     for name, tbl in tables.items():
         d = os.path.join(root, name)
@@ -123,11 +153,19 @@ def index_configs():
         IndexConfig("sr_cust_idx", ["sr_customer_sk"],
                     ["sr_store_sk", "sr_return_amt", "sr_returned_date_sk"]),
         IndexConfig("li_pk_idx", ["l_partkey"], ["l_quantity"]),
+        # store_sales/item pair: both join sides indexed on the q42/q52/q55
+        # join keys so the JoinIndexRule's compatible-pair search has real
+        # candidates on the new fact table.
+        IndexConfig("ss_item_idx", ["ss_item_sk"],
+                    ["ss_sold_date_sk", "ss_store_sk", "ss_sales_price",
+                     "ss_quantity"]),
+        IndexConfig("it_sk_idx", ["i_item_sk"], ["i_brand", "i_category"]),
     ]
 
 INDEXED_TABLES = {"li_ok_idx": "lineitem", "od_ok_idx": "orders",
                   "li_ship_idx": "lineitem", "sr_cust_idx": "store_returns",
-                  "li_pk_idx": "lineitem"}
+                  "li_pk_idx": "lineitem", "ss_item_idx": "store_sales",
+                  "it_sk_idx": "item"}
 
 
 # ---------------------------------------------------------------------------
@@ -149,6 +187,16 @@ QUERY_NAMES = [
     "or_of_ranges", "count_distinct_groups", "join_chain_filters",
     "not_in_exclusion", "proj_arith_groupby", "distinct_flags",
     "union_of_ranges", "left_outer_orders",
+    # Round-3 growth: the store_sales/item family + TPC-H shapes q2/q4/q11/
+    # q13/q15/q16/q20/q22 + new-surface shapes (with_column/drop/right/full
+    # outer/second-level aggregates/cross-fact m:n join).
+    "tpcds_q42_like", "tpcds_q52_like", "tpcds_q55_like",
+    "store_channel_mix", "returns_vs_sales", "with_column_charge",
+    "drop_columns_scan", "right_outer_items", "full_outer_store_keys",
+    "tpch_q4_like", "tpch_q13_like", "tpch_q15_like", "tpch_q16_like",
+    "tpch_q20_like", "tpch_q22_like", "tpch_q2_like", "tpch_q11_like",
+    "in_list_strings", "float_between_discount", "second_level_agg",
+    "union_sales_returns", "distinct_join", "cross_fact_join",
 ]
 
 
@@ -447,6 +495,225 @@ def queries(dfs):
               on=col("ok") == col("l_orderkey"), how="left")
         .group_by("ok").agg(count(col("l_extendedprice")).alias("n_items"))
         .sort("ok").limit(30))
+
+    ss, it, st = dfs["store_sales"], dfs["item"], dfs["store"]
+
+    # TPC-DS Q42-like: category revenue for a month (both join sides carry
+    # covering indexes on the join keys — the ss⋈item pair is the
+    # JoinIndexRule target).
+    q["tpcds_q42_like"] = (
+        ss.join(dd.filter((col("d_year") == 2000) & (col("d_moy") == 11)),
+                on=col("ss_sold_date_sk") == col("d_date_sk"))
+        .join(it, on=col("ss_item_sk") == col("i_item_sk"))
+        .group_by("i_category")
+        .agg(sum_(col("ss_sales_price")).alias("revenue"))
+        .sort(("revenue", False), "i_category"))
+
+    # TPC-DS Q52-like: brand revenue in December, top sellers first.
+    q["tpcds_q52_like"] = (
+        ss.join(dd.filter(col("d_moy") == 12),
+                on=col("ss_sold_date_sk") == col("d_date_sk"))
+        .join(it, on=col("ss_item_sk") == col("i_item_sk"))
+        .group_by("i_brand")
+        .agg(sum_(col("ss_sales_price")).alias("brand_rev"))
+        .sort(("brand_rev", False), "i_brand").limit(10))
+
+    # TPC-DS Q55-like: same family without the date filter — the pure
+    # indexed ss⋈item join under an aggregate.
+    q["tpcds_q55_like"] = (
+        ss.join(it, on=col("ss_item_sk") == col("i_item_sk"))
+        .group_by("i_brand")
+        .agg(sum_(col("ss_sales_price")).alias("brand_rev"),
+             count(None).alias("n"))
+        .sort("i_brand"))
+
+    # Channel mix: fact ⋈ tiny dimension (store), state rollup.
+    q["store_channel_mix"] = (
+        ss.join(st, on=col("ss_store_sk") == col("s_store_sk"))
+        .group_by("s_state")
+        .agg(sum_(col("ss_sales_price")).alias("sales"),
+             avg(col("ss_quantity")).alias("avg_qty"))
+        .sort("s_state"))
+
+    # Per-customer sales vs returns: two grouped facts joined, derived
+    # ratio via with_column (aggregate-on-aggregate join shape).
+    sales_per_cust = (ss.group_by("ss_customer_sk")
+                      .agg(sum_(col("ss_sales_price")).alias("bought")))
+    rets_per_cust = (sr.group_by("sr_customer_sk")
+                     .agg(sum_(col("sr_return_amt")).alias("returned")))
+    q["returns_vs_sales"] = (
+        sales_per_cust.join(rets_per_cust,
+                            on=col("ss_customer_sk") == col("sr_customer_sk"))
+        .with_column("ratio", col("returned") / col("bought"))
+        .select("ss_customer_sk", "ratio")
+        .sort(("ratio", False)).limit(15))
+
+    # with_column feeding a group-by (same charge expression as
+    # proj_arith_groupby but through the with_column surface).
+    q["with_column_charge"] = (
+        li.with_column("charge",
+                       col("l_extendedprice") * (1 - col("l_discount"))
+                       * (1 + col("l_tax")))
+        .group_by("l_linestatus")
+        .agg(sum_(col("charge")).alias("sum_charge"),
+             max_(col("charge")).alias("max_charge"))
+        .sort("l_linestatus"))
+
+    # drop() then an indexed filter: the scan must shrink to the kept
+    # columns and still hit li_ship_idx (all survivors are covered).
+    q["drop_columns_scan"] = (
+        li.select("l_quantity", "l_extendedprice", "l_discount",
+                  "l_shipdate")
+        .drop("l_discount")
+        .filter(col("l_shipdate") > d(1997, 1, 1)))
+
+    # Right outer: the sales side is filtered to items 0..9, so items 10..19
+    # are null-padded by construction — count(ss_sales_price) must skip the
+    # padded nulls per category.
+    q["right_outer_items"] = (
+        ss.select("ss_item_sk", "ss_sales_price")
+        .filter(col("ss_item_sk") < 10)
+        .join(it.select(col("i_item_sk"), col("i_category")),
+              on=col("ss_item_sk") == col("i_item_sk"), how="right")
+        .group_by("i_category")
+        .agg(count(col("ss_sales_price")).alias("n_sales"))
+        .sort("i_category"))
+
+    # Full outer over two overlapping-but-distinct store-key ranges: stores
+    # 0..3 on the sales side, 2..5 on the returns side, so both sides emit
+    # null-padded rows AND the nullable sort keys see real nulls.
+    q["full_outer_store_keys"] = (
+        ss.filter(col("ss_store_sk") <= 3)
+        .group_by("ss_store_sk").agg(sum_(col("ss_sales_price")).alias("sold"))
+        .join(sr.filter(col("sr_store_sk") >= 2)
+              .group_by("sr_store_sk")
+              .agg(sum_(col("sr_return_amt")).alias("ret")),
+              on=col("ss_store_sk") == col("sr_store_sk"), how="full")
+        .sort("ss_store_sk", "sr_store_sk"))
+
+    # TPC-H Q4-like: order-priority counts for orders having a late
+    # lineitem (EXISTS emulated as distinct-key inner join).
+    late = (li.filter(col("l_shipdate") > d(1997, 1, 1))
+            .select("l_orderkey").distinct())
+    q["tpch_q4_like"] = (
+        od.join(late, on=col("o_orderkey") == col("l_orderkey"))
+        .group_by("o_orderpriority")
+        .agg(count(None).alias("order_count"))
+        .sort("o_orderpriority"))
+
+    # TPC-H Q13-like: distribution of orders per customer (left outer so
+    # zero-order customers keep a row, then a second-level group-by).
+    per_cust = (cu.select(col("c_customer_sk"))
+                .join(od.select("o_custkey", "o_orderkey"),
+                      on=col("c_customer_sk") == col("o_custkey"), how="left")
+                .group_by("c_customer_sk")
+                .agg(count(col("o_orderkey")).alias("c_count")))
+    q["tpch_q13_like"] = (
+        per_cust.group_by("c_count").agg(count(None).alias("custdist"))
+        .sort(("custdist", False), ("c_count", False)))
+
+    # TPC-H Q15-like: top revenue generator (argmax via sort+limit 1).
+    q["tpch_q15_like"] = (
+        li.filter(col("l_shipdate").between(d(1996, 1, 1), d(1996, 3, 31)))
+        .group_by("l_orderkey")
+        .agg(sum_(col("l_extendedprice") * (1 - col("l_discount")))
+             .alias("total_rev"))
+        .sort(("total_rev", False), "l_orderkey").limit(1))
+
+    # TPC-H Q16-like: part counts by brand/container excluding one brand.
+    q["tpch_q16_like"] = (
+        pt.filter(~col("p_brand").isin(["Brand#45"]))
+        .group_by("p_brand", "p_container")
+        .agg(count(col("p_partkey")).alias("part_cnt"))
+        .sort(("part_cnt", False), "p_brand", "p_container"))
+
+    # TPC-H Q20-like: parts whose stocked quantity exceeds a threshold
+    # (grouped fact joined back to the dimension).
+    heavy = (li.group_by("l_partkey")
+             .agg(sum_(col("l_quantity")).alias("qty_sum"))
+             .filter(col("qty_sum") > 120))
+    q["tpch_q20_like"] = (
+        pt.join(heavy, on=col("p_partkey") == col("l_partkey"))
+        .select("p_brand", "p_container", "qty_sum")
+        .sort(("qty_sum", False), "p_brand"))
+
+    # TPC-H Q22-like: customers with no orders (anti-join emulated as left
+    # outer + count == 0).
+    q["tpch_q22_like"] = (
+        cu.select(col("c_customer_sk"), col("c_customer_id"))
+        .join(od.select("o_custkey", "o_orderkey"),
+              on=col("c_customer_sk") == col("o_custkey"), how="left")
+        .group_by("c_customer_sk", "c_customer_id")
+        .agg(count(col("o_orderkey")).alias("n_orders"))
+        .filter(col("n_orders") == 0)
+        .sort("c_customer_sk"))
+
+    # TPC-H Q2-like: cheapest offer per part among small parts.
+    min_price = (li.group_by("l_partkey")
+                 .agg(min_(col("l_extendedprice")).alias("min_price")))
+    q["tpch_q2_like"] = (
+        pt.filter(col("p_size") < 15)
+        .join(min_price, on=col("p_partkey") == col("l_partkey"))
+        .select("p_partkey", "p_brand", "min_price")
+        .sort("min_price", "p_partkey").limit(10))
+
+    # TPC-H Q11-like: high-value part positions (grouped sum over the
+    # indexed l_partkey, thresholded — the group-by index + HAVING shape).
+    q["tpch_q11_like"] = (
+        li.group_by("l_partkey")
+        .agg(sum_(col("l_extendedprice") * col("l_quantity")).alias("value"))
+        .filter(col("value") > 1_000_000)
+        .sort(("value", False)))
+
+    # IN-list over a string column (dictionary-code translation at the
+    # planning boundary, not a range).
+    q["in_list_strings"] = (
+        od.filter(col("o_orderpriority").isin(["1-URGENT", "2-HIGH"]))
+        .group_by("o_orderpriority")
+        .agg(count(None).alias("n"), max_(col("o_totalprice")).alias("top"))
+        .sort("o_orderpriority"))
+
+    # Float between on non-leading index columns: no rewrite, pure engine
+    # range scan over f64.
+    q["float_between_discount"] = (
+        li.filter(col("l_discount").between(0.02, 0.04)
+                  & (col("l_quantity") < 30))
+        .select("l_orderkey", "l_discount", "l_quantity")
+        .sort("l_orderkey", "l_discount").limit(40))
+
+    # Second-level aggregate: avg over per-store revenue (aggregate of an
+    # aggregate, no join).
+    q["second_level_agg"] = (
+        ss.group_by("ss_store_sk")
+        .agg(sum_(col("ss_sales_price")).alias("store_rev"))
+        .agg(avg(col("store_rev")).alias("avg_store_rev"),
+             count(None).alias("n_stores")))
+
+    # Union across two different fact tables with aligned projections.
+    q["union_sales_returns"] = (
+        ss.select(col("ss_customer_sk").alias("cust"),
+                  col("ss_sales_price").alias("amt"))
+        .union(sr.select(col("sr_customer_sk").alias("cust"),
+                         col("sr_return_amt").alias("amt")))
+        .group_by("cust").agg(sum_(col("amt")).alias("volume"))
+        .sort(("volume", False)).limit(20))
+
+    # Distinct keys then dimension join (semi-join-flavoured count).
+    q["distinct_join"] = (
+        ss.select("ss_item_sk").distinct()
+        .join(it, on=col("ss_item_sk") == col("i_item_sk"))
+        .group_by("i_category")
+        .agg(count(None).alias("n_items"))
+        .sort("i_category"))
+
+    # Cross-fact m:n join on the customer key (neither side unique).
+    q["cross_fact_join"] = (
+        sr.select("sr_customer_sk", "sr_return_amt")
+        .join(ss.select("ss_customer_sk", "ss_store_sk"),
+              on=col("sr_customer_sk") == col("ss_customer_sk"))
+        .group_by("ss_store_sk")
+        .agg(count(None).alias("n"), sum_(col("sr_return_amt")).alias("amt"))
+        .sort("ss_store_sk"))
 
     assert sorted(q) == sorted(QUERY_NAMES), \
         f"QUERY_NAMES out of sync: {sorted(set(q) ^ set(QUERY_NAMES))}"

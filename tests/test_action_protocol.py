@@ -1,0 +1,255 @@
+"""Mock-based action protocol tests: the begin/op/end state machine verified
+against stubbed log/data managers, with zero I/O.
+
+Parity: the reference's action suites (actions/CreateActionTest.scala,
+RefreshActionTest.scala, DeleteActionTest.scala, RestoreActionTest.scala,
+VacuumActionTest.scala, CancelActionTest.scala) drive the same protocol with
+Mockito mocks of IndexLogManager/IndexDataManager — validation failures,
+acquire-state conflicts, and the exact order of log writes are asserted
+without touching a filesystem.
+"""
+
+from unittest import mock
+
+import pytest
+
+from hyperspace_tpu.actions.action import Action
+from hyperspace_tpu.actions.lifecycle import (CancelAction, DeleteAction,
+                                              RestoreAction, VacuumAction)
+from hyperspace_tpu.exceptions import HyperspaceException, NoChangesException
+from hyperspace_tpu.index.constants import States
+from hyperspace_tpu.index.log_entry import IndexLogEntry
+from hyperspace_tpu.telemetry.events import CreateActionEvent
+
+from test_log_entry import make_entry
+
+
+def make_session():
+    session = mock.MagicMock(name="session")
+    session.hs_conf.event_logger_class.return_value = None  # no-op logger
+    return session
+
+
+def make_log_manager(latest_id=4, stable=None, latest=None):
+    lm = mock.MagicMock(name="log_manager")
+    lm.get_latest_id.return_value = latest_id
+    lm.get_latest_stable_log.return_value = stable
+    lm.get_latest_log.return_value = latest
+    lm.write_log.return_value = True
+    lm.delete_latest_stable_log.return_value = True
+    lm.create_latest_stable_log.return_value = True
+    return lm
+
+
+class ProbeAction(Action):
+    """Minimal concrete action recording when op() ran."""
+
+    transient_state = States.CREATING
+    final_state = States.ACTIVE
+
+    def __init__(self, session, log_manager, fail_op=None, fail_validate=None):
+        super().__init__(session, log_manager)
+        self.op_calls = 0
+        self.fail_op = fail_op
+        self.fail_validate = fail_validate
+
+    @property
+    def log_entry(self):
+        return make_entry("probe_idx", States.DOESNOTEXIST)
+
+    def validate(self):
+        if self.fail_validate is not None:
+            raise self.fail_validate
+
+    def op(self):
+        self.op_calls += 1
+        if self.fail_op is not None:
+            raise self.fail_op
+
+    def event(self, message):
+        return CreateActionEvent(message=message, index_name="probe_idx")
+
+
+class TestProtocolOrder:
+    def test_happy_path_writes_in_order(self):
+        lm = make_log_manager(latest_id=4)
+        action = ProbeAction(make_session(), lm)
+        action.run()
+
+        assert action.op_calls == 1
+        # Exact call order on the log manager: transient write, stable-tag
+        # delete, final write, stable-tag create (Action.scala:34-108).
+        calls = [c for c in lm.method_calls
+                 if c[0] in ("write_log", "delete_latest_stable_log",
+                             "create_latest_stable_log")]
+        assert [c[0] for c in calls] == [
+            "write_log", "delete_latest_stable_log", "write_log",
+            "create_latest_stable_log"]
+        first_write, _, final_write, stable = calls
+        assert first_write.args[0] == 5 and final_write.args[0] == 6
+        assert first_write.args[1].state == States.CREATING
+        assert final_write.args[1].state == States.ACTIVE
+        assert stable.args == (6,)
+
+    def test_entry_reevaluated_between_begin_and_end(self):
+        # log_entry is a property read twice so op() results can land in the
+        # final entry; the two written entries must be distinct objects.
+        lm = make_log_manager()
+        action = ProbeAction(make_session(), lm)
+        action.run()
+        entries = [c.args[1] for c in lm.method_calls if c[0] == "write_log"]
+        assert entries[0] is not entries[1]
+
+    def test_base_id_with_empty_log(self):
+        lm = make_log_manager(latest_id=None)
+        action = ProbeAction(make_session(), lm)
+        assert action.base_id == -1
+        action.run()
+        ids = [c.args[0] for c in lm.method_calls if c[0] == "write_log"]
+        assert ids == [0, 1]
+
+    def test_base_id_cached_across_reads(self):
+        lm = make_log_manager(latest_id=7)
+        action = ProbeAction(make_session(), lm)
+        assert action.base_id == 7 and action.end_id == 9
+        assert action.base_id == 7
+        lm.get_latest_id.assert_called_once()
+
+
+class TestProtocolFailures:
+    def test_acquire_conflict_skips_op(self):
+        # Another writer claimed baseId+1: no op(), no final write.
+        lm = make_log_manager()
+        lm.write_log.return_value = False
+        action = ProbeAction(make_session(), lm)
+        with pytest.raises(HyperspaceException, match="acquire proper state"):
+            action.run()
+        assert action.op_calls == 0
+        lm.delete_latest_stable_log.assert_not_called()
+        lm.create_latest_stable_log.assert_not_called()
+
+    def test_op_failure_leaves_transient_state(self):
+        lm = make_log_manager()
+        action = ProbeAction(make_session(), lm, fail_op=RuntimeError("boom"))
+        with pytest.raises(RuntimeError, match="boom"):
+            action.run()
+        # Only the transient write happened; latestStable untouched — the
+        # wreck is visible for CancelAction (crash recovery).
+        writes = [c for c in lm.method_calls if c[0] == "write_log"]
+        assert len(writes) == 1 and writes[0].args[1].state == States.CREATING
+        lm.create_latest_stable_log.assert_not_called()
+
+    def test_validate_failure_writes_nothing(self):
+        lm = make_log_manager()
+        action = ProbeAction(make_session(), lm,
+                             fail_validate=HyperspaceException("invalid"))
+        with pytest.raises(HyperspaceException, match="invalid"):
+            action.run()
+        assert action.op_calls == 0
+        lm.write_log.assert_not_called()
+
+    def test_no_changes_is_quiet_noop(self):
+        lm = make_log_manager()
+        action = ProbeAction(make_session(), lm,
+                             fail_validate=NoChangesException("nothing to do"))
+        action.run()  # swallowed, not raised
+        assert action.op_calls == 0
+        lm.write_log.assert_not_called()
+
+    def test_stable_tag_delete_failure_aborts_end(self):
+        lm = make_log_manager()
+        lm.delete_latest_stable_log.return_value = False
+        action = ProbeAction(make_session(), lm)
+        with pytest.raises(HyperspaceException, match="latest stable log"):
+            action.run()
+        # op ran, transient written, but no final write / stable re-tag.
+        assert action.op_calls == 1
+        writes = [c for c in lm.method_calls if c[0] == "write_log"]
+        assert len(writes) == 1
+        lm.create_latest_stable_log.assert_not_called()
+
+
+class TestTransitionActions:
+    def test_delete_requires_active(self):
+        lm = make_log_manager(stable=make_entry("i", States.DELETED))
+        with pytest.raises(HyperspaceException, match="only supported"):
+            DeleteAction(make_session(), lm).run()
+        lm.write_log.assert_not_called()
+
+    def test_delete_writes_deleting_then_deleted(self):
+        lm = make_log_manager(stable=make_entry("i", States.ACTIVE))
+        DeleteAction(make_session(), lm).run()
+        states = [c.args[1].state for c in lm.method_calls
+                  if c[0] == "write_log"]
+        assert states == [States.DELETING, States.DELETED]
+
+    def test_restore_requires_deleted(self):
+        lm = make_log_manager(stable=make_entry("i", States.ACTIVE))
+        with pytest.raises(HyperspaceException, match="only supported"):
+            RestoreAction(make_session(), lm).run()
+
+    def test_restore_reactivates(self):
+        lm = make_log_manager(stable=make_entry("i", States.DELETED))
+        RestoreAction(make_session(), lm).run()
+        states = [c.args[1].state for c in lm.method_calls
+                  if c[0] == "write_log"]
+        assert states == [States.RESTORING, States.ACTIVE]
+
+    def test_transition_preserves_entry_content(self):
+        stable = make_entry("keepme", States.ACTIVE)
+        lm = make_log_manager(stable=stable)
+        DeleteAction(make_session(), lm).run()
+        final = [c.args[1] for c in lm.method_calls
+                 if c[0] == "write_log"][-1]
+        assert final.name == "keepme"
+        assert final.derivedDataset.indexed_columns == \
+            stable.derivedDataset.indexed_columns
+        # A fresh copy, not mutation of the stable entry in place.
+        assert stable.state == States.ACTIVE
+
+    def test_vacuum_deletes_every_version(self):
+        lm = make_log_manager(stable=make_entry("i", States.DELETED))
+        dm = mock.MagicMock(name="data_manager")
+        dm.get_all_version_ids.return_value = [0, 1, 2]
+        VacuumAction(make_session(), lm, data_manager=dm).run()
+        assert [c.args for c in dm.delete.call_args_list] == [(0,), (1,), (2,)]
+        states = [c.args[1].state for c in lm.method_calls
+                  if c[0] == "write_log"]
+        assert states == [States.VACUUMING, States.DOESNOTEXIST]
+
+    def test_vacuum_requires_deleted(self):
+        lm = make_log_manager(stable=make_entry("i", States.ACTIVE))
+        dm = mock.MagicMock(name="data_manager")
+        with pytest.raises(HyperspaceException, match="only supported"):
+            VacuumAction(make_session(), lm, data_manager=dm).run()
+        dm.delete.assert_not_called()
+
+
+class TestCancelAction:
+    def test_cancel_on_stable_latest_raises(self):
+        stable = make_entry("i", States.ACTIVE)
+        lm = make_log_manager(stable=stable, latest=stable)
+        with pytest.raises(HyperspaceException, match="not needed"):
+            CancelAction(make_session(), lm).run()
+
+    def test_cancel_rolls_back_to_stable_state(self):
+        stable = make_entry("i", States.ACTIVE)
+        wreck = make_entry("i", States.REFRESHING)
+        lm = make_log_manager(stable=stable, latest=wreck)
+        CancelAction(make_session(), lm).run()
+        states = [c.args[1].state for c in lm.method_calls
+                  if c[0] == "write_log"]
+        assert states == [States.CANCELLING, States.ACTIVE]
+
+    def test_cancel_first_create_rolls_to_doesnotexist(self):
+        wreck = make_entry("i", States.CREATING)
+        lm = make_log_manager(stable=None, latest=wreck)
+        CancelAction(make_session(), lm).run()
+        states = [c.args[1].state for c in lm.method_calls
+                  if c[0] == "write_log"]
+        assert states == [States.CANCELLING, States.DOESNOTEXIST]
+
+    def test_cancel_without_any_log_raises(self):
+        lm = make_log_manager(stable=None, latest=None)
+        with pytest.raises(HyperspaceException, match="No log entry"):
+            CancelAction(make_session(), lm).run()
