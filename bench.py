@@ -275,6 +275,27 @@ def _spill_partial() -> None:
             pass
 
 
+# Signatures of a dead device/compile service (observed on the real-TPU
+# runs: the tunnel's remote-compile endpoint dies mid-run with Connection
+# refused, after which ANY device op blocks forever in a C-level recv that
+# no Python signal can interrupt). Once seen, every later device phase must
+# be skipped outright — "try the next query anyway" converts a clean partial
+# result into a 55-minute watchdog wedge.
+_DEAD_BACKEND_MARKERS = ("UNAVAILABLE", "Connection refused",
+                         "Connection Failed", "remote_compile",
+                         "DEADLINE_EXCEEDED", "failed to connect")
+_BACKEND_DEAD = False
+
+
+class _SkipToMesh(Exception):
+    """Control flow: abandon the single-device phases (dead backend /
+    failed build) but still run the CPU-subprocess mesh phase."""
+
+
+def _backend_dead() -> bool:
+    return _BACKEND_DEAD
+
+
 def _phase(name: str):
     """Decorator-less phase guard: returns True if fn ran clean. Failures
     are recorded in RESULT["errors"] and the bench continues."""
@@ -292,8 +313,12 @@ def _phase(name: str):
                 # line says nothing (observed on the first real-TPU run).
                 lines = [l.rstrip() for l in
                          traceback.format_exception(et, ev, tb)]
-                RESULT["errors"].append(
-                    f"phase {name}: " + " | ".join(lines[-8:])[-2000:])
+                text = " | ".join(lines[-8:])[-2000:]
+                RESULT["errors"].append(f"phase {name}: " + text)
+                if any(m in text for m in _DEAD_BACKEND_MARKERS):
+                    global _BACKEND_DEAD
+                    _BACKEND_DEAD = True
+                    RESULT["backend_dead_after_phase"] = name
                 _spill_partial()
                 return True  # swallow; later phases still run
             RESULT.pop("phase_current", None)
@@ -439,6 +464,165 @@ def _run_mesh_phase(scale: float, timeout_s: float) -> None:
             f"mesh phase rc={out.returncode}; stderr tail={_tail(out.stderr)}")
 
 
+def _single_device_phases(args, root):
+    """Datagen + index build + the four timed query pairs on the
+    ambient (single-device) backend. Raises _SkipToMesh when the
+    backend dies or the build fails — the caller still runs the
+    CPU-subprocess mesh phase either way (it spawns its own CPU
+    subprocess and needs no device)."""
+    import hyperspace_tpu as hst
+    from hyperspace_tpu.api import Hyperspace, IndexConfig
+    from hyperspace_tpu.index.constants import IndexConstants
+
+    if _backend_dead():
+        # pallas_self_check (the only device phase so far) killed the
+        # backend: skip every single-device phase outright.
+        RESULT["errors"].append(
+            "index_build and query phases skipped: backend dead")
+        raise _SkipToMesh()
+
+    session = None
+    with _phase("datagen"):
+        li_dir, od_dir, pt_dir, n_li, n_od = make_tpch_like(
+            root, args.scale)
+        RESULT["lineitem_rows"] = n_li
+        session = hst.Session(system_path=os.path.join(root, "indexes"))
+        session.conf.set(IndexConstants.INDEX_NUM_BUCKETS, 32)
+        hs = Hyperspace(session)
+        li = session.read.parquet(li_dir)
+        od = session.read.parquet(od_dir)
+    if session is None:
+        RESULT["errors"].append("query phases skipped: datagen failed")
+        raise _SkipToMesh()
+
+    # ---- index build (the BASELINE "index build time" metric) ----
+    with _phase("index_build"):
+        row_group = max(4096, int(n_li / 32 / 8))
+        session.conf.set(IndexConstants.INDEX_ROW_GROUP_SIZE, row_group)
+
+        def build_all():
+            hs.create_index(li, IndexConfig(
+                "li_idx", ["l_orderkey"],
+                ["l_extendedprice", "l_discount", "l_shipdate"]))
+            hs.create_index(od, IndexConfig(
+                "od_idx", ["o_orderkey"],
+                ["o_custkey", "o_orderdate", "o_shippriority"]))
+            # Filter index: fewer, larger buckets → more prunable groups.
+            session.conf.set(IndexConstants.INDEX_NUM_BUCKETS, 8)
+            hs.create_index(li, IndexConfig(
+                "li_ship_idx", ["l_shipdate"],
+                ["l_orderkey", "l_extendedprice"]))
+            session.conf.set(IndexConstants.INDEX_NUM_BUCKETS, 32)
+
+        # Cold pass compiles the build programs; timed pass measures
+        # steady-state build throughput (comparable to the JVM
+        # baseline's warmed executors).
+        t0 = time.perf_counter()
+        build_all()
+        cold_build_s = time.perf_counter() - t0
+        RESULT["index_build_cold_s"] = round(cold_build_s, 3)
+        for name in ("li_idx", "od_idx", "li_ship_idx"):
+            hs.delete_index(name)
+            hs.vacuum_index(name)
+        t0 = time.perf_counter()
+        build_all()
+        build_s = time.perf_counter() - t0
+        RESULT["index_build_s"] = round(build_s, 3)
+        RESULT["index_build_scope"] = (
+            "warm rebuild of all 3 indexes (cold pass incl. compiles "
+            "reported separately)")
+        RESULT["build_rows_per_s"] = round(n_li / build_s, 1)
+
+    if "index_build_s" not in RESULT or _backend_dead():
+        # Build failed or killed the backend: no query numbers are
+        # possible, but the CPU-mesh phase still is.
+        RESULT["errors"].append("query phases skipped: " + (
+            "backend dead" if _backend_dead() else "index build failed"))
+        raise _SkipToMesh()
+
+    with _phase("aux_indexes"):
+        # Q17 covering indexes + the data-skipping index on the
+        # time-ordered orders (BASELINE configs #3-#4).
+        from hyperspace_tpu.api import (DataSkippingIndexConfig,
+                                        MinMaxSketch)
+        pt = session.read.parquet(pt_dir)
+        hs.create_index(pt, IndexConfig(
+            "pt_idx", ["p_partkey"], ["p_brand", "p_container"]))
+        hs.create_index(li, IndexConfig(
+            "li_pk_idx", ["l_partkey"], ["l_quantity", "l_extendedprice"]))
+        hs.create_index(od, DataSkippingIndexConfig(
+            "od_skip", [MinMaxSketch("o_orderdate")]))
+
+    queries = {}
+    with _phase("plan_queries"):
+        queries["filter"] = build_filter_query(session, li_dir)
+        queries["q3"] = build_q3(session, li_dir, od_dir)
+        queries["q17"] = build_q17(session, li_dir, pt_dir)
+        queries["skipping"] = build_skipping_query(session, od_dir)
+
+    rewrite_ok = {}
+    with _phase("rewrite_checks"):
+        session.enable_hyperspace()
+        for name in ("filter", "q3", "q17"):
+            q = queries.get(name)
+            if q is None:
+                continue
+            rewrite_ok[name] = any(
+                "IndexScan" in l.simple_string()
+                for l in q.optimized_plan().collect_leaves())
+            if not rewrite_ok[name]:
+                RESULT["errors"].append(
+                    f"{name} was not rewritten to use an index")
+        sq = queries.get("skipping")
+        if sq is not None:
+            skip_leaves = sq.optimized_plan().collect_leaves()
+            skip_kept = min(
+                len(l.relation.all_files()) for l in skip_leaves)
+            RESULT["skipping_files_kept"] = skip_kept
+            RESULT["skipping_files_total"] = OD_PARTS
+            rewrite_ok["skipping"] = skip_kept < OD_PARTS
+            if not rewrite_ok["skipping"]:
+                RESULT["errors"].append("data-skipping pruned nothing")
+        session.disable_hyperspace()
+
+    # ---- timed runs (per query: warm both paths, then time both) ----
+    # Safest first: q3/q17 compile join programs (searchsorted /
+    # match-expansion / multi-operand sorts) that have twice crashed the
+    # tunnel's remote-compile service; running filter+skipping first
+    # banks those numbers before the risky compiles start.
+    timing_order = ["filter", "skipping", "q17", "q3"]
+    for name in timing_order + [n for n in queries if n not in timing_order]:
+        q = queries.get(name)
+        if q is None or not rewrite_ok.get(name, False):
+            continue  # no rewrite → enabled/disabled runs are the same
+            # plan; timing them would report a fake ~1.0x with rc=0.
+        if _backend_dead():
+            RESULT["errors"].append(
+                f"time_{name} skipped: backend dead")
+            continue
+        with _phase(f"time_{name}"):
+            session.enable_hyperspace()
+            q.to_arrow()  # warm indexed path
+            session.disable_hyperspace()
+            q.to_arrow()  # warm scan path
+            scan_s = timed_best(lambda: q.to_arrow(), args.repeats)
+            session.enable_hyperspace()
+            idx_s = timed_best(lambda: q.to_arrow(), args.repeats)
+            session.disable_hyperspace()
+            sp = scan_s / idx_s if idx_s > 0 else float("inf")
+            RESULT[f"{name}_scan_s"] = round(scan_s, 4)
+            RESULT[f"{name}_indexed_s"] = round(idx_s, 4)
+            if name == "filter":
+                # Headline metric lands the moment it's measured — a
+                # later phase hanging (observed: tunnel compile service
+                # dying mid-q3) must not zero the whole run.
+                RESULT["value"] = round(sp, 3)
+                RESULT["vs_baseline"] = round(sp, 3)
+            else:
+                RESULT[f"{name}_speedup"] = round(sp, 3)
+
+
+
 def main():
     parser = argparse.ArgumentParser()
     # Default 0.2 (1.2M lineitem rows): at 0.05 the on-chip runs are
@@ -479,9 +663,7 @@ def main():
             # on the resolved backend at Session creation, so this switch
             # also turns the crash-prone CPU cache off (execution/__init__).
             jax.config.update("jax_platforms", "cpu")
-        import hyperspace_tpu as hst
-        from hyperspace_tpu.api import Hyperspace, IndexConfig
-        from hyperspace_tpu.index.constants import IndexConstants
+        import hyperspace_tpu  # noqa: F401 — import smoke-test
         RESULT["device"] = str(jax.devices()[0])
         RESULT["backend"] = jax.default_backend()
         RESULT["jax_version"] = jax.__version__
@@ -501,134 +683,11 @@ def main():
         RESULT["pallas"] = pallas_kernels.self_check(auto_disable=True)
 
     root = tempfile.mkdtemp(prefix="hs_bench_")
-    session = None
     try:
-        with _phase("datagen"):
-            li_dir, od_dir, pt_dir, n_li, n_od = make_tpch_like(
-                root, args.scale)
-            RESULT["lineitem_rows"] = n_li
-            session = hst.Session(system_path=os.path.join(root, "indexes"))
-            session.conf.set(IndexConstants.INDEX_NUM_BUCKETS, 32)
-            hs = Hyperspace(session)
-            li = session.read.parquet(li_dir)
-            od = session.read.parquet(od_dir)
-        if session is None:
-            _emit_and_exit(0)
-
-        # ---- index build (the BASELINE "index build time" metric) ----
-        with _phase("index_build"):
-            row_group = max(4096, int(n_li / 32 / 8))
-            session.conf.set(IndexConstants.INDEX_ROW_GROUP_SIZE, row_group)
-
-            def build_all():
-                hs.create_index(li, IndexConfig(
-                    "li_idx", ["l_orderkey"],
-                    ["l_extendedprice", "l_discount", "l_shipdate"]))
-                hs.create_index(od, IndexConfig(
-                    "od_idx", ["o_orderkey"],
-                    ["o_custkey", "o_orderdate", "o_shippriority"]))
-                # Filter index: fewer, larger buckets → more prunable groups.
-                session.conf.set(IndexConstants.INDEX_NUM_BUCKETS, 8)
-                hs.create_index(li, IndexConfig(
-                    "li_ship_idx", ["l_shipdate"],
-                    ["l_orderkey", "l_extendedprice"]))
-                session.conf.set(IndexConstants.INDEX_NUM_BUCKETS, 32)
-
-            # Cold pass compiles the build programs; timed pass measures
-            # steady-state build throughput (comparable to the JVM
-            # baseline's warmed executors).
-            t0 = time.perf_counter()
-            build_all()
-            cold_build_s = time.perf_counter() - t0
-            RESULT["index_build_cold_s"] = round(cold_build_s, 3)
-            for name in ("li_idx", "od_idx", "li_ship_idx"):
-                hs.delete_index(name)
-                hs.vacuum_index(name)
-            t0 = time.perf_counter()
-            build_all()
-            build_s = time.perf_counter() - t0
-            RESULT["index_build_s"] = round(build_s, 3)
-            RESULT["index_build_scope"] = (
-                "warm rebuild of all 3 indexes (cold pass incl. compiles "
-                "reported separately)")
-            RESULT["build_rows_per_s"] = round(n_li / build_s, 1)
-
-        if "index_build_s" not in RESULT:
-            _emit_and_exit(0)
-
-        with _phase("aux_indexes"):
-            # Q17 covering indexes + the data-skipping index on the
-            # time-ordered orders (BASELINE configs #3-#4).
-            from hyperspace_tpu.api import (DataSkippingIndexConfig,
-                                            MinMaxSketch)
-            pt = session.read.parquet(pt_dir)
-            hs.create_index(pt, IndexConfig(
-                "pt_idx", ["p_partkey"], ["p_brand", "p_container"]))
-            hs.create_index(li, IndexConfig(
-                "li_pk_idx", ["l_partkey"], ["l_quantity", "l_extendedprice"]))
-            hs.create_index(od, DataSkippingIndexConfig(
-                "od_skip", [MinMaxSketch("o_orderdate")]))
-
-        queries = {}
-        with _phase("plan_queries"):
-            queries["filter"] = build_filter_query(session, li_dir)
-            queries["q3"] = build_q3(session, li_dir, od_dir)
-            queries["q17"] = build_q17(session, li_dir, pt_dir)
-            queries["skipping"] = build_skipping_query(session, od_dir)
-
-        rewrite_ok = {}
-        with _phase("rewrite_checks"):
-            session.enable_hyperspace()
-            for name in ("filter", "q3", "q17"):
-                q = queries.get(name)
-                if q is None:
-                    continue
-                rewrite_ok[name] = any(
-                    "IndexScan" in l.simple_string()
-                    for l in q.optimized_plan().collect_leaves())
-                if not rewrite_ok[name]:
-                    RESULT["errors"].append(
-                        f"{name} was not rewritten to use an index")
-            sq = queries.get("skipping")
-            if sq is not None:
-                skip_leaves = sq.optimized_plan().collect_leaves()
-                skip_kept = min(
-                    len(l.relation.all_files()) for l in skip_leaves)
-                RESULT["skipping_files_kept"] = skip_kept
-                RESULT["skipping_files_total"] = OD_PARTS
-                rewrite_ok["skipping"] = skip_kept < OD_PARTS
-                if not rewrite_ok["skipping"]:
-                    RESULT["errors"].append("data-skipping pruned nothing")
-            session.disable_hyperspace()
-
-        # ---- timed runs (per query: warm both paths, then time both) ----
-        speedups = {}
-        for name, q in queries.items():
-            if q is None or not rewrite_ok.get(name, False):
-                continue  # no rewrite → enabled/disabled runs are the same
-                # plan; timing them would report a fake ~1.0x with rc=0.
-            with _phase(f"time_{name}"):
-                session.enable_hyperspace()
-                q.to_arrow()  # warm indexed path
-                session.disable_hyperspace()
-                q.to_arrow()  # warm scan path
-                scan_s = timed_best(lambda: q.to_arrow(), args.repeats)
-                session.enable_hyperspace()
-                idx_s = timed_best(lambda: q.to_arrow(), args.repeats)
-                session.disable_hyperspace()
-                sp = scan_s / idx_s if idx_s > 0 else float("inf")
-                speedups[name] = sp
-                RESULT[f"{name}_scan_s"] = round(scan_s, 4)
-                RESULT[f"{name}_indexed_s"] = round(idx_s, 4)
-                if name == "filter":
-                    # Headline metric lands the moment it's measured — a
-                    # later phase hanging (observed: tunnel compile service
-                    # dying mid-q3) must not zero the whole run.
-                    RESULT["value"] = round(sp, 3)
-                    RESULT["vs_baseline"] = round(sp, 3)
-                else:
-                    RESULT[f"{name}_speedup"] = round(sp, 3)
-
+        try:
+            _single_device_phases(args, root)
+        except _SkipToMesh:
+            pass
         with _phase("mesh"):
             # Multi-device numbers ride along at a bounded scale (the
             # virtual CPU mesh measures path health + collective overhead,

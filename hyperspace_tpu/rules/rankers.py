@@ -20,17 +20,15 @@ class FilterIndexRanker:
              ) -> Optional[IndexLogEntry]:
         if not candidates:
             return None
+        # min() with negated numeric components so the name tiebreak is a
+        # plain lexicographic ascending compare (a -ord() tuple under max()
+        # mis-orders names of different lengths that share a prefix).
         if session.hs_conf.hybrid_scan_enabled():
-            return max(candidates,
-                       key=lambda e: (common_source_bytes(e, relation),
-                                      _neg_name(e.name)))
+            return min(candidates,
+                       key=lambda e: (-common_source_bytes(e, relation),
+                                      e.name))
         return min(candidates,
                    key=lambda e: (e.index_files_size_in_bytes, e.name))
-
-
-def _neg_name(name: str):
-    # max() with lexicographically-smallest-name tiebreak.
-    return tuple(-ord(c) for c in name)
 
 
 class JoinIndexRanker:
@@ -44,17 +42,12 @@ class JoinIndexRanker:
 
         def score(pair):
             l, r = pair
-            equal_buckets = 1 if l.num_buckets == r.num_buckets else 0
-            more_buckets = l.num_buckets + r.num_buckets
+            equal_buckets = 0 if l.num_buckets == r.num_buckets else 1
+            fewer_buckets = -(l.num_buckets + r.num_buckets)
             common = 0
             if hybrid:
                 common = (common_source_bytes(l, left_relation)
                           + common_source_bytes(r, right_relation))
-            return (equal_buckets, more_buckets, common,
-                    _neg_names(l.name, r.name))
+            return (equal_buckets, fewer_buckets, -common, l.name, r.name)
 
-        return max(pairs, key=score)
-
-
-def _neg_names(a: str, b: str):
-    return tuple(-ord(c) for c in a + "\x00" + b)
+        return min(pairs, key=score)

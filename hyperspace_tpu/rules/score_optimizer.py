@@ -20,7 +20,7 @@ from __future__ import annotations
 from typing import Dict, List, Optional, Tuple
 
 from ..index.log_entry import IndexLogEntry
-from ..plan.nodes import Join, LogicalPlan, Scan
+from ..plan.nodes import IndexScan, Join, LogicalPlan, Scan
 from .index_filters import ReasonCollector
 from .rule_utils import common_source_bytes, get_relation
 
@@ -42,6 +42,17 @@ def _coverage_ratio(session, entry: IndexLogEntry, relation,
     if cache is not None:
         cache[key] = ratio
     return ratio
+
+
+def _plan_index_bytes(plan: LogicalPlan) -> int:
+    """Total index bytes a plan reads — the tie-break between alternatives
+    with EQUAL scores: a wide and a slim covering index that both fully
+    satisfy the query score identically (50 x 1.0), and the optimizer should
+    pick the plan scanning fewer bytes. Kept out of the score itself so the
+    reference's 50/70 scale is never perturbed in non-tie cases."""
+    return sum(leaf.index_entry.index_files_size_in_bytes
+               for leaf in plan.collect_leaves()
+               if isinstance(leaf, IndexScan))
 
 
 class HyperspaceRule:
@@ -152,14 +163,19 @@ class ScoreBasedIndexPlanOptimizer:
             # higher-scoring rewrite further up the tree.
             alternatives = [(base_plan, base_score)]
             best_plan, best_score = base_plan, base_score
+            best_bytes = _plan_index_bytes(base_plan)
             for rule in self.rules:
                 rewritten, score = rule.apply(session, node, candidates, ctx,
                                               file_stats_cache)
                 if rewritten is None:
                     continue
                 alternatives.append((rewritten, score))
-                if score > best_score:
+                if score < best_score:
+                    continue
+                rw_bytes = _plan_index_bytes(rewritten)
+                if score > best_score or rw_bytes < best_bytes:
                     best_plan, best_score = rewritten, score
+                    best_bytes = rw_bytes
 
             # Indexes used only in out-scored alternatives get a whyNot
             # reason — otherwise "why wasn't my filter index used" has no
