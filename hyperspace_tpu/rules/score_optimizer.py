@@ -163,19 +163,22 @@ class ScoreBasedIndexPlanOptimizer:
             # higher-scoring rewrite further up the tree.
             alternatives = [(base_plan, base_score)]
             best_plan, best_score = base_plan, base_score
-            best_bytes = _plan_index_bytes(base_plan)
+            best_bytes = None  # lazy: only ties need the leaf walk
             for rule in self.rules:
                 rewritten, score = rule.apply(session, node, candidates, ctx,
                                               file_stats_cache)
                 if rewritten is None:
                     continue
                 alternatives.append((rewritten, score))
-                if score < best_score:
-                    continue
-                rw_bytes = _plan_index_bytes(rewritten)
-                if score > best_score or rw_bytes < best_bytes:
+                if score > best_score:
                     best_plan, best_score = rewritten, score
-                    best_bytes = rw_bytes
+                    best_bytes = None
+                elif score == best_score:
+                    if best_bytes is None:
+                        best_bytes = _plan_index_bytes(best_plan)
+                    rw_bytes = _plan_index_bytes(rewritten)
+                    if rw_bytes < best_bytes:
+                        best_plan, best_bytes = rewritten, rw_bytes
 
             # Indexes used only in out-scored alternatives get a whyNot
             # reason — otherwise "why wasn't my filter index used" has no
@@ -186,11 +189,17 @@ class ScoreBasedIndexPlanOptimizer:
                     if alt_plan is best_plan:
                         continue
                     for name in set(_applied_index_names(alt_plan)) - winner_names:
-                        ctx.add_name(
-                            "OUTSCORED", name,
-                            f"A rewrite using this index scored "
-                            f"{alt_score:.0f}, below the chosen plan's "
-                            f"{best_score:.0f}.")
+                        if alt_score == best_score:
+                            reason = (
+                                f"A rewrite using this index tied the "
+                                f"chosen plan's score ({best_score:.0f}) "
+                                f"but reads more index bytes.")
+                        else:
+                            reason = (
+                                f"A rewrite using this index scored "
+                                f"{alt_score:.0f}, below the chosen "
+                                f"plan's {best_score:.0f}.")
+                        ctx.add_name("OUTSCORED", name, reason)
 
             memo[id(node)] = (best_plan, best_score)
             return best_plan, best_score

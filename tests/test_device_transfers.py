@@ -88,6 +88,32 @@ class TestToHost:
         t.to_host()
         assert len(calls) == 1  # one pytree fetch, not one per column
 
+    def test_single_device_get_for_to_arrow(self, monkeypatch):
+        """Query results cross the host boundary in ONE batched fetch: on
+        the TPU tunnel each device_get is a full round trip, so per-column
+        fetches made a 4-column result cost 8."""
+        calls = []
+        orig = jax.device_get
+
+        def counting(x):
+            calls.append(x)
+            return orig(x)
+
+        monkeypatch.setattr(jax, "device_get", counting)
+        t = Table({
+            "a": Column(INT64, jnp.arange(6), jnp.ones(6, jnp.bool_)),
+            "b": Column(INT64, jnp.arange(6)),
+            "s": Column(STRING, jnp.asarray([0, 1, 0, 1, 0, 1]), None,
+                        np.asarray(["x", "y"], object)),
+        })
+        out = t.to_arrow()
+        assert len(calls) == 1
+        assert out.num_rows == 6 and out.column_names == ["a", "b", "s"]
+        # Host-resident tables skip the fetch entirely.
+        calls.clear()
+        t.to_host().to_arrow()
+        assert len(calls) == 1  # the to_host fetch; to_arrow adds none
+
 
 class TestBuildTransferBudget:
     def test_build_device_gets_independent_of_bucket_count(
