@@ -193,7 +193,9 @@ class ScoreBasedIndexPlanOptimizer:
                             reason = (
                                 f"A rewrite using this index tied the "
                                 f"chosen plan's score ({best_score:.0f}) "
-                                f"but reads more index bytes.")
+                                f"and lost the tie-break (fewer index "
+                                f"bytes read wins; equal plans keep the "
+                                f"first found).")
                         else:
                             reason = (
                                 f"A rewrite using this index scored "

@@ -124,9 +124,15 @@ class FileBasedSourceProviderManager:
             if result is not None:
                 answers.append((p, result))
         if len(answers) != 1:
+            # A format typo is the common path here — name it, and the
+            # providers that were asked, instead of a bare count.
+            detail = ""
+            if fn_name == "build_relation" and len(args) >= 2:
+                detail = f" for format {args[1]!r}"
+            names = ", ".join(type(p).__name__ for p in self._providers)
             raise HyperspaceException(
-                f"Exactly one provider must respond to {fn_name}; "
-                f"got {len(answers)} of {len(self._providers)}.")
+                f"Exactly one provider must respond to {fn_name}{detail}; "
+                f"got {len(answers)} of {len(self._providers)} ({names}).")
         return answers[0][1]
 
     def get_relation(self, plan_leaf) -> FileBasedRelation:

@@ -1,0 +1,181 @@
+"""User-facing error surfaces: every misuse of the DataFrame / reader /
+writer / index API must fail fast with a HyperspaceException naming the
+problem — not a deep engine traceback.
+
+Parity: the reference asserts error messages across its suites
+(IndexConfigTest, IndexManagerTest's duplicate/invalid cases,
+E2EHyperspaceRulesTest's unsupported-plan cases); this file concentrates
+the same contract for the TPU-native API.
+"""
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+import hyperspace_tpu as hst
+from hyperspace_tpu.api import Hyperspace, IndexConfig
+from hyperspace_tpu.exceptions import HyperspaceException
+from hyperspace_tpu.plan.expr import col, sum_
+
+
+@pytest.fixture()
+def env(tmp_path):
+    d = tmp_path / "data"
+    d.mkdir()
+    rng = np.random.default_rng(1)
+    pq.write_table(pa.Table.from_pandas(pd.DataFrame({
+        "k": rng.integers(0, 20, 100).astype(np.int64),
+        "v": rng.integers(0, 9, 100).astype(np.int64),
+    })), d / "p0.parquet")
+    session = hst.Session(system_path=str(tmp_path / "indexes"))
+    return session, str(d), tmp_path
+
+
+class TestPlanConstructionErrors:
+    def test_select_unknown_column_names_available(self, env):
+        session, d, _ = env
+        df = session.read.parquet(d)
+        with pytest.raises(HyperspaceException, match="unknown column.*'z'"):
+            df.select("k", "z")
+
+    def test_filter_unknown_column(self, env):
+        session, d, _ = env
+        df = session.read.parquet(d)
+        with pytest.raises(HyperspaceException, match="z"):
+            df.filter(col("z") > 1)
+
+    def test_duplicate_projection_names(self, env):
+        session, d, _ = env
+        df = session.read.parquet(d)
+        with pytest.raises(HyperspaceException, match="Duplicate"):
+            df.select(col("k"), col("v").alias("k"))
+
+    def test_sort_unknown_column(self, env):
+        session, d, _ = env
+        df = session.read.parquet(d)
+        with pytest.raises(HyperspaceException):
+            df.sort("nope").to_arrow()
+
+    def test_group_by_unknown_column(self, env):
+        session, d, _ = env
+        df = session.read.parquet(d)
+        with pytest.raises(HyperspaceException):
+            (df.group_by("nope").agg(sum_(col("v")).alias("s"))
+             .to_arrow())
+
+    def test_join_unknown_key(self, env):
+        session, d, _ = env
+        df = session.read.parquet(d)
+        with pytest.raises(HyperspaceException):
+            df.join(df.select(col("k").alias("k2"), col("v").alias("v2")),
+                    on=col("missing") == col("k2")).to_arrow()
+
+    def test_join_bad_how(self, env):
+        session, d, _ = env
+        df = session.read.parquet(d)
+        other = df.select(col("k").alias("k2"))
+        with pytest.raises(HyperspaceException, match="join type"):
+            df.join(other, on=col("k") == col("k2"), how="sideways")
+
+    def test_drop_everything_raises(self, env):
+        session, d, _ = env
+        df = session.read.parquet(d)
+        with pytest.raises(HyperspaceException, match="every column"):
+            df.drop("k", "v")
+
+    def test_union_column_mismatch(self, env):
+        session, d, _ = env
+        df = session.read.parquet(d)
+        with pytest.raises(HyperspaceException, match="column mismatch"):
+            df.select("k").union(df.select("v"))
+
+    def test_union_dtype_mismatch(self, env, tmp_path):
+        session, d, _ = env
+        other = tmp_path / "floats"
+        other.mkdir()
+        pq.write_table(pa.table({
+            "k": pa.array([1.5, 2.5], type=pa.float64()),
+            "v": pa.array([1, 2], type=pa.int64())}),
+            other / "p0.parquet")
+        df = session.read.parquet(d)
+        f = session.read.parquet(str(other))
+        with pytest.raises(HyperspaceException, match="dtype mismatch"):
+            df.union(f)
+
+
+class TestReaderWriterErrors:
+    def test_read_missing_dir(self, env):
+        session, _, tmp = env
+        with pytest.raises(HyperspaceException):
+            session.read.parquet(str(tmp / "nope")).to_arrow()
+
+    def test_unknown_format(self, env):
+        session, d, _ = env
+        with pytest.raises(HyperspaceException, match="format 'xml'"):
+            session.read.format("xml").load(d)
+
+    def test_write_refuses_overwrite_by_default(self, env):
+        session, d, tmp = env
+        df = session.read.parquet(d)
+        out = tmp / "out"
+        df.write.parquet(str(out))
+        with pytest.raises(HyperspaceException, match="mode"):
+            df.write.parquet(str(out))
+
+    def test_write_bad_mode(self, env):
+        session, d, tmp = env
+        df = session.read.parquet(d)
+        with pytest.raises(HyperspaceException, match="mode"):
+            df.write.mode("upsert").parquet(str(tmp / "o2"))
+
+
+class TestViewErrors:
+    def test_table_unknown_view(self, env):
+        session, _, _ = env
+        with pytest.raises(HyperspaceException, match="view"):
+            session.table("ghost")
+
+    def test_duplicate_view_without_replace(self, env):
+        session, d, _ = env
+        df = session.read.parquet(d)
+        session.create_temp_view("v1", df)
+        with pytest.raises(HyperspaceException):
+            session.create_temp_view("v1", df)
+        session.drop_temp_view("v1")
+
+
+class TestIndexApiErrors:
+    def test_create_index_unknown_indexed_column(self, env):
+        session, d, _ = env
+        hs = Hyperspace(session)
+        df = session.read.parquet(d)
+        with pytest.raises(HyperspaceException):
+            hs.create_index(df, IndexConfig("i1", ["zzz"], ["v"]))
+
+    def test_create_index_unknown_included_column(self, env):
+        session, d, _ = env
+        hs = Hyperspace(session)
+        df = session.read.parquet(d)
+        with pytest.raises(HyperspaceException):
+            hs.create_index(df, IndexConfig("i2", ["k"], ["zzz"]))
+
+    def test_create_index_overlapping_columns(self, env):
+        session, d, _ = env
+        hs = Hyperspace(session)
+        df = session.read.parquet(d)
+        with pytest.raises(HyperspaceException):
+            hs.create_index(df, IndexConfig("i3", ["k"], ["k"]))
+
+    def test_delete_unknown_index(self, env):
+        session, _, _ = env
+        hs = Hyperspace(session)
+        with pytest.raises(HyperspaceException):
+            hs.delete_index("ghost")
+
+    def test_refresh_unknown_index(self, env):
+        session, _, _ = env
+        hs = Hyperspace(session)
+        with pytest.raises(HyperspaceException):
+            hs.refresh_index("ghost")
