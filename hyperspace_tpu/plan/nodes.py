@@ -106,17 +106,23 @@ class LogicalPlan:
 
 
 class Scan(LogicalPlan):
-    """Leaf: scan a file-based relation (LogicalRelation analogue)."""
+    """Leaf: scan a file-based relation (LogicalRelation analogue).
 
-    def __init__(self, relation):
+    ``skipping_note``: set by DataSkippingIndexRule when it narrows the
+    relation's file list, so golden plans and explain render the pruning
+    (e.g. "[1/4 files after skipping]")."""
+
+    def __init__(self, relation, skipping_note: Optional[str] = None):
         self.relation = relation  # sources.FileBasedRelation
+        self.skipping_note = skipping_note
 
     @property
     def schema(self) -> Schema:
         return self.relation.schema
 
     def simple_string(self) -> str:
-        return f"Scan {self.relation.describe()}"
+        return f"Scan {self.relation.describe()}" + \
+            (f" [{self.skipping_note}]" if self.skipping_note else "")
 
 
 class IndexScan(LogicalPlan):

@@ -83,7 +83,11 @@ class DataSkippingIndexRule:
             return None  # nothing pruned → no rewrite, no usage event.
         applied.extend(hit_names)
         kept_files = [f for f, k in zip(all_files, keep) if k]
-        return Scan(relation.with_files(kept_files))
+        # The note makes the pruning visible in golden plans + explain:
+        # without it a skipped scan prints identically to the full scan.
+        return Scan(relation.with_files(kept_files),
+                    skipping_note=(f"{len(kept_files)}/{len(all_files)} "
+                                   f"files after skipping"))
 
 
 def evaluate_sketch_predicate(entry: IndexLogEntry, condition: E.Expr,

@@ -121,6 +121,19 @@ def _tpcds_sales_tables(rng) -> Dict[str, pa.Table]:
     }
 
 
+def _web_events_table(rng) -> pa.Table:
+    """Date-sorted event fact written as FOUR files (see register_tables):
+    each file covers a date quarter, so per-file MinMax sketches prune —
+    the data-skipping golden surface."""
+    n = 200
+    dates = np.sort(rng.integers(9000, 9400, n)).astype(np.int32)
+    return pa.table({
+        "we_event_date": pa.array(dates, type=pa.int32()).cast(pa.date32()),
+        "we_user_sk": pa.array(rng.integers(0, 30, n).astype(np.int64)),
+        "we_amount": pa.array(np.round(rng.uniform(1, 500, n), 2)),
+    })
+
+
 def register_tables(session, root: str) -> Dict[str, "object"]:
     """Write the deterministic datasets (once per directory) and return
     name → DataFrame."""
@@ -134,6 +147,18 @@ def register_tables(session, root: str) -> Dict[str, "object"]:
             os.makedirs(d)
             pq.write_table(tbl, os.path.join(d, "part0.parquet"))
         dfs[name] = session.read.parquet(d)
+    # web_events: 4 date-range part files (sketch-prunable layout).
+    we = _web_events_table(np.random.default_rng(13))
+    d = os.path.join(root, "web_events")
+    if not os.path.isdir(d):
+        os.makedirs(d)
+        step = we.num_rows // 4
+        for i in range(4):
+            lo = i * step
+            hi = (i + 1) * step if i < 3 else we.num_rows
+            pq.write_table(we.slice(lo, hi - lo),
+                           os.path.join(d, f"part{i}.parquet"))
+    dfs["web_events"] = session.read.parquet(d)
     return dfs
 
 
@@ -142,8 +167,11 @@ def register_tables(session, root: str) -> Dict[str, "object"]:
 # ---------------------------------------------------------------------------
 
 def index_configs():
-    from hyperspace_tpu.api import IndexConfig
+    from hyperspace_tpu.api import (DataSkippingIndexConfig, IndexConfig,
+                                    MinMaxSketch)
     return [
+        DataSkippingIndexConfig("we_skip",
+                                [MinMaxSketch("we_event_date")]),
         IndexConfig("li_ok_idx", ["l_orderkey"],
                     ["l_extendedprice", "l_discount", "l_shipdate"]),
         IndexConfig("od_ok_idx", ["o_orderkey"],
@@ -165,7 +193,7 @@ def index_configs():
 INDEXED_TABLES = {"li_ok_idx": "lineitem", "od_ok_idx": "orders",
                   "li_ship_idx": "lineitem", "sr_cust_idx": "store_returns",
                   "li_pk_idx": "lineitem", "ss_item_idx": "store_sales",
-                  "it_sk_idx": "item"}
+                  "it_sk_idx": "item", "we_skip": "web_events"}
 
 
 # ---------------------------------------------------------------------------
@@ -197,6 +225,8 @@ QUERY_NAMES = [
     "tpch_q20_like", "tpch_q22_like", "tpch_q2_like", "tpch_q11_like",
     "in_list_strings", "float_between_discount", "second_level_agg",
     "union_sales_returns", "distinct_join", "cross_fact_join",
+    # Data-skipping surface (multi-file web_events + MinMax sketch).
+    "skipping_date_window", "skipping_unprunable_amount",
 ]
 
 
@@ -714,6 +744,24 @@ def queries(dfs):
         .group_by("ss_store_sk")
         .agg(count(None).alias("n"), sum_(col("sr_return_amt")).alias("amt"))
         .sort("ss_store_sk"))
+
+    we = dfs["web_events"]
+
+    # Narrow date window → the MinMax sketch refutes most part files; the
+    # enabled golden pins the "[k/4 files after skipping]" scan annotation.
+    q["skipping_date_window"] = (
+        we.filter(col("we_event_date").between(d(1994, 9, 1),
+                                               d(1994, 10, 15)))
+        .group_by("we_user_sk")
+        .agg(sum_(col("we_amount")).alias("amt"))
+        .sort("we_user_sk"))
+
+    # Predicate on an unsketeched column: the rule must keep all files
+    # (conservative no-op; enabled plan equals disabled).
+    q["skipping_unprunable_amount"] = (
+        we.filter(col("we_amount") > 450)
+        .select("we_user_sk", "we_amount")
+        .sort(("we_amount", False)).limit(10))
 
     assert sorted(q) == sorted(QUERY_NAMES), \
         f"QUERY_NAMES out of sync: {sorted(set(q) ^ set(QUERY_NAMES))}"

@@ -183,7 +183,11 @@ class TestExpectedRewrites:
               "in_list_strings": False, "float_between_discount": False,
               "second_level_agg": False, "union_sales_returns": False,
               "distinct_join": True,       # ss_item_idx ⋈ it_sk_idx
-              "cross_fact_join": False}    # ss side not keyed on customer
+              "cross_fact_join": False,    # ss side not keyed on customer
+              # Data skipping narrows the Scan in place (no IndexScan
+              # node); the golden pins the [k/4 files] annotation instead.
+              "skipping_date_window": False,
+              "skipping_unprunable_amount": False}
 
     def test_rewrite_expectations(self, harness):
         session, queries = harness
