@@ -1,0 +1,180 @@
+"""whyNot diagnostics + IndexStatistics surfaces.
+
+Parity: the reference's whyNot APIs (`Hyperspace.whyNot`, FILTER_REASONS in
+rules/IndexFilter.scala:41-52, reason tags in IndexLogEntryTags.scala:57-63)
+and `hs.index(name)` / `hs.indexes()` statistics (IndexStatistics.scala) —
+each reason code the rules emit must surface through the public API with an
+actionable message.
+"""
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+import hyperspace_tpu as hst
+from hyperspace_tpu.api import Hyperspace, IndexConfig
+from hyperspace_tpu.index.constants import IndexConstants
+from hyperspace_tpu.plan.expr import avg, col, sum_
+
+
+@pytest.fixture()
+def env(tmp_path):
+    d = tmp_path / "data"
+    d.mkdir()
+    rng = np.random.default_rng(9)
+    pq.write_table(pa.Table.from_pandas(pd.DataFrame({
+        "k": rng.integers(0, 40, 400).astype(np.int64),
+        "v": rng.integers(0, 9, 400).astype(np.int64),
+        "w": rng.integers(0, 9, 400).astype(np.int64),
+    })), d / "p0.parquet")
+    session = hst.Session(system_path=str(tmp_path / "indexes"))
+    session.conf.set(IndexConstants.INDEX_NUM_BUCKETS, 4)
+    session.enable_hyperspace()
+    return dict(session=session, hs=Hyperspace(session), path=str(d),
+                dir=d)
+
+
+class TestWhyNotReasons:
+    def test_col_schema_mismatch(self, env, tmp_path):
+        hs, session = env["hs"], env["session"]
+        df = session.read.parquet(env["path"])
+        hs.create_index(df, IndexConfig("kv", ["k"], ["v"]))
+        # Query a DIFFERENT table that has none of kv's columns: the
+        # candidate collector rejects kv on column schema.
+        d2 = tmp_path / "other"
+        d2.mkdir()
+        pq.write_table(pa.table({
+            "x": pa.array(np.arange(10, dtype=np.int64))}),
+            d2 / "p0.parquet")
+        other = session.read.parquet(str(d2))
+        out = hs.why_not(other.filter(col("x") > 3).select("x"))
+        assert "kv" in out and "COL_SCHEMA_MISMATCH" in out
+
+    def test_no_first_indexed_col(self, env):
+        hs, session = env["hs"], env["session"]
+        df = session.read.parquet(env["path"])
+        hs.create_index(df, IndexConfig("kv2", ["k"], ["v"]))
+        # Filter on v only: kv2 covers the columns but its first indexed
+        # column (k) is not constrained.
+        out = hs.why_not(df.filter(col("v") > 3).select("k", "v"))
+        assert "NO_FIRST_INDEXED_COL" in out
+
+    def test_signature_mismatch_after_append(self, env):
+        hs, session = env["hs"], env["session"]
+        df = session.read.parquet(env["path"])
+        hs.create_index(df, IndexConfig("kv3", ["k"], ["v"]))
+        # Mutate the source (hybrid scan off → signature must mismatch).
+        pq.write_table(pa.Table.from_pandas(pd.DataFrame({
+            "k": np.array([100], dtype=np.int64),
+            "v": np.array([1], dtype=np.int64),
+            "w": np.array([1], dtype=np.int64),
+        })), env["dir"] / "p1.parquet")
+        fresh = session.read.parquet(env["path"])
+        out = hs.why_not(fresh.filter(col("k") > 3).select("k", "v"))
+        assert "SOURCE_DATA_CHANGED" in out
+
+    def test_outscored_on_tie(self, env):
+        hs, session = env["hs"], env["session"]
+        df = session.read.parquet(env["path"])
+        hs.create_index(df, IndexConfig("wide", ["k"], ["v", "w"]))
+        hs.create_index(df, IndexConfig("slim", ["k"], ["v"]))
+        out = hs.why_not(df.filter(col("k") > 3).select("k", "v"))
+        assert "OUTSCORED" in out and "wide" in out
+        assert "tie" in out  # the tie-break wording, not a false "scored
+        #                      below" claim
+
+    def test_join_no_compatible_pair(self, env, tmp_path):
+        hs, session = env["hs"], env["session"]
+        d2 = tmp_path / "dim"
+        d2.mkdir()
+        pq.write_table(pa.table({
+            "dk": pa.array(np.arange(40, dtype=np.int64)),
+            "dv": pa.array(np.arange(40, dtype=np.int64))}),
+            d2 / "p0.parquet")
+        df = session.read.parquet(env["path"])
+        dim = session.read.parquet(str(d2))
+        hs.create_index(df, IndexConfig("fact_k", ["k"], ["v"]))
+        # dim side has NO index → no compatible pair.
+        q = df.join(dim, on=col("k") == col("dk")).select("k", "dv")
+        out = hs.why_not(q)
+        assert "fact_k" in out
+
+    def test_why_not_filtered_to_one_index(self, env):
+        hs, session = env["hs"], env["session"]
+        df = session.read.parquet(env["path"])
+        hs.create_index(df, IndexConfig("aa", ["k"], ["v"]))
+        hs.create_index(df, IndexConfig("bb", ["v"], ["w"]))
+        out = hs.why_not(df.filter(col("w") > 3).select("w"),
+                         index_name="bb")
+        assert "bb" in out and "aa" not in out
+
+    def test_applied_index_not_reported_as_rejected(self, env):
+        hs, session = env["hs"], env["session"]
+        df = session.read.parquet(env["path"])
+        hs.create_index(df, IndexConfig("used", ["k"], ["v"]))
+        q = df.filter(col("k") > 3).select("k", "v")
+        # The query IS rewritten; why_not must not claim 'used' failed.
+        assert "IndexScan" in q.optimized_plan().tree_string()
+        out = hs.why_not(q)
+        for bad in ("COL_SCHEMA_MISMATCH", "MISSING_REQUIRED_COL"):
+            assert f"used: {bad}" not in out
+
+
+class TestIndexStatistics:
+    def test_summary_row_shape(self, env):
+        hs, session = env["hs"], env["session"]
+        df = session.read.parquet(env["path"])
+        hs.create_index(df, IndexConfig("st1", ["k"], ["v"]))
+        t = hs.indexes()  # pandas DataFrame (the reference returns a
+        #                      Spark DataFrame from the same columns)
+        assert len(t) == 1
+        # The reference's summary columns (IndexStatistics.scala).
+        assert list(t.columns) == ["name", "indexedColumns",
+                                   "includedColumns", "numBuckets",
+                                   "schema", "indexLocation", "state"]
+        row = t.iloc[0]
+        assert row["name"] == "st1"
+        assert row["indexedColumns"] == ["k"]
+        assert row["includedColumns"] == ["v"]
+        assert row["numBuckets"] == 4
+        assert row["state"] == "ACTIVE"
+        assert "v__=0" in row["indexLocation"]
+
+    def test_extended_stats_counts(self, env):
+        hs, session = env["hs"], env["session"]
+        df = session.read.parquet(env["path"])
+        hs.create_index(df, IndexConfig("st2", ["k"], ["v"]))
+        stat = hs.index("st2").iloc[0]
+        assert stat["sourceFileCount"] == 1
+        assert stat["indexFileCount"] == 4  # one parquet per bucket
+        assert stat["indexSizeBytes"] > 0
+        assert stat["sourceSizeBytes"] > 0
+        assert stat["appendedFileCount"] == 0
+        assert stat["deletedFileCount"] == 0
+
+    def test_stats_track_lifecycle_state(self, env):
+        hs, session = env["hs"], env["session"]
+        df = session.read.parquet(env["path"])
+        hs.create_index(df, IndexConfig("st3", ["k"], ["v"]))
+        hs.delete_index("st3")
+        # Listing defaults to non-deleted states only; the reference shows
+        # DELETED indexes through the same API when asked.
+        t = hs.indexes()
+        st3 = t[t["name"] == "st3"]
+        assert len(st3) == 0 or st3.iloc[0]["state"] == "DELETED"
+
+    def test_refresh_bumps_version_location(self, env):
+        hs, session = env["hs"], env["session"]
+        df = session.read.parquet(env["path"])
+        hs.create_index(df, IndexConfig("st4", ["k"], ["v"]))
+        pq.write_table(pa.Table.from_pandas(pd.DataFrame({
+            "k": np.array([7], dtype=np.int64),
+            "v": np.array([1], dtype=np.int64),
+            "w": np.array([2], dtype=np.int64),
+        })), env["dir"] / "p1.parquet")
+        hs.refresh_index("st4", "full")
+        stat = hs.index("st4").iloc[0]
+        assert "v__=1" in stat["indexLocation"]
+        assert stat["sourceFileCount"] == 2
