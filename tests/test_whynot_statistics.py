@@ -81,9 +81,10 @@ class TestWhyNotReasons:
         hs.create_index(df, IndexConfig("wide", ["k"], ["v", "w"]))
         hs.create_index(df, IndexConfig("slim", ["k"], ["v"]))
         out = hs.why_not(df.filter(col("k") > 3).select("k", "v"))
-        assert "OUTSCORED" in out and "wide" in out
-        assert "tie" in out  # the tie-break wording, not a false "scored
-        #                      below" claim
+        # Specifically WIDE lost the tie (slim won), with the tie-break
+        # wording, not a false "scored below" claim.
+        assert "[wide] OUTSCORED" in out and "tie" in out
+        assert "[slim] OUTSCORED" not in out
 
     def test_join_no_compatible_pair(self, env, tmp_path):
         hs, session = env["hs"], env["session"]
@@ -95,11 +96,16 @@ class TestWhyNotReasons:
             d2 / "p0.parquet")
         df = session.read.parquet(env["path"])
         dim = session.read.parquet(str(d2))
-        hs.create_index(df, IndexConfig("fact_k", ["k"], ["v"]))
-        # dim side has NO index → no compatible pair.
-        q = df.join(dim, on=col("k") == col("dk")).select("k", "dv")
+        # Both sides are indexed on the join columns but in OPPOSITE
+        # order: usable individually, incompatible as a pair.
+        hs.create_index(df, IndexConfig("fact_kv", ["k", "v"], ["w"]))
+        hs.create_index(dim, IndexConfig("dim_vd", ["dv", "dk"], []))
+        q = (df.join(dim, on=(col("k") == col("dk"))
+                     & (col("v") == col("dv")))
+             .select("k", "v", "dk", "dv", "w"))
         out = hs.why_not(q)
-        assert "fact_k" in out
+        assert "[fact_kv] NO_AVAIL_JOIN_INDEX_PAIR" in out
+        assert "[dim_vd] NO_AVAIL_JOIN_INDEX_PAIR" in out
 
     def test_why_not_filtered_to_one_index(self, env):
         hs, session = env["hs"], env["session"]
@@ -118,8 +124,9 @@ class TestWhyNotReasons:
         # The query IS rewritten; why_not must not claim 'used' failed.
         assert "IndexScan" in q.optimized_plan().tree_string()
         out = hs.why_not(q)
-        for bad in ("COL_SCHEMA_MISMATCH", "MISSING_REQUIRED_COL"):
-            assert f"used: {bad}" not in out
+        for bad in ("COL_SCHEMA_MISMATCH", "MISSING_REQUIRED_COL",
+                    "NO_FIRST_INDEXED_COL_COND", "OUTSCORED"):
+            assert f"[used] {bad}" not in out
 
 
 class TestIndexStatistics:
