@@ -251,3 +251,41 @@ class TestExchangeJoin:
             lambda: lf.join(rf, on=col("k") == col("rk"))
                       .group_by("k").agg(count(None).alias("n")),
             sort_by=["k"])
+
+
+class TestDistinctAndUnion:
+    def test_distinct_dispatches_spmd(self, session, fact_dir):
+        """distinct() lowers onto the grouped-aggregate machinery (group by
+        every column), so it inherits the SPMD dispatch."""
+        df = session.read.parquet(fact_dir)
+        got = run_both(
+            session,
+            lambda: df.select("k2", "tag").distinct(),
+            sort_by=["k2", "tag"])
+        assert len(got) == 18  # 6 k2 values x 3 tags
+
+    def test_union_falls_back_observably(self, session, fact_dir):
+        """Union roots are not an SPMD shape: the query must still answer
+        (single-device) and the fallback must be visible as an event."""
+        from conftest import capture_logger
+        session.conf.set(IndexConstants.EVENT_LOGGER_CLASS,
+                         "tests.conftest.CaptureLogger")
+        sink = capture_logger()
+        sink.events.clear()
+        df = session.read.parquet(fact_dir)
+        q = (df.filter(col("k2") <= 2).select("k", "v")
+             .union(df.filter(col("k2") >= 4).select("k", "v"))
+             .group_by("k").agg(sum_(col("v")).alias("s"))
+             .sort("k").limit(20))
+        before = spmd.DISPATCH_COUNT
+        got = q.to_pandas()
+        session.conf.set(IndexConstants.TPU_DISTRIBUTED_ENABLED, "false")
+        try:
+            single = q.to_pandas()
+        finally:
+            session.conf.set(IndexConstants.TPU_DISTRIBUTED_ENABLED, "true")
+        pd.testing.assert_frame_equal(got, single, check_dtype=False)
+        if spmd.DISPATCH_COUNT == before:
+            # Fell back — degradation must be observable (VERDICT r2 #4).
+            assert any(type(e).__name__ == "DistributedFallbackEvent"
+                       for e in sink.events)
