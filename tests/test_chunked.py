@@ -259,3 +259,100 @@ class TestChunkedIndexScan:
         pd.testing.assert_frame_equal(
             got.sort_values(key).reset_index(drop=True),
             raw.sort_values(key).reset_index(drop=True), check_dtype=False)
+
+
+class TestChunkedRefreshOptimize:
+    """Refresh and optimize over indexes whose data exceeds the chunk
+    budget — the lifecycle actions must ride the same streaming paths."""
+
+    def test_incremental_refresh_under_budget(self, env, tmp_path):
+        session, hs = env["session"], env["hs"]
+        session.conf.set(IndexConstants.TPU_MAX_CHUNK_ROWS, CHUNK)
+        df = session.read.parquet(env["path"])
+        hs.create_index(df, IndexConfig("rIdx", ["k"], ["v", "s"]))
+        # Append MORE than one chunk budget of new rows.
+        rng = np.random.default_rng(99)
+        extra = pd.DataFrame({
+            "k": rng.integers(0, 5000, CHUNK + 5000).astype(np.int64),
+            "v": rng.integers(0, 100, CHUNK + 5000).astype(np.int64),
+            "s": rng.choice(["ab", "cd"], CHUNK + 5000),
+        })
+        pq.write_table(pa.Table.from_pandas(extra),
+                       os.path.join(env["path"], "part9.parquet"),
+                       row_group_size=7_000)
+        index_build.CHUNK_STATS["max_device_rows"] = 0
+        hs.refresh_index("rIdx", "incremental")
+        assert index_build.CHUNK_STATS["max_device_rows"] <= \
+            max(CHUNK, int((CHUNK + 5000) / 8 * 3))
+        # Oracle: indexed answers equal fresh-scan answers post-refresh.
+        session.enable_hyperspace()
+        q = (session.read.parquet(env["path"])
+             .filter(col("k") < 500).group_by("k")
+             .agg(sum_(col("v")).alias("sv")).sort("k"))
+        with_idx = q.to_pandas()
+        session.disable_hyperspace()
+        pd.testing.assert_frame_equal(with_idx, q.to_pandas())
+
+    def test_optimize_after_chunked_refresh(self, env):
+        session, hs = env["session"], env["hs"]
+        session.conf.set(IndexConstants.TPU_MAX_CHUNK_ROWS, CHUNK)
+        df = session.read.parquet(env["path"])
+        hs.create_index(df, IndexConfig("oIdx", ["k"], ["v"]))
+        rng = np.random.default_rng(7)
+        extra = pd.DataFrame({
+            "k": rng.integers(0, 5000, 9000).astype(np.int64),
+            "v": rng.integers(0, 100, 9000).astype(np.int64),
+            "s": rng.choice(["ab", "cd"], 9000),
+        })
+        pq.write_table(pa.Table.from_pandas(extra),
+                       os.path.join(env["path"], "part8.parquet"))
+        hs.refresh_index("oIdx", "incremental")
+        hs.optimize_index("oIdx", "full")
+        sys_path = str(env["tmp"] / "indexes")
+        versions = sorted(os.listdir(os.path.join(sys_path, "oIdx")))
+        latest = [v for v in versions if v.startswith("v__=")][-1]
+        files = os.listdir(os.path.join(sys_path, "oIdx", latest))
+        assert len(files) == 8  # one file per bucket after full compaction
+        session.enable_hyperspace()
+        q = (session.read.parquet(env["path"])
+             .filter(col("k") < 300).group_by("k")
+             .agg(sum_(col("v")).alias("sv")).sort("k"))
+        with_idx = q.to_pandas()
+        session.disable_hyperspace()
+        pd.testing.assert_frame_equal(with_idx, q.to_pandas())
+
+
+class TestChunkedSkew:
+    def test_one_bucket_dominates(self, tmp_path):
+        """90% of rows hash to one key: that bucket alone exceeds the chunk
+        budget; the per-bucket merge must still produce a single sorted
+        bucket file with every row."""
+        rng = np.random.default_rng(5)
+        n = 60_000
+        k = np.where(rng.random(n) < 0.9, 7, rng.integers(0, 5000, n)) \
+            .astype(np.int64)
+        df = pd.DataFrame({"k": k,
+                           "v": rng.integers(0, 9, n).astype(np.int64)})
+        path = write_parts(tmp_path, "skew", df, parts=3)
+        session = hst.Session(system_path=str(tmp_path / "idx"))
+        session.conf.set(IndexConstants.INDEX_NUM_BUCKETS, 8)
+        session.conf.set(IndexConstants.TPU_MAX_CHUNK_ROWS, 10_000)
+        hs = Hyperspace(session)
+        hs.create_index(session.read.parquet(path),
+                        IndexConfig("skewIdx", ["k"], ["v"]))
+        sys_path = str(tmp_path / "idx")
+        files = os.listdir(os.path.join(sys_path, "skewIdx", "v__=0"))
+        total = 0
+        for f in files:
+            t = pq.read_table(os.path.join(sys_path, "skewIdx", "v__=0", f))
+            keys = t.column("k").to_pylist()
+            assert keys == sorted(keys), f"bucket {f} unsorted"
+            total += t.num_rows
+        assert total == n
+        # Oracle through the rewrite on the skewed key.
+        session.enable_hyperspace()
+        q = (session.read.parquet(path).filter(col("k") == 7)
+             .group_by("k").agg(sum_(col("v")).alias("sv")))
+        with_idx = q.to_pandas()
+        session.disable_hyperspace()
+        pd.testing.assert_frame_equal(with_idx, q.to_pandas())

@@ -1,0 +1,164 @@
+"""Config-system contract + columnar Table edge cases.
+
+Parity: the reference's HyperspaceConf/IndexConstants suites pin key
+precedence, defaults, and parse behavior (util/HyperspaceConfTest-style
+assertions inside other suites); the columnar layer is this framework's
+own (the engine Spark provides in the reference) so its invariants —
+dictionary re-unification on concat, validity widening, host/device
+round-trips — get direct coverage.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import hyperspace_tpu as hst
+from hyperspace_tpu.config import Conf, HyperspaceConf
+from hyperspace_tpu.exceptions import HyperspaceException
+from hyperspace_tpu.execution.columnar import Column, Table
+from hyperspace_tpu.index.constants import IndexConstants
+from hyperspace_tpu.schema import DATE, INT64, STRING
+
+
+class TestConf:
+    def test_set_get_roundtrip_stringifies(self):
+        c = Conf()
+        c.set("a.b", 42)
+        assert c.get("a.b") == "42"  # values normalize to strings
+        c.set("a.b", True)
+        assert c.get("a.b") == "True"
+
+    def test_get_default_and_contains(self):
+        c = Conf({"x": "1"})
+        assert c.get("y") is None
+        assert c.get("y", "fallback") == "fallback"
+        assert c.contains("x") and not c.contains("y")
+
+    def test_unset(self):
+        c = Conf({"x": "1"})
+        c.unset("x")
+        assert c.get("x") is None
+        c.unset("x")  # idempotent
+
+    def test_copy_is_independent(self):
+        c = Conf({"x": "1"})
+        d = c.copy()
+        d.set("x", "2")
+        assert c.get("x") == "1" and d.get("x") == "2"
+
+    def test_session_conf_chaining(self, tmp_path):
+        s = hst.Session(system_path=str(tmp_path / "idx"))
+        s.conf.set(IndexConstants.INDEX_NUM_BUCKETS, 8) \
+            .set(IndexConstants.INDEX_LINEAGE_ENABLED, "true")
+        assert s.hs_conf.num_bucket_count() == 8
+        assert s.hs_conf.index_lineage_enabled() is True
+
+
+class TestHyperspaceConfDefaults:
+    def make(self, **kv):
+        return HyperspaceConf(Conf({k: str(v) for k, v in kv.items()}))
+
+    def test_reference_defaults(self):
+        hc = self.make()
+        # The reference's IndexConstants defaults (IndexConstants.scala).
+        assert hc.num_bucket_count() == 200
+        assert hc.hybrid_scan_enabled() is False
+        assert hc.hybrid_scan_appended_ratio_threshold() == pytest.approx(0.3)
+        assert hc.hybrid_scan_deleted_ratio_threshold() == pytest.approx(0.2)
+        assert hc.optimize_file_size_threshold() == 256 * 1024 * 1024
+        assert hc.index_cache_expiry_seconds() == 300
+        assert hc.case_sensitive() is False
+        assert hc.event_logger_class() is None
+
+    def test_boolean_parsing_is_case_insensitive(self):
+        assert self.make(**{
+            IndexConstants.INDEX_HYBRID_SCAN_ENABLED: "TRUE"
+        }).hybrid_scan_enabled() is True
+        assert self.make(**{
+            IndexConstants.INDEX_HYBRID_SCAN_ENABLED: "False"
+        }).hybrid_scan_enabled() is False
+
+    def test_numeric_overrides(self):
+        hc = self.make(**{IndexConstants.INDEX_NUM_BUCKETS: "16"})
+        assert hc.num_bucket_count() == 16
+
+
+class TestColumnarConcat:
+    def int_col(self, vals, validity=None):
+        v = None if validity is None else jnp.asarray(validity)
+        return Column(INT64, jnp.asarray(np.asarray(vals, np.int64)), v)
+
+    def str_col(self, codes, dictionary):
+        return Column(STRING, jnp.asarray(np.asarray(codes, np.int32)),
+                      None, np.asarray(dictionary, object))
+
+    def test_concat_dtype_mismatch_raises(self):
+        a = Table({"x": self.int_col([1, 2])})
+        b = Table({"x": Column(DATE, jnp.asarray(np.asarray([1], np.int32)))})
+        with pytest.raises(HyperspaceException, match="dtype mismatch"):
+            Table.concat([a, b])
+
+    def test_concat_skips_empty_tables(self):
+        a = Table({"x": self.int_col([1, 2])})
+        empty = Table({"x": self.int_col([])})
+        out = Table.concat([empty, a, empty])
+        np.testing.assert_array_equal(np.asarray(out.column("x").data), [1, 2])
+
+    def test_concat_widens_validity(self):
+        # One side has no validity (all valid); the union must keep the
+        # other side's nulls and mark the first side all-true.
+        a = Table({"x": self.int_col([1, 2])})
+        b = Table({"x": self.int_col([3, 4], validity=[True, False])})
+        out = Table.concat([a, b])
+        np.testing.assert_array_equal(
+            np.asarray(out.column("x").validity),
+            [True, True, True, False])
+
+    def test_concat_reunifies_string_dictionaries(self):
+        # Different dictionaries for the same logical values: codes must be
+        # remapped onto one dictionary, values preserved.
+        a = Table({"s": self.str_col([0, 1], ["apple", "pear"])})
+        b = Table({"s": self.str_col([0, 1], ["banana", "apple"])})
+        out = Table.concat([a, b])
+        col = out.column("s")
+        dic = list(col.dictionary)
+        got = [dic[int(c)] for c in np.asarray(col.data)]
+        assert got == ["apple", "pear", "banana", "apple"]
+        # Order-preserving dictionary: codes must compare like the strings.
+        order = np.argsort(np.asarray(col.data, np.int64))
+        assert [got[i] for i in order] == sorted(got)
+
+    def test_to_host_roundtrip_preserves_everything(self):
+        t = Table({
+            "x": self.int_col([5, 6, 7], validity=[True, False, True]),
+            "s": self.str_col([1, 0, 1], ["aa", "bb"]),
+        }, bucket_order=(4, ("x",)))
+        h = t.to_host()
+        assert h.bucket_order == (4, ("x",))
+        back = h.to_arrow()
+        assert back.column("x").to_pylist() == [5, None, 7]
+        assert back.column("s").to_pylist() == ["bb", "aa", "bb"]
+
+
+class TestTableSliceTake:
+    def test_slice_preserves_bucket_order(self):
+        t = Table({"x": Column(INT64, jnp.arange(10))},
+                  bucket_order=(2, ("x",)))
+        s = t.slice(2, 5)
+        assert s.bucket_order == (2, ("x",))
+        assert s.num_rows == 3
+
+    def test_filter_mask_length_mismatch_raises(self):
+        t = Table({"x": Column(INT64, jnp.arange(4))})
+        with pytest.raises(HyperspaceException, match="mask length"):
+            t.filter(jnp.ones(3, jnp.bool_))
+
+    def test_take_reorders_all_columns(self):
+        t = Table({
+            "x": Column(INT64, jnp.asarray(np.asarray([10, 20, 30], np.int64))),
+            "y": Column(INT64, jnp.asarray(np.asarray([1, 2, 3], np.int64))),
+        })
+        out = t.take(jnp.asarray(np.asarray([2, 0], np.int32)))
+        np.testing.assert_array_equal(np.asarray(out.column("x").data), [30, 10])
+        np.testing.assert_array_equal(np.asarray(out.column("y").data), [3, 1])
