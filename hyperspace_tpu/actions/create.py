@@ -243,21 +243,26 @@ def _file_triple(path: str):
 
 
 def _write_bucket_files(table: Table, bounds, base: int, num_buckets: int,
-                        out_dir: str, row_group_size: int) -> None:
+                        out_dir: str, row_group_size: int,
+                        file_name=None) -> None:
     """One parquet per non-empty bucket from bucket-contiguous rows.
     ``bounds[b]``..``bounds[b+1]`` (plus ``base``) delimit bucket b; the
-    single shared layout rule for the single-device and mesh builds.
+    single shared layout rule for the single-device and mesh builds AND
+    for user-facing bucketed writes (session.py ``bucket_by``, which
+    passes ``file_name`` to add its per-write uniqueness suffix).
 
     Deliberately serial: the writes are host-side (the build fetched the
     table wholesale already) and measured GIL/IO-bound — a thread pool
     over the per-bucket writes changed nothing at SF1 (1.12 s either
     way), so the simple loop stays."""
+    if file_name is None:
+        file_name = index_build.bucket_file_name
     for b in range(num_buckets):
         lo, hi = int(bounds[b]), int(bounds[b + 1])
         if hi <= lo:
             continue  # empty buckets produce no file.
         write_parquet(table.slice(base + lo, base + hi),
-                      os.path.join(out_dir, index_build.bucket_file_name(b)),
+                      os.path.join(out_dir, file_name(b)),
                       row_group_size=row_group_size)
 
 

@@ -375,17 +375,42 @@ class DataFrame:
 class DataFrameWriter:
     """Minimal writer: result → parquet/csv/json files. ``mode``:
     "error" (default, refuse to overwrite a non-empty dir) |
-    "overwrite" | "append" (add a new part file)."""
+    "overwrite" | "append" (add a new part file).
+
+    ``bucket_by(n, cols...)`` (parquet only) writes a bucketed, per-bucket-
+    sorted dataset through the same hash→bucket→sort pipeline the index
+    build uses — the analogue of the reference's ``saveWithBuckets``
+    (util/DataFrameWriterExtensions.scala): bucket ids are recoverable
+    from the file names and rows within each file are sorted by the
+    bucketing columns."""
 
     def __init__(self, df: "DataFrame"):
         self._df = df
         self._mode = "error"
+        self._bucket = None  # (num_buckets, [cols]) once bucket_by is set
 
     def mode(self, mode: str) -> "DataFrameWriter":
         if mode not in ("error", "overwrite", "append"):
             raise HyperspaceException(f"Unknown write mode: {mode}")
         self._mode = mode
         return self
+
+    def bucket_by(self, num_buckets: int, *cols: str) -> "DataFrameWriter":
+        if num_buckets <= 0:
+            raise HyperspaceException(
+                f"bucket_by needs a positive bucket count, got {num_buckets}")
+        if not cols:
+            raise HyperspaceException(
+                "bucket_by needs at least one bucketing column")
+        missing = [c for c in cols if c not in self._df.plan.schema]
+        if missing:
+            raise HyperspaceException(
+                f"bucket_by columns not in the result: {missing}; "
+                f"available: {self._df.plan.schema.names}")
+        self._bucket = (num_buckets, list(cols))
+        return self
+
+    bucketBy = bucket_by
 
     # Write protocol, in this order for every format:
     #   1. _check: cheap destination validation BEFORE the query runs
@@ -403,28 +428,108 @@ class DataFrameWriter:
                 f"Path not empty: {path} (use mode('overwrite') or "
                 "mode('append'))")
 
-    def _finalize(self, path: str) -> str:
+    def _prepare_dir(self, path: str) -> str:
+        """Destination prep shared by all writers: delete (overwrite) and
+        create the dir only AFTER the query result was materialized — so
+        writing a query back over its own source is safe."""
         import shutil
-        import uuid
         if self._mode == "overwrite" and os.path.isdir(path):
             shutil.rmtree(path)
         os.makedirs(path, exist_ok=True)
+        return path
+
+    def _finalize(self, path: str) -> str:
+        import uuid
+        self._prepare_dir(path)
         return os.path.join(path, f"part-{uuid.uuid4().hex[:12]}")
+
+    BUCKET_SPEC_FILE = "_bucket_spec.json"  # invisible to readers (they
+    #                                         list only format suffixes)
 
     def parquet(self, path: str) -> None:
         from .execution.columnar import write_parquet
         self._check(path)
+        if self._bucket is not None:
+            self._bucketed_parquet(path)
+            return
+        if self._mode == "append" and \
+                os.path.isfile(os.path.join(path, self.BUCKET_SPEC_FILE)):
+            raise HyperspaceException(
+                f"{path} holds a bucketed dataset; appending unbucketed "
+                "rows would break its layout. Use "
+                "bucket_by(<same spec>) or mode('overwrite').")
         table = self._df.execute().to_host()
         write_parquet(table, self._finalize(path) + ".parquet")
+
+    def _bucketed_parquet(self, path: str) -> None:
+        import json
+        import uuid
+
+        from .actions.create import _write_bucket_files
+        from .ops import index_build
+
+        num_buckets, cols = self._bucket
+        spec_path = os.path.join(path, self.BUCKET_SPEC_FILE)
+        if self._mode == "append" and os.path.isdir(path) and \
+                os.listdir(path):
+            # Appends must match the directory's existing bucket layout —
+            # a different spec (or a previously unbucketed dir) would
+            # silently put rows in files whose name promises a different
+            # bucket (the recoverable-bucket-id contract).
+            try:
+                with open(spec_path) as f:
+                    existing = json.load(f)
+            except OSError:
+                raise HyperspaceException(
+                    f"Cannot bucket-append to {path}: it was not written "
+                    "with bucket_by (no bucket spec found).") from None
+            if existing != {"numBuckets": num_buckets, "columns": cols}:
+                raise HyperspaceException(
+                    f"bucket_by({num_buckets}, {cols}) does not match the "
+                    f"existing layout of {path}: "
+                    f"bucket_by({existing['numBuckets']}, "
+                    f"{existing['columns']}).")
+        table = self._df.execute()
+        sorted_table, bounds = index_build.build_sorted_buckets(
+            table, cols, num_buckets)
+        host = sorted_table.to_host()
+        self._prepare_dir(path)
+        with open(spec_path, "w") as f:
+            json.dump({"numBuckets": num_buckets, "columns": cols}, f)
+        # A unique per-write suffix keeps Append-mode files from colliding;
+        # the bucket id stays recoverable (bucket_id_from_file matches the
+        # part-<id> prefix).
+        suffix = uuid.uuid4().hex[:8]
+
+        def name_for(bucket: int) -> str:
+            return index_build.bucket_file_name(bucket).replace(
+                ".parquet", f"-{suffix}.parquet")
+
+        if host.num_rows == 0 and not any(
+                f.endswith(".parquet") for f in os.listdir(path)):
+            # Schema preservation for an empty result landing in an empty
+            # dir: one 0-row file (read-back of a fileless dir would fail).
+            from .execution.columnar import write_parquet
+            write_parquet(host, os.path.join(path, name_for(0)))
+            return
+        _write_bucket_files(host, bounds, 0, num_buckets, path,
+                            row_group_size=None, file_name=name_for)
 
     def csv(self, path: str) -> None:
         import pyarrow.csv as pa_csv
         self._check(path)
+        self._reject_buckets("csv")
         at = self._df.to_arrow()
         pa_csv.write_csv(at, self._finalize(path) + ".csv")
 
+    def _reject_buckets(self, fmt: str) -> None:
+        if self._bucket is not None:
+            raise HyperspaceException(
+                f"bucket_by is only supported for parquet output, not {fmt}")
+
     def json(self, path: str) -> None:
         self._check(path)
+        self._reject_buckets("json")
         df = self._df.to_pandas()
         df.to_json(self._finalize(path) + ".json",
                    orient="records", lines=True, date_format="iso")
@@ -432,6 +537,7 @@ class DataFrameWriter:
     def avro(self, path: str) -> None:
         from .util.avro import write_avro
         self._check(path)
+        self._reject_buckets("avro")
         at = self._df.to_arrow()
         write_avro(at, self._finalize(path) + ".avro")
 
