@@ -1,0 +1,118 @@
+"""Display-mode + BufferStream unit tests (parity: the reference's
+plananalysis/DisplayModeTest.scala, BufferStreamTest.scala, and the
+operator-count section of PhysicalOperatorAnalyzerTest.scala).
+"""
+
+import pytest
+
+from hyperspace_tpu.plananalysis.display import (BufferStream, ConsoleMode,
+                                                 DisplayMode, HTMLMode,
+                                                 PlainTextMode, get_mode)
+
+
+class TestGetMode:
+    def test_names_resolve_case_insensitively(self):
+        assert isinstance(get_mode("plaintext"), PlainTextMode)
+        assert isinstance(get_mode("Console"), ConsoleMode)
+        assert isinstance(get_mode("HTML"), HTMLMode)
+
+    def test_instance_passes_through(self):
+        m = ConsoleMode()
+        assert get_mode(m) is m
+
+    def test_unknown_mode_raises_with_choices(self):
+        with pytest.raises(ValueError, match="console"):
+            get_mode("markdown")
+
+
+class TestPlainText:
+    def test_no_decoration(self):
+        buf = BufferStream(PlainTextMode())
+        buf.write_line("a <plan> & b", highlight=True)
+        buf.write_line("second")
+        assert buf.build() == "a <plan> & b\nsecond"
+
+
+class TestConsole:
+    def test_ansi_highlight_only_on_highlighted_lines(self):
+        buf = BufferStream(ConsoleMode())
+        buf.write_line("normal")
+        buf.write_line("hot", highlight=True)
+        out = buf.build()
+        assert "normal" in out and "\033[93mhot\033[0m" in out
+        assert not out.startswith("\033")  # first line undecorated
+
+    def test_blank_highlight_lines_not_decorated(self):
+        # Highlighting whitespace-only lines would print bare ANSI codes.
+        buf = BufferStream(ConsoleMode())
+        buf.write_line("   ", highlight=True)
+        assert "\033" not in buf.build()
+
+
+class TestHTML:
+    def test_escaping_newlines_and_wrap(self):
+        buf = BufferStream(HTMLMode())
+        buf.write_line("a <b> & c")
+        buf.write_line("hot", highlight=True)
+        out = buf.build()
+        assert out.startswith("<pre>") and out.endswith("</pre>")
+        assert "a &lt;b&gt; &amp; c" in out
+        assert "<b>hot</b>" in out
+        assert "<br>" in out
+
+    def test_escape_happens_before_highlight_tags(self):
+        # The highlight markup itself must survive escaping.
+        buf = BufferStream(HTMLMode())
+        buf.write_line("<x>", highlight=True)
+        assert buf.build() == "<pre><b>&lt;x&gt;</b></pre>"
+
+
+class TestCustomMode:
+    def test_mode_contract_is_open(self):
+        # A user-defined mode only needs the four class attributes
+        # (parity: DisplayMode.scala is a pluggable trait).
+        class Brackets(DisplayMode):
+            highlight_begin = "["
+            highlight_end = "]"
+            new_line = "|"
+
+        buf = BufferStream(Brackets())
+        buf.write_line("a")
+        buf.write_line("b", highlight=True)
+        assert buf.build() == "a|[b]"
+
+
+class TestOperatorCounts:
+    def test_physical_operator_stats_section(self, tmp_path):
+        """The explain output's operator-count diff (parity:
+        PhysicalOperatorAnalyzerTest): rewritten plans report IndexScan
+        appearing and Scan disappearing."""
+        import numpy as np
+        import pandas as pd
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+
+        import hyperspace_tpu as hst
+        from hyperspace_tpu.api import Hyperspace, IndexConfig
+        from hyperspace_tpu.index.constants import IndexConstants
+        from hyperspace_tpu.plan.expr import col
+        from hyperspace_tpu.plananalysis.explain import explain_string
+
+        d = tmp_path / "data"
+        d.mkdir()
+        rng = np.random.default_rng(4)
+        pq.write_table(pa.Table.from_pandas(pd.DataFrame({
+            "k": rng.integers(0, 30, 200).astype(np.int64),
+            "v": rng.integers(0, 9, 200).astype(np.int64),
+        })), d / "p0.parquet")
+        session = hst.Session(system_path=str(tmp_path / "idx"))
+        session.conf.set(IndexConstants.INDEX_NUM_BUCKETS, 2)
+        hs = Hyperspace(session)
+        df = session.read.parquet(str(d))
+        hs.create_index(df, IndexConfig("opIdx", ["k"], ["v"]))
+        session.enable_hyperspace()
+        q = df.filter(col("k") > 10).select("k", "v")
+        out = explain_string(session, q.plan, verbose=True)
+        assert "Physical operator stats" in out
+        assert "IndexScan: 0 -> 1" in out
+        assert "Scan: 1 -> 0" in out
