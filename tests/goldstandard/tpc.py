@@ -121,6 +121,21 @@ def _tpcds_sales_tables(rng) -> Dict[str, pa.Table]:
     }
 
 
+def _orders_nested_table(rng) -> pa.Table:
+    """Struct-typed orders analogue: nested leaves flatten to dotted names
+    (`detail.price`, `detail.ship.days`) end-to-end — the golden surface
+    for the resolver's nested-column path (ref CreateIndexNestedTest)."""
+    n = 80
+    price = np.round(rng.uniform(10, 900, n), 2)
+    days = rng.integers(1, 30, n).astype(np.int64)
+    return pa.table({
+        "no_key": pa.array(np.arange(n, dtype=np.int64)),
+        "detail": pa.array([
+            {"price": float(price[i]), "ship": {"days": int(days[i])}}
+            for i in range(n)]),
+    })
+
+
 def _web_events_table(rng) -> pa.Table:
     """Date-sorted event fact written as FOUR files (see register_tables):
     each file covers a date quarter, so per-file MinMax sketches prune —
@@ -159,6 +174,20 @@ def register_tables(session, root: str) -> Dict[str, "object"]:
             pq.write_table(we.slice(lo, hi - lo),
                            os.path.join(d, f"part{i}.parquet"))
     dfs["web_events"] = session.read.parquet(d)
+    # orders_nested: struct leaves → dotted flat columns.
+    on = _orders_nested_table(np.random.default_rng(23))
+    d = os.path.join(root, "orders_nested")
+    if not os.path.isdir(d):
+        os.makedirs(d)
+        pq.write_table(on, os.path.join(d, "part0.parquet"))
+    dfs["orders_nested"] = session.read.parquet(d)
+    # A temp view over filtered lineitem: rewrites must reach through
+    # views (ref E2E covers views; here the PLAN is the golden surface).
+    session.create_temp_view(
+        "recent_lineitem",
+        dfs["lineitem"],
+        replace=True)
+    dfs["__view__recent_lineitem"] = session.table("recent_lineitem")
     return dfs
 
 
@@ -172,6 +201,9 @@ def index_configs():
     return [
         DataSkippingIndexConfig("we_skip",
                                 [MinMaxSketch("we_event_date")]),
+        # Nested-leaf covering index (dotted flat names end-to-end).
+        IndexConfig("on_days_idx", ["detail.ship.days"],
+                    ["detail.price", "no_key"]),
         IndexConfig("li_ok_idx", ["l_orderkey"],
                     ["l_extendedprice", "l_discount", "l_shipdate"]),
         IndexConfig("od_ok_idx", ["o_orderkey"],
@@ -193,7 +225,8 @@ def index_configs():
 INDEXED_TABLES = {"li_ok_idx": "lineitem", "od_ok_idx": "orders",
                   "li_ship_idx": "lineitem", "sr_cust_idx": "store_returns",
                   "li_pk_idx": "lineitem", "ss_item_idx": "store_sales",
-                  "it_sk_idx": "item", "we_skip": "web_events"}
+                  "it_sk_idx": "item", "we_skip": "web_events",
+                  "on_days_idx": "orders_nested"}
 
 
 # ---------------------------------------------------------------------------
@@ -227,6 +260,9 @@ QUERY_NAMES = [
     "union_sales_returns", "distinct_join", "cross_fact_join",
     # Data-skipping surface (multi-file web_events + MinMax sketch).
     "skipping_date_window", "skipping_unprunable_amount",
+    # Nested-struct leaves + temp-view query shapes.
+    "nested_filter_rewrite", "nested_group_rollup",
+    "view_filter_pushdown", "view_join_orders",
 ]
 
 
@@ -762,6 +798,36 @@ def queries(dfs):
         we.filter(col("we_amount") > 450)
         .select("we_user_sk", "we_amount")
         .sort(("we_amount", False)).limit(10))
+
+    on = dfs["orders_nested"]
+    view = dfs["__view__recent_lineitem"]
+
+    # Filter on the nested indexed leaf, every referenced column covered.
+    q["nested_filter_rewrite"] = (
+        on.filter(col("detail.ship.days") < 7)
+        .select("no_key", "detail.price"))
+
+    # Group-by over the nested leaf (group-by index shape on dotted name).
+    q["nested_group_rollup"] = (
+        on.group_by("detail.ship.days")
+        .agg(avg(col("detail.price")).alias("avg_price"),
+             count(None).alias("n"))
+        .sort("detail.ship.days"))
+
+    # Rewrites reach THROUGH temp views: the view resolves to the same
+    # scan, so li_ship_idx must still fire.
+    q["view_filter_pushdown"] = (
+        view.select("l_quantity", "l_extendedprice", "l_shipdate")
+        .where(col("l_shipdate") > d(1997, 1, 1))
+        .select("l_quantity", "l_extendedprice"))
+
+    # And the join rule too (view ⋈ orders on the indexed pair).
+    q["view_join_orders"] = (
+        view.filter(col("l_shipdate") > d(1995, 3, 15))
+        .join(od, on=col("l_orderkey") == col("o_orderkey"))
+        .group_by("o_shippriority")
+        .agg(sum_(col("l_extendedprice")).alias("rev"))
+        .sort("o_shippriority"))
 
     assert sorted(q) == sorted(QUERY_NAMES), \
         f"QUERY_NAMES out of sync: {sorted(set(q) ^ set(QUERY_NAMES))}"

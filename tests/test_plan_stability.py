@@ -187,7 +187,11 @@ class TestExpectedRewrites:
               # Data skipping narrows the Scan in place (no IndexScan
               # node); the golden pins the [k/4 files] annotation instead.
               "skipping_date_window": False,
-              "skipping_unprunable_amount": False}
+              "skipping_unprunable_amount": False,
+              # Nested leaves index like flat columns; rewrites reach
+              # through temp views to the underlying scan.
+              "nested_filter_rewrite": True, "nested_group_rollup": True,
+              "view_filter_pushdown": True, "view_join_orders": True}
 
     def test_rewrite_expectations(self, harness):
         session, queries = harness
