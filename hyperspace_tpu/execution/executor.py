@@ -916,7 +916,11 @@ def _execute_aggregate(plan: Aggregate, table: Table) -> Table:
         sorted_keys = [c.data for c in key_cols]
         GROUPBY_SORT_SKIPPED += 1
     elif bo is not None and set(bo[1]) < set(plan.group_cols) \
-            and keys_non_null:
+            and keys_non_null \
+            and not any(isinstance(_unwrap_agg(a), E.CountDistinct)
+                        for a in plan.aggs):
+        # (CountDistinct is excluded: distinct counts of run partials
+        # cannot be combined — the full-sort path below handles it.)
         # Bucket keys are a strict SUBSET of the grouping keys (e.g. Q3:
         # join output ordered by l_orderkey, grouped by (l_orderkey,
         # o_orderdate, o_shippriority)): equal group tuples need not be
@@ -1076,8 +1080,39 @@ def _sum_out_dtype(sums) -> str:
     return FLOAT64 if jnp.issubdtype(sums.dtype, jnp.floating) else INT64
 
 
+def _count_distinct(child: Column, gids, num_groups: int) -> Column:
+    """COUNT(DISTINCT value) per group: sort rows by (group, value), flag
+    each (group, value) pair's first occurrence, segment-sum the flags.
+    NULL values are excluded (SQL semantics) by parking their rows in a
+    sentinel segment past the real groups."""
+    n = child.data.shape[0]
+    if n == 0:
+        return Column(INT64, jnp.zeros(num_groups, jnp.int64))
+    data = child.data.astype(jnp.int32) if child.dtype == BOOL else child.data
+    gid_key = gids if child.validity is None else \
+        jnp.where(child.validity, gids, num_groups)
+    perm = kernels.lex_sort_indices([gid_key, data])
+    sg = jnp.take(gid_key, perm)
+    sv = jnp.take(data, perm)
+    first = kernels.change_mask([sg, sv]).at[0].set(True)
+    if jnp.issubdtype(sv.dtype, jnp.floating):
+        # NaN != NaN would count every NaN separately; the sort places a
+        # group's NaNs adjacent, so un-flag NaN-after-NaN pairs (Spark
+        # semantics: NaN is ONE distinct value).
+        nan_pair = jnp.concatenate([
+            jnp.zeros(1, jnp.bool_),
+            jnp.isnan(sv[1:]) & jnp.isnan(sv[:-1]) & (sg[1:] == sg[:-1])])
+        first = first & ~nan_pair
+    counts = kernels.segment_sum(first.astype(jnp.int64), sg,
+                                 num_groups + 1)[:num_groups]
+    return Column(INT64, counts)
+
+
 def _eval_agg(agg: E.Expr, sorted_table: Table, gids, num_groups: int) -> Column:
     agg = _unwrap_agg(agg)
+    if isinstance(agg, E.CountDistinct):
+        return _count_distinct(eval_expr(sorted_table, agg.child),
+                               gids, num_groups)
     if isinstance(agg, E.Count):
         if agg.child is None:
             data = kernels.segment_count(gids, num_groups)

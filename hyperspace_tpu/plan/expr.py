@@ -288,6 +288,19 @@ class Avg(AggExpr):
     agg_name = "Avg"
 
 
+class CountDistinct(AggExpr):
+    """COUNT(DISTINCT child). Deliberately NOT a Count subclass: distinct
+    counts are not decomposable (run partials cannot combine), so the
+    two-phase and SPMD paths must not treat it as a plain count."""
+
+    agg_name = "CountDistinct"
+
+    def __init__(self, child: Expr):
+        if child is None:
+            raise ValueError("count_distinct requires a column expression")
+        super().__init__(child)
+
+
 # Public helpers (the pyspark-like functions module).
 
 def col(name: str) -> Col:
@@ -316,6 +329,13 @@ def max_(e) -> Max:
 
 def avg(e) -> Avg:
     return Avg(_wrap(e) if not isinstance(e, Expr) else e)
+
+
+def count_distinct(e) -> CountDistinct:
+    if e is None:
+        # count(None) means COUNT(*); the distinct analogue has no meaning.
+        raise ValueError("count_distinct requires a column expression")
+    return CountDistinct(_wrap(e) if not isinstance(e, Expr) else e)
 
 
 # ---------------------------------------------------------------------------

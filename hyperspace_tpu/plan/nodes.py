@@ -43,7 +43,7 @@ def infer_dtype(e: E.Expr, schema: Schema) -> str:
         return FLOAT64 if (FLOAT64 in kinds or "float32" in kinds) else INT64
     if isinstance(e, E.Divide):
         return FLOAT64
-    if isinstance(e, E.Count):
+    if isinstance(e, (E.Count, E.CountDistinct)):
         return INT64
     if isinstance(e, E.Avg):
         return FLOAT64

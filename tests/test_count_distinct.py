@@ -1,0 +1,178 @@
+"""COUNT(DISTINCT col): grouped + global, null exclusion, strings/dates,
+and the paths that must refuse it (two-phase run combination, SPMD).
+
+The reference gets countDistinct from Spark SQL; this engine implements it
+as sort-by-(group, value) + first-occurrence flags + segment sum
+(executor._count_distinct).
+"""
+
+import datetime
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+import hyperspace_tpu as hst
+from hyperspace_tpu.api import Hyperspace, IndexConfig
+from hyperspace_tpu.execution import executor
+from hyperspace_tpu.index.constants import IndexConstants
+from hyperspace_tpu.plan.expr import col, count, count_distinct, sum_
+
+
+@pytest.fixture()
+def env(tmp_path):
+    rng = np.random.default_rng(33)
+    n = 2000
+    d = tmp_path / "data"
+    d.mkdir()
+    vals = rng.integers(0, 25, n).astype(np.int64)
+    null_mask = rng.random(n) < 0.1
+    pq.write_table(pa.table({
+        "g": pa.array(rng.integers(0, 8, n).astype(np.int64)),
+        "v": pa.array(np.where(null_mask, 0, vals), type=pa.int64(),
+                      mask=null_mask),
+        "s": pa.array(rng.choice(["x", "y", "z", "w"], n)),
+        "dt": pa.array(rng.integers(8000, 8020, n).astype(np.int32),
+                       type=pa.int32()).cast(pa.date32()),
+    }), d / "p0.parquet")
+    session = hst.Session(system_path=str(tmp_path / "idx"))
+    session.conf.set(IndexConstants.INDEX_NUM_BUCKETS, 4)
+    return session, str(d)
+
+
+def oracle(pdf, group, valcol):
+    return (pdf.groupby(group)[valcol].nunique()
+            .rename("nd").reset_index().sort_values(group)
+            .reset_index(drop=True))
+
+
+class TestCountDistinct:
+    def test_grouped_int_with_nulls(self, env):
+        session, d = env
+        df = session.read.parquet(d)
+        got = (df.group_by("g").agg(count_distinct(col("v")).alias("nd"))
+               .sort("g").to_pandas())
+        # pandas nunique skips NaN — same SQL semantics.
+        expect = oracle(df.to_pandas(), "g", "v")
+        pd.testing.assert_frame_equal(got, expect, check_dtype=False)
+
+    def test_grouped_string(self, env):
+        session, d = env
+        df = session.read.parquet(d)
+        got = (df.group_by("g").agg(count_distinct(col("s")).alias("nd"))
+               .sort("g").to_pandas())
+        expect = oracle(df.to_pandas(), "g", "s")
+        pd.testing.assert_frame_equal(got, expect, check_dtype=False)
+
+    def test_grouped_date(self, env):
+        session, d = env
+        df = session.read.parquet(d)
+        got = (df.group_by("g").agg(count_distinct(col("dt")).alias("nd"))
+               .sort("g").to_pandas())
+        expect = oracle(df.to_pandas(), "g", "dt")
+        pd.testing.assert_frame_equal(got, expect, check_dtype=False)
+
+    def test_global_count_distinct(self, env):
+        session, d = env
+        df = session.read.parquet(d)
+        t = df.agg(count_distinct(col("v")).alias("nd"),
+                   count_distinct(col("s")).alias("ns")).to_arrow()
+        pdf = df.to_pandas()
+        assert t.column("nd").to_pylist() == [pdf["v"].nunique()]
+        assert t.column("ns").to_pylist() == [pdf["s"].nunique()]
+
+    def test_mixed_with_other_aggs(self, env):
+        session, d = env
+        df = session.read.parquet(d)
+        got = (df.group_by("g")
+               .agg(count_distinct(col("v")).alias("nd"),
+                    count(col("v")).alias("c"),
+                    sum_(col("g")).alias("sg"))
+               .sort("g").to_pandas())
+        pdf = df.to_pandas()
+        base = pdf.groupby("g").agg(
+            nd=("v", "nunique"), c=("v", "count"),
+            sg=("g", "sum")).reset_index().sort_values("g") \
+            .reset_index(drop=True)
+        pd.testing.assert_frame_equal(got, base, check_dtype=False)
+
+    def test_all_null_group_counts_zero(self, env, tmp_path):
+        session, _ = env
+        d2 = tmp_path / "nulls"
+        d2.mkdir()
+        pq.write_table(pa.table({
+            "g": pa.array([1, 1, 2], type=pa.int64()),
+            "v": pa.array([None, None, 5], type=pa.int64()),
+        }), d2 / "p0.parquet")
+        df = session.read.parquet(str(d2))
+        t = (df.group_by("g").agg(count_distinct(col("v")).alias("nd"))
+             .sort("g").to_arrow())
+        assert t.column("nd").to_pylist() == [0, 1]
+
+    def test_count_distinct_requires_child(self):
+        with pytest.raises(ValueError, match="requires a column"):
+            from hyperspace_tpu.plan.expr import CountDistinct
+            CountDistinct(None)
+
+
+class TestPathSelection:
+    def test_two_phase_path_excluded(self, env, tmp_path):
+        """Grouping a bucket-ordered table by a superset of its bucket keys
+        normally takes the two-phase run path; CountDistinct must force
+        the full-sort path (run partials cannot combine) and still agree
+        with the oracle."""
+        session, d = env
+        hs = Hyperspace(session)
+        df = session.read.parquet(d)
+        hs.create_index(df, IndexConfig("gIdx", ["g"], ["v", "s"]))
+        session.enable_hyperspace()
+        before = executor.GROUPBY_TWO_PHASE
+        q = (df.filter(col("g") >= 0)
+             .group_by("g", "s")
+             .agg(count_distinct(col("v")).alias("nd")))
+        got = q.to_pandas().sort_values(["g", "s"]).reset_index(drop=True)
+        assert executor.GROUPBY_TWO_PHASE == before  # path refused
+        pdf = df.to_pandas()
+        expect = (pdf.groupby(["g", "s"])["v"].nunique().rename("nd")
+                  .reset_index().sort_values(["g", "s"])
+                  .reset_index(drop=True))
+        pd.testing.assert_frame_equal(got, expect, check_dtype=False)
+
+    def test_spmd_falls_back(self, env):
+        """Distinct counts are not decomposable — SPMD must NOT dispatch
+        (CountDistinct is deliberately not a Count subclass)."""
+        from hyperspace_tpu.execution import spmd
+        session, d = env
+        df = session.read.parquet(d)
+        before = spmd.DISPATCH_COUNT
+        got = (df.group_by("g").agg(count_distinct(col("v")).alias("nd"))
+               .sort("g").to_pandas())
+        assert spmd.DISPATCH_COUNT == before
+        expect = oracle(df.to_pandas(), "g", "v")
+        pd.testing.assert_frame_equal(got, expect, check_dtype=False)
+
+
+class TestFloatAndNaN:
+    def test_nan_counts_as_one_distinct(self, env, tmp_path):
+        """0/0 through Divide yields NaN (validity stays true); Spark
+        counts NaN as ONE distinct value per group."""
+        session, _ = env
+        d2 = tmp_path / "floats"
+        d2.mkdir()
+        pq.write_table(pa.table({
+            "g": pa.array([1, 1, 1, 2, 2], type=pa.int64()),
+            "num": pa.array([0.0, 0.0, 2.0, 0.0, 3.0], type=pa.float64()),
+            "den": pa.array([0.0, 0.0, 1.0, 0.0, 1.0], type=pa.float64()),
+        }), d2 / "p0.parquet")
+        df = session.read.parquet(str(d2))
+        t = (df.with_column("q", col("num") / col("den"))
+             .group_by("g").agg(count_distinct(col("q")).alias("nd"))
+             .sort("g").to_arrow())
+        # g=1: {NaN, NaN, 2.0} -> 2;  g=2: {NaN, 3.0} -> 2.
+        assert t.column("nd").to_pylist() == [2, 2]
+
+    def test_public_helper_rejects_none(self):
+        with pytest.raises(ValueError, match="column expression"):
+            count_distinct(None)
