@@ -263,6 +263,8 @@ QUERY_NAMES = [
     # Nested-struct leaves + temp-view query shapes.
     "nested_filter_rewrite", "nested_group_rollup",
     "view_filter_pushdown", "view_join_orders",
+    # COUNT(DISTINCT) — the real TPC-H Q16 aggregate.
+    "tpch_q16_distinct",
 ]
 
 
@@ -828,6 +830,17 @@ def queries(dfs):
         .group_by("o_shippriority")
         .agg(sum_(col("l_extendedprice")).alias("rev"))
         .sort("o_shippriority"))
+
+    # TPC-H Q16 with its true aggregate: distinct suppliers per
+    # (brand, container) — here distinct orders per (brand, container)
+    # since the schema has no supplier axis.
+    from hyperspace_tpu.plan.expr import count_distinct
+    q["tpch_q16_distinct"] = (
+        li.join(pt.filter(~col("p_brand").isin(["Brand#45"])),
+                on=col("l_partkey") == col("p_partkey"))
+        .group_by("p_brand", "p_container")
+        .agg(count_distinct(col("l_orderkey")).alias("supplier_cnt"))
+        .sort(("supplier_cnt", False), "p_brand", "p_container"))
 
     assert sorted(q) == sorted(QUERY_NAMES), \
         f"QUERY_NAMES out of sync: {sorted(set(q) ^ set(QUERY_NAMES))}"
