@@ -21,7 +21,8 @@ import hyperspace_tpu as hst
 from hyperspace_tpu.api import Hyperspace, IndexConfig
 from hyperspace_tpu.index.constants import IndexConstants
 from hyperspace_tpu.plan import expr as E
-from hyperspace_tpu.plan.expr import avg, col, count, max_, min_, sum_
+from hyperspace_tpu.plan.expr import (avg, col, count,
+                                      count_distinct, max_, min_, sum_)
 
 _EPOCH = datetime.date(1970, 1, 1)
 
@@ -158,6 +159,8 @@ def _random_query(rng, t, schema):
                 else:
                     aggs.append(min_(col(v)).alias("lo"))
                     aggs.append(max_(col(v)).alias("hi"))
+                if rng.random() < 0.3:
+                    aggs.append(count_distinct(col(v)).alias("nd"))
             q = q.group_by(g).agg(*aggs)
     if rng.random() < 0.4:
         sch = q.plan.schema
