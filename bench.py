@@ -157,6 +157,10 @@ def make_tpch_like(root: str, scale: float, seed: int = 0):
         "o_custkey": pa.array(rng.integers(0, max(n_od // 10, 1), n_od).astype(np.int64)),
         "o_orderdate": pa.array(o_orderdate, type=pa.int32()).cast(pa.date32()),
         "o_shippriority": pa.array(np.zeros(n_od, dtype=np.int32)),
+        # Deliberately NOT carried by od_idx: bloom-skipping queries that
+        # select it cannot be answered by the covering index, so the
+        # DataSkippingIndexRule (not the covering rewrite) is what fires.
+        "o_totalprice": pa.array(np.round(rng.uniform(1000, 400000, n_od), 2)),
     })
     n_parts = 4
     step = n_od // OD_PARTS
@@ -249,6 +253,45 @@ def build_skipping_query(session, od_dir: str):
     return od.filter(col("o_orderdate").between(
         datetime.date(1994, 6, 1), datetime.date(1994, 7, 31))) \
         .select("o_orderkey", "o_custkey")
+
+
+def build_bloom_query(session, od_dir: str, n_od: int):
+    """BASELINE config #4: point lookups on the high-cardinality
+    o_orderkey — the Bloom sketch refutes the files that cannot contain
+    each key (orders are written key-contiguous, so ~1 of 16 survives)."""
+    from hyperspace_tpu.plan.expr import col
+
+    od = session.read.parquet(od_dir)
+    return od.filter(col("o_orderkey").isin(
+        [n_od // 5, n_od // 2, (4 * n_od) // 5])) \
+        .select("o_orderkey", "o_totalprice")
+
+
+def append_lineitem_files(li_dir: str, n_li: int, seed: int = 99) -> int:
+    """BASELINE config #5 prep: append ~5% new rows as fresh part files
+    (inside the 0.3 Hybrid Scan appended-bytes ratio)."""
+    import numpy as np
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    rng = np.random.default_rng(seed)
+    n_new = max(n_li // 20, 1000)
+    base = (datetime.date(1992, 1, 1) - datetime.date(1970, 1, 1)).days
+    t = pa.table({
+        "l_orderkey": pa.array(rng.integers(0, max(n_li // 4, 1), n_new)
+                               .astype("int64")),
+        "l_partkey": pa.array(rng.integers(0, max(n_li // 30, 200), n_new)
+                              .astype("int64")),
+        "l_quantity": pa.array(rng.integers(1, 51, n_new).astype("int64")),
+        "l_extendedprice": pa.array(
+            (rng.uniform(900, 105000, n_new)).round(2)),
+        "l_discount": pa.array((rng.uniform(0, 0.1, n_new)).round(2)),
+        "l_shipdate": pa.array((rng.integers(0, 2520, n_new) + base)
+                               .astype("int32"), type=pa.int32())
+        .cast(pa.date32()),
+    })
+    pq.write_table(t, os.path.join(li_dir, "part-appended.parquet"))
+    return n_new
 
 
 def timed_best(fn, repeats: int) -> float:
@@ -541,9 +584,11 @@ def _single_device_phases(args, root):
         raise _SkipToMesh()
 
     with _phase("aux_indexes"):
-        # Q17 covering indexes + the data-skipping index on the
-        # time-ordered orders (BASELINE configs #3-#4).
-        from hyperspace_tpu.api import (DataSkippingIndexConfig,
+        # Q17 covering indexes + the data-skipping indexes on orders
+        # (BASELINE configs #3-#4: MinMax on the time-ordered o_orderdate,
+        # Bloom on the high-cardinality o_orderkey).
+        from hyperspace_tpu.api import (BloomFilterSketch,
+                                        DataSkippingIndexConfig,
                                         MinMaxSketch)
         pt = session.read.parquet(pt_dir)
         hs.create_index(pt, IndexConfig(
@@ -552,6 +597,8 @@ def _single_device_phases(args, root):
             "li_pk_idx", ["l_partkey"], ["l_quantity", "l_extendedprice"]))
         hs.create_index(od, DataSkippingIndexConfig(
             "od_skip", [MinMaxSketch("o_orderdate")]))
+        hs.create_index(od, DataSkippingIndexConfig(
+            "od_bloom", [BloomFilterSketch("o_orderkey")]))
 
     queries = {}
     with _phase("plan_queries"):
@@ -559,6 +606,7 @@ def _single_device_phases(args, root):
         queries["q3"] = build_q3(session, li_dir, od_dir)
         queries["q17"] = build_q17(session, li_dir, pt_dir)
         queries["skipping"] = build_skipping_query(session, od_dir)
+        queries["bloom"] = build_bloom_query(session, od_dir, n_od)
 
     rewrite_ok = {}
     with _phase("rewrite_checks"):
@@ -573,16 +621,25 @@ def _single_device_phases(args, root):
             if not rewrite_ok[name]:
                 RESULT["errors"].append(
                     f"{name} was not rewritten to use an index")
-        sq = queries.get("skipping")
-        if sq is not None:
-            skip_leaves = sq.optimized_plan().collect_leaves()
+        for name, label in (("skipping", "data-skipping"),
+                            ("bloom", "bloom-skipping")):
+            sq = queries.get(name)
+            if sq is None:
+                continue
+            skip_leaves = [l for l in sq.optimized_plan().collect_leaves()
+                           if hasattr(l, "relation")]
+            if not skip_leaves:
+                RESULT["errors"].append(
+                    f"{label} query was covering-rewritten, not skipped")
+                rewrite_ok[name] = False
+                continue
             skip_kept = min(
                 len(l.relation.all_files()) for l in skip_leaves)
-            RESULT["skipping_files_kept"] = skip_kept
-            RESULT["skipping_files_total"] = OD_PARTS
-            rewrite_ok["skipping"] = skip_kept < OD_PARTS
-            if not rewrite_ok["skipping"]:
-                RESULT["errors"].append("data-skipping pruned nothing")
+            RESULT[f"{name}_files_kept"] = skip_kept
+            RESULT[f"{name}_files_total"] = OD_PARTS
+            rewrite_ok[name] = skip_kept < OD_PARTS
+            if not rewrite_ok[name]:
+                RESULT["errors"].append(f"{label} pruned nothing")
         session.disable_hyperspace()
 
     # ---- timed runs (per query: warm both paths, then time both) ----
@@ -590,7 +647,7 @@ def _single_device_phases(args, root):
     # match-expansion / multi-operand sorts) that have twice crashed the
     # tunnel's remote-compile service; running filter+skipping first
     # banks those numbers before the risky compiles start.
-    timing_order = ["filter", "skipping", "q17", "q3"]
+    timing_order = ["filter", "skipping", "bloom", "q17", "q3"]
     for name in timing_order + [n for n in queries if n not in timing_order]:
         q = queries.get(name)
         if q is None or not rewrite_ok.get(name, False):
@@ -621,6 +678,47 @@ def _single_device_phases(args, root):
             else:
                 RESULT[f"{name}_speedup"] = round(sp, 3)
 
+    # ---- BASELINE config #5: Hybrid Scan over appended source files ----
+    # Runs LAST: the appends invalidate plain signatures, so every other
+    # query pair must be timed first.
+    if not _backend_dead():
+        from hyperspace_tpu.execution import executor as _exec
+
+        hybrid_ok = False  # _phase swallows failures; unbound would crash
+        with _phase("hybrid_prep"):
+            n_new = append_lineitem_files(li_dir, n_li)
+            RESULT["hybrid_appended_rows"] = n_new
+            session.conf.set(
+                IndexConstants.INDEX_HYBRID_SCAN_ENABLED, "true")
+            hybrid_q = build_filter_query(session, li_dir)
+            session.enable_hyperspace()
+            hybrid_ok = any(
+                "IndexScan" in l.simple_string()
+                for l in hybrid_q.optimized_plan().collect_leaves())
+            session.disable_hyperspace()
+            if not hybrid_ok:
+                RESULT["errors"].append(
+                    "hybrid scan did not keep the index after appends")
+        if hybrid_ok and not _backend_dead():
+            with _phase("time_hybrid"):
+                merges_before = _exec.HYBRID_MERGE_COUNT
+                session.enable_hyperspace()
+                hybrid_q.to_arrow()
+                session.disable_hyperspace()
+                hybrid_q.to_arrow()
+                scan_s = timed_best(lambda: hybrid_q.to_arrow(),
+                                    args.repeats)
+                session.enable_hyperspace()
+                idx_s = timed_best(lambda: hybrid_q.to_arrow(),
+                                   args.repeats)
+                session.disable_hyperspace()
+                RESULT["hybrid_scan_s"] = round(scan_s, 4)
+                RESULT["hybrid_indexed_s"] = round(idx_s, 4)
+                RESULT["hybrid_speedup"] = round(
+                    scan_s / idx_s if idx_s > 0 else float("inf"), 3)
+                RESULT["hybrid_merge_preserved_order"] = \
+                    _exec.HYBRID_MERGE_COUNT > merges_before
+        session.conf.set(IndexConstants.INDEX_HYBRID_SCAN_ENABLED, "false")
 
 
 def main():
@@ -628,7 +726,7 @@ def main():
     # Default 0.5 (3M lineitem rows): at 0.2 the on-chip query pairs were
     # still tunnel-round-trip-bound (filter scan 0.39 s vs indexed 0.35 s —
     # fixed per-query latency swamps the bytes saved); 0.5 gives each round
-    # trip 2.5x the compute while keeping the full run (probe + builds + 4
+    # trip 2.5x the compute while keeping the full run (probe + builds + 6
     # query pairs + mesh phase) well inside the 3300 s child watchdog on
     # both backends (compile time, the cold-run majority, is
     # scale-independent).
