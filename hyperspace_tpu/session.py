@@ -388,6 +388,7 @@ class DataFrameWriter:
         self._df = df
         self._mode = "error"
         self._bucket = None  # (num_buckets, [cols]) once bucket_by is set
+        self._partition = None  # [cols] once partition_by is set
 
     def mode(self, mode: str) -> "DataFrameWriter":
         if mode not in ("error", "overwrite", "append"):
@@ -407,10 +408,39 @@ class DataFrameWriter:
             raise HyperspaceException(
                 f"bucket_by columns not in the result: {missing}; "
                 f"available: {self._df.plan.schema.names}")
+        if self._partition is not None:
+            raise HyperspaceException(
+                "bucket_by and partition_by cannot be combined")
         self._bucket = (num_buckets, list(cols))
         return self
 
     bucketBy = bucket_by
+
+    def partition_by(self, *cols: str) -> "DataFrameWriter":
+        """Hive-partitioned layout (`col=value/` directories) — pairs with
+        the reader's partition discovery/pruning (sources/partitions.py)."""
+        if not cols:
+            raise HyperspaceException(
+                "partition_by needs at least one partition column")
+        names = self._df.plan.schema.names
+        missing = [c for c in cols if c not in names]
+        if missing:
+            raise HyperspaceException(
+                f"partition_by columns not in the result: {missing}; "
+                f"available: {names}")
+        if len(set(cols)) != len(cols):
+            raise HyperspaceException(
+                f"partition_by columns repeat: {list(cols)}")
+        if len(set(cols)) == len(names):
+            raise HyperspaceException(
+                "partition_by cannot consume every output column")
+        if self._bucket is not None:
+            raise HyperspaceException(
+                "bucket_by and partition_by cannot be combined")
+        self._partition = list(cols)
+        return self
+
+    partitionBy = partition_by
 
     # Write protocol, in this order for every format:
     #   1. _check: cheap destination validation BEFORE the query runs
@@ -452,14 +482,41 @@ class DataFrameWriter:
         if self._bucket is not None:
             self._bucketed_parquet(path)
             return
-        if self._mode == "append" and \
-                os.path.isfile(os.path.join(path, self.BUCKET_SPEC_FILE)):
-            raise HyperspaceException(
-                f"{path} holds a bucketed dataset; appending unbucketed "
-                "rows would break its layout. Use "
-                "bucket_by(<same spec>) or mode('overwrite').")
+        if self._partition is not None:
+            self._guard_bucketed_dir(path)
+            self._partitioned_parquet(path)
+            return
+        self._guard_bucketed_dir(path)
         table = self._df.execute().to_host()
         write_parquet(table, self._finalize(path) + ".parquet")
+
+    def _partitioned_parquet(self, path: str) -> None:
+        import uuid
+
+        import pyarrow as pa
+        import pyarrow.dataset as pa_ds
+
+        at = self._df.to_arrow()  # materialize BEFORE destination prep
+        part_schema = pa.schema([at.schema.field(c)
+                                 for c in self._partition])
+        self._prepare_dir(path)
+        if at.num_rows == 0:
+            # pa_ds.write_dataset emits NOTHING for 0 rows, leaving an
+            # unreadable dir; a full-schema 0-row file keeps read-back
+            # working (the bucketed writer does the same).
+            import pyarrow.parquet as _pq
+            if not any(f.endswith(".parquet")
+                       for f in os.listdir(path)):
+                _pq.write_table(
+                    at, os.path.join(
+                        path, f"part-{uuid.uuid4().hex[:12]}.parquet"))
+            return
+        pa_ds.write_dataset(
+            at, path, format="parquet",
+            partitioning=pa_ds.partitioning(part_schema, flavor="hive"),
+            basename_template=(
+                f"part-{uuid.uuid4().hex[:12]}-{{i}}.parquet"),
+            existing_data_behavior="overwrite_or_ignore")
 
     def _bucketed_parquet(self, path: str) -> None:
         import json
@@ -526,6 +583,19 @@ class DataFrameWriter:
         if self._bucket is not None:
             raise HyperspaceException(
                 f"bucket_by is only supported for parquet output, not {fmt}")
+        if self._partition is not None:
+            raise HyperspaceException(
+                f"partition_by is only supported for parquet output, "
+                f"not {fmt}")
+
+    def _guard_bucketed_dir(self, path: str) -> None:
+        """Non-bucketed writes must not land inside a bucketed dataset."""
+        if self._mode == "append" and \
+                os.path.isfile(os.path.join(path, self.BUCKET_SPEC_FILE)):
+            raise HyperspaceException(
+                f"{path} holds a bucketed dataset; appending "
+                "non-bucketed rows would break its layout. Use "
+                "bucket_by(<same spec>) or mode('overwrite').")
 
     def json(self, path: str) -> None:
         self._check(path)

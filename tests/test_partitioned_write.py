@@ -1,0 +1,133 @@
+"""Hive-partitioned writes: df.write.partition_by(cols).parquet(path).
+
+The write side of the partitioned-data support (VERDICT r2 #6 covered
+reads; this closes the loop): output lands in `col=value/` directories,
+reads back with the partition columns restored, and partition pruning
+fires on the written layout.
+"""
+
+import os
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+import hyperspace_tpu as hst
+from hyperspace_tpu.exceptions import HyperspaceException
+from hyperspace_tpu.plan.expr import col
+
+
+@pytest.fixture()
+def env(tmp_path):
+    rng = np.random.default_rng(41)
+    n = 1200
+    d = tmp_path / "src"
+    d.mkdir()
+    pq.write_table(pa.Table.from_pandas(pd.DataFrame({
+        "region": rng.choice(["emea", "apac", "amer"], n),
+        "year": rng.choice([2022, 2023], n).astype(np.int64),
+        "amount": rng.integers(0, 500, n).astype(np.int64),
+    })), d / "p0.parquet")
+    session = hst.Session(system_path=str(tmp_path / "idx"))
+    return session, str(d), tmp_path
+
+
+class TestPartitionedWrite:
+    def test_hive_layout_and_roundtrip(self, env):
+        session, src, tmp = env
+        df = session.read.parquet(src)
+        out = str(tmp / "out1")
+        df.write.partition_by("region").parquet(out)
+        subdirs = sorted(x for x in os.listdir(out)
+                         if os.path.isdir(os.path.join(out, x)))
+        assert subdirs == ["region=amer", "region=apac", "region=emea"]
+        back = session.read.parquet(out)
+        # Partition column restored by the reader's discovery.
+        assert sorted(back.columns) == ["amount", "region", "year"]
+        key = ["region", "year", "amount"]
+        a = back.to_pandas().sort_values(key).reset_index(drop=True)[key]
+        b = df.to_pandas().sort_values(key).reset_index(drop=True)[key]
+        pd.testing.assert_frame_equal(a, b)
+
+    def test_two_level_partitioning(self, env):
+        session, src, tmp = env
+        df = session.read.parquet(src)
+        out = str(tmp / "out2")
+        df.write.partition_by("region", "year").parquet(out)
+        assert os.path.isdir(os.path.join(out, "region=emea", "year=2022"))
+        back = session.read.parquet(out)
+        assert back.count() == 1200
+
+    def test_partition_pruning_on_written_layout(self, env):
+        session, src, tmp = env
+        df = session.read.parquet(src)
+        out = str(tmp / "out3")
+        df.write.partition_by("region").parquet(out)
+        back = session.read.parquet(out)
+        q = back.filter(col("region") == "apac")
+        leaves = q.optimized_plan().collect_leaves()
+        files = leaves[0].relation.all_files()
+        # Planning-time pruning: only the apac partition's files remain.
+        assert files and all("region=apac" in f for f in files)
+        assert q.count() == int(
+            (df.to_pandas()["region"] == "apac").sum())
+
+    def test_modes(self, env):
+        session, src, tmp = env
+        df = session.read.parquet(src)
+        out = str(tmp / "out4")
+        df.write.partition_by("region").parquet(out)
+        with pytest.raises(HyperspaceException, match="not empty"):
+            df.write.partition_by("region").parquet(out)
+        df.write.mode("append").partition_by("region").parquet(out)
+        assert session.read.parquet(out).count() == 2400
+        df.write.mode("overwrite").partition_by("region").parquet(out)
+        assert session.read.parquet(out).count() == 1200
+
+    def test_validation(self, env):
+        session, src, tmp = env
+        df = session.read.parquet(src)
+        with pytest.raises(HyperspaceException, match="at least one"):
+            df.write.partition_by()
+        with pytest.raises(HyperspaceException, match="not in the result"):
+            df.write.partition_by("ghost")
+        with pytest.raises(HyperspaceException, match="every output"):
+            df.write.partition_by("region", "year", "amount")
+        with pytest.raises(HyperspaceException, match="cannot be combined"):
+            df.write.partition_by("region").bucket_by(3, "amount")
+        with pytest.raises(HyperspaceException, match="cannot be combined"):
+            df.write.bucket_by(3, "amount").partition_by("region")
+
+    def test_partition_by_rejected_for_non_parquet(self, env):
+        session, src, tmp = env
+        df = session.read.parquet(src)
+        for fmt in ("csv", "json", "avro"):
+            with pytest.raises(HyperspaceException, match="only supported"):
+                getattr(df.write.partition_by("region"), fmt)(
+                    str(tmp / f"o_{fmt}"))
+
+    def test_partitioned_append_into_bucketed_dir_rejected(self, env):
+        session, src, tmp = env
+        df = session.read.parquet(src)
+        out = str(tmp / "out5")
+        df.write.bucket_by(3, "amount").parquet(out)
+        with pytest.raises(HyperspaceException, match="bucketed dataset"):
+            df.write.mode("append").partition_by("region").parquet(out)
+
+    def test_empty_result_keeps_schema(self, env):
+        session, src, tmp = env
+        df = session.read.parquet(src)
+        out = str(tmp / "out6")
+        df.filter(col("amount") > 10_000).write.partition_by(
+            "region").parquet(out)
+        back = session.read.parquet(out)
+        assert back.count() == 0
+        assert sorted(back.columns) == ["amount", "region", "year"]
+
+    def test_duplicate_partition_columns_rejected(self, env):
+        session, src, tmp = env
+        df = session.read.parquet(src)
+        with pytest.raises(HyperspaceException, match="repeat"):
+            df.write.partition_by("region", "region")
