@@ -79,3 +79,13 @@ class TestExplain:
     def test_unknown_mode_raises(self, env):
         with pytest.raises(Exception):
             env["hs"].explain(env["q"], mode="nope")
+
+
+class TestRedirect:
+    def test_redirect_func_receives_full_text(self, env):
+        """Parity: the reference's explain(df, redirectFunc) streams the
+        rendered output to a caller-supplied sink."""
+        captured = []
+        out = env["hs"].explain(env["q"], verbose=True,
+                                redirect_func=captured.append)
+        assert captured and captured[0] == out

@@ -214,3 +214,26 @@ class TestHybridMergePositions:
         merged[pos_b] = b
         assert np.array_equal(merged, np.sort(np.concatenate([a, b]),
                                               kind="stable"))
+
+
+class TestMergeJoinProperty:
+    def test_random_joins_match_naive(self):
+        """Property: merge_join_indices over random multisets equals the
+        naive nested-loop pairing, across sizes incl. empty and skew."""
+        import numpy as np
+        import jax.numpy as jnp
+        from hyperspace_tpu.ops import kernels
+
+        for seed in (0, 1, 2, 3):
+            rng = np.random.default_rng(seed)
+            n_l = int(rng.integers(0, 300))
+            n_r = int(rng.integers(0, 300))
+            left = rng.integers(-20, 20, n_l).astype(np.int64)
+            right = np.sort(rng.integers(-20, 20, n_r).astype(np.int64))
+            li, ri = kernels.merge_join_indices(
+                jnp.asarray(left), jnp.asarray(right))
+            got = sorted(zip(np.asarray(li).tolist(),
+                             np.asarray(ri).tolist()))
+            naive = sorted((i, j) for i in range(n_l) for j in range(n_r)
+                           if left[i] == right[j])
+            assert got == naive, f"seed {seed}"
