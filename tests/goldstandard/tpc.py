@@ -265,6 +265,10 @@ QUERY_NAMES = [
     "view_filter_pushdown", "view_join_orders",
     # COUNT(DISTINCT) — the real TPC-H Q16 aggregate.
     "tpch_q16_distinct",
+    # Edge shapes: 3-way union, limit 0, always-true literal predicate,
+    # two-level distinct composition.
+    "union_three_way", "limit_zero",
+    "literal_true_filter", "count_distinct_two_level",
 ]
 
 
@@ -841,6 +845,36 @@ def queries(dfs):
         .group_by("p_brand", "p_container")
         .agg(count_distinct(col("l_orderkey")).alias("supplier_cnt"))
         .sort(("supplier_cnt", False), "p_brand", "p_container"))
+
+    # Three-way union of disjoint ranges, re-aggregated.
+    q["union_three_way"] = (
+        li.filter(col("l_shipdate") < d(1993, 6, 1)).select("l_orderkey")
+        .union(li.filter(col("l_shipdate").between(d(1994, 1, 1),
+                                                   d(1994, 6, 1)))
+               .select("l_orderkey"))
+        .union(li.filter(col("l_shipdate") > d(1997, 6, 1))
+               .select("l_orderkey"))
+        .group_by("l_orderkey").agg(count(None).alias("n"))
+        .sort("l_orderkey").limit(20))
+
+    # limit(0): schema survives, zero rows.
+    q["limit_zero"] = (
+        od.select("o_orderkey", "o_totalprice").sort("o_orderkey").limit(0))
+
+    # Always-true literal predicate: must not break rewrites or pruning.
+    q["literal_true_filter"] = (
+        li.filter((col("l_quantity") >= 1)
+                  & (col("l_shipdate") > d(1996, 1, 1)))
+        .select("l_quantity", "l_extendedprice", "l_shipdate"))
+
+    # count_distinct feeding a second-level aggregate.
+    from hyperspace_tpu.plan.expr import count_distinct as _cd
+    q["count_distinct_two_level"] = (
+        li.group_by("l_returnflag", "l_linestatus")
+        .agg(_cd(col("l_orderkey")).alias("nd"))
+        .group_by("l_returnflag")
+        .agg(sum_(col("nd")).alias("total_nd"))
+        .sort("l_returnflag"))
 
     assert sorted(q) == sorted(QUERY_NAMES), \
         f"QUERY_NAMES out of sync: {sorted(set(q) ^ set(QUERY_NAMES))}"

@@ -193,7 +193,12 @@ class TestExpectedRewrites:
               "nested_filter_rewrite": True, "nested_group_rollup": True,
               "view_filter_pushdown": True, "view_join_orders": True,
               # COUNT DISTINCT over l_orderkey: not covered by any index.
-              "tpch_q16_distinct": False}
+              "tpch_q16_distinct": False,
+              # Edge shapes: only the literal-true filter is covered
+              # (li_ship_idx; the always-true conjunct is harmless).
+              "union_three_way": False, "limit_zero": False,
+              "literal_true_filter": True,
+              "count_distinct_two_level": False}
 
     def test_rewrite_expectations(self, harness):
         session, queries = harness
