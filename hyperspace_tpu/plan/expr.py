@@ -342,6 +342,34 @@ def count_distinct(e) -> CountDistinct:
 # Predicate utilities used by the rewrite rules.
 # ---------------------------------------------------------------------------
 
+def rename_columns(e: Expr, rename) -> Expr:
+    """Rebuild ``e`` with every Col reference passed through ``rename``
+    (a str -> str mapping). Used by the DataFrame API's case-insensitive
+    resolution: user-spelled names are rewritten to the schema's spelling
+    before plan construction. Nodes without Col descendants are returned
+    as-is (Exprs are immutable, sharing is safe)."""
+    if isinstance(e, Col):
+        new = rename(e.column)
+        return e if new == e.column else Col(new)
+    if isinstance(e, Lit):
+        return e
+    if isinstance(e, _Binary):
+        return type(e)(rename_columns(e.left, rename),
+                       rename_columns(e.right, rename))
+    if isinstance(e, Not):
+        return Not(rename_columns(e.child, rename))
+    if isinstance(e, In):
+        return In(rename_columns(e.value, rename),
+                  [rename_columns(o, rename) for o in e.options])
+    if isinstance(e, Alias):
+        return Alias(rename_columns(e.child, rename), e.alias_name)
+    if isinstance(e, AggExpr):
+        if e.child is None:
+            return e
+        return type(e)(rename_columns(e.child, rename))
+    raise HyperspaceException(f"Cannot rewrite expression {e!r}")
+
+
 def split_conjunctive_predicates(e: Expr) -> List[Expr]:
     """Flatten nested ANDs into a list (CNF top level)."""
     if isinstance(e, And):

@@ -249,8 +249,23 @@ class DataFrame:
     def columns(self) -> List[str]:
         return self.plan.schema.names
 
+    # Column names resolve case-insensitively against the schema (Spark
+    # analyzer behavior; `hyperspace.caseSensitive=true` restores exact
+    # matching). Unresolvable names pass through unchanged so the plan
+    # constructors raise with the user's spelling.
+    def _spelling(self, name: str, names: Optional[List[str]] = None) -> str:
+        from .util.resolver import resolve
+        avail = names if names is not None else self.plan.schema.names
+        r = resolve(avail, name, self.session.hs_conf.case_sensitive())
+        return r if r is not None else name
+
+    def _resolve_expr(self, e: E.Expr,
+                      names: Optional[List[str]] = None) -> E.Expr:
+        return E.rename_columns(e, lambda n: self._spelling(n, names))
+
     def filter(self, condition: E.Expr) -> "DataFrame":
-        return DataFrame(self.session, Filter(condition, self.plan))
+        return DataFrame(self.session,
+                         Filter(self._resolve_expr(condition), self.plan))
 
     where = filter
 
@@ -261,26 +276,34 @@ class DataFrame:
                 flat.extend(e)
             else:
                 flat.append(e)
+        flat = [self._spelling(e) if isinstance(e, str)
+                else self._resolve_expr(e) for e in flat]
         return DataFrame(self.session, Project(flat, self.plan))
 
     def join(self, other: "DataFrame", on: E.Expr, how: str = "inner") -> "DataFrame":
-        return DataFrame(self.session, Join(self.plan, other.plan, on, how))
+        both = list(self.plan.schema.names) + list(other.plan.schema.names)
+        return DataFrame(self.session,
+                         Join(self.plan, other.plan,
+                              self._resolve_expr(on, both), how))
 
     def group_by(self, *cols: str) -> "GroupedData":
-        return GroupedData(self, list(cols))
+        return GroupedData(self, [self._spelling(c) for c in cols])
 
     groupBy = group_by
 
     def agg(self, *aggs: E.Expr) -> "DataFrame":
-        return DataFrame(self.session, Aggregate([], list(aggs), self.plan))
+        return DataFrame(self.session,
+                         Aggregate([], [self._resolve_expr(a) for a in aggs],
+                                   self.plan))
 
     def sort(self, *orders) -> "DataFrame":
         normalized: List[Tuple[str, bool]] = []
         for o in orders:
             if isinstance(o, str):
-                normalized.append((o, True))
+                normalized.append((self._spelling(o), True))
             else:
-                normalized.append(o)  # (name, ascending)
+                name, asc = o
+                normalized.append((self._spelling(name), asc))
         return DataFrame(self.session, Sort(normalized, self.plan))
 
     order_by = sort
@@ -321,17 +344,22 @@ class DataFrame:
         return text
 
     def with_column(self, name: str, expr: E.Expr) -> "DataFrame":
-        """Add or replace a column (Spark's withColumn)."""
-        exprs = [E.Col(n) if n != name else expr.alias(name)
+        """Add or replace a column (Spark's withColumn: the column to
+        REPLACE matches case-insensitively, but the output keeps the
+        caller's spelling — Spark emits col.as(the user's name))."""
+        resolved = self._spelling(name)
+        expr = self._resolve_expr(expr)
+        exprs = [E.Col(n) if n != resolved else expr.alias(name)
                  for n in self.plan.schema.names]
-        if name not in self.plan.schema.names:
+        if resolved not in self.plan.schema.names:
             exprs.append(expr.alias(name))
         return DataFrame(self.session, Project(exprs, self.plan))
 
     withColumn = with_column
 
     def drop(self, *names: str) -> "DataFrame":
-        keep = [n for n in self.plan.schema.names if n not in set(names)]
+        dropped = {self._spelling(n) for n in names}
+        keep = [n for n in self.plan.schema.names if n not in dropped]
         if not keep:
             raise HyperspaceException("drop() would remove every column")
         return DataFrame(self.session, Project(keep, self.plan))
@@ -403,6 +431,7 @@ class DataFrameWriter:
         if not cols:
             raise HyperspaceException(
                 "bucket_by needs at least one bucketing column")
+        cols = tuple(self._df._spelling(c) for c in cols)
         missing = [c for c in cols if c not in self._df.plan.schema]
         if missing:
             raise HyperspaceException(
@@ -423,6 +452,7 @@ class DataFrameWriter:
             raise HyperspaceException(
                 "partition_by needs at least one partition column")
         names = self._df.plan.schema.names
+        cols = tuple(self._df._spelling(c) for c in cols)
         missing = [c for c in cols if c not in names]
         if missing:
             raise HyperspaceException(
@@ -619,7 +649,9 @@ class GroupedData:
 
     def agg(self, *aggs: E.Expr) -> DataFrame:
         return DataFrame(self._df.session,
-                         Aggregate(self._group_cols, list(aggs), self._df.plan))
+                         Aggregate(self._group_cols,
+                                   [self._df._resolve_expr(a) for a in aggs],
+                                   self._df.plan))
 
     def count(self) -> DataFrame:
         return self.agg(E.Count(None))

@@ -1,0 +1,135 @@
+"""Case-insensitive column resolution in the DataFrame API (Spark analyzer
+parity: references resolve against the schema case-insensitively unless
+``hyperspace.caseSensitive=true``). The rules already honored the conf;
+this pins the API layer — filter/select/sort/group_by/join/agg/
+with_column/drop all accept any-case spellings, and rewrites still fire.
+"""
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+import hyperspace_tpu as hst
+from hyperspace_tpu.api import Hyperspace, IndexConfig
+from hyperspace_tpu.exceptions import HyperspaceException
+from hyperspace_tpu.index.constants import IndexConstants
+from hyperspace_tpu.plan.expr import col, count_distinct, sum_
+
+
+@pytest.fixture()
+def env(tmp_path):
+    rng = np.random.default_rng(55)
+    d = tmp_path / "data"
+    d.mkdir()
+    pq.write_table(pa.Table.from_pandas(pd.DataFrame({
+        "Key": rng.integers(0, 30, 600).astype(np.int64),
+        "Val": rng.integers(0, 9, 600).astype(np.int64),
+        "Tag": rng.choice(["a", "b"], 600),
+    })), d / "p0.parquet")
+    session = hst.Session(system_path=str(tmp_path / "idx"))
+    session.conf.set(IndexConstants.INDEX_NUM_BUCKETS, 4)
+    return session, str(d)
+
+
+class TestResolution:
+    def test_filter_select_any_case(self, env):
+        session, d = env
+        df = session.read.parquet(d)
+        got = (df.filter(col("KEY") > 10).select("key", "VAL")
+               .to_arrow())
+        # Output keeps the SCHEMA's spelling, not the query's.
+        assert got.column_names == ["Key", "Val"]
+        assert got.num_rows > 0
+
+    def test_group_sort_agg_any_case(self, env):
+        session, d = env
+        df = session.read.parquet(d)
+        got = (df.group_by("tag")
+               .agg(sum_(col("VAL")).alias("s"),
+                    count_distinct(col("key")).alias("nd"))
+               .sort("TAG").to_pandas())
+        pdf = df.to_pandas()
+        expect = (pdf.groupby("Tag")
+                  .agg(s=("Val", "sum"), nd=("Key", "nunique"))
+                  .reset_index().rename(columns={"Tag": "Tag"})
+                  .sort_values("Tag").reset_index(drop=True))
+        pd.testing.assert_frame_equal(
+            got.rename(columns={"Tag": "Tag"}), expect, check_dtype=False)
+
+    def test_join_keys_any_case(self, env, tmp_path):
+        session, d = env
+        d2 = tmp_path / "dim"
+        d2.mkdir()
+        pq.write_table(pa.table({
+            "DKey": pa.array(np.arange(30, dtype=np.int64)),
+            "DVal": pa.array(np.arange(30, dtype=np.int64) * 10)}),
+            d2 / "p0.parquet")
+        df = session.read.parquet(d)
+        dim = session.read.parquet(str(d2))
+        got = (df.join(dim, on=col("key") == col("dkey"))
+               .select("Key", "DVal").to_arrow())
+        assert got.num_rows == 600
+
+    def test_with_column_replace_and_drop_any_case(self, env):
+        session, d = env
+        df = session.read.parquet(d)
+        out = (df.with_column("VAL", col("val") * 2)
+               .drop("TAG").to_arrow())
+        # Spark parity: the REPLACED column keeps the caller's spelling
+        # (withColumn emits col.as(the user's name)).
+        assert out.column_names == ["Key", "VAL"]
+        orig = df.to_pandas()["Val"] * 2
+        assert out.column("VAL").to_pylist() == orig.tolist()
+
+    def test_writer_layouts_any_case(self, env, tmp_path):
+        session, d = env
+        df = session.read.parquet(d)
+        out1 = str(tmp_path / "b")
+        df.write.bucket_by(2, "KEY").parquet(out1)
+        assert session.read.parquet(out1).count() == 600
+        out2 = str(tmp_path / "p")
+        df.write.partition_by("tag").parquet(out2)
+        import os
+        assert any(x.startswith("Tag=") for x in os.listdir(out2))
+
+    def test_rewrite_fires_through_wrong_case(self, env):
+        session, d = env
+        hs = Hyperspace(session)
+        df = session.read.parquet(d)
+        hs.create_index(df, IndexConfig("ciIdx", ["Key"], ["Val"]))
+        session.enable_hyperspace()
+        q = df.filter(col("KEY") > 5).select("key", "val")
+        assert "IndexScan" in q.optimized_plan().tree_string()
+        # Oracle.
+        a = q.to_pandas().sort_values(["Key", "Val"]).reset_index(drop=True)
+        session.disable_hyperspace()
+        b = q.to_pandas().sort_values(["Key", "Val"]).reset_index(drop=True)
+        pd.testing.assert_frame_equal(a, b)
+
+    def test_unknown_name_error_keeps_user_spelling(self, env):
+        session, d = env
+        df = session.read.parquet(d)
+        with pytest.raises(HyperspaceException, match="'GhOsT'"):
+            df.select("GhOsT")
+
+    def test_case_sensitive_mode_rejects_wrong_case(self, env):
+        session, d = env
+        session.conf.set("hyperspace.caseSensitive", "true")
+        df = session.read.parquet(d)
+        with pytest.raises(HyperspaceException, match="KEY"):
+            df.filter(col("KEY") > 1).to_arrow()
+        # Exact spelling still works.
+        assert df.filter(col("Key") > 1).count() > 0
+
+    def test_ambiguous_names_raise(self, env, tmp_path):
+        session, _ = env
+        d2 = tmp_path / "amb"
+        d2.mkdir()
+        pq.write_table(pa.table({
+            "x": pa.array([1, 2], type=pa.int64()),
+            "X": pa.array([3, 4], type=pa.int64())}), d2 / "p0.parquet")
+        df = session.read.parquet(str(d2))
+        with pytest.raises(HyperspaceException, match="Ambiguous"):
+            df.select("x")
