@@ -266,9 +266,10 @@ QUERY_NAMES = [
     # COUNT(DISTINCT) — the real TPC-H Q16 aggregate.
     "tpch_q16_distinct",
     # Edge shapes: 3-way union, limit 0, always-true literal predicate,
-    # two-level distinct composition.
+    # two-level distinct composition, any-case column references.
     "union_three_way", "limit_zero",
     "literal_true_filter", "count_distinct_two_level",
+    "case_insensitive_cols",
 ]
 
 
@@ -845,6 +846,13 @@ def queries(dfs):
         .group_by("p_brand", "p_container")
         .agg(count_distinct(col("l_orderkey")).alias("supplier_cnt"))
         .sort(("supplier_cnt", False), "p_brand", "p_container"))
+
+    # Wrong-case column references resolve (hyperspace.caseSensitive
+    # defaults false, like Spark) and the rewrite still fires; the plan
+    # carries the SCHEMA's spelling.
+    q["case_insensitive_cols"] = (
+        li.filter(col("L_SHIPDATE") > d(1997, 1, 1))
+        .select("L_QUANTITY", "l_extendedprice", "L_SHIPDATE"))
 
     # Three-way union of disjoint ranges, re-aggregated.
     q["union_three_way"] = (

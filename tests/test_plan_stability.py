@@ -198,7 +198,10 @@ class TestExpectedRewrites:
               # (li_ship_idx; the always-true conjunct is harmless).
               "union_three_way": False, "limit_zero": False,
               "literal_true_filter": True,
-              "count_distinct_two_level": False}
+              "count_distinct_two_level": False,
+              # Wrong-case spellings resolve to the schema's names and the
+              # covering rewrite fires as if spelled exactly.
+              "case_insensitive_cols": True}
 
     def test_rewrite_expectations(self, harness):
         session, queries = harness
