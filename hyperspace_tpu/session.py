@@ -168,6 +168,13 @@ class Session:
     def create_dataframe(self, plan: LogicalPlan) -> "DataFrame":
         return DataFrame(self, plan)
 
+    def sql(self, text: str) -> "DataFrame":
+        """Lower one SQL SELECT over registered temp views onto the
+        DataFrame IR (see hyperspace_tpu/sql.py for the supported
+        subset); index rewrites apply exactly as for DataFrame queries."""
+        from .sql import sql as _sql
+        return _sql(self, text)
+
 
 class DataFrameReader:
     def __init__(self, session: Session):

@@ -1,0 +1,422 @@
+"""A minimal SQL SELECT front-end over registered temp views.
+
+The reference's users write Spark SQL; this framework's primary surface is
+the DataFrame IR, and `session.sql(...)` lowers a practical SELECT subset
+onto it — so every index rewrite, skipping rule, and execution path behaves
+exactly as for the equivalent DataFrame query.
+
+Supported grammar (case-insensitive keywords):
+
+    SELECT <*| expr [AS name], ...>
+    FROM <view> [ [INNER|LEFT|RIGHT|FULL] JOIN <view> ON a = b [AND c = d] ]*
+    [WHERE <predicate>]
+    [GROUP BY col, ...] [HAVING <predicate>]
+    [ORDER BY col [ASC|DESC], ...] [LIMIT n]
+
+Expressions: identifiers, integer/float/string literals, DATE 'yyyy-mm-dd',
++ - * /, comparisons (= != <> < <= > >=), BETWEEN x AND y, [NOT] IN (...),
+AND/OR/NOT, and aggregates SUM/AVG/MIN/MAX/COUNT(*)/COUNT(x)/
+COUNT(DISTINCT x). Everything else raises a clear error naming the token.
+"""
+
+from __future__ import annotations
+
+import datetime
+import re
+from typing import List, Optional, Tuple
+
+from .exceptions import HyperspaceException
+from .plan import expr as E
+
+_TOKEN = re.compile(r"""
+    \s*(?:
+      (?P<date>DATE\s*'(\d{4}-\d{2}-\d{2})')
+    | (?P<str>'(?:[^']|'')*')
+    | (?P<num>\d+\.\d+|\d+)
+    | (?P<ident>[A-Za-z_][A-Za-z_0-9.]*)
+    | (?P<op><>|!=|<=|>=|=|<|>|\(|\)|,|\*|/|\+|-)
+    )""", re.VERBOSE | re.IGNORECASE)
+
+_KEYWORDS = {
+    "SELECT", "FROM", "WHERE", "GROUP", "BY", "HAVING", "ORDER", "LIMIT",
+    "JOIN", "INNER", "LEFT", "RIGHT", "FULL", "OUTER", "ON", "AS", "AND",
+    "OR", "NOT", "IN", "BETWEEN", "ASC", "DESC", "DATE", "DISTINCT",
+    "SUM", "AVG", "MIN", "MAX", "COUNT",
+}
+
+
+def _tokenize(text: str) -> List[Tuple[str, str]]:
+    out: List[Tuple[str, str]] = []
+    pos = 0
+    while pos < len(text):
+        m = _TOKEN.match(text, pos)
+        if m is None:
+            rest = text[pos:].strip()
+            if not rest:
+                break
+            raise HyperspaceException(
+                f"SQL: cannot tokenize near {rest[:25]!r}")
+        pos = m.end()
+        if m.group("date"):
+            out.append(("DATE_LIT", m.group(2)))
+        elif m.group("str"):
+            out.append(("STR", m.group("str")[1:-1].replace("''", "'")))
+        elif m.group("num"):
+            out.append(("NUM", m.group("num")))
+        elif m.group("ident"):
+            word = m.group("ident")
+            if word.upper() in _KEYWORDS:
+                out.append(("KW", word.upper()))
+            else:
+                out.append(("IDENT", word))
+        else:
+            out.append(("OP", m.group("op")))
+    out.append(("EOF", ""))
+    return out
+
+
+class _Parser:
+    def __init__(self, session, text: str):
+        self.session = session
+        self.toks = _tokenize(text)
+        self.i = 0
+
+    # -- token helpers ---------------------------------------------------
+    def peek(self, kind: str = None, value: str = None) -> bool:
+        k, v = self.toks[self.i]
+        if kind is not None and k != kind:
+            return False
+        if value is not None and v != value:
+            return False
+        return True
+
+    def take(self, kind: str = None, value: str = None) -> str:
+        k, v = self.toks[self.i]
+        if (kind is not None and k != kind) or \
+                (value is not None and v != value):
+            raise HyperspaceException(
+                f"SQL: expected {value or kind} but found {v or k!r}")
+        self.i += 1
+        return v
+
+    def accept(self, kind: str, value: str = None) -> bool:
+        if self.peek(kind, value):
+            self.i += 1
+            return True
+        return False
+
+    # -- expressions -----------------------------------------------------
+    def expr(self) -> E.Expr:
+        return self._or()
+
+    def _or(self) -> E.Expr:
+        e = self._and()
+        while self.accept("KW", "OR"):
+            e = e | self._and()
+        return e
+
+    def _and(self) -> E.Expr:
+        e = self._not()
+        while self.accept("KW", "AND"):
+            e = e & self._not()
+        return e
+
+    def _not(self) -> E.Expr:
+        if self.accept("KW", "NOT"):
+            return ~self._not()
+        return self._comparison()
+
+    def _comparison(self) -> E.Expr:
+        left = self._additive()
+        if self.accept("KW", "BETWEEN"):
+            lo = self._additive()
+            self.take("KW", "AND")
+            hi = self._additive()
+            return left.between(_lit_value(lo), _lit_value(hi))
+        negated = False
+        if self.peek("KW", "NOT"):
+            # Only NOT IN reaches here (prefix NOT handled above).
+            self.take("KW", "NOT")
+            self.take("KW", "IN")
+            negated = True
+        elif self.accept("KW", "IN"):
+            pass
+        else:
+            for op, make in (("=", lambda a, b: a == b),
+                             ("!=", lambda a, b: a != b),
+                             ("<>", lambda a, b: a != b),
+                             ("<=", lambda a, b: a <= b),
+                             (">=", lambda a, b: a >= b),
+                             ("<", lambda a, b: a < b),
+                             (">", lambda a, b: a > b)):
+                if self.accept("OP", op):
+                    return make(left, self._additive())
+            return left
+        self.take("OP", "(")
+        values = [_lit_value(self._additive())]
+        while self.accept("OP", ","):
+            values.append(_lit_value(self._additive()))
+        self.take("OP", ")")
+        e = left.isin(values)
+        return ~e if negated else e
+
+    def _additive(self) -> E.Expr:
+        e = self._multiplicative()
+        while True:
+            if self.accept("OP", "+"):
+                e = _fold(e, self._multiplicative(), lambda a, b: a + b,
+                          lambda a, b: a + b)
+            elif self.accept("OP", "-"):
+                e = _fold(e, self._multiplicative(), lambda a, b: a - b,
+                          lambda a, b: a - b)
+            else:
+                return e
+
+    def _multiplicative(self) -> E.Expr:
+        e = self._atom()
+        while True:
+            if self.accept("OP", "*"):
+                e = _fold(e, self._atom(), lambda a, b: a * b,
+                          lambda a, b: a * b)
+            elif self.accept("OP", "/"):
+                e = _fold(e, self._atom(), lambda a, b: a / b,
+                          lambda a, b: a / b)
+            else:
+                return e
+
+    def _atom(self) -> E.Expr:
+        if self.accept("OP", "-"):
+            # Unary minus: folds for literals, 0 - x otherwise.
+            return _fold(E.lit(0), self._atom(), lambda a, b: a - b,
+                         lambda a, b: a - b)
+        if self.accept("OP", "("):
+            e = self.expr()
+            self.take("OP", ")")
+            return e
+        if self.peek("KW") and self.toks[self.i][1] in (
+                "SUM", "AVG", "MIN", "MAX", "COUNT"):
+            return self._aggregate()
+        if self.peek("IDENT"):
+            return E.col(self.take("IDENT"))
+        if self.peek("NUM"):
+            raw = self.take("NUM")
+            return E.lit(float(raw) if "." in raw else int(raw))
+        if self.peek("STR"):
+            return E.lit(self.take("STR"))
+        if self.peek("DATE_LIT"):
+            return E.lit(datetime.date.fromisoformat(self.take("DATE_LIT")))
+        raise HyperspaceException(
+            f"SQL: unexpected token {self.toks[self.i][1]!r}")
+
+    def _aggregate(self) -> E.Expr:
+        fn = self.take("KW")
+        self.take("OP", "(")
+        if fn == "COUNT":
+            if self.accept("OP", "*"):
+                self.take("OP", ")")
+                return E.count(None)
+            if self.accept("KW", "DISTINCT"):
+                inner = self.expr()
+                self.take("OP", ")")
+                return E.count_distinct(inner)
+            inner = self.expr()
+            self.take("OP", ")")
+            return E.count(inner)
+        inner = self.expr()
+        self.take("OP", ")")
+        return {"SUM": E.sum_, "AVG": E.avg,
+                "MIN": E.min_, "MAX": E.max_}[fn](inner)
+
+    # -- query -----------------------------------------------------------
+    def query(self):
+        self.take("KW", "SELECT")
+        items: List[Tuple[Optional[E.Expr], Optional[str]]] = []
+        star = False
+        if self.accept("OP", "*"):
+            star = True
+        else:
+            items.append(self._select_item())
+            while self.accept("OP", ","):
+                items.append(self._select_item())
+
+        self.take("KW", "FROM")
+        df = self.session.table(self.take("IDENT"))
+
+        while self.peek("KW") and self.toks[self.i][1] in (
+                "JOIN", "INNER", "LEFT", "RIGHT", "FULL"):
+            df = self._join(df)
+
+        if self.accept("KW", "WHERE"):
+            df = df.filter(self.expr())
+
+        group_cols: List[str] = []
+        if self.accept("KW", "GROUP"):
+            self.take("KW", "BY")
+            group_cols.append(self.take("IDENT"))
+            while self.accept("OP", ","):
+                group_cols.append(self.take("IDENT"))
+
+        has_agg = any(_contains_agg(e) for e, _ in items if e is not None)
+        if group_cols or has_agg:
+            if star:
+                raise HyperspaceException(
+                    "SQL: SELECT * cannot be combined with aggregation")
+            # Resolve spellings once (the API is case-insensitive; raw
+            # string comparison here must be too).
+            spell = df._spelling
+            group_resolved = [spell(g) for g in group_cols]
+            aggs, out_names = [], []
+            for e, alias in items:
+                if _contains_agg(e):
+                    named = e.alias(alias) if alias else e
+                    aggs.append(named)
+                    out_names.append(named.name)
+                else:
+                    if not isinstance(e, E.Col):
+                        raise HyperspaceException(
+                            "SQL: non-aggregate select items must be "
+                            "plain grouped columns")
+                    if spell(e.column) not in group_resolved:
+                        raise HyperspaceException(
+                            f"SQL: column {e.column!r} must appear in "
+                            "GROUP BY or inside an aggregate")
+                    out_names.append(spell(e.column))
+            # HAVING may reference aggregates inline (standard SQL):
+            # materialize them as hidden columns, filter, then project the
+            # SELECT list (which also drops the hidden columns and fixes
+            # the output order to the SELECT order).
+            having: Optional[E.Expr] = None
+            if self.accept("KW", "HAVING"):
+                having = self.expr()
+                having, hidden = _lift_having_aggs(having, len(aggs))
+                aggs.extend(hidden)
+            df = (df.group_by(*group_cols).agg(*aggs) if group_cols
+                  else df.agg(*aggs))
+            if having is not None:
+                df = df.filter(having)
+            df = df.select(*out_names)
+        elif not star:
+            df = df.select(*[e.alias(alias) if alias else e
+                             for e, alias in items])
+            if self.accept("KW", "HAVING"):
+                raise HyperspaceException(
+                    "SQL: HAVING requires GROUP BY or aggregates")
+
+        if self.accept("KW", "ORDER"):
+            self.take("KW", "BY")
+            orders = [self._order_item()]
+            while self.accept("OP", ","):
+                orders.append(self._order_item())
+            df = df.sort(*orders)
+
+        if self.accept("KW", "LIMIT"):
+            raw = self.take("NUM")
+            if "." in raw:
+                raise HyperspaceException(
+                    f"SQL: LIMIT takes an integer, found {raw!r}")
+            df = df.limit(int(raw))
+
+        self.take("EOF")
+        return df
+
+    def _select_item(self):
+        e = self.expr()
+        alias = None
+        if self.accept("KW", "AS"):
+            alias = self.take("IDENT")
+        elif self.peek("IDENT"):
+            alias = self.take("IDENT")
+        return e, alias
+
+    def _order_item(self):
+        name = self.take("IDENT")
+        if self.accept("KW", "DESC"):
+            return (name, False)
+        self.accept("KW", "ASC")
+        return (name, True)
+
+    def _join(self, df):
+        how = "inner"
+        if self.accept("KW", "LEFT"):
+            how = "left"
+        elif self.accept("KW", "RIGHT"):
+            how = "right"
+        elif self.accept("KW", "FULL"):
+            how = "full"
+        else:
+            self.accept("KW", "INNER")
+        self.accept("KW", "OUTER")
+        self.take("KW", "JOIN")
+        other = self.session.table(self.take("IDENT"))
+        self.take("KW", "ON")
+        cond = self._join_condition()
+        return df.join(other, on=cond, how=how)
+
+    def _join_condition(self) -> E.Expr:
+        cond = self._join_eq()
+        while self.accept("KW", "AND"):
+            cond = cond & self._join_eq()
+        return cond
+
+    def _join_eq(self) -> E.Expr:
+        left = E.col(self.take("IDENT"))
+        self.take("OP", "=")
+        return left == E.col(self.take("IDENT"))
+
+
+def _fold(a: E.Expr, b: E.Expr, expr_op, py_op) -> E.Expr:
+    """Constant-fold literal-literal arithmetic at parse time (e.g. the
+    ``1 + 0.1`` inside ``price * (1 + 0.1)``) — the engine's evaluator
+    deliberately rejects all-literal subtrees."""
+    if isinstance(a, E.Lit) and isinstance(b, E.Lit) and \
+            isinstance(a.value, (int, float)) and \
+            isinstance(b.value, (int, float)):
+        return E.lit(py_op(a.value, b.value))
+    return expr_op(a, b)
+
+
+def _contains_agg(e: Optional[E.Expr]) -> bool:
+    if e is None:
+        return False
+    if isinstance(e, E.AggExpr):
+        return True
+    return any(_contains_agg(c) for c in e.children)
+
+
+def _lift_having_aggs(e: E.Expr, start: int):
+    """Replace every aggregate inside a HAVING predicate with a reference
+    to a hidden output column, returning (rewritten predicate, the hidden
+    aliased aggregates to append to the agg list)."""
+    hidden: List[E.Expr] = []
+
+    def rec(node: E.Expr) -> E.Expr:
+        if isinstance(node, E.AggExpr):
+            name = f"__having_{start + len(hidden)}"
+            hidden.append(node.alias(name))
+            return E.col(name)
+        if isinstance(node, E.Col) or isinstance(node, E.Lit):
+            return node
+        if isinstance(node, E.Not):
+            return ~rec(node.child)
+        if isinstance(node, E.In):
+            return E.In(rec(node.value), list(node.options))
+        if isinstance(node, E.Alias):
+            return rec(node.child).alias(node.alias_name)
+        if isinstance(node, E._Binary):
+            return type(node)(rec(node.left), rec(node.right))
+        raise HyperspaceException(
+            f"SQL: unsupported HAVING expression {node!r}")
+
+    return rec(e), hidden
+
+
+def _lit_value(e: E.Expr):
+    if not isinstance(e, E.Lit):
+        raise HyperspaceException(
+            f"SQL: expected a literal, found {e!r}")
+    return e.value
+
+
+def sql(session, text: str):
+    """Parse and lower one SELECT statement to a DataFrame."""
+    return _Parser(session, text).query()

@@ -1,0 +1,190 @@
+"""session.sql(): the SQL SELECT subset lowers onto the DataFrame IR —
+answers match the equivalent DataFrame query (and pandas), and index
+rewrites fire identically.
+"""
+
+import datetime
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+import hyperspace_tpu as hst
+from hyperspace_tpu.api import Hyperspace, IndexConfig
+from hyperspace_tpu.exceptions import HyperspaceException
+from hyperspace_tpu.index.constants import IndexConstants
+from hyperspace_tpu.plan.expr import col, sum_
+
+
+@pytest.fixture()
+def env(tmp_path):
+    rng = np.random.default_rng(77)
+    n = 1500
+    d = tmp_path / "li"
+    d.mkdir()
+    pq.write_table(pa.Table.from_pandas(pd.DataFrame({
+        "okey": rng.integers(0, 100, n).astype(np.int64),
+        "qty": rng.integers(1, 50, n).astype(np.int64),
+        "price": np.round(rng.uniform(1, 1000, n), 2),
+        "flag": rng.choice(["A", "N", "R"], n),
+        "ship": pd.to_datetime(
+            rng.integers(9000, 9400, n), unit="D").date,
+    })), d / "p0.parquet")
+    d2 = tmp_path / "od"
+    d2.mkdir()
+    pq.write_table(pa.table({
+        "okey2": pa.array(np.arange(100, dtype=np.int64)),
+        "prio": pa.array(rng.choice(["HI", "LO"], 100)),
+    }), d2 / "p0.parquet")
+    session = hst.Session(system_path=str(tmp_path / "idx"))
+    session.conf.set(IndexConstants.INDEX_NUM_BUCKETS, 4)
+    session.create_temp_view("li", session.read.parquet(str(d)))
+    session.create_temp_view("od", session.read.parquet(str(d2)))
+    return session
+
+
+class TestSelect:
+    def test_star_where_order_limit(self, env):
+        got = env.sql("SELECT * FROM li WHERE qty > 40 "
+                      "ORDER BY okey, qty, price LIMIT 10").to_pandas()
+        exp = (env.table("li").filter(col("qty") > 40)
+               .sort("okey", "qty", "price").limit(10).to_pandas())
+        pd.testing.assert_frame_equal(got, exp)
+
+    def test_projection_arithmetic_alias(self, env):
+        got = env.sql("SELECT okey, price * (1 + 0.1) AS taxed FROM li "
+                      "WHERE okey = 3").to_pandas()
+        exp = (env.table("li").filter(col("okey") == 3)
+               .select(col("okey"), (col("price") * 1.1).alias("taxed"))
+               .to_pandas())
+        pd.testing.assert_frame_equal(got, exp)
+
+    def test_date_literal_and_between(self, env):
+        got = env.sql(
+            "SELECT okey FROM li WHERE ship BETWEEN DATE '1994-09-01' "
+            "AND DATE '1994-12-31'").count()
+        d1, d2 = datetime.date(1994, 9, 1), datetime.date(1994, 12, 31)
+        exp = env.table("li").filter(col("ship").between(d1, d2)).count()
+        assert got == exp > 0
+
+    def test_in_and_not_in(self, env):
+        got = env.sql("SELECT okey FROM li WHERE flag IN ('A', 'R') "
+                      "AND okey NOT IN (1, 2, 3)").count()
+        exp = env.table("li").filter(
+            col("flag").isin(["A", "R"])
+            & ~col("okey").isin([1, 2, 3])).count()
+        assert got == exp > 0
+
+    def test_group_by_having_aggregates(self, env):
+        got = env.sql(
+            "SELECT flag, SUM(qty) AS total, COUNT(*) AS n, "
+            "COUNT(DISTINCT okey) AS nd FROM li "
+            "GROUP BY flag HAVING total > 100 ORDER BY flag").to_pandas()
+        pdf = env.table("li").to_pandas()
+        exp = (pdf.groupby("flag")
+               .agg(total=("qty", "sum"), n=("qty", "size"),
+                    nd=("okey", "nunique"))
+               .reset_index().query("total > 100")
+               .sort_values("flag").reset_index(drop=True))
+        pd.testing.assert_frame_equal(got, exp, check_dtype=False)
+
+    def test_global_aggregate(self, env):
+        t = env.sql("SELECT SUM(price) AS sp, MIN(qty) AS lo, "
+                    "MAX(qty) AS hi FROM li").to_arrow()
+        pdf = env.table("li").to_pandas()
+        assert t.column("sp").to_pylist() == [pytest.approx(pdf.price.sum())]
+        assert t.column("lo").to_pylist() == [pdf.qty.min()]
+        assert t.column("hi").to_pylist() == [pdf.qty.max()]
+
+    def test_join(self, env):
+        got = env.sql(
+            "SELECT flag, SUM(price) AS rev FROM li "
+            "JOIN od ON okey = okey2 WHERE prio = 'HI' "
+            "GROUP BY flag ORDER BY flag").to_pandas()
+        li, od = env.table("li"), env.table("od")
+        exp = (li.join(od, on=col("okey") == col("okey2"))
+               .filter(col("prio") == "HI")
+               .group_by("flag").agg(sum_(col("price")).alias("rev"))
+               .sort("flag").to_pandas())
+        pd.testing.assert_frame_equal(got, exp)
+
+    def test_left_join(self, env):
+        got = env.sql("SELECT okey2, COUNT(okey) AS n FROM od "
+                      "LEFT JOIN li ON okey2 = okey "
+                      "GROUP BY okey2 ORDER BY okey2").to_pandas()
+        assert len(got) == 100  # every od row survives
+
+
+class TestSqlRewrite:
+    def test_index_rewrite_fires_for_sql(self, env, tmp_path):
+        hs = Hyperspace(env)
+        hs.create_index(env.table("li"),
+                        IndexConfig("sqlIdx", ["okey"], ["qty", "price"]))
+        env.enable_hyperspace()
+        q = env.sql("SELECT okey, qty FROM li WHERE okey < 50")
+        assert "IndexScan" in q.optimized_plan().tree_string()
+        a = q.to_pandas().sort_values(["okey", "qty"]).reset_index(drop=True)
+        env.disable_hyperspace()
+        b = q.to_pandas().sort_values(["okey", "qty"]).reset_index(drop=True)
+        pd.testing.assert_frame_equal(a, b)
+
+
+class TestSqlErrors:
+    def test_unknown_view(self, env):
+        with pytest.raises(HyperspaceException, match="temp view"):
+            env.sql("SELECT * FROM ghost")
+
+    def test_ungrouped_column_with_aggregate(self, env):
+        with pytest.raises(HyperspaceException, match="GROUP BY"):
+            env.sql("SELECT okey, SUM(qty) AS s FROM li GROUP BY flag")
+
+    def test_star_with_aggregate(self, env):
+        with pytest.raises(HyperspaceException, match="SELECT \\*"):
+            env.sql("SELECT * FROM li GROUP BY flag")
+
+    def test_garbage_token(self, env):
+        with pytest.raises(HyperspaceException, match="tokenize"):
+            env.sql("SELECT ; FROM li")
+
+    def test_truncated_query(self, env):
+        with pytest.raises(HyperspaceException, match="expected"):
+            env.sql("SELECT okey FROM")
+
+
+class TestSqlReviewRegressions:
+    def test_group_by_case_insensitive(self, env):
+        got = env.sql("SELECT FLAG, SUM(qty) AS s FROM li "
+                      "GROUP BY flag ORDER BY flag").to_pandas()
+        assert list(got.columns) == ["flag", "s"]
+        assert len(got) == 3
+
+    def test_select_order_and_hidden_group_cols(self, env):
+        # Aggregate-only SELECT: the group column must NOT leak out.
+        t = env.sql("SELECT SUM(qty) AS s FROM li GROUP BY flag").to_arrow()
+        assert t.column_names == ["s"] and t.num_rows == 3
+        # SELECT order is honored (agg before group col).
+        t2 = env.sql("SELECT SUM(qty) AS s, flag FROM li "
+                     "GROUP BY flag ORDER BY flag").to_arrow()
+        assert t2.column_names == ["s", "flag"]
+
+    def test_having_with_inline_aggregate(self, env):
+        got = env.sql("SELECT flag FROM li GROUP BY flag "
+                      "HAVING SUM(qty) > 100 ORDER BY flag").to_pandas()
+        pdf = env.table("li").to_pandas()
+        exp = (pdf.groupby("flag")["qty"].sum().reset_index()
+               .query("qty > 100")["flag"]
+               .sort_values().reset_index(drop=True))
+        assert got["flag"].tolist() == exp.tolist()
+        assert list(got.columns) == ["flag"]  # hidden agg projected away
+
+    def test_unary_minus(self, env):
+        assert env.sql("SELECT okey FROM li WHERE okey = -1").count() == 0
+        got = env.sql("SELECT okey, price * -1 AS neg FROM li "
+                      "WHERE okey IN (-5, 3) ORDER BY neg LIMIT 3").to_pandas()
+        assert (got["neg"] <= 0).all()
+
+    def test_limit_float_raises_cleanly(self, env):
+        with pytest.raises(HyperspaceException, match="LIMIT"):
+            env.sql("SELECT okey FROM li LIMIT 10.5")
