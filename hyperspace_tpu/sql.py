@@ -7,11 +7,15 @@ exactly as for the equivalent DataFrame query.
 
 Supported grammar (case-insensitive keywords):
 
-    SELECT <*| expr [AS name], ...>
-    FROM <view> [ [INNER|LEFT|RIGHT|FULL] JOIN <view> ON a = b [AND c = d] ]*
-    [WHERE <predicate>]
-    [GROUP BY col, ...] [HAVING <predicate>]
-    [ORDER BY col [ASC|DESC], ...] [LIMIT n]
+    query      := select [UNION ALL select]*
+    select     := SELECT [DISTINCT] <*| expr [AS name], ...>
+                  FROM table_ref
+                  [ [INNER|LEFT|RIGHT|FULL] JOIN table_ref
+                    ON a = b [AND c = d] ]*
+                  [WHERE <predicate>]
+                  [GROUP BY col, ...] [HAVING <predicate>]
+                  [ORDER BY col [ASC|DESC], ...] [LIMIT n]
+    table_ref  := <view> | ( select ) [AS name]
 
 Expressions: identifiers, integer/float/string literals, DATE 'yyyy-mm-dd',
 + - * /, comparisons (= != <> < <= > >=), BETWEEN x AND y, [NOT] IN (...),
@@ -40,7 +44,7 @@ _TOKEN = re.compile(r"""
 _KEYWORDS = {
     "SELECT", "FROM", "WHERE", "GROUP", "BY", "HAVING", "ORDER", "LIMIT",
     "JOIN", "INNER", "LEFT", "RIGHT", "FULL", "OUTER", "ON", "AS", "AND",
-    "OR", "NOT", "IN", "BETWEEN", "ASC", "DESC", "DATE", "DISTINCT",
+    "OR", "NOT", "IN", "BETWEEN", "ASC", "DESC", "DATE", "DISTINCT", "UNION", "ALL",
     "SUM", "AVG", "MIN", "MAX", "COUNT",
 }
 
@@ -229,7 +233,52 @@ class _Parser:
 
     # -- query -----------------------------------------------------------
     def query(self):
+        df = self._query_body()
+        self.take("EOF")
+        return df
+
+    def _query_body(self):
+        """select [UNION ALL select]* [ORDER BY ...] [LIMIT n] — a
+        trailing ORDER BY/LIMIT binds to the WHOLE union (standard SQL),
+        and the same production serves derived tables."""
+        df = self._select_stmt()
+        while self.peek("KW", "UNION"):
+            self.take("KW", "UNION")
+            self.take("KW", "ALL")
+            df = df.union(self._select_stmt())
+        return self._order_limit(df)
+
+    def _order_limit(self, df):
+        if self.accept("KW", "ORDER"):
+            self.take("KW", "BY")
+            orders = [self._order_item()]
+            while self.accept("OP", ","):
+                orders.append(self._order_item())
+            df = df.sort(*orders)
+        if self.accept("KW", "LIMIT"):
+            raw = self.take("NUM")
+            if "." in raw:
+                raise HyperspaceException(
+                    f"SQL: LIMIT takes an integer, found {raw!r}")
+            df = df.limit(int(raw))
+        return df
+
+    def _table_ref(self):
+        if self.accept("OP", "("):
+            # Derived table: ( query-body ) [AS name] — may itself contain
+            # UNION ALL and its own ORDER BY/LIMIT.
+            inner = self._query_body()
+            self.take("OP", ")")
+            if self.accept("KW", "AS"):
+                self.take("IDENT")
+            elif self.peek("IDENT"):
+                self.take("IDENT")
+            return inner
+        return self.session.table(self.take("IDENT"))
+
+    def _select_stmt(self):
         self.take("KW", "SELECT")
+        distinct = self.accept("KW", "DISTINCT")
         items: List[Tuple[Optional[E.Expr], Optional[str]]] = []
         star = False
         if self.accept("OP", "*"):
@@ -240,7 +289,7 @@ class _Parser:
                 items.append(self._select_item())
 
         self.take("KW", "FROM")
-        df = self.session.table(self.take("IDENT"))
+        df = self._table_ref()
 
         while self.peek("KW") and self.toks[self.i][1] in (
                 "JOIN", "INNER", "LEFT", "RIGHT", "FULL"):
@@ -302,21 +351,9 @@ class _Parser:
                 raise HyperspaceException(
                     "SQL: HAVING requires GROUP BY or aggregates")
 
-        if self.accept("KW", "ORDER"):
-            self.take("KW", "BY")
-            orders = [self._order_item()]
-            while self.accept("OP", ","):
-                orders.append(self._order_item())
-            df = df.sort(*orders)
+        if distinct:
+            df = df.distinct()
 
-        if self.accept("KW", "LIMIT"):
-            raw = self.take("NUM")
-            if "." in raw:
-                raise HyperspaceException(
-                    f"SQL: LIMIT takes an integer, found {raw!r}")
-            df = df.limit(int(raw))
-
-        self.take("EOF")
         return df
 
     def _select_item(self):
@@ -347,7 +384,7 @@ class _Parser:
             self.accept("KW", "INNER")
         self.accept("KW", "OUTER")
         self.take("KW", "JOIN")
-        other = self.session.table(self.take("IDENT"))
+        other = self._table_ref()
         self.take("KW", "ON")
         cond = self._join_condition()
         return df.join(other, on=cond, how=how)

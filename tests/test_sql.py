@@ -188,3 +188,55 @@ class TestSqlReviewRegressions:
     def test_limit_float_raises_cleanly(self, env):
         with pytest.raises(HyperspaceException, match="LIMIT"):
             env.sql("SELECT okey FROM li LIMIT 10.5")
+
+
+class TestSqlDistinctUnionDerived:
+    def test_select_distinct(self, env):
+        got = env.sql("SELECT DISTINCT flag FROM li ORDER BY flag") \
+            .to_pandas()
+        assert got["flag"].tolist() == ["A", "N", "R"]
+
+    def test_union_all(self, env):
+        n = env.sql("SELECT okey FROM li WHERE okey < 10 "
+                    "UNION ALL SELECT okey FROM li WHERE okey >= 90").count()
+        pdf = env.table("li").to_pandas()
+        assert n == int((pdf.okey < 10).sum() + (pdf.okey >= 90).sum())
+
+    def test_derived_table(self, env):
+        got = env.sql(
+            "SELECT flag, total FROM "
+            "(SELECT flag, SUM(qty) AS total FROM li GROUP BY flag) t "
+            "WHERE total > 100 ORDER BY flag").to_pandas()
+        pdf = env.table("li").to_pandas()
+        exp = (pdf.groupby("flag")["qty"].sum().rename("total")
+               .reset_index().query("total > 100")
+               .sort_values("flag").reset_index(drop=True))
+        pd.testing.assert_frame_equal(got, exp, check_dtype=False)
+
+    def test_join_with_derived_table(self, env):
+        got = env.sql(
+            "SELECT prio, COUNT(*) AS n FROM "
+            "(SELECT okey FROM li WHERE qty > 45) h "
+            "JOIN od ON okey = okey2 GROUP BY prio ORDER BY prio") \
+            .to_pandas()
+        assert set(got["prio"]) <= {"HI", "LO"} and got["n"].sum() > 0
+
+    def test_order_limit_bind_to_whole_union(self, env):
+        got = env.sql(
+            "SELECT okey FROM li WHERE okey < 5 "
+            "UNION ALL SELECT okey FROM li WHERE okey >= 95 "
+            "ORDER BY okey DESC LIMIT 4").to_pandas()
+        # Sorted over the WHOLE union: the top values come from the
+        # second branch only, descending.
+        assert (got["okey"] >= 95).all()
+        assert got["okey"].is_monotonic_decreasing and len(got) == 4
+
+    def test_union_inside_derived_table(self, env):
+        n = env.sql(
+            "SELECT okey FROM "
+            "(SELECT okey FROM li WHERE okey < 5 "
+            " UNION ALL SELECT okey FROM li WHERE okey >= 95) u "
+            "WHERE okey <> 0").count()
+        pdf = env.table("li").to_pandas()
+        assert n == int(((pdf.okey < 5) & (pdf.okey != 0)).sum()
+                        + (pdf.okey >= 95).sum())
