@@ -339,11 +339,20 @@ class _Parser:
                 having = self.expr()
                 having, hidden = _lift_having_aggs(having, len(aggs))
                 aggs.extend(hidden)
+            visible_agg_names = [a.name for a in aggs
+                                 if not a.name.startswith("__having_")]
             df = (df.group_by(*group_cols).agg(*aggs) if group_cols
                   else df.agg(*aggs))
             if having is not None:
                 df = df.filter(having)
-            df = df.select(*out_names)
+            # Project only when the SELECT list differs from the
+            # aggregate's natural output (group cols then aggregates) —
+            # a redundant Project would make SQL plans diverge from the
+            # equivalent DataFrame plans.
+            natural = group_resolved + visible_agg_names
+            if out_names != natural or len(visible_agg_names) != len(aggs):
+                # (hidden HAVING aggregates always force the projection.)
+                df = df.select(*out_names)
         elif not star:
             df = df.select(*[e.alias(alias) if alias else e
                              for e, alias in items])

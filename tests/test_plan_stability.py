@@ -209,3 +209,49 @@ class TestExpectedRewrites:
         got = {name: "IndexScan" in q.optimized_plan().tree_string()
                for name, q in queries.items()}
         assert got == self.EXPECT
+
+
+class TestSqlParity:
+    """SQL text versions of golden queries produce byte-identical optimized
+    plans to their DataFrame counterparts — the front-end adds no plan
+    divergence, so every golden file covers both surfaces."""
+
+    def test_sql_matches_dataframe_plans(self, harness):
+        session, queries = harness
+        import datetime as _dt
+        for name in ("lineitem", "orders", "part"):
+            # Views over the same scans the DataFrame queries use.
+            session.create_temp_view(
+                name, session.create_dataframe(
+                    queries["tpch_q1"].plan.collect_leaves()[0].__class__ and
+                    _scan_for(queries, name)), replace=True)
+        session.enable_hyperspace()
+        cases = {
+            "tpch_q6": (
+                "SELECT SUM(l_extendedprice * l_discount) AS revenue "
+                "FROM lineitem WHERE l_shipdate BETWEEN DATE '1994-01-01' "
+                "AND DATE '1994-12-31' AND l_discount BETWEEN 0.05 AND 0.07 "
+                "AND l_quantity < 24"),
+            "groupby_index": (
+                "SELECT l_partkey, AVG(l_quantity) AS aq, COUNT(*) AS n "
+                "FROM lineitem GROUP BY l_partkey "
+                "ORDER BY l_partkey LIMIT 15"),
+        }
+        for name, text in cases.items():
+            sql_plan = session.sql(text).optimized_plan().tree_string()
+            df_plan = queries[name].optimized_plan().tree_string()
+            assert sql_plan == df_plan, (
+                f"{name}: SQL and DataFrame plans diverge\n--- sql ---\n"
+                f"{sql_plan}\n--- df ---\n{df_plan}")
+
+
+def _scan_for(queries, table):
+    """The Scan leaf of the golden query set for a base table."""
+    from hyperspace_tpu.plan.nodes import Scan
+    probe = {"lineitem": "tpch_q1", "orders": "tpch_q18",
+             "part": "tpch_q19"}[table]
+    for leaf in queries[probe].plan.collect_leaves():
+        if isinstance(leaf, Scan) and \
+                f"/{table}" in leaf.relation.describe():
+            return leaf
+    raise AssertionError(f"no scan for {table}")
