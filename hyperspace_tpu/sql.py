@@ -314,22 +314,33 @@ class _Parser:
             # string comparison here must be too).
             spell = df._spelling
             group_resolved = [spell(g) for g in group_cols]
-            aggs, out_names = [], []
+            aggs, out_cols, out_names = [], [], []
+            aliased = False
             for e, alias in items:
                 if _contains_agg(e):
                     named = e.alias(alias) if alias else e
                     aggs.append(named)
+                    out_cols.append(named.name)
                     out_names.append(named.name)
                 else:
                     if not isinstance(e, E.Col):
                         raise HyperspaceException(
                             "SQL: non-aggregate select items must be "
                             "plain grouped columns")
-                    if spell(e.column) not in group_resolved:
+                    spelled = spell(e.column)
+                    if spelled not in group_resolved:
                         raise HyperspaceException(
                             f"SQL: column {e.column!r} must appear in "
                             "GROUP BY or inside an aggregate")
-                    out_names.append(spell(e.column))
+                    if alias:
+                        # SELECT g AS grp: the output carries the alias.
+                        aliased = True
+                        out_cols.append(E.col(spelled).alias(alias))
+                    else:
+                        out_cols.append(spelled)
+                    out_names.append(spelled)
+            n_visible = len(aggs)
+            visible_agg_names = [a.name for a in aggs]
             # HAVING may reference aggregates inline (standard SQL):
             # materialize them as hidden columns, filter, then project the
             # SELECT list (which also drops the hidden columns and fixes
@@ -337,10 +348,8 @@ class _Parser:
             having: Optional[E.Expr] = None
             if self.accept("KW", "HAVING"):
                 having = self.expr()
-                having, hidden = _lift_having_aggs(having, len(aggs))
+                having, hidden = _lift_having_aggs(having, n_visible)
                 aggs.extend(hidden)
-            visible_agg_names = [a.name for a in aggs
-                                 if not a.name.startswith("__having_")]
             df = (df.group_by(*group_cols).agg(*aggs) if group_cols
                   else df.agg(*aggs))
             if having is not None:
@@ -348,11 +357,11 @@ class _Parser:
             # Project only when the SELECT list differs from the
             # aggregate's natural output (group cols then aggregates) —
             # a redundant Project would make SQL plans diverge from the
-            # equivalent DataFrame plans.
+            # equivalent DataFrame plans. Aliases on group columns and
+            # hidden HAVING aggregates always force the projection.
             natural = group_resolved + visible_agg_names
-            if out_names != natural or len(visible_agg_names) != len(aggs):
-                # (hidden HAVING aggregates always force the projection.)
-                df = df.select(*out_names)
+            if aliased or out_names != natural or len(aggs) != n_visible:
+                df = df.select(*out_cols)
         elif not star:
             df = df.select(*[e.alias(alias) if alias else e
                              for e, alias in items])

@@ -240,3 +240,8 @@ class TestSqlDistinctUnionDerived:
         pdf = env.table("li").to_pandas()
         assert n == int(((pdf.okey < 5) & (pdf.okey != 0)).sum()
                         + (pdf.okey >= 95).sum())
+
+    def test_group_column_alias_kept(self, env):
+        t = env.sql("SELECT flag AS f, SUM(qty) AS s FROM li "
+                    "GROUP BY flag ORDER BY f").to_arrow()
+        assert t.column_names == ["f", "s"]
