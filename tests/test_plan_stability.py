@@ -218,11 +218,11 @@ class TestSqlParity:
 
     def test_sql_matches_dataframe_plans(self, harness):
         session, queries = harness
-        # A view over the same scan the DataFrame queries use.
-        session.create_temp_view(
-            "lineitem",
-            session.create_dataframe(_scan_for(queries, "lineitem")),
-            replace=True)
+        # Views over the same scans the DataFrame queries use.
+        for name in ("lineitem", "orders"):
+            session.create_temp_view(
+                name, session.create_dataframe(_scan_for(queries, name)),
+                replace=True)
         session.enable_hyperspace()
         cases = {
             "tpch_q6": (
@@ -234,6 +234,21 @@ class TestSqlParity:
                 "SELECT l_partkey, AVG(l_quantity) AS aq, COUNT(*) AS n "
                 "FROM lineitem GROUP BY l_partkey "
                 "ORDER BY l_partkey LIMIT 15"),
+            # The headline join: per-side filters via derived tables (the
+            # DataFrame version filters below the join; a WHERE above the
+            # join is a different — also rewritten — plan, since there is
+            # no filter-through-join pushdown rule), indexed pair, 3-col
+            # group, desc sort, limit — the full q3 shape through SQL.
+            "tpch_q3": (
+                "SELECT l_orderkey, o_orderdate, o_shippriority, "
+                "SUM(l_extendedprice * (1 - l_discount)) AS revenue "
+                "FROM (SELECT * FROM lineitem "
+                "      WHERE l_shipdate > DATE '1995-03-15') l "
+                "JOIN (SELECT * FROM orders "
+                "      WHERE o_orderdate < DATE '1995-03-15') o "
+                "ON l_orderkey = o_orderkey "
+                "GROUP BY l_orderkey, o_orderdate, o_shippriority "
+                "ORDER BY revenue DESC, o_orderdate LIMIT 10"),
         }
         for name, text in cases.items():
             sql_plan = session.sql(text).optimized_plan().tree_string()
@@ -246,7 +261,7 @@ class TestSqlParity:
 def _scan_for(queries, table):
     """The Scan leaf of the golden query set for a base table."""
     from hyperspace_tpu.plan.nodes import Scan
-    probe = {"lineitem": "tpch_q1"}[table]
+    probe = {"lineitem": "tpch_q1", "orders": "tpch_q18"}[table]
     for leaf in queries[probe].plan.collect_leaves():
         if isinstance(leaf, Scan) and \
                 f"/{table}" in leaf.relation.describe():
