@@ -1,4 +1,5 @@
-"""Filter pushdown through Project — Catalyst-parity plan normalization.
+"""Filter pushdown through Project and inner Join — Catalyst-parity plan
+normalization.
 
 The reference's index rules match ``Scan → Filter (→ Project)`` shapes
 (rules/FilterIndexRule.scala:165) and get away with that narrow pattern
@@ -21,10 +22,10 @@ it sits directly on the scan where the index rules can see it.
 
 from __future__ import annotations
 
-from typing import Dict, Optional
+from typing import Dict, List, Optional
 
 from ..plan import expr as E
-from ..plan.nodes import Filter, LogicalPlan, Project
+from ..plan.nodes import Filter, Join, LogicalPlan, Project
 
 
 def _substitute(e: E.Expr, mapping: Dict[str, E.Expr]) -> Optional[E.Expr]:
@@ -56,8 +57,21 @@ def _substitute(e: E.Expr, mapping: Dict[str, E.Expr]) -> Optional[E.Expr]:
     return None  # AggExpr or future kinds: not pushable.
 
 
+def _conjoin(parts: List[E.Expr]) -> E.Expr:
+    out = parts[0]
+    for p in parts[1:]:
+        out = out & p
+    return out
+
+
 def push_filters(plan: LogicalPlan) -> LogicalPlan:
-    """Bottom-up: sink every Filter below the Projects beneath it."""
+    """Bottom-up: sink every Filter below the Projects beneath it, and
+    split conjuncts of a Filter above an INNER Join to the side whose
+    columns they reference (Catalyst's PushDownPredicate — a WHERE written
+    above a join then prunes each input BEFORE the join and becomes
+    visible to the per-side index rules). Outer joins are left alone: a
+    predicate on the null-producing side is not semantics-preserving
+    below the join."""
     children = plan.children
     if children:
         plan = plan.with_children([push_filters(c) for c in children])
@@ -73,4 +87,37 @@ def push_filters(plan: LogicalPlan) -> LogicalPlan:
         if cond is not None:
             # Recurse: the sunk filter may sit above another Project.
             return Project(proj.exprs, push_filters(Filter(cond, proj.child)))
+    if isinstance(plan, Filter) and isinstance(plan.child, Join) \
+            and plan.child.join_type == "inner":
+        join = plan.child
+        l_names = set(join.left.schema.names)
+        r_names = set(join.right.schema.names)
+        to_left: List[E.Expr] = []
+        to_right: List[E.Expr] = []
+        stay: List[E.Expr] = []
+        for conj in E.split_conjunctive_predicates(plan.condition):
+            refs = set(conj.references)
+            if refs and refs <= l_names:
+                to_left.append(conj)
+            elif refs and refs <= r_names:
+                to_right.append(conj)
+            else:
+                stay.append(conj)
+        if to_left or to_right:
+            left = push_filters(Filter(_conjoin(to_left), join.left)) \
+                if to_left else join.left
+            right = push_filters(Filter(_conjoin(to_right), join.right)) \
+                if to_right else join.right
+            out: LogicalPlan = Join(left, right, join.condition,
+                                    join.join_type)
+            if stay:
+                out = Filter(_conjoin(stay), out)
+            return out
+    if isinstance(plan, Filter) and isinstance(plan.child, Filter):
+        # CombineFilters: adjacent filters (user chains, or a pushed
+        # conjunct landing on an already-filtered side) merge into ONE
+        # node — the index rules match Filter(Scan), not Filter(Filter(...)).
+        inner = plan.child
+        return push_filters(
+            Filter(plan.condition & inner.condition, inner.child))
     return plan

@@ -234,19 +234,16 @@ class TestSqlParity:
                 "SELECT l_partkey, AVG(l_quantity) AS aq, COUNT(*) AS n "
                 "FROM lineitem GROUP BY l_partkey "
                 "ORDER BY l_partkey LIMIT 15"),
-            # The headline join: per-side filters via derived tables (the
-            # DataFrame version filters below the join; a WHERE above the
-            # join is a different — also rewritten — plan, since there is
-            # no filter-through-join pushdown rule), indexed pair, 3-col
-            # group, desc sort, limit — the full q3 shape through SQL.
+            # The headline join, written the natural way: the
+            # filter-through-join pushdown sinks each WHERE conjunct to
+            # its side, so this optimizes to the SAME plan as the
+            # DataFrame version that filters below the join.
             "tpch_q3": (
                 "SELECT l_orderkey, o_orderdate, o_shippriority, "
                 "SUM(l_extendedprice * (1 - l_discount)) AS revenue "
-                "FROM (SELECT * FROM lineitem "
-                "      WHERE l_shipdate > DATE '1995-03-15') l "
-                "JOIN (SELECT * FROM orders "
-                "      WHERE o_orderdate < DATE '1995-03-15') o "
-                "ON l_orderkey = o_orderkey "
+                "FROM lineitem JOIN orders ON l_orderkey = o_orderkey "
+                "WHERE l_shipdate > DATE '1995-03-15' "
+                "AND o_orderdate < DATE '1995-03-15' "
                 "GROUP BY l_orderkey, o_orderdate, o_shippriority "
                 "ORDER BY revenue DESC, o_orderdate LIMIT 10"),
         }

@@ -122,3 +122,64 @@ class TestResults:
         exp = df[df.k * 2 == 84]
         assert len(got) == len(exp)
         assert (got["k2"] == 84).all()
+
+
+class TestPushThroughJoin:
+    """Catalyst's PushDownPredicate analogue for inner joins: conjuncts
+    of a WHERE above a join sink to the side they reference; mixed-side
+    conjuncts stay above; outer joins are untouched."""
+
+    @pytest.fixture()
+    def joined(self, tmp_path):
+        import numpy as np
+        import pandas as pd
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+        rng = np.random.default_rng(19)
+        d1, d2 = tmp_path / "a", tmp_path / "b"
+        d1.mkdir(); d2.mkdir()
+        pq.write_table(pa.Table.from_pandas(pd.DataFrame({
+            "k": rng.integers(0, 30, 400).astype(np.int64),
+            "v": rng.integers(0, 99, 400).astype(np.int64)})),
+            d1 / "p.parquet")
+        pq.write_table(pa.table({
+            "k2": pa.array(np.arange(30, dtype=np.int64)),
+            "w": pa.array(rng.integers(0, 99, 30).astype(np.int64))}),
+            d2 / "p.parquet")
+        session = hst.Session(system_path=str(tmp_path / "idx"))
+        return session, session.read.parquet(str(d1)), \
+            session.read.parquet(str(d2))
+
+    def test_conjuncts_split_to_sides(self, joined):
+        session, a, b = joined
+        q = (a.join(b, on=col("k") == col("k2"))
+             .filter((col("v") > 50) & (col("w") < 40)
+                     & (col("v") + col("w") < 120)))
+        plan = session.optimize(q.plan).tree_string()
+        lines = plan.splitlines()
+        join_at = next(i for i, l in enumerate(lines) if "Join" in l)
+        # Single-side conjuncts are BELOW the join, the mixed one above.
+        assert any("col(v) > lit(50)" in l for l in lines[join_at:])
+        assert any("col(w) < lit(40)" in l for l in lines[join_at:])
+        assert any("(col(v) + col(w)) < lit(120)" in l
+                   for l in lines[:join_at])
+        # Oracle.
+        got = q.to_pandas().sort_values(["k", "v", "w"]).reset_index(drop=True)
+        pdf_a, pdf_b = a.to_pandas(), b.to_pandas()
+        m = pdf_a.merge(pdf_b, left_on="k", right_on="k2")
+        exp = (m[(m.v > 50) & (m.w < 40) & (m.v + m.w < 120)]
+               .sort_values(["k", "v", "w"]).reset_index(drop=True)
+               [["k", "v", "k2", "w"]])
+        import pandas as pd
+        pd.testing.assert_frame_equal(got[["k", "v", "k2", "w"]], exp)
+
+    def test_outer_join_untouched(self, joined):
+        session, a, b = joined
+        q = (a.join(b, on=col("k") == col("k2"), how="left")
+             .filter(col("w") < 40))
+        plan = session.optimize(q.plan).tree_string()
+        lines = plan.splitlines()
+        join_at = next(i for i, l in enumerate(lines) if "Join" in l)
+        # The right-side predicate must stay ABOVE the left outer join.
+        assert any("col(w) < lit(40)" in l for l in lines[:join_at])
+        assert not any("Filter" in l for l in lines[join_at:])
