@@ -339,6 +339,63 @@ def append_lineitem_files(li_dir: str, n_li: int, seed: int = 99) -> int:
     return n_new
 
 
+class _CompileLogBank:
+    """Context manager capturing jax's per-program compile log into RESULT
+    and spilling the partial file around every compile, so a hang inside the
+    tunnel's remote-compile service (the round-3 killer: it dies during
+    Q3's compile burst and the process blocks forever in an uninterruptible
+    recv) leaves the NAME of the exact in-flight program in the spill the
+    watchdog recovers. jax_log_compiles emits at WARNING, so no logger
+    level changes are needed."""
+
+    def __init__(self, name: str):
+        self._key = f"compile_log_{name}"
+        self._loggers = []
+        self._handler = None
+        self._prev = None
+
+    def __enter__(self):
+        import logging
+
+        import jax
+
+        bank = self
+
+        class _H(logging.Handler):
+            def emit(self, record):
+                try:
+                    msg = record.getMessage()
+                except Exception:
+                    return
+                if "ompil" not in msg:  # Compiling / compiled / compilation
+                    return
+                RESULT.setdefault(bank._key, []).append(msg[:300])
+                RESULT["compile_in_flight"] = msg[:300]
+                _spill_partial()
+
+        self._handler = _H(level=logging.DEBUG)
+        self._prev = jax.config.jax_log_compiles
+        jax.config.update("jax_log_compiles", True)
+        for mod in ("jax._src.dispatch", "jax._src.interpreters.pxla",
+                    "jax._src.compiler"):
+            lg = logging.getLogger(mod)
+            lg.addHandler(self._handler)
+            self._loggers.append(lg)
+        return self
+
+    def __exit__(self, et, ev, tb):
+        import jax
+        jax.config.update("jax_log_compiles", self._prev)
+        for lg in self._loggers:
+            lg.removeHandler(self._handler)
+        if et is None:
+            # Clean exit: nothing is in flight any more. On an exception or
+            # a hang the last compile line stays behind as the attribution.
+            RESULT.pop("compile_in_flight", None)
+            _spill_partial()
+        return False
+
+
 def timed_best(fn, repeats: int) -> float:
     best = float("inf")
     for _ in range(repeats):
@@ -427,11 +484,36 @@ def _run_with_watchdog(argv: List[str], total_timeout: float) -> int:
     env = dict(os.environ)
     env["BENCH_CHILD_PARTIAL"] = partial
     try:
-        out = subprocess.run(
+        # Popen + SIGTERM-with-grace, never a straight SIGKILL: round 3
+        # showed a SIGKILLed child (holding the tunnel's device claim)
+        # wedges jax.devices() for every later client until the claim
+        # leases out. SIGTERM's default disposition kills the process at
+        # the OS level even when it is blocked in an uninterruptible recv,
+        # and lets the kernel close the claim socket in the normal path.
+        proc = subprocess.Popen(
             [sys.executable, os.path.abspath(__file__)] + argv,
-            env=env, timeout=total_timeout, capture_output=True, text=True)
-        last = (out.stdout or "").strip().splitlines()
-        if out.returncode == 0 and last:
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            text=True)
+        try:
+            stdout, stderr = proc.communicate(timeout=total_timeout)
+            timed_out = False
+        except subprocess.TimeoutExpired:
+            timed_out = True
+            proc.terminate()
+            try:
+                stdout, stderr = proc.communicate(timeout=20)
+            except subprocess.TimeoutExpired:
+                proc.kill()  # last resort only, after the SIGTERM grace
+                try:
+                    stdout, stderr = proc.communicate(timeout=10)
+                except subprocess.TimeoutExpired:
+                    # A child wedged in an uninterruptible (D-state) recv
+                    # defers even SIGKILL; blocking on it forever would
+                    # wedge the WATCHDOG. Abandon the pipes — the partial
+                    # spill below is the recovery path.
+                    stdout, stderr = "", "child unkillable (D-state?)"
+        last = (stdout or "").strip().splitlines()
+        if not timed_out and proc.returncode == 0 and last:
             print(last[-1])
             return 0
         # Child died without printing: recover its spilled partial state.
@@ -440,21 +522,16 @@ def _run_with_watchdog(argv: List[str], total_timeout: float) -> int:
                 RESULT.update(json.load(f))
         except (OSError, ValueError):
             pass
-        RESULT["errors"].append(
-            f"bench child rc={out.returncode}; "
-            f"stderr tail={_tail(out.stderr)}")
-    except subprocess.TimeoutExpired as e:
-        try:
-            with open(partial) as f:
-                RESULT.update(json.load(f))
-        except (OSError, ValueError):
-            pass
-        so = e.stdout.decode() if isinstance(e.stdout, bytes) else e.stdout
-        se = e.stderr.decode() if isinstance(e.stderr, bytes) else e.stderr
-        RESULT["errors"].append(
-            f"bench child timed out after {total_timeout:.0f}s in phase "
-            f"{RESULT.get('phase_current', '?')!r}; stdout tail={_tail(so)}; "
-            f"stderr tail={_tail(se)}")
+        if timed_out:
+            RESULT["errors"].append(
+                f"bench child timed out after {total_timeout:.0f}s in phase "
+                f"{RESULT.get('phase_current', '?')!r} "
+                f"(in-flight compile: {RESULT.get('compile_in_flight')}); "
+                f"stdout tail={_tail(stdout)}; stderr tail={_tail(stderr)}")
+        else:
+            RESULT["errors"].append(
+                f"bench child rc={proc.returncode}; "
+                f"stderr tail={_tail(stderr)}")
     finally:
         try:
             os.unlink(partial)
@@ -606,7 +683,8 @@ def _single_device_phases(args, root):
         # steady-state build throughput (comparable to the JVM
         # baseline's warmed executors).
         t0 = time.perf_counter()
-        build_all()
+        with _CompileLogBank("build"):
+            build_all()
         cold_build_s = time.perf_counter() - t0
         RESULT["index_build_cold_s"] = round(cold_build_s, 3)
         for name in ("li_idx", "od_idx", "li_ship_idx"):
@@ -702,9 +780,9 @@ def _single_device_phases(args, root):
             RESULT["errors"].append(
                 f"time_{name} skipped: backend dead")
             continue
-        with _phase(f"time_{name}"):
+        with _phase(f"time_{name}"), _CompileLogBank(name):
             session.enable_hyperspace()
-            q.to_arrow()  # warm indexed path
+            q.to_arrow()  # warm indexed path (compiles bank per-program)
             session.disable_hyperspace()
             q.to_arrow()  # warm scan path
             scan_s = timed_best(lambda: q.to_arrow(), args.repeats)

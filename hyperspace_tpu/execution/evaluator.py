@@ -71,6 +71,16 @@ def eval_expr(table: Table, e: E.Expr) -> Column:
         return _eval_in(table, e)
     if isinstance(e, (E.Add, E.Subtract, E.Multiply, E.Divide)):
         return _eval_arith(table, e)
+    if isinstance(e, E.Like):
+        return _eval_like(table, e)
+    if isinstance(e, E.IsNull):
+        return _eval_is_null(table, e)
+    if isinstance(e, E.CaseWhen):
+        return _eval_case_when(table, e)
+    if isinstance(e, E.DatePart):
+        return _eval_date_part(table, e)
+    if isinstance(e, (E.Substring, E.StringTransform)):
+        return _eval_string_transform(table, e)
     raise HyperspaceException(f"Cannot evaluate expression: {e!r}")
 
 
@@ -227,6 +237,219 @@ def _eval_in(table: Table, e: E.In) -> Column:
     for v in values[1:]:
         mask = mask | compare_literal(col, "EqualTo", v)
     return Column(BOOL, mask, col.validity)
+
+
+def like_pattern_to_regex(pattern: str) -> str:
+    """SQL LIKE → anchored regex: % = any run, _ = any one char, the rest
+    literal."""
+    import re as _re
+
+    out = []
+    for ch in pattern:
+        if ch == "%":
+            out.append(".*")
+        elif ch == "_":
+            out.append(".")
+        else:
+            out.append(_re.escape(ch))
+    return "".join(out)
+
+
+def _eval_like(table: Table, e: "E.Like") -> Column:
+    """LIKE over the order-preserving dictionary: match each distinct
+    string ONCE on the host, then one device gather maps codes → bool.
+    Cost is O(|dict|) host regex + O(n) gather — the dictionary-encoding
+    analogue of Spark evaluating LIKE per row."""
+    import re as _re
+
+    import numpy as np
+
+    col = eval_expr(table, e.child)
+    if col.dtype != STRING:
+        raise HyperspaceException(f"LIKE requires a string operand: {e!r}")
+    # DOTALL: SQL's % and _ match newlines too (Spark wraps in (?s)).
+    rx = _re.compile(like_pattern_to_regex(e.pattern), _re.DOTALL)
+    dict_mask = np.fromiter(
+        (rx.fullmatch(s) is not None for s in col.dictionary),
+        dtype=np.bool_, count=len(col.dictionary))
+    if e.negated:
+        dict_mask = ~dict_mask
+    if dict_mask.all() or not dict_mask.any():
+        # Constant over the dictionary: skip the gather entirely.
+        data = jnp.full(len(col), bool(dict_mask.all()) if len(dict_mask)
+                        else e.negated, jnp.bool_)
+        return Column(BOOL, data, col.validity)
+    data = jnp.take(jnp.asarray(dict_mask), col.data)
+    return Column(BOOL, data, col.validity)
+
+
+def _eval_is_null(table: Table, e: "E.IsNull") -> Column:
+    col = eval_expr(table, e.child)
+    if col.validity is None:
+        data = jnp.full(len(col), e.negated, jnp.bool_)
+    else:
+        data = col.validity if e.negated else ~col.validity
+    return Column(BOOL, data, None)  # IS NULL itself is never null.
+
+
+def _eval_case_when(table: Table, e: "E.CaseWhen") -> Column:
+    """First-true-condition-wins where-chain. A null condition falls
+    through (SQL: null is not true); the selected branch's own validity
+    carries; no match and no ELSE yields null."""
+    import numpy as np
+
+    n = table.num_rows
+    conds = []
+    for c, _ in e.branches:
+        cc = eval_expr(table, c)
+        if cc.dtype != BOOL:
+            raise HyperspaceException(f"CASE condition is not boolean: {c!r}")
+        t = cc.data
+        if cc.validity is not None:
+            t = t & cc.validity
+        conds.append(t)
+
+    def value_col(v) -> Optional[Column]:
+        if isinstance(v, E.Lit):
+            if v.value is None:
+                return None  # typed after unification (all-null column)
+            # Materialize the literal as a constant column of the right
+            # logical type (strings get a one-entry dictionary, unified
+            # below).
+            import datetime as _dt
+            if isinstance(v.value, str):
+                return Column(STRING, jnp.zeros(n, jnp.int32), None,
+                              np.asarray([v.value]))
+            if isinstance(v.value, bool):
+                return Column(BOOL, jnp.full(n, v.value, jnp.bool_), None)
+            if isinstance(v.value, int):
+                return Column(INT64, jnp.full(n, v.value, jnp.int64), None)
+            if isinstance(v.value, float):
+                return Column(FLOAT64, jnp.full(n, v.value, jnp.float64), None)
+            if isinstance(v.value, _dt.date):
+                days = (v.value - _dt.date(1970, 1, 1)).days
+                from ..schema import DATE
+                return Column(DATE, jnp.full(n, days, jnp.int32), None)
+            raise HyperspaceException(f"Unsupported CASE literal {v.value!r}")
+        return eval_expr(table, v)
+
+    vals = [value_col(v) for _, v in e.branches]
+    if e.else_value is not None:
+        vals.append(value_col(e.else_value))
+    vals = _unify_branch_columns(vals, n)
+    # Fold right-to-left so the FIRST true condition wins.
+    if e.else_value is not None:
+        acc = vals[-1]
+        branch_vals = vals[:-1]
+    else:
+        proto = vals[0]
+        acc = Column(proto.dtype,
+                     jnp.zeros(n, proto.data.dtype),
+                     jnp.zeros(n, jnp.bool_), proto.dictionary)
+        branch_vals = vals
+    data, validity = acc.data, acc.validity
+    for cond, v in zip(reversed(conds), reversed(branch_vals)):
+        data = jnp.where(cond, v.data, data)
+        v_valid = v.validity if v.validity is not None \
+            else jnp.ones(n, jnp.bool_)
+        a_valid = validity if validity is not None else jnp.ones(n, jnp.bool_)
+        new_valid = jnp.where(cond, v_valid, a_valid)
+        validity = None if (v.validity is None and validity is None) \
+            else new_valid
+    return Column(vals[0].dtype, data, validity, vals[0].dictionary)
+
+
+def _unify_branch_columns(vals, n: int):
+    """Bring all CASE branch values into one dtype (+ one dictionary for
+    strings) so the where-chain operates on compatible arrays. ``None``
+    entries (explicit NULL branches) materialize as all-null columns of
+    the unified type."""
+    import numpy as np
+
+    typed = [v for v in vals if v is not None]
+    if not typed:
+        raise HyperspaceException("CASE with only NULL branches has no type")
+    if len(typed) < len(vals):
+        typed = _unify_branch_columns(typed, n)
+        proto = typed[0]
+        null_col = Column(proto.dtype, jnp.zeros(n, proto.data.dtype),
+                          jnp.zeros(n, jnp.bool_), proto.dictionary)
+        it = iter(typed)
+        return [null_col if v is None else next(it) for v in vals]
+    kinds = {v.dtype for v in vals}
+    if kinds == {STRING}:
+        dicts = [v.dictionary for v in vals]
+        if all(dictionaries_equal(dicts[0], d) for d in dicts[1:]):
+            return vals
+        union = np.unique(np.concatenate(dicts))
+        return [Column(STRING, translate_codes(union, v), v.validity, union)
+                for v in vals]
+    if len(kinds) == 1:
+        return vals
+    if STRING in kinds:
+        raise HyperspaceException(
+            f"CASE branches mix string and non-string types: {sorted(kinds)}")
+    target = jnp.float64 if any(
+        jnp.issubdtype(v.data.dtype, jnp.floating) for v in vals) \
+        else jnp.int64
+    dtype = FLOAT64 if target == jnp.float64 else INT64
+    return [Column(dtype, v.data.astype(target), v.validity) for v in vals]
+
+
+def _eval_date_part(table: Table, e: "E.DatePart") -> Column:
+    """EXTRACT over date32 days: the branch-free civil-from-days algorithm
+    (integer ops only — vectorizes onto the VPU with no host round-trip)."""
+    from ..schema import DATE
+
+    col = eval_expr(table, e.child)
+    if col.dtype != DATE:
+        raise HyperspaceException(f"EXTRACT requires a date operand: {e!r}")
+    z = col.data.astype(jnp.int64) + 719468
+    era = z // 146097
+    doe = z - era * 146097
+    yoe = (doe - doe // 1460 + doe // 36524 - doe // 146096) // 365
+    y = yoe + era * 400
+    doy = doe - (365 * yoe + yoe // 4 - yoe // 100)
+    mp = (5 * doy + 2) // 153
+    d = doy - (153 * mp + 2) // 5 + 1
+    m = mp + jnp.where(mp < 10, 3, -9)
+    year = y + (m <= 2)
+    out = {"year": year, "month": m, "day": d,
+           "quarter": (m - 1) // 3 + 1}[e.part]
+    return Column(INT64, out.astype(jnp.int64), col.validity)
+
+
+def _eval_string_transform(table: Table, e) -> Column:
+    """SUBSTRING/UPPER/LOWER/TRIM: transform each distinct dictionary
+    entry once on the host, re-encode (the transform can collapse or
+    reorder entries), then remap codes with one gather."""
+    import numpy as np
+
+    col = eval_expr(table, e.child)
+    if col.dtype != STRING:
+        raise HyperspaceException(f"{e.op_name} requires a string operand")
+    if isinstance(e, E.Substring):
+        # Spark/Hive semantics: 1-based positive start; negative start
+        # counts from the END of the string; start 0 behaves like 1. A
+        # virtual start before the beginning still consumes length
+        # (substring('abc', -5, 4) = 'ab'), so clamp AFTER computing the
+        # window — never Python's negative-index slicing.
+        def fn(s):
+            n = len(s)
+            p = e.start
+            start = p - 1 if p > 0 else (n + p if p < 0 else 0)
+            end = n if e.length is None else start + max(e.length, 0)
+            lo = min(max(start, 0), n)
+            return s[lo:max(end, lo)]
+    else:
+        fn = {"upper": str.upper, "lower": str.lower,
+              "trim": str.strip}[e.fn]
+    transformed = np.asarray([fn(s) for s in col.dictionary])
+    if len(transformed) == 0:
+        return Column(STRING, col.data, col.validity, transformed)
+    union, inverse = np.unique(transformed, return_inverse=True)
+    codes = jnp.take(jnp.asarray(inverse.astype(np.int32)), col.data)
+    return Column(STRING, codes, col.validity, union)
 
 
 def _eval_arith(table: Table, e) -> Column:

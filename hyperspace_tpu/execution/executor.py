@@ -688,6 +688,8 @@ def _execute_join(plan: Join, needed: Optional[Set[str]]) -> Table:
     right = _execute(plan.right, rneed)
 
     how = plan.join_type
+    if how in ("semi", "anti"):
+        return _execute_semi_anti_join(left, right, norm, how)
     if how == "right":
         # right join = left join with the sides swapped: the output below
         # is assembled by column NAME against plan.schema, so the swap is
@@ -746,6 +748,33 @@ def _execute_join(plan: Join, needed: Optional[Set[str]]) -> Table:
     if lbo is not None and all(k in out for k in lbo[1]):
         order_out = lbo
     return Table(out, bucket_order=order_out)
+
+
+def _execute_semi_anti_join(left: Table, right: Table, norm,
+                            how: str) -> Table:
+    """Existence probe (SQL [NOT] IN / [NOT] EXISTS lowering): keep left
+    rows with (semi) / without (anti) a key match on the right. No match
+    expansion — membership is one sort + searchsorted, O(n log m). Null
+    left keys never match (kept by anti, dropped by semi); null right keys
+    are discarded up front. Left row order (and any bucket order) is
+    preserved, the filter-like shape downstream rules rely on."""
+    lkeys, rkeys = _join_key_arrays(left, right, norm)
+    lvalid = _keys_validity(left, [p[0] for p in norm])
+    rvalid = _keys_validity(right, [p[1] for p in norm])
+    if rvalid is not None:
+        rkeys = rkeys[rvalid]
+    n_right = rkeys.shape[0]
+    if n_right == 0:
+        found = jnp.zeros(lkeys.shape[0], jnp.bool_)
+    else:
+        rsorted = jnp.sort(rkeys)
+        pos = jnp.searchsorted(rsorted, lkeys)
+        found = (pos < n_right) & (
+            jnp.take(rsorted, jnp.minimum(pos, n_right - 1)) == lkeys)
+    if lvalid is not None:
+        found = found & lvalid
+    mask = found if how == "semi" else ~found
+    return left.filter(mask)
 
 
 def _null_filled_like(table: Table, n: int) -> Dict[str, Column]:

@@ -83,6 +83,20 @@ class Expr:
     def alias(self, name: str):
         return Alias(self, name)
 
+    def like(self, pattern: str):
+        """SQL LIKE (% = any run, _ = any one char), full-string match."""
+        return Like(self, pattern)
+
+    def is_null(self):
+        return IsNull(self)
+
+    def is_not_null(self):
+        return IsNull(self, negated=True)
+
+    def substr(self, start: int, length: Optional[int] = None):
+        """SQL SUBSTRING: 1-based ``start``, optional ``length``."""
+        return Substring(self, start, length)
+
     @property
     def references(self) -> List[str]:
         out: List[str] = []
@@ -245,6 +259,149 @@ class Alias(Expr):
         return f"{self.child!r} AS {self.alias_name}"
 
 
+class Like(Expr):
+    """SQL LIKE predicate. The reference inherits Spark's full expression
+    surface (rules/FilterIndexRule.scala:165-186 matches ANY Filter
+    condition); LIKE is the workhorse of TPC-H/TPC-DS string predicates
+    (e.g. tpcds/queries' p_type filters). Evaluated over the
+    order-preserving string dictionary, so the per-row cost is one gather.
+    """
+
+    op_name = "Like"
+
+    def __init__(self, child: Expr, pattern: str, negated: bool = False):
+        if not isinstance(pattern, str):
+            raise HyperspaceException("LIKE pattern must be a string literal")
+        self.child = child
+        self.pattern = pattern
+        self.negated = negated
+
+    @property
+    def children(self) -> List[Expr]:
+        return [self.child]
+
+    def __repr__(self):
+        return (f"{self.child!r} {'NOT ' if self.negated else ''}"
+                f"LIKE {self.pattern!r}")
+
+
+class IsNull(Expr):
+    """IS [NOT] NULL predicate (never yields null itself)."""
+
+    op_name = "IsNull"
+
+    def __init__(self, child: Expr, negated: bool = False):
+        self.child = child
+        self.negated = negated
+
+    @property
+    def children(self) -> List[Expr]:
+        return [self.child]
+
+    def __repr__(self):
+        return f"{self.child!r} IS {'NOT ' if self.negated else ''}NULL"
+
+
+class CaseWhen(Expr):
+    """CASE WHEN c1 THEN v1 [WHEN ...]* [ELSE e] END. A null/false
+    condition falls through; no matching branch and no ELSE yields null
+    (SQL semantics)."""
+
+    op_name = "CaseWhen"
+
+    def __init__(self, branches: Sequence[Tuple[Expr, Expr]],
+                 else_value: Optional[Expr] = None):
+        if not branches:
+            raise HyperspaceException("CASE requires at least one WHEN")
+        self.branches = [(c, _wrap(v)) for c, v in branches]
+        self.else_value = _wrap(else_value) if else_value is not None \
+            and not isinstance(else_value, Expr) else else_value
+
+    @property
+    def children(self) -> List[Expr]:
+        out: List[Expr] = []
+        for c, v in self.branches:
+            out.extend((c, v))
+        if self.else_value is not None:
+            out.append(self.else_value)
+        return out
+
+    def __repr__(self):
+        parts = " ".join(f"WHEN {c!r} THEN {v!r}" for c, v in self.branches)
+        tail = f" ELSE {self.else_value!r}" if self.else_value is not None else ""
+        return f"CASE {parts}{tail} END"
+
+
+_DATE_PARTS = ("year", "month", "day", "quarter")
+
+
+class DatePart(Expr):
+    """EXTRACT(part FROM date) — year/month/day/quarter as int64."""
+
+    op_name = "DatePart"
+
+    def __init__(self, part: str, child: Expr):
+        part = part.lower()
+        if part not in _DATE_PARTS:
+            raise HyperspaceException(
+                f"EXTRACT supports {_DATE_PARTS}, got {part!r}")
+        self.part = part
+        self.child = child
+
+    @property
+    def children(self) -> List[Expr]:
+        return [self.child]
+
+    def __repr__(self):
+        return f"EXTRACT({self.part} FROM {self.child!r})"
+
+
+class Substring(Expr):
+    """SQL SUBSTRING with 1-based literal start/length (evaluated on the
+    string dictionary, one re-encode + gather per column)."""
+
+    op_name = "Substring"
+
+    def __init__(self, child: Expr, start: int, length: Optional[int] = None):
+        if not isinstance(start, int) or \
+                (length is not None and not isinstance(length, int)):
+            raise HyperspaceException(
+                "SUBSTRING start/length must be integer literals")
+        self.child = child
+        self.start = start
+        self.length = length
+
+    @property
+    def children(self) -> List[Expr]:
+        return [self.child]
+
+    def __repr__(self):
+        tail = f", {self.length}" if self.length is not None else ""
+        return f"SUBSTRING({self.child!r}, {self.start}{tail})"
+
+
+class StringTransform(Expr):
+    """UPPER/LOWER/TRIM — per-dictionary-entry host transform + gather."""
+
+    _FNS = ("upper", "lower", "trim")
+    op_name = "StringTransform"
+
+    def __init__(self, fn: str, child: Expr):
+        fn = fn.lower()
+        if fn not in self._FNS:
+            raise HyperspaceException(
+                f"String function must be one of {self._FNS}, got {fn!r}")
+        self.fn = fn
+        self.child = child
+
+    @property
+    def children(self) -> List[Expr]:
+        return [self.child]
+
+    def __repr__(self):
+        return f"{self.fn.upper()}({self.child!r})"
+
+
 # ---------------------------------------------------------------------------
 # Aggregates.
 # ---------------------------------------------------------------------------
@@ -338,9 +495,82 @@ def count_distinct(e) -> CountDistinct:
     return CountDistinct(_wrap(e) if not isinstance(e, Expr) else e)
 
 
+def case_when(branches: Sequence[Tuple[Expr, Any]],
+              else_value: Any = None) -> CaseWhen:
+    return CaseWhen([(c, _wrap(v)) for c, v in branches],
+                    _wrap(else_value) if else_value is not None else None)
+
+
+def year(e) -> DatePart:
+    return DatePart("year", _wrap(e))
+
+
+def month(e) -> DatePart:
+    return DatePart("month", _wrap(e))
+
+
+def dayofmonth(e) -> DatePart:
+    return DatePart("day", _wrap(e))
+
+
+def quarter(e) -> DatePart:
+    return DatePart("quarter", _wrap(e))
+
+
+def substring(e, start: int, length: Optional[int] = None) -> Substring:
+    return Substring(_wrap(e), start, length)
+
+
+def upper(e) -> StringTransform:
+    return StringTransform("upper", _wrap(e))
+
+
+def lower(e) -> StringTransform:
+    return StringTransform("lower", _wrap(e))
+
+
+def trim(e) -> StringTransform:
+    return StringTransform("trim", _wrap(e))
+
+
 # ---------------------------------------------------------------------------
 # Predicate utilities used by the rewrite rules.
 # ---------------------------------------------------------------------------
+
+def map_children(e: Expr, fn) -> Expr:
+    """Rebuild ``e`` with every direct child replaced by ``fn(child)``.
+    The single structural-rewrite primitive: rename_columns, the SQL
+    front-end's alias resolution, and the rules' substitution walkers all
+    ride on it, so a new Expr kind only needs one case here."""
+    if isinstance(e, (Col, Lit)):
+        return e
+    if isinstance(e, _Binary):
+        return type(e)(fn(e.left), fn(e.right))
+    if isinstance(e, Not):
+        return Not(fn(e.child))
+    if isinstance(e, In):
+        return In(fn(e.value), [fn(o) for o in e.options])
+    if isinstance(e, Alias):
+        return Alias(fn(e.child), e.alias_name)
+    if isinstance(e, Like):
+        return Like(fn(e.child), e.pattern, e.negated)
+    if isinstance(e, IsNull):
+        return IsNull(fn(e.child), e.negated)
+    if isinstance(e, CaseWhen):
+        return CaseWhen([(fn(c), fn(v)) for c, v in e.branches],
+                        fn(e.else_value) if e.else_value is not None else None)
+    if isinstance(e, DatePart):
+        return DatePart(e.part, fn(e.child))
+    if isinstance(e, Substring):
+        return Substring(fn(e.child), e.start, e.length)
+    if isinstance(e, StringTransform):
+        return StringTransform(e.fn, fn(e.child))
+    if isinstance(e, AggExpr):
+        if e.child is None:
+            return e
+        return type(e)(fn(e.child))
+    raise HyperspaceException(f"Cannot rewrite expression {e!r}")
+
 
 def rename_columns(e: Expr, rename) -> Expr:
     """Rebuild ``e`` with every Col reference passed through ``rename``
@@ -351,23 +581,7 @@ def rename_columns(e: Expr, rename) -> Expr:
     if isinstance(e, Col):
         new = rename(e.column)
         return e if new == e.column else Col(new)
-    if isinstance(e, Lit):
-        return e
-    if isinstance(e, _Binary):
-        return type(e)(rename_columns(e.left, rename),
-                       rename_columns(e.right, rename))
-    if isinstance(e, Not):
-        return Not(rename_columns(e.child, rename))
-    if isinstance(e, In):
-        return In(rename_columns(e.value, rename),
-                  [rename_columns(o, rename) for o in e.options])
-    if isinstance(e, Alias):
-        return Alias(rename_columns(e.child, rename), e.alias_name)
-    if isinstance(e, AggExpr):
-        if e.child is None:
-            return e
-        return type(e)(rename_columns(e.child, rename))
-    raise HyperspaceException(f"Cannot rewrite expression {e!r}")
+    return map_children(e, lambda c: rename_columns(c, rename))
 
 
 def split_conjunctive_predicates(e: Expr) -> List[Expr]:
@@ -375,6 +589,15 @@ def split_conjunctive_predicates(e: Expr) -> List[Expr]:
     if isinstance(e, And):
         return split_conjunctive_predicates(e.left) + split_conjunctive_predicates(e.right)
     return [e]
+
+
+def conjoin(parts: Sequence[Expr]) -> Expr:
+    """Left-fold a non-empty predicate list back into one AND tree (the
+    inverse of split_conjunctive_predicates for left-associated input)."""
+    out = parts[0]
+    for p in parts[1:]:
+        out = out & p
+    return out
 
 
 def extract_equi_join_keys(condition: Expr) -> Optional[List[Tuple[str, str]]]:

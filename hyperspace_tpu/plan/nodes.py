@@ -36,8 +36,34 @@ def infer_dtype(e: E.Expr, schema: Schema) -> str:
             return STRING
         raise HyperspaceException(f"Cannot infer type of literal {v!r}")
     if isinstance(e, (E.EqualTo, E.LessThan, E.LessThanOrEqual, E.GreaterThan,
-                      E.GreaterThanOrEqual, E.And, E.Or, E.Not, E.In)):
+                      E.GreaterThanOrEqual, E.And, E.Or, E.Not, E.In,
+                      E.Like, E.IsNull)):
         return BOOL
+    if isinstance(e, E.DatePart):
+        return INT64
+    if isinstance(e, (E.Substring, E.StringTransform)):
+        if infer_dtype(e.child, schema) != STRING:
+            raise HyperspaceException(
+                f"{e.op_name} requires a string operand: {e!r}")
+        return STRING
+    if isinstance(e, E.CaseWhen):
+        values = [v for _, v in e.branches]
+        if e.else_value is not None:
+            values.append(e.else_value)
+        # Explicit NULL branches contribute nullability, not a type.
+        kinds = [infer_dtype(v, schema) for v in values
+                 if not (isinstance(v, E.Lit) and v.value is None)]
+        if not kinds:
+            raise HyperspaceException(
+                f"CASE with only NULL branches has no type: {e!r}")
+        uniq = set(kinds)
+        if len(uniq) == 1:
+            return kinds[0]
+        numeric = {INT64, "int32", FLOAT64, "float32"}
+        if uniq <= numeric:
+            return FLOAT64 if (FLOAT64 in uniq or "float32" in uniq) else INT64
+        raise HyperspaceException(
+            f"CASE branches have incompatible types {sorted(uniq)}: {e!r}")
     if isinstance(e, (E.Add, E.Subtract, E.Multiply)):
         kinds = {infer_dtype(c, schema) for c in e.children}
         return FLOAT64 if (FLOAT64 in kinds or "float32" in kinds) else INT64
@@ -218,7 +244,7 @@ class Project(LogicalPlan):
 class Join(LogicalPlan):
     def __init__(self, left: LogicalPlan, right: LogicalPlan, condition: E.Expr,
                  join_type: str = "inner"):
-        if join_type not in ("inner", "left", "right", "full"):
+        if join_type not in ("inner", "left", "right", "full", "semi", "anti"):
             raise HyperspaceException(f"Unsupported join type: {join_type}")
         overlap = set(left.schema.names) & set(right.schema.names)
         if overlap:
@@ -235,6 +261,12 @@ class Join(LogicalPlan):
         self.right = right
         self.condition = condition
         self.join_type = join_type
+        if join_type in ("semi", "anti"):
+            # Semi/anti joins emit only the left side's rows (the right
+            # side is an existence probe) — the lowering target for SQL
+            # [NOT] IN / [NOT] EXISTS subqueries.
+            self._schema = left.schema
+            return
         # Outer joins null-fill the non-preserved side's columns.
         if join_type != "inner":
             from ..schema import Field

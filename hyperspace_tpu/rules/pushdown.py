@@ -24,44 +24,39 @@ from __future__ import annotations
 
 from typing import Dict, List, Optional
 
+from ..exceptions import HyperspaceException
 from ..plan import expr as E
 from ..plan.nodes import Filter, Join, LogicalPlan, Project
+
+
+class _NotPushable(Exception):
+    pass
 
 
 def _substitute(e: E.Expr, mapping: Dict[str, E.Expr]) -> Optional[E.Expr]:
     """Rebuild ``e`` with every Col reference replaced by the projection
     expression that produces it. Returns None for expression kinds we
-    don't know how to rebuild (the filter then stays where it is)."""
-    if isinstance(e, E.Col):
-        return mapping.get(e.column, e)
-    if isinstance(e, E.Lit):
-        return e
-    if isinstance(e, E.Alias):
-        child = _substitute(e.child, mapping)
-        return None if child is None else E.Alias(child, e.alias_name)
-    if isinstance(e, E.Not):
-        child = _substitute(e.child, mapping)
-        return None if child is None else E.Not(child)
-    if isinstance(e, E.In):
-        value = _substitute(e.value, mapping)
-        options = [_substitute(o, mapping) for o in e.options]
-        if value is None or any(o is None for o in options):
-            return None
-        return E.In(value, options)
-    if isinstance(e, E._Binary):
-        left = _substitute(e.left, mapping)
-        right = _substitute(e.right, mapping)
-        if left is None or right is None:
-            return None
-        return type(e)(left, right)
-    return None  # AggExpr or future kinds: not pushable.
+    don't know how to rebuild (the filter then stays where it is).
+    Structural recursion rides on E.map_children, so every scalar
+    expression kind (LIKE, CASE, EXTRACT, ...) is pushable by default;
+    aggregates and unknown kinds are not."""
+
+    def rec(node: E.Expr) -> E.Expr:
+        if isinstance(node, E.Col):
+            return mapping.get(node.column, node)
+        if isinstance(node, E.Lit):
+            return node
+        if isinstance(node, E.AggExpr):
+            raise _NotPushable
+        return E.map_children(node, rec)
+
+    try:
+        return rec(e)
+    except (_NotPushable, HyperspaceException):
+        return None
 
 
-def _conjoin(parts: List[E.Expr]) -> E.Expr:
-    out = parts[0]
-    for p in parts[1:]:
-        out = out & p
-    return out
+_conjoin = E.conjoin
 
 
 def push_filters(plan: LogicalPlan) -> LogicalPlan:

@@ -1,33 +1,58 @@
-"""A minimal SQL SELECT front-end over registered temp views.
+"""A SQL SELECT front-end over registered temp views.
 
 The reference's users write Spark SQL; this framework's primary surface is
 the DataFrame IR, and `session.sql(...)` lowers a practical SELECT subset
 onto it — so every index rewrite, skipping rule, and execution path behaves
-exactly as for the equivalent DataFrame query.
+exactly as for the equivalent DataFrame query. The grammar is wide enough
+to run the verbatim TPC-H texts the reference exercises through Spark
+(goldstandard/TPCDSBase.scala pattern; tests/test_tpch_sql.py runs the
+actual query texts).
 
 Supported grammar (case-insensitive keywords):
 
     query      := select [UNION ALL select]*
     select     := SELECT [DISTINCT] <*| expr [AS name], ...>
-                  FROM table_ref
-                  [ [INNER|LEFT|RIGHT|FULL] JOIN table_ref
-                    ON a = b [AND c = d] ]*
+                  FROM table_ref [[AS] alias] [, table_ref [[AS] alias]]*
+                  [ [INNER|LEFT|RIGHT|FULL] JOIN table_ref ON a = b [AND ...] ]*
                   [WHERE <predicate>]
                   [GROUP BY col, ...] [HAVING <predicate>]
                   [ORDER BY col [ASC|DESC], ...] [LIMIT n]
     table_ref  := <view> | ( select ) [AS name]
 
-Expressions: identifiers, integer/float/string literals, DATE 'yyyy-mm-dd',
-+ - * /, comparisons (= != <> < <= > >=), BETWEEN x AND y, [NOT] IN (...),
-AND/OR/NOT, and aggregates SUM/AVG/MIN/MAX/COUNT(*)/COUNT(x)/
-COUNT(DISTINCT x). Everything else raises a clear error naming the token.
+Comma-separated FROM lists are lowered to inner joins using the WHERE
+clause's equality predicates (single-table conjuncts pre-filter their
+table; predicates common to every branch of a top-level OR are factored
+out first, so the TPC-H Q19 shape finds its join key).
+
+Expressions: identifiers (optionally alias-qualified: ``l.l_orderkey``),
+integer/float/string literals, DATE 'yyyy-mm-dd', INTERVAL 'n' DAY|MONTH|
+YEAR (folded into date literals at parse time), + - * /, comparisons
+(= != <> < <= > >=), [NOT] BETWEEN x AND y, [NOT] IN (...), [NOT] LIKE,
+IS [NOT] NULL, CASE [x] WHEN ... THEN ... [ELSE ...] END,
+EXTRACT(YEAR|MONTH|DAY|QUARTER FROM x), SUBSTRING(x FROM a [FOR b]) or
+SUBSTRING(x, a, b), UPPER/LOWER/TRIM, AND/OR/NOT, and aggregates
+SUM/AVG/MIN/MAX/COUNT(*)/COUNT(x)/COUNT(DISTINCT x) — including
+arithmetic OVER aggregates (``100 * sum(a) / sum(b)``).
+
+Subqueries in WHERE (as top-level conjuncts):
+  * ``x [NOT] IN (SELECT col FROM t [WHERE ...])``      → semi/anti join
+  * ``[NOT] EXISTS (SELECT ... FROM t WHERE corr)``     → semi/anti join
+  * ``expr <op> (SELECT <agg> FROM t WHERE corr)``      → decorrelated
+    group-by + join (the TPC-H Q17 shape)
+Correlation must be equality predicates; the subquery body is a single
+optionally-filtered table. Everything else raises a clear error naming
+the unsupported construct.
+
+NOT IN follows the non-null convention (a null in the subquery result
+does not veto every row) — documented divergence from three-valued SQL,
+matching the TPC-H data contract where join keys are non-null.
 """
 
 from __future__ import annotations
 
 import datetime
 import re
-from typing import List, Optional, Tuple
+from typing import Dict, List, Optional, Tuple
 
 from .exceptions import HyperspaceException
 from .plan import expr as E
@@ -36,16 +61,29 @@ _TOKEN = re.compile(r"""
     \s*(?:
       (?P<date>DATE\s*'(\d{4}-\d{2}-\d{2})')
     | (?P<str>'(?:[^']|'')*')
-    | (?P<num>\d+\.\d+|\d+)
+    | (?P<num>\d+\.\d+|\.\d+|\d+)
     | (?P<ident>[A-Za-z_][A-Za-z_0-9.]*)
-    | (?P<op><>|!=|<=|>=|=|<|>|\(|\)|,|\*|/|\+|-)
+    | (?P<op><>|!=|<=|>=|=|<|>|\(|\)|,|\*|/|\+|-|;)
     )""", re.VERBOSE | re.IGNORECASE)
 
 _KEYWORDS = {
     "SELECT", "FROM", "WHERE", "GROUP", "BY", "HAVING", "ORDER", "LIMIT",
     "JOIN", "INNER", "LEFT", "RIGHT", "FULL", "OUTER", "ON", "AS", "AND",
-    "OR", "NOT", "IN", "BETWEEN", "ASC", "DESC", "DATE", "DISTINCT", "UNION", "ALL",
+    "OR", "NOT", "IN", "BETWEEN", "ASC", "DESC", "DATE", "DISTINCT",
+    "UNION", "ALL",
     "SUM", "AVG", "MIN", "MAX", "COUNT",
+    "LIKE", "IS", "NULL", "CASE", "WHEN", "THEN", "ELSE", "END",
+    "EXTRACT", "INTERVAL", "DAY", "MONTH", "YEAR", "QUARTER",
+    "EXISTS", "SUBSTRING", "FOR", "UPPER", "LOWER", "TRIM",
+}
+
+# Words that are only meaningful in specific grammar positions (EXTRACT's
+# field, INTERVAL's unit, SUBSTRING's FOR, function names before '(').
+# Everywhere else they are ordinary identifiers — Spark SQL reserves almost
+# nothing, so a column named ``year`` must stay reachable.
+_SOFT_KEYWORDS = {
+    "YEAR", "MONTH", "DAY", "QUARTER", "FOR",
+    "UPPER", "LOWER", "TRIM", "SUBSTRING", "EXTRACT",
 }
 
 
@@ -68,15 +106,128 @@ def _tokenize(text: str) -> List[Tuple[str, str]]:
         elif m.group("num"):
             out.append(("NUM", m.group("num")))
         elif m.group("ident"):
+            # KW tokens keep the RAW spelling: soft keywords double as
+            # identifiers (take_name) and must preserve the user's case
+            # for output aliases. Comparisons normalize in the helpers.
             word = m.group("ident")
             if word.upper() in _KEYWORDS:
-                out.append(("KW", word.upper()))
+                out.append(("KW", word))
             else:
                 out.append(("IDENT", word))
         else:
             out.append(("OP", m.group("op")))
+    # Statement terminator: legal only in the trailing position. A ';'
+    # anywhere else stays a token the grammar will reject — silently
+    # dropping it would splice two statements into one.
+    while out and out[-1] == ("OP", ";"):
+        out.pop()
     out.append(("EOF", ""))
     return out
+
+
+# ---------------------------------------------------------------------------
+# Subquery / interval parse-time markers (never reach the execution engine).
+# ---------------------------------------------------------------------------
+
+class _SubQ:
+    """Structural (unanalyzed) subquery: SELECT items FROM one table
+    [WHERE expr]. Kept unresolved because correlated references would not
+    validate against the inner schema until the transform classifies them."""
+
+    def __init__(self, items, star: bool, table: str, alias: Optional[str],
+                 where: Optional[E.Expr]):
+        self.items = items  # [(expr, alias)]
+        self.star = star
+        self.table = table
+        self.alias = alias
+        self.where = where
+
+
+class _ScalarSubquery(E.Expr):
+    def __init__(self, subq: _SubQ):
+        self.subq = subq
+
+    def __repr__(self):
+        return "(scalar subquery)"
+
+
+class _InSubquery(E.Expr):
+    def __init__(self, value: E.Expr, subq: _SubQ, negated: bool):
+        self.value = value
+        self.subq = subq
+        self.negated = negated
+
+    @property
+    def children(self):
+        return [self.value]
+
+    def __repr__(self):
+        return f"{self.value!r} {'NOT ' if self.negated else ''}IN (subquery)"
+
+
+class _ExistsSubquery(E.Expr):
+    def __init__(self, subq: _SubQ, negated: bool):
+        self.subq = subq
+        self.negated = negated
+
+    def __repr__(self):
+        return f"{'NOT ' if self.negated else ''}EXISTS (subquery)"
+
+
+_SUBQUERY_MARKERS = (_ScalarSubquery, _InSubquery, _ExistsSubquery)
+
+
+def _contains_subquery(e: E.Expr) -> bool:
+    if isinstance(e, _SUBQUERY_MARKERS):
+        return True
+    return any(_contains_subquery(c) for c in e.children)
+
+
+class _IntervalLit(E.Expr):
+    """INTERVAL 'n' DAY|MONTH|YEAR — only valid added to / subtracted from
+    a date literal, folded at parse time."""
+
+    def __init__(self, n: int, unit: str):
+        self.n = n
+        self.unit = unit
+
+    def __repr__(self):
+        return f"INTERVAL '{self.n}' {self.unit}"
+
+
+def _shift_date(d: datetime.date, n: int, unit: str) -> datetime.date:
+    if unit == "DAY":
+        return d + datetime.timedelta(days=n)
+    months = n * (12 if unit == "YEAR" else 1)
+    m0 = d.month - 1 + months
+    y, m = d.year + m0 // 12, m0 % 12 + 1
+    # Clamp to month length (SQL date arithmetic convention).
+    last = [31, 29 if (y % 4 == 0 and (y % 100 != 0 or y % 400 == 0)) else 28,
+            31, 30, 31, 30, 31, 31, 30, 31, 30, 31][m - 1]
+    return datetime.date(y, m, min(d.day, last))
+
+
+class _Scope:
+    """Alias/table-name → DataFrame bindings (chained for subqueries)."""
+
+    def __init__(self, parent: Optional["_Scope"] = None):
+        self.bindings: Dict[str, object] = {}
+        self.parent = parent
+
+    def bind(self, name: str, df) -> None:
+        self.bindings[name.lower()] = df
+
+    def lookup(self, prefix: str):
+        s = self
+        while s is not None:
+            if prefix.lower() in s.bindings:
+                return s.bindings[prefix.lower()]
+            s = s.parent
+        return None
+
+
+def _has_col(df, name: str) -> bool:
+    return df._spelling(name) in df.plan.schema.names
 
 
 class _Parser:
@@ -84,30 +235,55 @@ class _Parser:
         self.session = session
         self.toks = _tokenize(text)
         self.i = 0
+        self._sq_counter = 0
 
     # -- token helpers ---------------------------------------------------
+    @staticmethod
+    def _norm(k: str, v: str) -> str:
+        """Comparison form of a token value (keywords case-fold)."""
+        return v.upper() if k == "KW" else v
+
     def peek(self, kind: str = None, value: str = None) -> bool:
         k, v = self.toks[self.i]
         if kind is not None and k != kind:
             return False
-        if value is not None and v != value:
+        if value is not None and self._norm(k, v) != value:
             return False
         return True
+
+    def peek2(self, kind: str, value: str = None) -> bool:
+        if self.i + 1 >= len(self.toks):
+            return False
+        k, v = self.toks[self.i + 1]
+        return k == kind and (value is None or self._norm(k, v) == value)
 
     def take(self, kind: str = None, value: str = None) -> str:
         k, v = self.toks[self.i]
         if (kind is not None and k != kind) or \
-                (value is not None and v != value):
+                (value is not None and self._norm(k, v) != value):
             raise HyperspaceException(
                 f"SQL: expected {value or kind} but found {v or k!r}")
         self.i += 1
-        return v
+        return self._norm(k, v)
 
     def accept(self, kind: str, value: str = None) -> bool:
         if self.peek(kind, value):
             self.i += 1
             return True
         return False
+
+    def peek_name(self) -> bool:
+        """True when the next token can serve as an identifier — a plain
+        IDENT or a soft keyword used outside its special position."""
+        k, v = self.toks[self.i]
+        return k == "IDENT" or (k == "KW" and v.upper() in _SOFT_KEYWORDS)
+
+    def take_name(self) -> str:
+        k, v = self.toks[self.i]
+        if k == "KW" and v.upper() in _SOFT_KEYWORDS:
+            self.i += 1
+            return v  # raw spelling: identifiers keep the user's case
+        return self.take("IDENT")
 
     # -- expressions -----------------------------------------------------
     def expr(self) -> E.Expr:
@@ -126,12 +302,30 @@ class _Parser:
         return e
 
     def _not(self) -> E.Expr:
+        if self.peek("KW", "NOT") and self.peek2("KW", "EXISTS"):
+            self.take("KW", "NOT")
+            self.take("KW", "EXISTS")
+            return _ExistsSubquery(self._exists_body(), negated=True)
+        if self.accept("KW", "EXISTS"):
+            return _ExistsSubquery(self._exists_body(), negated=False)
         if self.accept("KW", "NOT"):
             return ~self._not()
         return self._comparison()
 
+    def _exists_body(self) -> _SubQ:
+        self.take("OP", "(")
+        sub = self._subquery_struct()
+        self.take("OP", ")")
+        return sub
+
     def _comparison(self) -> E.Expr:
         left = self._additive()
+        if self.accept("KW", "IS"):
+            negated = self.accept("KW", "NOT")
+            self.take("KW", "NULL")
+            return E.IsNull(left, negated)
+        if self.accept("KW", "LIKE"):
+            return E.Like(left, self.take("STR"))
         if self.accept("KW", "BETWEEN"):
             lo = self._additive()
             self.take("KW", "AND")
@@ -139,8 +333,16 @@ class _Parser:
             return left.between(_lit_value(lo), _lit_value(hi))
         negated = False
         if self.peek("KW", "NOT"):
-            # Only NOT IN reaches here (prefix NOT handled above).
+            # Postfix negations: NOT IN / NOT LIKE / NOT BETWEEN (prefix
+            # NOT is handled one level up).
             self.take("KW", "NOT")
+            if self.accept("KW", "LIKE"):
+                return E.Like(left, self.take("STR"), negated=True)
+            if self.accept("KW", "BETWEEN"):
+                lo = self._additive()
+                self.take("KW", "AND")
+                hi = self._additive()
+                return ~left.between(_lit_value(lo), _lit_value(hi))
             self.take("KW", "IN")
             negated = True
         elif self.accept("KW", "IN"):
@@ -157,6 +359,10 @@ class _Parser:
                     return make(left, self._additive())
             return left
         self.take("OP", "(")
+        if self.peek("KW", "SELECT"):
+            sub = self._subquery_struct()
+            self.take("OP", ")")
+            return _InSubquery(left, sub, negated)
         values = [_lit_value(self._additive())]
         while self.accept("OP", ","):
             values.append(_lit_value(self._additive()))
@@ -168,13 +374,26 @@ class _Parser:
         e = self._multiplicative()
         while True:
             if self.accept("OP", "+"):
-                e = _fold(e, self._multiplicative(), lambda a, b: a + b,
-                          lambda a, b: a + b)
+                e = self._add_or_shift(e, self._multiplicative(), +1)
             elif self.accept("OP", "-"):
-                e = _fold(e, self._multiplicative(), lambda a, b: a - b,
-                          lambda a, b: a - b)
+                e = self._add_or_shift(e, self._multiplicative(), -1)
             else:
                 return e
+
+    def _add_or_shift(self, a: E.Expr, b: E.Expr, sign: int) -> E.Expr:
+        if isinstance(b, _IntervalLit):
+            if not (isinstance(a, E.Lit)
+                    and isinstance(a.value, datetime.date)):
+                raise HyperspaceException(
+                    "SQL: INTERVAL arithmetic is only supported against "
+                    "DATE literals")
+            return E.lit(_shift_date(a.value, sign * b.n, b.unit))
+        if isinstance(a, _IntervalLit):
+            raise HyperspaceException(
+                "SQL: INTERVAL must follow a DATE literal")
+        if sign > 0:
+            return _fold(a, b, lambda x, y: x + y, lambda x, y: x + y)
+        return _fold(a, b, lambda x, y: x - y, lambda x, y: x - y)
 
     def _multiplicative(self) -> E.Expr:
         e = self._atom()
@@ -194,14 +413,55 @@ class _Parser:
             return _fold(E.lit(0), self._atom(), lambda a, b: a - b,
                          lambda a, b: a - b)
         if self.accept("OP", "("):
+            if self.peek("KW", "SELECT"):
+                sub = self._subquery_struct()
+                self.take("OP", ")")
+                return _ScalarSubquery(sub)
             e = self.expr()
             self.take("OP", ")")
             return e
-        if self.peek("KW") and self.toks[self.i][1] in (
+        if self.accept("KW", "CASE"):
+            return self._case()
+        # Function-named soft keywords act as functions only when a '('
+        # follows; bare they fall through to the identifier branch below
+        # (a column named ``extract`` or ``trim`` stays reachable).
+        if self.peek("KW", "EXTRACT") and self.peek2("OP", "("):
+            self.take("KW")
+            self.take("OP", "(")
+            part = self.take("KW")
+            if part not in ("YEAR", "MONTH", "DAY", "QUARTER"):
+                raise HyperspaceException(
+                    f"SQL: EXTRACT supports YEAR/MONTH/DAY/QUARTER, "
+                    f"got {part}")
+            self.take("KW", "FROM")
+            inner = self.expr()
+            self.take("OP", ")")
+            return E.DatePart(part.lower(), inner)
+        if self.peek("KW", "SUBSTRING") and self.peek2("OP", "("):
+            self.take("KW")
+            return self._substring()
+        for fn in ("UPPER", "LOWER", "TRIM"):
+            if self.peek("KW", fn) and self.peek2("OP", "("):
+                self.take("KW")
+                self.take("OP", "(")
+                inner = self.expr()
+                self.take("OP", ")")
+                return E.StringTransform(fn.lower(), inner)
+        if self.accept("KW", "INTERVAL"):
+            raw = self.take("STR")
+            if not raw.strip().lstrip("-").isdigit():
+                raise HyperspaceException(
+                    f"SQL: INTERVAL takes an integer string, got {raw!r}")
+            unit = self.take("KW")
+            if unit not in ("DAY", "MONTH", "YEAR"):
+                raise HyperspaceException(
+                    f"SQL: INTERVAL unit must be DAY/MONTH/YEAR, got {unit}")
+            return _IntervalLit(int(raw), unit)
+        if self.peek("KW") and self.toks[self.i][1].upper() in (
                 "SUM", "AVG", "MIN", "MAX", "COUNT"):
             return self._aggregate()
-        if self.peek("IDENT"):
-            return E.col(self.take("IDENT"))
+        if self.peek_name():
+            return E.col(self.take_name())
         if self.peek("NUM"):
             raw = self.take("NUM")
             return E.lit(float(raw) if "." in raw else int(raw))
@@ -209,8 +469,52 @@ class _Parser:
             return E.lit(self.take("STR"))
         if self.peek("DATE_LIT"):
             return E.lit(datetime.date.fromisoformat(self.take("DATE_LIT")))
+        if self.peek("KW", "NULL"):
+            self.take("KW", "NULL")
+            return E.lit(None)
         raise HyperspaceException(
             f"SQL: unexpected token {self.toks[self.i][1]!r}")
+
+    def _case(self) -> E.Expr:
+        operand = None
+        if not self.peek("KW", "WHEN"):
+            operand = self.expr()  # simple CASE: CASE x WHEN v THEN r ...
+        branches = []
+        while self.accept("KW", "WHEN"):
+            c = self.expr()
+            if operand is not None:
+                c = E.EqualTo(operand, c)
+            self.take("KW", "THEN")
+            branches.append((c, self.expr()))
+        if not branches:
+            raise HyperspaceException("SQL: CASE requires at least one WHEN")
+        else_v = self.expr() if self.accept("KW", "ELSE") else None
+        self.take("KW", "END")
+        return E.CaseWhen(branches, else_v)
+
+    def _substring(self) -> E.Expr:
+        self.take("OP", "(")
+        inner = self.expr()
+        length = None
+        if self.accept("KW", "FROM"):
+            start = self._int_literal()
+            if self.accept("KW", "FOR"):
+                length = self._int_literal()
+        else:
+            self.take("OP", ",")
+            start = self._int_literal()
+            if self.accept("OP", ","):
+                length = self._int_literal()
+        self.take("OP", ")")
+        return E.Substring(inner, start, length)
+
+    def _int_literal(self, what: str = "") -> int:
+        neg = self.accept("OP", "-")
+        raw = self.take("NUM")
+        if "." in raw:
+            raise HyperspaceException(
+                f"SQL: {what or 'expected'} an integer, found {raw!r}")
+        return -int(raw) if neg else int(raw)
 
     def _aggregate(self) -> E.Expr:
         fn = self.take("KW")
@@ -230,6 +534,64 @@ class _Parser:
         self.take("OP", ")")
         return {"SUM": E.sum_, "AVG": E.avg,
                 "MIN": E.min_, "MAX": E.max_}[fn](inner)
+
+    # -- subquery structure ----------------------------------------------
+    def _subquery_struct(self) -> _SubQ:
+        """SELECT <*|items> FROM <table> [[AS] alias] [WHERE expr] — the
+        body stays structural (no DataFrame ops yet: correlated references
+        would not resolve against the inner schema)."""
+        self.take("KW", "SELECT")
+        items, star = [], False
+        if self.accept("OP", "*"):
+            star = True
+        else:
+            items.append(self._select_item())
+            while self.accept("OP", ","):
+                items.append(self._select_item())
+        self.take("KW", "FROM")
+        if self.peek("OP", "("):
+            raise HyperspaceException(
+                "SQL: subqueries over derived tables are not supported")
+        table = self.take_name()
+        alias = None
+        if self.accept("KW", "AS"):
+            alias = self.take_name()
+        elif self.peek_name():
+            alias = self.take_name()
+        where = self.expr() if self.accept("KW", "WHERE") else None
+        if self.peek("KW") and self.toks[self.i][1].upper() in ("GROUP", "ORDER",
+                                                        "HAVING", "JOIN"):
+            raise HyperspaceException(
+                f"SQL: {self.toks[self.i][1]} inside subqueries is not "
+                "supported (single filtered table only)")
+        return _SubQ(items, star, table, alias, where)
+
+    # -- qualified-name resolution ----------------------------------------
+    def _resolve_quals(self, e: E.Expr, scope: _Scope) -> E.Expr:
+        """Strip alias qualifiers (``l.l_orderkey`` → ``l_orderkey``) once
+        the FROM clause has bound them. Unknown prefixes pass through (they
+        may be flattened struct leaves like ``detail.price``)."""
+        if isinstance(e, E.Col):
+            return E.Col(self._resolve_qual_name(e.column, scope))
+        if isinstance(e, (_ScalarSubquery, _ExistsSubquery)):
+            return e  # inner names resolve at transform time
+        if isinstance(e, _InSubquery):
+            return _InSubquery(self._resolve_quals(e.value, scope),
+                               e.subq, e.negated)
+        return E.map_children(e, lambda c: self._resolve_quals(c, scope))
+
+    def _resolve_qual_name(self, name: str, scope: _Scope) -> str:
+        if "." not in name:
+            return name
+        prefix, rest = name.split(".", 1)
+        df = scope.lookup(prefix)
+        if df is None:
+            return name  # struct leaf or unknown: downstream error names it
+        if not _has_col(df, rest):
+            raise HyperspaceException(
+                f"SQL: {name!r}: table alias {prefix!r} has no column "
+                f"{rest!r}; available: {df.plan.schema.names}")
+        return df._spelling(rest)
 
     # -- query -----------------------------------------------------------
     def query(self):
@@ -256,25 +618,39 @@ class _Parser:
                 orders.append(self._order_item())
             df = df.sort(*orders)
         if self.accept("KW", "LIMIT"):
-            raw = self.take("NUM")
-            if "." in raw:
+            n = self._int_literal("LIMIT expects")
+            if n < 0:
                 raise HyperspaceException(
-                    f"SQL: LIMIT takes an integer, found {raw!r}")
-            df = df.limit(int(raw))
+                    f"SQL: LIMIT expects a non-negative integer, got {n}")
+            df = df.limit(n)
         return df
 
-    def _table_ref(self):
+    def _table_ref(self, scope: _Scope):
+        """One FROM-list entry: returns (df, bound-name or None). The
+        binding (alias if given, else the table name) feeds qualified-name
+        resolution."""
         if self.accept("OP", "("):
             # Derived table: ( query-body ) [AS name] — may itself contain
             # UNION ALL and its own ORDER BY/LIMIT.
             inner = self._query_body()
             self.take("OP", ")")
+            alias = None
             if self.accept("KW", "AS"):
-                self.take("IDENT")
-            elif self.peek("IDENT"):
-                self.take("IDENT")
-            return inner
-        return self.session.table(self.take("IDENT"))
+                alias = self.take_name()
+            elif self.peek_name():
+                alias = self.take_name()
+            if alias:
+                scope.bind(alias, inner)
+            return inner, alias
+        name = self.take_name()
+        df = self.session.table(name)
+        alias = None
+        if self.accept("KW", "AS"):
+            alias = self.take_name()
+        elif self.peek_name():
+            alias = self.take_name()
+        scope.bind(alias or name, df)
+        return df, alias or name
 
     def _select_stmt(self):
         self.take("KW", "SELECT")
@@ -288,22 +664,47 @@ class _Parser:
             while self.accept("OP", ","):
                 items.append(self._select_item())
 
+        scope = _Scope()
         self.take("KW", "FROM")
-        df = self._table_ref()
+        refs = [self._table_ref(scope)]
+        while self.accept("OP", ","):
+            refs.append(self._table_ref(scope))
 
-        while self.peek("KW") and self.toks[self.i][1] in (
-                "JOIN", "INNER", "LEFT", "RIGHT", "FULL"):
-            df = self._join(df)
+        if len(refs) == 1:
+            df = refs[0][0]
+            while self.peek("KW") and self.toks[self.i][1].upper() in (
+                    "JOIN", "INNER", "LEFT", "RIGHT", "FULL"):
+                df = self._join(df, scope)
+            if self.accept("KW", "WHERE"):
+                cond = self._resolve_quals(self.expr(), scope)
+                if _contains_subquery(cond):
+                    df = self._apply_where_with_subqueries(df, cond, scope)
+                else:
+                    df = df.filter(cond)
+        else:
+            if self.peek("KW") and self.toks[self.i][1].upper() in (
+                    "JOIN", "INNER", "LEFT", "RIGHT", "FULL"):
+                raise HyperspaceException(
+                    "SQL: mixing comma-joins with explicit JOIN syntax is "
+                    "not supported")
+            cond = None
+            if self.accept("KW", "WHERE"):
+                cond = self._resolve_quals(self.expr(), scope)
+            df = self._build_implicit_joins(refs, cond, scope)
 
-        if self.accept("KW", "WHERE"):
-            df = df.filter(self.expr())
+        # Resolve alias-qualified names in the select list now that the
+        # FROM clause has bound the aliases.
+        items = [(self._resolve_quals(e, scope) if e is not None else None,
+                  alias) for e, alias in items]
 
         group_cols: List[str] = []
         if self.accept("KW", "GROUP"):
             self.take("KW", "BY")
-            group_cols.append(self.take("IDENT"))
+            group_cols.append(
+                self._resolve_qual_name(self.take_name(), scope))
             while self.accept("OP", ","):
-                group_cols.append(self.take("IDENT"))
+                group_cols.append(
+                    self._resolve_qual_name(self.take_name(), scope))
 
         has_agg = any(_contains_agg(e) for e, _ in items if e is not None)
         if group_cols or has_agg:
@@ -316,12 +717,27 @@ class _Parser:
             group_resolved = [spell(g) for g in group_cols]
             aggs, out_cols, out_names = [], [], []
             aliased = False
+            compound = False
             for e, alias in items:
                 if _contains_agg(e):
-                    named = e.alias(alias) if alias else e
-                    aggs.append(named)
-                    out_cols.append(named.name)
-                    out_names.append(named.name)
+                    base = e.child if isinstance(e, E.Alias) else e
+                    if isinstance(base, E.AggExpr):
+                        named = e.alias(alias) if alias else e
+                        aggs.append(named)
+                        out_cols.append(named.name)
+                        out_names.append(named.name)
+                    else:
+                        # Arithmetic over aggregates (``100*sum(a)/sum(b)``):
+                        # materialize each aggregate as a hidden column and
+                        # compute the arithmetic in a post-projection.
+                        compound = True
+                        rewritten, hidden = _lift_aggs(
+                            e, f"__item_{len(out_cols)}")
+                        aggs.extend(hidden)
+                        named = rewritten.alias(alias) if alias \
+                            else rewritten.alias(e.name)
+                        out_cols.append(named)
+                        out_names.append(named.name)
                 else:
                     if not isinstance(e, E.Col):
                         raise HyperspaceException(
@@ -347,8 +763,8 @@ class _Parser:
             # the output order to the SELECT order).
             having: Optional[E.Expr] = None
             if self.accept("KW", "HAVING"):
-                having = self.expr()
-                having, hidden = _lift_having_aggs(having, n_visible)
+                having = self._resolve_quals(self.expr(), scope)
+                having, hidden = _lift_aggs(having, f"__having_{n_visible}")
                 aggs.extend(hidden)
             df = (df.group_by(*group_cols).agg(*aggs) if group_cols
                   else df.agg(*aggs))
@@ -357,10 +773,12 @@ class _Parser:
             # Project only when the SELECT list differs from the
             # aggregate's natural output (group cols then aggregates) —
             # a redundant Project would make SQL plans diverge from the
-            # equivalent DataFrame plans. Aliases on group columns and
-            # hidden HAVING aggregates always force the projection.
+            # equivalent DataFrame plans. Aliases on group columns,
+            # compound aggregate items, and hidden HAVING aggregates
+            # always force the projection.
             natural = group_resolved + visible_agg_names
-            if aliased or out_names != natural or len(aggs) != n_visible:
+            if aliased or compound or out_names != natural \
+                    or len(aggs) != n_visible:
                 df = df.select(*out_cols)
         elif not star:
             df = df.select(*[e.alias(alias) if alias else e
@@ -369,28 +787,47 @@ class _Parser:
                 raise HyperspaceException(
                     "SQL: HAVING requires GROUP BY or aggregates")
 
+        if star:
+            # Scalar-subquery lowering joins hidden __sqN_* helper columns
+            # onto the plan; SELECT * must not expose them.
+            leaked = [n for n in df.plan.schema.names
+                      if re.match(r"__sq\d+_", n)]
+            if leaked:
+                df = df.select(*[n for n in df.plan.schema.names
+                                 if not re.match(r"__sq\d+_", n)])
+
         if distinct:
             df = df.distinct()
 
+        # ORDER BY qualified-name resolution. Assigned on the way OUT so a
+        # derived table's inner select (which runs this method re-entrantly
+        # mid-FROM) can't leave ITS scope behind as the binding for the
+        # outer query's ORDER BY.
+        self._last_scope = scope
         return df
 
     def _select_item(self):
         e = self.expr()
         alias = None
         if self.accept("KW", "AS"):
-            alias = self.take("IDENT")
-        elif self.peek("IDENT"):
-            alias = self.take("IDENT")
+            alias = self.take_name()
+        elif self.peek_name():
+            alias = self.take_name()
         return e, alias
 
     def _order_item(self):
-        name = self.take("IDENT")
+        name = self.take_name()
+        # Alias-qualified order keys (``o.o_orderdate``) resolve against
+        # the most recent select's FROM bindings; unknown prefixes pass
+        # through (flattened struct leaves sort by their dotted name).
+        name = self._resolve_qual_name(
+            name, getattr(self, "_last_scope", None) or _Scope())
         if self.accept("KW", "DESC"):
             return (name, False)
         self.accept("KW", "ASC")
         return (name, True)
 
-    def _join(self, df):
+    def _join(self, df, scope: _Scope):
         how = "inner"
         if self.accept("KW", "LEFT"):
             how = "left"
@@ -402,9 +839,9 @@ class _Parser:
             self.accept("KW", "INNER")
         self.accept("KW", "OUTER")
         self.take("KW", "JOIN")
-        other = self._table_ref()
+        other, _alias = self._table_ref(scope)
         self.take("KW", "ON")
-        cond = self._join_condition()
+        cond = self._resolve_quals(self._join_condition(), scope)
         return df.join(other, on=cond, how=how)
 
     def _join_condition(self) -> E.Expr:
@@ -414,18 +851,348 @@ class _Parser:
         return cond
 
     def _join_eq(self) -> E.Expr:
-        left = E.col(self.take("IDENT"))
+        left = E.col(self.take_name())
         self.take("OP", "=")
-        return left == E.col(self.take("IDENT"))
+        return left == E.col(self.take_name())
+
+    # -- implicit joins (comma-separated FROM) ---------------------------
+    def _build_implicit_joins(self, refs, cond: Optional[E.Expr],
+                              scope: _Scope):
+        """Lower ``FROM a, b, c WHERE ...`` to inner joins: single-table
+        conjuncts pre-filter their table, two-table equality conjuncts
+        become join conditions, the rest (and subquery conjuncts) apply
+        after the joins. Predicates common to all branches of a top-level
+        OR are factored out first (the Q19 shape: the join key equality
+        is repeated inside each OR branch)."""
+        dfs = [r[0] for r in refs]
+        labels = [r[1] or f"table#{i}" for i, r in enumerate(refs)]
+        conjuncts: List[E.Expr] = []
+        if cond is not None:
+            for c in E.split_conjunctive_predicates(cond):
+                conjuncts.extend(_factor_common_or(c))
+
+        def owner(refs_set):
+            """Index of the unique table containing all refs, else None
+            (ambiguous references stay post-join, where the Join
+            constructor's duplicate-column check gives a clear error)."""
+            hits = [i for i, d in enumerate(dfs)
+                    if all(_has_col(d, r) for r in refs_set)]
+            return hits[0] if len(hits) == 1 else None
+
+        pre: Dict[int, List[E.Expr]] = {}
+        edges: List[Tuple[int, int, E.Expr]] = []
+        post: List[E.Expr] = []
+        subs: List[E.Expr] = []
+        for c in conjuncts:
+            if _contains_subquery(c):
+                subs.append(c)
+                continue
+            refs_set = set(c.references)
+            if isinstance(c, E.EqualTo) and isinstance(c.left, E.Col) \
+                    and isinstance(c.right, E.Col):
+                li = owner({c.left.column})
+                ri = owner({c.right.column})
+                if li is not None and ri is not None and li != ri:
+                    edges.append((li, ri, c))
+                    continue
+            o = owner(refs_set) if refs_set else None
+            if o is not None:
+                pre.setdefault(o, []).append(c)
+            else:
+                post.append(c)
+
+        for i, preds in pre.items():
+            dfs[i] = dfs[i].filter(_conjoin(preds))
+
+        joined = {0}
+        cur = dfs[0]
+        remaining = set(range(1, len(dfs)))
+        while remaining:
+            pick = None
+            for t in sorted(remaining):
+                conds = [p for (a, b, p) in edges
+                         if (a in joined and b == t)
+                         or (b in joined and a == t)]
+                if conds:
+                    pick = (t, conds)
+                    break
+            if pick is None:
+                missing = ", ".join(labels[t] for t in sorted(remaining))
+                raise HyperspaceException(
+                    f"SQL: no equality predicate joins {missing} to the "
+                    "rest of the FROM list (cross joins are not supported)")
+            t, conds = pick
+            cur = cur.join(dfs[t], on=_conjoin(conds), how="inner")
+            joined.add(t)
+            remaining.remove(t)
+
+        for c in post:
+            cur = cur.filter(c)
+        for c in subs:
+            cur = self._apply_subquery_conjunct(cur, c, scope)
+        return cur
+
+    # -- subquery lowering ------------------------------------------------
+    def _apply_where_with_subqueries(self, df, cond: E.Expr, scope: _Scope):
+        plain: List[E.Expr] = []
+        subs: List[E.Expr] = []
+        for c in E.split_conjunctive_predicates(cond):
+            (subs if _contains_subquery(c) else plain).append(c)
+        if plain:
+            df = df.filter(_conjoin(plain))
+        for c in subs:
+            df = self._apply_subquery_conjunct(df, c, scope)
+        return df
+
+    def _apply_subquery_conjunct(self, df, c: E.Expr, scope: _Scope):
+        if isinstance(c, _ExistsSubquery):
+            return self._lower_semi_anti(df, c.subq, scope,
+                                         value=None, negated=c.negated)
+        if isinstance(c, _InSubquery):
+            if not isinstance(c.value, E.Col):
+                raise HyperspaceException(
+                    "SQL: [NOT] IN (SELECT ...) requires a plain column "
+                    f"on the left, got {c.value!r}")
+            return self._lower_semi_anti(df, c.subq, scope,
+                                         value=c.value, negated=c.negated)
+        if isinstance(c, E._Binary) and not isinstance(c, (E.And, E.Or)):
+            sides = [c.left, c.right]
+            marks = [isinstance(s, _ScalarSubquery) for s in sides]
+            if sum(marks) == 1:
+                return self._lower_scalar(df, c, scope)
+        raise HyperspaceException(
+            "SQL: subqueries are only supported as top-level WHERE "
+            f"conjuncts (EXISTS / IN / scalar comparison); got {c!r}")
+
+    def _analyze_subquery(self, subq: _SubQ, scope: _Scope, outer_df):
+        """Split the subquery's WHERE into local predicates and correlated
+        equality pairs (inner column, outer column).
+
+        Side classification happens on the still-QUALIFIED names: when the
+        subquery reads the same table as the outer query (the TPC-H Q21
+        family), ``t2.g = t.g`` must stay a correlation even though both
+        sides strip to the same bare column."""
+        inner = self.session.table(subq.table)
+        child = _Scope(parent=scope)
+        inner_name = (subq.alias or subq.table).lower()
+        child.bind(inner_name, inner)
+
+        def side(col: E.Col) -> str:
+            """'inner' | 'outer' | 'unknown' for one column reference,
+            honoring explicit qualifiers before schema membership."""
+            name = col.column
+            if "." in name:
+                prefix, rest = name.split(".", 1)
+                if prefix.lower() == inner_name:
+                    return "inner" if _has_col(inner, rest) else "unknown"
+                if scope.lookup(prefix) is not None:
+                    d = scope.lookup(prefix)
+                    return "outer" if _has_col(d, rest) else "unknown"
+                # Unknown prefix: maybe a struct leaf of the inner table.
+                return "inner" if _has_col(inner, name) else (
+                    "outer" if _has_col(outer_df, name) else "unknown")
+            if _has_col(inner, name):
+                return "inner"  # inner scope shadows outer (SQL scoping)
+            if _has_col(outer_df, name):
+                return "outer"
+            return "unknown"
+
+        def bare(col: E.Col) -> str:
+            return self._resolve_qual_name(col.column, child)
+
+        local: List[E.Expr] = []
+        corr: List[Tuple[str, str]] = []
+        conjuncts = [] if subq.where is None else \
+            E.split_conjunctive_predicates(subq.where)
+        for c in conjuncts:
+            if _contains_subquery(c):
+                raise HyperspaceException(
+                    "SQL: nested subqueries are not supported")
+            if isinstance(c, E.EqualTo) and isinstance(c.left, E.Col) \
+                    and isinstance(c.right, E.Col):
+                ls, rs = side(c.left), side(c.right)
+                if ls == "inner" and rs == "outer":
+                    corr.append((inner._spelling(bare(c.left)),
+                                 outer_df._spelling(bare(c.right))))
+                    continue
+                if ls == "outer" and rs == "inner":
+                    corr.append((inner._spelling(bare(c.right)),
+                                 outer_df._spelling(bare(c.left))))
+                    continue
+            resolved = self._resolve_quals(c, child)
+            refs = set(resolved.references)
+            cols = _collect_cols(c)
+            if all(_has_col(inner, r) for r in refs) \
+                    and all(side(col) != "outer" for col in cols):
+                local.append(resolved)
+                continue
+            raise HyperspaceException(
+                "SQL: unsupported correlated predicate in subquery "
+                f"(only equality correlation): {c!r}")
+        if local:
+            inner = inner.filter(_conjoin(local))
+        return inner, corr, child
+
+    def _lower_semi_anti(self, df, subq: _SubQ, scope: _Scope,
+                         value: Optional[E.Col], negated: bool):
+        """[NOT] IN / [NOT] EXISTS → semi/anti join (the TPU engine's
+        existence probe keeps the left side's row and bucket order)."""
+        inner, corr, child = self._analyze_subquery(subq, scope, df)
+        i = self._sq_counter
+        self._sq_counter += 1
+        keys: List[Tuple[str, str]] = []  # (inner col, outer col)
+        if value is not None:
+            if subq.star or len(subq.items) != 1 \
+                    or not isinstance(subq.items[0][0], E.Col):
+                raise HyperspaceException(
+                    "SQL: IN subqueries must select exactly one column")
+            inner_col = self._resolve_qual_name(subq.items[0][0].column,
+                                                child)
+            if not _has_col(inner, inner_col):
+                raise HyperspaceException(
+                    f"SQL: subquery selects unknown column {inner_col!r}")
+            keys.append((inner._spelling(inner_col),
+                         df._spelling(value.column)))
+        keys.extend(corr)
+        if not keys:
+            raise HyperspaceException(
+                "SQL: EXISTS subqueries must be correlated by at least "
+                "one equality predicate")
+        sel = [E.col(k_in).alias(f"__sq{i}_k{j}")
+               for j, (k_in, _) in enumerate(keys)]
+        sub = inner.select(*sel)
+        cond = None
+        for j, (_, k_out) in enumerate(keys):
+            eq = E.col(k_out) == E.col(f"__sq{i}_k{j}")
+            cond = eq if cond is None else (cond & eq)
+        return df.join(sub, on=cond, how="anti" if negated else "semi")
+
+    def _lower_scalar(self, df, comparison: E._Binary, scope: _Scope):
+        """``expr <op> (SELECT agg FROM t WHERE corr)`` — the TPC-H Q17
+        shape. Decorrelated exactly as the reference's users hand-write it
+        in DataFrames: group the inner table by its correlation keys,
+        compute the aggregate per group, join back on the keys, compare.
+        Rows with no group fall out of the inner join — the same result
+        as comparing against a NULL scalar (comparison yields unknown)."""
+        flipped = isinstance(comparison.left, _ScalarSubquery)
+        marker = comparison.left if flipped else comparison.right
+        outer_expr = comparison.right if flipped else comparison.left
+        subq = marker.subq
+        if subq.star or len(subq.items) != 1:
+            raise HyperspaceException(
+                "SQL: scalar subqueries must select exactly one expression")
+        inner, corr, child = self._analyze_subquery(subq, scope, df)
+        if not corr:
+            raise HyperspaceException(
+                "SQL: uncorrelated scalar subqueries are not supported")
+        # The select item may be alias-qualified (``AVG(l2.qty)``) — the
+        # same resolution the WHERE conjuncts already got.
+        item = self._resolve_quals(subq.items[0][0], child)
+        aggs_found: List[E.AggExpr] = []
+
+        def collect(node):
+            if isinstance(node, E.AggExpr):
+                aggs_found.append(node)
+            for ch in node.children:
+                collect(ch)
+
+        collect(item)
+        if len(aggs_found) != 1:
+            raise HyperspaceException(
+                "SQL: scalar subqueries must contain exactly one aggregate")
+        i = self._sq_counter
+        self._sq_counter += 1
+        agg_name = f"__sq{i}_agg"
+        val_name = f"__sq{i}_val"
+
+        def replace_agg(node):
+            if isinstance(node, E.AggExpr):
+                return E.col(agg_name)
+            return E.map_children(node, replace_agg)
+
+        keys_in = [k for k, _ in corr]
+        sub = inner.group_by(*keys_in).agg(aggs_found[0].alias(agg_name))
+        sel = [E.col(k).alias(f"__sq{i}_k{j}")
+               for j, k in enumerate(keys_in)]
+        sel.append(replace_agg(item).alias(val_name))
+        sub = sub.select(*sel)
+        cond = None
+        for j, (_, k_out) in enumerate(corr):
+            eq = E.col(k_out) == E.col(f"__sq{i}_k{j}")
+            cond = eq if cond is None else (cond & eq)
+        joined = df.join(sub, on=cond, how="inner")
+        val = E.col(val_name)
+        pred = type(comparison)(val, outer_expr) if flipped \
+            else type(comparison)(outer_expr, val)
+        return joined.filter(pred)
+
+
+_conjoin = E.conjoin
+
+
+def _collect_cols(e: E.Expr) -> List[E.Col]:
+    out: List[E.Col] = []
+    if isinstance(e, E.Col):
+        out.append(e)
+    for c in e.children:
+        out.extend(_collect_cols(c))
+    return out
+
+
+def _split_disjuncts(e: E.Expr) -> List[E.Expr]:
+    if isinstance(e, E.Or):
+        return _split_disjuncts(e.left) + _split_disjuncts(e.right)
+    return [e]
+
+
+def _factor_common_or(c: E.Expr) -> List[E.Expr]:
+    """For an OR conjunct, hoist predicates that appear in EVERY branch:
+    ``(j AND a1) OR (j AND a2)`` → ``j`` + ``(a1 OR a2)``. Purely a
+    parse-time normalization (sound by distributivity); it is what lets
+    the Q19 text's repeated ``p_partkey = l_partkey`` become a join edge."""
+    if not isinstance(c, E.Or):
+        return [c]
+    branches = [E.split_conjunctive_predicates(b)
+                for b in _split_disjuncts(c)]
+    if any(any(_contains_subquery(p) for p in br) for br in branches):
+        return [c]
+    rep_sets = [{repr(p) for p in br} for br in branches]
+    common = set.intersection(*rep_sets)
+    if not common:
+        return [c]
+    out: List[E.Expr] = [p for p in branches[0] if repr(p) in common]
+    residuals = [[p for p in br if repr(p) not in common]
+                 for br in branches]
+    if all(residuals):
+        ors = [_conjoin(r) for r in residuals]
+        rest = ors[0]
+        for o in ors[1:]:
+            rest = rest | o
+        out.append(rest)
+    # else: some branch is exactly the common set → the OR is implied by
+    # the common predicates alone.
+    return out
 
 
 def _fold(a: E.Expr, b: E.Expr, expr_op, py_op) -> E.Expr:
     """Constant-fold literal-literal arithmetic at parse time (e.g. the
     ``1 + 0.1`` inside ``price * (1 + 0.1)``) — the engine's evaluator
-    deliberately rejects all-literal subtrees."""
+    deliberately rejects all-literal subtrees.
+
+    Folding with floats involved goes through Decimal: Spark parses
+    ``.06 - 0.01`` as DECIMAL arithmetic yielding exactly 0.05, while
+    float64 yields 0.04999999999999999 — a bound that silently excludes
+    the 0.05 data values TPC-H Q6 selects."""
     if isinstance(a, E.Lit) and isinstance(b, E.Lit) and \
             isinstance(a.value, (int, float)) and \
             isinstance(b.value, (int, float)):
+        if isinstance(a.value, float) or isinstance(b.value, float):
+            from decimal import Decimal, InvalidOperation
+            try:
+                return E.lit(float(py_op(Decimal(str(a.value)),
+                                         Decimal(str(b.value)))))
+            except (InvalidOperation, ZeroDivisionError):
+                pass
         return E.lit(py_op(a.value, b.value))
     return expr_op(a, b)
 
@@ -438,29 +1205,19 @@ def _contains_agg(e: Optional[E.Expr]) -> bool:
     return any(_contains_agg(c) for c in e.children)
 
 
-def _lift_having_aggs(e: E.Expr, start: int):
-    """Replace every aggregate inside a HAVING predicate with a reference
-    to a hidden output column, returning (rewritten predicate, the hidden
-    aliased aggregates to append to the agg list)."""
+def _lift_aggs(e: E.Expr, prefix: str):
+    """Replace every aggregate inside ``e`` with a reference to a hidden
+    output column, returning (rewritten expression, the hidden aliased
+    aggregates to append to the agg list). Serves both HAVING predicates
+    and compound select items like ``100 * sum(a) / sum(b)``."""
     hidden: List[E.Expr] = []
 
     def rec(node: E.Expr) -> E.Expr:
         if isinstance(node, E.AggExpr):
-            name = f"__having_{start + len(hidden)}"
+            name = f"{prefix}_{len(hidden)}"
             hidden.append(node.alias(name))
             return E.col(name)
-        if isinstance(node, E.Col) or isinstance(node, E.Lit):
-            return node
-        if isinstance(node, E.Not):
-            return ~rec(node.child)
-        if isinstance(node, E.In):
-            return E.In(rec(node.value), list(node.options))
-        if isinstance(node, E.Alias):
-            return rec(node.child).alias(node.alias_name)
-        if isinstance(node, E._Binary):
-            return type(node)(rec(node.left), rec(node.right))
-        raise HyperspaceException(
-            f"SQL: unsupported HAVING expression {node!r}")
+        return E.map_children(node, rec)
 
     return rec(e), hidden
 

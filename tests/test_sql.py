@@ -145,7 +145,14 @@ class TestSqlErrors:
             env.sql("SELECT * FROM li GROUP BY flag")
 
     def test_garbage_token(self, env):
+        # ';' became a legal token (verbatim TPC-H texts end in one), so
+        # the untokenizable character here must be something the grammar
+        # will never claim.
         with pytest.raises(HyperspaceException, match="tokenize"):
+            env.sql("SELECT @ FROM li")
+
+    def test_misplaced_semicolon(self, env):
+        with pytest.raises(HyperspaceException, match="unexpected token"):
             env.sql("SELECT ; FROM li")
 
     def test_truncated_query(self, env):
@@ -188,6 +195,90 @@ class TestSqlReviewRegressions:
     def test_limit_float_raises_cleanly(self, env):
         with pytest.raises(HyperspaceException, match="LIMIT"):
             env.sql("SELECT okey FROM li LIMIT 10.5")
+
+    def test_scalar_subquery_qualified_aggregate(self, env):
+        # The subquery's select item uses the subquery's own alias — it
+        # must resolve exactly like qualified names in its WHERE do.
+        got = env.sql(
+            "SELECT okey, qty FROM li WHERE qty > "
+            "(SELECT AVG(l2.qty) FROM li l2 WHERE l2.okey = li.okey) "
+            "ORDER BY okey, qty").to_pandas()
+        pdf = env.table("li").to_pandas()
+        avg = pdf.groupby("okey")["qty"].mean()
+        exp = pdf[pdf["qty"] > pdf["okey"].map(avg)][["okey", "qty"]] \
+            .sort_values(["okey", "qty"]).reset_index(drop=True)
+        pd.testing.assert_frame_equal(got, exp)
+
+    def test_order_by_alias_after_derived_table(self, env):
+        # A derived table in FROM runs the select parser re-entrantly; the
+        # outer ORDER BY must still resolve against the OUTER aliases.
+        got = env.sql(
+            "SELECT o.prio, d.qty FROM (SELECT okey, qty FROM li) AS d "
+            "JOIN od o ON okey = okey2 ORDER BY o.prio, d.qty LIMIT 5"
+        ).to_pandas()
+        assert list(got.columns) == ["prio", "qty"]
+        assert (got["prio"].values == sorted(got["prio"].values)).all()
+
+    def test_soft_keywords_usable_as_column_names(self, env, tmp_path):
+        # YEAR/MONTH/DAY/TRIM/... are grammar words only in their special
+        # positions; a table whose columns carry those names stays fully
+        # reachable from SQL (Spark reserves almost nothing).
+        d = tmp_path / "soft"
+        d.mkdir()
+        pq.write_table(pa.table({
+            "year": pa.array([2024, 2025, 2025], type=pa.int64()),
+            "trim": pa.array(["a", "b", "c"]),
+        }), d / "p0.parquet")
+        env.create_temp_view("soft", env.read.parquet(str(d)))
+        got = env.sql("SELECT year, trim FROM soft WHERE year = 2025 "
+                      "ORDER BY trim").to_pandas()
+        assert got["year"].tolist() == [2025, 2025]
+        assert got["trim"].tolist() == ["b", "c"]
+        # GROUP BY a soft-keyword column, and alias one.
+        g = env.sql("SELECT year, COUNT(*) AS c FROM soft GROUP BY year "
+                    "ORDER BY year").to_pandas()
+        assert g["c"].tolist() == [1, 2]
+        a = env.sql("SELECT okey AS month FROM li LIMIT 1").to_pandas()
+        assert list(a.columns) == ["month"]
+        # ...while the special positions still work.
+        y = env.sql("SELECT EXTRACT(YEAR FROM ship) AS y FROM li LIMIT 1")
+        assert y.to_pandas()["y"].iloc[0] >= 1994
+
+    def test_limit_negative_rejected(self, env):
+        # SUBSTRING made _int_literal sign-aware; LIMIT must still reject.
+        with pytest.raises(HyperspaceException, match="non-negative"):
+            env.sql("SELECT okey FROM li LIMIT -5")
+
+    def test_like_matches_across_newlines(self, env, tmp_path):
+        d = tmp_path / "nl"
+        d.mkdir()
+        pq.write_table(pa.table({"s": pa.array(["line1\nline2", "other"])}),
+                       d / "p0.parquet")
+        env.create_temp_view("nl", env.read.parquet(str(d)))
+        got = env.sql("SELECT s FROM nl WHERE s LIKE '%line2'").to_pandas()
+        assert got["s"].tolist() == ["line1\nline2"]
+
+    def test_substring_negative_start_counts_from_end(self, env):
+        # Spark/Hive substr(-2, 2) takes the LAST two characters.
+        got = env.sql("SELECT DISTINCT SUBSTRING(prio, -1, 1) AS t "
+                      "FROM od ORDER BY t").to_pandas()
+        assert got["t"].tolist() == ["I", "O"]  # HI / LO
+        from hyperspace_tpu.plan.expr import col
+        df = env.table("od").select(
+            col("prio").substr(-2, 2).alias("whole"),
+            col("prio").substr(-5, 4).alias("virt"),
+        ).to_pandas()
+        assert set(df["whole"]) == {"HI", "LO"}
+        # Virtual start before the beginning consumes length: the window
+        # [-3, 1) clamps to one visible char.
+        assert set(df["virt"]) == {"H", "L"}
+
+    def test_mid_statement_semicolon_rejected(self, env):
+        # ';' is legal only as a trailing terminator — never silently
+        # dropped mid-statement (that would splice two statements).
+        with pytest.raises(HyperspaceException, match="';'"):
+            env.sql("SELECT okey FROM li; ORDER BY okey")
+        assert env.sql("SELECT okey FROM li LIMIT 1;").count() == 1
 
 
 class TestSqlDistinctUnionDerived:
