@@ -31,8 +31,10 @@ Execution model — mask-based streaming with static shapes throughout:
   * exchange (m:n): both sides are hash-routed over ICI with ONE
     lax.all_to_all each (value-stable key hash → owner device, the
     reference's shuffle join), then merge-joined locally into
-    capacity-bounded output slots; capacity overflow escalates ×4 and
-    recompiles, a few rungs, then falls back.
+    capacity-bounded output slots; on capacity overflow the program
+    reports its exact needs and ONE right-sized recompile retries
+    (2 in the rare skewed-send case) — never an open-ended
+    escalation ladder on a backend where compiles are the risk.
 - Global aggregates psum/pmin/pmax partial contributions (one collective
   per partial).
 - Grouped aggregates compute capacity-bounded per-device partials (local
@@ -707,10 +709,17 @@ def _prepare(root, executor, caps: Dict[int, Tuple[int, int]]) -> _Prepared:
                      out_rows, project_live)
 
 
-# Exchange-capacity escalation: multiply caps by 4 up to this many times
-# before falling back to single-device execution (static shapes recompile
-# per escalation, so the ladder is short).
-_MAX_CAP_RETRIES = 3
+# Exchange-capacity retries PER EXCHANGE JOIN: each retry recompiles with
+# the EXACT needs the failed program reported (see _escalate_on_overflow),
+# so one overflowing join needs 1 retry (2 with a skewed send). Chained
+# exchange joins can discover needs one at a time — an upstream join's
+# clamped output hides the downstream join's true input — so the budget
+# scales with the join count instead of being a flat constant.
+_MAX_CAP_RETRIES = 2
+
+# Capacity attempts of the most recent _run/_run_stream (1 = first program
+# fit). Tests pin the one-recompile contract with this.
+LAST_CAP_ATTEMPTS = 0
 
 
 def _out_rows(prep: _Prepared, caps: Dict[int, Tuple[int, int]]) -> int:
@@ -723,7 +732,8 @@ def _out_rows(prep: _Prepared, caps: Dict[int, Tuple[int, int]]) -> int:
 
 
 def _run(plan: Aggregate, executor) -> Table:
-    global DISPATCH_COUNT
+    global DISPATCH_COUNT, LAST_CAP_ATTEMPTS
+    LAST_CAP_ATTEMPTS = 1
     caps: Dict[int, Tuple[int, int]] = {}
     # Prepared ONCE: leaf IO, join-side materialization, and sharding don't
     # depend on caps — only the jitted program (static shapes) does, so
@@ -758,9 +768,11 @@ def _run(plan: Aggregate, executor) -> Table:
                             G=G, G2=G2, mode="agg", routed_merge=routed)
         if _escalate_on_overflow(out, caps):
             cap_attempts += 1
-            if cap_attempts > _MAX_CAP_RETRIES:
+            n_xch = sum(1 for j in prep.joins.values() if j[0] == "x")
+            if cap_attempts > _MAX_CAP_RETRIES * max(n_xch, 1):
                 raise _Unsupported(
                     "exchange join capacity escalation exhausted")
+            LAST_CAP_ATTEMPTS = cap_attempts + 1
             # New caps → new partial-group distribution; the one-shot
             # owner-capacity retry becomes available again.
             gmof_retried = False
@@ -793,14 +805,17 @@ def _run_stream(root, executor) -> Table:
     """Row-returning SPMD execution of a {Filter, Project, Join}* chain:
     every device runs the stages on its shard, the host gathers each
     device's valid rows and concatenates (VERDICT r3 #3a)."""
-    global DISPATCH_COUNT
+    global DISPATCH_COUNT, LAST_CAP_ATTEMPTS
+    LAST_CAP_ATTEMPTS = 1
     caps: Dict[int, Tuple[int, int]] = {}
     prep = _prepare(root, executor, caps)  # once; see _run
     out_names = [n for n in root.schema.names if n in prep.final_meta]
     if not out_names:
         raise _Unsupported("no output columns")
     out_pairs = tuple((n, prep.final_meta[n][2]) for n in out_names)
-    for attempt in range(_MAX_CAP_RETRIES + 1):
+    n_xch = sum(1 for j in prep.joins.values() if j[0] == "x")
+    for attempt in range(_MAX_CAP_RETRIES * max(n_xch, 1) + 1):
+        LAST_CAP_ATTEMPTS = attempt + 1
         descr = _StageDescr(prep.stages, prep.joins, prep.col_meta,
                             (), out_pairs, dict(caps), prep.project_live)
         out = _spmd_program(prep.sharded, prep.valid, prep.bcast, prep.xch,
@@ -823,9 +838,25 @@ def _run_stream(root, executor) -> Table:
     raise _Unsupported("exchange join capacity escalation exhausted")
 
 
+def _round_up_pow2(n: int) -> int:
+    """Retry capacities round up to a power of two: ≤2× memory waste and a
+    coarse jit-cache key (many different exact needs share one program)."""
+    return max(128, 1 << max(int(n) - 1, 1).bit_length())
+
+
 def _escalate_on_overflow(out, caps: Dict[int, Tuple[int, int]]) -> bool:
-    """True if any exchange join overflowed its capacity; caps are bumped
-    in place for the retry."""
+    """True if any exchange join overflowed its capacity; caps are set in
+    place from the EXACT needs the program reported, so one recompile
+    suffices in the common case (VERDICT r3 #6 — a blind ×4 ladder would
+    recompile up to 4 programs per query on a backend where each compile
+    can kill the remote-compile service).
+
+    The send-block need (``xneedc``) is measured before slot clamping and
+    is always exact. The output-slot need (``xneedo``) is exact only when
+    the send side fit — a clamped receive undercounts matches — so after a
+    send overflow (``xneedc`` above cap) the retry doubles the reported output need as
+    a safety margin; the attempt after that sees exact numbers. Worst case
+    is therefore 2 retries (skewed send), 1 in the common case."""
     bumped = False
     for key in out:
         if not key.startswith("xof:"):
@@ -833,7 +864,13 @@ def _escalate_on_overflow(out, caps: Dict[int, Tuple[int, int]]) -> bool:
         i = int(key.split(":")[1])
         if bool(np.asarray(jax.device_get(out[key]))):
             cap, k_out = caps[i]
-            caps[i] = (cap * 4, k_out * 4)
+            need_c = int(np.asarray(jax.device_get(out[f"xneedc:{i}"])))
+            need_o = int(np.asarray(jax.device_get(out[f"xneedo:{i}"])))
+            send_of = need_c > cap  # definitionally the send overflow
+            new_cap = max(cap, _round_up_pow2(need_c))
+            new_out = max(k_out, _round_up_pow2(
+                need_o * 2 if send_of else need_o))
+            caps[i] = (new_cap, new_out)
             bumped = True
     return bumped
 
@@ -957,8 +994,11 @@ def _a2a_exchange(arrays: Dict[str, jax.Array], send_ok: jax.Array,
                   dst: jax.Array, n_dev: int, cap: int):
     """Route rows to their destination device with ONE lax.all_to_all.
     ``dst`` in [0, n_dev); rows with ``send_ok`` False are dropped. Returns
-    (received arrays, received-valid mask, overflow flag) — overflow is
-    raised (pmax) when any (device, destination) block exceeds ``cap``."""
+    (received arrays, received-valid mask, overflow flag, exact need) —
+    overflow is raised (pmax) when any (device, destination) block exceeds
+    ``cap``; ``need`` is the worldwide max block count, i.e. the exact
+    capacity a retry must allocate (counts are measured BEFORE clamping,
+    so the need is reliable even on overflow)."""
     rows = send_ok.shape[0]
     dst = jnp.where(send_ok, dst, n_dev)  # drop → virtual device n_dev
     perm = kernels.lex_sort_indices([dst])
@@ -968,6 +1008,7 @@ def _a2a_exchange(arrays: Dict[str, jax.Array], send_ok: jax.Array,
     counts = starts[1:] - starts[:-1]
     overflow = jax.lax.pmax(jnp.any(counts > cap).astype(jnp.int32),
                             DATA_AXIS)
+    need = jax.lax.pmax(jnp.max(counts).astype(jnp.int32), DATA_AXIS)
     pos = jnp.arange(rows, dtype=jnp.int32) - jnp.take(
         starts, jnp.minimum(sorted_dst, n_dev)).astype(jnp.int32)
     slot_ok = (pos < cap) & (sorted_dst < n_dev)
@@ -986,7 +1027,7 @@ def _a2a_exchange(arrays: Dict[str, jax.Array], send_ok: jax.Array,
     recv = {name: a2a(scatter(a)) for name, a in arrays.items()}
     recv_valid = a2a(jnp.zeros(n_dev * cap + 1, jnp.bool_)
                      .at[send_idx].set(slot_ok, mode="drop")[:-1])
-    return recv, recv_valid, overflow
+    return recv, recv_valid, overflow, need
 
 
 @partial(jax.jit,
@@ -1075,7 +1116,7 @@ def _spmd_program(sharded, valid, bcast, xch, *, mesh: Mesh,
                     l_arrays[f"d:{n}"] = c.data
                     if c.validity is not None:
                         l_arrays[f"v:{n}"] = c.validity
-                recv_l, lvalid, of_l = _a2a_exchange(
+                recv_l, lvalid, of_l, need_l = _a2a_exchange(
                     l_arrays, l_ok, dst_l, n_dev, cap)
 
                 rk = xch[f"x:{i}:k"]
@@ -1085,9 +1126,13 @@ def _spmd_program(sharded, valid, bcast, xch, *, mesh: Mesh,
                 r_arrays = {n[len(f"x:{i}:"):]: a for n, a in xch.items()
                             if n.startswith(f"x:{i}:") and
                             not n.endswith("__valid")}
-                recv_r, rvalid, of_r = _a2a_exchange(
+                recv_r, rvalid, of_r, need_r = _a2a_exchange(
                     r_arrays, r_ok, dst_r, n_dev, cap)
                 overflow_flags[f"xof:{i}"] = jnp.maximum(of_l, of_r)
+                # Exact retry sizing: worst (src, dst) block over both
+                # sides. Send overflow is recoverable host-side as
+                # need > cap, so no separate flag rides along.
+                overflow_flags[f"xneedc:{i}"] = jnp.maximum(need_l, need_r)
 
                 # Local merge join: right sorted (valid first, by key),
                 # invalid tail pinned to the key dtype's max so the whole
@@ -1112,6 +1157,11 @@ def _spmd_program(sharded, valid, bcast, xch, *, mesh: Mesh,
                     overflow_flags[f"xof:{i}"],
                     jax.lax.pmax((total > k_out).astype(jnp.int32),
                                  DATA_AXIS))
+                # Exact per-device output need (counts are computed before
+                # any slot clamping, so this is exact whenever the send
+                # side fit — xneedc above cap marks the exception).
+                overflow_flags[f"xneedo:{i}"] = jax.lax.pmax(
+                    total.astype(jnp.int32), DATA_AXIS)
                 n_l = lkr.shape[0]
                 li = jnp.repeat(jnp.arange(n_l, dtype=jnp.int32), counts,
                                 total_repeat_length=k_out)
@@ -1229,7 +1279,8 @@ def _spmd_program(sharded, valid, bcast, xch, *, mesh: Mesh,
         if n_dev > 1 and routed_merge:
             send = {k: v for k, v in out.items()
                     if k not in ("overflow", "gvalid")
-                    and not k.startswith("xof:")}
+                    and not k.startswith(("xof:", "xneedc:",
+                                          "xneedo:"))}
             gv = out["gvalid"]
             h = None
             for g in group_cols:
@@ -1240,7 +1291,7 @@ def _spmd_program(sharded, valid, bcast, xch, *, mesh: Mesh,
                     ch, out[f"gf:{g}"].astype(jnp.uint32))
                 h = ch if h is None else kernels.hash_combine(h, ch)
             dst = (h % np.uint32(n_dev)).astype(jnp.int32)
-            recv, rvalid, _ = _a2a_exchange(send, gv, dst, n_dev, cap=G)
+            recv, rvalid, _, _ = _a2a_exchange(send, gv, dst, n_dev, cap=G)
             order2, m2, sflags2, sdatas2, gids2, owned = _group_segments(
                 rvalid, [recv[f"gf:{g}"] for g in group_cols],
                 [recv[f"g:{g}"] for g in group_cols], G2)
@@ -1274,7 +1325,8 @@ def _spmd_program(sharded, valid, bcast, xch, *, mesh: Mesh,
             out["gmof"] = jnp.zeros((), jnp.int32)
         return out
 
-    xof_keys = [f"xof:{i}" for i, j in descr.joins.items() if j[0] == "x"]
+    xof_keys = [f"{tag}:{i}" for i, j in descr.joins.items() if j[0] == "x"
+                for tag in ("xof", "xneedc", "xneedo")]
     if mode == "stream":
         out_specs: Dict[str, P] = {"omask": P(DATA_AXIS)}
         for n, nul in group_cols:

@@ -114,7 +114,8 @@ class TestA2AExchange:
         def per_device(arrays, valid):
             dst = (kernels.hash32_values(arrays["k"], "int64")
                    % np.uint32(n_dev)).astype(jnp.int32)
-            recv, rvalid, of = _a2a_exchange(arrays, valid, dst, n_dev, cap)
+            recv, rvalid, of, _need = _a2a_exchange(
+                arrays, valid, dst, n_dev, cap)
             return recv["k"], recv["p"], rvalid, of
 
         k_r, p_r, v_r, of = jax.shard_map(
@@ -150,13 +151,17 @@ class TestA2AExchange:
         def per_device(arrays, valid):
             dst = (kernels.hash32_values(arrays["k"], "int64")
                    % np.uint32(n_dev)).astype(jnp.int32)
-            _, _, of = _a2a_exchange(arrays, valid, dst, n_dev, 2)
-            return (of,)
+            _, _, of, need = _a2a_exchange(arrays, valid, dst, n_dev, 2)
+            return (of, need)
 
-        (of,) = jax.shard_map(
+        (of, need) = jax.shard_map(
             per_device, mesh=mesh, in_specs=(P(DATA_AXIS), P(DATA_AXIS)),
-            out_specs=(P(),), check_vma=False)(arrays, valid)
+            out_specs=(P(), P()), check_vma=False)(arrays, valid)
         assert int(of) == 1
+        # The reported need is the exact worst block: every row of the
+        # biggest shard targets one destination.
+        rows_per_dev = -(-n // n_dev)
+        assert int(need) == rows_per_dev
 
 
 class TestMultiKeyComposite:
