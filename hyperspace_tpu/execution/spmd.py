@@ -22,12 +22,16 @@ Execution model — mask-based streaming with static shapes throughout:
   physically filtering, so every shape stays static under ``shard_map``.
 - Filters AND into the mask; Projects re-evaluate live columns (the
   expression evaluator is shape-preserving and traces cleanly per device).
-- Joins pick one of two strategies per stage:
+- Joins pick one of two strategies per stage, and cover every join type
+  (inner, left/right/full outer, semi, anti — Spark distributes all of
+  them, so falling back would concede the reference's coverage):
   * broadcast (m:1): the non-stream side is materialized, required unique
     on the key, key-sorted, replicated, and probed with a per-device
     searchsorted. Multi-key joins probe a bit-packed composite built from
     the broadcast side's per-column value ranges (out-of-range stream
-    values hit a sentinel that never matches).
+    values hit a sentinel that never matches). Left outer keeps unmatched
+    stream rows with the right columns invalid; semi/anti broadcast the
+    KEYS only (duplicates fine) and just mask the stream.
   * exchange (m:n): both sides are hash-routed over ICI with ONE
     lax.all_to_all each (value-stable key hash → owner device, the
     reference's shuffle join), then merge-joined locally into
@@ -35,6 +39,10 @@ Execution model — mask-based streaming with static shapes throughout:
     reports its exact needs and ONE right-sized recompile retries
     (2 in the rare skewed-send case) — never an open-ended
     escalation ladder on a backend where compiles are the risk.
+    Multi-key joins route on the bit-packed composite. Because equal
+    keys all meet on one device, local match status is global: left
+    outer pads unmatched stream rows in place, right/full outer
+    append each owner's unmatched right rows — no extra collective.
 - Global aggregates psum/pmin/pmax partial contributions (one collective
   per partial).
 - Grouped aggregates compute capacity-bounded per-device partials (local
@@ -169,8 +177,6 @@ def _load_leaf(leaf, stages, needed, executor) -> "Table":
 
 
 def _normalized_join_pairs(join: Join) -> List[Tuple[str, str]]:
-    if join.join_type != "inner":
-        raise _Unsupported(f"{join.join_type} join")  # outer: single-device
     pairs = E.extract_equi_join_keys(join.condition)
     if pairs is None:
         raise _Unsupported("non-equi join")
@@ -191,9 +197,15 @@ def _needed_per_stage(needed: Set[str], stages):
     """Top-down walk computing the leaf's needed column set, per join stage
     the non-stream side's needed set, and per project stage the *live*
     output names (the traced program evaluates only those — a dead project
-    expr may reference columns that were pruned below it)."""
+    expr may reference columns that were pruned below it).
+
+    ``right_used[i]`` is the subset of the right side's columns a stage
+    above actually consumes — join KEYS appear in ``right_needed[i]``
+    (the side must be materialized with them to compute routing codes)
+    but ride the exchange as data only when used."""
     needed = set(needed)
     right_needed: Dict[int, Set[str]] = {}
+    right_used: Dict[int, Set[str]] = {}
     project_live: Dict[int, frozenset] = {}
     for i in range(len(stages) - 1, -1, -1):
         kind, node = stages[i]
@@ -209,12 +221,19 @@ def _needed_per_stage(needed: Set[str], stages):
             needed = below
         else:  # join
             pairs = _normalized_join_pairs(node)
-            rnames = set(node.right.schema.names)
-            right_needed[i] = {n for n in needed if n in rnames} | \
-                {r for _, r in pairs}
-            needed = {n for n in needed if n not in rnames} | \
-                {l for l, _ in pairs}
-    return needed, right_needed, project_live
+            if node.join_type in ("semi", "anti"):
+                # Existence probe: the right side contributes keys only
+                # and no columns survive into the output (schema = left).
+                right_needed[i] = {r for _, r in pairs}
+                right_used[i] = set()
+                needed = needed | {l for l, _ in pairs}
+            else:
+                rnames = set(node.right.schema.names)
+                right_used[i] = {n for n in needed if n in rnames}
+                right_needed[i] = right_used[i] | {r for _, r in pairs}
+                needed = {n for n in needed if n not in rnames} | \
+                    {l for l, _ in pairs}
+    return needed, right_needed, right_used, project_live
 
 
 # ---------------------------------------------------------------------------
@@ -245,17 +264,23 @@ class _ExchangeSide:
     the stream-code-space dtype used for value-stable routing hashes.
     ``stream_meta`` snapshots the STREAM side's per-column metadata at this
     stage (projects below the join may have created or redefined columns
-    that the leaf col_meta doesn't know)."""
+    that the leaf col_meta doesn't know). ``pack`` is the multi-key
+    composite spec (None for single-key): the routed "k" arrays hold the
+    packed int64 composite, and every right column — keys included —
+    additionally rides as data so outer-join appendix rows can surface
+    their own key values."""
 
     def __init__(self, arrays: Dict[str, jax.Array], valid: jax.Array,
                  table_meta: Dict[str, Tuple[str, Optional[np.ndarray], bool]],
                  key_dtype: str,
-                 stream_meta: Dict[str, Tuple[str, Optional[np.ndarray], bool]]):
+                 stream_meta: Dict[str, Tuple[str, Optional[np.ndarray], bool]],
+                 pack=None):
         self.arrays = arrays
         self.valid = valid
         self.table_meta = table_meta
         self.key_dtype = key_dtype
         self.stream_meta = stream_meta
+        self.pack = pack
 
 
 def _right_key_codes(right: Table, rkey: str, lcol: Column) -> jax.Array:
@@ -282,41 +307,49 @@ def _drop_null_keys(right: Table, rkeys: List[str]):
     return right, None
 
 
-def _prepare_broadcast(right: Table, pairs, tiny: Dict[str, Column]
-                       ) -> _BroadcastSide:
+def _pack_codes(codes):
+    """Bit-pack multiple key-code arrays into one int64 composite (None
+    pack for single-key). Each key column is offset into [0, range) from
+    the RIGHT side's own min/max and packed into disjoint bit fields. A +1
+    sentinel per field encodes "stream value outside the right side's
+    range" — it can never equal a packed right key, so composite equality
+    ⇔ per-column equality, exactly."""
+    if len(codes) == 1:
+        return codes[0], None
+    pack = []
+    shift = 0
+    packed = None
+    for c in codes:
+        c64 = c.astype(jnp.int64)
+        if c64.shape[0] == 0:
+            rmin, rmax = 0, 0
+        else:
+            rmin = int(jnp.min(c64))
+            rmax = int(jnp.max(c64))
+        span = rmax - rmin + 2  # +1 for the out-of-range sentinel
+        bits = max(int(span - 1).bit_length(), 1)
+        pack.append((rmin, shift, span - 1))
+        packed = (c64 - rmin) << shift if packed is None else \
+            packed | ((c64 - rmin) << shift)
+        shift += bits
+        if shift > 62:
+            raise _Unsupported("multi-key composite exceeds 62 bits")
+    return packed, tuple(pack)
+
+
+def _prepare_broadcast(right: Table, pairs, tiny: Dict[str, Column],
+                       keys_only: bool = False) -> _BroadcastSide:
+    """``keys_only`` (semi/anti probes) skips the m:1 uniqueness demand —
+    duplicate keys are harmless to an existence searchsorted — and ships
+    no data columns at all."""
     right, _ = _drop_null_keys(right, [r for _, r in pairs])
     codes = [_right_key_codes(right, rname, tiny[lname])
              for lname, rname in pairs]
-    if len(pairs) == 1:
-        keys, pack = codes[0], None
-    else:
-        # Multi-key composite: each key column is offset into [0, range)
-        # from the broadcast side's own min/max and bit-packed into one
-        # int64. A +1 sentinel per field encodes "stream value outside the
-        # broadcast side's range" — it can never equal a packed right key,
-        # so composite equality ⇔ per-column equality, exactly.
-        pack = []
-        shift = 0
-        packed = None
-        for c in codes:
-            c64 = c.astype(jnp.int64)
-            if c64.shape[0] == 0:
-                rmin, rmax = 0, 0
-            else:
-                rmin = int(jnp.min(c64))
-                rmax = int(jnp.max(c64))
-            span = rmax - rmin + 2  # +1 for the out-of-range sentinel
-            bits = max(int(span - 1).bit_length(), 1)
-            pack.append((rmin, shift, span - 1))
-            packed = (c64 - rmin) << shift if packed is None else \
-                packed | ((c64 - rmin) << shift)
-            shift += bits
-            if shift > 62:
-                raise _Unsupported("multi-key composite exceeds 62 bits")
-        keys = packed
-        pack = tuple(pack)
+    keys, pack = _pack_codes(codes)
     order = kernels.lex_sort_indices([keys])
     keys = jnp.take(keys, order)
+    if keys_only:
+        return _BroadcastSide(keys, Table({}), pack)
     right = right.take(order)
     # m:1 requirement — broadcast side unique on the key (one host sync).
     if keys.shape[0] > 1 and bool(jnp.any(keys[1:] == keys[:-1])):
@@ -325,29 +358,69 @@ def _prepare_broadcast(right: Table, pairs, tiny: Dict[str, Column]
 
 
 def _prepare_exchange(right: Table, pairs, tiny: Dict[str, Column],
-                      mesh: Mesh) -> _ExchangeSide:
-    """Shard an m:n join side over the mesh for the all-to-all route."""
-    if len(pairs) != 1:
-        raise _Unsupported("multi-key exchange join")
-    lname, rname = pairs[0]
-    lcol = tiny[lname]
-    right, _ = _drop_null_keys(right, [rname])
-    codes = _right_key_codes(right, rname, lcol)
+                      mesh: Mesh, used: Set[str],
+                      keep_null_keys: bool) -> _ExchangeSide:
+    """Shard an m:n join side over the mesh for the all-to-all route.
+    Multi-key joins route on the bit-packed composite (the same trick the
+    broadcast side uses, VERDICT r3 #7) — both sides hash the composite,
+    so equal key TUPLES meet on one device.
+
+    ``used`` gates the data payload: join keys ride only the routing "k"
+    array unless a stage above consumes the column. ``keep_null_keys``
+    (right/full outer) keeps null-key rows in the route — they match
+    nothing, but the preserving side must still emit them (the single-
+    device executor's _execute_outer_join does); a "kv" flag rides along
+    so the merge can exclude them from matching."""
+    key_validity = None
+    if keep_null_keys:
+        for _, rk in pairs:
+            v = right.column(rk).validity
+            if v is not None:
+                key_validity = v if key_validity is None \
+                    else (key_validity & v)
+    else:
+        right, _ = _drop_null_keys(right, [r for _, r in pairs])
+    codes = [_right_key_codes(right, rname, tiny[lname])
+             for lname, rname in pairs]
     if right.num_rows == 0:
         raise _Unsupported("empty exchange side")
-    arrays: Dict[str, jax.Array] = {"k": codes}
+    if key_validity is not None and len(pairs) > 1:
+        # Null slots hold arbitrary fill — pin them to each column's valid
+        # min so the composite's bit budget reflects real values only.
+        pinned = []
+        for c in codes:
+            vmin = jnp.min(jnp.where(key_validity, c,
+                                     _max_sentinel(c.dtype)))
+            vmin = jnp.where(jnp.any(key_validity), vmin,
+                             jnp.zeros((), c.dtype))
+            pinned.append(jnp.where(key_validity, c, vmin))
+        codes = pinned
+    keys, pack = _pack_codes(codes)
+    arrays: Dict[str, jax.Array] = {"k": keys}
+    if key_validity is not None:
+        arrays["kv"] = key_validity
+    rkeys = {r for _, r in pairs}
+    # Key columns ride as data only when some stage consumes them AND the
+    # program cannot rebuild them for free from the stream side: single-
+    # key non-preserve-right joins reconstruct the right key from the
+    # stream key (equal by definition on matches, null on padding), so
+    # only composites (unpackable) and right/full (appendix rows carry
+    # their OWN key values) pay the duplicate payload.
+    carry_keys = pack is not None or keep_null_keys
     meta: Dict[str, Tuple[str, Optional[np.ndarray], bool]] = {}
     for n in right.names:
+        if n in rkeys and not (n in used and carry_keys):
+            continue
         rc = right.column(n)
-        if n != rname:
-            arrays[f"d:{n}"] = rc.data
-            if rc.validity is not None:
-                arrays[f"v:{n}"] = rc.validity
+        arrays[f"d:{n}"] = rc.data
+        if rc.validity is not None:
+            arrays[f"v:{n}"] = rc.validity
         meta[n] = (rc.dtype, rc.dictionary, rc.validity is not None)
     arrays, valid = pad_and_shard(mesh, arrays, right.num_rows)
     stream_meta = {n: (c.dtype, c.dictionary, c.validity is not None)
                    for n, c in tiny.items()}
-    return _ExchangeSide(arrays, valid, meta, lcol.dtype, stream_meta)
+    key_dtype = INT64 if pack is not None else tiny[pairs[0][0]].dtype
+    return _ExchangeSide(arrays, valid, meta, key_dtype, stream_meta, pack)
 
 
 # ---------------------------------------------------------------------------
@@ -615,7 +688,7 @@ def _prepare(root, executor, caps: Dict[int, Tuple[int, int]]) -> _Prepared:
     here)."""
     leaf, stages = _linearize(root)
     out_needed = set(root.schema.names)
-    leaf_needed, right_needed, project_live = _needed_per_stage(
+    leaf_needed, right_needed, right_used, project_live = _needed_per_stage(
         out_needed, stages)
 
     leaf_table = _load_leaf(leaf, stages,
@@ -655,53 +728,122 @@ def _prepare(root, executor, caps: Dict[int, Tuple[int, int]]) -> _Prepared:
                     if e.name in live}
             continue
         pairs = _normalized_join_pairs(node)
+        jt = node.join_type
         for lname, _ in pairs:
             if lname not in tiny:
                 raise _Unsupported(f"unknown stream join key {lname}")
         right_table = executor(node.right, right_needed[i])
-        try:
-            side = _prepare_broadcast(right_table, pairs, tiny)
-            joins[i] = ("b", pairs, side)
+        side = None
+        if jt in ("semi", "anti"):
+            # Existence probe: keys-only broadcast (duplicates fine, no
+            # data columns, no schema change) — the classic broadcast
+            # semi join, and the SPMD home of SQL [NOT] IN / EXISTS.
+            # An _Unsupported here (e.g. composite bit overflow) falls
+            # back to single-device — never to the exchange, which has
+            # no existence-probe mode.
+            side = _prepare_broadcast(right_table, pairs, tiny,
+                                      keys_only=True)
+        elif jt in ("inner", "left"):
+            # m:1 probe; left outer keeps unmatched stream rows with the
+            # right columns invalid instead of masking them out.
+            try:
+                side = _prepare_broadcast(right_table, pairs, tiny)
+            except _Unsupported:
+                side = None
+        if side is not None:
+            joins[i] = ("b", pairs, side, jt)
             bcast_arrays[f"k:{i}"] = side.keys
-            for n in side.table.names:
+            for n in side.table.names:  # empty for keys_only sides
                 rc = side.table.column(n)
                 if n not in {r for _, r in pairs}:
                     bcast_arrays[f"b:{i}:{n}"] = rc.data
                     if rc.validity is not None:
                         bcast_arrays[f"bv:{i}:{n}"] = rc.validity
-        except _Unsupported:
-            # m:n (duplicate keys) → hash-route both sides over ICI and
-            # merge-join locally: the reference's shuffle join.
-            side = _prepare_exchange(right_table, pairs, tiny, mesh)
+            if jt in ("semi", "anti"):
+                continue
+        if side is None:
+            # m:n (duplicate keys) and right/full outer → hash-route both
+            # sides over ICI and merge-join locally: the reference's
+            # shuffle join. Right/full need the exchange because only
+            # there is a right row owned by exactly ONE device (a
+            # replicated broadcast side would emit its unmatched rows
+            # once per device).
+            side = _prepare_exchange(right_table, pairs, tiny, mesh,
+                                     right_used[i],
+                                     keep_null_keys=jt in ("right", "full"))
             if i not in caps:
                 r_shard = next(iter(side.arrays.values())).shape[0] // n_dev
                 cap = min(2 * max(out_rows, r_shard) // n_dev + 1,
                           max(out_rows, r_shard))
                 k_out = 2 * max(out_rows, r_shard)
+                if jt in ("left", "full"):
+                    k_out += out_rows  # every stream row may emit alone
+                if jt in ("right", "full"):
+                    k_out += 2 * r_shard  # plus the unmatched-right tail
                 caps[i] = (cap, k_out)
-            joins[i] = ("x", pairs, side)
+            joins[i] = ("x", pairs, side, jt)
             for name, arr in side.arrays.items():
                 xch_arrays[f"x:{i}:{name}"] = arr
             xch_arrays[f"x:{i}:__valid"] = side.valid
             out_rows = caps[i][1]
         # Post-join stream metadata: non-key right columns appear; matched
-        # rows' right key values equal the left key's.
+        # rows' right key values equal the left key's. Outer joins make
+        # the null-padded side's columns nullable (nodes.Join.schema).
+        if jt in ("right", "full"):
+            # Meta comes from the tiny column itself, NOT col_meta: a
+            # Project below this join may have created/renamed columns
+            # col_meta never saw (KeyError here would escape the
+            # _Unsupported fallback net as a crash).
+            for n, c in list(tiny.items()):
+                col_meta[n] = (c.dtype, c.dictionary, True)
+                tiny[n] = Column(c.dtype,
+                                 jnp.zeros(0, _DEVICE_DTYPE[c.dtype]),
+                                 jnp.zeros(0, jnp.bool_), c.dictionary)
         rnames = {r for _, r in pairs}
         side_meta = side.table_meta if isinstance(side, _ExchangeSide) else \
             {n: (side.table.column(n).dtype, side.table.column(n).dictionary,
                  side.table.column(n).validity is not None)
              for n in side.table.names}
         for n, (dt, dic, nul) in side_meta.items():
+            if jt in ("left", "full"):
+                nul = True
             if n not in rnames:
                 tiny[n] = Column(dt, jnp.zeros(0, _DEVICE_DTYPE[dt]),
                                  jnp.zeros(0, jnp.bool_) if nul else None,
                                  dic)
             col_meta[n] = (dt, dic, nul)
         for lname, rname in pairs:
-            if rname in node.schema.names and rname not in tiny:
+            if rname in tiny:
+                continue
+            # Left/full outer: the right key column is null on the
+            # unmatched-left padding rows, so it turns nullable even
+            # when the source key is not. The exchange path carries
+            # the right key column as data (its OWN dictionary) exactly
+            # when a stage above consumes it (right_used); the broadcast
+            # path rebuilds it from the stream key whenever the join
+            # schema exposes it.
+            if isinstance(side, _ExchangeSide) and rname in side.table_meta:
+                dt, dic, nul0 = side.table_meta[rname]
+                nul = nul0 or jt in ("left", "full")
+            elif isinstance(side, _ExchangeSide):
+                # Key rides no data: the program rebuilds it from the
+                # stream key (single-key, non-preserve-right only).
+                if side.pack is not None or jt in ("right", "full") \
+                        or rname not in node.schema.names:
+                    continue
+                lc = tiny[pairs[0][0]]
+                dt, dic = lc.dtype, lc.dictionary
+                nul = lc.validity is not None or jt in ("left", "full")
+            else:
+                if rname not in node.schema.names:
+                    continue
                 lc = tiny[lname]
-                tiny[rname] = Column(lc.dtype, lc.data, lc.validity,
-                                     lc.dictionary)
+                dt, dic = lc.dtype, lc.dictionary
+                nul = lc.validity is not None or jt in ("left", "full")
+            tiny[rname] = Column(
+                dt, jnp.zeros(0, _DEVICE_DTYPE[dt]),
+                jnp.zeros(0, jnp.bool_) if nul else None, dic)
+            col_meta[rname] = (dt, dic, nul)
     final_meta = {n: (c.dtype, c.dictionary, c.validity is not None)
                   for n, c in tiny.items()}
     return _Prepared(mesh, n_dev, sharded, valid, bcast_arrays, xch_arrays,
@@ -903,10 +1045,9 @@ class _StageDescr:
             elif kind == "project":
                 parts.append(("P", tuple(repr(e) for e in node.exprs)))
             else:
-                jkind, pairs, side = joins[i]
-                pack = side.pack if isinstance(side, _BroadcastSide) else None
-                parts.append(("J", jkind, repr(node.condition),
-                              tuple(node.schema.names), pack))
+                jkind, pairs, side, jt = joins[i]
+                parts.append(("J", jkind, jt, repr(node.condition),
+                              tuple(node.schema.names), side.pack))
         for n, (dt, dic, nul) in sorted(col_meta.items()):
             parts.append((n, dt, _dict_fingerprint(dic), nul))
         for s in agg_specs:
@@ -1060,7 +1201,7 @@ def _spmd_program(sharded, valid, bcast, xch, *, mesh: Mesh,
                 table = Table({e.name: eval_expr(table, e)
                                for e in node.exprs if e.name in live})
             elif joins[i][0] == "b":  # broadcast join probe
-                _, pairs, side = joins[i]
+                _, pairs, side, jt = joins[i]
                 lk, keys_valid = _stream_probe_key(table, pairs, side.pack)
                 rkeys = bcast[f"k:{i}"]
                 n_r = rkeys.shape[0]
@@ -1072,7 +1213,18 @@ def _spmd_program(sharded, valid, bcast, xch, *, mesh: Mesh,
                     idx_c = jnp.minimum(idx, n_r - 1)
                     found = jnp.take(rkeys, idx_c) == lk
                 found = found & keys_valid
-                mask = mask & found
+                if jt == "semi":
+                    mask = mask & found
+                    continue
+                if jt == "anti":
+                    # Null / unmatched keys match nothing → kept (the
+                    # NOT IN non-null convention the executor documents).
+                    mask = mask & ~found
+                    continue
+                if jt == "inner":
+                    mask = mask & found
+                # left outer: mask unchanged — unmatched stream rows stay,
+                # with the right columns invalid below.
                 rnames = {r for _, r in pairs}
                 new_cols = dict(table.columns)
                 for n in side.table.names:
@@ -1088,29 +1240,43 @@ def _spmd_program(sharded, valid, bcast, xch, *, mesh: Mesh,
                         vkey = f"bv:{i}:{n}"
                         vv = (jnp.take(bcast[vkey], idx_c)
                               if vkey in bcast else None)
+                    if jt == "left":
+                        vv = found if vv is None else (vv & found)
                     new_cols[n] = Column(rc.dtype, data, vv, rc.dictionary)
                 for lname, rname in pairs:
                     if rname in node.schema.names and rname not in new_cols:
                         lc = table.column(lname)
-                        # Matched rows: right key == left key by definition.
-                        new_cols[rname] = Column(lc.dtype, lc.data,
-                                                 lc.validity, lc.dictionary)
+                        # Matched rows: right key == left key by definition;
+                        # left-outer padding rows carry a null right key.
+                        vv = lc.validity
+                        if jt == "left":
+                            vv = found if vv is None else (vv & found)
+                        new_cols[rname] = Column(lc.dtype, lc.data, vv,
+                                                 lc.dictionary)
                 table = Table(new_cols)
             else:  # exchange (m:n shuffle) join
-                _, pairs, side = joins[i]
-                lname, rname = pairs[0]
+                _, pairs, side, jt = joins[i]
                 cap, k_out = descr.caps[i]
-                lk, keys_valid = _stream_probe_key(table, pairs, None)
-                l_ok = mask & keys_valid
+                lk, keys_valid = _stream_probe_key(table, pairs, side.pack)
+                preserve_left = jt in ("left", "full")
+                preserve_right = jt in ("right", "full")
+                # Preserved-left rows route even with a null key (they
+                # must surface as unmatched); a "kv" flag rides along so
+                # the merge still refuses to match them. Otherwise
+                # null-key rows are dropped at the send.
+                l_ok = mask if preserve_left else (mask & keys_valid)
                 # Routing hashes the key in the SAME code space on both
                 # sides, so equal keys land on one device. String keys are
                 # already translated into one dictionary — their codes
                 # hash as plain int32 (no dictionary needed for routing;
-                # equal codes ⇔ equal strings).
+                # equal codes ⇔ equal strings); multi-key composites are
+                # packed int64 on both sides.
                 dtype = INT32 if side.key_dtype == STRING else side.key_dtype
                 dst_l = (kernels.hash32_values(lk, dtype)
                          % np.uint32(n_dev)).astype(jnp.int32)
                 l_arrays = {"k": lk}
+                if preserve_left:
+                    l_arrays["kv"] = keys_valid
                 for n in table.names:
                     c = table.column(n)
                     l_arrays[f"d:{n}"] = c.data
@@ -1137,43 +1303,93 @@ def _spmd_program(sharded, valid, bcast, xch, *, mesh: Mesh,
                 # Local merge join: right sorted (valid first, by key),
                 # invalid tail pinned to the key dtype's max so the whole
                 # array stays ascending for searchsorted; hi is clamped to
-                # the valid prefix length.
+                # the valid prefix length. Because equal keys all meet on
+                # one device, LOCAL match status is GLOBAL match status —
+                # which is what lets outer joins emit their unmatched
+                # rows here without any further coordination.
                 rkr = recv_r["k"]
+                # Key-valid ∧ receive-valid: null-key right rows (carried
+                # only under right/full, flagged "kv") must never match
+                # but still appendix as unmatched.
+                rkeyok = rvalid
+                if "kv" in recv_r:
+                    rkeyok = rvalid & recv_r["kv"]
                 sort_r = kernels.lex_sort_indices(
-                    [(~rvalid).astype(jnp.int32), rkr])
+                    [(~rkeyok).astype(jnp.int32), rkr])
                 rk_sorted = jnp.take(rkr, sort_r)
                 rvalid_sorted = jnp.take(rvalid, sort_r)
-                n_valid_r = jnp.sum(rvalid.astype(jnp.int32))
-                rk_probe = jnp.where(rvalid_sorted, rk_sorted,
+                rkeyok_sorted = jnp.take(rkeyok, sort_r)
+                n_valid_r = jnp.sum(rkeyok.astype(jnp.int32))
+                rk_probe = jnp.where(rkeyok_sorted, rk_sorted,
                                      _max_sentinel(rk_sorted.dtype))
                 lkr = recv_l["k"]
+                lkvalid = lvalid
+                if preserve_left:
+                    lkvalid = lvalid & recv_l["kv"]
                 lo = jnp.searchsorted(rk_probe, lkr, side="left")
                 hi = jnp.minimum(
                     jnp.searchsorted(rk_probe, lkr, side="right"), n_valid_r)
-                counts = jnp.where(lvalid,
-                                   jnp.maximum(hi - lo, 0), 0).astype(jnp.int32)
-                total = jnp.sum(counts)
+                matched_counts = jnp.where(
+                    lkvalid, jnp.maximum(hi - lo, 0), 0).astype(jnp.int32)
+                if preserve_left:
+                    # Every received stream row emits at least once.
+                    emit_counts = jnp.where(
+                        lvalid, jnp.maximum(matched_counts, 1), 0)
+                else:
+                    emit_counts = matched_counts
+                total_l = jnp.sum(emit_counts)
+                n_l = lkr.shape[0]
+                li = jnp.repeat(jnp.arange(n_l, dtype=jnp.int32),
+                                emit_counts, total_repeat_length=k_out)
+                starts_ = jnp.cumsum(emit_counts) - emit_counts
+                base = jnp.repeat(starts_.astype(jnp.int32), emit_counts,
+                                  total_repeat_length=k_out)
+                within = jnp.arange(k_out, dtype=jnp.int32) - base
+                is_match = within < jnp.take(matched_counts, li)
+                ri = jnp.repeat(lo.astype(jnp.int32), emit_counts,
+                                total_repeat_length=k_out) + \
+                    jnp.where(is_match, within, 0)
+                ri = jnp.clip(ri, 0, max(rkr.shape[0] - 1, 0))
+
+                if preserve_right:
+                    # Right rows whose key no received left row carries
+                    # emit once, appended after the matched block. The
+                    # left keys need their own sort for the probe.
+                    sort_l = kernels.lex_sort_indices(
+                        [(~lkvalid).astype(jnp.int32), lkr])
+                    lk_sorted = jnp.take(lkr, sort_l)
+                    lkv_sorted = jnp.take(lkvalid, sort_l)
+                    n_valid_l = jnp.sum(lkvalid.astype(jnp.int32))
+                    lk_probe = jnp.where(lkv_sorted, lk_sorted,
+                                         _max_sentinel(lk_sorted.dtype))
+                    lo_r = jnp.searchsorted(lk_probe, rk_sorted, side="left")
+                    hi_r = jnp.minimum(
+                        jnp.searchsorted(lk_probe, rk_sorted, side="right"),
+                        n_valid_l)
+                    r_unmatched = rvalid_sorted & \
+                        (~rkeyok_sorted | ((hi_r - lo_r) <= 0))
+                    appendix = jnp.sum(r_unmatched.astype(jnp.int32))
+                    appendix_pos = total_l + jnp.cumsum(
+                        r_unmatched.astype(jnp.int32)) - 1
+                    # mode="drop" discards slots at/above k_out.
+                    appendix_slot = jnp.where(r_unmatched, appendix_pos,
+                                              k_out).astype(jnp.int32)
+                    total_eff = total_l + appendix
+                else:
+                    appendix_slot = None
+                    total_eff = total_l
                 overflow_flags[f"xof:{i}"] = jnp.maximum(
                     overflow_flags[f"xof:{i}"],
-                    jax.lax.pmax((total > k_out).astype(jnp.int32),
+                    jax.lax.pmax((total_eff > k_out).astype(jnp.int32),
                                  DATA_AXIS))
                 # Exact per-device output need (counts are computed before
                 # any slot clamping, so this is exact whenever the send
                 # side fit — xneedc above cap marks the exception).
                 overflow_flags[f"xneedo:{i}"] = jax.lax.pmax(
-                    total.astype(jnp.int32), DATA_AXIS)
-                n_l = lkr.shape[0]
-                li = jnp.repeat(jnp.arange(n_l, dtype=jnp.int32), counts,
-                                total_repeat_length=k_out)
-                starts_ = jnp.cumsum(counts) - counts
-                base = jnp.repeat(starts_.astype(jnp.int32), counts,
-                                  total_repeat_length=k_out)
-                within = jnp.arange(k_out, dtype=jnp.int32) - base
-                ri = jnp.repeat(lo.astype(jnp.int32), counts,
-                                total_repeat_length=k_out) + within
-                ri = jnp.clip(ri, 0, max(rkr.shape[0] - 1, 0))
-                out_mask = jnp.arange(k_out, dtype=jnp.int32) < total
+                    total_eff.astype(jnp.int32), DATA_AXIS)
+                out_mask = jnp.arange(k_out, dtype=jnp.int32) < total_eff
 
+                live = jnp.arange(k_out, dtype=jnp.int32) < total_l
                 new_cols = {}
                 for n in table.names:
                     # Stream meta snapshot from prep time: projects below
@@ -1183,21 +1399,45 @@ def _spmd_program(sharded, valid, bcast, xch, *, mesh: Mesh,
                     data = jnp.take(recv_l[f"d:{n}"], li, axis=0)
                     vv = (jnp.take(recv_l[f"v:{n}"], li)
                           if f"v:{n}" in recv_l else None)
+                    if preserve_right:
+                        # Appendix rows have no left side: null-pad.
+                        vv = live if vv is None else (vv & live)
                     new_cols[n] = Column(dt, data, vv, dic)
-                rnames = {rname}
                 for n, (dt, dic, nul) in side.table_meta.items():
-                    if n in rnames:
-                        continue
-                    data = jnp.take(jnp.take(recv_r[f"d:{n}"], sort_r,
-                                             axis=0), ri, axis=0)
+                    col_sorted = jnp.take(recv_r[f"d:{n}"], sort_r, axis=0)
+                    data = jnp.take(col_sorted, ri, axis=0)
                     vv = (jnp.take(jnp.take(recv_r[f"v:{n}"], sort_r), ri)
                           if f"v:{n}" in recv_r else None)
+                    if preserve_left:
+                        # Unmatched stream rows: right side is null.
+                        vv = is_match if vv is None else (vv & is_match)
+                    if preserve_right:
+                        base_v = vv if vv is not None else \
+                            jnp.ones(k_out, jnp.bool_)
+                        scat_v = (jnp.take(recv_r[f"v:{n}"], sort_r)
+                                  if f"v:{n}" in recv_r
+                                  else jnp.ones(rkr.shape[0], jnp.bool_))
+                        data = data.at[appendix_slot].set(
+                            col_sorted, mode="drop")
+                        vv = base_v.at[appendix_slot].set(
+                            scat_v, mode="drop")
                     new_cols[n] = Column(dt, data, vv, dic)
-                if rname in node.schema.names and rname not in new_cols:
-                    lcm = side.stream_meta[lname]
-                    new_cols[rname] = Column(
-                        lcm[0], jnp.take(recv_l["k"], li),
-                        None, lcm[1])
+                if side.pack is None and not preserve_right:
+                    # Single-key, no appendix: the right key column is
+                    # rebuilt for free from the stream key (equal on
+                    # matches, null on left-outer padding) instead of
+                    # riding the exchange as duplicate payload.
+                    lname, rname = pairs[0]
+                    if rname in node.schema.names \
+                            and rname not in new_cols:
+                        lcm = side.stream_meta[lname]
+                        data = jnp.take(recv_l[f"d:{lname}"], li, axis=0)
+                        vv = (jnp.take(recv_l[f"v:{lname}"], li)
+                              if f"v:{lname}" in recv_l else None)
+                        if preserve_left:
+                            vv = is_match if vv is None else \
+                                (vv & is_match)
+                        new_cols[rname] = Column(lcm[0], data, vv, lcm[1])
                 table = Table(new_cols)
                 mask = out_mask
 

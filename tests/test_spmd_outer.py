@@ -1,0 +1,388 @@
+"""SPMD outer / semi / anti / multi-key joins (VERDICT r3 #7): the plan
+shapes that used to fall back to single-device now run distributed.
+
+Spark (the reference's engine) distributes every join type
+(RuleUtils.scala delegates to Spark's shuffle machinery); here:
+  - left outer rides both strategies (broadcast m:1 keeps unmatched
+    stream rows with invalid right columns; exchange pads per shard),
+  - right/full outer ride the exchange (each right row is owned by
+    exactly one device after the hash route, so local match status is
+    global and unmatched rows append without coordination),
+  - semi/anti are keys-only broadcasts (duplicates fine),
+  - multi-key m:n joins route on the bit-packed composite.
+
+Oracle pattern matches test_spmd.py: assert SPMD was actually taken
+(DISPATCH_COUNT advances) and compare against the single-device executor
+with distribution disabled.
+"""
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+import hyperspace_tpu as hst
+from hyperspace_tpu.execution import spmd
+from hyperspace_tpu.index.constants import IndexConstants
+from hyperspace_tpu.plan.expr import col, count, sum_
+
+
+@pytest.fixture()
+def session(tmp_system_path):
+    return hst.Session(system_path=tmp_system_path)
+
+
+def write_dir(tmp_path, name, table):
+    d = tmp_path / name
+    d.mkdir()
+    pq.write_table(table, str(d / "part0.parquet"))
+    return str(d)
+
+
+def run_both(session, make_query, sort_by):
+    before = spmd.DISPATCH_COUNT
+    dist = make_query().to_pandas()
+    assert spmd.DISPATCH_COUNT > before, "SPMD path was not taken"
+    session.conf.set(IndexConstants.TPU_DISTRIBUTED_ENABLED, "false")
+    try:
+        single = make_query().to_pandas()
+    finally:
+        session.conf.set(IndexConstants.TPU_DISTRIBUTED_ENABLED, "true")
+    a = dist.sort_values(sort_by).reset_index(drop=True)
+    b = single.sort_values(sort_by).reset_index(drop=True)
+    pd.testing.assert_frame_equal(a, b, check_dtype=False)
+    return a
+
+
+@pytest.fixture()
+def fact_dim(tmp_path):
+    """Fact keys 0..119; dim covers only 0..79 (m:1, unique) so a left
+    join leaves 1/3 of fact unmatched."""
+    rng = np.random.default_rng(60)
+    n = 3000
+    fact = write_dir(tmp_path, "fact", pa.table({
+        "k": rng.integers(0, 120, n).astype(np.int64),
+        "v": rng.integers(0, 50, n).astype(np.int64),
+    }))
+    dim = write_dir(tmp_path, "dim", pa.table({
+        "dk": np.arange(80, dtype=np.int64),
+        "dval": rng.integers(0, 9, 80).astype(np.int64),
+    }))
+    return fact, dim
+
+
+class TestLeftOuterBroadcast:
+    def test_stream(self, session, fact_dim):
+        fact, dim = fact_dim
+        lf = session.read.parquet(fact)
+        rf = session.read.parquet(dim)
+        out = run_both(
+            session,
+            lambda: lf.join(rf, on=col("k") == col("dk"), how="left")
+                      .select("k", "v", "dval"),
+            sort_by=["k", "v"])
+        assert len(out) == 3000  # every fact row survives
+        assert out[out.k >= 80]["dval"].isna().all()
+        assert out[out.k < 80]["dval"].notna().all()
+
+    def test_aggregate_skips_nulls(self, session, fact_dim):
+        fact, dim = fact_dim
+        lf = session.read.parquet(fact)
+        rf = session.read.parquet(dim)
+        run_both(
+            session,
+            lambda: lf.join(rf, on=col("k") == col("dk"), how="left")
+                      .group_by("k").agg(count(None).alias("n"),
+                                         sum_(col("dval")).alias("sd")),
+            sort_by=["k"])
+
+    def test_group_by_nullable_right_col(self, session, fact_dim):
+        """Unmatched rows fall into the null group — nullable key meta
+        must propagate through the join into the grouped aggregate."""
+        fact, dim = fact_dim
+        lf = session.read.parquet(fact)
+        rf = session.read.parquet(dim)
+        out = run_both(
+            session,
+            lambda: lf.join(rf, on=col("k") == col("dk"), how="left")
+                      .group_by("dval").agg(count(None).alias("n")),
+            sort_by=["dval"])
+        assert out["dval"].isna().any()  # the null group exists
+
+
+class TestExchangeOuter:
+    @pytest.fixture()
+    def mn(self, tmp_path):
+        """m:n with one-sided key ranges: left 0..59, right 30..89 with
+        ~3 dups per key — both unmatched-left and unmatched-right exist."""
+        rng = np.random.default_rng(61)
+        left = write_dir(tmp_path, "l", pa.table({
+            "k": rng.integers(0, 60, 1200).astype(np.int64),
+            "v": np.arange(1200, dtype=np.int64),
+        }))
+        right = write_dir(tmp_path, "r", pa.table({
+            "rk": rng.integers(30, 90, 180).astype(np.int64),
+            "w": np.arange(180, dtype=np.int64),
+        }))
+        return left, right
+
+    def test_left_outer(self, session, mn):
+        left, right = mn
+        lf = session.read.parquet(left)
+        rf = session.read.parquet(right)
+        out = run_both(
+            session,
+            lambda: lf.join(rf, on=col("k") == col("rk"), how="left")
+                      .select("k", "v", "w"),
+            sort_by=["k", "v", "w"])
+        assert out[out.k < 30]["w"].isna().all()
+
+    def test_right_outer(self, session, mn):
+        left, right = mn
+        lf = session.read.parquet(left)
+        rf = session.read.parquet(right)
+        out = run_both(
+            session,
+            lambda: lf.join(rf, on=col("k") == col("rk"), how="right")
+                      .select("k", "rk", "w"),
+            sort_by=["rk", "w", "k"])
+        assert out[out.rk >= 60]["k"].isna().all()
+        assert set(out["rk"]) >= {60}  # unmatched right rows surfaced
+
+    def test_full_outer(self, session, mn):
+        left, right = mn
+        lf = session.read.parquet(left)
+        rf = session.read.parquet(right)
+        out = run_both(
+            session,
+            lambda: lf.join(rf, on=col("k") == col("rk"), how="full")
+                      .select("k", "v", "rk", "w"),
+            sort_by=["k", "rk", "v", "w"])
+        assert out["k"].isna().any() and out["rk"].isna().any()
+
+    def test_left_outer_aggregate(self, session, mn):
+        left, right = mn
+        lf = session.read.parquet(left)
+        rf = session.read.parquet(right)
+        run_both(
+            session,
+            lambda: lf.join(rf, on=col("k") == col("rk"), how="left")
+                      .group_by("k").agg(count(None).alias("n"),
+                                         sum_(col("w")).alias("sw")),
+            sort_by=["k"])
+
+    def test_full_outer_aggregate_null_group(self, session, mn):
+        left, right = mn
+        lf = session.read.parquet(left)
+        rf = session.read.parquet(right)
+        out = run_both(
+            session,
+            lambda: lf.join(rf, on=col("k") == col("rk"), how="full")
+                      .group_by("k").agg(count(None).alias("n")),
+            sort_by=["k"])
+        assert out["k"].isna().any()  # the appendix rows' null group
+
+
+class TestOuterNullKeys:
+    """Null join keys match nothing, but outer joins must still EMIT the
+    preserving side's null-key rows as unmatched — the single-device
+    executor does (_execute_outer_join), and the exchange path carries a
+    key-validity flag so they survive the route."""
+
+    @pytest.fixture()
+    def dirs(self, tmp_path):
+        rng = np.random.default_rng(66)
+        lk = rng.integers(0, 50, 900).astype(np.float64)
+        lk[rng.permutation(900)[:30]] = np.nan
+        left = write_dir(tmp_path, "nkl", pa.table({
+            "k": pa.array([None if np.isnan(x) else int(x) for x in lk],
+                          type=pa.int64()),
+            "v": np.arange(900, dtype=np.int64),
+        }))
+        rk = rng.integers(20, 70, 120).astype(np.float64)
+        rk[rng.permutation(120)[:8]] = np.nan
+        right = write_dir(tmp_path, "nkr", pa.table({
+            "rk": pa.array([None if np.isnan(x) else int(x) for x in rk],
+                           type=pa.int64()),
+            "w": np.arange(120, dtype=np.int64),
+        }))
+        return left, right
+
+    def test_full_outer_null_keys(self, session, dirs):
+        left, right = dirs
+        lf = session.read.parquet(left)
+        rf = session.read.parquet(right)
+        out = run_both(
+            session,
+            lambda: lf.join(rf, on=col("k") == col("rk"), how="full")
+                      .select("k", "v", "rk", "w"),
+            sort_by=["k", "rk", "v", "w"])
+        # Null-key rows from BOTH sides surface as unmatched.
+        assert out["v"].notna().sum() >= 900
+        assert out["w"].notna().sum() >= 120
+
+    def test_right_outer_null_keys(self, session, dirs):
+        left, right = dirs
+        lf = session.read.parquet(left)
+        rf = session.read.parquet(right)
+        out = run_both(
+            session,
+            lambda: lf.join(rf, on=col("k") == col("rk"), how="right")
+                      .select("k", "rk", "w"),
+            sort_by=["rk", "w", "k"])
+        # Every right row appears at least once, incl. the 8 null-key ones.
+        assert out["w"].nunique() == 120
+        assert out["rk"].isna().sum() >= 8
+
+
+class TestProjectBelowOuterJoin:
+    def test_projected_key_full_outer(self, session, tmp_path):
+        """A Project below a right/full outer join creates columns the
+        leaf metadata never saw — prep must read the projected meta, not
+        crash past the fallback net (r4 review regression)."""
+        rng = np.random.default_rng(67)
+        left = write_dir(tmp_path, "pl", pa.table({
+            "k": rng.integers(0, 40, 800).astype(np.int64),
+            "v": np.arange(800, dtype=np.int64)}))
+        right = write_dir(tmp_path, "pr", pa.table({
+            "rk": rng.integers(20, 60, 100).astype(np.int64),
+            "w": np.arange(100, dtype=np.int64)}))
+        lf = session.read.parquet(left)
+        rf = session.read.parquet(right)
+        run_both(
+            session,
+            lambda: lf.select((col("k") + 1).alias("k2"), "v")
+                      .join(rf, on=col("k2") == col("rk"), how="full")
+                      .select("k2", "v", "rk", "w"),
+            sort_by=["k2", "rk", "v", "w"])
+
+
+class TestSemiAnti:
+    @pytest.fixture()
+    def dirs(self, tmp_path):
+        rng = np.random.default_rng(62)
+        left = write_dir(tmp_path, "sl", pa.table({
+            "k": rng.integers(0, 100, 2000).astype(np.int64),
+            "v": np.arange(2000, dtype=np.int64),
+        }))
+        # Duplicate probe keys: a plain broadcast join would refuse (m:1),
+        # but semi/anti must not care.
+        right = write_dir(tmp_path, "sr", pa.table({
+            "rk": np.repeat(rng.permutation(100)[:40], 3).astype(np.int64),
+        }))
+        return left, right
+
+    def test_semi(self, session, dirs):
+        left, right = dirs
+        lf = session.read.parquet(left)
+        rf = session.read.parquet(right)
+        out = run_both(
+            session,
+            lambda: lf.join(rf, on=col("k") == col("rk"), how="semi")
+                      .select("k", "v"),
+            sort_by=["v"])
+        assert 0 < len(out) < 2000
+
+    def test_anti(self, session, dirs):
+        left, right = dirs
+        lf = session.read.parquet(left)
+        rf = session.read.parquet(right)
+        semi = run_both(
+            session,
+            lambda: lf.join(rf, on=col("k") == col("rk"), how="semi")
+                      .select("v"), sort_by=["v"])
+        anti = run_both(
+            session,
+            lambda: lf.join(rf, on=col("k") == col("rk"), how="anti")
+                      .select("v"), sort_by=["v"])
+        assert len(semi) + len(anti) == 2000
+
+    def test_semi_aggregate(self, session, dirs):
+        left, right = dirs
+        lf = session.read.parquet(left)
+        rf = session.read.parquet(right)
+        run_both(
+            session,
+            lambda: lf.join(rf, on=col("k") == col("rk"), how="semi")
+                      .group_by("k").agg(count(None).alias("n")),
+            sort_by=["k"])
+
+
+class TestMultiKeyExchange:
+    def test_two_key_m_n(self, session, tmp_path):
+        """Duplicate (k1, k2) pairs on both sides: the broadcast side
+        refuses (m:1) and the exchange must route on the packed
+        composite so equal TUPLES meet on one device."""
+        rng = np.random.default_rng(63)
+        left = write_dir(tmp_path, "m2l", pa.table({
+            "a": rng.integers(0, 25, 1500).astype(np.int64),
+            "b": rng.integers(0, 4, 1500).astype(np.int64),
+            "v": np.arange(1500, dtype=np.int64),
+        }))
+        right = write_dir(tmp_path, "m2r", pa.table({
+            "ra": np.repeat(np.arange(25, dtype=np.int64), 8),
+            "rb": np.tile(np.arange(4, dtype=np.int64), 50),
+            "w": np.arange(200, dtype=np.int64),
+        }))
+        lf = session.read.parquet(left)
+        rf = session.read.parquet(right)
+        run_both(
+            session,
+            lambda: lf.join(rf, on=(col("a") == col("ra"))
+                            & (col("b") == col("rb")))
+                      .group_by("a").agg(count(None).alias("n"),
+                                         sum_(col("w")).alias("sw")),
+            sort_by=["a"])
+
+    def test_three_key_left_outer(self, session, tmp_path):
+        rng = np.random.default_rng(64)
+        left = write_dir(tmp_path, "m3l", pa.table({
+            "a": rng.integers(0, 10, 900).astype(np.int64),
+            "b": rng.integers(0, 5, 900).astype(np.int64),
+            "c": rng.integers(0, 3, 900).astype(np.int64),
+            "v": np.arange(900, dtype=np.int64),
+        }))
+        # Right covers half the key space, with dups.
+        right = write_dir(tmp_path, "m3r", pa.table({
+            "ra": np.repeat(np.arange(5, dtype=np.int64), 30),
+            "rb": np.tile(np.repeat(np.arange(5, dtype=np.int64), 6), 5),
+            "rc": np.tile(np.arange(3, dtype=np.int64), 50),
+            "w": np.arange(150, dtype=np.int64),
+        }))
+        lf = session.read.parquet(left)
+        rf = session.read.parquet(right)
+        out = run_both(
+            session,
+            lambda: lf.join(
+                rf, on=(col("a") == col("ra")) & (col("b") == col("rb"))
+                & (col("c") == col("rc")), how="left")
+                .select("a", "b", "c", "v", "w"),
+            sort_by=["a", "b", "c", "v", "w"])
+        assert out[out.a >= 5]["w"].isna().all()
+
+    def test_string_key_left_outer_exchange(self, session, tmp_path):
+        rng = np.random.default_rng(65)
+        names = np.array([f"s{i:02d}" for i in range(30)])
+        left = write_dir(tmp_path, "skl", pa.table({
+            "k": names[rng.integers(0, 30, 1000)],
+            "v": np.arange(1000, dtype=np.int64),
+        }))
+        # Only the first 18 names, duplicated (m:n).
+        right = write_dir(tmp_path, "skr", pa.table({
+            "rk": names[rng.integers(0, 18, 120)],
+            "w": np.arange(120, dtype=np.int64),
+        }))
+        lf = session.read.parquet(left)
+        rf = session.read.parquet(right)
+        out = run_both(
+            session,
+            lambda: lf.join(rf, on=col("k") == col("rk"), how="left")
+                      .select("k", "rk", "v", "w"),
+            sort_by=["k", "v", "w"])
+        unmatched = out[out["w"].isna()]
+        assert len(unmatched) > 0
+        assert unmatched["rk"].isna().all()
+        # Matched rows surface the right key's own spelling.
+        matched = out[out["w"].notna()]
+        assert (matched["k"] == matched["rk"]).all()
