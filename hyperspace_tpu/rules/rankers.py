@@ -2,8 +2,9 @@
 
 Parity reference: rankers/FilterIndexRanker.scala:43 (Hybrid Scan → max
 common source bytes, else min index size; ties broken lexicographically by
-name) and rankers/JoinIndexRanker.scala:52 (prefer equal bucket counts, then
-more buckets, then more common source bytes).
+name) and rankers/JoinIndexRanker.scala:52-92 (equal bucket counts first;
+under Hybrid Scan common source bytes dominate within each
+equal/unequal class, with bucket count as the tiebreak).
 """
 
 from __future__ import annotations
@@ -32,6 +33,24 @@ class FilterIndexRanker:
 
 
 class JoinIndexRanker:
+    """Reference-matching priority (JoinIndexRanker.scala:72-92):
+
+    1. Pairs with EQUAL bucket counts outrank unequal pairs (zero
+       exchange in the aligned merge join).
+    2. Among equal-bucket pairs under Hybrid Scan, MORE common source
+       bytes wins (less on-the-fly merging); bucket count breaks the
+       common-bytes tie. Without Hybrid Scan, more buckets wins outright
+       (better join parallelism).
+    3. Among unequal-bucket pairs under Hybrid Scan, more common bytes
+       wins; without Hybrid Scan the input order is kept, as the
+       reference's sortWith does.
+
+    Deliberate extension: among EQUAL-bucket pairs, full ties break
+    lexicographically by index names so the chosen plan is reproducible
+    across candidate enumeration orders. Unequal-bucket pairs keep the
+    reference's input-order behavior exactly (sortWith stability).
+    """
+
     @staticmethod
     def rank(session, left_relation, right_relation,
              pairs: List[Tuple[IndexLogEntry, IndexLogEntry]]
@@ -40,14 +59,14 @@ class JoinIndexRanker:
             return None
         hybrid = session.hs_conf.hybrid_scan_enabled()
 
-        def score(pair):
-            l, r = pair
-            equal_buckets = 0 if l.num_buckets == r.num_buckets else 1
-            fewer_buckets = -(l.num_buckets + r.num_buckets)
+        def score(pos_pair):
+            pos, (l, r) = pos_pair
             common = 0
             if hybrid:
                 common = (common_source_bytes(l, left_relation)
                           + common_source_bytes(r, right_relation))
-            return (equal_buckets, fewer_buckets, -common, l.name, r.name)
+            if l.num_buckets == r.num_buckets:
+                return (0, -common, -l.num_buckets, l.name, r.name)
+            return (1, -common, pos)  # common is 0 when hybrid is off
 
-        return min(pairs, key=score)
+        return min(enumerate(pairs), key=score)[1]

@@ -129,6 +129,45 @@ class TestJoinIndexRanker:
                 [small_overlap, big_overlap])
         assert got is big_overlap
 
+    def test_hybrid_common_bytes_outrank_buckets_among_equal_pairs(self):
+        """Reference branch (JoinIndexRanker.scala:75-80): when both
+        pairs are internally equal-bucket and Hybrid Scan is on, common
+        source bytes dominate the bucket count (the pre-r4 key compared
+        bucket sums first — the ADVICE r3 divergence)."""
+        overlap = {"l1": 1000, "r1": 1000, "l2": 5, "r2": 5}
+        coarse_common = (entry("l1", 8), entry("r1", 8))
+        fine_rare = (entry("l2", 16), entry("r2", 16))
+        with mock.patch(
+                "hyperspace_tpu.rules.rankers.common_source_bytes",
+                side_effect=lambda e, rel: overlap[e.name]):
+            got = JoinIndexRanker.rank(
+                session_with(True), mock.MagicMock(), mock.MagicMock(),
+                [fine_rare, coarse_common])
+        assert got is coarse_common
+
+    def test_hybrid_common_bytes_decide_among_unequal_pairs(self):
+        """Reference branch (JoinIndexRanker.scala:86-91): both pairs
+        unequal-bucket → common bytes alone decide under Hybrid Scan."""
+        overlap = {"l1": 5, "r1": 5, "l2": 800, "r2": 800}
+        rare = (entry("l1", 16), entry("r1", 8))
+        common = (entry("l2", 4), entry("r2", 2))
+        with mock.patch(
+                "hyperspace_tpu.rules.rankers.common_source_bytes",
+                side_effect=lambda e, rel: overlap[e.name]):
+            got = JoinIndexRanker.rank(
+                session_with(True), mock.MagicMock(), mock.MagicMock(),
+                [rare, common])
+        assert got is common
+
+    def test_non_hybrid_unequal_pairs_keep_input_order(self):
+        """Reference: sortWith returns true for every unequal-unequal
+        compare without Hybrid Scan — input order is preserved."""
+        first = (entry("l1", 16), entry("r1", 8))
+        second = (entry("l2", 64), entry("r2", 32))
+        got = JoinIndexRanker.rank(
+            session_with(False), None, None, [first, second])
+        assert got is first
+
     def test_bucket_rules_dominate_common_bytes(self):
         overlap = {"l1": 1, "r1": 1, "l2": 1000, "r2": 1000}
         even_small = (entry("l1", 8), entry("r1", 8))
