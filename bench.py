@@ -844,6 +844,125 @@ def _single_device_phases(args, root):
         session.conf.set(IndexConstants.INDEX_HYBRID_SCAN_ENABLED, "false")
 
 
+def _run_lake_phase(args, root: str) -> None:
+    """Sketch indexes at LAKE scale (VERDICT r3 #5): planning-time pruning
+    only visibly pays when the file count is large (thousands of small
+    files — the lake shape the native probe loop exists for). Generates a
+    ≥1000-file lake, builds one skipping index carrying BOTH sketches
+    (MinMax on the time column, Bloom on the high-cardinality id), then
+    measures (a) the sketch-probe planning cost over all files, C++ vs
+    numpy on identical inputs, and (b) the end-to-end skipping speedup
+    vs the unskipped scan."""
+    import numpy as np
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    import hyperspace_tpu as hst
+    from hyperspace_tpu import native
+    from hyperspace_tpu.api import (BloomFilterSketch,
+                                    DataSkippingIndexConfig, Hyperspace,
+                                    MinMaxSketch)
+    from hyperspace_tpu.plan.expr import col, sum_
+    from hyperspace_tpu.rules import data_skipping_rule as dsr
+    from hyperspace_tpu.rules.apply_hyperspace import active_indexes
+
+    n_files = 1600 if args.scale >= 0.1 else 128
+    rows_per_file = 1500
+    rng = np.random.default_rng(17)
+    lake_dir = os.path.join(root, "lake")
+    os.makedirs(lake_dir)
+    for i in range(n_files):
+        # Time-ordered across files (MinMax prunable), ids key-contiguous
+        # per file (Bloom refutes the other files exactly).
+        ts = (8000 + i * 2
+              + np.sort(rng.integers(0, 3, rows_per_file))).astype(np.int64)
+        eid = (i * rows_per_file
+               + rng.permutation(rows_per_file)).astype(np.int64)
+        pq.write_table(pa.table({
+            "ts": pa.array(ts),
+            "event_id": pa.array(eid),
+            "amount": pa.array(np.round(rng.uniform(1, 500, rows_per_file),
+                                        2)),
+        }), os.path.join(lake_dir, f"f{i:05d}.parquet"))
+    RESULT["lake_files"] = n_files
+    RESULT["lake_rows"] = n_files * rows_per_file
+
+    session = hst.Session(system_path=os.path.join(root, "lake_idx"))
+    hs = Hyperspace(session)
+    lake = session.read.parquet(lake_dir)
+    t0 = time.perf_counter()
+    # Bloom sized to the per-file cardinality (default expected_items of
+    # 100k would build a ~117KB bitset per 1500-row file — ~190MB of
+    # sketch for the lake, drowning the measurement in bitset IO).
+    hs.create_index(lake, DataSkippingIndexConfig(
+        "lake_skip", [MinMaxSketch("ts"),
+                      BloomFilterSketch("event_id",
+                                        expected_items=rows_per_file)]))
+    RESULT["lake_sketch_build_s"] = round(time.perf_counter() - t0, 3)
+
+    # Queries: a ~1%-of-files time window, and 3 id point lookups.
+    mid = 8000 + n_files  # middle of the ts range
+    q_mm = (lake.filter((col("ts") >= mid) & (col("ts") <= mid + 30))
+            .agg(sum_(col("amount")).alias("s")))
+    ids = [rows_per_file * (n_files // 3) + 7,
+           rows_per_file * (n_files // 2) + 11,
+           rows_per_file * (4 * n_files // 5) + 13]
+    q_bloom = lake.filter(col("event_id").isin(ids)) \
+        .select("event_id", "amount")
+
+    session.enable_hyperspace()
+    for qname, q in (("lake_minmax", q_mm), ("lake_bloom", q_bloom)):
+        leaves = [l for l in q.optimized_plan().collect_leaves()
+                  if hasattr(l, "relation")]
+        kept = min(len(l.relation.all_files()) for l in leaves)
+        RESULT[f"{qname}_files_kept"] = kept
+        if kept >= n_files:
+            RESULT["errors"].append(f"{qname}: nothing pruned")
+
+    # Planning-cost A/B on identical inputs: the sketch-probe evaluation
+    # over all files, native C++ vs the numpy fallback (the sketch table
+    # is cached after the warm-up call, so this times pure probe work).
+    entry = next(e for e in active_indexes(session)
+                 if e.name == "lake_skip")
+    scan_plan = lake.plan
+    while hasattr(scan_plan, "child"):
+        scan_plan = scan_plan.child
+    all_files = scan_plan.relation.all_files()
+    schema = scan_plan.relation.schema
+    cond = (col("event_id") == ids[0]) & \
+        (col("ts") >= mid) & (col("ts") <= mid + 30)
+    probe = lambda: dsr.evaluate_sketch_predicate(
+        entry, cond, all_files, schema)
+    probe()  # warm: loads + caches the sketch table
+    reps = max(args.repeats, 3)
+    if native.available():
+        RESULT["lake_plan_native_ms"] = round(
+            timed_best(probe, reps) * 1000, 3)
+    saved = (native._lib, native._lib_tried)
+    native._lib, native._lib_tried = None, True
+    try:
+        RESULT["lake_plan_numpy_ms"] = round(
+            timed_best(probe, reps) * 1000, 3)
+    finally:
+        native._lib, native._lib_tried = saved
+    if "lake_plan_native_ms" in RESULT and RESULT["lake_plan_native_ms"] > 0:
+        RESULT["lake_plan_native_speedup"] = round(
+            RESULT["lake_plan_numpy_ms"] / RESULT["lake_plan_native_ms"], 2)
+
+    # End-to-end: the same queries with skipping on vs the raw scan.
+    for qname, q in (("lake_minmax", q_mm), ("lake_bloom", q_bloom)):
+        session.enable_hyperspace()
+        q.to_arrow()
+        skip_s = timed_best(lambda: q.to_arrow(), args.repeats)
+        session.disable_hyperspace()
+        q.to_arrow()
+        scan_s = timed_best(lambda: q.to_arrow(), args.repeats)
+        RESULT[f"{qname}_skip_s"] = round(skip_s, 4)
+        RESULT[f"{qname}_scan_s"] = round(scan_s, 4)
+        RESULT[f"{qname}_speedup"] = round(
+            scan_s / skip_s if skip_s > 0 else float("inf"), 3)
+
+
 def main():
     parser = argparse.ArgumentParser()
     # Default 0.5 (3M lineitem rows): at 0.2 the on-chip query pairs were
@@ -912,6 +1031,13 @@ def main():
             _single_device_phases(args, root)
         except _SkipToMesh:
             pass
+        if not _backend_dead():
+            with _phase("lake"):
+                try:
+                    _run_lake_phase(args, root)
+                except Exception as e:
+                    RESULT["errors"].append(
+                        f"lake phase: {type(e).__name__}: {e}")
         with _phase("mesh"):
             # Multi-device numbers ride along at a bounded scale (the
             # virtual CPU mesh measures path health + collective overhead,

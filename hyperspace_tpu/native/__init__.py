@@ -18,7 +18,7 @@ import ctypes
 import hashlib
 import os
 import subprocess
-from typing import List, Optional
+from typing import List, Optional, Tuple
 
 import numpy as np
 
@@ -115,13 +115,15 @@ def bloom_positions(value, dtype: str, num_bits: int,
                      for i in range(num_hashes)], dtype=np.int32)
 
 
-def bloom_probe_many(bits_rows: List[Optional[bytes]], value, dtype: str,
-                     num_bits: int, num_hashes: int) -> np.ndarray:
-    """keep-mask over files: False where the bitset proves the literal
-    absent. Missing bitsets (None) keep the file."""
+def prepare_bloom(bits_rows: List[Optional[bytes]],
+                  num_bits: int) -> Tuple[np.ndarray, np.ndarray]:
+    """Assemble the per-file bitset matrix ONCE. At lake scale (thousands
+    of files) this Python loop dominates the probe cost, so callers cache
+    the result next to the sketch table and probe with
+    bloom_probe_prepared — microseconds per literal instead of
+    milliseconds."""
     n = len(bits_rows)
     stride = num_bits // 8
-    positions = bloom_positions(value, dtype, num_bits, num_hashes)
     buf = np.zeros((n, stride), dtype=np.uint8)
     valid = np.zeros(n, dtype=np.uint8)
     for i, b in enumerate(bits_rows):
@@ -129,6 +131,16 @@ def bloom_probe_many(bits_rows: List[Optional[bytes]], value, dtype: str,
             row = np.frombuffer(b, dtype=np.uint8)
             buf[i, :row.shape[0]] = row[:stride]
             valid[i] = 1
+    return buf, valid
+
+
+def bloom_probe_prepared(buf: np.ndarray, valid: np.ndarray, value,
+                         dtype: str, num_bits: int,
+                         num_hashes: int) -> np.ndarray:
+    """keep-mask over files from a prepare_bloom matrix: False where the
+    bitset proves the literal absent; missing bitsets keep the file."""
+    n, stride = buf.shape
+    positions = bloom_positions(value, dtype, num_bits, num_hashes)
     lib = get_lib()
     out = np.zeros(n, dtype=np.uint8)
     if lib is not None:
@@ -143,6 +155,15 @@ def bloom_probe_many(bits_rows: List[Optional[bytes]], value, dtype: str,
         byte = buf[:, p >> 3]
         keep &= ((byte >> (7 - (p & 7))) & 1).astype(bool)
     return keep | ~valid.astype(bool)
+
+
+def bloom_probe_many(bits_rows: List[Optional[bytes]], value, dtype: str,
+                     num_bits: int, num_hashes: int) -> np.ndarray:
+    """One-shot convenience: prepare + probe (callers with repeated
+    probes should cache prepare_bloom's result instead)."""
+    buf, valid = prepare_bloom(bits_rows, num_bits)
+    return bloom_probe_prepared(buf, valid, value, dtype, num_bits,
+                                num_hashes)
 
 
 # ---------------------------------------------------------------------------
@@ -199,13 +220,13 @@ def _int_domain_literal(op: str, value):
     return op, v
 
 
-def minmax_prune(lo_rows: List, hi_rows: List, op: str, value, dtype: str
-                 ) -> Optional[np.ndarray]:
-    """keep-mask over files for ``col <op> value`` given per-file min/max.
-    Returns None when the dtype isn't supported natively (caller falls back
-    to the generic Python path — e.g. strings)."""
+def prepare_minmax(lo_rows: List, hi_rows: List,
+                   dtype: str) -> Optional[Tuple]:
+    """Convert the per-file (min, max) pylists into probe-ready numpy
+    arrays ONCE — at lake scale the Python conversion loop dominates the
+    probe, so callers cache this next to the sketch table. Returns
+    (lo, hi, has) or None for natively-unsupported dtypes (strings)."""
     import datetime
-    import math
 
     from ..schema import BOOL, DATE, FLOAT32, FLOAT64, INT32, INT64
 
@@ -220,30 +241,32 @@ def minmax_prune(lo_rows: List, hi_rows: List, op: str, value, dtype: str
                 a[i] = conv(r)
         return a
 
-    lib = get_lib()
-    out = np.zeros(n, dtype=np.uint8)
     if dtype in (INT32, INT64, BOOL, DATE):
         if dtype == DATE:
             epoch = datetime.date(1970, 1, 1)
             conv = lambda v: (v - epoch).days
-            v = conv(value)
         else:
             conv = int
-            op, v = _int_domain_literal(op, value)
-            if op == "ALL":
-                return np.ones(n, dtype=bool)
-            if op == "NONE":
-                return ~has.astype(bool)  # only all-null files survive.
-        op_code = _OPS[op]
-        lo = fill(lo_rows, np.int64, conv)
-        hi = fill(hi_rows, np.int64, conv)
-        if lib is not None:
-            lib.hst_minmax_prune_i64(
-                lo.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
-                hi.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
-                _as_u8p(has), n, v, op_code, _as_u8p(out))
-            return out.astype(bool)
-        return _np_prune(lo, hi, has, v, op_code)
+        return fill(lo_rows, np.int64, conv), \
+            fill(hi_rows, np.int64, conv), has
+    if dtype in (FLOAT32, FLOAT64):
+        return fill(lo_rows, np.float64, float), \
+            fill(hi_rows, np.float64, float), has
+    return None
+
+
+def minmax_prune_prepared(prep: Tuple, op: str, value,
+                          dtype: str) -> np.ndarray:
+    """keep-mask from a prepare_minmax triple for ``col <op> value``."""
+    import datetime
+    import math
+
+    from ..schema import DATE, FLOAT32, FLOAT64
+
+    lo, hi, has = prep
+    n = lo.shape[0]
+    lib = get_lib()
+    out = np.zeros(n, dtype=np.uint8)
     if dtype in (FLOAT32, FLOAT64):
         try:
             v = float(value)
@@ -252,8 +275,6 @@ def minmax_prune(lo_rows: List, hi_rows: List, op: str, value, dtype: str
         if math.isnan(v):
             return ~has.astype(bool)
         op_code = _OPS[op]
-        lo = fill(lo_rows, np.float64, float)
-        hi = fill(hi_rows, np.float64, float)
         if lib is not None:
             lib.hst_minmax_prune_f64(
                 lo.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
@@ -261,7 +282,34 @@ def minmax_prune(lo_rows: List, hi_rows: List, op: str, value, dtype: str
                 _as_u8p(has), n, v, op_code, _as_u8p(out))
             return out.astype(bool)
         return _np_prune(lo, hi, has, v, op_code)
-    return None
+    if dtype == DATE:
+        v = (value - datetime.date(1970, 1, 1)).days
+    else:
+        op, v = _int_domain_literal(op, value)
+        if op == "ALL":
+            return np.ones(n, dtype=bool)
+        if op == "NONE":
+            return ~has.astype(bool)  # only all-null files survive.
+    op_code = _OPS[op]
+    if lib is not None:
+        lib.hst_minmax_prune_i64(
+            lo.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            hi.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            _as_u8p(has), n, v, op_code, _as_u8p(out))
+        return out.astype(bool)
+    return _np_prune(lo, hi, has, v, op_code)
+
+
+def minmax_prune(lo_rows: List, hi_rows: List, op: str, value, dtype: str
+                 ) -> Optional[np.ndarray]:
+    """keep-mask over files for ``col <op> value`` given per-file min/max.
+    Returns None when the dtype isn't supported natively (caller falls back
+    to the generic Python path — e.g. strings). One-shot convenience over
+    prepare_minmax + minmax_prune_prepared."""
+    prep = prepare_minmax(lo_rows, hi_rows, dtype)
+    if prep is None:
+        return None
+    return minmax_prune_prepared(prep, op, value, dtype)
 
 
 # ---------------------------------------------------------------------------

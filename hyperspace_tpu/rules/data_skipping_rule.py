@@ -186,6 +186,13 @@ def _eval_compare(column: str, op: str, value, table, sketch_by_col,
 
     from .. import native
 
+    # Probe-ready arrays (bitset matrices, converted min/max columns) are
+    # assembled once per sketch table and cached inside it — at lake scale
+    # (thousands of files) the per-probe Python assembly otherwise costs
+    # more than the scan it would save (r4 lake bench: 230 ms/probe at
+    # 1600 files, vs microseconds prepared).
+    prep_cache = table.setdefault("__prepared__", {})
+
     for s in sketches:
         if s.kind == "MinMax":
             lo_name, hi_name = minmax_cols(column)
@@ -193,7 +200,13 @@ def _eval_compare(column: str, op: str, value, table, sketch_by_col,
             dtype = relation_schema.field(column).dtype
             # Native (or vectorized) prune over all files in one call; the
             # generic Python loop remains for unsupported dtypes (strings).
-            m = native.minmax_prune(lo, hi, op, value, dtype)
+            pr = prep_cache.get((column, "MinMax"))
+            if pr is None:
+                pr = native.prepare_minmax(lo, hi, dtype)
+                prep_cache[(column, "MinMax")] = \
+                    pr if pr is not None else "unsupported"
+            m = None if pr in (None, "unsupported") else \
+                native.minmax_prune_prepared(pr, op, value, dtype)
             if m is None:
                 m = np.ones(n, dtype=bool)
                 for i in range(n):
@@ -222,9 +235,17 @@ def _eval_compare(column: str, op: str, value, table, sketch_by_col,
             dtype = relation_schema.field(column).dtype
             num_bits = int(s.properties["numBits"])
             num_hashes = int(s.properties["numHashes"])
-            bits_rows = table[bloom_col(column)]
-            m = native.bloom_probe_many(bits_rows, value, dtype,
-                                        num_bits, num_hashes)
+            pr = prep_cache.get((column, "BloomFilter"))
+            if pr is None:
+                pr = native.prepare_bloom(table[bloom_col(column)],
+                                          num_bits)
+                prep_cache[(column, "BloomFilter")] = pr
+                # The raw bitset pylist is equal-sized to the prepared
+                # matrix and never read again — drop it so the cached
+                # table doesn't hold bloom bytes twice.
+                table[bloom_col(column)] = None
+            m = native.bloom_probe_prepared(pr[0], pr[1], value, dtype,
+                                            num_bits, num_hashes)
             apply_mask(m)
     return out
 
