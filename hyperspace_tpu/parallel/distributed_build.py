@@ -128,7 +128,9 @@ DISPATCH_COUNT = 0
 def distributed_build_sorted_buckets(
         table: Table, indexed_cols: Sequence[str], num_buckets: int,
         mesh: Optional[Mesh] = None,
-        capacity_factor: float = 2.0) -> Tuple[Table, jnp.ndarray, jnp.ndarray]:
+        capacity_factor: float = 2.0,
+        process_local_rows: bool = False
+        ) -> Tuple[Table, jnp.ndarray, jnp.ndarray]:
     """Distributed hash-partition + sort of ``table`` over ``mesh``.
 
     Returns (globally sorted-by-(device,bucket,keys) Table, validity mask,
@@ -136,13 +138,16 @@ def distributed_build_sorted_buckets(
     buckets in its contiguous range, each sorted by the indexed columns.
     Retries with doubled capacity on exchange overflow (skewed buckets,
     SURVEY §7 hard-part #3).
+
+    ``process_local_rows``: on a multi-process mesh, asserts that
+    ``table`` is THIS process's disjoint slice of the source (the
+    multihost contract — see pad_and_shard).
     """
     from .mesh import pad_and_shard
 
     mesh = mesh or make_mesh()
     n_dev = mesh.devices.size
     rows = table.num_rows
-    shard_rows = -(-max(rows, 1) // n_dev)  # ceil.
 
     # Column data is shipped under "d:<name>"; a nullable column's validity
     # bitmap rides the same exchange under "v:<name>" (null rows keep their
@@ -164,7 +169,13 @@ def distributed_build_sorted_buckets(
     for c in indexed_cols:
         key_dtypes.append(table.column(c).dtype)
 
-    arrays, valid = pad_and_shard(mesh, arrays, rows)
+    arrays, valid = pad_and_shard(mesh, arrays, rows,
+                                  process_local=process_local_rows)
+    # Shard size from the GLOBAL padded array, not the local row count:
+    # under a multi-process runtime each process holds different local
+    # rows, and a locally-derived static capacity would compile different
+    # collectives per process (a gloo size-mismatch abort).
+    shard_rows = next(iter(arrays.values())).shape[0] // n_dev
 
     # cap == shard_rows always suffices (a device can send at most its whole
     # shard to one destination), so escalation terminates.

@@ -31,13 +31,35 @@ def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 
 
-def pad_and_shard(mesh: Mesh, arrays: dict, rows: int) -> tuple:
+def pad_and_shard(mesh: Mesh, arrays: dict, rows: int,
+                  process_local: bool = False) -> tuple:
     """Zero-pad each 1-D-leading array to a device multiple, build the
     validity mask, and device_put everything row-sharded over the data axis.
     Returns (sharded arrays dict, sharded valid mask). The single shared
-    recipe for putting host rows onto the mesh (build + query sides)."""
+    recipe for putting host rows onto the mesh (build + query sides).
+
+    When ``mesh`` spans multiple processes (jax.distributed over DCN) the
+    caller must state what its rows ARE: ``process_local=True`` means
+    ``arrays`` hold THIS process's disjoint slice of the data — every
+    process pads its block to the worldwide max local shard (one
+    allgather of row counts) and the global row-sharded arrays assemble
+    from the per-process blocks. Callers that read the FULL dataset in
+    every process (the current query paths) must NOT silently shard it —
+    that would duplicate every row — so they fail loudly instead until
+    reader sharding exists."""
     import jax.numpy as jnp
 
+    spans = {d.process_index for d in mesh.devices.flat}
+    if len(spans) > 1:
+        if not process_local:
+            raise NotImplementedError(
+                "pad_and_shard over a multi-process mesh needs "
+                "process-local input rows (process_local=True); sharding "
+                "a full-dataset copy from every process would duplicate "
+                "rows. Multi-process reads currently require the caller "
+                "to split the source per process (see parallel/multihost "
+                "and __graft_entry__.dryrun_multihost).")
+        return _pad_and_shard_multihost(mesh, arrays, rows)
     n_dev = mesh.devices.size
     shard = -(-max(rows, 1) // n_dev)  # ceil.
     padded = shard * n_dev
@@ -52,6 +74,45 @@ def pad_and_shard(mesh: Mesh, arrays: dict, rows: int) -> tuple:
     sharding = row_sharding(mesh)
     return ({n: jax.device_put(a, sharding) for n, a in out.items()},
             jax.device_put(valid, sharding))
+
+
+def _pad_and_shard_multihost(mesh: Mesh, arrays: dict, rows: int) -> tuple:
+    """Multi-process assembly: local rows → global row-sharded arrays.
+    The per-device shard is sized to the LARGEST process block so every
+    device shard is equal (static shapes worldwide); short processes pad
+    with invalid rows."""
+    from jax.experimental import multihost_utils
+
+    n_total = mesh.devices.size
+    n_local = len(mesh.local_devices)
+    # One allgather carries (rows, n_local): asymmetric device counts
+    # would compile different collectives per process — the gloo
+    # size-mismatch abort — so fail loudly up front instead.
+    stats = np.asarray(multihost_utils.process_allgather(
+        np.array([rows, n_local], np.int64)))
+    if n_local == 0 or not (stats[..., 1] == n_local).all():
+        raise NotImplementedError(
+            "multi-process pad_and_shard requires every process to hold "
+            f"the same number of mesh-local devices; saw "
+            f"{stats[..., 1].tolist()}")
+    shard = -(-max(int(stats[..., 0].max()), 1) // n_local)  # worldwide max
+    local_padded = shard * n_local
+    global_rows = shard * n_total
+    sharding = row_sharding(mesh)
+
+    def assemble(a):
+        a = np.asarray(a)
+        if local_padded != a.shape[0]:
+            pad = np.zeros((local_padded - a.shape[0],) + a.shape[1:],
+                           a.dtype)
+            a = np.concatenate([a, pad])
+        return jax.make_array_from_process_local_data(
+            sharding, a, (global_rows,) + a.shape[1:])
+
+    out = {n: assemble(a) for n, a in arrays.items()}
+    valid = assemble(np.concatenate(
+        [np.ones(rows, bool), np.zeros(local_padded - rows, bool)]))
+    return out, valid
 
 
 def device_bucket_range(device_index: int, n_devices: int,

@@ -7,11 +7,29 @@ processes over DCN, and `jax.devices()` then spans every chip in the slice
 — at which point the SAME collectives this framework already uses
 (lax.all_to_all bucket exchanges in parallel/distributed_build.py and
 execution/spmd.py, psum/pmin/pmax aggregation) ride ICI within a host and
-DCN across hosts with no code changes: `make_mesh()` simply sees more
-devices.
+DCN across hosts: `make_mesh()` simply sees more devices. The caller-side
+contract that changes is the INPUT: each process must feed its own
+disjoint slice of the source (pad_and_shard's ``process_local`` flag);
+paths that read the full dataset in every process fail loudly rather
+than silently duplicating rows.
 
 Single-host processes (and the CI's virtual CPU mesh) skip initialization
 entirely, so the framework is identical from one chip to a pod slice.
+
+This is NOT an init-helper-only contract: the distributed build really
+executes across a process boundary in CI — __graft_entry__.dryrun_multihost
+forms a 2-process × N-device jax.distributed cluster on CPU (gloo
+collectives standing in for DCN), each process contributes its own local
+rows (mesh._pad_and_shard_multihost assembles the global row-sharded
+arrays from per-process blocks, padding to the worldwide max shard so
+every process compiles identical collectives), and the bucket exchange
+crosses processes with row conservation, host-hash bucket agreement, and
+single ownership verified (tests/test_multihost.py).
+
+Known limitation: STRING columns currently carry per-process dictionaries;
+a cross-process build with string indexed columns would need a global
+dictionary union first (the exchange ships codes, and codes from
+different dictionaries must not meet). The dryrun pins the numeric path.
 """
 
 from __future__ import annotations

@@ -88,3 +88,24 @@ class TestGlobalMesh:
         mesh = global_mesh()
         import jax
         assert int(np.prod(mesh.devices.shape)) == len(jax.devices())
+
+
+class TestRealTwoProcessCluster:
+    """The wiring above, un-mocked: 2 OS processes × 2 virtual CPU devices
+    form ONE jax.distributed cluster (gloo collectives standing in for
+    DCN) and run the REAL distributed index build across the process
+    boundary (SURVEY §5 comm-backend DCN row; VERDICT r3 #10)."""
+
+    def test_distributed_build_crosses_the_process_boundary(self):
+        import os
+        import sys
+        sys.path.insert(0, os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))))
+        import __graft_entry__ as g
+
+        # Verified inside the dryrun: worker init through
+        # initialize_multihost, row conservation across processes,
+        # device-computed bucket ids equal the host hash, every bucket
+        # owned by exactly one (process, device), contiguous per-device
+        # ranges, and an UNEVEN source split (the worldwide shard pad).
+        g.dryrun_multihost(n_processes=2, local_devices=2)
