@@ -16,7 +16,8 @@ Supported grammar (case-insensitive keywords):
                   [ [INNER|LEFT|RIGHT|FULL] JOIN table_ref ON a = b [AND ...] ]*
                   [WHERE <predicate>]
                   [GROUP BY col, ...] [HAVING <predicate>]
-                  [ORDER BY col [ASC|DESC], ...] [LIMIT n]
+                  [ORDER BY col|expr [ASC|DESC], ...] [LIMIT n]
+                  (an ORDER BY expression must restate a SELECT item)
     table_ref  := <view> | ( select ) [AS name]
 
 Comma-separated FROM lists are lowered to inner joins using the WHERE
@@ -25,12 +26,15 @@ table; predicates common to every branch of a top-level OR are factored
 out first, so the TPC-H Q19 shape finds its join key).
 
 Expressions: identifiers (optionally alias-qualified: ``l.l_orderkey``),
-integer/float/string literals, DATE 'yyyy-mm-dd', INTERVAL 'n' DAY|MONTH|
-YEAR (folded into date literals at parse time), + - * /, comparisons
+integer/float/string literals, DATE 'yyyy-mm-dd', INTERVAL n|'n'
+DAY[S]|MONTH[S]|YEAR[S] (folded into date literals at parse time),
+CAST(x AS DATE|INT|BIGINT|DOUBLE) (literals fold; date-typed expressions
+pass through), + - * /, comparisons
 (= != <> < <= > >=), [NOT] BETWEEN x AND y, [NOT] IN (...), [NOT] LIKE,
-IS [NOT] NULL, CASE [x] WHEN ... THEN ... [ELSE ...] END,
-EXTRACT(YEAR|MONTH|DAY|QUARTER FROM x), SUBSTRING(x FROM a [FOR b]) or
-SUBSTRING(x, a, b), UPPER/LOWER/TRIM, AND/OR/NOT, and aggregates
+IS [NOT] NULL, CASE [x] WHEN ... THEN ... [ELSE ...] END (ELSE NULL END
+elides to the no-ELSE form),
+EXTRACT(YEAR|MONTH|DAY|QUARTER FROM x), SUBSTRING/SUBSTR(x FROM a [FOR b])
+or SUBSTRING/SUBSTR(x, a, b), UPPER/LOWER/TRIM, AND/OR/NOT, and aggregates
 SUM/AVG/MIN/MAX/COUNT(*)/COUNT(x)/COUNT(DISTINCT x) — including
 arithmetic OVER aggregates (``100 * sum(a) / sum(b)``).
 
@@ -74,7 +78,8 @@ _KEYWORDS = {
     "SUM", "AVG", "MIN", "MAX", "COUNT",
     "LIKE", "IS", "NULL", "CASE", "WHEN", "THEN", "ELSE", "END",
     "EXTRACT", "INTERVAL", "DAY", "MONTH", "YEAR", "QUARTER",
-    "EXISTS", "SUBSTRING", "FOR", "UPPER", "LOWER", "TRIM",
+    "EXISTS", "SUBSTRING", "SUBSTR", "FOR", "UPPER", "LOWER", "TRIM",
+    "CAST",
 }
 
 # Words that are only meaningful in specific grammar positions (EXTRACT's
@@ -83,7 +88,7 @@ _KEYWORDS = {
 # nothing, so a column named ``year`` must stay reachable.
 _SOFT_KEYWORDS = {
     "YEAR", "MONTH", "DAY", "QUARTER", "FOR",
-    "UPPER", "LOWER", "TRIM", "SUBSTRING", "EXTRACT",
+    "UPPER", "LOWER", "TRIM", "SUBSTRING", "SUBSTR", "EXTRACT", "CAST",
 }
 
 
@@ -437,9 +442,13 @@ class _Parser:
             inner = self.expr()
             self.take("OP", ")")
             return E.DatePart(part.lower(), inner)
-        if self.peek("KW", "SUBSTRING") and self.peek2("OP", "("):
+        if (self.peek("KW", "SUBSTRING") or self.peek("KW", "SUBSTR")) \
+                and self.peek2("OP", "("):
             self.take("KW")
             return self._substring()
+        if self.peek("KW", "CAST") and self.peek2("OP", "("):
+            self.take("KW")
+            return self._cast()
         for fn in ("UPPER", "LOWER", "TRIM"):
             if self.peek("KW", fn) and self.peek2("OP", "("):
                 self.take("KW")
@@ -448,15 +457,21 @@ class _Parser:
                 self.take("OP", ")")
                 return E.StringTransform(fn.lower(), inner)
         if self.accept("KW", "INTERVAL"):
-            raw = self.take("STR")
-            if not raw.strip().lstrip("-").isdigit():
-                raise HyperspaceException(
-                    f"SQL: INTERVAL takes an integer string, got {raw!r}")
-            unit = self.take("KW")
+            if self.peek("STR"):
+                raw = self.take("STR")
+                if not raw.strip().lstrip("-").isdigit():
+                    raise HyperspaceException(
+                        f"SQL: INTERVAL takes an integer, got {raw!r}")
+                n = int(raw)
+            else:
+                n = self._int_literal("INTERVAL expects")
+            # Unit: keyword (DAY) or identifier (days — the TPC-DS
+            # spelling), singular or plural.
+            unit = self.take().upper().rstrip("S")
             if unit not in ("DAY", "MONTH", "YEAR"):
                 raise HyperspaceException(
                     f"SQL: INTERVAL unit must be DAY/MONTH/YEAR, got {unit}")
-            return _IntervalLit(int(raw), unit)
+            return _IntervalLit(n, unit)
         if self.peek("KW") and self.toks[self.i][1].upper() in (
                 "SUM", "AVG", "MIN", "MAX", "COUNT"):
             return self._aggregate()
@@ -488,7 +503,12 @@ class _Parser:
             branches.append((c, self.expr()))
         if not branches:
             raise HyperspaceException("SQL: CASE requires at least one WHEN")
-        else_v = self.expr() if self.accept("KW", "ELSE") else None
+        else_v = None
+        if self.accept("KW", "ELSE"):
+            if self.peek("KW", "NULL") and self.peek2("KW", "END"):
+                self.take("KW")  # ELSE NULL END ≡ no ELSE (SQL: both null)
+            else:
+                else_v = self.expr()
         self.take("KW", "END")
         return E.CaseWhen(branches, else_v)
 
@@ -507,6 +527,48 @@ class _Parser:
                 length = self._int_literal()
         self.take("OP", ")")
         return E.Substring(inner, start, length)
+
+    def _cast(self) -> E.Expr:
+        """CAST(x AS type). DATE casts fold string literals to date
+        literals and pass date-typed expressions through (the TPC-DS
+        texts cast already-date columns defensively); INT/BIGINT and
+        DOUBLE casts fold numeric literals. Anything else is a clear
+        error naming the unsupported target."""
+        self.take("OP", "(")
+        inner = self.expr()
+        self.take("KW", "AS")
+        ty = self.take().upper()
+        if self.peek("OP", "("):
+            # Parameterized targets (DECIMAL(7,2), CHAR(16), ...): name
+            # the target in the error instead of a bare parse failure.
+            raise HyperspaceException(
+                f"SQL: unsupported CAST target {ty}(...)")
+        self.take("OP", ")")
+        if ty == "DATE":
+            if isinstance(inner, E.Lit):
+                if not isinstance(inner.value, str):
+                    raise HyperspaceException(
+                        f"SQL: CAST({inner.value!r} AS DATE): only "
+                        "yyyy-mm-dd string literals fold to dates")
+                try:
+                    y, m, d = inner.value.split("-")
+                    return E.lit(datetime.date(int(y), int(m), int(d)))
+                except ValueError:
+                    raise HyperspaceException(
+                        f"SQL: CAST({inner.value!r} AS DATE): not a "
+                        "yyyy-mm-dd literal")
+            return inner  # date-typed expression: identity
+        if ty in ("INT", "INTEGER", "BIGINT", "DOUBLE", "FLOAT"):
+            conv = int if ty in ("INT", "INTEGER", "BIGINT") else float
+            if isinstance(inner, E.Lit):
+                try:
+                    return E.lit(conv(inner.value))
+                except (TypeError, ValueError):
+                    raise HyperspaceException(
+                        f"SQL: CAST({inner.value!r} AS {ty}): literal "
+                        "does not convert")
+            return inner
+        raise HyperspaceException(f"SQL: unsupported CAST target {ty}")
 
     def _int_literal(self, what: str = "") -> int:
         neg = self.accept("OP", "-")
@@ -799,11 +861,12 @@ class _Parser:
         if distinct:
             df = df.distinct()
 
-        # ORDER BY qualified-name resolution. Assigned on the way OUT so a
-        # derived table's inner select (which runs this method re-entrantly
-        # mid-FROM) can't leave ITS scope behind as the binding for the
-        # outer query's ORDER BY.
+        # ORDER BY resolution state. Assigned on the way OUT so a derived
+        # table's inner select (which runs this method re-entrantly
+        # mid-FROM) can't leave ITS scope/items behind as the binding for
+        # the outer query's ORDER BY.
         self._last_scope = scope
+        self._last_items = items if not star else []
         return df
 
     def _select_item(self):
@@ -816,12 +879,25 @@ class _Parser:
         return e, alias
 
     def _order_item(self):
-        name = self.take_name()
-        # Alias-qualified order keys (``o.o_orderdate``) resolve against
-        # the most recent select's FROM bindings; unknown prefixes pass
-        # through (flattened struct leaves sort by their dotted name).
-        name = self._resolve_qual_name(
-            name, getattr(self, "_last_scope", None) or _Scope())
+        scope = getattr(self, "_last_scope", None) or _Scope()
+        # Parse a full expression. A plain [qualified] column (or output
+        # alias, which resolves to itself) is the common case; any other
+        # expression (``ORDER BY sum(x) DESC``, ``ORDER BY a * b`` — the
+        # TPC-DS house style) must restate a SELECT item, and the sort
+        # key is that item's output column.
+        e = self._resolve_quals(self.expr(), scope)
+        if isinstance(e, E.Col):
+            name = e.column
+        else:
+            name = None
+            for item, alias in getattr(self, "_last_items", []):
+                if item is not None and repr(item) == repr(e):
+                    name = alias or item.name
+                    break
+            if name is None:
+                raise HyperspaceException(
+                    f"SQL: ORDER BY expression {e!r} must restate an "
+                    "item of the SELECT list")
         if self.accept("KW", "DESC"):
             return (name, False)
         self.accept("KW", "ASC")

@@ -1,0 +1,474 @@
+"""Verbatim TPC-DS queries over a synthetic mini-catalog.
+
+The texts below are the published TPC-DS v1.4 benchmark queries with the
+reference's parameter substitutions (the same queries the reference runs
+through Spark for its 99 approved-plan goldens —
+goldstandard/TPCDSBase.scala:41, src/test/resources/tpcds/queries/).
+Only single-SELECT queries inside the SQL front-end's grammar are
+included — no CTEs, window functions, or ROLLUP (13 of the 99 today);
+growing this list is a matter of grammar, not harness.
+
+The catalog generator builds every referenced table with exactly the
+columns these queries touch, seeded and sized so each query returns a
+non-empty answer (each query's literal predicates — manager ids,
+manufacturer ids, price bands, date windows — are guaranteed hits by
+construction below).
+"""
+
+from __future__ import annotations
+
+import datetime
+from typing import Dict
+
+import numpy as np
+import pyarrow as pa
+
+# Calendar span covering every query's date predicates (1998..2002).
+_D0 = datetime.date(1998, 1, 1)
+N_DD = 1700
+
+_DAY_NAMES = ["Monday", "Tuesday", "Wednesday", "Thursday", "Friday",
+              "Saturday", "Sunday"]
+
+
+def tables(rng: np.random.Generator) -> Dict[str, pa.Table]:
+    n_it, n_cu, n_ca, n_st, n_cd, n_pr, n_hd, n_td, n_wh = \
+        60, 120, 80, 6, 40, 12, 15, 200, 4
+    n_ss, n_cs, n_inv = 1600, 1200, 900
+
+    dates = [_D0 + datetime.timedelta(days=i) for i in range(N_DD)]
+    date_dim = pa.table({
+        "d_date_sk": pa.array(np.arange(N_DD, dtype=np.int64)),
+        "d_date": pa.array(dates, type=pa.date32()),
+        "d_year": pa.array(np.array([d.year for d in dates], np.int64)),
+        "d_moy": pa.array(np.array([d.month for d in dates], np.int64)),
+        "d_qoy": pa.array(np.array([(d.month - 1) // 3 + 1 for d in dates],
+                                   np.int64)),
+        "d_day_name": pa.array([_DAY_NAMES[d.weekday()] for d in dates]),
+    })
+
+    # Items: cycle manager/manufacturer ids through every value the query
+    # texts name, and force price-band coverage (q21: [0.99,1.49],
+    # q37: [68,98], q82: [62,92]).
+    managers = np.array([1, 8, 28] + list(range(2, 8)) + [9, 10],
+                        dtype=np.int64)
+    manufacts = np.array([128, 677, 940, 694, 808, 129, 270, 821, 423, 55],
+                         dtype=np.int64)
+    prices = np.round(rng.uniform(1, 110, n_it), 2)
+    prices[0:6] = [1.10, 1.25, 70.0, 80.0, 65.0, 90.0]
+    cats = ["Music", "Books", "Sports", "Home", "Shoes"]
+    item = pa.table({
+        "i_item_sk": pa.array(np.arange(n_it, dtype=np.int64)),
+        "i_item_id": pa.array([f"ITEM{i:08d}" for i in range(n_it)]),
+        "i_item_desc": pa.array([f"desc of item {i}" for i in range(n_it)]),
+        "i_brand_id": pa.array((np.arange(n_it, dtype=np.int64) % 9) + 1),
+        "i_brand": pa.array([f"brand#{(i % 9) + 1}" for i in range(n_it)]),
+        "i_manufact_id": pa.array(manufacts[np.arange(n_it) % len(manufacts)]),
+        "i_manufact": pa.array(
+            [f"manufact{int(m)}" for m in
+             manufacts[np.arange(n_it) % len(manufacts)]]),
+        "i_category_id": pa.array((np.arange(n_it, dtype=np.int64) % 5) + 1),
+        "i_category": pa.array([cats[i % 5] for i in range(n_it)]),
+        "i_class": pa.array([f"class{i % 4}" for i in range(n_it)]),
+        "i_current_price": pa.array(prices),
+        "i_manager_id": pa.array(managers[np.arange(n_it) % len(managers)]),
+    })
+
+    customer = pa.table({
+        "c_customer_sk": pa.array(np.arange(n_cu, dtype=np.int64)),
+        "c_current_addr_sk": pa.array(
+            rng.integers(0, n_ca, n_cu).astype(np.int64)),
+    })
+    zips = ["85669", "86197", "60601", "10001", "94111", "30301", "73301",
+            "88274"]
+    states = ["CA", "WA", "GA", "TN", "TX", "NY"]
+    customer_address = pa.table({
+        "ca_address_sk": pa.array(np.arange(n_ca, dtype=np.int64)),
+        "ca_zip": pa.array([zips[i % len(zips)] + "0000" for i in
+                            range(n_ca)]),
+        "ca_state": pa.array([states[i % len(states)] for i in range(n_ca)]),
+    })
+    store = pa.table({
+        "s_store_sk": pa.array(np.arange(n_st, dtype=np.int64)),
+        "s_store_id": pa.array([f"S{i:04d}" for i in range(n_st)]),
+        "s_store_name": pa.array(
+            ["ese" if i % 3 == 0 else f"store{i}" for i in range(n_st)]),
+        "s_zip": pa.array([zips[(i + 3) % len(zips)] + "0000"
+                           for i in range(n_st)]),
+        "s_gmt_offset": pa.array(
+            np.where(np.arange(n_st) % 2 == 0, -5, -6).astype(np.int64)),
+    })
+    customer_demographics = pa.table({
+        "cd_demo_sk": pa.array(np.arange(n_cd, dtype=np.int64)),
+        "cd_gender": pa.array(["M" if i % 2 == 0 else "F"
+                               for i in range(n_cd)]),
+        "cd_marital_status": pa.array(["S" if i % 3 == 0 else "M"
+                                       for i in range(n_cd)]),
+        "cd_education_status": pa.array(
+            ["College" if i % 2 == 0 else "4 yr Degree"
+             for i in range(n_cd)]),
+    })
+    promotion = pa.table({
+        "p_promo_sk": pa.array(np.arange(n_pr, dtype=np.int64)),
+        "p_channel_email": pa.array(["N" if i % 2 == 0 else "Y"
+                                     for i in range(n_pr)]),
+        "p_channel_event": pa.array(["N" if i % 3 == 0 else "Y"
+                                     for i in range(n_pr)]),
+    })
+    household_demographics = pa.table({
+        "hd_demo_sk": pa.array(np.arange(n_hd, dtype=np.int64)),
+        "hd_dep_count": pa.array((np.arange(n_hd, dtype=np.int64) % 10)),
+    })
+    time_dim = pa.table({
+        "t_time_sk": pa.array(np.arange(n_td, dtype=np.int64)),
+        "t_hour": pa.array((np.arange(n_td, dtype=np.int64) % 24)),
+        "t_minute": pa.array(
+            ((np.arange(n_td, dtype=np.int64) * 7) % 60)),
+    })
+    warehouse = pa.table({
+        "w_warehouse_sk": pa.array(np.arange(n_wh, dtype=np.int64)),
+        "w_warehouse_name": pa.array([f"Warehouse number {i}"
+                                      for i in range(n_wh)]),
+    })
+
+    store_sales = pa.table({
+        "ss_sold_date_sk": pa.array(
+            rng.integers(0, N_DD, n_ss).astype(np.int64)),
+        "ss_sold_time_sk": pa.array(
+            rng.integers(0, n_td, n_ss).astype(np.int64)),
+        "ss_item_sk": pa.array(rng.integers(0, n_it, n_ss).astype(np.int64)),
+        "ss_customer_sk": pa.array(
+            rng.integers(0, n_cu, n_ss).astype(np.int64)),
+        "ss_cdemo_sk": pa.array(rng.integers(0, n_cd, n_ss).astype(np.int64)),
+        "ss_hdemo_sk": pa.array(rng.integers(0, n_hd, n_ss).astype(np.int64)),
+        "ss_promo_sk": pa.array(rng.integers(0, n_pr, n_ss).astype(np.int64)),
+        "ss_store_sk": pa.array(rng.integers(0, n_st, n_ss).astype(np.int64)),
+        "ss_quantity": pa.array(rng.integers(1, 100, n_ss).astype(np.int64)),
+        "ss_list_price": pa.array(np.round(rng.uniform(1, 300, n_ss), 2)),
+        "ss_coupon_amt": pa.array(np.round(rng.uniform(0, 40, n_ss), 2)),
+        "ss_sales_price": pa.array(np.round(rng.uniform(1, 290, n_ss), 2)),
+        "ss_ext_sales_price": pa.array(
+            np.round(rng.uniform(5, 4000, n_ss), 2)),
+    })
+    catalog_sales = pa.table({
+        "cs_sold_date_sk": pa.array(
+            rng.integers(0, N_DD, n_cs).astype(np.int64)),
+        "cs_item_sk": pa.array(rng.integers(0, n_it, n_cs).astype(np.int64)),
+        "cs_bill_customer_sk": pa.array(
+            rng.integers(0, n_cu, n_cs).astype(np.int64)),
+        "cs_bill_cdemo_sk": pa.array(
+            rng.integers(0, n_cd, n_cs).astype(np.int64)),
+        "cs_promo_sk": pa.array(rng.integers(0, n_pr, n_cs).astype(np.int64)),
+        "cs_quantity": pa.array(rng.integers(1, 100, n_cs).astype(np.int64)),
+        "cs_list_price": pa.array(np.round(rng.uniform(1, 300, n_cs), 2)),
+        "cs_coupon_amt": pa.array(np.round(rng.uniform(0, 40, n_cs), 2)),
+        "cs_sales_price": pa.array(np.round(rng.uniform(1, 600, n_cs), 2)),
+        "cs_ext_sales_price": pa.array(
+            np.round(rng.uniform(5, 4000, n_cs), 2)),
+    })
+    # Inventory dates concentrated around the q21/q37/q82 windows so the
+    # ±30/60-day BETWEENs keep rows.
+    inv_base = (datetime.date(2000, 2, 1) - _D0).days
+    inventory = pa.table({
+        "inv_item_sk": pa.array(rng.integers(0, n_it, n_inv).astype(np.int64)),
+        "inv_warehouse_sk": pa.array(
+            rng.integers(0, n_wh, n_inv).astype(np.int64)),
+        "inv_date_sk": pa.array(
+            (inv_base + rng.integers(0, 160, n_inv)).astype(np.int64)),
+        "inv_quantity_on_hand": pa.array(
+            rng.integers(0, 600, n_inv).astype(np.int64)),
+    })
+
+    return {
+        "date_dim": date_dim, "item": item, "customer": customer,
+        "customer_address": customer_address, "store": store,
+        "customer_demographics": customer_demographics,
+        "promotion": promotion,
+        "household_demographics": household_demographics,
+        "time_dim": time_dim, "warehouse": warehouse,
+        "store_sales": store_sales, "catalog_sales": catalog_sales,
+        "inventory": inventory,
+    }
+
+
+def register_tables(session, root: str) -> None:
+    import os
+
+    import pyarrow.parquet as pq
+
+    rng = np.random.default_rng(2024)
+    for name, t in tables(rng).items():
+        d = os.path.join(root, name)
+        os.makedirs(d, exist_ok=True)
+        pq.write_table(t, os.path.join(d, "part0.parquet"))
+        session.create_temp_view(name, session.read.parquet(d))
+
+
+def index_configs():
+    """Covering indexes matching the corpus's FIRST joins: the join rule
+    (like the reference's isPlanLinear check) only rewrites joins whose
+    both sides are linear, i.e. the bottom of each left-deep star-join
+    tree. FROM-order puts date_dim⋈store_sales at the bottom of the
+    q3/q42/q43/q52/q55 family and item⋈inventory under q21/q37/q82, so
+    those four tables carry the indexes — both sides of a rewritten join
+    need one (JoinIndexRule compatible-pair requirement)."""
+    from hyperspace_tpu.api import IndexConfig
+
+    return [
+        ("date_dim", IndexConfig(
+            "ds_dd_sk", ["d_date_sk"],
+            ["d_date", "d_year", "d_moy", "d_qoy", "d_day_name"])),
+        ("store_sales", IndexConfig(
+            "ds_ss_date", ["ss_sold_date_sk"],
+            ["ss_item_sk", "ss_store_sk", "ss_ext_sales_price",
+             "ss_sales_price"])),
+        ("item", IndexConfig(
+            "ds_item_sk", ["i_item_sk"],
+            ["i_item_id", "i_item_desc", "i_brand_id", "i_brand",
+             "i_manufact_id", "i_manufact", "i_category_id", "i_category",
+             "i_class", "i_current_price", "i_manager_id"])),
+        ("inventory", IndexConfig(
+            "ds_inv_item", ["inv_item_sk"],
+            ["inv_date_sk", "inv_warehouse_sk", "inv_quantity_on_hand"])),
+    ]
+
+
+# The verbatim texts (TPC-DS v1.4, reference parameter substitutions).
+QUERY_TEXTS: Dict[str, str] = {
+    "tpcds_real_q3": """
+SELECT
+  dt.d_year,
+  item.i_brand_id brand_id,
+  item.i_brand brand,
+  SUM(ss_ext_sales_price) sum_agg
+FROM date_dim dt, store_sales, item
+WHERE dt.d_date_sk = store_sales.ss_sold_date_sk
+  AND store_sales.ss_item_sk = item.i_item_sk
+  AND item.i_manufact_id = 128
+  AND dt.d_moy = 11
+GROUP BY dt.d_year, item.i_brand, item.i_brand_id
+ORDER BY dt.d_year, sum_agg DESC, brand_id
+LIMIT 100
+""",
+    "tpcds_real_q7": """
+SELECT
+  i_item_id,
+  avg(ss_quantity) agg1,
+  avg(ss_list_price) agg2,
+  avg(ss_coupon_amt) agg3,
+  avg(ss_sales_price) agg4
+FROM store_sales, customer_demographics, date_dim, item, promotion
+WHERE ss_sold_date_sk = d_date_sk AND
+  ss_item_sk = i_item_sk AND
+  ss_cdemo_sk = cd_demo_sk AND
+  ss_promo_sk = p_promo_sk AND
+  cd_gender = 'M' AND
+  cd_marital_status = 'S' AND
+  cd_education_status = 'College' AND
+  (p_channel_email = 'N' OR p_channel_event = 'N') AND
+  d_year = 2000
+GROUP BY i_item_id
+ORDER BY i_item_id
+LIMIT 100
+""",
+    "tpcds_real_q15": """
+SELECT
+  ca_zip,
+  sum(cs_sales_price)
+FROM catalog_sales, customer, customer_address, date_dim
+WHERE cs_bill_customer_sk = c_customer_sk
+  AND c_current_addr_sk = ca_address_sk
+  AND (substr(ca_zip, 1, 5) IN ('85669', '86197', '88274', '83405', '86475',
+                                '85392', '85460', '80348', '81792')
+  OR ca_state IN ('CA', 'WA', 'GA')
+  OR cs_sales_price > 500)
+  AND cs_sold_date_sk = d_date_sk
+  AND d_qoy = 2 AND d_year = 2001
+GROUP BY ca_zip
+ORDER BY ca_zip
+LIMIT 100
+""",
+    "tpcds_real_q21": """
+SELECT *
+FROM (
+       SELECT
+         w_warehouse_name,
+         i_item_id,
+         sum(CASE WHEN (cast(d_date AS DATE) < cast('2000-03-11' AS DATE))
+           THEN inv_quantity_on_hand
+             ELSE 0 END) AS inv_before,
+         sum(CASE WHEN (cast(d_date AS DATE) >= cast('2000-03-11' AS DATE))
+           THEN inv_quantity_on_hand
+             ELSE 0 END) AS inv_after
+       FROM inventory, warehouse, item, date_dim
+       WHERE i_current_price BETWEEN 0.99 AND 1.49
+         AND i_item_sk = inv_item_sk
+         AND inv_warehouse_sk = w_warehouse_sk
+         AND inv_date_sk = d_date_sk
+         AND d_date BETWEEN (cast('2000-03-11' AS DATE) - INTERVAL 30 days)
+       AND (cast('2000-03-11' AS DATE) + INTERVAL 30 days)
+       GROUP BY w_warehouse_name, i_item_id) x
+WHERE (CASE WHEN inv_before > 0
+  THEN inv_after / inv_before
+       ELSE NULL
+       END) BETWEEN 2.0 / 3.0 AND 3.0 / 2.0
+ORDER BY w_warehouse_name, i_item_id
+LIMIT 100
+""",
+    "tpcds_real_q26": """
+SELECT
+  i_item_id,
+  avg(cs_quantity) agg1,
+  avg(cs_list_price) agg2,
+  avg(cs_coupon_amt) agg3,
+  avg(cs_sales_price) agg4
+FROM catalog_sales, customer_demographics, date_dim, item, promotion
+WHERE cs_sold_date_sk = d_date_sk AND
+  cs_item_sk = i_item_sk AND
+  cs_bill_cdemo_sk = cd_demo_sk AND
+  cs_promo_sk = p_promo_sk AND
+  cd_gender = 'M' AND
+  cd_marital_status = 'S' AND
+  cd_education_status = 'College' AND
+  (p_channel_email = 'N' OR p_channel_event = 'N') AND
+  d_year = 2000
+GROUP BY i_item_id
+ORDER BY i_item_id
+LIMIT 100
+""",
+    "tpcds_real_q37": """
+SELECT
+  i_item_id,
+  i_item_desc,
+  i_current_price
+FROM item, inventory, date_dim, catalog_sales
+WHERE i_current_price BETWEEN 68 AND 68 + 30
+  AND inv_item_sk = i_item_sk
+  AND d_date_sk = inv_date_sk
+  AND d_date BETWEEN cast('2000-02-01' AS DATE) AND (cast('2000-02-01' AS DATE) + INTERVAL 60 days)
+  AND i_manufact_id IN (677, 940, 694, 808)
+  AND inv_quantity_on_hand BETWEEN 100 AND 500
+  AND cs_item_sk = i_item_sk
+GROUP BY i_item_id, i_item_desc, i_current_price
+ORDER BY i_item_id
+LIMIT 100
+""",
+    "tpcds_real_q42": """
+SELECT
+  dt.d_year,
+  item.i_category_id,
+  item.i_category,
+  sum(ss_ext_sales_price)
+FROM date_dim dt, store_sales, item
+WHERE dt.d_date_sk = store_sales.ss_sold_date_sk
+  AND store_sales.ss_item_sk = item.i_item_sk
+  AND item.i_manager_id = 1
+  AND dt.d_moy = 11
+  AND dt.d_year = 2000
+GROUP BY dt.d_year
+  , item.i_category_id
+  , item.i_category
+ORDER BY sum(ss_ext_sales_price) DESC, dt.d_year
+  , item.i_category_id
+  , item.i_category
+LIMIT 100
+""",
+    "tpcds_real_q43": """
+SELECT
+  s_store_name,
+  s_store_id,
+  sum(CASE WHEN (d_day_name = 'Sunday')
+    THEN ss_sales_price
+      ELSE NULL END) sun_sales,
+  sum(CASE WHEN (d_day_name = 'Monday')
+    THEN ss_sales_price
+      ELSE NULL END) mon_sales,
+  sum(CASE WHEN (d_day_name = 'Tuesday')
+    THEN ss_sales_price
+      ELSE NULL END) tue_sales,
+  sum(CASE WHEN (d_day_name = 'Wednesday')
+    THEN ss_sales_price
+      ELSE NULL END) wed_sales,
+  sum(CASE WHEN (d_day_name = 'Thursday')
+    THEN ss_sales_price
+      ELSE NULL END) thu_sales,
+  sum(CASE WHEN (d_day_name = 'Friday')
+    THEN ss_sales_price
+      ELSE NULL END) fri_sales,
+  sum(CASE WHEN (d_day_name = 'Saturday')
+    THEN ss_sales_price
+      ELSE NULL END) sat_sales
+FROM date_dim, store_sales, store
+WHERE d_date_sk = ss_sold_date_sk AND
+  s_store_sk = ss_store_sk AND
+  s_gmt_offset = -5 AND
+  d_year = 2000
+GROUP BY s_store_name, s_store_id
+ORDER BY s_store_name, s_store_id, sun_sales, mon_sales, tue_sales, wed_sales,
+  thu_sales, fri_sales, sat_sales
+LIMIT 100
+""",
+    "tpcds_real_q52": """
+SELECT
+  dt.d_year,
+  item.i_brand_id brand_id,
+  item.i_brand brand,
+  sum(ss_ext_sales_price) ext_price
+FROM date_dim dt, store_sales, item
+WHERE dt.d_date_sk = store_sales.ss_sold_date_sk
+  AND store_sales.ss_item_sk = item.i_item_sk
+  AND item.i_manager_id = 1
+  AND dt.d_moy = 11
+  AND dt.d_year = 2000
+GROUP BY dt.d_year, item.i_brand, item.i_brand_id
+ORDER BY dt.d_year, ext_price DESC, brand_id
+LIMIT 100
+""",
+    "tpcds_real_q55": """
+SELECT
+  i_brand_id brand_id,
+  i_brand brand,
+  sum(ss_ext_sales_price) ext_price
+FROM date_dim, store_sales, item
+WHERE d_date_sk = ss_sold_date_sk
+  AND ss_item_sk = i_item_sk
+  AND i_manager_id = 28
+  AND d_moy = 11
+  AND d_year = 1999
+GROUP BY i_brand, i_brand_id
+ORDER BY ext_price DESC, brand_id
+LIMIT 100
+""",
+    "tpcds_real_q82": """
+SELECT
+  i_item_id,
+  i_item_desc,
+  i_current_price
+FROM item, inventory, date_dim, store_sales
+WHERE i_current_price BETWEEN 62 AND 62 + 30
+  AND inv_item_sk = i_item_sk
+  AND d_date_sk = inv_date_sk
+  AND d_date BETWEEN cast('2000-05-25' AS DATE) AND (cast('2000-05-25' AS DATE) + INTERVAL 60 days)
+  AND i_manufact_id IN (129, 270, 821, 423)
+  AND inv_quantity_on_hand BETWEEN 100 AND 500
+  AND ss_item_sk = i_item_sk
+GROUP BY i_item_id, i_item_desc, i_current_price
+ORDER BY i_item_id
+LIMIT 100
+""",
+    "tpcds_real_q96": """
+SELECT count(*)
+FROM store_sales, household_demographics, time_dim, store
+WHERE ss_sold_time_sk = time_dim.t_time_sk
+  AND ss_hdemo_sk = household_demographics.hd_demo_sk
+  AND ss_store_sk = s_store_sk
+  AND time_dim.t_hour = 20
+  AND time_dim.t_minute >= 30
+  AND household_demographics.hd_dep_count = 7
+  AND store.s_store_name = 'ese'
+ORDER BY count(*)
+LIMIT 100
+""",
+}
+
+QUERY_NAMES = sorted(QUERY_TEXTS)

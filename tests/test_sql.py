@@ -273,6 +273,34 @@ class TestSqlReviewRegressions:
         # [-3, 1) clamps to one visible char.
         assert set(df["virt"]) == {"H", "L"}
 
+    def test_cast_folds_or_errors_clearly(self, env):
+        got = env.sql("SELECT okey FROM li WHERE okey = CAST('3' AS INT) "
+                      "LIMIT 1").to_pandas()
+        assert got["okey"].tolist() == [3]
+        with pytest.raises(HyperspaceException, match="DECIMAL"):
+            env.sql("SELECT CAST(price AS DECIMAL(7,2)) FROM li")
+        with pytest.raises(HyperspaceException, match="does not convert"):
+            env.sql("SELECT okey FROM li WHERE okey = CAST('x' AS INT)")
+
+    def test_order_by_expression_restates_select_item(self, env):
+        got = env.sql(
+            "SELECT okey, price * qty AS total FROM li "
+            "ORDER BY price * qty DESC LIMIT 5").to_pandas()
+        assert (got["total"].values == sorted(got["total"], reverse=True)
+                ).all()
+        g2 = env.sql("SELECT flag, SUM(qty) FROM li GROUP BY flag "
+                     "ORDER BY SUM(qty) DESC").to_pandas()
+        assert g2.iloc[0, 1] == g2.iloc[:, 1].max()
+        with pytest.raises(HyperspaceException, match="restate"):
+            env.sql("SELECT okey FROM li ORDER BY okey + 1")
+
+    def test_case_else_null_equals_no_else(self, env):
+        a = env.sql("SELECT SUM(CASE WHEN flag = 'A' THEN qty ELSE NULL "
+                    "END) AS s FROM li").to_pandas()
+        b = env.sql("SELECT SUM(CASE WHEN flag = 'A' THEN qty END) AS s "
+                    "FROM li").to_pandas()
+        assert a["s"][0] == b["s"][0]
+
     def test_mid_statement_semicolon_rejected(self, env):
         # ';' is legal only as a trailing terminator — never silently
         # dropped mid-statement (that would splice two statements).
