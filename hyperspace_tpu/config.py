@@ -171,6 +171,20 @@ class HyperspaceConf:
             IndexConstants.TPU_DISTRIBUTED_ENABLED,
             IndexConstants.TPU_DISTRIBUTED_ENABLED_DEFAULT)
 
+    def distributed_single_device(self) -> str:
+        v = str(self._conf.get(
+            IndexConstants.TPU_DISTRIBUTED_SINGLE_DEVICE,
+            IndexConstants.TPU_DISTRIBUTED_SINGLE_DEVICE_DEFAULT)).lower()
+        # Accept the sibling boolean flags' spellings; reject garbage
+        # loudly instead of silently coercing to "auto".
+        v = {"true": "on", "false": "off"}.get(v, v)
+        if v not in ("auto", "on", "off"):
+            from .exceptions import HyperspaceException
+            raise HyperspaceException(
+                f"{IndexConstants.TPU_DISTRIBUTED_SINGLE_DEVICE} must be "
+                f"auto/on/off (or true/false), got {v!r}")
+        return v
+
     def build_rows_per_shard(self) -> int:
         return int(
             self._conf.get(

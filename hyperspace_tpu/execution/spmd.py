@@ -535,12 +535,33 @@ class _AggSpec:
 # Entry point.
 # ---------------------------------------------------------------------------
 
+def _device_count() -> int:
+    """Devices the dispatch mesh will span (tests shrink this to exercise
+    the 1-device fused path on a multi-device host)."""
+    return len(jax.devices())
+
+
 def _spmd_eligible(session) -> bool:
     if session is None:
         return False
     if not session.hs_conf.distributed_enabled():
         return False
-    return len(jax.devices()) >= 2
+    if _device_count() >= 2:
+        return True
+    # ONE device: the "SPMD" program degenerates to a single fused jit
+    # program (collectives over a 1-device mesh are identity, and XLA
+    # removes them). That still matters on an accelerator, where the
+    # interpreted executor pays a host↔device round trip per operator —
+    # the measured round-3 on-chip filter bottleneck — while the fused
+    # program pays ~one. On CPU the "device" shares the silicon with the
+    # host, so fusing buys nothing and costs compiles; "auto" therefore
+    # keys on the backend (VERDICT r3 #8).
+    mode = session.hs_conf.distributed_single_device()
+    if mode == "off":
+        return False
+    if mode == "on":
+        return True
+    return jax.default_backend() not in ("cpu",)
 
 
 def _leaf_within_budget(root, session) -> bool:
@@ -697,7 +718,7 @@ def _prepare(root, executor, caps: Dict[int, Tuple[int, int]]) -> _Prepared:
     if leaf_table.num_rows == 0:
         raise _Unsupported("empty stream")
 
-    mesh = make_mesh()
+    mesh = make_mesh(jax.devices()[:_device_count()])
     n_dev = mesh.devices.size
 
     stream_arrays: Dict[str, jax.Array] = {}

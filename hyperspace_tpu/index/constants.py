@@ -146,3 +146,10 @@ class IndexConstants:
     # (actions/CreateActionBase.scala:118-121). "true" | "false".
     TPU_DISTRIBUTED_ENABLED = "hyperspace.tpu.distributed.enabled"
     TPU_DISTRIBUTED_ENABLED_DEFAULT = "true"
+    # One-device dispatch of the fused SPMD query program: "auto" takes it
+    # on accelerators (every host sync is a device round trip there —
+    # measured as the round-3 on-chip filter bottleneck) and skips it on
+    # CPU (the interpreted executor shares the silicon, so fusing buys
+    # nothing and costs compiles). "on"/"off" force.
+    TPU_DISTRIBUTED_SINGLE_DEVICE = "hyperspace.tpu.distributed.singleDevice"
+    TPU_DISTRIBUTED_SINGLE_DEVICE_DEFAULT = "auto"

@@ -1,0 +1,129 @@
+"""One-device dispatch of the fused SPMD query program (VERDICT r3 #8).
+
+On a 1-device mesh the SPMD program degenerates to a single fused jit
+program (XLA removes identity collectives). On an accelerator that cuts
+the per-operator host↔device round trips the interpreted executor pays —
+the measured round-3 on-chip filter bottleneck — so `auto` enables it
+there; on CPU `auto` keeps the interpreted path (shared silicon, compile
+cost buys nothing). These tests force `on` with the mesh shrunk to one
+device and oracle-match every supported plan shape.
+"""
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+import hyperspace_tpu as hst
+from hyperspace_tpu.execution import spmd
+from hyperspace_tpu.index.constants import IndexConstants
+from hyperspace_tpu.plan.expr import col, count, sum_
+
+
+@pytest.fixture()
+def session(tmp_system_path, monkeypatch):
+    monkeypatch.setattr(spmd, "_device_count", lambda: 1)
+    s = hst.Session(system_path=tmp_system_path)
+    s.conf.set(IndexConstants.TPU_DISTRIBUTED_SINGLE_DEVICE, "on")
+    return s
+
+
+def write_dir(tmp_path, name, table):
+    d = tmp_path / name
+    d.mkdir()
+    pq.write_table(table, str(d / "part0.parquet"))
+    return str(d)
+
+
+@pytest.fixture()
+def dirs(tmp_path):
+    rng = np.random.default_rng(70)
+    left = write_dir(tmp_path, "l", pa.table({
+        "k": rng.integers(0, 50, 2000).astype(np.int64),
+        "g": rng.integers(0, 7, 2000).astype(np.int64),
+        "v": np.round(rng.uniform(0, 10, 2000), 3),
+    }))
+    right = write_dir(tmp_path, "r", pa.table({
+        "rk": np.arange(50, dtype=np.int64),
+        "w": rng.integers(0, 100, 50).astype(np.int64),
+    }))
+    return left, right
+
+
+def run_both(session, make_query, sort_by):
+    before = spmd.DISPATCH_COUNT
+    fused = make_query().to_pandas()
+    assert spmd.DISPATCH_COUNT > before, \
+        "1-device fused dispatch was not taken"
+    session.conf.set(IndexConstants.TPU_DISTRIBUTED_SINGLE_DEVICE, "off")
+    try:
+        interp = make_query().to_pandas()
+    finally:
+        session.conf.set(IndexConstants.TPU_DISTRIBUTED_SINGLE_DEVICE, "on")
+    a = fused.sort_values(sort_by).reset_index(drop=True)
+    b = interp.sort_values(sort_by).reset_index(drop=True)
+    pd.testing.assert_frame_equal(a, b, check_dtype=False)
+    return a
+
+
+class TestOneDeviceFusedDispatch:
+    def test_filtered_grouped_aggregate(self, session, dirs):
+        left, _ = dirs
+        lf = session.read.parquet(left)
+        run_both(
+            session,
+            lambda: lf.filter(col("k") < 30).group_by("g")
+                      .agg(count(None).alias("n"), sum_(col("v")).alias("sv")),
+            sort_by=["g"])
+
+    def test_join_then_aggregate(self, session, dirs):
+        left, right = dirs
+        lf = session.read.parquet(left)
+        rf = session.read.parquet(right)
+        run_both(
+            session,
+            lambda: lf.join(rf, on=col("k") == col("rk"))
+                      .group_by("g").agg(sum_(col("w")).alias("sw")),
+            sort_by=["g"])
+
+    def test_row_returning_stream(self, session, dirs):
+        left, _ = dirs
+        lf = session.read.parquet(left)
+        out = run_both(
+            session,
+            lambda: lf.filter(col("k") < 10).select("k", "v"),
+            sort_by=["k", "v"])
+        assert len(out) > 0
+
+    def test_exchange_join_degenerates_cleanly(self, session, dirs,
+                                               tmp_path):
+        """m:n join on one device: the hash route is an identity
+        all_to_all; the local merge does all the work."""
+        left, _ = dirs
+        rng = np.random.default_rng(71)
+        dup = write_dir(tmp_path, "rdup", pa.table({
+            "rk": rng.integers(0, 50, 200).astype(np.int64),
+            "w": np.arange(200, dtype=np.int64)}))
+        lf = session.read.parquet(left)
+        rf = session.read.parquet(dup)
+        run_both(
+            session,
+            lambda: lf.join(rf, on=col("k") == col("rk"))
+                      .group_by("k").agg(count(None).alias("n")),
+            sort_by=["k"])
+
+    def test_auto_stays_off_on_cpu(self, session, dirs):
+        """`auto` must not take the fused path on the CPU backend — the
+        host and the 'device' share silicon, so there is no round trip
+        to save (the analysis BASELINE.md records)."""
+        import jax
+        if jax.default_backend() != "cpu":
+            pytest.skip("auto keys on the backend; this pins the CPU leg")
+        left, _ = dirs
+        session.conf.set(IndexConstants.TPU_DISTRIBUTED_SINGLE_DEVICE,
+                         "auto")
+        lf = session.read.parquet(left)
+        before = spmd.DISPATCH_COUNT
+        lf.group_by("g").agg(count(None).alias("n")).to_pandas()
+        assert spmd.DISPATCH_COUNT == before
