@@ -6,8 +6,13 @@ root path:
     <indexPath>/_hyperspace_log/<id>        — JSON log entry, immutable
     <indexPath>/_hyperspace_log/latestStable — copy of the latest stable entry
 
-``write_log`` refuses to overwrite an existing id (temp file + atomic
-create-if-absent), which is how concurrent actions detect conflicts.
+``write_log`` refuses to overwrite an existing id (conditional
+put-if-absent), which is how concurrent actions detect conflicts. The
+storage behind the protocol is pluggable (log_store.LogStore): local FS
+by default, conditional-put object stores by scheme registration — the
+protocol uses no rename, so S3/GCS-class stores satisfy it with one
+conditional PUT (SURVEY §7 hard-part 4; tests/test_log_store.py runs
+the lifecycle against the object-store double).
 """
 
 from __future__ import annotations
@@ -15,13 +20,20 @@ from __future__ import annotations
 import os
 from typing import List, Optional
 
-from ..util import file_utils, json_utils
+from ..util import json_utils
 from .constants import IndexConstants, STABLE_STATES, States
 from .log_entry import IndexLogEntry
+from .log_store import (LocalFsLogStore, LogStore, store_for_path,
+                        strip_file_scheme)
 
 
 class IndexLogManager:
-    def __init__(self, index_path: str):
+    def __init__(self, index_path: str, store: Optional[LogStore] = None):
+        self._store = store or store_for_path(index_path)
+        if isinstance(self._store, LocalFsLogStore):
+            # Local store: a file:// URI must become a real path before
+            # os.path.join builds entry paths under it.
+            index_path = strip_file_scheme(index_path)
         self._index_path = index_path
         self._log_path = os.path.join(index_path, IndexConstants.HYPERSPACE_LOG)
         self._latest_stable_path = os.path.join(
@@ -35,17 +47,16 @@ class IndexLogManager:
         return os.path.join(self._log_path, str(log_id))
 
     def _get_log_at(self, path: str) -> Optional[IndexLogEntry]:
-        if not os.path.exists(path):
+        data = self._store.read(path)
+        if data is None:
             return None
-        return IndexLogEntry.from_json(file_utils.read_contents(path))
+        return IndexLogEntry.from_json(data)
 
     def get_log(self, log_id: int) -> Optional[IndexLogEntry]:
         return self._get_log_at(self._path_from_id(log_id))
 
     def get_latest_id(self) -> Optional[int]:
-        if not os.path.isdir(self._log_path):
-            return None
-        ids = [int(name) for name in os.listdir(self._log_path) if name.isdigit()]
+        ids = self._store.list_numeric_ids(self._log_path)
         return max(ids) if ids else None
 
     def get_latest_log(self) -> Optional[IndexLogEntry]:
@@ -108,20 +119,15 @@ class IndexLogManager:
         entry = self.get_log(log_id)
         if entry is None or entry.state not in STABLE_STATES:
             return False
-        file_utils.atomic_overwrite(
+        self._store.put_overwrite(
             self._latest_stable_path, json_utils.to_json(entry.to_json_dict()))
         return True
 
     def delete_latest_stable_log(self) -> bool:
-        try:
-            if os.path.exists(self._latest_stable_path):
-                os.unlink(self._latest_stable_path)
-            return True
-        except OSError:
-            return False
+        return self._store.delete(self._latest_stable_path)
 
     def write_log(self, log_id: int, entry: IndexLogEntry) -> bool:
         """Write entry at ``log_id`` iff that id doesn't exist yet."""
         entry.id = log_id
-        return file_utils.atomic_create(
+        return self._store.put_if_absent(
             self._path_from_id(log_id), json_utils.to_json(entry.to_json_dict()))

@@ -1,0 +1,183 @@
+"""Storage abstraction under the op log (SURVEY §7 hard-part 4).
+
+The local filesystem gives the log its crash consistency through
+link-into-place atomicity; object stores have no rename, but they DO
+have conditional put (S3 ``If-None-Match: *``, GCS
+``if-generation-match: 0``, ADLS ETag preconditions) — and
+put-if-absent is the ONLY primitive ``write_log``'s optimistic
+concurrency actually needs. This module states that contract once,
+keeps the local-FS implementation as the default, and ships an
+in-memory conditional-put store the protocol tests run against — so the
+log manager is proven to need nothing an object store cannot give
+(no rename anywhere in the protocol).
+
+``latestStable`` is a convenience CACHE (a copy of the newest stable
+entry), not a correctness participant: ``get_latest_stable_log`` falls
+back to the backward scan whenever it is stale, torn, or absent, so a
+last-writer-wins overwrite (a plain PUT) suffices for it on every
+store. The reference leans on HDFS-compatible ``FileContext.rename``
+for the same protocol (IndexLogManagerImpl); the TPU-native runtime
+targets object stores directly instead.
+
+Deployments back a cloud scheme by registering a factory:
+
+    from hyperspace_tpu.index import log_store
+    log_store.register_scheme("s3", lambda path: MyS3LogStore(path))
+
+Paths without a scheme (or ``file://``) use the local filesystem.
+
+SCOPE: the registration covers the OP LOG — the crash-consistency
+surface SURVEY §7 defers. Full object-store residency (index DATA
+files, IndexCollectionManager's directory existence gates) is not
+wired yet: an object-store deployment today embeds IndexLogManager
+with an explicit ``store=`` for the log while index data stays on a
+mounted/local path. The protocol tests prove the log side needs no
+further primitives.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Callable, Dict, List, Optional
+
+from ..exceptions import HyperspaceException
+from ..util import file_utils
+
+
+class LogStore:
+    """The op-log storage contract. Only four operations, and only
+    ``put_if_absent`` must be atomic — it decides every race."""
+
+    def put_if_absent(self, path: str, data: str) -> bool:
+        """Write ``data`` at ``path`` iff nothing exists there; True on
+        win. Object-store mapping: conditional PUT (If-None-Match: *)."""
+        raise NotImplementedError
+
+    def put_overwrite(self, path: str, data: str) -> None:
+        """Last-writer-wins full overwrite (plain PUT). Used only for the
+        latestStable cache."""
+        raise NotImplementedError
+
+    def read(self, path: str) -> Optional[str]:
+        """Contents, or None when absent."""
+        raise NotImplementedError
+
+    def list_numeric_ids(self, dirpath: str) -> List[int]:
+        """The numeric entry names under ``dirpath`` (LIST prefix)."""
+        raise NotImplementedError
+
+    def delete(self, path: str) -> bool:
+        """Best-effort delete; True when gone (or already absent)."""
+        raise NotImplementedError
+
+
+class LocalFsLogStore(LogStore):
+    """The default store: POSIX link-into-place create, fsync'd."""
+
+    def put_if_absent(self, path: str, data: str) -> bool:
+        return file_utils.atomic_create(path, data)
+
+    def put_overwrite(self, path: str, data: str) -> None:
+        file_utils.atomic_overwrite(path, data)
+
+    def read(self, path: str) -> Optional[str]:
+        if not os.path.exists(path):
+            return None
+        return file_utils.read_contents(path)
+
+    def list_numeric_ids(self, dirpath: str) -> List[int]:
+        if not os.path.isdir(dirpath):
+            return []
+        return [int(n) for n in os.listdir(dirpath) if n.isdigit()]
+
+    def delete(self, path: str) -> bool:
+        try:
+            if os.path.exists(path):
+                os.unlink(path)
+            return True
+        except OSError:
+            return False
+
+
+class InMemoryObjectStore(LogStore):
+    """A conditional-put object store double: flat key space, LIST by
+    prefix, compare-and-create under a lock — the semantics S3/GCS give
+    (strong read-after-write consistency, no rename). The log-protocol
+    tests run the full CREATING→ACTIVE lifecycle, recovery scans, and
+    multi-writer races against this, proving the protocol needs no
+    filesystem."""
+
+    def __init__(self):
+        self._objects: Dict[str, str] = {}
+        self._lock = threading.Lock()
+
+    def put_if_absent(self, path: str, data: str) -> bool:
+        with self._lock:  # the conditional PUT
+            if path in self._objects:
+                return False
+            self._objects[path] = data
+            return True
+
+    def put_overwrite(self, path: str, data: str) -> None:
+        with self._lock:
+            self._objects[path] = data
+
+    def read(self, path: str) -> Optional[str]:
+        with self._lock:
+            return self._objects.get(path)
+
+    def list_numeric_ids(self, dirpath: str) -> List[int]:
+        prefix = dirpath.rstrip("/") + "/"
+        with self._lock:
+            out = []
+            for k in self._objects:
+                if k.startswith(prefix):
+                    tail = k[len(prefix):]
+                    if "/" not in tail and tail.isdigit():
+                        out.append(int(tail))
+            return out
+
+    def delete(self, path: str) -> bool:
+        with self._lock:
+            self._objects.pop(path, None)
+            return True
+
+    # Test hook: simulate a torn tail (crash mid-upload leaves a partial
+    # object on stores without atomic multipart completion).
+    def corrupt(self, path: str) -> None:
+        with self._lock:
+            if path in self._objects:
+                self._objects[path] = self._objects[path][: 10]
+
+
+_SCHEME_FACTORIES: Dict[str, Callable[[str], LogStore]] = {}
+
+
+def register_scheme(scheme: str, factory: Callable[[str], LogStore]) -> None:
+    """Back ``scheme://`` index paths with a custom LogStore."""
+    _SCHEME_FACTORIES[scheme.lower()] = factory
+
+
+def strip_file_scheme(path: str) -> str:
+    """file:// URIs address the local filesystem: hand os.* the real
+    path, never the URI (a literal './file:...' directory otherwise)."""
+    if path.lower().startswith("file://"):
+        return path[len("file://"):]
+    return path
+
+
+def store_for_path(index_path: str) -> LogStore:
+    if "://" in index_path:
+        scheme = index_path.split("://", 1)[0].lower()
+        if scheme in ("file", ""):
+            return LocalFsLogStore()
+        factory = _SCHEME_FACTORIES.get(scheme)
+        if factory is None:
+            raise HyperspaceException(
+                f"No LogStore registered for scheme {scheme!r}; register "
+                "one with hyperspace_tpu.index.log_store.register_scheme "
+                "(the store only needs conditional put — see the module "
+                "docstring for the exact contract)")
+        return factory(index_path)
+    return LocalFsLogStore()

@@ -1,0 +1,115 @@
+"""The op-log protocol over a conditional-put OBJECT store (SURVEY §7
+hard-part 4; VERDICT r3 missing #6).
+
+The local filesystem's link-into-place atomicity is NOT part of the log
+protocol's contract — only conditional put-if-absent is. These tests run
+the full lifecycle (CREATING→ACTIVE, latestStable cache, stale/torn-tail
+recovery scans, multi-writer races) against InMemoryObjectStore, the
+S3/GCS-semantics double (flat keys, LIST prefix, conditional PUT, no
+rename), proving an object-store deployment needs nothing more.
+"""
+
+import threading
+
+import pytest
+
+from hyperspace_tpu.exceptions import HyperspaceException
+from hyperspace_tpu.index.constants import States
+from hyperspace_tpu.index.log_entry import IndexLogEntry
+from hyperspace_tpu.index.log_manager import IndexLogManager
+from hyperspace_tpu.index.log_store import (InMemoryObjectStore,
+                                            LocalFsLogStore, register_scheme,
+                                            store_for_path)
+from test_log_entry import make_entry
+
+
+def entry(state: str, version: int = 1) -> IndexLogEntry:
+    del version  # make_entry's fingerprint fixes the version; ids matter here
+    return make_entry(state=state)
+
+
+@pytest.fixture()
+def mgr():
+    return IndexLogManager("s3://bucket/indexes/idx",
+                           store=InMemoryObjectStore())
+
+
+class TestProtocolOverObjectStore:
+    def test_lifecycle_and_latest_stable(self, mgr):
+        assert mgr.write_log(0, entry(States.CREATING))
+        assert mgr.write_log(1, entry(States.ACTIVE))
+        assert not mgr.write_log(1, entry(States.ACTIVE)), \
+            "the conditional PUT must refuse an existing id"
+        assert mgr.create_latest_stable_log(1)
+        got = mgr.get_latest_stable_log()
+        assert got is not None and got.state == States.ACTIVE
+
+    def test_backward_scan_past_transient_tail(self, mgr):
+        mgr.write_log(0, entry(States.CREATING))
+        mgr.write_log(1, entry(States.ACTIVE))
+        mgr.create_latest_stable_log(1)
+        mgr.write_log(2, entry(States.REFRESHING))
+        mgr.delete_latest_stable_log()
+        got = mgr.get_latest_stable_log()
+        assert got is not None and got.state == States.ACTIVE and got.id == 1
+
+    def test_torn_tail_recovers(self):
+        store = InMemoryObjectStore()
+        mgr = IndexLogManager("s3://b/idx", store=store)
+        mgr.write_log(0, entry(States.CREATING))
+        mgr.write_log(1, entry(States.ACTIVE))
+        mgr.write_log(2, entry(States.REFRESHING))
+        # Crash mid-upload: the tail object is a truncated JSON blob.
+        store.corrupt(mgr._path_from_id(2))
+        got = mgr.get_latest_stable_log()
+        assert got is not None and got.id == 1
+
+    def test_race_exactly_one_winner(self):
+        store = InMemoryObjectStore()
+        mgr = IndexLogManager("s3://b/idx", store=store)
+        wins = []
+        barrier = threading.Barrier(16)
+
+        def contend(i):
+            barrier.wait()
+            if mgr.write_log(5, entry(States.CREATING)):
+                wins.append(i)
+
+        ts = [threading.Thread(target=contend, args=(i,)) for i in range(16)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        assert len(wins) == 1, f"winners: {wins}"
+
+    def test_latest_id_lists_only_numeric_keys(self, mgr):
+        mgr.write_log(0, entry(States.CREATING))
+        mgr.write_log(1, entry(States.ACTIVE))
+        mgr.create_latest_stable_log(1)  # writes the latestStable key too
+        assert mgr.get_latest_id() == 1
+
+
+class TestStoreResolution:
+    def test_plain_path_is_local(self, tmp_path):
+        assert isinstance(store_for_path(str(tmp_path)), LocalFsLogStore)
+        assert isinstance(store_for_path(f"file://{tmp_path}"),
+                          LocalFsLogStore)
+
+    def test_file_uri_addresses_the_real_path(self, tmp_path):
+        """file:// must strip to the filesystem path — otherwise os.*
+        would silently create a literal './file:...' tree under cwd."""
+        mgr = IndexLogManager(f"file://{tmp_path}/idx")
+        assert mgr.write_log(0, entry(States.CREATING))
+        import os
+        assert os.path.isfile(str(tmp_path / "idx" / "_hyperspace_log" / "0"))
+        # The same log is visible through the plain-path spelling.
+        assert IndexLogManager(str(tmp_path / "idx")).get_latest_id() == 0
+
+    def test_unregistered_scheme_is_a_clear_error(self):
+        with pytest.raises(HyperspaceException, match="register_scheme"):
+            store_for_path("abfss://container/path")
+
+    def test_registered_scheme_wins(self):
+        mem = InMemoryObjectStore()
+        register_scheme("testmem", lambda p: mem)
+        assert store_for_path("testmem://x/y") is mem
