@@ -66,6 +66,7 @@ _TOKEN = re.compile(r"""
       (?P<date>DATE\s*'(\d{4}-\d{2}-\d{2})')
     | (?P<str>'(?:[^']|'')*')
     | (?P<num>\d+\.\d+|\.\d+|\d+)
+    | (?P<bident>`[^`]*`)
     | (?P<ident>[A-Za-z_][A-Za-z_0-9.]*)
     | (?P<op><>|!=|<=|>=|=|<|>|\(|\)|,|\*|/|\+|-|;)
     )""", re.VERBOSE | re.IGNORECASE)
@@ -110,6 +111,10 @@ def _tokenize(text: str) -> List[Tuple[str, str]]:
             out.append(("STR", m.group("str")[1:-1].replace("''", "'")))
         elif m.group("num"):
             out.append(("NUM", m.group("num")))
+        elif m.group("bident"):
+            # Backtick-quoted identifier: spaces and symbols allowed,
+            # never a keyword (the TPC-DS house style for aliases).
+            out.append(("IDENT", m.group("bident")[1:-1]))
         elif m.group("ident"):
             # KW tokens keep the RAW spelling: soft keywords double as
             # identifiers (take_name) and must preserve the user's case
@@ -760,13 +765,61 @@ class _Parser:
                   alias) for e, alias in items]
 
         group_cols: List[str] = []
+        group_exprs: List[Tuple[E.Expr, str]] = []
+
+        def group_item() -> str:
+            # Parse a full expression: a plain [qualified] column is the
+            # fast path that falls out of it, and anything else
+            # (``GROUP BY substr(x, 1, 20)``, ``GROUP BY a + b`` — the
+            # TPC-DS house style) must restate a SELECT item; it is
+            # materialized by a pre-projection under that item's output
+            # name and grouped as a plain column.
+            e = self._resolve_quals(self.expr(), scope)
+            if isinstance(e, E.Col):
+                return e.column
+            for ie, alias in items:
+                if ie is not None and repr(ie) == repr(e):
+                    name = alias or ie.name
+                    if all(nm != name for _, nm in group_exprs):
+                        group_exprs.append((e, name))
+                    return name
+            raise HyperspaceException(
+                f"SQL: GROUP BY expression {e!r} must restate an item "
+                "of the SELECT list")
+
         if self.accept("KW", "GROUP"):
             self.take("KW", "BY")
-            group_cols.append(
-                self._resolve_qual_name(self.take_name(), scope))
+            # Duplicate keys are redundant in SQL (GROUP BY x, x ≡ x) and
+            # would collide as output columns — keep first occurrences.
+            g = group_item()
+            group_cols.append(g)
             while self.accept("OP", ","):
-                group_cols.append(
-                    self._resolve_qual_name(self.take_name(), scope))
+                g = group_item()
+                if g not in group_cols:
+                    group_cols.append(g)
+
+        orig_items = items
+        if group_exprs:
+            # Materialize the expression keys; existing columns pass
+            # through (later column pruning drops the dead ones) except
+            # ones SHADOWED by a synthesized key name (the q8 shape:
+            # ``SELECT substr(ca_zip, 1, 5) AS ca_zip``). The expressions
+            # still read the pre-projection INPUT, so shadowing only
+            # hides the original from stages above — which is why an
+            # aggregate referencing the shadowed original is refused.
+            synth = {nm for _, nm in group_exprs}
+            for ie, _alias in items:
+                if ie is not None and _contains_agg(ie) and                         synth & set(ie.references) &                         set(df.plan.schema.names):
+                    raise HyperspaceException(
+                        "SQL: an aggregate references a column shadowed "
+                        f"by a GROUP BY expression alias ({sorted(synth & set(ie.references))})")
+            df = df.select(*(
+                [E.col(n) for n in df.plan.schema.names if n not in synth]
+                + [e.alias(nm) for e, nm in group_exprs]))
+            by_repr = {repr(e): nm for e, nm in group_exprs}
+            items = [(E.col(by_repr[repr(e)])
+                      if e is not None and repr(e) in by_repr else e, alias)
+                     for e, alias in items]
 
         has_agg = any(_contains_agg(e) for e, _ in items if e is not None)
         if group_cols or has_agg:
@@ -866,7 +919,9 @@ class _Parser:
         # mid-FROM) can't leave ITS scope/items behind as the binding for
         # the outer query's ORDER BY.
         self._last_scope = scope
-        self._last_items = items if not star else []
+        # ORDER BY matches against the ORIGINAL spellings (a GROUP BY
+        # expression rewrite must not hide ``ORDER BY substr(...)``).
+        self._last_items = orig_items if not star else []
         return df
 
     def _select_item(self):

@@ -5,7 +5,7 @@ reference's parameter substitutions (the same queries the reference runs
 through Spark for its 99 approved-plan goldens —
 goldstandard/TPCDSBase.scala:41, src/test/resources/tpcds/queries/).
 Only single-SELECT queries inside the SQL front-end's grammar are
-included — no CTEs, window functions, or ROLLUP (13 of the 99 today);
+included — no CTEs, window functions, or ROLLUP (14 of the 99 today);
 growing this list is a matter of grammar, not harness.
 
 The catalog generator builds every referenced table with exactly the
@@ -34,7 +34,8 @@ _DAY_NAMES = ["Monday", "Tuesday", "Wednesday", "Thursday", "Friday",
 def tables(rng: np.random.Generator) -> Dict[str, pa.Table]:
     n_it, n_cu, n_ca, n_st, n_cd, n_pr, n_hd, n_td, n_wh = \
         60, 120, 80, 6, 40, 12, 15, 200, 4
-    n_ss, n_cs, n_inv = 1600, 1200, 900
+    n_sm, n_web, n_cc = 5, 4, 3
+    n_ss, n_cs, n_inv, n_ws = 1600, 1200, 900, 1000
 
     dates = [_D0 + datetime.timedelta(days=i) for i in range(N_DD)]
     date_dim = pa.table({
@@ -45,6 +46,10 @@ def tables(rng: np.random.Generator) -> Dict[str, pa.Table]:
         "d_qoy": pa.array(np.array([(d.month - 1) // 3 + 1 for d in dates],
                                    np.int64)),
         "d_day_name": pa.array([_DAY_NAMES[d.weekday()] for d in dates]),
+        # TPC-DS month sequence: 2000-01 = 1200 (q62/q99's window).
+        "d_month_seq": pa.array(np.array(
+            [(d.year - 1998) * 12 + (d.month - 1) + 1176 for d in dates],
+            np.int64)),
     })
 
     # Items: cycle manager/manufacturer ids through every value the query
@@ -130,6 +135,31 @@ def tables(rng: np.random.Generator) -> Dict[str, pa.Table]:
         "w_warehouse_name": pa.array([f"Warehouse number {i}"
                                       for i in range(n_wh)]),
     })
+    ship_mode = pa.table({
+        "sm_ship_mode_sk": pa.array(np.arange(n_sm, dtype=np.int64)),
+        "sm_type": pa.array(["EXPRESS", "OVERNIGHT", "REGULAR", "TWO DAY",
+                             "LIBRARY"][:n_sm]),
+    })
+    web_site = pa.table({
+        "web_site_sk": pa.array(np.arange(n_web, dtype=np.int64)),
+        "web_name": pa.array([f"site_{i}" for i in range(n_web)]),
+    })
+    call_center = pa.table({
+        "cc_call_center_sk": pa.array(np.arange(n_cc, dtype=np.int64)),
+        "cc_name": pa.array([f"call center {i}" for i in range(n_cc)]),
+    })
+    ws_sold = rng.integers(0, N_DD - 150, n_ws).astype(np.int64)
+    web_sales = pa.table({
+        "ws_sold_date_sk": pa.array(ws_sold),
+        "ws_ship_date_sk": pa.array(
+            ws_sold + rng.integers(1, 140, n_ws).astype(np.int64)),
+        "ws_warehouse_sk": pa.array(
+            rng.integers(0, n_wh, n_ws).astype(np.int64)),
+        "ws_ship_mode_sk": pa.array(
+            rng.integers(0, n_sm, n_ws).astype(np.int64)),
+        "ws_web_site_sk": pa.array(
+            rng.integers(0, n_web, n_ws).astype(np.int64)),
+    })
 
     store_sales = pa.table({
         "ss_sold_date_sk": pa.array(
@@ -150,9 +180,17 @@ def tables(rng: np.random.Generator) -> Dict[str, pa.Table]:
         "ss_ext_sales_price": pa.array(
             np.round(rng.uniform(5, 4000, n_ss), 2)),
     })
+    cs_sold = rng.integers(0, N_DD - 150, n_cs).astype(np.int64)
     catalog_sales = pa.table({
-        "cs_sold_date_sk": pa.array(
-            rng.integers(0, N_DD, n_cs).astype(np.int64)),
+        "cs_sold_date_sk": pa.array(cs_sold),
+        "cs_ship_date_sk": pa.array(
+            cs_sold + rng.integers(1, 140, n_cs).astype(np.int64)),
+        "cs_warehouse_sk": pa.array(
+            rng.integers(0, n_wh, n_cs).astype(np.int64)),
+        "cs_ship_mode_sk": pa.array(
+            rng.integers(0, n_sm, n_cs).astype(np.int64)),
+        "cs_call_center_sk": pa.array(
+            rng.integers(0, n_cc, n_cs).astype(np.int64)),
         "cs_item_sk": pa.array(rng.integers(0, n_it, n_cs).astype(np.int64)),
         "cs_bill_customer_sk": pa.array(
             rng.integers(0, n_cu, n_cs).astype(np.int64)),
@@ -186,6 +224,8 @@ def tables(rng: np.random.Generator) -> Dict[str, pa.Table]:
         "promotion": promotion,
         "household_demographics": household_demographics,
         "time_dim": time_dim, "warehouse": warehouse,
+        "ship_mode": ship_mode, "web_site": web_site,
+        "call_center": call_center, "web_sales": web_sales,
         "store_sales": store_sales, "catalog_sales": catalog_sales,
         "inventory": inventory,
     }
@@ -454,6 +494,79 @@ WHERE i_current_price BETWEEN 62 AND 62 + 30
   AND ss_item_sk = i_item_sk
 GROUP BY i_item_id, i_item_desc, i_current_price
 ORDER BY i_item_id
+LIMIT 100
+""",
+    "tpcds_real_q62": """
+SELECT
+  substr(w_warehouse_name, 1, 20),
+  sm_type,
+  web_name,
+  sum(CASE WHEN (ws_ship_date_sk - ws_sold_date_sk <= 30)
+    THEN 1
+      ELSE 0 END)  AS `30 days `,
+  sum(CASE WHEN (ws_ship_date_sk - ws_sold_date_sk > 30) AND
+    (ws_ship_date_sk - ws_sold_date_sk <= 60)
+    THEN 1
+      ELSE 0 END)  AS `31 - 60 days `,
+  sum(CASE WHEN (ws_ship_date_sk - ws_sold_date_sk > 60) AND
+    (ws_ship_date_sk - ws_sold_date_sk <= 90)
+    THEN 1
+      ELSE 0 END)  AS `61 - 90 days `,
+  sum(CASE WHEN (ws_ship_date_sk - ws_sold_date_sk > 90) AND
+    (ws_ship_date_sk - ws_sold_date_sk <= 120)
+    THEN 1
+      ELSE 0 END)  AS `91 - 120 days `,
+  sum(CASE WHEN (ws_ship_date_sk - ws_sold_date_sk > 120)
+    THEN 1
+      ELSE 0 END)  AS `>120 days `
+FROM
+  web_sales, warehouse, ship_mode, web_site, date_dim
+WHERE
+  d_month_seq BETWEEN 1200 AND 1200 + 11
+    AND ws_ship_date_sk = d_date_sk
+    AND ws_warehouse_sk = w_warehouse_sk
+    AND ws_ship_mode_sk = sm_ship_mode_sk
+    AND ws_web_site_sk = web_site_sk
+GROUP BY
+  substr(w_warehouse_name, 1, 20), sm_type, web_name
+ORDER BY
+  substr(w_warehouse_name, 1, 20), sm_type, web_name
+LIMIT 100
+""",
+    "tpcds_real_q99": """
+SELECT
+  substr(w_warehouse_name, 1, 20),
+  sm_type,
+  cc_name,
+  sum(CASE WHEN (cs_ship_date_sk - cs_sold_date_sk <= 30)
+    THEN 1
+      ELSE 0 END)  AS `30 days `,
+  sum(CASE WHEN (cs_ship_date_sk - cs_sold_date_sk > 30) AND
+    (cs_ship_date_sk - cs_sold_date_sk <= 60)
+    THEN 1
+      ELSE 0 END)  AS `31 - 60 days `,
+  sum(CASE WHEN (cs_ship_date_sk - cs_sold_date_sk > 60) AND
+    (cs_ship_date_sk - cs_sold_date_sk <= 90)
+    THEN 1
+      ELSE 0 END)  AS `61 - 90 days `,
+  sum(CASE WHEN (cs_ship_date_sk - cs_sold_date_sk > 90) AND
+    (cs_ship_date_sk - cs_sold_date_sk <= 120)
+    THEN 1
+      ELSE 0 END)  AS `91 - 120 days `,
+  sum(CASE WHEN (cs_ship_date_sk - cs_sold_date_sk > 120)
+    THEN 1
+      ELSE 0 END)  AS `>120 days `
+FROM
+  catalog_sales, warehouse, ship_mode, call_center, date_dim
+WHERE
+  d_month_seq BETWEEN 1200 AND 1200 + 11
+    AND cs_ship_date_sk = d_date_sk
+    AND cs_warehouse_sk = w_warehouse_sk
+    AND cs_ship_mode_sk = sm_ship_mode_sk
+    AND cs_call_center_sk = cc_call_center_sk
+GROUP BY
+  substr(w_warehouse_name, 1, 20), sm_type, cc_name
+ORDER BY substr(w_warehouse_name, 1, 20), sm_type, cc_name
 LIMIT 100
 """,
     "tpcds_real_q96": """

@@ -301,6 +301,34 @@ class TestSqlReviewRegressions:
                     "FROM li").to_pandas()
         assert a["s"][0] == b["s"][0]
 
+    def test_group_by_expression(self, env, tmp_path):
+        d = tmp_path / "gz"
+        d.mkdir()
+        pq.write_table(pa.table({
+            "zip": pa.array(["85669a", "85669b", "10001x"]),
+            "v": pa.array([1, 2, 3])}), d / "p0.parquet")
+        env.create_temp_view("gz", env.read.parquet(str(d)))
+        # The q8 shadow shape: the expression's alias reuses the source
+        # column name; the expression still reads the original.
+        r = env.sql("SELECT substr(zip,1,5) AS zip, SUM(v) AS sv FROM gz "
+                    "GROUP BY substr(zip,1,5) ORDER BY zip").to_pandas()
+        assert r["zip"].tolist() == ["10001", "85669"]
+        assert r["sv"].tolist() == [3, 3]
+        # Duplicate keys are redundant, arithmetic group keys work, and
+        # an aggregate over a shadowed column refuses clearly.
+        r2 = env.sql("SELECT v + v AS d, COUNT(*) AS c FROM gz "
+                     "GROUP BY v + v, v + v ORDER BY d").to_pandas()
+        assert r2["d"].tolist() == [2, 4, 6]
+        with pytest.raises(HyperspaceException, match="shadowed"):
+            env.sql("SELECT substr(zip,1,5) AS zip, COUNT(zip) FROM gz "
+                    "GROUP BY substr(zip,1,5)")
+        with pytest.raises(HyperspaceException, match="restate"):
+            env.sql("SELECT v FROM gz GROUP BY v + 1")
+
+    def test_backtick_aliases(self, env):
+        r = env.sql("SELECT SUM(qty) AS `total qty ` FROM li").to_pandas()
+        assert list(r.columns) == ["total qty "]
+
     def test_mid_statement_semicolon_rejected(self, env):
         # ';' is legal only as a trailing terminator — never silently
         # dropped mid-statement (that would splice two statements).

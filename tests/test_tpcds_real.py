@@ -2,7 +2,8 @@
 
 The reference ships 99 approved-plan golden files from the actual TPC-DS
 v1.4 SQL (goldstandard/TPCDSBase.scala:41); this suite runs the subset the
-SQL grammar covers today — 12 published query texts, verbatim — through
+SQL grammar covers today (the texts in goldstandard/tpcds_real.py,
+verbatim) through
 session.sql, pins the optimized plan in enabled AND disabled golden files,
 and checks the answers agree between the two (the disable-and-compare
 oracle). Regenerate goldens with GENERATE_GOLDEN_FILES=1.
