@@ -5,7 +5,7 @@ reference's parameter substitutions (the same queries the reference runs
 through Spark for its 99 approved-plan goldens —
 goldstandard/TPCDSBase.scala:41, src/test/resources/tpcds/queries/).
 Only single-SELECT queries inside the SQL front-end's grammar are
-included — no CTEs, window functions, or ROLLUP (14 of the 99 today);
+included — no CTEs, window functions, or ROLLUP (16 of the 99 today);
 growing this list is a matter of grammar, not harness.
 
 The catalog generator builds every referenced table with exactly the
@@ -86,12 +86,14 @@ def tables(rng: np.random.Generator) -> Dict[str, pa.Table]:
     })
     zips = ["85669", "86197", "60601", "10001", "94111", "30301", "73301",
             "88274"]
-    states = ["CA", "WA", "GA", "TN", "TX", "NY"]
+    states = ["CA", "WA", "GA", "TN", "TX", "NY", "OH", "OR", "NM",
+              "KY", "VA", "MS", "IN", "WI", "MO"]
     customer_address = pa.table({
         "ca_address_sk": pa.array(np.arange(n_ca, dtype=np.int64)),
         "ca_zip": pa.array([zips[i % len(zips)] + "0000" for i in
                             range(n_ca)]),
         "ca_state": pa.array([states[i % len(states)] for i in range(n_ca)]),
+        "ca_country": pa.array(["United States"] * n_ca),
     })
     store = pa.table({
         "s_store_sk": pa.array(np.arange(n_st, dtype=np.int64)),
@@ -103,15 +105,21 @@ def tables(rng: np.random.Generator) -> Dict[str, pa.Table]:
         "s_gmt_offset": pa.array(
             np.where(np.arange(n_st) % 2 == 0, -5, -6).astype(np.int64)),
     })
+    maritals = ["M", "S", "W", "D", "U"]
+    educations = ["Advanced Degree", "College", "2 yr Degree",
+                  "4 yr Degree", "Secondary"]
     customer_demographics = pa.table({
         "cd_demo_sk": pa.array(np.arange(n_cd, dtype=np.int64)),
         "cd_gender": pa.array(["M" if i % 2 == 0 else "F"
                                for i in range(n_cd)]),
-        "cd_marital_status": pa.array(["S" if i % 3 == 0 else "M"
-                                       for i in range(n_cd)]),
+        # Independent small cycles: every (marital, education) pair the
+        # query texts name co-occurs within n_cd=40 rows (q7/q26 need
+        # (S, College); q13 (M, Advanced Degree), (S, College),
+        # (W, 2 yr Degree); q48 (M, 4 yr Degree), (D, 2 yr Degree)).
+        "cd_marital_status": pa.array(
+            [maritals[i % 5] for i in range(n_cd)]),
         "cd_education_status": pa.array(
-            ["College" if i % 2 == 0 else "4 yr Degree"
-             for i in range(n_cd)]),
+            [educations[(i + i // 5) % 5] for i in range(n_cd)]),
     })
     promotion = pa.table({
         "p_promo_sk": pa.array(np.arange(n_pr, dtype=np.int64)),
@@ -148,6 +156,7 @@ def tables(rng: np.random.Generator) -> Dict[str, pa.Table]:
         "cc_call_center_sk": pa.array(np.arange(n_cc, dtype=np.int64)),
         "cc_name": pa.array([f"call center {i}" for i in range(n_cc)]),
     })
+    rng2 = np.random.default_rng(99)
     ws_sold = rng.integers(0, N_DD - 150, n_ws).astype(np.int64)
     web_sales = pa.table({
         "ws_sold_date_sk": pa.array(ws_sold),
@@ -161,24 +170,52 @@ def tables(rng: np.random.Generator) -> Dict[str, pa.Table]:
             rng.integers(0, n_web, n_ws).astype(np.int64)),
     })
 
+    # Constructed hit rows make the q13/q48 compound predicates TRUE by
+    # construction, not seed luck: both are scalar aggregates that return
+    # one row even with zero matches, so an accidentally-empty match set
+    # would never fail the non-empty guard (r4 review finding).
+    ss_sold = rng.integers(0, N_DD, n_ss).astype(np.int64)
+    ss_cdemo = rng.integers(0, n_cd, n_ss).astype(np.int64)
+    ss_hdemo = rng.integers(0, n_hd, n_ss).astype(np.int64)
+    ss_price = np.round(rng.uniform(1, 290, n_ss), 2)
+    d2001 = (datetime.date(2001, 6, 15) - _D0).days
+    for j in range(4):
+        ss_sold[j] = d2001 + j
+        ss_cdemo[j] = 0       # (M, Advanced Degree) — q13 branch 1
+        ss_hdemo[j] = 3       # hd_dep_count == 3
+        ss_price[j] = 120.0   # in [100, 150]
+    for j in range(4, 8):
+        ss_sold[j] = d2001 + j
+        ss_cdemo[j] = 1       # i=1: marital S, education College (q48 b3)
+        ss_price[j] = 170.0   # in [150, 200]
     store_sales = pa.table({
-        "ss_sold_date_sk": pa.array(
-            rng.integers(0, N_DD, n_ss).astype(np.int64)),
+        "ss_sold_date_sk": pa.array(ss_sold),
         "ss_sold_time_sk": pa.array(
             rng.integers(0, n_td, n_ss).astype(np.int64)),
         "ss_item_sk": pa.array(rng.integers(0, n_it, n_ss).astype(np.int64)),
         "ss_customer_sk": pa.array(
             rng.integers(0, n_cu, n_ss).astype(np.int64)),
-        "ss_cdemo_sk": pa.array(rng.integers(0, n_cd, n_ss).astype(np.int64)),
-        "ss_hdemo_sk": pa.array(rng.integers(0, n_hd, n_ss).astype(np.int64)),
+        "ss_cdemo_sk": pa.array(ss_cdemo),
+        "ss_hdemo_sk": pa.array(ss_hdemo),
         "ss_promo_sk": pa.array(rng.integers(0, n_pr, n_ss).astype(np.int64)),
         "ss_store_sk": pa.array(rng.integers(0, n_st, n_ss).astype(np.int64)),
         "ss_quantity": pa.array(rng.integers(1, 100, n_ss).astype(np.int64)),
         "ss_list_price": pa.array(np.round(rng.uniform(1, 300, n_ss), 2)),
         "ss_coupon_amt": pa.array(np.round(rng.uniform(0, 40, n_ss), 2)),
-        "ss_sales_price": pa.array(np.round(rng.uniform(1, 290, n_ss), 2)),
+        "ss_sales_price": pa.array(ss_price),
         "ss_ext_sales_price": pa.array(
             np.round(rng.uniform(5, 4000, n_ss), 2)),
+        # q13/q48 columns from a SEPARATE generator: appending draws to
+        # the shared one would shift every later table and churn the
+        # whole corpus' data.
+        "ss_ext_wholesale_cost": pa.array(
+            np.round(rng2.uniform(1, 100, n_ss), 2)),
+        "ss_addr_sk": pa.array(np.concatenate(
+            [np.full(8, 4, np.int64),  # ca 4 = TX, United States
+             rng2.integers(0, n_ca, n_ss - 8).astype(np.int64)])),
+        "ss_net_profit": pa.array(np.concatenate(
+            [np.full(8, 150.0),       # inside every profit band used
+             np.round(rng2.uniform(0, 330, n_ss - 8), 2)])),
     })
     cs_sold = rng.integers(0, N_DD - 150, n_cs).astype(np.int64)
     catalog_sales = pa.table({
@@ -310,6 +347,122 @@ WHERE ss_sold_date_sk = d_date_sk AND
 GROUP BY i_item_id
 ORDER BY i_item_id
 LIMIT 100
+""",
+    "tpcds_real_q13": """
+SELECT
+  avg(ss_quantity),
+  avg(ss_ext_sales_price),
+  avg(ss_ext_wholesale_cost),
+  sum(ss_ext_wholesale_cost)
+FROM store_sales
+  , store
+  , customer_demographics
+  , household_demographics
+  , customer_address
+  , date_dim
+WHERE s_store_sk = ss_store_sk
+  AND ss_sold_date_sk = d_date_sk AND d_year = 2001
+  AND ((ss_hdemo_sk = hd_demo_sk
+  AND cd_demo_sk = ss_cdemo_sk
+  AND cd_marital_status = 'M'
+  AND cd_education_status = 'Advanced Degree'
+  AND ss_sales_price BETWEEN 100.00 AND 150.00
+  AND hd_dep_count = 3
+) OR
+  (ss_hdemo_sk = hd_demo_sk
+    AND cd_demo_sk = ss_cdemo_sk
+    AND cd_marital_status = 'S'
+    AND cd_education_status = 'College'
+    AND ss_sales_price BETWEEN 50.00 AND 100.00
+    AND hd_dep_count = 1
+  ) OR
+  (ss_hdemo_sk = hd_demo_sk
+    AND cd_demo_sk = ss_cdemo_sk
+    AND cd_marital_status = 'W'
+    AND cd_education_status = '2 yr Degree'
+    AND ss_sales_price BETWEEN 150.00 AND 200.00
+    AND hd_dep_count = 1
+  ))
+  AND ((ss_addr_sk = ca_address_sk
+  AND ca_country = 'United States'
+  AND ca_state IN ('TX', 'OH', 'TX')
+  AND ss_net_profit BETWEEN 100 AND 200
+) OR
+  (ss_addr_sk = ca_address_sk
+    AND ca_country = 'United States'
+    AND ca_state IN ('OR', 'NM', 'KY')
+    AND ss_net_profit BETWEEN 150 AND 300
+  ) OR
+  (ss_addr_sk = ca_address_sk
+    AND ca_country = 'United States'
+    AND ca_state IN ('VA', 'TX', 'MS')
+    AND ss_net_profit BETWEEN 50 AND 250
+  ))
+""",
+    "tpcds_real_q48": """
+SELECT sum(ss_quantity)
+FROM store_sales, store, customer_demographics, customer_address, date_dim
+WHERE s_store_sk = ss_store_sk
+  AND ss_sold_date_sk = d_date_sk AND d_year = 2001
+  AND
+  (
+    (
+      cd_demo_sk = ss_cdemo_sk
+        AND
+        cd_marital_status = 'M'
+        AND
+        cd_education_status = '4 yr Degree'
+        AND
+        ss_sales_price BETWEEN 100.00 AND 150.00
+    )
+      OR
+      (
+        cd_demo_sk = ss_cdemo_sk
+          AND
+          cd_marital_status = 'D'
+          AND
+          cd_education_status = '2 yr Degree'
+          AND
+          ss_sales_price BETWEEN 50.00 AND 100.00
+      )
+      OR
+      (
+        cd_demo_sk = ss_cdemo_sk
+          AND
+          cd_marital_status = 'S'
+          AND
+          cd_education_status = 'College'
+          AND
+          ss_sales_price BETWEEN 150.00 AND 200.00
+      )
+  )
+  AND
+  (
+    (
+      ss_addr_sk = ca_address_sk
+        AND
+        ca_country = 'United States'
+        AND
+        ca_state IN ('CO', 'OH', 'TX')
+        AND ss_net_profit BETWEEN 0 AND 2000
+    )
+      OR
+      (ss_addr_sk = ca_address_sk
+        AND
+        ca_country = 'United States'
+        AND
+        ca_state IN ('OR', 'MN', 'KY')
+        AND ss_net_profit BETWEEN 150 AND 3000
+      )
+      OR
+      (ss_addr_sk = ca_address_sk
+        AND
+        ca_country = 'United States'
+        AND
+        ca_state IN ('VA', 'CA', 'MS')
+        AND ss_net_profit BETWEEN 50 AND 25000
+      )
+  )
 """,
     "tpcds_real_q15": """
 SELECT
