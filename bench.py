@@ -41,8 +41,131 @@ RESULT: dict = {
 }
 
 
+# The driver captures a bounded tail of stdout; round 4's artifact lost its
+# HEAD fields (backend, filter speedup, build rate) because the per-program
+# compile_log_* arrays flooded the final line past the capture window
+# (BENCH_r04 `parsed: null`). The final line therefore carries only bounded
+# values — unbounded debug arrays go to a sidecar file whose path is
+# recorded in the line itself.
+_FINAL_LINE_MAX = 16384
+
+
+def _sanitize_nonfinite(v):
+    """Make a value strict-JSON-safe, recursively: inf/nan (json.dumps
+    would emit non-standard Infinity/NaN tokens a strict driver parser
+    rejects) become None; numpy scalars unwrap via item(); anything else
+    non-plain becomes its repr rather than a TypeError at emission."""
+    if hasattr(v, "item") and not isinstance(v, (str, bytes)):
+        try:
+            v = v.item()  # numpy / jax scalar
+        except Exception:
+            pass
+    if isinstance(v, float) and (v != v or v in (float("inf"),
+                                                 float("-inf"))):
+        return None
+    if isinstance(v, dict):
+        return {str(k): _sanitize_nonfinite(x) for k, x in v.items()}
+    if isinstance(v, (list, tuple)):
+        return [_sanitize_nonfinite(x) for x in v]
+    if v is None or isinstance(v, (str, int, float, bool)):
+        return v
+    return repr(v)[:300]
+
+
+def _final_line(result: dict) -> str:
+    """Serialize ``result`` to the ONE driver-facing JSON line: strip
+    list-valued debug banks into a sidecar, cap error text, enforce a hard
+    size bound, and self-check that the line round-trips through json.
+    Never raises: emission is the last act of the bench — a failure here
+    must still produce a parseable line."""
+    try:
+        return _final_line_inner(result)
+    except Exception as e:  # pragma: no cover - defense in depth
+        fallback = {"metric": str(result.get("metric", "?")),
+                    "value": 0.0, "unit": "x", "vs_baseline": 0.0,
+                    "errors": [f"final-line emission failed: "
+                               f"{type(e).__name__}: {e}"[:500]]}
+        # Salvage the measured scalars — a broken debug key must not
+        # zero out a real benchmark number.
+        for k in ("value", "vs_baseline", "backend", "device", "scale",
+                  "index_build_s", "build_rows_per_s"):
+            v = _sanitize_nonfinite(result.get(k))
+            if isinstance(v, (int, float, str)):
+                fallback[k] = v
+        return json.dumps(fallback, default=str)
+
+
+def _final_line_inner(result: dict) -> str:
+    slim: dict = {}
+    sidecar: dict = {}
+    compile_counts: dict = {}
+    for k, v in result.items():
+        if k.startswith("compile_log_"):
+            sidecar[k] = v
+            compile_counts[k[len("compile_log_"):]] = \
+                len(v) if hasattr(v, "__len__") else 0
+        else:
+            slim[k] = _sanitize_nonfinite(v)
+    if compile_counts:
+        slim["compile_counts"] = compile_counts
+    errs_raw = slim.get("errors") or []
+    if any(len(str(e)) > 500 for e in errs_raw) or len(errs_raw) > 8:
+        sidecar["errors_full"] = [str(e) for e in errs_raw]
+        errs = [str(e)[:500] for e in errs_raw]
+        # First errors carry the root cause of a cascade; keep both ends.
+        slim["errors"] = errs if len(errs) <= 8 else errs[:3] + errs[-5:]
+
+    # Headroom for the debug_file pointer (path created lazily below) and
+    # a possible debug_write_error marker appended after the size checks.
+    budget = _FINAL_LINE_MAX - 400
+
+    if len(json.dumps(slim)) > budget:
+        # Over budget: move the largest non-essential compound/long-string
+        # values to the sidecar until the line fits.
+        essential = {"metric", "value", "unit", "vs_baseline", "errors",
+                     "backend", "device", "scale"}
+        movable = sorted(
+            (k for k, v in slim.items()
+             if k not in essential
+             and (isinstance(v, (list, dict))
+                  or (isinstance(v, str) and len(v) > 256))),
+            key=lambda k: -len(json.dumps(slim[k])))
+        for k in movable:
+            sidecar[k] = slim.pop(k)
+            if len(json.dumps(slim)) <= budget:
+                break
+        if len(json.dumps(slim)) > budget:
+            # Scalar-heavy overflow (should not happen): keep the essential
+            # fields, spill the rest, rather than emit a broken line.
+            for k in list(slim):
+                if k not in essential:
+                    sidecar[k] = slim.pop(k)
+            slim["truncated"] = True
+
+    if sidecar:
+        try:
+            debug_path = os.environ.get("BENCH_DEBUG_PATH")
+            if debug_path:
+                f = open(debug_path, "w")
+            else:
+                import tempfile as _tf
+                fd, debug_path = _tf.mkstemp(prefix="hs_bench_debug_",
+                                             suffix=".json")
+                f = os.fdopen(fd, "w")
+            with f:
+                json.dump(sidecar, f, default=str)
+            slim["debug_file"] = debug_path
+        except OSError as e:
+            slim["debug_write_error"] = str(e)[:200]
+
+    line = json.dumps(slim)
+    json.loads(line)  # self-check: the emitted artifact must parse
+    assert "\n" not in line and len(line) <= _FINAL_LINE_MAX
+    return line
+
+
 def _emit_and_exit(code: int = 0) -> None:
-    print(json.dumps(RESULT))
+    print(_final_line(RESULT))
     sys.stdout.flush()
     sys.exit(code)
 
@@ -537,7 +660,7 @@ def _run_with_watchdog(argv: List[str], total_timeout: float) -> int:
             os.unlink(partial)
         except OSError:
             pass
-    print(json.dumps(RESULT))
+    print(_final_line(RESULT))
     return 0
 
 
