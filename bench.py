@@ -81,7 +81,7 @@ def _final_line(result: dict) -> str:
     try:
         return _final_line_inner(result)
     except Exception as e:  # pragma: no cover - defense in depth
-        fallback = {"metric": str(result.get("metric", "?")),
+        fallback = {"metric": str(result.get("metric", "?"))[:500],
                     "value": 0.0, "unit": "x", "vs_baseline": 0.0,
                     "errors": [f"final-line emission failed: "
                                f"{type(e).__name__}: {e}"[:500]]}
@@ -90,6 +90,8 @@ def _final_line(result: dict) -> str:
         for k in ("value", "vs_baseline", "backend", "device", "scale",
                   "index_build_s", "build_rows_per_s"):
             v = _sanitize_nonfinite(result.get(k))
+            if isinstance(v, str):
+                v = v[:500]
             if isinstance(v, (int, float, str)):
                 fallback[k] = v
         return json.dumps(fallback, default=str)
@@ -105,7 +107,10 @@ def _final_line_inner(result: dict) -> str:
             compile_counts[k[len("compile_log_"):]] = \
                 len(v) if hasattr(v, "__len__") else 0
         else:
-            slim[k] = _sanitize_nonfinite(v)
+            v = _sanitize_nonfinite(v)
+            if isinstance(v, str) and len(v) > 2000:
+                v = v[:2000]  # no single string may threaten the bound
+            slim[k] = v
     if compile_counts:
         slim["compile_counts"] = compile_counts
     errs_raw = slim.get("errors") or []

@@ -15,6 +15,7 @@ from __future__ import annotations
 import contextvars
 from typing import Dict, List, Optional, Sequence, Set, Tuple
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -22,7 +23,7 @@ from ..exceptions import HyperspaceException
 from ..ops import kernels
 from ..plan import expr as E
 from ..plan.nodes import (Aggregate, BucketUnion, Filter, IndexScan, Join, Limit,
-                          LogicalPlan, Project, Scan, Sort, Union)
+                          LogicalPlan, Project, Scan, Sort, Union, Window)
 from ..schema import BOOL, DATE, FLOAT64, INT32, INT64, STRING
 from .columnar import (Column, Table, dictionaries_equal, read_parquet,
                        translate_codes)
@@ -122,6 +123,13 @@ def _execute(plan: LogicalPlan, needed: Optional[Set[str]]) -> Table:
             child_needed.update(a.references)
         table = _execute(plan.child, child_needed)
         return _execute_aggregate(plan, table)
+    if isinstance(plan, Window):
+        out_names = {name for name, _ in plan.wexprs}
+        child_needed = None if needed is None else \
+            (needed - out_names) | {r for _, w in plan.wexprs
+                                    for r in w.references}
+        table = _execute(plan.child, child_needed)
+        return _execute_window(plan, table)
     if isinstance(plan, Sort):
         child_needed = None if needed is None else \
             needed | {c for c, _ in plan.orders}
@@ -1192,6 +1200,163 @@ def _execute_global_aggregate(plan: Aggregate, table: Table) -> Table:
     for agg in plan.aggs:
         out[agg.name] = _eval_agg(agg, table, gids, 1)
     return Table(out)
+
+
+def _segmented_scan(data: jnp.ndarray, seg_start: jnp.ndarray, op):
+    """Inclusive running ``op`` within segments of pre-sorted rows:
+    ``seg_start`` marks each segment's first row. One associative_scan —
+    the XLA-native way to reset an accumulator at segment boundaries
+    (no data-dependent Python control flow)."""
+    def combine(a, b):
+        af, av = a
+        bf, bv = b
+        return af | bf, jnp.where(bf, bv, op(av, bv))
+
+    _, out = jax.lax.associative_scan(combine, (seg_start, data))
+    return out
+
+
+def _execute_window(plan: Window, table: Table) -> Table:
+    """Analytic functions as sort + segmented scans, preserving the
+    child's row order (outputs are computed in partition-sorted space and
+    scattered back through the sort permutation). Window exprs sharing a
+    (partition, order) spec share one sort."""
+    n = table.num_rows
+    out = dict(table.columns)
+    if n == 0:
+        for name, w in plan.wexprs:
+            f = plan.schema.field(name)
+            dic = _dict_for(table, w.arg.column) if (
+                w.arg is not None and f.dtype == STRING) else None
+            out[name] = Column(f.dtype, jnp.zeros(0, _np_dtype_for(f.dtype)),
+                               None, dic)
+        return Table(out, bucket_order=table.bucket_order)
+    iota = jnp.arange(n, dtype=jnp.int32)
+
+    specs = {}
+    for name, w in plan.wexprs:
+        key = (tuple(p.column for p in w.partition),
+               tuple((o.column, asc) for o, asc in w.orders))
+        specs.setdefault(key, []).append((name, w))
+
+    for (pcols, oitems), group in specs.items():
+        keys, asc_flags = [], []
+        for p in pcols:
+            for k in _null_aware_keys(table.column(p)):
+                keys.append(k)
+                asc_flags.append(True)
+        for oc, a in oitems:
+            for k in _null_aware_keys(table.column(oc)):
+                keys.append(k)
+                asc_flags.append(a)
+        order = kernels.lex_sort_indices(keys, asc_flags) if keys else iota
+        if pcols:
+            pkeys_sorted = _group_sort_keys(
+                [table.column(p).take(order) for p in pcols])
+            pids, n_part = kernels.group_ids_from_sorted(pkeys_sorted)
+        else:
+            pkeys_sorted = []
+            pids, n_part = jnp.zeros(n, jnp.int32), 1
+        part_start = jnp.concatenate(
+            [jnp.ones(1, jnp.bool_), pids[1:] != pids[:-1]])
+        part_first = kernels.segment_first_index(pids, n_part)
+        pos = iota - jnp.take(part_first, pids)
+        peer_gid = peer_first = peer_last = None
+        if oitems:
+            okeys_sorted = _group_sort_keys(
+                [table.column(oc).take(order) for oc, _ in oitems])
+            peer_gid, n_peer = kernels.group_ids_from_sorted(
+                pkeys_sorted + okeys_sorted)
+            peer_first = kernels.segment_first_index(peer_gid, n_peer)
+            peer_last = kernels.segment_max(iota, peer_gid, n_peer)
+
+        for name, w in group:
+            dtype = plan.schema.field(name).dtype
+            validity_s = None
+            dic = None
+            if w.fn == "row_number":
+                vals = (pos + 1).astype(jnp.int64)
+            elif w.fn == "rank":
+                vals = (jnp.take(peer_first, peer_gid)
+                        - jnp.take(part_first, pids) + 1).astype(jnp.int64)
+            elif w.fn == "dense_rank":
+                first_peer = jnp.take(peer_gid, jnp.take(part_first, pids))
+                vals = (peer_gid - first_peer + 1).astype(jnp.int64)
+            else:
+                arg = None if w.arg is None \
+                    else table.column(w.arg.column).take(order)
+                vals, validity_s = _window_agg(
+                    w, arg, pids, n_part, part_start, peer_gid, peer_last)
+                if dtype == STRING:
+                    dic = arg.dictionary
+            data = jnp.zeros(n, vals.dtype).at[order].set(vals)
+            validity = None if validity_s is None else \
+                jnp.zeros(n, jnp.bool_).at[order].set(validity_s)
+            out[name] = Column(dtype, data, validity, dic)
+    return Table(out, bucket_order=table.bucket_order)
+
+
+def _window_agg(w: E.WindowExpr, arg: Optional[Column], pids, n_part,
+                part_start, peer_gid, peer_last):
+    """One windowed aggregate in partition-sorted space. Returns (values,
+    validity or None). Frames: 'partition' = whole partition;
+    'rows' = running; 'range' = running where order-key peers share the
+    value of their last row (the SQL default frame with ORDER BY)."""
+    fn = w.fn
+    frame = w.frame
+    if frame == "range" and peer_gid is None:
+        frame = "partition"  # no ORDER BY: every row is a peer
+
+    if fn == "count":
+        data = jnp.ones(pids.shape[0], jnp.int64) if arg is None or \
+            arg.validity is None else arg.validity.astype(jnp.int64)
+    elif fn in ("sum", "avg"):
+        if arg.dtype == STRING:
+            # Same guard as the aggregate path (_agg_child_column):
+            # summing dictionary codes would be silently wrong.
+            raise HyperspaceException(f"{fn} over string column")
+        data = _acc_widen(arg.data, arg.validity)
+        if fn == "avg":
+            data = data.astype(jnp.float64)
+    else:  # min / max
+        data = _sentinel_filled(arg, fn)
+
+    valid = None if arg is None or arg.validity is None \
+        else arg.validity.astype(jnp.int64)
+
+    def framed(values, op, identity_op_name):
+        if frame == "partition":
+            seg = {"sum": kernels.segment_sum,
+                   "min": kernels.segment_min,
+                   "max": kernels.segment_max}[identity_op_name](
+                values, pids, n_part)
+            return jnp.take(seg, pids)
+        running = _segmented_scan(values, part_start, op)
+        if frame == "range":
+            running = jnp.take(running, jnp.take(peer_last, peer_gid))
+        return running
+
+    if fn == "count":
+        return framed(data, jnp.add, "sum"), None
+    if fn in ("min", "max"):
+        op = jnp.minimum if fn == "min" else jnp.maximum
+        vals = framed(data, op, fn)
+        if valid is None:
+            return vals, None
+        cnt = framed(valid, jnp.add, "sum")
+        return vals, cnt > 0
+    # sum / avg
+    total = framed(data, jnp.add, "sum")
+    if fn == "avg":
+        cnt = framed(valid if valid is not None
+                     else jnp.ones(pids.shape[0], jnp.int64),
+                     jnp.add, "sum")
+        vals = total / jnp.maximum(cnt, 1)
+        return vals, (cnt > 0) if valid is not None else None
+    if valid is None:
+        return total, None
+    cnt = framed(valid, jnp.add, "sum")
+    return total, cnt > 0
 
 
 def _execute_sort(plan: Sort, table: Table) -> Table:

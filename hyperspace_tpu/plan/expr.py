@@ -445,6 +445,86 @@ class Avg(AggExpr):
     agg_name = "Avg"
 
 
+class WindowExpr(Expr):
+    """``fn([arg]) OVER (PARTITION BY p... [ORDER BY o [ASC|DESC]...]
+    [frame])`` — the analytic-function marker the SQL front-end lowers to
+    a Window plan node (the reference inherits these from Spark SQL; the
+    TPC-DS corpus uses rank/sum/avg-over — e.g. queries q51/q53/q63/q89).
+
+    ``fn``: 'rank' | 'dense_rank' | 'row_number' | 'sum' | 'avg' | 'min' |
+    'max' | 'count'. ``frame``: 'partition' (whole partition — the SQL
+    default without ORDER BY), 'range' (running aggregate including order
+    peers — the default with ORDER BY), or 'rows' (running, row at a
+    time — ROWS BETWEEN UNBOUNDED PRECEDING AND CURRENT ROW)."""
+
+    RANK_FNS = ("rank", "dense_rank", "row_number")
+    AGG_FNS = ("sum", "avg", "min", "max", "count")
+
+    def __init__(self, fn: str, arg: Optional[Expr],
+                 partition: Sequence[Expr],
+                 orders: Sequence[Tuple[Expr, bool]],
+                 frame: str = None):
+        if fn not in self.RANK_FNS + self.AGG_FNS:
+            raise HyperspaceException(f"Unknown window function {fn!r}")
+        self.fn = fn
+        self.arg = arg
+        self.partition = list(partition)
+        self.orders = [(e, bool(asc)) for e, asc in orders]
+        if fn in self.RANK_FNS and not self.orders:
+            raise HyperspaceException(
+                f"window function {fn}() requires ORDER BY")
+        if fn in ("sum", "avg", "min", "max") and arg is None:
+            raise HyperspaceException(
+                f"window function {fn}() requires an argument")
+        if frame is None:
+            frame = "range" if self.orders else "partition"
+        if frame not in ("partition", "range", "rows"):
+            raise HyperspaceException(f"Unknown window frame {frame!r}")
+        self.frame = frame
+
+    @property
+    def children(self) -> List[Expr]:
+        out = [] if self.arg is None else [self.arg]
+        out.extend(self.partition)
+        out.extend(e for e, _ in self.orders)
+        return out
+
+    @property
+    def name(self) -> str:
+        inner = "" if self.arg is None else self.arg.name
+        return f"{self.fn}({inner}) OVER"
+
+    def __repr__(self):
+        parts = []
+        if self.partition:
+            parts.append("PARTITION BY "
+                         + ", ".join(repr(p) for p in self.partition))
+        if self.orders:
+            parts.append("ORDER BY " + ", ".join(
+                f"{e!r} {'ASC' if asc else 'DESC'}" for e, asc in self.orders))
+        if self.frame == "rows":
+            parts.append("ROWS UNBOUNDED PRECEDING")
+        inner = "" if self.arg is None else repr(self.arg)
+        return f"{self.fn}({inner}) OVER ({' '.join(parts)})"
+
+
+def window(fn: str, arg=None, partition_by=(), order_by=(),
+           frame: str = None) -> WindowExpr:
+    """Public constructor: ``order_by`` items are exprs/names or
+    (expr, ascending) pairs."""
+    orders = []
+    for o in order_by:
+        if isinstance(o, tuple):
+            e, asc = o
+        else:
+            e, asc = o, True
+        orders.append((Col(e) if isinstance(e, str) else _wrap(e), asc))
+    part = [Col(p) if isinstance(p, str) else _wrap(p) for p in partition_by]
+    return WindowExpr(fn, None if arg is None else (
+        Col(arg) if isinstance(arg, str) else _wrap(arg)), part, orders,
+        frame)
+
+
 class CountDistinct(AggExpr):
     """COUNT(DISTINCT child). Deliberately NOT a Count subclass: distinct
     counts are not decomposable (run partials cannot combine), so the
@@ -569,6 +649,10 @@ def map_children(e: Expr, fn) -> Expr:
         if e.child is None:
             return e
         return type(e)(fn(e.child))
+    if isinstance(e, WindowExpr):
+        return WindowExpr(e.fn, None if e.arg is None else fn(e.arg),
+                          [fn(p) for p in e.partition],
+                          [(fn(o), asc) for o, asc in e.orders], e.frame)
     raise HyperspaceException(f"Cannot rewrite expression {e!r}")
 
 

@@ -78,6 +78,15 @@ def infer_dtype(e: E.Expr, schema: Schema) -> str:
         return FLOAT64 if child in (FLOAT64, "float32") else INT64
     if isinstance(e, (E.Min, E.Max)):
         return infer_dtype(e.child, schema)
+    if isinstance(e, E.WindowExpr):
+        if e.fn in E.WindowExpr.RANK_FNS or e.fn == "count":
+            return INT64
+        if e.fn == "avg":
+            return FLOAT64
+        child = infer_dtype(e.arg, schema)
+        if e.fn == "sum":
+            return FLOAT64 if child in (FLOAT64, "float32") else INT64
+        return child  # min/max
     raise HyperspaceException(f"Cannot infer type of {e!r}")
 
 
@@ -322,6 +331,66 @@ class Aggregate(LogicalPlan):
     def simple_string(self) -> str:
         return (f"Aggregate [{', '.join(self.group_cols)}] "
                 f"[{', '.join(a.name for a in self.aggs)}]")
+
+
+class Window(LogicalPlan):
+    """Analytic functions over partitions: appends one output column per
+    WindowExpr to the child's schema, preserving the child's row order
+    (values are computed in partition-sorted space and scattered back).
+
+    The reference inherits window execution from Spark SQL
+    (window exprs appear throughout its TPC-DS golden corpus, e.g.
+    src/test/resources/tpcds/queries/q51.sql, q63.sql, q89.sql); here it
+    is a first-class plan node executed as sort + segmented scans on
+    device. Window argument/partition/order expressions must be plain
+    columns — the SQL front-end materializes anything else first."""
+
+    def __init__(self, wexprs: Sequence[Tuple[str, E.WindowExpr]],
+                 child: LogicalPlan):
+        if not wexprs:
+            raise HyperspaceException("Window requires at least one expr")
+        self.wexprs = [(name, w) for name, w in wexprs]
+        for name, w in self.wexprs:
+            for ref in w.references:
+                if ref not in child.schema:
+                    raise HyperspaceException(
+                        f"Window expr references unknown column '{ref}'; "
+                        f"available: {child.schema.names}")
+            for p in w.partition:
+                if not isinstance(p, E.Col):
+                    raise HyperspaceException(
+                        f"Window PARTITION BY must be plain columns; "
+                        f"got {p!r}")
+            for o, _ in w.orders:
+                if not isinstance(o, E.Col):
+                    raise HyperspaceException(
+                        f"Window ORDER BY must be plain columns; got {o!r}")
+            if w.arg is not None and not isinstance(w.arg, E.Col):
+                raise HyperspaceException(
+                    f"Window argument must be a plain column; got {w.arg!r}")
+            if name in child.schema:
+                raise HyperspaceException(
+                    f"Window output '{name}' collides with input column")
+        self.child = child
+        fields = list(child.schema.fields)
+        for name, w in self.wexprs:
+            fields.append(Field(name, infer_dtype(w, child.schema)))
+        self._schema = Schema(fields)
+
+    @property
+    def children(self) -> List[LogicalPlan]:
+        return [self.child]
+
+    def with_children(self, children):
+        return Window(self.wexprs, children[0])
+
+    @property
+    def schema(self) -> Schema:
+        return self._schema
+
+    def simple_string(self) -> str:
+        return ("Window [" + ", ".join(
+            f"{name}={w!r}" for name, w in self.wexprs) + "]")
 
 
 class Sort(LogicalPlan):

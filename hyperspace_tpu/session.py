@@ -19,7 +19,7 @@ from .config import Conf, HyperspaceConf
 from .exceptions import HyperspaceException
 from .plan import expr as E
 from .plan.nodes import (Aggregate, Filter, Join, Limit, LogicalPlan, Project,
-                         Scan, Sort, Union)
+                         Scan, Sort, Union, Window)
 from .schema import Schema
 from .sources.interfaces import FileBasedSourceProviderManager
 
@@ -363,6 +363,19 @@ class DataFrame:
         return DataFrame(self.session, Project(exprs, self.plan))
 
     withColumn = with_column
+
+    def with_window(self, name: str, wexpr: E.Expr) -> "DataFrame":
+        """Append an analytic (window) column — the analogue of Spark's
+        ``withColumn(name, fn.over(windowSpec))``; build ``wexpr`` with
+        ``hyperspace_tpu.functions.window(...)``. The reference inherits
+        window execution from Spark SQL; here it is a first-class plan
+        node (plan/nodes.py Window)."""
+        if not isinstance(wexpr, E.WindowExpr):
+            raise HyperspaceException(
+                f"with_window expects a WindowExpr; got {wexpr!r}")
+        return DataFrame(self.session,
+                         Window([(name, self._resolve_expr(wexpr))],
+                                self.plan))
 
     def drop(self, *names: str) -> "DataFrame":
         dropped = {self._spelling(n) for n in names}

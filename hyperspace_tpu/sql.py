@@ -75,12 +75,14 @@ _KEYWORDS = {
     "SELECT", "FROM", "WHERE", "GROUP", "BY", "HAVING", "ORDER", "LIMIT",
     "JOIN", "INNER", "LEFT", "RIGHT", "FULL", "OUTER", "ON", "AS", "AND",
     "OR", "NOT", "IN", "BETWEEN", "ASC", "DESC", "DATE", "DISTINCT",
-    "UNION", "ALL",
+    "UNION", "ALL", "WITH",
     "SUM", "AVG", "MIN", "MAX", "COUNT",
     "LIKE", "IS", "NULL", "CASE", "WHEN", "THEN", "ELSE", "END",
     "EXTRACT", "INTERVAL", "DAY", "MONTH", "YEAR", "QUARTER",
     "EXISTS", "SUBSTRING", "SUBSTR", "FOR", "UPPER", "LOWER", "TRIM",
-    "CAST",
+    "CAST", "COALESCE",
+    "OVER", "PARTITION", "ROWS", "RANGE", "UNBOUNDED", "PRECEDING",
+    "FOLLOWING", "CURRENT", "ROW", "RANK", "DENSE_RANK", "ROW_NUMBER",
 }
 
 # Words that are only meaningful in specific grammar positions (EXTRACT's
@@ -90,6 +92,9 @@ _KEYWORDS = {
 _SOFT_KEYWORDS = {
     "YEAR", "MONTH", "DAY", "QUARTER", "FOR",
     "UPPER", "LOWER", "TRIM", "SUBSTRING", "SUBSTR", "EXTRACT", "CAST",
+    "COALESCE", "OVER", "PARTITION", "ROWS", "RANGE", "UNBOUNDED",
+    "PRECEDING", "FOLLOWING", "CURRENT", "ROW", "RANK", "DENSE_RANK",
+    "ROW_NUMBER",
 }
 
 
@@ -246,6 +251,19 @@ class _Parser:
         self.toks = _tokenize(text)
         self.i = 0
         self._sq_counter = 0
+        self._win_counter = 0
+        # WITH-clause bindings (CTEs): name → DataFrame. Checked before
+        # session temp views everywhere a table name resolves.
+        self._ctes: Dict[str, object] = {}
+
+    def _table(self, name: str):
+        """Resolve a table reference: CTE bindings shadow temp views
+        (standard SQL scoping; the reference inherits WITH from Spark —
+        its first TPC-DS golden needs it, tpcds/queries/q1.sql)."""
+        df = self._ctes.get(name.lower())
+        if df is not None:
+            return df
+        return self.session.table(name)
 
     # -- token helpers ---------------------------------------------------
     @staticmethod
@@ -461,6 +479,27 @@ class _Parser:
                 inner = self.expr()
                 self.take("OP", ")")
                 return E.StringTransform(fn.lower(), inner)
+        if self.peek("KW", "COALESCE") and self.peek2("OP", "("):
+            self.take("KW")
+            self.take("OP", "(")
+            args = [self.expr()]
+            while self.accept("OP", ","):
+                args.append(self.expr())
+            self.take("OP", ")")
+            if len(args) < 2:
+                raise HyperspaceException(
+                    "SQL: COALESCE takes at least two arguments")
+            # Parse-time rewrite onto CASE (first non-null argument).
+            e = args[-1]
+            for a in reversed(args[:-1]):
+                e = E.CaseWhen([(E.IsNull(a, negated=True), a)], e)
+            return e
+        for rank_fn in ("RANK", "DENSE_RANK", "ROW_NUMBER"):
+            if self.peek("KW", rank_fn) and self.peek2("OP", "("):
+                self.take("KW")
+                self.take("OP", "(")
+                self.take("OP", ")")
+                return self._window_spec(rank_fn.lower(), None)
         if self.accept("KW", "INTERVAL"):
             if self.peek("STR"):
                 raw = self.take("STR")
@@ -479,7 +518,20 @@ class _Parser:
             return _IntervalLit(n, unit)
         if self.peek("KW") and self.toks[self.i][1].upper() in (
                 "SUM", "AVG", "MIN", "MAX", "COUNT"):
-            return self._aggregate()
+            agg = self._aggregate()
+            if self.peek("KW", "OVER"):
+                # ``agg(x) OVER (...)`` is a window, not a group aggregate:
+                # the aggregate's argument becomes the window argument
+                # (``avg(sum(x)) OVER`` keeps the inner sum as the arg —
+                # it is lifted to a hidden aggregate column at lowering).
+                base = agg
+                if isinstance(base, E.CountDistinct):
+                    raise HyperspaceException(
+                        "SQL: COUNT(DISTINCT ...) OVER is not supported")
+                fn = {E.Sum: "sum", E.Avg: "avg", E.Min: "min",
+                      E.Max: "max", E.Count: "count"}[type(base)]
+                return self._window_spec(fn, base.child)
+            return agg
         if self.peek_name():
             return E.col(self.take_name())
         if self.peek("NUM"):
@@ -543,9 +595,19 @@ class _Parser:
         inner = self.expr()
         self.take("KW", "AS")
         ty = self.take().upper()
-        if self.peek("OP", "("):
-            # Parameterized targets (DECIMAL(7,2), CHAR(16), ...): name
-            # the target in the error instead of a bare parse failure.
+        if ty == "DECIMAL" and self.peek("OP", "("):
+            # DECIMAL(p,s): both engine paths compute in float64, so the
+            # cast is an identity here (same-engine disable-and-compare
+            # keeps the oracle sound); literals fold to float below.
+            self.take("OP", "(")
+            self._int_literal("DECIMAL precision expects")
+            if self.accept("OP", ","):
+                self._int_literal("DECIMAL scale expects")
+            self.take("OP", ")")
+            ty = "DOUBLE"
+        elif self.peek("OP", "("):
+            # Other parameterized targets (CHAR(16), VARCHAR(20), ...):
+            # name the target in the error instead of a bare parse failure.
             raise HyperspaceException(
                 f"SQL: unsupported CAST target {ty}(...)")
         self.take("OP", ")")
@@ -601,6 +663,47 @@ class _Parser:
         self.take("OP", ")")
         return {"SUM": E.sum_, "AVG": E.avg,
                 "MIN": E.min_, "MAX": E.max_}[fn](inner)
+
+    def _window_spec(self, fn: str, arg: Optional[E.Expr]) -> E.Expr:
+        """OVER ( [PARTITION BY e, ...] [ORDER BY e [ASC|DESC], ...]
+        [ROWS|RANGE BETWEEN UNBOUNDED PRECEDING AND CURRENT ROW] )."""
+        self.take("KW", "OVER")
+        self.take("OP", "(")
+        partition: List[E.Expr] = []
+        orders: List[Tuple[E.Expr, bool]] = []
+        frame = None
+        if self.peek("KW", "PARTITION"):
+            self.take("KW")
+            self.take("KW", "BY")
+            partition.append(self.expr())
+            while self.accept("OP", ","):
+                partition.append(self.expr())
+        if self.accept("KW", "ORDER"):
+            self.take("KW", "BY")
+            while True:
+                e = self.expr()
+                asc = True
+                if self.accept("KW", "DESC"):
+                    asc = False
+                else:
+                    self.accept("KW", "ASC")
+                orders.append((e, asc))
+                if not self.accept("OP", ","):
+                    break
+        if self.peek("KW", "ROWS") or self.peek("KW", "RANGE"):
+            kind = self.take("KW")
+            self.take("KW", "BETWEEN")
+            if not (self.accept("KW", "UNBOUNDED")
+                    and self.accept("KW", "PRECEDING")):
+                raise HyperspaceException(
+                    "SQL: only BETWEEN UNBOUNDED PRECEDING AND CURRENT "
+                    "ROW window frames are supported")
+            self.take("KW", "AND")
+            self.take("KW", "CURRENT")
+            self.take("KW", "ROW")
+            frame = "rows" if kind == "ROWS" else "range"
+        self.take("OP", ")")
+        return E.WindowExpr(fn, arg, partition, orders, frame)
 
     # -- subquery structure ----------------------------------------------
     def _subquery_struct(self) -> _SubQ:
@@ -662,9 +765,26 @@ class _Parser:
 
     # -- query -----------------------------------------------------------
     def query(self):
+        self._with_clause()
         df = self._query_body()
         self.take("EOF")
         return df
+
+    def _with_clause(self):
+        """WITH name AS ( query-body ) [, name2 AS ( ... )]* — each body
+        is any supported query (joins, group-by, unions, windows, its own
+        ORDER BY/LIMIT); later CTEs may reference earlier ones."""
+        if not self.accept("KW", "WITH"):
+            return
+        while True:
+            name = self.take_name()
+            self.take("KW", "AS")
+            self.take("OP", "(")
+            df = self._query_body()
+            self.take("OP", ")")
+            self._ctes[name.lower()] = df
+            if not self.accept("OP", ","):
+                break
 
     def _query_body(self):
         """select [UNION ALL select]* [ORDER BY ...] [LIMIT n] — a
@@ -710,7 +830,7 @@ class _Parser:
                 scope.bind(alias, inner)
             return inner, alias
         name = self.take_name()
-        df = self.session.table(name)
+        df = self._table(name)
         alias = None
         if self.accept("KW", "AS"):
             alias = self.take_name()
@@ -834,7 +954,7 @@ class _Parser:
             aliased = False
             compound = False
             for e, alias in items:
-                if _contains_agg(e):
+                if _contains_agg(e) or _contains_window(e):
                     base = e.child if isinstance(e, E.Alias) else e
                     if isinstance(base, E.AggExpr):
                         named = e.alias(alias) if alias else e
@@ -885,6 +1005,13 @@ class _Parser:
                   else df.agg(*aggs))
             if having is not None:
                 df = df.filter(having)
+            # Window functions evaluate AFTER grouping (standard SQL): by
+            # now every inner aggregate is a hidden column, so the window
+            # specs reference plain aggregate outputs / group columns.
+            windowed = any(isinstance(c, E.Expr) and _contains_window(c)
+                           for c in out_cols)
+            if windowed:
+                df, out_cols = self._apply_windows_mixed(df, out_cols)
             # Project only when the SELECT list differs from the
             # aggregate's natural output (group cols then aggregates) —
             # a redundant Project would make SQL plans diverge from the
@@ -892,12 +1019,14 @@ class _Parser:
             # compound aggregate items, and hidden HAVING aggregates
             # always force the projection.
             natural = group_resolved + visible_agg_names
-            if aliased or compound or out_names != natural \
+            if aliased or compound or windowed or out_names != natural \
                     or len(aggs) != n_visible:
                 df = df.select(*out_cols)
         elif not star:
-            df = df.select(*[e.alias(alias) if alias else e
-                             for e, alias in items])
+            sel = [e.alias(alias) if alias else e for e, alias in items]
+            if any(_contains_window(e) for e in sel):
+                df, sel = self._apply_windows_mixed(df, sel)
+            df = df.select(*sel)
             if self.accept("KW", "HAVING"):
                 raise HyperspaceException(
                     "SQL: HAVING requires GROUP BY or aggregates")
@@ -1063,6 +1192,53 @@ class _Parser:
             cur = self._apply_subquery_conjunct(cur, c, scope)
         return cur
 
+    # -- window lowering ---------------------------------------------------
+    def _apply_windows_mixed(self, df, cols):
+        """Rewrite a projection list (strings or exprs) so every embedded
+        WindowExpr becomes a reference to a hidden window output column.
+        All specs land in ONE Window plan node, so exprs sharing a
+        (partition, order) spec share one sort in the executor."""
+        specs: List[Tuple[str, E.WindowExpr]] = []
+
+        def rewrite(node: E.Expr) -> E.Expr:
+            if isinstance(node, E.WindowExpr):
+                name = f"__win{self._win_counter}"
+                self._win_counter += 1
+                specs.append((name, node))
+                return E.col(name)
+            return E.map_children(node, rewrite)
+
+        out = [rewrite(c) if isinstance(c, E.Expr) and _contains_window(c)
+               else c for c in cols]
+        if specs:
+            df = self._attach_windows(df, specs)
+        return df, out
+
+    def _attach_windows(self, df, specs):
+        """Materialize non-column window sub-expressions (argument,
+        partition keys, order keys) as hidden projected columns, then add
+        one Window node carrying every spec. Hidden columns are dropped
+        by the enclosing SELECT's final projection."""
+        from .plan.nodes import Window
+
+        def mat(sub, name, tag):
+            nonlocal df
+            if sub is None or isinstance(sub, E.Col):
+                return sub
+            hidden = f"{name}_{tag}"
+            df = df.with_column(hidden, sub)
+            return E.col(hidden)
+
+        prepared = []
+        for name, w in specs:
+            arg = mat(w.arg, name, "a")
+            part = [mat(p, name, f"p{i}") for i, p in enumerate(w.partition)]
+            orders = [(mat(o, name, f"o{i}"), asc)
+                      for i, (o, asc) in enumerate(w.orders)]
+            prepared.append((name, df._resolve_expr(
+                E.WindowExpr(w.fn, arg, part, orders, w.frame))))
+        return type(df)(df.session, Window(prepared, df.plan))
+
     # -- subquery lowering ------------------------------------------------
     def _apply_where_with_subqueries(self, df, cond: E.Expr, scope: _Scope):
         plain: List[E.Expr] = []
@@ -1103,7 +1279,7 @@ class _Parser:
         subquery reads the same table as the outer query (the TPC-H Q21
         family), ``t2.g = t.g`` must stay a correlation even though both
         sides strip to the same bare column."""
-        inner = self.session.table(subq.table)
+        inner = self._table(subq.table)
         child = _Scope(parent=scope)
         inner_name = (subq.alias or subq.table).lower()
         child.bind(inner_name, inner)
@@ -1334,6 +1510,14 @@ def _contains_agg(e: Optional[E.Expr]) -> bool:
     if isinstance(e, E.AggExpr):
         return True
     return any(_contains_agg(c) for c in e.children)
+
+
+def _contains_window(e: Optional[E.Expr]) -> bool:
+    if e is None:
+        return False
+    if isinstance(e, E.WindowExpr):
+        return True
+    return any(_contains_window(c) for c in e.children)
 
 
 def _lift_aggs(e: E.Expr, prefix: str):

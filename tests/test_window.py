@@ -1,0 +1,167 @@
+"""Window (analytic) functions: executor semantics vs a pandas oracle.
+
+The reference inherits window execution from Spark SQL (its TPC-DS golden
+corpus is full of rank()/sum() OVER — e.g. queries q51, q53, q63, q89);
+here Window is a first-class plan node (plan/nodes.py) executed as
+sort + segmented scans (execution/executor.py _execute_window), and these
+tests pin the semantics: rank families, the three frames (whole partition,
+RANGE-running with order peers, ROWS-running), null handling, and
+order-preservation of the operator itself.
+"""
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+import hyperspace_tpu as hst
+from hyperspace_tpu.exceptions import HyperspaceException
+from hyperspace_tpu.plan import expr as E
+
+
+@pytest.fixture(scope="module")
+def env(tmp_path_factory):
+    root = tmp_path_factory.mktemp("window")
+    rng = np.random.default_rng(11)
+    n = 400
+    v = np.round(rng.uniform(0, 100, n), 2)
+    valid = rng.random(n) > 0.15
+    t = pa.table({
+        "g": pa.array(rng.integers(0, 6, n).astype(np.int64)),
+        "o": pa.array(rng.integers(0, 25, n).astype(np.int64)),
+        "v": pa.array(v),
+        "nv": pa.array([float(x) if ok else None
+                        for x, ok in zip(v, valid)], type=pa.float64()),
+        "s": pa.array(rng.choice(["aa", "bb", "cc", "dd"], n)),
+    })
+    d = root / "t"
+    d.mkdir()
+    pq.write_table(t, str(d / "p.parquet"))
+    session = hst.Session(system_path=str(root / "idx"))
+    df = session.read.parquet(str(d))
+    return session, df, t.to_pandas()
+
+
+def _sorted(df, cols):
+    return df.sort_values(cols, kind="stable").reset_index(drop=True)
+
+
+def test_rank_min_semantics(env):
+    _, df, pdf = env
+    out = df.with_window("rk", E.window(
+        "rank", partition_by=["g"], order_by=[("o", False)])).to_pandas()
+    exp = pdf.assign(rk=pdf.groupby("g")["o"].rank(
+        method="min", ascending=False).astype("int64"))
+    pd.testing.assert_series_equal(_sorted(out, ["g", "o", "v"])["rk"],
+                                   _sorted(exp, ["g", "o", "v"])["rk"])
+
+
+def test_dense_rank_and_row_number(env):
+    _, df, pdf = env
+    out = df.with_window(
+        "dr", E.window("dense_rank", partition_by=["g"], order_by=["o"])) \
+        .with_window(
+        "rn", E.window("row_number", partition_by=["g"], order_by=["o"])) \
+        .to_pandas()
+    exp = pdf.assign(dr=pdf.groupby("g")["o"].rank(
+        method="dense").astype("int64"))
+    pd.testing.assert_series_equal(_sorted(out, ["g", "o", "v"])["dr"],
+                                   _sorted(exp, ["g", "o", "v"])["dr"])
+    for _, grp in out.groupby("g"):
+        assert sorted(grp["rn"]) == list(range(1, len(grp) + 1))
+        # row_number refines rank: within a partition, ordering rows by
+        # rn must keep o non-decreasing.
+        assert grp.sort_values("rn")["o"].is_monotonic_increasing
+
+
+def test_whole_partition_aggregates(env):
+    _, df, pdf = env
+    out = df.with_window("sm", E.window("sum", arg="v", partition_by=["g"])) \
+        .with_window("av", E.window("avg", arg="v", partition_by=["g"])) \
+        .with_window("mn", E.window("min", arg="v", partition_by=["g"])) \
+        .with_window("mx", E.window("max", arg="v", partition_by=["g"])) \
+        .with_window("ct", E.window("count", partition_by=["g"])) \
+        .to_pandas()
+    gb = pdf.groupby("g")["v"]
+    exp = pdf.assign(sm=gb.transform("sum"), av=gb.transform("mean"),
+                     mn=gb.transform("min"), mx=gb.transform("max"),
+                     ct=gb.transform("size").astype("int64"))
+    got, want = _sorted(out, ["g", "o", "v"]), _sorted(exp, ["g", "o", "v"])
+    for c in ("sm", "av", "mn", "mx", "ct"):
+        pd.testing.assert_series_equal(got[c], want[c], rtol=1e-9)
+
+
+def test_running_sum_rows_frame(env):
+    _, df, pdf = env
+    out = df.with_window("rr", E.window(
+        "sum", arg="v", partition_by=["g"], order_by=["o"],
+        frame="rows")).to_pandas()
+    got = _sorted(out, ["g", "o"])
+    exp = _sorted(pdf, ["g", "o"])
+    exp["rr"] = exp.groupby("g")["v"].cumsum()
+    pd.testing.assert_series_equal(got["rr"], exp["rr"], rtol=1e-9)
+
+
+def test_running_sum_range_frame_includes_peers(env):
+    _, df, pdf = env
+    out = df.with_window("rs", E.window(
+        "sum", arg="v", partition_by=["g"], order_by=["o"])).to_pandas()
+    exp = _sorted(pdf, ["g", "o"])
+    exp["cum"] = exp.groupby("g")["v"].cumsum()
+    # Default RANGE frame: order-key peers all take the peer group's total.
+    exp["rs"] = exp.groupby(["g", "o"])["cum"].transform("max")
+    pd.testing.assert_series_equal(_sorted(out, ["g", "o", "v"])["rs"],
+                                   _sorted(exp, ["g", "o", "v"])["rs"],
+                                   rtol=1e-9)
+
+
+def test_nullable_argument(env):
+    _, df, pdf = env
+    out = df.with_window("sm", E.window("sum", arg="nv", partition_by=["g"])) \
+        .with_window("av", E.window("avg", arg="nv", partition_by=["g"])) \
+        .with_window("ct", E.window("count", arg="nv", partition_by=["g"])) \
+        .to_pandas()
+    gb = pdf.groupby("g")["nv"]
+    exp = pdf.assign(sm=gb.transform("sum"), av=gb.transform("mean"),
+                     ct=gb.transform("count").astype("int64"))
+    got, want = _sorted(out, ["g", "o", "v"]), _sorted(exp, ["g", "o", "v"])
+    for c in ("sm", "av", "ct"):
+        pd.testing.assert_series_equal(got[c], want[c], rtol=1e-9)
+
+
+def test_global_window_no_partition(env):
+    _, df, pdf = env
+    out = df.with_window("mx", E.window("max", arg="v")).to_pandas()
+    assert np.allclose(out["mx"], pdf["v"].max())
+
+
+def test_string_min_max_over_partition(env):
+    _, df, pdf = env
+    out = df.with_window("smin", E.window(
+        "min", arg="s", partition_by=["g"])).to_pandas()
+    exp = pdf.assign(smin=pdf.groupby("g")["s"].transform("min"))
+    pd.testing.assert_series_equal(_sorted(out, ["g", "o", "v"])["smin"],
+                                   _sorted(exp, ["g", "o", "v"])["smin"])
+
+
+def test_window_preserves_row_order(env):
+    _, df, pdf = env
+    out = df.with_window("rn", E.window(
+        "row_number", partition_by=["g"], order_by=["o"])).to_pandas()
+    # The operator appends a column without permuting existing rows.
+    pd.testing.assert_frame_equal(out[["g", "o", "v"]],
+                                  pdf[["g", "o", "v"]])
+
+
+def test_rank_requires_order_by(env):
+    with pytest.raises(HyperspaceException, match="requires ORDER BY"):
+        E.window("rank", partition_by=["g"])
+
+
+def test_empty_input(env):
+    _, df, _ = env
+    out = df.filter(E.col("o") < -1).with_window(
+        "rk", E.window("rank", partition_by=["g"],
+                       order_by=["o"])).to_pandas()
+    assert len(out) == 0 and "rk" in out.columns
