@@ -7,13 +7,15 @@ track validity alongside values and fold it in at mask time.
 
 from __future__ import annotations
 
+import datetime
 from typing import Optional, Tuple
 
 import jax.numpy as jnp
+import numpy as np
 
 from ..exceptions import HyperspaceException
 from ..plan import expr as E
-from ..schema import BOOL, FLOAT64, INT64, STRING
+from ..schema import BOOL, DATE, FLOAT64, INT64, STRING
 from .columnar import (Column, Table, dictionaries_equal, literal_to_device,
                        translate_codes)
 
@@ -38,8 +40,25 @@ def eval_expr(table: Table, e: E.Expr) -> Column:
     if isinstance(e, E.Alias):
         return eval_expr(table, e.child)
     if isinstance(e, E.Lit):
+        # Constant projection (SQL: SELECT 's' sale_type ... — the TPC-DS
+        # q4/q11/q74 house style): broadcast to a constant column. A bare
+        # NULL has no type and stays rejected.
+        n = table.num_rows
+        v = e.value
+        if isinstance(v, bool):
+            return Column(BOOL, jnp.full(n, v, jnp.bool_))
+        if isinstance(v, int):
+            return Column(INT64, jnp.full(n, v, jnp.int64))
+        if isinstance(v, float):
+            return Column(FLOAT64, jnp.full(n, v, jnp.float64))
+        if isinstance(v, str):
+            return Column(STRING, jnp.zeros(n, jnp.int32),
+                          None, np.array([v], dtype=object))
+        if isinstance(v, datetime.date):
+            days = (v - datetime.date(1970, 1, 1)).days
+            return Column(DATE, jnp.full(n, days, jnp.int32))
         raise HyperspaceException(
-            "Bare literals must appear inside a comparison/arithmetic expression")
+            f"Cannot project literal {v!r} as a column")
     if isinstance(e, _COMPARISONS):
         return _eval_comparison(table, e)
     if isinstance(e, (E.And, E.Or)):

@@ -672,6 +672,8 @@ def _string_join_keys(lc: Column, rc: Column):
 
 
 def _execute_join(plan: Join, needed: Optional[Set[str]]) -> Table:
+    if plan.join_type == "cross":
+        return _execute_cross_join(plan, needed)
     pairs = E.extract_equi_join_keys(plan.condition)
     if pairs is None:
         raise HyperspaceException(
@@ -756,6 +758,43 @@ def _execute_join(plan: Join, needed: Optional[Set[str]]) -> Table:
     if lbo is not None and all(k in out for k in lbo[1]):
         order_out = lbo
     return Table(out, bucket_order=order_out)
+
+
+def _execute_cross_join(plan: Join, needed: Optional[Set[str]]) -> Table:
+    """Cartesian product via index expansion (left repeated, right tiled).
+    The SQL front-end only emits this for single-row sides (comma-joined
+    global aggregates — the TPC-DS q28/q61/q88/q90 shape), so the usual
+    blow-up risk does not apply; a guard still bounds the general case."""
+    left_names = set(plan.left.schema.names)
+    lneed = None if needed is None else {n for n in needed
+                                         if n in left_names}
+    rneed = None if needed is None else {n for n in needed
+                                         if n not in left_names}
+    left = _execute(plan.left, lneed)
+    right = _execute(plan.right, rneed)
+    n, m = left.num_rows, right.num_rows
+    if n * m > 50_000_000:
+        raise HyperspaceException(
+            f"Cross join too large: {n} x {m} rows")
+    li = jnp.repeat(jnp.arange(n, dtype=jnp.int32), m)
+    ri = jnp.tile(jnp.arange(m, dtype=jnp.int32), n)
+    out = {}
+    for name in plan.schema.names:
+        if needed is not None and name not in needed:
+            continue
+        if name in left.columns:
+            out[name] = left.column(name).take(li)
+        elif name in right.columns:
+            out[name] = right.column(name).take(ri)
+    if not out:
+        # count(*) over a cross join: materialize one column for the count.
+        if left.columns:
+            k = next(iter(left.columns))
+            out[k] = left.columns[k].take(li)
+        else:
+            k = next(iter(right.columns))
+            out[k] = right.columns[k].take(ri)
+    return Table(out)
 
 
 def _execute_semi_anti_join(left: Table, right: Table, norm,

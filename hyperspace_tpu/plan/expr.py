@@ -692,6 +692,8 @@ def extract_equi_join_keys(condition: Expr) -> Optional[List[Tuple[str, str]]]:
     rules/JoinIndexRule.scala:135) — only CNF of EqualTo over direct column
     refs is supported.
     """
+    if condition is None:  # cross join: no keys
+        return None
     pairs: List[Tuple[str, str]] = []
     for pred in split_conjunctive_predicates(condition):
         if isinstance(pred, EqualTo) and isinstance(pred.left, Col) \

@@ -253,8 +253,15 @@ class Project(LogicalPlan):
 class Join(LogicalPlan):
     def __init__(self, left: LogicalPlan, right: LogicalPlan, condition: E.Expr,
                  join_type: str = "inner"):
-        if join_type not in ("inner", "left", "right", "full", "semi", "anti"):
+        if join_type not in ("inner", "left", "right", "full", "semi",
+                             "anti", "cross"):
             raise HyperspaceException(f"Unsupported join type: {join_type}")
+        if join_type == "cross":
+            if condition is not None:
+                raise HyperspaceException("Cross join takes no condition")
+        elif condition is None:
+            raise HyperspaceException(
+                f"{join_type} join requires a condition")
         overlap = set(left.schema.names) & set(right.schema.names)
         if overlap:
             raise HyperspaceException(
@@ -263,7 +270,7 @@ class Join(LogicalPlan):
         # Validate references resolve against the combined schema.
         combined = list(left.schema.fields) + list(right.schema.fields)
         names = {f.name for f in combined}
-        for ref in condition.references:
+        for ref in (condition.references if condition is not None else ()):
             if ref not in names:
                 raise HyperspaceException(f"Join condition references unknown '{ref}'")
         self.left = left
@@ -277,7 +284,7 @@ class Join(LogicalPlan):
             self._schema = left.schema
             return
         # Outer joins null-fill the non-preserved side's columns.
-        if join_type != "inner":
+        if join_type in ("left", "right", "full"):
             from ..schema import Field
             left_nullable = join_type in ("right", "full")
             right_nullable = join_type in ("left", "full")
@@ -300,6 +307,8 @@ class Join(LogicalPlan):
         return self._schema
 
     def simple_string(self) -> str:
+        if self.join_type == "cross":
+            return "Join cross"
         return f"Join {self.join_type} ({self.condition!r})"
 
 

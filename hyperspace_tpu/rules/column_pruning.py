@@ -49,7 +49,8 @@ def prune_columns(plan: LogicalPlan, required: Optional[Set[str]] = None
         children = [prune_columns(c, set(required)) for c in plan.children]
         return plan.with_children(children)
     if isinstance(plan, Join):
-        cond_refs = set(plan.condition.references)
+        cond_refs = set(plan.condition.references) \
+            if plan.condition is not None else set()
         left_names = set(plan.left.schema.names)
         right_names = set(plan.right.schema.names)
         lreq = (required | cond_refs) & left_names

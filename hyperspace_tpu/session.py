@@ -293,6 +293,15 @@ class DataFrame:
                          Join(self.plan, other.plan,
                               self._resolve_expr(on, both), how))
 
+    def cross_join(self, other: "DataFrame") -> "DataFrame":
+        """Cartesian product (Spark's crossJoin). The SQL front-end emits
+        this only for single-row sides (comma-joined global aggregates —
+        the TPC-DS q28/q61/q88/q90 shape)."""
+        return DataFrame(self.session,
+                         Join(self.plan, other.plan, None, "cross"))
+
+    crossJoin = cross_join
+
     def group_by(self, *cols: str) -> "GroupedData":
         return GroupedData(self, [self._spelling(c) for c in cols])
 

@@ -83,6 +83,7 @@ _KEYWORDS = {
     "CAST", "COALESCE",
     "OVER", "PARTITION", "ROWS", "RANGE", "UNBOUNDED", "PRECEDING",
     "FOLLOWING", "CURRENT", "ROW", "RANK", "DENSE_RANK", "ROW_NUMBER",
+    "ABS",
 }
 
 # Words that are only meaningful in specific grammar positions (EXTRACT's
@@ -94,11 +95,15 @@ _SOFT_KEYWORDS = {
     "UPPER", "LOWER", "TRIM", "SUBSTRING", "SUBSTR", "EXTRACT", "CAST",
     "COALESCE", "OVER", "PARTITION", "ROWS", "RANGE", "UNBOUNDED",
     "PRECEDING", "FOLLOWING", "CURRENT", "ROW", "RANK", "DENSE_RANK",
-    "ROW_NUMBER",
+    "ROW_NUMBER", "ABS",
 }
 
 
 def _tokenize(text: str) -> List[Tuple[str, str]]:
+    # SQL line comments (``-- ...``): stripped before tokenizing, except
+    # inside string literals (a '--' in a LIKE pattern must survive).
+    text = re.sub(r"('(?:[^']|'')*')|--[^\n]*",
+                  lambda m: m.group(1) or " ", text)
     out: List[Tuple[str, str]] = []
     pos = 0
     while pos < len(text):
@@ -223,10 +228,15 @@ def _shift_date(d: datetime.date, n: int, unit: str) -> datetime.date:
 
 
 class _Scope:
-    """Alias/table-name → DataFrame bindings (chained for subqueries)."""
+    """Alias/table-name → DataFrame bindings (chained for subqueries).
+    ``renames`` maps (alias, column) → mangled output name for duplicate
+    table instances in one FROM list (``date_dim d1, date_dim d2`` — the
+    q25/q29/q50 shape), where the later instances' columns are renamed to
+    keep the join output unambiguous."""
 
     def __init__(self, parent: Optional["_Scope"] = None):
         self.bindings: Dict[str, object] = {}
+        self.renames: Dict[str, Dict[str, str]] = {}
         self.parent = parent
 
     def bind(self, name: str, df) -> None:
@@ -237,6 +247,14 @@ class _Scope:
         while s is not None:
             if prefix.lower() in s.bindings:
                 return s.bindings[prefix.lower()]
+            s = s.parent
+        return None
+
+    def rename_for(self, prefix: str) -> Optional[Dict[str, str]]:
+        s = self
+        while s is not None:
+            if prefix.lower() in s.renames:
+                return s.renames[prefix.lower()]
             s = s.parent
         return None
 
@@ -479,6 +497,16 @@ class _Parser:
                 inner = self.expr()
                 self.take("OP", ")")
                 return E.StringTransform(fn.lower(), inner)
+        if self.peek("KW", "ABS") and self.peek2("OP", "("):
+            self.take("KW")
+            self.take("OP", "(")
+            inner = self.expr()
+            self.take("OP", ")")
+            # Parse-time rewrite: abs(x) = CASE WHEN x < 0 THEN -x ELSE x
+            # END (null in → null out, via CaseWhen's null propagation).
+            return E.CaseWhen([(E.LessThan(inner, E.lit(0)),
+                                _fold(E.lit(0), inner, lambda a, b: a - b,
+                                      lambda a, b: a - b))], inner)
         if self.peek("KW", "COALESCE") and self.peek2("OP", "("):
             self.take("KW")
             self.take("OP", "(")
@@ -754,6 +782,9 @@ class _Parser:
         if "." not in name:
             return name
         prefix, rest = name.split(".", 1)
+        rename = scope.rename_for(prefix)
+        if rename is not None and rest.lower() in rename:
+            return rename[rest.lower()]
         df = scope.lookup(prefix)
         if df is None:
             return name  # struct leaf or unknown: downstream error names it
@@ -803,7 +834,7 @@ class _Parser:
             orders = [self._order_item()]
             while self.accept("OP", ","):
                 orders.append(self._order_item())
-            df = df.sort(*orders)
+            df = self._sort_maybe_hidden(df, orders)
         if self.accept("KW", "LIMIT"):
             n = self._int_literal("LIMIT expects")
             if n < 0:
@@ -811,6 +842,45 @@ class _Parser:
                     f"SQL: LIMIT expects a non-negative integer, got {n}")
             df = df.limit(n)
         return df
+
+    def _sort_maybe_hidden(self, df, orders):
+        """ORDER BY may reference input/grouping columns the SELECT list
+        dropped (standard SQL; the q98/q20 shape sorts by a grouped
+        i_item_id that is not projected) or an arbitrary expression over
+        output columns (the q89 shape). Both lower to hidden columns:
+        widen, sort, re-project."""
+        exprs = [(n, asc) for n, asc in orders if isinstance(n, E.Expr)]
+        if exprs:
+            out_names = list(df.plan.schema.names)
+            resolved = []
+            hidden_i = 0
+            for n, asc in orders:
+                if isinstance(n, E.Expr):
+                    hn = f"__sort{hidden_i}"
+                    hidden_i += 1
+                    df = df.with_column(hn, n)
+                    resolved.append((hn, asc))
+                else:
+                    resolved.append((n, asc))
+            return df.sort(*resolved).select(*out_names)
+        have = set(df.plan.schema.names)
+        missing = [n for n, _ in orders if df._spelling(n) not in have]
+        if not missing:
+            return df.sort(*orders)
+        parent = getattr(self, "_sortable_parent", None)
+        if parent is None or parent[2] is not df:
+            return df.sort(*orders)  # original error names the column
+        child_df, out_cols, _ = parent
+        hidden = []
+        for n in missing:
+            sp = child_df._spelling(n)
+            if sp not in child_df.plan.schema.names:
+                return df.sort(*orders)  # truly unknown: clear error below
+            if sp not in hidden:
+                hidden.append(sp)
+        out_names = list(df.plan.schema.names)
+        widened = child_df.select(*(list(out_cols) + hidden))
+        return widened.sort(*orders).select(*out_names)
 
     def _table_ref(self, scope: _Scope):
         """One FROM-list entry: returns (df, bound-name or None). The
@@ -876,13 +946,28 @@ class _Parser:
                     "not supported")
             cond = None
             if self.accept("KW", "WHERE"):
-                cond = self._resolve_quals(self.expr(), scope)
+                # Resolution happens inside _build_implicit_joins, after
+                # duplicate-table instances are renamed (qualifiers must
+                # survive until then — the q25 ``date_dim d1, d2`` shape).
+                cond = self.expr()
             df = self._build_implicit_joins(refs, cond, scope)
 
         # Resolve alias-qualified names in the select list now that the
-        # FROM clause has bound the aliases.
-        items = [(self._resolve_quals(e, scope) if e is not None else None,
-                  alias) for e, alias in items]
+        # FROM clause has bound the aliases. An unaliased qualified column
+        # of a renamed duplicate-table instance (``SELECT d2.d_moy``) keeps
+        # its user-visible name as the output alias — the mangled internal
+        # spelling must never surface in results.
+        def _item_resolve(e, alias):
+            if e is None:
+                return None, alias
+            r = self._resolve_quals(e, scope)
+            if alias is None and isinstance(e, E.Col) and "." in e.column \
+                    and isinstance(r, E.Col) and r.column != e.column \
+                    and r.column.startswith("__"):
+                alias = e.column.split(".", 1)[1]
+            return r, alias
+
+        items = [_item_resolve(e, alias) for e, alias in items]
 
         group_cols: List[str] = []
         group_exprs: List[Tuple[E.Expr, str]] = []
@@ -974,6 +1059,15 @@ class _Parser:
                         out_cols.append(named)
                         out_names.append(named.name)
                 else:
+                    if isinstance(e, E.Lit):
+                        # Constant select item in a grouped query
+                        # (``'s' sale_type`` — the q4/q11/q74 style):
+                        # projected after aggregation.
+                        compound = True
+                        named = e.alias(alias) if alias else e.alias(e.name)
+                        out_cols.append(named)
+                        out_names.append(named.name)
+                        continue
                     if not isinstance(e, E.Col):
                         raise HyperspaceException(
                             "SQL: non-aggregate select items must be "
@@ -1021,24 +1115,30 @@ class _Parser:
             natural = group_resolved + visible_agg_names
             if aliased or compound or windowed or out_names != natural \
                     or len(aggs) != n_visible:
+                pre = df
                 df = df.select(*out_cols)
+                self._sortable_parent = (pre, list(out_cols), df)
         elif not star:
             sel = [e.alias(alias) if alias else e for e, alias in items]
             if any(_contains_window(e) for e in sel):
                 df, sel = self._apply_windows_mixed(df, sel)
+            pre = df
             df = df.select(*sel)
+            self._sortable_parent = (pre, list(sel), df)
             if self.accept("KW", "HAVING"):
                 raise HyperspaceException(
                     "SQL: HAVING requires GROUP BY or aggregates")
 
         if star:
-            # Scalar-subquery lowering joins hidden __sqN_* helper columns
-            # onto the plan; SELECT * must not expose them.
+            # Hidden helper columns must not surface through SELECT *:
+            # scalar-subquery keys (__sqN_*) and duplicate-table renames
+            # (__<alias>__<col>).
+            hidden_re = r"__sq\d+_|__\w+__"
             leaked = [n for n in df.plan.schema.names
-                      if re.match(r"__sq\d+_", n)]
+                      if re.match(hidden_re, n)]
             if leaked:
                 df = df.select(*[n for n in df.plan.schema.names
-                                 if not re.match(r"__sq\d+_", n)])
+                                 if not re.match(hidden_re, n)])
 
         if distinct:
             df = df.distinct()
@@ -1079,9 +1179,10 @@ class _Parser:
                     name = alias or item.name
                     break
             if name is None:
-                raise HyperspaceException(
-                    f"SQL: ORDER BY expression {e!r} must restate an "
-                    "item of the SELECT list")
+                # Arbitrary sort expression over output columns (the q89
+                # ``ORDER BY sum_sales - avg_monthly_sales`` shape):
+                # materialized as a hidden column by _sort_maybe_hidden.
+                name = e
         if self.accept("KW", "DESC"):
             return (name, False)
         self.accept("KW", "ASC")
@@ -1105,10 +1206,20 @@ class _Parser:
         return df.join(other, on=cond, how=how)
 
     def _join_condition(self) -> E.Expr:
-        cond = self._join_eq()
+        cond = self._join_term()
         while self.accept("KW", "AND"):
-            cond = cond & self._join_eq()
+            cond = cond & self._join_term()
         return cond
+
+    def _join_term(self) -> E.Expr:
+        # Parentheses at any level (``ON (a.k = b.k AND a.j = b.j)``,
+        # ``ON (a.k = b.k) AND (a.j = b.j)`` — both appear in the TPC-DS
+        # texts, e.g. q97).
+        if self.accept("OP", "("):
+            inner = self._join_condition()
+            self.take("OP", ")")
+            return inner
+        return self._join_eq()
 
     def _join_eq(self) -> E.Expr:
         left = E.col(self.take_name())
@@ -1126,6 +1237,28 @@ class _Parser:
         is repeated inside each OR branch)."""
         dfs = [r[0] for r in refs]
         labels = [r[1] or f"table#{i}" for i, r in enumerate(refs)]
+        # Duplicate table instances (``date_dim d1, date_dim d2, ...``):
+        # rename the later instances' columns so the join output stays
+        # unambiguous; qualified references resolve through scope.renames.
+        seen_cols: set = set()
+        for i, d in enumerate(dfs):
+            cols = list(d.plan.schema.names)
+            if set(cols) & seen_cols:
+                label = refs[i][1]
+                if label is None:
+                    raise HyperspaceException(
+                        "SQL: duplicate table in FROM list requires an "
+                        f"alias (columns {sorted(set(cols) & seen_cols)} "
+                        "repeat)")
+                mapping = {c.lower(): f"__{label.lower()}__{c}"
+                           for c in cols}
+                dfs[i] = d.select(*[E.col(c).alias(mapping[c.lower()])
+                                    for c in cols])
+                scope.bind(label, dfs[i])
+                scope.renames[label.lower()] = mapping
+            seen_cols.update(dfs[i].plan.schema.names)
+        if cond is not None:
+            cond = self._resolve_quals(cond, scope)
         conjuncts: List[E.Expr] = []
         if cond is not None:
             for c in E.split_conjunctive_predicates(cond):
@@ -1177,6 +1310,18 @@ class _Parser:
                     pick = (t, conds)
                     break
             if pick is None:
+                # Single-row cross join: comma-joined global aggregates
+                # carry no join keys (the q28/q61/q88/q90 shape — derived
+                # tables that are each one aggregate row). General cross
+                # joins stay rejected.
+                singles = [t for t in sorted(remaining)
+                           if _is_single_row(dfs[t].plan)]
+                if singles:
+                    t = singles[0]
+                    cur = cur.cross_join(dfs[t])
+                    joined.add(t)
+                    remaining.remove(t)
+                    continue
                 missing = ", ".join(labels[t] for t in sorted(remaining))
                 raise HyperspaceException(
                     f"SQL: no equality predicate joins {missing} to the "
@@ -1510,6 +1655,19 @@ def _contains_agg(e: Optional[E.Expr]) -> bool:
     if isinstance(e, E.AggExpr):
         return True
     return any(_contains_agg(c) for c in e.children)
+
+
+def _is_single_row(plan) -> bool:
+    """True when the plan provably yields at most one row: a global
+    aggregate (no group columns), possibly under projections, or LIMIT 1."""
+    from .plan.nodes import Aggregate, Limit, Project
+    if isinstance(plan, Aggregate):
+        return not plan.group_cols
+    if isinstance(plan, Limit):
+        return plan.n == 1 or _is_single_row(plan.child)
+    if isinstance(plan, Project):
+        return _is_single_row(plan.child)
+    return False
 
 
 def _contains_window(e: Optional[E.Expr]) -> bool:

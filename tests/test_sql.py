@@ -277,8 +277,13 @@ class TestSqlReviewRegressions:
         got = env.sql("SELECT okey FROM li WHERE okey = CAST('3' AS INT) "
                       "LIMIT 1").to_pandas()
         assert got["okey"].tolist() == [3]
-        with pytest.raises(HyperspaceException, match="DECIMAL"):
-            env.sql("SELECT CAST(price AS DECIMAL(7,2)) FROM li")
+        # DECIMAL(p,s) is accepted as a float64 identity (the TPC-DS house
+        # style); other parameterized targets still error clearly.
+        d = env.sql("SELECT CAST(price AS DECIMAL(7,2)) p FROM li LIMIT 1") \
+            .to_pandas()
+        assert len(d) == 1
+        with pytest.raises(HyperspaceException, match="CHAR"):
+            env.sql("SELECT CAST(price AS CHAR(16)) FROM li")
         with pytest.raises(HyperspaceException, match="does not convert"):
             env.sql("SELECT okey FROM li WHERE okey = CAST('x' AS INT)")
 
@@ -291,8 +296,12 @@ class TestSqlReviewRegressions:
         g2 = env.sql("SELECT flag, SUM(qty) FROM li GROUP BY flag "
                      "ORDER BY SUM(qty) DESC").to_pandas()
         assert g2.iloc[0, 1] == g2.iloc[:, 1].max()
-        with pytest.raises(HyperspaceException, match="restate"):
-            env.sql("SELECT okey FROM li ORDER BY okey + 1")
+        # An ORDER BY expression that does NOT restate a select item is
+        # materialized as a hidden sort column (the TPC-DS q89 shape) —
+        # the result is sorted by it and does not expose it.
+        g3 = env.sql("SELECT okey FROM li ORDER BY okey + 1").to_pandas()
+        assert list(g3.columns) == ["okey"]
+        assert g3["okey"].is_monotonic_increasing
 
     def test_case_else_null_equals_no_else(self, env):
         a = env.sql("SELECT SUM(CASE WHEN flag = 'A' THEN qty ELSE NULL "
