@@ -96,6 +96,12 @@ MAX_LOCAL_GROUPS = 1 << 16
 # path is actually taken).
 DISPATCH_COUNT = 0
 
+# Distributed ORDER BY executions (range-partitioned sample sort).
+SORT_DISPATCH_COUNT = 0
+
+# Per-device sample count for the distributed sort's splitter estimation.
+_SORT_SAMPLES = 64
+
 
 class _Unsupported(Exception):
     """Plan/dtype/shape not handled by the SPMD path — fall back."""
@@ -634,9 +640,13 @@ def try_execute_plan(plan, session, executor: Callable) -> Optional[Table]:
     while isinstance(node, (Sort, Limit)):
         wrappers.append(node)
         node = node.child
-    if isinstance(node, Aggregate) or isinstance(node, (Scan, IndexScan)):
-        return None  # aggregates dispatch inside the executor; bare scans
-        # have no distributed work to do.
+    if isinstance(node, Aggregate):
+        return None  # aggregates dispatch inside the executor
+    if isinstance(node, (Scan, IndexScan)) and not (
+            wrappers and isinstance(wrappers[-1], Sort)
+            and _use_spmd_sort()):
+        return None  # a bare scan has no distributed work — unless a
+        # Sort sits above it (the distributed sample sort IS the work)
     try:
         _linearize(node)  # raises _Unsupported on non-chain shapes
     except _Unsupported:
@@ -646,8 +656,15 @@ def try_execute_plan(plan, session, executor: Callable) -> Optional[Table]:
         emit_distributed_fallback(session, "spmd_query",
                                   "leaf exceeds device chunk budget")
         return None
+    # Distributed ORDER BY: the innermost Sort runs ON the mesh as a
+    # range-partitioned sample sort, so the host gather receives sorted
+    # device ranges instead of unsorted rows (VERDICT r5 #4).
+    sort_orders: Tuple = ()
+    if wrappers and isinstance(wrappers[-1], Sort) and _use_spmd_sort():
+        sort_orders = tuple(wrappers[-1].orders)
+        wrappers = wrappers[:-1]
     try:
-        table = _run_stream(node, executor)
+        table = _run_stream(node, executor, sort_orders)
     except _Unsupported as e:
         from ..telemetry.logging import emit_distributed_fallback
         emit_distributed_fallback(session, "spmd_query", str(e))
@@ -660,6 +677,19 @@ def try_execute_plan(plan, session, executor: Callable) -> Optional[Table]:
         else:
             table = table.slice(0, min(w.n, table.num_rows))
     return table
+
+
+def _use_spmd_sort() -> bool:
+    """Backend cost decision for the distributed ORDER BY, mirroring
+    _use_routed_merge: on a single-host CPU mesh the sample-sort
+    collectives run on the silicon the host sort would use, so the host
+    sort wins; on real multi-chip the sort scales with devices and the
+    exchange rides ICI. HST_SPMD_SORT=on|off overrides (tests and the
+    multi-chip dryrun force it on)."""
+    mode = os.environ.get("HST_SPMD_SORT", "auto")
+    if mode in ("on", "off"):
+        return mode == "on"
+    return jax.devices()[0].platform != "cpu"
 
 
 def _dict_fingerprint(dic: Optional[np.ndarray]):
@@ -964,26 +994,41 @@ def _run(plan: Aggregate, executor) -> Table:
         return table
 
 
-def _run_stream(root, executor) -> Table:
+def _run_stream(root, executor, sort_orders=()) -> Table:
     """Row-returning SPMD execution of a {Filter, Project, Join}* chain:
     every device runs the stages on its shard, the host gathers each
-    device's valid rows and concatenates (VERDICT r3 #3a)."""
-    global DISPATCH_COUNT, LAST_CAP_ATTEMPTS
+    device's valid rows and concatenates (VERDICT r3 #3a). With
+    ``sort_orders``, the program additionally range-partitions and sorts
+    on device (sample sort), so the gathered rows arrive globally sorted
+    and the host does NO sort work."""
+    global DISPATCH_COUNT, SORT_DISPATCH_COUNT, LAST_CAP_ATTEMPTS
     LAST_CAP_ATTEMPTS = 1
     caps: Dict[int, Tuple[int, int]] = {}
     prep = _prepare(root, executor, caps)  # once; see _run
     out_names = [n for n in root.schema.names if n in prep.final_meta]
     if not out_names:
         raise _Unsupported("no output columns")
+    mode = "stream"
+    if sort_orders:
+        mode = "sort"
+        for n, _asc in sort_orders:
+            if n not in prep.final_meta:
+                raise _Unsupported(f"sort key {n!r} not in stream output")
+        # Initial per-(src, dst) send block: 2x the balanced share;
+        # sorted/skewed inputs overflow once and retry with the exact
+        # reported need (same mechanism as the exchange joins, keyed -1).
+        caps[-1] = (_round_up_pow2(
+            max(2 * prep.shard_rows // prep.n_dev, 128)), 0)
     out_pairs = tuple((n, prep.final_meta[n][2]) for n in out_names)
     n_xch = sum(1 for j in prep.joins.values() if j[0] == "x")
-    for attempt in range(_MAX_CAP_RETRIES * max(n_xch, 1) + 1):
+    for attempt in range(_MAX_CAP_RETRIES * (n_xch + 1) + 1):
         LAST_CAP_ATTEMPTS = attempt + 1
         descr = _StageDescr(prep.stages, prep.joins, prep.col_meta,
-                            (), out_pairs, dict(caps), prep.project_live)
+                            (), out_pairs, dict(caps), prep.project_live,
+                            sort_orders=tuple(sort_orders))
         out = _spmd_program(prep.sharded, prep.valid, prep.bcast, prep.xch,
                             mesh=prep.mesh, descr=descr, grouped=False,
-                            G=1, mode="stream")
+                            G=1, mode=mode)
         if _escalate_on_overflow(out, caps):
             continue
         mask = np.asarray(jax.device_get(out["omask"]))
@@ -997,6 +1042,8 @@ def _run_stream(root, executor) -> Table:
                     np.asarray(jax.device_get(out[f"ov:{n}"]))[mask])
             cols[n] = Column(dt, jnp.asarray(data), validity, dic)
         DISPATCH_COUNT += 1
+        if mode == "sort":
+            SORT_DISPATCH_COUNT += 1
         return Table(cols)
     raise _Unsupported("exchange join capacity escalation exhausted")
 
@@ -1049,7 +1096,7 @@ class _StageDescr:
     → (send capacity per destination, output slots per device)."""
 
     def __init__(self, stages, joins, col_meta, agg_specs, group_cols,
-                 caps, project_live):
+                 caps, project_live, sort_orders=()):
         self.stages = stages
         self.joins = joins
         self.col_meta = col_meta
@@ -1057,7 +1104,9 @@ class _StageDescr:
         self.group_cols = group_cols
         self.caps = caps
         self.project_live = project_live
+        self.sort_orders = tuple(sort_orders)
         parts: List = [group_cols, tuple(sorted(caps.items())),
+                       self.sort_orders,
                        tuple(sorted((i, tuple(sorted(v)))
                              for i, v in project_live.items()))]
         for i, (kind, node) in enumerate(stages):
@@ -1462,6 +1511,81 @@ def _spmd_program(sharded, valid, bcast, xch, *, mesh: Mesh,
                 table = Table(new_cols)
                 mask = out_mask
 
+        if mode == "sort":
+            # Distributed ORDER BY: range-partitioned sample sort (the
+            # TPU-native analogue of Spark's range-partitioned global
+            # sort consumed via exchange planning). Each device samples
+            # its primary sort key, splitters come back over one
+            # all_gather, rows route with one all_to_all, and each
+            # device's local lex sort finishes the job — the host then
+            # concatenates ALREADY-SORTED device ranges in rank order.
+            k0, asc0 = descr.sort_orders[0]
+            c0 = table.column(k0)
+            view = kernels._sort_key_view(c0.data, asc0)
+            if c0.validity is not None:
+                # Null placement (nulls first when ascending, last when
+                # descending) holds in view space by routing nulls to the
+                # extreme sentinel; the local sort places them exactly.
+                sentinel = _min_sentinel(view.dtype) if asc0 \
+                    else _max_sentinel(view.dtype)
+                view = jnp.where(c0.validity, view, sentinel)
+
+            order0 = kernels.lex_sort_indices(
+                [(~mask).astype(jnp.int32), view])
+            sorted_view = jnp.take(view, order0)
+            v_count = jnp.sum(mask.astype(jnp.int32))
+            k = _SORT_SAMPLES
+            pos = jnp.minimum((jnp.arange(k, dtype=jnp.int32) * v_count)
+                              // k, jnp.maximum(v_count - 1, 0))
+            samples = jnp.where(
+                v_count > 0, jnp.take(sorted_view, pos),
+                jnp.full(k, _max_sentinel(view.dtype), view.dtype))
+            all_samples = jax.lax.all_gather(
+                samples, DATA_AXIS).reshape(-1)
+            all_sorted = jnp.sort(all_samples)
+            total = n_dev * k
+            spl_pos = (jnp.arange(1, n_dev, dtype=jnp.int32) * total) \
+                // n_dev
+            splitters = jnp.take(all_sorted, spl_pos)
+            dst = jnp.searchsorted(splitters, view,
+                                   side="right").astype(jnp.int32)
+
+            arrays = {}
+            for n, nul in group_cols:
+                c = table.column(n)
+                arrays[f"d:{n}"] = c.data
+                if nul:
+                    arrays[f"v:{n}"] = c.validity \
+                        if c.validity is not None \
+                        else jnp.ones(c.data.shape[0], jnp.bool_)
+            cap = descr.caps[-1][0]
+            recv, rvalid, of, need = _a2a_exchange(
+                arrays, mask, dst, n_dev, cap)
+            out = dict(overflow_flags)
+            out["xof:-1"] = of
+            out["xneedc:-1"] = need
+            out["xneedo:-1"] = need
+
+            keys = [(~rvalid).astype(jnp.int32)]
+            ascs = [True]
+            for n, asc in descr.sort_orders:
+                vkey = f"v:{n}"
+                data = recv[f"d:{n}"]
+                if vkey in recv:
+                    keys.append(recv[vkey].astype(jnp.int32))
+                    ascs.append(asc)
+                    data = jnp.where(recv[vkey], data,
+                                     jnp.zeros((), data.dtype))
+                keys.append(data)
+                ascs.append(asc)
+            final = kernels.lex_sort_indices(keys, ascs)
+            out["omask"] = jnp.take(rvalid, final)
+            for n, nul in group_cols:
+                out[f"o:{n}"] = jnp.take(recv[f"d:{n}"], final, axis=0)
+                if nul:
+                    out[f"ov:{n}"] = jnp.take(recv[f"v:{n}"], final)
+            return out
+
         if mode == "stream":
             # group_cols doubles as ((name, nullable), ...) in stream mode.
             out = dict(overflow_flags)
@@ -1588,7 +1712,9 @@ def _spmd_program(sharded, valid, bcast, xch, *, mesh: Mesh,
 
     xof_keys = [f"{tag}:{i}" for i, j in descr.joins.items() if j[0] == "x"
                 for tag in ("xof", "xneedc", "xneedo")]
-    if mode == "stream":
+    if mode == "sort":
+        xof_keys += ["xof:-1", "xneedc:-1", "xneedo:-1"]
+    if mode in ("stream", "sort"):
         out_specs: Dict[str, P] = {"omask": P(DATA_AXIS)}
         for n, nul in group_cols:
             out_specs[f"o:{n}"] = P(DATA_AXIS)
