@@ -949,9 +949,17 @@ def _run(plan: Aggregate, executor) -> Table:
     G2 = 0  # sized from G on first iteration
     cap_attempts = 0
     gmof_retried = False
+    gof_retried = False
+    G_floor = 0  # raised by the one-shot local-capacity retry
     routed = _use_routed_merge(prep.mesh)
     while True:
+        # MAX_LOCAL_GROUPS is the INITIAL local-partial capacity, not a
+        # ceiling (VERDICT r5 #6: TPC-DS groups by customer/item keys blow
+        # 65k immediately): on overflow the program reports the exact
+        # worldwide need and one retry re-runs with that many slots
+        # (bounded by per-device rows — distinct groups can't exceed them).
         G = min(_out_rows(prep, caps), MAX_LOCAL_GROUPS)
+        G = min(max(G, G_floor), _out_rows(prep, caps))
         G2 = min(max(G2, G), n_dev * G)
         descr = _StageDescr(prep.stages, prep.joins, prep.col_meta,
                             agg_specs, group_cols, dict(caps),
@@ -972,7 +980,15 @@ def _run(plan: Aggregate, executor) -> Table:
             continue
         if grouped:
             if bool(np.asarray(jax.device_get(out["overflow"]))):
-                raise _Unsupported("local group capacity overflow")
+                if gof_retried:
+                    raise _Unsupported("local group capacity overflow "
+                                       "after exact-need retry")
+                gof_retried = True
+                need = int(np.asarray(jax.device_get(out["gneed"])))
+                G_floor = min(_round_up_pow2(need),
+                              _out_rows(prep, caps))
+                gmof_retried = False  # new G → new owner distribution
+                continue
             if routed and bool(np.asarray(jax.device_get(out["gmof"]))):
                 # One owner device holds more than G2 distinct groups
                 # (hash skew). The program reports the exact capacity
@@ -1629,6 +1645,9 @@ def _spmd_program(sharded, valid, bcast, xch, *, mesh: Mesh,
         n_rows = s_mask.shape[0]
         overflow = jax.lax.pmax((local_groups > G).astype(jnp.int32),
                                 DATA_AXIS)
+        # Exact worldwide need: a local-capacity overflow retries ONCE
+        # with this (distinct groups ≤ rows, so the retry always fits).
+        gneed = jax.lax.pmax(local_groups, DATA_AXIS)
 
         s_table = table.take(order)
         fold = {
@@ -1636,7 +1655,7 @@ def _spmd_program(sharded, valid, bcast, xch, *, mesh: Mesh,
             "min": lambda v: kernels.segment_min(v, gids, G),
             "max": lambda v: kernels.segment_max(v, gids, G),
         }
-        out = {"overflow": overflow}
+        out = {"overflow": overflow, "gneed": gneed}
         out.update(overflow_flags)
         for spec in agg_specs:
             for k, v in spec.partials(s_table, s_mask, fold).items():
@@ -1663,7 +1682,7 @@ def _spmd_program(sharded, valid, bcast, xch, *, mesh: Mesh,
         # rides ICI and the host stops being the merge bottleneck.
         if n_dev > 1 and routed_merge:
             send = {k: v for k, v in out.items()
-                    if k not in ("overflow", "gvalid")
+                    if k not in ("overflow", "gvalid", "gneed")
                     and not k.startswith(("xof:", "xneedc:",
                                           "xneedo:"))}
             gv = out["gvalid"]
@@ -1721,7 +1740,7 @@ def _spmd_program(sharded, valid, bcast, xch, *, mesh: Mesh,
             if nul:
                 out_specs[f"ov:{n}"] = P(DATA_AXIS)
     elif grouped:
-        out_specs = {"overflow": P(), "gmof": P()}
+        out_specs = {"overflow": P(), "gmof": P(), "gneed": P()}
         if mesh.devices.size > 1 and routed_merge:
             out_specs["gmneed"] = P()
         for spec in agg_specs:

@@ -1,0 +1,78 @@
+"""Grouped SPMD aggregation past the 65k local-capacity floor
+(VERDICT r5 #6: TPC-DS groups by customer/item keys — 65,536 local groups
+was a real-query ceiling that silently serialized the largest queries).
+
+MAX_LOCAL_GROUPS is now the INITIAL capacity: on overflow the program
+reports the exact worldwide need and ONE retry re-runs with that many
+segment slots (distinct groups never exceed per-device rows, so the
+retry always fits). The test runs >=1M distinct groups over the 8-device
+mesh and asserts the SPMD path is taken — no single-device fallback —
+with a pandas oracle on the results.
+"""
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+import hyperspace_tpu as hst
+from hyperspace_tpu.execution import spmd
+from hyperspace_tpu.plan.expr import col, count, sum_
+
+
+@pytest.fixture()
+def session(tmp_system_path):
+    return hst.Session(system_path=tmp_system_path)
+
+
+def test_million_groups_no_fallback(session, tmp_path):
+    rng = np.random.default_rng(31)
+    # 1.05M distinct keys guaranteed (arange) plus 150k repeats drawn
+    # from a hot range so the aggregation is not a pure identity.
+    k = np.concatenate([np.arange(1_050_000, dtype=np.int64),
+                        rng.integers(0, 1000, 150_000).astype(np.int64)])
+    rng.shuffle(k)
+    n = len(k)
+    v = rng.integers(0, 100, n).astype(np.int64)
+    t = pa.table({"k": pa.array(k), "v": pa.array(v)})
+    d = tmp_path / "big"
+    d.mkdir()
+    pq.write_table(t, str(d / "p.parquet"))
+
+    df = session.read.parquet(str(d)).group_by("k").agg(
+        sum_(col("v")).alias("sv"), count(None).alias("n"))
+    before = spmd.DISPATCH_COUNT
+    out = df.to_pandas()
+    assert spmd.DISPATCH_COUNT == before + 1, \
+        "grouped SPMD fell back below the group-capacity retry"
+
+    ref = (pd.DataFrame({"k": k, "v": v}).groupby("k")
+           .agg(sv=("v", "sum"), n=("v", "size")).reset_index())
+    assert len(out) == len(ref) >= 950_000
+    got = out.sort_values("k").reset_index(drop=True)
+    want = ref.sort_values("k").reset_index(drop=True)
+    pd.testing.assert_series_equal(got["k"], want["k"])
+    pd.testing.assert_series_equal(got["sv"], want["sv"],
+                                   check_dtype=False)
+    pd.testing.assert_series_equal(got["n"], want["n"],
+                                   check_dtype=False)
+
+
+def test_overflow_retry_is_single_shot(session, tmp_path):
+    """A shape just past the floor: the retry fires once and succeeds
+    (observable through the result; a second overflow would raise and
+    fall back, failing the dispatch assertion)."""
+    n = 150_000
+    k = np.arange(n, dtype=np.int64)  # every row its own group per shard
+    t = pa.table({"k": pa.array(k),
+                  "v": pa.array(np.ones(n, dtype=np.int64))})
+    d = tmp_path / "edge"
+    d.mkdir()
+    pq.write_table(t, str(d / "p.parquet"))
+    df = session.read.parquet(str(d)).group_by("k").agg(
+        count(None).alias("n"))
+    before = spmd.DISPATCH_COUNT
+    out = df.to_pandas()
+    assert spmd.DISPATCH_COUNT == before + 1
+    assert len(out) == n and (out["n"] == 1).all()
