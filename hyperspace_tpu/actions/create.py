@@ -22,6 +22,7 @@ import numpy as np
 import pyarrow.parquet as pq
 
 from ..exceptions import HyperspaceException
+from ..util import file_utils
 from ..execution.columnar import Column, Table, write_parquet
 from ..index.constants import IndexConstants, States
 from ..index.data_manager import IndexDataManager
@@ -93,7 +94,7 @@ class CreateActionBase(Action):
         num_buckets = self._num_buckets()
         row_group_size = self.session.hs_conf.index_row_group_size()
         out_dir = self.data_manager.get_path(version)
-        os.makedirs(out_dir, exist_ok=True)
+        file_utils.makedirs(out_dir)
         if self._use_mesh_build(table):
             self._write_index_files_distributed(
                 table, indexed, num_buckets, out_dir, row_group_size)
@@ -137,7 +138,7 @@ class CreateActionBase(Action):
             lineage_ids = [file_id_tracker.add_file(*_file_triple(f))
                            for f in files]
         out_dir = self.data_manager.get_path(version)
-        os.makedirs(out_dir, exist_ok=True)
+        file_utils.makedirs(out_dir)
         build_sorted_buckets_chunked(
             files, indexed + included, indexed,
             self._num_buckets(), chunk_rows, out_dir,

@@ -23,6 +23,8 @@ import pyarrow as pa
 import pyarrow.parquet as pq
 
 from ..exceptions import HyperspaceException
+from ..index import data_store
+from ..util import file_utils
 from ..execution.columnar import read_parquet
 from ..index.constants import States
 from ..index.log_entry import (Content, DataSkippingIndex, FileIdTracker,
@@ -118,11 +120,12 @@ def sketch_arrow_schema(relation_schema: Schema,
 
 def write_sketch_table(rows: Dict[str, list], arrow_schema: pa.Schema,
                        out_dir: str) -> str:
-    os.makedirs(out_dir, exist_ok=True)
+    file_utils.makedirs(out_dir)
     table = pa.table({f.name: pa.array(rows[f.name], type=f.type)
                       for f in arrow_schema}, schema=arrow_schema)
     path = os.path.join(out_dir, SKETCH_FILE_NAME)
-    pq.write_table(table, path)
+    fs, norm = data_store.fs_and_path(path)
+    pq.write_table(table, norm, filesystem=fs)
     return path
 
 
@@ -256,7 +259,9 @@ class RefreshDataSkippingIncrementalAction(RefreshDataSkippingAction):
         tracker = self._seeded_tracker()
         sketch_list = prev.derivedDataset.sketches
         deleted_names = {f.name for f in self.deleted_files}
-        old = pq.read_table(_sketch_file(prev))
+        _sf = _sketch_file(prev)
+        _fs, _sfp = data_store.fs_and_path(_sf)
+        old = pq.read_table(_sfp, filesystem=_fs)
         keep_mask = [name not in deleted_names
                      for name in old.column(FILE_COL).to_pylist()]
         kept = old.filter(pa.array(keep_mask))
@@ -271,8 +276,10 @@ class RefreshDataSkippingIncrementalAction(RefreshDataSkippingAction):
 
         version = self._new_version()
         out_dir = self.data_manager.get_path(version)
-        os.makedirs(out_dir, exist_ok=True)
-        pq.write_table(merged, os.path.join(out_dir, SKETCH_FILE_NAME))
+        file_utils.makedirs(out_dir)
+        _mp = os.path.join(out_dir, SKETCH_FILE_NAME)
+        _fs2, _mpn = data_store.fs_and_path(_mp)
+        pq.write_table(merged, _mpn, filesystem=_fs2)
         index_content = Content.from_directory(out_dir, tracker)
         source = self._build_source(self.relation, Scan(self.relation), tracker)
         entry = IndexLogEntry.create(

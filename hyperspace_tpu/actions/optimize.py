@@ -17,6 +17,7 @@ from __future__ import annotations
 from collections import defaultdict
 from typing import Dict, List, Optional, Tuple
 
+from ..util import file_utils
 from ..exceptions import HyperspaceException, NoChangesException
 from ..execution.columnar import read_parquet, write_parquet
 from ..index.constants import IndexConstants, States
@@ -93,7 +94,7 @@ class OptimizeAction(ExistingIndexActionBase):
         compact, skipped = self._files_to_optimize()
         version = self._new_version()
         out_dir = self.data_manager.get_path(version)
-        os.makedirs(out_dir, exist_ok=True)
+        file_utils.makedirs(out_dir)
         row_group_size = self.session.hs_conf.index_row_group_size()
         new_paths: List[str] = []
         for bucket in sorted(compact):

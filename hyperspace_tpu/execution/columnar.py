@@ -353,15 +353,28 @@ def _concat_string_columns(cols: List[Column]) -> Column:
 # Parquet IO.
 # ---------------------------------------------------------------------------
 
+def _resolve_files(files: Sequence[str]):
+    """(filesystem-or-None, normalized paths) — the multi-path form of
+    data_store.fs_and_path, delegating to the same store resolution."""
+    if not files:
+        return None, list(files)
+    from ..index import data_store
+    store = data_store.store_for_path(files[0])
+    if store is None:
+        return None, list(files)
+    return store.filesystem(), [store.normalize(f) for f in files]
+
+
 def read_parquet(files: Sequence[str], columns: Optional[Sequence[str]] = None,
                  fmt: str = "parquet", filters=None) -> Table:
     if not files:
         raise HyperspaceException("read_parquet: no files")
     if fmt == "parquet":
+        fs, files = _resolve_files(files)
         read_cols = list(columns) if columns else None
         flatten_select = None
         if columns:
-            top_level = set(pq.read_schema(files[0]).names)
+            top_level = set(pq.read_schema(files[0], filesystem=fs).names)
             if any(c not in top_level for c in columns):
                 # Dotted struct leaves: read each leaf's root struct column,
                 # flatten after read, then select the exact leaves (pyarrow's
@@ -373,7 +386,8 @@ def read_parquet(files: Sequence[str], columns: Optional[Sequence[str]] = None,
                     if root not in roots:
                         roots.append(root)
                 read_cols, flatten_select = roots, list(columns)
-        at = pq.read_table(list(files), columns=read_cols, filters=filters)
+        at = pq.read_table(list(files), columns=read_cols, filters=filters,
+                           filesystem=fs)
         if flatten_select is not None:
             while any(pa.types.is_struct(f.type) for f in at.schema):
                 at = at.flatten()
@@ -426,7 +440,8 @@ def read_parquet(files: Sequence[str], columns: Optional[Sequence[str]] = None,
 
 @functools.lru_cache(maxsize=65536)
 def _file_row_count(path: str, size: int, mtime_ns: int) -> int:
-    return pq.ParquetFile(path).metadata.num_rows
+    fs, paths = _resolve_files([path])
+    return pq.ParquetFile(paths[0], filesystem=fs).metadata.num_rows
 
 
 def parquet_row_counts(files: Sequence[str]) -> List[int]:
@@ -435,10 +450,17 @@ def parquet_row_counts(files: Sequence[str]) -> List[int]:
     and re-opening every footer per query would tax the hot cached path
     (index files are immutable, so staleness means a new path/version)."""
     import os
+
+    from ..index import data_store
     out = []
     for f in files:
-        st = os.stat(f)
-        out.append(_file_row_count(f, st.st_size, st.st_mtime_ns))
+        store = data_store.store_for_path(f)
+        if store is None:
+            st = os.stat(f)
+            out.append(_file_row_count(f, st.st_size, st.st_mtime_ns))
+        else:
+            _, size, mtime_ms = store.file_info(f)
+            out.append(_file_row_count(f, size, mtime_ms))
     return out
 
 
@@ -463,9 +485,10 @@ def iter_parquet_chunks(files: Sequence[str], columns: Optional[Sequence[str]],
         batch, batch_rows, provenance = [], 0, []
         return out
 
+    fs, files = _resolve_files(list(files))
     read_cols = list(columns) if columns else None
     for fi, path in enumerate(files):
-        pf = pq.ParquetFile(path)
+        pf = pq.ParquetFile(path, filesystem=fs)
         for rg in range(pf.num_row_groups):
             t = pf.read_row_group(rg, columns=read_cols)
             start = 0
@@ -495,7 +518,8 @@ def iter_dataset_chunks(files: Sequence[str],
     import pyarrow.dataset as pa_ds
 
     expr = pq.filters_to_expression(filters) if filters is not None else None
-    ds = pa_ds.dataset(list(files), format="parquet")
+    fs, files = _resolve_files(list(files))
+    ds = pa_ds.dataset(list(files), format="parquet", filesystem=fs)
     batch: List[pa.Table] = []
     batch_rows = 0
     for rb in ds.scanner(columns=list(columns) if columns else None,
@@ -518,7 +542,9 @@ def iter_dataset_chunks(files: Sequence[str],
 
 
 def write_parquet(table: Table, path: str, row_group_size: Optional[int] = None) -> None:
-    pq.write_table(table.to_arrow(), path, row_group_size=row_group_size)
+    fs, paths = _resolve_files([path])
+    pq.write_table(table.to_arrow(), paths[0],
+                   row_group_size=row_group_size, filesystem=fs)
 
 
 def empty_table(schema: "Schema") -> Table:

@@ -1,0 +1,266 @@
+"""Object-store residency for index DATA files (VERDICT r5 #7).
+
+``log_store.py`` proved the OP LOG rename-free; this module extends the
+same stance to the index data files and the collection manager's
+directory-existence gates, so the ENTIRE index lifecycle
+(create/refresh/optimize/vacuum and the query-side reads) can run
+against an object store. The reference runs wholly on HDFS-compatible
+stores incl. ABFS/S3A (index/IndexLogManager.scala:33,
+docs/_docs/14-toh-indexes-on-the-lake.md); the TPU-native runtime
+targets object stores directly through pyarrow's ``filesystem=``
+parameter, which accepts any fsspec-style filesystem — so a deployment
+backs a scheme with one ``register_scheme`` call and every parquet
+write, leaf listing, existence gate, and recursive delete routes
+through it. Nothing in the data path needs rename: data files are
+immutable puts under fresh ``v__=<n>/`` names, listings are prefix
+LISTs, deletes are prefix deletes.
+
+Paths without a scheme (or ``file://``) keep the local-filesystem fast
+path untouched. The built-in ``hsmem://`` scheme (fsspec's memory
+filesystem + a lock-guarded conditional-put log adapter) is the test
+double proving the whole lifecycle runs store-only — the analogue of
+``log_store.InMemoryObjectStore`` for the data side.
+"""
+
+from __future__ import annotations
+
+import os
+import posixpath
+import threading
+from typing import Callable, Dict, List, Optional, Tuple
+
+from ..exceptions import HyperspaceException
+
+
+class DataStore:
+    """Index-data storage contract: immutable file puts + prefix lists.
+
+    ``filesystem()`` returns an fsspec-style filesystem handed straight
+    to pyarrow (``pq.write_table(..., filesystem=...)``); the remaining
+    operations cover the non-parquet surface (existence gates, leaf
+    listing for Content fingerprints, recursive delete for vacuum)."""
+
+    def filesystem(self):
+        raise NotImplementedError
+
+    def normalize(self, path: str) -> str:
+        """The path as ``filesystem()`` expects it (scheme stripped)."""
+        raise NotImplementedError
+
+    def is_dir(self, path: str) -> bool:
+        raise NotImplementedError
+
+    def list_dir(self, path: str) -> List[str]:
+        """Names (not paths) directly under ``path``."""
+        raise NotImplementedError
+
+    def list_leaf_files(self, path: str) -> List[str]:
+        """All regular files under ``path`` recursively — SCHEME-QUALIFIED
+        full paths (they round-trip into log entries and back into
+        reads), sorted, hidden names excluded."""
+        raise NotImplementedError
+
+    def file_info(self, path: str) -> Tuple[str, int, int]:
+        """(path, size, mtime_ms) — the signature triple."""
+        raise NotImplementedError
+
+    def makedirs(self, path: str) -> None:
+        """Create a directory marker (no-op on flat object stores)."""
+        raise NotImplementedError
+
+    def delete_recursively(self, path: str) -> None:
+        raise NotImplementedError
+
+
+class InMemoryDataStore(DataStore):
+    """fsspec memory filesystem behind ``hsmem://`` paths. The memory
+    filesystem is process-global (fsspec singleton), so distinct tests
+    isolate by path root exactly as they do with tmp dirs."""
+
+    scheme = "hsmem"
+
+    def __init__(self):
+        import fsspec
+        self._fs = fsspec.filesystem("memory")
+
+    def filesystem(self):
+        return self._fs
+
+    def normalize(self, path: str) -> str:
+        if path.startswith(self.scheme + "://"):
+            return "/" + path[len(self.scheme) + 3:].lstrip("/")
+        return path
+
+    def _qualify(self, norm: str) -> str:
+        return f"{self.scheme}://{norm.lstrip('/')}"
+
+    def is_dir(self, path: str) -> bool:
+        p = self.normalize(path)
+        try:
+            return self._fs.isdir(p)
+        except FileNotFoundError:
+            return False
+
+    def list_dir(self, path: str) -> List[str]:
+        p = self.normalize(path)
+        if not self.is_dir(p):
+            return []
+        return sorted(posixpath.basename(e.rstrip("/"))
+                      for e in self._fs.ls(p, detail=False))
+
+    def list_leaf_files(self, path: str) -> List[str]:
+        p = self.normalize(path)
+        if not self._fs.exists(p):
+            return []
+        root = p.strip("/")
+        out = []
+        for f in self._fs.find(p):
+            # Hidden-name filter applies only BELOW the listing root
+            # (matching the local os.walk behavior — an ancestor segment
+            # like '_data' in the index root must not hide everything).
+            rel = f.strip("/")
+            if rel.startswith(root):
+                rel = rel[len(root):].lstrip("/")
+            if any(s.startswith((".", "_")) for s in rel.split("/")):
+                continue
+            out.append(self._qualify(f))
+        return sorted(out)
+
+    def file_info(self, path: str) -> Tuple[str, int, int]:
+        p = self.normalize(path)
+        info = self._fs.info(p)
+        created = info.get("created") or 0
+        try:
+            mtime_ms = int(float(created) * 1000)
+        except (TypeError, ValueError):
+            mtime_ms = 0
+        return (path, int(info.get("size") or 0), mtime_ms)
+
+    def makedirs(self, path: str) -> None:
+        self._fs.makedirs(self.normalize(path), exist_ok=True)
+
+    def delete_recursively(self, path: str) -> None:
+        p = self.normalize(path)
+        if self._fs.exists(p):
+            self._fs.rm(p, recursive=True)
+
+
+_SCHEME_FACTORIES: Dict[str, Callable[[], DataStore]] = {}
+_STORE_CACHE: Dict[str, DataStore] = {}
+_LOCK = threading.Lock()
+
+
+def register_scheme(scheme: str, factory: Callable[[], DataStore]) -> None:
+    """Back ``scheme://`` index-data paths with a custom DataStore."""
+    _SCHEME_FACTORIES[scheme.lower()] = factory
+
+
+def scheme_of(path: str) -> Optional[str]:
+    if "://" not in path:
+        return None
+    scheme = path.split("://", 1)[0].lower()
+    return None if scheme in ("file", "") else scheme
+
+
+def store_for_path(path: str) -> Optional[DataStore]:
+    """The DataStore for a scheme-qualified path, or None for local
+    paths (the default fast path — untouched local-FS behavior)."""
+    scheme = scheme_of(path)
+    if scheme is None:
+        return None
+    with _LOCK:
+        store = _STORE_CACHE.get(scheme)
+        if store is None:
+            factory = _SCHEME_FACTORIES.get(scheme)
+            if factory is None:
+                raise HyperspaceException(
+                    f"No DataStore registered for scheme {scheme!r}; "
+                    "register one with hyperspace_tpu.index.data_store."
+                    "register_scheme (pyarrow-compatible fsspec filesystem "
+                    "+ prefix listing — see the module docstring)")
+            store = factory()
+            _STORE_CACHE[scheme] = store
+    return store
+
+
+def fs_and_path(path: str):
+    """(filesystem-or-None, normalized path) for pyarrow IO calls.
+    Local paths return (None, path): pyarrow resolves them natively."""
+    store = store_for_path(path)
+    if store is None:
+        return None, path
+    return store.filesystem(), store.normalize(path)
+
+
+# ---------------------------------------------------------------------------
+# The built-in in-memory scheme + its op-log adapter.
+# ---------------------------------------------------------------------------
+
+class _MemConditionalPutLogStore:
+    """Conditional-put LogStore over the same fsspec memory filesystem
+    the data side uses, so a single ``hsmem://`` tree carries the whole
+    index (log + data). The lock stands in for the store's conditional
+    PUT (S3 If-None-Match: *) — this is the test double; real stores
+    register adapters speaking their native precondition."""
+
+    def __init__(self):
+        import fsspec
+        self._fs = fsspec.filesystem("memory")
+        self._lock = threading.Lock()
+
+    @staticmethod
+    def _norm(path: str) -> str:
+        return "/" + path[len("hsmem://"):].lstrip("/") \
+            if path.startswith("hsmem://") else path
+
+    def put_if_absent(self, path: str, data: str) -> bool:
+        p = self._norm(path)
+        with self._lock:
+            if self._fs.exists(p):
+                return False
+            with self._fs.open(p, "w") as f:
+                f.write(data)
+            return True
+
+    def put_overwrite(self, path: str, data: str) -> None:
+        p = self._norm(path)
+        with self._lock:
+            with self._fs.open(p, "w") as f:
+                f.write(data)
+
+    def read(self, path: str) -> Optional[str]:
+        p = self._norm(path)
+        with self._lock:
+            if not self._fs.exists(p) or self._fs.isdir(p):
+                return None
+            with self._fs.open(p, "r") as f:
+                return f.read()
+
+    def list_numeric_ids(self, dirpath: str) -> List[int]:
+        p = self._norm(dirpath)
+        with self._lock:
+            if not self._fs.exists(p):
+                return []
+            out = []
+            for e in self._fs.ls(p, detail=False):
+                tail = posixpath.basename(e.rstrip("/"))
+                if tail.isdigit():
+                    out.append(int(tail))
+            return out
+
+    def delete(self, path: str) -> bool:
+        p = self._norm(path)
+        with self._lock:
+            if self._fs.exists(p):
+                self._fs.rm(p)
+            return True
+
+
+def _register_builtin() -> None:
+    from . import log_store
+    register_scheme(InMemoryDataStore.scheme, InMemoryDataStore)
+    log_store.register_scheme(InMemoryDataStore.scheme,
+                              lambda path: _MemConditionalPutLogStore())
+
+
+_register_builtin()

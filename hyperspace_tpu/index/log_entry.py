@@ -125,6 +125,14 @@ class Directory:
             dir_path = dir_path.rstrip("/") or "/"
             if dir_path in dir_nodes:
                 return dir_nodes[dir_path]
+            if "://" in dir_path and "/" not in dir_path.split("://", 1)[1]:
+                # Object-store scheme root ("hsmem://bucket"): one opaque
+                # child of "/" so the scheme survives the tree round-trip
+                # (os.path.dirname would collapse the double slash).
+                node = Directory(name=dir_path)
+                root.subDirs.append(node)
+                dir_nodes[dir_path] = node
+                return node
             parent = node_for(os.path.dirname(dir_path))
             node = Directory(name=os.path.basename(dir_path))
             parent.subDirs.append(node)
@@ -132,7 +140,8 @@ class Directory:
             return node
 
         for p in sorted(paths):
-            p = os.path.abspath(p)
+            if "://" not in p:
+                p = os.path.abspath(p)  # store paths are already rooted
             # Stat exactly once so the tracker key and the recorded FileInfo
             # can never disagree if the file changes mid-listing.
             full, size, mtime = file_utils.file_info_triple(p)
@@ -167,7 +176,11 @@ class Content:
         """Yield (full_path, FileInfo) for every leaf file in the tree."""
 
         def rec(node: Directory, prefix: str):
-            base = os.path.join(prefix, node.name) if node.name != "/" else "/"
+            if "://" in node.name:
+                base = node.name  # object-store scheme root is absolute
+            else:
+                base = os.path.join(prefix, node.name) \
+                    if node.name != "/" else "/"
             for f in node.files:
                 full = f.name if os.path.isabs(f.name) else os.path.join(base, f.name)
                 yield full, f

@@ -181,3 +181,9 @@ def store_for_path(index_path: str) -> LogStore:
                 "docstring for the exact contract)")
         return factory(index_path)
     return LocalFsLogStore()
+
+
+# Built-in scheme registrations (hsmem:// — the in-memory data+log test
+# double) live in data_store; importing it here makes them available the
+# moment any store resolution happens.
+from . import data_store  # noqa: E402,F401  (registration side effect)

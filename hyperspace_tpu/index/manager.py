@@ -13,6 +13,7 @@ import os
 from typing import List, Optional
 
 from ..exceptions import HyperspaceException
+from ..util import file_utils
 from .cache import CreationTimeBasedIndexCache
 from .constants import IndexConstants, States
 from .data_manager import IndexDataManager
@@ -74,7 +75,7 @@ class IndexCollectionManager(IndexManager):
 
     def _log_manager(self, name: str, must_exist: bool = True) -> IndexLogManager:
         path = self._index_path(name)
-        if must_exist and not os.path.isdir(path):
+        if must_exist and not file_utils.is_dir(path):
             raise HyperspaceException(f"Index with name {name} could not be found.")
         return IndexLogManager(path)
 
@@ -166,11 +167,12 @@ class IndexCollectionManager(IndexManager):
 
     def _index_names(self) -> List[str]:
         system_path = self._path_resolver.system_path
-        if not os.path.isdir(system_path):
+        if not file_utils.is_dir(system_path):
             return []
         return sorted(
-            n for n in os.listdir(system_path)
-            if os.path.isdir(os.path.join(system_path, n, IndexConstants.HYPERSPACE_LOG)))
+            n for n in file_utils.list_dir(system_path)
+            if file_utils.is_dir(
+                os.path.join(system_path, n, IndexConstants.HYPERSPACE_LOG)))
 
     def get_indexes(self, states: Optional[List[str]] = None) -> List[IndexLogEntry]:
         out = []

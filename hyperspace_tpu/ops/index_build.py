@@ -22,6 +22,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..execution.columnar import Table
+from ..index import data_store
 from . import kernels
 
 
@@ -204,9 +205,10 @@ def _chunked_spill_and_merge(files, columns, indexed_cols, num_buckets,
         lo = 0
         for i, b in enumerate(batch):
             hi = lo + rows_of[b]
-            pq.write_table(at.slice(lo, hi - lo),
-                           os.path.join(out_dir, bucket_file_name(b)),
-                           row_group_size=row_group_size)
+            _dst = os.path.join(out_dir, bucket_file_name(b))
+            _fs, _dstp = data_store.fs_and_path(_dst)
+            pq.write_table(at.slice(lo, hi - lo), _dstp,
+                           row_group_size=row_group_size, filesystem=_fs)
             lo = hi
 
     batch: List[int] = []

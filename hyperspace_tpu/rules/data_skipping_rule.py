@@ -264,7 +264,9 @@ def _load_sketch_table(entry: IndexLogEntry) -> dict:
         return cached
     files = [f for f in entry.content.files
              if os.path.basename(f) == SKETCH_FILE_NAME]
-    t = pq.read_table(files[0])
+    from ..index import data_store
+    _fs, _p0 = data_store.fs_and_path(files[0])
+    t = pq.read_table(_p0, filesystem=_fs)
     table = {name: t.column(name).to_pylist() for name in t.column_names}
     if len(_SKETCH_CACHE) >= 8:  # keep at most a handful of entries alive.
         _SKETCH_CACHE.pop(next(iter(_SKETCH_CACHE)))

@@ -62,7 +62,20 @@ def read_contents(path: str) -> str:
         return f.read()
 
 
+def _data_store_for(path: str):
+    """The registered DataStore for scheme-qualified paths (None for
+    local). Lazy import: data_store sits above this module."""
+    if "://" not in path:
+        return None
+    from ..index import data_store
+    return data_store.store_for_path(path)
+
+
 def delete_recursively(path: str) -> None:
+    store = _data_store_for(path)
+    if store is not None:
+        store.delete_recursively(path)
+        return
     if os.path.isdir(path):
         shutil.rmtree(path)
     elif os.path.exists(path):
@@ -76,6 +89,9 @@ def list_leaf_files(path: str) -> List[str]:
     data-path filter (PathUtils.DataPathFilter), except that '_hyperspace_log'
     style metadata never sits under data dirs anyway.
     """
+    store = _data_store_for(path)
+    if store is not None:
+        return store.list_leaf_files(path)
     out: List[str] = []
     for root, dirs, files in os.walk(path):
         dirs[:] = sorted(d for d in dirs if not _is_hidden(d))
@@ -91,5 +107,36 @@ def _is_hidden(name: str) -> bool:
 
 def file_info_triple(path: str) -> tuple:
     """(full_path, size, mtime_ms) for a file, the signature triple."""
+    store = _data_store_for(path)
+    if store is not None:
+        return store.file_info(path)
     st = os.stat(path)
     return (os.path.abspath(path), st.st_size, int(st.st_mtime * 1000))
+
+
+def is_dir(path: str) -> bool:
+    """Directory/prefix existence across local FS and data stores."""
+    store = _data_store_for(path)
+    if store is not None:
+        return store.is_dir(path)
+    return os.path.isdir(path)
+
+
+def list_dir(path: str) -> List[str]:
+    """Names directly under ``path`` across local FS and data stores."""
+    store = _data_store_for(path)
+    if store is not None:
+        return store.list_dir(path)
+    if not os.path.isdir(path):
+        return []
+    return sorted(os.listdir(path))
+
+
+def makedirs(path: str) -> None:
+    """mkdir -p across local FS and data stores (a no-op marker on flat
+    object stores)."""
+    store = _data_store_for(path)
+    if store is not None:
+        store.makedirs(path)
+        return
+    os.makedirs(path, exist_ok=True)
