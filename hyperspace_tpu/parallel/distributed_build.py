@@ -124,6 +124,78 @@ def _exchange_and_sort(arrays: Dict[str, jax.Array], valid: jax.Array,
 # distributed path actually ran).
 DISPATCH_COUNT = 0
 
+# Cross-process dictionary unions performed (the multihost dryrun asserts
+# the string path actually exercised it).
+DICT_UNION_COUNT = 0
+
+
+def _union_string_dictionaries(table: Table) -> Table:
+    """Global dictionary union for multi-process builds (VERDICT r5 #8).
+
+    Each process encodes its STRING columns against its own local
+    dictionary; shipping those codes through the exchange would let codes
+    from different dictionaries meet. Before the exchange, every process
+    contributes its dictionaries ONCE host-side (two small allgathers per
+    column: sizes, then padded utf-8 blobs — the analogue of Spark
+    shipping real strings through its shuffle, paid once per build
+    instead of per row), the union is sorted into the one global
+    dictionary, and local codes re-encode into it. Single-process runs
+    return the table untouched."""
+    if jax.process_count() <= 1:
+        return table
+    if not any(table.column(n).dtype == STRING for n in table.names):
+        return table
+    global DICT_UNION_COUNT
+    from jax.experimental import multihost_utils as mhu
+
+    new_cols = {}
+    for name in table.names:
+        col = table.column(name)
+        if col.dtype != STRING:
+            new_cols[name] = col
+            continue
+        words = [str(w) for w in col.dictionary.tolist()]
+        # Length-prefixed encoding (NOT a sentinel separator: a value may
+        # legally contain any byte, and an empty dictionary entry must
+        # survive the round trip).
+        encoded = [w.encode("utf-8") for w in words]
+        lengths = np.array([len(b) for b in encoded], np.int64)
+        blob = np.frombuffer(b"".join(encoded), np.uint8) \
+            if encoded else np.zeros(0, np.uint8)
+        dims = np.asarray(mhu.process_allgather(
+            np.array([len(words), blob.size], np.int64)))
+        dims = dims.reshape(-1, 2)
+        max_words = max(int(dims[:, 0].max()), 1)
+        max_bytes = max(int(dims[:, 1].max()), 1)
+        lengths_p = np.zeros(max_words, np.int64)
+        lengths_p[:lengths.size] = lengths
+        blob_p = np.zeros(max_bytes, np.uint8)
+        blob_p[:blob.size] = blob
+        all_lengths = np.asarray(mhu.process_allgather(lengths_p))
+        all_blobs = np.asarray(mhu.process_allgather(blob_p))
+        union = set()
+        for i in range(dims.shape[0]):
+            nw = int(dims[i, 0])
+            off = 0
+            for ln in all_lengths[i][:nw]:
+                ln = int(ln)
+                union.add(all_blobs[i][off:off + ln]
+                          .tobytes().decode("utf-8"))
+                off += ln
+        global_dict = np.array(sorted(union), dtype=object)
+        remap = np.searchsorted(global_dict, np.array(words, dtype=object)) \
+            if words else np.zeros(0, np.int64)
+        remap_dev = jnp.asarray(remap.astype(np.int32))
+        if remap.size:
+            data = jnp.where(col.data >= 0,
+                             jnp.take(remap_dev, jnp.maximum(col.data, 0)),
+                             col.data)
+        else:
+            data = col.data
+        new_cols[name] = Column(STRING, data, col.validity, global_dict)
+    DICT_UNION_COUNT += 1
+    return Table(new_cols)
+
 
 def distributed_build_sorted_buckets(
         table: Table, indexed_cols: Sequence[str], num_buckets: int,
@@ -147,6 +219,9 @@ def distributed_build_sorted_buckets(
 
     mesh = mesh or make_mesh()
     n_dev = mesh.devices.size
+    # Multi-process: string codes must share ONE dictionary before any
+    # code crosses the exchange (no-op single-process / no strings).
+    table = _union_string_dictionaries(table)
     rows = table.num_rows
 
     # Column data is shipped under "d:<name>"; a nullable column's validity

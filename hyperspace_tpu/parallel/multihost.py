@@ -26,10 +26,14 @@ every process compiles identical collectives), and the bucket exchange
 crosses processes with row conservation, host-hash bucket agreement, and
 single ownership verified (tests/test_multihost.py).
 
-Known limitation: STRING columns currently carry per-process dictionaries;
-a cross-process build with string indexed columns would need a global
-dictionary union first (the exchange ships codes, and codes from
-different dictionaries must not meet). The dryrun pins the numeric path.
+STRING columns build across processes through a global dictionary union
+(distributed_build._union_string_dictionaries): before the exchange,
+every process contributes its local dictionaries host-side (two small
+allgathers per column), the sorted union becomes the one shared
+dictionary, and local codes re-encode into it — so the exchange only
+ever moves codes from a single code space. The dryrun pins both the
+numeric path and a string indexed column with per-process-disjoint
+value sets (__graft_entry__.dryrun_multihost).
 """
 
 from __future__ import annotations
