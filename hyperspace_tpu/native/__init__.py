@@ -1,10 +1,19 @@
 """Native host-ops runtime: builds and binds hst_native.cpp via ctypes.
 
 The shared library is compiled once per source hash into
-``~/.cache/hyperspace_tpu/native/`` (g++ -O3) and loaded with ctypes; when
-no compiler is available (or HST_NATIVE=off), every entry point falls back
-to a vectorized numpy implementation with identical semantics, so callers
-use this module unconditionally.
+``~/.cache/hyperspace_tpu/native/`` (g++ -O3) and loaded with ctypes;
+every entry point has a vectorized numpy implementation with identical
+semantics, so callers use this module unconditionally.
+
+Dispatch policy (round 5, measured — see BASELINE.md §"Native C++ probe
+path"): the sketch-PROBE entry points (``bloom_probe_*``,
+``minmax_prune*``) default to the NUMPY implementation — it measured
+2-3x faster at every lake scale up to 50k files, because the arrays are
+tiny and ctypes call + bitmap marshalling dominates — and use C++ only
+when ``HST_NATIVE_PROBE=on`` (probe_native_enabled). The Avro codec
+(``avro_decode_block``) always prefers native when built: byte-level
+varint decode has no numpy equivalent. ``HST_NATIVE=off`` still
+disables the build entirely.
 
 Entry points (all host-side scan-planning hot loops):
 
@@ -90,6 +99,22 @@ def available() -> bool:
     return get_lib() is not None
 
 
+def probe_native_enabled() -> bool:
+    """The C++ sketch-PROBE loops are OPT-IN (HST_NATIVE_PROBE=on).
+
+    Measured round 5 at 1,600-50,000 synthetic files x 1-16 predicates:
+    the numpy fallback is 2-3x FASTER than the ctypes-dispatched C++
+    probe at every lake scale this corpus can generate — the arrays are
+    small enough (<=400 KB at 50k files) that numpy's vectorized
+    compares are already memory-bound-optimal and the per-call ctypes
+    marshalling dominates the native path. numpy is therefore the
+    default; the C++ loops remain for deployments that profile a win on
+    their own shapes. The Avro codec is NOT gated — its byte-level
+    varint decode has no vectorized numpy equivalent and native genuinely
+    wins there."""
+    return os.environ.get("HST_NATIVE_PROBE", "off").lower() == "on"
+
+
 _OPS = {"EqualTo": 0, "LessThan": 1, "LessThanOrEqual": 2,
         "GreaterThan": 3, "GreaterThanOrEqual": 4}
 
@@ -141,7 +166,7 @@ def bloom_probe_prepared(buf: np.ndarray, valid: np.ndarray, value,
     bitset proves the literal absent; missing bitsets keep the file."""
     n, stride = buf.shape
     positions = bloom_positions(value, dtype, num_bits, num_hashes)
-    lib = get_lib()
+    lib = get_lib() if probe_native_enabled() else None
     out = np.zeros(n, dtype=np.uint8)
     if lib is not None:
         lib.hst_bloom_probe_many(
@@ -265,7 +290,7 @@ def minmax_prune_prepared(prep: Tuple, op: str, value,
 
     lo, hi, has = prep
     n = lo.shape[0]
-    lib = get_lib()
+    lib = get_lib() if probe_native_enabled() else None
     out = np.zeros(n, dtype=np.uint8)
     if dtype in (FLOAT32, FLOAT64):
         try:

@@ -19,6 +19,14 @@ def lib_available():
         pytest.skip("no C++ toolchain available")
 
 
+@pytest.fixture(autouse=True)
+def _enable_native_probe(monkeypatch):
+    # The C++ probe loops are opt-in since round 5 (numpy measured
+    # faster at every lake scale); these tests exist to pin the C++
+    # implementations against the references, so turn them on.
+    monkeypatch.setenv("HST_NATIVE_PROBE", "on")
+
+
 def _bloom_rows(n_filters=40, num_bits=256, num_hashes=4, seed=0):
     """Per-filter bitsets built by the real device/host builder."""
     rng = np.random.default_rng(seed)
@@ -136,10 +144,16 @@ class TestMinMaxPrune:
         hi = [l + int(d) for l, d in zip(lo, rng.integers(0, 30, 200))]
         for op, v in self.CASES:
             with_native = native.minmax_prune(lo, hi, op, v * 3, INT64)
-            monkeypatch.setattr(native, "_lib", None)
-            monkeypatch.setattr(native, "_lib_tried", True)
-            without = native.minmax_prune(lo, hi, op, v * 3, INT64)
-            monkeypatch.undo()
+            # A dedicated MonkeyPatch: undo() on the shared fixture
+            # instance would also revert the autouse HST_NATIVE_PROBE=on,
+            # turning the remaining iterations into numpy-vs-numpy.
+            mp = pytest.MonkeyPatch()
+            try:
+                mp.setattr(native, "_lib", None)
+                mp.setattr(native, "_lib_tried", True)
+                without = native.minmax_prune(lo, hi, op, v * 3, INT64)
+            finally:
+                mp.undo()
             np.testing.assert_array_equal(with_native, without)
 
 
