@@ -90,6 +90,52 @@ def eval_expr(table: Table, e: E.Expr) -> Column:
         return _eval_in(table, e)
     if isinstance(e, (E.Add, E.Subtract, E.Multiply, E.Divide)):
         return _eval_arith(table, e)
+    if isinstance(e, E.Concat):
+        lits = [p.value for p in e.parts if isinstance(p, E.Lit)]
+        cols = [p for p in e.parts if not isinstance(p, E.Lit)]
+        if not cols:
+            return Column(STRING, jnp.zeros(table.num_rows, jnp.int32),
+                          None, np.array(["".join(map(str, lits))],
+                                         dtype=object))
+        c = eval_expr(table, cols[0])
+        if c.dtype != STRING:
+            raise HyperspaceException("concat() over non-string column")
+        pre, post, seen = [], [], False
+        for p in e.parts:
+            if isinstance(p, E.Lit):
+                (post if seen else pre).append(str(p.value))
+            else:
+                seen = True
+        prefix, suffix = "".join(pre), "".join(post)
+        dic = np.array([f"{prefix}{s}{suffix}" for s in c.dictionary],
+                       dtype=object)
+        # Dictionaries must stay SORTED (codes compare like the strings —
+        # columnar.py's invariant). A prefix preserves order; a suffix can
+        # break it (['a','ab'] + 'z' → ['az','abz']), so re-sort + remap.
+        if dic.size > 1 and any(dic[i] > dic[i + 1]
+                                for i in range(dic.size - 1)):
+            order = np.argsort(dic)
+            remap = np.empty(dic.size, np.int32)
+            remap[order] = np.arange(dic.size, dtype=np.int32)
+            data = jnp.take(jnp.asarray(remap),
+                            jnp.clip(c.data, 0, dic.size - 1))
+            data = jnp.where(c.data >= 0, data, c.data)
+            return Column(STRING, data, c.validity, dic[order])
+        return Column(STRING, c.data, c.validity, dic)
+    if isinstance(e, E.NullLit):
+        n = table.num_rows
+        from .columnar import _DEVICE_DTYPE
+        dic = np.array([""], dtype=object) if e.dtype == STRING else None
+        return Column(e.dtype, jnp.zeros(n, _DEVICE_DTYPE[e.dtype]),
+                      jnp.zeros(n, jnp.bool_), dic)
+    if isinstance(e, E.Sqrt):
+        c = eval_expr(table, e.child)
+        x = c.data.astype(jnp.float64)
+        # sqrt of a negative is NULL in SQL, not NaN (no host sync: the
+        # validity bitmap is carried unconditionally).
+        nonneg = x >= 0
+        validity = nonneg if c.validity is None else (c.validity & nonneg)
+        return Column(FLOAT64, jnp.sqrt(jnp.maximum(x, 0.0)), validity)
     if isinstance(e, E.Like):
         return _eval_like(table, e)
     if isinstance(e, E.IsNull):

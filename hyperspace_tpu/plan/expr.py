@@ -406,6 +406,69 @@ class StringTransform(Expr):
 # Aggregates.
 # ---------------------------------------------------------------------------
 
+class Concat(Expr):
+    """String concatenation with at most ONE column operand (the TPC-DS
+    q5 ``concat('store', s_store_id)`` shape): evaluates as a pure
+    dictionary rewrite — codes never change, the per-value strings do."""
+
+    def __init__(self, parts: Sequence[Expr]):
+        if sum(1 for p in parts if not isinstance(p, Lit)) > 1:
+            raise HyperspaceException(
+                "concat() supports at most one column operand "
+                "(literal affixes rewrite the dictionary; general "
+                "column-column concat would need a cross dictionary)")
+        self.parts = list(parts)
+
+    @property
+    def children(self) -> List[Expr]:
+        return list(self.parts)
+
+    @property
+    def name(self) -> str:
+        return "concat(" + ", ".join(p.name for p in self.parts) + ")"
+
+    def __repr__(self):
+        return "concat(" + ", ".join(repr(p) for p in self.parts) + ")"
+
+
+class NullLit(Expr):
+    """A typed all-NULL constant column (the ROLLUP lowering's filler for
+    rolled-up grouping keys; a bare ``Lit(None)`` has no type)."""
+
+    def __init__(self, dtype: str):
+        self.dtype = dtype
+
+    @property
+    def name(self) -> str:
+        return f"null:{self.dtype}"
+
+    def __repr__(self):
+        return f"null({self.dtype})"
+
+
+class Sqrt(Expr):
+    """Square root (needed by the STDDEV lowering; the reference gets it
+    from Spark SQL's function library)."""
+
+    def __init__(self, child: Expr):
+        self.child = child
+
+    @property
+    def children(self) -> List[Expr]:
+        return [self.child]
+
+    @property
+    def name(self) -> str:
+        return f"sqrt({self.child.name})"
+
+    def __repr__(self):
+        return f"sqrt({self.child!r})"
+
+
+def sqrt(e) -> Sqrt:
+    return Sqrt(_wrap(e))
+
+
 class AggExpr(Expr):
     agg_name = "?"
 
@@ -622,7 +685,7 @@ def map_children(e: Expr, fn) -> Expr:
     The single structural-rewrite primitive: rename_columns, the SQL
     front-end's alias resolution, and the rules' substitution walkers all
     ride on it, so a new Expr kind only needs one case here."""
-    if isinstance(e, (Col, Lit)):
+    if isinstance(e, (Col, Lit, NullLit)):
         return e
     if isinstance(e, _Binary):
         return type(e)(fn(e.left), fn(e.right))
@@ -645,6 +708,10 @@ def map_children(e: Expr, fn) -> Expr:
         return Substring(fn(e.child), e.start, e.length)
     if isinstance(e, StringTransform):
         return StringTransform(e.fn, fn(e.child))
+    if isinstance(e, Sqrt):
+        return Sqrt(fn(e.child))
+    if isinstance(e, Concat):
+        return Concat([fn(p) for p in e.parts])
     if isinstance(e, AggExpr):
         if e.child is None:
             return e

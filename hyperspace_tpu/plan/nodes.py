@@ -67,8 +67,17 @@ def infer_dtype(e: E.Expr, schema: Schema) -> str:
     if isinstance(e, (E.Add, E.Subtract, E.Multiply)):
         kinds = {infer_dtype(c, schema) for c in e.children}
         return FLOAT64 if (FLOAT64 in kinds or "float32" in kinds) else INT64
-    if isinstance(e, E.Divide):
+    if isinstance(e, (E.Divide, E.Sqrt)):
         return FLOAT64
+    if isinstance(e, E.NullLit):
+        return e.dtype
+    if isinstance(e, E.Concat):
+        for p in e.parts:
+            pt = infer_dtype(p, schema)
+            if pt != STRING:
+                raise HyperspaceException(
+                    f"concat() operands must be strings; got {pt}")
+        return STRING
     if isinstance(e, (E.Count, E.CountDistinct)):
         return INT64
     if isinstance(e, E.Avg):

@@ -75,7 +75,7 @@ _KEYWORDS = {
     "SELECT", "FROM", "WHERE", "GROUP", "BY", "HAVING", "ORDER", "LIMIT",
     "JOIN", "INNER", "LEFT", "RIGHT", "FULL", "OUTER", "ON", "AS", "AND",
     "OR", "NOT", "IN", "BETWEEN", "ASC", "DESC", "DATE", "DISTINCT",
-    "UNION", "ALL", "WITH",
+    "UNION", "ALL", "WITH", "INTERSECT", "EXCEPT", "ROLLUP", "GROUPING",
     "SUM", "AVG", "MIN", "MAX", "COUNT",
     "LIKE", "IS", "NULL", "CASE", "WHEN", "THEN", "ELSE", "END",
     "EXTRACT", "INTERVAL", "DAY", "MONTH", "YEAR", "QUARTER",
@@ -83,7 +83,7 @@ _KEYWORDS = {
     "CAST", "COALESCE",
     "OVER", "PARTITION", "ROWS", "RANGE", "UNBOUNDED", "PRECEDING",
     "FOLLOWING", "CURRENT", "ROW", "RANK", "DENSE_RANK", "ROW_NUMBER",
-    "ABS",
+    "ABS", "STDDEV", "STDDEV_SAMP", "SQRT", "CONCAT",
 }
 
 # Words that are only meaningful in specific grammar positions (EXTRACT's
@@ -95,7 +95,8 @@ _SOFT_KEYWORDS = {
     "UPPER", "LOWER", "TRIM", "SUBSTRING", "SUBSTR", "EXTRACT", "CAST",
     "COALESCE", "OVER", "PARTITION", "ROWS", "RANGE", "UNBOUNDED",
     "PRECEDING", "FOLLOWING", "CURRENT", "ROW", "RANK", "DENSE_RANK",
-    "ROW_NUMBER", "ABS",
+    "ROW_NUMBER", "ABS", "STDDEV", "STDDEV_SAMP", "SQRT", "GROUPING",
+    "ROLLUP", "CONCAT",
 }
 
 
@@ -522,6 +523,54 @@ class _Parser:
             for a in reversed(args[:-1]):
                 e = E.CaseWhen([(E.IsNull(a, negated=True), a)], e)
             return e
+        if self.peek("KW", "GROUPING") and self.peek2("OP", "("):
+            self.take("KW")
+            self.take("OP", "(")
+            name = self.take_name()
+            self.take("OP", ")")
+            # GROUPING(c) is a per-grouping-set constant; the ROLLUP
+            # lowering materializes it as a hidden 0/1 column per branch
+            # (q27/q36/q70/q86). The double-underscore suffix keeps it
+            # inside the SELECT-* hidden-name filter.
+            return E.col(f"__grouping__{name.split('.')[-1].lower()}__")
+        if self.peek("KW", "CONCAT") and self.peek2("OP", "("):
+            self.take("KW")
+            self.take("OP", "(")
+            parts = [self.expr()]
+            while self.accept("OP", ","):
+                parts.append(self.expr())
+            self.take("OP", ")")
+            return E.Concat(parts)
+        if self.peek("KW", "SQRT") and self.peek2("OP", "("):
+            self.take("KW")
+            self.take("OP", "(")
+            inner = self.expr()
+            self.take("OP", ")")
+            return E.Sqrt(inner)
+        for sd in ("STDDEV", "STDDEV_SAMP"):
+            if self.peek("KW", sd) and self.peek2("OP", "("):
+                self.take("KW")
+                self.take("OP", "(")
+                x = self.expr()
+                self.take("OP", ")")
+                # Parse-time rewrite onto decomposable aggregates (the
+                # q17/q39 shape): stddev_samp(x) =
+                # sqrt((sum(x*x) - sum(x)^2/n) / (n - 1)), NULL for n < 2
+                # (matching SQL; the n=1 denominator would divide by 0).
+                # Computed in float64 like Spark — sum(x)^2 over an int
+                # column would silently wrap int64.
+                xf = E.Multiply(x, E.lit(1.0))
+                n = E.count(x)
+                sx = E.sum_(xf)
+                sxx = E.sum_(E.Multiply(xf, xf))
+                var = E.Divide(E.Subtract(sxx, E.Divide(
+                    E.Multiply(sx, sx), n)), E.Subtract(n, E.lit(1)))
+                # Clamp float cancellation error: a variance of -1e-12
+                # must yield 0, not NULL-from-sqrt(-x).
+                var = E.CaseWhen([(E.LessThan(var, E.lit(0)), E.lit(0.0))],
+                                 var)
+                return E.CaseWhen(
+                    [(E.GreaterThan(n, E.lit(1)), E.Sqrt(var))], None)
         for rank_fn in ("RANK", "DENSE_RANK", "ROW_NUMBER"):
             if self.peek("KW", rank_fn) and self.peek2("OP", "("):
                 self.take("KW")
@@ -818,15 +867,66 @@ class _Parser:
                 break
 
     def _query_body(self):
-        """select [UNION ALL select]* [ORDER BY ...] [LIMIT n] — a
-        trailing ORDER BY/LIMIT binds to the WHOLE union (standard SQL),
-        and the same production serves derived tables."""
-        df = self._select_stmt()
-        while self.peek("KW", "UNION"):
-            self.take("KW", "UNION")
-            self.take("KW", "ALL")
-            df = df.union(self._select_stmt())
+        """select [UNION ALL | INTERSECT | EXCEPT select]*
+        [ORDER BY ...] [LIMIT n] — a trailing ORDER BY/LIMIT binds to the
+        WHOLE compound (standard SQL), INTERSECT binds tighter than
+        UNION/EXCEPT, and the same production serves derived tables."""
+        df = self._intersect_term()
+        while True:
+            if self.peek("KW", "UNION"):
+                self.take("KW", "UNION")
+                if self.accept("KW", "ALL"):
+                    df = df.union(self._intersect_term())
+                else:
+                    # UNION without ALL deduplicates (standard SQL;
+                    # positional — a later UNION ALL may re-add rows).
+                    df = df.union(self._intersect_term()).distinct()
+            elif self.accept("KW", "EXCEPT"):
+                df = self._set_op(df, self._intersect_term(), anti=True)
+            else:
+                break
         return self._order_limit(df)
+
+    def _intersect_term(self):
+        df = self._set_operand()
+        while self.accept("KW", "INTERSECT"):
+            df = self._set_op(df, self._set_operand(), anti=False)
+        return df
+
+    def _set_operand(self):
+        # Parenthesized set-op operands: ``(SELECT ...) EXCEPT
+        # (SELECT ...)`` — the q8/q87 house style.
+        if self.peek("OP", "(") and self.peek2("KW", "SELECT"):
+            self.take("OP", "(")
+            inner = self._query_body()
+            self.take("OP", ")")
+            return inner
+        return self._select_stmt()
+
+    def _set_op(self, left, right, anti: bool):
+        """INTERSECT / EXCEPT with SQL's DISTINCT semantics, lowered to
+        distinct + semi/anti join on every column positionally (the
+        q8/q14/q38/q87 shapes). Divergence from three-valued SQL: set
+        ops treat NULL keys as equal, the join's equality never matches
+        them — same documented convention as NOT IN; the conformance
+        corpus' set-op keys are non-null."""
+        lnames = left.plan.schema.names
+        rnames = right.plan.schema.names
+        if len(lnames) != len(rnames):
+            raise HyperspaceException(
+                f"SQL: {'EXCEPT' if anti else 'INTERSECT'} sides have "
+                f"{len(lnames)} vs {len(rnames)} columns")
+        i = self._sq_counter
+        self._sq_counter += 1
+        sel = [E.col(rn).alias(f"__set{i}_k{j}")
+               for j, rn in enumerate(rnames)]
+        probe = right.select(*sel)
+        cond = None
+        for j, ln in enumerate(lnames):
+            eq = E.col(ln) == E.col(f"__set{i}_k{j}")
+            cond = eq if cond is None else (cond & eq)
+        return left.distinct().join(probe, on=cond,
+                                    how="anti" if anti else "semi")
 
     def _order_limit(self, df):
         if self.accept("KW", "ORDER"):
@@ -992,16 +1092,36 @@ class _Parser:
                 f"SQL: GROUP BY expression {e!r} must restate an item "
                 "of the SELECT list")
 
+        rollup_cols: List[str] = []
         if self.accept("KW", "GROUP"):
             self.take("KW", "BY")
-            # Duplicate keys are redundant in SQL (GROUP BY x, x ≡ x) and
-            # would collide as output columns — keep first occurrences.
-            g = group_item()
-            group_cols.append(g)
-            while self.accept("OP", ","):
+
+            def one_group_entry():
+                # ROLLUP(c1, ..., cn): the trailing keys become grouping
+                # sets (prefixes) — lowered below as a union of per-set
+                # aggregations (the reference inherits ROLLUP from Spark
+                # SQL; TPC-DS q5/q18/q22/q27/q67/q77/q80 use it).
+                if self.peek("KW", "ROLLUP") and self.peek2("OP", "("):
+                    self.take("KW")
+                    self.take("OP", "(")
+                    rollup_cols.append(group_item())
+                    while self.accept("OP", ","):
+                        rollup_cols.append(group_item())
+                    self.take("OP", ")")
+                    return
                 g = group_item()
                 if g not in group_cols:
                     group_cols.append(g)
+
+            one_group_entry()
+            while self.accept("OP", ","):
+                one_group_entry()
+            # A key listed BOTH plainly and inside ROLLUP stays grouped
+            # in every grouping set (Spark: GROUP BY a, ROLLUP(a, b)
+            # never rolls `a` up): it leaves the rollup list.
+            rollup_cols = [c for c in rollup_cols if c not in group_cols]
+            for g in rollup_cols:
+                group_cols.append(g)
 
         orig_items = items
         if group_exprs:
@@ -1068,10 +1188,27 @@ class _Parser:
                         out_cols.append(named)
                         out_names.append(named.name)
                         continue
-                    if not isinstance(e, E.Col):
+                    if not isinstance(e, E.Col) or \
+                            e.column.startswith("__grouping__"):
+                        # Non-aggregate EXPRESSIONS over grouping keys /
+                        # GROUPING() flags (standard SQL; the q27
+                        # ``grouping(a) + grouping(b) AS lochierarchy``
+                        # shape): projected after aggregation.
+                        refs = set(e.references)
+                        if refs and all(
+                                spell(r) in group_resolved
+                                or r.startswith("__grouping__")
+                                for r in refs):
+                            compound = True
+                            named = e.alias(alias) if alias \
+                                else e.alias(e.name)
+                            out_cols.append(named)
+                            out_names.append(named.name)
+                            continue
                         raise HyperspaceException(
                             "SQL: non-aggregate select items must be "
-                            "plain grouped columns")
+                            "plain grouped columns or expressions over "
+                            "them")
                     spelled = spell(e.column)
                     if spelled not in group_resolved:
                         raise HyperspaceException(
@@ -1092,11 +1229,19 @@ class _Parser:
             # the output order to the SELECT order).
             having: Optional[E.Expr] = None
             if self.accept("KW", "HAVING"):
+                if rollup_cols:
+                    raise HyperspaceException(
+                        "SQL: HAVING with ROLLUP is not supported")
                 having = self._resolve_quals(self.expr(), scope)
                 having, hidden = _lift_aggs(having, f"__having_{n_visible}")
                 aggs.extend(hidden)
-            df = (df.group_by(*group_cols).agg(*aggs) if group_cols
-                  else df.agg(*aggs))
+            if rollup_cols:
+                df = self._rollup_union(
+                    df, [g for g in group_cols if g not in rollup_cols],
+                    rollup_cols, aggs)
+            else:
+                df = (df.group_by(*group_cols).agg(*aggs) if group_cols
+                      else df.agg(*aggs))
             if having is not None:
                 df = df.filter(having)
             # Window functions evaluate AFTER grouping (standard SQL): by
@@ -1113,8 +1258,8 @@ class _Parser:
             # compound aggregate items, and hidden HAVING aggregates
             # always force the projection.
             natural = group_resolved + visible_agg_names
-            if aliased or compound or windowed or out_names != natural \
-                    or len(aggs) != n_visible:
+            if aliased or compound or windowed or bool(rollup_cols) \
+                    or out_names != natural or len(aggs) != n_visible:
                 pre = df
                 df = df.select(*out_cols)
                 self._sortable_parent = (pre, list(out_cols), df)
@@ -1200,10 +1345,43 @@ class _Parser:
             self.accept("KW", "INNER")
         self.accept("KW", "OUTER")
         self.take("KW", "JOIN")
-        other, _alias = self._table_ref(scope)
+        other, alias2 = self._table_ref(scope)
+        overlap = set(n.lower() for n in df.plan.schema.names) & \
+            set(n.lower() for n in other.plan.schema.names)
+        if overlap:
+            # Columns shared by both JOIN sides (CTEs joined to CTEs —
+            # the q77 ``ss LEFT JOIN sr ON ss.s_store_sk =
+            # sr.s_store_sk`` shape): rename the right side's shared
+            # columns internally; qualified references resolve through
+            # scope.renames, unqualified references to them would be
+            # ambiguous SQL anyway.
+            if alias2 is None:
+                raise HyperspaceException(
+                    "SQL: JOIN sides share columns "
+                    f"{sorted(overlap)}; alias the right side")
+            other = self._mangle_columns(other, alias2, overlap, scope)
         self.take("KW", "ON")
         cond = self._resolve_quals(self._join_condition(), scope)
         return df.join(other, on=cond, how=how)
+
+    def _mangle_columns(self, df, label: str, cols_lower, scope: _Scope):
+        """Rename ``df``'s columns in ``cols_lower`` to
+        ``__<label>__<col>`` and register the mapping with the scope —
+        the ONE rename convention shared by duplicate-table comma joins
+        and overlapping explicit JOIN sides."""
+        mapping = {}
+        sel = []
+        for c in df.plan.schema.names:
+            if c.lower() in cols_lower:
+                mangled = f"__{label.lower()}__{c}"
+                mapping[c.lower()] = mangled
+                sel.append(E.col(c).alias(mangled))
+            else:
+                sel.append(E.col(c))
+        out = df.select(*sel)
+        scope.bind(label, out)
+        scope.renames[label.lower()] = mapping
+        return out
 
     def _join_condition(self) -> E.Expr:
         cond = self._join_term()
@@ -1250,12 +1428,8 @@ class _Parser:
                         "SQL: duplicate table in FROM list requires an "
                         f"alias (columns {sorted(set(cols) & seen_cols)} "
                         "repeat)")
-                mapping = {c.lower(): f"__{label.lower()}__{c}"
-                           for c in cols}
-                dfs[i] = d.select(*[E.col(c).alias(mapping[c.lower()])
-                                    for c in cols])
-                scope.bind(label, dfs[i])
-                scope.renames[label.lower()] = mapping
+                dfs[i] = self._mangle_columns(
+                    d, label, {c.lower() for c in cols}, scope)
             seen_cols.update(dfs[i].plan.schema.names)
         if cond is not None:
             cond = self._resolve_quals(cond, scope)
@@ -1336,6 +1510,39 @@ class _Parser:
         for c in subs:
             cur = self._apply_subquery_conjunct(cur, c, scope)
         return cur
+
+    # -- ROLLUP lowering ---------------------------------------------------
+    def _rollup_union(self, df, plain: List[str], roll: List[str], aggs):
+        """GROUP BY [plain,] ROLLUP(r1..rn) as a UNION ALL of the n+1
+        grouping sets (prefixes of the rollup list), each aggregated
+        from the SAME pre-aggregation input — exact for every aggregate
+        (including avg and count-distinct, which cannot be re-aggregated
+        from the finest set). Rolled-up keys become typed NULL columns;
+        per-branch constant ``__grouping__<col>__`` flag columns carry
+        GROUPING() (dropped by the hidden-name filter unless selected).
+        Parity: Spark SQL's rollup, inherited by the reference — TPC-DS
+        q5/q18/q22/q27/q67/q77/q80 and the grouping() family."""
+        schema = df.plan.schema
+        agg_names = [a.name for a in aggs]
+        flag_names = [f"__grouping__{c.lower()}__" for c in roll]
+        out_names = plain + roll + agg_names + flag_names
+        branches = []
+        for k in range(len(roll), -1, -1):
+            keys = plain + roll[:k]
+            part = (df.group_by(*keys).agg(*aggs) if keys
+                    else df.agg(*aggs))
+            for c in roll[k:]:
+                sp = df._spelling(c)
+                part = part.with_column(
+                    c, E.NullLit(schema.field(sp).dtype))
+            for j, c in enumerate(roll):
+                part = part.with_column(flag_names[j],
+                                        E.lit(1 if j >= k else 0))
+            branches.append(part.select(*out_names))
+        out = branches[0]
+        for b in branches[1:]:
+            out = out.union(b)
+        return out
 
     # -- window lowering ---------------------------------------------------
     def _apply_windows_mixed(self, df, cols):
@@ -1682,13 +1889,20 @@ def _lift_aggs(e: E.Expr, prefix: str):
     """Replace every aggregate inside ``e`` with a reference to a hidden
     output column, returning (rewritten expression, the hidden aliased
     aggregates to append to the agg list). Serves both HAVING predicates
-    and compound select items like ``100 * sum(a) / sum(b)``."""
+    and compound select items like ``100 * sum(a) / sum(b)``. Repeated
+    aggregates dedupe by structure (the STDDEV rewrite repeats sum/count
+    several times; each distinct aggregate is computed once)."""
     hidden: List[E.Expr] = []
+    by_repr: Dict[str, str] = {}
 
     def rec(node: E.Expr) -> E.Expr:
         if isinstance(node, E.AggExpr):
-            name = f"{prefix}_{len(hidden)}"
-            hidden.append(node.alias(name))
+            key = repr(node)
+            name = by_repr.get(key)
+            if name is None:
+                name = f"{prefix}_{len(hidden)}"
+                by_repr[key] = name
+                hidden.append(node.alias(name))
             return E.col(name)
         return E.map_children(node, rec)
 

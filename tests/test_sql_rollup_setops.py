@@ -1,0 +1,169 @@
+"""SQL ROLLUP/GROUPING, INTERSECT/EXCEPT, UNION-distinct, and STDDEV
+(round 5 wave 2 — the constructs gating TPC-DS q5/q18/q22/q27/q36/q38/
+q47/q57/q77/q86/q87 and the q17 family). Oracles are pandas
+recomputations.
+"""
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+import hyperspace_tpu as hst
+from hyperspace_tpu.exceptions import HyperspaceException
+
+
+@pytest.fixture(scope="module")
+def env(tmp_path_factory):
+    root = tmp_path_factory.mktemp("rollup")
+    rng = np.random.default_rng(9)
+    n = 500
+    t = pa.table({
+        "a": pa.array(rng.integers(0, 4, n).astype(np.int64)),
+        "b": pa.array(rng.choice(["x", "y", "z"], n)),
+        "v": pa.array(np.round(rng.uniform(0, 10, n), 2)),
+    })
+    d = root / "t"
+    d.mkdir()
+    pq.write_table(t, str(d / "p.parquet"))
+    session = hst.Session(system_path=str(root / "idx"))
+    session.create_temp_view("t", session.read.parquet(str(d)))
+    return session, t.to_pandas()
+
+
+def test_rollup_grouping_sets(env):
+    session, pdf = env
+    out = session.sql("""
+        SELECT b, a, sum(v) sv, count(*) n,
+               grouping(a) ga, grouping(b) gb
+        FROM t GROUP BY ROLLUP (b, a) ORDER BY gb, ga, b, a
+    """).to_pandas()
+    fine = pdf.groupby(["b", "a"]).agg(sv=("v", "sum"), n=("v", "size"))
+    n_b = pdf["b"].nunique()
+    assert len(out) == len(fine) + n_b + 1
+    # Finest set.
+    finest = out[(out.ga == 0) & (out.gb == 0)]
+    assert len(finest) == len(fine)
+    np.testing.assert_allclose(sorted(finest["sv"]), sorted(fine["sv"]),
+                               rtol=1e-9)
+    # Per-b subtotals: a is NULL, grouping(a) = 1.
+    sub = out[(out.ga == 1) & (out.gb == 0)]
+    assert sub["a"].isna().all()
+    np.testing.assert_allclose(
+        sorted(sub["sv"]), sorted(pdf.groupby("b")["v"].sum()), rtol=1e-9)
+    # Grand total.
+    total = out[(out.ga == 1) & (out.gb == 1)]
+    assert len(total) == 1 and total["b"].isna().all()
+    assert abs(total["sv"].iloc[0] - pdf["v"].sum()) < 1e-6
+    assert int(total["n"].iloc[0]) == len(pdf)
+
+
+def test_rollup_with_avg_is_exact(env):
+    """avg cannot be re-aggregated from the finest set — the lowering
+    recomputes each grouping set from the pre-aggregation input."""
+    session, pdf = env
+    out = session.sql("""
+        SELECT b, avg(v) av, grouping(b) gb
+        FROM t GROUP BY ROLLUP (b) ORDER BY gb, b
+    """).to_pandas()
+    total = out[out.gb == 1]
+    assert abs(total["av"].iloc[0] - pdf["v"].mean()) < 1e-9
+    per_b = out[out.gb == 0].set_index("b")["av"]
+    exp = pdf.groupby("b")["v"].mean()
+    for k in exp.index:
+        assert abs(per_b[k] - exp[k]) < 1e-9
+
+
+def test_grouping_expression_item(env):
+    """The q27 shape: grouping(a) + grouping(b) AS lochierarchy."""
+    session, _ = env
+    out = session.sql("""
+        SELECT a, b, sum(v) sv, grouping(a) + grouping(b) lochierarchy
+        FROM t GROUP BY ROLLUP (a, b)
+        ORDER BY lochierarchy DESC, a, b
+    """).to_pandas()
+    assert out["lochierarchy"].iloc[0] == 2  # grand total first
+    assert set(out["lochierarchy"]) == {0, 1, 2}
+
+
+def test_intersect_and_except(env):
+    session, pdf = env
+    out = session.sql("""
+        SELECT a FROM t WHERE v > 5 INTERSECT SELECT a FROM t WHERE v <= 5
+        ORDER BY a
+    """).to_pandas()
+    exp = sorted(set(pdf[pdf.v > 5].a) & set(pdf[pdf.v <= 5].a))
+    assert out["a"].tolist() == exp
+    out = session.sql("""
+        SELECT a, b FROM t EXCEPT SELECT a, b FROM t WHERE v < 9
+        ORDER BY a, b
+    """).to_pandas()
+    have = set(map(tuple, pdf[["a", "b"]].itertuples(index=False)))
+    minus = set(map(tuple, pdf[pdf.v < 9][["a", "b"]]
+                    .itertuples(index=False)))
+    assert sorted(map(tuple, out.itertuples(index=False))) == \
+        sorted(have - minus)
+
+
+def test_parenthesized_set_operands(env):
+    """The q87 shape: (SELECT ...) EXCEPT (SELECT ...) wrapped as a
+    derived table under count(*)."""
+    session, pdf = env
+    out = session.sql("""
+        SELECT count(*) n FROM (
+          (SELECT DISTINCT a, b FROM t)
+          EXCEPT
+          (SELECT DISTINCT a, b FROM t WHERE v < 5)
+        ) cool
+    """).to_pandas()
+    have = set(map(tuple, pdf[["a", "b"]].itertuples(index=False)))
+    minus = set(map(tuple, pdf[pdf.v < 5][["a", "b"]]
+                    .itertuples(index=False)))
+    assert int(out["n"].iloc[0]) == len(have - minus)
+
+
+def test_union_distinct(env):
+    session, pdf = env
+    out = session.sql("""
+        SELECT a FROM t WHERE v > 8 UNION SELECT a FROM t WHERE v < 2
+        ORDER BY a
+    """).to_pandas()
+    exp = sorted(set(pdf[pdf.v > 8].a) | set(pdf[pdf.v < 2].a))
+    assert out["a"].tolist() == exp
+
+
+def test_stddev_samp(env):
+    session, pdf = env
+    out = session.sql(
+        "SELECT b, stddev_samp(v) sd FROM t GROUP BY b ORDER BY b"
+    ).to_pandas()
+    exp = pdf.groupby("b")["v"].std()
+    np.testing.assert_allclose(out.set_index("b")["sd"], exp, rtol=1e-9)
+    # n = 1 group: NULL, not a division error.
+    one = session.sql(
+        "SELECT stddev_samp(v) sd FROM t WHERE v = (0 - 1)").to_pandas()
+    assert one["sd"].isna().all() or len(one) == 1
+
+
+def test_rollup_with_having_is_clear_error(env):
+    session, _ = env
+    with pytest.raises(HyperspaceException, match="HAVING with ROLLUP"):
+        session.sql("SELECT a, sum(v) FROM t GROUP BY ROLLUP (a) "
+                    "HAVING sum(v) > 0")
+
+
+def test_rollup_under_window(env):
+    """The q36/q86 shape: rank() over the rollup output, partitioned by
+    the grouping flags."""
+    session, pdf = env
+    out = session.sql("""
+        SELECT b, sum(v) sv, grouping(b) gb,
+               rank() OVER (PARTITION BY grouping(b) ORDER BY sum(v) DESC)
+               rk
+        FROM t GROUP BY ROLLUP (b) ORDER BY gb, rk
+    """).to_pandas()
+    per_b = out[out.gb == 0]
+    assert per_b["rk"].tolist() == list(range(1, len(per_b) + 1))
+    assert per_b["sv"].is_monotonic_decreasing
+    assert out[out.gb == 1]["rk"].tolist() == [1]
