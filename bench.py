@@ -934,6 +934,37 @@ def _single_device_phases(args, root):
             else:
                 RESULT[f"{name}_speedup"] = round(sp, 3)
 
+    # ---- serving result cache: repeated-query latency pair ----
+    # The serving-layer metric (BENCH_r06+): the same query re-issued
+    # with the cache off vs on. Runs BEFORE the hybrid appends so the
+    # source signatures (cache-key component) stay stable mid-phase.
+    if not _backend_dead():
+        with _phase("result_cache"):
+            from hyperspace_tpu.serving.constants import ServingConstants
+            rq = queries.get("q3") or queries.get("filter")
+            if rq is None:
+                RESULT["errors"].append(
+                    "result_cache phase skipped: no planned query")
+            else:
+                session.disable_hyperspace()
+                rq.to_arrow()  # warm the compiled programs
+                off_s = timed_best(lambda: rq.to_arrow(), args.repeats)
+                session.conf.set(
+                    ServingConstants.RESULT_CACHE_ENABLED, "true")
+                session.conf.set(
+                    ServingConstants.RESULT_CACHE_MIN_COMPUTE_SECONDS, "0")
+                rq.to_arrow()  # miss + admission
+                on_s = timed_best(lambda: rq.to_arrow(), args.repeats)
+                stats = session.result_cache.stats() \
+                    if session.result_cache is not None else {}
+                session.conf.set(
+                    ServingConstants.RESULT_CACHE_ENABLED, "false")
+                RESULT["result_cache_off_s"] = round(off_s, 4)
+                RESULT["result_cache_on_s"] = round(on_s, 4)
+                RESULT["result_cache_speedup"] = round(
+                    off_s / on_s if on_s > 0 else float("inf"), 3)
+                RESULT["result_cache_hits"] = stats.get("hits", 0)
+
     # ---- BASELINE config #5: Hybrid Scan over appended source files ----
     # Runs LAST: the appends invalidate plain signatures, so every other
     # query pair must be timed first.

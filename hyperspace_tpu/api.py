@@ -180,6 +180,37 @@ class Hyperspace:
             redirect_func(text)
         return text
 
+    def result_cache_stats(self) -> dict:
+        """Serving-layer cache observability: result-cache counters
+        (hit/miss/admit/evict per tier), the SQL plan-memo counters, and
+        the HBM index-table-cache counters (execution/index_cache.py) in
+        one dict. All zeros/None while the cache is disabled."""
+        from .execution import index_cache
+        cache = self.session.result_cache
+        out = {
+            "result_cache": cache.stats() if cache is not None else None,
+            "sql_plan_cache": dict(self.session._sql_plan_stats),
+        }
+        if index_cache.enabled():
+            ic = index_cache.get_cache()
+            out["index_table_cache"] = {
+                "hits": ic.hits, "misses": ic.misses,
+                "resident_bytes": ic.nbytes,
+            }
+        else:
+            out["index_table_cache"] = None
+        return out
+
+    def clear_result_cache(self) -> None:
+        """Drop every cached result (both tiers) and the SQL plan memo.
+        Never needed for correctness — invalidation is by key
+        construction — but frees the memory immediately."""
+        cache = self.session.result_cache
+        if cache is not None:
+            cache.clear()
+        with self.session._sql_plan_lock:
+            self.session._sql_plan_cache.clear()
+
     def why_not(self, df, index_name: Optional[str] = None) -> str:
         """Report why each index was (not) applied to this query plan.
 

@@ -10,9 +10,11 @@ cheap scalar accessors just re-parse per call.
 
 from __future__ import annotations
 
+import os
 from typing import Any, Callable, Dict, Generic, Optional, TypeVar
 
 from .index.constants import IndexConstants
+from .serving.constants import ServingConstants
 
 T = TypeVar("T")
 
@@ -199,6 +201,66 @@ class HyperspaceConf:
             self._conf.get(
                 IndexConstants.TPU_MAX_CHUNK_ROWS,
                 IndexConstants.TPU_MAX_CHUNK_ROWS_DEFAULT))
+
+    # ------------------------------------------------------------------
+    # Serving layer (serving/constants.py). The env-var fallbacks follow
+    # the HST_INDEX_CACHE* convention but are resolved HERE and nowhere
+    # else — scripts/lint.py rejects os.environ reads in new modules.
+    # ------------------------------------------------------------------
+
+    def _serving_get(self, key: str, default: str) -> str:
+        v = self._conf.get(key)
+        if v is not None:
+            return v
+        env_key = ServingConstants.ENV_FALLBACKS.get(key)
+        if env_key:
+            ev = os.environ.get(env_key)
+            if ev is not None:
+                # Accept the index-cache env spellings for the boolean.
+                return {"on": "true", "off": "false"}.get(
+                    ev.strip().lower(), ev)
+        return default
+
+    def result_cache_enabled(self) -> bool:
+        return self._serving_get(
+            ServingConstants.RESULT_CACHE_ENABLED,
+            ServingConstants.RESULT_CACHE_ENABLED_DEFAULT
+        ).strip().lower() == "true"
+
+    def result_cache_device_bytes(self) -> int:
+        return int(self._serving_get(
+            ServingConstants.RESULT_CACHE_DEVICE_BYTES,
+            ServingConstants.RESULT_CACHE_DEVICE_BYTES_DEFAULT))
+
+    def result_cache_host_bytes(self) -> int:
+        return int(self._serving_get(
+            ServingConstants.RESULT_CACHE_HOST_BYTES,
+            ServingConstants.RESULT_CACHE_HOST_BYTES_DEFAULT))
+
+    def result_cache_min_compute_seconds(self) -> float:
+        return float(self._serving_get(
+            ServingConstants.RESULT_CACHE_MIN_COMPUTE_SECONDS,
+            ServingConstants.RESULT_CACHE_MIN_COMPUTE_SECONDS_DEFAULT))
+
+    def result_cache_min_input_bytes(self) -> int:
+        return int(self._serving_get(
+            ServingConstants.RESULT_CACHE_MIN_INPUT_BYTES,
+            ServingConstants.RESULT_CACHE_MIN_INPUT_BYTES_DEFAULT))
+
+    def result_cache_plan_cache_size(self) -> int:
+        return int(self._serving_get(
+            ServingConstants.RESULT_CACHE_PLAN_CACHE_SIZE,
+            ServingConstants.RESULT_CACHE_PLAN_CACHE_SIZE_DEFAULT))
+
+    def result_cache_conf_string(self) -> str:
+        """Raw identity of the cache INSTANCE (CacheWithTransform key):
+        enabled flag + tier budgets. Admission thresholds are read live
+        per query, so tuning them does not drop a warm cache."""
+        return "|".join([
+            str(self.result_cache_enabled()),
+            str(self.result_cache_device_bytes()),
+            str(self.result_cache_host_bytes()),
+        ])
 
     def _get_bool(self, key: str, default: str) -> bool:
         return (self._conf.get(key, default) or "").strip().lower() == "true"

@@ -474,6 +474,21 @@ def _chunked_filtered_index_scan(plan: IndexScan, needed: Optional[Set[str]],
     return table
 
 
+def _emit_index_cache_probe(index_name: str, hit: bool) -> None:
+    """Surface IndexTableCache probes through telemetry (the hit/miss
+    counters in execution/index_cache.py were previously counted but
+    never reported anywhere). No-op outside a session context."""
+    session = _SESSION.get()
+    if session is None:
+        return
+    from ..telemetry.events import IndexCacheHitEvent, IndexCacheMissEvent
+    from ..telemetry.logging import get_logger
+    cls = IndexCacheHitEvent if hit else IndexCacheMissEvent
+    get_logger(session.hs_conf.event_logger_class()).log_event(
+        cls(message=f"index table cache {'hit' if hit else 'miss'}",
+            index_name=index_name))
+
+
 def _execute_index_scan(plan: IndexScan, needed: Optional[Set[str]],
                         pa_filter=None,
                         bucket_subset: Optional[Set[int]] = None,
@@ -508,6 +523,7 @@ def _execute_index_scan(plan: IndexScan, needed: Optional[Set[str]],
                    tuple(cols) if cols is not None else None)
             cache = index_cache.get_cache()
             table = cache.get(key)
+            _emit_index_cache_probe(entry.name, hit=table is not None)
             if table is None:
                 table = read_parquet(index_files, cols)
                 cache.put(key, table)

@@ -26,12 +26,18 @@ from typing import Optional, Tuple
 from .columnar import Table
 
 
-def _table_nbytes(table: Table) -> int:
+def table_nbytes(table: Table) -> int:
+    """Approximate residency cost of a Table (device or host): column
+    data + validity bitmaps + dictionary slots. The single byte
+    accounting shared by this cache and the serving result cache
+    (serving/result_cache.py)."""
     total = 0
     for col in table.columns.values():
         total += col.data.size * col.data.dtype.itemsize
         if col.validity is not None:
             total += col.validity.size
+        if col.dictionary is not None:
+            total += col.dictionary.size * 8
     return total
 
 
@@ -53,7 +59,7 @@ class IndexTableCache:
         return hit[0]
 
     def put(self, key: Tuple, table: Table) -> None:
-        nbytes = _table_nbytes(table)
+        nbytes = table_nbytes(table)
         if nbytes > self.max_bytes:
             return  # larger than the whole cache: don't thrash.
         old = self._entries.pop(key, None)

@@ -24,7 +24,6 @@ double proving the whole lifecycle runs store-only — the analogue of
 
 from __future__ import annotations
 
-import os
 import posixpath
 import threading
 from typing import Callable, Dict, List, Optional, Tuple

@@ -115,6 +115,19 @@ class IndexLogManager:
                 break
         return versions
 
+    def latest_entry_fingerprint(self) -> Optional[tuple]:
+        """(latest id, md5 of the latest entry's raw bytes), or None when
+        the log is empty. Cheap change detector for the serving result
+        cache: a full refresh restarts the log at the SAME ids (fresh
+        create cycle), so the id alone cannot pin the index state — the
+        entry bytes can, without parsing JSON."""
+        latest = self.get_latest_id()
+        if latest is None:
+            return None
+        data = self._store.read(self._path_from_id(latest))
+        from ..util import hashing
+        return (latest, hashing.md5_hex(data) if data is not None else "")
+
     def create_latest_stable_log(self, log_id: int) -> bool:
         entry = self.get_log(log_id)
         if entry is None or entry.state not in STABLE_STATES:

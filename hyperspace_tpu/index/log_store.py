@@ -186,4 +186,4 @@ def store_for_path(index_path: str) -> LogStore:
 # Built-in scheme registrations (hsmem:// — the in-memory data+log test
 # double) live in data_store; importing it here makes them available the
 # moment any store resolution happens.
-from . import data_store  # noqa: E402,F401  (registration side effect)
+from . import data_store as _data_store  # noqa: E402,F401  (registration side effect)

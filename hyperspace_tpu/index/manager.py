@@ -188,6 +188,22 @@ class IndexCollectionManager(IndexManager):
             return None
         return self._log_manager(index_name).get_latest_log()
 
+    def latest_log_ids(self) -> tuple:
+        """(index name, latest op-log id, entry-bytes md5) per index under
+        the system path, name-sorted — the result cache's invalidation
+        component (serving/fingerprint.py). Reads directory listings plus
+        the one latest entry file (no JSON parse) and deliberately
+        bypasses the TTL metadata cache: a cross-process refresh must
+        flip cache keys at once."""
+        out = []
+        for name in self._index_names():
+            fp = IndexLogManager(os.path.join(
+                self._path_resolver.system_path,
+                name)).latest_entry_fingerprint()
+            if fp is not None:
+                out.append((name, fp[0], fp[1]))
+        return tuple(out)
+
     def get_index_versions(self, index_name: str, states: List[str]) -> List[int]:
         return self._log_manager(index_name).get_index_versions(states)
 

@@ -100,6 +100,7 @@ def explain_string(session, plan: LogicalPlan, verbose: bool = False,
     used = _used_indexes(with_index)
     for line in (used if used else ["<none>"]):
         buf.write_line(line)
+    _write_cache_section(buf, session, plan)
     if verbose:
         buf.write_line()
         _header(buf, "Physical operator stats:")
@@ -110,6 +111,46 @@ def explain_string(session, plan: LogicalPlan, verbose: bool = False,
             if b != a:
                 buf.write_line(f"{name}: {b} -> {a}")
     return buf.build()
+
+
+def _write_cache_section(buf: BufferStream, session,
+                         plan: LogicalPlan) -> None:
+    """Serving-cache observability (rendered only while the result cache
+    is enabled, so the explain goldens of cache-less sessions are
+    untouched): whether THIS query would be served from cache, plus the
+    result-cache and HBM index-table-cache counters (the latter were
+    previously counted in execution/index_cache.py but never shown)."""
+    cache = session.result_cache
+    if cache is None:
+        return
+    from ..serving.fingerprint import compute_key
+    buf.write_line()
+    _header(buf, "Result cache:")
+    key = compute_key(session, plan)
+    if key is None:
+        buf.write_line("plan shape not cacheable")
+    else:
+        tier = cache.peek(key)
+        if tier is not None:
+            buf.write_line(
+                f"result served from cache ({tier} tier, "
+                f"key {key.digest()})")
+        else:
+            buf.write_line(
+                f"miss - result will be computed and considered for "
+                f"admission (key {key.digest()})")
+    s = cache.stats()
+    buf.write_line(
+        f"result cache: hits={s['hits']} misses={s['misses']} "
+        f"admissions={s['admissions']} evictions={s['evictions']} "
+        f"entries={s['device_entries']}+{s['host_entries']} "
+        f"bytes={s['device_nbytes']}+{s['host_nbytes']}")
+    from ..execution import index_cache
+    if index_cache.enabled():
+        ic = index_cache.get_cache()
+        buf.write_line(
+            f"index table cache: hits={ic.hits} misses={ic.misses} "
+            f"resident_bytes={ic.nbytes}")
 
 
 def _count_nodes(plan: LogicalPlan):

@@ -1,0 +1,11 @@
+"""Serving layer: high-QPS query-path infrastructure.
+
+First subsystem: the plan-signature-keyed result cache with log-version
+invalidation (result_cache.py, fingerprint.py), plus the SQL plan memo
+wired into Session.sql. Knobs: ``serving.result_cache.*`` (constants.py,
+read through config.py accessors only).
+"""
+
+from .constants import ServingConstants  # noqa: F401
+from .fingerprint import ResultCacheKey, compute_key  # noqa: F401
+from .result_cache import ResultCache, build_result_cache  # noqa: F401

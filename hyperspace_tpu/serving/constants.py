@@ -1,0 +1,56 @@
+"""Serving-layer config keys + defaults (`serving.result_cache.*`).
+
+No reference analogue: the reference is a batch library; this subsystem is
+the first piece of a serving layer (ROADMAP north star: high-QPS repeated
+queries). Keys follow the conf-string convention of
+``index/constants.py``; env fallbacks follow the ``HST_*`` convention of
+``execution/index_cache.py`` but are resolved ONLY in ``config.py`` (the
+lint gate `scripts/lint.py` enforces that no serving module reads
+``os.environ`` directly).
+"""
+
+from __future__ import annotations
+
+
+class ServingConstants:
+    # Master switch. Default off: enabling changes no answers (tested by
+    # the disable-and-compare oracle) but trades memory for latency, a
+    # serving-deployment decision.
+    RESULT_CACHE_ENABLED = "serving.result_cache.enabled"
+    RESULT_CACHE_ENABLED_DEFAULT = "false"
+
+    # Byte budget of the device-resident (HBM) tier.
+    RESULT_CACHE_DEVICE_BYTES = "serving.result_cache.deviceBytes"
+    RESULT_CACHE_DEVICE_BYTES_DEFAULT = str(256 * 1024 * 1024)
+
+    # Byte budget of the host spill tier (device-tier LRU victims demote
+    # here instead of being dropped; host victims are gone).
+    RESULT_CACHE_HOST_BYTES = "serving.result_cache.hostBytes"
+    RESULT_CACHE_HOST_BYTES_DEFAULT = str(1024 * 1024 * 1024)
+
+    # Admission policy: a result is admitted only if BOTH its observed
+    # execution time and its estimated recompute input volume (from the
+    # optimized plan's file/index statistics) clear these floors — cheap
+    # results are cheaper to recompute than to hold resident.
+    RESULT_CACHE_MIN_COMPUTE_SECONDS = "serving.result_cache.minComputeSeconds"
+    RESULT_CACHE_MIN_COMPUTE_SECONDS_DEFAULT = "0.005"
+    RESULT_CACHE_MIN_INPUT_BYTES = "serving.result_cache.minInputBytes"
+    RESULT_CACHE_MIN_INPUT_BYTES_DEFAULT = "0"
+
+    # SQL text -> logical plan memo (active only while the result cache is
+    # enabled): a high-QPS serving loop re-issues identical SQL, and the
+    # parse+analyze pass is pure given the temp-view registry version.
+    # 0 disables.
+    RESULT_CACHE_PLAN_CACHE_SIZE = "serving.result_cache.planCacheSize"
+    RESULT_CACHE_PLAN_CACHE_SIZE_DEFAULT = "64"
+
+    # Env-var fallbacks (HST_INDEX_CACHE* convention), applied when the
+    # conf key is unset. "on"/"off" spellings are accepted for the
+    # boolean. Resolution happens in config.py exclusively.
+    ENV_FALLBACKS = {
+        RESULT_CACHE_ENABLED: "HST_RESULT_CACHE",
+        RESULT_CACHE_DEVICE_BYTES: "HST_RESULT_CACHE_DEVICE_BYTES",
+        RESULT_CACHE_HOST_BYTES: "HST_RESULT_CACHE_HOST_BYTES",
+        RESULT_CACHE_MIN_COMPUTE_SECONDS: "HST_RESULT_CACHE_MIN_COMPUTE_S",
+        RESULT_CACHE_MIN_INPUT_BYTES: "HST_RESULT_CACHE_MIN_INPUT_BYTES",
+    }

@@ -1,0 +1,195 @@
+"""Result-cache key derivation.
+
+A cached result may be served iff recomputing the query NOW would produce
+the identical table. The key therefore pins every input the executor's
+answer depends on:
+
+  1. canonical plan fingerprint — the plan AFTER the deterministic
+     normalization passes (predicate pushdown + column pruning), serialized
+     with full operator detail (expressions, join types, sort orders, file
+     listings), so `select().where()` and `where().select()` spellings of
+     one query share an entry;
+  2. source-relation signature — (size, mtime, path) of every source file
+     the plan's relations have pinned (the FileBasedSignatureProvider
+     fingerprint, index/signatures.py); in-place file changes flip it;
+  3. index log versions — (index name, latest op-log id, entry-bytes
+     md5) for every index under the system path, collected only while
+     hyperspace is enabled (disabled plans cannot touch an index):
+     refreshIndex/optimizeIndex/createIndex all change the latest log
+     entry (a full refresh restarts the log at the SAME ids, which the
+     byte hash catches), so stale keys become unreachable by
+     construction, never by heuristic TTLs;
+  4. config hash — the session conf + the hyperspace-enabled flag (a conf
+     change can alter the chosen physical plan and with it row order).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional, Tuple
+
+from ..plan.nodes import (Aggregate, BucketUnion, Filter, IndexScan, Join,
+                          Limit, LogicalPlan, Project, Scan, Sort, Union,
+                          Window)
+from ..util import hashing
+
+
+@dataclass(frozen=True)
+class ResultCacheKey:
+    plan_fingerprint: str
+    source_signature: str
+    index_versions: Tuple[Tuple[str, int, str], ...]
+    config_hash: str
+
+    def digest(self) -> str:
+        """Stable short form for telemetry/explain output."""
+        return hashing.md5_hex(
+            (self.plan_fingerprint, self.source_signature,
+             self.index_versions, self.config_hash))[:12]
+
+
+def _node_detail(plan: LogicalPlan) -> Optional[str]:
+    """Full-detail one-node serialization (tree_string is NOT enough: e.g.
+    Project's simple_string shows output names only, hiding the exprs).
+    Returns None for nodes this module does not understand — the whole
+    plan is then uncacheable rather than wrongly keyed."""
+    if isinstance(plan, Scan):
+        rel = plan.relation
+        return (f"Scan[{rel.file_format};{','.join(rel.root_paths)};"
+                f"{sorted(rel.options.items())}]")
+    if isinstance(plan, IndexScan):
+        e = plan.index_entry
+        return (f"IndexScan[{e.name};{e.log_version};"
+                f"{sorted(plan.deleted_file_ids)};"
+                f"{sorted(plan.appended_files)};{plan.use_bucket_spec}]")
+    if isinstance(plan, Filter):
+        return f"Filter[{plan.condition!r}]"
+    if isinstance(plan, Project):
+        return "Project[" + ";".join(repr(e) for e in plan.exprs) + "]"
+    if isinstance(plan, Join):
+        return f"Join[{plan.join_type};{plan.condition!r}]"
+    if isinstance(plan, Aggregate):
+        return (f"Aggregate[{plan.group_cols};"
+                + ";".join(repr(a) for a in plan.aggs) + "]")
+    if isinstance(plan, Window):
+        return ("Window[" + ";".join(f"{n}={w!r}" for n, w in plan.wexprs)
+                + "]")
+    if isinstance(plan, Sort):
+        return f"Sort[{plan.orders}]"
+    if isinstance(plan, Limit):
+        return f"Limit[{plan.n}]"
+    if isinstance(plan, (Union, BucketUnion)):
+        return plan.node_name
+    return None
+
+
+def _serialize(plan: LogicalPlan, out) -> bool:
+    detail = _node_detail(plan)
+    if detail is None:
+        return False
+    out.append(f"({detail}")
+    for c in plan.children:
+        if not _serialize(c, out):
+            return False
+    out.append(")")
+    return True
+
+
+def normalize(plan: LogicalPlan) -> LogicalPlan:
+    """The deterministic, environment-free prefix of Session.optimize:
+    predicates sink below projections and columns prune, so syntactic
+    variants of one query canonicalize to one fingerprint. (The
+    hyperspace rewrite and partition pruning are NOT applied here — they
+    depend on the environment, which the other key components pin.)"""
+    from ..rules.column_pruning import prune_columns
+    from ..rules.pushdown import push_filters
+    return prune_columns(push_filters(plan))
+
+
+def plan_fingerprint(plan: LogicalPlan,
+                     normalized: Optional[LogicalPlan] = None
+                     ) -> Optional[str]:
+    """Fingerprint of ``plan``; pass ``normalized`` (= normalize(plan))
+    when the caller already computed it — the miss path feeds the same
+    normalized tree into the rest of the optimizer, so the passes run
+    once, not twice."""
+    parts: list = []
+    if not _serialize(normalized if normalized is not None
+                      else normalize(plan), parts):
+        return None
+    return hashing.md5_hex("".join(parts))
+
+
+def source_signature(plan: LogicalPlan) -> Optional[str]:
+    """Combined (size, mtime, path) fingerprint of every file-based leaf
+    (the FileBasedSignatureProvider semantics). Sizes/mtimes are stat'ed
+    live, so an in-place rewrite of a pinned file invalidates; the file
+    LIST is the relation's pinned snapshot — exactly what execution will
+    read (keying on a re-listing would let a just-appended file's rows be
+    cached under a fresh relation's key without being in the result)."""
+    parts = []
+    for leaf in plan.collect_leaves():
+        relation = getattr(leaf, "relation", None)
+        if relation is None:
+            return None
+        for path, size, mtime in relation.all_file_infos():
+            parts.append(f"{size}{mtime}{path}")
+    return hashing.md5_hex("".join(parts))
+
+
+def index_versions(session) -> Tuple[Tuple[str, int, str], ...]:
+    """(name, latest log id, entry-bytes md5) per index, sorted — read
+    fresh from the op logs (NOT through the TTL metadata cache: a
+    cross-process refresh must flip the key immediately; nothing here
+    parses JSON)."""
+    if not session.is_hyperspace_enabled():
+        return ()
+    return session.index_collection_manager.latest_log_ids()
+
+
+def config_hash(session) -> str:
+    """Conf + enabled-flag hash. The serving knobs themselves are
+    excluded: they steer THIS cache (admission floors, budgets), never
+    the computed answer — hashing them would orphan every warm entry on
+    an admission-threshold tweak, breaking config.py's live-tuning
+    contract."""
+    items = [(k, v) for k, v in sorted(session.conf.as_dict().items())
+             if not k.startswith("serving.")]
+    return hashing.md5_hex((items, session.is_hyperspace_enabled()))
+
+
+def compute_key(session, plan: LogicalPlan,
+                normalized: Optional[LogicalPlan] = None
+                ) -> Optional[ResultCacheKey]:
+    """The full key, or None when the plan is not soundly cacheable."""
+    fp = plan_fingerprint(plan, normalized)
+    if fp is None:
+        return None
+    sig = source_signature(normalized if normalized is not None else plan)
+    if sig is None:
+        return None
+    return ResultCacheKey(fp, sig, index_versions(session),
+                          config_hash(session))
+
+
+def estimate_recompute_bytes(optimized: LogicalPlan) -> int:
+    """Admission-policy cost proxy: total input bytes the optimized plan
+    would read if recomputed — source file sizes for relation leaves,
+    IndexStatistics sizes (index files + hybrid appends) for index
+    leaves."""
+    total = 0
+    for leaf in optimized.collect_leaves():
+        relation = getattr(leaf, "relation", None)
+        if relation is not None:
+            total += sum(size for _, size, _ in relation.all_file_infos())
+        elif isinstance(leaf, IndexScan):
+            from ..index.statistics import IndexStatistics
+            stats = IndexStatistics.from_entry(leaf.index_entry)
+            total += stats.index_size_bytes
+            from ..util import file_utils
+            for f in leaf.appended_files:
+                try:
+                    total += file_utils.file_info_triple(f)[1]
+                except OSError:
+                    pass
+    return total

@@ -1,0 +1,279 @@
+"""Two-tier, byte-budgeted query result cache.
+
+The serving-layer memo over `Session.execute`: executed results are kept
+keyed by :class:`fingerprint.ResultCacheKey` (canonical plan fingerprint +
+source signature + index log versions + config hash) so a repeated query
+is served without re-planning or re-executing, and any change that could
+alter the answer changes the key — stale entries become unreachable, they
+are never "expired".
+
+Tiers (the HBM-residency design of execution/index_cache.py, extended):
+
+  device  — the executed Table as-is (device-resident columns); LRU
+            victims DEMOTE to the host tier instead of being dropped.
+  host    — `Table.to_host()` copies (numpy-backed, HBM-free); LRU
+            victims here are evicted for good.
+
+Admission is decided by the caller (execute_with_cache) from observed
+execution time + the optimized plan's input-byte estimate: results that
+are cheap to recompute are not worth residency.
+
+Thread safety: one lock around both tiers — the serving pattern is many
+query threads sharing a session.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import OrderedDict
+from typing import Optional, Tuple
+
+from .fingerprint import (ResultCacheKey, compute_key,
+                          estimate_recompute_bytes, normalize)
+
+TIER_DEVICE = "device"
+TIER_HOST = "host"
+
+
+def table_nbytes(table) -> int:
+    """One byte-accounting for every residency cache in the system
+    (execution/index_cache.py owns it; imported lazily because the
+    execution package pulls in jax, and `import hyperspace_tpu` — which
+    loads this module through config.py — must stay light)."""
+    from ..execution.index_cache import table_nbytes as impl
+    return impl(table)
+
+
+class ResultCache:
+    def __init__(self, device_bytes: int, host_bytes: int, on_evict=None):
+        self.device_bytes = device_bytes
+        self.host_bytes = host_bytes
+        # on_evict(tier, nbytes, demoted): observability hook; MAY be
+        # called while the lock is held, so it must not reenter the
+        # cache.
+        self._on_evict = on_evict
+        self._lock = threading.Lock()
+        self._device: "OrderedDict[ResultCacheKey, Tuple[object, int]]" = \
+            OrderedDict()
+        self._host: "OrderedDict[ResultCacheKey, Tuple[object, int]]" = \
+            OrderedDict()
+        self._device_nbytes = 0
+        self._host_nbytes = 0
+        self.hits = 0
+        self.device_hits = 0
+        self.host_hits = 0
+        self.misses = 0
+        self.admissions = 0
+        self.rejections = 0
+        self.demotions = 0
+        self.evictions = 0
+
+    # ------------------------------------------------------------------
+    # Lookup.
+    # ------------------------------------------------------------------
+
+    def get(self, key: ResultCacheKey):
+        """(table, tier) on hit — device tier first — else None."""
+        with self._lock:
+            entry = self._device.get(key)
+            if entry is not None:
+                self._device.move_to_end(key)
+                self.hits += 1
+                self.device_hits += 1
+                return entry[0], TIER_DEVICE
+            entry = self._host.get(key)
+            if entry is not None:
+                self._host.move_to_end(key)
+                self.hits += 1
+                self.host_hits += 1
+                return entry[0], TIER_HOST
+            self.misses += 1
+            return None
+
+    def peek(self, key: ResultCacheKey) -> Optional[str]:
+        """Tier holding ``key`` (no counter/LRU effect) — explain's probe."""
+        with self._lock:
+            if key in self._device:
+                return TIER_DEVICE
+            if key in self._host:
+                return TIER_HOST
+            return None
+
+    # ------------------------------------------------------------------
+    # Admission / eviction.
+    # ------------------------------------------------------------------
+
+    def put(self, key: ResultCacheKey, table) -> Optional[str]:
+        """Store an admitted result; returns the tier it landed in, or
+        None when it exceeds every budget (too large to hold).
+
+        Device→host transfers (``to_host``) happen OUTSIDE the lock —
+        one demotion cascade must not stall every concurrent get()
+        probe behind a multi-hundred-MB device fetch."""
+        nbytes = table_nbytes(table)
+        if nbytes <= self.device_bytes:
+            with self._lock:
+                self._drop(key)
+                self._device[key] = (table, nbytes)
+                self._device_nbytes += nbytes
+                self.admissions += 1
+                victims = self._pop_device_victims()
+            self._demote(victims)
+            return TIER_DEVICE
+        if nbytes <= self.host_bytes:
+            host_copy = table.to_host()  # outside the lock
+            with self._lock:
+                self._drop(key)
+                self._host[key] = (host_copy, nbytes)
+                self._host_nbytes += nbytes
+                self.admissions += 1
+                self._evict_host_overflow()
+            return TIER_HOST
+        return None
+
+    def note_rejected(self) -> None:
+        with self._lock:
+            self.rejections += 1
+
+    def _drop(self, key: ResultCacheKey) -> None:
+        old = self._device.pop(key, None)
+        if old is not None:
+            self._device_nbytes -= old[1]
+        old = self._host.pop(key, None)
+        if old is not None:
+            self._host_nbytes -= old[1]
+
+    def _pop_device_victims(self) -> list:
+        """Under the lock: pop LRU device entries past the budget.
+        Victims that fit the host budget are returned for out-of-lock
+        demotion (a concurrent get() during the handoff misses them —
+        a benign recompute, never a stale serve); the rest are evicted
+        for good right here."""
+        victims = []
+        while self._device_nbytes > self.device_bytes \
+                and len(self._device) > 1:
+            vk, (vt, vn) = self._device.popitem(last=False)
+            self._device_nbytes -= vn
+            if vn <= self.host_bytes:
+                self.demotions += 1
+                victims.append((vk, vt, vn))
+            else:
+                self.evictions += 1
+                if self._on_evict is not None:
+                    self._on_evict(TIER_DEVICE, vn, False)
+        return victims
+
+    def _demote(self, victims: list) -> None:
+        for vk, vt, vn in victims:
+            host_copy = vt.to_host()  # outside the lock
+            with self._lock:
+                if vk in self._device or vk in self._host:
+                    continue  # re-admitted during the handoff; keep that
+                self._host[vk] = (host_copy, vn)
+                self._host_nbytes += vn
+                self._evict_host_overflow()
+            if self._on_evict is not None:
+                self._on_evict(TIER_DEVICE, vn, True)
+
+    def _evict_host_overflow(self) -> None:
+        # Caller holds the lock. Host victims are gone for good.
+        while self._host_nbytes > self.host_bytes and len(self._host) > 1:
+            _, (_, vn) = self._host.popitem(last=False)
+            self._host_nbytes -= vn
+            self.evictions += 1
+            if self._on_evict is not None:
+                self._on_evict(TIER_HOST, vn, False)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._device.clear()
+            self._host.clear()
+            self._device_nbytes = 0
+            self._host_nbytes = 0
+
+    # ------------------------------------------------------------------
+    # Observability.
+    # ------------------------------------------------------------------
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "hits": self.hits,
+                "device_hits": self.device_hits,
+                "host_hits": self.host_hits,
+                "misses": self.misses,
+                "admissions": self.admissions,
+                "rejections": self.rejections,
+                "demotions": self.demotions,
+                "evictions": self.evictions,
+                "device_entries": len(self._device),
+                "host_entries": len(self._host),
+                "device_nbytes": self._device_nbytes,
+                "host_nbytes": self._host_nbytes,
+            }
+
+
+def build_result_cache(session) -> Optional[ResultCache]:
+    """Session hook (wired through CacheWithTransform on the serving conf
+    string, so budget changes rebuild — and thereby clear — the cache)."""
+    conf = session.hs_conf
+    if not conf.result_cache_enabled():
+        return None
+
+    def on_evict(tier: str, nbytes: int, demoted: bool) -> None:
+        from ..telemetry.events import ResultCacheEvictionEvent
+        from ..telemetry.logging import get_logger
+        get_logger(conf.event_logger_class()).log_event(
+            ResultCacheEvictionEvent(
+                message=f"result cache evicted {nbytes} bytes from "
+                        f"{tier} tier" + (" (demoted)" if demoted else ""),
+                tier=tier, nbytes=nbytes, demoted=demoted))
+
+    return ResultCache(conf.result_cache_device_bytes(),
+                       conf.result_cache_host_bytes(), on_evict)
+
+
+def execute_with_cache(session, cache: ResultCache, plan):
+    """Session.execute body when the result cache is on: probe, serve on
+    hit (skipping plan rewrite AND execution), otherwise execute and run
+    the admission policy. Events mirror the action-event convention."""
+    from ..telemetry.events import (ResultCacheAdmitEvent,
+                                    ResultCacheHitEvent,
+                                    ResultCacheMissEvent)
+    from ..telemetry.logging import get_logger
+
+    norm = normalize(plan)
+    key = compute_key(session, plan, normalized=norm)
+    if key is None:
+        # Uncacheable shape: execute as if the cache did not exist.
+        return session._run_optimized(
+            session.optimize(norm, _pre_normalized=True))
+    logger = get_logger(session.hs_conf.event_logger_class())
+    hit = cache.get(key)
+    if hit is not None:
+        table, tier = hit
+        logger.log_event(ResultCacheHitEvent(
+            message=f"result served from cache ({tier} tier)",
+            key_digest=key.digest(), tier=tier,
+            nbytes=table_nbytes(table)))
+        return table
+    logger.log_event(ResultCacheMissEvent(
+        message="result cache miss", key_digest=key.digest()))
+    optimized = session.optimize(norm, _pre_normalized=True)
+    t0 = time.perf_counter()
+    table = session._run_optimized(optimized)
+    elapsed = time.perf_counter() - t0
+    conf = session.hs_conf
+    admit = elapsed >= conf.result_cache_min_compute_seconds() and \
+        estimate_recompute_bytes(optimized) >= \
+        conf.result_cache_min_input_bytes()
+    tier = cache.put(key, table) if admit else None
+    if tier is not None:
+        logger.log_event(ResultCacheAdmitEvent(
+            message=f"result admitted to cache ({tier} tier)",
+            key_digest=key.digest(), tier=tier,
+            nbytes=table_nbytes(table)))
+    else:
+        cache.note_rejected()
+    return table

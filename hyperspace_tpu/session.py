@@ -13,6 +13,8 @@ from __future__ import annotations
 
 import importlib
 import os
+import threading
+from collections import OrderedDict
 from typing import Dict, List, Optional, Tuple, Union as TUnion
 
 from .config import Conf, HyperspaceConf
@@ -45,6 +47,21 @@ class Session:
         self._provider_manager_cache = CacheWithTransform(
             self.hs_conf.file_based_source_builders, self._build_provider_manager)
         self._index_collection_manager = None
+        # Serving layer: the result cache instance follows the serving
+        # conf string (enabled flag + budgets) — rebuilt, and thereby
+        # cleared, when that changes. The SQL plan memo keys on the
+        # temp-view registry version (any view change flips it).
+        self._result_cache_holder = CacheWithTransform(
+            self.hs_conf.result_cache_conf_string, self._build_result_cache)
+        # CacheWithTransform itself is not thread-safe; the holder is
+        # probed on every execute() of the multi-threaded serving path.
+        self._result_cache_lock = threading.Lock()
+        self._temp_views_version = 0
+        self._sql_plan_cache: "OrderedDict[Tuple, LogicalPlan]" = OrderedDict()
+        self._sql_plan_stats = {"hits": 0, "misses": 0}
+        # The memo is on the multi-threaded serving path (like the
+        # result cache, which carries its own lock).
+        self._sql_plan_lock = threading.Lock()
 
     @property
     def index_collection_manager(self):
@@ -54,6 +71,17 @@ class Session:
             from .index.manager import CachingIndexCollectionManager
             self._index_collection_manager = CachingIndexCollectionManager(self)
         return self._index_collection_manager
+
+    def _build_result_cache(self, raw: str):
+        from .serving.result_cache import build_result_cache
+        return build_result_cache(self)
+
+    @property
+    def result_cache(self):
+        """The serving-layer result cache (serving/result_cache.py), or
+        None while ``serving.result_cache.enabled`` is false."""
+        with self._result_cache_lock:
+            return self._result_cache_holder.load()
 
     @property
     def read(self) -> "DataFrameReader":
@@ -74,6 +102,7 @@ class Session:
         if key in views and not replace:
             raise HyperspaceException(f"Temp view already exists: {name}")
         views[key] = df.plan
+        self._temp_views_version += 1
 
     def table(self, name: str) -> "DataFrame":
         """DataFrame over a registered temp view. The view shares the
@@ -87,7 +116,10 @@ class Session:
 
     def drop_temp_view(self, name: str) -> bool:
         views = getattr(self, "_temp_views", {})
-        return views.pop(name.lower(), None) is not None
+        dropped = views.pop(name.lower(), None) is not None
+        if dropped:
+            self._temp_views_version += 1
+        return dropped
 
     # ------------------------------------------------------------------
     # Source providers (parity: FileBasedSourceProviderManager.buildProviders).
@@ -130,30 +162,45 @@ class Session:
     # Execution.
     # ------------------------------------------------------------------
 
-    def optimize(self, plan: LogicalPlan) -> LogicalPlan:
+    def optimize(self, plan: LogicalPlan,
+                 _pre_normalized: bool = False) -> LogicalPlan:
         """General optimizations (column pruning), the hyperspace rewrite
         batch if enabled, then partition pruning. Partition pruning is
         always on (like Spark's native pruning) but must run AFTER the
         index rules: it narrows a Scan's file list, and the index rules
         fingerprint the relation's full file listing — pruning first would
         mismatch every index signature (same ordering rule as the
-        data-skipping rule inside the batch)."""
+        data-skipping rule inside the batch).
+
+        ``_pre_normalized``: the caller already ran serving.fingerprint.
+        normalize (= the first two passes here) on ``plan`` — skip them
+        rather than re-walking the tree (the result-cache miss path)."""
         from .rules.column_pruning import prune_columns
         from .rules.pushdown import push_filters
         from .sources.partitions import prune_partitions
         # Catalyst-parity normalization first: predicates sink below
         # projections so the index rules see Scan→Filter shapes regardless
         # of how the user ordered select()/where().
-        plan = push_filters(plan)
-        plan = prune_columns(plan)
+        if not _pre_normalized:
+            plan = push_filters(plan)
+            plan = prune_columns(plan)
         if self._hyperspace_enabled:
             from .rules.apply_hyperspace import apply_hyperspace
             plan = apply_hyperspace(self, plan)
         return prune_partitions(plan)
 
     def execute(self, plan: LogicalPlan):
+        cache = self.result_cache
+        if cache is not None:
+            # Serving path: probe the result cache first — a hit skips
+            # the rewrite batch AND execution (serving/result_cache.py);
+            # a miss executes below and runs the admission policy.
+            from .serving.result_cache import execute_with_cache
+            return execute_with_cache(self, cache, plan)
+        return self._run_optimized(self.optimize(plan))
+
+    def _run_optimized(self, optimized: LogicalPlan):
         from .execution import execute as run
-        optimized = self.optimize(plan)
         trace_dir = self.hs_conf.trace_dir()
         if trace_dir:
             # XLA-profiler integration (SURVEY §5): device timelines for
@@ -171,9 +218,32 @@ class Session:
     def sql(self, text: str) -> "DataFrame":
         """Lower one SQL SELECT over registered temp views onto the
         DataFrame IR (see hyperspace_tpu/sql.py for the supported
-        subset); index rewrites apply exactly as for DataFrame queries."""
+        subset); index rewrites apply exactly as for DataFrame queries.
+
+        With the serving result cache enabled, the lowered plan is also
+        memoized per (text, temp-view registry version, case mode) — the
+        parse+analyze pass is pure given those, and a serving workload
+        re-issues identical texts."""
         from .sql import sql as _sql
-        return _sql(self, text)
+        size = self.hs_conf.result_cache_plan_cache_size() \
+            if self.result_cache is not None else 0
+        if size <= 0:
+            return _sql(self, text)
+        key = (text, self._temp_views_version,
+               self.hs_conf.case_sensitive())
+        with self._sql_plan_lock:
+            plan = self._sql_plan_cache.get(key)
+            if plan is not None:
+                self._sql_plan_cache.move_to_end(key)
+                self._sql_plan_stats["hits"] += 1
+                return DataFrame(self, plan)
+            self._sql_plan_stats["misses"] += 1
+        df = _sql(self, text)
+        with self._sql_plan_lock:
+            self._sql_plan_cache[key] = df.plan
+            while len(self._sql_plan_cache) > size:
+                self._sql_plan_cache.popitem(last=False)
+        return df
 
 
 class DataFrameReader:

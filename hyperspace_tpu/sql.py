@@ -1134,7 +1134,9 @@ class _Parser:
             # aggregate referencing the shadowed original is refused.
             synth = {nm for _, nm in group_exprs}
             for ie, _alias in items:
-                if ie is not None and _contains_agg(ie) and                         synth & set(ie.references) &                         set(df.plan.schema.names):
+                if ie is not None and _contains_agg(ie) \
+                        and synth & set(ie.references) \
+                        & set(df.plan.schema.names):
                     raise HyperspaceException(
                         "SQL: an aggregate references a column shadowed "
                         f"by a GROUP BY expression alias ({sorted(synth & set(ie.references))})")

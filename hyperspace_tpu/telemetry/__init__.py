@@ -1,6 +1,8 @@
 from .events import (  # noqa: F401
     CancelActionEvent, CreateActionEvent, DeleteActionEvent, HyperspaceEvent,
-    HyperspaceIndexUsageEvent, OptimizeActionEvent, RefreshActionEvent,
-    RefreshIncrementalActionEvent, RefreshQuickActionEvent, RestoreActionEvent,
+    HyperspaceIndexUsageEvent, IndexCacheHitEvent, IndexCacheMissEvent,
+    OptimizeActionEvent, RefreshActionEvent, RefreshIncrementalActionEvent,
+    RefreshQuickActionEvent, RestoreActionEvent, ResultCacheAdmitEvent,
+    ResultCacheEvictionEvent, ResultCacheHitEvent, ResultCacheMissEvent,
     VacuumActionEvent)
 from .logging import EventLogger, HyperspaceEventLogging, NoOpEventLogger, get_logger  # noqa: F401

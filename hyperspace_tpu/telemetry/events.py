@@ -95,3 +95,56 @@ class HyperspaceIndexUsageEvent(HyperspaceEvent):
 
     index_names: List[str] = field(default_factory=list)
     plan_string: str = ""
+
+
+@dataclass
+class ResultCacheEvent(HyperspaceEvent):
+    """Base of the serving-layer result-cache events (no reference
+    analogue; see serving/result_cache.py). ``key_digest`` is the stable
+    short form of the cache key; ``tier`` is "device" | "host"."""
+
+    key_digest: str = ""
+    tier: str = ""
+    nbytes: int = 0
+
+
+@dataclass
+class ResultCacheHitEvent(ResultCacheEvent):
+    pass
+
+
+@dataclass
+class ResultCacheMissEvent(ResultCacheEvent):
+    pass
+
+
+@dataclass
+class ResultCacheAdmitEvent(ResultCacheEvent):
+    pass
+
+
+@dataclass
+class ResultCacheEvictionEvent(ResultCacheEvent):
+    """``demoted`` — a device-tier victim that moved to the host tier
+    (still servable) rather than leaving the cache entirely."""
+
+    demoted: bool = False
+
+
+@dataclass
+class IndexCacheProbeEvent(HyperspaceEvent):
+    """Base of the HBM index-table-cache probe events: the executor emits
+    one per IndexScan cache lookup (execution/index_cache.py counts were
+    previously invisible outside the process)."""
+
+    index_name: str = ""
+
+
+@dataclass
+class IndexCacheHitEvent(IndexCacheProbeEvent):
+    pass
+
+
+@dataclass
+class IndexCacheMissEvent(IndexCacheProbeEvent):
+    pass

@@ -20,6 +20,22 @@ PACKAGE_DIRS = ("hyperspace_tpu",)
 ALL_DIRS = ("hyperspace_tpu", "tests", "scripts")
 TOP_FILES = ("bench.py", "__graft_entry__.py")
 
+# Config/env-knob discipline: package code reads knobs through config.py
+# accessors, never ad-hoc os.environ — otherwise knobs are undocumented,
+# unhashable into cache keys, and invisible to the conf system. This list
+# is FROZEN: config.py is the sanctioned reader, the rest are pre-gate
+# legacy (executor-side switches documented in their module docstrings).
+# New modules (e.g. serving/) must not be added here.
+ENV_READ_ALLOWLIST = frozenset({
+    "hyperspace_tpu/config.py",
+    "hyperspace_tpu/execution/__init__.py",
+    "hyperspace_tpu/execution/index_cache.py",
+    "hyperspace_tpu/execution/spmd.py",
+    "hyperspace_tpu/native/__init__.py",
+    "hyperspace_tpu/ops/pallas_kernels.py",
+    "hyperspace_tpu/parallel/multihost.py",
+})
+
 
 def iter_sources():
     for d in ALL_DIRS:
@@ -66,6 +82,21 @@ def unused_imports(tree: ast.AST) -> list:
                   if name not in used and not name.startswith("_"))
 
 
+def env_reads(tree: ast.AST) -> list:
+    """Line numbers of os.environ / os.getenv style env accesses."""
+    out = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Attribute) \
+                and isinstance(node.value, ast.Name) \
+                and node.value.id == "os" \
+                and node.attr in ("environ", "getenv"):
+            out.append(node.lineno)
+        elif isinstance(node, ast.ImportFrom) and node.module == "os":
+            if any(a.name in ("environ", "getenv") for a in node.names):
+                out.append(node.lineno)
+    return sorted(set(out))
+
+
 def main() -> int:
     problems = []
     for path in iter_sources():
@@ -88,6 +119,12 @@ def main() -> int:
                 and os.path.basename(path) != "__init__.py":  # re-exports
             for line, name in unused_imports(tree):
                 problems.append(f"{rel}:{line}: unused import '{name}'")
+        if any(rel.startswith(d + os.sep) for d in PACKAGE_DIRS) \
+                and rel.replace(os.sep, "/") not in ENV_READ_ALLOWLIST:
+            for line in env_reads(tree):
+                problems.append(
+                    f"{rel}:{line}: ad-hoc env read (os.environ/getenv); "
+                    "knobs must go through config.py accessors")
     for p in problems:
         print(p)
     print(f"lint: {len(problems)} problem(s) across "

@@ -1,0 +1,225 @@
+"""Result-cache invalidation races (the satellite of serving/result_cache).
+
+No stale result may EVER be served: a cached entry's key pins the plan,
+the source files (size/mtime/path), the index op-log state, and the conf
+— so any interleaved `refreshIndex` / source append / index create must
+make old entries unreachable. These tests interleave cached queries with
+every mutating action (the deterministic oracle loop), race a real OS
+process running a refresh against a querying parent (the
+test_log_concurrency reader/writer pattern), and hammer the cache object
+itself from threads (the serving access pattern).
+"""
+
+import multiprocessing as mp
+import os
+import threading
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+import pyarrow.parquet as pq
+
+import hyperspace_tpu as hst
+from hyperspace_tpu.api import Hyperspace, IndexConfig
+from hyperspace_tpu.index.constants import IndexConstants
+from hyperspace_tpu.plan.expr import col
+from hyperspace_tpu.serving.constants import ServingConstants
+from hyperspace_tpu.serving.fingerprint import ResultCacheKey
+from hyperspace_tpu.serving.result_cache import ResultCache, table_nbytes
+
+
+def _seed(tmp_path, n=4000):
+    rng = np.random.default_rng(5)
+    data_dir = tmp_path / "data"
+    data_dir.mkdir()
+    df = pd.DataFrame({
+        "k": rng.integers(0, 60, n).astype(np.int64),
+        "v": rng.integers(0, 9, n).astype(np.int64),
+    })
+    pq.write_table(pa.Table.from_pandas(df), data_dir / "p.parquet")
+    (tmp_path / "indexes").mkdir()
+    return df
+
+
+def _session(tmp_path, cache_on=True):
+    session = hst.Session(system_path=str(tmp_path / "indexes"))
+    session.conf.set(IndexConstants.INDEX_NUM_BUCKETS, 4)
+    session.conf.set(IndexConstants.TPU_DISTRIBUTED_ENABLED, "false")
+    if cache_on:
+        session.conf.set(ServingConstants.RESULT_CACHE_ENABLED, "true")
+        session.conf.set(
+            ServingConstants.RESULT_CACHE_MIN_COMPUTE_SECONDS, "0")
+    return session
+
+
+class TestInterleavedInvalidation:
+    def test_oracle_loop_across_every_mutation(self, tmp_path):
+        """Cached session vs cache-off oracle session over one dataset:
+        after EVERY mutating step (append, create, incremental refresh,
+        optimize, full refresh, delete) both sessions run the same fresh
+        query and must agree — a stale serve would break equality."""
+        _seed(tmp_path)
+        cached = _session(tmp_path, cache_on=True)
+        oracle = _session(tmp_path, cache_on=False)
+        hs = Hyperspace(cached)
+        data_dir = str(tmp_path / "data")
+
+        def check(tag):
+            q_c = cached.read.parquet(data_dir) \
+                .filter(col("k") == 7).select("k", "v")
+            q_o = oracle.read.parquet(data_dir) \
+                .filter(col("k") == 7).select("k", "v")
+            # Twice on the cached side: the second run exercises a hit
+            # (or a just-invalidated miss). Serving must be byte-exact
+            # between the two; the cross-session oracle compares row
+            # MULTISETS (the query has no ORDER BY, and the two sessions
+            # may legally pick different physical plans/row orders).
+            a1, a2 = q_c.to_pandas(), q_c.to_pandas()
+            expected = q_o.to_pandas()
+            pd.testing.assert_frame_equal(a1, a2, obj=tag + "/hit")
+
+            def canon(frame):
+                return frame.sort_values(list(frame.columns)) \
+                    .reset_index(drop=True)
+
+            pd.testing.assert_frame_equal(canon(a1), canon(expected),
+                                          obj=tag)
+
+        def append(seed, n=500):
+            rng = np.random.default_rng(seed)
+            pq.write_table(pa.Table.from_pandas(pd.DataFrame({
+                "k": rng.integers(0, 60, n).astype(np.int64),
+                "v": rng.integers(0, 9, n).astype(np.int64)})),
+                tmp_path / "data" / f"extra{seed}.parquet")
+
+        check("baseline")
+        append(1)
+        check("after append")
+        df = cached.read.parquet(data_dir)
+        cached.conf.set(IndexConstants.INDEX_LINEAGE_ENABLED, "true")
+        hs.create_index(df, IndexConfig("ccIdx", ["k"], ["v"]))
+        cached.enable_hyperspace()
+        oracle.enable_hyperspace()
+        check("after create, enabled")
+        append(2)
+        hs.refresh_index("ccIdx", "incremental")
+        check("after incremental refresh")
+        hs.optimize_index("ccIdx", "quick")
+        check("after optimize")
+        append(3)
+        hs.refresh_index("ccIdx", "full")
+        check("after full refresh")
+        hs.delete_index("ccIdx")
+        check("after delete")
+        stats = cached.result_cache.stats()
+        assert stats["hits"] >= 1, stats  # the loop did exercise serving
+
+
+class TestThreadSafety:
+    def test_concurrent_get_put_under_pressure(self):
+        """16 threads share one budget-constrained cache: no exceptions,
+        byte accounting stays within budgets, counters reconcile."""
+        from hyperspace_tpu.execution.columnar import Table
+
+        def make(i):
+            return Table.from_arrow(pa.table(
+                {"x": pa.array(np.full(256, i, np.int64))}))
+
+        tables = [make(i) for i in range(8)]
+        nbytes = table_nbytes(tables[0])
+        cache = ResultCache(device_bytes=3 * nbytes,
+                            host_bytes=3 * nbytes)
+        errors = []
+        gets = 24 * 40
+
+        def worker(tid):
+            try:
+                rng = np.random.default_rng(tid)
+                for i in range(40):
+                    key = ResultCacheKey(
+                        f"p{int(rng.integers(0, 8))}", "s", (), "c")
+                    r = cache.get(key)
+                    if r is None:
+                        cache.put(key, tables[int(rng.integers(0, 8))])
+                    # A second probe mixes tiers while others evict.
+                    cache.get(ResultCacheKey(
+                        f"p{int(rng.integers(0, 8))}", "s", (), "c"))
+            except Exception as e:  # pragma: no cover - failure channel
+                errors.append(e)
+
+        threads = [threading.Thread(target=worker, args=(t,))
+                   for t in range(12)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        assert not errors, errors
+        s = cache.stats()
+        assert s["device_nbytes"] <= cache.device_bytes
+        assert s["host_nbytes"] <= cache.host_bytes
+        assert s["hits"] + s["misses"] == gets
+        assert s["device_nbytes"] == sum(
+            n for _, n in cache._device.values())
+        assert s["host_nbytes"] == sum(n for _, n in cache._host.values())
+
+
+def _refresh_worker(root, q):
+    """Child process: run an incremental refresh while the parent serves
+    cached queries (test_log_concurrency._refresh_worker pattern)."""
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import sys
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+    import hyperspace_tpu as hst
+    from hyperspace_tpu.api import Hyperspace
+
+    session = hst.Session(system_path=os.path.join(root, "indexes"))
+    from hyperspace_tpu.index.constants import IndexConstants as IC
+    session.conf.set(IC.TPU_DISTRIBUTED_ENABLED, "false")
+    try:
+        Hyperspace(session).refresh_index("raceIdx", "incremental")
+        q.put(("refresh", "ok"))
+    except Exception as e:  # pragma: no cover - diagnostic channel
+        q.put(("refresh", f"err: {e}"))
+
+
+class TestReaderWriterRace:
+    def test_cached_queries_stable_during_refresh(self, tmp_path):
+        """With the result cache ON, a refresh racing in another process
+        must never change the answers of a pinned-snapshot query
+        mid-flight (cache keys flip with the op log, recomputes land on
+        the same snapshot), and a FRESH relation after the refresh must
+        see the appended rows — not a stale cached result."""
+        df = _seed(tmp_path)
+        session = _session(tmp_path, cache_on=True)
+        hs = Hyperspace(session)
+        t = session.read.parquet(str(tmp_path / "data"))
+        hs.create_index(t, IndexConfig("raceIdx", ["k"], ["v"]))
+        rng = np.random.default_rng(6)
+        pq.write_table(pa.Table.from_pandas(pd.DataFrame({
+            "k": rng.integers(0, 60, 1500).astype(np.int64),
+            "v": rng.integers(0, 9, 1500).astype(np.int64),
+        })), tmp_path / "data" / "extra.parquet")
+
+        ctx = mp.get_context("spawn")
+        q = ctx.Queue()
+        p = ctx.Process(target=_refresh_worker, args=(str(tmp_path), q))
+        p.start()
+        session.enable_hyperspace()
+        expected = (df.k == 7).sum()
+        query = t.filter(col("k") == 7).select("k", "v")
+        import time
+        deadline = time.monotonic() + 300
+        while p.is_alive():
+            assert time.monotonic() < deadline, "refresh child hung"
+            assert len(query.to_pandas()) == expected
+        tag, status = q.get(timeout=300)
+        p.join(timeout=300)
+        assert status == "ok", status
+        # Post-refresh, a fresh listing must produce the bigger answer —
+        # the cache serves it only under the fresh key.
+        t2 = session.read.parquet(str(tmp_path / "data"))
+        got = len(t2.filter(col("k") == 7).select("k", "v").to_pandas())
+        session.disable_hyperspace()
+        raw = len(t2.filter(col("k") == 7).select("k", "v").to_pandas())
+        assert got == raw > expected

@@ -115,6 +115,83 @@ class TestActionEvents:
         assert "RefreshQuickActionEvent" in names_of(evs)
 
 
+class TestCacheEvents:
+    """Serving result-cache events (serving/result_cache.py) + the
+    index-table-cache probe events (execution/executor.py): hit/miss/
+    admit/evict all flow through the conf-pluggable logger."""
+
+    def _serving(self, env):
+        from hyperspace_tpu.serving.constants import ServingConstants
+        session = env["session"]
+        session.conf.set(IndexConstants.TPU_DISTRIBUTED_ENABLED, "false")
+        session.conf.set(ServingConstants.RESULT_CACHE_ENABLED, "true")
+        session.conf.set(
+            ServingConstants.RESULT_CACHE_MIN_COMPUTE_SECONDS, "0")
+        return session
+
+    def test_result_cache_miss_admit_then_hit(self, env):
+        session = self._serving(env)
+        q = session.read.parquet(env["path"]) \
+            .filter(col("k") == 3).select("k", "v")
+        mark = len(sink().events)
+        q.to_pandas()
+        evs, mark = take_new(mark)
+        assert "ResultCacheMissEvent" in names_of(evs)
+        assert "ResultCacheAdmitEvent" in names_of(evs)
+        admit = [e for e in evs
+                 if type(e).__name__ == "ResultCacheAdmitEvent"][0]
+        assert admit.tier == "device" and admit.nbytes > 0
+        assert admit.key_digest
+        q.to_pandas()
+        evs, _ = take_new(mark)
+        hits = [e for e in evs
+                if type(e).__name__ == "ResultCacheHitEvent"]
+        assert hits and hits[0].key_digest == admit.key_digest
+        assert "result served from cache" in hits[0].message
+
+    def test_result_cache_eviction_event_on_demotion(self, env):
+        from hyperspace_tpu.serving.constants import ServingConstants
+        session = self._serving(env)
+        q1 = session.read.parquet(env["path"]).filter(col("k") == 3)
+        q1.to_pandas()
+        nbytes = session.result_cache.stats()["device_nbytes"]
+        session.conf.set(
+            ServingConstants.RESULT_CACHE_DEVICE_BYTES, str(nbytes))
+        q1.to_pandas()  # refill the rebuilt cache
+        mark = len(sink().events)
+        session.read.parquet(env["path"]) \
+            .filter(col("k") == 3).select("v", "k").to_pandas()
+        evs, _ = take_new(mark)
+        evictions = [e for e in evs
+                     if type(e).__name__ == "ResultCacheEvictionEvent"]
+        assert evictions and evictions[0].tier == "device"
+        assert evictions[0].demoted
+
+    def test_index_cache_probe_events(self, env):
+        from hyperspace_tpu.plan import expr as E
+        session = self._serving(env)
+        hs = env["hs"]
+        df = session.read.parquet(env["path"])
+        hs.create_index(df, IndexConfig("icIdx", ["k"], ["v"]))
+        session.enable_hyperspace()
+        # Group-bys probe the HBM index-table cache without a pushable
+        # filter (leading-column equality filters take the pruned-read
+        # path, which bypasses the cache by design).
+        mark = len(sink().events)
+        df.group_by("k").agg(E.Sum(col("v")).alias("s")).to_pandas()
+        evs, mark = take_new(mark)
+        misses = [e for e in evs
+                  if type(e).__name__ == "IndexCacheMissEvent"]
+        assert misses and misses[0].index_name == "icIdx"
+        # Different aggregate over the SAME columns: the RESULT cache
+        # misses (new plan), but the index table probe now hits HBM.
+        df.group_by("k").agg(E.Avg(col("v")).alias("a")).to_pandas()
+        evs, _ = take_new(mark)
+        hits = [e for e in evs
+                if type(e).__name__ == "IndexCacheHitEvent"]
+        assert hits and hits[0].index_name == "icIdx"
+
+
 class TestUsageEvents:
     def test_rewrite_emits_index_usage_event(self, env):
         hs, session = env["hs"], env["session"]
