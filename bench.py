@@ -569,16 +569,34 @@ def _backend_dead() -> bool:
     return _BACKEND_DEAD
 
 
+def _compile_counter() -> int:
+    """Process-level XLA compile counter (execution/shapes.py, hooked on
+    jax.monitoring) — the per-phase tally the shape-bucketing acceptance
+    tracks; 0 before hyperspace_tpu is importable."""
+    try:
+        from hyperspace_tpu.execution import shapes
+        return shapes.compile_count()
+    except Exception:
+        return 0
+
+
 def _phase(name: str):
     """Decorator-less phase guard: returns True if fn ran clean. Failures
-    are recorded in RESULT["errors"] and the bench continues."""
+    are recorded in RESULT["errors"] and the bench continues. Each phase
+    also records its XLA compile delta from the process-level counter."""
     class _Ctx:
         def __enter__(self):
             RESULT["phase_current"] = name
+            self._compiles0 = _compile_counter()
             _spill_partial()
             return self
 
+        def _record_compiles(self):
+            delta = _compile_counter() - self._compiles0
+            RESULT.setdefault("phase_compiles", {})[name] = delta
+
         def __exit__(self, et, ev, tb):
+            self._record_compiles()
             if et is not None and issubclass(et, Exception):
                 import traceback
                 # Record the *last frames*, not just the message: JAX wraps
@@ -915,7 +933,12 @@ def _single_device_phases(args, root):
             continue
         with _phase(f"time_{name}"), _CompileLogBank(name):
             session.enable_hyperspace()
+            c0 = _compile_counter()
             q.to_arrow()  # warm indexed path (compiles bank per-program)
+            RESULT[f"{name}_compiles_first_run"] = _compile_counter() - c0
+            c0 = _compile_counter()
+            q.to_arrow()
+            RESULT[f"{name}_compiles_second_run"] = _compile_counter() - c0
             session.disable_hyperspace()
             q.to_arrow()  # warm scan path
             scan_s = timed_best(lambda: q.to_arrow(), args.repeats)
@@ -1110,13 +1133,21 @@ def _run_lake_phase(args, root: str) -> None:
     probe()  # warm: loads + caches the sketch table
     reps = max(args.repeats, 3)
     # The C++ probe is opt-in since round 5 (numpy measured 2-3x faster
-    # at every lake scale — native.probe_native_enabled docstring); the
-    # A/B stays in the bench so the decision re-measures every round.
+    # at every lake scale — native.probe_native_enabled docstring) and
+    # file-count-gated since round 7: below probe_min_files() the native
+    # path auto-disables so it can never lose to the numpy fallback. The
+    # forced A/B stays in the bench so the decision re-measures every
+    # round; the headline speedup is only emitted when the gate would
+    # actually dispatch native for this lake's shape.
+    gated = n_files < native.probe_min_files()
+    RESULT["lake_plan_native_auto_disabled"] = bool(
+        gated or not native.available())
+    RESULT["lake_plan_native_min_files"] = native.probe_min_files()
     if native.available():
         prior = os.environ.get("HST_NATIVE_PROBE")
-        os.environ["HST_NATIVE_PROBE"] = "on"
+        os.environ["HST_NATIVE_PROBE"] = "force"
         try:
-            RESULT["lake_plan_native_ms"] = round(
+            RESULT["lake_plan_native_forced_ms"] = round(
                 timed_best(probe, reps) * 1000, 3)
         finally:
             if prior is None:
@@ -1125,9 +1156,15 @@ def _run_lake_phase(args, root: str) -> None:
                 os.environ["HST_NATIVE_PROBE"] = prior
     RESULT["lake_plan_numpy_ms"] = round(
         timed_best(probe, reps) * 1000, 3)
-    if "lake_plan_native_ms" in RESULT and RESULT["lake_plan_native_ms"] > 0:
-        RESULT["lake_plan_native_speedup"] = round(
-            RESULT["lake_plan_numpy_ms"] / RESULT["lake_plan_native_ms"], 2)
+    forced = RESULT.get("lake_plan_native_forced_ms", 0)
+    if forced:
+        RESULT["lake_plan_native_forced_speedup"] = round(
+            RESULT["lake_plan_numpy_ms"] / forced, 2)
+    if not RESULT["lake_plan_native_auto_disabled"] and forced:
+        # Gate open for this shape: the forced timing IS the native path.
+        RESULT["lake_plan_native_ms"] = forced
+        RESULT["lake_plan_native_speedup"] = \
+            RESULT["lake_plan_native_forced_speedup"]
 
     # End-to-end: the same queries with skipping on vs the raw scan.
     for qname, q in (("lake_minmax", q_mm), ("lake_bloom", q_bloom)):

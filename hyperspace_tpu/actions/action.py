@@ -60,12 +60,16 @@ class Action:
 
     def run(self) -> None:
         logger = get_logger(self.session.hs_conf.event_logger_class())
+        # Shape-class scope: build/refresh/optimize kernels (sorts, hashes,
+        # sketch reductions) read the session's shapeBucketing conf.
+        from ..execution import shapes
         try:
             logger.log_event(self.event("Operation started."))
-            self.validate()
-            self._begin()
-            self.op()
-            self._end()
+            with shapes.use_conf(self.session.hs_conf):
+                self.validate()
+                self._begin()
+                self.op()
+                self._end()
             logger.log_event(self.event("Operation succeeded."))
         except NoChangesException as e:
             logger.log_event(self.event(f"No-op operation recorded: {e}"))

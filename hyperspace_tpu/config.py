@@ -196,6 +196,31 @@ class HyperspaceConf:
     def trace_dir(self) -> Optional[str]:
         return self._conf.get(IndexConstants.TPU_TRACE_DIR)
 
+    def shape_bucketing_enabled(self) -> bool:
+        return self._get_bool(
+            IndexConstants.TPU_SHAPE_BUCKETING_ENABLED,
+            IndexConstants.TPU_SHAPE_BUCKETING_ENABLED_DEFAULT)
+
+    def shape_bucketing_growth_factor(self) -> float:
+        return float(self._conf.get(
+            IndexConstants.TPU_SHAPE_BUCKETING_GROWTH_FACTOR,
+            IndexConstants.TPU_SHAPE_BUCKETING_GROWTH_FACTOR_DEFAULT))
+
+    def shape_bucketing_min_pad(self) -> int:
+        return int(self._conf.get(
+            IndexConstants.TPU_SHAPE_BUCKETING_MIN_PAD,
+            IndexConstants.TPU_SHAPE_BUCKETING_MIN_PAD_DEFAULT))
+
+    def shape_bucketing_max_waste_ratio(self) -> float:
+        return float(self._conf.get(
+            IndexConstants.TPU_SHAPE_BUCKETING_MAX_WASTE_RATIO,
+            IndexConstants.TPU_SHAPE_BUCKETING_MAX_WASTE_RATIO_DEFAULT))
+
+    def shape_bucketing_exact_fallback_rows(self) -> int:
+        return int(self._conf.get(
+            IndexConstants.TPU_SHAPE_BUCKETING_EXACT_FALLBACK_ROWS,
+            IndexConstants.TPU_SHAPE_BUCKETING_EXACT_FALLBACK_ROWS_DEFAULT))
+
     def max_chunk_rows(self) -> int:
         return int(
             self._conf.get(

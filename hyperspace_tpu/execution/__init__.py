@@ -38,11 +38,14 @@ def _host_fingerprint() -> str:
     return hashlib.sha1(bits.encode()).hexdigest()[:12]
 
 
-# CPU-backend sessions skip the persistent cache entirely: XLA:CPU compiles
-# are sub-second (the cache buys little) and this image's cache layer has
-# crashed twice under it — an Abort loading a stale-feature AOT entry and a
-# SIGSEGV serializing a fresh one. On accelerators the compile is tens of
-# seconds and serialization is the hardened path, so the cache stays on.
+# CPU-backend sessions skip the persistent cache BY DEFAULT: XLA:CPU
+# compiles are sub-second (the cache buys little) and this image's cache
+# layer has crashed twice under it — an Abort loading a stale-feature AOT
+# entry and a SIGSEGV serializing a fresh one. On accelerators the compile
+# is tens of seconds and serialization is the hardened path, so the cache
+# stays on. HST_XLA_CACHE=on OPTS IN on CPU too (the per-fingerprint dir
+# above makes that safe against host migration) so tests and the bench can
+# exercise the persistent-cache path without a chip.
 # Detection uses jax's RESOLVED backend (not the env var), so in-process
 # ``jax.config.update("jax_platforms", "cpu")`` switches — the bench's CPU
 # fallback, test conftest — are honored; it therefore runs lazily at
@@ -51,9 +54,12 @@ def _host_fingerprint() -> str:
 _cache_configured = False
 
 
-def ensure_compilation_cache() -> None:
+def ensure_compilation_cache(force: bool = False) -> None:
+    """Configure jax's persistent compilation cache per the policy above.
+    ``force`` re-evaluates after the first call (tests flip HST_XLA_CACHE
+    mid-process; production sessions never need it)."""
     global _cache_configured
-    if _cache_configured:
+    if _cache_configured and not force:
         return
     _cache_configured = True
     mode = os.environ.get("HST_XLA_CACHE", "auto")

@@ -59,9 +59,15 @@ class Column:
         return self.validity is not None
 
     def take(self, indices) -> "Column":
-        return Column(self.dtype, jnp.take(self.data, indices, axis=0),
+        # clip mode: padded gather indices (shape-class execution) may
+        # carry out-of-range filler in the pad tail; clipping keeps the
+        # gather defined (the clipped rows land in the pad region of the
+        # result and are never read as data).
+        return Column(self.dtype, jnp.take(self.data, indices, axis=0,
+                                           mode="clip"),
                       None if self.validity is None
-                      else jnp.take(self.validity, indices, axis=0),
+                      else jnp.take(self.validity, indices, axis=0,
+                                    mode="clip"),
                       self.dictionary)
 
     def slice(self, start: int, stop: int) -> "Column":
@@ -79,21 +85,61 @@ class Table:
     sorted by key_cols within each bucket — the covering-index invariant.
     The join path uses it to skip re-sorting (shuffle-free SMJ analogue).
     Operations that permute or merge rows must drop it.
+
+    ``valid_rows`` is the shape-class execution contract
+    (execution/shapes.py): when set, the column arrays are padded to a
+    length class and only rows ``[0, valid_rows)`` are data — the pad tail
+    holds arbitrary values that must never be read. ``num_rows`` is the
+    LOGICAL count; ``data_rows`` the physical array length. Everything
+    leaving the engine (to_arrow/to_host/compact) drops the padding, so
+    results are byte-identical to exact-shape execution.
     """
 
     columns: Dict[str, Column]
     bucket_order: Optional[Tuple[int, Tuple[str, ...]]] = None
+    valid_rows: Optional[int] = None
 
     def __post_init__(self):
         lengths = {len(c) for c in self.columns.values()}
         if len(lengths) > 1:
             raise HyperspaceException(f"Ragged table: column lengths {lengths}")
+        if self.valid_rows is not None:
+            phys = next(iter(lengths), 0)
+            if not 0 <= self.valid_rows <= phys:
+                raise HyperspaceException(
+                    f"valid_rows {self.valid_rows} outside [0, {phys}]")
+            if self.valid_rows == phys:
+                self.valid_rows = None  # exact: no padding in play
 
     @property
     def num_rows(self) -> int:
+        if self.valid_rows is not None:
+            return self.valid_rows
         if not self.columns:
             return 0
         return len(next(iter(self.columns.values())))
+
+    @property
+    def data_rows(self) -> int:
+        """Physical column length (== num_rows unless class-padded)."""
+        if not self.columns:
+            return 0
+        return len(next(iter(self.columns.values())))
+
+    @property
+    def is_padded(self) -> bool:
+        return self.valid_rows is not None
+
+    def compact(self) -> "Table":
+        """Drop class padding: slice every column to the valid prefix
+        (one fused program per (table signature, valid count) — a
+        data-dependent count compiles per value, so terminal results
+        prefer the free host-boundary trim in executor.execute). No-op
+        (and no copy) for exact tables."""
+        n = self.valid_rows
+        if n is None:
+            return self
+        return self.slice(0, n)
 
     @property
     def names(self) -> List[str]:
@@ -116,39 +162,90 @@ class Table:
 
     def select(self, names: Sequence[str]) -> "Table":
         return Table({n: self.column(n) for n in names},
-                     bucket_order=self._keep_order(names))
+                     bucket_order=self._keep_order(names),
+                     valid_rows=self.valid_rows)
 
-    def take(self, indices) -> "Table":
-        return Table({n: c.take(indices) for n, c in self.columns.items()})
+    def take(self, indices, valid_rows: Optional[int] = None) -> "Table":
+        """Row gather. ``valid_rows`` declares the valid prefix of a
+        class-padded ``indices`` array (shape-class execution). All
+        column buffers gather through ONE fused program
+        (kernels.gather_arrays) — one compile per table signature."""
+        from ..ops import kernels
+        arrays, spec = [], []
+        for n, c in self.columns.items():
+            arrays.append(c.data)
+            spec.append((n, "d"))
+            if c.validity is not None:
+                arrays.append(c.validity)
+                spec.append((n, "v"))
+        taken = dict(zip(spec, kernels.gather_arrays(indices, arrays)))
+        return Table({n: Column(c.dtype, taken[(n, "d")],
+                                taken.get((n, "v")), c.dictionary)
+                      for n, c in self.columns.items()},
+                     valid_rows=valid_rows)
 
-    def filter(self, mask) -> "Table":
+    def filter(self, mask, padded: bool = False) -> "Table":
         # A subsequence of bucket-ordered rows is still bucket-ordered.
         # One flatnonzero for the whole table: per-column boolean indexing
         # would re-run the mask→indices conversion for every column (and
         # jax's bool-index path is markedly slower than an int gather).
-        if mask.shape[0] != self.num_rows:
+        # Shape classes: the survivor count is data-dependent — the classic
+        # recompile driver — so with ``padded=True`` (the executor's hot
+        # path) the gather indices are padded to their length class and the
+        # result rides with valid_rows. Default stays exact: callers
+        # outside the padded pipeline (SPMD routing, build, chunk streams)
+        # read .data directly and must keep exact shapes.
+        if mask.shape[0] != self.data_rows:
             # jnp.take clips out-of-range indices silently; fail loud here.
             raise HyperspaceException(
-                f"filter mask length {mask.shape[0]} != rows {self.num_rows}")
-        idx = jnp.flatnonzero(mask)
-        return Table({n: c.take(idx) for n, c in self.columns.items()},
-                     bucket_order=self.bucket_order)
+                f"filter mask length {mask.shape[0]} != rows {self.data_rows}")
+        idx, m = filter_indices(mask, self.valid_rows, padded=padded)
+        out = self.take(idx, valid_rows=m if int(idx.shape[0]) != m else None)
+        return Table(out.columns, bucket_order=self.bucket_order,
+                     valid_rows=out.valid_rows)
 
     def slice(self, start: int, stop: int) -> "Table":
-        return Table({n: c.slice(start, stop) for n, c in self.columns.items()},
+        # start/stop address the valid prefix, so the result is exact.
+        # Device-resident buffers slice through ONE fused program per
+        # table signature; host (numpy) buffers slice for free.
+        from ..ops import kernels
+        dev, spec = [], []
+        for n, c in self.columns.items():
+            if not isinstance(c.data, np.ndarray):
+                dev.append(c.data)
+                spec.append((n, "d"))
+            if c.validity is not None and not isinstance(c.validity,
+                                                         np.ndarray):
+                dev.append(c.validity)
+                spec.append((n, "v"))
+        sliced = dict(zip(spec, kernels.slice_arrays(dev, start, stop))) \
+            if dev else {}
+
+        def part(c, name, kind, host):
+            if (name, kind) in sliced:
+                return sliced[(name, kind)]
+            return host[start:stop]
+
+        return Table({n: Column(c.dtype, part(c, n, "d", c.data),
+                                part(c, n, "v", c.validity)
+                                if c.validity is not None else None,
+                                c.dictionary)
+                      for n, c in self.columns.items()},
                      bucket_order=self.bucket_order)
 
     def with_column(self, name: str, col: Column) -> "Table":
         out = dict(self.columns)
         out[name] = col
-        return Table(out, bucket_order=self.bucket_order)
+        return Table(out, bucket_order=self.bucket_order,
+                     valid_rows=self.valid_rows)
 
     def to_host(self) -> "Table":
         """Materialize every column as host numpy with ONE device_get over
         the whole pytree. On a remote-attached TPU the per-transfer round
         trip (not bandwidth) dominates, so anything that will be sliced
         many times on the host (e.g. one parquet file per bucket) must be
-        fetched wholesale first, never slice-by-slice."""
+        fetched wholesale first, never slice-by-slice. Class padding is
+        dropped on the host (free — a numpy slice, no device program)."""
         import jax
         arrays = {}
         for n, c in self.columns.items():
@@ -156,8 +253,14 @@ class Table:
             if c.validity is not None:
                 arrays[(n, "v")] = c.validity
         host = jax.device_get(arrays)
-        return Table({n: Column(c.dtype, np.asarray(host[(n, "d")]),
-                                np.asarray(host[(n, "v")])
+        rows = self.num_rows
+
+        def trim(a):
+            a = np.asarray(a)
+            return a[:rows] if self.valid_rows is not None else a
+
+        return Table({n: Column(c.dtype, trim(host[(n, "d")]),
+                                trim(host[(n, "v")])
                                 if c.validity is not None else None,
                                 c.dictionary)
                       for n, c in self.columns.items()},
@@ -168,11 +271,14 @@ class Table:
         if order:
             order = (order[0], tuple(mapping.get(k, k) for k in order[1]))
         return Table({mapping.get(n, n): c for n, c in self.columns.items()},
-                     bucket_order=order)
+                     bucket_order=order, valid_rows=self.valid_rows)
 
     @staticmethod
     def concat(tables: Sequence["Table"]) -> "Table":
-        """Union of schema-aligned tables; string dictionaries are re-unified."""
+        """Union of schema-aligned tables; string dictionaries are re-unified.
+        Class-padded inputs are compacted first (an interleaved pad tail
+        cannot ride through a concatenation)."""
+        tables = [t.compact() for t in tables]
         tables = [t for t in tables if t.num_rows > 0] or list(tables[:1])
         if len(tables) == 1:
             return tables[0]
@@ -220,10 +326,17 @@ class Table:
             return a
 
         arrays = []
+        rows = self.num_rows
         for name, col in self.columns.items():
             np_data = fetch(col.data, (name, "d"))
             np_valid = (fetch(col.validity, (name, "v"))
                         if col.validity is not None else None)
+            if self.valid_rows is not None:
+                # Drop class padding at the host boundary (a numpy slice —
+                # no device program, byte-identical to exact execution).
+                np_data = np.asarray(np_data)[:rows]
+                if np_valid is not None:
+                    np_valid = np.asarray(np_valid)[:rows]
             mask = None if np_valid is None else ~np_valid
             if col.dtype == STRING:
                 codes = np_data
@@ -246,29 +359,86 @@ class Table:
         return self.to_arrow().to_pandas()
 
     @staticmethod
-    def from_arrow(table: pa.Table) -> "Table":
+    def from_arrow(table: pa.Table, pad_to_class: bool = False) -> "Table":
         # Struct columns are flattened into dotted leaf names ("a.b.c") so
         # only fixed-width flat arrays reach the device (see
         # Schema.from_arrow).
         while any(pa.types.is_struct(f.type) for f in table.schema):
             table = table.flatten()
+        # Shape classes at the host->device boundary: padding in numpy is
+        # FREE (no device program), so executor-bound reads land on their
+        # length class before any XLA op ever sees the exact row count.
+        target = None
+        if pad_to_class and table.num_rows > 0:
+            from . import shapes
+            cls = shapes.padded_length(table.num_rows)
+            if cls != table.num_rows:
+                target = cls
         cols: Dict[str, Column] = {}
         for name in table.column_names:
-            cols[name] = _encode_arrow_column(table.column(name))
-        return Table(cols)
+            cols[name] = _encode_arrow_column(table.column(name), target)
+        return Table(cols, valid_rows=table.num_rows
+                     if target is not None else None)
+
+
+def filter_indices(mask, valid_rows: Optional[int] = None,
+                   padded: bool = True):
+    """(gather indices, survivor count) for a keep mask over a possibly
+    class-padded table. Pad rows are masked out; with ``padded`` the
+    indices come out at the survivor count's length class directly
+    (jnp.nonzero with a static class size, filler 0 — always in-bounds
+    for a non-empty source): no exact-length array ever materializes, so
+    downstream gathers compile once per class instead of once per
+    survivor count."""
+    from ..ops import kernels
+    return kernels.mask_count_nonzero(mask, valid_rows, padded)
+
+
+def pad_table_to_class(table: Table) -> Table:
+    """Class-pad an exact table (one lax.pad per column buffer — a few
+    tiny programs per distinct table length, vs one per downstream op).
+    The executor applies this at scan boundaries so every chain over the
+    table runs at its length class."""
+    from . import shapes
+    n = table.num_rows
+    if table.is_padded or n == 0:
+        return table
+    cls = shapes.padded_length(n)
+    if cls == n:
+        return table
+    cols = {}
+    for name, c in table.columns.items():
+        if isinstance(c.data, np.ndarray):
+            return table  # host-resident tables stay exact
+        cols[name] = Column(c.dtype, shapes.pad_to(c.data, cls),
+                            shapes.pad_to(c.validity, cls, False)
+                            if c.validity is not None else None,
+                            c.dictionary)
+    return Table(cols, bucket_order=table.bucket_order, valid_rows=n)
 
 
 # ---------------------------------------------------------------------------
 # Encoding.
 # ---------------------------------------------------------------------------
 
-def _encode_arrow_column(chunked: pa.ChunkedArray) -> Column:
+def _pad_host(np_data: np.ndarray, target: Optional[int], fill=0) -> np.ndarray:
+    """Host-side class pad (no device program; see Table.from_arrow)."""
+    if target is None or np_data.shape[0] >= target:
+        return np_data
+    out = np.empty(target, dtype=np_data.dtype)
+    out[:np_data.shape[0]] = np_data
+    out[np_data.shape[0]:] = fill
+    return out
+
+
+def _encode_arrow_column(chunked: pa.ChunkedArray,
+                         target: Optional[int] = None) -> Column:
     t = chunked.type
     if pa.types.is_dictionary(t):
         chunked = chunked.cast(t.value_type)
         t = t.value_type
     if pa.types.is_string(t) or pa.types.is_large_string(t):
-        return _encode_string(chunked)
+        return _encode_string(chunked, target)
     combined = chunked.combine_chunks() if chunked.num_chunks != 1 else chunked.chunk(0)
     null_count = combined.null_count
     if pa.types.is_date32(t):
@@ -305,13 +475,14 @@ def _encode_arrow_column(chunked: pa.ChunkedArray) -> Column:
         fill = 0
         np_data = np.where(valid_np, np.nan_to_num(np_data, nan=fill)
                            if np_data.dtype.kind == "f" else np_data, fill)
-        validity = jnp.asarray(valid_np)
-    target = _DEVICE_DTYPE[dtype]
-    return Column(dtype, jnp.asarray(np.ascontiguousarray(np_data), dtype=target),
-                  validity)
+        validity = jnp.asarray(_pad_host(valid_np, target, False))
+    dev_dtype = _DEVICE_DTYPE[dtype]
+    np_data = _pad_host(np.ascontiguousarray(np_data), target)
+    return Column(dtype, jnp.asarray(np_data, dtype=dev_dtype), validity)
 
 
-def _encode_string(chunked: pa.ChunkedArray) -> Column:
+def _encode_string(chunked: pa.ChunkedArray,
+                   target: Optional[int] = None) -> Column:
     """Order-preserving dictionary encoding: codes sort like the strings."""
     combined = chunked.combine_chunks() if chunked.num_chunks != 1 else chunked.chunk(0)
     uniques = pc.unique(combined.drop_null())
@@ -324,8 +495,9 @@ def _encode_string(chunked: pa.ChunkedArray) -> Column:
     if combined.null_count:
         valid_np = ~np.asarray(combined.is_null())
         codes = np.where(valid_np, codes, -1).astype(np.int32)
-        validity = jnp.asarray(valid_np)
-    return Column(STRING, jnp.asarray(codes), validity, dictionary)
+        validity = jnp.asarray(_pad_host(valid_np, target, False))
+    return Column(STRING, jnp.asarray(_pad_host(codes, target)), validity,
+                  dictionary)
 
 
 def _concat_string_columns(cols: List[Column]) -> Column:
@@ -366,7 +538,11 @@ def _resolve_files(files: Sequence[str]):
 
 
 def read_parquet(files: Sequence[str], columns: Optional[Sequence[str]] = None,
-                 fmt: str = "parquet", filters=None) -> Table:
+                 fmt: str = "parquet", filters=None,
+                 pad_to_class: bool = False) -> Table:
+    """``pad_to_class`` class-pads the result host-side (free) for the
+    executor's shape-class pipeline; leave False for callers that read
+    ``.data`` directly (builds, sketches, spmd leaves)."""
     if not files:
         raise HyperspaceException("read_parquet: no files")
     if fmt == "parquet":
@@ -435,7 +611,7 @@ def read_parquet(files: Sequence[str], columns: Optional[Sequence[str]] = None,
             at = at.select(list(columns))
     else:
         raise HyperspaceException(f"Unsupported format: {fmt}")
-    return Table.from_arrow(at)
+    return Table.from_arrow(at, pad_to_class=pad_to_class)
 
 
 @functools.lru_cache(maxsize=65536)

@@ -10,6 +10,7 @@ from __future__ import annotations
 import datetime
 from typing import Optional, Tuple
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -34,6 +35,296 @@ def eval_predicate_mask(table: Table, condition: E.Expr) -> jnp.ndarray:
     return mask
 
 
+# ---------------------------------------------------------------------------
+# Fused predicate programs (shape-class execution). The executor's Filter
+# operator compiles ONE program per predicate STRUCTURE covering the whole
+# mask-eval + validity + pad-tail-mask + survivor-count chain; literal
+# values are runtime scalar arguments, so sweeping literals (the serving
+# workload) reuses one compiled program. Unsupported expression shapes
+# return None and take the eager per-op path above.
+# ---------------------------------------------------------------------------
+
+class _NotFusable(Exception):
+    pass
+
+
+def _pred_structure(table: Table, e: E.Expr, col_ix: dict, lits: list):
+    """(hashable structure, literal slot values) for the supported subset:
+    Col/Lit comparisons (incl. STRING-vs-literal via dictionary bounds),
+    numeric col-vs-col comparisons, And/Or/Not, In over literals, IsNull.
+    Raises _NotFusable for anything else (LIKE, CASE, arithmetic, string
+    col-col — those keep the eager path)."""
+    if isinstance(e, (E.And, E.Or)):
+        return (("and" if isinstance(e, E.And) else "or"),
+                _pred_structure(table, e.left, col_ix, lits),
+                _pred_structure(table, e.right, col_ix, lits))
+    if isinstance(e, E.Not):
+        return ("not", _pred_structure(table, e.child, col_ix, lits))
+    if isinstance(e, E.IsNull):
+        if not isinstance(e.child, E.Col):
+            raise _NotFusable()
+        return ("isnull", col_ix[e.child.column], bool(e.negated))
+    if isinstance(e, E.In):
+        if not isinstance(e.value, E.Col) \
+                or not all(isinstance(o, E.Lit) for o in e.options):
+            raise _NotFusable()
+        i = col_ix[e.value.column]
+        slots = tuple(_lit_slot(table, e.value.column, "EqualTo",
+                                o.value, lits) for o in e.options)
+        return ("in", i, slots)
+    if isinstance(e, _COMPARISONS):
+        left, right = e.left, e.right
+        flipped = False
+        if isinstance(left, E.Lit) and not isinstance(right, E.Lit):
+            left, right = right, left
+            flipped = True
+        if not isinstance(left, E.Col):
+            raise _NotFusable()
+        if isinstance(right, E.Lit):
+            op = _op_name(e, flipped)
+            i = col_ix[left.column]
+            slot = _lit_slot(table, left.column, op, right.value, lits)
+            return ("cmp", op, i, slot)
+        if not isinstance(right, E.Col):
+            raise _NotFusable()
+        lc, rc = table.column(left.column), table.column(right.column)
+        if lc.dtype == STRING or rc.dtype == STRING:
+            raise _NotFusable()  # dictionary translation is host work
+        return ("colcmp", _op_name(e, False), col_ix[left.column],
+                col_ix[right.column])
+    raise _NotFusable()
+
+
+def _lit_slot(table: Table, column: str, op: str, value, lits: list):
+    """Append the encoded literal(s) to the slot list; return a hashable
+    slot descriptor carrying the python-type tag (part of the program
+    structure — it determines the traced scalar dtype)."""
+    c = table.column(column)
+    if c.dtype == STRING:
+        lo, hi = literal_to_device(value, STRING, c.dictionary)
+        j = len(lits)
+        lits.extend([lo, hi])
+        return ("slit", j)
+    lit = literal_to_device(value, c.dtype, None)
+    j = len(lits)
+    lits.append(lit)
+    return ("lit", j, type(lit).__name__)
+
+
+def _pred_eval(spec, cols, lits):
+    """Evaluate a predicate structure over traced (data, validity) pairs.
+    Returns (bool data, validity-or-None) with the eager evaluator's
+    exact semantics (Kleene logic, STRING dictionary-bound compares)."""
+    kind = spec[0]
+    if kind in ("and", "or"):
+        ld, lv = _pred_eval(spec[1], cols, lits)
+        rd, rv = _pred_eval(spec[2], cols, lits)
+        from ..ops import kernels
+        true, known = kernels.kleene_and_or(ld, lv, rd, rv,
+                                            is_and=kind == "and")
+        return true, None if (lv is None and rv is None) else known
+    if kind == "not":
+        d, v = _pred_eval(spec[1], cols, lits)
+        return ~d, v
+    if kind == "isnull":
+        _, i, negated = spec
+        data, validity = cols[i]
+        n = data.shape[0]
+        if validity is None:
+            return jnp.full(n, negated, jnp.bool_), None
+        return (validity if negated else ~validity), None
+    if kind == "in":
+        _, i, slots = spec
+        data, validity = cols[i]
+        mask = _pred_cmp_slot("EqualTo", data, slots[0], lits) \
+            if slots else jnp.zeros(data.shape[0], jnp.bool_)
+        for s in slots[1:]:
+            mask = mask | _pred_cmp_slot("EqualTo", data, s, lits)
+        return mask, validity
+    if kind == "cmp":
+        _, op, i, slot = spec
+        data, validity = cols[i]
+        return _pred_cmp_slot(op, data, slot, lits), validity
+    if kind == "colcmp":
+        _, op, i, j = spec
+        ld, lv = cols[i]
+        rd, rv = cols[j]
+        data = {
+            "EqualTo": lambda: ld == rd,
+            "LessThan": lambda: ld < rd,
+            "LessThanOrEqual": lambda: ld <= rd,
+            "GreaterThan": lambda: ld > rd,
+            "GreaterThanOrEqual": lambda: ld >= rd,
+        }[op]()
+        return data, _merge_validity(lv, rv)
+    raise HyperspaceException(f"bad predicate spec {spec!r}")
+
+
+def _pred_cmp_slot(op: str, data, slot, lits):
+    if slot[0] == "slit":
+        # STRING: (lo, hi) dictionary bounds as traced scalars. Same op
+        # table as compare_literal; the lo==hi "literal absent" case for
+        # equality folds in as a runtime conjunct.
+        lo, hi = lits[slot[1]], lits[slot[1] + 1]
+        if op == "EqualTo":
+            return (data == lo) & (jnp.asarray(lo) != jnp.asarray(hi))
+        if op == "LessThan":
+            return data < lo
+        if op == "LessThanOrEqual":
+            return data < hi
+        if op == "GreaterThan":
+            return data >= hi
+        if op == "GreaterThanOrEqual":
+            return data >= lo
+        raise HyperspaceException(f"Unknown op {op}")
+    lit = lits[slot[1]]
+    return {
+        "EqualTo": lambda: data == lit,
+        "LessThan": lambda: data < lit,
+        "LessThanOrEqual": lambda: data <= lit,
+        "GreaterThan": lambda: data > lit,
+        "GreaterThanOrEqual": lambda: data >= lit,
+    }[op]()
+
+
+def _arith_structure(table: Table, e: E.Expr, col_ix: dict, lits: list):
+    """Structure for arithmetic trees over Col/Lit (the Project / agg-child
+    hot shape, e.g. revenue = price * (1 - discount))."""
+    if isinstance(e, E.Alias):
+        return _arith_structure(table, e.child, col_ix, lits)
+    if isinstance(e, E.Col):
+        c = table.column(e.column)
+        if c.dtype == STRING:
+            raise _NotFusable()
+        return ("col", col_ix[e.column])
+    if isinstance(e, E.Lit):
+        v = e.value
+        if not isinstance(v, (int, float, bool)) or isinstance(v, bool):
+            raise _NotFusable()
+        j = len(lits)
+        lits.append(v)
+        return ("alit", j, type(v).__name__)
+    if isinstance(e, (E.Add, E.Subtract, E.Multiply, E.Divide)):
+        return ("arith", type(e).__name__,
+                _arith_structure(table, e.left, col_ix, lits),
+                _arith_structure(table, e.right, col_ix, lits))
+    raise _NotFusable()
+
+
+def _arith_eval(spec, cols, lits):
+    """Mirror of _eval_arith over traced operands. Returns
+    (data, validity-or-None); the caller applies the final output
+    widening exactly as the eager path does."""
+    kind = spec[0]
+    if kind == "col":
+        return cols[spec[1]]
+    if kind == "alit":
+        return lits[spec[1]], None
+    _, op, ls, rs = spec
+    ld, lv = _arith_eval(ls, cols, lits)
+    rd, rv = _arith_eval(rs, cols, lits)
+    if op == "Add":
+        data = ld + rd
+    elif op == "Subtract":
+        data = ld - rd
+    elif op == "Multiply":
+        data = ld * rd
+    else:
+        data = jnp.asarray(ld, jnp.float64) / rd
+    # The eager evaluator widens at EVERY arith node (each nested result
+    # is a FLOAT64/INT64 Column); mirror it so nesting promotes (and
+    # overflows) identically.
+    data = data.astype(jnp.float64 if jnp.issubdtype(data.dtype,
+                                                     jnp.floating)
+                       else jnp.int64)
+    return data, _merge_validity(lv, rv)
+
+
+def eval_expr_fused(table: Table, e: E.Expr) -> Optional[Column]:
+    """Fused arithmetic expression evaluation: ONE compiled program per
+    expression structure (literal values as runtime arguments), matching
+    _eval_arith's semantics bit for bit. None when the expression isn't a
+    pure Col/Lit arithmetic tree (the eager evaluator handles it)."""
+    from ..ops import kernels, pallas_kernels
+    if pallas_kernels.enabled():
+        return None
+    inner = e.child if isinstance(e, E.Alias) else e
+    if not isinstance(inner, (E.Add, E.Subtract, E.Multiply, E.Divide)):
+        return None
+    names = sorted(set(e.references))
+    if not names or table.data_rows == 0:
+        return None
+    col_objs = []
+    for nm in names:
+        c = table.column(nm)
+        if isinstance(c.data, jax.core.Tracer):
+            return None
+        col_objs.append(c)
+    col_ix = {nm: i for i, nm in enumerate(names)}
+    lits: list = []
+    try:
+        spec = _arith_structure(table, e, col_ix, lits)
+    except _NotFusable:
+        return None
+    key = ("arith", spec,
+           tuple((c.dtype, c.validity is not None) for c in col_objs))
+
+    def builder(cols, lit_args, _n):
+        data, validity = _arith_eval(spec, cols, lit_args)
+        target = jnp.float64 \
+            if jnp.issubdtype(data.dtype, jnp.floating) else jnp.int64
+        return data.astype(target), validity
+
+    cols = tuple((c.data, c.validity) for c in col_objs)
+    data, validity = kernels.run_fused_predicate(key, builder, cols,
+                                                 tuple(lits), 0)
+    dtype = FLOAT64 if jnp.issubdtype(data.dtype, jnp.floating) else INT64
+    return Column(dtype, data, validity)
+
+
+def eval_expr_maybe_fused(table: Table, e: E.Expr) -> Column:
+    fused = eval_expr_fused(table, e)
+    return fused if fused is not None else eval_expr(table, e)
+
+
+def eval_predicate_mask_counted(table: Table, condition: E.Expr):
+    """Fused filter front-end: (pad-masked keep mask, survivor count) from
+    ONE compiled program per predicate structure, or None when the
+    condition (or backend path) requires the eager evaluator."""
+    from ..ops import kernels, pallas_kernels
+    if pallas_kernels.enabled():
+        return None  # the eager path fuses differently (Pallas kernels)
+    names = sorted(set(condition.references))
+    if not names or table.data_rows == 0:
+        return None
+    col_objs = []
+    for nm in names:
+        c = table.column(nm)
+        if isinstance(c.data, jax.core.Tracer):
+            return None  # SPMD evaluates inside its own jit
+        col_objs.append(c)
+    col_ix = {nm: i for i, nm in enumerate(names)}
+    lits: list = []
+    try:
+        spec = _pred_structure(table, condition, col_ix, lits)
+    except _NotFusable:
+        return None
+    key = (spec,
+           tuple((c.dtype, c.validity is not None) for c in col_objs))
+
+    def builder(cols, lit_args, n):
+        data, validity = _pred_eval(spec, cols, lit_args)
+        mask = data if validity is None else (data & validity)
+        phys = mask.shape[0]
+        mask = mask & (jnp.arange(phys, dtype=jnp.int32) < jnp.int32(n))
+        return mask, jnp.sum(mask)
+
+    cols = tuple((c.data, c.validity) for c in col_objs)
+    mask, cnt = kernels.run_fused_predicate(key, builder, cols,
+                                            tuple(lits), table.num_rows)
+    return mask, int(cnt)  # HOST SYNC (single scalar)
+
+
 def eval_expr(table: Table, e: E.Expr) -> Column:
     if isinstance(e, E.Col):
         return table.column(e.column)
@@ -42,8 +333,10 @@ def eval_expr(table: Table, e: E.Expr) -> Column:
     if isinstance(e, E.Lit):
         # Constant projection (SQL: SELECT 's' sale_type ... — the TPC-DS
         # q4/q11/q74 house style): broadcast to a constant column. A bare
-        # NULL has no type and stays rejected.
-        n = table.num_rows
+        # NULL has no type and stays rejected. Materializations use the
+        # PHYSICAL length: on a class-padded table every column (and so
+        # every evaluated expression) is padded to the same class.
+        n = table.data_rows
         v = e.value
         if isinstance(v, bool):
             return Column(BOOL, jnp.full(n, v, jnp.bool_))
@@ -68,18 +361,13 @@ def eval_expr(table: Table, e: E.Expr) -> Column:
                 return fused
         left = eval_expr(table, e.left)
         right = eval_expr(table, e.right)
-        # Kleene 3-valued logic: TRUE OR NULL = TRUE, FALSE AND NULL = FALSE.
-        lv = left.validity if left.validity is not None \
-            else jnp.ones(len(left), jnp.bool_)
-        rv = right.validity if right.validity is not None \
-            else jnp.ones(len(right), jnp.bool_)
-        lt, lf = lv & left.data, lv & ~left.data
-        rt, rf = rv & right.data, rv & ~right.data
-        if isinstance(e, E.And):
-            true, false = lt & rt, lf | rf
-        else:
-            true, false = lt | rt, lf & rf
-        known = true | false
+        # Kleene 3-valued logic: TRUE OR NULL = TRUE, FALSE AND NULL =
+        # FALSE. One fused program (ops/kernels.py) instead of ~8 eager
+        # ops per distinct length class.
+        from ..ops import kernels
+        true, known = kernels.kleene_and_or(
+            left.data, left.validity, right.data, right.validity,
+            is_and=isinstance(e, E.And))
         validity = None if (left.validity is None and right.validity is None) \
             else known
         return Column(BOOL, true, validity)
@@ -94,7 +382,7 @@ def eval_expr(table: Table, e: E.Expr) -> Column:
         lits = [p.value for p in e.parts if isinstance(p, E.Lit)]
         cols = [p for p in e.parts if not isinstance(p, E.Lit)]
         if not cols:
-            return Column(STRING, jnp.zeros(table.num_rows, jnp.int32),
+            return Column(STRING, jnp.zeros(table.data_rows, jnp.int32),
                           None, np.array(["".join(map(str, lits))],
                                          dtype=object))
         c = eval_expr(table, cols[0])
@@ -123,7 +411,7 @@ def eval_expr(table: Table, e: E.Expr) -> Column:
             return Column(STRING, data, c.validity, dic[order])
         return Column(STRING, c.data, c.validity, dic)
     if isinstance(e, E.NullLit):
-        n = table.num_rows
+        n = table.data_rows
         from .columnar import _DEVICE_DTYPE
         dic = np.array([""], dtype=object) if e.dtype == STRING else None
         return Column(e.dtype, jnp.zeros(n, _DEVICE_DTYPE[e.dtype]),
@@ -363,7 +651,7 @@ def _eval_case_when(table: Table, e: "E.CaseWhen") -> Column:
     carries; no match and no ELSE yields null."""
     import numpy as np
 
-    n = table.num_rows
+    n = table.data_rows
     conds = []
     for c, _ in e.branches:
         cc = eval_expr(table, c)

@@ -25,9 +25,11 @@ from ..plan import expr as E
 from ..plan.nodes import (Aggregate, BucketUnion, Filter, IndexScan, Join, Limit,
                           LogicalPlan, Project, Scan, Sort, Union, Window)
 from ..schema import BOOL, DATE, FLOAT64, INT32, INT64, STRING
-from .columnar import (Column, Table, dictionaries_equal, read_parquet,
-                       translate_codes)
-from .evaluator import eval_expr, eval_predicate_mask
+from . import shapes
+from .columnar import (Column, Table, dictionaries_equal, filter_indices,
+                       read_parquet, translate_codes)
+from .evaluator import (eval_expr, eval_expr_maybe_fused,
+                        eval_predicate_mask)
 from .pushdown import prefers_pruned_read, pushable_filter
 
 
@@ -40,17 +42,52 @@ _SESSION: contextvars.ContextVar = contextvars.ContextVar(
 def execute(plan: LogicalPlan, session=None) -> Table:
     token = _SESSION.set(session)
     try:
-        # Row-returning distributed path: a {Filter, Project, Join}* chain
-        # root (optionally under Sort/Limit) runs SPMD over the mesh, rows
-        # gathered per device (execution/spmd.py). Aggregate roots dispatch
-        # inside _execute; anything else falls through to single-device.
-        from . import spmd
-        result = spmd.try_execute_plan(plan, session, _execute)
-        if result is not None:
-            return result
-        return _execute(plan, needed=None)
+        # Shape-class execution scope: kernels and the padded pipeline
+        # below read the session's shapeBucketing conf through it.
+        conf = session.hs_conf if session is not None else None
+        with shapes.use_conf(conf), \
+                shapes.compile_scope("execute") as tally:
+            # Row-returning distributed path: a {Filter, Project, Join}*
+            # chain root (optionally under Sort/Limit) runs SPMD over the
+            # mesh, rows gathered per device (execution/spmd.py). Aggregate
+            # roots dispatch inside _execute; anything else falls through
+            # to single-device. SPMD manages its own static shapes, so it
+            # only ever sees compacted tables.
+            from . import spmd
+            result = spmd.try_execute_plan(plan, session, _execute_compact)
+            if result is None:
+                result = _execute(plan, needed=None)
+                if result.is_padded:
+                    # The result leaving the engine is always exact: class
+                    # padding is an internal representation. Final results
+                    # trim at the HOST boundary (one device_get, numpy
+                    # slice): a device-side slice would compile one
+                    # program per distinct row count — the literal-sweep
+                    # serving pattern would recompile per query.
+                    result = result.to_host()
+        _emit_compile_event(session, tally["count"], tally["seconds"])
+        return result
     finally:
         _SESSION.reset(token)
+
+
+def _execute_compact(plan: LogicalPlan, needed: Optional[Set[str]]) -> Table:
+    """_execute for callers outside the padded pipeline (SPMD leaf reads)."""
+    return _execute(plan, needed).compact()
+
+
+def _emit_compile_event(session, count: int, seconds: float) -> None:
+    """Surface the per-execution XLA compile tally (shapes.py counter) as
+    a KernelCompileEvent. No-op when nothing compiled or no session."""
+    if session is None or count <= 0:
+        return
+    from ..telemetry.events import KernelCompileEvent
+    from ..telemetry.logging import get_logger
+    get_logger(session.hs_conf.event_logger_class()).log_event(
+        KernelCompileEvent(
+            message=f"{count} XLA compilation(s) during plan execution",
+            count=count, seconds=round(seconds, 4),
+            total=shapes.compile_count()))
 
 
 def _execute(plan: LogicalPlan, needed: Optional[Set[str]]) -> Table:
@@ -88,14 +125,15 @@ def _execute(plan: LogicalPlan, needed: Optional[Set[str]]) -> Table:
                                             prefer_pruned_read=pruned)
         else:
             table = _execute(plan.child, child_needed)
-        mask = eval_predicate_mask(table, plan.condition)
-        return table.filter(mask)
+        return _filter_table(table, plan.condition)
     if isinstance(plan, Project):
         child_needed = set()
         for e in plan.exprs:
             child_needed.update(e.references)
         table = _execute(plan.child, child_needed)
-        out = Table({e.name: eval_expr(table, e) for e in plan.exprs})
+        out = Table({e.name: eval_expr_maybe_fused(table, e)
+                     for e in plan.exprs},
+                    valid_rows=table.valid_rows)
         # Pass-through column projections keep the bucket-order invariant.
         bo = table.bucket_order
         if bo:
@@ -106,7 +144,8 @@ def _execute(plan: LogicalPlan, needed: Optional[Set[str]]) -> Table:
                     name_map.setdefault(inner.column, e.name)
             if all(k in name_map for k in bo[1]):
                 out = Table(out.columns,
-                            bucket_order=(bo[0], tuple(name_map[k] for k in bo[1])))
+                            bucket_order=(bo[0], tuple(name_map[k] for k in bo[1])),
+                            valid_rows=table.valid_rows)
         return out
     if isinstance(plan, Join):
         return _execute_join(plan, needed)
@@ -115,7 +154,7 @@ def _execute(plan: LogicalPlan, needed: Optional[Set[str]]) -> Table:
         # over the mesh (execution/spmd.py); fall back on any mismatch.
         from . import spmd
         spmd_result = spmd.try_execute_aggregate(plan, _SESSION.get(),
-                                                 _execute)
+                                                 _execute_compact)
         if spmd_result is not None:
             return spmd_result
         child_needed = set(plan.group_cols)
@@ -128,7 +167,9 @@ def _execute(plan: LogicalPlan, needed: Optional[Set[str]]) -> Table:
         child_needed = None if needed is None else \
             (needed - out_names) | {r for _, w in plan.wexprs
                                     for r in w.references}
-        table = _execute(plan.child, child_needed)
+        # Window internals (segmented scans, scatter-back through the sort
+        # permutation) assume exact shapes; compact at the boundary.
+        table = _execute(plan.child, child_needed).compact()
         return _execute_window(plan, table)
     if isinstance(plan, Sort):
         child_needed = None if needed is None else \
@@ -156,6 +197,26 @@ def _execute(plan: LogicalPlan, needed: Optional[Set[str]]) -> Table:
         aligned = [t.select(out_names) for t in tables]
         return Table.concat(aligned)
     raise HyperspaceException(f"Cannot execute plan node {plan.node_name}")
+
+
+def _filter_table(table: Table, condition) -> Table:
+    """Filter operator body. The fused predicate program (one compile per
+    predicate structure, literals as runtime args — evaluator.
+    eval_predicate_mask_counted) covers the common shapes; everything
+    else evaluates eagerly. Output rides the survivor count's length
+    class either way (byte-identical after compaction)."""
+    from .evaluator import eval_predicate_mask_counted
+    fused = eval_predicate_mask_counted(table, condition)
+    if fused is None:
+        mask = eval_predicate_mask(table, condition)
+        return table.filter(mask, padded=True)
+    mask, m = fused
+    cls = shapes.padded_length(m)
+    idx = kernels.nonzero_pad_indices(mask, cls)
+    out = table.take(idx, valid_rows=m if cls != m else None)
+    # A subsequence of bucket-ordered rows is still bucket-ordered.
+    return Table(out.columns, bucket_order=table.bucket_order,
+                 valid_rows=out.valid_rows)
 
 
 # Chunked-scan observability (mirrors ops.index_build.CHUNK_STATS): tests
@@ -240,8 +301,14 @@ def _execute_scan(plan: Scan, needed: Optional[Set[str]],
     if fmt != "parquet":
         pa_filter = None
     from ..sources.partitions import read_relation_files
-    return read_relation_files(relation, files, cols, fmt,
-                               filters=pa_filter)
+    from .columnar import pad_table_to_class
+    # Class-pad at the scan boundary: every downstream chain (mask eval,
+    # gathers, key hashing) then runs at the table's length class, and an
+    # append/refresh that changes the row count lands on the same class
+    # instead of recompiling the whole chain. Simple reads pad host-side
+    # (free); partition-attach assemblies pad on device here.
+    return pad_table_to_class(read_relation_files(
+        relation, files, cols, fmt, filters=pa_filter, pad_to_class=True))
 
 
 def _equality_bucket_subset(plan: IndexScan, condition) -> Optional[Set[int]]:
@@ -525,10 +592,13 @@ def _execute_index_scan(plan: IndexScan, needed: Optional[Set[str]],
             table = cache.get(key)
             _emit_index_cache_probe(entry.name, hit=table is not None)
             if table is None:
-                table = read_parquet(index_files, cols)
+                # Padded host-side at read: the cache's only consumer is
+                # this (padded-aware) scan path.
+                table = read_parquet(index_files, cols, pad_to_class=True)
                 cache.put(key, table)
         else:
-            table = read_parquet(index_files, cols, filters=pa_filter)
+            table = read_parquet(index_files, cols, filters=pa_filter,
+                                 pad_to_class=True)
     if entry.derivedDataset.kind == "CoveringIndex" \
             and buckets_have_single_file \
             and all(c in table.names for c in entry.indexed_columns):
@@ -536,14 +606,18 @@ def _execute_index_scan(plan: IndexScan, needed: Optional[Set[str]],
         # are sorted by the indexed columns within each bucket. Downstream
         # joins exploit this to skip re-sorting. (Subsequent filters keep it.)
         table = Table(table.columns, bucket_order=(
-            entry.num_buckets, tuple(entry.indexed_columns)))
+            entry.num_buckets, tuple(entry.indexed_columns)),
+            valid_rows=table.valid_rows)
     if plan.deleted_file_ids:
         lineage = table.column(IndexConstants.DATA_FILE_NAME_ID)
         deleted = jnp.asarray(
             np.sort(np.asarray(plan.deleted_file_ids, dtype=np.int64)))
         keep = ~kernels.isin_sorted(lineage.data.astype(jnp.int64), deleted)
-        table = table.filter(keep)
+        table = table.filter(keep, padded=True)
     if plan.appended_files:
+        # The order-preserving merge scatters by absolute row position —
+        # exact shapes (appends are the rare path; correctness first).
+        table = table.compact()
         appended = read_parquet(
             plan.appended_files,
             [c for c in (cols or schema_names)
@@ -564,7 +638,8 @@ def _execute_index_scan(plan: IndexScan, needed: Optional[Set[str]],
     if drop_lineage:
         table = table.select([n for n in table.names
                               if n != IndexConstants.DATA_FILE_NAME_ID])
-    return table
+    from .columnar import pad_table_to_class
+    return pad_table_to_class(table)
 
 
 # Observability counters for the shuffle-free fast paths (tests assert the
@@ -732,7 +807,9 @@ def _execute_join(plan: Join, needed: Optional[Set[str]]) -> Table:
 
     how = plan.join_type
     if how in ("semi", "anti"):
-        return _execute_semi_anti_join(left, right, norm, how)
+        # Membership probes sort/search raw key arrays — exact shapes.
+        return _execute_semi_anti_join(left.compact(), right.compact(),
+                                       norm, how)
     if how == "right":
         # right join = left join with the sides swapped: the output below
         # is assembled by column NAME against plan.schema, so the swap is
@@ -741,18 +818,28 @@ def _execute_join(plan: Join, needed: Optional[Set[str]]) -> Table:
         norm = [(r, l) for l, r in norm]
         how = "left"
     if how in ("left", "full"):
-        return _execute_outer_join(plan, left, right, norm, how)
+        # Outer padding scatters by absolute row position — exact shapes.
+        return _execute_outer_join(plan, left.compact(), right.compact(),
+                                   norm, how)
 
+    if not _padded_join_keys_ok(left, right, norm):
+        # The general N-key path dense-ranks the concatenation of both
+        # sides' keys — offsets are absolute row positions, so it needs
+        # exact shapes.
+        left, right = left.compact(), right.compact()
     lkeys, rkeys = _join_key_arrays(left, right, norm)
-    # Inner join: drop null keys up front.
+    # Inner join: drop null keys up front (pad rows ride along: the padded
+    # filter keeps the key arrays and the table aligned).
     lvalid = _keys_validity(left, [p[0] for p in norm])
     if lvalid is not None:
-        left = left.filter(lvalid)
-        lkeys = lkeys[lvalid]
+        idx, m = filter_indices(lvalid, left.valid_rows)
+        left = left.take(idx, valid_rows=m if int(idx.shape[0]) != m else None)
+        lkeys = jnp.take(lkeys, idx, mode="clip")
     rvalid = _keys_validity(right, [p[1] for p in norm])
     if rvalid is not None:
-        right = right.filter(rvalid)
-        rkeys = rkeys[rvalid]
+        idx, m = filter_indices(rvalid, right.valid_rows)
+        right = right.take(idx, valid_rows=m if int(idx.shape[0]) != m else None)
+        rkeys = jnp.take(rkeys, idx, mode="clip")
 
     # Shuffle-free path: a side that carries the covering-index bucket order
     # on its join key is already sorted by (bucket, key) — probe it directly
@@ -766,16 +853,25 @@ def _execute_join(plan: Join, needed: Optional[Set[str]]) -> Table:
         if swapped:
             left, right = right, left
             lcomp, rcomp = rcomp, lcomp
-        li, ri = kernels.merge_join_indices(lcomp, rcomp)
+        li, ri, total = kernels.merge_join_indices(
+            lcomp, rcomp, left_valid=left.num_rows,
+            right_valid=right.num_rows, padded_out=True)
         right_sorted = right
     else:
-        order = kernels.lex_sort_indices([rkeys])
-        right_sorted = right.take(order)
-        rkeys_sorted = jnp.take(rkeys, order)
-        li, ri = kernels.merge_join_indices(lkeys, rkeys_sorted)
+        r_padded = right.is_padded
+        order = kernels.lex_sort_indices(
+            [rkeys], valid_count=right.num_rows if r_padded else None,
+            padded_out=r_padded)
+        right_sorted = right.take(
+            order, valid_rows=right.num_rows if r_padded else None)
+        rkeys_sorted = jnp.take(rkeys, order, mode="clip")
+        li, ri, total = kernels.merge_join_indices(
+            lkeys, rkeys_sorted, left_valid=left.num_rows,
+            right_valid=right.num_rows, padded_out=True)
+    out_valid = total if int(li.shape[0]) != total else None
     out = {}
-    taken_left = left.take(li)
-    taken_right = right_sorted.take(ri)
+    taken_left = left.take(li, valid_rows=out_valid)
+    taken_right = right_sorted.take(ri, valid_rows=out_valid)
     for n in plan.schema.names:
         # Children were column-pruned; emit only the materialized subset.
         if n in taken_left.columns:
@@ -790,7 +886,23 @@ def _execute_join(plan: Join, needed: Optional[Set[str]]) -> Table:
     lbo = left.bucket_order
     if lbo is not None and all(k in out for k in lbo[1]):
         order_out = lbo
-    return Table(out, bucket_order=order_out)
+    return Table(out, bucket_order=order_out, valid_rows=out_valid)
+
+
+def _padded_join_keys_ok(left: Table, right: Table, norm) -> bool:
+    """True when _join_key_arrays will take a per-row (elementwise or
+    packed) key path that is safe over class-padded inputs. Mirrors its
+    branching: 1 pair always; 2 pairs only when every key is INT32/DATE
+    (otherwise it falls through to the absolute-offset dense-rank path)."""
+    if len(norm) == 1:
+        return True
+    if len(norm) == 2:
+        for lname, rname in norm:
+            if left.column(lname).dtype not in (INT32, DATE) \
+                    or right.column(rname).dtype not in (INT32, DATE):
+                return False
+        return True
+    return False
 
 
 def _execute_cross_join(plan: Join, needed: Optional[Set[str]]) -> Table:
@@ -803,8 +915,9 @@ def _execute_cross_join(plan: Join, needed: Optional[Set[str]]) -> Table:
                                          if n in left_names}
     rneed = None if needed is None else {n for n in needed
                                          if n not in left_names}
-    left = _execute(plan.left, lneed)
-    right = _execute(plan.right, rneed)
+    # Index expansion addresses absolute row positions — exact shapes.
+    left = _execute(plan.left, lneed).compact()
+    right = _execute(plan.right, rneed).compact()
     n, m = left.num_rows, right.num_rows
     if n * m > 50_000_000:
         raise HyperspaceException(
@@ -951,21 +1064,19 @@ def _bucketed_merge_keys(left: Table, right: Table, norm, lkeys, rkeys):
         num_buckets = left.bucket_order[0]
     else:
         return None
-    # Keys must fit int32 for the (bucket << 32 | biased key) packing. One
-    # fused reduction + single host sync covers both arrays.
-    to_check = [a for a in (lkeys, rkeys) if a.dtype == jnp.int64 and a.shape[0]]
-    if to_check:
-        extreme = int(jnp.maximum(*[jnp.max(jnp.abs(a)) for a in to_check])
-                      if len(to_check) == 2 else jnp.max(jnp.abs(to_check[0])))
-        if extreme >= 2 ** 31 or extreme < 0:  # < 0: abs(int64 min) overflow.
-            return None
-
-    def composite(col: Column, keys):
-        h = kernels.hash32_values(keys, col.dtype)
-        b = kernels.bucket_ids(h, num_buckets)
-        return kernels.pack2_int32(b, keys.astype(jnp.int32))
-
-    return composite(lcol, lkeys), composite(rcol, rkeys), swapped
+    # Keys must fit int32 for the (bucket << 32 | biased key) packing; the
+    # composite program also emits max(|key|) over the valid prefix, so
+    # the check costs no extra program (pad tails are masked inside).
+    lcomp, l_ext = kernels.bucket_composite_keys(
+        lkeys, lcol.dtype, num_buckets, valid_count=left.num_rows)
+    rcomp, r_ext = kernels.bucket_composite_keys(
+        rkeys, rcol.dtype, num_buckets, valid_count=right.num_rows)
+    for a, ext in ((lkeys, l_ext), (rkeys, r_ext)):
+        if a.dtype == jnp.int64 and a.shape[0]:
+            extreme = int(ext)  # HOST SYNC (single scalar)
+            if extreme >= 2 ** 31 or extreme < 0:  # < 0: |int64 min| overflow
+                return None
+    return lcomp, rcomp, swapped
 
 
 def _keys_validity(table: Table, names: Sequence[str]):
@@ -1014,6 +1125,8 @@ def _execute_aggregate(plan: Aggregate, table: Table) -> Table:
     key_cols = [table.column(g) for g in plan.group_cols]
     bo = table.bucket_order
     keys_non_null = all(c.validity is None for c in key_cols)
+    padded_in = table.is_padded
+    n_valid = table.num_rows
     if bo is not None and set(bo[1]) == set(plan.group_cols) \
             and keys_non_null:
         # Covering-index layout: rows sorted by (bucket, keys) ⇒ equal key
@@ -1042,24 +1155,47 @@ def _execute_aggregate(plan: Aggregate, table: Table) -> Table:
         GROUPBY_TWO_PHASE += 1
         return _execute_aggregate_two_phase(plan, table, key_cols)
     else:
-        order = kernels.lex_sort_indices(_group_sort_keys(key_cols))
-        sorted_table = table.take(order)
+        order = kernels.lex_sort_indices(
+            _group_sort_keys(key_cols),
+            valid_count=n_valid if padded_in else None,
+            padded_out=padded_in)
+        sorted_table = table.take(
+            order, valid_rows=n_valid if padded_in else None)
         sorted_keys = _group_sort_keys(
             [sorted_table.column(g) for g in plan.group_cols])
-    gids, num_groups = kernels.group_ids_from_sorted(sorted_keys)
+    gids, num_groups = kernels.group_ids_from_sorted(
+        sorted_keys, valid_count=n_valid if sorted_table.is_padded else None,
+        padded_out=sorted_table.is_padded)
     if num_groups == 0:
         return Table({f.name: Column(f.dtype,
                                      jnp.zeros(0, _np_dtype_for(f.dtype)),
                                      None,
                                      _dict_for(table, f.name))
                       for f in plan.schema.fields})
-    firsts = kernels.segment_first_index(gids, num_groups)
+    # The group count is data-dependent — outputs ride on its length class
+    # (pad segments hold scatter identities, gathered with clip only).
+    cap = shapes.padded_length(num_groups)
+    out_valid = num_groups if cap != num_groups else None
+    # One fused first-index + gather for every group column buffer.
+    head_arrays, head_spec = [], []
+    for g in plan.group_cols:
+        c = sorted_table.column(g)
+        head_arrays.append(c.data)
+        head_spec.append((g, "d"))
+        if c.validity is not None:
+            head_arrays.append(c.validity)
+            head_spec.append((g, "v"))
+    heads = dict(zip(head_spec, kernels.segment_heads(
+        gids, head_arrays, num_groups, padded_out=True)))
     out = {}
     for g in plan.group_cols:
-        out[g] = sorted_table.column(g).take(firsts)
+        c = sorted_table.column(g)
+        out[g] = Column(c.dtype, heads[(g, "d")], heads.get((g, "v")),
+                        c.dictionary)
     for agg in plan.aggs:
-        out[agg.name] = _eval_agg(agg, sorted_table, gids, num_groups)
-    return Table(out)
+        out[agg.name] = _eval_agg(agg, sorted_table, gids, num_groups,
+                                  padded_out=True)
+    return Table(out, valid_rows=out_valid)
 
 
 def _execute_aggregate_two_phase(plan: Aggregate, table: Table,
@@ -1067,76 +1203,95 @@ def _execute_aggregate_two_phase(plan: Aggregate, table: Table,
     """Run-based partial aggregation: phase 1 segments CONSECUTIVE equal
     key tuples (no sort) and reduces each run to partials; phase 2 sorts
     only the runs and combines duplicate tuples. All on device; output is
-    key-sorted like the main path."""
+    key-sorted like the main path.
+
+    Shape classes: the run count and group count are both data-dependent,
+    so phase-1 partials live on the run count's length class and the
+    output on the group count's (kernels route pad rows to dropped
+    segments; pad gathers clip)."""
+    padded_in = table.is_padded
+    n_valid = table.num_rows
     run_keys = [c.data for c in key_cols]
-    rids, num_runs = kernels.group_ids_from_sorted(run_keys)
+    rids, num_runs = kernels.group_ids_from_sorted(
+        run_keys, valid_count=n_valid if padded_in else None,
+        padded_out=padded_in)
     if num_runs == 0:
         return _execute_aggregate(
             plan, Table(dict(table.columns)))  # empty: reuse generic path
-    firsts = kernels.segment_first_index(rids, num_runs)
-    run_vals = [jnp.take(k, firsts) for k in run_keys]
+    cap_r = shapes.padded_length(num_runs)
+    run_padded = cap_r != num_runs
+    run_vals = list(kernels.segment_heads(rids, run_keys, num_runs,
+                                          padded_out=True))
 
-    order2 = kernels.lex_sort_indices(run_vals)
-    sorted_vals = [jnp.take(v, order2) for v in run_vals]
-    gids2, num_groups = kernels.group_ids_from_sorted(sorted_vals)
+    order2 = kernels.lex_sort_indices(
+        run_vals, valid_count=num_runs if run_padded else None,
+        padded_out=run_padded)
+    sorted_vals = list(kernels.gather_arrays(order2, run_vals))
+    gids2, num_groups = kernels.group_ids_from_sorted(
+        sorted_vals, valid_count=num_runs if run_padded else None,
+        padded_out=run_padded)
+    cap_g = shapes.padded_length(num_groups)
+    out_valid = num_groups if cap_g != num_groups else None
 
     def combine(run_partial, op):
-        return op(jnp.take(run_partial, order2), gids2, num_groups)
+        # Fused gather-through-order2 + segment reduce.
+        return kernels.gather_segment(run_partial, order2, gids2,
+                                      num_groups, op, padded_out=True)
 
     out = {}
-    firsts2 = kernels.segment_first_index(gids2, num_groups)
-    for g, sv in zip(plan.group_cols, sorted_vals):
+    group_vals = kernels.segment_heads(gids2, sorted_vals, num_groups,
+                                       padded_out=True)
+    for g, gv in zip(plan.group_cols, group_vals):
         src = table.column(g)
-        out[g] = Column(src.dtype, jnp.take(sv, firsts2), None,
-                        src.dictionary)
+        out[g] = Column(src.dtype, gv, None, src.dictionary)
     for agg_expr in plan.aggs:
         agg = _unwrap_agg(agg_expr)
         name = agg_expr.name
         if isinstance(agg, E.Count):
             validity = None if agg.child is None \
                 else eval_expr(table, agg.child).validity
-            run_c = kernels.segment_count(rids, num_runs, validity)
-            out[name] = Column(INT64, combine(run_c, kernels.segment_sum))
+            run_c = kernels.segment_count(rids, num_runs, validity,
+                                          padded_out=True)
+            out[name] = Column(INT64, combine(run_c, "sum"))
             continue
         child = _agg_child_column(agg, table)
         validity = child.validity
         out_validity = None
         total_valid = None
-        if validity is not None or isinstance(agg, E.Avg):
-            run_valid = kernels.segment_count(rids, num_runs, validity)
-            total_valid = combine(run_valid, kernels.segment_sum)
-            if validity is not None:
-                out_validity = total_valid > 0
         if isinstance(agg, (E.Sum, E.Avg)):
-            sums = combine(
-                kernels.segment_sum(_acc_widen(child.data, validity),
-                                    rids, num_runs),
-                kernels.segment_sum)
+            # Partial sums AND partial valid counts from one program.
+            run_sums, run_valid = kernels.segment_agg(
+                child.data, validity, rids, num_runs, "sum",
+                padded_out=True)
+            if run_valid is not None:
+                total_valid = combine(run_valid, "sum")
+                if validity is not None:
+                    out_validity = total_valid > 0
+            sums = combine(run_sums, "sum")
             if isinstance(agg, E.Sum):
                 out[name] = Column(_sum_out_dtype(sums), sums, out_validity)
             else:
+                if total_valid is None:
+                    run_valid = kernels.segment_count(rids, num_runs,
+                                                      padded_out=True)
+                    total_valid = combine(run_valid, "sum")
                 out[name] = Column(
                     FLOAT64,
                     sums.astype(jnp.float64) /
                     jnp.maximum(total_valid, 1).astype(jnp.float64),
                     out_validity)
-        elif isinstance(agg, E.Min):
-            out[name] = Column(
-                child.dtype,
-                combine(kernels.segment_min(_sentinel_filled(child, "min"),
-                                            rids, num_runs),
-                        kernels.segment_min),
-                out_validity, child.dictionary)
-        elif isinstance(agg, E.Max):
-            out[name] = Column(
-                child.dtype,
-                combine(kernels.segment_max(_sentinel_filled(child, "max"),
-                                            rids, num_runs),
-                        kernels.segment_max),
-                out_validity, child.dictionary)
+        elif isinstance(agg, (E.Min, E.Max)):
+            op = "min" if isinstance(agg, E.Min) else "max"
+            run_m, run_valid = kernels.segment_agg(
+                child.data, validity, rids, num_runs, op, widen=False,
+                padded_out=True)
+            if run_valid is not None:
+                out_validity = combine(run_valid, "sum") > 0
+            out[name] = Column(child.dtype, combine(run_m, op),
+                               out_validity, child.dictionary)
         else:
             raise HyperspaceException(f"Unknown aggregate {agg!r}")
-    return Table(out)
+    return Table(out, valid_rows=out_valid)
 
 
 def _np_dtype_for(dtype: str):
@@ -1161,7 +1316,7 @@ def _unwrap_agg(agg: E.Expr) -> E.AggExpr:
 
 
 def _agg_child_column(agg: E.AggExpr, table: Table) -> Column:
-    child = eval_expr(table, agg.child)
+    child = eval_expr_maybe_fused(table, agg.child)
     if child.dtype == STRING and not isinstance(agg, (E.Min, E.Max)):
         raise HyperspaceException("sum/avg over string column")
     return child
@@ -1217,42 +1372,45 @@ def _count_distinct(child: Column, gids, num_groups: int) -> Column:
     return Column(INT64, counts)
 
 
-def _eval_agg(agg: E.Expr, sorted_table: Table, gids, num_groups: int) -> Column:
+def _eval_agg(agg: E.Expr, sorted_table: Table, gids, num_groups: int,
+              padded_out: bool = False) -> Column:
     agg = _unwrap_agg(agg)
     if isinstance(agg, E.CountDistinct):
-        return _count_distinct(eval_expr(sorted_table, agg.child),
-                               gids, num_groups)
+        col = _count_distinct(eval_expr(sorted_table, agg.child),
+                              gids, num_groups)
+        if padded_out:
+            col = Column(col.dtype, shapes.pad_to(
+                col.data, shapes.padded_length(num_groups)), col.validity,
+                col.dictionary)
+        return col
     if isinstance(agg, E.Count):
         if agg.child is None:
-            data = kernels.segment_count(gids, num_groups)
+            data = kernels.segment_count(gids, num_groups,
+                                         padded_out=padded_out)
         else:
             child = eval_expr(sorted_table, agg.child)
-            data = kernels.segment_count(gids, num_groups, child.validity)
+            data = kernels.segment_count(gids, num_groups, child.validity,
+                                         padded_out=padded_out)
         return Column(INT64, data)
     child = _agg_child_column(agg, sorted_table)
     validity = child.validity
-    # SQL semantics: a group with no valid values aggregates to NULL.
-    out_validity = None
-    if validity is not None:
-        out_validity = kernels.segment_count(gids, num_groups, validity) > 0
     if isinstance(agg, (E.Sum, E.Avg)):
-        sums = kernels.segment_sum(_acc_widen(child.data, validity),
-                                   gids, num_groups)
+        op = "mean" if isinstance(agg, E.Avg) else "sum"
+        value, counts = kernels.segment_agg(child.data, validity, gids,
+                                            num_groups, op,
+                                            padded_out=padded_out)
+        # SQL semantics: a group with no valid values aggregates to NULL.
+        out_validity = (counts > 0) if validity is not None else None
         if isinstance(agg, E.Sum):
-            return Column(_sum_out_dtype(sums), sums, out_validity)
-        counts = kernels.segment_count(gids, num_groups, validity)
-        return Column(FLOAT64, sums.astype(jnp.float64) /
-                      jnp.maximum(counts, 1).astype(jnp.float64), out_validity)
-    if isinstance(agg, E.Min):
-        return Column(child.dtype,
-                      kernels.segment_min(_sentinel_filled(child, "min"),
-                                          gids, num_groups),
-                      out_validity, child.dictionary)
-    if isinstance(agg, E.Max):
-        return Column(child.dtype,
-                      kernels.segment_max(_sentinel_filled(child, "max"),
-                                          gids, num_groups),
-                      out_validity, child.dictionary)
+            return Column(_sum_out_dtype(value), value, out_validity)
+        return Column(FLOAT64, value, out_validity)
+    if isinstance(agg, (E.Min, E.Max)):
+        op = "min" if isinstance(agg, E.Min) else "max"
+        value, counts = kernels.segment_agg(child.data, validity, gids,
+                                            num_groups, op, widen=False,
+                                            padded_out=padded_out)
+        out_validity = (counts > 0) if validity is not None else None
+        return Column(child.dtype, value, out_validity, child.dictionary)
     raise HyperspaceException(f"Unknown aggregate {agg!r}")
 
 
@@ -1267,7 +1425,11 @@ def _min_sentinel(dtype):
 
 
 def _execute_global_aggregate(plan: Aggregate, table: Table) -> Table:
-    gids = jnp.zeros(table.num_rows, jnp.int32)
+    if table.is_padded:
+        # One fused program: pad rows scatter to a dropped segment.
+        gids = kernels.global_segment_ids(table.num_rows, table.data_rows)
+    else:
+        gids = jnp.zeros(table.data_rows, jnp.int32)
     out = {}
     for agg in plan.aggs:
         out[agg.name] = _eval_agg(agg, table, gids, 1)
@@ -1440,5 +1602,9 @@ def _execute_sort(plan: Sort, table: Table) -> Table:
         for k in _null_aware_keys(table.column(name)):
             keys.append(k)
             ascending.append(asc)
-    order = kernels.lex_sort_indices(keys, ascending)
-    return table.take(order)
+    padded = table.is_padded
+    order = kernels.lex_sort_indices(
+        keys, ascending, valid_count=table.num_rows if padded else None,
+        padded_out=padded)
+    return table.take(order,
+                      valid_rows=table.num_rows if padded else None)

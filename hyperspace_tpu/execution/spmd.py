@@ -165,8 +165,11 @@ def _load_leaf(leaf, stages, needed, executor) -> "Table":
             pa_filter = pruned_index_read_filter(
                 leaf.index_entry, combined, leaf.schema)
             if pa_filter is not None:
+                # compact(): the scan boundary class-pads for the padded
+                # pipeline; the SPMD stream manages its own static shapes.
                 table = ex._execute_index_scan(
-                    leaf, needed, pa_filter, prefer_pruned_read=True)
+                    leaf, needed, pa_filter,
+                    prefer_pruned_read=True).compact()
                 if table.num_rows > 0:
                     return table
                 # Filter matched nothing: fall through to the cached full
@@ -176,7 +179,7 @@ def _load_leaf(leaf, stages, needed, executor) -> "Table":
             pa_filter = pushable_filter(combined, leaf.schema,
                                         allow_nested=False)
             if pa_filter is not None:
-                table = ex._execute_scan(leaf, needed, pa_filter)
+                table = ex._execute_scan(leaf, needed, pa_filter).compact()
                 if table.num_rows > 0:
                     return table
     return executor(leaf, needed)

@@ -153,3 +153,26 @@ class IndexConstants:
     # nothing and costs compiles). "on"/"off" force.
     TPU_DISTRIBUTED_SINGLE_DEVICE = "hyperspace.tpu.distributed.singleDevice"
     TPU_DISTRIBUTED_SINGLE_DEVICE_DEFAULT = "auto"
+
+    # Shape-class execution (execution/shapes.py): arrays whose length is
+    # data-dependent (filter survivors, join match totals, group counts) are
+    # padded to a geometric length class with an explicit valid count, so the
+    # per-length XLA recompilation storm collapses onto a handful of compiled
+    # programs. maxWasteRatio/exactFallbackRows bound the HBM cost: an array
+    # of at least exactFallbackRows rows whose padding would waste more than
+    # maxWasteRatio of its size runs at its exact shape instead (huge arrays
+    # amortize their own compile; the waste would be real memory).
+    TPU_SHAPE_BUCKETING_ENABLED = "hyperspace.tpu.execution.shapeBucketing.enabled"
+    TPU_SHAPE_BUCKETING_ENABLED_DEFAULT = "true"
+    TPU_SHAPE_BUCKETING_GROWTH_FACTOR = \
+        "hyperspace.tpu.execution.shapeBucketing.growthFactor"
+    TPU_SHAPE_BUCKETING_GROWTH_FACTOR_DEFAULT = "2.0"
+    TPU_SHAPE_BUCKETING_MIN_PAD = \
+        "hyperspace.tpu.execution.shapeBucketing.minPadElements"
+    TPU_SHAPE_BUCKETING_MIN_PAD_DEFAULT = "1024"
+    TPU_SHAPE_BUCKETING_MAX_WASTE_RATIO = \
+        "hyperspace.tpu.execution.shapeBucketing.maxWasteRatio"
+    TPU_SHAPE_BUCKETING_MAX_WASTE_RATIO_DEFAULT = "0.25"
+    TPU_SHAPE_BUCKETING_EXACT_FALLBACK_ROWS = \
+        "hyperspace.tpu.execution.shapeBucketing.exactFallbackRows"
+    TPU_SHAPE_BUCKETING_EXACT_FALLBACK_ROWS_DEFAULT = str(4 * 1024 * 1024)

@@ -99,8 +99,24 @@ def available() -> bool:
     return get_lib() is not None
 
 
-def probe_native_enabled() -> bool:
-    """The C++ sketch-PROBE loops are OPT-IN (HST_NATIVE_PROBE=on).
+def probe_min_files() -> int:
+    """File-count floor below which the C++ probe NEVER dispatches, even
+    when enabled: at every measured lake scale up to 50k files numpy wins
+    (see probe_native_enabled), so the native path must not be allowed to
+    lose there. Deployments that profile a native win on bigger lakes
+    lower/raise HST_NATIVE_PROBE_MIN_FILES alongside HST_NATIVE_PROBE=on;
+    HST_NATIVE_PROBE=force bypasses the gate (benchmark A/B use)."""
+    try:
+        return int(os.environ.get("HST_NATIVE_PROBE_MIN_FILES", "100000"))
+    except ValueError:
+        return 100000
+
+
+def probe_native_enabled(n_files: Optional[int] = None) -> bool:
+    """The C++ sketch-PROBE loops are OPT-IN (HST_NATIVE_PROBE=on) and,
+    since round 7, additionally gated on the probed file count
+    (``n_files`` >= probe_min_files()) so the native path auto-disables
+    itself on workload shapes where numpy is faster.
 
     Measured round 5 at 1,600-50,000 synthetic files x 1-16 predicates:
     the numpy fallback is 2-3x FASTER than the ctypes-dispatched C++
@@ -112,7 +128,12 @@ def probe_native_enabled() -> bool:
     their own shapes. The Avro codec is NOT gated — its byte-level
     varint decode has no vectorized numpy equivalent and native genuinely
     wins there."""
-    return os.environ.get("HST_NATIVE_PROBE", "off").lower() == "on"
+    mode = os.environ.get("HST_NATIVE_PROBE", "off").lower()
+    if mode == "force":
+        return True
+    if mode != "on":
+        return False
+    return n_files is None or n_files >= probe_min_files()
 
 
 _OPS = {"EqualTo": 0, "LessThan": 1, "LessThanOrEqual": 2,
@@ -166,7 +187,7 @@ def bloom_probe_prepared(buf: np.ndarray, valid: np.ndarray, value,
     bitset proves the literal absent; missing bitsets keep the file."""
     n, stride = buf.shape
     positions = bloom_positions(value, dtype, num_bits, num_hashes)
-    lib = get_lib() if probe_native_enabled() else None
+    lib = get_lib() if probe_native_enabled(n) else None
     out = np.zeros(n, dtype=np.uint8)
     if lib is not None:
         lib.hst_bloom_probe_many(
@@ -290,7 +311,7 @@ def minmax_prune_prepared(prep: Tuple, op: str, value,
 
     lo, hi, has = prep
     n = lo.shape[0]
-    lib = get_lib() if probe_native_enabled() else None
+    lib = get_lib() if probe_native_enabled(n) else None
     out = np.zeros(n, dtype=np.uint8)
     if dtype in (FLOAT32, FLOAT64):
         try:

@@ -65,7 +65,10 @@ def build_sorted_buckets(table: Table, indexed_cols: Sequence[str],
 
     bids = bucket_ids_for(table, indexed_cols, num_buckets)
     sort_keys = [bids] + [table.column(c).data for c in indexed_cols]
-    perm = kernels.lex_sort_indices(sort_keys)
+    # pad=False: the build sorts the whole dataset at a stable length —
+    # class padding would cost ~growthFactor/2 extra sort work per build
+    # for no compile reuse (the length only changes when the data does).
+    perm = kernels.lex_sort_indices(sort_keys, pad=False)
     sorted_table = table.take(perm)
     if pallas_kernels.enabled():
         # Boundary offsets from the per-bucket histogram (one pass over the

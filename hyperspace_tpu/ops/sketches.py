@@ -24,6 +24,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..exceptions import HyperspaceException
+from ..execution import shapes
 from ..execution.columnar import Column
 from ..schema import DATE, STRING
 from . import kernels
@@ -55,14 +56,25 @@ def bloom_parameters(expected_items: int, fpp: float) -> Tuple[int, int]:
 
 def bloom_build(col: Column, num_bits: int, num_hashes: int) -> np.ndarray:
     """Build a bloom bitset over the column's valid values on device.
-    Returns the packed bits as host uint8 (num_bits/8 bytes)."""
-    h1 = kernels.hash32_values(col.data, col.dtype, col.dictionary)
+    Returns the packed bits as host uint8 (num_bits/8 bytes).
+
+    Shape classes: the column is padded to its length class so every
+    per-file build at a class shares one compiled program; pad rows (like
+    null rows) scatter onto the overflow bit that is sliced away — the
+    packed bitset is byte-identical to the unpadded build."""
+    data, n = shapes.pad_class(col.data)
+    validity = col.validity
+    if validity is not None:
+        validity = shapes.pad_to(validity, int(data.shape[0]), False)
+    elif shapes.is_padded(data, n):
+        validity = shapes.valid_mask(int(data.shape[0]), n)
+    h1 = kernels.hash32_values(data, col.dtype, col.dictionary)
     h2 = _h2_device(h1)
     i = jnp.arange(num_hashes, dtype=jnp.uint32)[:, None]
     pos = ((h1[None, :] + i * h2[None, :]) % np.uint32(num_bits)).astype(jnp.int32)
-    if col.validity is not None:
-        # Null rows scatter onto an overflow bit that is sliced away.
-        pos = jnp.where(col.validity[None, :], pos, num_bits)
+    if validity is not None:
+        # Null (and pad) rows scatter onto an overflow bit, sliced away.
+        pos = jnp.where(validity[None, :], pos, num_bits)
     bits = jnp.zeros(num_bits + 1, jnp.bool_).at[pos.reshape(-1)].set(True)
     return np.packbits(np.asarray(jax.device_get(bits[:num_bits])))
 
@@ -113,24 +125,32 @@ def minmax_values(col: Column) -> Tuple[Optional[object], Optional[object]]:
 
     from . import pallas_kernels
 
-    data = col.data
+    if col.data.shape[0] == 0:
+        return None, None
+    # Shape classes: padded to the length class, pad rows masked like
+    # nulls — per-file builds at one class share one compiled reduction.
+    data, n = shapes.pad_class(col.data)
+    validity = col.validity
+    if validity is not None:
+        validity = shapes.pad_to(validity, int(data.shape[0]), False)
+    elif shapes.is_padded(data, n):
+        validity = shapes.valid_mask(int(data.shape[0]), n)
     # 32-bit lanes go through the fused one-pass Pallas reduction on TPU.
     use_pallas = (pallas_kernels.enabled() and data.shape[0] > 0
                   and data.dtype in (jnp.int32, jnp.float32))
-    if col.validity is not None:
-        n_valid = int(jnp.sum(col.validity))
-        if n_valid == 0:
-            return None, None
+    if validity is not None:
+        if col.validity is not None:
+            n_valid = int(jnp.sum(validity))
+            if n_valid == 0:
+                return None, None
         if use_pallas:
-            mn, mx = pallas_kernels.masked_minmax(data, col.validity)
+            mn, mx = pallas_kernels.masked_minmax(data, validity)
         else:
             lo_sent = _max_sentinel(data.dtype)
             hi_sent = _min_sentinel(data.dtype)
-            mn = jnp.min(jnp.where(col.validity, data, lo_sent))
-            mx = jnp.max(jnp.where(col.validity, data, hi_sent))
+            mn = jnp.min(jnp.where(validity, data, lo_sent))
+            mx = jnp.max(jnp.where(validity, data, hi_sent))
     else:
-        if data.shape[0] == 0:
-            return None, None
         if use_pallas:
             mn, mx = pallas_kernels.masked_minmax(data)
         else:

@@ -101,6 +101,7 @@ def explain_string(session, plan: LogicalPlan, verbose: bool = False,
     for line in (used if used else ["<none>"]):
         buf.write_line(line)
     _write_cache_section(buf, session, plan)
+    _write_compilation_section(buf, session)
     if verbose:
         buf.write_line()
         _header(buf, "Physical operator stats:")
@@ -151,6 +152,31 @@ def _write_cache_section(buf: BufferStream, session,
         buf.write_line(
             f"index table cache: hits={ic.hits} misses={ic.misses} "
             f"resident_bytes={ic.nbytes}")
+
+
+def _write_compilation_section(buf: BufferStream, session) -> None:
+    """Shape-class execution observability (execution/shapes.py): the
+    process-lifetime XLA compile tally and the active bucketing knobs.
+    Rendered only when bucketing is explicitly configured OR compiles
+    have happened, so pristine-session explain goldens are untouched."""
+    from ..execution import shapes
+    total = shapes.compile_count()
+    if total == 0:
+        return
+    p = shapes.params_from_conf(session.hs_conf)
+    buf.write_line()
+    _header(buf, "Compilation:")
+    buf.write_line(
+        f"xla compiles: total={total} "
+        f"seconds={shapes.compile_seconds():.2f}")
+    if p.enabled:
+        buf.write_line(
+            f"shape bucketing: on (growth={p.growth_factor:g} "
+            f"minPad={p.min_pad} maxWaste={p.max_waste_ratio:g} "
+            f"exactFallbackRows={p.exact_fallback_rows})")
+    else:
+        buf.write_line("shape bucketing: off (every data-dependent "
+                       "length compiles its own programs)")
 
 
 def _count_nodes(plan: LogicalPlan):

@@ -36,6 +36,33 @@ TIER_DEVICE = "device"
 TIER_HOST = "host"
 
 
+def _to_device(table):
+    """Upload a host-resident result into HBM with ONE batched device_put
+    (shape-class execution trims padded final results at the host
+    boundary, so most results arrive numpy-backed). The device tier must
+    hold REAL device buffers — otherwise its byte budget would charge
+    host RAM against HBM and 'demotion' would be a no-op copy."""
+    import jax
+    import numpy as np
+
+    from ..execution.columnar import Column
+    from ..execution.columnar import Table as _Table
+    if not any(isinstance(c.data, np.ndarray)
+               for c in table.columns.values()):
+        return table
+    arrays = {}
+    for n, c in table.columns.items():
+        arrays[(n, "d")] = c.data
+        if c.validity is not None:
+            arrays[(n, "v")] = c.validity
+    dev = jax.device_put(arrays)
+    return _Table({n: Column(c.dtype, dev[(n, "d")],
+                             dev[(n, "v")] if c.validity is not None
+                             else None, c.dictionary)
+                   for n, c in table.columns.items()},
+                  bucket_order=table.bucket_order)
+
+
 def table_nbytes(table) -> int:
     """One byte-accounting for every residency cache in the system
     (execution/index_cache.py owns it; imported lazily because the
@@ -113,6 +140,7 @@ class ResultCache:
         probe behind a multi-hundred-MB device fetch."""
         nbytes = table_nbytes(table)
         if nbytes <= self.device_bytes:
+            table = _to_device(table)  # outside the lock
             with self._lock:
                 self._drop(key)
                 self._device[key] = (table, nbytes)

@@ -151,17 +151,21 @@ def attach_partition_columns(table, relation, files: Sequence[str],
 
 def read_relation_files(relation, files: Sequence[str],
                         cols: Optional[Sequence[str]], fmt: str,
-                        filters=None):
+                        filters=None, pad_to_class: bool = False):
     """Read ``files`` with partition columns attached (the single reader
     shared by the scan executor and the index build). Non-partitioned
-    relations delegate straight to the columnar reader."""
+    relations delegate straight to the columnar reader. ``pad_to_class``
+    (executor scans only — never the build) class-pads host-side; the
+    partition-attach paths stay exact and are padded on device by the
+    executor instead."""
     from ..execution.columnar import (parquet_row_counts, read_parquet)
 
     fields = getattr(relation, "partition_fields", lambda: [])()
     part_names = {f.name for f in fields}
     if not fields or (cols is not None
                       and not any(c in part_names for c in cols)):
-        return read_parquet(files, cols, fmt, filters=filters)
+        return read_parquet(files, cols, fmt, filters=filters,
+                            pad_to_class=pad_to_class)
     wanted = fields if cols is None else \
         [f for f in fields if f.name in cols]
     phys_cols = None if cols is None else \

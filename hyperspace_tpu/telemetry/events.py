@@ -132,6 +132,21 @@ class ResultCacheEvictionEvent(ResultCacheEvent):
 
 
 @dataclass
+class KernelCompileEvent(HyperspaceEvent):
+    """XLA compilation tally for one plan execution (no reference
+    analogue; see execution/shapes.py). ``count`` is the number of
+    backend compiles the execution triggered, ``seconds`` their summed
+    compile time, ``total`` the process-lifetime compile count. With
+    shape bucketing healthy, steady-state executions emit no event at
+    all (count 0 is not reported); a stream of these on a warm serving
+    path is the recompilation-storm signature."""
+
+    count: int = 0
+    seconds: float = 0.0
+    total: int = 0
+
+
+@dataclass
 class IndexCacheProbeEvent(HyperspaceEvent):
     """Base of the HBM index-table-cache probe events: the executor emits
     one per IndexScan cache lookup (execution/index_cache.py counts were

@@ -36,6 +36,21 @@ ENV_READ_ALLOWLIST = frozenset({
     "hyperspace_tpu/parallel/multihost.py",
 })
 
+# Compile-observability discipline: every jax.jit stays inside the
+# instrumented kernel modules, where the shape-class layer
+# (execution/shapes.py) can see and count its compiles. A jit in an
+# arbitrary module is invisible to the compile counter's attribution and
+# bypasses the padding contract. This list is FROZEN — new jitted stages
+# go into ops/kernels.py (or pallas_kernels.py for Mosaic), not new files.
+JIT_SITE_ALLOWLIST = frozenset({
+    "hyperspace_tpu/ops/kernels.py",
+    "hyperspace_tpu/ops/pallas_kernels.py",
+    "hyperspace_tpu/execution/shapes.py",
+    "hyperspace_tpu/execution/spmd.py",
+    "hyperspace_tpu/parallel/distributed_build.py",
+    "hyperspace_tpu/parallel/distributed_query.py",
+})
+
 
 def iter_sources():
     for d in ALL_DIRS:
@@ -82,6 +97,23 @@ def unused_imports(tree: ast.AST) -> list:
                   if name not in used and not name.startswith("_"))
 
 
+def jit_sites(tree: ast.AST) -> list:
+    """Line numbers of jax.jit / jax.pjit references (attribute access
+    covers bare calls, partial(jax.jit, ...) and decorators alike)."""
+    out = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Attribute) \
+                and node.attr in ("jit", "pjit") \
+                and isinstance(node.value, ast.Name) \
+                and node.value.id == "jax":
+            out.append(node.lineno)
+        elif isinstance(node, ast.ImportFrom) and node.module \
+                and node.module.split(".")[0] == "jax":
+            if any(a.name in ("jit", "pjit") for a in node.names):
+                out.append(node.lineno)
+    return sorted(set(out))
+
+
 def env_reads(tree: ast.AST) -> list:
     """Line numbers of os.environ / os.getenv style env accesses."""
     out = []
@@ -125,6 +157,13 @@ def main() -> int:
                 problems.append(
                     f"{rel}:{line}: ad-hoc env read (os.environ/getenv); "
                     "knobs must go through config.py accessors")
+        if any(rel.startswith(d + os.sep) for d in PACKAGE_DIRS) \
+                and rel.replace(os.sep, "/") not in JIT_SITE_ALLOWLIST:
+            for line in jit_sites(tree):
+                problems.append(
+                    f"{rel}:{line}: jax.jit outside the instrumented "
+                    "kernel modules; add the jitted stage to ops/kernels.py "
+                    "so the compile counter sees it")
     for p in problems:
         print(p)
     print(f"lint: {len(problems)} problem(s) across "

@@ -22,9 +22,10 @@ def lib_available():
 @pytest.fixture(autouse=True)
 def _enable_native_probe(monkeypatch):
     # The C++ probe loops are opt-in since round 5 (numpy measured
-    # faster at every lake scale); these tests exist to pin the C++
-    # implementations against the references, so turn them on.
-    monkeypatch.setenv("HST_NATIVE_PROBE", "on")
+    # faster at every lake scale) and file-count-gated since round 7;
+    # these tests exist to pin the C++ implementations against the
+    # references, so force them past both gates.
+    monkeypatch.setenv("HST_NATIVE_PROBE", "force")
 
 
 def _bloom_rows(n_filters=40, num_bits=256, num_hashes=4, seed=0):

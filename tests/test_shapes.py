@@ -1,0 +1,455 @@
+"""Shape-class execution layer (execution/shapes.py).
+
+Two contracts under test:
+
+1. BYTE-IDENTITY — every padded+masked kernel (hash, sort, merge join,
+   segment ops, sketch builds) and the padded executor pipeline must
+   return byte-identical results to exact-shape execution, across all
+   dtypes including the STRING dictionary path.
+
+2. COMPILE COLLAPSE — a mixed-length batch of file scans must compile
+   each kernel a small constant number of times (one per length CLASS),
+   not once per distinct file length: the recompilation storm this layer
+   exists to kill.
+"""
+
+import datetime
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+import hyperspace_tpu as hst
+from hyperspace_tpu.execution import shapes
+from hyperspace_tpu.execution.columnar import Column, Table
+from hyperspace_tpu.index.constants import IndexConstants
+from hyperspace_tpu.ops import kernels, sketches
+from hyperspace_tpu.plan.expr import avg, col, sum_
+from hyperspace_tpu.schema import (BOOL, DATE, FLOAT32, FLOAT64, INT32,
+                                   INT64, STRING)
+
+ENABLED = shapes.ShapeParams(enabled=True, min_pad=64, growth_factor=2.0)
+DISABLED = shapes.ShapeParams(enabled=False)
+
+# Lengths straddling class boundaries: empty, tiny, one below/at/above a
+# class edge, and a mid-class odd size.
+LENGTHS = [0, 1, 63, 64, 65, 127, 128, 200]
+
+
+def _session(tmp_path, **conf):
+    s = hst.Session(system_path=str(tmp_path / "idx"))
+    s.conf.set(IndexConstants.TPU_DISTRIBUTED_ENABLED, "false")
+    for k, v in conf.items():
+        s.conf.set(k, v)
+    return s
+
+
+class TestPaddedLength:
+    def test_at_least_n_and_on_ladder(self):
+        with shapes.use_params(ENABLED):
+            for n in range(1, 5000, 37):
+                c = shapes.padded_length(n)
+                assert c >= n
+                assert c >= ENABLED.min_pad
+                # Ladder membership: min_pad * growth^k.
+                k = c
+                while k > ENABLED.min_pad:
+                    assert k % 2 == 0
+                    k //= 2
+                assert k == ENABLED.min_pad
+
+    def test_idempotent_and_monotone_ladder(self):
+        with shapes.use_params(ENABLED):
+            for n in (1, 64, 65, 1000, 4096):
+                c = shapes.padded_length(n)
+                assert shapes.padded_length(c) == c
+
+    def test_disabled_and_zero(self):
+        with shapes.use_params(DISABLED):
+            assert shapes.padded_length(77) == 77
+        with shapes.use_params(ENABLED):
+            assert shapes.padded_length(0) == 0
+
+    def test_huge_exact_fallback(self):
+        p = shapes.ShapeParams(enabled=True, min_pad=64, growth_factor=2.0,
+                               max_waste_ratio=0.25,
+                               exact_fallback_rows=1000)
+        with shapes.use_params(p):
+            # 1100 -> next class 2048 wastes 86% > 25% and n >= fallback.
+            assert shapes.padded_length(1100) == 1100
+            # 2000 -> 2048 wastes 2.4% <= 25%: still bucketed.
+            assert shapes.padded_length(2000) == 2048
+            # below the huge threshold, waste is always accepted.
+            assert shapes.padded_length(70) == 128
+
+    def test_conf_roundtrip(self, tmp_path):
+        s = _session(
+            tmp_path,
+            **{IndexConstants.TPU_SHAPE_BUCKETING_MIN_PAD: "32",
+               IndexConstants.TPU_SHAPE_BUCKETING_GROWTH_FACTOR: "4.0"})
+        p = shapes.params_from_conf(s.hs_conf)
+        assert p.min_pad == 32 and p.growth_factor == 4.0 and p.enabled
+        s.conf.set(IndexConstants.TPU_SHAPE_BUCKETING_ENABLED, "false")
+        assert not shapes.params_from_conf(s.hs_conf).enabled
+
+
+class TestPadPrimitives:
+    def test_pad_host_and_device_roundtrip(self):
+        for arr in (np.arange(10, dtype=np.int64),
+                    jnp.arange(10, dtype=jnp.float64)):
+            out = shapes.pad_to(arr, 16, 7)
+            assert out.shape == (16,)
+            np.testing.assert_array_equal(np.asarray(out[:10]),
+                                          np.asarray(arr))
+            np.testing.assert_array_equal(np.asarray(out[10:]),
+                                          np.full(6, 7))
+            np.testing.assert_array_equal(
+                np.asarray(shapes.unpad(out, 10)), np.asarray(arr))
+
+    def test_mask_tail_and_valid_mask(self):
+        arr = jnp.arange(8, dtype=jnp.int32)
+        np.testing.assert_array_equal(
+            np.asarray(shapes.mask_tail(arr, 5, -1)),
+            [0, 1, 2, 3, 4, -1, -1, -1])
+        np.testing.assert_array_equal(
+            np.asarray(shapes.valid_mask(6, 2)),
+            [True, True, False, False, False, False])
+
+
+def _rand_keys(rng, n, dtype):
+    if dtype == INT32:
+        return jnp.asarray(rng.integers(-50, 50, n).astype(np.int32))
+    if dtype == INT64:
+        return jnp.asarray(rng.integers(-10**12, 10**12, n))
+    if dtype == DATE:
+        return jnp.asarray(rng.integers(0, 10000, n).astype(np.int32))
+    if dtype == BOOL:
+        return jnp.asarray(rng.integers(0, 2, n).astype(bool))
+    if dtype == FLOAT32:
+        return jnp.asarray(rng.normal(size=n).astype(np.float32))
+    return jnp.asarray(rng.normal(size=n))
+
+
+class TestKernelByteIdentity:
+    """Each kernel: padded-class execution == exact execution, bit for bit."""
+
+    @pytest.mark.parametrize("dtype", [INT32, INT64, DATE, BOOL,
+                                       FLOAT32, FLOAT64])
+    @pytest.mark.parametrize("n", LENGTHS)
+    def test_hash32(self, dtype, n):
+        rng = np.random.default_rng(n)
+        data = _rand_keys(rng, n, dtype)
+        with shapes.use_params(DISABLED):
+            want = np.asarray(kernels.hash32_values(data, dtype))
+        with shapes.use_params(ENABLED):
+            got = np.asarray(kernels.hash32_values(data, dtype))
+        np.testing.assert_array_equal(got, want)
+
+    @pytest.mark.parametrize("n", LENGTHS)
+    def test_hash32_string_dictionary(self, n):
+        rng = np.random.default_rng(n)
+        dictionary = np.array(sorted({f"s{i:03d}" for i in range(17)}))
+        codes = jnp.asarray(rng.integers(0, len(dictionary), n)
+                            .astype(np.int32))
+        with shapes.use_params(DISABLED):
+            want = np.asarray(kernels.hash32_values(codes, STRING,
+                                                    dictionary))
+        with shapes.use_params(ENABLED):
+            got = np.asarray(kernels.hash32_values(codes, STRING,
+                                                   dictionary))
+        np.testing.assert_array_equal(got, want)
+
+    @pytest.mark.parametrize("n", LENGTHS)
+    def test_lex_sort_indices(self, n):
+        rng = np.random.default_rng(n)
+        k1 = _rand_keys(rng, n, INT64)
+        k2 = _rand_keys(rng, n, FLOAT64)
+        for ascending in (None, [False, True]):
+            with shapes.use_params(DISABLED):
+                want = np.asarray(kernels.lex_sort_indices([k1, k2],
+                                                           ascending))
+            with shapes.use_params(ENABLED):
+                got = np.asarray(kernels.lex_sort_indices([k1, k2],
+                                                          ascending))
+            np.testing.assert_array_equal(got, want)
+
+    @pytest.mark.parametrize("n", LENGTHS)
+    def test_lex_sort_padded_out_prefix(self, n):
+        with shapes.use_params(ENABLED):
+            cls = shapes.padded_length(n)
+            rng = np.random.default_rng(n)
+            k = shapes.pad_to(_rand_keys(rng, n, INT64), cls, 123)
+            perm = kernels.lex_sort_indices([k], valid_count=n,
+                                            padded_out=True)
+            assert perm.shape[0] == cls
+            with shapes.use_params(DISABLED):
+                want = np.asarray(kernels.lex_sort_indices([k[:n]]))
+            np.testing.assert_array_equal(np.asarray(perm)[:n], want)
+            # Pad entries index pad rows (sorted last).
+            assert np.all(np.asarray(perm)[n:] >= n)
+
+    @pytest.mark.parametrize("n", LENGTHS)
+    def test_merge_join_indices(self, n):
+        rng = np.random.default_rng(n)
+        left = jnp.asarray(rng.integers(0, max(n, 1), max(n, 1)))
+        right = jnp.sort(jnp.asarray(
+            rng.integers(0, max(n, 1), max(n // 2, 1))))
+        with shapes.use_params(DISABLED):
+            wl, wr, wc = kernels.merge_join_indices(left, right,
+                                                    return_counts=True)
+        with shapes.use_params(ENABLED):
+            gl, gr, gc = kernels.merge_join_indices(left, right,
+                                                    return_counts=True)
+        np.testing.assert_array_equal(np.asarray(gl), np.asarray(wl))
+        np.testing.assert_array_equal(np.asarray(gr), np.asarray(wr))
+        np.testing.assert_array_equal(np.asarray(gc), np.asarray(wc))
+
+    def test_merge_join_dtype_max_keys(self):
+        # Real keys equal to the pad sentinel must still match exactly.
+        m = np.iinfo(np.int64).max
+        left = jnp.asarray(np.array([1, m, 5, m], dtype=np.int64))
+        right = jnp.asarray(np.array([1, 5, m], dtype=np.int64))
+        with shapes.use_params(DISABLED):
+            wl, wr = kernels.merge_join_indices(left, right)
+        with shapes.use_params(ENABLED):
+            gl, gr = kernels.merge_join_indices(left, right)
+        np.testing.assert_array_equal(np.asarray(gl), np.asarray(wl))
+        np.testing.assert_array_equal(np.asarray(gr), np.asarray(wr))
+
+    @pytest.mark.parametrize("n", LENGTHS)
+    def test_group_ids_and_segment_ops(self, n):
+        rng = np.random.default_rng(n)
+        keys = jnp.sort(jnp.asarray(rng.integers(0, 20, n)))
+        vals = jnp.asarray(rng.normal(size=n))
+        with shapes.use_params(DISABLED):
+            wg, wn = kernels.group_ids_from_sorted([keys])
+            want = {
+                "sum": np.asarray(kernels.segment_sum(vals, wg, wn)),
+                "min": np.asarray(kernels.segment_min(vals, wg, wn)),
+                "max": np.asarray(kernels.segment_max(vals, wg, wn)),
+                "cnt": np.asarray(kernels.segment_count(wg, wn)),
+                "first": np.asarray(kernels.segment_first_index(wg, wn)),
+            } if wn else {}
+        with shapes.use_params(ENABLED):
+            gg, gn = kernels.group_ids_from_sorted([keys])
+            assert gn == wn
+            np.testing.assert_array_equal(np.asarray(gg), np.asarray(wg))
+            if gn:
+                np.testing.assert_array_equal(
+                    np.asarray(kernels.segment_sum(vals, gg, gn)),
+                    want["sum"])
+                np.testing.assert_array_equal(
+                    np.asarray(kernels.segment_min(vals, gg, gn)),
+                    want["min"])
+                np.testing.assert_array_equal(
+                    np.asarray(kernels.segment_max(vals, gg, gn)),
+                    want["max"])
+                np.testing.assert_array_equal(
+                    np.asarray(kernels.segment_count(gg, gn)), want["cnt"])
+                np.testing.assert_array_equal(
+                    np.asarray(kernels.segment_first_index(gg, gn)),
+                    want["first"])
+
+
+class TestSketchByteIdentity:
+    @pytest.mark.parametrize("n", [1, 63, 200])
+    @pytest.mark.parametrize("with_nulls", [False, True])
+    def test_bloom_build(self, n, with_nulls):
+        rng = np.random.default_rng(n)
+        data = jnp.asarray(rng.integers(0, 1000, n))
+        validity = jnp.asarray(rng.integers(0, 2, n).astype(bool)) \
+            if with_nulls else None
+        c = Column(INT64, data, validity)
+        with shapes.use_params(DISABLED):
+            want = sketches.bloom_build(c, 256, 4)
+        with shapes.use_params(ENABLED):
+            got = sketches.bloom_build(c, 256, 4)
+        assert got.tobytes() == want.tobytes()
+
+    @pytest.mark.parametrize("n", [1, 63, 200])
+    def test_bloom_build_string(self, n):
+        rng = np.random.default_rng(n)
+        dictionary = np.array(sorted({f"v{i}" for i in range(9)}))
+        codes = jnp.asarray(rng.integers(0, len(dictionary), n)
+                            .astype(np.int32))
+        c = Column(STRING, codes, None, dictionary)
+        with shapes.use_params(DISABLED):
+            want = sketches.bloom_build(c, 128, 3)
+        with shapes.use_params(ENABLED):
+            got = sketches.bloom_build(c, 128, 3)
+        assert got.tobytes() == want.tobytes()
+
+    @pytest.mark.parametrize("dtype", [INT32, INT64, DATE, FLOAT64])
+    @pytest.mark.parametrize("n", [1, 63, 200])
+    def test_minmax(self, dtype, n):
+        rng = np.random.default_rng(n)
+        data = _rand_keys(rng, n, dtype)
+        validity = jnp.asarray(rng.integers(0, 2, n).astype(bool))
+        for v in (None, validity):
+            c = Column(dtype, data, v)
+            with shapes.use_params(DISABLED):
+                want = sketches.minmax_values(c)
+            with shapes.use_params(ENABLED):
+                got = sketches.minmax_values(c)
+            assert got == want
+
+    def test_minmax_all_null(self):
+        c = Column(INT64, jnp.arange(70), jnp.zeros(70, jnp.bool_))
+        with shapes.use_params(ENABLED):
+            assert sketches.minmax_values(c) == (None, None)
+
+
+class TestEndToEndByteIdentity:
+    """Padded pipeline vs exact pipeline over a query exercising filter,
+    string predicates, join, group-by, sort and nulls."""
+
+    def _write(self, tmp_path):
+        rng = np.random.default_rng(7)
+        n = 3000
+        pq.write_table(pa.table({
+            "k": pa.array(rng.integers(0, 40, n).astype(np.int64)),
+            "v": pa.array(np.round(rng.uniform(0, 100, n), 2)),
+            "s": pa.array(rng.choice(["red", "green", "blue", None], n)),
+            "d": pa.array((rng.integers(0, 3000, n)).astype("int32"),
+                          type=pa.int32()).cast(pa.date32()),
+        }), str(tmp_path / "t.parquet"))
+        m = 400
+        pq.write_table(pa.table({
+            "k2": pa.array(rng.integers(0, 40, m).astype(np.int64)),
+            "w": pa.array(np.round(rng.uniform(0, 10, m), 2)),
+        }), str(tmp_path / "u.parquet"))
+
+    def test_query_identical(self, tmp_path):
+        self._write(tmp_path)
+        s = _session(tmp_path)
+        t = s.read.parquet(str(tmp_path / "t.parquet"))
+        u = s.read.parquet(str(tmp_path / "u.parquet"))
+        q = (t.filter((col("k") > 3) & (col("s") != "red"))
+             .join(u, on=col("k") == col("k2"))
+             .group_by("k", "s")
+             .agg(sum_(col("v") * col("w")).alias("vw"),
+                  avg(col("v")).alias("va"))
+             .sort(("vw", False), "k")
+             .limit(50))
+        got = q.to_arrow()
+        s.conf.set(IndexConstants.TPU_SHAPE_BUCKETING_ENABLED, "false")
+        want = q.to_arrow()
+        assert got.equals(want)
+
+    def test_filter_result_identical_and_compact(self, tmp_path):
+        self._write(tmp_path)
+        s = _session(tmp_path)
+        t = s.read.parquet(str(tmp_path / "t.parquet"))
+        q = t.filter(col("d") >= datetime.date(1975, 1, 1)).select("k", "v")
+        res = q.execute()
+        assert not res.is_padded  # execute() compacts at the boundary
+        got = q.to_arrow()
+        s.conf.set(IndexConstants.TPU_SHAPE_BUCKETING_ENABLED, "false")
+        assert got.equals(q.to_arrow())
+
+
+class TestCompileCollapse:
+    def test_mixed_length_scans_compile_bounded(self, tmp_path):
+        """A batch of file scans with MANY distinct lengths within one
+        length class compiles only for the first (plus the tiny per-file
+        host boundary) — not one chain per length."""
+        rng = np.random.default_rng(3)
+        paths = []
+        # 8 distinct lengths, all inside the (1024, 2048] class.
+        for i, n in enumerate([1100, 1205, 1333, 1478, 1555, 1717, 1890,
+                               2047]):
+            p = str(tmp_path / f"f{i}.parquet")
+            pq.write_table(pa.table({
+                "a": pa.array(rng.integers(0, 1000, n).astype(np.int64)),
+                "b": pa.array(rng.uniform(0, 1, n)),
+            }), p)
+            paths.append(p)
+        s = _session(tmp_path)
+
+        def scan(p):
+            # ~10% selectivity keeps every file's pushdown survivor count
+            # inside ONE length class (the scan lengths already share one).
+            df = s.read.parquet(p)
+            return df.filter(col("a") > 900).agg(
+                sum_(col("b")).alias("t")).to_arrow()
+
+        scan(paths[0])  # warm the class's programs
+        before = shapes.compile_count()
+        for p in paths[1:]:
+            scan(p)
+        delta = shapes.compile_count() - before
+        # Every later scan shares the first one's compiled class programs.
+        assert delta <= 3, f"expected near-zero compiles, got {delta}"
+
+    def test_compile_counter_monotone(self):
+        a = shapes.compile_count()
+        jnp.sort(jnp.arange(4097) % 7).block_until_ready()
+        assert shapes.compile_count() >= a
+
+    def test_kernel_compile_event_emitted(self, tmp_path):
+        from tests.conftest import capture_logger
+        rng = np.random.default_rng(0)
+        p = str(tmp_path / "e.parquet")
+        pq.write_table(pa.table({
+            "a": pa.array(rng.integers(0, 9999, 5000).astype(np.int64))}),
+            p)
+        s = _session(tmp_path)
+        s.conf.set(IndexConstants.EVENT_LOGGER_CLASS,
+                   "tests.conftest.CaptureLogger")
+        cap = capture_logger()
+        cap.events = []
+        # A fresh filter on a fresh length class forces compiles.
+        s.read.parquet(p).filter(col("a") > 123).to_arrow()
+        names = [e.event_name for e in cap.events]
+        assert "KernelCompileEvent" in names
+        ev = [e for e in cap.events
+              if e.event_name == "KernelCompileEvent"][0]
+        assert ev.count > 0 and ev.total >= ev.count
+
+    def test_explain_compilation_section(self, tmp_path):
+        rng = np.random.default_rng(0)
+        p = str(tmp_path / "x.parquet")
+        pq.write_table(pa.table({
+            "a": pa.array(rng.integers(0, 99, 100).astype(np.int64))}), p)
+        s = _session(tmp_path)
+        from hyperspace_tpu.api import Hyperspace
+        hs = Hyperspace(s)
+        df = s.read.parquet(p).filter(col("a") > 5)
+        text = hs.explain(df, verbose=False)
+        assert "Compilation:" in text
+        assert "shape bucketing: on" in text
+        s.conf.set(IndexConstants.TPU_SHAPE_BUCKETING_ENABLED, "false")
+        text = hs.explain(df, verbose=False)
+        assert "shape bucketing: off" in text
+
+
+class TestXlaCacheOptIn:
+    def test_cpu_opt_in(self, monkeypatch, tmp_path):
+        from hyperspace_tpu import execution as ex
+        monkeypatch.setenv("HST_XLA_CACHE", "on")
+        monkeypatch.setenv("HST_XLA_CACHE_DIR", str(tmp_path / "xla"))
+        prev = jax.config.jax_compilation_cache_dir
+        try:
+            ex.ensure_compilation_cache(force=True)
+            assert jax.config.jax_compilation_cache_dir == \
+                str(tmp_path / "xla")
+            assert os.path.isdir(str(tmp_path / "xla"))
+        finally:
+            jax.config.update("jax_compilation_cache_dir", prev)
+            ex._cache_configured = False
+
+    def test_cpu_default_stays_off(self, monkeypatch):
+        from hyperspace_tpu import execution as ex
+        monkeypatch.setenv("HST_XLA_CACHE", "auto")
+        prev = jax.config.jax_compilation_cache_dir
+        try:
+            jax.config.update("jax_compilation_cache_dir", None)
+            ex.ensure_compilation_cache(force=True)
+            assert jax.config.jax_compilation_cache_dir is None
+        finally:
+            jax.config.update("jax_compilation_cache_dir", prev)
+            ex._cache_configured = False
