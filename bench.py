@@ -988,6 +988,58 @@ def _single_device_phases(args, root):
                     off_s / on_s if on_s > 0 else float("inf"), 3)
                 RESULT["result_cache_hits"] = stats.get("hits", 0)
 
+    # ---- advisor: capture workload -> recommend -> build top reco ----
+    # A FRESH session over its own (empty) system path: recommendations
+    # are for indexes that do not exist yet, and the capture must see the
+    # unrewritten scans. Runs BEFORE the hybrid appends so the advisor's
+    # what-if signatures and the timed pairs see identical sources.
+    if not _backend_dead():
+        with _phase("advisor"):
+            from hyperspace_tpu.advisor.constants import AdvisorConstants
+            adv_session = hst.Session(
+                system_path=os.path.join(root, "advisor_indexes"))
+            adv_session.conf.set(IndexConstants.INDEX_NUM_BUCKETS, 32)
+            adv_session.enable_hyperspace()
+            adv_hs = Hyperspace(adv_session)
+            adv_qs = [("q3", build_q3(adv_session, li_dir, od_dir)),
+                      ("q17", build_q17(adv_session, li_dir, pt_dir))]
+            adv_session.conf.set(AdvisorConstants.CAPTURE_ENABLED, "true")
+            for _qn, q in adv_qs:
+                q.to_arrow()  # one captured record per query
+            adv_session.conf.set(AdvisorConstants.CAPTURE_ENABLED, "false")
+            report = adv_hs.recommend(top_k=5)
+            RESULT["advisor_recommended"] = [
+                {"names": list(r.names), "kind": r.kind,
+                 "predicted_benefit_s": round(r.predicted_benefit_s, 4),
+                 "predicted_speedup": round(r.predicted_speedup, 3)}
+                for r in report.recommendations]
+            if report.recommendations:
+                top = report.recommendations[0]
+                base_s = {qn: timed_best(lambda q=q: q.to_arrow(),
+                                         args.repeats)
+                          for qn, q in adv_qs}
+                t0 = time.perf_counter()
+                adv_hs.build_recommendation(top)
+                RESULT["advisor_top_reco_build_s"] = round(
+                    time.perf_counter() - t0, 3)
+                matched = [adv_qs[i] for i in top.record_indices
+                           if i < len(adv_qs)] or adv_qs
+                for _qn, q in matched:
+                    q.to_arrow()  # warm the rewritten path
+                after_s = {qn: timed_best(lambda q=q: q.to_arrow(),
+                                          args.repeats)
+                           for qn, q in matched}
+                tb = sum(base_s[qn] for qn, _ in matched)
+                ta = sum(after_s.values())
+                RESULT["advisor_top_reco_speedup"] = round(
+                    tb / ta if ta > 0 else 0.0, 3)
+                RESULT["advisor_top_reco_speedup_predicted"] = round(
+                    top.predicted_speedup, 3)
+            else:
+                RESULT["errors"].append(
+                    "advisor produced no recommendations from the "
+                    "captured workload")
+
     # ---- BASELINE config #5: Hybrid Scan over appended source files ----
     # Runs LAST: the appends invalidate plain signatures, so every other
     # query pair must be timed first.

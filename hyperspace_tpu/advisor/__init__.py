@@ -1,0 +1,16 @@
+"""Workload-capture, what-if planning, and cost-ranked index recommendation.
+
+Modules (imported lazily by the API facade so that ``import
+hyperspace_tpu`` stays light):
+
+  constants   — ``hyperspace.tpu.advisor.*`` keys + hypothetical markers
+  workload    — in-session workload log wired into Session.execute
+  candidates  — candidate IndexConfig / sketch-set generation from the log
+  whatif      — hypothetical IndexLogEntry injection through the rules'
+                ``candidates_for`` hooks (metadata only, no build)
+  cost        — input-byte cost model seeded from file/index statistics
+  recommend   — cost-ranked recommendations (`Hyperspace.recommend`)
+
+Invariant: hypothetical entries are in-memory values only — they never
+reach a log store, a data manager, or the executor.
+"""

@@ -1,0 +1,49 @@
+"""Advisor config keys + metadata property names.
+
+No reference analogue: the original project's roadmap headlines index
+recommendation but never shipped it; the design here follows the
+cost-based, workload-adaptive selection literature (PAPERS.md: "Only
+Aggressive Elephants are Fast Elephants", arxiv 1208.0287; sketch choice
+as a per-column decision, "Extensible Data Skipping", arxiv 2009.08150).
+
+Keys live under ``hyperspace.tpu.advisor.*`` and are read exclusively
+through config.py accessors (the scripts/lint.py env-read gate) and must
+each appear in docs/configuration.md (the scripts/lint.py doc-drift
+gate).
+"""
+
+from __future__ import annotations
+
+
+class AdvisorConstants:
+    # Workload capture: when true, every Session.execute records a
+    # WorkloadRecord (fingerprint, shapes, latency, applied indexes)
+    # into the in-session workload log.
+    CAPTURE_ENABLED = "hyperspace.tpu.advisor.capture.enabled"
+    CAPTURE_ENABLED_DEFAULT = "false"
+
+    # Bound on the in-session workload log; oldest records drop first.
+    CAPTURE_MAX_ENTRIES = "hyperspace.tpu.advisor.capture.maxEntries"
+    CAPTURE_MAX_ENTRIES_DEFAULT = "10000"
+
+    # Bound on candidate groups the recommender evaluates with the
+    # what-if planner (highest-support groups first).
+    MAX_CANDIDATES = "hyperspace.tpu.advisor.maxCandidates"
+    MAX_CANDIDATES_DEFAULT = "32"
+
+    # Minimum number of captured queries that must exhibit a shape
+    # before a candidate derived from it is considered.
+    MIN_SUPPORT = "hyperspace.tpu.advisor.minSupport"
+    MIN_SUPPORT_DEFAULT = "1"
+
+    # derivedDataset property marking a metadata-only what-if entry.
+    # Anything carrying it must never reach a log store or executor.
+    HYPOTHETICAL_PROPERTY = "advisor.hypothetical"
+
+    # Synthetic content-file name carrying the predicted index size so
+    # the rankers' index_files_size_in_bytes comparisons stay meaningful
+    # for entries that have no data files.
+    HYPOTHETICAL_FILE_NAME = "__advisor_hypothetical__"
+
+    # Deterministic candidate-name prefix.
+    CANDIDATE_NAME_PREFIX = "adv"

@@ -1,0 +1,306 @@
+"""What-if planning: would this index rewrite the plan, without building it?
+
+A *hypothetical* IndexLogEntry is assembled from an IndexConfig and a
+live Scan exactly the way actions/create.py assembles a real one —
+source content, signature, derived-dataset descriptor — except its
+content tree holds a single synthetic FileInfo whose size is the COST
+MODEL'S predicted index size (cost.predicted_index_size_bytes). That one
+trick makes the existing machinery rank hypotheticals fairly with zero
+special cases: FilterIndexRanker's min-size compare, the score
+optimizer's index-bytes tie-break, and cost.plan_cost_bytes all read
+``index_files_size_in_bytes`` and see the prediction.
+
+Injection goes through the rules' ``candidates_for`` hooks
+(rules/filter_rule.try_rewrite_filter, rules/join_rule.try_rewrite_join
+— dormant outside the score optimizer until now): the what-if pass hands
+the ScoreBasedIndexPlanOptimizer a candidate map that merges the real
+CandidateIndexCollector output with the hypothetical entries, so the
+chosen plan is exactly what ``Session.optimize`` would pick if the
+indexes existed.
+
+Lifecycle invariant: hypothetical entries are function-local values.
+They are never handed to a log manager, a data manager, the metadata
+cache, or the executor — the index log store's byte-state is unchanged
+by any number of what-if/recommend calls (asserted in
+tests/test_advisor.py).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+from ..api import DataSkippingIndexConfig, IndexConfig
+from ..exceptions import HyperspaceException
+from ..index.log_entry import (Content, CoveringIndex, Directory, FileInfo,
+                               FileIdTracker, Hdfs, IndexLogEntry,
+                               LogicalPlanFingerprint, Relation, Signature,
+                               Source, SourcePlan)
+from ..index.signatures import IndexSignatureProvider
+from ..plan import expr as E
+from ..plan.nodes import Filter, IndexScan, LogicalPlan, Scan
+from ..schema import Schema
+from .constants import AdvisorConstants
+from . import cost
+
+
+def build_hypothetical_entry(session, config: IndexConfig,
+                             scan: Scan) -> Optional[IndexLogEntry]:
+    """Metadata-only ACTIVE entry for ``config`` over ``scan``'s
+    relation, or None when the config's columns don't resolve there."""
+    from ..index.constants import States
+    from ..util.resolver import resolve_all
+    relation = scan.relation
+    names = relation.schema.names
+    cs = session.hs_conf.case_sensitive()
+    try:
+        indexed = resolve_all(names, config.indexed_columns, cs)
+        included = resolve_all(names, config.included_columns, cs)
+    except HyperspaceException:
+        return None
+    tracker = FileIdTracker()
+    source_content = Content.from_leaf_files(relation.all_files(), tracker)
+    rel_meta = Relation(
+        rootPaths=list(relation.root_paths), data=Hdfs(source_content),
+        dataSchema=relation.schema, fileFormat=relation.file_format,
+        options=dict(relation.options))
+    provider = IndexSignatureProvider()
+    fingerprint = LogicalPlanFingerprint(
+        [Signature(provider.name(), provider.signature(scan))])
+    predicted = cost.predicted_index_size_bytes(
+        relation, len(indexed) + len(included))
+    derived = CoveringIndex(
+        indexed_columns=indexed, included_columns=included,
+        schema=Schema([relation.schema.field(c)
+                       for c in indexed + included]),
+        num_buckets=session.hs_conf.num_bucket_count(),
+        properties={AdvisorConstants.HYPOTHETICAL_PROPERTY: "true"})
+    content = Content(Directory("/", files=[
+        FileInfo(AdvisorConstants.HYPOTHETICAL_FILE_NAME, predicted, 0, 0)]))
+    entry = IndexLogEntry.create(
+        config.index_name, derived, content,
+        Source(SourcePlan([rel_meta], fingerprint)),
+        {AdvisorConstants.HYPOTHETICAL_PROPERTY: "true"})
+    entry.state = States.ACTIVE
+    return entry
+
+
+def is_hypothetical(entry: IndexLogEntry) -> bool:
+    return entry.properties.get(
+        AdvisorConstants.HYPOTHETICAL_PROPERTY, "false") == "true"
+
+
+def sketch_statically_applicable(plan: LogicalPlan,
+                                 config: DataSkippingIndexConfig,
+                                 table: Optional[Tuple[str, ...]] = None
+                                 ) -> bool:
+    """Structural applicability of a sketch set: some Filter conjunct is
+    a literal compare the sketch kind could refute on the sketched
+    column. (The real prunability needs built sketch tables; this is
+    the metadata-only half the what-if planner can promise.)
+
+    ``table``: when the sketch candidate is pinned to a table, only
+    Filters whose subtree scans that table contribute conjuncts — a
+    same-named column filtered on a DIFFERENT table of a join must not
+    make this candidate look applicable."""
+    from .workload import _classify_conjunct
+    equality, rng = set(), set()
+
+    def over_pinned_table(node: LogicalPlan) -> bool:
+        if table is None:
+            return True
+        return any(tuple(leaf.relation.root_paths) == table
+                   for leaf in node.collect_leaves()
+                   if hasattr(leaf, "relation"))
+
+    def visit(node: LogicalPlan):
+        if isinstance(node, Filter) and over_pinned_table(node):
+            for conj in E.split_conjunctive_predicates(node.condition):
+                classified = _classify_conjunct(conj)
+                if classified is not None:
+                    (equality if classified[0] == "equality"
+                     else rng).add(classified[1])
+        for c in node.children:
+            visit(c)
+    visit(plan)
+    for s in config.sketches:
+        if s.kind == "MinMax" and s.column in (equality | rng):
+            return True
+        if s.kind in ("BloomFilter", "ValueList") and s.column in equality:
+            return True
+    return False
+
+
+@dataclass
+class WhatIfOutcome:
+    """One what-if pass over one plan."""
+
+    applied: Tuple[str, ...]           # hypothetical names in the plan
+    applied_existing: Tuple[str, ...]  # real indexes the plan also uses
+    cost_before_bytes: int
+    cost_after_bytes: int
+    plan_before: str
+    plan_after: str
+    sketch_applicable: Dict[str, bool]
+
+    @property
+    def rewritten(self) -> bool:
+        return bool(self.applied)
+
+    @property
+    def predicted_speedup(self) -> float:
+        if self.cost_after_bytes <= 0:
+            return 1.0
+        return self.cost_before_bytes / self.cost_after_bytes
+
+    def explain(self) -> str:
+        lines = ["=== What-If Analysis ==="]
+        if self.applied:
+            lines.append("Hypothetical indexes applied: "
+                         + ", ".join(self.applied))
+        else:
+            lines.append("No hypothetical index would rewrite this plan.")
+        if self.applied_existing:
+            lines.append("Existing indexes in the plan: "
+                         + ", ".join(self.applied_existing))
+        lines.append(f"Input bytes: {self.cost_before_bytes} -> "
+                     f"{self.cost_after_bytes} "
+                     f"(predicted speedup {self.predicted_speedup:.2f}x)")
+        for name, ok in sorted(self.sketch_applicable.items()):
+            lines.append(
+                f"Sketch set '{name}': "
+                + ("statically applicable (prunability needs a build)"
+                   if ok else "no refutable predicate in this plan"))
+        lines.append("")
+        lines.append("--- Plan without the hypothetical indexes ---")
+        lines.append(self.plan_before)
+        lines.append("--- Plan with the hypothetical indexes ---")
+        lines.append(self.plan_after)
+        return "\n".join(lines)
+
+
+@dataclass
+class WhatIfBaseline:
+    """The config-independent half of a what-if pass over one plan: the
+    normalized tree, the REAL candidate map, the plan the optimizer
+    picks today, and its cost. `recommend` evaluates many candidate
+    groups against one captured record — computing this once per record
+    instead of once per (group, record) removes the dominant repeated
+    work (optimizer passes + source-file listings)."""
+
+    norm: LogicalPlan
+    base: dict
+    before_plan: LogicalPlan
+    cost_before_bytes: int
+
+
+def prepare_baseline(session, plan: LogicalPlan,
+                     include_existing: bool = True) -> WhatIfBaseline:
+    from ..rules.apply_hyperspace import active_indexes
+    from ..rules.index_filters import (CandidateIndexCollector,
+                                       ReasonCollector)
+    from ..rules.score_optimizer import ScoreBasedIndexPlanOptimizer
+    from ..serving import fingerprint as fp
+
+    norm = fp.normalize(plan)
+    real: List[IndexLogEntry] = []
+    if include_existing:
+        real = [e for e in active_indexes(session)
+                if e.derivedDataset.kind == "CoveringIndex"]
+    ctx = ReasonCollector(enabled=False, silent=True)
+    base = CandidateIndexCollector.collect(session, norm, real, ctx)
+    before_plan = ScoreBasedIndexPlanOptimizer().apply(
+        session, norm, base, ctx)
+    return WhatIfBaseline(norm, base, before_plan,
+                          cost.plan_cost_bytes(before_plan))
+
+
+def what_if_plan(session, plan: LogicalPlan, configs,
+                 include_existing: bool = True,
+                 config_tables: Optional[Dict[str, Tuple[str, ...]]] = None,
+                 baseline: Optional[WhatIfBaseline] = None,
+                 entry_cache: Optional[dict] = None) -> WhatIfOutcome:
+    """Re-run the index-selection search with hypothetical entries for
+    ``configs`` injected next to the real candidates. Pure planning: no
+    telemetry, no usage counters, no reason-collector mutation, nothing
+    persisted.
+
+    ``config_tables`` (index name → root-path tuple) pins a config to
+    ITS table: without it a config is injected at every scan whose
+    schema resolves its columns — right for the user-facing API, where
+    no table was declared, but wrong for generated candidates (two
+    tables sharing column names would cross-match and inflate benefit).
+    ``baseline``: pass prepare_baseline(...) when evaluating many
+    config sets against one plan. ``entry_cache``: a dict shared across
+    calls memoizing hypothetical entries per (config name, relation) —
+    building one stats every source file, and `recommend` would
+    otherwise rebuild identical entries per candidate group."""
+    from ..rules.index_filters import ReasonCollector
+    from ..rules.score_optimizer import ScoreBasedIndexPlanOptimizer
+
+    if baseline is None:
+        baseline = prepare_baseline(session, plan, include_existing)
+    norm = baseline.norm
+    covering_cfgs = [c for c in configs if isinstance(c, IndexConfig)]
+    sketch_cfgs = [c for c in configs
+                   if isinstance(c, DataSkippingIndexConfig)]
+
+    merged = {k: (scan, list(entries))
+              for k, (scan, entries) in baseline.base.items()}
+    hypo_names: List[str] = []
+    for leaf in norm.collect_leaves():
+        if not isinstance(leaf, Scan):
+            continue
+        if not session.source_provider_manager.is_supported_relation(leaf):
+            continue
+        for cfg in covering_cfgs:
+            pinned = (config_tables or {}).get(cfg.index_name)
+            if pinned is not None and \
+                    tuple(leaf.relation.root_paths) != pinned:
+                continue
+            if entry_cache is not None:
+                cache_key = (cfg.index_name, id(leaf.relation))
+                if cache_key not in entry_cache:
+                    entry_cache[cache_key] = \
+                        build_hypothetical_entry(session, cfg, leaf)
+                entry = entry_cache[cache_key]
+            else:
+                entry = build_hypothetical_entry(session, cfg, leaf)
+            if entry is None:
+                continue
+            scan, entries = merged.get(id(leaf), (leaf, []))
+            merged[id(leaf)] = (scan, entries + [entry])
+            hypo_names.append(entry.name)
+    ctx2 = ReasonCollector(enabled=False, silent=True)
+    after_plan = ScoreBasedIndexPlanOptimizer().apply(
+        session, norm, merged, ctx2)
+
+    used = {leaf.index_entry.name for leaf in after_plan.collect_leaves()
+            if isinstance(leaf, IndexScan)}
+    return WhatIfOutcome(
+        applied=tuple(sorted(used & set(hypo_names))),
+        applied_existing=tuple(sorted(used - set(hypo_names))),
+        cost_before_bytes=baseline.cost_before_bytes,
+        cost_after_bytes=cost.plan_cost_bytes(after_plan),
+        plan_before=baseline.before_plan.tree_string(),
+        plan_after=after_plan.tree_string(),
+        sketch_applicable={c.index_name: sketch_statically_applicable(
+                               norm, c,
+                               (config_tables or {}).get(c.index_name))
+                           for c in sketch_cfgs})
+
+
+def what_if(session, plan: LogicalPlan, configs) -> WhatIfOutcome:
+    """The user-facing entry (`Hyperspace.what_if`): one what-if pass
+    plus its telemetry event."""
+    outcome = what_if_plan(session, plan, configs)
+    from ..telemetry.events import AdvisorWhatIfEvent
+    from ..telemetry.logging import get_logger
+    get_logger(session.hs_conf.event_logger_class()).log_event(
+        AdvisorWhatIfEvent(
+            message="what-if analysis "
+                    + ("rewrote the plan" if outcome.rewritten
+                       else "did not rewrite the plan"),
+            index_names=[getattr(c, "index_name", "?") for c in configs],
+            applied_names=list(outcome.applied)))
+    return outcome

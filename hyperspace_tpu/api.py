@@ -166,7 +166,9 @@ class Hyperspace:
         entry = self.index_manager.get_index(index_name)
         if entry is None:
             raise HyperspaceException(f"Index with name {index_name} could not be found.")
-        return pd.DataFrame([IndexStatistics.from_entry(entry).to_extended_row()])
+        usage = self.session._index_usage_counts.get(index_name, 0)
+        return pd.DataFrame([IndexStatistics.from_entry(
+            entry, usage_count=usage).to_extended_row()])
 
     def explain(self, df, verbose: bool = False, redirect_func=None,
                 mode: str = "plaintext") -> str:
@@ -228,6 +230,45 @@ class Hyperspace:
         apply_hyperspace(self.session, prune_columns(df.plan), ctx)
         return ctx.format(index_name)
 
+    # ------------------------------------------------------------------
+    # Advisor: workload capture → what-if → recommendation (advisor/).
+    # ------------------------------------------------------------------
+
+    def recommend(self, top_k: int = 5):
+        """Cost-ranked index recommendations from the captured workload
+        (enable capture via ``hyperspace.tpu.advisor.capture.enabled``).
+        Pure planning: builds nothing, leaves the index log store
+        byte-identical. Returns an AdvisorReport (``.recommendations``,
+        ``.explain()``)."""
+        from .advisor.recommend import recommend
+        return recommend(self.session, top_k=top_k)
+
+    def what_if(self, df, configs):
+        """Would building ``configs`` (IndexConfig /
+        DataSkippingIndexConfig instances) rewrite this query? Injects
+        metadata-only hypothetical entries through the rules'
+        ``candidates_for`` hooks and re-runs index selection — no index
+        data is built and nothing is persisted. Returns a WhatIfOutcome
+        (``.rewritten``, ``.predicted_speedup``, ``.explain()``)."""
+        from .advisor.whatif import what_if
+        return what_if(self.session, df.plan, configs)
+
+    def build_recommendation(self, recommendation) -> None:
+        """Materialize one recommendation's configs through the normal
+        create path (this one DOES build index data)."""
+        from .advisor.recommend import build_recommendation
+        build_recommendation(self, recommendation)
+
+    def workload(self):
+        """The captured workload log as a pandas DataFrame (empty until
+        ``hyperspace.tpu.advisor.capture.enabled`` is set)."""
+        import pandas as pd
+        from .advisor.workload import log_for
+        rows = log_for(self.session).to_rows()
+        return pd.DataFrame(rows, columns=[
+            "fingerprint", "tables", "latency_s", "appliedIndexes",
+            "rulesFired"])
+
     # CamelCase aliases for drop-in parity with the reference's API.
     createIndex = create_index
     deleteIndex = delete_index
@@ -236,3 +277,4 @@ class Hyperspace:
     refreshIndex = refresh_index
     optimizeIndex = optimize_index
     whyNot = why_not
+    whatIf = what_if

@@ -13,6 +13,7 @@ from __future__ import annotations
 import os
 from typing import Any, Callable, Dict, Generic, Optional, TypeVar
 
+from .advisor.constants import AdvisorConstants
 from .index.constants import IndexConstants
 from .serving.constants import ServingConstants
 
@@ -286,6 +287,30 @@ class HyperspaceConf:
             str(self.result_cache_device_bytes()),
             str(self.result_cache_host_bytes()),
         ])
+
+    # ------------------------------------------------------------------
+    # Advisor (advisor/constants.py): workload capture + recommendation.
+    # ------------------------------------------------------------------
+
+    def advisor_capture_enabled(self) -> bool:
+        return self._get_bool(
+            AdvisorConstants.CAPTURE_ENABLED,
+            AdvisorConstants.CAPTURE_ENABLED_DEFAULT)
+
+    def advisor_capture_max_entries(self) -> int:
+        return int(self._conf.get(
+            AdvisorConstants.CAPTURE_MAX_ENTRIES,
+            AdvisorConstants.CAPTURE_MAX_ENTRIES_DEFAULT))
+
+    def advisor_max_candidates(self) -> int:
+        return int(self._conf.get(
+            AdvisorConstants.MAX_CANDIDATES,
+            AdvisorConstants.MAX_CANDIDATES_DEFAULT))
+
+    def advisor_min_support(self) -> int:
+        return int(self._conf.get(
+            AdvisorConstants.MIN_SUPPORT,
+            AdvisorConstants.MIN_SUPPORT_DEFAULT))
 
     def _get_bool(self, key: str, default: str) -> bool:
         return (self._conf.get(key, default) or "").strip().lower() == "true"

@@ -210,7 +210,9 @@ class IndexCollectionManager(IndexManager):
     def indexes(self):
         from .statistics import IndexStatistics
         import pandas as pd
-        rows = [IndexStatistics.from_entry(e).to_row()
+        counts = self.session._index_usage_counts
+        rows = [IndexStatistics.from_entry(
+                    e, usage_count=counts.get(e.name, 0)).to_row()
                 for e in self.get_indexes()
                 if e.state != States.DOESNOTEXIST]
         return pd.DataFrame(rows, columns=IndexStatistics.SUMMARY_COLUMNS)

@@ -25,12 +25,19 @@ class IndexStatistics:
     appended_file_count: int
     deleted_file_count: int
     index_content_paths: List[str]
+    # Times a real (non-diagnostic) rewrite pass selected this index in
+    # THIS session: executions and explicit optimized_plan() calls, the
+    # same passes that emit usage telemetry; explain/why_not/what_if run
+    # silent and never count (rule_utils.log_index_usage tally; 0 across
+    # sessions/processes) — the advisor's and humans' dead-index signal.
+    usage_count: int = 0
 
     SUMMARY_COLUMNS = ["name", "indexedColumns", "includedColumns", "numBuckets",
-                       "schema", "indexLocation", "state"]
+                       "schema", "indexLocation", "state", "usageCount"]
 
     @staticmethod
-    def from_entry(entry: IndexLogEntry) -> "IndexStatistics":
+    def from_entry(entry: IndexLogEntry,
+                   usage_count: int = 0) -> "IndexStatistics":
         import json
         content_files = entry.content.files
         # Index location = common version dir prefix of the newest files.
@@ -53,7 +60,8 @@ class IndexStatistics:
             index_size_bytes=entry.index_files_size_in_bytes,
             appended_file_count=len(entry.appended_files),
             deleted_file_count=len(entry.deleted_files),
-            index_content_paths=sorted({p.rsplit("/", 1)[0] for p in content_files}))
+            index_content_paths=sorted({p.rsplit("/", 1)[0] for p in content_files}),
+            usage_count=usage_count)
 
     def to_row(self) -> Dict:
         return {
@@ -64,6 +72,7 @@ class IndexStatistics:
             "schema": self.schema_json,
             "indexLocation": self.index_location,
             "state": self.state,
+            "usageCount": self.usage_count,
         }
 
     def to_extended_row(self) -> Dict:

@@ -80,7 +80,9 @@ def explain_string(session, plan: LogicalPlan, verbose: bool = False,
     was_enabled = session.is_hyperspace_enabled()
     try:
         session.enable_hyperspace()
-        with_index = session.optimize(plan)
+        # Diagnostic pass: explain must not bump usage counts or emit
+        # usage telemetry for a query it does not execute.
+        with_index = session.optimize(plan, diagnostic=True)
     finally:
         if not was_enabled:
             session.disable_hyperspace()
@@ -102,6 +104,7 @@ def explain_string(session, plan: LogicalPlan, verbose: bool = False,
         buf.write_line(line)
     _write_cache_section(buf, session, plan)
     _write_compilation_section(buf, session)
+    _write_advisor_section(buf, session, with_index)
     if verbose:
         buf.write_line()
         _header(buf, "Physical operator stats:")
@@ -177,6 +180,31 @@ def _write_compilation_section(buf: BufferStream, session) -> None:
     else:
         buf.write_line("shape bucketing: off (every data-dependent "
                        "length compiles its own programs)")
+
+
+def _write_advisor_section(buf: BufferStream, session,
+                           with_index: LogicalPlan) -> None:
+    """Advisor observability (advisor/): workload-capture status and the
+    session-local applied counts of the indexes this plan uses. Rendered
+    only when capture is on or a workload was already recorded, so the
+    explain goldens of advisor-less sessions are untouched."""
+    from ..advisor.workload import log_for
+    log = log_for(session)
+    capture_on = session.hs_conf.advisor_capture_enabled()
+    if len(log) == 0 and not capture_on:
+        return
+    buf.write_line()
+    _header(buf, "Advisor:")
+    buf.write_line(
+        f"workload capture: {'on' if capture_on else 'off'} "
+        f"({len(log)} record(s); "
+        f"hs.recommend() ranks index candidates from them)")
+    counts = session._index_usage_counts
+    for leaf in with_index.collect_leaves():
+        if isinstance(leaf, IndexScan):
+            name = leaf.index_entry.name
+            buf.write_line(f"index '{name}' applied "
+                           f"{counts.get(name, 0)} time(s) this session")
 
 
 def _count_nodes(plan: LogicalPlan):

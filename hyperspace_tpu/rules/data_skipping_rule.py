@@ -76,9 +76,18 @@ class DataSkippingIndexRule:
                 continue
             verdict = evaluate_sketch_predicate(entry, condition, all_files,
                                                 relation.schema)
-            if verdict is not None:
-                keep &= verdict
-                hit_names.append(entry.name)
+            if verdict is None:
+                if ctx is not None:
+                    sketched = sorted({s.column for s in
+                                       entry.derivedDataset.sketches})
+                    ctx.add("NO_APPLICABLE_SKETCH", entry,
+                            f"No filter conjunct is refutable by the "
+                            f"index's sketches (sketched columns: "
+                            f"{sketched}); only literal comparisons and "
+                            f"IN lists on a sketched column can prune.")
+                continue
+            keep &= verdict
+            hit_names.append(entry.name)
         if not hit_names or keep.all():
             return None  # nothing pruned → no rewrite, no usage event.
         applied.extend(hit_names)

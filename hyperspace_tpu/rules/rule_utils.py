@@ -28,9 +28,15 @@ def log_index_usage(session, ctx, index_names: List[str], plan_string: str,
                     message: str) -> None:
     """Emit an index-usage telemetry event unless this is a silent
     (diagnostic, e.g. why_not) pass — the single enforcement point of the
-    'diagnostic passes emit no telemetry' invariant."""
+    'diagnostic passes emit no telemetry' invariant. The same point
+    tallies per-index applied counts (session._index_usage_counts), which
+    statistics/advisor surface to spot hot and dead indexes."""
     if ctx is not None and getattr(ctx, "silent", False):
         return
+    with session._usage_counts_lock:
+        counts = session._index_usage_counts
+        for name in index_names:
+            counts[name] = counts.get(name, 0) + 1
     from ..telemetry.events import HyperspaceIndexUsageEvent
     from ..telemetry.logging import get_logger
     get_logger(session.hs_conf.event_logger_class()).log_event(

@@ -14,6 +14,7 @@ from __future__ import annotations
 import importlib
 import os
 import threading
+import time
 from collections import OrderedDict
 from typing import Dict, List, Optional, Tuple, Union as TUnion
 
@@ -41,8 +42,12 @@ class Session:
         self.hs_conf = HyperspaceConf(self.conf)
         self._hyperspace_enabled = False
         self._event_logger = None
-        # whyNot reasons of the most recent hyperspace rewrite pass.
-        self._last_reason_collector = None
+        # whyNot reasons of the most recent hyperspace rewrite pass —
+        # PER THREAD (threading.local behind the property below): on the
+        # multi-threaded serving path, one thread's optimize must not
+        # clobber the collector another thread's workload capture is
+        # about to attribute from.
+        self._reason_tls = threading.local()
         from .config import CacheWithTransform
         self._provider_manager_cache = CacheWithTransform(
             self.hs_conf.file_based_source_builders, self._build_provider_manager)
@@ -57,11 +62,31 @@ class Session:
         # probed on every execute() of the multi-threaded serving path.
         self._result_cache_lock = threading.Lock()
         self._temp_views_version = 0
+        # Advisor state: the in-session workload log (advisor/workload.py
+        # — created eagerly: a lazy check-then-create would race between
+        # serving threads and lose records) and per-index applied counts
+        # (rule_utils.log_index_usage increments under the lock;
+        # statistics surface them).
+        from .advisor.workload import WorkloadLog
+        self._workload_log = WorkloadLog()
+        self._index_usage_counts: Dict[str, int] = {}
+        self._usage_counts_lock = threading.Lock()
         self._sql_plan_cache: "OrderedDict[Tuple, LogicalPlan]" = OrderedDict()
         self._sql_plan_stats = {"hits": 0, "misses": 0}
         # The memo is on the multi-threaded serving path (like the
         # result cache, which carries its own lock).
         self._sql_plan_lock = threading.Lock()
+
+    # The reason collector of the calling thread's most recent rewrite
+    # pass. Plain attribute syntax everywhere (apply_hyperspace writes,
+    # why_not/capture read); the thread-local backing is invisible.
+    @property
+    def _last_reason_collector(self):
+        return getattr(self._reason_tls, "collector", None)
+
+    @_last_reason_collector.setter
+    def _last_reason_collector(self, ctx) -> None:
+        self._reason_tls.collector = ctx
 
     @property
     def index_collection_manager(self):
@@ -163,7 +188,8 @@ class Session:
     # ------------------------------------------------------------------
 
     def optimize(self, plan: LogicalPlan,
-                 _pre_normalized: bool = False) -> LogicalPlan:
+                 _pre_normalized: bool = False,
+                 diagnostic: bool = False) -> LogicalPlan:
         """General optimizations (column pruning), the hyperspace rewrite
         batch if enabled, then partition pruning. Partition pruning is
         always on (like Spark's native pruning) but must run AFTER the
@@ -174,7 +200,12 @@ class Session:
 
         ``_pre_normalized``: the caller already ran serving.fingerprint.
         normalize (= the first two passes here) on ``plan`` — skip them
-        rather than re-walking the tree (the result-cache miss path)."""
+        rather than re-walking the tree (the result-cache miss path).
+
+        ``diagnostic``: an inspection pass (explain) that will not
+        execute the result — the rewrite runs with a silent collector,
+        so it emits no usage telemetry, bumps no usageCount, and leaves
+        the last real pass's whyNot reasons in place."""
         from .rules.column_pruning import prune_columns
         from .rules.pushdown import push_filters
         from .sources.partitions import prune_partitions
@@ -186,10 +217,30 @@ class Session:
             plan = prune_columns(plan)
         if self._hyperspace_enabled:
             from .rules.apply_hyperspace import apply_hyperspace
-            plan = apply_hyperspace(self, plan)
+            ctx = None
+            if diagnostic:
+                from .rules.index_filters import ReasonCollector
+                ctx = ReasonCollector(
+                    self.hs_conf.filter_reason_enabled(), silent=True)
+            plan = apply_hyperspace(self, plan, ctx)
         return prune_partitions(plan)
 
     def execute(self, plan: LogicalPlan):
+        if not self.hs_conf.advisor_capture_enabled():
+            return self._execute_uncaptured(plan)
+        # Advisor workload capture (advisor/workload.py): time whatever
+        # path actually runs and record the canonical plan + shapes +
+        # applied indexes. Resetting the reason collector first makes
+        # ``applied`` attributable to THIS execution (a result-cache hit
+        # runs no rewrite pass and records an empty applied set).
+        self._last_reason_collector = None
+        t0 = time.perf_counter()
+        table = self._execute_uncaptured(plan)
+        from .advisor.workload import capture_execution
+        capture_execution(self, plan, time.perf_counter() - t0)
+        return table
+
+    def _execute_uncaptured(self, plan: LogicalPlan):
         cache = self.result_cache
         if cache is not None:
             # Serving path: probe the result cache first — a hit skips
@@ -425,8 +476,11 @@ class DataFrame:
     def explain(self, verbose: bool = False) -> str:
         text = self.plan.tree_string()
         if self.session.is_hyperspace_enabled():
+            # Diagnostic pass: explaining a plan must not count as index
+            # usage or emit usage telemetry.
             text += "\n\n== Optimized (hyperspace) ==\n" + \
-                self.optimized_plan().tree_string()
+                self.session.optimize(self.plan,
+                                      diagnostic=True).tree_string()
         return text
 
     def with_column(self, name: str, expr: E.Expr) -> "DataFrame":

@@ -147,6 +147,28 @@ class KernelCompileEvent(HyperspaceEvent):
 
 
 @dataclass
+class AdvisorWhatIfEvent(HyperspaceEvent):
+    """Emitted per user-facing what-if analysis (advisor/whatif.py).
+    ``index_names`` are the hypothetical configs analyzed,
+    ``applied_names`` the subset the re-optimized plan would use. Bulk
+    what-if passes inside `recommend` are silent (one event per
+    recommendation run, not per candidate x record)."""
+
+    index_names: List[str] = field(default_factory=list)
+    applied_names: List[str] = field(default_factory=list)
+
+
+@dataclass
+class AdvisorRecommendationEvent(HyperspaceEvent):
+    """Emitted per `Hyperspace.recommend` run (advisor/recommend.py):
+    the ranked index names plus how much evidence backed them."""
+
+    recommended: List[str] = field(default_factory=list)
+    candidates_evaluated: int = 0
+    records_considered: int = 0
+
+
+@dataclass
 class IndexCacheProbeEvent(HyperspaceEvent):
     """Base of the HBM index-table-cache probe events: the executor emits
     one per IndexScan cache lookup (execution/index_cache.py counts were

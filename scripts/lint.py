@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import ast
 import os
+import re
 import sys
 
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -114,6 +115,25 @@ def jit_sites(tree: ast.AST) -> list:
     return sorted(set(out))
 
 
+# Doc-drift discipline: every `hyperspace.tpu.*` config key the package
+# defines must be documented in docs/configuration.md — a key literal
+# that exists only in code is an undocumented knob. Full-string match
+# only, so prose mentioning the prefix never trips it.
+CONFIG_KEY_PATTERN = re.compile(
+    r"^hyperspace\.tpu(\.[A-Za-z][A-Za-z0-9_]*)+$")
+CONFIG_DOC = "docs/configuration.md"
+
+
+def config_key_literals(tree: ast.AST) -> list:
+    """(line, key) for every full-string hyperspace.tpu.* literal."""
+    out = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Constant) and isinstance(node.value, str) \
+                and CONFIG_KEY_PATTERN.match(node.value):
+            out.append((node.lineno, node.value))
+    return out
+
+
 def env_reads(tree: ast.AST) -> list:
     """Line numbers of os.environ / os.getenv style env accesses."""
     out = []
@@ -131,6 +151,8 @@ def env_reads(tree: ast.AST) -> list:
 
 def main() -> int:
     problems = []
+    with open(os.path.join(ROOT, CONFIG_DOC), encoding="utf-8") as f:
+        config_doc_text = f.read()
     for path in iter_sources():
         rel = os.path.relpath(path, ROOT)
         with open(path, encoding="utf-8") as f:
@@ -157,6 +179,12 @@ def main() -> int:
                 problems.append(
                     f"{rel}:{line}: ad-hoc env read (os.environ/getenv); "
                     "knobs must go through config.py accessors")
+        if any(rel.startswith(d + os.sep) for d in PACKAGE_DIRS):
+            for line, key in config_key_literals(tree):
+                if key not in config_doc_text:
+                    problems.append(
+                        f"{rel}:{line}: config key '{key}' is not "
+                        f"documented in {CONFIG_DOC}")
         if any(rel.startswith(d + os.sep) for d in PACKAGE_DIRS) \
                 and rel.replace(os.sep, "/") not in JIT_SITE_ALLOWLIST:
             for line in jit_sites(tree):

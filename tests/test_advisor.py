@@ -1,0 +1,432 @@
+"""Advisor subsystem: workload capture, candidate generation, what-if
+planning, and cost-ranked recommendation (advisor/).
+
+Key invariants under test:
+
+  - capture is conf-gated and records fingerprint/shapes/latency/applied;
+  - `what_if` confirms a rewrite WITHOUT building index data, and the
+    index log store's byte-state is unchanged by what_if/recommend;
+  - `recommend` deterministically ranks the known-good covering indexes
+    ahead of strictly-worse candidates (ones whose rewrite never fires);
+  - per-index usageCount surfaces through hs.indexes()/hs.index(name).
+
+All tests pin hyperspace.tpu.distributed.enabled=false: this image's
+jax 0.4.37 lacks jax.shard_map, so the SPMD path is environmentally
+broken (seed tier-1 failures) and must not leak into new tests.
+"""
+
+import os
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+import hyperspace_tpu as hst
+from hyperspace_tpu.api import (BloomFilterSketch, DataSkippingIndexConfig,
+                                Hyperspace, IndexConfig, MinMaxSketch)
+from hyperspace_tpu.advisor.constants import AdvisorConstants
+from hyperspace_tpu.index.constants import IndexConstants
+from hyperspace_tpu.plan.expr import col, sum_
+
+
+def _dir_state(path):
+    """{file path: bytes} for every file under ``path`` — the byte-state
+    oracle for 'hypothetical entries are never persisted'."""
+    out = {}
+    for r, _dirs, files in os.walk(path):
+        for f in files:
+            p = os.path.join(r, f)
+            with open(p, "rb") as fh:
+                out[p] = fh.read()
+    return out
+
+
+@pytest.fixture()
+def env(tmp_path):
+    fact_dir = tmp_path / "fact"
+    fact_dir.mkdir()
+    rng = np.random.default_rng(3)
+    # Two time-ordered part files (MinMax-prunable shape).
+    ks = np.sort(rng.integers(0, 100, 4000)).astype(np.int64)
+    t = pa.table({
+        "k": pa.array(ks),
+        "v": pa.array(rng.integers(0, 9, 4000).astype(np.int64)),
+        "w": pa.array(np.round(rng.uniform(0, 1, 4000), 3)),
+        "pad": pa.array(rng.integers(0, 5, 4000).astype(np.int64)),
+    })
+    pq.write_table(t.slice(0, 2000), fact_dir / "p0.parquet")
+    pq.write_table(t.slice(2000, 2000), fact_dir / "p1.parquet")
+    dim_dir = tmp_path / "dim"
+    dim_dir.mkdir()
+    pq.write_table(pa.table({
+        "dk": pa.array(np.arange(100, dtype=np.int64)),
+        "dv": pa.array(rng.integers(0, 5, 100).astype(np.int64)),
+    }), dim_dir / "p0.parquet")
+
+    session = hst.Session(system_path=str(tmp_path / "indexes"))
+    session.conf.set(IndexConstants.TPU_DISTRIBUTED_ENABLED, "false")
+    session.conf.set(IndexConstants.INDEX_NUM_BUCKETS, 4)
+    session.enable_hyperspace()
+    return dict(session=session, hs=Hyperspace(session),
+                fact=str(fact_dir), dim=str(dim_dir),
+                system_path=str(tmp_path / "indexes"))
+
+
+def _capture(session, *queries):
+    session.conf.set(AdvisorConstants.CAPTURE_ENABLED, "true")
+    for q in queries:
+        q.to_arrow()
+    session.conf.set(AdvisorConstants.CAPTURE_ENABLED, "false")
+
+
+class TestWorkloadCapture:
+    def test_disabled_by_default(self, env):
+        session, hs = env["session"], env["hs"]
+        session.read.parquet(env["fact"]).filter(col("k") > 5) \
+            .select("k", "v").to_arrow()
+        assert len(hs.workload()) == 0
+
+    def test_record_contents(self, env):
+        session, hs = env["session"], env["hs"]
+        fact = session.read.parquet(env["fact"])
+        q = fact.filter(col("k") > 50).select("k", "v")
+        _capture(session, q)
+        from hyperspace_tpu.advisor.workload import log_for
+        records = log_for(session).snapshot()
+        assert len(records) == 1
+        r = records[0]
+        assert r.fingerprint is not None
+        assert r.latency_s > 0
+        assert r.applied_indexes == ()  # no index exists
+        (shape,) = r.scan_shapes
+        assert shape.root_paths == (env["fact"],)
+        assert shape.filter_cols == ("k",)
+        assert set(shape.project_cols) == {"k", "v"}
+        assert shape.range_cols == ("k",)
+        assert shape.equality_cols == ()
+
+    def test_capture_records_applied_indexes(self, env):
+        session, hs = env["session"], env["hs"]
+        fact = session.read.parquet(env["fact"])
+        hs.create_index(fact, IndexConfig("kv", ["k"], ["v"]))
+        q = fact.filter(col("k") > 50).select("k", "v")
+        _capture(session, q)
+        from hyperspace_tpu.advisor.workload import log_for
+        (r,) = log_for(session).snapshot()
+        assert r.applied_indexes == ("kv",)
+        assert r.rules_fired == ("CoveringIndexRules",)
+
+    def test_join_shape_extraction(self, env):
+        session = env["session"]
+        fact = session.read.parquet(env["fact"])
+        dim = session.read.parquet(env["dim"])
+        q = (fact.join(dim, on=col("k") == col("dk"))
+             .group_by("dv").agg(sum_(col("v")).alias("sv")))
+        _capture(session, q)
+        from hyperspace_tpu.advisor.workload import log_for
+        (r,) = log_for(session).snapshot()
+        (js,) = r.join_shapes
+        assert js.left.join_cols == ("k",)
+        assert js.right.join_cols == ("dk",)
+        assert "v" in js.left.referenced_cols
+        assert "dv" in js.right.referenced_cols
+
+    def test_max_entries_bound(self, env):
+        session = env["session"]
+        session.conf.set(AdvisorConstants.CAPTURE_MAX_ENTRIES, 3)
+        fact = session.read.parquet(env["fact"])
+        q = fact.filter(col("k") > 10).select("k")
+        _capture(session, q, q, q, q, q)
+        from hyperspace_tpu.advisor.workload import log_for
+        log = log_for(session)
+        assert len(log) == 3
+        assert log.dropped == 2
+
+    def test_workload_dataframe(self, env):
+        session, hs = env["session"], env["hs"]
+        q = session.read.parquet(env["fact"]).filter(col("k") > 1) \
+            .select("k")
+        _capture(session, q)
+        df = hs.workload()
+        assert list(df.columns) == ["fingerprint", "tables", "latency_s",
+                                    "appliedIndexes", "rulesFired"]
+        assert len(df) == 1
+
+
+class TestWhatIf:
+    def test_filter_rewrite_confirmed_without_build(self, env):
+        session, hs = env["session"], env["hs"]
+        fact = session.read.parquet(env["fact"])
+        q = fact.filter(col("k") > 50).select("k", "v")
+        before = _dir_state(env["system_path"])
+        out = hs.what_if(q, [IndexConfig("hypo", ["k"], ["v"])])
+        assert out.rewritten
+        assert out.applied == ("hypo",)
+        assert "IndexScan" in out.plan_after
+        assert "IndexScan" not in out.plan_before
+        assert out.cost_after_bytes < out.cost_before_bytes
+        assert out.predicted_speedup > 1.0
+        # Metadata only: nothing persisted, byte-for-byte.
+        assert _dir_state(env["system_path"]) == before
+        assert "What-If" in out.explain()
+
+    def test_wrong_column_config_does_not_rewrite(self, env):
+        session, hs = env["session"], env["hs"]
+        fact = session.read.parquet(env["fact"])
+        q = fact.filter(col("k") > 50).select("k", "v")
+        # First indexed column not in the predicate -> rule refuses.
+        out = hs.what_if(q, [IndexConfig("bad", ["w"], ["k", "v"])])
+        assert not out.rewritten
+        assert out.cost_after_bytes == out.cost_before_bytes
+
+    def test_join_pair_rewrite(self, env):
+        session, hs = env["session"], env["hs"]
+        fact = session.read.parquet(env["fact"])
+        dim = session.read.parquet(env["dim"])
+        q = (fact.join(dim, on=col("k") == col("dk"))
+             .group_by("dv").agg(sum_(col("v")).alias("sv")))
+        out = hs.what_if(q, [IndexConfig("h_l", ["k"], ["v"]),
+                             IndexConfig("h_r", ["dk"], ["dv"])])
+        assert set(out.applied) == {"h_l", "h_r"}
+
+    def test_join_needs_both_sides(self, env):
+        session, hs = env["session"], env["hs"]
+        fact = session.read.parquet(env["fact"])
+        dim = session.read.parquet(env["dim"])
+        # Project both sides' columns so neither a filter rewrite nor a
+        # join rewrite can fire with only ONE side's index.
+        q = fact.join(dim, on=col("k") == col("dk")) \
+            .select("k", "v", "dk", "dv")
+        out = hs.what_if(q, [IndexConfig("h_l", ["k"], ["v"])])
+        assert not out.rewritten
+
+    def test_sketch_static_applicability(self, env):
+        session, hs = env["session"], env["hs"]
+        fact = session.read.parquet(env["fact"])
+        q = fact.filter(col("k") > 50).select("k", "v")
+        out = hs.what_if(q, [
+            DataSkippingIndexConfig("sk_ok", [MinMaxSketch("k")]),
+            DataSkippingIndexConfig("sk_wrong", [BloomFilterSketch("v")]),
+        ])
+        assert out.sketch_applicable == {"sk_ok": True, "sk_wrong": False}
+
+    def test_what_if_sees_existing_indexes(self, env):
+        session, hs = env["session"], env["hs"]
+        fact = session.read.parquet(env["fact"])
+        hs.create_index(fact, IndexConfig("real_kv", ["k"], ["v"]))
+        q = fact.filter(col("k") > 50).select("k", "v")
+        # A hypothetical strictly wider than the real index loses the
+        # size tie-break: the plan keeps the real index.
+        out = hs.what_if(q, [IndexConfig("hypo_wide", ["k"],
+                                         ["v", "w", "pad"])])
+        assert not out.rewritten
+        assert out.applied_existing == ("real_kv",)
+
+    def test_what_if_emits_telemetry(self, env, tmp_path):
+        from tests.conftest import capture_logger
+        session, hs = env["session"], env["hs"]
+        capture_logger().events = []
+        session.conf.set(IndexConstants.EVENT_LOGGER_CLASS,
+                         "tests.conftest.CaptureLogger")
+        fact = session.read.parquet(env["fact"])
+        q = fact.filter(col("k") > 50).select("k", "v")
+        hs.what_if(q, [IndexConfig("hypo", ["k"], ["v"])])
+        names = [type(e).__name__ for e in capture_logger().events]
+        assert "AdvisorWhatIfEvent" in names
+        (ev,) = [e for e in capture_logger().events
+                 if type(e).__name__ == "AdvisorWhatIfEvent"]
+        assert ev.applied_names == ["hypo"]
+
+
+class TestRecommend:
+    def _workload(self, env):
+        session = env["session"]
+        fact = session.read.parquet(env["fact"])
+        dim = session.read.parquet(env["dim"])
+        q_filter = fact.filter(col("k") > 50).select("k", "v")
+        q_join = (fact.join(dim, on=col("k") == col("dk"))
+                  .group_by("dv").agg(sum_(col("v")).alias("sv")))
+        _capture(session, q_filter, q_join)
+        return q_filter, q_join
+
+    def test_recommends_known_good_ahead_of_worse(self, env):
+        hs = env["hs"]
+        self._workload(env)
+        report = hs.recommend(top_k=10)
+        assert report.records_considered == 2
+        assert report.recommendations, report.explain()
+        top = report.recommendations[0]
+        # The known-good proposals: fact indexed on the join/filter key k
+        # covering v, dim indexed on dk covering dv. Every recommendation
+        # that ranks must have fired somewhere (strictly-worse candidates
+        # whose rewrite never applies are cut).
+        covering = [r for r in report.recommendations
+                    if r.kind in ("filter", "join")]
+        assert covering and all(r.queries_matched > 0 for r in covering)
+        assert top.kind in ("filter", "join")
+        assert top.predicted_benefit_s > 0
+        flat = [list(c.indexed_columns) + sorted(c.included_columns)
+                for r in covering for c in r.configs]
+        assert ["k", "v"] in flat  # the known-good fact index
+        # Sketch proposals exist but rank behind confirmed benefit.
+        sketches = [r for r in report.recommendations if r.kind == "sketch"]
+        for s in sketches:
+            assert s.predicted_benefit_s == 0.0
+            assert s.rank > top.rank
+        assert "Index Recommendations" in report.explain()
+
+    def test_deterministic(self, env):
+        hs = env["hs"]
+        self._workload(env)
+        r1 = hs.recommend(top_k=5)
+        r2 = hs.recommend(top_k=5)
+        as_tuples = lambda rep: [
+            (r.rank, r.names, round(r.predicted_benefit_s, 9))
+            for r in rep.recommendations]
+        assert as_tuples(r1) == as_tuples(r2)
+
+    def test_log_store_bytes_unchanged(self, env):
+        hs = env["hs"]
+        session = env["session"]
+        fact = session.read.parquet(env["fact"])
+        hs.create_index(fact, IndexConfig("pre", ["pad"], ["w"]))
+        self._workload(env)
+        before = _dir_state(env["system_path"])
+        hs.recommend(top_k=5)
+        assert _dir_state(env["system_path"]) == before
+
+    def test_existing_index_not_reproposed(self, env):
+        session, hs = env["session"], env["hs"]
+        fact = session.read.parquet(env["fact"])
+        q = fact.filter(col("k") > 50).select("k", "v")
+        _capture(session, q)
+        # Build exactly what the workload needs; the same shape must not
+        # be proposed again.
+        hs.create_index(fact, IndexConfig("kv", ["k"], ["v"]))
+        report = hs.recommend(top_k=5)
+        for r in report.recommendations:
+            for cfg, _tbl in zip(r.configs, r.tables):
+                if hasattr(cfg, "indexed_columns"):
+                    assert not (list(cfg.indexed_columns) == ["k"]
+                                and set(cfg.included_columns) <= {"v"})
+
+    def test_build_recommendation_then_rewrite_fires(self, env):
+        session, hs = env["session"], env["hs"]
+        q_filter, q_join = self._workload(env)
+        report = hs.recommend(top_k=3)
+        top = report.recommendations[0]
+        hs.build_recommendation(top)
+        listed = set(hs.indexes()["name"])
+        assert set(top.names) <= listed
+        # The workload query the recommendation matched now rewrites.
+        plans = [q_filter.optimized_plan().tree_string(),
+                 q_join.optimized_plan().tree_string()]
+        assert any("IndexScan" in p for p in plans)
+
+    def test_candidates_pinned_to_their_table(self, env, tmp_path):
+        # Two tables with IDENTICAL schemas: a candidate generated from
+        # one table's workload must not accrue benefit by "applying" to
+        # the other table's queries (and build_recommendation would
+        # otherwise build an index that can't deliver the prediction).
+        session, hs = env["session"], env["hs"]
+        clone_dir = tmp_path / "fact_clone"
+        clone_dir.mkdir()
+        rng = np.random.default_rng(5)
+        pq.write_table(pa.table({
+            "k": pa.array(rng.integers(0, 100, 1000).astype(np.int64)),
+            "v": pa.array(rng.integers(0, 9, 1000).astype(np.int64)),
+            "w": pa.array(np.round(rng.uniform(0, 1, 1000), 3)),
+            "pad": pa.array(rng.integers(0, 5, 1000).astype(np.int64)),
+        }), clone_dir / "p0.parquet")
+        fact = session.read.parquet(env["fact"])
+        clone = session.read.parquet(str(clone_dir))
+        _capture(session,
+                 fact.filter(col("k") > 50).select("k", "v"),
+                 clone.filter(col("k") > 50).select("k", "v"))
+        report = hs.recommend(top_k=10)
+        filters = [r for r in report.recommendations if r.kind == "filter"]
+        assert len(filters) == 2  # one per table, not one matching both
+        for r in filters:
+            assert r.queries_matched == 1
+
+    def test_min_support_filters(self, env):
+        session, hs = env["session"], env["hs"]
+        session.conf.set(AdvisorConstants.MIN_SUPPORT, 2)
+        fact = session.read.parquet(env["fact"])
+        q = fact.filter(col("k") > 50).select("k", "v")
+        _capture(session, q)  # support 1 < 2
+        assert hs.recommend(top_k=5).recommendations == []
+        _capture(session, q)  # support 2
+        assert hs.recommend(top_k=5).recommendations
+
+    def test_recommend_emits_telemetry(self, env):
+        from tests.conftest import capture_logger
+        session, hs = env["session"], env["hs"]
+        self._workload(env)
+        capture_logger().events = []
+        session.conf.set(IndexConstants.EVENT_LOGGER_CLASS,
+                         "tests.conftest.CaptureLogger")
+        report = hs.recommend(top_k=2)
+        evs = [e for e in capture_logger().events
+               if type(e).__name__ == "AdvisorRecommendationEvent"]
+        assert len(evs) == 1
+        assert evs[0].records_considered == 2
+        assert set(evs[0].recommended) == {
+            n for r in report.recommendations for n in r.names}
+
+
+class TestUsageCounts:
+    def test_usage_counts_surface(self, env):
+        session, hs = env["session"], env["hs"]
+        fact = session.read.parquet(env["fact"])
+        hs.create_index(fact, IndexConfig("hot", ["k"], ["v"]))
+        hs.create_index(fact, IndexConfig("dead", ["pad"], ["w"]))
+        q = fact.filter(col("k") > 50).select("k", "v")
+        q.to_arrow()
+        q.to_arrow()
+        t = hs.indexes().set_index("name")
+        assert t.loc["hot", "usageCount"] == 2
+        assert t.loc["dead", "usageCount"] == 0
+        assert hs.index("hot").iloc[0]["usageCount"] == 2
+        assert hs.index("dead").iloc[0]["usageCount"] == 0
+
+    def test_explain_advisor_section(self, env):
+        session, hs = env["session"], env["hs"]
+        fact = session.read.parquet(env["fact"])
+        hs.create_index(fact, IndexConfig("hot", ["k"], ["v"]))
+        q = fact.filter(col("k") > 50).select("k", "v")
+        # Advisor-less session: no section (goldens untouched).
+        assert "Advisor:" not in hs.explain(q)
+        _capture(session, q)
+        out = hs.explain(q)
+        assert "Advisor:" in out
+        assert "workload capture: off (1 record(s)" in out
+        assert "index 'hot' applied" in out
+
+    def test_explain_does_not_count_usage(self, env):
+        session, hs = env["session"], env["hs"]
+        fact = session.read.parquet(env["fact"])
+        hs.create_index(fact, IndexConfig("hot", ["k"], ["v"]))
+        q = fact.filter(col("k") > 50).select("k", "v")
+        # Diagnostic passes: neither explain surface may inflate the
+        # dead-index detector for a query that never executed.
+        hs.explain(q)
+        q.explain()
+        assert hs.indexes().set_index("name").loc["hot", "usageCount"] == 0
+
+    def test_why_not_does_not_count_usage(self, env):
+        session, hs = env["session"], env["hs"]
+        fact = session.read.parquet(env["fact"])
+        hs.create_index(fact, IndexConfig("hot", ["k"], ["v"]))
+        q = fact.filter(col("k") > 50).select("k", "v")
+        hs.why_not(q)  # diagnostic: silent pass
+        assert hs.indexes().set_index("name").loc["hot", "usageCount"] == 0
+
+    def test_what_if_does_not_count_usage(self, env):
+        session, hs = env["session"], env["hs"]
+        fact = session.read.parquet(env["fact"])
+        hs.create_index(fact, IndexConfig("hot", ["k"], ["v"]))
+        q = fact.filter(col("k") > 50).select("k", "v")
+        hs.what_if(q, [IndexConfig("hypo", ["pad"], ["w"])])
+        assert hs.indexes().set_index("name").loc["hot", "usageCount"] == 0

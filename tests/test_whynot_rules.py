@@ -1,0 +1,154 @@
+"""Hyperspace.why_not coverage across ALL THREE rule families — filter,
+join, and data-skipping — including the no-index and wrong-column cases
+(the diagnostic surface the advisor's reports point users at).
+
+All tests pin hyperspace.tpu.distributed.enabled=false (this image's
+jax 0.4.37 lacks jax.shard_map; the environmental seed failures must not
+grow).
+"""
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+import hyperspace_tpu as hst
+from hyperspace_tpu.api import (BloomFilterSketch, DataSkippingIndexConfig,
+                                Hyperspace, IndexConfig, MinMaxSketch)
+from hyperspace_tpu.index.constants import IndexConstants
+from hyperspace_tpu.plan.expr import col
+
+
+@pytest.fixture()
+def env(tmp_path):
+    d = tmp_path / "fact"
+    d.mkdir()
+    rng = np.random.default_rng(11)
+    # Two time-ordered parts so MinMax sketches could prune.
+    ks = np.sort(rng.integers(0, 100, 800)).astype(np.int64)
+    t = pa.table({
+        "k": pa.array(ks),
+        "v": pa.array(rng.integers(0, 9, 800).astype(np.int64)),
+        "w": pa.array(rng.integers(0, 9, 800).astype(np.int64)),
+    })
+    pq.write_table(t.slice(0, 400), d / "p0.parquet")
+    pq.write_table(t.slice(400, 400), d / "p1.parquet")
+    d2 = tmp_path / "dim"
+    d2.mkdir()
+    pq.write_table(pa.table({
+        "dk": pa.array(np.arange(100, dtype=np.int64)),
+        "dv": pa.array(np.arange(100, dtype=np.int64)),
+    }), d2 / "p0.parquet")
+    session = hst.Session(system_path=str(tmp_path / "indexes"))
+    session.conf.set(IndexConstants.TPU_DISTRIBUTED_ENABLED, "false")
+    session.conf.set(IndexConstants.INDEX_NUM_BUCKETS, 4)
+    session.enable_hyperspace()
+    return dict(session=session, hs=Hyperspace(session),
+                fact=str(d), dim=str(d2))
+
+
+class TestWhyNotNoIndex:
+    def test_no_index_at_all(self, env):
+        session, hs = env["session"], env["hs"]
+        q = session.read.parquet(env["fact"]).filter(col("k") > 3) \
+            .select("k", "v")
+        assert hs.why_not(q) == "No reason recorded."
+
+    def test_named_index_does_not_exist(self, env):
+        session, hs = env["session"], env["hs"]
+        q = session.read.parquet(env["fact"]).filter(col("k") > 3) \
+            .select("k", "v")
+        out = hs.why_not(q, index_name="ghost")
+        assert "No reasons recorded for index 'ghost'" in out
+
+
+class TestWhyNotFilterRule:
+    def test_wrong_first_indexed_column(self, env):
+        session, hs = env["session"], env["hs"]
+        fact = session.read.parquet(env["fact"])
+        hs.create_index(fact, IndexConfig("on_v", ["v"], ["k"]))
+        out = hs.why_not(fact.filter(col("k") > 3).select("k", "v"))
+        assert "[on_v] NO_FIRST_INDEXED_COL_COND" in out
+
+    def test_missing_required_column(self, env):
+        session, hs = env["session"], env["hs"]
+        fact = session.read.parquet(env["fact"])
+        hs.create_index(fact, IndexConfig("kv", ["k"], ["v"]))
+        # The query also needs w, which kv does not carry.
+        out = hs.why_not(fact.filter(col("k") > 3).select("k", "w"))
+        assert "[kv] MISSING_REQUIRED_COL" in out
+
+
+class TestWhyNotJoinRule:
+    def test_join_one_side_unindexed(self, env):
+        session, hs = env["session"], env["hs"]
+        fact = session.read.parquet(env["fact"])
+        dim = session.read.parquet(env["dim"])
+        hs.create_index(fact, IndexConfig("f_k", ["k"], ["v"]))
+        q = fact.join(dim, on=col("k") == col("dk")) \
+            .select("k", "v", "dv")
+        out = hs.why_not(q)
+        # f_k alone cannot make the pair; it must NOT be reported as
+        # applied, and no false reason may claim it covers nothing.
+        assert "Applied indexes" not in out
+        assert "[f_k] MISSING_REQUIRED_COL" not in out
+
+    def test_join_wrong_indexed_columns(self, env):
+        session, hs = env["session"], env["hs"]
+        fact = session.read.parquet(env["fact"])
+        dim = session.read.parquet(env["dim"])
+        # Indexed on v, not on the join column k.
+        hs.create_index(fact, IndexConfig("f_wrong", ["v"], ["k"]))
+        hs.create_index(dim, IndexConfig("d_ok", ["dk"], ["dv"]))
+        q = fact.join(dim, on=col("k") == col("dk")) \
+            .select("k", "v", "dv")
+        out = hs.why_not(q)
+        assert "[f_wrong] NOT_ALL_JOIN_COL_INDEXED" in out
+
+
+class TestWhyNotDataSkippingRule:
+    def test_wrong_column_sketch(self, env):
+        session, hs = env["session"], env["hs"]
+        fact = session.read.parquet(env["fact"])
+        # Sketch on v; the predicate constrains k only.
+        hs.create_index(fact, DataSkippingIndexConfig(
+            "skip_v", [MinMaxSketch("v")]))
+        out = hs.why_not(fact.filter(col("k") > 3).select("k", "v", "w"))
+        assert "[skip_v] NO_APPLICABLE_SKETCH" in out
+        assert "sketched columns: ['v']" in out
+
+    def test_unsupported_predicate_shape(self, env):
+        session, hs = env["session"], env["hs"]
+        fact = session.read.parquet(env["fact"])
+        hs.create_index(fact, DataSkippingIndexConfig(
+            "skip_b", [BloomFilterSketch("k")]))
+        # A Bloom sketch cannot refute a range predicate.
+        out = hs.why_not(fact.filter(col("k") > 3).select("k", "v", "w"))
+        assert "[skip_b] NO_APPLICABLE_SKETCH" in out
+
+    def test_stale_sketch_after_source_change(self, env):
+        session, hs = env["session"], env["hs"]
+        fact = session.read.parquet(env["fact"])
+        hs.create_index(fact, DataSkippingIndexConfig(
+            "skip_k", [MinMaxSketch("k")]))
+        pq.write_table(pa.table({
+            "k": pa.array(np.array([500], dtype=np.int64)),
+            "v": pa.array(np.array([1], dtype=np.int64)),
+            "w": pa.array(np.array([1], dtype=np.int64)),
+        }), f"{env['fact']}/p2.parquet")
+        fresh = session.read.parquet(env["fact"])
+        out = hs.why_not(fresh.filter(col("k") > 990).select("k", "v", "w"))
+        assert "[skip_k] SOURCE_DATA_CHANGED" in out
+
+    def test_applied_sketch_not_reported_as_failed(self, env):
+        session, hs = env["session"], env["hs"]
+        fact = session.read.parquet(env["fact"])
+        hs.create_index(fact, DataSkippingIndexConfig(
+            "skip_k", [MinMaxSketch("k")]))
+        # k is time-ordered across the two parts: a tight range prunes.
+        q = fact.filter(col("k") > 95).select("k", "v", "w")
+        plan = q.optimized_plan()
+        assert any(getattr(l, "skipping_note", None)
+                   for l in plan.collect_leaves())
+        out = hs.why_not(q)
+        assert "[skip_k] NO_APPLICABLE_SKETCH" not in out

@@ -137,10 +137,13 @@ class TestIndexStatistics:
         t = hs.indexes()  # pandas DataFrame (the reference returns a
         #                      Spark DataFrame from the same columns)
         assert len(t) == 1
-        # The reference's summary columns (IndexStatistics.scala).
+        # The reference's summary columns (IndexStatistics.scala) plus
+        # the session-local usageCount column (advisor dead-index
+        # detector, rule_utils.log_index_usage tally).
         assert list(t.columns) == ["name", "indexedColumns",
                                    "includedColumns", "numBuckets",
-                                   "schema", "indexLocation", "state"]
+                                   "schema", "indexLocation", "state",
+                                   "usageCount"]
         row = t.iloc[0]
         assert row["name"] == "st1"
         assert row["indexedColumns"] == ["k"]
@@ -148,6 +151,7 @@ class TestIndexStatistics:
         assert row["numBuckets"] == 4
         assert row["state"] == "ACTIVE"
         assert "v__=0" in row["indexLocation"]
+        assert row["usageCount"] == 0  # no query has applied it yet
 
     def test_extended_stats_counts(self, env):
         hs, session = env["hs"], env["session"]
