@@ -1232,6 +1232,140 @@ def _run_lake_phase(args, root: str) -> None:
             scan_s / skip_s if skip_s > 0 else float("inf"), 3)
 
 
+def _gil_free_scaling() -> float:
+    """2-thread vs serial throughput of GIL-free zlib decompression —
+    the host's REAL parallel capacity (vCPU count lies on time-shared
+    sandboxes; this box's 2 vCPUs measured ~1.1x)."""
+    import threading
+    import zlib
+
+    import numpy as np
+    comp = zlib.compress(np.random.default_rng(0)
+                         .integers(0, 255, 4 * 1024 * 1024, dtype=np.uint8)
+                         .tobytes(), 6)
+
+    def work(n):
+        for _ in range(n):
+            zlib.decompress(comp)
+
+    work(2)  # warm
+    t0 = time.perf_counter()
+    work(8)
+    serial = time.perf_counter() - t0
+    threads = [threading.Thread(target=work, args=(4,)) for _ in range(2)]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    par = time.perf_counter() - t0
+    return serial / par if par > 0 else 1.0
+
+
+def _run_io_phase(args, root: str) -> None:
+    """Parallel-I/O A/B (parallel/io.py): cold multi-file scan and
+    per-file sketch-build wall clock at `io.threads=1` (the sequential
+    baseline) vs auto (pooled fan-out + prefetch pipeline), plus the
+    read-vs-wait split from the pool counters. Fresh session per side
+    (nothing cached between them beyond the OS page cache, which a
+    warm-up pass levels for both); distributed off like the other
+    phases.
+
+    The phase also CALIBRATES the host: a GIL-free 2-thread zlib
+    scaling probe (`io_host_parallel_scaling`). On a host whose vCPUs
+    time-share ~one physical core (this sandbox measured 1.0-1.25x) and
+    whose fs is fully page-cached (9p: no I/O wait to overlap), NO
+    read-parallelism can beat ~1.3x — total CPU work is conserved and
+    the device IS the CPU, so the consumer's compute contends with the
+    readers. `io_env_serial` marks that condition so a flat speedup
+    reads as an environment bound, not a subsystem failure (the
+    r07 lake_plan_native_auto_disabled precedent). The wait split is
+    the direct evidence the pipeline works: `io_wait_seconds` ~ 0 with
+    `io_read_seconds` >> 0 means ~all read time was hidden behind
+    compute."""
+    import numpy as np
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    import hyperspace_tpu as hst
+    from hyperspace_tpu.api import (DataSkippingIndexConfig, Hyperspace,
+                                    MinMaxSketch)
+    from hyperspace_tpu.index.constants import IndexConstants
+    from hyperspace_tpu.parallel import io as pio
+    from hyperspace_tpu.plan.expr import col, sum_
+
+    RESULT["io_host_parallel_scaling"] = round(_gil_free_scaling(), 3)
+    RESULT["io_env_serial"] = RESULT["io_host_parallel_scaling"] < 1.5
+
+    # Files sized so the READ genuinely dominates (the per-file device
+    # reductions cost ~constant dispatch time, so tiny files measure jax
+    # overhead, not I/O) and zstd-compressed so decode is real GIL-free
+    # CPU work on any healthy host.
+    n_files = 48
+    rows_per_file = 100_000 if args.scale >= 0.1 else 20_000
+    rng = np.random.default_rng(23)
+    io_dir = os.path.join(root, "io_bench")
+    os.makedirs(io_dir)
+    for i in range(n_files):
+        ts = (10_000 + i * 10
+              + np.sort(rng.integers(0, 12, rows_per_file))).astype(np.int64)
+        eid = (i * rows_per_file
+               + rng.permutation(rows_per_file)).astype(np.int64)
+        pq.write_table(pa.table({
+            "ts": pa.array(ts),
+            "event_id": pa.array(eid),
+            "amount": pa.array(np.round(
+                rng.uniform(1, 500, rows_per_file), 2)),
+        }), os.path.join(io_dir, f"f{i:05d}.parquet"), compression="zstd")
+    RESULT["io_files"] = n_files
+    RESULT["io_rows"] = n_files * rows_per_file
+
+    def side(tag: str, threads: int):
+        session = hst.Session(
+            system_path=os.path.join(root, f"io_idx_{tag}"))
+        session.conf.set(IndexConstants.TPU_DISTRIBUTED_ENABLED, "false")
+        session.conf.set(IndexConstants.TPU_IO_THREADS, threads)
+        hs = Hyperspace(session)
+        df = session.read.parquet(io_dir)
+        q = df.filter(col("ts") >= 0).agg(
+            sum_(col("ts")).alias("st"),
+            sum_(col("event_id")).alias("se"),
+            sum_(col("amount")).alias("sa"))
+        q.to_arrow()  # warm: compiled programs + OS page cache
+        scan_s = timed_best(lambda: q.to_arrow(), max(args.repeats, 2))
+        pio.reset_stats()
+
+        def timed_build() -> float:
+            t0 = time.perf_counter()
+            hs.create_index(df, DataSkippingIndexConfig(
+                "io_skip", [MinMaxSketch("ts"), MinMaxSketch("event_id")]))
+            return time.perf_counter() - t0
+
+        # Best of two builds (delete+vacuum between), mirroring
+        # timed_best: a single cold pass is at the mercy of host noise.
+        build_s = timed_build()
+        hs.delete_index("io_skip")
+        hs.vacuum_index("io_skip")
+        build_s = min(build_s, timed_build())
+        stats = pio.pool_stats()
+        RESULT[f"io_scan_{tag}_s"] = round(scan_s, 4)
+        RESULT[f"io_sketch_build_{tag}_s"] = round(build_s, 4)
+        return scan_s, build_s, stats
+
+    scan_1t, build_1t, _ = side("1t", 1)
+    scan_auto, build_auto, auto_stats = side("auto", 0)
+    RESULT["io_pool_threads"] = auto_stats["pool_threads"]
+    RESULT["io_scan_speedup"] = round(
+        scan_1t / scan_auto if scan_auto > 0 else 0.0, 3)
+    RESULT["io_sketch_build_speedup"] = round(
+        build_1t / build_auto if build_auto > 0 else 0.0, 3)
+    # Wait-vs-compute split of the pooled sketch build: in-worker
+    # read+decode seconds vs the consumer's blocked-on-pool seconds —
+    # their gap is read time hidden behind the device reductions.
+    RESULT["io_read_seconds"] = round(auto_stats["read_seconds"], 4)
+    RESULT["io_wait_seconds"] = round(auto_stats["wait_seconds"], 4)
+
+
 def main():
     parser = argparse.ArgumentParser()
     # Default 0.5 (3M lineitem rows): at 0.2 the on-chip query pairs were
@@ -1307,6 +1441,13 @@ def main():
                 except Exception as e:
                     RESULT["errors"].append(
                         f"lake phase: {type(e).__name__}: {e}")
+        if not _backend_dead():
+            with _phase("io"):
+                try:
+                    _run_io_phase(args, root)
+                except Exception as e:
+                    RESULT["errors"].append(
+                        f"io phase: {type(e).__name__}: {e}")
         with _phase("mesh"):
             # Multi-device numbers ride along at a bounded scale (the
             # virtual CPU mesh measures path health + collective overhead,

@@ -61,11 +61,15 @@ class Action:
     def run(self) -> None:
         logger = get_logger(self.session.hs_conf.event_logger_class())
         # Shape-class scope: build/refresh/optimize kernels (sorts, hashes,
-        # sketch reductions) read the session's shapeBucketing conf.
+        # sketch reductions) read the session's shapeBucketing conf. The
+        # parallel-io scope does the same for the reader pool (sketch
+        # builds, chunked-build streams, spill merges under this action).
         from ..execution import shapes
+        from ..parallel import io as pio
         try:
             logger.log_event(self.event("Operation started."))
-            with shapes.use_conf(self.session.hs_conf):
+            with shapes.use_conf(self.session.hs_conf), \
+                    pio.use_session(self.session):
                 self.validate()
                 self._begin()
                 self.op()

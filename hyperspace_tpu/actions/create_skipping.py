@@ -57,7 +57,14 @@ def valuelist_col(column: str) -> str:
 
 def build_sketch_rows(relation, sketch_list: List[Sketch],
                       files: List[str], tracker: FileIdTracker) -> Dict[str, list]:
-    """One sketch row per file; device reductions per (file, sketch)."""
+    """One sketch row per file; device reductions per (file, sketch).
+
+    Reads pipeline through the shared pool (parallel/io.py): file k+1
+    (and deeper, to the pool width) reads+decodes while file k's device
+    reductions run. The consumer loop walks ``files`` in order, so
+    ``tracker`` id assignment and row order — and therefore the sketch
+    table bytes — are identical at any thread count."""
+    from ..parallel import io as pio
     needed = sorted({s.column for s in sketch_list})
     rows: Dict[str, list] = {FILE_COL: [], FILE_ID_COL: []}
     for s in sketch_list:
@@ -72,10 +79,20 @@ def build_sketch_rows(relation, sketch_list: List[Sketch],
         else:
             raise HyperspaceException(f"Unknown sketch kind: {s.kind}")
     from ..util.file_utils import file_info_triple
-    for path in files:
-        table = read_parquet([path], needed,
-                             getattr(relation, "data_file_format",
-                                     relation.file_format))
+    fmt = getattr(relation, "data_file_format", relation.file_format)
+    def _weight(f) -> int:
+        # Local stat only (cheap, runs on the submit thread); store-backed
+        # paths fall to 0 rather than paying a metadata RPC per file twice
+        # (tracker.add_file needs the full info triple later anyway).
+        import os
+        try:
+            return int(os.path.getsize(f))
+        except OSError:
+            return 0
+
+    for path, table in pio.zip_prefetch(
+            files, lambda f: read_parquet([f], needed, fmt),
+            weight=_weight, label="sketch_build"):
         rows[FILE_COL].append(path)
         rows[FILE_ID_COL].append(tracker.add_file(*file_info_triple(path)))
         for s in sketch_list:

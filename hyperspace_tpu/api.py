@@ -203,6 +203,14 @@ class Hyperspace:
             out["index_table_cache"] = None
         return out
 
+    def io_stats(self) -> dict:
+        """Process-wide parallel-I/O pool counters (parallel/io.py):
+        pooled read fan-outs, file tasks, byte estimates, in-worker
+        read+decode seconds, consumer wait seconds, prefetch streams,
+        and the current pool width."""
+        from .parallel import io as pio
+        return pio.pool_stats()
+
     def clear_result_cache(self) -> None:
         """Drop every cached result (both tiers) and the SQL plan memo.
         Never needed for correctness — invalidation is by key

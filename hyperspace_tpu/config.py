@@ -222,6 +222,32 @@ class HyperspaceConf:
             IndexConstants.TPU_SHAPE_BUCKETING_EXACT_FALLBACK_ROWS,
             IndexConstants.TPU_SHAPE_BUCKETING_EXACT_FALLBACK_ROWS_DEFAULT))
 
+    # ------------------------------------------------------------------
+    # Parallel I/O (parallel/io.py): reader pool + prefetch pipelines.
+    # ------------------------------------------------------------------
+
+    def io_enabled(self) -> bool:
+        return self._get_bool(
+            IndexConstants.TPU_IO_ENABLED,
+            IndexConstants.TPU_IO_ENABLED_DEFAULT)
+
+    def io_threads(self) -> int:
+        """Reader-pool width; 0 = auto (min(16, cpu count)), 1 = fully
+        sequential reads (the determinism-baseline setting)."""
+        return int(self._conf.get(
+            IndexConstants.TPU_IO_THREADS,
+            IndexConstants.TPU_IO_THREADS_DEFAULT))
+
+    def io_prefetch_depth(self) -> int:
+        return int(self._conf.get(
+            IndexConstants.TPU_IO_PREFETCH_DEPTH,
+            IndexConstants.TPU_IO_PREFETCH_DEPTH_DEFAULT))
+
+    def io_max_inflight_bytes(self) -> int:
+        return int(self._conf.get(
+            IndexConstants.TPU_IO_MAX_INFLIGHT_BYTES,
+            IndexConstants.TPU_IO_MAX_INFLIGHT_BYTES_DEFAULT))
+
     def max_chunk_rows(self) -> int:
         return int(
             self._conf.get(

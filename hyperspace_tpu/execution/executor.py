@@ -43,9 +43,12 @@ def execute(plan: LogicalPlan, session=None) -> Table:
     token = _SESSION.set(session)
     try:
         # Shape-class execution scope: kernels and the padded pipeline
-        # below read the session's shapeBucketing conf through it.
+        # below read the session's shapeBucketing conf through it. The
+        # parallel-io scope routes every read under this execution through
+        # the session's hyperspace.tpu.io.* conf (and its event logger).
+        from ..parallel import io as pio
         conf = session.hs_conf if session is not None else None
-        with shapes.use_conf(conf), \
+        with shapes.use_conf(conf), pio.use_session(session), \
                 shapes.compile_scope("execute") as tally:
             # Row-returning distributed path: a {Filter, Project, Join}*
             # chain root (optionally under Sort/Limit) runs SPMD over the
@@ -463,13 +466,19 @@ def _chunked_filtered_index_scan(plan: IndexScan, needed: Optional[Set[str]],
         # schemas), unreadable probes take the safe fallback.
         app_cols = [c for c in cols if c != lineage]
         import pyarrow.parquet as _pq
+
+        from ..parallel import io as pio
         try:
-            flat = True
-            for f in plan.appended_files:
-                names = set(_pq.read_schema(f).names)
-                if any(c not in names for c in app_cols):
-                    flat = False
-                    break
+            # Footer probes fan out over the reader pool (one metadata
+            # round trip per appended file — on remote stores the latency
+            # sum, not bandwidth, is what the pool hides). Lazy gather:
+            # all() short-circuits at the first evolved schema, closing
+            # the stream and cancelling not-yet-started probes.
+            flat = all(
+                not any(c not in names for c in app_cols)
+                for names in pio.imap_ordered(
+                    lambda f: set(_pq.read_schema(f).names),
+                    list(plan.appended_files), label="schema_probe"))
         except Exception:
             flat = False
         if flat:
@@ -496,7 +505,10 @@ def _chunked_filtered_index_scan(plan: IndexScan, needed: Optional[Set[str]],
                     at = at.select(app_cols)
                     for lo in range(0, at.num_rows, chunk_rows):
                         yield Table.from_arrow(at.slice(lo, chunk_rows))
-            app_iter = _app_chunks()
+            from .columnar import _table_nbytes_estimate
+            app_iter = pio.prefetch_iter(
+                _app_chunks(), nbytes=_table_nbytes_estimate,
+                label="hybrid_appended_chunks")
         for chunk in app_iter:
             CHUNK_SCAN_STATS["max_device_rows"] = max(
                 CHUNK_SCAN_STATS["max_device_rows"], chunk.num_rows)

@@ -176,3 +176,18 @@ class IndexConstants:
     TPU_SHAPE_BUCKETING_EXACT_FALLBACK_ROWS = \
         "hyperspace.tpu.execution.shapeBucketing.exactFallbackRows"
     TPU_SHAPE_BUCKETING_EXACT_FALLBACK_ROWS_DEFAULT = str(4 * 1024 * 1024)
+
+    # Parallel I/O (parallel/io.py): the process-wide bounded reader pool
+    # and the producer/consumer prefetch pipelines behind every multi-file
+    # read, chunk stream, sketch build, and spill merge. Ordered gather
+    # makes results byte-identical at any thread count; maxInflightBytes
+    # bounds the estimated bytes held by in-flight reads. threads=0 means
+    # auto (min(16, cpu count)); threads=1 restores sequential reads.
+    TPU_IO_ENABLED = "hyperspace.tpu.io.enabled"
+    TPU_IO_ENABLED_DEFAULT = "true"
+    TPU_IO_THREADS = "hyperspace.tpu.io.threads"
+    TPU_IO_THREADS_DEFAULT = "0"
+    TPU_IO_PREFETCH_DEPTH = "hyperspace.tpu.io.prefetchDepth"
+    TPU_IO_PREFETCH_DEPTH_DEFAULT = "2"
+    TPU_IO_MAX_INFLIGHT_BYTES = "hyperspace.tpu.io.maxInflightBytes"
+    TPU_IO_MAX_INFLIGHT_BYTES_DEFAULT = str(256 * 1024 * 1024)

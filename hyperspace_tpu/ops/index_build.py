@@ -190,13 +190,45 @@ def _chunked_spill_and_merge(files, columns, indexed_cols, num_buckets,
                        parquet_row_counts([spill_paths[b]
                                            for b in bucket_list])))
 
-    def flush(batch) -> None:
-        if not batch:
-            return
+    batches: List[List[int]] = []
+    batch: List[int] = []
+    batch_rows = 0
+    for b in bucket_list:
+        if batch and batch_rows + rows_of[b] > chunk_rows:
+            batches.append(batch)
+            batch, batch_rows = [], 0
+        batch.append(b)
+        batch_rows += rows_of[b]
+    if batch:
+        batches.append(batch)
+
+    def _read_batch(batch):
         # One multi-file read (host-side dictionary unification, file
         # order preserved) — not a per-file read + device concat, which
         # would hold ~3x the batch on device at the merge peak.
-        merged = read_parquet([spill_paths[b] for b in batch])
+        return read_parquet([spill_paths[b] for b in batch])
+
+    def _batch_weight(batch) -> int:
+        try:
+            return sum(os.path.getsize(spill_paths[b]) for b in batch)
+        except OSError:
+            return 0
+
+    # Double-buffered merge (parallel/io.py): batch i+1 reads back from
+    # spill while batch i sorts on device and writes its bucket files.
+    # Residency is pinned to TWO batches alive (threads=2, depth=0 →
+    # one in-flight read + the one being consumed) — each batch is
+    # ~chunk_rows decoded device rows, so the pool's general
+    # threads+prefetchDepth window would multiply the device footprint
+    # the chunked build exists to bound.
+    from ..parallel import io as pio
+    p = pio.active_params()
+    merge_params = pio.IoParams(
+        enabled=p.enabled, threads=min(2, p.resolved_threads()),
+        prefetch_depth=0, max_inflight_bytes=p.max_inflight_bytes)
+    for batch, merged in pio.zip_prefetch(
+            batches, _read_batch, weight=_batch_weight,
+            params=merge_params, label="spill_merge"):
         bids = np.concatenate([np.full(rows_of[b], i, np.int32)
                                for i, b in enumerate(batch)])
         _note_device_rows(merged.num_rows)
@@ -213,16 +245,6 @@ def _chunked_spill_and_merge(files, columns, indexed_cols, num_buckets,
             pq.write_table(at.slice(lo, hi - lo), _dstp,
                            row_group_size=row_group_size, filesystem=_fs)
             lo = hi
-
-    batch: List[int] = []
-    batch_rows = 0
-    for b in bucket_list:
-        if batch and batch_rows + rows_of[b] > chunk_rows:
-            flush(batch)
-            batch, batch_rows = [], 0
-        batch.append(b)
-        batch_rows += rows_of[b]
-    flush(batch)
 
 
 def bucket_file_name(bucket: int) -> str:

@@ -1,0 +1,444 @@
+"""Parallel I/O subsystem: pooled ordered file reads + prefetch pipelines.
+
+The reference delegated all I/O parallelism to Spark's task scheduler; this
+engine ships its own, and before this module every byte it ingested was read
+on one thread. Two primitives fix that, both with a hard determinism
+contract (results byte-identical to the sequential loop, any thread count):
+
+- ``map_ordered`` / ``imap_ordered``: fan ``fn(item)`` out over a
+  process-wide bounded reader pool, gathering results **in submission
+  order** — the per-file-parallel read underneath ``read_parquet``'s
+  multi-file fan-out, the sketch builder, and the spill-merge batches.
+- ``prefetch_iter``: a producer/consumer pipeline that advances a stream on
+  a dedicated thread up to ``prefetchDepth`` items (and ``maxInflightBytes``
+  bytes) ahead of the consumer — so chunk k+1 decodes on the host while
+  chunk k executes on device (the Flare move, arxiv 1703.08219).
+
+Ordering IS the correctness story: the pool never reorders results, the
+prefetcher never reorders the stream, so file→row provenance (lineage ids,
+``FileIdTracker`` assignment, dictionary unification) is independent of the
+thread count — asserted by tests/test_parallel_io.py at threads
+∈ {1, 4, oversubscribed}.
+
+Budgeting: in-flight work is bounded twice — at most ``threads +
+prefetchDepth`` results alive at once (the in-flight window plus the one
+the consumer holds), and ``maxInflightBytes`` of estimated result bytes
+(weights come from file sizes or decoded-table nbytes), so a wide
+fan-out over a huge dataset cannot balloon host/device memory. This is
+the ONLY module allowed to construct threads (scripts/lint.py gate): an
+ad-hoc pool elsewhere would bypass the byte budget.
+
+Nested calls (a pooled task that itself fans out) run sequentially inside
+the worker — the classic nested-pool deadlock is impossible by
+construction. Conf: ``hyperspace.tpu.io.*`` read via config.py accessors
+only; the active session rides a contextvar (``use_session``) set by the
+executor and the action framework.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import os
+import threading
+import time
+from collections import deque
+from concurrent.futures import ThreadPoolExecutor
+from dataclasses import dataclass
+from typing import Callable, Iterable, Iterator, Optional
+
+# ---------------------------------------------------------------------------
+# Parameters (conf-backed; see config.py io_* accessors).
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class IoParams:
+    enabled: bool = True
+    threads: int = 0  # 0 = auto (min(16, cpu count))
+    prefetch_depth: int = 2
+    max_inflight_bytes: int = 256 * 1024 * 1024
+
+    def resolved_threads(self) -> int:
+        if self.threads > 0:
+            return self.threads
+        return min(16, max(2, os.cpu_count() or 4))
+
+
+_DEFAULT_PARAMS = IoParams()
+
+# The session whose conf governs pool parameters AND receives telemetry.
+# Set by executor.execute and Action.run (use_session); conf values are
+# re-read per call, so runtime conf changes take effect immediately (the
+# CacheWithTransform philosophy: knobs are live).
+_SESSION: contextvars.ContextVar = contextvars.ContextVar(
+    "hst_io_session", default=None)
+
+
+@contextlib.contextmanager
+def use_session(session):
+    """Scope the session whose ``hyperspace.tpu.io.*`` conf and event
+    logger the io primitives use (None = defaults, no telemetry)."""
+    token = _SESSION.set(session)
+    try:
+        yield
+    finally:
+        _SESSION.reset(token)
+
+
+def params_from_conf(hs_conf) -> IoParams:
+    """Build IoParams from a HyperspaceConf (validated, clamped sane)."""
+    return IoParams(
+        enabled=bool(hs_conf.io_enabled()),
+        threads=max(int(hs_conf.io_threads()), 0),
+        prefetch_depth=max(int(hs_conf.io_prefetch_depth()), 1),
+        max_inflight_bytes=max(int(hs_conf.io_max_inflight_bytes()), 1))
+
+
+def active_params() -> IoParams:
+    session = _SESSION.get()
+    if session is not None:
+        return params_from_conf(session.hs_conf)
+    return _DEFAULT_PARAMS
+
+
+def active_session():
+    return _SESSION.get()
+
+
+# ---------------------------------------------------------------------------
+# Process-wide pool.
+# ---------------------------------------------------------------------------
+
+_pool_lock = threading.Lock()
+_pool: Optional[ThreadPoolExecutor] = None
+_pool_size = 0
+
+# Set inside pool tasks: a pooled fn that itself calls map_ordered /
+# prefetch_iter runs sequentially (waiting on the pool FROM the pool is
+# the textbook thread-starvation deadlock).
+_IN_WORKER = threading.local()
+
+
+def in_worker() -> bool:
+    """True on a pool worker thread: nested fan-outs run sequentially
+    (deadlock-proof), and readers should stay single-threaded — the pool
+    is already the parallelism."""
+    return bool(getattr(_IN_WORKER, "flag", False))
+
+
+def _executor(n: int) -> ThreadPoolExecutor:
+    """The shared reader pool, grown (never shrunk) to ``n`` workers.
+    Callers that asked for fewer threads are throttled by their submission
+    window, not by pool size, so one session's threads=2 does not choke a
+    concurrent session's threads=8."""
+    global _pool, _pool_size
+    with _pool_lock:
+        if _pool is None or _pool_size < n:
+            old = _pool
+            _pool = ThreadPoolExecutor(max_workers=n,
+                                       thread_name_prefix="hst-io")
+            _pool_size = n
+            if old is not None:
+                old.shutdown(wait=False)
+        return _pool
+
+
+# ---------------------------------------------------------------------------
+# Stats (process-wide; explain's "I/O:" section and Hyperspace.io_stats).
+# ---------------------------------------------------------------------------
+
+_stats_lock = threading.Lock()
+_STATS = {
+    "pooled_reads": 0,      # completed map_ordered fan-outs (>1 task)
+    "read_tasks": 0,        # individual pooled fn(item) completions
+    "read_bytes": 0,        # summed weight estimates of pooled tasks
+    "read_seconds": 0.0,    # summed in-worker read+decode time
+    "wait_seconds": 0.0,    # consumer time blocked on pool/prefetch results
+    "prefetch_streams": 0,  # completed prefetch_iter pipelines
+    "prefetch_items": 0,    # items that crossed a prefetch queue
+}
+
+
+def _note(**deltas) -> None:
+    with _stats_lock:
+        for k, v in deltas.items():
+            _STATS[k] += v
+
+
+def pool_stats() -> dict:
+    """Snapshot of the process-wide pool counters + current sizing."""
+    with _stats_lock:
+        out = dict(_STATS)
+    out["pool_threads"] = _pool_size
+    return out
+
+
+def reset_stats() -> None:
+    """Zero the counters (bench A/B phases; never needed for correctness)."""
+    with _stats_lock:
+        for k in _STATS:
+            _STATS[k] = 0 if isinstance(_STATS[k], int) else 0.0
+
+
+# ---------------------------------------------------------------------------
+# Telemetry.
+# ---------------------------------------------------------------------------
+
+def _emit(session, event) -> None:
+    target = session if session is not None else _SESSION.get()
+    if target is None:
+        return
+    from ..telemetry.logging import get_logger
+    try:
+        get_logger(target.hs_conf.event_logger_class()).log_event(event)
+    except Exception:
+        # Telemetry must never fail a read (a misconfigured logger class
+        # already raises loudly on the action path).
+        pass
+
+
+def _emit_read(session, label: str, files: int, nbytes: int,
+               seconds: float, threads: int) -> None:
+    from ..telemetry.events import IoReadEvent
+    _emit(session, IoReadEvent(
+        message=f"pooled read '{label}': {files} file task(s)",
+        files=files, nbytes=nbytes, seconds=round(seconds, 4),
+        threads=threads))
+
+
+def _emit_wait(session, label: str, wait_seconds: float,
+               read_seconds: float, items: int) -> None:
+    from ..telemetry.events import IoWaitEvent
+    _emit(session, IoWaitEvent(
+        message=f"prefetch stream '{label}': {items} item(s)",
+        where=label, wait_seconds=round(wait_seconds, 4),
+        read_seconds=round(read_seconds, 4), items=items))
+
+
+# ---------------------------------------------------------------------------
+# Ordered pooled map.
+# ---------------------------------------------------------------------------
+
+def imap_ordered(fn: Callable, items: Iterable, *,
+                 weight: Optional[Callable] = None,
+                 params: Optional[IoParams] = None,
+                 label: str = "read", session=None) -> Iterator:
+    """Yield ``fn(item)`` for every item IN ORDER, fanning the calls out
+    over the shared pool with a bounded window and in-flight byte budget.
+
+    ``weight(item)`` estimates the bytes a result will hold (file size,
+    spill-batch size); submission pauses while the estimated in-flight
+    bytes exceed ``maxInflightBytes`` (the first pending task is always
+    allowed, so an over-budget single item still makes progress).
+
+    Residency bound: at most ``threads + prefetchDepth`` results are
+    ALIVE at once — the in-flight window plus the one the consumer
+    holds. The window refills just before each yield, so the next read
+    overlaps the consumer's work even at the minimum window of one
+    (threads=2, depth=0 — the chunked build's strict double buffer).
+
+    Sequential (plain loop, no pool) when the pool is disabled, threads
+    <= 1, a single item, or when called from inside a pool worker.
+    """
+    items = list(items)
+    p = params if params is not None else active_params()
+    n = p.resolved_threads()
+    if not p.enabled or n <= 1 or len(items) <= 1 or in_worker():
+        for it in items:
+            yield fn(it)
+        return
+
+    def _task(it):
+        _IN_WORKER.flag = True
+        t0 = time.perf_counter()
+        return fn(it), time.perf_counter() - t0
+
+    ex = _executor(n)
+
+    def _submit(it):
+        # The pool can be REPLACED under us by a concurrent stream that
+        # asked for more threads (grow-only _executor); the old pool still
+        # runs everything already submitted, but rejects new work — grab
+        # the replacement and continue (looped: another stream may race
+        # a further replacement in between).
+        nonlocal ex
+        while True:
+            try:
+                return ex.submit(_task, it)
+            except RuntimeError:
+                ex = _executor(n)
+
+    window = max(n + max(p.prefetch_depth, 0) - 1, 1)
+    budget = p.max_inflight_bytes
+    pending: deque = deque()
+    state = {"inflight": 0}
+    done = 0
+    read_s = 0.0
+    wait_s = 0.0
+    nbytes = 0
+    i = 0
+
+    def _refill():
+        nonlocal i
+        while i < len(items) and len(pending) < window:
+            w = int(weight(items[i])) if weight is not None else 0
+            if pending and state["inflight"] + w > budget:
+                break
+            pending.append((_submit(items[i]), w))
+            state["inflight"] += w
+            i += 1
+
+    try:
+        _refill()
+        while pending:
+            fut, w = pending.popleft()
+            t0 = time.perf_counter()
+            result, task_s = fut.result()
+            wait_s += time.perf_counter() - t0
+            state["inflight"] -= w
+            done += 1
+            read_s += task_s
+            nbytes += w
+            # Refill BEFORE yielding: the next reads run while the
+            # consumer processes this result.
+            _refill()
+            yield result
+    finally:
+        for fut, _ in pending:
+            fut.cancel()
+        _note(pooled_reads=1, read_tasks=done, read_bytes=nbytes,
+              read_seconds=read_s, wait_seconds=wait_s)
+        _emit_read(session, label, done, nbytes, read_s, n)
+
+
+def map_ordered(fn: Callable, items: Iterable, *,
+                weight: Optional[Callable] = None,
+                params: Optional[IoParams] = None,
+                label: str = "read", session=None) -> list:
+    """``list(imap_ordered(...))`` — the eager form for callers that need
+    every result anyway (read_parquet's multi-file fan-out)."""
+    return list(imap_ordered(fn, items, weight=weight, params=params,
+                             label=label, session=session))
+
+
+# ---------------------------------------------------------------------------
+# Producer/consumer prefetch pipeline.
+# ---------------------------------------------------------------------------
+
+_DONE = object()
+
+
+def prefetch_iter(source: Iterable, *,
+                  nbytes: Optional[Callable] = None,
+                  params: Optional[IoParams] = None,
+                  label: str = "prefetch", session=None) -> Iterator:
+    """Iterate ``source`` with a dedicated producer thread running up to
+    ``prefetchDepth`` items (and ``maxInflightBytes`` estimated bytes)
+    ahead of the consumer — chunk k+1 reads/decodes while chunk k is being
+    consumed (executed on device). Order, values, and exceptions are
+    exactly the source's own; an abandoned consumer (early break) stops
+    and closes the producer.
+
+    The producer runs under a copy of the caller's context, so
+    contextvar-scoped state (shape-class params, the executing session)
+    behaves as if the source ran inline. Pass-through (no thread) when
+    the pool is disabled, threads <= 1, or inside a pool worker.
+    """
+    p = params if params is not None else active_params()
+    if not p.enabled or p.resolved_threads() <= 1 or in_worker():
+        yield from source
+        return
+
+    depth = max(p.prefetch_depth, 1)
+    budget = p.max_inflight_bytes
+    cond = threading.Condition()
+    buf: deque = deque()
+    state = {"bytes": 0, "closed": False, "read_s": 0.0, "error": None}
+
+    def _room() -> bool:
+        return len(buf) < depth and (not buf or state["bytes"] < budget)
+
+    def _produce():
+        it = iter(source)
+        try:
+            while True:
+                # Wait for room BEFORE advancing the source: producing
+                # first would hold one extra decoded item outside the
+                # queue, silently raising the residency bound the depth
+                # and byte budget promise (at most depth buffered + one
+                # at the consumer + one in production).
+                with cond:
+                    while not _room() and not state["closed"]:
+                        cond.wait()
+                    if state["closed"]:
+                        break
+                t0 = time.perf_counter()
+                try:
+                    item = next(it)
+                except StopIteration:
+                    break
+                state["read_s"] += time.perf_counter() - t0
+                w = int(nbytes(item)) if nbytes is not None else 0
+                with cond:
+                    if state["closed"]:
+                        break
+                    buf.append((item, w))
+                    state["bytes"] += w
+                    cond.notify_all()
+        except BaseException as e:  # re-raised at the consumer
+            with cond:
+                state["error"] = e
+                cond.notify_all()
+        finally:
+            if hasattr(it, "close"):
+                try:
+                    it.close()
+                except Exception:
+                    pass
+            with cond:
+                buf.append((_DONE, 0))
+                cond.notify_all()
+
+    ctx = contextvars.copy_context()
+    producer = threading.Thread(target=ctx.run, args=(_produce,),
+                                name=f"hst-io-prefetch-{label}", daemon=True)
+    producer.start()
+    wait_s = 0.0
+    items = 0
+    try:
+        while True:
+            t0 = time.perf_counter()
+            with cond:
+                while not buf and state["error"] is None:
+                    cond.wait()
+                if state["error"] is not None and not buf:
+                    raise state["error"]
+                item, w = buf.popleft()
+                state["bytes"] -= w
+                cond.notify_all()
+            wait_s += time.perf_counter() - t0
+            if item is _DONE:
+                if state["error"] is not None:
+                    raise state["error"]
+                break
+            items += 1
+            yield item
+    finally:
+        with cond:
+            state["closed"] = True
+            buf.clear()
+            state["bytes"] = 0
+            cond.notify_all()
+        producer.join(timeout=30.0)
+        _note(prefetch_streams=1, prefetch_items=items,
+              wait_seconds=wait_s, read_seconds=state["read_s"])
+        _emit_wait(session, label, wait_s, state["read_s"], items)
+
+
+def zip_prefetch(items, fn: Callable, **kwargs) -> Iterator:
+    """(item, fn(item)) pairs in order, reads pooled ahead of the consumer
+    — the per-file pipeline shape (sketch builds: reads fan out while the
+    consumer computes device reductions file by file)."""
+    items = list(items)
+    return zip(items, imap_ordered(fn, items, **kwargs))

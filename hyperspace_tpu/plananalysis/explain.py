@@ -104,6 +104,7 @@ def explain_string(session, plan: LogicalPlan, verbose: bool = False,
         buf.write_line(line)
     _write_cache_section(buf, session, plan)
     _write_compilation_section(buf, session)
+    _write_io_section(buf, session)
     _write_advisor_section(buf, session, with_index)
     if verbose:
         buf.write_line()
@@ -180,6 +181,38 @@ def _write_compilation_section(buf: BufferStream, session) -> None:
     else:
         buf.write_line("shape bucketing: off (every data-dependent "
                        "length compiles its own programs)")
+
+
+def _write_io_section(buf: BufferStream, session) -> None:
+    """Parallel-I/O observability (parallel/io.py): the process-wide
+    reader-pool counters and the read/decode vs consumer-wait time split.
+    Rendered only once the pool or a prefetch stream has done work, so
+    the explain goldens of io-less sessions are untouched."""
+    from ..parallel import io as pio
+    s = pio.pool_stats()
+    if s["pooled_reads"] == 0 and s["prefetch_streams"] == 0:
+        return
+    p = pio.params_from_conf(session.hs_conf)
+    buf.write_line()
+    _header(buf, "I/O:")
+    if p.enabled and p.resolved_threads() > 1:
+        buf.write_line(
+            f"reader pool: on (threads={p.resolved_threads()} "
+            f"prefetchDepth={p.prefetch_depth} "
+            f"maxInflightBytes={p.max_inflight_bytes})")
+    else:
+        buf.write_line("reader pool: off (reads run sequentially on the "
+                       "calling thread)")
+    buf.write_line(
+        f"pooled reads: {s['pooled_reads']} fan-out(s), "
+        f"{s['read_tasks']} file task(s), {s['read_bytes']} bytes; "
+        f"prefetch: {s['prefetch_streams']} stream(s), "
+        f"{s['prefetch_items']} item(s)")
+    overlap = max(s["read_seconds"] - s["wait_seconds"], 0.0)
+    buf.write_line(
+        f"time split: read+decode={s['read_seconds']:.2f}s "
+        f"consumer wait={s['wait_seconds']:.2f}s "
+        f"(~{overlap:.2f}s of read hidden behind compute)")
 
 
 def _write_advisor_section(buf: BufferStream, session,

@@ -182,13 +182,25 @@ def read_relation_files(relation, files: Sequence[str],
         out = attach_partition_columns(table, relation, files, wanted,
                                        counts)
     else:
-        # Non-parquet: per-file reads so counts are known.
+        # Non-parquet: no footers to pre-count rows per file, so partition
+        # columns attach per GROUP instead — consecutive files sharing
+        # identical partition values batch into ONE multi-file read
+        # (pooled per file inside read_parquet) rather than N independent
+        # root reads. File listings walk directory by directory, so runs
+        # coincide with partitions; row order, attached values, and the
+        # unified string dictionaries are identical to the per-file loop.
+        from itertools import groupby
+
         from ..execution.columnar import Table
+        base = relation.partition_base_path
         parts = []
-        for f in files:
-            t = read_parquet([f], phys_cols, fmt)
-            parts.append(attach_partition_columns(t, relation, [f], wanted,
-                                                  [t.num_rows]))
+        for _vals, group in groupby(
+                files,
+                key=lambda f: file_partition_values(base, f, wanted)):
+            group = list(group)
+            t = read_parquet(group, phys_cols, fmt)
+            parts.append(attach_partition_columns(
+                t, relation, [group[0]], wanted, [t.num_rows]))
         out = Table.concat(parts)
     if cols is not None:
         # Drop the dummy physical column read only for its row count (a

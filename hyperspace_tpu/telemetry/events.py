@@ -169,6 +169,33 @@ class AdvisorRecommendationEvent(HyperspaceEvent):
 
 
 @dataclass
+class IoReadEvent(HyperspaceEvent):
+    """Emitted per pooled multi-file read fan-out (parallel/io.py
+    imap_ordered): how many file tasks ran, their summed size estimate,
+    the summed in-worker read+decode time, and the pool width used.
+    Sequential reads (pool off / threads=1 / single file) are silent."""
+
+    files: int = 0
+    nbytes: int = 0
+    seconds: float = 0.0
+    threads: int = 0
+
+
+@dataclass
+class IoWaitEvent(HyperspaceEvent):
+    """Emitted per completed prefetch stream (parallel/io.py
+    prefetch_iter): ``wait_seconds`` is consumer time blocked on the
+    queue (I/O-bound share), ``read_seconds`` the producer's read+decode
+    time — their gap is the decode/compute overlap the pipeline bought.
+    ``where`` labels the stream (dataset_chunks, sketch_build, ...)."""
+
+    where: str = ""
+    wait_seconds: float = 0.0
+    read_seconds: float = 0.0
+    items: int = 0
+
+
+@dataclass
 class IndexCacheProbeEvent(HyperspaceEvent):
     """Base of the HBM index-table-cache probe events: the executor emits
     one per IndexScan cache lookup (execution/index_cache.py counts were

@@ -115,6 +115,43 @@ def jit_sites(tree: ast.AST) -> list:
     return sorted(set(out))
 
 
+# I/O-parallelism discipline: every thread/pool construction stays inside
+# parallel/io.py, whose shared reader pool enforces the ordered-gather
+# determinism contract and the hyperspace.tpu.io.maxInflightBytes budget.
+# An ad-hoc ThreadPoolExecutor/threading.Thread elsewhere would read
+# outside the byte budget and invisibly to the pool stats. This list is
+# FROZEN — new parallel stages go through parallel/io.py primitives
+# (map_ordered / prefetch_iter), not new pools.
+THREAD_SITE_ALLOWLIST = frozenset({
+    "hyperspace_tpu/parallel/io.py",
+})
+
+
+def thread_sites(tree: ast.AST) -> list:
+    """Line numbers of ThreadPoolExecutor / threading.Thread construction
+    references (attribute access covers bare calls and aliases; plain
+    Lock/Condition/local stay allowed everywhere)."""
+    out = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Attribute) \
+                and node.attr == "Thread" \
+                and isinstance(node.value, ast.Name) \
+                and node.value.id == "threading":
+            out.append(node.lineno)
+        elif isinstance(node, ast.Attribute) \
+                and node.attr == "ThreadPoolExecutor":
+            out.append(node.lineno)
+        elif isinstance(node, ast.Name) and node.id == "ThreadPoolExecutor":
+            out.append(node.lineno)
+        elif isinstance(node, ast.ImportFrom) and node.module \
+                and node.module.split(".")[0] in ("threading",
+                                                  "concurrent"):
+            if any(a.name in ("Thread", "ThreadPoolExecutor")
+                   for a in node.names):
+                out.append(node.lineno)
+    return sorted(set(out))
+
+
 # Doc-drift discipline: every `hyperspace.tpu.*` config key the package
 # defines must be documented in docs/configuration.md — a key literal
 # that exists only in code is an undocumented knob. Full-string match
@@ -192,6 +229,14 @@ def main() -> int:
                     f"{rel}:{line}: jax.jit outside the instrumented "
                     "kernel modules; add the jitted stage to ops/kernels.py "
                     "so the compile counter sees it")
+        if any(rel.startswith(d + os.sep) for d in PACKAGE_DIRS) \
+                and rel.replace(os.sep, "/") not in THREAD_SITE_ALLOWLIST:
+            for line in thread_sites(tree):
+                problems.append(
+                    f"{rel}:{line}: thread/pool construction outside "
+                    "parallel/io.py; route the work through its "
+                    "map_ordered/prefetch_iter so the in-flight byte "
+                    "budget and ordered-gather contract hold")
     for p in problems:
         print(p)
     print(f"lint: {len(problems)} problem(s) across "

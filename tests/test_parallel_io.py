@@ -1,0 +1,356 @@
+"""Parallel I/O subsystem (parallel/io.py): pool/prefetch unit behavior,
+the thread-hammer concurrency sweep (tests/test_result_cache_concurrency
+style), and — the contract that matters — BYTE-IDENTITY at any thread
+count: query results, sketch table file bytes, chunked-build index files,
+and FileIdTracker provenance must be identical at io.threads ∈
+{1, 4, oversubscribed}, because the pool's ordered gather makes the
+parallelism invisible to every consumer.
+
+All sessions pin hyperspace.tpu.distributed.enabled=false (this image's
+jax lacks shard_map) and run on the CPU platform via conftest.
+"""
+
+import glob
+import os
+import threading
+import time
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+import hyperspace_tpu as hst
+from hyperspace_tpu.api import (BloomFilterSketch, DataSkippingIndexConfig,
+                                Hyperspace, IndexConfig)
+from hyperspace_tpu.index.constants import IndexConstants
+from hyperspace_tpu.index.log_entry import FileIdTracker
+from hyperspace_tpu.parallel import io as pio
+from hyperspace_tpu.plan.expr import col, sum_
+
+# sequential baseline / pooled / oversubscribed (beyond any sane cpu count)
+THREAD_SWEEP = [1, 4, 32]
+
+
+def _session(tmp_path, threads, tag=""):
+    sp = tmp_path / f"indexes_{tag}_{threads}"
+    sp.mkdir(parents=True, exist_ok=True)
+    s = hst.Session(system_path=str(sp))
+    s.conf.set(IndexConstants.INDEX_NUM_BUCKETS, 4)
+    s.conf.set(IndexConstants.TPU_DISTRIBUTED_ENABLED, "false")
+    s.conf.set(IndexConstants.TPU_IO_THREADS, threads)
+    return s
+
+
+@pytest.fixture(scope="module")
+def dataset(tmp_path_factory):
+    """Several parquet part files with int/float/string columns (string
+    dictionaries are the subtle cross-file unification case)."""
+    root = tmp_path_factory.mktemp("io_data")
+    d = root / "data"
+    d.mkdir()
+    rng = np.random.default_rng(29)
+    for i in range(7):
+        n = 900 + 40 * i  # distinct per-file lengths
+        pq.write_table(pa.table({
+            "k": pa.array(rng.integers(0, 50, n).astype(np.int64)),
+            "v": pa.array(np.round(rng.uniform(0, 10, n), 3)),
+            "s": pa.array(rng.choice(["ant", "bee", "cat", "dog"], n)),
+        }), d / f"p{i}.parquet")
+    return str(d)
+
+
+# ---------------------------------------------------------------------------
+# Unit behavior of the primitives.
+# ---------------------------------------------------------------------------
+
+class TestPoolPrimitives:
+    def test_map_ordered_preserves_order(self):
+        p = pio.IoParams(threads=4)
+        out = pio.map_ordered(lambda x: x * x, range(64), params=p)
+        assert out == [x * x for x in range(64)]
+
+    def test_map_ordered_propagates_exceptions(self):
+        p = pio.IoParams(threads=4)
+
+        def boom(x):
+            if x == 13:
+                raise ValueError("boom 13")
+            return x
+
+        with pytest.raises(ValueError, match="boom 13"):
+            pio.map_ordered(boom, range(32), params=p)
+
+    def test_byte_budget_serializes_oversized_items(self):
+        """Every item's weight exceeds the budget: the submission window
+        must collapse to one in-flight task at a time."""
+        p = pio.IoParams(threads=8, max_inflight_bytes=10)
+        lock = threading.Lock()
+        state = {"cur": 0, "max": 0}
+
+        def fn(x):
+            with lock:
+                state["cur"] += 1
+                state["max"] = max(state["max"], state["cur"])
+            time.sleep(0.005)
+            with lock:
+                state["cur"] -= 1
+            return x
+
+        out = pio.map_ordered(fn, range(16), weight=lambda x: 100, params=p)
+        assert out == list(range(16))
+        assert state["max"] == 1
+
+    def test_unweighted_items_do_run_concurrently(self):
+        p = pio.IoParams(threads=8)
+        lock = threading.Lock()
+        state = {"cur": 0, "max": 0}
+
+        def fn(x):
+            with lock:
+                state["cur"] += 1
+                state["max"] = max(state["max"], state["cur"])
+            time.sleep(0.01)
+            with lock:
+                state["cur"] -= 1
+            return x
+
+        pio.map_ordered(fn, range(32), params=p)
+        assert state["max"] > 1
+
+    def test_nested_fanout_runs_sequentially_without_deadlock(self):
+        p = pio.IoParams(threads=2)
+
+        def outer(x):
+            assert pio.in_worker()
+            inner = pio.map_ordered(lambda y: y + x, range(20), params=p)
+            return sum(inner)
+
+        out = pio.map_ordered(outer, range(40), params=p)
+        assert out == [sum(y + x for y in range(20)) for x in range(40)]
+
+    def test_prefetch_iter_order_and_close(self):
+        p = pio.IoParams(threads=4, prefetch_depth=3)
+        assert list(pio.prefetch_iter(iter(range(100)), params=p)) == \
+            list(range(100))
+
+        produced = []
+
+        def gen():
+            i = 0
+            while True:
+                produced.append(i)
+                yield i
+                i += 1
+
+        it = pio.prefetch_iter(gen(), params=p)
+        got = []
+        for v in it:
+            got.append(v)
+            if v >= 5:
+                break
+        it.close()
+        assert got == list(range(6))
+        # Producer ran at most depth ahead of what the consumer took.
+        assert len(produced) <= 6 + 3 + 1
+
+    def test_prefetch_iter_propagates_exceptions(self):
+        p = pio.IoParams(threads=4)
+
+        def gen():
+            yield 1
+            yield 2
+            raise RuntimeError("stream died")
+
+        it = pio.prefetch_iter(gen(), params=p)
+        assert next(it) == 1
+        with pytest.raises(RuntimeError, match="stream died"):
+            list(it)
+
+    def test_threads_one_is_fully_sequential(self):
+        p = pio.IoParams(threads=1)
+        seen_threads = set()
+
+        def fn(x):
+            seen_threads.add(threading.get_ident())
+            return x
+
+        pio.map_ordered(fn, range(8), params=p)
+        list(pio.prefetch_iter(iter(range(8)), params=p))
+        assert seen_threads == {threading.get_ident()}
+
+
+class TestPoolHammer:
+    def test_concurrent_streams_from_many_threads(self):
+        """The serving access pattern: many threads each running pooled
+        fan-outs and prefetch streams against the one process pool."""
+        p = pio.IoParams(threads=4, prefetch_depth=2)
+        errors = []
+
+        def worker(seed):
+            try:
+                rng = np.random.default_rng(seed)
+                for _ in range(5):
+                    items = [int(x) for x in rng.integers(0, 1000, 30)]
+                    assert pio.map_ordered(
+                        lambda x: x * 3, items, params=p,
+                        weight=lambda x: x) == [x * 3 for x in items]
+                    assert list(pio.prefetch_iter(
+                        iter(items), params=p,
+                        nbytes=lambda x: x)) == items
+            except Exception as e:  # surfaced below
+                errors.append(e)
+
+        threads = [threading.Thread(target=worker, args=(i,))
+                   for i in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errors
+
+
+# ---------------------------------------------------------------------------
+# Byte-identity across thread counts.
+# ---------------------------------------------------------------------------
+
+class TestScanDeterminism:
+    def test_query_results_identical_across_thread_counts(
+            self, dataset, tmp_path):
+        results = []
+        for threads in THREAD_SWEEP:
+            s = _session(tmp_path, threads, "scan")
+            df = s.read.parquet(dataset)
+            q = df.filter(col("k") > 10).select("k", "v", "s")
+            agg = df.group_by("s").agg(sum_(col("v")).alias("sv"))
+            results.append((q.to_arrow(), agg.to_arrow()))
+        base_q, base_agg = results[0]
+        for got_q, got_agg in results[1:]:
+            assert got_q.equals(base_q)
+            assert got_agg.equals(base_agg)
+
+    def test_chunked_scan_identical_across_thread_counts(
+            self, dataset, tmp_path):
+        """Force the streaming (prefetched) scan path with a tiny chunk
+        budget; survivors and their order must match the sequential
+        stream exactly."""
+        results = []
+        for threads in THREAD_SWEEP:
+            s = _session(tmp_path, threads, "chunk")
+            s.conf.set(IndexConstants.TPU_MAX_CHUNK_ROWS, 500)
+            q = s.read.parquet(dataset) \
+                .filter(col("k") > 25).select("k", "v", "s")
+            results.append(q.to_arrow())
+        for got in results[1:]:
+            assert got.equals(results[0])
+
+    def test_partitioned_csv_grouped_reads_identical(self, tmp_path):
+        """The sources/partitions.py satellite: non-parquet partitioned
+        reads batch per-partition file groups; values and row order must
+        equal the old per-file loop (= the threads=1 result)."""
+        rng = np.random.default_rng(31)
+        root = tmp_path / "pdata"
+        expected_frames = []
+        for region in ("asia", "emea", "na"):
+            for part in range(2):
+                d = root / f"region={region}"
+                d.mkdir(parents=True, exist_ok=True)
+                f = pd.DataFrame({
+                    "id": rng.integers(0, 500, 120).astype(np.int64),
+                    "amount": np.round(rng.uniform(0, 50, 120), 2),
+                })
+                f.to_csv(d / f"part{part}.csv", index=False)
+                expected_frames.append(f.assign(region=region))
+        results = []
+        for threads in THREAD_SWEEP:
+            s = _session(tmp_path, threads, "csv")
+            q = s.read.csv(str(root)).select("id", "amount", "region")
+            results.append(q.to_arrow())
+        for got in results[1:]:
+            assert got.equals(results[0])
+        # And the values are right (not merely consistently wrong).
+        got = results[0].to_pandas()
+        exp = pd.concat(expected_frames, ignore_index=True)
+        key = ["id", "amount", "region"]
+        pd.testing.assert_frame_equal(
+            got.sort_values(key).reset_index(drop=True),
+            exp.sort_values(key).reset_index(drop=True), check_dtype=False)
+
+
+class TestSketchDeterminism:
+    def test_sketch_table_bytes_and_provenance_identical(
+            self, dataset, tmp_path):
+        sketch_bytes = []
+        trackers = []
+        for threads in THREAD_SWEEP:
+            s = _session(tmp_path, threads, "sk")
+            hs = Hyperspace(s)
+            df = s.read.parquet(dataset)
+            hs.create_index(df, DataSkippingIndexConfig(
+                "sk", [BloomFilterSketch("k", expected_items=2000)]))
+            files = glob.glob(os.path.join(
+                str(tmp_path / f"indexes_sk_{threads}"), "**",
+                "sketches.parquet"), recursive=True)
+            assert len(files) == 1
+            with open(files[0], "rb") as f:
+                sketch_bytes.append(f.read())
+
+            # FileIdTracker provenance straight off the build helper.
+            from hyperspace_tpu.actions.create_skipping import \
+                build_sketch_rows
+            from hyperspace_tpu.index.log_entry import Sketch
+            relation = df.plan.relation
+            tracker = FileIdTracker()
+            with pio.use_session(s):
+                rows = build_sketch_rows(
+                    relation, [Sketch("MinMax", "k", {})],
+                    relation.all_files(), tracker)
+            trackers.append((rows["_file_id"], tracker.file_to_id_mapping))
+        for b in sketch_bytes[1:]:
+            assert b == sketch_bytes[0]
+        for ids, mapping in trackers[1:]:
+            assert ids == trackers[0][0]
+            assert mapping == trackers[0][1]
+
+
+class TestBuildDeterminism:
+    def test_chunked_lineage_build_identical_across_thread_counts(
+            self, dataset, tmp_path):
+        """The spill-merge path (double-buffered read-back) + lineage ids
+        from the prefetched chunk stream's provenance: every bucket file
+        must hold identical rows in identical order."""
+        per_threads = []
+        for threads in THREAD_SWEEP:
+            s = _session(tmp_path, threads, "bld")
+            s.conf.set(IndexConstants.TPU_MAX_CHUNK_ROWS, 700)
+            s.conf.set(IndexConstants.INDEX_LINEAGE_ENABLED, "true")
+            hs = Hyperspace(s)
+            df = s.read.parquet(dataset)
+            hs.create_index(df, IndexConfig("cov", ["k"], ["v", "s"]))
+            files = sorted(
+                glob.glob(os.path.join(
+                    str(tmp_path / f"indexes_bld_{threads}"), "**",
+                    "*.parquet"), recursive=True))
+            assert files
+            per_threads.append(
+                [(os.path.basename(f), pq.read_table(f)) for f in files])
+        base = per_threads[0]
+        for built in per_threads[1:]:
+            assert [n for n, _ in built] == [n for n, _ in base]
+            for (_, got), (_, exp) in zip(built, base):
+                assert got.equals(exp)
+
+    def test_indexed_query_identical_across_thread_counts(
+            self, dataset, tmp_path):
+        results = []
+        for threads in THREAD_SWEEP:
+            s = _session(tmp_path, threads, "q")
+            hs = Hyperspace(s)
+            df = s.read.parquet(dataset)
+            hs.create_index(df, IndexConfig("qidx", ["k"], ["v"]))
+            s.enable_hyperspace()
+            q = df.filter(col("k") == 7).select("k", "v")
+            results.append(q.to_arrow())
+        for got in results[1:]:
+            assert got.equals(results[0])

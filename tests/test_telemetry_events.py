@@ -216,3 +216,72 @@ class TestUsageEvents:
         evs, _ = take_new(mark)
         assert not [e for e in evs
                     if type(e).__name__ == "HyperspaceIndexUsageEvent"]
+
+
+class TestIoEvents:
+    """Parallel-I/O events (parallel/io.py): a pooled multi-file fan-out
+    emits IoReadEvent, a completed prefetch stream emits IoWaitEvent,
+    and explain() grows an "I/O:" section once the pool has worked."""
+
+    @pytest.fixture()
+    def io_env(self, tmp_path):
+        rng = np.random.default_rng(9)
+        d = tmp_path / "iodata"
+        d.mkdir()
+        for i in range(5):
+            pq.write_table(pa.Table.from_pandas(pd.DataFrame({
+                "k": rng.integers(0, 60, 400).astype(np.int64),
+                "v": rng.integers(0, 9, 400).astype(np.int64),
+            })), d / f"p{i}.parquet")
+        session = hst.Session(system_path=str(tmp_path / "indexes"))
+        session.conf.set(IndexConstants.INDEX_NUM_BUCKETS, 4)
+        session.conf.set(IndexConstants.TPU_DISTRIBUTED_ENABLED, "false")
+        session.conf.set(IndexConstants.TPU_IO_THREADS, 4)
+        session.conf.set(IndexConstants.EVENT_LOGGER_CLASS,
+                         "tests.conftest.CaptureLogger")
+        sink().events.clear()
+        return dict(session=session, hs=Hyperspace(session), path=str(d))
+
+    def test_pooled_scan_emits_io_read_event(self, io_env):
+        session = io_env["session"]
+        mark = len(sink().events)
+        session.read.parquet(io_env["path"]) \
+            .filter(col("k") > 5).select("k", "v").to_pandas()
+        evs, _ = take_new(mark)
+        reads = [e for e in evs if type(e).__name__ == "IoReadEvent"]
+        assert reads
+        assert reads[0].files > 1 and reads[0].threads == 4
+        assert reads[0].nbytes > 0
+
+    def test_chunked_scan_emits_io_wait_event(self, io_env):
+        session = io_env["session"]
+        session.conf.set(IndexConstants.TPU_MAX_CHUNK_ROWS, 300)
+        mark = len(sink().events)
+        session.read.parquet(io_env["path"]) \
+            .filter(col("k") > 30).select("k", "v").to_pandas()
+        evs, _ = take_new(mark)
+        waits = [e for e in evs if type(e).__name__ == "IoWaitEvent"]
+        assert waits
+        assert waits[0].where == "dataset_chunks"
+        assert waits[0].items > 0
+
+    def test_sketch_build_emits_io_read_event(self, io_env):
+        from hyperspace_tpu.api import DataSkippingIndexConfig, MinMaxSketch
+        session, hs = io_env["session"], io_env["hs"]
+        df = session.read.parquet(io_env["path"])
+        mark = len(sink().events)
+        hs.create_index(df, DataSkippingIndexConfig(
+            "skEvt", [MinMaxSketch("k")]))
+        evs, _ = take_new(mark)
+        reads = [e for e in evs if type(e).__name__ == "IoReadEvent"
+                 and "sketch_build" in e.message]
+        assert reads and reads[0].files == 5
+
+    def test_explain_reports_io_section(self, io_env):
+        session, hs = io_env["session"], io_env["hs"]
+        df = session.read.parquet(io_env["path"])
+        df.filter(col("k") > 5).select("k", "v").to_pandas()
+        text = hs.explain(df.filter(col("k") > 5).select("k", "v"))
+        assert "I/O:" in text
+        assert "reader pool: on" in text
+        assert "time split:" in text
