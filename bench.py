@@ -417,6 +417,29 @@ def build_q17(session, li_dir: str, pt_dir: str):
             .select((col("price_sum") / 7.0).alias("avg_yearly")))
 
 
+def build_reorder_query(session, li_dir: str, od_dir: str, pt_dir: str):
+    """A multi-join TPC-H shape (Q3's customer role played by the
+    filtered part table) written in the PESSIMAL text order: lineitem
+    joins the barely-selective orders first (~60% of orders survive the
+    date filter), and the 1/35-selective part filter — the join that
+    should run first — comes last. Cost-based reordering flips them."""
+    import datetime as _dt
+
+    from hyperspace_tpu.plan.expr import col, sum_
+
+    li = session.read.parquet(li_dir)
+    od = session.read.parquet(od_dir)
+    pt = session.read.parquet(pt_dir)
+    return (li.join(od.filter(col("o_orderdate") < _dt.date(1996, 1, 1)),
+                    on=col("l_orderkey") == col("o_orderkey"))
+            .join(pt.filter((col("p_brand") == "Brand#23")
+                            & (col("p_container") == "MED BOX")),
+                  on=col("l_partkey") == col("p_partkey"))
+            .group_by("p_brand", "o_shippriority")
+            .agg(sum_(col("l_extendedprice") * (1 - col("l_discount")))
+                 .alias("revenue")))
+
+
 def build_skipping_query(session, od_dir: str):
     """Month-range scan over the time-ordered orders files: per-file MinMax
     sketches prune most of the 16 parts."""
@@ -987,6 +1010,70 @@ def _single_device_phases(args, root):
                 RESULT["result_cache_speedup"] = round(
                     off_s / on_s if on_s > 0 else float("inf"), 3)
                 RESULT["result_cache_hits"] = stats.get("hits", 0)
+
+    # ---- cost-based join reordering: reorder-off/on A/B ----
+    # Alternating best-of-two on a multi-join TPC-H query written in the
+    # pessimal text order (hyperspace disabled: this measures the pure
+    # reorder effect, not index rewrites). Also asserts result identity
+    # modulo row order and reports the estimation q-error of the
+    # reordered joins (estimate vs executor-recorded actual output rows).
+    if not _backend_dead():
+        with _phase("join_reorder"):
+            from hyperspace_tpu.optimizer.constants import \
+                OptimizerConstants as _OC
+            session.disable_hyperspace()
+            rq = build_reorder_query(session, li_dir, od_dir, pt_dir)
+
+            def _reorder(on: bool):
+                session.conf.set(_OC.JOIN_REORDER_ENABLED,
+                                 "true" if on else "false")
+
+            _reorder(False)
+            off_plan = rq.optimized_plan().tree_string()
+            off_frame = rq.to_pandas()  # warm the off-path programs
+            _reorder(True)
+            on_plan = rq.optimized_plan().tree_string()
+            RESULT["join_reorder_plan_changed"] = on_plan != off_plan
+            on_frame = rq.to_pandas()  # warm the on-path programs
+            # Estimation q-error: the reorder records carry per-step
+            # estimates keyed by condition repr; the executor recorded
+            # the actual inner-join output rows under the same keys.
+            qerrs = []
+            for rec in (session._last_join_order or []):
+                for s in rec["steps"]:
+                    actual = session._join_actuals.get(s["key"])
+                    if actual is None:
+                        continue
+                    est = max(s["est_rows"], 1.0)
+                    act = max(actual, 1)
+                    qerrs.append(max(est / act, act / est))
+            if qerrs:
+                RESULT["join_reorder_qerror_max"] = round(max(qerrs), 3)
+                RESULT["join_reorder_qerror_mean"] = round(
+                    sum(qerrs) / len(qerrs), 3)
+            cols = list(off_frame.columns)
+            ident = on_frame.sort_values(cols).reset_index(drop=True) \
+                .round(6).equals(
+                    off_frame.sort_values(cols).reset_index(drop=True)
+                    .round(6))
+            RESULT["join_reorder_identical"] = bool(ident)
+            if not ident:
+                RESULT["errors"].append(
+                    "join_reorder: reorder-on answer differs from "
+                    "reorder-off")
+            off_best = on_best = float("inf")
+            for _ in range(2):  # alternating A/B, best-of-two
+                _reorder(False)
+                off_best = min(off_best,
+                               timed_best(lambda: rq.to_arrow(), 1))
+                _reorder(True)
+                on_best = min(on_best,
+                              timed_best(lambda: rq.to_arrow(), 1))
+            _reorder(False)
+            RESULT["join_reorder_off_s"] = round(off_best, 4)
+            RESULT["join_reorder_on_s"] = round(on_best, 4)
+            RESULT["join_reorder_speedup"] = round(
+                off_best / on_best if on_best > 0 else float("inf"), 3)
 
     # ---- advisor: capture workload -> recommend -> build top reco ----
     # A FRESH session over its own (empty) system path: recommendations

@@ -14,11 +14,19 @@ latencies: a rewrite that reads ``r`` of the baseline bytes is predicted
 to save ``(1 - r) x observed latency`` per captured occurrence — cheap,
 monotone in coverage, and honest about appends (Hybrid Scan coverage
 lowers it the same way it lowers the optimizer's scores).
+
+Since the statistics layer landed (optimizer/stats.py), leaf bytes under
+a Filter are additionally discounted by the predicate's estimated
+selectivity (filter_selectivity_map) — predicted index benefit follows
+predicate selectivity rather than the pure size-ratio proxy. The 50/70
+bucketed-join weighting (BUCKET_JOIN_DISCOUNT) is unchanged.
 """
 
 from __future__ import annotations
 
-from ..plan.nodes import IndexScan, LogicalPlan
+from typing import Dict, Optional, Tuple
+
+from ..plan.nodes import Filter, IndexScan, LogicalPlan, Scan
 
 
 def relation_bytes(relation) -> int:
@@ -46,20 +54,102 @@ def predicted_index_size_bytes(relation, n_index_columns: int) -> int:
 BUCKET_JOIN_DISCOUNT = 50.0 / 70.0
 
 
-def plan_cost_bytes(plan: LogicalPlan) -> int:
+# Selectivity floor for the effective-bytes discount: a filter can never
+# talk a leaf's cost all the way to zero (footer/IO fixed costs remain,
+# and estimates this small are noise).
+MIN_COST_SELECTIVITY = 0.01
+
+
+SelectivityKey = Tuple[Tuple[str, ...], str]
+
+
+def _leaf_source_key(leaf: LogicalPlan) -> Optional[Tuple[str, ...]]:
+    """Source identity of a leaf that survives the IndexScan swap: the
+    relation's root paths (the same identity candidates.py uses to match
+    an entry to its source). A Scan reads them off the live relation; an
+    IndexScan reads the source relation recorded in its log entry."""
+    relation = getattr(leaf, "relation", None)
+    if relation is not None:
+        return tuple(relation.root_paths)
+    if isinstance(leaf, IndexScan):
+        return tuple(leaf.index_entry.relation.rootPaths)
+    return None
+
+
+def filter_selectivity_map(session,
+                           plan: LogicalPlan) -> Dict[SelectivityKey, float]:
+    """(source root paths, condition repr) -> estimated selectivity for
+    every Filter directly above a Scan leaf of ``plan``, from the
+    statistics layer (optimizer/stats.py + optimizer/cardinality.py).
+    Empty when the stats conf family is disabled or no statistics exist —
+    in which case plan_cost_bytes degrades to the pure size-ratio proxy.
+    Scoping by source identity keeps identically-spelled predicates over
+    different tables from colliding, while the SAME map still prices the
+    before- and after-rewrite plans (an IndexScan swap keeps the Filter
+    condition, and its log entry records the source root paths)."""
+    if not session.hs_conf.optimizer_stats_enabled():
+        return {}
+    from ..optimizer import cardinality
+    from ..optimizer.stats import provider_for
+    provider = provider_for(session)
+    out: Dict[SelectivityKey, float] = {}
+
+    def walk(node: LogicalPlan) -> None:
+        if isinstance(node, Filter) and isinstance(node.child, Scan):
+            ts = provider.table_stats(node.child.relation)
+            if ts is not None:
+                cap = provider.sketch_row_fraction(node.child.relation,
+                                                   node.condition)
+                key = (tuple(node.child.relation.root_paths),
+                       repr(node.condition))
+                out[key] = cardinality.filter_selectivity(
+                    ts, node.condition, cap)
+        for c in node.children:
+            walk(c)
+
+    walk(plan)
+    return out
+
+
+def plan_cost_bytes(
+        plan: LogicalPlan,
+        selectivities: Optional[Dict[SelectivityKey, float]] = None) -> int:
     """Total effective leaf input bytes of an optimized (possibly
-    what-if) plan. Appended hybrid files are not stat'ed here
-    (hypothetical entries never have them; for real entries they are
-    bounded by the hybrid append ratio, a second-order term for ranking
-    purposes)."""
+    what-if) plan. ``selectivities`` (filter_selectivity_map) discounts
+    leaves under a matching Filter by the predicate's estimated
+    selectivity — an index whose rewrite serves a highly selective
+    predicate is predicted to save proportionally more than raw bytes
+    alone say. Appended hybrid files are not stat'ed here (hypothetical
+    entries never have them; for real entries they are bounded by the
+    hybrid append ratio, a second-order term for ranking purposes)."""
     total = 0
-    for leaf in plan.collect_leaves():
+
+    def leaf_bytes(leaf: LogicalPlan) -> int:
         relation = getattr(leaf, "relation", None)
         if relation is not None:
-            total += relation_bytes(relation)
-        elif isinstance(leaf, IndexScan):
+            return relation_bytes(relation)
+        if isinstance(leaf, IndexScan):
             nbytes = leaf.index_entry.index_files_size_in_bytes
             if leaf.use_bucket_spec:
                 nbytes = int(nbytes * BUCKET_JOIN_DISCOUNT)
-            total += nbytes
+            return nbytes
+        return 0
+
+    def walk(node: LogicalPlan, conds) -> None:
+        nonlocal total
+        if isinstance(node, Filter) and selectivities:
+            conds = conds + [repr(node.condition)]
+        if not node.children:
+            sel = 1.0
+            source_key = _leaf_source_key(node) if conds else None
+            if source_key is not None:
+                for cond_repr in conds:
+                    sel *= selectivities.get((source_key, cond_repr), 1.0)
+            total += int(leaf_bytes(node)
+                         * max(MIN_COST_SELECTIVITY, min(1.0, sel)))
+            return
+        for c in node.children:
+            walk(c, conds)
+
+    walk(plan, [])
     return total

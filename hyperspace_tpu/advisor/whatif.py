@@ -192,6 +192,13 @@ class WhatIfBaseline:
     base: dict
     before_plan: LogicalPlan
     cost_before_bytes: int
+    # Predicate-selectivity discounts keyed by cost.SelectivityKey —
+    # (source root-paths tuple, Filter-condition repr) — from
+    # cost.filter_selectivity_map over the normalized plan: the SAME
+    # map prices before- and after-rewrite plans, so the benefit ratio
+    # reflects how selective the served predicate actually is.
+    selectivities: Optional[Dict[Tuple[Tuple[str, ...], str],
+                                 float]] = None
 
 
 def prepare_baseline(session, plan: LogicalPlan,
@@ -203,6 +210,18 @@ def prepare_baseline(session, plan: LogicalPlan,
     from ..serving import fingerprint as fp
 
     norm = fp.normalize(plan)
+    if session.hs_conf.join_reorder_enabled():
+        # Mirror Session.optimize: reorder AFTER normalization, BEFORE
+        # the index rules, so the advisor prices rewrites against the
+        # tree execution will actually run (a benefit predicted for a
+        # join the reorderer demotes from leaf level would never
+        # materialize). Diagnostic pass: no telemetry; restore the
+        # session's chain records so explain/bench still read the last
+        # *executed* reorder, not this planning probe's.
+        from ..optimizer.join_order import reorder_joins
+        saved = getattr(session, "_last_join_order", None)
+        norm = reorder_joins(session, norm, diagnostic=True)
+        session._last_join_order = saved
     real: List[IndexLogEntry] = []
     if include_existing:
         real = [e for e in active_indexes(session)
@@ -211,8 +230,10 @@ def prepare_baseline(session, plan: LogicalPlan,
     base = CandidateIndexCollector.collect(session, norm, real, ctx)
     before_plan = ScoreBasedIndexPlanOptimizer().apply(
         session, norm, base, ctx)
+    selectivities = cost.filter_selectivity_map(session, norm)
     return WhatIfBaseline(norm, base, before_plan,
-                          cost.plan_cost_bytes(before_plan))
+                          cost.plan_cost_bytes(before_plan, selectivities),
+                          selectivities)
 
 
 def what_if_plan(session, plan: LogicalPlan, configs,
@@ -281,7 +302,8 @@ def what_if_plan(session, plan: LogicalPlan, configs,
         applied=tuple(sorted(used & set(hypo_names))),
         applied_existing=tuple(sorted(used - set(hypo_names))),
         cost_before_bytes=baseline.cost_before_bytes,
-        cost_after_bytes=cost.plan_cost_bytes(after_plan),
+        cost_after_bytes=cost.plan_cost_bytes(after_plan,
+                                              baseline.selectivities),
         plan_before=baseline.before_plan.tree_string(),
         plan_after=after_plan.tree_string(),
         sketch_applicable={c.index_name: sketch_statically_applicable(

@@ -15,6 +15,7 @@ from typing import Any, Callable, Dict, Generic, Optional, TypeVar
 
 from .advisor.constants import AdvisorConstants
 from .index.constants import IndexConstants
+from .optimizer.constants import OptimizerConstants
 from .serving.constants import ServingConstants
 
 T = TypeVar("T")
@@ -337,6 +338,36 @@ class HyperspaceConf:
         return int(self._conf.get(
             AdvisorConstants.MIN_SUPPORT,
             AdvisorConstants.MIN_SUPPORT_DEFAULT))
+
+    # ------------------------------------------------------------------
+    # Cost-based optimizer (optimizer/constants.py): statistics provider
+    # + join reordering.
+    # ------------------------------------------------------------------
+
+    def optimizer_stats_enabled(self) -> bool:
+        return self._get_bool(
+            OptimizerConstants.STATS_ENABLED,
+            OptimizerConstants.STATS_ENABLED_DEFAULT)
+
+    def optimizer_stats_sample_rows(self) -> int:
+        return int(self._conf.get(
+            OptimizerConstants.STATS_SAMPLE_ROWS,
+            OptimizerConstants.STATS_SAMPLE_ROWS_DEFAULT))
+
+    def optimizer_stats_cache_entries(self) -> int:
+        return int(self._conf.get(
+            OptimizerConstants.STATS_CACHE_ENTRIES,
+            OptimizerConstants.STATS_CACHE_ENTRIES_DEFAULT))
+
+    def join_reorder_enabled(self) -> bool:
+        return self._get_bool(
+            OptimizerConstants.JOIN_REORDER_ENABLED,
+            OptimizerConstants.JOIN_REORDER_ENABLED_DEFAULT)
+
+    def join_reorder_dp_threshold(self) -> int:
+        return int(self._conf.get(
+            OptimizerConstants.JOIN_REORDER_DP_THRESHOLD,
+            OptimizerConstants.JOIN_REORDER_DP_THRESHOLD_DEFAULT))
 
     def _get_bool(self, key: str, default: str) -> bool:
         return (self._conf.get(key, default) or "").strip().lower() == "true"

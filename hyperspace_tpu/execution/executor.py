@@ -151,7 +151,9 @@ def _execute(plan: LogicalPlan, needed: Optional[Set[str]]) -> Table:
                             valid_rows=table.valid_rows)
         return out
     if isinstance(plan, Join):
-        return _execute_join(plan, needed)
+        table = _execute_join(plan, needed)
+        _record_join_actual(plan, table)
+        return table
     if isinstance(plan, Aggregate):
         # Multi-device product path: run eligible aggregation subtrees SPMD
         # over the mesh (execution/spmd.py); fall back on any mismatch.
@@ -200,6 +202,28 @@ def _execute(plan: LogicalPlan, needed: Optional[Set[str]]) -> Table:
         aligned = [t.select(out_names) for t in tables]
         return Table.concat(aligned)
     raise HyperspaceException(f"Cannot execute plan node {plan.node_name}")
+
+
+def _record_join_actual(plan: Join, table: Table) -> None:
+    """Observed output cardinality of executed inner joins, kept on the
+    session keyed by condition repr (LRU-bounded) so explain's "Join
+    order:" section and bench's join_reorder phase can report estimated
+    vs actual rows (q-error) for the cost-based reorderer's steps."""
+    if plan.join_type != "inner" or plan.condition is None:
+        return
+    session = _SESSION.get()
+    if session is None:
+        return
+    actuals = getattr(session, "_join_actuals", None)
+    lock = getattr(session, "_join_actuals_lock", None)
+    if actuals is None or lock is None:
+        return
+    key = repr(plan.condition)
+    with lock:  # serving threads share the session (LRU eviction races)
+        actuals[key] = int(table.num_rows)
+        actuals.move_to_end(key)
+        while len(actuals) > 256:
+            actuals.popitem(last=False)
 
 
 def _filter_table(table: Table, condition) -> Table:

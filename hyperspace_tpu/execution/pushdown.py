@@ -1,10 +1,12 @@
 """Predicate pushdown to the parquet reader.
 
-Row-group pruning at the IO boundary: conjuncts of the form Col <op> Literal
-are translated to pyarrow compute expressions and handed to the parquet
-reader, which skips row groups whose min/max stats can't match. The device
-Filter stays in the plan (pushdown is an IO optimization, not a semantic
-transfer).
+Row-group pruning at the IO boundary: conjuncts of the form Col <op>
+Literal, Col IN (literals...), and Col IS [NOT] NULL are translated to
+pyarrow compute expressions and handed to the parquet reader, which skips
+row groups whose min/max/null-count stats can't match (IN-heavy TPC-DS
+filters and NOT NULL guards prune row groups like any comparison). The
+device Filter stays in the plan (pushdown is an IO optimization, not a
+semantic transfer).
 
 This is where the covering index's within-bucket sort order pays off for
 filter queries: index files are sorted by the indexed columns, so row-group
@@ -80,6 +82,14 @@ def _translate(e: E.Expr, schema: Schema, allow_nested: bool):
                 return None
             return f.isin(values)
         return None
+    if isinstance(e, E.IsNull) and isinstance(e.child, E.Col):
+        # Row groups carry null counts: IS NULL prunes all-valid groups,
+        # IS NOT NULL prunes all-null ones (the TPC-DS outer-join-guard
+        # shape). Never yields null itself, so pushing is sound.
+        f = field(e.child.column)
+        if f is None:
+            return None
+        return ~f.is_null() if e.negated else f.is_null()
     if isinstance(e, E.Or):
         l = _translate(e.left, schema, allow_nested)
         r = _translate(e.right, schema, allow_nested)

@@ -1,0 +1,6 @@
+"""Cost-based optimizer layer: table/column statistics, cardinality
+estimation, and join reordering (no reference analogue — the reference
+delegates plan optimization to Spark Catalyst; here the framework is the
+engine)."""
+
+from .constants import OptimizerConstants  # noqa: F401

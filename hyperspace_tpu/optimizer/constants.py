@@ -1,0 +1,45 @@
+"""Config keys for the cost-based optimizer layer (optimizer/).
+
+No reference analogue: the reference delegates all plan optimization to
+Spark Catalyst (SURVEY §1 L1); here the framework IS the engine, so the
+statistics provider and the join-reorder pass get their own
+``hyperspace.tpu.optimizer.*`` conf family, read exclusively through
+config.py accessors (the scripts/lint.py env-read gate applies).
+"""
+
+from __future__ import annotations
+
+
+class OptimizerConstants:
+    # Table/column statistics provider (optimizer/stats.py): lazy parquet
+    # footer harvesting + per-relation cache keyed on the relation's file
+    # (size, mtime, path) signature — source changes invalidate exactly
+    # like the serving result cache's source component.
+    STATS_ENABLED = "hyperspace.tpu.optimizer.stats.enabled"
+    STATS_ENABLED_DEFAULT = "true"
+
+    # Rows sampled (from the first file) for NDV estimation of columns
+    # whose min/max span cannot bound distinctness (strings, floats).
+    # 0 disables sampling: such columns report no NDV at all, and join
+    # estimation then divides by the side's full row count (keys
+    # treated as distinct), shrinking equality/join estimates.
+    STATS_SAMPLE_ROWS = "hyperspace.tpu.optimizer.stats.sampleRows"
+    STATS_SAMPLE_ROWS_DEFAULT = "65536"
+
+    # LRU bound of cached per-relation statistics entries.
+    STATS_CACHE_ENTRIES = "hyperspace.tpu.optimizer.stats.cacheEntries"
+    STATS_CACHE_ENTRIES_DEFAULT = "64"
+
+    # Cost-based join reordering (optimizer/join_order.py): rewrite
+    # inner-equi-join chains to the cheapest estimated linear order
+    # before the hyperspace index rules run. Semantics-preserving (inner
+    # joins only; output column order restored by a trailing Project).
+    JOIN_REORDER_ENABLED = "hyperspace.tpu.optimizer.joinReorder.enabled"
+    JOIN_REORDER_ENABLED_DEFAULT = "false"
+
+    # Chains with at most this many tables are enumerated exhaustively
+    # (left-deep dynamic programming over connected subsets); larger
+    # chains use greedy smallest-intermediate-first.
+    JOIN_REORDER_DP_THRESHOLD = \
+        "hyperspace.tpu.optimizer.joinReorder.dpThreshold"
+    JOIN_REORDER_DP_THRESHOLD_DEFAULT = "8"

@@ -260,8 +260,14 @@ class Project(LogicalPlan):
 
 
 class Join(LogicalPlan):
+    """``reorder_note``: set by the cost-based join reorderer
+    (optimizer/join_order.py) on joins it re-linearized, so explain and
+    golden plans render the rewrite (e.g. "[reordered, est~120 rows]") —
+    the same convention as Scan.skipping_note."""
+
     def __init__(self, left: LogicalPlan, right: LogicalPlan, condition: E.Expr,
-                 join_type: str = "inner"):
+                 join_type: str = "inner",
+                 reorder_note: Optional[str] = None):
         if join_type not in ("inner", "left", "right", "full", "semi",
                              "anti", "cross"):
             raise HyperspaceException(f"Unsupported join type: {join_type}")
@@ -286,6 +292,7 @@ class Join(LogicalPlan):
         self.right = right
         self.condition = condition
         self.join_type = join_type
+        self.reorder_note = reorder_note
         if join_type in ("semi", "anti"):
             # Semi/anti joins emit only the left side's rows (the right
             # side is an existence probe) — the lowering target for SQL
@@ -309,16 +316,18 @@ class Join(LogicalPlan):
         return [self.left, self.right]
 
     def with_children(self, children):
-        return Join(children[0], children[1], self.condition, self.join_type)
+        return Join(children[0], children[1], self.condition, self.join_type,
+                    self.reorder_note)
 
     @property
     def schema(self) -> Schema:
         return self._schema
 
     def simple_string(self) -> str:
+        note = f" [{self.reorder_note}]" if self.reorder_note else ""
         if self.join_type == "cross":
-            return "Join cross"
-        return f"Join {self.join_type} ({self.condition!r})"
+            return "Join cross" + note
+        return f"Join {self.join_type} ({self.condition!r})" + note
 
 
 class Aggregate(LogicalPlan):

@@ -106,6 +106,7 @@ def explain_string(session, plan: LogicalPlan, verbose: bool = False,
     _write_compilation_section(buf, session)
     _write_io_section(buf, session)
     _write_advisor_section(buf, session, with_index)
+    _write_join_order_section(buf, session)
     if verbose:
         buf.write_line()
         _header(buf, "Physical operator stats:")
@@ -238,6 +239,52 @@ def _write_advisor_section(buf: BufferStream, session,
             name = leaf.index_entry.name
             buf.write_line(f"index '{name}' applied "
                            f"{counts.get(name, 0)} time(s) this session")
+
+
+def _write_join_order_section(buf: BufferStream, session) -> None:
+    """Cost-based join-reorder observability (optimizer/join_order.py):
+    the chain records of the diagnostic pass that just ran — chosen
+    order plus per-step estimated rows, paired with actual executed
+    output rows where the executor has recorded them. Rendered only
+    while ``optimizer.joinReorder.enabled`` is true, so the explain
+    goldens of reorder-less sessions are untouched.
+
+    The estimate/actual pairing is BEST-EFFORT: ``_join_actuals`` keys
+    are condition reprs shared session-wide, so if another query (or the
+    same query under a different reorder setting) executed the same
+    condition text over a *different* intermediate, the displayed actual
+    is that execution's row count, not this step's. Re-keying by plan
+    identity would break the pairing whenever the index rules rewrite
+    the join below us (the common case this section exists to explain),
+    which is the worse trade — explain() is diagnostic output, and the
+    bench q-error path reads its actuals immediately after its own
+    execution, where the pairing is exact."""
+    if not session.hs_conf.join_reorder_enabled():
+        return
+    records = session._last_join_order
+    if not records:
+        return
+    actuals = getattr(session, "_join_actuals", {})
+    buf.write_line()
+    _header(buf, "Join order:")
+    for r in records:
+        if r["reordered"]:
+            buf.write_line(
+                f"chain [{', '.join(r['labels'])}] reordered -> "
+                f"[{', '.join(r['order'])}]")
+        else:
+            note = r.get("note", "kept")
+            buf.write_line(
+                f"chain [{', '.join(r['labels'])}] kept ({note})")
+        for b in r["base"]:
+            buf.write_line(
+                f"  {b['label']}: est {b['est_rows']:.0f} rows")
+        for s in r["steps"]:
+            actual = actuals.get(s["key"])
+            actual_str = f"{actual}" if actual is not None else "n/a"
+            buf.write_line(
+                f"  join +{s['right']}: est {s['est_rows']:.0f} rows, "
+                f"actual {actual_str}")
 
 
 def _count_nodes(plan: LogicalPlan):

@@ -73,6 +73,20 @@ class Session:
         self._usage_counts_lock = threading.Lock()
         self._sql_plan_cache: "OrderedDict[Tuple, LogicalPlan]" = OrderedDict()
         self._sql_plan_stats = {"hits": 0, "misses": 0}
+        # Cost-based optimizer state (optimizer/): the lazily-created
+        # statistics provider (optimizer/stats.py attaches it on first
+        # use), the chain records of the most recent join-reorder pass
+        # (explain's "Join order:" section + bench's q-error read them),
+        # and the observed output rows of recently executed inner joins
+        # (executor-recorded; keyed by condition repr, LRU-bounded).
+        self._stats_provider = None
+        self._last_join_order: Optional[list] = None
+        self._join_actuals: "OrderedDict[str, int]" = OrderedDict()
+        # The actuals dict is written by the executor on the
+        # multi-threaded serving path (like _usage_counts, it needs its
+        # own lock: unlocked LRU eviction could evict a key another
+        # thread is about to move_to_end).
+        self._join_actuals_lock = threading.Lock()
         # The memo is on the multi-threaded serving path (like the
         # result cache, which carries its own lock).
         self._sql_plan_lock = threading.Lock()
@@ -215,6 +229,15 @@ class Session:
         if not _pre_normalized:
             plan = push_filters(plan)
             plan = prune_columns(plan)
+        # Cost-based join reordering (optimizer/join_order.py) runs AFTER
+        # normalization (it wants the pushed-down filters for selectivity)
+        # and BEFORE the index rules, so FilterIndexRule/JoinIndexRule and
+        # the advisor's what-if hooks match the reordered tree unchanged.
+        # It is NOT part of serving.fingerprint.normalize: the result-cache
+        # key's conf hash pins the reorder flag instead.
+        if self.hs_conf.join_reorder_enabled():
+            from .optimizer.join_order import reorder_joins
+            plan = reorder_joins(self, plan, diagnostic=diagnostic)
         if self._hyperspace_enabled:
             from .rules.apply_hyperspace import apply_hyperspace
             ctx = None

@@ -196,6 +196,30 @@ class IoWaitEvent(HyperspaceEvent):
 
 
 @dataclass
+class JoinReorderEvent(HyperspaceEvent):
+    """Emitted when the cost-based join reorderer
+    (optimizer/join_order.py) re-linearizes an inner-equi-join chain:
+    ``tables`` in the original (text) order, ``order`` as chosen, and
+    the per-step estimated intermediate cardinalities. Diagnostic
+    passes (explain) are silent."""
+
+    tables: List[str] = field(default_factory=list)
+    order: List[str] = field(default_factory=list)
+    estimated_rows: List[float] = field(default_factory=list)
+
+
+@dataclass
+class CardinalityEstimateEvent(HyperspaceEvent):
+    """One cardinality estimate the reorderer committed to (per join
+    step of a reordered chain). ``subject`` is the join condition repr —
+    the same key the executor records actual inner-join output rows
+    under, so estimate and observation can be paired for q-error."""
+
+    subject: str = ""
+    estimated_rows: float = 0.0
+
+
+@dataclass
 class IndexCacheProbeEvent(HyperspaceEvent):
     """Base of the HBM index-table-cache probe events: the executor emits
     one per IndexScan cache lookup (execution/index_cache.py counts were

@@ -152,6 +152,19 @@ def thread_sites(tree: ast.AST) -> list:
     return sorted(set(out))
 
 
+# Telemetry-coverage discipline: every event class defined in
+# telemetry/events.py must be referenced somewhere under tests/ — an
+# event no test ever observes is unverified observability (the
+# IndexTableCache counters were counted-but-unreported for three rounds
+# before r06 made them visible; this gate would have caught it).
+EVENTS_FILE = "hyperspace_tpu/telemetry/events.py"
+
+
+def event_class_names(tree: ast.AST) -> list:
+    return sorted(node.name for node in ast.walk(tree)
+                  if isinstance(node, ast.ClassDef))
+
+
 # Doc-drift discipline: every `hyperspace.tpu.*` config key the package
 # defines must be documented in docs/configuration.md — a key literal
 # that exists only in code is an undocumented knob. Full-string match
@@ -190,15 +203,21 @@ def main() -> int:
     problems = []
     with open(os.path.join(ROOT, CONFIG_DOC), encoding="utf-8") as f:
         config_doc_text = f.read()
+    event_classes: list = []
+    tests_text_parts: list = []
     for path in iter_sources():
         rel = os.path.relpath(path, ROOT)
         with open(path, encoding="utf-8") as f:
             text = f.read()
+        if rel.startswith("tests" + os.sep):
+            tests_text_parts.append(text)
         try:
             tree = ast.parse(text, filename=rel)
         except SyntaxError as e:
             problems.append(f"{rel}:{e.lineno}: syntax error: {e.msg}")
             continue
+        if rel.replace(os.sep, "/") == EVENTS_FILE:
+            event_classes = event_class_names(tree)
         for i, line in enumerate(text.splitlines(), 1):
             if "\t" in line:
                 problems.append(f"{rel}:{i}: tab character")
@@ -237,6 +256,12 @@ def main() -> int:
                     "parallel/io.py; route the work through its "
                     "map_ordered/prefetch_iter so the in-flight byte "
                     "budget and ordered-gather contract hold")
+    tests_text = "\n".join(tests_text_parts)
+    for name in event_classes:
+        if name not in tests_text:
+            problems.append(
+                f"{EVENTS_FILE}: event class '{name}' is never referenced "
+                "under tests/; add a test observing (or at least naming) it")
     for p in problems:
         print(p)
     print(f"lint: {len(problems)} problem(s) across "

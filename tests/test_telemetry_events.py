@@ -285,3 +285,56 @@ class TestIoEvents:
         assert "I/O:" in text
         assert "reader pool: on" in text
         assert "time split:" in text
+
+
+class TestEventTaxonomy:
+    """The event-class hierarchy is load-bearing: sinks filter on the
+    shared bases (one isinstance check per family), so every concrete
+    event must sit under its family base. This also keeps the lint
+    telemetry-coverage gate honest for the abstract bases and for
+    events only error paths emit (CancelActionEvent)."""
+
+    def test_crud_events_share_the_crud_base(self):
+        from hyperspace_tpu.telemetry import events as ev
+        for cls in (ev.CreateActionEvent, ev.DeleteActionEvent,
+                    ev.RestoreActionEvent, ev.VacuumActionEvent,
+                    ev.CancelActionEvent, ev.RefreshActionEvent,
+                    ev.RefreshIncrementalActionEvent,
+                    ev.RefreshQuickActionEvent, ev.OptimizeActionEvent):
+            assert issubclass(cls, ev.HyperspaceIndexCRUDEvent)
+            assert issubclass(cls, ev.HyperspaceEvent)
+
+    def test_cache_events_share_their_probe_bases(self):
+        from hyperspace_tpu.telemetry import events as ev
+        for cls in (ev.ResultCacheHitEvent, ev.ResultCacheMissEvent,
+                    ev.ResultCacheAdmitEvent, ev.ResultCacheEvictionEvent):
+            assert issubclass(cls, ev.ResultCacheEvent)
+        for cls in (ev.IndexCacheHitEvent, ev.IndexCacheMissEvent):
+            assert issubclass(cls, ev.IndexCacheProbeEvent)
+
+    def test_cancel_event_emitted_by_cancel_action(self, env):
+        """cancel() on a wedged (transient-state) index emits
+        CancelActionEvent start+success like every other lifecycle
+        action."""
+        import copy
+        import os
+
+        from hyperspace_tpu.index.constants import States
+        from hyperspace_tpu.index.log_manager import IndexLogManager
+
+        hs, session = env["hs"], env["session"]
+        # This image's jax lacks shard_map; the distributed build path
+        # would fail environmentally (all new tests pin it off).
+        session.conf.set(IndexConstants.TPU_DISTRIBUTED_ENABLED, "false")
+        df = session.read.parquet(env["path"])
+        hs.create_index(df, IndexConfig("cxIdx", ["k"], ["v"]))
+        # Simulate a crash mid-refresh so cancel is legal.
+        lm = IndexLogManager(os.path.join(
+            session.hs_conf.system_path(), "cxIdx"))
+        wedged = copy.deepcopy(lm.get_latest_log())
+        wedged.state = States.REFRESHING
+        assert lm.write_log(lm.get_latest_id() + 1, wedged)
+        mark = len(sink().events)
+        hs.cancel("cxIdx")
+        evs, _ = take_new(mark)
+        assert names_of(evs).count("CancelActionEvent") == 2
