@@ -397,6 +397,26 @@ def build_q3(session, li_dir: str, od_dir: str):
             .limit(10))
 
 
+def build_q3_variant(session, li_dir: str, od_dir: str, shift_days: int):
+    """Literal variant of q3 (cutoff shifted by ``shift_days``): the
+    serving phase's batch-collapse input — same canonical template, only
+    the Filter literals differ."""
+    from hyperspace_tpu.plan.expr import col, sum_
+
+    li = session.read.parquet(li_dir)
+    od = session.read.parquet(od_dir)
+    cutoff = datetime.date(1995, 3, 15) + datetime.timedelta(
+        days=shift_days)
+    return (li.filter(col("l_shipdate") > cutoff)
+            .join(od.filter(col("o_orderdate") < cutoff),
+                  on=col("l_orderkey") == col("o_orderkey"))
+            .group_by("l_orderkey", "o_orderdate", "o_shippriority")
+            .agg(sum_(col("l_extendedprice") * (1 - col("l_discount")))
+                 .alias("revenue"))
+            .sort(("revenue", False), "o_orderdate")
+            .limit(10))
+
+
 def build_q17(session, li_dir: str, pt_dir: str):
     """TPC-H Q17 shape (small-quantity-order revenue): the correlated avg
     subquery becomes a group-by + rejoin in the DataFrame IR."""
@@ -1126,6 +1146,114 @@ def _single_device_phases(args, root):
                 RESULT["errors"].append(
                     "advisor produced no recommendations from the "
                     "captured workload")
+
+    # ---- serving: multi-session frontend under a mixed client mix ----
+    # Sustained QPS + p50/p99 latency for a mixed TPC-H workload issued
+    # by TWO independent sessions — serving frontend (shared program
+    # bank / concurrent workers) vs the same queries run in session
+    # isolation — plus the literal-batch collapse (N q3 literal variants
+    # -> 1 batched invocation). Runs BEFORE the hybrid appends so the
+    # batch templates and any cache keys see stable sources.
+    if not _backend_dead():
+        with _phase("serving"):
+            from hyperspace_tpu.serving.constants import \
+                ServingConstants as _SC
+            from hyperspace_tpu.serving.frontend import ServingFrontend
+
+            def _client_session():
+                s = hst.Session(system_path=os.path.join(root, "indexes"))
+                s.conf.set(IndexConstants.INDEX_NUM_BUCKETS, 32)
+                return s
+
+            mix_sessions = [_client_session() for _ in range(2)]
+
+            def _build_mix(s):
+                return [build_filter_query(s, li_dir),
+                        build_q3(s, li_dir, od_dir),
+                        build_skipping_query(s, od_dir)]
+
+            mixes = [_build_mix(s) for s in mix_sessions]
+            rounds = max(args.repeats, 2)
+            for q in mixes[0]:
+                q.to_arrow()  # warm the shared compiled programs once
+
+            # Baseline: sessions in isolation, strictly serial.
+            lat_iso = []
+            t0 = time.perf_counter()
+            for _ in range(rounds):
+                for mix in mixes:
+                    for q in mix:
+                        tq = time.perf_counter()
+                        q.to_arrow()
+                        lat_iso.append(time.perf_counter() - tq)
+            iso_s = time.perf_counter() - t0
+
+            def _pct(lats, frac):
+                lats = sorted(lats)
+                return lats[min(int(len(lats) * frac), len(lats) - 1)]
+
+            RESULT["serving_isolation_qps"] = round(len(lat_iso) / iso_s, 2)
+            RESULT["serving_isolation_p50_ms"] = round(
+                _pct(lat_iso, 0.5) * 1000, 2)
+            RESULT["serving_isolation_p99_ms"] = round(
+                _pct(lat_iso, 0.99) * 1000, 2)
+
+            # Serving tier: same mix, all queries submitted up front.
+            gov = mix_sessions[0]
+            gov.conf.set(_SC.SERVING_MAX_CONCURRENCY, "2")
+            gov.conf.set(_SC.SERVING_BATCHING_ENABLED, "false")
+            fe = ServingFrontend(gov)
+            t0 = time.perf_counter()
+            pend = []
+            for _ in range(rounds):
+                for mix in mixes:
+                    pend.extend(fe.submit(q) for q in mix)
+            for p in pend:
+                p.result(timeout=600)
+            serve_s = time.perf_counter() - t0
+            lat_srv = [p.latency_s for p in pend]
+            RESULT["serving_qps"] = round(len(pend) / serve_s, 2)
+            RESULT["serving_p50_ms"] = round(_pct(lat_srv, 0.5) * 1000, 2)
+            RESULT["serving_p99_ms"] = round(_pct(lat_srv, 0.99) * 1000, 2)
+            RESULT["serving_qps_vs_isolation"] = round(
+                RESULT["serving_qps"] / RESULT["serving_isolation_qps"]
+                if RESULT["serving_isolation_qps"] else float("inf"), 3)
+
+            # Literal-batch collapse: 8 q3 literal variants -> how many
+            # batched invocations (1 = full collapse).
+            gov.conf.set(_SC.SERVING_BATCHING_ENABLED, "true")
+            gov.conf.set(_SC.SERVING_BATCHING_WINDOW, "0.3")
+            gov.conf.set(_SC.SERVING_MAX_CONCURRENCY, "1")
+            variants = [build_q3_variant(gov, li_dir, od_dir, i)
+                        for i in range(8)]
+            serial = [v.to_pandas() for v in variants]
+            before = fe.stats()
+            vpend = [fe.submit(v) for v in variants]
+            vres = [p.result(timeout=600).to_pandas() for p in vpend]
+            after = fe.stats()
+            identical = all(a.round(6).equals(b.round(6))
+                            for a, b in zip(serial, vres))
+            RESULT["serving_batch_identical"] = bool(identical)
+            if not identical:
+                RESULT["errors"].append(
+                    "serving: batched literal-variant answers differ "
+                    "from serial")
+            # Collapse = members per executed batch (8.0 = the full
+            # N->1 collapse). One batch runs one vmapped invocation PER
+            # swept Filter position (q3 has two: l_shipdate, o_orderdate)
+            # — reported separately.
+            batches = max(after["batches"] - before["batches"], 1)
+            RESULT["serving_batch_members"] = (
+                after["batched_queries"] - before["batched_queries"])
+            RESULT["serving_batch_collapse"] = round(
+                RESULT["serving_batch_members"] / batches, 2)
+            RESULT["serving_batch_sweep_invocations"] = (
+                after["sweep_invocations"] - before["sweep_invocations"])
+            RESULT["serving_shared_scan_hits"] = (
+                after["shared_scan_hits"] - before["shared_scan_hits"])
+            bank = fe.stats()["program_bank"]
+            RESULT["serving_program_bank_hits"] = bank["hits"]
+            RESULT["serving_program_bank_programs"] = bank["programs"]
 
     # ---- BASELINE config #5: Hybrid Scan over appended source files ----
     # Runs LAST: the appends invalidate plain signatures, so every other

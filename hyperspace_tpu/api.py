@@ -211,6 +211,30 @@ class Hyperspace:
         from .parallel import io as pio
         return pio.pool_stats()
 
+    def serving_frontend(self):
+        """The process-default concurrent serving frontend
+        (serving/frontend.py), created on first use with this session as
+        its governing session. Requires
+        ``hyperspace.tpu.serving.enabled=true``."""
+        from .serving.frontend import get_frontend
+        return get_frontend(self.session)
+
+    def serving_stats(self) -> dict:
+        """Serving-tier observability in one dict: the process-default
+        frontend's admission/batching counters (None before any frontend
+        exists), the cross-session shared result cache, and the
+        process-wide compiled-program bank."""
+        from .serving import frontend as fe
+        from .serving.program_bank import get_bank
+        front = fe._DEFAULT
+        if front is not None:
+            out = front.stats()
+            out["frontend"] = True
+        else:
+            out = {"frontend": None,
+                   "program_bank": get_bank().stats()}
+        return out
+
     def clear_result_cache(self) -> None:
         """Drop every cached result (both tiers) and the SQL plan memo.
         Never needed for correctness — invalidation is by key

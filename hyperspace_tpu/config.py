@@ -50,7 +50,10 @@ class Conf:
 class CacheWithTransform(Generic[T]):
     """Caches ``transform(raw)`` and re-derives when the raw conf string changes.
 
-    Parity: util/CacheWithTransform.scala:1-45.
+    Parity: util/CacheWithTransform.scala:1-45. Thread-safe: holders are
+    probed on every execute() of the multi-threaded serving path, and an
+    unlocked check-then-transform could build two instances and tear a
+    (raw, value) pair.
     """
 
     def __init__(self, load_func: Callable[[], str], transform: Callable[[str], T]):
@@ -58,13 +61,16 @@ class CacheWithTransform(Generic[T]):
         self._transform = transform
         self._cached_raw: Optional[str] = None
         self._cached_value: Optional[T] = None
+        import threading
+        self._lock = threading.Lock()
 
     def load(self) -> T:
         raw = self._load_func()
-        if self._cached_raw is None or raw != self._cached_raw:
-            self._cached_raw = raw
-            self._cached_value = self._transform(raw)
-        return self._cached_value  # type: ignore[return-value]
+        with self._lock:
+            if self._cached_raw is None or raw != self._cached_raw:
+                self._cached_value = self._transform(raw)
+                self._cached_raw = raw
+            return self._cached_value  # type: ignore[return-value]
 
 
 class HyperspaceConf:
@@ -314,6 +320,45 @@ class HyperspaceConf:
             str(self.result_cache_device_bytes()),
             str(self.result_cache_host_bytes()),
         ])
+
+    # ------------------------------------------------------------------
+    # Concurrent serving frontend (serving/frontend.py).
+    # ------------------------------------------------------------------
+
+    def serving_enabled(self) -> bool:
+        return self._get_bool(
+            ServingConstants.SERVING_ENABLED,
+            ServingConstants.SERVING_ENABLED_DEFAULT)
+
+    def serving_max_concurrency(self) -> int:
+        return max(int(self._conf.get(
+            ServingConstants.SERVING_MAX_CONCURRENCY,
+            ServingConstants.SERVING_MAX_CONCURRENCY_DEFAULT)), 1)
+
+    def serving_queue_depth(self) -> int:
+        return max(int(self._conf.get(
+            ServingConstants.SERVING_QUEUE_DEPTH,
+            ServingConstants.SERVING_QUEUE_DEPTH_DEFAULT)), 1)
+
+    def serving_admission_max_bytes(self) -> int:
+        return max(int(self._conf.get(
+            ServingConstants.SERVING_ADMISSION_MAX_BYTES,
+            ServingConstants.SERVING_ADMISSION_MAX_BYTES_DEFAULT)), 1)
+
+    def serving_batching_enabled(self) -> bool:
+        return self._get_bool(
+            ServingConstants.SERVING_BATCHING_ENABLED,
+            ServingConstants.SERVING_BATCHING_ENABLED_DEFAULT)
+
+    def serving_batching_window(self) -> float:
+        return max(float(self._conf.get(
+            ServingConstants.SERVING_BATCHING_WINDOW,
+            ServingConstants.SERVING_BATCHING_WINDOW_DEFAULT)), 0.0)
+
+    def serving_batching_max_batch(self) -> int:
+        return max(int(self._conf.get(
+            ServingConstants.SERVING_BATCHING_MAX_BATCH,
+            ServingConstants.SERVING_BATCHING_MAX_BATCH_DEFAULT)), 1)
 
     # ------------------------------------------------------------------
     # Advisor (advisor/constants.py): workload capture + recommendation.

@@ -10,3 +10,10 @@ class HyperspaceException(Exception):
 class NoChangesException(HyperspaceException):
     """Raised by actions when there is nothing to do; aborts the transaction
     as a no-op (reference: actions/Action.scala NoChangesException handling)."""
+
+
+class ServingRejectedError(HyperspaceException):
+    """Raised by ServingFrontend.submit when admission control refuses a
+    query (queue at ``serving.queueDepth`` or in-flight input bytes past
+    ``serving.admission.maxBytes``). Back off and resubmit — rejection is
+    load shedding, not failure of the query itself."""

@@ -16,7 +16,7 @@ import numpy as np
 
 from ..exceptions import HyperspaceException
 from ..plan import expr as E
-from ..schema import BOOL, DATE, FLOAT64, INT64, STRING
+from ..schema import BOOL, DATE, FLOAT32, FLOAT64, INT64, STRING
 from .columnar import (Column, Table, dictionaries_equal, literal_to_device,
                        translate_codes)
 
@@ -287,6 +287,55 @@ def eval_expr_maybe_fused(table: Table, e: E.Expr) -> Column:
     return fused if fused is not None else eval_expr(table, e)
 
 
+def predicate_slots(table: Table, condition: E.Expr):
+    """(structure spec, encoded literal slot values) for a fusable
+    predicate against ``table``, or None. The literal-batching sweep
+    (serving/batcher.py) uses this to encode EVERY batch member's
+    literals against the shared table with the exact semantics of the
+    single-query path below."""
+    names = sorted(set(condition.references))
+    if not names:
+        return None
+    col_ix = {nm: i for i, nm in enumerate(names)}
+    lits: list = []
+    try:
+        return _pred_structure(table, condition, col_ix, lits), lits
+    except (_NotFusable, KeyError):
+        return None
+
+
+def predicate_slot_dtypes(spec, col_dtypes, n_slots):
+    """Per-slot numpy dtype for a STACKED literal matrix (the serving
+    literal sweep) such that comparisons reproduce the single-query
+    path's weak-scalar promotion. There a python float literal is a
+    weak-typed jit scalar that casts DOWN to a float32 column, while a
+    strong float64 matrix would promote the COLUMN and flip comparisons
+    near the f32 rounding boundary. None = numpy's default encoding is
+    already value-preserving (ints/bools/float64/string bounds)."""
+    out = [None] * n_slots
+    _mark_slot_dtypes(spec, col_dtypes, out)
+    return out
+
+
+def _mark_slot_dtypes(spec, col_dtypes, out) -> None:
+    tag = spec[0]
+    if tag in ("and", "or"):
+        _mark_slot_dtypes(spec[1], col_dtypes, out)
+        _mark_slot_dtypes(spec[2], col_dtypes, out)
+    elif tag == "not":
+        _mark_slot_dtypes(spec[1], col_dtypes, out)
+    elif tag == "cmp":
+        _mark_one_slot(spec[3], col_dtypes[spec[2]], out)
+    elif tag == "in":
+        for slot in spec[2]:
+            _mark_one_slot(slot, col_dtypes[spec[1]], out)
+
+
+def _mark_one_slot(slot, col_dtype, out) -> None:
+    if slot[0] == "lit" and col_dtype == FLOAT32:
+        out[slot[1]] = np.float32
+
+
 def eval_predicate_mask_counted(table: Table, condition: E.Expr):
     """Fused filter front-end: (pad-masked keep mask, survivor count) from
     ONE compiled program per predicate structure, or None when the
@@ -320,6 +369,17 @@ def eval_predicate_mask_counted(table: Table, condition: E.Expr):
         return mask, jnp.sum(mask)
 
     cols = tuple((c.data, c.validity) for c in col_objs)
+    # Cross-query literal sweep (serving/batcher.py): when this filter
+    # position belongs to an active batch over a shared table, ONE
+    # vmapped invocation computes every member's mask; this member's row
+    # comes out of the memo.
+    from ..serving import batcher
+    sweep = batcher.active_sweep()
+    if sweep is not None:
+        swept = sweep.try_masked_count(table, condition, key, builder,
+                                       cols)
+        if swept is not None:
+            return swept
     mask, cnt = kernels.run_fused_predicate(key, builder, cols,
                                             tuple(lits), table.num_rows)
     return mask, int(cnt)  # HOST SYNC (single scalar)

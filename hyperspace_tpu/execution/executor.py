@@ -93,14 +93,49 @@ def _emit_compile_event(session, count: int, seconds: float) -> None:
             total=shapes.compile_count()))
 
 
+def _shared_scan_key(plan: Scan, needed: Optional[Set[str]]):
+    """Batch-sweep scan-sharing key: the full relation detail plus the
+    column set about to be read (fingerprint._node_detail pins format,
+    paths and options)."""
+    from ..serving.fingerprint import _node_detail
+    return (_node_detail(plan),
+            tuple(sorted(needed)) if needed is not None else None)
+
+
 def _execute(plan: LogicalPlan, needed: Optional[Set[str]]) -> Table:
     if isinstance(plan, Scan):
+        from ..serving import batcher
+        sweep = batcher.active_sweep()
+        if sweep is not None:
+            # Literal-sweep batch: every member reads the same sources —
+            # the first member's table is reused by the rest.
+            return sweep.shared_scan(
+                _shared_scan_key(plan, needed),
+                lambda: _execute_scan(plan, needed))
         return _execute_scan(plan, needed)
     if isinstance(plan, IndexScan):
         return _execute_index_scan(plan, needed)
     if isinstance(plan, Filter):
         child_needed = None if needed is None else \
             needed | set(plan.condition.references)
+        if isinstance(plan.child, Scan):
+            from ..serving import batcher
+            sweep = batcher.active_sweep()
+            if sweep is not None:
+                # Under a sweep, row-group pushdown would prune
+                # DIFFERENT row groups per member's literals; reading
+                # the unpruned superset once is byte-identical (the full
+                # predicate re-applies on device) and shares one table
+                # across the batch. Sources past the chunk budget keep
+                # the per-member streamed path (too big to pin).
+                chunked = _chunked_filtered_scan(
+                    plan.child, child_needed, plan.condition, None)
+                if chunked is not None:
+                    return chunked
+                table = sweep.shared_scan(
+                    _shared_scan_key(plan.child, child_needed),
+                    lambda: _execute_scan(plan.child, child_needed))
+                return _filter_table(table, plan.condition)
         if isinstance(plan.child, (Scan, IndexScan)):
             # Push row-group-prunable conjuncts into the parquet read. A
             # source scan's struct leaves aren't physical columns, so dotted
@@ -211,19 +246,18 @@ def _record_join_actual(plan: Join, table: Table) -> None:
     vs actual rows (q-error) for the cost-based reorderer's steps."""
     if plan.join_type != "inner" or plan.condition is None:
         return
+    from ..serving import context as qctx
+    ctx = qctx.active_context()
+    if ctx is not None:
+        # Serving path: the QueryContext routes the write to its owning
+        # session's locked store.
+        ctx.record_join_actual(repr(plan.condition), int(table.num_rows))
+        return
     session = _SESSION.get()
     if session is None:
         return
-    actuals = getattr(session, "_join_actuals", None)
-    lock = getattr(session, "_join_actuals_lock", None)
-    if actuals is None or lock is None:
-        return
-    key = repr(plan.condition)
-    with lock:  # serving threads share the session (LRU eviction races)
-        actuals[key] = int(table.num_rows)
-        actuals.move_to_end(key)
-        while len(actuals) > 256:
-            actuals.popitem(last=False)
+    qctx.record_join_actual(session, repr(plan.condition),
+                            int(table.num_rows))
 
 
 def _filter_table(table: Table, condition) -> Table:

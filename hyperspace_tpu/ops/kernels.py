@@ -32,7 +32,6 @@ programs, where shapes are already static) bypass padding entirely.
 from __future__ import annotations
 
 import zlib
-from collections import OrderedDict
 from functools import partial
 from typing import Optional, Sequence, Tuple
 
@@ -401,27 +400,48 @@ def bucket_composite_keys(keys: jax.Array, dtype: str, num_buckets: int,
 # scalar arguments, so a serving workload sweeping literals reuses one
 # program. The builder also folds in the pad-tail mask and the survivor
 # count, replacing the per-op compare/kleene/mask/count chain with a
-# single program per (structure, class).
-_PREDICATE_PROGRAMS: "OrderedDict" = OrderedDict()
-_PREDICATE_PROGRAMS_MAX = 1024
+# single program per (structure, class). The wrappers live in the
+# process-wide PROGRAM BANK (serving/program_bank.py — the serving
+# tier's explicit, size-bounded, instrumented registry; one session's
+# warm-up pays every session's compiles); the jax.jit call stays HERE,
+# in the lint-sanctioned instrumented module.
+
+
+def _col_shape_vector(col_arrays) -> tuple:
+    """Shape-class vector of a fused stage's inputs (the bank's hit/miss
+    accounting unit; jax re-keys executables under the wrapper)."""
+    return tuple(int(d.shape[0]) for d, _v in col_arrays)
 
 
 def run_fused_predicate(key, builder, col_arrays, lit_args, n):
     """Run (compiling once per structure key x input signature) the fused
     predicate ``builder(col_arrays, lit_args, n) -> (mask, count)``.
     ``builder`` must be a pure function fully determined by ``key``.
-    Bounded as an LRU: overflowing evicts the single coldest structure
-    (dropping its jit wrapper and compiled executables), never the whole
-    map — a clear() here would re-trace every hot predicate at once, the
-    recompilation storm this layer exists to prevent."""
-    jitted = _PREDICATE_PROGRAMS.get(key)
-    if jitted is None:
-        while len(_PREDICATE_PROGRAMS) >= _PREDICATE_PROGRAMS_MAX:
-            _PREDICATE_PROGRAMS.popitem(last=False)
-        jitted = _PREDICATE_PROGRAMS[key] = jax.jit(builder)
-    else:
-        _PREDICATE_PROGRAMS.move_to_end(key)
+    The bank is a bounded LRU over stages: overflowing evicts the single
+    coldest structure (dropping its jit wrapper and compiled
+    executables), never the whole map — a clear() would re-trace every
+    hot predicate at once, the recompilation storm this layer exists to
+    prevent."""
+    from ..serving.program_bank import get_bank
+    jitted = get_bank().lookup(("fused-predicate", key),
+                               _col_shape_vector(col_arrays),
+                               lambda: jax.jit(builder))
     return jitted(col_arrays, lit_args, n)
+
+
+def run_fused_predicate_sweep(key, builder, col_arrays, lit_matrix, n,
+                              batch: int):
+    """Cross-query literal sweep: ONE invocation evaluating ``batch``
+    literal vectors against the same columns — ``builder`` vmapped over
+    the stacked literal axis. Returns (masks[batch, rows],
+    counts[batch]). The stage key extends the single-query key with the
+    batch class, so sweeps and singles never collide in the bank."""
+    from ..serving.program_bank import get_bank
+    jitted = get_bank().lookup(
+        ("fused-predicate-sweep", key, batch),
+        _col_shape_vector(col_arrays) + (batch,),
+        lambda: jax.jit(jax.vmap(builder, in_axes=(None, 0, None))))
+    return jitted(col_arrays, lit_matrix, n)
 
 
 def nonzero_pad_indices(mask, size: int):

@@ -145,6 +145,50 @@ def _executor(n: int) -> ThreadPoolExecutor:
 
 
 # ---------------------------------------------------------------------------
+# Serving-worker pool (serving/frontend.py drain loops). DISTINCT from the
+# reader pool on purpose: a serving worker executes whole queries and must
+# be able to fan its reads out underneath (reader-pool workers run nested
+# fan-outs sequentially — in_worker()), and a long-running query must not
+# occupy a reader slot. Lives here because this module is the lint-
+# sanctioned home of every thread construction in the package.
+# ---------------------------------------------------------------------------
+
+_serving_lock = threading.Lock()
+_serving_pool: Optional[ThreadPoolExecutor] = None
+_serving_pool_size = 0
+
+
+def submit_serving(fn: Callable, threads: int = 4):
+    """Run ``fn()`` on the serving-worker pool (grown — never shrunk —
+    to ``threads``). Returns the Future. Workers are NOT flagged as
+    reader-pool workers, so reads issued inside ``fn`` still
+    parallelize."""
+    global _serving_pool, _serving_pool_size
+    n = max(int(threads), 1)
+    with _serving_lock:
+        if _serving_pool is None or _serving_pool_size < n:
+            old = _serving_pool
+            _serving_pool = ThreadPoolExecutor(
+                max_workers=n, thread_name_prefix="hst-serve")
+            _serving_pool_size = n
+            if old is not None:
+                old.shutdown(wait=False)
+        pool = _serving_pool
+    while True:
+        try:
+            return pool.submit(fn)
+        except RuntimeError:
+            # Pool replaced by a concurrent grow: resubmit on the new one.
+            # The SAME pool refusing means it was shut down without
+            # replacement (interpreter teardown) — propagate rather than
+            # spinning on a dead pool forever.
+            with _serving_lock:
+                if _serving_pool is pool:
+                    raise
+                pool = _serving_pool
+
+
+# ---------------------------------------------------------------------------
 # Stats (process-wide; explain's "I/O:" section and Hyperspace.io_stats).
 # ---------------------------------------------------------------------------
 
@@ -164,6 +208,13 @@ def _note(**deltas) -> None:
     with _stats_lock:
         for k, v in deltas.items():
             _STATS[k] += v
+    # Per-query attribution: the serving tier's QueryContext (if one is
+    # active on this thread/context) gets the same deltas, so io_stats
+    # can be charged to the query that caused the reads.
+    from ..serving.context import active_context
+    ctx = active_context()
+    if ctx is not None:
+        ctx.note_io(**deltas)
 
 
 def pool_stats() -> dict:
@@ -245,6 +296,15 @@ def imap_ordered(fn: Callable, items: Iterable, *,
     p = params if params is not None else active_params()
     n = p.resolved_threads()
     if not p.enabled or n <= 1 or len(items) <= 1 or in_worker():
+        # Sequential path: process-wide pool counters deliberately stay
+        # untouched (they count POOLED work), but the serving tier's
+        # per-query attribution still wants these reads charged.
+        from ..serving.context import active_context
+        ctx = active_context()
+        if ctx is not None and items:
+            w = sum(int(weight(it)) for it in items) \
+                if weight is not None else 0
+            ctx.note_io(read_tasks=len(items), read_bytes=w)
         for it in items:
             yield fn(it)
         return

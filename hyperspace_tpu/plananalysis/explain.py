@@ -105,6 +105,7 @@ def explain_string(session, plan: LogicalPlan, verbose: bool = False,
     _write_cache_section(buf, session, plan)
     _write_compilation_section(buf, session)
     _write_io_section(buf, session)
+    _write_serving_section(buf, session)
     _write_advisor_section(buf, session, with_index)
     _write_join_order_section(buf, session)
     if verbose:
@@ -214,6 +215,46 @@ def _write_io_section(buf: BufferStream, session) -> None:
         f"time split: read+decode={s['read_seconds']:.2f}s "
         f"consumer wait={s['wait_seconds']:.2f}s "
         f"(~{overlap:.2f}s of read hidden behind compute)")
+
+
+def _write_serving_section(buf: BufferStream, session) -> None:
+    """Serving-tier observability (serving/frontend.py + program_bank):
+    frontend admission/batching counters and the process-wide compiled-
+    program bank. Rendered only when the serving tier is enabled on this
+    session or a frontend has actually processed queries, so explain
+    goldens of serving-less sessions are untouched."""
+    from ..serving import frontend as fe
+    from ..serving.program_bank import get_bank
+    front = fe._DEFAULT
+    enabled = session.hs_conf.serving_enabled()
+    fstats = front.stats() if front is not None else None
+    if not enabled and (fstats is None or fstats["submitted"] == 0):
+        return
+    buf.write_line()
+    _header(buf, "Serving:")
+    conf = session.hs_conf
+    buf.write_line(
+        f"frontend: {'on' if enabled else 'off'} "
+        f"(maxConcurrency={conf.serving_max_concurrency()} "
+        f"queueDepth={conf.serving_queue_depth()} "
+        f"admission.maxBytes={conf.serving_admission_max_bytes()} "
+        f"batching={'on' if conf.serving_batching_enabled() else 'off'})")
+    if fstats is not None:
+        s = fstats
+        buf.write_line(
+            f"queries: submitted={s['submitted']} admitted={s['admitted']} "
+            f"rejected={s['rejected']} completed={s['completed']} "
+            f"failed={s['failed']}")
+        buf.write_line(
+            f"batching: batches={s['batches']} "
+            f"batched_queries={s['batched_queries']} "
+            f"sweep_invocations={s['sweep_invocations']} "
+            f"shared_scans={s['shared_scans']}")
+    b = get_bank().stats()
+    buf.write_line(
+        f"program bank: stages={b['stages']} programs={b['programs']} "
+        f"hits={b['hits']} misses={b['misses']} "
+        f"evictions={b['stage_evictions']}")
 
 
 def _write_advisor_section(buf: BufferStream, session,

@@ -1,11 +1,20 @@
 """Serving layer: high-QPS query-path infrastructure.
 
-First subsystem: the plan-signature-keyed result cache with log-version
-invalidation (result_cache.py, fingerprint.py), plus the SQL plan memo
-wired into Session.sql. Knobs: ``serving.result_cache.*`` (constants.py,
-read through config.py accessors only).
+Subsystems: the plan-signature-keyed result cache with log-version
+invalidation (result_cache.py, fingerprint.py) plus the SQL plan memo
+wired into Session.sql; and the concurrent serving tier — explicit
+per-query contexts (context.py), the process-wide compiled-program bank
+(program_bank.py), cross-query literal batching (batcher.py), and the
+multi-session frontend with admission control (frontend.py). Knobs:
+``serving.result_cache.*`` and ``hyperspace.tpu.serving.*``
+(constants.py, read through config.py accessors only).
+
+ServingFrontend/QueryContext are imported lazily by callers (frontend
+pulls in the execution stack; ``import hyperspace_tpu`` must stay
+light).
 """
 
 from .constants import ServingConstants  # noqa: F401
+from .context import QueryContext  # noqa: F401
 from .fingerprint import ResultCacheKey, compute_key  # noqa: F401
 from .result_cache import ResultCache, build_result_cache  # noqa: F401

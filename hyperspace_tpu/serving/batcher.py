@@ -1,0 +1,337 @@
+"""Cross-query literal batching: N literal-variant queries, one invocation.
+
+The serving observation (ROADMAP item 1, the Flare idiom): a high-QPS
+workload is dominated by *literal sweeps* — many users issuing the same
+query shape with different constants (dates, keys, thresholds). r07
+already compiles such variants to ONE program with literals as runtime
+arguments; what still costs N× is everything around the program: N scans
+of the same source and N separate mask evaluations. This module
+collapses both:
+
+1. **Template matching** (:func:`plan_template`): a literal-abstracted
+   serialization of the canonical (normalized) plan. Two plans batch
+   together iff their templates are byte-identical — same operators,
+   same columns, same expression structure — and only Filter-condition
+   literals differ. Anything the serializer does not fully understand
+   keeps its concrete repr, so differing unsupported shapes simply never
+   match (conservative by construction).
+
+2. **SweepContext**: installed around the members' executions by the
+   serving frontend. It memoizes
+   - *shared scans* — the first member's source read is reused by every
+     other member (row-group pushdown is disabled under a sweep: the
+     full predicate re-applies on device, so reading the superset is
+     byte-identical, and one shared table beats N pruned reads);
+   - *stacked masks* — the first member to reach a swept Filter
+     evaluates ALL members' predicates in ONE vmapped fused-predicate
+     invocation (literal matrix padded to a power-of-two batch class so
+     batch sizes share programs); later members index their row out of
+     the memo. This is the "N queries → 1 padded batched invocation".
+
+Per-member results stay byte-identical to serial execution: each member
+keeps its own survivor count, its own downstream pipeline, and its own
+result-cache key. Unsupported positions (non-fusable predicates,
+IndexScan children, chunked-scan sources) silently fall back to normal
+per-member execution inside the same batch.
+
+No jax at module import time (config.py loads the serving package); the
+vmapped program itself is built in ops/kernels.py (the lint-sanctioned
+jit site) through the program bank.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import threading
+from typing import Callable, List, Optional, Tuple
+
+from ..plan import expr as E
+from ..plan.nodes import Filter, LogicalPlan
+
+_SWEEP: contextvars.ContextVar = contextvars.ContextVar(
+    "hst_literal_sweep", default=None)
+
+
+class Unbatchable(Exception):
+    """Plan shape the template serializer cannot soundly abstract."""
+
+
+_COMPARISONS = (E.EqualTo, E.LessThan, E.LessThanOrEqual, E.GreaterThan,
+                E.GreaterThanOrEqual)
+
+
+def condition_template(e: E.Expr, lits: Optional[list] = None
+                       ) -> Tuple[str, list]:
+    """(literal-abstracted template, literal values) for a filter
+    condition. Only the shapes the fused-predicate path can sweep are
+    abstracted (Col-vs-Lit comparisons, In over literals, under
+    And/Or/Not); everything else serializes concretely — differing
+    concrete parts make templates differ, which simply prevents
+    batching. The literal's python type rides in the template (it is
+    part of the compiled program's structure)."""
+    if lits is None:
+        lits = []
+    if isinstance(e, (E.And, E.Or)):
+        lt, _ = condition_template(e.left, lits)
+        rt, _ = condition_template(e.right, lits)
+        op = "And" if isinstance(e, E.And) else "Or"
+        return f"{op}({lt},{rt})", lits
+    if isinstance(e, E.Not):
+        ct, _ = condition_template(e.child, lits)
+        return f"Not({ct})", lits
+    if isinstance(e, E.In) and isinstance(e.value, E.Col) \
+            and all(isinstance(o, E.Lit) for o in e.options):
+        tags = []
+        for o in e.options:
+            tags.append(type(o.value).__name__)
+            lits.append(o.value)
+        return (f"In({e.value.column};{len(e.options)};"
+                f"{','.join(tags)})"), lits
+    if isinstance(e, _COMPARISONS):
+        left, right = e.left, e.right
+        flipped = False
+        if isinstance(left, E.Lit) and not isinstance(right, E.Lit):
+            left, right = right, left
+            flipped = True
+        if isinstance(left, E.Col) and isinstance(right, E.Lit):
+            from ..execution.evaluator import _op_name
+            lits.append(right.value)
+            return (f"{_op_name(e, flipped)}({left.column};"
+                    f"{type(right.value).__name__})"), lits
+    return repr(e), lits
+
+
+def plan_template(plan: LogicalPlan) -> Tuple[str, List[E.Expr]]:
+    """(template string, swept Filter conditions in DFS order) for a
+    normalized plan. Raises :class:`Unbatchable` for plans containing
+    nodes the result-cache serializer does not understand (same
+    soundness bar: unknown operators cannot be proven literal-only
+    variants)."""
+    from .fingerprint import _node_detail
+    parts: List[str] = []
+    conditions: List[E.Expr] = []
+
+    def walk(p: LogicalPlan) -> None:
+        if isinstance(p, Filter):
+            lits: list = []
+            t, _ = condition_template(p.condition, lits)
+            parts.append(f"(Filter[{t}]")
+            if lits:
+                conditions.append(p.condition)
+        else:
+            detail = _node_detail(p)
+            if detail is None:
+                raise Unbatchable(p.node_name)
+            parts.append("(" + detail)
+        for c in p.children:
+            walk(c)
+        parts.append(")")
+
+    walk(plan)
+    return "".join(parts), conditions
+
+
+def template_key(session, plan: LogicalPlan) -> Optional[Tuple[str, str]]:
+    """Batch-compatibility key for a normalized plan: the literal-
+    abstracted template plus the session's config hash (two sessions
+    whose conf could steer planning differently must not share a
+    sweep). None when the plan cannot be batched at all."""
+    from ..util import hashing
+    from .fingerprint import config_hash
+    try:
+        template, conditions = plan_template(plan)
+    except Unbatchable:
+        return None
+    if not conditions:
+        return None  # nothing literal-variant to sweep
+    return hashing.md5_hex(template), config_hash(session)
+
+
+def _padded_batch(n: int) -> int:
+    """Power-of-two batch class: batches of 5..8 members share one
+    compiled sweep program at batch dimension 8."""
+    b = 1
+    while b < n:
+        b *= 2
+    return b
+
+
+class SweepContext:
+    """Shared execution state for one batch of literal-variant plans.
+
+    Built by the frontend from the members' NORMALIZED plans (their
+    per-position Filter conditions); activated per member via
+    :func:`use_sweep` around the member's normal ``Session.execute``.
+    The executor and evaluator consult it through
+    :func:`active_sweep`."""
+
+    def __init__(self, member_conditions: List[List[E.Expr]]):
+        # member_conditions[m] = swept conditions of member m, DFS order.
+        self.size = len(member_conditions)
+        self.padded_size = _padded_batch(self.size)
+        positions = len(member_conditions[0]) if member_conditions else 0
+        # _conditions[p][m] = member m's condition at position p.
+        self._conditions: List[List[E.Expr]] = [
+            [member_conditions[m][p] for m in range(self.size)]
+            for p in range(positions)]
+        # Template -> position; a template claimed by two positions is
+        # ambiguous and disabled (both fall back to per-member eval).
+        self._by_template = {}
+        disabled = set()
+        for p in range(positions):
+            t, _ = condition_template(self._conditions[p][0])
+            if t in self._by_template:
+                disabled.add(t)
+            else:
+                self._by_template[t] = p
+        for t in disabled:
+            self._by_template.pop(t, None)
+        self.member = -1  # set by use_sweep
+        self._lock = threading.Lock()
+        self._tables: dict = {}      # scan share key -> Table
+        self._shared_ids: set = set()
+        self._masks: dict = {}       # (position, id(table)) -> (masks, counts)
+        # Stats surfaced through ServingBatchEvent / serving_stats.
+        self.shared_scans = 0
+        self.shared_scan_hits = 0
+        self.sweep_invocations = 0
+        self.sweep_hits = 0
+        self.fallbacks = 0
+
+    # ------------------------------------------------------------------
+    # Shared scans (executor hook).
+    # ------------------------------------------------------------------
+
+    def shared_scan(self, key, compute: Callable):
+        """The scanned Table for ``key``, read once per batch. The read
+        runs under the member's own session scope (io attribution goes
+        to the member that happened to read; later members hit)."""
+        with self._lock:
+            table = self._tables.get(key)
+            if table is not None:
+                self.shared_scan_hits += 1
+                return table
+        table = compute()  # outside the lock: reads can be slow
+        with self._lock:
+            existing = self._tables.get(key)
+            if existing is not None:
+                self.shared_scan_hits += 1
+                return existing
+            self._tables[key] = table
+            self._shared_ids.add(id(table))
+            self.shared_scans += 1
+        return table
+
+    # ------------------------------------------------------------------
+    # Stacked masks (evaluator hook).
+    # ------------------------------------------------------------------
+
+    def try_masked_count(self, table, condition, key, builder, cols):
+        """(member's mask row, member's survivor count) from the batched
+        invocation, or None when this condition/table combination cannot
+        be swept (caller falls back to the normal fused path)."""
+        if id(table) not in self._shared_ids or self.member < 0:
+            return None
+        t, _ = condition_template(condition)
+        pos = self._by_template.get(t)
+        if pos is None:
+            return None
+        registered = self._conditions[pos][self.member]
+        if repr(registered) != repr(condition):
+            # A rewrite changed the member's predicate after template
+            # registration: the stacked literals would be stale.
+            with self._lock:
+                self.fallbacks += 1
+            return None
+        memo_key = (pos, id(table))
+        with self._lock:
+            memo = self._masks.get(memo_key)
+        if memo is None:
+            memo = self._compute_stacked(memo_key, table, key, builder,
+                                         cols)
+            if memo is None:
+                return None
+        else:
+            with self._lock:
+                self.sweep_hits += 1
+        masks, counts = memo
+        import jax.lax
+        import jax.numpy as jnp
+        mask = jax.lax.dynamic_index_in_dim(
+            masks, jnp.int32(self.member), axis=0, keepdims=False)
+        return mask, int(counts[self.member])
+
+    def _compute_stacked(self, memo_key, table, key, builder, cols):
+        import numpy as np
+
+        from ..execution.evaluator import predicate_slots
+        from ..ops import kernels
+        pos = memo_key[0]
+        ref_spec = None
+        rows = []
+        for cond_m in self._conditions[pos]:
+            slots = predicate_slots(table, cond_m)
+            if slots is None or \
+                    (ref_spec is not None and slots[0] != ref_spec):
+                with self._lock:
+                    self.fallbacks += 1
+                return None
+            if ref_spec is None:
+                ref_spec = slots[0]
+            rows.append(slots[1])
+        # Pad member rows to the batch class by repeating row 0 (the
+        # padded rows' masks are computed and discarded).
+        while len(rows) < self.padded_size:
+            rows.append(rows[0])
+        slots_n = len(rows[0])
+        from ..execution.evaluator import predicate_slot_dtypes
+        names = sorted(set(self._conditions[pos][0].references))
+        slot_np = predicate_slot_dtypes(
+            ref_spec, [table.column(nm).dtype for nm in names], slots_n)
+        lit_matrix = tuple(
+            np.asarray([rows[m][j] for m in range(self.padded_size)],
+                       dtype=slot_np[j])
+            for j in range(slots_n))
+        masks, counts = kernels.run_fused_predicate_sweep(
+            key, builder, cols, lit_matrix, table.num_rows,
+            batch=self.padded_size)
+        memo = (masks, np.asarray(counts))
+        with self._lock:
+            self._masks[memo_key] = memo
+            self.sweep_invocations += 1
+        return memo
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "members": self.size,
+                "positions": len(self._conditions),
+                "shared_scans": self.shared_scans,
+                "shared_scan_hits": self.shared_scan_hits,
+                "sweep_invocations": self.sweep_invocations,
+                "sweep_hits": self.sweep_hits,
+                "fallbacks": self.fallbacks,
+            }
+
+
+@contextlib.contextmanager
+def use_sweep(sweep: Optional[SweepContext], member: int):
+    """Activate ``sweep`` for one member's execution. Members run
+    sequentially on one worker, so the member index is a plain
+    attribute; the contextvar keeps concurrent OTHER batches (other
+    workers) isolated."""
+    if sweep is None:
+        yield
+        return
+    token = _SWEEP.set(sweep)
+    sweep.member = member
+    try:
+        yield
+    finally:
+        sweep.member = -1
+        _SWEEP.reset(token)
+
+
+def active_sweep() -> Optional[SweepContext]:
+    return _SWEEP.get()

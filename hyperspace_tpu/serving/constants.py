@@ -44,6 +44,50 @@ class ServingConstants:
     RESULT_CACHE_PLAN_CACHE_SIZE = "serving.result_cache.planCacheSize"
     RESULT_CACHE_PLAN_CACHE_SIZE_DEFAULT = "64"
 
+    # ------------------------------------------------------------------
+    # Concurrent serving frontend (serving/frontend.py). The family is
+    # prefixed hyperspace.tpu.serving.* (the io/optimizer convention);
+    # the result-cache keys above predate it and keep their spelling.
+    # ------------------------------------------------------------------
+
+    # Master switch for the process-default frontend accessor
+    # (Hyperspace.serving_frontend / Session-level auto-routing). A
+    # directly-constructed ServingFrontend works regardless — the
+    # construction IS the opt-in.
+    SERVING_ENABLED = "hyperspace.tpu.serving.enabled"
+    SERVING_ENABLED_DEFAULT = "false"
+
+    # Worker-slot cap: how many queries execute concurrently. Workers
+    # come from the dedicated serving pool in parallel/io.py (NOT the
+    # reader pool — a query must be able to fan reads out underneath).
+    SERVING_MAX_CONCURRENCY = "hyperspace.tpu.serving.maxConcurrency"
+    SERVING_MAX_CONCURRENCY_DEFAULT = "4"
+
+    # Bounded submission queue: submissions beyond this many WAITING
+    # queries are rejected (ServingRejectEvent + ServingRejectedError)
+    # instead of queueing unboundedly.
+    SERVING_QUEUE_DEPTH = "hyperspace.tpu.serving.queueDepth"
+    SERVING_QUEUE_DEPTH_DEFAULT = "64"
+
+    # Admission byte budget: summed recompute-input estimates
+    # (serving/fingerprint.estimate_recompute_bytes) of queued+running
+    # queries; a submission pushing past it is rejected — unless nothing
+    # is in flight, so one over-budget query still runs alone.
+    SERVING_ADMISSION_MAX_BYTES = "hyperspace.tpu.serving.admission.maxBytes"
+    SERVING_ADMISSION_MAX_BYTES_DEFAULT = str(4 * 1024 * 1024 * 1024)
+
+    # Cross-query literal batching (serving/batcher.py): queries whose
+    # canonical plans differ only in Filter literals execute as one
+    # sweep. ``window`` (seconds) is how long a worker holding one
+    # batchable query waits for co-batchable arrivals; ``maxBatch`` caps
+    # members per sweep.
+    SERVING_BATCHING_ENABLED = "hyperspace.tpu.serving.batching.enabled"
+    SERVING_BATCHING_ENABLED_DEFAULT = "true"
+    SERVING_BATCHING_WINDOW = "hyperspace.tpu.serving.batching.window"
+    SERVING_BATCHING_WINDOW_DEFAULT = "0.01"
+    SERVING_BATCHING_MAX_BATCH = "hyperspace.tpu.serving.batching.maxBatch"
+    SERVING_BATCHING_MAX_BATCH_DEFAULT = "8"
+
     # Env-var fallbacks (HST_INDEX_CACHE* convention), applied when the
     # conf key is unset. "on"/"off" spellings are accepted for the
     # boolean. Resolution happens in config.py exclusively.

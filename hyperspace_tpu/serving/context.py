@@ -1,0 +1,149 @@
+"""Explicit per-query execution context.
+
+Before the serving tier, everything one query needed at runtime was
+implicit per-``Session`` state: the result-cache handle was re-probed
+from the session, advisor capture re-read the conf, the parallel-io
+layer attributed reads to a session-wide pile, and the executor wrote
+join cardinalities straight onto session attributes. That works for one
+thread per session; a process-wide frontend multiplexing many sessions
+over shared worker threads needs the per-query state to be an explicit
+object it can build, hand to a worker, and inspect afterwards.
+
+:class:`QueryContext` is that object. ``Session.execute`` creates one
+per call (or accepts one from the serving frontend), activates it on a
+contextvar for the duration of the execution, and every layer below —
+the executor, the result cache, the parallel-io pool, the program bank
+— reads the ACTIVE context instead of reaching for session attributes:
+
+- ``result_cache``: resolved ONCE at context creation — the frontend's
+  cross-session shared cache when the query came through the serving
+  tier, else the session's own. Mid-query conf flips cannot swap the
+  cache out from under an execution.
+- ``capture``: the advisor-capture decision, pinned at creation for the
+  same reason.
+- ``io``: per-query read counters (tasks, bytes, seconds, waits) that
+  ``parallel/io.py`` credits to the active context — so a multi-tenant
+  frontend can attribute I/O to the query that caused it, not just to
+  the process-wide pile.
+- ``record_join_actual``: the executor's observed-join-cardinality
+  write, routed through the context to the owning session's bounded
+  store (locked — worker threads share sessions).
+
+The contextvar (not a thread-local) matters: the prefetch producer and
+the serving workers enter copied contexts (``contextvars.copy_context``),
+so attribution follows the QUERY across threads, exactly like the io
+session scope it generalizes.
+
+No jax imports here — sessions (and config.py) must stay importable
+without touching the execution stack.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import itertools
+import threading
+import time
+from typing import Optional
+
+# Process-wide monotonically increasing query ids (itertools.count is
+# atomic under the GIL; the lock guards readers that want a stable
+# snapshot semantics anyway).
+_QUERY_IDS = itertools.count(1)
+
+_CONTEXT: contextvars.ContextVar = contextvars.ContextVar(
+    "hst_query_context", default=None)
+
+_IO_COUNTER_KEYS = ("read_tasks", "read_bytes", "read_seconds",
+                    "wait_seconds", "prefetch_items")
+
+
+class QueryContext:
+    """Everything one query execution needs, made explicit."""
+
+    def __init__(self, session, result_cache=None, capture: Optional[bool]
+                 = None, client: str = "", query_id: Optional[int] = None):
+        self.session = session
+        self.query_id = query_id if query_id is not None \
+            else next(_QUERY_IDS)
+        self.client = client
+        self.created_s = time.perf_counter()
+        # Resolved handles (pinned for the query's lifetime).
+        self.result_cache = result_cache
+        self.capture = bool(capture) if capture is not None else False
+        # Per-query io counters; the lock is for cross-thread writers
+        # (prefetch producers run in a copied context on another thread).
+        self._io_lock = threading.Lock()
+        self._io = {k: 0 if not k.endswith("seconds") else 0.0
+                    for k in _IO_COUNTER_KEYS}
+
+    # ------------------------------------------------------------------
+    # Construction.
+    # ------------------------------------------------------------------
+
+    @classmethod
+    def for_session(cls, session, shared_cache=None,
+                    client: str = "") -> "QueryContext":
+        """The per-query context ``Session.execute`` builds when none was
+        handed in. ``shared_cache`` (the serving frontend's cross-session
+        result cache) takes precedence over the session's own."""
+        cache = shared_cache if shared_cache is not None \
+            else session.result_cache
+        return cls(session, result_cache=cache,
+                   capture=session.hs_conf.advisor_capture_enabled(),
+                   client=client)
+
+    @contextlib.contextmanager
+    def activate(self):
+        token = _CONTEXT.set(self)
+        try:
+            yield self
+        finally:
+            _CONTEXT.reset(token)
+
+    # ------------------------------------------------------------------
+    # Per-query io attribution (parallel/io.py credits the active ctx).
+    # ------------------------------------------------------------------
+
+    def note_io(self, **deltas) -> None:
+        with self._io_lock:
+            for k, v in deltas.items():
+                if k in self._io:
+                    self._io[k] += v
+
+    def io_stats(self) -> dict:
+        with self._io_lock:
+            return dict(self._io)
+
+    # ------------------------------------------------------------------
+    # Executor write-backs (session stores, locked — workers share
+    # sessions).
+    # ------------------------------------------------------------------
+
+    def record_join_actual(self, condition_repr: str, rows: int) -> None:
+        record_join_actual(self.session, condition_repr, rows)
+
+
+_JOIN_ACTUALS_MAX = 256
+
+
+def record_join_actual(session, condition_repr: str, rows: int) -> None:
+    """Locked LRU write-back of an executed inner join's observed output
+    rows onto the owning session (the ONE copy of the bound/eviction
+    policy — shared by the serving QueryContext and the executor's
+    contextless fallback)."""
+    actuals = getattr(session, "_join_actuals", None)
+    lock = getattr(session, "_join_actuals_lock", None)
+    if actuals is None or lock is None:
+        return
+    with lock:
+        actuals[condition_repr] = int(rows)
+        actuals.move_to_end(condition_repr)
+        while len(actuals) > _JOIN_ACTUALS_MAX:
+            actuals.popitem(last=False)
+
+
+def active_context() -> Optional[QueryContext]:
+    """The QueryContext of the in-flight execution, if any."""
+    return _CONTEXT.get()

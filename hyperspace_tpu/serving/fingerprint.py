@@ -154,7 +154,8 @@ def config_hash(session) -> str:
     an admission-threshold tweak, breaking config.py's live-tuning
     contract."""
     items = [(k, v) for k, v in sorted(session.conf.as_dict().items())
-             if not k.startswith("serving.")]
+             if not k.startswith("serving.")
+             and not k.startswith("hyperspace.tpu.serving.")]
     return hashing.md5_hex((items, session.is_hyperspace_enabled()))
 
 

@@ -1,0 +1,450 @@
+"""Process-wide concurrent serving frontend.
+
+The "millions of users" entry point (ROADMAP item 1): one
+:class:`ServingFrontend` accepts queries from MANY independent sessions
+and executes them on a bounded worker pool, sharing everything that is
+safe to share across tenants:
+
+- **compiled programs** — process-wide through the program bank
+  (serving/program_bank.py): tenant A's warm-up pays tenant B's
+  compiles;
+- **results** — a frontend-owned cross-session
+  :class:`~..serving.result_cache.ResultCache`; the r06 keys already pin
+  the plan fingerprint, source signatures, index log versions, and the
+  session's config hash, so an entry computed for one session can be
+  served to another session iff recomputing there would be byte-identical
+  — no new invalidation machinery needed;
+- **literal sweeps** — queued queries whose canonical plans differ only
+  in Filter literals (serving/batcher.py) execute as ONE batched
+  invocation over a shared scan.
+
+Admission control keeps the tier honest under overload: a bounded
+submission queue (``serving.queueDepth``) plus an in-flight input-byte
+budget (``serving.admission.maxBytes``); rejected submissions raise
+:class:`~..exceptions.ServingRejectedError` immediately (load shedding,
+the hook the AQP degradation tier of ROADMAP item 5b will land behind).
+
+Threading: workers come from the dedicated serving pool in
+parallel/io.py (the lint-sanctioned thread module) — NOT the reader
+pool, so a serving query can still fan its reads out underneath. Each
+submission snapshots ``contextvars.copy_context()`` and each execution
+runs inside it, so the io/session contextvars and the QueryContext
+propagate into worker threads exactly as they do on the caller's thread.
+
+Config: ``hyperspace.tpu.serving.*`` via config.py accessors, read live
+from the frontend's governing conf at each decision point.
+"""
+
+from __future__ import annotations
+
+import contextvars
+import threading
+import time
+from collections import deque
+from typing import List, Optional
+
+from ..exceptions import HyperspaceException, ServingRejectedError
+from . import batcher
+from .context import QueryContext
+
+
+class PendingQuery:
+    """Handle returned by :meth:`ServingFrontend.submit`."""
+
+    def __init__(self, query_id: int, client: str, estimated_bytes: int):
+        self.query_id = query_id
+        self.client = client
+        self.estimated_bytes = estimated_bytes
+        self.submitted_s = time.perf_counter()
+        self.started_s: Optional[float] = None
+        self.completed_s: Optional[float] = None
+        self.batched = False
+        self.batch_size = 0
+        self.context: Optional[QueryContext] = None
+        self._event = threading.Event()
+        self._result = None
+        self._error: Optional[BaseException] = None
+
+    def done(self) -> bool:
+        return self._event.is_set()
+
+    def result(self, timeout: Optional[float] = None):
+        """The executed Table; blocks until completion. Raises the
+        query's own error if it failed, TimeoutError on timeout."""
+        if not self._event.wait(timeout):
+            raise TimeoutError(
+                f"query {self.query_id} not done after {timeout}s")
+        if self._error is not None:
+            raise self._error
+        return self._result
+
+    @property
+    def latency_s(self) -> Optional[float]:
+        if self.completed_s is None:
+            return None
+        return self.completed_s - self.submitted_s
+
+    def _finish(self, result=None, error: Optional[BaseException] = None
+                ) -> None:
+        self.completed_s = time.perf_counter()
+        self._result = result
+        self._error = error
+        self._event.set()
+
+
+class _Entry:
+    __slots__ = ("plan", "norm", "session", "ctx", "pending", "batch_key")
+
+    def __init__(self, plan, norm, session, ctx, pending, batch_key):
+        self.plan = plan
+        self.norm = norm
+        self.session = session
+        self.ctx = ctx                # contextvars.Context snapshot
+        self.pending = pending
+        self.batch_key = batch_key    # None = never batchable
+
+
+class ServingFrontend:
+    """One instance serves the whole process; sessions are clients."""
+
+    def __init__(self, session):
+        # The governing session: its conf carries the serving.* family
+        # and its event logger receives the frontend's telemetry.
+        self._session = session
+        self._hs_conf = session.hs_conf
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        self._queue: "deque[_Entry]" = deque()
+        self._active_workers = 0
+        self._inflight_bytes = 0
+        # Cross-session result cache: rebuilt — and thereby cleared —
+        # when the governing serving.result_cache.* budgets change
+        # (CacheWithTransform carries its own lock, so a rebuild never
+        # contends with the submit/_drain admission path).
+        from ..config import CacheWithTransform
+        from .result_cache import build_result_cache
+        self._shared_cache_holder = CacheWithTransform(
+            self._hs_conf.result_cache_conf_string,
+            lambda raw: build_result_cache(self._session))
+        self._stats = {
+            "submitted": 0, "admitted": 0, "rejected": 0,
+            "completed": 0, "failed": 0,
+            "batches": 0, "batched_queries": 0,
+            "sweep_invocations": 0, "shared_scans": 0,
+            "shared_scan_hits": 0,
+        }
+        # Construction is the opt-in (README/bench construct directly):
+        # the first live frontend becomes the process default so
+        # serving_stats()/explain's "Serving:" section observe it
+        # without going through get_frontend().
+        global _DEFAULT
+        with _DEFAULT_LOCK:
+            if _DEFAULT is None:
+                _DEFAULT = self
+
+    # ------------------------------------------------------------------
+    # Shared cross-session result cache.
+    # ------------------------------------------------------------------
+
+    def result_cache(self):
+        """The frontend's cross-session result cache (built from the
+        governing conf's serving.result_cache.* budgets; None while that
+        flag is off). Budget changes rebuild — and thereby clear — it,
+        the same CacheWithTransform contract as Session.result_cache."""
+        return self._shared_cache_holder.load()
+
+    # ------------------------------------------------------------------
+    # Submission + admission control.
+    # ------------------------------------------------------------------
+
+    def submit(self, query, session=None, client: str = "") -> PendingQuery:
+        """Enqueue one query (a DataFrame, or a LogicalPlan plus an
+        explicit ``session``). Returns immediately with a
+        :class:`PendingQuery`; raises :class:`ServingRejectedError` when
+        admission control refuses it."""
+        plan = getattr(query, "plan", query)
+        session = session if session is not None \
+            else getattr(query, "session", None)
+        if session is None:
+            raise HyperspaceException(
+                "submit() needs a DataFrame or an explicit session=")
+        from .fingerprint import estimate_recompute_bytes, normalize
+        norm = normalize(plan)
+        est = estimate_recompute_bytes(norm)
+        batch_key = batcher.template_key(session, norm) \
+            if self._hs_conf.serving_batching_enabled() else None
+        pending = PendingQuery(query_id=0, client=client,
+                               estimated_bytes=est)
+        depth = self._hs_conf.serving_queue_depth()
+        max_bytes = self._hs_conf.serving_admission_max_bytes()
+        with self._lock:
+            self._stats["submitted"] += 1
+            queued = len(self._queue)
+            inflight = self._inflight_bytes
+            if queued >= depth or \
+                    (inflight > 0 and inflight + est > max_bytes):
+                self._stats["rejected"] += 1
+                reason = (f"queue full ({queued}/{depth})"
+                          if queued >= depth else
+                          f"byte budget ({inflight + est} > {max_bytes})")
+                self._emit_reject(session, client, est, reason)
+                raise ServingRejectedError(
+                    f"serving admission rejected query: {reason}")
+            self._stats["admitted"] += 1
+            entry = _Entry(plan, norm, session,
+                           contextvars.copy_context(), pending, batch_key)
+            self._queue.append(entry)
+            self._inflight_bytes += est
+            spawn = self._active_workers < \
+                self._hs_conf.serving_max_concurrency()
+            if spawn:
+                self._active_workers += 1
+            self._cv.notify_all()  # wake EVERY window-waiting worker:
+            # notify() could pick one holding an incompatible batch,
+            # leaving a compatible (even full) batch waiting out its
+            # whole window.
+        self._emit_admit(session, client, est, queued + 1)
+        if spawn:
+            from ..parallel import io as pio
+            try:
+                pio.submit_serving(
+                    self._drain, self._hs_conf.serving_max_concurrency())
+            except BaseException:
+                # Roll the whole admission back: a stranded entry would
+                # consume queue depth and byte budget forever (and could
+                # execute later despite the caller being told the
+                # submission failed). If another worker already took it,
+                # leave it — it will complete normally.
+                with self._lock:
+                    self._active_workers -= 1
+                    try:
+                        self._queue.remove(entry)
+                    except ValueError:
+                        pass
+                    else:
+                        self._inflight_bytes = max(
+                            0, self._inflight_bytes - est)
+                        self._stats["admitted"] -= 1
+                raise
+        return pending
+
+    # ------------------------------------------------------------------
+    # Worker loop.
+    # ------------------------------------------------------------------
+
+    def _drain(self) -> None:
+        while True:
+            with self._lock:
+                if not self._queue:
+                    self._active_workers -= 1
+                    return
+                entry = self._queue.popleft()
+            batch = [entry]
+            # Everything past the pop is guarded: a worker dying with
+            # popped entries in hand would strand the clients' futures,
+            # leak _inflight_bytes, and wedge _active_workers forever
+            # (e.g. a malformed batching.window conf string). Errors
+            # land on the entries' futures and the worker lives on.
+            try:
+                window = self._hs_conf.serving_batching_window()
+                limit = self._hs_conf.serving_batching_max_batch()
+                with self._lock:
+                    self._collect_batch(entry, batch, limit)
+                if entry.batch_key is not None and window > 0 and \
+                        len(batch) < limit:
+                    # Hold the door open one full window for
+                    # co-batchable arrivals (a literal sweep is worth a
+                    # bounded wait); submits notify the cv, so the loop
+                    # re-collects as they land and exits early once the
+                    # batch is full.
+                    deadline = time.monotonic() + window
+                    with self._lock:
+                        while len(batch) < limit:
+                            remaining = deadline - time.monotonic()
+                            if remaining <= 0:
+                                break
+                            self._cv.wait(remaining)
+                            self._collect_batch(entry, batch, limit)
+                if len(batch) == 1:
+                    self._run_single(entry)
+                else:
+                    self._run_batch(batch)
+            except BaseException as e:
+                for b in batch:
+                    if not b.pending.done():
+                        b.pending._finish(error=e)
+                        self._note(failed=1)
+                        self._release(b)
+
+    def _collect_batch(self, head: _Entry, batch: List[_Entry],
+                       limit: int) -> None:
+        """Under the lock: move queued entries batch-compatible with
+        ``head`` into ``batch`` (submission order preserved)."""
+        if head.batch_key is None:
+            return
+        if len(batch) >= limit:
+            return
+        keep = deque()
+        while self._queue and len(batch) < limit:
+            e = self._queue.popleft()
+            if e.batch_key == head.batch_key:
+                batch.append(e)
+            else:
+                keep.append(e)
+        keep.extend(self._queue)
+        self._queue.clear()
+        self._queue.extend(keep)
+
+    def _run_single(self, entry: _Entry) -> None:
+        entry.pending.started_s = time.perf_counter()
+        try:
+            result = entry.ctx.run(self._execute_entry, entry, None, 0)
+            entry.pending._finish(result=result)
+            self._note(completed=1)
+        except BaseException as e:  # the submitter gets the error
+            entry.pending._finish(error=e)
+            self._note(failed=1)
+        finally:
+            self._release(entry)
+
+    def _run_batch(self, batch: List[_Entry]) -> None:
+        """Execute literal-variant members under one SweepContext: one
+        shared scan per source, one vmapped mask invocation per swept
+        Filter position; members otherwise run their normal path (own
+        result-cache key, own capture record, own downstream)."""
+        try:
+            conditions = [batcher.plan_template(e.norm)[1] for e in batch]
+        except batcher.Unbatchable:
+            for e in batch:
+                self._run_single(e)
+            return
+        sweep = batcher.SweepContext(conditions)
+        for i, e in enumerate(batch):
+            e.pending.started_s = time.perf_counter()
+            e.pending.batched = True
+            e.pending.batch_size = len(batch)
+            try:
+                result = e.ctx.run(self._execute_entry, e, sweep, i)
+                e.pending._finish(result=result)
+                self._note(completed=1)
+            except BaseException as err:
+                e.pending._finish(error=err)
+                self._note(failed=1)
+            finally:
+                self._release(e)
+        s = sweep.stats()
+        self._note(batches=1, batched_queries=len(batch),
+                   sweep_invocations=s["sweep_invocations"],
+                   shared_scans=s["shared_scans"],
+                   shared_scan_hits=s["shared_scan_hits"])
+        self._emit_batch(batch, s)
+
+    def _execute_entry(self, entry: _Entry,
+                       sweep: Optional[batcher.SweepContext],
+                       member: int):
+        qc = QueryContext.for_session(
+            entry.session, shared_cache=self.result_cache(),
+            client=entry.pending.client)
+        entry.pending.query_id = qc.query_id
+        entry.pending.context = qc
+        with batcher.use_sweep(sweep, member):
+            return entry.session.execute(entry.plan, context=qc)
+
+    def _release(self, entry: _Entry) -> None:
+        with self._lock:
+            self._inflight_bytes = max(
+                0, self._inflight_bytes - entry.pending.estimated_bytes)
+
+    # ------------------------------------------------------------------
+    # Observability.
+    # ------------------------------------------------------------------
+
+    def _note(self, **deltas) -> None:
+        with self._lock:
+            for k, v in deltas.items():
+                self._stats[k] += v
+
+    def stats(self) -> dict:
+        with self._lock:
+            out = dict(self._stats)
+            out["queued"] = len(self._queue)
+            out["active_workers"] = self._active_workers
+            out["inflight_bytes"] = self._inflight_bytes
+        cache = self.result_cache()
+        out["shared_result_cache"] = cache.stats() \
+            if cache is not None else None
+        from .program_bank import get_bank
+        out["program_bank"] = get_bank().stats()
+        return out
+
+    def drain(self, timeout: float = 60.0) -> None:
+        """Block until the queue is empty and workers are idle."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            with self._lock:
+                if not self._queue and self._active_workers == 0:
+                    return
+            time.sleep(0.005)
+        raise TimeoutError("serving frontend did not drain")
+
+    def _logger(self, session):
+        from ..telemetry.logging import get_logger
+        return get_logger(session.hs_conf.event_logger_class())
+
+    def _emit_admit(self, session, client, est, depth) -> None:
+        try:
+            from ..telemetry.events import ServingAdmitEvent
+            self._logger(session).log_event(ServingAdmitEvent(
+                message=f"query admitted (queue depth {depth})",
+                client=client, estimated_bytes=est, queue_depth=depth))
+        except Exception:
+            pass
+
+    def _emit_reject(self, session, client, est, reason) -> None:
+        try:
+            from ..telemetry.events import ServingRejectEvent
+            self._logger(session).log_event(ServingRejectEvent(
+                message=f"query rejected: {reason}",
+                client=client, estimated_bytes=est, reason=reason))
+        except Exception:
+            pass
+
+    def _emit_batch(self, batch: List[_Entry], s: dict) -> None:
+        try:
+            from ..telemetry.events import ServingBatchEvent
+            self._logger(batch[0].session).log_event(ServingBatchEvent(
+                message=(f"literal sweep: {len(batch)} queries, "
+                         f"{s['sweep_invocations']} batched "
+                         f"invocation(s), {s['shared_scans']} shared "
+                         "scan(s)"),
+                size=len(batch), positions=s["positions"],
+                sweep_invocations=s["sweep_invocations"],
+                shared_scans=s["shared_scans"]))
+        except Exception:
+            pass
+
+
+# ---------------------------------------------------------------------------
+# Process-default frontend (Hyperspace.serving_frontend / bench).
+# ---------------------------------------------------------------------------
+
+_DEFAULT: Optional[ServingFrontend] = None
+# Reentrant: get_frontend constructs under this lock and __init__
+# re-acquires it to self-register.
+_DEFAULT_LOCK = threading.RLock()
+
+
+def get_frontend(session) -> ServingFrontend:
+    """The process-default frontend, created on first use with
+    ``session`` as its governing session (conf + telemetry). Requires
+    ``hyperspace.tpu.serving.enabled=true`` on that session — the
+    explicit constructor carries no such gate."""
+    if not session.hs_conf.serving_enabled():
+        raise HyperspaceException(
+            "hyperspace.tpu.serving.enabled is false; set it (or "
+            "construct ServingFrontend directly) to use the serving tier")
+    global _DEFAULT
+    with _DEFAULT_LOCK:
+        if _DEFAULT is None:
+            _DEFAULT = ServingFrontend(session)
+        return _DEFAULT

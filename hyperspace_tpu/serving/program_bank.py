@@ -1,0 +1,147 @@
+"""Process-wide shared compiled-program bank.
+
+r07's shape-class layer already funnels every fused stage (predicate
+masks, arithmetic projections) through ONE wrapper per program STRUCTURE
+with literals as runtime arguments; jax then compiles one executable per
+(structure, shape-class vector). Those wrappers used to live in an
+anonymous module-level dict inside ops/kernels.py — shared across
+sessions by accident of process layout, unbounded in visibility, and
+invisible to observability.
+
+This module lifts them into an explicit registry — THE program bank of
+the serving tier: keyed on (stage fingerprint, shape-class vector),
+size-bounded (LRU over stage entries; evicting one stage drops its jit
+wrapper and every executable under it), and instrumented. Because the
+bank is process-wide, tenant A's warm-up pays tenant B's compiles: two
+sessions executing the same warm workload share every program, which is
+what makes the serving frontend's multi-session fan-in cheap.
+
+Accounting model: a *stage* is one jitted wrapper (one structure key);
+a *program* is one (stage, shape-class vector) pair — the unit XLA
+actually compiles. ``lookup`` records a **miss** the first time a
+(stage, shape vector) pair is seen (a backend compile is expected right
+after) and a **hit** on every later sighting. ``ProgramBankMissEvent``
+is emitted per new program, ``ProgramBankHitEvent`` once per program on
+its FIRST reuse (bounded event volume; the counters carry the totals).
+
+The jit wrappers themselves are constructed by the CALLER (ops/kernels
+passes a factory) — scripts/lint.py pins ``jax.jit`` to the
+instrumented kernel modules, and this module stays importable without
+jax (config.py pulls in the serving package).
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+from typing import Callable, Optional, Tuple
+
+
+class ProgramBank:
+    def __init__(self, max_stages: int = 1024):
+        self.max_stages = max_stages
+        self._lock = threading.Lock()
+        # stage key -> (callable, {shape vector: reuse count})
+        self._stages: "OrderedDict[tuple, Tuple[Callable, dict]]" = \
+            OrderedDict()
+        self.hits = 0
+        self.misses = 0
+        self.stage_evictions = 0
+        self.program_count = 0
+
+    def lookup(self, stage_key: tuple, shape_vec: tuple,
+               factory: Callable[[], Callable]) -> Callable:
+        """The jitted wrapper for ``stage_key``, created via ``factory``
+        on first sighting. ``shape_vec`` (the shape-class vector of the
+        arguments about to be passed) drives hit/miss accounting only —
+        jax's own cache keys executables under the wrapper."""
+        first_reuse = False
+        with self._lock:
+            entry = self._stages.get(stage_key)
+            if entry is None:
+                while len(self._stages) >= self.max_stages:
+                    _, (_, shapes_seen) = self._stages.popitem(last=False)
+                    self.stage_evictions += 1
+                    self.program_count -= len(shapes_seen)
+                fn = factory()
+                # shape vector -> times this program was looked up again
+                # after registration (0 = registered, never reused yet).
+                self._stages[stage_key] = (fn, {shape_vec: 0})
+                self.misses += 1
+                self.program_count += 1
+                hit = False
+            else:
+                self._stages.move_to_end(stage_key)
+                fn, shapes_seen = entry
+                if shape_vec in shapes_seen:
+                    self.hits += 1
+                    shapes_seen[shape_vec] += 1
+                    first_reuse = shapes_seen[shape_vec] == 1
+                    hit = True
+                else:
+                    shapes_seen[shape_vec] = 0
+                    self.misses += 1
+                    self.program_count += 1
+                    hit = False
+        self._emit(stage_key, shape_vec, hit=hit, first_reuse=first_reuse)
+        return fn
+
+    # ------------------------------------------------------------------
+    # Observability.
+    # ------------------------------------------------------------------
+
+    def _emit(self, stage_key: tuple, shape_vec: tuple, hit: bool,
+              first_reuse: bool) -> None:
+        """One MissEvent per new program; HitEvents would be per-lookup
+        spam, so only a program's FIRST reuse emits one. Needs an active
+        query context to find a logger; bankless paths stay silent."""
+        if hit and not first_reuse:
+            return
+        from .context import active_context
+        ctx = active_context()
+        if ctx is None or ctx.session is None:
+            return
+        try:
+            from ..telemetry.events import (ProgramBankHitEvent,
+                                            ProgramBankMissEvent)
+            from ..telemetry.logging import get_logger
+            from ..util import hashing
+            digest = hashing.md5_hex(repr(stage_key))[:12]
+            cls = ProgramBankHitEvent if hit else ProgramBankMissEvent
+            get_logger(ctx.session.hs_conf.event_logger_class()).log_event(
+                cls(message=("program bank " + ("reuse" if hit else "new")
+                             + f" stage {digest} shapes {shape_vec}"),
+                    stage_digest=digest, shape_vec=list(shape_vec),
+                    hits=self.hits, misses=self.misses))
+        except Exception:
+            pass  # observability must never fail an execution
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "stages": len(self._stages),
+                "programs": self.program_count,
+                "hits": self.hits,
+                "misses": self.misses,
+                "stage_evictions": self.stage_evictions,
+            }
+
+    def clear(self) -> None:
+        """Drop every wrapper (tests; a clear() re-traces every hot
+        stage — never on a serving path)."""
+        with self._lock:
+            self._stages.clear()
+            self.program_count = 0
+
+
+_BANK: Optional[ProgramBank] = None
+_BANK_LOCK = threading.Lock()
+
+
+def get_bank() -> ProgramBank:
+    global _BANK
+    if _BANK is None:
+        with _BANK_LOCK:
+            if _BANK is None:
+                _BANK = ProgramBank()
+    return _BANK

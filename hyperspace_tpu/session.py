@@ -58,9 +58,14 @@ class Session:
         # temp-view registry version (any view change flips it).
         self._result_cache_holder = CacheWithTransform(
             self.hs_conf.result_cache_conf_string, self._build_result_cache)
-        # CacheWithTransform itself is not thread-safe; the holder is
-        # probed on every execute() of the multi-threaded serving path.
+        # CacheWithTransform carries its own lock (config.py), but the
+        # holder's build function touches session state: keep the outer
+        # lock for the multi-threaded serving path's execute() probes.
         self._result_cache_lock = threading.Lock()
+        # Temp views: eager dict + lock — registrations can race with
+        # serving-path sql() lowering reading the registry version.
+        self._temp_views: Dict[str, LogicalPlan] = {}
+        self._views_lock = threading.Lock()
         self._temp_views_version = 0
         # Advisor state: the in-session workload log (advisor/workload.py
         # — created eagerly: a lazy check-then-create would race between
@@ -135,29 +140,29 @@ class Session:
     def create_temp_view(self, name: str, df: "DataFrame",
                          replace: bool = False) -> None:
         key = name.lower()
-        views = getattr(self, "_temp_views", None)
-        if views is None:
-            views = self._temp_views = {}
-        if key in views and not replace:
-            raise HyperspaceException(f"Temp view already exists: {name}")
-        views[key] = df.plan
-        self._temp_views_version += 1
+        with self._views_lock:
+            if key in self._temp_views and not replace:
+                raise HyperspaceException(
+                    f"Temp view already exists: {name}")
+            self._temp_views[key] = df.plan
+            self._temp_views_version += 1
 
     def table(self, name: str) -> "DataFrame":
         """DataFrame over a registered temp view. The view shares the
         underlying plan, so index rewrites (signatures are plan+file
         based) apply exactly as they do on the original DataFrame."""
-        views = getattr(self, "_temp_views", {})
         key = name.lower()
-        if key not in views:
+        with self._views_lock:
+            plan = self._temp_views.get(key)
+        if plan is None:
             raise HyperspaceException(f"No such temp view: {name}")
-        return DataFrame(self, views[key])
+        return DataFrame(self, plan)
 
     def drop_temp_view(self, name: str) -> bool:
-        views = getattr(self, "_temp_views", {})
-        dropped = views.pop(name.lower(), None) is not None
-        if dropped:
-            self._temp_views_version += 1
+        with self._views_lock:
+            dropped = self._temp_views.pop(name.lower(), None) is not None
+            if dropped:
+                self._temp_views_version += 1
         return dropped
 
     # ------------------------------------------------------------------
@@ -248,27 +253,43 @@ class Session:
             plan = apply_hyperspace(self, plan, ctx)
         return prune_partitions(plan)
 
-    def execute(self, plan: LogicalPlan):
-        if not self.hs_conf.advisor_capture_enabled():
-            return self._execute_uncaptured(plan)
-        # Advisor workload capture (advisor/workload.py): time whatever
-        # path actually runs and record the canonical plan + shapes +
-        # applied indexes. Resetting the reason collector first makes
-        # ``applied`` attributable to THIS execution (a result-cache hit
-        # runs no rewrite pass and records an empty applied set).
-        self._last_reason_collector = None
-        t0 = time.perf_counter()
-        table = self._execute_uncaptured(plan)
-        from .advisor.workload import capture_execution
-        capture_execution(self, plan, time.perf_counter() - t0)
-        return table
+    def execute(self, plan: LogicalPlan, context=None):
+        """Execute a plan under an explicit :class:`QueryContext`
+        (serving/context.py). The context pins the per-query state that
+        used to be implicit session attributes — result-cache handle,
+        capture decision, io attribution — so the serving frontend can
+        thread many concurrent queries (possibly sharing a process-wide
+        cache) through shared worker threads. Callers that pass no
+        context get a session-scoped one per call."""
+        from .serving.context import QueryContext
+        ctx = context if context is not None \
+            else QueryContext.for_session(self)
+        with ctx.activate():
+            if not ctx.capture:
+                return self._execute_uncaptured(plan, ctx)
+            # Advisor workload capture (advisor/workload.py): time
+            # whatever path actually runs and record the canonical plan
+            # + shapes + applied indexes. Resetting the reason collector
+            # first makes ``applied`` attributable to THIS execution (a
+            # result-cache hit runs no rewrite pass and records an empty
+            # applied set).
+            self._last_reason_collector = None
+            t0 = time.perf_counter()
+            table = self._execute_uncaptured(plan, ctx)
+            from .advisor.workload import capture_execution
+            capture_execution(self, plan, time.perf_counter() - t0)
+            return table
 
-    def _execute_uncaptured(self, plan: LogicalPlan):
-        cache = self.result_cache
+    def _execute_uncaptured(self, plan: LogicalPlan, ctx=None):
+        cache = ctx.result_cache if ctx is not None else self.result_cache
         if cache is not None:
             # Serving path: probe the result cache first — a hit skips
             # the rewrite batch AND execution (serving/result_cache.py);
-            # a miss executes below and runs the admission policy.
+            # a miss executes below and runs the admission policy. With
+            # a frontend-owned context the cache may be the process-wide
+            # CROSS-SESSION one — its keys pin plan, sources, index log
+            # versions, and this session's conf hash, so sharing is safe
+            # by construction.
             from .serving.result_cache import execute_with_cache
             return execute_with_cache(self, cache, plan)
         return self._run_optimized(self.optimize(plan))

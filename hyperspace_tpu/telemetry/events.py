@@ -220,6 +220,68 @@ class CardinalityEstimateEvent(HyperspaceEvent):
 
 
 @dataclass
+class ServingAdmitEvent(HyperspaceEvent):
+    """Emitted per query the serving frontend admits
+    (serving/frontend.py). ``estimated_bytes`` is the admission-control
+    recompute-input estimate; ``queue_depth`` the queue length after the
+    enqueue."""
+
+    client: str = ""
+    estimated_bytes: int = 0
+    queue_depth: int = 0
+
+
+@dataclass
+class ServingRejectEvent(HyperspaceEvent):
+    """Emitted per submission admission control refuses (queue at
+    ``serving.queueDepth`` or in-flight bytes past
+    ``serving.admission.maxBytes``); the caller sees a
+    ServingRejectedError carrying the same ``reason``."""
+
+    client: str = ""
+    estimated_bytes: int = 0
+    reason: str = ""
+
+
+@dataclass
+class ServingBatchEvent(HyperspaceEvent):
+    """Emitted per executed literal-sweep batch (serving/batcher.py):
+    ``size`` member queries collapsed onto ``sweep_invocations`` batched
+    predicate invocations over ``shared_scans`` shared source reads;
+    ``positions`` is how many Filter positions the template swept."""
+
+    size: int = 0
+    positions: int = 0
+    sweep_invocations: int = 0
+    shared_scans: int = 0
+
+
+@dataclass
+class ProgramBankEvent(HyperspaceEvent):
+    """Base of the compiled-program-bank events
+    (serving/program_bank.py). ``stage_digest`` identifies the stage
+    fingerprint; ``shape_vec`` the shape-class vector; ``hits``/
+    ``misses`` are the bank's running totals at emission time."""
+
+    stage_digest: str = ""
+    shape_vec: List[int] = field(default_factory=list)
+    hits: int = 0
+    misses: int = 0
+
+
+@dataclass
+class ProgramBankMissEvent(ProgramBankEvent):
+    """A new (stage, shape-class vector) program registered — a backend
+    compile is expected right after."""
+
+
+@dataclass
+class ProgramBankHitEvent(ProgramBankEvent):
+    """A program's FIRST reuse (later reuses only bump the counters —
+    per-lookup events would swamp the log on a warm serving path)."""
+
+
+@dataclass
 class IndexCacheProbeEvent(HyperspaceEvent):
     """Base of the HBM index-table-cache probe events: the executor emits
     one per IndexScan cache lookup (execution/index_cache.py counts were

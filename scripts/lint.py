@@ -152,6 +152,92 @@ def thread_sites(tree: ast.AST) -> list:
     return sorted(set(out))
 
 
+# Shared-state discipline (the serving refactor's ratchet): module-level
+# MUTABLE containers (dict/list/set literals or constructor calls) are
+# process-global shared state — invisible to the per-query accounting,
+# unguarded against the multi-threaded serving path, and unclearable by
+# construction. New cross-query state must live in QueryContext
+# (serving/context.py) or one of the sanctioned frontend registries
+# (program bank, frontend queue, io pools). This list is FROZEN: it
+# names the files that already held module-level mutable state when the
+# gate landed (pre-serving legacy caches and the sanctioned registries);
+# nothing gets added.
+MUTABLE_STATE_ALLOWLIST = frozenset({
+    "hyperspace_tpu/execution/executor.py",       # CHUNK_SCAN_STATS
+    "hyperspace_tpu/execution/shapes.py",         # compile counters
+    "hyperspace_tpu/index/data_store.py",         # scheme registry+cache
+    "hyperspace_tpu/index/log_store.py",          # scheme registry
+    "hyperspace_tpu/ops/index_build.py",          # CHUNK_STATS
+    "hyperspace_tpu/parallel/io.py",              # pool stats (sanctioned)
+    "hyperspace_tpu/rules/data_skipping_rule.py",  # sketch-table cache
+    "hyperspace_tpu/serving/program_bank.py",     # THE program registry
+    "hyperspace_tpu/sources/default.py",          # format-suffix registry
+    "hyperspace_tpu/telemetry/logging.py",        # logger instance memo
+})
+
+_MUTABLE_CALLS = {"dict", "list", "set", "OrderedDict", "defaultdict",
+                  "deque", "Counter"}
+_MUTATOR_METHODS = {"append", "appendleft", "add", "update", "setdefault",
+                    "pop", "popitem", "clear", "extend", "insert",
+                    "remove", "discard", "move_to_end"}
+
+
+def _mutated_names(tree: ast.AST) -> set:
+    """Names the module writes THROUGH (``x[k] = ...``, ``x.append(...)``,
+    ``del x[k]``, ``x += ...``) — the signature of a container used as
+    state rather than as a constant lookup table."""
+    out = set()
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.Assign, ast.AugAssign)):
+            targets = node.targets if isinstance(node, ast.Assign) \
+                else [node.target]
+            for t in targets:
+                if isinstance(t, ast.Subscript) \
+                        and isinstance(t.value, ast.Name):
+                    out.add(t.value.id)
+        elif isinstance(node, ast.Delete):
+            for t in node.targets:
+                if isinstance(t, ast.Subscript) \
+                        and isinstance(t.value, ast.Name):
+                    out.add(t.value.id)
+        elif isinstance(node, ast.Call) \
+                and isinstance(node.func, ast.Attribute) \
+                and node.func.attr in _MUTATOR_METHODS \
+                and isinstance(node.func.value, ast.Name):
+            out.add(node.func.value.id)
+    return out
+
+
+def mutable_state_sites(tree: ast.AST) -> list:
+    """(line, name) of module-level mutable containers the module also
+    MUTATES — process-global shared state. Constant lookup tables
+    (dicts/sets never written through) and ContextVar/Lock plumbing stay
+    allowed everywhere."""
+    mutated = _mutated_names(tree)
+    out = []
+    for node in tree.body:
+        if isinstance(node, ast.Assign):
+            targets, value = node.targets, node.value
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            targets, value = [node.target], node.value
+        else:
+            continue
+        names = [t.id for t in targets if isinstance(t, ast.Name)]
+        if not names or names == ["__all__"]:
+            continue
+        mutable = isinstance(value, (ast.List, ast.Dict, ast.Set,
+                                     ast.ListComp, ast.DictComp,
+                                     ast.SetComp))
+        if not mutable and isinstance(value, ast.Call):
+            f = value.func
+            callee = f.id if isinstance(f, ast.Name) else (
+                f.attr if isinstance(f, ast.Attribute) else "")
+            mutable = callee in _MUTABLE_CALLS
+        if mutable and any(n in mutated for n in names):
+            out.append((node.lineno, names[0]))
+    return out
+
+
 # Telemetry-coverage discipline: every event class defined in
 # telemetry/events.py must be referenced somewhere under tests/ — an
 # event no test ever observes is unverified observability (the
@@ -248,6 +334,14 @@ def main() -> int:
                     f"{rel}:{line}: jax.jit outside the instrumented "
                     "kernel modules; add the jitted stage to ops/kernels.py "
                     "so the compile counter sees it")
+        if any(rel.startswith(d + os.sep) for d in PACKAGE_DIRS) \
+                and rel.replace(os.sep, "/") not in MUTABLE_STATE_ALLOWLIST:
+            for line, name in mutable_state_sites(tree):
+                problems.append(
+                    f"{rel}:{line}: module-level mutable state '{name}'; "
+                    "cross-query state belongs in QueryContext "
+                    "(serving/context.py) or a sanctioned frontend "
+                    "registry (see MUTABLE_STATE_ALLOWLIST)")
         if any(rel.startswith(d + os.sep) for d in PACKAGE_DIRS) \
                 and rel.replace(os.sep, "/") not in THREAD_SITE_ALLOWLIST:
             for line in thread_sites(tree):

@@ -1,0 +1,750 @@
+"""Concurrent serving tier (serving/{context,program_bank,batcher,frontend}).
+
+Covers the subsystem's contract end to end: the explicit QueryContext
+threading (result-cache pinning, per-query io attribution, locked
+session write-backs), the process-wide compiled-program bank (two
+sessions share one warm workload's compiles), admission control
+(queueDepth / admission.maxBytes rejection with events), cross-query
+literal batching (N variants -> 1 batched invocation, byte-identical
+per-query results), cross-session result-cache sharing, the
+thread-safety hammer for session state concurrent execute() touches,
+and the mixed TPC-H/TPC-DS concurrency soak (M threads x K queries
+identical to serial execution).
+
+All sessions pin ``hyperspace.tpu.distributed.enabled=false``: the
+virtual 8-device SPMD path depends on jax APIs absent from this image's
+jax build (the known environmental tier-1 failure set).
+"""
+
+import os
+import threading
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+import hyperspace_tpu as hst
+from hyperspace_tpu.api import Hyperspace
+from hyperspace_tpu.exceptions import ServingRejectedError
+from hyperspace_tpu.index.constants import IndexConstants
+from hyperspace_tpu.plan.expr import col, sum_
+from hyperspace_tpu.serving import batcher
+from hyperspace_tpu.serving.constants import ServingConstants
+from hyperspace_tpu.serving.context import QueryContext, active_context
+from hyperspace_tpu.serving.frontend import PendingQuery, ServingFrontend
+from hyperspace_tpu.serving.program_bank import ProgramBank, get_bank
+
+from conftest import capture_logger
+
+
+def _write(d, n=4000, seed=7, files=1):
+    rng = np.random.default_rng(seed)
+    df = pd.DataFrame({
+        "k": rng.integers(0, 50, n).astype(np.int64),
+        "v": rng.integers(0, 9, n).astype(np.int64),
+    })
+    os.makedirs(str(d), exist_ok=True)
+    step = max(n // files, 1)
+    for i in range(files):
+        lo = i * step
+        hi = (i + 1) * step if i < files - 1 else n
+        pq.write_table(pa.Table.from_pandas(df.iloc[lo:hi]),
+                       os.path.join(str(d), f"p{i}.parquet"))
+    return df
+
+
+def _session(tmp_path, capture_events=False, **conf):
+    session = hst.Session(system_path=str(tmp_path / "indexes"))
+    session.conf.set(IndexConstants.INDEX_NUM_BUCKETS, 4)
+    session.conf.set(IndexConstants.TPU_DISTRIBUTED_ENABLED, "false")
+    if capture_events:
+        session.conf.set(IndexConstants.EVENT_LOGGER_CLASS,
+                         "tests.conftest.CaptureLogger")
+    for k, v in conf.items():
+        session.conf.set(k, v)
+    return session
+
+
+def _variants(session, path, n=8):
+    """n literal-variant aggregation queries (one canonical template)."""
+    r = session.read.parquet(str(path))
+    return [r.filter(col("k") < i + 3).group_by("k")
+            .agg(sum_(col("v")).alias("sv")).sort("k")
+            for i in range(n)]
+
+
+class _GatedSession(hst.Session):
+    """Session whose execute() blocks until released — deterministic
+    queue-occupancy control for the admission tests."""
+
+    def __init__(self, *a, **kw):
+        super().__init__(*a, **kw)
+        self.gate = threading.Event()
+
+    def execute(self, plan, context=None):
+        assert self.gate.wait(timeout=60), "gate never released"
+        return super().execute(plan, context)
+
+
+def _wait_until(pred, timeout=30.0):
+    import time
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(0.005)
+    return False
+
+
+# ---------------------------------------------------------------------------
+# QueryContext: the explicit per-query state object.
+# ---------------------------------------------------------------------------
+
+class TestQueryContext:
+    def test_execute_activates_a_context(self, tmp_path):
+        from hyperspace_tpu import session as session_mod
+        _write(tmp_path / "d")
+        session = _session(tmp_path)
+        seen = {}
+        df = session.read.parquet(str(tmp_path / "d"))
+        orig = session_mod.Session._run_optimized
+
+        def spy(self, optimized):
+            seen["ctx"] = active_context()
+            return orig(self, optimized)
+
+        session_mod.Session._run_optimized = spy
+        try:
+            df.filter(col("k") < 5).count()
+        finally:
+            session_mod.Session._run_optimized = orig
+        assert isinstance(seen["ctx"], QueryContext)
+        assert seen["ctx"].session is session
+        assert active_context() is None  # deactivated after execute
+
+    def test_explicit_context_pins_the_result_cache(self, tmp_path):
+        """A context-carried cache overrides the session's own (the
+        frontend's cross-session sharing mechanism)."""
+        from hyperspace_tpu.serving.result_cache import ResultCache
+        _write(tmp_path / "d")
+        session = _session(tmp_path)
+        shared = ResultCache(device_bytes=1 << 24, host_bytes=1 << 24)
+        df = session.read.parquet(str(tmp_path / "d")).filter(col("k") < 9)
+        ctx = QueryContext(session, result_cache=shared)
+        with ctx.activate():
+            t1 = session.execute(df.plan, context=ctx)
+        assert session.result_cache is None  # session flag still off
+        s = shared.stats()
+        assert s["misses"] == 1 and s["admissions"] == 1
+        ctx2 = QueryContext(session, result_cache=shared)
+        t2 = session.execute(df.plan, context=ctx2)
+        assert shared.stats()["hits"] == 1
+        assert t1.to_arrow().equals(t2.to_arrow())
+
+    def test_join_actual_recorded_through_context(self, tmp_path):
+        _write(tmp_path / "a", seed=1)
+        _write(tmp_path / "b", seed=2)
+        session = _session(tmp_path)
+        a = session.read.parquet(str(tmp_path / "a"))
+        b = session.read.parquet(str(tmp_path / "b"))
+        q = a.join(b.select(col("k").alias("k2"), col("v").alias("v2")),
+                   on=col("k") == col("k2"))
+        q.count()
+        assert len(session._join_actuals) == 1
+
+
+# ---------------------------------------------------------------------------
+# Program bank: explicit, bounded, instrumented, process-wide.
+# ---------------------------------------------------------------------------
+
+class TestProgramBank:
+    def test_hit_miss_accounting(self):
+        bank = ProgramBank(max_stages=2)
+        made = []
+        fn = bank.lookup(("s1",), (128,), lambda: made.append(1) or
+                         (lambda *a: "r1"))
+        assert fn() == "r1" and made == [1]
+        # Same stage, same shapes: hit, factory NOT called again.
+        bank.lookup(("s1",), (128,), lambda: made.append(2))
+        assert made == [1]
+        # Same stage, NEW shape class: miss (a compile is expected).
+        bank.lookup(("s1",), (256,), lambda: made.append(3))
+        assert made == [1]
+        s = bank.stats()
+        assert s == {"stages": 1, "programs": 2, "hits": 1, "misses": 2,
+                     "stage_evictions": 0}
+
+    def test_lru_stage_eviction(self):
+        bank = ProgramBank(max_stages=2)
+        for i in range(3):
+            bank.lookup((f"s{i}",), (1,), lambda: object())
+        s = bank.stats()
+        assert s["stages"] == 2 and s["stage_evictions"] == 1
+
+    def test_two_sessions_share_warm_programs(self, tmp_path):
+        """THE multi-tenant acceptance: total compiles for two sessions
+        running the same warm workload stay within 1.2x one session's
+        compile count (the bank + jax executable cache are
+        process-wide)."""
+        from hyperspace_tpu.execution import shapes
+        _write(tmp_path / "d", n=6000, seed=11)
+
+        def workload(session):
+            r = session.read.parquet(str(tmp_path / "d"))
+            out = []
+            for i in (2, 5, 9):
+                out.append(r.filter((col("k") < 30 + i) & (col("v") > 1))
+                           .group_by("k")
+                           .agg(sum_(col("v")).alias("s")).sort("k")
+                           .to_arrow())
+                out.append(r.filter(col("k").isin([i, i + 1, i + 7]))
+                           .select("k", "v").to_arrow())
+            return out
+
+        sess_a = _session(tmp_path)
+        c0 = shapes.compile_count()
+        ref = workload(sess_a)
+        c_a = shapes.compile_count() - c0
+        sess_b = _session(tmp_path)
+        c1 = shapes.compile_count()
+        got = workload(sess_b)
+        c_b = shapes.compile_count() - c1
+        for x, y in zip(ref, got):
+            assert x.equals(y)
+        # Second tenant rides the first tenant's compiles.
+        assert c_a + c_b <= 1.2 * c_a + 1, (c_a, c_b)
+
+    def test_bank_events_observed(self, tmp_path):
+        """ProgramBankMissEvent per new program; ProgramBankHitEvent on
+        first reuse — both through the active context's session logger."""
+        from hyperspace_tpu.telemetry.events import (ProgramBankEvent,
+                                                     ProgramBankHitEvent,
+                                                     ProgramBankMissEvent)
+        assert issubclass(ProgramBankHitEvent, ProgramBankEvent)
+        assert issubclass(ProgramBankMissEvent, ProgramBankEvent)
+        _write(tmp_path / "d", n=512, seed=23)
+        session = _session(tmp_path, capture_events=True)
+        sink = capture_logger()
+        sink.events.clear()
+        r = session.read.parquet(str(tmp_path / "d"))
+        # A fresh predicate structure (column/op mix unused elsewhere in
+        # this module) registers new programs, then reuses them.
+        q1 = r.filter((col("v") >= 3) | (col("k") == 7))
+        q2 = r.filter((col("v") >= 5) | (col("k") == 9))
+        q1.count()
+        q2.count()
+        names = [type(e).__name__ for e in sink.events]
+        assert "ProgramBankMissEvent" in names
+        assert "ProgramBankHitEvent" in names
+        ev = next(e for e in sink.events
+                  if type(e).__name__ == "ProgramBankMissEvent")
+        assert ev.stage_digest and ev.shape_vec
+
+
+# ---------------------------------------------------------------------------
+# Admission control.
+# ---------------------------------------------------------------------------
+
+class TestAdmission:
+    def test_queue_depth_rejection(self, tmp_path):
+        _write(tmp_path / "d")
+        session = _GatedSession(system_path=str(tmp_path / "indexes"))
+        session.conf.set(IndexConstants.TPU_DISTRIBUTED_ENABLED, "false")
+        session.conf.set(IndexConstants.EVENT_LOGGER_CLASS,
+                         "tests.conftest.CaptureLogger")
+        session.conf.set(ServingConstants.SERVING_QUEUE_DEPTH, "1")
+        session.conf.set(ServingConstants.SERVING_MAX_CONCURRENCY, "1")
+        session.conf.set(ServingConstants.SERVING_BATCHING_ENABLED,
+                         "false")
+        sink = capture_logger()
+        sink.events.clear()
+        fe = ServingFrontend(session)
+        qs = _variants(session, tmp_path / "d", 3)
+        p1 = fe.submit(qs[0])
+        # The worker must have TAKEN q1 (it blocks inside execute).
+        assert _wait_until(lambda: fe.stats()["queued"] == 0
+                           and fe.stats()["active_workers"] == 1)
+        p2 = fe.submit(qs[1])          # fills the depth-1 queue
+        with pytest.raises(ServingRejectedError) as err:
+            fe.submit(qs[2])
+        assert "queue full" in str(err.value)
+        st = fe.stats()
+        assert st["rejected"] == 1 and st["admitted"] == 2
+        names = [type(e).__name__ for e in sink.events]
+        assert names.count("ServingAdmitEvent") == 2
+        assert names.count("ServingRejectEvent") == 1
+        session.gate.set()
+        assert p1.result(timeout=60).num_rows >= 0
+        assert p2.result(timeout=60).num_rows >= 0
+        fe.drain()
+
+    def test_worker_survives_bad_conf(self, tmp_path):
+        """A mid-drain error (malformed batching.window) lands on the
+        query's future instead of killing the worker — no leaked
+        active_workers / inflight_bytes, and the frontend keeps serving
+        once the conf is fixed."""
+        _write(tmp_path / "d", seed=97)
+        session = _session(tmp_path)
+        session.conf.set(ServingConstants.SERVING_BATCHING_WINDOW, "0.3s")
+        fe = ServingFrontend(session)
+        q = _variants(session, tmp_path / "d", 1)[0]
+        p = fe.submit(q)
+        with pytest.raises(ValueError):
+            p.result(timeout=60)
+        fe.drain()
+        st = fe.stats()
+        assert st["active_workers"] == 0
+        assert st["inflight_bytes"] == 0
+        assert st["failed"] == 1
+        session.conf.set(ServingConstants.SERVING_BATCHING_WINDOW, "0.01")
+        assert fe.submit(q).result(timeout=60).num_rows >= 0
+
+    def test_byte_budget_rejection_but_lone_query_always_runs(
+            self, tmp_path):
+        _write(tmp_path / "d")
+        session = _GatedSession(system_path=str(tmp_path / "indexes"))
+        session.conf.set(IndexConstants.TPU_DISTRIBUTED_ENABLED, "false")
+        session.conf.set(ServingConstants.SERVING_MAX_CONCURRENCY, "1")
+        session.conf.set(ServingConstants.SERVING_BATCHING_ENABLED,
+                         "false")
+        session.conf.set(ServingConstants.SERVING_ADMISSION_MAX_BYTES,
+                         "1")
+        fe = ServingFrontend(session)
+        qs = _variants(session, tmp_path / "d", 2)
+        p1 = fe.submit(qs[0])  # over budget alone, but nothing in flight
+        assert p1.estimated_bytes > 1
+        with pytest.raises(ServingRejectedError) as err:
+            fe.submit(qs[1])
+        assert "byte budget" in str(err.value)
+        session.gate.set()
+        p1.result(timeout=60)
+        fe.drain()
+
+
+# ---------------------------------------------------------------------------
+# Cross-query literal batching.
+# ---------------------------------------------------------------------------
+
+class TestLiteralBatching:
+    def test_template_key_matches_literal_variants_only(self, tmp_path):
+        _write(tmp_path / "d")
+        session = _session(tmp_path)
+        qs = _variants(session, tmp_path / "d", 2)
+        from hyperspace_tpu.serving.fingerprint import normalize
+        k0 = batcher.template_key(session, normalize(qs[0].plan))
+        k1 = batcher.template_key(session, normalize(qs[1].plan))
+        assert k0 is not None and k0 == k1
+        other = session.read.parquet(str(tmp_path / "d")) \
+            .filter(col("v") < 3).group_by("k") \
+            .agg(sum_(col("v")).alias("sv")).sort("k")
+        ko = batcher.template_key(session, normalize(other.plan))
+        assert ko is not None and ko != k0  # different column: no batch
+
+    def test_eight_variants_one_invocation_byte_identical(self, tmp_path):
+        """THE literal-batching acceptance: N=8 literal-variant queries
+        execute as ONE batched invocation (one shared scan, one vmapped
+        sweep) with per-query results identical to serial execution."""
+        _write(tmp_path / "d", n=5000, files=2, seed=31)
+        session = _session(
+            tmp_path, capture_events=True,
+            **{ServingConstants.SERVING_MAX_CONCURRENCY: "1",
+               ServingConstants.SERVING_BATCHING_WINDOW: "0.5"})
+        sink = capture_logger()
+        sink.events.clear()
+        qs = _variants(session, tmp_path / "d", 8)
+        serial = [q.to_arrow() for q in qs]
+        fe = ServingFrontend(session)
+        pend = [fe.submit(q, client=f"user{i}")
+                for i, q in enumerate(qs)]
+        tables = [p.result(timeout=120) for p in pend]
+        for ref, got in zip(serial, tables):
+            assert ref.equals(got.to_arrow())
+        st = fe.stats()
+        assert st["batches"] == 1
+        assert st["batched_queries"] == 8
+        assert st["sweep_invocations"] == 1
+        assert st["shared_scans"] == 1
+        assert st["shared_scan_hits"] == 7
+        assert all(p.batched and p.batch_size == 8 for p in pend)
+        evs = [e for e in sink.events
+               if type(e).__name__ == "ServingBatchEvent"]
+        assert len(evs) == 1
+        assert evs[0].size == 8 and evs[0].sweep_invocations == 1
+        assert evs[0].shared_scans == 1 and evs[0].positions == 1
+
+    def test_batching_disabled_still_identical(self, tmp_path):
+        _write(tmp_path / "d", seed=41)
+        session = _session(
+            tmp_path,
+            **{ServingConstants.SERVING_BATCHING_ENABLED: "false"})
+        qs = _variants(session, tmp_path / "d", 4)
+        serial = [q.to_arrow() for q in qs]
+        fe = ServingFrontend(session)
+        pend = [fe.submit(q) for q in qs]
+        for ref, p in zip(serial, pend):
+            assert ref.equals(p.result(timeout=120).to_arrow())
+        assert fe.stats()["batches"] == 0
+
+    def test_mixed_batchable_and_not(self, tmp_path):
+        """Batchables interleaved with a structurally different query:
+        everyone gets the right answer, non-members run solo."""
+        _write(tmp_path / "d", seed=43)
+        session = _session(
+            tmp_path,
+            **{ServingConstants.SERVING_MAX_CONCURRENCY: "1",
+               ServingConstants.SERVING_BATCHING_WINDOW: "0.4"})
+        r = session.read.parquet(str(tmp_path / "d"))
+        qs = _variants(session, tmp_path / "d", 3)
+        solo = r.filter(col("v") >= 4).select("v").sort("v").limit(5)
+        batch = [qs[0], solo, qs[1], qs[2]]
+        serial = [q.to_arrow() for q in batch]
+        fe = ServingFrontend(session)
+        pend = [fe.submit(q) for q in batch]
+        for ref, p in zip(serial, pend):
+            assert ref.equals(p.result(timeout=120).to_arrow())
+        assert not pend[1].batched
+
+    def test_float32_literals_byte_identical(self, tmp_path):
+        """The stacked literal matrix must reproduce the single-query
+        path's WEAK-scalar promotion: a python float literal casts DOWN
+        to a float32 column there, so a strong float64 matrix (numpy's
+        default) would promote the column instead and flip comparisons
+        near the f32 rounding boundary (f32(1.1) > 1.1 is False weakly,
+        True in float64)."""
+        d = tmp_path / "d"
+        os.makedirs(str(d))
+        vals = np.asarray([1.1, 1.0999999, 1.1000001, 0.5, 2.0] * 800,
+                          dtype=np.float32)
+        pq.write_table(
+            pa.table({"x": pa.array(vals, type=pa.float32()),
+                      "k": pa.array(np.arange(vals.size) % 7,
+                                    type=pa.int64())}),
+            os.path.join(str(d), "p.parquet"))
+        session = _session(
+            tmp_path,
+            **{ServingConstants.SERVING_MAX_CONCURRENCY: "1",
+               ServingConstants.SERVING_BATCHING_WINDOW: "0.4"})
+        lits = [1.1, 1.1000001, 1.0999999, 1.1, 0.5, 1.1, 2.0, 1.1]
+        qs = [session.read.parquet(str(d)).filter(col("x") > v).select("k")
+              for v in lits]
+        serial = [q.to_arrow() for q in qs]
+        fe = ServingFrontend(session)
+        pend = [fe.submit(q) for q in qs]
+        tables = [p.result(timeout=120) for p in pend]
+        for ref, got in zip(serial, tables):
+            assert ref.equals(got.to_arrow())
+        st = fe.stats()
+        assert st["batches"] == 1 and st["sweep_invocations"] == 1, st
+
+
+# ---------------------------------------------------------------------------
+# Cross-session result-cache sharing.
+# ---------------------------------------------------------------------------
+
+class TestSharedResultCache:
+    def test_tenant_b_hits_tenant_a_result(self, tmp_path):
+        _write(tmp_path / "d", seed=53)
+        conf = {
+            ServingConstants.RESULT_CACHE_ENABLED: "true",
+            ServingConstants.RESULT_CACHE_MIN_COMPUTE_SECONDS: "0",
+            ServingConstants.SERVING_BATCHING_ENABLED: "false",
+        }
+        gov = _session(tmp_path, **conf)
+        sess_a = _session(tmp_path, **conf)
+        sess_b = _session(tmp_path, **conf)
+        fe = ServingFrontend(gov)
+        qa = _variants(sess_a, tmp_path / "d", 1)[0]
+        qb = _variants(sess_b, tmp_path / "d", 1)[0]
+        ta = fe.submit(qa).result(timeout=120)
+        fe.drain()
+        tb = fe.submit(qb).result(timeout=120)
+        shared = fe.result_cache()
+        s = shared.stats()
+        assert s["admissions"] == 1
+        assert s["hits"] == 1, s  # tenant B served tenant A's bytes
+        assert ta.to_arrow().equals(tb.to_arrow())
+        # The sessions' OWN caches never saw the traffic: the context
+        # carried the frontend's shared instance.
+        assert sess_a.result_cache.stats()["misses"] == 0
+        assert sess_b.result_cache.stats()["misses"] == 0
+
+
+# ---------------------------------------------------------------------------
+# Per-query io attribution (satellite: contextvars into pool workers).
+# ---------------------------------------------------------------------------
+
+class TestIoAttribution:
+    def test_reads_attributed_to_the_right_query(self, tmp_path):
+        _write(tmp_path / "small", n=2000, files=2, seed=61)
+        _write(tmp_path / "big", n=12000, files=6, seed=62)
+        session = _session(
+            tmp_path,
+            **{IndexConstants.TPU_IO_THREADS: "8",
+               ServingConstants.SERVING_MAX_CONCURRENCY: "2",
+               ServingConstants.SERVING_BATCHING_ENABLED: "false"})
+        small = session.read.parquet(str(tmp_path / "small")) \
+            .filter(col("k") < 10)
+        big = session.read.parquet(str(tmp_path / "big")) \
+            .filter(col("k") < 10)
+        fe = ServingFrontend(session)
+        ps = fe.submit(small, client="small")
+        pb = fe.submit(big, client="big")
+        ps.result(timeout=120)
+        pb.result(timeout=120)
+        io_s = ps.context.io_stats()
+        io_b = pb.context.io_stats()
+        # Worker threads entered the submitters' copied contexts, so
+        # each query's reads landed on ITS context, proportionally.
+        assert io_s["read_tasks"] > 0
+        assert io_b["read_tasks"] > 0
+        assert io_b["read_bytes"] > io_s["read_bytes"]
+
+    def test_direct_execute_attributes_too(self, tmp_path):
+        _write(tmp_path / "d", n=4000, files=4, seed=63)
+        session = _session(tmp_path,
+                           **{IndexConstants.TPU_IO_THREADS: "4"})
+        df = session.read.parquet(str(tmp_path / "d")).filter(col("k") < 7)
+        ctx = QueryContext.for_session(session)
+        session.execute(df.plan, context=ctx)
+        assert ctx.io_stats()["read_tasks"] > 0
+
+
+# ---------------------------------------------------------------------------
+# Session-state thread safety (satellite: the hammer).
+# ---------------------------------------------------------------------------
+
+class TestSessionThreadSafety:
+    def test_concurrent_execute_hammer(self, tmp_path):
+        """8 threads x joins+filters on ONE session with advisor capture
+        on: the workload log, join-actual LRU, sql-plan memo and
+        result-cache holder must neither corrupt nor raise."""
+        from hyperspace_tpu.advisor.constants import AdvisorConstants
+        _write(tmp_path / "a", seed=71)
+        _write(tmp_path / "b", seed=72)
+        session = _session(tmp_path)
+        session.conf.set(AdvisorConstants.CAPTURE_ENABLED, "true")
+        a = session.read.parquet(str(tmp_path / "a"))
+        b = session.read.parquet(str(tmp_path / "b"))
+        per_thread = 6
+        errors = []
+
+        def worker(tid):
+            try:
+                for i in range(per_thread):
+                    q = a.filter(col("k") < 10 + tid + i).join(
+                        b.select(col("k").alias("k2"), col("v").alias("v2")),
+                        on=col("k") == col("k2"))
+                    q.count()
+            except BaseException as e:  # pragma: no cover
+                errors.append(e)
+
+        threads = [threading.Thread(target=worker, args=(t,))
+                   for t in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=300)
+            assert not t.is_alive(), "hammer thread hung"
+        assert not errors, errors
+        assert len(session._workload_log) == 8 * per_thread
+        assert len(session._join_actuals) > 0
+        for rec in session._workload_log.snapshot():
+            assert rec.latency_s >= 0
+
+    def test_temp_view_registration_is_locked(self, tmp_path):
+        _write(tmp_path / "d", seed=73)
+        session = _session(tmp_path)
+        df = session.read.parquet(str(tmp_path / "d"))
+        errors = []
+
+        def worker(tid):
+            try:
+                for i in range(50):
+                    session.create_temp_view(f"v_{tid}_{i}", df)
+                    assert session.table(f"v_{tid}_{i}") is not None
+            except BaseException as e:  # pragma: no cover
+                errors.append(e)
+
+        threads = [threading.Thread(target=worker, args=(t,))
+                   for t in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+        assert not errors, errors
+        assert len(session._temp_views) == 8 * 50
+        assert session._temp_views_version == 8 * 50
+
+
+# ---------------------------------------------------------------------------
+# Mixed TPC-H / TPC-DS concurrency soak.
+# ---------------------------------------------------------------------------
+
+SOAK_QUERIES = ["tpch_q1", "tpch_q3", "tpch_q6", "tpch_q12",
+                "tpcds_q1_like", "tpcds_q3_like", "tpcds_q42_like",
+                "tpch_q17"]
+
+
+class TestConcurrencySoak:
+    @pytest.mark.parametrize("through_frontend", [True, False])
+    def test_m_threads_k_queries_identical_to_serial(
+            self, tmp_path, through_frontend):
+        """M=8 client threads x mixed TPC-H/TPC-DS queries across TWO
+        independent sessions produce answers identical to serial
+        execution — through the frontend and via raw concurrent
+        Session.execute alike; zero deadlocks (hard join timeouts)."""
+        from goldstandard import tpc
+        root = str(tmp_path / "tpc")
+        ref_session = _session(tmp_path)
+        dfs = tpc.register_tables(ref_session, root)
+        serial = {name: tpc.queries(dfs)[name].to_arrow()
+                  for name in SOAK_QUERIES}
+
+        sessions = [_session(tmp_path) for _ in range(2)]
+        plans = []
+        for s in sessions:
+            qdict = tpc.queries(tpc.register_tables(s, root))
+            plans.append({n: qdict[n] for n in SOAK_QUERIES})
+        fe = ServingFrontend(sessions[0]) if through_frontend else None
+
+        results = {}
+        errors = []
+
+        def client(tid):
+            try:
+                session_ix = tid % 2
+                for j, name in enumerate(SOAK_QUERIES):
+                    if (j + tid) % 2 == 0:
+                        continue  # each thread runs half the mix
+                    q = plans[session_ix][name]
+                    if fe is not None:
+                        table = fe.submit(q, client=f"c{tid}") \
+                            .result(timeout=300)
+                    else:
+                        table = q.execute()
+                    results[(tid, name)] = table.to_arrow()
+            except BaseException as e:  # pragma: no cover
+                errors.append((tid, e))
+
+        threads = [threading.Thread(target=client, args=(t,))
+                   for t in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=600)
+            assert not t.is_alive(), "soak client hung (deadlock?)"
+        assert not errors, errors
+        assert len(results) == 8 * len(SOAK_QUERIES) // 2
+        for (tid, name), table in results.items():
+            assert table.equals(serial[name]), \
+                f"thread {tid} query {name} diverged from serial"
+
+
+# ---------------------------------------------------------------------------
+# Observability surfaces.
+# ---------------------------------------------------------------------------
+
+class TestServingObservability:
+    def test_serving_stats_and_explain_section(self, tmp_path):
+        _write(tmp_path / "d", seed=83)
+        session = _session(
+            tmp_path, **{ServingConstants.SERVING_ENABLED: "true"})
+        hs = Hyperspace(session)
+        stats = hs.serving_stats()
+        assert "program_bank" in stats
+        df = session.read.parquet(str(tmp_path / "d")).filter(col("k") < 4)
+        text = hs.explain(df)
+        assert "Serving:" in text
+        assert "program bank:" in text
+        fe = hs.serving_frontend()
+        assert isinstance(fe.submit(df), PendingQuery)
+        fe.drain()
+        stats = hs.serving_stats()
+        assert stats["submitted"] >= 1 and stats["frontend"] is True
+        text = hs.explain(df)
+        assert "queries: submitted=" in text
+
+    def test_disabled_serving_explain_silent(self, tmp_path):
+        _write(tmp_path / "d", seed=89)
+        session = _session(tmp_path)
+        hs = Hyperspace(session)
+        from hyperspace_tpu.serving import frontend as fe_mod
+        if fe_mod._DEFAULT is None:
+            text = hs.explain(
+                session.read.parquet(str(tmp_path / "d")))
+            assert "Serving:" not in text
+
+    def test_default_frontend_requires_enabled(self, tmp_path):
+        from hyperspace_tpu.exceptions import HyperspaceException
+        from hyperspace_tpu.serving.frontend import get_frontend
+        session = _session(tmp_path)
+        with pytest.raises(HyperspaceException):
+            get_frontend(session)
+
+    def test_direct_construction_registers_default(self, tmp_path,
+                                                   monkeypatch):
+        """Construction is the opt-in (README/bench construct directly),
+        so a directly-built frontend must be visible to serving_stats()
+        and explain's Serving section, not just get_frontend()'s."""
+        from hyperspace_tpu.serving import frontend as fe_mod
+        monkeypatch.setattr(fe_mod, "_DEFAULT", None)
+        _write(tmp_path / "d", seed=97)
+        session = _session(tmp_path)
+        fe = ServingFrontend(session)
+        assert fe_mod._DEFAULT is fe
+        df = session.read.parquet(str(tmp_path / "d")).filter(col("k") < 4)
+        fe.submit(df).result(timeout=120)
+        fe.drain()
+        stats = Hyperspace(session).serving_stats()
+        assert stats["frontend"] is True and stats["submitted"] >= 1
+
+
+# ---------------------------------------------------------------------------
+# The lint ratchet (satellite: no new module-level mutable state).
+# ---------------------------------------------------------------------------
+
+class TestMutableStateGate:
+    def _sites(self, src):
+        import ast
+        import importlib.util
+        spec = importlib.util.spec_from_file_location(
+            "lint_under_test",
+            os.path.join(os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__))), "scripts", "lint.py"))
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod.mutable_state_sites(ast.parse(src))
+
+    def test_flags_mutated_module_dict(self):
+        sites = self._sites(
+            "_CACHE = {}\n"
+            "def put(k, v):\n"
+            "    _CACHE[k] = v\n")
+        assert [name for _, name in sites] == ["_CACHE"]
+
+    def test_allows_constant_lookup_tables_and_locals(self):
+        assert self._sites(
+            "_TABLE = {'a': 1}\n"
+            "def f():\n"
+            "    x = []\n"
+            "    x.append(1)\n"
+            "    return _TABLE['a'], x\n") == []
+
+    def test_flags_mutator_methods_and_constructors(self):
+        sites = self._sites(
+            "from collections import OrderedDict\n"
+            "_LRU = OrderedDict()\n"
+            "def touch(k):\n"
+            "    _LRU.move_to_end(k)\n")
+        assert [name for _, name in sites] == ["_LRU"]
+
+    def test_repo_is_clean_under_the_gate(self):
+        import subprocess
+        import sys
+        root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        out = subprocess.run(
+            [sys.executable, os.path.join(root, "scripts", "lint.py")],
+            capture_output=True, text=True)
+        assert "module-level mutable state" not in out.stdout
