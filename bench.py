@@ -789,6 +789,267 @@ def mesh_main(args) -> None:
     print(json.dumps(out))
 
 
+def spmd_main(args) -> None:
+    """SPMD phase child (one per device count): q3/q17 and the index
+    build, distributed on vs off on THIS process's forced-host mesh,
+    with byte-identity asserted and the compiled programs' HLO
+    collective counts reported. Prints ONE JSON line.
+
+    Like the r09 io phase, the speedup numbers are ENVIRONMENT-BOUND in
+    this sandbox: the N virtual devices time-share ~one physical core,
+    so the N-way partitioned program does the same total work plus
+    collective overhead — parity (~1.0x) is the healthy reading here,
+    and the real signal is byte-identity + dispatch + the collective
+    counts (all-to-all present exactly where the exchange was asked
+    for, zero resharding in the co-bucketed join). Real speedups need
+    real multi-chip ICI."""
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+    import hyperspace_tpu as hst
+    from hyperspace_tpu.api import Hyperspace, IndexConfig
+    from hyperspace_tpu.execution import spmd
+    from hyperspace_tpu.index.constants import IndexConstants
+    from hyperspace_tpu.parallel import distributed_build, sharding
+
+    out = {"n_devices": len(jax.devices()), "scale": args.scale}
+    root = tempfile.mkdtemp(prefix="hs_spmd_")
+    try:
+        li_dir, od_dir, pt_dir, n_li, _n_od = make_tpch_like(
+            root, args.scale)
+        session = hst.Session(system_path=os.path.join(root, "indexes"))
+        session.conf.set(IndexConstants.INDEX_NUM_BUCKETS, 16)
+        # One device: the fused single-jit dispatch IS the distributed
+        # path there; force it on (CPU "auto" would skip it).
+        session.conf.set(IndexConstants.TPU_DISTRIBUTED_SINGLE_DEVICE,
+                         "on")
+        hs = Hyperspace(session)
+        li = session.read.parquet(li_dir)
+
+        # ---- index build, distributed vs off ----
+        before = distributed_build.DISPATCH_COUNT
+        hs.create_index(li, IndexConfig(
+            "spmd_li", ["l_orderkey"], ["l_extendedprice", "l_discount"]))
+        out["build_dispatched"] = (
+            distributed_build.DISPATCH_COUNT > before
+            or len(jax.devices()) == 1)  # 1-dev build is single-device
+        out["build_exchange_collectives"] = \
+            distributed_build.last_collectives()
+        hs.delete_index("spmd_li")
+        hs.vacuum_index("spmd_li")
+        t0 = time.perf_counter()
+        hs.create_index(li, IndexConfig(
+            "spmd_li", ["l_orderkey"], ["l_extendedprice", "l_discount"]))
+        out["build_dist_s"] = round(time.perf_counter() - t0, 3)
+        hs.delete_index("spmd_li")
+        hs.vacuum_index("spmd_li")
+        session.conf.set(IndexConstants.TPU_DISTRIBUTED_ENABLED, "false")
+        hs.create_index(li, IndexConfig(
+            "spmd_li", ["l_orderkey"], ["l_extendedprice", "l_discount"]))
+        hs.delete_index("spmd_li")
+        hs.vacuum_index("spmd_li")
+        t0 = time.perf_counter()
+        hs.create_index(li, IndexConfig(
+            "spmd_li", ["l_orderkey"], ["l_extendedprice", "l_discount"]))
+        out["build_single_s"] = round(time.perf_counter() - t0, 3)
+        session.conf.unset(IndexConstants.TPU_DISTRIBUTED_ENABLED)
+        out["build_speedup"] = round(
+            out["build_single_s"] / out["build_dist_s"], 3) \
+            if out["build_dist_s"] else 0.0
+        out["build_rows_per_s_dist"] = round(n_li / out["build_dist_s"], 1)
+
+        # ---- q3 / q17, distributed on vs off, identity ----
+        # Non-float columns (group keys, counts, int sums) compare EXACT;
+        # float64 aggregates compare at rtol 1e-9 — psum merges partial
+        # sums in mesh order, and float addition is not associative, so
+        # last-ulp drift is inherent to ANY distributed sum (the SPMD
+        # test suite codifies the same tolerance).
+        def _tables_identical(a, b):
+            import numpy as _np
+            import pyarrow as _pa
+            if a.column_names != b.column_names or a.num_rows != b.num_rows:
+                return False
+            for cn in a.column_names:
+                ca, cb = a.column(cn), b.column(cn)
+                if _pa.types.is_floating(ca.type):
+                    if not _np.allclose(
+                            ca.to_numpy(zero_copy_only=False),
+                            cb.to_numpy(zero_copy_only=False),
+                            rtol=1e-9, equal_nan=True):
+                        return False
+                elif not ca.equals(cb):
+                    return False
+            return True
+
+        for name, q in (("q3", build_q3(session, li_dir, od_dir)),
+                        ("q17", build_q17(session, li_dir, pt_dir))):
+            before = spmd.DISPATCH_COUNT
+            dist_tbl = q.to_arrow()  # warm + compile
+            out[f"{name}_dispatched"] = spmd.DISPATCH_COUNT > before
+            out[f"{name}_collectives"] = spmd.last_collectives()
+            dist_s = timed_best(lambda: q.to_arrow(), args.repeats)
+            session.conf.set(IndexConstants.TPU_DISTRIBUTED_ENABLED,
+                             "false")
+            single_tbl = q.to_arrow()  # warm single-device path
+            single_s = timed_best(lambda: q.to_arrow(), args.repeats)
+            session.conf.unset(IndexConstants.TPU_DISTRIBUTED_ENABLED)
+            out[f"{name}_identical"] = _tables_identical(dist_tbl,
+                                                         single_tbl)
+            out[f"{name}_dist_s"] = round(dist_s, 4)
+            out[f"{name}_single_s"] = round(single_s, 4)
+            out[f"{name}_speedup"] = round(single_s / dist_s, 3) \
+                if dist_s else 0.0
+        # ---- sort / group micro-probes (the MULTICHIP artifact rows) ----
+        # Distributed ORDER BY is cost-gated OFF on CPU meshes (the host
+        # sort wins there — see spmd._use_spmd_sort); force it on so the
+        # sample-sort path is what gets timed. Key-only projection: rows
+        # tied on the full sort key are interchangeable, so identity
+        # compares the multiset the order actually constrains.
+        from hyperspace_tpu.plan.expr import col, count, sum_
+        cutoff = datetime.date(1995, 6, 1)
+        os.environ["HST_SPMD_SORT"] = "on"
+        try:
+            sq = (li.filter(col("l_shipdate") > cutoff)
+                  .select("l_orderkey", "l_extendedprice")
+                  .sort("l_orderkey", ("l_extendedprice", False)))
+            before = spmd.SORT_DISPATCH_COUNT
+            sort_dist = sq.to_arrow()
+            out["sort_dispatched"] = (spmd.SORT_DISPATCH_COUNT > before)
+            sort_dist_s = timed_best(lambda: sq.to_arrow(), args.repeats)
+        finally:
+            os.environ.pop("HST_SPMD_SORT", None)
+        session.conf.set(IndexConstants.TPU_DISTRIBUTED_ENABLED, "false")
+        sq.to_arrow()
+        sort_single_s = timed_best(lambda: sq.to_arrow(), args.repeats)
+        session.conf.unset(IndexConstants.TPU_DISTRIBUTED_ENABLED)
+        out["sort_identical"] = _tables_identical(sort_dist, sq.to_arrow())
+        out["sort_dist_s"] = round(sort_dist_s, 4)
+        out["sort_single_s"] = round(sort_single_s, 4)
+        out["sort_speedup"] = round(sort_single_s / sort_dist_s, 3) \
+            if sort_dist_s else 0.0
+
+        gq = (li.group_by("l_orderkey")
+              .agg(sum_(col("l_quantity")).alias("sq"),
+                   count(None).alias("n")))
+        before = spmd.DISPATCH_COUNT
+        group_dist = gq.to_arrow()
+        out["group_dispatched"] = spmd.DISPATCH_COUNT > before
+        group_dist_s = timed_best(lambda: gq.to_arrow(), args.repeats)
+        session.conf.set(IndexConstants.TPU_DISTRIBUTED_ENABLED, "false")
+        gq.to_arrow()
+        group_single_s = timed_best(lambda: gq.to_arrow(), args.repeats)
+        session.conf.unset(IndexConstants.TPU_DISTRIBUTED_ENABLED)
+        out["group_identical"] = _tables_identical(group_dist, gq.to_arrow())
+        out["group_dist_s"] = round(group_dist_s, 4)
+        out["group_single_s"] = round(group_single_s, 4)
+        out["group_speedup"] = round(group_single_s / group_dist_s, 3) \
+            if group_dist_s else 0.0
+
+        out["mesh_programs_compiled"] = sharding.COMPILE_COUNT
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+    print(json.dumps(out))
+
+
+def multichip_main(args) -> None:
+    """Write the round's MULTICHIP artifact: one spmd child per forced-
+    host device count in {1, 2, 4} (the count must be pinned before each
+    child's jax init, hence subprocesses), folding every child's
+    sort/group/join(q3)/q17/build timings, speedups vs single-device,
+    identity flags, and compiled-HLO collective counts into ONE json
+    file. r01–r05 artifacts came from a different jax (shard_map-era)
+    and are not comparable — this is the NamedSharding/jit tier's
+    baseline. ~1.0x is the healthy speedup reading on this 1-core
+    sandbox (see spmd_main); identity + collective shape are the signal."""
+    import jax
+
+    artifact = {"round": "r06",
+                "idiom": "NamedSharding+jit (parallel/sharding.py)",
+                "jax_version": jax.__version__,
+                "scale": args.scale,
+                "device_counts": {},
+                "ok": True, "errors": []}
+    for n_dev in (1, 2, 4):
+        env = dict(os.environ)
+        flags = [f for f in env.get("XLA_FLAGS", "").split()
+                 if "xla_force_host_platform_device_count" not in f]
+        env["XLA_FLAGS"] = " ".join(
+            flags + [f"--xla_force_host_platform_device_count={n_dev}"])
+        env["JAX_PLATFORMS"] = "cpu"
+        env.pop("BENCH_CHILD_PARTIAL", None)
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--spmd-devices",
+             str(n_dev), "--scale", str(args.scale),
+             "--repeats", str(args.repeats)],
+            env=env, capture_output=True, text=True, timeout=1800)
+        last = (proc.stdout or "").strip().splitlines()
+        if proc.returncode == 0 and last:
+            child = json.loads(last[-1])
+            artifact["device_counts"][str(n_dev)] = child
+            for probe in ("sort", "group", "q3", "q17"):
+                if child.get(f"{probe}_identical") is False:
+                    artifact["ok"] = False
+                    artifact["errors"].append(
+                        f"d{n_dev}: {probe} distributed != single-device")
+        else:
+            artifact["ok"] = False
+            artifact["errors"].append(
+                f"d{n_dev}: rc={proc.returncode} "
+                f"stderr tail={(proc.stderr or '')[-800:]}")
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "MULTICHIP_r06.json")
+    with open(path, "w") as f:
+        json.dump(artifact, f, indent=2)
+        f.write("\n")
+    print(json.dumps({"multichip_artifact": path, "ok": artifact["ok"],
+                      "errors": artifact["errors"]}))
+
+
+def _run_spmd_phase(scale: float, timeout_s: float) -> None:
+    """Spawn one SPMD child per device count {1, 8} (forced-host CPU —
+    the count must be pinned before the child's jax init) and fold the
+    results into RESULT under spmd_d1_* / spmd_d8_*, plus the headline
+    spmd_speedup / spmd_exchange_collectives / byte-identity flags from
+    the 8-device side. See spmd_main for why ~1.0x is the healthy
+    reading on this 1-core sandbox."""
+    for n_dev in (1, 8):
+        env = dict(os.environ)
+        flags = [f for f in env.get("XLA_FLAGS", "").split()
+                 if "xla_force_host_platform_device_count" not in f]
+        env["XLA_FLAGS"] = " ".join(
+            flags + [f"--xla_force_host_platform_device_count={n_dev}"])
+        env["JAX_PLATFORMS"] = "cpu"
+        env.pop("BENCH_CHILD_PARTIAL", None)
+        out = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--spmd-devices",
+             str(n_dev), "--scale", str(scale)],
+            env=env, capture_output=True, text=True, timeout=timeout_s)
+        last = (out.stdout or "").strip().splitlines()
+        if out.returncode == 0 and last:
+            child = json.loads(last[-1])
+            RESULT[f"spmd_d{n_dev}"] = child
+            for e in child.get("errors", []):
+                RESULT["errors"].append(f"spmd phase d{n_dev}: {e}")
+        else:
+            RESULT["errors"].append(
+                f"spmd phase d{n_dev} rc={out.returncode}; "
+                f"stderr tail={_tail(out.stderr)}")
+    d8 = RESULT.get("spmd_d8", {})
+    if d8:
+        RESULT["spmd_speedup"] = d8.get("q3_speedup", 0.0)
+        RESULT["spmd_q17_speedup"] = d8.get("q17_speedup", 0.0)
+        RESULT["spmd_build_speedup"] = d8.get("build_speedup", 0.0)
+        RESULT["spmd_exchange_collectives"] = d8.get("q3_collectives")
+        for name in ("q3", "q17"):
+            RESULT[f"spmd_{name}_identical"] = d8.get(f"{name}_identical")
+            if not d8.get(f"{name}_identical"):
+                RESULT["errors"].append(
+                    f"spmd phase: {name} distributed/single results differ")
+            if not d8.get(f"{name}_dispatched"):
+                RESULT["errors"].append(
+                    f"spmd phase: {name} SPMD path was not taken")
+
+
 def _run_mesh_phase(scale: float, timeout_s: float) -> None:
     """Spawn the mesh phase with a virtual 8-device CPU platform (env must
     be set before the child's jax import)."""
@@ -1595,6 +1856,12 @@ def main():
     parser.add_argument("--repeats", type=int, default=3)
     parser.add_argument("--mesh", action="store_true",
                         help="internal: run the multi-device phase")
+    parser.add_argument("--spmd-devices", type=int, default=0,
+                        help="internal: run the spmd phase child on this "
+                             "many forced-host devices")
+    parser.add_argument("--multichip", action="store_true",
+                        help="write MULTICHIP_r06.json: spmd children at "
+                             "forced-host device counts {1,2,4}")
     parser.add_argument("--keep", action="store_true")
     parser.add_argument("--backend-timeout", type=float, default=float(
         os.environ.get("BENCH_BACKEND_TIMEOUT", "540")))
@@ -1606,6 +1873,12 @@ def main():
 
     if args.mesh:
         mesh_main(args)
+        return
+    if args.spmd_devices:
+        spmd_main(args)
+        return
+    if args.multichip:
+        multichip_main(args)
         return
 
     global _PARTIAL_PATH
@@ -1671,6 +1944,15 @@ def main():
                 "BENCH_MESH_SCALE", str(min(args.scale, 0.05))))
             _run_mesh_phase(mesh_scale, timeout_s=float(
                 os.environ.get("BENCH_MESH_TIMEOUT", "900")))
+        with _phase("spmd"):
+            # Partitioned-jit SPMD A/B at device_count {1, 8}: identity,
+            # dispatch, and collective counts are the signal; wall-clock
+            # parity is the healthy reading on a 1-core sandbox (see
+            # spmd_main).
+            spmd_scale = float(os.environ.get(
+                "BENCH_SPMD_SCALE", str(min(args.scale, 0.05))))
+            _run_spmd_phase(spmd_scale, timeout_s=float(
+                os.environ.get("BENCH_SPMD_TIMEOUT", "900")))
     finally:
         if not args.keep:
             shutil.rmtree(root, ignore_errors=True)

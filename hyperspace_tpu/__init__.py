@@ -3,7 +3,8 @@
 A ground-up re-design of the capabilities of microsoft/hyperspace (an indexing
 subsystem for Apache Spark) for TPU hardware: covering indexes are built with
 JAX/XLA (hash-partition + sort-within-bucket on device, bucket exchange over
-ICI via shard_map collectives), queries are transparently rewritten to probe
+ICI via mesh-partitioned jit collectives), queries are transparently rewritten
+to probe
 HBM-resident bucketed columnar indexes, and data-skipping sketches are computed
 as on-device reductions — while the operation log and the Parquet index layout
 live on the TPU-VM host filesystem, mirroring the reference's on-disk

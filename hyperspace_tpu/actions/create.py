@@ -158,6 +158,18 @@ class CreateActionBase(Action):
             emit_distributed_fallback(self.session, "index_build",
                                       "empty source table")
             return False
+        # The same cost gate the SPMD query dispatch applies
+        # (distributed.minStreamRows): exchanging a few hundred rows
+        # over an N-device mesh pays compile + collective overhead for
+        # zero scaling win. 0 disables.
+        min_rows = self.session.hs_conf.distributed_min_stream_rows()
+        if 0 < table.num_rows < min_rows:
+            from ..telemetry.logging import emit_distributed_fallback
+            emit_distributed_fallback(
+                self.session, "index_build",
+                f"source {table.num_rows} rows below "
+                f"distributed.minStreamRows {min_rows}")
+            return False
         return True
 
     def _write_index_files_distributed(self, table: Table, indexed: List[str],

@@ -211,6 +211,30 @@ class Hyperspace:
         from .parallel import io as pio
         return pio.pool_stats()
 
+    def spmd_stats(self) -> dict:
+        """Distributed-tier observability (execution/spmd.py over the
+        parallel/sharding launcher): dispatch tallies per path, the mesh
+        the next dispatch would span, how many mesh programs this process
+        compiled, the last program's compiled-HLO collective counts, and
+        the capacity-escalation attempts of the most recent dispatch."""
+        import jax
+
+        from .execution import spmd
+        from .parallel import distributed_build, sharding
+        return {
+            "enabled": self.session.hs_conf.distributed_enabled(),
+            "mesh_devices": spmd._device_count(self.session),
+            "platform": jax.devices()[0].platform,
+            "query_dispatches": spmd.DISPATCH_COUNT,
+            "sort_dispatches": spmd.SORT_DISPATCH_COUNT,
+            "build_dispatches": distributed_build.DISPATCH_COUNT,
+            "mesh_programs_compiled": sharding.COMPILE_COUNT,
+            "last_collectives": spmd.last_collectives(),
+            "last_cap_attempts": spmd.LAST_CAP_ATTEMPTS,
+            "file_aligned_scan":
+                self.session.hs_conf.distributed_mesh_file_aligned_scan(),
+        }
+
     def serving_frontend(self):
         """The process-default concurrent serving frontend
         (serving/frontend.py), created on first use with this session as

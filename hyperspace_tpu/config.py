@@ -20,6 +20,32 @@ from .serving.constants import ServingConstants
 
 T = TypeVar("T")
 
+# Capability probe result, filled on first ask (None = not probed yet).
+_SPMD_CAPABLE: Optional[bool] = None
+
+
+def spmd_capable() -> bool:
+    """True when the mesh-partitioned SPMD tier can run on this image:
+    jax imports, the Mesh/NamedSharding/PartitionSpec sharding API exists,
+    and at least one device is visible. The distributed tier is built
+    entirely on ``jax.jit`` + ``NamedSharding`` (parallel/sharding.py), so
+    this — and NOT the presence of any per-device mapping primitive — is
+    the gating capability. ``distributed_enabled()`` defaults on exactly
+    when this passes; an explicit conf setting always overrides."""
+    global _SPMD_CAPABLE
+    if _SPMD_CAPABLE is None:
+        try:
+            import jax
+            import jax.sharding as _shd
+            _SPMD_CAPABLE = (
+                hasattr(jax, "jit")
+                and all(hasattr(_shd, n) for n in
+                        ("Mesh", "NamedSharding", "PartitionSpec"))
+                and len(jax.devices()) >= 1)
+        except Exception:
+            _SPMD_CAPABLE = False
+    return bool(_SPMD_CAPABLE)
+
 
 class Conf:
     """A mutable string-keyed configuration map (the SparkConf analogue)."""
@@ -177,9 +203,30 @@ class HyperspaceConf:
             IndexConstants.TPU_EXECUTION_ENABLED_DEFAULT)
 
     def distributed_enabled(self) -> bool:
+        """Distributed (mesh-partitioned) execution. An explicit setting
+        always wins; UNSET defaults on exactly when :func:`spmd_capable`
+        says the partitioned-jit tier can run on this image — the
+        capability probe, not a hardcoded default, decides."""
+        v = self._conf.get(IndexConstants.TPU_DISTRIBUTED_ENABLED)
+        if v is not None:
+            return v.strip().lower() == "true"
+        return (IndexConstants.TPU_DISTRIBUTED_ENABLED_DEFAULT == "true"
+                and spmd_capable())
+
+    def distributed_mesh_max_devices(self) -> int:
+        return int(self._conf.get(
+            IndexConstants.TPU_DISTRIBUTED_MESH_MAX_DEVICES,
+            IndexConstants.TPU_DISTRIBUTED_MESH_MAX_DEVICES_DEFAULT))
+
+    def distributed_min_stream_rows(self) -> int:
+        return int(self._conf.get(
+            IndexConstants.TPU_DISTRIBUTED_MIN_STREAM_ROWS,
+            IndexConstants.TPU_DISTRIBUTED_MIN_STREAM_ROWS_DEFAULT))
+
+    def distributed_mesh_file_aligned_scan(self) -> bool:
         return self._get_bool(
-            IndexConstants.TPU_DISTRIBUTED_ENABLED,
-            IndexConstants.TPU_DISTRIBUTED_ENABLED_DEFAULT)
+            IndexConstants.TPU_DISTRIBUTED_MESH_FILE_ALIGNED_SCAN,
+            IndexConstants.TPU_DISTRIBUTED_MESH_FILE_ALIGNED_SCAN_DEFAULT)
 
     def distributed_single_device(self) -> str:
         v = str(self._conf.get(

@@ -15,11 +15,17 @@ Supported plan shapes (checked structurally; any mismatch → fallback):
     [Limit] [Sort] chain of {Filter, Project, Join}* (try_execute_plan —
       └─ Scan | IndexScan                     row-returning stream queries)
 
-Execution model — mask-based streaming with static shapes throughout:
+Execution model — mask-based streaming with static shapes throughout.
+The per-device program launches as ONE mesh-partitioned ``jax.jit``
+through ``parallel/sharding.device_view`` (NamedSharding + sharding
+constraints — see that module) and registers in the serving ProgramBank
+keyed on (stage fingerprint, shape-class vector, mesh signature):
 
 - The leaf table is loaded once and row-sharded over the mesh
-  (``pad_and_shard``); a boolean *keep mask* rides along instead of
-  physically filtering, so every shape stays static under ``shard_map``.
+  (``pad_and_shard``; multi-file parquet scans shard file-aligned —
+  each device's rows come from its own files, read through the parallel
+  reader pool); a boolean *keep mask* rides along instead of physically
+  filtering, so every shape stays static in the partitioned program.
 - Filters AND into the mask; Projects re-evaluate live columns (the
   expression evaluator is shape-preserving and traces cleanly per device).
 - Joins pick one of two strategies per stage, and cover every join type
@@ -70,7 +76,6 @@ path uses — executor._null_aware_keys).
 from __future__ import annotations
 
 import os
-from functools import partial
 from typing import Callable, Dict, List, Optional, Set, Tuple
 
 import jax
@@ -79,7 +84,9 @@ import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ..ops import kernels
-from ..parallel.mesh import DATA_AXIS, make_mesh, pad_and_shard
+from ..parallel.mesh import (DATA_AXIS, make_mesh, pad_and_shard,
+                             pad_and_shard_blocks)
+from ..parallel.sharding import bank_program, device_view, mesh_signature
 from ..plan import expr as E
 from ..plan.nodes import (Aggregate, Filter, IndexScan, Join, LogicalPlan,
                           Project, Scan)
@@ -138,7 +145,7 @@ def _linearize(plan: LogicalPlan):
             raise _Unsupported(node.node_name)
 
 
-def _load_leaf(leaf, stages, needed, executor) -> "Table":
+def _load_leaf(leaf, stages, needed) -> "Table":
     """Materialize the stream leaf, pruning the read when possible.
 
     Filter stages sitting DIRECTLY above the leaf (before any project or
@@ -148,14 +155,21 @@ def _load_leaf(leaf, stages, needed, executor) -> "Table":
     later mask evaluation over the pruned rows is unchanged. For an
     IndexScan leaf, a leading-indexed-column constraint additionally
     bypasses the HBM cache (within-bucket sort makes row-group pruning
-    sharp — executor._execute's policy)."""
+    sharp — executor._execute's policy).
+
+    The returned table may be CLASS-PADDED (``valid_rows`` set):
+    compacting here would compile one gather per distinct valid count,
+    while the SPMD stream's keep mask absorbs the pad tail for free and
+    class-stable shapes are exactly what lets the sharded programs bank
+    (the r07 padding contract carried through r12's launcher)."""
+    from . import executor as ex
+
     conds = []
     for kind, node in stages:
         if kind != "filter":
             break
         conds.append(node.condition)
     if conds:
-        from . import executor as ex
         from .pushdown import pruned_index_read_filter, pushable_filter
 
         combined = conds[0]
@@ -165,11 +179,8 @@ def _load_leaf(leaf, stages, needed, executor) -> "Table":
             pa_filter = pruned_index_read_filter(
                 leaf.index_entry, combined, leaf.schema)
             if pa_filter is not None:
-                # compact(): the scan boundary class-pads for the padded
-                # pipeline; the SPMD stream manages its own static shapes.
                 table = ex._execute_index_scan(
-                    leaf, needed, pa_filter,
-                    prefer_pruned_read=True).compact()
+                    leaf, needed, pa_filter, prefer_pruned_read=True)
                 if table.num_rows > 0:
                     return table
                 # Filter matched nothing: fall through to the cached full
@@ -179,10 +190,12 @@ def _load_leaf(leaf, stages, needed, executor) -> "Table":
             pa_filter = pushable_filter(combined, leaf.schema,
                                         allow_nested=False)
             if pa_filter is not None:
-                table = ex._execute_scan(leaf, needed, pa_filter).compact()
+                table = ex._execute_scan(leaf, needed, pa_filter)
                 if table.num_rows > 0:
                     return table
-    return executor(leaf, needed)
+    # Padded-pipeline read (NOT the compacting callback): the stream
+    # shards the physical class-padded arrays and masks the tail.
+    return ex._execute(leaf, needed)
 
 
 def _normalized_join_pairs(join: Join) -> List[Tuple[str, str]]:
@@ -425,7 +438,9 @@ def _prepare_exchange(right: Table, pairs, tiny: Dict[str, Column],
         if rc.validity is not None:
             arrays[f"v:{n}"] = rc.validity
         meta[n] = (rc.dtype, rc.dictionary, rc.validity is not None)
-    arrays, valid = pad_and_shard(mesh, arrays, right.num_rows)
+    from .shapes import padded_length
+    arrays, valid = pad_and_shard(mesh, arrays, right.num_rows,
+                                  pad_rows=padded_length(right.num_rows))
     stream_meta = {n: (c.dtype, c.dictionary, c.validity is not None)
                    for n, c in tiny.items()}
     key_dtype = INT64 if pack is not None else tiny[pairs[0][0]].dtype
@@ -544,10 +559,16 @@ class _AggSpec:
 # Entry point.
 # ---------------------------------------------------------------------------
 
-def _device_count() -> int:
+def _device_count(session=None) -> int:
     """Devices the dispatch mesh will span (tests shrink this to exercise
-    the 1-device fused path on a multi-device host)."""
-    return len(jax.devices())
+    the 1-device fused path on a multi-device host; the
+    ``distributed.mesh.maxDevices`` knob caps it, 0 = all local)."""
+    n = len(jax.devices())
+    if session is not None:
+        cap = session.hs_conf.distributed_mesh_max_devices()
+        if cap > 0:
+            n = min(n, cap)
+    return n
 
 
 def _spmd_eligible(session) -> bool:
@@ -555,7 +576,15 @@ def _spmd_eligible(session) -> bool:
         return False
     if not session.hs_conf.distributed_enabled():
         return False
-    if _device_count() >= 2:
+    from ..serving import batcher
+    if batcher.active_sweep() is not None:
+        # A literal-sweep batch already collapses its members into ONE
+        # vmapped invocation over shared scans (serving/batcher.py) —
+        # the sweep kernel lives in the single-device padded pipeline,
+        # and distributing each member individually would both defeat
+        # the batching win and skip the shared-scan accounting.
+        return False
+    if _device_count(session) >= 2:
         return True
     # ONE device: the "SPMD" program degenerates to a single fused jit
     # program (collectives over a 1-device mesh are identity, and XLA
@@ -573,39 +602,65 @@ def _spmd_eligible(session) -> bool:
     return jax.default_backend() not in ("cpu",)
 
 
-def _leaf_within_budget(root, session) -> bool:
-    """False when the stream leaf exceeds the device-footprint budget —
-    the SPMD path materializes the leaf before sharding, so oversized
-    sources must go to the chunked single-device path instead (the two
-    compose once the chunked reader learns to feed shards directly)."""
+def _stream_leaf_rows(root) -> Optional[int]:
+    """Row count of the stream leaf from parquet METADATA only (no read),
+    or None when unknowable (non-parquet, structural mismatch — let the
+    caller proceed/fail for its own reason)."""
     from .columnar import parquet_row_counts
 
     try:
         leaf, _ = _linearize(root)
     except _Unsupported:
-        return True  # let the caller fail with the structural reason
+        return None
     if isinstance(leaf, IndexScan):
-        # Index leaves materialize fully too (index content PLUS any
-        # hybrid appended files) — over budget must go to the
-        # single-device chunked index scan.
+        # Index leaves materialize fully (index content PLUS any hybrid
+        # appended files).
         try:
-            total = sum(parquet_row_counts(
+            return sum(parquet_row_counts(
                 list(leaf.index_entry.content.files)
                 + list(leaf.appended_files)))
         except Exception:
-            return True
-        return total <= session.hs_conf.max_chunk_rows()
+            return None
     if not isinstance(leaf, Scan):
-        return True
+        return None
     relation = leaf.relation
     fmt = getattr(relation, "data_file_format", relation.file_format)
     if fmt != "parquet":
-        return True
+        return None
     try:
-        total = sum(parquet_row_counts(relation.all_files()))
+        return sum(parquet_row_counts(relation.all_files()))
     except Exception:
+        return None
+
+
+def _leaf_within_budget(root, session) -> bool:
+    """False when the stream leaf exceeds the device-footprint budget —
+    the SPMD path materializes the leaf before sharding, so oversized
+    sources must go to the chunked single-device path instead (the two
+    compose once the chunked reader learns to feed shards directly)."""
+    total = _stream_leaf_rows(root)
+    return total is None or total <= session.hs_conf.max_chunk_rows()
+
+
+def _passes_min_rows(root, session) -> bool:
+    """The distributed COST GATE: streams whose leaf holds fewer rows
+    than ``distributed.minStreamRows`` stay single-device — an N-device
+    program over a few hundred rows pays compile + collective overhead
+    for zero scaling win (and on the virtual test mesh it would tax the
+    whole suite with mesh compiles). Unknown row counts pass (the
+    structural checks decide). Observable like every other fallback."""
+    min_rows = session.hs_conf.distributed_min_stream_rows()
+    if min_rows <= 0:
         return True
-    return total <= session.hs_conf.max_chunk_rows()
+    rows = _stream_leaf_rows(root)
+    if rows is None or rows >= min_rows:
+        return True
+    from ..telemetry.logging import emit_distributed_fallback
+    emit_distributed_fallback(
+        session, "spmd_query",
+        f"stream leaf {rows} rows below distributed.minStreamRows "
+        f"{min_rows}")
+    return False
 
 
 def try_execute_aggregate(plan: Aggregate, session,
@@ -615,13 +670,15 @@ def try_execute_aggregate(plan: Aggregate, session,
     executor, used to materialize the scan leaf and join sides."""
     if not _spmd_eligible(session):
         return None
+    if not _passes_min_rows(plan.child, session):
+        return None
     if not _leaf_within_budget(plan.child, session):
         from ..telemetry.logging import emit_distributed_fallback
         emit_distributed_fallback(session, "spmd_query",
                                   "leaf exceeds device chunk budget")
         return None
     try:
-        return _run(plan, executor)
+        return _run(plan, executor, session)
     except _Unsupported as e:
         from ..telemetry.logging import emit_distributed_fallback
         emit_distributed_fallback(session, "spmd_query", str(e))
@@ -654,6 +711,8 @@ def try_execute_plan(plan, session, executor: Callable) -> Optional[Table]:
         _linearize(node)  # raises _Unsupported on non-chain shapes
     except _Unsupported:
         return None
+    if not _passes_min_rows(node, session):
+        return None
     if not _leaf_within_budget(node, session):
         from ..telemetry.logging import emit_distributed_fallback
         emit_distributed_fallback(session, "spmd_query",
@@ -667,7 +726,7 @@ def try_execute_plan(plan, session, executor: Callable) -> Optional[Table]:
         sort_orders = tuple(wrappers[-1].orders)
         wrappers = wrappers[:-1]
     try:
-        table = _run_stream(node, executor, sort_orders)
+        table = _run_stream(node, executor, sort_orders, session)
     except _Unsupported as e:
         from ..telemetry.logging import emit_distributed_fallback
         emit_distributed_fallback(session, "spmd_query", str(e))
@@ -713,7 +772,7 @@ class _Prepared:
 
     def __init__(self, mesh, n_dev, sharded, valid, bcast, xch, stages,
                  joins, col_meta, final_meta, shard_rows, out_rows,
-                 project_live):
+                 project_live, file_aligned=False):
         self.mesh = mesh
         self.n_dev = n_dev
         self.sharded = sharded
@@ -727,9 +786,60 @@ class _Prepared:
         self.shard_rows = shard_rows
         self.out_rows = out_rows  # per-device rows after the last stage
         self.project_live = project_live  # stage idx -> live output names
+        self.file_aligned = file_aligned  # leaf sharded on file boundaries
 
 
-def _prepare(root, executor, caps: Dict[int, Tuple[int, int]]) -> _Prepared:
+def _file_aligned_bounds(leaf, leaf_table, n_dev: int):
+    """Row offsets assigning whole files to devices, or None. Only for
+    plain multi-file parquet Scan leaves whose materialized row count
+    matches the file metadata exactly (no pruned read, no class padding)
+    — then splitting the already-read arrays at file boundaries gives
+    every device rows from its own files at zero extra IO (the host read
+    itself fanned per-file through the parallel reader pool). Any
+    monotonic bounds are CORRECT (order preserved, padding masked);
+    alignment buys locality, not semantics."""
+    from .columnar import parquet_row_counts
+
+    if not isinstance(leaf, Scan):
+        return None
+    relation = leaf.relation
+    fmt = getattr(relation, "data_file_format", relation.file_format)
+    if fmt != "parquet":
+        return None
+    try:
+        files = list(relation.all_files())
+        counts = parquet_row_counts(files)
+    except Exception:
+        return None
+    if len(counts) < 2 or sum(counts) != leaf_table.num_rows:
+        return None
+    total = sum(counts)
+    bounds = [0]
+    acc = 0
+    i = 0
+    for d in range(1, n_dev):
+        target = (d * total) // n_dev
+        while i < len(counts) and acc + counts[i] <= target:
+            acc += counts[i]
+            i += 1
+        bounds.append(acc)
+    bounds.append(total)
+    # Skew guard: every shard pads to the LARGEST block, so a lopsided
+    # file layout (one giant file among small ones) would inflate device
+    # memory toward n_dev x the data and serialize the real work onto
+    # few devices. At 2x the even shard and beyond, locality stops
+    # paying for the padding — fall back to the even row split. (Below
+    # that the ratio is ordinary file-granularity quantization: e.g. 5
+    # equal files over 8 devices necessarily hands some device a whole
+    # file, 1.6x the even shard.)
+    largest = max(bounds[d + 1] - bounds[d] for d in range(n_dev))
+    if largest >= -(-total // n_dev) * 2:
+        return None
+    return bounds
+
+
+def _prepare(root, executor, caps: Dict[int, Tuple[int, int]],
+             session=None) -> _Prepared:
     """Walk the stage chain preparing each join side. The walk runs over
     zero-length columns (the evaluator propagates dtype/dictionary/
     nullability exactly as the traced per-device program will), so join
@@ -746,12 +856,11 @@ def _prepare(root, executor, caps: Dict[int, Tuple[int, int]]) -> _Prepared:
         out_needed, stages)
 
     leaf_table = _load_leaf(leaf, stages,
-                            set(leaf_needed) if leaf_needed else None,
-                            executor)
+                            set(leaf_needed) if leaf_needed else None)
     if leaf_table.num_rows == 0:
         raise _Unsupported("empty stream")
 
-    mesh = make_mesh(jax.devices()[:_device_count()])
+    mesh = make_mesh(jax.devices()[:_device_count(session)])
     n_dev = mesh.devices.size
 
     stream_arrays: Dict[str, jax.Array] = {}
@@ -762,7 +871,27 @@ def _prepare(root, executor, caps: Dict[int, Tuple[int, int]]) -> _Prepared:
         if c.validity is not None:
             stream_arrays[f"v:{name}"] = c.validity
         col_meta[name] = (c.dtype, c.dictionary, c.validity is not None)
-    sharded, valid = pad_and_shard(mesh, stream_arrays, leaf_table.num_rows)
+    # Stream sharding keeps the r07 static-shape contract: the leaf pads
+    # to its geometric LENGTH CLASS (shapes.padded_length under the
+    # executor's active params) before the device split, so repeated
+    # executions over different-length sources within one class hit ONE
+    # compiled mesh program in the bank — the valid mask keeps results
+    # byte-identical.
+    from .shapes import padded_length
+    bounds = None
+    if n_dev > 1 and session is not None \
+            and session.hs_conf.distributed_mesh_file_aligned_scan():
+        bounds = _file_aligned_bounds(leaf, leaf_table, n_dev)
+    if bounds is not None:
+        max_block = max(bounds[i + 1] - bounds[i]
+                        for i in range(len(bounds) - 1))
+        sharded, valid = pad_and_shard_blocks(
+            mesh, stream_arrays, bounds,
+            shard_rows=padded_length(max_block))
+    else:
+        sharded, valid = pad_and_shard(
+            mesh, stream_arrays, leaf_table.num_rows,
+            pad_rows=padded_length(leaf_table.num_rows))
     shard_rows = next(iter(sharded.values())).shape[0] // n_dev
     out_rows = shard_rows
 
@@ -902,7 +1031,57 @@ def _prepare(root, executor, caps: Dict[int, Tuple[int, int]]) -> _Prepared:
                   for n, c in tiny.items()}
     return _Prepared(mesh, n_dev, sharded, valid, bcast_arrays, xch_arrays,
                      stages, joins, col_meta, final_meta, shard_rows,
-                     out_rows, project_live)
+                     out_rows, project_live,
+                     file_aligned=bounds is not None)
+
+
+def _emit_spmd_events(session, mode: str, prep: "_Prepared", caps,
+                      attempts: int) -> None:
+    """Observability per successful dispatch: one ShardedExecutionEvent with
+    the mesh identity, the chosen PartitionSpecs, and the compiled
+    program's HLO collective counts, plus one SpmdExchangeEvent per join
+    stage (strategy, capacities) and one for the sort's range exchange.
+    Event emission must never fail an execution."""
+    if session is None:
+        return
+    try:
+        from ..telemetry.events import SpmdExchangeEvent, ShardedExecutionEvent
+        from ..telemetry.logging import NoOpEventLogger, get_logger
+        logger = get_logger(session.hs_conf.event_logger_class())
+        if isinstance(logger, NoOpEventLogger):
+            return  # skip event (and lazy HLO-count) work entirely
+        sig = mesh_signature(prep.mesh)
+        out_specs = {"stream": f"rows:P({DATA_AXIS}) flags:P()",
+                     "sort": f"rows:P({DATA_AXIS}) flags:P()",
+                     "grouped-agg": f"partials:P({DATA_AXIS}) flags:P()",
+                     "global-agg": "partials:P()"}[mode]
+        logger.log_event(ShardedExecutionEvent(
+            message=f"spmd {mode} over {prep.n_dev}-device mesh",
+            mode=mode, mesh_axes=list(sig[0]), mesh_shape=list(sig[1]),
+            mesh_platform=sig[2], shard_rows=prep.shard_rows,
+            file_aligned_scan=prep.file_aligned,
+            in_specs=f"stream:P({DATA_AXIS}) bcast:P() xch:P({DATA_AXIS})",
+            out_specs=out_specs,
+            collectives=last_collectives(), cap_attempts=attempts))
+        for i in sorted(prep.joins):
+            jkind, _pairs, _side, jt = prep.joins[i]
+            cap, k_out = caps.get(i, (0, 0))
+            logger.log_event(SpmdExchangeEvent(
+                message=f"stage {i} {jt} join via "
+                        + ("bucket exchange" if jkind == "x"
+                           else "broadcast"),
+                stage=i, join_type=jt,
+                strategy="exchange" if jkind == "x" else "broadcast",
+                capacity=cap, output_slots=k_out,
+                all_to_all=2 if jkind == "x" else 0))
+        if mode == "sort":
+            cap, _ = caps.get(-1, (0, 0))
+            logger.log_event(SpmdExchangeEvent(
+                message="distributed sort range exchange", stage=-1,
+                join_type="", strategy="sort-route", capacity=cap,
+                output_slots=0, all_to_all=1))
+    except Exception:
+        pass  # observability must never fail an execution
 
 
 # Exchange-capacity retries PER EXCHANGE JOIN: each retry recompiles with
@@ -927,14 +1106,14 @@ def _out_rows(prep: _Prepared, caps: Dict[int, Tuple[int, int]]) -> int:
     return rows
 
 
-def _run(plan: Aggregate, executor) -> Table:
+def _run(plan: Aggregate, executor, session=None) -> Table:
     global DISPATCH_COUNT, LAST_CAP_ATTEMPTS
     LAST_CAP_ATTEMPTS = 1
     caps: Dict[int, Tuple[int, int]] = {}
     # Prepared ONCE: leaf IO, join-side materialization, and sharding don't
     # depend on caps — only the jitted program (static shapes) does, so
     # escalation retries recompile but never redo IO.
-    prep = _prepare(plan.child, executor, caps)
+    prep = _prepare(plan.child, executor, caps, session)
 
     def probe(e: E.Expr) -> Column:
         t = {n: Column(dt, jnp.zeros(0, _DEVICE_DTYPE[dt]),
@@ -1010,10 +1189,13 @@ def _run(plan: Aggregate, executor) -> Table:
         else:
             table = _merge_global(out, agg_specs, prep.final_meta)
         DISPATCH_COUNT += 1
+        _emit_spmd_events(session,
+                          "grouped-agg" if grouped else "global-agg",
+                          prep, caps, LAST_CAP_ATTEMPTS)
         return table
 
 
-def _run_stream(root, executor, sort_orders=()) -> Table:
+def _run_stream(root, executor, sort_orders=(), session=None) -> Table:
     """Row-returning SPMD execution of a {Filter, Project, Join}* chain:
     every device runs the stages on its shard, the host gathers each
     device's valid rows and concatenates (VERDICT r3 #3a). With
@@ -1023,7 +1205,7 @@ def _run_stream(root, executor, sort_orders=()) -> Table:
     global DISPATCH_COUNT, SORT_DISPATCH_COUNT, LAST_CAP_ATTEMPTS
     LAST_CAP_ATTEMPTS = 1
     caps: Dict[int, Tuple[int, int]] = {}
-    prep = _prepare(root, executor, caps)  # once; see _run
+    prep = _prepare(root, executor, caps, session)  # once; see _run
     out_names = [n for n in root.schema.names if n in prep.final_meta]
     if not out_names:
         raise _Unsupported("no output columns")
@@ -1063,6 +1245,7 @@ def _run_stream(root, executor, sort_orders=()) -> Table:
         DISPATCH_COUNT += 1
         if mode == "sort":
             SORT_DISPATCH_COUNT += 1
+        _emit_spmd_events(session, mode, prep, caps, LAST_CAP_ATTEMPTS)
         return Table(cols)
     raise _Unsupported("exchange join capacity escalation exhausted")
 
@@ -1260,9 +1443,24 @@ def _a2a_exchange(arrays: Dict[str, jax.Array], send_ok: jax.Array,
     return recv, recv_valid, overflow, need
 
 
-@partial(jax.jit,
-         static_argnames=("mesh", "descr", "grouped", "G", "G2", "mode",
-                          "routed_merge"))
+# (program, shape signature) of the most recent SPMD dispatch. Rebound
+# (never mutated) per _spmd_program call; last_collectives() reads it
+# lazily. The SIGNATURE is retained, not the arguments — live device
+# arrays here would pin the last query's whole sharded input in device
+# memory for as long as the process idles.
+_LAST_PROGRAM: Optional[Tuple] = None
+
+
+def last_collectives() -> Dict[str, int]:
+    """HLO collective counts of the most recent SPMD program — computed
+    lazily from the retained compiled executable (rendering HLO text is
+    too expensive for the dispatch path) and cached per program."""
+    if _LAST_PROGRAM is None:
+        return {}
+    prog, sig = _LAST_PROGRAM
+    return prog.collectives_for(sig)
+
+
 def _spmd_program(sharded, valid, bcast, xch, *, mesh: Mesh,
                   descr: _StageDescr, grouped: bool, G: int, mode: str,
                   G2: int = 1, routed_merge: bool = True):
@@ -1759,10 +1957,26 @@ def _spmd_program(sharded, valid, bcast, xch, *, mesh: Mesh,
     for k in xof_keys:
         out_specs[k] = P()
 
-    return jax.shard_map(
-        per_device, mesh=mesh,
-        in_specs=(P(DATA_AXIS), P(DATA_AXIS), P(), P(DATA_AXIS)),
-        out_specs=out_specs, check_vma=False)(sharded, valid, bcast, xch)
+    def global_view(sharded, valid, bcast, xch):
+        return device_view(
+            per_device, mesh,
+            in_specs=(P(DATA_AXIS), P(DATA_AXIS), P(), P(DATA_AXIS)),
+            out_specs=out_specs)(sharded, valid, bcast, xch)
+
+    # One bank entry per (stage fingerprint, mesh signature): the stage
+    # fingerprint is the structural _StageDescr signature plus every
+    # capacity/mode static — exactly what used to be the jit static-arg
+    # key — so retries with escalated caps compile their own program while
+    # repeated executions of the same query shape hit the bank (and two
+    # sessions share it: the r11 cross-session contract now covers the
+    # distributed tier).
+    args = (sharded, valid, bcast, xch)
+    prog = bank_program("exec", mesh,
+                        (descr, grouped, G, G2, mode, routed_merge),
+                        args, lambda: global_view)
+    global _LAST_PROGRAM
+    _LAST_PROGRAM = (prog, prog.signature(args))
+    return prog(*args)
 
 
 # ---------------------------------------------------------------------------

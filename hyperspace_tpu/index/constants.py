@@ -153,6 +153,25 @@ class IndexConstants:
     # nothing and costs compiles). "on"/"off" force.
     TPU_DISTRIBUTED_SINGLE_DEVICE = "hyperspace.tpu.distributed.singleDevice"
     TPU_DISTRIBUTED_SINGLE_DEVICE_DEFAULT = "auto"
+    # Mesh construction for the partitioned-jit SPMD tier
+    # (parallel/sharding.py). maxDevices caps how many local devices the
+    # dispatch mesh spans (0 = all visible devices); fileAlignedScan
+    # shards multi-file parquet leaves on file boundaries so each
+    # device's rows come from its own files (locality for per-shard host
+    # reads; byte-identical either way).
+    TPU_DISTRIBUTED_MESH_MAX_DEVICES = \
+        "hyperspace.tpu.distributed.mesh.maxDevices"
+    TPU_DISTRIBUTED_MESH_MAX_DEVICES_DEFAULT = "0"
+    # Cost gate: streams whose leaf holds fewer rows (parquet metadata)
+    # than this stay single-device — sharding a few hundred rows over a
+    # mesh pays compile + collective overhead for zero win. 0 disables
+    # the gate (the SPMD test tier pins 0 to exercise small meshes).
+    TPU_DISTRIBUTED_MIN_STREAM_ROWS = \
+        "hyperspace.tpu.distributed.minStreamRows"
+    TPU_DISTRIBUTED_MIN_STREAM_ROWS_DEFAULT = "4096"
+    TPU_DISTRIBUTED_MESH_FILE_ALIGNED_SCAN = \
+        "hyperspace.tpu.distributed.mesh.fileAlignedScan"
+    TPU_DISTRIBUTED_MESH_FILE_ALIGNED_SCAN_DEFAULT = "true"
 
     # Shape-class execution (execution/shapes.py): arrays whose length is
     # data-dependent (filter survivors, join match totals, group counts) are

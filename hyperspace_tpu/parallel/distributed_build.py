@@ -14,12 +14,14 @@ Spark moves rows through its network shuffle service; here every device
 Shapes are static end-to-end: the exchange uses a capacity-bounded buffer
 (like MoE dispatch); overflow is detected on device and surfaced as a flag
 so the host can retry with a larger capacity factor. Padding rows carry a
-validity mask and sort to the tail.
+validity mask and sort to the tail. The program launches as a
+mesh-partitioned ``jax.jit`` through :mod:`.sharding` (NamedSharding +
+sharding constraints) and registers in the serving ProgramBank keyed on
+(stage fingerprint, shape-class vector, mesh signature).
 """
 
 from __future__ import annotations
 
-from functools import partial
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -32,6 +34,7 @@ from ..execution.columnar import Column, Table
 from ..ops import kernels
 from ..schema import STRING
 from .mesh import DATA_AXIS, make_mesh
+from .sharding import bank_program, device_view
 
 
 def _bucket_ids_from_arrays(key_arrays: List[jax.Array],
@@ -49,14 +52,10 @@ def _bucket_ids_from_arrays(key_arrays: List[jax.Array],
     return kernels.bucket_ids(h, num_buckets)
 
 
-@partial(jax.jit, static_argnames=("num_buckets", "n_dev", "cap", "key_names",
-                                   "key_dtypes", "mesh"))
-def _exchange_and_sort(arrays: Dict[str, jax.Array], valid: jax.Array,
-                       dict_hash_tables: Dict[str, jax.Array],
-                       *, num_buckets: int, n_dev: int, cap: int,
-                       key_names: Tuple[str, ...], key_dtypes: Tuple[str, ...],
-                       mesh: Mesh):
-    """The full distributed build step, jitted over the mesh."""
+def _exchange_and_sort_fn(num_buckets: int, n_dev: int, cap: int,
+                          key_names: Tuple[str, ...],
+                          key_dtypes: Tuple[str, ...], mesh: Mesh):
+    """The full distributed build step as a mesh-partitioned program."""
 
     def per_device(arrays, valid, dict_hash_tables):
         rows = valid.shape[0]
@@ -112,12 +111,45 @@ def _exchange_and_sort(arrays: Dict[str, jax.Array], valid: jax.Array,
         out_bids = jnp.where(out_valid, jnp.take(recv_bids, perm2), num_buckets)
         return out, out_valid, out_bids, overflow
 
-    shard_fn = jax.shard_map(
-        per_device, mesh=mesh,
-        in_specs=(P(DATA_AXIS), P(DATA_AXIS), P()),
-        out_specs=(P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS), P()),
-        check_vma=False)
-    return shard_fn(arrays, valid, dict_hash_tables)
+    def run(arrays, valid, dict_hash_tables):
+        return device_view(
+            per_device, mesh,
+            in_specs=(P(DATA_AXIS), P(DATA_AXIS), P()),
+            out_specs=(P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS), P()))(
+                arrays, valid, dict_hash_tables)
+
+    return run
+
+
+def _exchange_and_sort(arrays: Dict[str, jax.Array], valid: jax.Array,
+                       dict_hash_tables: Dict[str, jax.Array],
+                       *, num_buckets: int, n_dev: int, cap: int,
+                       key_names: Tuple[str, ...], key_dtypes: Tuple[str, ...],
+                       mesh: Mesh):
+    global _LAST_PROGRAM
+    args = (arrays, valid, dict_hash_tables)
+    prog = bank_program(
+        "bucket-exchange", mesh,
+        (num_buckets, n_dev, cap, key_names, key_dtypes), args,
+        lambda: _exchange_and_sort_fn(num_buckets, n_dev, cap, key_names,
+                                      key_dtypes, mesh))
+    _LAST_PROGRAM = (prog, prog.signature(args))
+    return prog(*args)
+
+
+# (program, shape signature) of the most recent build exchange;
+# last_collectives() reads the HLO counts lazily (bench / tests assert
+# the exchange is ONE all-to-all class of traffic and zero unrequested
+# resharding). The signature is retained, not the live arrays — see
+# execution/spmd._LAST_PROGRAM.
+_LAST_PROGRAM: Optional[Tuple] = None
+
+
+def last_collectives() -> Dict[str, int]:
+    if _LAST_PROGRAM is None:
+        return {}
+    prog, sig = _LAST_PROGRAM
+    return prog.collectives_for(sig)
 
 
 # Successful mesh builds in this process (bench/tests assert the

@@ -2,10 +2,12 @@
 
 The consumer side of the distributed build (SURVEY §2 distributed
 primitives 5–6): queries run SPMD over row shards with XLA collectives —
-``psum`` over ICI — instead of a network shuffle:
+``psum`` over ICI — instead of a network shuffle. Both entry points launch
+mesh-partitioned ``jax.jit`` programs through :mod:`.sharding` (NamedSharding
++ sharding constraints; see that module for the launcher contract):
 
 - ``distributed_range_agg``: filter (range predicate) + aggregate in one
-  shard_map program; each device masks its shard and contributes partial
+  mesh program; each device masks its shard and contributes partial
   sums/counts, one psum returns replicated scalars (the TPC-H Q6 shape).
 - ``distributed_join_agg``: inner equi-join + aggregate over two tables
   bucket-co-partitioned by the SAME key hash (e.g. two
@@ -13,17 +15,18 @@ primitives 5–6): queries run SPMD over row shards with XLA collectives —
   device on both sides, so each device merge-joins locally (searchsorted
   over its re-sorted shard, prefix-sum segment totals) and a single psum
   combines — the shuffle-free sort-merge-join aggregate (the Q3/Q17 inner
-  shape) with zero row movement.
+  shape) with zero row movement. ``join_agg_collectives`` exposes the
+  compiled program's HLO collective counts so tests/bench can ASSERT the
+  zero-resharding property instead of trusting it.
 
 All shapes are static; join results are aggregated on device (count, left-
 and right-value sums) rather than materialized, so no variable-length
-output crosses the shard_map boundary.
+output crosses the program boundary.
 """
 
 from __future__ import annotations
 
-from functools import partial
-from typing import Optional, Tuple
+from typing import Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -33,12 +36,11 @@ from jax.sharding import Mesh, PartitionSpec as P
 from ..exceptions import HyperspaceException
 from ..execution.columnar import Table
 from .mesh import DATA_AXIS, make_mesh, pad_and_shard
+from .sharding import bank_program, device_view
 
 
-@partial(jax.jit, static_argnames=("mesh", "value_names", "lo_incl",
-                                   "hi_incl"))
-def _range_agg(filter_data, valid, lo, hi, values, *, mesh: Mesh,
-               value_names: Tuple[str, ...], lo_incl: bool, hi_incl: bool):
+def _range_agg_fn(mesh: Mesh, value_names: Tuple[str, ...], lo_incl: bool,
+                  hi_incl: bool):
     def per_device(fd, v, lo, hi, vals):
         ml = (fd >= lo) if lo_incl else (fd > lo)
         mh = (fd <= hi) if hi_incl else (fd < hi)
@@ -49,11 +51,22 @@ def _range_agg(filter_data, valid, lo, hi, values, *, mesh: Mesh,
             for name in value_names}
         return count, sums
 
-    return jax.shard_map(
-        per_device, mesh=mesh,
-        in_specs=(P(DATA_AXIS), P(DATA_AXIS), P(), P(), P(DATA_AXIS)),
-        out_specs=(P(), P()), check_vma=False)(
-            filter_data, valid, lo, hi, values)
+    def run(filter_data, valid, lo, hi, values):
+        return device_view(
+            per_device, mesh,
+            in_specs=(P(DATA_AXIS), P(DATA_AXIS), P(), P(), P(DATA_AXIS)),
+            out_specs=(P(), P()))(filter_data, valid, lo, hi, values)
+
+    return run
+
+
+def _range_agg(filter_data, valid, lo, hi, values, *, mesh: Mesh,
+               value_names: Tuple[str, ...], lo_incl: bool, hi_incl: bool):
+    args = (filter_data, valid, lo, hi, values)
+    prog = bank_program(
+        "range-agg", mesh, (value_names, lo_incl, hi_incl), args,
+        lambda: _range_agg_fn(mesh, value_names, lo_incl, hi_incl))
+    return prog(*args)
 
 
 def distributed_range_agg(table: Table, filter_col: str, lo, hi,
@@ -85,8 +98,7 @@ def distributed_range_agg(table: Table, filter_col: str, lo, hi,
     return int(count), {k: v for k, v in sums.items()}
 
 
-@partial(jax.jit, static_argnames=("mesh",))
-def _join_agg(lk, lv_valid, lval, rk, rv_valid, rval, *, mesh: Mesh):
+def _join_agg_fn(mesh: Mesh):
     def per_device(lk, lvalid, lval, rk, rvalid, rval):
         # Local re-sort of the right shard by pure key (device-local order
         # after the bucket exchange is (bucket, key); searchsorted needs key
@@ -127,11 +139,34 @@ def _join_agg(lk, lv_valid, lval, rk, rv_valid, rval, *, mesh: Mesh):
                                  DATA_AXIS)
         return pair_count, left_sum, right_sum
 
-    return jax.shard_map(
-        per_device, mesh=mesh,
-        in_specs=(P(DATA_AXIS),) * 6,
-        out_specs=(P(), P(), P()), check_vma=False)(
-            lk, lv_valid, lval, rk, rv_valid, rval)
+    def run(lk, lv_valid, lval, rk, rv_valid, rval):
+        return device_view(
+            per_device, mesh,
+            in_specs=(P(DATA_AXIS),) * 6,
+            out_specs=(P(), P(), P()))(lk, lv_valid, lval, rk, rv_valid,
+                                       rval)
+
+    return run
+
+
+def _join_agg_program(args, mesh: Mesh):
+    return bank_program("join-agg", mesh, (), args,
+                        lambda: _join_agg_fn(mesh))
+
+
+def join_agg_collectives(left: Table, left_valid, right: Table, right_valid,
+                         key: str, left_value: str, right_value: str,
+                         mesh: Optional[Mesh] = None) -> Dict[str, int]:
+    """HLO collective counts of the co-bucketed join-aggregate program for
+    these inputs (compiling it if cold). The shuffle-free property the
+    build's co-partitioning buys is exactly: zero all-to-all / all-gather /
+    collective-permute / reduce-scatter — only the final psum all-reduces.
+    Tests and the bench assert on this."""
+    mesh = mesh or make_mesh()
+    args = (left.column(key).data, left_valid, left.column(left_value).data,
+            right.column(key).data, right_valid,
+            right.column(right_value).data)
+    return _join_agg_program(args, mesh).collectives(*args)
 
 
 def distributed_join_agg(left: Table, left_valid, right: Table, right_valid,
@@ -156,6 +191,6 @@ def distributed_join_agg(left: Table, left_valid, right: Table, right_valid,
     rk = right.column(key).data
     lval = left.column(left_value).data
     rval = right.column(right_value).data
-    count, lsum, rsum = _join_agg(lk, left_valid, lval, rk, right_valid,
-                                  rval, mesh=mesh)
+    args = (lk, left_valid, lval, rk, right_valid, rval)
+    count, lsum, rsum = _join_agg_program(args, mesh)(*args)
     return int(count), np.asarray(lsum).item(), np.asarray(rsum).item()

@@ -32,11 +32,18 @@ def replicated(mesh: Mesh) -> NamedSharding:
 
 
 def pad_and_shard(mesh: Mesh, arrays: dict, rows: int,
-                  process_local: bool = False) -> tuple:
+                  process_local: bool = False,
+                  pad_rows: Optional[int] = None) -> tuple:
     """Zero-pad each 1-D-leading array to a device multiple, build the
     validity mask, and device_put everything row-sharded over the data axis.
     Returns (sharded arrays dict, sharded valid mask). The single shared
     recipe for putting host rows onto the mesh (build + query sides).
+
+    ``pad_rows``: optional padding target ≥ ``rows`` (the r07 length
+    class) — callers that want repeated executions of different-length
+    inputs to share ONE compiled mesh program pad to the class instead
+    of the exact device multiple; the valid mask keeps results
+    byte-identical either way.
 
     When ``mesh`` spans multiple processes (jax.distributed over DCN) the
     caller must state what its rows ARE: ``process_local=True`` means
@@ -61,19 +68,77 @@ def pad_and_shard(mesh: Mesh, arrays: dict, rows: int,
                 "and __graft_entry__.dryrun_multihost).")
         return _pad_and_shard_multihost(mesh, arrays, rows)
     n_dev = mesh.devices.size
-    shard = -(-max(rows, 1) // n_dev)  # ceil.
+    # Arrays may arrive ALREADY class-padded beyond ``rows`` (the r07
+    # padded pipeline hands its tables to the SPMD boundary untrimmed —
+    # compacting would compile one gather per distinct valid count);
+    # the shard target covers the largest physical length so padding
+    # only ever grows.
+    cur_max = max((int(a.shape[0]) for a in arrays.values()), default=0)
+    target = max(rows, pad_rows or 0, cur_max, 1)
+    shard = -(-target // n_dev)  # ceil.
     padded = shard * n_dev
     out = {}
     for name, a in arrays.items():
-        if padded != rows:
+        cur = int(a.shape[0])
+        if padded != cur:
             a = jnp.concatenate(
-                [a, jnp.zeros((padded - rows,) + a.shape[1:], a.dtype)])
+                [a, jnp.zeros((padded - cur,) + a.shape[1:], a.dtype)])
         out[name] = a
-    valid = jnp.concatenate([jnp.ones(rows, jnp.bool_),
-                             jnp.zeros(padded - rows, jnp.bool_)])
+    # Host-built mask: a jnp.concatenate here would compile one tiny
+    # program per distinct valid count — the exact storm class padding
+    # exists to avoid.
+    vm = np.zeros(padded, bool)
+    vm[:rows] = True
     sharding = row_sharding(mesh)
     return ({n: jax.device_put(a, sharding) for n, a in out.items()},
-            jax.device_put(valid, sharding))
+            jax.device_put(jnp.asarray(vm), sharding))
+
+
+def pad_and_shard_blocks(mesh: Mesh, arrays: dict, bounds,
+                         shard_rows: Optional[int] = None) -> tuple:
+    """File-aligned sharding: ``bounds`` (``n_dev + 1`` ascending row
+    offsets) assigns contiguous row blocks — whole files, as computed by
+    the caller from parquet metadata — to devices. Each block pads to the
+    largest block so every shard is equal (static shapes); the validity
+    mask marks each block's real rows. Results are byte-identical to the
+    even split (row order is preserved and padding is masked), but each
+    device's rows come from its OWN files — the layout a multi-process
+    pod needs to read only its shard's files host-side, and the layout
+    that keeps per-shard host reads contiguous in the reader pool.
+
+    ``shard_rows``: optional per-device shard size ≥ the largest block
+    (the r07 length class of it) so different file layouts share one
+    compiled program."""
+    import jax.numpy as jnp
+
+    n_dev = mesh.devices.size
+    if len(bounds) != n_dev + 1:
+        raise ValueError("bounds must have n_dev + 1 offsets")
+    sizes = [int(bounds[i + 1]) - int(bounds[i]) for i in range(n_dev)]
+    shard = max(max(sizes), shard_rows or 0, 1)
+    sharding = row_sharding(mesh)
+
+    def assemble(a):
+        # One slice + pad per block, one concatenate: O(padded) copies.
+        # (Chained buf.at[...].set() updates would copy the FULL padded
+        # buffer once per device — O(n_dev * padded) — and a host-side
+        # numpy buffer would force a device->host round trip per column
+        # on real accelerators.)
+        parts = []
+        for i in range(n_dev):
+            blk = a[int(bounds[i]):int(bounds[i + 1])]
+            if sizes[i] < shard:
+                blk = jnp.concatenate(
+                    [blk, jnp.zeros((shard - sizes[i],) + a.shape[1:],
+                                    a.dtype)])
+            parts.append(blk)
+        return jax.device_put(jnp.concatenate(parts), sharding)
+
+    out = {n: assemble(a) for n, a in arrays.items()}
+    vm = np.zeros(shard * n_dev, bool)
+    for i in range(n_dev):
+        vm[i * shard:i * shard + sizes[i]] = True
+    return out, jax.device_put(jnp.asarray(vm), sharding)
 
 
 def _pad_and_shard_multihost(mesh: Mesh, arrays: dict, rows: int) -> tuple:

@@ -1,0 +1,255 @@
+"""Global-view SPMD launcher: ``NamedSharding`` + ``jax.jit`` over an
+explicit :class:`Mesh`.
+
+Every distributed path (execution/spmd.py, parallel/distributed_build.py,
+parallel/distributed_query.py) writes its program as a *per-device*
+function — static shapes, a validity mask riding along, ``lax`` collectives
+(psum/pmin/pmax/all_to_all/all_gather) over the mesh axis name. Earlier
+revisions launched those bodies with a per-device mapping primitive; this
+module launches them in the partitioned-jit idiom instead, which is the
+form that composes with the serving tier's program bank and scales to
+multi-process TPU pods (pjit partitions inputs across all devices, and
+pre-partitioned handoffs between jitted stages avoid resharding):
+
+- :func:`device_view` reshapes each row-sharded global array from
+  ``(n_dev * shard, ...)`` to ``(n_dev, shard, ...)`` — a zero-exchange
+  resharding, every device's rows stay put — pins the layout with
+  ``with_sharding_constraint`` (``PartitionSpec(axis, None, ...)``), and
+  runs the per-device body under ``jax.vmap(..., axis_name=axis)``. jax's
+  collective batching rules give ``lax.psum``/``all_to_all``/… over the
+  vmapped axis exactly their per-device semantics, and because the batch
+  axis is mesh-sharded, GSPMD lowers them to the real ICI collectives.
+  The per-device program bodies did not change in the port — only the
+  launcher did.
+
+- :class:`MeshProgram` is the AOT wrapper the call sites register in the
+  serving tier's ProgramBank: one entry per (stage fingerprint, mesh
+  signature), holding one compiled executable per argument shape
+  signature. Owning the compile step (``lower().compile()``) is what
+  makes the compiled-HLO collective counts observable — the
+  ``ShardedExecutionEvent`` / zero-resharding assertions read them from here
+  — without ever paying a second compile on the dispatch path.
+
+Replication contract: an ``out_specs`` entry of ``P()`` asserts the
+per-device value is identical on every device (it is the result of a
+psum/pmax-style collective); the launcher materializes device 0's copy.
+"""
+
+from __future__ import annotations
+
+import re
+import threading
+from typing import Callable, Dict
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+# HLO collective categories counted from compiled programs. "all-to-all"
+# is the bucket exchange; "all-reduce" the psum/pmin/pmax partial merges;
+# "all-gather"/"collective-permute"/"reduce-scatter" indicate resharding
+# the program did NOT ask for (the shuffle-free join asserts these are 0).
+COLLECTIVE_OPS = ("all-to-all", "all-reduce", "all-gather",
+                  "collective-permute", "reduce-scatter")
+
+# Mesh programs compiled in this process (bench/tests read this alongside
+# the r07 backend-compile counter; one MeshProgram compile == one entry).
+COMPILE_COUNT = 0
+
+
+def mesh_signature(mesh: Mesh) -> tuple:
+    """Hashable identity of a mesh for program keys and telemetry:
+    (axis names, device grid shape, platform). Two meshes with the same
+    signature compile identical partitioned programs."""
+    return (tuple(mesh.axis_names), tuple(mesh.devices.shape),
+            str(mesh.devices.flat[0].platform))
+
+
+def collective_counts(hlo_text: str) -> Dict[str, int]:
+    """Occurrences of each collective op in compiled HLO text. Only the
+    opcode position counts — ``op(`` — not the ``%op``-style instruction
+    names or operand references that repeat it on the same line.
+    Start/done pairs (async collectives) count once via the ``-start``
+    form when present."""
+    counts = {}
+    for op in COLLECTIVE_OPS:
+        starts = len(re.findall(rf"\b{op}-start\(", hlo_text))
+        plain = len(re.findall(rf"\b{op}\(", hlo_text))
+        counts[op] = starts if starts else plain
+    return counts
+
+
+def _is_sharded(spec: P) -> bool:
+    return len(spec) > 0 and spec[0] is not None
+
+
+def _leading_spec(mesh: Mesh, x) -> NamedSharding:
+    axis = mesh.axis_names[0]
+    return NamedSharding(mesh, P(axis, *([None] * (max(x.ndim, 1) - 1))))
+
+
+def _prefix_apply(specs, tree, fn):
+    """Apply ``fn(spec, subtree)`` treating ``specs`` as a pytree prefix of
+    ``tree`` with PartitionSpec leaves (the in_specs/out_specs convention:
+    one spec may cover a whole dict of arrays)."""
+    spec_leaves, spec_treedef = jax.tree_util.tree_flatten(
+        specs, is_leaf=lambda s: isinstance(s, P))
+    subtrees = spec_treedef.flatten_up_to(tree)
+    mapped = [fn(s, t) for s, t in zip(spec_leaves, subtrees)]
+    return jax.tree_util.tree_unflatten(spec_treedef, mapped)
+
+
+def device_view(fn: Callable, mesh: Mesh, in_specs, out_specs) -> Callable:
+    """Run a per-device SPMD body in the global partitioned-jit view.
+
+    ``fn`` sees per-device shards (leading row axis = its shard) and may
+    use lax collectives over the mesh axis name. Call inside ``jax.jit``;
+    sharding is pinned with ``with_sharding_constraint`` so GSPMD emits
+    the collectives the body asked for and nothing else.
+    """
+    n_dev = mesh.devices.size
+    axis = mesh.axis_names[0]
+
+    def run(*args):
+        in_axes = []
+        split_args = []
+        for arg, spec in zip(args, in_specs):
+            if _is_sharded(spec):
+                def split(x):
+                    x = jax.lax.with_sharding_constraint(
+                        x, _leading_spec(mesh, x))
+                    return x.reshape(
+                        (n_dev, x.shape[0] // n_dev) + x.shape[1:])
+                split_args.append(jax.tree_util.tree_map(split, arg))
+                in_axes.append(0)
+            else:
+                split_args.append(jax.tree_util.tree_map(
+                    lambda x: jax.lax.with_sharding_constraint(
+                        x, NamedSharding(mesh, P())), arg))
+                in_axes.append(None)
+
+        out = jax.vmap(fn, in_axes=tuple(in_axes), out_axes=0,
+                       axis_name=axis)(*split_args)
+
+        def finish(spec, subtree):
+            if _is_sharded(spec):
+                def unsplit(x):
+                    x = x.reshape((x.shape[0] * x.shape[1],) + x.shape[2:])
+                    return jax.lax.with_sharding_constraint(
+                        x, _leading_spec(mesh, x))
+                return jax.tree_util.tree_map(unsplit, subtree)
+            # Replicated: collective-reduced, identical across devices —
+            # materialize device 0's copy (see module docstring).
+            return jax.tree_util.tree_map(
+                lambda x: jax.lax.with_sharding_constraint(
+                    x[0], NamedSharding(mesh, P())), subtree)
+
+        return _prefix_apply(out_specs, out, finish)
+
+    return run
+
+
+class MeshProgram:
+    """One SPMD stage, AOT-compiled per argument shape signature.
+
+    ``fn`` is a plain (unjitted) function of the dynamic arguments; static
+    configuration must already be bound (partial/closure). Each distinct
+    (shape, dtype, weak_type) signature lowers and compiles exactly once;
+    the compiled executable and its HLO collective counts are retained.
+    """
+
+    def __init__(self, fn: Callable, name: str = "spmd"):
+        self._fn = fn
+        self._name = name
+        self._lock = threading.Lock()
+        # shape signature -> [compiled, collective counts or None].
+        # Counts are computed LAZILY on the first collectives() ask:
+        # compiled.as_text() renders multi-MB HLO for wide meshes, and
+        # paying that on the dispatch path would tax every cold query
+        # for an observability detail most dispatches never read.
+        self._compiled: Dict[tuple, list] = {}
+
+    @staticmethod
+    def _sig(args) -> tuple:
+        def leaf(x):
+            aval = jax.api_util.shaped_abstractify(x)
+            return (aval.shape, str(aval.dtype),
+                    bool(getattr(aval, "weak_type", False)))
+        leaves, treedef = jax.tree_util.tree_flatten(args)
+        return (treedef, tuple(leaf(x) for x in leaves))
+
+    def _get(self, args) -> list:
+        sig = self._sig(args)
+        entry = self._compiled.get(sig)
+        if entry is not None:
+            return entry
+        with self._lock:
+            entry = self._compiled.get(sig)
+            if entry is None:
+                global COMPILE_COUNT
+                # shardings: inferred from the committed NamedSharding
+                # inputs; device_view pins every internal layout with
+                # with_sharding_constraint (see module docstring).
+                compiled = jax.jit(self._fn).lower(*args).compile()
+                entry = [compiled, None]
+                self._compiled[sig] = entry
+                COMPILE_COUNT += 1
+        return entry
+
+    def __call__(self, *args):
+        return self._get(args)[0](*args)
+
+    def signature(self, args) -> tuple:
+        """The shape signature of an argument tuple — retain THIS (not
+        the live arguments) to read a dispatched program's collectives
+        later: holding device arrays would pin the query's whole sharded
+        input in device memory after the dispatch returns."""
+        return self._sig(args)
+
+    def collectives(self, *args) -> Dict[str, int]:
+        """Collective counts of the program compiled for these argument
+        shapes (compiling it if never run). Counted from the compiled
+        HLO once per program, then cached."""
+        return self._counts(self._get(args))
+
+    def collectives_for(self, sig: tuple) -> Dict[str, int]:
+        """Collective counts of the already-compiled program for this
+        :meth:`signature`; ``{}`` if no such program was ever compiled
+        (never compiles — the reader path must not pay or mask one)."""
+        entry = self._compiled.get(sig)
+        return {} if entry is None else self._counts(entry)
+
+    def _counts(self, entry: list) -> Dict[str, int]:
+        if entry[1] is None:
+            with self._lock:
+                if entry[1] is None:
+                    entry[1] = collective_counts(entry[0].as_text())
+        return dict(entry[1])
+
+    @property
+    def programs(self) -> int:
+        return len(self._compiled)
+
+
+def shape_vector(args) -> tuple:
+    """The bank's shape-class vector for an argument tuple: one
+    (shape, dtype) pair per array leaf. SPMD inputs are already padded to
+    static shapes (pad_and_shard / the r07 padding contract), so this is
+    the shape-class identity of the executable about to run."""
+    return tuple((tuple(x.shape), str(x.dtype))
+                 for x in jax.tree_util.tree_leaves(args))
+
+
+def bank_program(name: str, mesh: Mesh, static_key: tuple, args: tuple,
+                 build: Callable[[], Callable]) -> MeshProgram:
+    """Fetch (or create) the :class:`MeshProgram` for an SPMD stage from
+    the process-wide serving ProgramBank.
+
+    The bank key is (stage name, static fingerprint, mesh signature) —
+    the r11 registry extended with the mesh identity, so two sessions on
+    the same mesh share every sharded executable while a resized mesh
+    compiles its own. The argument shape signature rides as the bank's
+    shape-class vector (hit/miss accounting + events)."""
+    from ..serving.program_bank import get_bank
+    key = ("spmd", name, static_key, mesh_signature(mesh))
+    return get_bank().lookup(key, shape_vector(args),
+                             lambda: MeshProgram(build(), name))

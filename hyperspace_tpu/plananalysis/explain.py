@@ -75,7 +75,11 @@ def _header(buf: BufferStream, title: str) -> None:
 
 
 def explain_string(session, plan: LogicalPlan, verbose: bool = False,
-                   mode="plaintext") -> str:
+                   mode="plaintext", diagnostics: bool = True) -> str:
+    """``diagnostics=False`` renders the PLAN-ONLY explain (rewrite diff,
+    indexes used, operator stats): the runtime sections (cache /
+    compilation / io / spmd / serving) read process-lifetime counters,
+    which golden plan-stability diffs must not depend on."""
     display: DisplayMode = get_mode(mode)
     was_enabled = session.is_hyperspace_enabled()
     try:
@@ -102,10 +106,12 @@ def explain_string(session, plan: LogicalPlan, verbose: bool = False,
     used = _used_indexes(with_index)
     for line in (used if used else ["<none>"]):
         buf.write_line(line)
-    _write_cache_section(buf, session, plan)
-    _write_compilation_section(buf, session)
-    _write_io_section(buf, session)
-    _write_serving_section(buf, session)
+    if diagnostics:
+        _write_cache_section(buf, session, plan)
+        _write_compilation_section(buf, session)
+        _write_io_section(buf, session)
+        _write_spmd_section(buf, session)
+        _write_serving_section(buf, session)
     _write_advisor_section(buf, session, with_index)
     _write_join_order_section(buf, session)
     if verbose:
@@ -215,6 +221,42 @@ def _write_io_section(buf: BufferStream, session) -> None:
         f"time split: read+decode={s['read_seconds']:.2f}s "
         f"consumer wait={s['wait_seconds']:.2f}s "
         f"(~{overlap:.2f}s of read hidden behind compute)")
+
+
+def _write_spmd_section(buf: BufferStream, session) -> None:
+    """Distributed-tier observability (execution/spmd.py over
+    parallel/sharding.py): the mesh the dispatch would span, dispatch
+    tallies, and the last program's compiled HLO collective counts.
+    Rendered only once an SPMD program has actually dispatched (or a
+    distributed build ran), so explain goldens of sessions that never
+    went distributed are untouched."""
+    import jax
+
+    from ..execution import spmd
+    from ..parallel import distributed_build, sharding
+    total = spmd.DISPATCH_COUNT + distributed_build.DISPATCH_COUNT
+    if total == 0:
+        return
+    buf.write_line()
+    _header(buf, "Distributed:")
+    conf = session.hs_conf
+    n_dev = spmd._device_count(session)
+    state = "on" if conf.distributed_enabled() else "off"
+    buf.write_line(
+        f"distributed: {state} (mesh devices={n_dev} "
+        f"platform={jax.devices()[0].platform} "
+        f"singleDevice={conf.distributed_single_device()} "
+        f"fileAlignedScan="
+        f"{'on' if conf.distributed_mesh_file_aligned_scan() else 'off'})")
+    buf.write_line(
+        f"dispatches: queries={spmd.DISPATCH_COUNT} "
+        f"sorts={spmd.SORT_DISPATCH_COUNT} "
+        f"builds={distributed_build.DISPATCH_COUNT} "
+        f"mesh programs compiled={sharding.COMPILE_COUNT}")
+    lc = spmd.last_collectives()
+    if lc:
+        pairs = " ".join(f"{k}={v}" for k, v in sorted(lc.items()) if v)
+        buf.write_line(f"last program collectives: {pairs or 'none'}")
 
 
 def _write_serving_section(buf: BufferStream, session) -> None:

@@ -89,6 +89,47 @@ class DistributedFallbackEvent(HyperspaceEvent):
 
 
 @dataclass
+class ShardedExecutionEvent(HyperspaceEvent):
+    """Emitted per successful SPMD dispatch (execution/spmd.py): the mesh
+    identity the program partitioned over, the PartitionSpecs chosen for
+    its inputs/outputs, whether the leaf sharded file-aligned, and the
+    compiled program's HLO collective counts (all-to-all = the bucket
+    exchange, all-reduce = psum partial merges; all-gather /
+    collective-permute / reduce-scatter would be resharding the program
+    never asked for). ``cap_attempts`` counts capacity-escalation
+    compiles (1 = first program fit)."""
+
+    mode: str = ""            # global-agg | grouped-agg | stream | sort
+    mesh_axes: Optional[List[str]] = None
+    mesh_shape: Optional[List[int]] = None
+    mesh_platform: str = ""
+    shard_rows: int = 0
+    file_aligned_scan: bool = False
+    in_specs: str = ""
+    out_specs: str = ""
+    collectives: Optional[dict] = None
+    cap_attempts: int = 1
+
+
+@dataclass
+class SpmdExchangeEvent(HyperspaceEvent):
+    """Emitted per join stage (and per distributed-sort range exchange)
+    of an SPMD dispatch: which strategy ran — ``broadcast`` (replicated
+    side, zero row movement), ``exchange`` (hash-routed bucket exchange,
+    one all_to_all per side), or ``sort-route`` (sample-sort range
+    partitioning) — and the static capacities the program was compiled
+    with. ``all_to_all`` is the number of logical all-to-all collectives
+    the stage asked for (compiled totals ride ShardedExecutionEvent)."""
+
+    stage: int = -1
+    join_type: str = ""
+    strategy: str = ""        # broadcast | exchange | sort-route
+    capacity: int = 0
+    output_slots: int = 0
+    all_to_all: int = 0
+
+
+@dataclass
 class HyperspaceIndexUsageEvent(HyperspaceEvent):
     """Emitted when a rewrite rule applies indexes to a plan
     (parity: rules/FilterIndexRule.scala:69-78)."""

@@ -42,15 +42,82 @@ ENV_READ_ALLOWLIST = frozenset({
 # (execution/shapes.py) can see and count its compiles. A jit in an
 # arbitrary module is invisible to the compile counter's attribution and
 # bypasses the padding contract. This list is FROZEN — new jitted stages
-# go into ops/kernels.py (or pallas_kernels.py for Mosaic), not new files.
+# go into ops/kernels.py (or pallas_kernels.py for Mosaic), not new
+# files. (The r12 SPMD port removed the distributed modules' direct jits:
+# they launch through parallel/sharding.py, the one sanctioned mesh-jit
+# site.)
 JIT_SITE_ALLOWLIST = frozenset({
     "hyperspace_tpu/ops/kernels.py",
     "hyperspace_tpu/ops/pallas_kernels.py",
     "hyperspace_tpu/execution/shapes.py",
-    "hyperspace_tpu/execution/spmd.py",
+    "hyperspace_tpu/parallel/sharding.py",
+})
+
+# SPMD-idiom ratchet (the r12 port must be total and stay total):
+# 1. shard_map / pmap are forbidden REPO-WIDE, no allowlist — the
+#    distributed tier is built on NamedSharding + jit (GSPMD), the idiom
+#    that works on this image AND scales to multi-process pods. A
+#    per-device mapping primitive creeping back in would silently fork
+#    the two worlds again.
+# 2. In the distributed modules, every jax.jit must either pass explicit
+#    in_shardings/out_shardings or carry a documented sharding marker
+#    (a "# shardings:" or "# replicated" comment on the call line or the
+#    two lines above) — partitioning must be stated, never implied.
+SPMD_BANNED_NAMES = ("shard_map", "pmap")
+SPMD_JIT_SHARDING_MODULES = frozenset({
+    "hyperspace_tpu/parallel/sharding.py",
+    "hyperspace_tpu/parallel/mesh.py",
+    "hyperspace_tpu/parallel/multihost.py",
     "hyperspace_tpu/parallel/distributed_build.py",
     "hyperspace_tpu/parallel/distributed_query.py",
+    "hyperspace_tpu/execution/spmd.py",
 })
+
+
+def spmd_banned_sites(tree: ast.AST) -> list:
+    """(line, name) of shard_map/pmap references: attribute access
+    (jax.shard_map / jax.pmap), bare names, and imports. AST-based, so
+    prose in docstrings/comments never trips it."""
+    out = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Attribute) \
+                and node.attr in SPMD_BANNED_NAMES:
+            out.append((node.lineno, node.attr))
+        elif isinstance(node, ast.Name) and node.id in SPMD_BANNED_NAMES:
+            out.append((node.lineno, node.id))
+        elif isinstance(node, ast.ImportFrom) and node.module and any(
+                part in SPMD_BANNED_NAMES
+                for part in node.module.split(".")):
+            out.append((node.lineno, node.module))
+        elif isinstance(node, (ast.Import, ast.ImportFrom)):
+            for a in node.names:
+                if a.name and any(part in SPMD_BANNED_NAMES
+                                  for part in a.name.split(".")):
+                    out.append((node.lineno, a.name))
+    return sorted(set(out))
+
+
+def jit_sharding_violations(tree: ast.AST, lines: list) -> list:
+    """Lines of jax.jit/pjit CALLS in the distributed modules that
+    neither pass in_shardings/out_shardings nor carry a sharding marker
+    comment nearby."""
+    out = []
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in ("jit", "pjit")
+                and isinstance(node.func.value, ast.Name)
+                and node.func.value.id == "jax"):
+            continue
+        kw = {k.arg for k in node.keywords}
+        if {"in_shardings", "out_shardings"} & kw:
+            continue
+        lo = max(node.lineno - 5, 0)
+        nearby = "\n".join(lines[lo:node.lineno])
+        if "# shardings:" in nearby or "# replicated" in nearby:
+            continue
+        out.append(node.lineno)
+    return sorted(set(out))
 
 
 def iter_sources():
@@ -334,6 +401,16 @@ def main() -> int:
                     f"{rel}:{line}: jax.jit outside the instrumented "
                     "kernel modules; add the jitted stage to ops/kernels.py "
                     "so the compile counter sees it")
+        for line, name in spmd_banned_sites(tree):
+            problems.append(
+                f"{rel}:{line}: '{name}' is forbidden repo-wide; the SPMD "
+                "tier is NamedSharding+jit only (parallel/sharding.py)")
+        if rel.replace(os.sep, "/") in SPMD_JIT_SHARDING_MODULES:
+            for line in jit_sharding_violations(tree, text.splitlines()):
+                problems.append(
+                    f"{rel}:{line}: jax.jit in a distributed module must "
+                    "pass explicit in_shardings/out_shardings or carry a "
+                    "'# shardings:'/'# replicated' marker comment")
         if any(rel.startswith(d + os.sep) for d in PACKAGE_DIRS) \
                 and rel.replace(os.sep, "/") not in MUTABLE_STATE_ALLOWLIST:
             for line, name in mutable_state_sites(tree):

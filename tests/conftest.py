@@ -45,6 +45,42 @@ def tmp_system_path(tmp_path):
     return str(p)
 
 
+def run_on_mesh(snippet: str, device_count: int = 8,
+                timeout: int = 240) -> str:
+    """Run a python snippet in a SUBPROCESS pinned to a forced-host CPU
+    mesh of ``device_count`` devices (XLA_FLAGS
+    --xla_force_host_platform_device_count). Device count is fixed at
+    backend init, so in-process tests can never vary it — and an
+    externally-set XLA_FLAGS could silently shrink the mesh; the
+    subprocess guarantees the topology regardless of the parent
+    environment. The snippet's stdout is returned (assert on it);
+    non-zero exit raises with stderr attached."""
+    import subprocess
+    import sys
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (
+        f"--xla_force_host_platform_device_count={device_count}")
+    proc = subprocess.run(
+        [sys.executable, "-c", snippet], env=env, capture_output=True,
+        text=True, timeout=timeout,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    if proc.returncode != 0:
+        raise AssertionError(
+            f"mesh subprocess (devices={device_count}) failed "
+            f"rc={proc.returncode}\nstdout: {proc.stdout[-4000:]}\n"
+            f"stderr: {proc.stderr[-4000:]}")
+    return proc.stdout
+
+
+@pytest.fixture()
+def mesh_subprocess():
+    """Subprocess-isolated forced-host mesh runner (see run_on_mesh):
+    ``mesh_subprocess(snippet, device_count=8)`` → stdout."""
+    return run_on_mesh
+
+
 class CaptureLogger:
     """Conf-pluggable telemetry sink collecting every event (the reference
     test pattern: TestUtils.MockEventLogger). Point the conf at

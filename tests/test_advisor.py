@@ -10,9 +10,9 @@ Key invariants under test:
     ahead of strictly-worse candidates (ones whose rewrite never fires);
   - per-index usageCount surfaces through hs.indexes()/hs.index(name).
 
-All tests pin hyperspace.tpu.distributed.enabled=false: this image's
-jax 0.4.37 lacks jax.shard_map, so the SPMD path is environmentally
-broken (seed tier-1 failures) and must not leak into new tests.
+Sessions run with the default distributed tier (the partitioned-jit
+SPMD path over the virtual 8-device CPU mesh) — the r12 port retired
+the old quarantine.
 """
 
 import os
@@ -65,7 +65,6 @@ def env(tmp_path):
     }), dim_dir / "p0.parquet")
 
     session = hst.Session(system_path=str(tmp_path / "indexes"))
-    session.conf.set(IndexConstants.TPU_DISTRIBUTED_ENABLED, "false")
     session.conf.set(IndexConstants.INDEX_NUM_BUCKETS, 4)
     session.enable_hyperspace()
     return dict(session=session, hs=Hyperspace(session),

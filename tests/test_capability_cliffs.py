@@ -40,6 +40,9 @@ def write_dir(tmp_path, name, table, parts=2):
 def session(tmp_system_path):
     s = hst.Session(system_path=tmp_system_path)
     s.conf.set(IndexConstants.INDEX_NUM_BUCKETS, 8)
+    # Gate off: these fixtures deliberately exercise the mesh paths on
+    # small tables.
+    s.conf.set(IndexConstants.TPU_DISTRIBUTED_MIN_STREAM_ROWS, "0")
     return s
 
 

@@ -38,6 +38,8 @@ def env(tmp_path):
                        type=pa.int32()).cast(pa.date32()),
     }), d / "p0.parquet")
     session = hst.Session(system_path=str(tmp_path / "idx"))
+    session.conf.set(
+        IndexConstants.TPU_DISTRIBUTED_MIN_STREAM_ROWS, "0")
     session.conf.set(IndexConstants.INDEX_NUM_BUCKETS, 4)
     return session, str(d)
 

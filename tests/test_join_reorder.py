@@ -7,9 +7,9 @@ and join-output estimators, chain extraction + reorder semantics
 star-schema property test), the explain/telemetry observability, and
 the advisor's selectivity-discounted costing.
 
-Every session pins ``hyperspace.tpu.distributed.enabled=false``: this
-image's jax 0.4.37 lacks ``jax.shard_map``, and the SPMD path would
-fail environmentally, not meaningfully.
+Sessions run with the default distributed tier (partitioned-jit SPMD
+over the virtual 8-device CPU mesh; the r12 port retired the old
+quarantine).
 """
 
 from __future__ import annotations
@@ -35,7 +35,6 @@ from conftest import capture_logger as sink  # noqa: E402
 
 def _session(tmp_path, **conf):
     session = hst.Session(system_path=str(tmp_path / "indexes"))
-    session.conf.set(IndexConstants.TPU_DISTRIBUTED_ENABLED, "false")
     session.conf.set(IndexConstants.INDEX_NUM_BUCKETS, 4)
     for k, v in conf.items():
         session.conf.set(k, v)
@@ -427,8 +426,12 @@ class TestObservability:
     def test_estimated_vs_actual_qerror(self, wired):
         """The executor records actual inner-join output rows under the
         condition repr the reorder steps carry — every reordered step
-        must be pairable, with a sane q-error."""
+        must be pairable, with a sane q-error. Join-actual recording is
+        single-device executor instrumentation (the SPMD program
+        aggregates join output on device without materializing it), so
+        this test pins distributed off."""
         session, paths = wired
+        session.conf.set(IndexConstants.TPU_DISTRIBUTED_ENABLED, "false")
         _three_way(session, paths).to_pandas()
         steps = [s for r in session._last_join_order
                  for s in r["steps"]]
@@ -441,7 +444,9 @@ class TestObservability:
             assert q_err < 50  # sane, not perfect
 
     def test_explain_shows_actuals_after_execution(self, wired):
+        # Pins distributed off: see test_estimated_vs_actual_qerror.
         session, paths = wired
+        session.conf.set(IndexConstants.TPU_DISTRIBUTED_ENABLED, "false")
         from hyperspace_tpu.plananalysis.explain import explain_string
         q = _three_way(session, paths)
         q.to_pandas()

@@ -8,9 +8,9 @@ modulo row order only — reordering legitimately permutes rows). Queries
 the reorderer leaves untouched are asserted untouched (plan
 tree-strings identical), so parity there is structural, not timed.
 
-All sessions pin ``hyperspace.tpu.distributed.enabled=false`` (this
-image's jax lacks ``jax.shard_map``; SPMD failures would be
-environmental noise).
+Sessions run with the default distributed tier (partitioned-jit SPMD
+over the virtual 8-device CPU mesh; the r12 port retired the old
+quarantine).
 """
 
 from __future__ import annotations
@@ -81,7 +81,6 @@ def _assert_parity(session, name: str, text: str,
 def tpch(tmp_path_factory):
     root = str(tmp_path_factory.mktemp("tpch_reorder"))
     session = hst.Session(system_path=os.path.join(root, "indexes"))
-    session.conf.set(IndexConstants.TPU_DISTRIBUTED_ENABLED, "false")
     tables = tpch_mod._make_tables(np.random.default_rng(20260731))
     for name, t in tables.items():
         d = os.path.join(root, name)
@@ -121,7 +120,6 @@ class TestTpchReorderParity:
 def tpcds(tmp_path_factory):
     root = tmp_path_factory.mktemp("tpcds_reorder")
     session = hst.Session(system_path=str(root / "indexes"))
-    session.conf.set(IndexConstants.TPU_DISTRIBUTED_ENABLED, "false")
     tpcds_real.register_tables(session, str(root / "data"))
     return session
 

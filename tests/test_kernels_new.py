@@ -118,10 +118,11 @@ class TestA2AExchange:
                 arrays, valid, dst, n_dev, cap)
             return recv["k"], recv["p"], rvalid, of
 
-        k_r, p_r, v_r, of = jax.shard_map(
-            per_device, mesh=mesh, in_specs=(P(DATA_AXIS), P(DATA_AXIS)),
-            out_specs=(P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS), P()),
-            check_vma=False)(arrays, valid)
+        from hyperspace_tpu.parallel.sharding import device_view
+        k_r, p_r, v_r, of = device_view(
+            per_device, mesh, in_specs=(P(DATA_AXIS), P(DATA_AXIS)),
+            out_specs=(P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS), P()))(
+                arrays, valid)
         assert int(of) == 0
         k_r = np.asarray(k_r)
         p_r = np.asarray(p_r)
@@ -154,9 +155,10 @@ class TestA2AExchange:
             _, _, of, need = _a2a_exchange(arrays, valid, dst, n_dev, 2)
             return (of, need)
 
-        (of, need) = jax.shard_map(
-            per_device, mesh=mesh, in_specs=(P(DATA_AXIS), P(DATA_AXIS)),
-            out_specs=(P(), P()), check_vma=False)(arrays, valid)
+        from hyperspace_tpu.parallel.sharding import device_view
+        (of, need) = device_view(
+            per_device, mesh, in_specs=(P(DATA_AXIS), P(DATA_AXIS)),
+            out_specs=(P(), P()))(arrays, valid)
         assert int(of) == 1
         # The reported need is the exact worst block: every row of the
         # biggest shard targets one destination.

@@ -6,8 +6,8 @@ and FileIdTracker provenance must be identical at io.threads ∈
 {1, 4, oversubscribed}, because the pool's ordered gather makes the
 parallelism invisible to every consumer.
 
-All sessions pin hyperspace.tpu.distributed.enabled=false (this image's
-jax lacks shard_map) and run on the CPU platform via conftest.
+Sessions run on the CPU platform via conftest with the default
+distributed tier (partitioned-jit SPMD over the virtual 8-device mesh).
 """
 
 import glob
@@ -38,7 +38,6 @@ def _session(tmp_path, threads, tag=""):
     sp.mkdir(parents=True, exist_ok=True)
     s = hst.Session(system_path=str(sp))
     s.conf.set(IndexConstants.INDEX_NUM_BUCKETS, 4)
-    s.conf.set(IndexConstants.TPU_DISTRIBUTED_ENABLED, "false")
     s.conf.set(IndexConstants.TPU_IO_THREADS, threads)
     return s
 

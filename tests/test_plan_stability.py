@@ -118,9 +118,12 @@ class TestExplainGolden:
         from hyperspace_tpu.plananalysis.explain import explain_string
 
         session, queries = harness
-        # explain_string enables hyperspace itself and restores prior state.
+        # explain_string enables hyperspace itself and restores prior
+        # state. diagnostics=False: the golden pins the PLAN rendering;
+        # the runtime sections (compilation/io/spmd) read process-wide
+        # counters earlier tests in this process already moved.
         out = explain_string(session, queries[name].plan, verbose=True,
-                             mode=mode)
+                             mode=mode, diagnostics=False)
         _check(os.path.join("explain", mode), name, out)
 
 

@@ -5,8 +5,8 @@ pins the full conjunct surface — IN lists and IS [NOT] NULL included
 (the IN-heavy TPC-DS filter shape got no pruning before those landed) —
 plus result-correctness of scans whose filters are pushed.
 
-All sessions pin ``hyperspace.tpu.distributed.enabled=false`` (this
-image's jax lacks ``jax.shard_map``).
+Sessions run with the default distributed tier (partitioned-jit SPMD
+over the virtual 8-device CPU mesh).
 """
 
 from __future__ import annotations
@@ -81,7 +81,6 @@ class TestEndToEnd:
         # Many small row groups so pruning has something to skip.
         pq.write_table(t, d / "p0.parquet", row_group_size=256)
         session = hst.Session(system_path=str(tmp_path / "indexes"))
-        session.conf.set(IndexConstants.TPU_DISTRIBUTED_ENABLED, "false")
         return session, str(d), t.to_pandas()
 
     def _check(self, session, path, expected):

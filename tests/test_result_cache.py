@@ -40,10 +40,6 @@ def _write(d, n=4000, seed=7, name="p0.parquet", k_mod=50):
 def _session(tmp_path, enabled=True):
     session = hst.Session(system_path=str(tmp_path / "indexes"))
     session.conf.set(IndexConstants.INDEX_NUM_BUCKETS, 4)
-    # Single-device execution: the cache contract under test is
-    # dispatch-independent, and the virtual 8-device SPMD path depends
-    # on jax APIs absent from this image's jax build.
-    session.conf.set(IndexConstants.TPU_DISTRIBUTED_ENABLED, "false")
     if enabled:
         session.conf.set(ServingConstants.RESULT_CACHE_ENABLED, "true")
         session.conf.set(
@@ -393,7 +389,6 @@ def tpcds(tmp_path_factory):
     root = tmp_path_factory.mktemp("tpcds_result_cache")
     session = hst.Session(system_path=str(root / "indexes"))
     session.conf.set(IndexConstants.INDEX_NUM_BUCKETS, 4)
-    session.conf.set(IndexConstants.TPU_DISTRIBUTED_ENABLED, "false")
     session.conf.set(ServingConstants.RESULT_CACHE_ENABLED, "true")
     session.conf.set(ServingConstants.RESULT_CACHE_MIN_COMPUTE_SECONDS, "0")
     tpcds_real.register_tables(session, str(root / "data"))

@@ -44,7 +44,6 @@ def _seed(tmp_path, n=4000):
 def _session(tmp_path, cache_on=True):
     session = hst.Session(system_path=str(tmp_path / "indexes"))
     session.conf.set(IndexConstants.INDEX_NUM_BUCKETS, 4)
-    session.conf.set(IndexConstants.TPU_DISTRIBUTED_ENABLED, "false")
     if cache_on:
         session.conf.set(ServingConstants.RESULT_CACHE_ENABLED, "true")
         session.conf.set(
@@ -175,7 +174,6 @@ def _refresh_worker(root, q):
 
     session = hst.Session(system_path=os.path.join(root, "indexes"))
     from hyperspace_tpu.index.constants import IndexConstants as IC
-    session.conf.set(IC.TPU_DISTRIBUTED_ENABLED, "false")
     try:
         Hyperspace(session).refresh_index("raceIdx", "incremental")
         q.put(("refresh", "ok"))

@@ -11,9 +11,9 @@ thread-safety hammer for session state concurrent execute() touches,
 and the mixed TPC-H/TPC-DS concurrency soak (M threads x K queries
 identical to serial execution).
 
-All sessions pin ``hyperspace.tpu.distributed.enabled=false``: the
-virtual 8-device SPMD path depends on jax APIs absent from this image's
-jax build (the known environmental tier-1 failure set).
+Sessions run with the default distributed tier (partitioned-jit SPMD
+over the virtual 8-device CPU mesh; the r12 port retired the old
+quarantine).
 """
 
 import os
@@ -58,7 +58,6 @@ def _write(d, n=4000, seed=7, files=1):
 def _session(tmp_path, capture_events=False, **conf):
     session = hst.Session(system_path=str(tmp_path / "indexes"))
     session.conf.set(IndexConstants.INDEX_NUM_BUCKETS, 4)
-    session.conf.set(IndexConstants.TPU_DISTRIBUTED_ENABLED, "false")
     if capture_events:
         session.conf.set(IndexConstants.EVENT_LOGGER_CLASS,
                          "tests.conftest.CaptureLogger")
@@ -251,7 +250,6 @@ class TestAdmission:
     def test_queue_depth_rejection(self, tmp_path):
         _write(tmp_path / "d")
         session = _GatedSession(system_path=str(tmp_path / "indexes"))
-        session.conf.set(IndexConstants.TPU_DISTRIBUTED_ENABLED, "false")
         session.conf.set(IndexConstants.EVENT_LOGGER_CLASS,
                          "tests.conftest.CaptureLogger")
         session.conf.set(ServingConstants.SERVING_QUEUE_DEPTH, "1")
@@ -305,7 +303,6 @@ class TestAdmission:
             self, tmp_path):
         _write(tmp_path / "d")
         session = _GatedSession(system_path=str(tmp_path / "indexes"))
-        session.conf.set(IndexConstants.TPU_DISTRIBUTED_ENABLED, "false")
         session.conf.set(ServingConstants.SERVING_MAX_CONCURRENCY, "1")
         session.conf.set(ServingConstants.SERVING_BATCHING_ENABLED,
                          "false")

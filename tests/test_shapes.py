@@ -42,7 +42,6 @@ LENGTHS = [0, 1, 63, 64, 65, 127, 128, 200]
 
 def _session(tmp_path, **conf):
     s = hst.Session(system_path=str(tmp_path / "idx"))
-    s.conf.set(IndexConstants.TPU_DISTRIBUTED_ENABLED, "false")
     for k, v in conf.items():
         s.conf.set(k, v)
     return s

@@ -24,7 +24,10 @@ from hyperspace_tpu.plan.expr import avg, col, count, max_, min_, sum_
 
 @pytest.fixture()
 def session(tmp_system_path):
-    return hst.Session(system_path=tmp_system_path)
+    s = hst.Session(system_path=tmp_system_path)
+    # Gate off: these fixtures are deliberately small meshes.
+    s.conf.set(IndexConstants.TPU_DISTRIBUTED_MIN_STREAM_ROWS, "0")
+    return s
 
 
 @pytest.fixture()

@@ -18,12 +18,16 @@ import pytest
 
 import hyperspace_tpu as hst
 from hyperspace_tpu.execution import spmd
+from hyperspace_tpu.index.constants import IndexConstants
 from hyperspace_tpu.plan.expr import col, count, sum_
 
 
 @pytest.fixture()
 def session(tmp_system_path):
-    return hst.Session(system_path=tmp_system_path)
+    s = hst.Session(system_path=tmp_system_path)
+    # Gate off: these fixtures are deliberately small meshes.
+    s.conf.set(IndexConstants.TPU_DISTRIBUTED_MIN_STREAM_ROWS, "0")
+    return s
 
 
 def test_million_groups_no_fallback(session, tmp_path):

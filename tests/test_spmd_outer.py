@@ -30,7 +30,10 @@ from hyperspace_tpu.plan.expr import col, count, sum_
 
 @pytest.fixture()
 def session(tmp_system_path):
-    return hst.Session(system_path=tmp_system_path)
+    s = hst.Session(system_path=tmp_system_path)
+    # Gate off: these fixtures are deliberately small meshes.
+    s.conf.set(IndexConstants.TPU_DISTRIBUTED_MIN_STREAM_ROWS, "0")
+    return s
 
 
 def write_dir(tmp_path, name, table):

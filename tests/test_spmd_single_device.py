@@ -23,8 +23,9 @@ from hyperspace_tpu.plan.expr import col, count, sum_
 
 @pytest.fixture()
 def session(tmp_system_path, monkeypatch):
-    monkeypatch.setattr(spmd, "_device_count", lambda: 1)
+    monkeypatch.setattr(spmd, "_device_count", lambda *a: 1)
     s = hst.Session(system_path=tmp_system_path)
+    s.conf.set(IndexConstants.TPU_DISTRIBUTED_MIN_STREAM_ROWS, "0")
     s.conf.set(IndexConstants.TPU_DISTRIBUTED_SINGLE_DEVICE, "on")
     return s
 

@@ -22,6 +22,7 @@ import pytest
 
 import hyperspace_tpu as hst
 from hyperspace_tpu.execution import spmd
+from hyperspace_tpu.index.constants import IndexConstants
 from hyperspace_tpu.plan.expr import col
 
 
@@ -34,7 +35,10 @@ def _force_spmd_sort(monkeypatch):
 
 @pytest.fixture()
 def session(tmp_system_path):
-    return hst.Session(system_path=tmp_system_path)
+    s = hst.Session(system_path=tmp_system_path)
+    # Gate off: these fixtures are deliberately small meshes.
+    s.conf.set(IndexConstants.TPU_DISTRIBUTED_MIN_STREAM_ROWS, "0")
+    return s
 
 
 @pytest.fixture()

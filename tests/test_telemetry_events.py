@@ -323,9 +323,6 @@ class TestEventTaxonomy:
         from hyperspace_tpu.index.log_manager import IndexLogManager
 
         hs, session = env["hs"], env["session"]
-        # This image's jax lacks shard_map; the distributed build path
-        # would fail environmentally (all new tests pin it off).
-        session.conf.set(IndexConstants.TPU_DISTRIBUTED_ENABLED, "false")
         df = session.read.parquet(env["path"])
         hs.create_index(df, IndexConfig("cxIdx", ["k"], ["v"]))
         # Simulate a crash mid-refresh so cancel is legal.

@@ -2,9 +2,8 @@
 join, and data-skipping — including the no-index and wrong-column cases
 (the diagnostic surface the advisor's reports point users at).
 
-All tests pin hyperspace.tpu.distributed.enabled=false (this image's
-jax 0.4.37 lacks jax.shard_map; the environmental seed failures must not
-grow).
+Sessions run with the default distributed tier (partitioned-jit SPMD
+over the virtual 8-device CPU mesh).
 """
 
 import numpy as np
@@ -40,7 +39,6 @@ def env(tmp_path):
         "dv": pa.array(np.arange(100, dtype=np.int64)),
     }), d2 / "p0.parquet")
     session = hst.Session(system_path=str(tmp_path / "indexes"))
-    session.conf.set(IndexConstants.TPU_DISTRIBUTED_ENABLED, "false")
     session.conf.set(IndexConstants.INDEX_NUM_BUCKETS, 4)
     session.enable_hyperspace()
     return dict(session=session, hs=Hyperspace(session),
