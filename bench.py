@@ -1516,6 +1516,68 @@ def _single_device_phases(args, root):
             RESULT["serving_program_bank_hits"] = bank["hits"]
             RESULT["serving_program_bank_programs"] = bank["programs"]
 
+    # ---- observability: tracing overhead + live serving latency ----
+    # The r13 acceptance pair: (a) trace_overhead_pct — the same warm
+    # q3/q17 timed traced vs untraced, alternating best-of-two (the
+    # same A/B discipline as join_reorder; tracing must cost <= ~3% on
+    # and ~0 off), and (b) serving_live_p99_ms — the rolling-window
+    # latency histogram the serving frontend fed during the serving
+    # phase just above, read back through the metrics registry (the
+    # LIVE percentiles ROADMAP item 1 asked for, vs the bench-computed
+    # ones). Runs BEFORE the hybrid appends so the traced queries see
+    # the same sources the untraced timings did.
+    if not _backend_dead():
+        with _phase("observability"):
+            from hyperspace_tpu.telemetry.constants import \
+                TelemetryConstants as _TC
+            from hyperspace_tpu.telemetry.metrics import get_registry
+
+            def _tracing(on: bool):
+                session.conf.set(_TC.TRACE_ENABLED,
+                                 "true" if on else "false")
+
+            # Histogram first: its window slides (samples landed during
+            # the serving phase just above; the trace A/B below could
+            # age them out at large scales).
+            hist = get_registry().snapshot()["histograms"].get(
+                "serving.latency_ms")
+            if hist and hist.get("count"):
+                RESULT["serving_live_p50_ms"] = round(hist["p50"], 2)
+                RESULT["serving_live_p99_ms"] = round(hist["p99"], 2)
+                RESULT["serving_live_qps"] = hist["qps"]
+                RESULT["serving_live_window_s"] = hist["window_s"]
+            else:
+                RESULT["errors"].append(
+                    "observability: serving latency histogram empty "
+                    "(serving phase skipped or failed)")
+            session.disable_hyperspace()
+            overheads = []
+            for qn in ("q3", "q17"):
+                tq = queries.get(qn)
+                if tq is None:
+                    continue
+                tq.to_arrow()  # warm the untraced path's programs
+                _tracing(True)
+                tq.to_arrow()  # warm the traced path (same programs)
+                off_best = on_best = float("inf")
+                for _ in range(2):  # alternating A/B, best-of-two
+                    _tracing(False)
+                    off_best = min(off_best,
+                                   timed_best(lambda: tq.to_arrow(), 1))
+                    _tracing(True)
+                    on_best = min(on_best,
+                                  timed_best(lambda: tq.to_arrow(), 1))
+                _tracing(False)
+                pct = ((on_best - off_best) / off_best * 100.0) \
+                    if off_best > 0 else 0.0
+                overheads.append(pct)
+                RESULT[f"trace_overhead_{qn}_pct"] = round(pct, 2)
+                RESULT[f"trace_spans_{qn}"] = len(getattr(
+                    session, "_last_trace").spans)
+            if overheads:
+                RESULT["trace_overhead_pct"] = round(
+                    sum(overheads) / len(overheads), 2)
+
     # ---- BASELINE config #5: Hybrid Scan over appended source files ----
     # Runs LAST: the appends invalidate plain signatures, so every other
     # query pair must be timed first.

@@ -207,9 +207,13 @@ class Hyperspace:
         """Process-wide parallel-I/O pool counters (parallel/io.py):
         pooled read fan-outs, file tasks, byte estimates, in-worker
         read+decode seconds, consumer wait seconds, prefetch streams,
-        and the current pool width."""
+        and the current pool width. Delegates to the process metrics
+        registry's "io" collector (telemetry/metrics.py) — importing
+        the pool module registers it."""
         from .parallel import io as pio
-        return pio.pool_stats()
+        from .telemetry.metrics import get_registry
+        out = get_registry().collect("io")
+        return out if out is not None else pio.pool_stats()
 
     def spmd_stats(self) -> dict:
         """Distributed-tier observability (execution/spmd.py over the
@@ -234,6 +238,37 @@ class Hyperspace:
             "file_aligned_scan":
                 self.session.hs_conf.distributed_mesh_file_aligned_scan(),
         }
+
+    def metrics(self) -> dict:
+        """ONE snapshot over every subsystem (telemetry/metrics.py): the
+        process registry's counters/gauges, the live histograms (the
+        serving frontend feeds ``serving.latency_ms`` — rolling
+        p50/p95/p99 + QPS over
+        ``hyperspace.tpu.telemetry.serving.latencyWindow``), and every
+        named collector — ``io``, ``program_bank``, ``serving`` plus the
+        session-scoped ``result_cache`` and ``spmd`` surfaces — so every
+        counter previously reachable only through the five per-subsystem
+        stats APIs is reachable here."""
+        from .parallel import io as pio
+        from .serving.program_bank import get_bank
+        from .telemetry.metrics import get_registry
+        snap = get_registry().snapshot()
+        cols = snap["collectors"]
+        cols.setdefault("io", pio.pool_stats())
+        cols.setdefault("program_bank", get_bank().stats())
+        cols["result_cache"] = self.result_cache_stats()
+        cols["spmd"] = self.spmd_stats()
+        if "serving" not in cols:
+            cols["serving"] = self.serving_stats()
+        return snap
+
+    def last_trace(self):
+        """The span-tree :class:`~.telemetry.trace.Trace` of this
+        session's most recent traced query — None until a query runs
+        with ``hyperspace.tpu.telemetry.trace.enabled=true``. Export
+        with ``.to_chrome_json()`` (chrome://tracing / Perfetto) or
+        render via ``telemetry.trace.render_timeline``."""
+        return getattr(self.session, "_last_trace", None)
 
     def serving_frontend(self):
         """The process-default concurrent serving frontend

@@ -17,6 +17,7 @@ from .advisor.constants import AdvisorConstants
 from .index.constants import IndexConstants
 from .optimizer.constants import OptimizerConstants
 from .serving.constants import ServingConstants
+from .telemetry.constants import TelemetryConstants
 
 T = TypeVar("T")
 
@@ -460,6 +461,40 @@ class HyperspaceConf:
         return int(self._conf.get(
             OptimizerConstants.JOIN_REORDER_DP_THRESHOLD,
             OptimizerConstants.JOIN_REORDER_DP_THRESHOLD_DEFAULT))
+
+    # ------------------------------------------------------------------
+    # Telemetry (telemetry/constants.py): tracing, metrics, profiler.
+    # ------------------------------------------------------------------
+
+    def telemetry_trace_enabled(self) -> bool:
+        return self._get_bool(
+            TelemetryConstants.TRACE_ENABLED,
+            TelemetryConstants.TRACE_ENABLED_DEFAULT)
+
+    def telemetry_trace_max_spans(self) -> int:
+        return int(self._conf.get(
+            TelemetryConstants.TRACE_MAX_SPANS,
+            TelemetryConstants.TRACE_MAX_SPANS_DEFAULT))
+
+    def telemetry_metrics_enabled(self) -> bool:
+        return self._get_bool(
+            TelemetryConstants.METRICS_ENABLED,
+            TelemetryConstants.METRICS_ENABLED_DEFAULT)
+
+    def telemetry_serving_latency_window(self) -> float:
+        return max(float(self._conf.get(
+            TelemetryConstants.SERVING_LATENCY_WINDOW,
+            TelemetryConstants.SERVING_LATENCY_WINDOW_DEFAULT)), 0.001)
+
+    def telemetry_profiler_enabled(self) -> bool:
+        return self._get_bool(
+            TelemetryConstants.PROFILER_ENABLED,
+            TelemetryConstants.PROFILER_ENABLED_DEFAULT)
+
+    def telemetry_profiler_dir(self) -> str:
+        return self._conf.get(
+            TelemetryConstants.PROFILER_DIR,
+            TelemetryConstants.PROFILER_DIR_DEFAULT) or ""
 
     def _get_bool(self, key: str, default: str) -> bool:
         return (self._conf.get(key, default) or "").strip().lower() == "true"

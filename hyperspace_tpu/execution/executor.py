@@ -25,6 +25,8 @@ from ..plan import expr as E
 from ..plan.nodes import (Aggregate, BucketUnion, Filter, IndexScan, Join, Limit,
                           LogicalPlan, Project, Scan, Sort, Union, Window)
 from ..schema import BOOL, DATE, FLOAT64, INT32, INT64, STRING
+from ..telemetry import span_names as SN
+from ..telemetry import trace as _trace
 from . import shapes
 from .columnar import (Column, Table, dictionaries_equal, filter_indices,
                        read_parquet, translate_codes)
@@ -103,6 +105,20 @@ def _shared_scan_key(plan: Scan, needed: Optional[Set[str]]):
 
 
 def _execute(plan: LogicalPlan, needed: Optional[Set[str]]) -> Table:
+    """Per-stage tracing wrapper: one ``exec.stage`` span per executed
+    plan node, nesting with the recursion so the span tree mirrors the
+    plan tree. ``idle()`` short-circuits the whole thing to a plain call
+    while tracing is off (the no-op fast path contract)."""
+    if _trace.idle():
+        return _execute_node(plan, needed)
+    with _trace.span(SN.EXEC_STAGE, node=plan.node_name) as sp:
+        table = _execute_node(plan, needed)
+        if sp is not None:
+            sp.attrs["rows"] = int(table.num_rows)
+        return table
+
+
+def _execute_node(plan: LogicalPlan, needed: Optional[Set[str]]) -> Table:
     if isinstance(plan, Scan):
         from ..serving import batcher
         sweep = batcher.active_sweep()

@@ -91,6 +91,8 @@ from ..plan import expr as E
 from ..plan.nodes import (Aggregate, Filter, IndexScan, Join, LogicalPlan,
                           Project, Scan)
 from ..schema import BOOL, DATE, FLOAT64, INT32, INT64, STRING
+from ..telemetry import span_names as SN
+from ..telemetry import trace as _trace
 from .columnar import Column, Table, dictionaries_equal, translate_codes
 from .evaluator import eval_expr, eval_predicate_mask
 
@@ -1106,7 +1108,38 @@ def _out_rows(prep: _Prepared, caps: Dict[int, Tuple[int, int]]) -> int:
     return rows
 
 
+def _record_join_actuals(session, prep: "_Prepared", out) -> None:
+    """Write the SPMD program's observed inner-join output rows (the
+    psum'd ``jrows:`` outputs) to the same session store the
+    single-device executor uses (serving/context.record_join_actual) —
+    the join-reorder q-error pairing works on the distributed path too,
+    so its instrumentation no longer pins ``distributed.enabled=false``."""
+    from ..serving import context as qctx
+    ctx = qctx.active_context()
+    for i, (kind, node) in enumerate(prep.stages):
+        key = f"jrows:{i}"
+        if kind != "join" or key not in out:
+            continue
+        rows = int(np.asarray(jax.device_get(out[key])))
+        if ctx is not None:
+            ctx.record_join_actual(repr(node.condition), rows)
+        elif session is not None:
+            qctx.record_join_actual(session, repr(node.condition), rows)
+
+
 def _run(plan: Aggregate, executor, session=None) -> Table:
+    """Dispatch wrapper: one ``spmd.dispatch`` span per mesh execution
+    (capacity-escalation retries stay inside the one span — they are one
+    dispatch from the query's point of view)."""
+    with _trace.span(SN.SPMD_DISPATCH, mode="agg") as sp:
+        table = _run_impl(plan, executor, session)
+        if sp is not None:
+            sp.attrs["rows"] = int(table.num_rows)
+            sp.attrs["cap_attempts"] = LAST_CAP_ATTEMPTS
+        return table
+
+
+def _run_impl(plan: Aggregate, executor, session=None) -> Table:
     global DISPATCH_COUNT, LAST_CAP_ATTEMPTS
     LAST_CAP_ATTEMPTS = 1
     caps: Dict[int, Tuple[int, int]] = {}
@@ -1189,6 +1222,7 @@ def _run(plan: Aggregate, executor, session=None) -> Table:
         else:
             table = _merge_global(out, agg_specs, prep.final_meta)
         DISPATCH_COUNT += 1
+        _record_join_actuals(session, prep, out)
         _emit_spmd_events(session,
                           "grouped-agg" if grouped else "global-agg",
                           prep, caps, LAST_CAP_ATTEMPTS)
@@ -1196,6 +1230,17 @@ def _run(plan: Aggregate, executor, session=None) -> Table:
 
 
 def _run_stream(root, executor, sort_orders=(), session=None) -> Table:
+    """Dispatch wrapper for the row-returning path — see :func:`_run`."""
+    mode = "sort" if sort_orders else "stream"
+    with _trace.span(SN.SPMD_DISPATCH, mode=mode) as sp:
+        table = _run_stream_impl(root, executor, sort_orders, session)
+        if sp is not None:
+            sp.attrs["rows"] = int(table.num_rows)
+            sp.attrs["cap_attempts"] = LAST_CAP_ATTEMPTS
+        return table
+
+
+def _run_stream_impl(root, executor, sort_orders=(), session=None) -> Table:
     """Row-returning SPMD execution of a {Filter, Project, Join}* chain:
     every device runs the stages on its shard, the host gathers each
     device's valid rows and concatenates (VERDICT r3 #3a). With
@@ -1245,6 +1290,7 @@ def _run_stream(root, executor, sort_orders=(), session=None) -> Table:
         DISPATCH_COUNT += 1
         if mode == "sort":
             SORT_DISPATCH_COUNT += 1
+        _record_join_actuals(session, prep, out)
         _emit_spmd_events(session, mode, prep, caps, LAST_CAP_ATTEMPTS)
         return Table(cols)
     raise _Unsupported("exchange join capacity escalation exhausted")
@@ -1510,6 +1556,12 @@ def _spmd_program(sharded, valid, bcast, xch, *, mesh: Mesh,
                     continue
                 if jt == "inner":
                     mask = mask & found
+                    # Observed join output rows (m:1 probe: one emit per
+                    # surviving stream row) — psum'd so the host can
+                    # write the actual back to the session's q-error
+                    # store (optimizer/join_order pairing).
+                    overflow_flags[f"jrows:{i}"] = jax.lax.psum(
+                        jnp.sum(mask.astype(jnp.int32)), DATA_AXIS)
                 # left outer: mask unchanged — unmatched stream rows stay,
                 # with the right columns invalid below.
                 rnames = {r for _, r in pairs}
@@ -1727,6 +1779,12 @@ def _spmd_program(sharded, valid, bcast, xch, *, mesh: Mesh,
                         new_cols[rname] = Column(lcm[0], data, vv, lcm[1])
                 table = Table(new_cols)
                 mask = out_mask
+                if jt == "inner":
+                    # Emitted match pairs across the mesh (inner: every
+                    # emit is a match; preserved-outer shapes are not
+                    # recorded, matching the executor's actuals policy).
+                    overflow_flags[f"jrows:{i}"] = jax.lax.psum(
+                        total_eff.astype(jnp.int32), DATA_AXIS)
 
         if mode == "sort":
             # Distributed ORDER BY: range-partitioned sample sort (the
@@ -1885,7 +1943,7 @@ def _spmd_program(sharded, valid, bcast, xch, *, mesh: Mesh,
             send = {k: v for k, v in out.items()
                     if k not in ("overflow", "gvalid", "gneed")
                     and not k.startswith(("xof:", "xneedc:",
-                                          "xneedo:"))}
+                                          "xneedo:", "jrows:"))}
             gv = out["gvalid"]
             h = None
             for g in group_cols:
@@ -1932,6 +1990,10 @@ def _spmd_program(sharded, valid, bcast, xch, *, mesh: Mesh,
 
     xof_keys = [f"{tag}:{i}" for i, j in descr.joins.items() if j[0] == "x"
                 for tag in ("xof", "xneedc", "xneedo")]
+    # Replicated (psum'd) per-inner-join output counts — the SPMD-path
+    # join actuals the host records after a successful dispatch.
+    xof_keys += [f"jrows:{i}" for i, j in descr.joins.items()
+                 if j[3] == "inner"]
     if mode == "sort":
         xof_keys += ["xof:-1", "xneedc:-1", "xneedo:-1"]
     if mode in ("stream", "sort"):

@@ -331,8 +331,15 @@ def reorder_joins(session, plan: LogicalPlan,
     ``session._last_join_order`` (explain/bench read it back); emits
     JoinReorderEvent/CardinalityEstimateEvent telemetry on non-diagnostic
     passes that changed an order."""
+    from ..telemetry import span_names as SN
+    from ..telemetry import trace as _trace
     records: List[dict] = []
-    out = _rewrite(session, plan, records)
+    with _trace.span(SN.JOIN_REORDER) as sp:
+        out = _rewrite(session, plan, records)
+        if sp is not None:
+            sp.attrs["chains"] = len(records)
+            sp.attrs["reordered"] = sum(
+                1 for r in records if r["reordered"])
     session._last_join_order = records
     if not diagnostic and any(r["reordered"] for r in records):
         _emit_events(session, records)

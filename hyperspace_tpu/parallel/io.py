@@ -47,6 +47,10 @@ from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass
 from typing import Callable, Iterable, Iterator, Optional
 
+from ..telemetry import metrics as _metrics
+from ..telemetry import span_names as _sn
+from ..telemetry import trace as _trace
+
 # ---------------------------------------------------------------------------
 # Parameters (conf-backed; see config.py io_* accessors).
 # ---------------------------------------------------------------------------
@@ -225,6 +229,13 @@ def pool_stats() -> dict:
     return out
 
 
+# The pool counters are a named collector in the process metrics
+# registry (telemetry/metrics.py): Hyperspace.io_stats() delegates
+# through it, and Hyperspace.metrics() snapshots it with every other
+# subsystem.
+_metrics.get_registry().register_collector("io", pool_stats)
+
+
 def reset_stats() -> None:
     """Zero the counters (bench A/B phases; never needed for correctness)."""
     with _stats_lock:
@@ -338,6 +349,7 @@ def imap_ordered(fn: Callable, items: Iterable, *,
     wait_s = 0.0
     nbytes = 0
     i = 0
+    t_start = time.perf_counter()
 
     def _refill():
         nonlocal i
@@ -369,6 +381,13 @@ def imap_ordered(fn: Callable, items: Iterable, *,
             fut.cancel()
         _note(pooled_reads=1, read_tasks=done, read_bytes=nbytes,
               read_seconds=read_s, wait_seconds=wait_s)
+        # Trace attribution rides the same consumer-side seam as _note's
+        # per-query io counters: pool workers never see the query's
+        # context, the consuming thread does.
+        _trace.add_span(_sn.IO_READ, start_perf=t_start, label=label,
+                        files=done, nbytes=nbytes,
+                        read_seconds=round(read_s, 4),
+                        wait_seconds=round(wait_s, 4), threads=n)
         _emit_read(session, label, done, nbytes, read_s, n)
 
 
@@ -466,6 +485,7 @@ def prefetch_iter(source: Iterable, *,
     producer.start()
     wait_s = 0.0
     items = 0
+    t_start = time.perf_counter()
     try:
         while True:
             t0 = time.perf_counter()
@@ -493,6 +513,10 @@ def prefetch_iter(source: Iterable, *,
         producer.join(timeout=30.0)
         _note(prefetch_streams=1, prefetch_items=items,
               wait_seconds=wait_s, read_seconds=state["read_s"])
+        _trace.add_span(_sn.IO_PREFETCH, start_perf=t_start, label=label,
+                        items=items,
+                        read_seconds=round(state["read_s"], 4),
+                        wait_seconds=round(wait_s, 4))
         _emit_wait(session, label, wait_s, state["read_s"], items)
 
 

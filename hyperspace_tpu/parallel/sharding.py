@@ -186,10 +186,13 @@ class MeshProgram:
             entry = self._compiled.get(sig)
             if entry is None:
                 global COMPILE_COUNT
-                # shardings: inferred from the committed NamedSharding
-                # inputs; device_view pins every internal layout with
-                # with_sharding_constraint (see module docstring).
-                compiled = jax.jit(self._fn).lower(*args).compile()
+                from ..telemetry import span_names as _sn
+                from ..telemetry import trace as _tr
+                with _tr.span(_sn.SPMD_COMPILE, stage=self._name):
+                    # shardings: inferred from the committed NamedSharding
+                    # inputs; device_view pins every internal layout with
+                    # with_sharding_constraint (see module docstring).
+                    compiled = jax.jit(self._fn).lower(*args).compile()
                 entry = [compiled, None]
                 self._compiled[sig] = entry
                 COMPILE_COUNT += 1

@@ -112,6 +112,7 @@ def explain_string(session, plan: LogicalPlan, verbose: bool = False,
         _write_io_section(buf, session)
         _write_spmd_section(buf, session)
         _write_serving_section(buf, session)
+        _write_trace_section(buf, session)
     _write_advisor_section(buf, session, with_index)
     _write_join_order_section(buf, session)
     if verbose:
@@ -297,6 +298,26 @@ def _write_serving_section(buf: BufferStream, session) -> None:
         f"program bank: stages={b['stages']} programs={b['programs']} "
         f"hits={b['hits']} misses={b['misses']} "
         f"evictions={b['stage_evictions']}")
+
+
+def _write_trace_section(buf: BufferStream, session) -> None:
+    """Unified-tracing observability (telemetry/trace.py): the span
+    timeline of the session's most recent traced query, with per-span
+    wall and self times. Rendered only once a traced query has actually
+    run (``_last_trace`` set), so explain goldens of trace-less sessions
+    are untouched."""
+    trace = getattr(session, "_last_trace", None)
+    if trace is None:
+        return
+    from ..telemetry.trace import render_timeline
+    buf.write_line()
+    _header(buf, "Trace:")
+    buf.write_line(
+        f"trace {trace.trace_id}: {len(trace.spans)} span(s), "
+        f"{trace.duration_s() * 1000:.2f} ms total "
+        f"(hs.last_trace().to_chrome_json() exports it)")
+    for line in render_timeline(trace):
+        buf.write_line(line)
 
 
 def _write_advisor_section(buf: BufferStream, session,

@@ -36,6 +36,17 @@ def _applied_index_names(plan: LogicalPlan) -> List[str]:
 
 def apply_hyperspace(session, plan: LogicalPlan,
                      ctx: ReasonCollector = None) -> LogicalPlan:
+    from ..telemetry import span_names as SN
+    from ..telemetry import trace as _trace
+    with _trace.span(SN.INDEX_REWRITE) as sp:
+        plan = _apply_hyperspace(session, plan, ctx)
+        if sp is not None:
+            sp.attrs["applied"] = len(_applied_index_names(plan))
+        return plan
+
+
+def _apply_hyperspace(session, plan: LogicalPlan,
+                      ctx: ReasonCollector = None) -> LogicalPlan:
     from .data_skipping_rule import DataSkippingIndexRule
     from .filter_rule import FilterIndexRule
     from .join_rule import JoinIndexRule

@@ -300,6 +300,10 @@ class SweepContext:
         with self._lock:
             self._masks[memo_key] = memo
             self.sweep_invocations += 1
+        # Process-lifetime tally in the metrics registry (the frontend's
+        # batch counters reset with the frontend; this one survives it).
+        from ..telemetry import metrics as _metrics
+        _metrics.get_registry().counter_add("serving.sweep_invocations")
         return memo
 
     def stats(self) -> dict:

@@ -72,6 +72,13 @@ class QueryContext:
         # Resolved handles (pinned for the query's lifetime).
         self.result_cache = result_cache
         self.capture = bool(capture) if capture is not None else False
+        # Unified tracing (telemetry/trace.py): ``trace`` is the Trace
+        # this query's spans landed in (set by query_trace once tracing
+        # is on); ``trace_parent`` is an optional (Trace, Span) pair a
+        # literal-sweep batch hands in so member queries nest under ONE
+        # shared sweep span instead of opening their own roots.
+        self.trace = None
+        self.trace_parent = None
         # Per-query io counters; the lock is for cross-thread writers
         # (prefetch producers run in a copied context on another thread).
         self._io_lock = threading.Lock()

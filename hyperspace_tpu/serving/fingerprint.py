@@ -148,14 +148,17 @@ def index_versions(session) -> Tuple[Tuple[str, int, str], ...]:
 
 
 def config_hash(session) -> str:
-    """Conf + enabled-flag hash. The serving knobs themselves are
-    excluded: they steer THIS cache (admission floors, budgets), never
-    the computed answer — hashing them would orphan every warm entry on
-    an admission-threshold tweak, breaking config.py's live-tuning
-    contract."""
+    """Conf + enabled-flag hash. The serving and telemetry knobs
+    themselves are excluded: they steer THIS cache (admission floors,
+    budgets) or pure observability (tracing/metrics/profiler — results
+    are byte-identical by contract, asserted in tests/test_tracing.py),
+    never the computed answer — hashing them would orphan every warm
+    entry on an admission-threshold tweak or a tracing toggle, breaking
+    config.py's live-tuning contract."""
     items = [(k, v) for k, v in sorted(session.conf.as_dict().items())
              if not k.startswith("serving.")
-             and not k.startswith("hyperspace.tpu.serving.")]
+             and not k.startswith("hyperspace.tpu.serving.")
+             and not k.startswith("hyperspace.tpu.telemetry.")]
     return hashing.md5_hex((items, session.is_hyperspace_enabled()))
 
 

@@ -136,11 +136,16 @@ class ServingFrontend:
         # Construction is the opt-in (README/bench construct directly):
         # the first live frontend becomes the process default so
         # serving_stats()/explain's "Serving:" section observe it
-        # without going through get_frontend().
+        # without going through get_frontend(). The default frontend
+        # also registers as the "serving" collector in the process
+        # metrics registry (telemetry/metrics.py).
         global _DEFAULT
         with _DEFAULT_LOCK:
             if _DEFAULT is None:
                 _DEFAULT = self
+                from ..telemetry import metrics as _metrics
+                _metrics.get_registry().register_collector(
+                    "serving", self.stats)
 
     # ------------------------------------------------------------------
     # Shared cross-session result cache.
@@ -306,6 +311,23 @@ class ServingFrontend:
             self._note(failed=1)
         finally:
             self._release(entry)
+            self._observe_latency(entry.pending)
+
+    def _sweep_trace(self, batch: List[_Entry]):
+        """The shared sweep trace (telemetry/trace.py): ONE
+        ``serving.sweep`` span whose children are the member queries'
+        roots — opened only when the governing conf traces, handed to
+        members via QueryContext.trace_parent (their submit-time context
+        snapshots predate the batch, so a contextvar cannot carry it)."""
+        if not self._hs_conf.telemetry_trace_enabled():
+            return None
+        from ..telemetry import span_names as SN
+        from ..telemetry import trace as _trace
+        tr = _trace.Trace(self._hs_conf.telemetry_trace_max_spans(),
+                          label="sweep")
+        span = tr.new_span(SN.SERVING_SWEEP, None,
+                           {"size": len(batch)})
+        return (tr, span)
 
     def _run_batch(self, batch: List[_Entry]) -> None:
         """Execute literal-variant members under one SweepContext: one
@@ -319,12 +341,14 @@ class ServingFrontend:
                 self._run_single(e)
             return
         sweep = batcher.SweepContext(conditions)
+        trace_parent = self._sweep_trace(batch)
         for i, e in enumerate(batch):
             e.pending.started_s = time.perf_counter()
             e.pending.batched = True
             e.pending.batch_size = len(batch)
             try:
-                result = e.ctx.run(self._execute_entry, e, sweep, i)
+                result = e.ctx.run(self._execute_entry, e, sweep, i,
+                                   trace_parent)
                 e.pending._finish(result=result)
                 self._note(completed=1)
             except BaseException as err:
@@ -332,7 +356,14 @@ class ServingFrontend:
                 self._note(failed=1)
             finally:
                 self._release(e)
+                self._observe_latency(e.pending)
         s = sweep.stats()
+        if trace_parent is not None:
+            _, sweep_span = trace_parent
+            if sweep_span is not None:
+                sweep_span.attrs["positions"] = s["positions"]
+                sweep_span.attrs["members"] = len(batch)
+                sweep_span.finish()
         self._note(batches=1, batched_queries=len(batch),
                    sweep_invocations=s["sweep_invocations"],
                    shared_scans=s["shared_scans"],
@@ -341,14 +372,37 @@ class ServingFrontend:
 
     def _execute_entry(self, entry: _Entry,
                        sweep: Optional[batcher.SweepContext],
-                       member: int):
+                       member: int, trace_parent=None):
         qc = QueryContext.for_session(
             entry.session, shared_cache=self.result_cache(),
             client=entry.pending.client)
+        qc.trace_parent = trace_parent
         entry.pending.query_id = qc.query_id
         entry.pending.context = qc
         with batcher.use_sweep(sweep, member):
             return entry.session.execute(entry.plan, context=qc)
+
+    def _observe_latency(self, pending: PendingQuery) -> None:
+        """Feed the live serving latency histogram
+        (telemetry/metrics.py ``serving.latency_ms``) — the source of
+        Hyperspace.metrics()'s rolling p50/p95/p99 + QPS."""
+        if pending.latency_s is None:
+            return
+        try:
+            if not self._hs_conf.telemetry_metrics_enabled():
+                return
+            from ..telemetry import metrics as _metrics
+            # Only the process-DEFAULT frontend's conf governs the
+            # shared instrument's window; other frontends just record
+            # (two frontends with different latencyWindow confs must
+            # not thrash the window per completed query).
+            window = self._hs_conf.telemetry_serving_latency_window() \
+                if _DEFAULT is self else None
+            _metrics.get_registry().histogram(
+                "serving.latency_ms", window
+            ).record(pending.latency_s * 1000.0)
+        except Exception:
+            pass  # observability must never fail a query
 
     def _release(self, entry: _Entry) -> None:
         with self._lock:

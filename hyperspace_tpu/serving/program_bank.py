@@ -55,15 +55,18 @@ class ProgramBank:
         on first sighting. ``shape_vec`` (the shape-class vector of the
         arguments about to be passed) drives hit/miss accounting only —
         jax's own cache keys executables under the wrapper."""
+        from ..telemetry import span_names as SN
+        from ..telemetry import trace as _trace
         first_reuse = False
-        with self._lock:
+        with _trace.span(SN.BANK_LOOKUP) as sp, self._lock:
             entry = self._stages.get(stage_key)
             if entry is None:
                 while len(self._stages) >= self.max_stages:
                     _, (_, shapes_seen) = self._stages.popitem(last=False)
                     self.stage_evictions += 1
                     self.program_count -= len(shapes_seen)
-                fn = factory()
+                with _trace.span(SN.BANK_COMPILE):
+                    fn = factory()
                 # shape vector -> times this program was looked up again
                 # after registration (0 = registered, never reused yet).
                 self._stages[stage_key] = (fn, {shape_vec: 0})
@@ -83,6 +86,8 @@ class ProgramBank:
                     self.misses += 1
                     self.program_count += 1
                     hit = False
+            if sp is not None:
+                sp.attrs["hit"] = hit
         self._emit(stage_key, shape_vec, hit=hit, first_reuse=first_reuse)
         return fn
 
@@ -117,12 +122,17 @@ class ProgramBank:
             pass  # observability must never fail an execution
 
     def stats(self) -> dict:
+        """Counters follow the registry-wide ``hits``/``misses``/
+        ``evictions`` spelling (telemetry/metrics.py naming convention);
+        ``stage_evictions`` is the pre-r13 spelling kept as a DEPRECATED
+        alias for existing readers."""
         with self._lock:
             return {
                 "stages": len(self._stages),
                 "programs": self.program_count,
                 "hits": self.hits,
                 "misses": self.misses,
+                "evictions": self.stage_evictions,
                 "stage_evictions": self.stage_evictions,
             }
 
@@ -145,3 +155,15 @@ def get_bank() -> ProgramBank:
             if _BANK is None:
                 _BANK = ProgramBank()
     return _BANK
+
+
+def _bank_stats() -> dict:
+    return get_bank().stats()
+
+
+# The bank's counters are a named collector in the process metrics
+# registry (telemetry/metrics.py): Hyperspace.metrics() and
+# serving_stats() read the SAME dict through it.
+from ..telemetry import metrics as _metrics  # noqa: E402
+
+_metrics.get_registry().register_collector("program_bank", _bank_stats)

@@ -266,19 +266,31 @@ def execute_with_cache(session, cache: ResultCache, plan):
     """Session.execute body when the result cache is on: probe, serve on
     hit (skipping plan rewrite AND execution), otherwise execute and run
     the admission policy. Events mirror the action-event convention."""
+    from ..telemetry import span_names as SN
+    from ..telemetry import trace as _trace
     from ..telemetry.events import (ResultCacheAdmitEvent,
                                     ResultCacheHitEvent,
                                     ResultCacheMissEvent)
     from ..telemetry.logging import get_logger
 
-    norm = normalize(plan)
-    key = compute_key(session, plan, normalized=norm)
+    # The cache-lookup span covers key computation + probe (NOT the
+    # recompute on a miss): a hit trace and a cold trace differ exactly
+    # here — hit attr flips, and the cold trace grows the optimize/exec
+    # spans below.
+    with _trace.span(SN.CACHE_LOOKUP) as sp:
+        norm = normalize(plan)
+        key = compute_key(session, plan, normalized=norm)
+        hit = cache.get(key) if key is not None else None
+        if sp is not None:
+            sp.attrs["cacheable"] = key is not None
+            sp.attrs["hit"] = hit is not None
+            if hit is not None:
+                sp.attrs["tier"] = hit[1]
     if key is None:
         # Uncacheable shape: execute as if the cache did not exist.
         return session._run_optimized(
             session.optimize(norm, _pre_normalized=True))
     logger = get_logger(session.hs_conf.event_logger_class())
-    hit = cache.get(key)
     if hit is not None:
         table, tier = hit
         logger.log_event(ResultCacheHitEvent(

@@ -25,6 +25,8 @@ from .plan.nodes import (Aggregate, Filter, Join, Limit, LogicalPlan, Project,
                          Scan, Sort, Union, Window)
 from .schema import Schema
 from .sources.interfaces import FileBasedSourceProviderManager
+from .telemetry import span_names as SN
+from .telemetry import trace as _trace
 
 
 class Session:
@@ -95,6 +97,11 @@ class Session:
         # The memo is on the multi-threaded serving path (like the
         # result cache, which carries its own lock).
         self._sql_plan_lock = threading.Lock()
+        # Span-tree trace of the most recent traced execution
+        # (telemetry/trace.py; None until telemetry.trace.enabled runs a
+        # query). Read by Hyperspace.last_trace() and explain's
+        # "Trace:" section.
+        self._last_trace = None
 
     # The reason collector of the calling thread's most recent rewrite
     # pass. Plain attribute syntax everywhere (apply_hyperspace writes,
@@ -232,8 +239,9 @@ class Session:
         # projections so the index rules see Scan→Filter shapes regardless
         # of how the user ordered select()/where().
         if not _pre_normalized:
-            plan = push_filters(plan)
-            plan = prune_columns(plan)
+            with _trace.span(SN.PLAN_NORMALIZE):
+                plan = push_filters(plan)
+                plan = prune_columns(plan)
         # Cost-based join reordering (optimizer/join_order.py) runs AFTER
         # normalization (it wants the pushed-down filters for selectivity)
         # and BEFORE the index rules, so FilterIndexRule/JoinIndexRule and
@@ -264,7 +272,12 @@ class Session:
         from .serving.context import QueryContext
         ctx = context if context is not None \
             else QueryContext.for_session(self)
-        with ctx.activate():
+        # The trace root (telemetry/trace.py): a no-op unless
+        # telemetry.trace.enabled is set on this session or the serving
+        # frontend handed the context a shared sweep trace; the opt-in
+        # jax.profiler hook brackets the first query after arming.
+        with ctx.activate(), _trace.maybe_profile(self), \
+                _trace.query_trace(self, ctx):
             if not ctx.capture:
                 return self._execute_uncaptured(plan, ctx)
             # Advisor workload capture (advisor/workload.py): time

@@ -6,3 +6,4 @@ from .events import (  # noqa: F401
     ResultCacheEvictionEvent, ResultCacheHitEvent, ResultCacheMissEvent,
     VacuumActionEvent)
 from .logging import EventLogger, HyperspaceEventLogging, NoOpEventLogger, get_logger  # noqa: F401
+from .constants import TelemetryConstants  # noqa: F401

@@ -1,0 +1,46 @@
+"""Telemetry config keys + defaults (``hyperspace.tpu.telemetry.*``).
+
+No reference analogue: the reference delegates observability to Spark's
+listener bus; this family governs the unified tracing/metrics layer
+(telemetry/trace.py, telemetry/metrics.py). Keys are read via config.py
+accessors only (the lint gate rejects ad-hoc env reads).
+"""
+
+from __future__ import annotations
+
+
+class TelemetryConstants:
+    # Per-query span-tree tracing (telemetry/trace.py). Default off:
+    # tracing-off is a hard no-op fast path (bench `observability` phase
+    # pins the traced overhead <= ~3% and ~0 when off).
+    TRACE_ENABLED = "hyperspace.tpu.telemetry.trace.enabled"
+    TRACE_ENABLED_DEFAULT = "false"
+
+    # Span cap per trace: past it new spans are dropped (counted on
+    # Trace.dropped) instead of growing without bound — a pathological
+    # plan or a huge literal sweep must not balloon host memory.
+    TRACE_MAX_SPANS = "hyperspace.tpu.telemetry.trace.maxSpans"
+    TRACE_MAX_SPANS_DEFAULT = "4096"
+
+    # Process-metrics registry feeds (telemetry/metrics.py). Governs the
+    # push-side instruments (the serving latency histogram); the named
+    # collectors (io / program bank / serving / ...) are snapshot pulls
+    # and stay readable regardless.
+    METRICS_ENABLED = "hyperspace.tpu.telemetry.metrics.enabled"
+    METRICS_ENABLED_DEFAULT = "true"
+
+    # Sliding window (seconds) of the serving frontend's live latency
+    # histogram — p50/p95/p99 + QPS are computed over samples this
+    # recent (Hyperspace.metrics() -> histograms["serving.latency_ms"]).
+    SERVING_LATENCY_WINDOW = "hyperspace.tpu.telemetry.serving.latencyWindow"
+    SERVING_LATENCY_WINDOW_DEFAULT = "60"
+
+    # Opt-in jax.profiler capture bracketing ONE query (the first
+    # executed after arming): device timelines land under `dir` for
+    # TensorBoard/xprof. One-shot per process (re-arm via
+    # telemetry.trace.reset_profiler, tests only) so a serving loop
+    # cannot accumulate unbounded capture directories.
+    PROFILER_ENABLED = "hyperspace.tpu.telemetry.profiler.enabled"
+    PROFILER_ENABLED_DEFAULT = "false"
+    PROFILER_DIR = "hyperspace.tpu.telemetry.profiler.dir"
+    PROFILER_DIR_DEFAULT = ""

@@ -15,11 +15,25 @@ from typing import List, Optional
 @dataclass
 class HyperspaceEvent:
     """Base event. ``app_id`` identifies the session; ``message`` carries
-    RUNNING/SUCCESS/FAILURE details."""
+    RUNNING/SUCCESS/FAILURE details.
+
+    ``trace_id``/``span_id`` correlate the event with the query that
+    emitted it: auto-stamped from the ACTIVE trace span
+    (telemetry/trace.py) at construction time — which IS emission time,
+    events are built at their emit sites — and empty outside a traced
+    execution, so tracing-off event streams are byte-identical to
+    pre-trace ones."""
 
     app_id: str = ""
     message: str = ""
     emitted_on_ms: int = field(default_factory=lambda: int(time.time() * 1000))
+    trace_id: str = ""
+    span_id: str = ""
+
+    def __post_init__(self):
+        if not self.trace_id:
+            from .trace import active_ids
+            self.trace_id, self.span_id = active_ids()
 
     @property
     def event_name(self) -> str:

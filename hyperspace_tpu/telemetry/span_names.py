@@ -1,0 +1,60 @@
+"""Frozen registry of trace span names.
+
+Every ``trace.span(...)`` / ``trace.add_span(...)`` site in the package
+must name its span with one of these constants — free-form strings are
+rejected by the scripts/lint.py span-discipline gate, and every name
+registered here must be referenced under tests/ (an unobserved span is
+unverified observability, the same contract the event-taxonomy gate
+enforces for telemetry/events.py).
+
+Keep the vocabulary SMALL and stable: dashboards, the Chrome-trace
+exporter, and the explain "Trace:" section all key on these strings.
+Variable detail (node kinds, hit/miss, byte counts) rides in span
+attributes, never in the name.
+"""
+
+from __future__ import annotations
+
+# The per-query root span, opened by Session.execute (one per
+# QueryContext; literal-sweep members nest under SERVING_SWEEP).
+QUERY = "query"
+
+# Plan normalization (push_filters + prune_columns) in Session.optimize.
+PLAN_NORMALIZE = "plan.normalize"
+
+# Cost-based join reordering (optimizer/join_order.reorder_joins).
+JOIN_REORDER = "optimize.join_reorder"
+
+# The hyperspace index-rewrite batch (rules/apply_hyperspace).
+INDEX_REWRITE = "rewrite.index_rules"
+
+# Result-cache key computation + probe (serving/result_cache).
+CACHE_LOOKUP = "serving.cache_lookup"
+
+# Program-bank lookup (serving/program_bank; attrs carry hit/miss) and
+# the wrapper construction on a bank miss.
+BANK_LOOKUP = "bank.lookup"
+BANK_COMPILE = "bank.compile"
+
+# One span per executed plan node (execution/executor._execute).
+EXEC_STAGE = "exec.stage"
+
+# Pooled multi-file read fan-out / prefetch stream (parallel/io.py),
+# recorded on the consumer side of the r11 per-query io attribution.
+IO_READ = "io.read"
+IO_PREFETCH = "io.prefetch"
+
+# SPMD mesh dispatch (execution/spmd) and the AOT compile of one mesh
+# executable (parallel/sharding.MeshProgram).
+SPMD_DISPATCH = "spmd.dispatch"
+SPMD_COMPILE = "spmd.compile"
+
+# The shared literal-sweep batch span (serving/frontend._run_batch);
+# member queries' QUERY spans are its children.
+SERVING_SWEEP = "serving.sweep"
+
+SPAN_NAMES = frozenset({
+    QUERY, PLAN_NORMALIZE, JOIN_REORDER, INDEX_REWRITE, CACHE_LOOKUP,
+    BANK_LOOKUP, BANK_COMPILE, EXEC_STAGE, IO_READ, IO_PREFETCH,
+    SPMD_DISPATCH, SPMD_COMPILE, SERVING_SWEEP,
+})

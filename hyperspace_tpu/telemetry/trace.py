@@ -1,0 +1,402 @@
+"""Per-query span-tree tracing.
+
+The third telemetry pillar (beside typed events and the metrics
+registry): one :class:`Trace` per query execution, holding a tree of
+:class:`Span` records — trace_id + span_id + parent links, wall-clock
+anchor + ``perf_counter`` timestamps, and structured attributes — so a
+single query's time can be attributed across optimize → rewrite → cache
+lookup → program-bank lookup → per-stage execution → I/O → SPMD
+dispatch. Events emitted during a traced execution are stamped with the
+active (trace_id, span_id), correlating e.g. a ResultCacheMissEvent with
+the IoReadEvents of the *same* query.
+
+Propagation is a contextvar, not a thread-local: the serving frontend
+snapshots ``contextvars.copy_context()`` per submission and the prefetch
+producer runs under a copied context, so the active span follows the
+QUERY across worker threads exactly like the r11 io attribution it rides
+next to. Pool workers (reader pool) do NOT inherit the context — their
+work is recorded on the consumer side (``add_span``), mirroring how
+parallel/io.py credits the per-query io counters.
+
+Tracing OFF is a hard no-op fast path: ``span(...)`` returns a shared
+no-op context manager after one contextvar read, and ``Session.execute``
+opens no trace at all unless ``hyperspace.tpu.telemetry.trace.enabled``
+is set (conf via config.py only). Span NAMES come from the frozen
+registry in span_names.py — the scripts/lint.py span-discipline gate
+rejects free-form strings.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import json
+import os
+import threading
+import time
+import uuid
+from typing import Dict, List, Optional, Tuple
+
+from . import span_names
+
+# The (Trace, Span) pair of the in-flight traced execution, if any.
+_ACTIVE: contextvars.ContextVar = contextvars.ContextVar(
+    "hst_active_trace", default=None)
+
+
+class Span:
+    """One timed region. ``end_perf`` is None while open; attributes are
+    a plain dict the owner may amend until the trace is exported."""
+
+    __slots__ = ("trace_id", "span_id", "parent_id", "name", "tid",
+                 "start_perf", "end_perf", "attrs")
+
+    def __init__(self, trace_id: str, span_id: str,
+                 parent_id: Optional[str], name: str, attrs: dict):
+        self.trace_id = trace_id
+        self.span_id = span_id
+        self.parent_id = parent_id
+        self.name = name
+        self.tid = threading.get_ident()
+        self.start_perf = time.perf_counter()
+        self.end_perf: Optional[float] = None
+        self.attrs = attrs
+
+    def finish(self) -> None:
+        if self.end_perf is None:
+            self.end_perf = time.perf_counter()
+
+    @property
+    def duration_s(self) -> float:
+        end = self.end_perf if self.end_perf is not None \
+            else time.perf_counter()
+        return max(end - self.start_perf, 0.0)
+
+    def __repr__(self) -> str:  # diagnostic only
+        return (f"Span({self.name}, id={self.span_id}, "
+                f"parent={self.parent_id}, {self.duration_s * 1000:.2f}ms)")
+
+
+class Trace:
+    """The span tree of one query (or one literal-sweep batch). Spans
+    append under a lock — members of a sweep and prefetch producers can
+    write from several threads — in completion-independent creation
+    order; parent links carry the tree."""
+
+    def __init__(self, max_spans: int = 4096, label: str = ""):
+        self.trace_id = uuid.uuid4().hex[:16]
+        self.label = label
+        self.max_spans = max(int(max_spans), 1)
+        self.created_wall_ms = int(time.time() * 1000)
+        self._anchor_perf = time.perf_counter()
+        self._lock = threading.Lock()
+        self.spans: List[Span] = []
+        self.dropped = 0
+        self._ids = 0
+
+    def new_span(self, name: str, parent_id: Optional[str],
+                 attrs: Optional[dict] = None) -> Optional[Span]:
+        """Open a span; None once the trace is at ``maxSpans`` (the
+        would-be span's children then attach to its parent — the tree
+        stays connected, the cap stays hard)."""
+        with self._lock:
+            if len(self.spans) >= self.max_spans:
+                self.dropped += 1
+                return None
+            self._ids += 1
+            span = Span(self.trace_id, format(self._ids, "x"),
+                        parent_id, name, dict(attrs) if attrs else {})
+            self.spans.append(span)
+            return span
+
+    @property
+    def root(self) -> Optional[Span]:
+        for s in self.spans:
+            if s.parent_id is None:
+                return s
+        return None
+
+    def find(self, name: str) -> List[Span]:
+        return [s for s in self.spans if s.name == name]
+
+    def duration_s(self) -> float:
+        root = self.root
+        return root.duration_s if root is not None else 0.0
+
+    # ------------------------------------------------------------------
+    # Export: Chrome trace-event JSON (chrome://tracing, Perfetto).
+    # ------------------------------------------------------------------
+
+    def to_chrome_json(self) -> str:
+        """Complete ("X") trace events, ts/dur in microseconds relative
+        to the trace's start; span/parent ids ride in ``args`` so the
+        tree survives the flat format."""
+        pid = os.getpid()
+        events = []
+        for s in self.spans:
+            args: Dict[str, object] = {"span_id": s.span_id}
+            if s.parent_id is not None:
+                args["parent_id"] = s.parent_id
+            args.update(s.attrs)
+            events.append({
+                "name": s.name,
+                "cat": "hyperspace",
+                "ph": "X",
+                "ts": round((s.start_perf - self._anchor_perf) * 1e6, 3),
+                "dur": round(s.duration_s * 1e6, 3),
+                "pid": pid,
+                "tid": s.tid,
+                "args": args,
+            })
+        return json.dumps({
+            "traceEvents": events,
+            "displayTimeUnit": "ms",
+            "otherData": {"trace_id": self.trace_id,
+                          "label": self.label,
+                          "start_wall_ms": self.created_wall_ms,
+                          "dropped_spans": self.dropped},
+        }, default=str)
+
+
+# ---------------------------------------------------------------------------
+# Ambient-span API (the only span-opening surface outside this module).
+# ---------------------------------------------------------------------------
+
+class _NoopSpan:
+    """Shared do-nothing context manager: the tracing-off fast path."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return None
+
+    def __exit__(self, et, ev, tb):
+        return False
+
+
+NOOP = _NoopSpan()
+
+
+class _SpanScope:
+    __slots__ = ("_name", "_attrs", "_pair", "_token", "span")
+
+    def __init__(self, name: str, attrs: dict):
+        self._name = name
+        self._attrs = attrs
+        self.span: Optional[Span] = None
+        self._token = None
+
+    def __enter__(self) -> Optional[Span]:
+        pair = _ACTIVE.get()
+        if pair is None:
+            return None
+        tr, parent = pair
+        span = tr.new_span(self._name,
+                           parent.span_id if parent is not None else None,
+                           self._attrs)
+        if span is None:  # trace at maxSpans
+            return None
+        self.span = span
+        self._token = _ACTIVE.set((tr, span))
+        return span
+
+    def __exit__(self, et, ev, tb):
+        if self.span is not None:
+            if et is not None:
+                self.span.attrs["error"] = type(et).__name__
+            self.span.finish()
+            _ACTIVE.reset(self._token)
+        return False
+
+
+def span(name: str, **attrs):
+    """Context manager timing one region under the active trace. Returns
+    the shared no-op scope when no trace is active (one contextvar read —
+    the instrumented hot paths pay effectively nothing while tracing is
+    off); yields the open :class:`Span` (or None at the span cap)."""
+    if _ACTIVE.get() is None:
+        return NOOP
+    return _SpanScope(name, attrs)
+
+
+def add_span(name: str, start_perf: Optional[float] = None,
+             **attrs) -> Optional[Span]:
+    """Record an already-elapsed region as a completed child of the
+    active span — the consumer-side recording shape for work that ran on
+    non-context threads (the reader pool, the prefetch producer), rided
+    by parallel/io.py exactly where it credits the per-query io
+    counters."""
+    pair = _ACTIVE.get()
+    if pair is None:
+        return None
+    tr, parent = pair
+    span = tr.new_span(name,
+                       parent.span_id if parent is not None else None,
+                       attrs)
+    if span is None:
+        return None
+    if start_perf is not None:
+        span.start_perf = float(start_perf)
+    span.finish()
+    return span
+
+
+def active() -> Optional[Tuple[Trace, Span]]:
+    return _ACTIVE.get()
+
+
+def idle() -> bool:
+    """True when no trace is active on this context — the guard the
+    hottest call sites use to skip even attribute-dict construction."""
+    return _ACTIVE.get() is None
+
+
+def active_ids() -> Tuple[str, str]:
+    """(trace_id, span_id) of the active span, ("", "") when idle — the
+    stamp HyperspaceEvent picks up at construction/emission time."""
+    pair = _ACTIVE.get()
+    if pair is None:
+        return "", ""
+    tr, span = pair
+    return tr.trace_id, span.span_id if span is not None else ""
+
+
+@contextlib.contextmanager
+def query_trace(session, ctx=None):
+    """The root scope ``Session.execute`` opens around one query.
+
+    Resolution order:
+    - ``ctx.trace_parent`` set (a literal-sweep member handed a shared
+      sweep trace by the frontend): open this query's QUERY span as a
+      child in THAT trace;
+    - a trace already active on this context (nested execution): open a
+      child QUERY span in it;
+    - ``telemetry.trace.enabled`` on the session: open a fresh Trace
+      with a root QUERY span;
+    - otherwise: hard no-op.
+
+    The finished trace lands on ``session._last_trace`` (and on
+    ``ctx.trace``) for Hyperspace.last_trace() / explain's "Trace:"
+    section."""
+    parent = getattr(ctx, "trace_parent", None) if ctx is not None else None
+    ambient = _ACTIVE.get()
+    if parent is None and ambient is None:
+        if session is None or \
+                not session.hs_conf.telemetry_trace_enabled():
+            yield None
+            return
+    attrs = {}
+    if ctx is not None:
+        attrs["query_id"] = ctx.query_id
+        if ctx.client:
+            attrs["client"] = ctx.client
+    if parent is not None:
+        tr, parent_span = parent
+        parent_id = parent_span.span_id if parent_span is not None else None
+    elif ambient is not None:
+        tr, parent_span = ambient
+        parent_id = parent_span.span_id if parent_span is not None else None
+    else:
+        tr = Trace(session.hs_conf.telemetry_trace_max_spans(),
+                   label=ctx.client if ctx is not None else "")
+        parent_id = None
+    root = tr.new_span(span_names.QUERY, parent_id, attrs)
+    if ctx is not None:
+        ctx.trace = tr
+    token = _ACTIVE.set((tr, root)) if root is not None else None
+    try:
+        yield root
+    finally:
+        if root is not None:
+            root.finish()
+            _ACTIVE.reset(token)
+        if session is not None:
+            session._last_trace = tr
+
+
+# ---------------------------------------------------------------------------
+# Opt-in jax.profiler capture (one query per arm).
+# ---------------------------------------------------------------------------
+
+_PROFILER_LOCK = threading.Lock()
+_PROFILER_DONE = False
+
+
+@contextlib.contextmanager
+def maybe_profile(session):
+    """Bracket ONE query with ``jax.profiler.trace`` when
+    ``hyperspace.tpu.telemetry.profiler.{enabled,dir}`` arm it. One-shot
+    per process: the first execution after arming captures, later ones
+    run untouched (a serving loop must not accumulate captures)."""
+    global _PROFILER_DONE
+    if session is None or \
+            not session.hs_conf.telemetry_profiler_enabled():
+        yield False
+        return
+    out_dir = session.hs_conf.telemetry_profiler_dir()
+    if not out_dir:
+        yield False
+        return
+    with _PROFILER_LOCK:
+        if _PROFILER_DONE:
+            yield False
+            return
+        _PROFILER_DONE = True
+    import jax
+
+    with jax.profiler.trace(out_dir):
+        yield True
+
+
+def reset_profiler() -> None:
+    """Re-arm the one-shot profiler capture (tests)."""
+    global _PROFILER_DONE
+    _PROFILER_DONE = False
+
+
+# ---------------------------------------------------------------------------
+# Rendering (explain's "Trace:" section).
+# ---------------------------------------------------------------------------
+
+_RENDER_ATTRS = ("node", "hit", "tier", "mode", "files", "rows",
+                 "size", "members")
+_MAX_RENDER_LINES = 48
+
+
+def render_timeline(trace: Trace) -> List[str]:
+    """Indented span tree with per-span wall duration and self-time
+    (duration minus direct children — where the time actually went)."""
+    children: Dict[Optional[str], List[Span]] = {}
+    for s in trace.spans:
+        children.setdefault(s.parent_id, []).append(s)
+    lines: List[str] = []
+    total = 0
+
+    def walk(span: Span, depth: int) -> None:
+        nonlocal total
+        total += 1
+        if len(lines) >= _MAX_RENDER_LINES:
+            return
+        kids = children.get(span.span_id, [])
+        dur = span.duration_s
+        self_s = max(dur - sum(k.duration_s for k in kids), 0.0)
+        detail = " ".join(
+            f"{k}={span.attrs[k]}" for k in _RENDER_ATTRS
+            if k in span.attrs)
+        pad = "  " * depth
+        lines.append(
+            f"{pad}{span.name:<24} {dur * 1000:9.2f} ms "
+            f"(self {self_s * 1000:.2f} ms)"
+            + (f"  [{detail}]" if detail else ""))
+        for k in kids:
+            walk(k, depth + 1)
+
+    for root in children.get(None, []):
+        walk(root, 0)
+    hidden = len(trace.spans) - min(len(trace.spans), _MAX_RENDER_LINES)
+    if hidden > 0:
+        lines.append(f"... {hidden} more span(s) not shown")
+    if trace.dropped:
+        lines.append(f"({trace.dropped} span(s) dropped at the "
+                     f"maxSpans={trace.max_spans} cap)")
+    return lines

@@ -305,6 +305,61 @@ def mutable_state_sites(tree: ast.AST) -> list:
     return out
 
 
+# Span-naming discipline (the r13 tracing layer's ratchet): every
+# trace.span(...) / trace.add_span(...) site in package code must name
+# its span via a constant from the frozen telemetry/span_names.py
+# registry (or a string literal registered there) — free-form strings
+# would fragment the vocabulary dashboards and the Chrome exporter key
+# on. And like the event-taxonomy gate below, every REGISTERED span
+# name must be referenced under tests/: an unobserved span is
+# unverified observability.
+SPAN_NAMES_FILE = "hyperspace_tpu/telemetry/span_names.py"
+SPAN_MODULE_ALIASES = ("span_names", "SN", "_sn")
+
+
+def span_name_constants(tree: ast.AST) -> dict:
+    """Module-level UPPERCASE string constants of span_names.py:
+    constant name -> span name string."""
+    out = {}
+    for node in tree.body:
+        if not (isinstance(node, ast.Assign)
+                and isinstance(node.value, ast.Constant)
+                and isinstance(node.value.value, str)):
+            continue
+        for t in node.targets:
+            if isinstance(t, ast.Name) and t.id.isupper():
+                out[t.id] = node.value.value
+    return out
+
+
+def span_site_violations(tree: ast.AST, names: dict) -> list:
+    """(line, detail) of trace.span()/trace.add_span() calls whose name
+    argument is neither a span_names constant nor a registered literal."""
+    values = set(names.values())
+    out = []
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in ("span", "add_span")
+                and isinstance(node.func.value, ast.Name)
+                and node.func.value.id in ("trace", "_trace", "_tr")):
+            continue
+        if not node.args:
+            out.append((node.lineno, "no span name argument"))
+            continue
+        arg = node.args[0]
+        if isinstance(arg, ast.Attribute) \
+                and isinstance(arg.value, ast.Name) \
+                and arg.value.id in SPAN_MODULE_ALIASES \
+                and arg.attr in names:
+            continue
+        if isinstance(arg, ast.Constant) and arg.value in values:
+            continue
+        out.append((node.lineno,
+                    "span name must come from telemetry/span_names.py"))
+    return out
+
+
 # Telemetry-coverage discipline: every event class defined in
 # telemetry/events.py must be referenced somewhere under tests/ — an
 # event no test ever observes is unverified observability (the
@@ -356,6 +411,8 @@ def main() -> int:
     problems = []
     with open(os.path.join(ROOT, CONFIG_DOC), encoding="utf-8") as f:
         config_doc_text = f.read()
+    with open(os.path.join(ROOT, SPAN_NAMES_FILE), encoding="utf-8") as f:
+        span_names = span_name_constants(ast.parse(f.read()))
     event_classes: list = []
     tests_text_parts: list = []
     for path in iter_sources():
@@ -419,6 +476,11 @@ def main() -> int:
                     "cross-query state belongs in QueryContext "
                     "(serving/context.py) or a sanctioned frontend "
                     "registry (see MUTABLE_STATE_ALLOWLIST)")
+        if any(rel.startswith(d + os.sep) for d in PACKAGE_DIRS):
+            for line, detail in span_site_violations(tree, span_names):
+                problems.append(
+                    f"{rel}:{line}: {detail} (frozen registry; free-form "
+                    "span strings are forbidden)")
         if any(rel.startswith(d + os.sep) for d in PACKAGE_DIRS) \
                 and rel.replace(os.sep, "/") not in THREAD_SITE_ALLOWLIST:
             for line in thread_sites(tree):
@@ -433,6 +495,13 @@ def main() -> int:
             problems.append(
                 f"{EVENTS_FILE}: event class '{name}' is never referenced "
                 "under tests/; add a test observing (or at least naming) it")
+    for const, value in sorted(span_names.items()):
+        if const == "SPAN_NAMES":
+            continue
+        if value not in tests_text:
+            problems.append(
+                f"{SPAN_NAMES_FILE}: span name '{value}' ({const}) is "
+                "never referenced under tests/; add a test observing it")
     for p in problems:
         print(p)
     print(f"lint: {len(problems)} problem(s) across "

@@ -426,13 +426,22 @@ class TestObservability:
     def test_estimated_vs_actual_qerror(self, wired):
         """The executor records actual inner-join output rows under the
         condition repr the reorder steps carry — every reordered step
-        must be pairable, with a sane q-error. Join-actual recording is
-        single-device executor instrumentation (the SPMD program
-        aggregates join output on device without materializing it), so
-        this test pins distributed off."""
+        must be pairable, with a sane q-error. Since r13 the SPMD
+        program reports per-join output counts too (psum'd ``jrows:``
+        outputs), so this runs under the DEFAULT distributed tier;
+        minStreamRows is lowered so the 4000-row star actually
+        dispatches on the mesh where SPMD is available (single-device
+        images exercise the executor path through the same test)."""
         session, paths = wired
-        session.conf.set(IndexConstants.TPU_DISTRIBUTED_ENABLED, "false")
+        session.conf.set(
+            IndexConstants.TPU_DISTRIBUTED_MIN_STREAM_ROWS, "64")
+        from hyperspace_tpu.execution import spmd
+        dispatches0 = spmd.DISPATCH_COUNT
         _three_way(session, paths).to_pandas()
+        if session.hs_conf.distributed_enabled():
+            # The point of the un-pin: the actuals below came from the
+            # SPMD program, not single-device instrumentation.
+            assert spmd.DISPATCH_COUNT > dispatches0
         steps = [s for r in session._last_join_order
                  for s in r["steps"]]
         assert steps
@@ -443,10 +452,33 @@ class TestObservability:
             q_err = max(est / max(actual, 1), max(actual, 1) / est)
             assert q_err < 50  # sane, not perfect
 
-    def test_explain_shows_actuals_after_execution(self, wired):
-        # Pins distributed off: see test_estimated_vs_actual_qerror.
+    def test_spmd_actuals_match_single_device(self, wired):
+        """The SPMD-reported join actuals must be the SAME numbers the
+        single-device executor records (results are byte-identical, so
+        the observed cardinalities must be too)."""
         session, paths = wired
+        session.conf.set(
+            IndexConstants.TPU_DISTRIBUTED_MIN_STREAM_ROWS, "64")
+        if not session.hs_conf.distributed_enabled():
+            import pytest as _pytest
+            _pytest.skip("SPMD tier unavailable on this image")
+        _three_way(session, paths).to_pandas()
+        spmd_actuals = dict(session._join_actuals)
+        session._join_actuals.clear()
         session.conf.set(IndexConstants.TPU_DISTRIBUTED_ENABLED, "false")
+        _three_way(session, paths).to_pandas()
+        single = dict(session._join_actuals)
+        session.conf.unset(IndexConstants.TPU_DISTRIBUTED_ENABLED)
+        assert spmd_actuals
+        for key, rows in single.items():
+            assert spmd_actuals.get(key) == rows, key
+
+    def test_explain_shows_actuals_after_execution(self, wired):
+        # Runs under the default distributed tier (see
+        # test_estimated_vs_actual_qerror — the r13 un-pin).
+        session, paths = wired
+        session.conf.set(
+            IndexConstants.TPU_DISTRIBUTED_MIN_STREAM_ROWS, "64")
         from hyperspace_tpu.plananalysis.explain import explain_string
         q = _three_way(session, paths)
         q.to_pandas()
