@@ -1578,6 +1578,142 @@ def _single_device_phases(args, root):
                 RESULT["trace_overhead_pct"] = round(
                     sum(overheads) / len(overheads), 2)
 
+    # ---- robustness: disarmed overhead, deadline lag, crash recovery ----
+    # The r11-robustness acceptance trio. (a) Fault-point overhead on
+    # warm q3/q17, alternating best-of-two (r13 trace-overhead
+    # discipline): the truly-disarmed side IS the default the whole
+    # bench ran under, so the A/B arms every query-path point at p=0 —
+    # the armed-but-silent configuration does strictly MORE work than
+    # disarmed (registry build + per-hit bookkeeping), bounding the
+    # disarmed overhead from above (target ≈0%). (b) Deadline
+    # enforcement: a warm q3 submitted with a 50 ms deadline; the
+    # reported lag is how far past the deadline the cooperative
+    # cancellation landed (stage/io boundary granularity). (c) Crash
+    # recovery: a subprocess kill -9'd mid-create at the op-log fault
+    # point, then the recovery sweep — both wall-clocks reported.
+    if not _backend_dead():
+        with _phase("robustness"):
+            from hyperspace_tpu.exceptions import QueryDeadlineError
+            from hyperspace_tpu.robustness import fault_names as _FNM
+            from hyperspace_tpu.robustness.constants import \
+                RobustnessConstants as _RCN
+            from hyperspace_tpu.serving.frontend import ServingFrontend
+
+            arm_keys = [f"{_RCN.FAULTS_PREFIX}.{p}" for p in (
+                _FNM.IO_POOLED_READ, _FNM.SCAN_PARQUET_DECODE,
+                _FNM.SPMD_DISPATCH, _FNM.BANK_COMPILE)]
+
+            def _arm(on: bool) -> None:
+                for k in arm_keys:
+                    if on:
+                        session.conf.set(k, "error:p=0")
+                    else:
+                        session.conf.unset(k)
+
+            overheads = []
+            for qn in ("q3", "q17"):
+                tq = queries.get(qn)
+                if tq is None:
+                    continue
+                tq.to_arrow()  # warm
+                off_best = on_best = float("inf")
+                for _ in range(2):  # alternating A/B, best-of-two
+                    _arm(False)
+                    off_best = min(off_best,
+                                   timed_best(lambda: tq.to_arrow(), 1))
+                    _arm(True)
+                    on_best = min(on_best,
+                                  timed_best(lambda: tq.to_arrow(), 1))
+                _arm(False)
+                pct = ((on_best - off_best) / off_best * 100.0) \
+                    if off_best > 0 else 0.0
+                overheads.append(pct)
+                RESULT[f"robustness_disarmed_overhead_{qn}_pct"] = \
+                    round(pct, 2)
+            if overheads:
+                RESULT["robustness_disarmed_overhead_pct"] = round(
+                    sum(overheads) / len(overheads), 2)
+
+            # (b) deadline-enforcement latency.
+            q3w = queries.get("q3")
+            if q3w is not None:
+                fe = ServingFrontend(session)
+                t0 = time.perf_counter()
+                p = fe.submit(q3w, deadline_ms=50)
+                try:
+                    p.result(timeout=300)
+                    RESULT["errors"].append(
+                        "robustness: 50ms-deadline q3 was not cancelled")
+                except QueryDeadlineError:
+                    wall_ms = (time.perf_counter() - t0) * 1000.0
+                    RESULT["robustness_deadline_lag_ms"] = round(
+                        max(wall_ms - 50.0, 0.0), 1)
+                fe.drain()
+
+            # (c) crash-recovery wall clock (kill -9 mid-create at the
+            # op-log fault point, then the recovery sweep).
+            import textwrap as _tw
+
+            import numpy as _rnp
+            import pandas as _rpd
+            crash_root = os.path.join(root, "crash_lake")
+            crash_data = os.path.join(crash_root, "data")
+            os.makedirs(crash_data, exist_ok=True)
+            _rpd.DataFrame({
+                "k": _rnp.arange(4000, dtype=_rnp.int64) % 40,
+                "v": _rnp.arange(4000, dtype=_rnp.int64) % 9,
+            }).to_parquet(os.path.join(crash_data, "p0.parquet"))
+            child_src = _tw.dedent("""
+                import sys
+                import hyperspace_tpu as hst
+                from hyperspace_tpu.api import Hyperspace, IndexConfig
+                data_dir, sys_dir = sys.argv[1:3]
+                s = hst.Session(system_path=sys_dir)
+                s.conf.set("hyperspace.index.numBuckets", 4)
+                s.conf.set("hyperspace.tpu.distributed.enabled", "false")
+                s.conf.set(
+                    "hyperspace.tpu.robustness.faults.log.write",
+                    "kill:nth=2")
+                t = s.read.parquet(data_dir)
+                Hyperspace(s).create_index(
+                    t, IndexConfig("cx", ["k"], ["v"]))
+            """)
+            script = os.path.join(crash_root, "crash_child.py")
+            with open(script, "w") as f:
+                f.write(child_src)
+            env = dict(os.environ)
+            env["JAX_PLATFORMS"] = "cpu"
+            env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+            env["PYTHONPATH"] = (
+                os.path.dirname(os.path.abspath(__file__))
+                + os.pathsep + env.get("PYTHONPATH", ""))
+            t0 = time.perf_counter()
+            proc = subprocess.run(
+                [sys.executable, script, crash_data,
+                 os.path.join(crash_root, "indexes")],
+                env=env, capture_output=True, text=True, timeout=600)
+            RESULT["robustness_crash_child_s"] = round(
+                time.perf_counter() - t0, 2)
+            if proc.returncode != -9:
+                RESULT["errors"].append(
+                    f"robustness: crash child rc={proc.returncode} "
+                    f"(expected SIGKILL); stderr={_tail(proc.stderr)}")
+            else:
+                from hyperspace_tpu.api import Hyperspace as _HS
+                rs = hst.Session(
+                    system_path=os.path.join(crash_root, "indexes"))
+                t0 = time.perf_counter()
+                summary = _HS(rs).recover()
+                RESULT["robustness_crash_recover_s"] = round(
+                    time.perf_counter() - t0, 3)
+                RESULT["robustness_crash_recovered"] = bool(
+                    summary["cancelled"] == ["cx"]
+                    and not summary["errors"])
+                if not RESULT["robustness_crash_recovered"]:
+                    RESULT["errors"].append(
+                        f"robustness: recovery sweep unexpected: "
+                        f"{summary}")
+
     # ---- BASELINE config #5: Hybrid Scan over appended source files ----
     # Runs LAST: the appends invalidate plain signatures, so every other
     # query pair must be timed first.

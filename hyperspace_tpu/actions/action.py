@@ -66,12 +66,20 @@ class Action:
         # builds, chunked-build streams, spill merges under this action).
         from ..execution import shapes
         from ..parallel import io as pio
+        from ..robustness import fault_names as _fn
+        from ..robustness import faults as _faults
         try:
             logger.log_event(self.event("Operation started."))
+            # The fault scope arms this session's robustness.faults.*
+            # conf for exactly this action run (the crash-recovery
+            # harness kill -9s inside these boundaries); disarmed it
+            # costs one conf-dict scan.
             with shapes.use_conf(self.session.hs_conf), \
-                    pio.use_session(self.session):
+                    pio.use_session(self.session), \
+                    _faults.scope_for(self.session.hs_conf):
                 self.validate()
                 self._begin()
+                _faults.fault_point(_fn.ACTION_OP)
                 self.op()
                 self._end()
             logger.log_event(self.event("Operation succeeded."))

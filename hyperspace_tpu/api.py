@@ -151,6 +151,26 @@ class Hyperspace:
     def cancel(self, index_name: str) -> None:
         self.index_manager.cancel(index_name)
 
+    def recover(self, index_names=None) -> dict:
+        """Crash recovery sweep (robustness/recovery.py): roll every
+        index whose latest op-log entry is transient — another process
+        died mid create/refresh/optimize/vacuum — back to its last
+        stable state, and vacuum data version directories no committed
+        entry references (the dead action's partial output). A healthy
+        lake is a no-op. OPERATOR ACTION: a transient entry is
+        indistinguishable from a LIVE in-flight action, so run this only
+        when no other process is mutating the lake (cancelling a live
+        action and vacuuming its half-written version is exactly what
+        this does to a wreck — and would do to a healthy writer).
+        Returns the summary dict ({scanned, cancelled, vacuumed,
+        errors})."""
+        from .robustness.recovery import recover_indexes
+        summary = recover_indexes(self.session, names=index_names)
+        # Recovered indexes changed state out from under the caching
+        # manager: drop its entry cache so listings see the rollback.
+        self.index_manager.clear_cache()
+        return summary
+
     # ------------------------------------------------------------------
     # Introspection.
     # ------------------------------------------------------------------

@@ -16,6 +16,7 @@ from typing import Any, Callable, Dict, Generic, Optional, TypeVar
 from .advisor.constants import AdvisorConstants
 from .index.constants import IndexConstants
 from .optimizer.constants import OptimizerConstants
+from .robustness.constants import RobustnessConstants
 from .serving.constants import ServingConstants
 from .telemetry.constants import TelemetryConstants
 
@@ -359,6 +360,19 @@ class HyperspaceConf:
             ServingConstants.RESULT_CACHE_PLAN_CACHE_SIZE,
             ServingConstants.RESULT_CACHE_PLAN_CACHE_SIZE_DEFAULT))
 
+    def result_cache_spill_dir(self) -> str:
+        """Directory of the optional disk-spill tier (host-tier LRU
+        victims spill to files there instead of being dropped); empty =
+        spill disabled (the pre-spill two-tier behavior)."""
+        return self._serving_get(
+            ServingConstants.RESULT_CACHE_SPILL_DIR,
+            ServingConstants.RESULT_CACHE_SPILL_DIR_DEFAULT).strip()
+
+    def result_cache_spill_bytes(self) -> int:
+        return int(self._serving_get(
+            ServingConstants.RESULT_CACHE_SPILL_BYTES,
+            ServingConstants.RESULT_CACHE_SPILL_BYTES_DEFAULT))
+
     def result_cache_conf_string(self) -> str:
         """Raw identity of the cache INSTANCE (CacheWithTransform key):
         enabled flag + tier budgets. Admission thresholds are read live
@@ -367,6 +381,8 @@ class HyperspaceConf:
             str(self.result_cache_enabled()),
             str(self.result_cache_device_bytes()),
             str(self.result_cache_host_bytes()),
+            self.result_cache_spill_dir(),
+            str(self.result_cache_spill_bytes()),
         ])
 
     # ------------------------------------------------------------------
@@ -495,6 +511,47 @@ class HyperspaceConf:
         return self._conf.get(
             TelemetryConstants.PROFILER_DIR,
             TelemetryConstants.PROFILER_DIR_DEFAULT) or ""
+
+    # ------------------------------------------------------------------
+    # Robustness (robustness/constants.py): fault injection, deadlines,
+    # retry, degradation ladders.
+    # ------------------------------------------------------------------
+
+    def robustness_fault_specs(self) -> Dict[str, str]:
+        """The armed fault points: ``{point name: spec string}`` from
+        every ``hyperspace.tpu.robustness.faults.<point>`` key. Empty
+        (the default) means disarmed — fault points compile to a hard
+        no-op and the per-run arming scope is skipped entirely."""
+        prefix = RobustnessConstants.FAULTS_PREFIX + "."
+        out: Dict[str, str] = {}
+        for k, v in self._conf.as_dict().items():
+            if k.startswith(prefix):
+                out[k[len(prefix):]] = v
+        return out
+
+    def robustness_seed(self) -> int:
+        return int(self._conf.get(
+            RobustnessConstants.SEED, RobustnessConstants.SEED_DEFAULT))
+
+    def robustness_deadline_ms(self) -> float:
+        return max(float(self._conf.get(
+            RobustnessConstants.DEADLINE_MS,
+            RobustnessConstants.DEADLINE_MS_DEFAULT)), 0.0)
+
+    def robustness_retry_max_attempts(self) -> int:
+        return max(int(self._conf.get(
+            RobustnessConstants.RETRY_MAX_ATTEMPTS,
+            RobustnessConstants.RETRY_MAX_ATTEMPTS_DEFAULT)), 1)
+
+    def robustness_retry_base_ms(self) -> float:
+        return max(float(self._conf.get(
+            RobustnessConstants.RETRY_BASE_MS,
+            RobustnessConstants.RETRY_BASE_MS_DEFAULT)), 0.0)
+
+    def robustness_degrade_enabled(self) -> bool:
+        return self._get_bool(
+            RobustnessConstants.DEGRADE_ENABLED,
+            RobustnessConstants.DEGRADE_ENABLED_DEFAULT)
 
     def _get_bool(self, key: str, default: str) -> bool:
         return (self._conf.get(key, default) or "").strip().lower() == "true"

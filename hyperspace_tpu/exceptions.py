@@ -17,3 +17,13 @@ class ServingRejectedError(HyperspaceException):
     query (queue at ``serving.queueDepth`` or in-flight input bytes past
     ``serving.admission.maxBytes``). Back off and resubmit — rejection is
     load shedding, not failure of the query itself."""
+
+
+class QueryDeadlineError(HyperspaceException):
+    """Raised when a query's cooperative deadline
+    (``ServingFrontend.submit(deadline_ms=...)`` or
+    ``hyperspace.tpu.robustness.deadlineMs``) expires: checked at the
+    executor's per-node stage boundary, the parallel-io wait loops, and
+    SPMD dispatch (robustness layer, serving/context.check_deadline).
+    The query is cancelled, its serving slot freed — the answer was NOT
+    computed, so the degradation ladders never absorb this error."""

@@ -603,8 +603,13 @@ def read_parquet(files: Sequence[str], columns: Optional[Sequence[str]] = None,
     (parallel/io.py) with order-preserving gather; device encoding stays
     on the calling thread."""
     from ..parallel import io as pio
+    from ..robustness import fault_names as _fn
+    from ..robustness import faults as _faults
     if not files:
         raise HyperspaceException("read_parquet: no files")
+    # Robustness fault point: the scan-decode boundary every format
+    # funnels through (hard no-op disarmed; see robustness/faults.py).
+    _faults.fault_point(_fn.SCAN_PARQUET_DECODE)
     if fmt == "parquet":
         fs, files = _resolve_files(files)
         read_cols = list(columns) if columns else None

@@ -19,12 +19,13 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..exceptions import HyperspaceException
+from ..exceptions import HyperspaceException, QueryDeadlineError
 from ..ops import kernels
 from ..plan import expr as E
 from ..plan.nodes import (Aggregate, BucketUnion, Filter, IndexScan, Join, Limit,
                           LogicalPlan, Project, Scan, Sort, Union, Window)
 from ..schema import BOOL, DATE, FLOAT64, INT32, INT64, STRING
+from ..serving.context import check_deadline
 from ..telemetry import span_names as SN
 from ..telemetry import trace as _trace
 from . import shapes
@@ -59,7 +60,9 @@ def execute(plan: LogicalPlan, session=None) -> Table:
             # to single-device. SPMD manages its own static shapes, so it
             # only ever sees compacted tables.
             from . import spmd
-            result = spmd.try_execute_plan(plan, session, _execute_compact)
+            result = _spmd_with_fault_fallback(
+                lambda: spmd.try_execute_plan(plan, session,
+                                              _execute_compact), session)
             if result is None:
                 result = _execute(plan, needed=None)
                 if result.is_padded:
@@ -79,6 +82,34 @@ def execute(plan: LogicalPlan, session=None) -> Table:
 def _execute_compact(plan: LogicalPlan, needed: Optional[Set[str]]) -> Table:
     """_execute for callers outside the padded pipeline (SPMD leaf reads)."""
     return _execute(plan, needed).compact()
+
+
+def _spmd_with_fault_fallback(run, session) -> Optional[Table]:
+    """The SPMD -> single-device degradation ladder (robustness layer):
+    a dispatch or compile FAULT — injected or real — degrades to
+    single-device re-execution of the same stage instead of failing the
+    query, observable as a DistributedFallbackEvent with reason
+    "fault: ...". Structural mismatches already return None inside
+    try_execute_* (the pre-existing fallback); a QueryDeadlineError is a
+    CANCELLATION, never degraded; ``robustness.degrade.enabled=false``
+    restores fail-loud behavior for debugging. The single-device rerun
+    produces byte-identical answers (proven under fault injection in
+    tests/test_robustness.py), because both paths execute the same
+    logical stage."""
+    try:
+        return run()
+    except QueryDeadlineError:
+        raise
+    except Exception as e:
+        if session is None or \
+                not session.hs_conf.robustness_degrade_enabled():
+            raise
+        from ..robustness import faults as _faults
+        from ..telemetry.logging import emit_distributed_fallback
+        _faults.note(degraded_spmd=1)
+        emit_distributed_fallback(
+            session, "spmd_query", f"fault: {type(e).__name__}: {e}")
+        return None
 
 
 def _emit_compile_event(session, count: int, seconds: float) -> None:
@@ -108,13 +139,24 @@ def _execute(plan: LogicalPlan, needed: Optional[Set[str]]) -> Table:
     """Per-stage tracing wrapper: one ``exec.stage`` span per executed
     plan node, nesting with the recursion so the span tree mirrors the
     plan tree. ``idle()`` short-circuits the whole thing to a plain call
-    while tracing is off (the no-op fast path contract)."""
+    while tracing is off (the no-op fast path contract).
+
+    The per-node deadline check makes every stage boundary a
+    cooperative cancellation point (robustness layer): deadline-less
+    queries pay one contextvar read + one attribute test."""
+    check_deadline("exec.stage")
     if _trace.idle():
-        return _execute_node(plan, needed)
+        table = _execute_node(plan, needed)
+        # Checked on EXIT too: the recursion enters ancestors before
+        # their slow leaves run, so entry checks alone would let an
+        # expired query bubble all the way up uncancelled.
+        check_deadline("exec.stage")
+        return table
     with _trace.span(SN.EXEC_STAGE, node=plan.node_name) as sp:
         table = _execute_node(plan, needed)
         if sp is not None:
             sp.attrs["rows"] = int(table.num_rows)
+        check_deadline("exec.stage")
         return table
 
 
@@ -207,10 +249,13 @@ def _execute_node(plan: LogicalPlan, needed: Optional[Set[str]]) -> Table:
         return table
     if isinstance(plan, Aggregate):
         # Multi-device product path: run eligible aggregation subtrees SPMD
-        # over the mesh (execution/spmd.py); fall back on any mismatch.
+        # over the mesh (execution/spmd.py); fall back on any mismatch —
+        # and, via the robustness ladder, on any dispatch/compile FAULT.
         from . import spmd
-        spmd_result = spmd.try_execute_aggregate(plan, _SESSION.get(),
-                                                 _execute_compact)
+        spmd_result = _spmd_with_fault_fallback(
+            lambda: spmd.try_execute_aggregate(plan, _SESSION.get(),
+                                               _execute_compact),
+            _SESSION.get())
         if spmd_result is not None:
             return spmd_result
         child_needed = set(plan.group_cols)

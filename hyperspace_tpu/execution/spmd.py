@@ -1130,7 +1130,15 @@ def _record_join_actuals(session, prep: "_Prepared", out) -> None:
 def _run(plan: Aggregate, executor, session=None) -> Table:
     """Dispatch wrapper: one ``spmd.dispatch`` span per mesh execution
     (capacity-escalation retries stay inside the one span — they are one
-    dispatch from the query's point of view)."""
+    dispatch from the query's point of view). The deadline check and the
+    fault point sit here, BEFORE any mesh work: an expired query never
+    pays a dispatch, and an injected dispatch fault propagates to the
+    executor's SPMD->single-device degradation ladder."""
+    from ..robustness import fault_names as _fltn
+    from ..robustness import faults as _faults
+    from ..serving.context import check_deadline
+    check_deadline("spmd.dispatch")
+    _faults.fault_point(_fltn.SPMD_DISPATCH)
     with _trace.span(SN.SPMD_DISPATCH, mode="agg") as sp:
         table = _run_impl(plan, executor, session)
         if sp is not None:
@@ -1231,6 +1239,11 @@ def _run_impl(plan: Aggregate, executor, session=None) -> Table:
 
 def _run_stream(root, executor, sort_orders=(), session=None) -> Table:
     """Dispatch wrapper for the row-returning path — see :func:`_run`."""
+    from ..robustness import fault_names as _fltn
+    from ..robustness import faults as _faults
+    from ..serving.context import check_deadline
+    check_deadline("spmd.dispatch")
+    _faults.fault_point(_fltn.SPMD_DISPATCH)
     mode = "sort" if sort_orders else "stream"
     with _trace.span(SN.SPMD_DISPATCH, mode=mode) as sp:
         table = _run_stream_impl(root, executor, sort_orders, session)

@@ -20,6 +20,9 @@ from __future__ import annotations
 import os
 from typing import List, Optional
 
+from ..robustness import fault_names as _fn
+from ..robustness import faults as _faults
+from ..robustness import retry as _retry
 from ..util import json_utils
 from .constants import IndexConstants, STABLE_STATES, States
 from .log_entry import IndexLogEntry
@@ -132,15 +135,50 @@ class IndexLogManager:
         entry = self.get_log(log_id)
         if entry is None or entry.state not in STABLE_STATES:
             return False
-        self._store.put_overwrite(
-            self._latest_stable_path, json_utils.to_json(entry.to_json_dict()))
+        data = json_utils.to_json(entry.to_json_dict())
+
+        def _put() -> None:
+            # Crash window the recovery scan must survive: a kill here
+            # leaves the final entry committed but latestStable stale —
+            # get_latest_stable_log's backward scan covers it. Transient
+            # store errors (OSError on a flaky mount / object store)
+            # retry with backoff; the cache is last-writer-wins, so a
+            # re-put is always safe.
+            _faults.fault_point(_fn.LOG_STABLE)
+            self._store.put_overwrite(self._latest_stable_path, data)
+
+        _retry.call(_put, where="log.stable")
         return True
 
     def delete_latest_stable_log(self) -> bool:
         return self._store.delete(self._latest_stable_path)
 
     def write_log(self, log_id: int, entry: IndexLogEntry) -> bool:
-        """Write entry at ``log_id`` iff that id doesn't exist yet."""
+        """Write entry at ``log_id`` iff that id doesn't exist yet.
+        Transient store errors retry (robustness/retry.py): put-if-absent
+        decides every race, so re-putting after an OSError keeps exactly
+        the protocol's semantics — a retry that loses the race reports
+        False like any other loser. One subtlety makes the retry
+        outcome-idempotent: a failed attempt may have COMMITTED the
+        entry before erroring (e.g. link-into-place succeeded, the temp
+        cleanup raised), so a post-transient "loss" whose stored bytes
+        are OUR bytes is a win, not a conflict. The fault point inside
+        the retried body is where the crash harness kill -9s
+        mid-protocol."""
         entry.id = log_id
-        return self._store.put_if_absent(
-            self._path_from_id(log_id), json_utils.to_json(entry.to_json_dict()))
+        path = self._path_from_id(log_id)
+        data = json_utils.to_json(entry.to_json_dict())
+        state = {"transient": False}
+
+        def _put() -> bool:
+            _faults.fault_point(_fn.LOG_WRITE)
+            try:
+                return self._store.put_if_absent(path, data)
+            except _retry.TRANSIENT_TYPES:
+                state["transient"] = True
+                raise
+
+        won = _retry.call(_put, where="log.write")
+        if not won and state["transient"]:
+            won = self._store.read(path) == data  # lost to OURSELVES?
+        return won

@@ -47,6 +47,9 @@ from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass
 from typing import Callable, Iterable, Iterator, Optional
 
+from ..robustness import fault_names as _fn
+from ..robustness import faults as _faults
+from ..robustness import retry as _retry
 from ..telemetry import metrics as _metrics
 from ..telemetry import span_names as _sn
 from ..telemetry import trace as _trace
@@ -306,6 +309,27 @@ def imap_ordered(fn: Callable, items: Iterable, *,
     items = list(items)
     p = params if params is not None else active_params()
     n = p.resolved_threads()
+    # Robustness captures, taken CONSUMER-side (pool workers never see
+    # the contextvars): the armed fault registry, the retry policy of
+    # the governing session, and whether the active query carries a
+    # deadline. All three are no-ops in the default configuration.
+    reg = _faults.armed()
+    sess = session if session is not None else _SESSION.get()
+    pol = _retry.policy_from_conf(sess.hs_conf) if sess is not None \
+        else _retry.DEFAULT_POLICY
+
+    def _read(it):
+        # The retried pooled-read body: the fault point sits INSIDE so
+        # injected transient faults exercise the real retry path; the
+        # ordered gather makes attempt-2 results byte-identical to
+        # attempt-1 results by construction (reads are idempotent).
+        def _attempt():
+            _faults.fault_point(_fn.IO_POOLED_READ, reg=reg)
+            return fn(it)
+
+        return _retry.call(_attempt, where="io.pooled_read",
+                           policy=pol, session=sess)
+
     if not p.enabled or n <= 1 or len(items) <= 1 or in_worker():
         # Sequential path: process-wide pool counters deliberately stay
         # untouched (they count POOLED work), but the serving tier's
@@ -317,13 +341,13 @@ def imap_ordered(fn: Callable, items: Iterable, *,
                 if weight is not None else 0
             ctx.note_io(read_tasks=len(items), read_bytes=w)
         for it in items:
-            yield fn(it)
+            yield _read(it)
         return
 
     def _task(it):
         _IN_WORKER.flag = True
         t0 = time.perf_counter()
-        return fn(it), time.perf_counter() - t0
+        return _read(it), time.perf_counter() - t0
 
     ex = _executor(n)
 
@@ -361,12 +385,38 @@ def imap_ordered(fn: Callable, items: Iterable, *,
             state["inflight"] += w
             i += 1
 
+    from ..serving.context import check_deadline, deadline_remaining_s
+    from concurrent.futures import TimeoutError as _FutTimeout
+    has_deadline = deadline_remaining_s() is not None
     try:
         _refill()
         while pending:
             fut, w = pending.popleft()
             t0 = time.perf_counter()
-            result, task_s = fut.result()
+            if has_deadline:
+                # Cooperative cancellation in the consumer-wait loop: a
+                # deadline'd query polls instead of blocking forever on
+                # a wedged read (the finally below cancels the window).
+                while True:
+                    check_deadline("io.read")
+                    try:
+                        result, task_s = fut.result(timeout=0.05)
+                        break
+                    except _FutTimeout:
+                        if fut.done():
+                            # Either the task completed in the race
+                            # window after the wait timed out, or the
+                            # TASK itself raised TimeoutError (on 3.11+
+                            # futures.TimeoutError IS the builtin).
+                            # Re-resolving the done future yields the
+                            # real result or the task's real error —
+                            # never the wait timeout, and never a
+                            # masked spin until the deadline.
+                            result, task_s = fut.result()
+                            break
+                        continue
+            else:
+                result, task_s = fut.result()
             wait_s += time.perf_counter() - t0
             state["inflight"] -= w
             done += 1
@@ -454,6 +504,12 @@ def prefetch_iter(source: Iterable, *,
                         break
                 t0 = time.perf_counter()
                 try:
+                    # The producer runs under a COPY of the consumer's
+                    # context, so the armed fault registry (and the
+                    # query's io attribution) propagate here by the same
+                    # mechanism — an injected error crosses the queue
+                    # and surfaces typed at the consumer below.
+                    _faults.fault_point(_fn.IO_PREFETCH_PRODUCE)
                     item = next(it)
                 except StopIteration:
                     break
@@ -486,12 +542,19 @@ def prefetch_iter(source: Iterable, *,
     wait_s = 0.0
     items = 0
     t_start = time.perf_counter()
+    from ..serving.context import check_deadline, deadline_remaining_s
+    has_deadline = deadline_remaining_s() is not None
     try:
         while True:
             t0 = time.perf_counter()
             with cond:
                 while not buf and state["error"] is None:
-                    cond.wait()
+                    # Deadline'd queries poll the consumer wait so a
+                    # stalled producer cannot outlive the cancellation
+                    # (the finally below closes the producer).
+                    cond.wait(0.05 if has_deadline else None)
+                    if has_deadline:
+                        check_deadline("io.prefetch")
                 if state["error"] is not None and not buf:
                     raise state["error"]
                 item, w = buf.popleft()

@@ -186,8 +186,14 @@ class MeshProgram:
             entry = self._compiled.get(sig)
             if entry is None:
                 global COMPILE_COUNT
+                from ..robustness import fault_names as _fn
+                from ..robustness import faults as _faults
                 from ..telemetry import span_names as _sn
                 from ..telemetry import trace as _tr
+                # Robustness fault point: an injected compile failure
+                # propagates to the dispatch site, where the executor's
+                # SPMD->single-device degradation ladder absorbs it.
+                _faults.fault_point(_fn.SPMD_COMPILE)
                 with _tr.span(_sn.SPMD_COMPILE, stage=self._name):
                     # shardings: inferred from the committed NamedSharding
                     # inputs; device_view pins every internal layout with

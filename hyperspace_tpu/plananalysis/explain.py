@@ -112,6 +112,7 @@ def explain_string(session, plan: LogicalPlan, verbose: bool = False,
         _write_io_section(buf, session)
         _write_spmd_section(buf, session)
         _write_serving_section(buf, session)
+        _write_robustness_section(buf, session)
         _write_trace_section(buf, session)
     _write_advisor_section(buf, session, with_index)
     _write_join_order_section(buf, session)
@@ -298,6 +299,44 @@ def _write_serving_section(buf: BufferStream, session) -> None:
         f"program bank: stages={b['stages']} programs={b['programs']} "
         f"hits={b['hits']} misses={b['misses']} "
         f"evictions={b['stage_evictions']}")
+
+
+def _write_robustness_section(buf: BufferStream, session) -> None:
+    """Robustness-layer observability (robustness/): the active
+    deadline/retry/degradation knobs, armed fault points, and the
+    process-lifetime counters of every ladder. Rendered only when the
+    session configures the layer or something robustness-worthy already
+    happened (a retry, an injected fault, a degradation, a
+    cancellation), so pristine-session explain goldens are untouched."""
+    from ..robustness import faults as _faults
+    conf = session.hs_conf
+    s = _faults.stats()
+    armed = conf.robustness_fault_specs()
+    configured = bool(armed) or conf.robustness_deadline_ms() > 0 or \
+        not conf.robustness_degrade_enabled()
+    if not configured and not any(s.values()):
+        return
+    buf.write_line()
+    _header(buf, "Robustness:")
+    buf.write_line(
+        f"deadlineMs={conf.robustness_deadline_ms():g} "
+        f"retry.maxAttempts={conf.robustness_retry_max_attempts()} "
+        f"retry.baseMs={conf.robustness_retry_base_ms():g} "
+        f"degrade={'on' if conf.robustness_degrade_enabled() else 'off'}")
+    buf.write_line(
+        f"fault points armed: {len(armed)}"
+        + (f" ({', '.join(sorted(armed))})" if armed else ""))
+    buf.write_line(
+        f"retries={s['retries']} retry_failures={s['retry_failures']} "
+        f"injected={s['injected']} "
+        f"cancellations={s['deadline_cancellations']}")
+    buf.write_line(
+        f"degradations: spmd={s['degraded_spmd']} "
+        f"bank_compile={s['degraded_bank_compile']} "
+        f"device_put={s['degraded_device_put']} "
+        f"spill_corrupt={s['spill_corruptions']} "
+        f"sweep_member={s['member_fallbacks']} "
+        f"worker_release={s['worker_releases']}")
 
 
 def _write_trace_section(buf: BufferStream, session) -> None:

@@ -1,0 +1,26 @@
+"""Robustness layer: fault injection, deadlines, retry, degradation.
+
+Four legs over the whole engine (see ISSUE r11 / ROADMAP item 5's
+prerequisites — a cross-process call that cannot time out, retry, or
+degrade cannot ship):
+
+- ``faults``   — named fault points at every risky boundary, armed via
+  ``hyperspace.tpu.robustness.faults.*`` conf, hard no-op disarmed;
+- ``retry``    — bounded exponential-backoff retry for transient
+  errors at idempotent boundaries (pooled reads, op-log writes);
+- deadlines    — per-query cooperative cancellation
+  (serving/context.check_deadline at stage/io/dispatch boundaries);
+- ``recovery`` — crash recovery for a lake another process died in
+  (transient-state rollback + orphaned data-version vacuum).
+
+The degradation ladders themselves live at their fault sites (executor
+SPMD fallback, program-bank eager path, result-cache spill handling,
+frontend member/worker release); this package provides the machinery
+that arms, observes, and proves them.
+"""
+
+# Only the light fault core is re-exported: config.py imports this
+# package for its constants, so the package import must not drag the
+# index/action stack in (recovery is imported lazily by its callers).
+from .faults import (FaultRegistry, FaultSpec, InjectedFaultError,  # noqa: F401
+                     TransientInjectedFaultError, fault_point)

@@ -1,0 +1,56 @@
+"""Robustness-layer config keys + defaults (`hyperspace.tpu.robustness.*`).
+
+No reference analogue: the reference delegated all fault tolerance —
+task retry, speculative execution, atomic commit — to Spark (PAPER.md);
+owning it is the point of this reproduction. Keys follow the
+conf-string convention of ``index/constants.py`` and are read ONLY
+through config.py accessors (the scripts/lint.py env gate).
+"""
+
+from __future__ import annotations
+
+
+class RobustnessConstants:
+    # Fault-injection arming: one key PER fault point, spelled
+    # ``hyperspace.tpu.robustness.faults.<point>`` where <point> comes
+    # from the frozen robustness/fault_names.py registry. The value is a
+    # spec string ``kind[:opt=val[,opt=val...]]``:
+    #   kinds  error (typed InjectedFaultError, or exc=<builtin name>),
+    #          transient (retryable TransientInjectedFaultError),
+    #          latency (sleep ms, then proceed),
+    #          kill (SIGKILL the process — the crash harness's kill -9)
+    #   opts   p=<0..1> probability, nth=<n> fire only on the nth hit,
+    #          times=<k> fire at most k times, ms=<n> latency duration,
+    #          exc=<name> builtin exception class for kind=error
+    # Unset (the default) compiles every fault point to a hard no-op:
+    # one contextvar read, nothing armed, byte-identical results.
+    FAULTS_PREFIX = "hyperspace.tpu.robustness.faults"
+
+    # Seed of the per-arming RNG behind probabilistic (p=) specs, so a
+    # chaos run replays deterministically.
+    SEED = "hyperspace.tpu.robustness.seed"
+    SEED_DEFAULT = "0"
+
+    # Per-query cooperative deadline in milliseconds (0 = none). Applies
+    # to every Session.execute on the session; ServingFrontend.submit's
+    # explicit ``deadline_ms=`` overrides per submission (measured from
+    # submit time, so queue wait counts). Expiry raises the typed
+    # QueryDeadlineError at the next stage/io/dispatch boundary.
+    DEADLINE_MS = "hyperspace.tpu.robustness.deadlineMs"
+    DEADLINE_MS_DEFAULT = "0"
+
+    # Transient-fault retry (pooled reader tasks, op-log store writes):
+    # up to maxAttempts total attempts with exponential backoff starting
+    # at baseMs (jittered). maxAttempts=1 disables retry entirely.
+    RETRY_MAX_ATTEMPTS = "hyperspace.tpu.robustness.retry.maxAttempts"
+    RETRY_MAX_ATTEMPTS_DEFAULT = "3"
+    RETRY_BASE_MS = "hyperspace.tpu.robustness.retry.baseMs"
+    RETRY_BASE_MS_DEFAULT = "10"
+
+    # Master switch of the graceful-degradation ladders (SPMD dispatch /
+    # compile failure -> single-device re-execution; program-bank
+    # compile failure -> uncached eager path; sweep-member failure ->
+    # per-member re-execution; result-cache device_put failure -> host
+    # tier). Off = failures propagate as-is (debugging).
+    DEGRADE_ENABLED = "hyperspace.tpu.robustness.degrade.enabled"
+    DEGRADE_ENABLED_DEFAULT = "true"

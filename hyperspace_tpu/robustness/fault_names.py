@@ -1,0 +1,72 @@
+"""Frozen registry of fault-point names.
+
+Every ``faults.fault_point(...)`` site in the package must name its
+point with one of these constants — free-form strings are rejected by
+the scripts/lint.py fault-discipline gate, and every name registered
+here must be referenced under tests/ (an uninjected fault point is
+unverified robustness, the same contract the span-discipline gate
+enforces for telemetry/span_names.py).
+
+A fault point marks ONE risky boundary: the exact program position a
+crash, a transient I/O error, or injected latency is allowed to strike
+when the point is armed via ``hyperspace.tpu.robustness.faults.<name>``
+conf. Keep the vocabulary SMALL and stable — the chaos soak, the crash
+harness, and the degradation-ladder tests all key on these strings.
+"""
+
+from __future__ import annotations
+
+# One pooled reader task (parallel/io.py imap_ordered): fires inside
+# the retried read fn, so transient injections exercise the retry path
+# on worker threads and on the sequential fallback alike.
+IO_POOLED_READ = "io.pooled_read"
+
+# The prefetch producer advancing its source one item (parallel/io.py
+# prefetch_iter) — errors cross the queue and surface at the consumer.
+IO_PREFETCH_PRODUCE = "io.prefetch_produce"
+
+# Multi-file scan decode (execution/columnar.read_parquet entry — every
+# format funnels through it).
+SCAN_PARQUET_DECODE = "scan.parquet_decode"
+
+# SPMD mesh dispatch (execution/spmd._run/_run_stream) and the AOT
+# compile of one mesh executable (parallel/sharding.MeshProgram).
+# Failures here prove the SPMD -> single-device degradation ladder.
+SPMD_DISPATCH = "spmd.dispatch"
+SPMD_COMPILE = "spmd.compile"
+
+# Program-bank wrapper construction (serving/program_bank.lookup):
+# failure degrades to the uncached eager path.
+BANK_COMPILE = "bank.compile"
+
+# Result-cache residency moves (serving/result_cache): the batched
+# device_put on device-tier admission, and the disk-spill read-back
+# (corruption here must be a miss, never a wrong answer).
+RESULT_CACHE_DEVICE_PUT = "result_cache.device_put"
+RESULT_CACHE_SPILL_READ = "result_cache.spill_read"
+
+# Op-log writes (index/log_manager): the conditional entry put and the
+# latestStable overwrite — the crash-recovery harness kill -9s here.
+LOG_WRITE = "log.write"
+LOG_STABLE = "log.stable"
+
+# The start of an action's op() body (actions/action.py): a crash here
+# leaves the transient log state with partial (or no) index data.
+ACTION_OP = "action.op"
+
+# A serving worker between popping an entry and executing it
+# (serving/frontend._drain): death here must release held members to
+# per-member execution, never strand their futures. Arming scope: the
+# point fires under the HEAD entry's SUBMIT-time context snapshot, so
+# arm it with an explicit ``faults.scope(registry)`` around the
+# submits (one registry per submission wave — worker death is a
+# property of the workload, not of one query's conf); per-execute conf
+# arming happens after this point and cannot reach it.
+SERVING_WORKER = "serving.worker"
+
+FAULT_NAMES = frozenset({
+    IO_POOLED_READ, IO_PREFETCH_PRODUCE, SCAN_PARQUET_DECODE,
+    SPMD_DISPATCH, SPMD_COMPILE, BANK_COMPILE,
+    RESULT_CACHE_DEVICE_PUT, RESULT_CACHE_SPILL_READ,
+    LOG_WRITE, LOG_STABLE, ACTION_OP, SERVING_WORKER,
+})

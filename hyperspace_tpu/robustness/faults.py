@@ -1,0 +1,327 @@
+"""Process-wide fault-injection registry.
+
+The robustness layer's first leg: named fault points (the frozen
+vocabulary of fault_names.py) instrumented at every risky boundary —
+pooled reads and prefetch producers, parquet decode, SPMD compile +
+dispatch, program-bank compile, result-cache device_put and spill
+read-back, op-log writes, action bodies, and serving workers — armed
+via ``hyperspace.tpu.robustness.faults.<point>`` conf and compiled to a
+hard no-op while disarmed: :func:`fault_point` is ONE contextvar read
+returning immediately (the r13 tracing-off precedent), so production
+paths pay effectively nothing.
+
+Arming is SCOPED, not global: ``Session.execute`` and ``Action.run``
+build one :class:`FaultRegistry` per run from the governing conf
+(:func:`scope_for`), so ``nth=``/``times=`` counters are deterministic
+per query / per action, and concurrent sessions with different fault
+confs never see each other's injections. The registry rides the
+contextvar across serving workers and prefetch producers exactly like
+the trace/io scopes it sits beside; reader-pool workers (which never
+inherit the context) get the registry handed in explicitly
+(``fault_point(name, reg=...)``) by the consumer that captured it.
+
+Spec grammar (the conf value): ``kind[:opt=val[,opt=val...]]`` —
+see robustness/constants.py for kinds and options. ``kill`` SIGKILLs
+the process at the point, which is how the crash-recovery harness
+produces a real mid-action ``kill -9`` at an exact protocol position.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import itertools
+import os
+import random
+import signal
+import threading
+import time
+from typing import Dict, Optional
+
+from ..exceptions import HyperspaceException
+from . import fault_names
+
+
+class InjectedFaultError(HyperspaceException):
+    """The typed error an armed ``error`` fault point raises — a
+    HyperspaceException subclass, so chaos runs can assert every failed
+    submission surfaced a typed framework error."""
+
+
+class TransientInjectedFaultError(InjectedFaultError):
+    """An armed ``transient`` fault: classified retryable by
+    robustness/retry.py alongside OSError/TimeoutError."""
+
+
+_KINDS = ("error", "transient", "latency", "kill")
+
+# Builtin exception classes an ``error:exc=<name>`` spec may name.
+_EXC_CLASSES = {
+    "OSError": OSError,
+    "IOError": OSError,
+    "TimeoutError": TimeoutError,
+    "RuntimeError": RuntimeError,
+    "ValueError": ValueError,
+    "MemoryError": MemoryError,
+}
+
+
+class FaultSpec:
+    """One parsed fault-point arming."""
+
+    __slots__ = ("name", "kind", "p", "nth", "times", "ms", "exc")
+
+    def __init__(self, name: str, kind: str, p: float = 1.0,
+                 nth: Optional[int] = None, times: Optional[int] = None,
+                 ms: float = 50.0, exc=None):
+        self.name = name
+        self.kind = kind
+        self.p = p
+        self.nth = nth
+        self.times = times
+        self.ms = ms
+        self.exc = exc
+
+    @classmethod
+    def parse(cls, name: str, raw: str) -> "FaultSpec":
+        if name not in fault_names.FAULT_NAMES:
+            raise HyperspaceException(
+                f"Unknown fault point {name!r}; names come from the "
+                "frozen robustness/fault_names.py registry: "
+                f"{sorted(fault_names.FAULT_NAMES)}")
+        raw = (raw or "").strip()
+        kind, _, opts_raw = raw.partition(":")
+        kind = kind.strip().lower()
+        if kind not in _KINDS:
+            raise HyperspaceException(
+                f"Unknown fault kind {kind!r} for point {name!r}; "
+                f"expected one of {_KINDS} "
+                "(spec: kind[:opt=val[,opt=val...]])")
+        spec = cls(name, kind)
+        for part in filter(None, (p.strip() for p in opts_raw.split(","))):
+            k, eq, v = part.partition("=")
+            if not eq:
+                raise HyperspaceException(
+                    f"Malformed fault option {part!r} for point {name!r}")
+            k = k.strip().lower()
+            v = v.strip()
+            if k == "p":
+                spec.p = min(max(float(v), 0.0), 1.0)
+            elif k == "nth":
+                spec.nth = max(int(v), 1)
+            elif k == "times":
+                spec.times = max(int(v), 0)
+            elif k == "ms":
+                spec.ms = max(float(v), 0.0)
+            elif k == "exc":
+                exc = _EXC_CLASSES.get(v)
+                if exc is None:
+                    raise HyperspaceException(
+                        f"Unknown exception class {v!r} for fault point "
+                        f"{name!r}; supported: "
+                        f"{sorted(_EXC_CLASSES)}")
+                spec.exc = exc
+            else:
+                raise HyperspaceException(
+                    f"Unknown fault option {k!r} for point {name!r}")
+        return spec
+
+
+class FaultRegistry:
+    """The armed fault points of one scope (one query / one action run).
+    ``trigger`` counts every hit per point and fires per the spec;
+    counters live here, so nth/times semantics are scope-deterministic."""
+
+    def __init__(self, specs: Dict[str, FaultSpec], seed: int = 0):
+        self._specs = dict(specs)
+        self._rng = random.Random(seed)
+        self._lock = threading.Lock()
+        self._hits = {n: 0 for n in specs}
+        self._fired = {n: 0 for n in specs}
+
+    @classmethod
+    def from_conf_specs(cls, raw_specs: Dict[str, str],
+                        seed: int = 0) -> "FaultRegistry":
+        return cls({n: FaultSpec.parse(n, raw) for n, raw
+                    in raw_specs.items()}, seed=seed)
+
+    def hit_count(self, name: str) -> int:
+        with self._lock:
+            return self._hits.get(name, 0)
+
+    def trigger(self, name: str) -> None:
+        spec = self._specs.get(name)
+        if spec is None:
+            return
+        with self._lock:
+            self._hits[name] += 1
+            hit = self._hits[name]
+            if spec.nth is not None and hit != spec.nth:
+                return
+            if spec.times is not None and self._fired[name] >= spec.times:
+                return
+            if spec.p < 1.0 and self._rng.random() >= spec.p:
+                return
+            self._fired[name] += 1
+        note(injected=1)
+        if spec.kind == "latency":
+            time.sleep(spec.ms / 1000.0)
+            return
+        if spec.kind == "kill":
+            # The crash harness's mid-action kill -9: immediate,
+            # unhandleable, no atexit/flush — exactly a hard crash.
+            os.kill(os.getpid(), signal.SIGKILL)
+        if spec.kind == "transient":
+            raise TransientInjectedFaultError(
+                f"injected transient fault at {name!r}")
+        exc = spec.exc if spec.exc is not None else InjectedFaultError
+        raise exc(f"injected fault at {name!r}")
+
+
+# ---------------------------------------------------------------------------
+# The ambient armed scope (contextvar — follows the query across serving
+# workers and prefetch producers like the trace/io scopes).
+# ---------------------------------------------------------------------------
+
+_ARMED: contextvars.ContextVar = contextvars.ContextVar(
+    "hst_armed_faults", default=None)
+
+
+def armed() -> Optional[FaultRegistry]:
+    """The active registry, or None while disarmed. Consumers that fan
+    work out to context-less pool threads capture this once and hand it
+    to ``fault_point(name, reg=...)`` inside the task."""
+    return _ARMED.get()
+
+
+def fault_point(name: str, reg: Optional[FaultRegistry] = None) -> None:
+    """Declare one named risky boundary. Disarmed (the default) this is
+    a single contextvar read; armed, the registry decides whether to
+    raise / sleep / kill here per the point's conf spec."""
+    r = reg if reg is not None else _ARMED.get()
+    if r is None:
+        return
+    r.trigger(name)
+
+
+@contextlib.contextmanager
+def scope(registry: Optional[FaultRegistry]):
+    """Activate ``registry`` on this context (None = explicit no-op)."""
+    if registry is None:
+        yield None
+        return
+    token = _ARMED.set(registry)
+    try:
+        yield registry
+    finally:
+        _ARMED.reset(token)
+
+
+# Per-arming scope counter: conf-armed registries are built fresh per
+# run, so p= specs must NOT replay the identical RNG sequence every
+# query (that would make "p=0.5" fire for either 100% or 0% of queries).
+# Deriving each scope's seed from (conf seed, scope ordinal) keeps a
+# single-threaded run replayable while giving real per-query sampling.
+_SCOPE_IDS = itertools.count(1)
+
+
+@contextlib.contextmanager
+def scope_for(hs_conf):
+    """Arm from the governing conf for one run (Session.execute /
+    Action.run). No ``robustness.faults.*`` keys set — the overwhelmingly
+    common case — skips registry construction AND the contextvar write
+    entirely: the scope costs one small dict scan per run."""
+    raw_specs = hs_conf.robustness_fault_specs()
+    if not raw_specs:
+        yield None
+        return
+    registry = FaultRegistry.from_conf_specs(
+        raw_specs,
+        seed=hs_conf.robustness_seed() * 1_000_003 + next(_SCOPE_IDS))
+    token = _ARMED.set(registry)
+    try:
+        yield registry
+    finally:
+        _ARMED.reset(token)
+
+
+def degrade_enabled() -> bool:
+    """The ``robustness.degrade.enabled`` master switch of the governing
+    session — the active QueryContext's, else the parallel-io session
+    scope's (actions), else the default (on). Every degradation ladder
+    asks HERE so fail-loud debugging disables all of them uniformly."""
+    from ..serving.context import active_context
+    ctx = active_context()
+    session = ctx.session if ctx is not None else None
+    if session is None:
+        from ..parallel import io as pio
+        session = pio.active_session()
+    if session is None:
+        return True
+    return session.hs_conf.robustness_degrade_enabled()
+
+
+# ---------------------------------------------------------------------------
+# Process-wide robustness counters (explain's "Robustness:" section, the
+# "robustness" collector in the metrics registry, bench assertions).
+# ---------------------------------------------------------------------------
+
+_COUNTER_KEYS = (
+    "injected",                # fault points that actually fired
+    "retries",                 # individual retry attempts that ran
+    "retry_failures",          # retry sequences that exhausted attempts
+    "deadline_cancellations",  # queries cancelled at a deadline check
+    "degraded_spmd",           # SPMD faults absorbed by single-device
+    "degraded_bank_compile",   # bank-compile faults -> uncached eager
+    "degraded_device_put",     # device-tier put faults -> host tier
+    "spill_corruptions",       # corrupt spill files served as misses
+    "member_fallbacks",        # sweep members re-run standalone
+    "worker_releases",         # entries released from a dying worker
+    "recovered_indexes",       # transient op-log states rolled back
+    "vacuumed_orphans",        # orphaned index data versions removed
+)
+
+
+class _Stats:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counts = {k: 0 for k in _COUNTER_KEYS}
+
+    def note(self, **deltas) -> None:
+        with self._lock:
+            for k, v in deltas.items():
+                self._counts[k] = self._counts.get(k, 0) + v
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return dict(self._counts)
+
+    def reset(self) -> None:
+        with self._lock:
+            for k in self._counts:
+                self._counts[k] = 0
+
+
+_STATS = _Stats()
+
+
+def note(**deltas) -> None:
+    _STATS.note(**deltas)
+
+
+def stats() -> dict:
+    """Process-lifetime robustness counters."""
+    return _STATS.snapshot()
+
+
+def reset_stats() -> None:
+    """Zero the counters (bench A/B phases; never needed for
+    correctness)."""
+    _STATS.reset()
+
+
+# The robustness counters are a named collector in the process metrics
+# registry (telemetry/metrics.py), beside io/program_bank/serving.
+from ..telemetry import metrics as _metrics  # noqa: E402
+
+_metrics.get_registry().register_collector("robustness", stats)

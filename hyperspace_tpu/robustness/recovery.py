@@ -1,0 +1,130 @@
+"""Crash recovery: op-log rollback + orphaned data-version vacuum.
+
+The op-log protocol (actions/action.py) already guarantees a crashed
+action leaves only a TRANSIENT log state: queries keep serving the last
+stable entry, and ``cancel`` rolls the log back. What nothing owned
+until now is the sweep a fresh session runs over a lake another process
+died in — finding the wrecks and cleaning up the bytes:
+
+- every index whose latest log entry is transient (CREATING /
+  REFRESHING / OPTIMIZING / VACUUMING / ...) is rolled back to its last
+  stable state via the existing CancelAction (the protocol's own
+  recovery primitive, so concurrency control still applies);
+- index data version directories (``v__=<n>``) referenced by NO
+  ACTIVE/DELETED log entry are the dead action's partial output —
+  immutable-version layout means they can never be served, so they are
+  deleted (the "partial data files are vacuumed" half of crash safety).
+
+Conservative by construction: version references are collected from
+EVERY parseable log entry in a live state (not just the latest), so a
+version any historical stable entry names survives; only directories no
+entry has ever committed are removed. Proven by the kill -9 harness in
+tests/test_crash_recovery.py across create/refresh/optimize/vacuum at
+every op-log fault point.
+
+Scope: filesystem-backed lakes (the index enumeration walks the system
+path). Object-store deployments run the same per-index recovery through
+``recover_index`` with their own listing.
+
+OPERATOR ACTION: the op log records no liveness, so a transient entry
+left by a crash is indistinguishable from one a LIVE action holds right
+now — run the sweep only when no other process is mutating the lake
+(the same contract as ``cancel``, which this drives).
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from typing import Dict, List, Optional
+
+from ..index.constants import IndexConstants, STABLE_STATES, States
+from ..index.data_manager import IndexDataManager
+from ..index.log_manager import IndexLogManager
+from . import faults as _faults
+
+# v__=<n> anywhere in a content file path names the data version the
+# entry serves from.
+_VERSION_RE = re.compile(
+    re.escape(IndexConstants.INDEX_VERSION_DIRECTORY_PREFIX) + r"=(\d+)")
+
+
+def recover_indexes(session, names: Optional[List[str]] = None) -> Dict:
+    """Sweep every index under the session's system path (or just
+    ``names``): roll back transient states, vacuum orphaned data
+    versions. Returns a summary dict; per-index failures are collected
+    under ``errors`` so one wrecked index cannot block the sweep."""
+    summary: Dict = {"scanned": [], "cancelled": [], "vacuumed": {},
+                     "errors": {}}
+    root = session.hs_conf.system_path()
+    if not os.path.isdir(root):
+        return summary
+    for name in sorted(os.listdir(root)):
+        if names is not None and name not in names:
+            continue
+        index_path = os.path.join(root, name)
+        if not os.path.isdir(
+                os.path.join(index_path, IndexConstants.HYPERSPACE_LOG)):
+            continue
+        summary["scanned"].append(name)
+        try:
+            recover_index(session, index_path, name, summary)
+        except Exception as e:
+            summary["errors"][name] = f"{type(e).__name__}: {e}"
+    return summary
+
+
+def recover_index(session, index_path: str, name: str,
+                  summary: Optional[Dict] = None) -> Dict:
+    """Recover ONE index directory; see :func:`recover_indexes`."""
+    if summary is None:
+        summary = {"scanned": [name], "cancelled": [], "vacuumed": {},
+                   "errors": {}}
+    mgr = IndexLogManager(index_path)
+    latest_id = mgr.get_latest_id()
+    if latest_id is None:
+        return summary
+    latest = mgr._get_log_lenient(latest_id)
+    if latest is not None and latest.state not in STABLE_STATES:
+        from ..actions.lifecycle import CancelAction
+        CancelAction(session, mgr, IndexDataManager(index_path)).run()
+        summary["cancelled"].append(name)
+        _faults.note(recovered_indexes=1)
+    orphans = _vacuum_orphan_versions(mgr, index_path)
+    if orphans:
+        summary["vacuumed"][name] = orphans
+        _faults.note(vacuumed_orphans=len(orphans))
+    return summary
+
+
+def _referenced_versions(mgr: IndexLogManager, latest_id: int) -> set:
+    """Data versions any parseable ACTIVE/DELETED entry commits to.
+    DOESNOTEXIST and transient entries reference nothing servable — a
+    crashed action's entry must not protect its own partial output."""
+    referenced: set = set()
+    for log_id in range(latest_id, -1, -1):
+        entry = mgr._get_log_lenient(log_id)
+        if entry is None or entry.state not in (States.ACTIVE,
+                                                States.DELETED):
+            continue
+        try:
+            files = entry.content.files
+        except Exception:
+            continue  # a content-less entry constrains nothing
+        for f in files:
+            for m in _VERSION_RE.finditer(f):
+                referenced.add(int(m.group(1)))
+    return referenced
+
+
+def _vacuum_orphan_versions(mgr: IndexLogManager,
+                            index_path: str) -> List[int]:
+    latest_id = mgr.get_latest_id()
+    if latest_id is None:
+        return []
+    referenced = _referenced_versions(mgr, latest_id)
+    dm = IndexDataManager(index_path)
+    orphans = [v for v in dm.get_all_version_ids() if v not in referenced]
+    for v in orphans:
+        dm.delete(v)
+    return orphans

@@ -1,0 +1,131 @@
+"""Bounded retry with exponential backoff + jitter for transient faults.
+
+The robustness layer's third leg, wrapped around exactly the boundaries
+where a transient error is both plausible and safe to repeat: pooled
+reader tasks (parallel/io.py — file reads are idempotent, and the
+ordered gather keeps results byte-identical whether attempt 1 or 3
+produced them) and op-log store writes (index/log_manager.py — the
+conditional put decides every race, so re-putting after an OSError is
+the protocol's own semantics).
+
+Transient means: OSError/TimeoutError (the real I/O failure classes)
+or an injected :class:`~.faults.TransientInjectedFaultError`. Anything
+else propagates on the FIRST attempt — retrying a deterministic error
+only doubles the damage. A sequence that exhausts its attempts
+surfaces the ORIGINAL error (the first failure is the diagnosis; later
+attempts' errors are noise from a degrading system).
+
+Policy comes from ``hyperspace.tpu.robustness.retry.{maxAttempts,
+baseMs}`` via config.py; delays are ``baseMs * 2^(attempt-1)`` jittered
+uniformly in [0.5x, 1.5x) so synchronized retry storms decorrelate. A
+query past its deadline never sleeps here — ``check_deadline`` runs
+before each backoff.
+"""
+
+from __future__ import annotations
+
+import random
+import time
+from dataclasses import dataclass
+from typing import Callable, Optional
+
+from . import faults as _faults
+
+# The exception classes a retry may absorb. ConnectionError/InterruptedError
+# are OSError subclasses; everything else is assumed deterministic.
+TRANSIENT_TYPES = (OSError, TimeoutError,
+                   _faults.TransientInjectedFaultError)
+
+# OSError subclasses that are DETERMINISTIC, not flaky-I/O: a missing
+# file or a permission wall fails identically on every attempt —
+# retrying only delays the real error and pollutes the retry telemetry.
+NON_TRANSIENT_TYPES = (FileNotFoundError, NotADirectoryError,
+                       IsADirectoryError, PermissionError)
+
+
+@dataclass(frozen=True)
+class RetryPolicy:
+    max_attempts: int = 3
+    base_ms: float = 10.0
+
+
+DEFAULT_POLICY = RetryPolicy()
+
+
+def policy_from_conf(hs_conf) -> RetryPolicy:
+    return RetryPolicy(
+        max_attempts=max(int(hs_conf.robustness_retry_max_attempts()), 1),
+        base_ms=max(float(hs_conf.robustness_retry_base_ms()), 0.0))
+
+
+def active_policy() -> RetryPolicy:
+    """Policy of the governing session: the active QueryContext's, else
+    the parallel-io session scope's (actions run under it), else the
+    defaults."""
+    from ..parallel import io as pio
+    from ..serving.context import active_context
+    ctx = active_context()
+    session = ctx.session if ctx is not None else pio.active_session()
+    if session is not None:
+        return policy_from_conf(session.hs_conf)
+    return DEFAULT_POLICY
+
+
+def call(fn: Callable, *, where: str = "", policy: Optional[RetryPolicy]
+         = None, session=None):
+    """Run ``fn()`` with up to ``policy.max_attempts`` attempts,
+    absorbing transient errors between them. Emits one RetryEvent per
+    sequence that retried (success or exhaustion) and feeds the
+    process-wide robustness counters."""
+    p = policy if policy is not None else active_policy()
+    first_err: Optional[BaseException] = None
+    for attempt in range(1, p.max_attempts + 1):
+        try:
+            result = fn()
+        except TRANSIENT_TYPES as e:
+            if isinstance(e, NON_TRANSIENT_TYPES):
+                raise  # deterministic: fail now, with the real error
+            if first_err is None:
+                first_err = e
+            if attempt >= p.max_attempts:
+                _faults.note(retries=attempt - 1, retry_failures=1)
+                _emit(session, where, attempt, False, first_err)
+                raise first_err
+            # A cancelled query must not sleep through a backoff.
+            from ..serving.context import check_deadline
+            check_deadline(where)
+            delay_s = (p.base_ms / 1000.0) * (2 ** (attempt - 1))
+            if delay_s > 0:
+                time.sleep(delay_s * (0.5 + random.random()))
+            continue
+        if attempt > 1:
+            _faults.note(retries=attempt - 1)
+            _emit(session, where, attempt, True, first_err)
+        return result
+
+
+def _emit(session, where: str, attempts: int, succeeded: bool,
+          first_err: Optional[BaseException]) -> None:
+    """One RetryEvent per retried sequence, through the governing
+    session's logger (the explicit one, else the parallel-io scope's)."""
+    try:
+        if session is None:
+            from ..parallel import io as pio
+            session = pio.active_session()
+        if session is None:
+            from ..serving.context import active_context
+            ctx = active_context()
+            session = ctx.session if ctx is not None else None
+        if session is None:
+            return
+        from ..telemetry.events import RetryEvent
+        from ..telemetry.logging import get_logger
+        get_logger(session.hs_conf.event_logger_class()).log_event(
+            RetryEvent(
+                message=(f"retry at {where!r}: {attempts} attempt(s), "
+                         + ("recovered" if succeeded else "exhausted")),
+                where=where, attempts=attempts, succeeded=succeeded,
+                error=(f"{type(first_err).__name__}: {first_err}"
+                       if first_err is not None else "")))
+    except Exception:
+        pass  # observability must never fail the retried operation

@@ -37,6 +37,17 @@ class ServingConstants:
     RESULT_CACHE_MIN_INPUT_BYTES = "serving.result_cache.minInputBytes"
     RESULT_CACHE_MIN_INPUT_BYTES_DEFAULT = "0"
 
+    # Optional disk-spill tier (r11-robustness): host-tier LRU victims
+    # spill to files under ``spillDir`` (empty = disabled) up to
+    # ``spillBytes``; spill victims are gone for good. A truncated or
+    # corrupt spill file reads back as a MISS (entry evicted,
+    # ResultCacheMissEvent reason="spill-corrupt") — never an error or a
+    # wrong answer mid-query.
+    RESULT_CACHE_SPILL_DIR = "serving.result_cache.spillDir"
+    RESULT_CACHE_SPILL_DIR_DEFAULT = ""
+    RESULT_CACHE_SPILL_BYTES = "serving.result_cache.spillBytes"
+    RESULT_CACHE_SPILL_BYTES_DEFAULT = str(4 * 1024 * 1024 * 1024)
+
     # SQL text -> logical plan memo (active only while the result cache is
     # enabled): a high-QPS serving loop re-issues identical SQL, and the
     # parse+analyze pass is pure given the temp-view registry version.

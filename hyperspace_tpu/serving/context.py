@@ -63,7 +63,8 @@ class QueryContext:
     """Everything one query execution needs, made explicit."""
 
     def __init__(self, session, result_cache=None, capture: Optional[bool]
-                 = None, client: str = "", query_id: Optional[int] = None):
+                 = None, client: str = "", query_id: Optional[int] = None,
+                 deadline_s: Optional[float] = None):
         self.session = session
         self.query_id = query_id if query_id is not None \
             else next(_QUERY_IDS)
@@ -72,6 +73,13 @@ class QueryContext:
         # Resolved handles (pinned for the query's lifetime).
         self.result_cache = result_cache
         self.capture = bool(capture) if capture is not None else False
+        # Cooperative deadline (robustness layer): an ABSOLUTE
+        # perf_counter stamp, or None. Checked at the executor's
+        # per-node stage boundary, the io wait loops, and SPMD dispatch
+        # via :func:`check_deadline`; expiry raises the typed
+        # QueryDeadlineError and emits ONE QueryCancelledEvent.
+        self.deadline_s = deadline_s
+        self._cancel_emitted = False
         # Unified tracing (telemetry/trace.py): ``trace`` is the Trace
         # this query's spans landed in (set by query_trace once tracing
         # is on); ``trace_parent`` is an optional (Trace, Span) pair a
@@ -90,16 +98,26 @@ class QueryContext:
     # ------------------------------------------------------------------
 
     @classmethod
-    def for_session(cls, session, shared_cache=None,
-                    client: str = "") -> "QueryContext":
+    def for_session(cls, session, shared_cache=None, client: str = "",
+                    deadline_s: Optional[float] = None,
+                    query_id: Optional[int] = None) -> "QueryContext":
         """The per-query context ``Session.execute`` builds when none was
         handed in. ``shared_cache`` (the serving frontend's cross-session
-        result cache) takes precedence over the session's own."""
+        result cache) takes precedence over the session's own; an
+        explicit ``deadline_s`` (the frontend's submit-time deadline)
+        over the session's ``robustness.deadlineMs`` conf; an explicit
+        ``query_id`` (allocated at SUBMIT time by the frontend, so
+        queue-expired cancellations correlate) over a fresh one."""
         cache = shared_cache if shared_cache is not None \
             else session.result_cache
+        if deadline_s is None:
+            ms = session.hs_conf.robustness_deadline_ms()
+            if ms > 0:
+                deadline_s = time.perf_counter() + ms / 1000.0
         return cls(session, result_cache=cache,
                    capture=session.hs_conf.advisor_capture_enabled(),
-                   client=client)
+                   client=client, deadline_s=deadline_s,
+                   query_id=query_id)
 
     @contextlib.contextmanager
     def activate(self):
@@ -151,6 +169,90 @@ def record_join_actual(session, condition_repr: str, rows: int) -> None:
             actuals.popitem(last=False)
 
 
+def next_query_id() -> int:
+    """Allocate one process-wide query id eagerly (the serving frontend
+    stamps it at SUBMIT time, so events emitted before execution — the
+    queue-expired cancellation — still correlate)."""
+    return next(_QUERY_IDS)
+
+
 def active_context() -> Optional[QueryContext]:
     """The QueryContext of the in-flight execution, if any."""
     return _CONTEXT.get()
+
+
+# ---------------------------------------------------------------------------
+# Cooperative per-query deadline (robustness layer).
+# ---------------------------------------------------------------------------
+
+def deadline_remaining_s() -> Optional[float]:
+    """Seconds until the active query's deadline (may be negative), or
+    None when no context / no deadline — the io wait loops use this to
+    bound their condition waits."""
+    ctx = _CONTEXT.get()
+    if ctx is None or ctx.deadline_s is None:
+        return None
+    return ctx.deadline_s - time.perf_counter()
+
+
+def check_deadline(where: str = "") -> None:
+    """The cooperative cancellation point: a hard no-op (one contextvar
+    read, one attribute check) unless the active query carries a
+    deadline AND it has expired — then the typed QueryDeadlineError
+    aborts the execution at this boundary. Instrumented at the
+    executor's per-node stage entry, the pooled-read gather, the
+    prefetch consumer wait, retry backoffs, and SPMD dispatch."""
+    ctx = _CONTEXT.get()
+    if ctx is None or ctx.deadline_s is None:
+        return
+    if time.perf_counter() < ctx.deadline_s:
+        return
+    _trip_deadline(ctx, where)
+
+
+def _trip_deadline(ctx: QueryContext, where: str) -> None:
+    elapsed_ms = (time.perf_counter() - ctx.created_s) * 1000.0
+    with ctx._io_lock:
+        first = not ctx._cancel_emitted
+        ctx._cancel_emitted = True
+    if first:
+        try:  # trace attribution: flag the span the cancellation hit
+            from ..telemetry import trace as _trace
+            pair = _trace.active()
+            if pair is not None and pair[1] is not None:
+                pair[1].attrs["deadline_exceeded"] = True
+                pair[1].attrs["cancelled_at"] = where
+        except Exception:
+            pass
+    deadline_cancel(ctx.session, ctx.query_id, where, elapsed_ms,
+                    emit=first)
+
+
+def deadline_cancel(session, query_id: int, where: str,
+                    elapsed_ms: float, emit: bool = True) -> None:
+    """THE cancellation protocol, shared by the mid-query trip above
+    and the serving frontend's queue fast-fail: bump the process
+    counter, emit ONE QueryCancelledEvent (``emit=False`` on re-trips
+    of an already-cancelled query), raise the typed error."""
+    from ..exceptions import QueryDeadlineError
+    if emit:
+        from ..robustness import faults as _faults
+        _faults.note(deadline_cancellations=1)
+        try:
+            if session is not None:
+                from ..telemetry.events import QueryCancelledEvent
+                from ..telemetry.logging import get_logger
+                get_logger(
+                    session.hs_conf.event_logger_class()
+                ).log_event(QueryCancelledEvent(
+                    message=(f"query {query_id} cancelled at "
+                             f"{where or 'boundary'}: deadline expired "
+                             f"after {elapsed_ms:.1f} ms"),
+                    query_id=query_id, where=where,
+                    elapsed_ms=round(elapsed_ms, 3)))
+        except Exception:
+            pass  # observability must never mask the cancellation
+    raise QueryDeadlineError(
+        f"query {query_id} exceeded its deadline "
+        f"({elapsed_ms:.1f} ms elapsed; cancelled at "
+        f"{where or 'stage boundary'})")

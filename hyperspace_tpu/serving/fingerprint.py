@@ -148,17 +148,21 @@ def index_versions(session) -> Tuple[Tuple[str, int, str], ...]:
 
 
 def config_hash(session) -> str:
-    """Conf + enabled-flag hash. The serving and telemetry knobs
-    themselves are excluded: they steer THIS cache (admission floors,
-    budgets) or pure observability (tracing/metrics/profiler — results
-    are byte-identical by contract, asserted in tests/test_tracing.py),
-    never the computed answer — hashing them would orphan every warm
-    entry on an admission-threshold tweak or a tracing toggle, breaking
+    """Conf + enabled-flag hash. The serving, telemetry, and robustness
+    knobs themselves are excluded: they steer THIS cache (admission
+    floors, budgets), pure observability (tracing/metrics/profiler —
+    results are byte-identical by contract, asserted in
+    tests/test_tracing.py), or fault handling (deadlines/retry/
+    degradation ladders produce byte-identical answers or typed errors,
+    never a different answer — asserted in tests/test_robustness.py) —
+    hashing them would orphan every warm entry on an admission-threshold
+    tweak, a tracing toggle, or a deadline/fault (dis)arming, breaking
     config.py's live-tuning contract."""
     items = [(k, v) for k, v in sorted(session.conf.as_dict().items())
              if not k.startswith("serving.")
              and not k.startswith("hyperspace.tpu.serving.")
-             and not k.startswith("hyperspace.tpu.telemetry.")]
+             and not k.startswith("hyperspace.tpu.telemetry.")
+             and not k.startswith("hyperspace.tpu.robustness.")]
     return hashing.md5_hex((items, session.is_hyperspace_enabled()))
 
 

@@ -93,15 +93,18 @@ class PendingQuery:
 
 
 class _Entry:
-    __slots__ = ("plan", "norm", "session", "ctx", "pending", "batch_key")
+    __slots__ = ("plan", "norm", "session", "ctx", "pending", "batch_key",
+                 "deadline_s")
 
-    def __init__(self, plan, norm, session, ctx, pending, batch_key):
+    def __init__(self, plan, norm, session, ctx, pending, batch_key,
+                 deadline_s=None):
         self.plan = plan
         self.norm = norm
         self.session = session
         self.ctx = ctx                # contextvars.Context snapshot
         self.pending = pending
         self.batch_key = batch_key    # None = never batchable
+        self.deadline_s = deadline_s  # absolute perf_counter, or None
 
 
 class ServingFrontend:
@@ -162,11 +165,20 @@ class ServingFrontend:
     # Submission + admission control.
     # ------------------------------------------------------------------
 
-    def submit(self, query, session=None, client: str = "") -> PendingQuery:
+    def submit(self, query, session=None, client: str = "",
+               deadline_ms: Optional[float] = None) -> PendingQuery:
         """Enqueue one query (a DataFrame, or a LogicalPlan plus an
         explicit ``session``). Returns immediately with a
         :class:`PendingQuery`; raises :class:`ServingRejectedError` when
-        admission control refuses it."""
+        admission control refuses it.
+
+        ``deadline_ms`` (robustness layer) bounds the query end to end
+        FROM SUBMIT TIME — queue wait counts. Expiry cancels the query
+        at the next cooperative boundary (or before it ever starts),
+        frees the worker slot, and surfaces the typed
+        :class:`~..exceptions.QueryDeadlineError` on ``result()``;
+        unset falls back to the session's
+        ``hyperspace.tpu.robustness.deadlineMs`` conf."""
         plan = getattr(query, "plan", query)
         session = session if session is not None \
             else getattr(query, "session", None)
@@ -178,8 +190,11 @@ class ServingFrontend:
         est = estimate_recompute_bytes(norm)
         batch_key = batcher.template_key(session, norm) \
             if self._hs_conf.serving_batching_enabled() else None
-        pending = PendingQuery(query_id=0, client=client,
+        from .context import next_query_id
+        pending = PendingQuery(query_id=next_query_id(), client=client,
                                estimated_bytes=est)
+        deadline_s = time.perf_counter() + deadline_ms / 1000.0 \
+            if deadline_ms is not None and deadline_ms > 0 else None
         depth = self._hs_conf.serving_queue_depth()
         max_bytes = self._hs_conf.serving_admission_max_bytes()
         with self._lock:
@@ -197,7 +212,8 @@ class ServingFrontend:
                     f"serving admission rejected query: {reason}")
             self._stats["admitted"] += 1
             entry = _Entry(plan, norm, session,
-                           contextvars.copy_context(), pending, batch_key)
+                           contextvars.copy_context(), pending, batch_key,
+                           deadline_s=deadline_s)
             self._queue.append(entry)
             self._inflight_bytes += est
             spawn = self._active_workers < \
@@ -248,9 +264,22 @@ class ServingFrontend:
             # Everything past the pop is guarded: a worker dying with
             # popped entries in hand would strand the clients' futures,
             # leak _inflight_bytes, and wedge _active_workers forever
-            # (e.g. a malformed batching.window conf string). Errors
-            # land on the entries' futures and the worker lives on.
+            # (e.g. a malformed batching.window conf string). A death in
+            # the window/collection phase — BEFORE any member started —
+            # releases the held members to per-member execution (each
+            # with its own error handling) instead of failing innocents
+            # with the worker's own error; a death with members already
+            # started lands the error on the unfinished futures. Either
+            # way the worker lives on.
             try:
+                from ..robustness import fault_names as _fn
+                from ..robustness import faults as _faults
+                # Runs under the HEAD entry's submit-time context
+                # snapshot: the worker thread itself carries no armed
+                # fault scope, the submitter's does (one registry across
+                # a whole submission wave — worker death is a property
+                # of the workload, not of one query's execution).
+                entry.ctx.run(_faults.fault_point, _fn.SERVING_WORKER)
                 window = self._hs_conf.serving_batching_window()
                 limit = self._hs_conf.serving_batching_max_batch()
                 with self._lock:
@@ -275,11 +304,35 @@ class ServingFrontend:
                 else:
                     self._run_batch(batch)
             except BaseException as e:
-                for b in batch:
-                    if not b.pending.done():
-                        b.pending._finish(error=e)
-                        self._note(failed=1)
-                        self._release(b)
+                self._release_batch(batch, e)
+
+    def _release_batch(self, batch: List[_Entry], error) -> None:
+        """Worker-death recovery: members the dying worker never started
+        re-execute per-member (their own errors land on their own
+        futures); started-but-unfinished members get the worker's error.
+        Last-resort guard: anything this release path itself fails to
+        place lands the original error, so no future is ever stranded
+        until the drain timeout."""
+        from ..robustness import faults as _faults
+
+        def _fail(b: _Entry) -> None:
+            if not b.pending.done():
+                b.pending._finish(error=error)
+                self._note(failed=1)
+                self._release(b)
+
+        for b in batch:
+            if b.pending.done():
+                continue
+            if b.pending.started_s is None and \
+                    b.session.hs_conf.robustness_degrade_enabled():
+                try:
+                    _faults.note(worker_releases=1)
+                    self._run_single(b)  # own try/except per member
+                except BaseException:
+                    _fail(b)
+                continue
+            _fail(b)
 
     def _collect_batch(self, head: _Entry, batch: List[_Entry],
                        limit: int) -> None:
@@ -303,6 +356,7 @@ class ServingFrontend:
     def _run_single(self, entry: _Entry) -> None:
         entry.pending.started_s = time.perf_counter()
         try:
+            self._check_entry_deadline(entry, "serving.queue")
             result = entry.ctx.run(self._execute_entry, entry, None, 0)
             entry.pending._finish(result=result)
             self._note(completed=1)
@@ -312,6 +366,19 @@ class ServingFrontend:
         finally:
             self._release(entry)
             self._observe_latency(entry.pending)
+
+    def _check_entry_deadline(self, entry: _Entry, where: str) -> None:
+        """Fast-fail an entry whose submit-time deadline already expired
+        BEFORE paying any execution: the slot frees immediately and the
+        submitter gets the same typed error a mid-query cancellation
+        raises."""
+        if entry.deadline_s is None or \
+                time.perf_counter() < entry.deadline_s:
+            return
+        from .context import deadline_cancel
+        waited_s = time.perf_counter() - entry.pending.submitted_s
+        deadline_cancel(entry.session, entry.pending.query_id, where,
+                        waited_s * 1000.0)
 
     def _sweep_trace(self, batch: List[_Entry]):
         """The shared sweep trace (telemetry/trace.py): ONE
@@ -347,8 +414,27 @@ class ServingFrontend:
             e.pending.batched = True
             e.pending.batch_size = len(batch)
             try:
-                result = e.ctx.run(self._execute_entry, e, sweep, i,
-                                   trace_parent)
+                self._check_entry_deadline(e, "serving.queue")
+                try:
+                    result = e.ctx.run(self._execute_entry, e, sweep, i,
+                                       trace_parent)
+                except BaseException as err:
+                    # Sweep-member degradation ladder (robustness
+                    # layer): one member's failure inside the shared
+                    # sweep must not poison its siblings OR itself —
+                    # re-execute the member standalone (no sweep). The
+                    # standalone rerun is the plain single-query path,
+                    # so a persistent error surfaces from it unchanged;
+                    # cancellations and disabled degradation skip the
+                    # rerun.
+                    from ..exceptions import QueryDeadlineError
+                    if isinstance(err, QueryDeadlineError) or not \
+                            e.session.hs_conf.robustness_degrade_enabled():
+                        raise
+                    from ..robustness import faults as _faults
+                    _faults.note(member_fallbacks=1)
+                    result = e.ctx.run(self._execute_entry, e, None, 0,
+                                       trace_parent)
                 e.pending._finish(result=result)
                 self._note(completed=1)
             except BaseException as err:
@@ -375,9 +461,9 @@ class ServingFrontend:
                        member: int, trace_parent=None):
         qc = QueryContext.for_session(
             entry.session, shared_cache=self.result_cache(),
-            client=entry.pending.client)
+            client=entry.pending.client, deadline_s=entry.deadline_s,
+            query_id=entry.pending.query_id)
         qc.trace_parent = trace_parent
-        entry.pending.query_id = qc.query_id
         entry.pending.context = qc
         with batcher.use_sweep(sweep, member):
             return entry.session.execute(entry.plan, context=qc)

@@ -66,11 +66,21 @@ class ProgramBank:
                     self.stage_evictions += 1
                     self.program_count -= len(shapes_seen)
                 with _trace.span(SN.BANK_COMPILE):
-                    fn = factory()
+                    fn, degraded = self._build(factory)
+                self.misses += 1
+                if degraded:
+                    # Bank-compile degradation ladder (robustness
+                    # layer): the wrapper that failed once is handed
+                    # back UNREGISTERED — this execution runs the
+                    # uncached eager path, and the next lookup tries
+                    # the bank again from scratch.
+                    if sp is not None:
+                        sp.attrs["hit"] = False
+                        sp.attrs["degraded"] = True
+                    return fn
                 # shape vector -> times this program was looked up again
                 # after registration (0 = registered, never reused yet).
                 self._stages[stage_key] = (fn, {shape_vec: 0})
-                self.misses += 1
                 self.program_count += 1
                 hit = False
             else:
@@ -90,6 +100,25 @@ class ProgramBank:
                 sp.attrs["hit"] = hit
         self._emit(stage_key, shape_vec, hit=hit, first_reuse=first_reuse)
         return fn
+
+    @staticmethod
+    def _build(factory: Callable[[], Callable]):
+        """Run the caller's wrapper factory behind the ``bank.compile``
+        fault point. A failure (injected or real) degrades to ONE
+        immediate rebuild whose result is returned UNCACHED — the eager
+        path — unless degradation is off (or the rebuild fails too, a
+        persistent error that must surface). Returns (fn, degraded)."""
+        from ..robustness import fault_names as _fltn
+        from ..robustness import faults as _faults
+        try:
+            _faults.fault_point(_fltn.BANK_COMPILE)
+            return factory(), False
+        except Exception:
+            if not _faults.degrade_enabled():
+                raise
+            fn = factory()  # persistent failures raise here, loudly
+            _faults.note(degraded_bank_compile=1)
+            return fn, True
 
     # ------------------------------------------------------------------
     # Observability.

@@ -1,4 +1,4 @@
-"""Two-tier, byte-budgeted query result cache.
+"""Three-tier, byte-budgeted query result cache.
 
 The serving-layer memo over `Session.execute`: executed results are kept
 keyed by :class:`fingerprint.ResultCacheKey` (canonical plan fingerprint +
@@ -12,28 +12,50 @@ Tiers (the HBM-residency design of execution/index_cache.py, extended):
   device  — the executed Table as-is (device-resident columns); LRU
             victims DEMOTE to the host tier instead of being dropped.
   host    — `Table.to_host()` copies (numpy-backed, HBM-free); LRU
-            victims here are evicted for good.
+            victims demote to the disk-spill tier when one is
+            configured, else are evicted for good.
+  spill   — optional (``serving.result_cache.spillDir``): length-framed
+            pickled host tables on disk up to ``spillBytes``; victims
+            here are gone. Read-back is CRASH-SAFE by contract: a
+            truncated or corrupt spill file is a MISS (entry evicted,
+            file deleted, ResultCacheMissEvent reason="spill-corrupt")
+            — never a propagated exception mid-query, never a wrong
+            answer (robustness layer; fault point
+            ``result_cache.spill_read`` proves it under injection).
 
 Admission is decided by the caller (execute_with_cache) from observed
 execution time + the optimized plan's input-byte estimate: results that
-are cheap to recompute are not worth residency.
+are cheap to recompute are not worth residency. A device_put failure on
+device-tier admission degrades the entry to the host tier (fault point
+``result_cache.device_put``) — residency is an optimization and must
+never fail the query that produced the result.
 
-Thread safety: one lock around both tiers — the serving pattern is many
-query threads sharing a session.
+Thread safety: one lock around all tiers — the serving pattern is many
+query threads sharing a session. Spill file reads/writes and
+device→host transfers happen OUTSIDE the lock.
 """
 
 from __future__ import annotations
 
+import os
+import pickle
 import threading
 import time
 from collections import OrderedDict
 from typing import Optional, Tuple
 
+from ..robustness import fault_names as _fltn
+from ..robustness import faults as _faults
 from .fingerprint import (ResultCacheKey, compute_key,
                           estimate_recompute_bytes, normalize)
 
 TIER_DEVICE = "device"
 TIER_HOST = "host"
+TIER_SPILL = "spill"
+
+# Sentinel: a spill file a concurrent drop/clear unlinked mid-probe —
+# a plain miss, never corruption (see _spill_read).
+_GONE = object()
 
 
 def _to_device(table):
@@ -47,6 +69,7 @@ def _to_device(table):
 
     from ..execution.columnar import Column
     from ..execution.columnar import Table as _Table
+    _faults.fault_point(_fltn.RESULT_CACHE_DEVICE_PUT)
     if not any(isinstance(c.data, np.ndarray)
                for c in table.columns.values()):
         return table
@@ -73,28 +96,46 @@ def table_nbytes(table) -> int:
 
 
 class ResultCache:
-    def __init__(self, device_bytes: int, host_bytes: int, on_evict=None):
+    def __init__(self, device_bytes: int, host_bytes: int, on_evict=None,
+                 spill_dir: Optional[str] = None, spill_bytes: int = 0,
+                 on_spill_corrupt=None):
         self.device_bytes = device_bytes
         self.host_bytes = host_bytes
+        self.spill_dir = spill_dir or None
+        if self.spill_dir is not None:
+            try:
+                os.makedirs(self.spill_dir, exist_ok=True)
+            except OSError:
+                self.spill_dir = None  # unusable dir: run two-tier
+        self.spill_bytes = spill_bytes if self.spill_dir else 0
         # on_evict(tier, nbytes, demoted): observability hook; MAY be
         # called while the lock is held, so it must not reenter the
-        # cache.
+        # cache. on_spill_corrupt(nbytes): a corrupt/truncated spill
+        # entry was evicted and served as a miss.
         self._on_evict = on_evict
+        self._on_spill_corrupt = on_spill_corrupt
         self._lock = threading.Lock()
         self._device: "OrderedDict[ResultCacheKey, Tuple[object, int]]" = \
             OrderedDict()
         self._host: "OrderedDict[ResultCacheKey, Tuple[object, int]]" = \
             OrderedDict()
+        # key -> (file path, nbytes); the table lives on disk only.
+        self._spill: "OrderedDict[ResultCacheKey, Tuple[str, int]]" = \
+            OrderedDict()
         self._device_nbytes = 0
         self._host_nbytes = 0
+        self._spill_nbytes = 0
+        self._spill_seq = 0
         self.hits = 0
         self.device_hits = 0
         self.host_hits = 0
+        self.spill_hits = 0
         self.misses = 0
         self.admissions = 0
         self.rejections = 0
         self.demotions = 0
         self.evictions = 0
+        self.spill_corruptions = 0
 
     # ------------------------------------------------------------------
     # Lookup.
@@ -115,8 +156,56 @@ class ResultCache:
                 self.hits += 1
                 self.host_hits += 1
                 return entry[0], TIER_HOST
-            self.misses += 1
+            spilled = self._spill.get(key)
+            if spilled is None:
+                self.misses += 1
+                return None
+            self._spill.move_to_end(key)
+            path, nbytes = spilled
+        # Disk read-back OUTSIDE the lock (a multi-MB read must not
+        # stall concurrent probes). Corruption/truncation — torn by a
+        # crash mid-spill, bit-rotted — is a MISS: evict the entry,
+        # drop the file, recompute downstream. A file a CONCURRENT
+        # drop/clear unlinked mid-probe is a plain miss, NOT corruption
+        # (the counter must stay a real disk-health signal).
+        table = self._spill_read(path)
+        if table is None or table is _GONE:
+            with self._lock:
+                old = self._spill.pop(key, None)
+                if old is not None:
+                    self._spill_nbytes -= old[1]
+                self.misses += 1
+                # Only the thread that actually evicted the entry
+                # counts the corruption — concurrent probes of one
+                # corrupt file must not inflate the disk-health signal.
+                corrupt = table is None and old is not None
+                if corrupt:
+                    self.spill_corruptions += 1
+            if corrupt:
+                self._unlink(path)
+                _faults.note(spill_corruptions=1)
+                if self._on_spill_corrupt is not None:
+                    self._on_spill_corrupt(nbytes)
             return None
+        # Promote back to the host tier: a hot spilled entry must not
+        # pay disk + deserialize on every repeat hit once host pressure
+        # subsides (the device→host demotion path, in reverse). Host
+        # victims the promotion displaces spill as usual.
+        host_victims = []
+        with self._lock:
+            self.hits += 1
+            self.spill_hits += 1
+            still = self._spill.pop(key, None)
+            if still is not None:
+                self._spill_nbytes -= still[1]
+                if key not in self._device and key not in self._host:
+                    self._host[key] = (table, still[1])
+                    self._host_nbytes += still[1]
+                    host_victims = self._pop_host_victims()
+        if still is not None:
+            self._unlink(path)
+        self._spill_store(host_victims)
+        return table, TIER_SPILL
 
     def peek(self, key: ResultCacheKey) -> Optional[str]:
         """Tier holding ``key`` (no counter/LRU effect) — explain's probe."""
@@ -125,6 +214,8 @@ class ResultCache:
                 return TIER_DEVICE
             if key in self._host:
                 return TIER_HOST
+            if key in self._spill:
+                return TIER_SPILL
             return None
 
     # ------------------------------------------------------------------
@@ -135,20 +226,30 @@ class ResultCache:
         """Store an admitted result; returns the tier it landed in, or
         None when it exceeds every budget (too large to hold).
 
-        Device→host transfers (``to_host``) happen OUTSIDE the lock —
-        one demotion cascade must not stall every concurrent get()
-        probe behind a multi-hundred-MB device fetch."""
+        Device→host transfers (``to_host``) and spill file writes happen
+        OUTSIDE the lock — one demotion cascade must not stall every
+        concurrent get() probe behind a multi-hundred-MB device fetch.
+        A device_put failure (fault point ``result_cache.device_put``)
+        degrades the entry to the host tier: residency must never fail
+        the query that computed the result."""
         nbytes = table_nbytes(table)
         if nbytes <= self.device_bytes:
-            table = _to_device(table)  # outside the lock
-            with self._lock:
-                self._drop(key)
-                self._device[key] = (table, nbytes)
-                self._device_nbytes += nbytes
-                self.admissions += 1
-                victims = self._pop_device_victims()
-            self._demote(victims)
-            return TIER_DEVICE
+            try:
+                dev_table = _to_device(table)  # outside the lock
+            except Exception:
+                if not _faults.degrade_enabled():
+                    raise  # fail-loud debugging mode
+                _faults.note(degraded_device_put=1)
+                dev_table = None  # degrade to the host tier below
+            if dev_table is not None:
+                with self._lock:
+                    self._drop(key)
+                    self._device[key] = (dev_table, nbytes)
+                    self._device_nbytes += nbytes
+                    self.admissions += 1
+                    victims = self._pop_device_victims()
+                self._demote(victims)
+                return TIER_DEVICE
         if nbytes <= self.host_bytes:
             host_copy = table.to_host()  # outside the lock
             with self._lock:
@@ -156,7 +257,8 @@ class ResultCache:
                 self._host[key] = (host_copy, nbytes)
                 self._host_nbytes += nbytes
                 self.admissions += 1
-                self._evict_host_overflow()
+                host_victims = self._pop_host_victims()
+            self._spill_store(host_victims)
             return TIER_HOST
         return None
 
@@ -171,6 +273,10 @@ class ResultCache:
         old = self._host.pop(key, None)
         if old is not None:
             self._host_nbytes -= old[1]
+        old = self._spill.pop(key, None)
+        if old is not None:
+            self._spill_nbytes -= old[1]
+            self._unlink(old[0])
 
     def _pop_device_victims(self) -> list:
         """Under the lock: pop LRU device entries past the budget.
@@ -193,6 +299,7 @@ class ResultCache:
         return victims
 
     def _demote(self, victims: list) -> None:
+        spill_victims = []
         for vk, vt, vn in victims:
             host_copy = vt.to_host()  # outside the lock
             with self._lock:
@@ -200,25 +307,135 @@ class ResultCache:
                     continue  # re-admitted during the handoff; keep that
                 self._host[vk] = (host_copy, vn)
                 self._host_nbytes += vn
-                self._evict_host_overflow()
+                spill_victims.extend(self._pop_host_victims())
             if self._on_evict is not None:
                 self._on_evict(TIER_DEVICE, vn, True)
+        self._spill_store(spill_victims)
 
-    def _evict_host_overflow(self) -> None:
-        # Caller holds the lock. Host victims are gone for good.
+    def _pop_host_victims(self) -> list:
+        """Under the lock: pop LRU host entries past the budget. With a
+        spill tier configured, victims that fit its budget return for
+        out-of-lock spilling; otherwise they are evicted for good."""
+        victims = []
         while self._host_nbytes > self.host_bytes and len(self._host) > 1:
-            _, (_, vn) = self._host.popitem(last=False)
+            vk, (vt, vn) = self._host.popitem(last=False)
             self._host_nbytes -= vn
-            self.evictions += 1
+            if self.spill_dir is not None and vn <= self.spill_bytes:
+                # Counted as a demotion only once the spill WRITE lands
+                # (_spill_store) — a failed write is an eviction, and
+                # counting both would skew the stats.
+                victims.append((vk, vt, vn))
+            else:
+                self.evictions += 1
+                if self._on_evict is not None:
+                    self._on_evict(TIER_HOST, vn, False)
+        return victims
+
+    # ------------------------------------------------------------------
+    # Disk-spill tier.
+    # ------------------------------------------------------------------
+
+    def _spill_path(self) -> str:
+        with self._lock:
+            self._spill_seq += 1
+            seq = self._spill_seq
+        return os.path.join(self.spill_dir, f"rc-{os.getpid()}-{seq}.bin")
+
+    def _spill_store(self, victims: list) -> None:
+        """Write host-tier victims to disk (outside the lock). A write
+        failure (disk full, unwritable dir) evicts the victim for good —
+        spilling is an optimization and must never fail the query."""
+        for vk, vt, vn in victims:
+            path = self._spill_path()
+            try:
+                payload = pickle.dumps(vt, protocol=pickle.HIGHEST_PROTOCOL)
+                tmp = path + ".tmp"
+                with open(tmp, "wb") as f:
+                    # Length framing: read-back can tell a torn tail
+                    # (crash mid-spill) from a complete payload.
+                    f.write(len(payload).to_bytes(8, "big"))
+                    f.write(payload)
+                os.replace(tmp, path)
+            except Exception:
+                with self._lock:
+                    self.evictions += 1
+                if self._on_evict is not None:
+                    self._on_evict(TIER_HOST, vn, False)
+                continue
+            overflow = []
+            with self._lock:
+                if vk in self._device or vk in self._host \
+                        or vk in self._spill:
+                    stale = True  # re-admitted during the handoff
+                else:
+                    stale = False
+                    self._spill[vk] = (path, vn)
+                    self._spill_nbytes += vn
+                    # The write already landed (it precedes this lock):
+                    # the demotion counts here, in the same acquisition.
+                    self.demotions += 1
+                    while self._spill_nbytes > self.spill_bytes \
+                            and len(self._spill) > 1:
+                        _, (op, on) = self._spill.popitem(last=False)
+                        self._spill_nbytes -= on
+                        self.evictions += 1
+                        overflow.append((op, on))
+            if stale:
+                self._unlink(path)
+                continue
+            for op, on in overflow:
+                self._unlink(op)
+                if self._on_evict is not None:
+                    self._on_evict(TIER_SPILL, on, False)
             if self._on_evict is not None:
-                self._on_evict(TIER_HOST, vn, False)
+                self._on_evict(TIER_HOST, vn, True)
+
+    def _spill_read(self, path: str):
+        """Deserialize one spilled entry; None on ANY corruption-shaped
+        failure — the crash-safe read-back contract (fault point
+        ``result_cache.spill_read`` injects failures here). ``_GONE``
+        when the file vanished (a concurrent drop/clear won the race):
+        a miss, but never counted as corruption."""
+        try:
+            _faults.fault_point(_fltn.RESULT_CACHE_SPILL_READ)
+        except Exception:
+            return None
+        try:
+            with open(path, "rb") as f:
+                header = f.read(8)
+                if len(header) != 8:
+                    return None
+                expected = int.from_bytes(header, "big")
+                payload = f.read()
+        except FileNotFoundError:
+            return _GONE
+        except Exception:
+            return None
+        try:
+            if len(payload) != expected:
+                return None  # torn tail: crash mid-spill
+            return pickle.loads(payload)
+        except Exception:
+            return None
+
+    @staticmethod
+    def _unlink(path: str) -> None:
+        try:
+            os.unlink(path)
+        except OSError:
+            pass
 
     def clear(self) -> None:
         with self._lock:
             self._device.clear()
             self._host.clear()
+            spilled = list(self._spill.values())
+            self._spill.clear()
             self._device_nbytes = 0
             self._host_nbytes = 0
+            self._spill_nbytes = 0
+        for path, _ in spilled:
+            self._unlink(path)
 
     # ------------------------------------------------------------------
     # Observability.
@@ -230,15 +447,19 @@ class ResultCache:
                 "hits": self.hits,
                 "device_hits": self.device_hits,
                 "host_hits": self.host_hits,
+                "spill_hits": self.spill_hits,
                 "misses": self.misses,
                 "admissions": self.admissions,
                 "rejections": self.rejections,
                 "demotions": self.demotions,
                 "evictions": self.evictions,
+                "spill_corruptions": self.spill_corruptions,
                 "device_entries": len(self._device),
                 "host_entries": len(self._host),
+                "spill_entries": len(self._spill),
                 "device_nbytes": self._device_nbytes,
                 "host_nbytes": self._host_nbytes,
+                "spill_nbytes": self._spill_nbytes,
             }
 
 
@@ -258,8 +479,22 @@ def build_result_cache(session) -> Optional[ResultCache]:
                         f"{tier} tier" + (" (demoted)" if demoted else ""),
                 tier=tier, nbytes=nbytes, demoted=demoted))
 
+    def on_spill_corrupt(nbytes: int) -> None:
+        from ..telemetry.events import ResultCacheMissEvent
+        from ..telemetry.logging import get_logger
+        get_logger(conf.event_logger_class()).log_event(
+            ResultCacheMissEvent(
+                message=("corrupt/truncated spill entry evicted; "
+                         "serving as a miss"),
+                tier=TIER_SPILL, nbytes=nbytes, reason="spill-corrupt"))
+
+    # The constructor owns spill-dir creation and the unusable-dir
+    # fallback (run two-tier); pass the raw conf value through.
     return ResultCache(conf.result_cache_device_bytes(),
-                       conf.result_cache_host_bytes(), on_evict)
+                       conf.result_cache_host_bytes(), on_evict,
+                       spill_dir=conf.result_cache_spill_dir() or None,
+                       spill_bytes=conf.result_cache_spill_bytes(),
+                       on_spill_corrupt=on_spill_corrupt)
 
 
 def execute_with_cache(session, cache: ResultCache, plan):

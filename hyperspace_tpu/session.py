@@ -269,15 +269,19 @@ class Session:
         thread many concurrent queries (possibly sharing a process-wide
         cache) through shared worker threads. Callers that pass no
         context get a session-scoped one per call."""
+        from .robustness import faults as _faults
         from .serving.context import QueryContext
         ctx = context if context is not None \
             else QueryContext.for_session(self)
         # The trace root (telemetry/trace.py): a no-op unless
         # telemetry.trace.enabled is set on this session or the serving
         # frontend handed the context a shared sweep trace; the opt-in
-        # jax.profiler hook brackets the first query after arming.
-        with ctx.activate(), _trace.maybe_profile(self), \
-                _trace.query_trace(self, ctx):
+        # jax.profiler hook brackets the first query after arming. The
+        # fault scope (robustness/faults.py) arms this session's
+        # robustness.faults.* conf for exactly this execution — skipped
+        # entirely (no contextvar write) while nothing is armed.
+        with ctx.activate(), _faults.scope_for(self.hs_conf), \
+                _trace.maybe_profile(self), _trace.query_trace(self, ctx):
             if not ctx.capture:
                 return self._execute_uncaptured(plan, ctx)
             # Advisor workload capture (advisor/workload.py): time

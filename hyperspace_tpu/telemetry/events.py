@@ -170,7 +170,12 @@ class ResultCacheHitEvent(ResultCacheEvent):
 
 @dataclass
 class ResultCacheMissEvent(ResultCacheEvent):
-    pass
+    """``reason`` distinguishes robustness misses ("spill-corrupt" — a
+    truncated/corrupt spill file was evicted and served as a miss) from
+    plain cold misses ("", byte-compatible with the pre-robustness
+    event stream)."""
+
+    reason: str = ""
 
 
 @dataclass
@@ -334,6 +339,32 @@ class ProgramBankMissEvent(ProgramBankEvent):
 class ProgramBankHitEvent(ProgramBankEvent):
     """A program's FIRST reuse (later reuses only bump the counters —
     per-lookup events would swamp the log on a warm serving path)."""
+
+
+@dataclass
+class RetryEvent(HyperspaceEvent):
+    """Emitted per retried sequence (robustness/retry.py — pooled
+    reader tasks, op-log store writes): how many attempts ran, whether
+    the sequence recovered, and the ORIGINAL transient error (the one
+    surfaced on exhaustion). Sequences that succeed first try are
+    silent — a healthy system emits no retry telemetry."""
+
+    where: str = ""
+    attempts: int = 0
+    succeeded: bool = False
+    error: str = ""
+
+
+@dataclass
+class QueryCancelledEvent(HyperspaceEvent):
+    """Emitted ONCE per query cancelled at a cooperative deadline check
+    (serving/context.check_deadline): which boundary the cancellation
+    struck and how long the query had been running. The caller sees the
+    typed QueryDeadlineError; the serving worker slot is freed."""
+
+    query_id: int = 0
+    where: str = ""
+    elapsed_ms: float = 0.0
 
 
 @dataclass

@@ -360,6 +360,91 @@ def span_site_violations(tree: ast.AST, names: dict) -> list:
     return out
 
 
+# Fault-point discipline (the robustness layer's ratchet, mirroring the
+# span gate): every ``faults.fault_point(...)`` site in package code
+# must name its point via a constant from the frozen
+# robustness/fault_names.py registry (or a string literal registered
+# there), AND every registered name must be referenced under tests/ —
+# an uninjected fault point is unverified robustness.
+FAULT_NAMES_FILE = "hyperspace_tpu/robustness/fault_names.py"
+FAULT_MODULE_ALIASES = ("faults", "_faults")
+FAULT_NAME_ALIASES = ("fault_names", "_fn", "_fltn", "FN")
+
+
+def fault_site_violations(tree: ast.AST, names: dict) -> list:
+    """(line, detail) of fault_point() calls whose name argument is
+    neither a fault_names constant nor a registered literal."""
+    values = set(names.values())
+    out = []
+    for node in ast.walk(tree):
+        is_attr_call = (isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Attribute)
+                        and node.func.attr == "fault_point"
+                        and isinstance(node.func.value, ast.Name)
+                        and node.func.value.id in FAULT_MODULE_ALIASES)
+        is_name_call = (isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Name)
+                        and node.func.id == "fault_point")
+        if not (is_attr_call or is_name_call):
+            continue
+        if not node.args:
+            out.append((node.lineno, "no fault-point name argument"))
+            continue
+        arg = node.args[0]
+        if isinstance(arg, ast.Attribute) \
+                and isinstance(arg.value, ast.Name) \
+                and arg.value.id in FAULT_NAME_ALIASES \
+                and arg.attr in names:
+            continue
+        if isinstance(arg, ast.Constant) and arg.value in values:
+            continue
+        out.append((node.lineno, "fault-point name must come from "
+                    "robustness/fault_names.py"))
+    return out
+
+
+# Exception-swallowing discipline (robustness ratchet): a bare
+# ``except:`` anywhere, or an ``except BaseException: pass`` that
+# swallows silently, hides crashes the robustness layer exists to
+# surface (cancellation, injected faults, worker death). The allowlist
+# is FROZEN and EMPTY — the tree was clean when the gate landed;
+# narrow the handler or handle the error instead.
+EXCEPT_SWALLOW_ALLOWLIST = frozenset()
+
+
+def _names_in_except_type(node) -> set:
+    if node is None:
+        return set()
+    types = node.elts if isinstance(node, ast.Tuple) else [node]
+    out = set()
+    for t in types:
+        if isinstance(t, ast.Name):
+            out.add(t.id)
+        elif isinstance(t, ast.Attribute):
+            out.add(t.attr)
+    return out
+
+
+def except_swallow_sites(tree: ast.AST) -> list:
+    """(line, detail) of forbidden handlers: bare ``except:`` (any
+    body), and ``except BaseException`` whose body is only ``pass``."""
+    out = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.ExceptHandler):
+            continue
+        if node.type is None:
+            out.append((node.lineno,
+                        "bare 'except:'; name the exception classes"))
+            continue
+        body_is_pass = all(isinstance(s, ast.Pass) for s in node.body)
+        if body_is_pass and "BaseException" in _names_in_except_type(
+                node.type):
+            out.append((node.lineno,
+                        "'except BaseException: pass' swallows "
+                        "cancellation and crashes silently"))
+    return out
+
+
 # Telemetry-coverage discipline: every event class defined in
 # telemetry/events.py must be referenced somewhere under tests/ — an
 # event no test ever observes is unverified observability (the
@@ -413,6 +498,8 @@ def main() -> int:
         config_doc_text = f.read()
     with open(os.path.join(ROOT, SPAN_NAMES_FILE), encoding="utf-8") as f:
         span_names = span_name_constants(ast.parse(f.read()))
+    with open(os.path.join(ROOT, FAULT_NAMES_FILE), encoding="utf-8") as f:
+        fault_names = span_name_constants(ast.parse(f.read()))
     event_classes: list = []
     tests_text_parts: list = []
     for path in iter_sources():
@@ -481,6 +568,16 @@ def main() -> int:
                 problems.append(
                     f"{rel}:{line}: {detail} (frozen registry; free-form "
                     "span strings are forbidden)")
+        if any(rel.startswith(d + os.sep) for d in PACKAGE_DIRS):
+            for line, detail in fault_site_violations(tree, fault_names):
+                problems.append(
+                    f"{rel}:{line}: {detail} (frozen registry; free-form "
+                    "fault-point strings are forbidden)")
+        if any(rel.startswith(d + os.sep) for d in PACKAGE_DIRS) \
+                and rel.replace(os.sep, "/") not in \
+                EXCEPT_SWALLOW_ALLOWLIST:
+            for line, detail in except_swallow_sites(tree):
+                problems.append(f"{rel}:{line}: {detail}")
         if any(rel.startswith(d + os.sep) for d in PACKAGE_DIRS) \
                 and rel.replace(os.sep, "/") not in THREAD_SITE_ALLOWLIST:
             for line in thread_sites(tree):
@@ -502,6 +599,13 @@ def main() -> int:
             problems.append(
                 f"{SPAN_NAMES_FILE}: span name '{value}' ({const}) is "
                 "never referenced under tests/; add a test observing it")
+    for const, value in sorted(fault_names.items()):
+        if const == "FAULT_NAMES":
+            continue
+        if value not in tests_text:
+            problems.append(
+                f"{FAULT_NAMES_FILE}: fault point '{value}' ({const}) is "
+                "never referenced under tests/; add a test injecting it")
     for p in problems:
         print(p)
     print(f"lint: {len(problems)} problem(s) across "

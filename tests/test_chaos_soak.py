@@ -1,0 +1,143 @@
+"""Chaos soak: concurrent serving under random fault injection.
+
+8 client threads hammer the serving frontend with a mixed TPC-H/TPC-DS
+workload across two sessions while ONE seeded fault registry injects
+transient read faults, scan/dispatch/compile errors, latency, spill
+corruption, and worker deaths at every query-path fault point — plus a
+few submissions carrying unmeetable deadlines. The robustness
+invariants under fire:
+
+- NO deadlock: every client thread joins, every future completes;
+- NO stranded worker slot: after drain the frontend reports zero queued
+  entries, zero active workers, zero in-flight bytes;
+- every submission ends in a byte-identical result (the ladders +
+  retries absorbed the fault) or a TYPED HyperspaceException
+  (InjectedFaultError / QueryDeadlineError / ...) — never a bare
+  exception, never a silent wrong answer.
+"""
+
+import threading
+
+import hyperspace_tpu as hst
+from hyperspace_tpu.exceptions import HyperspaceException
+from hyperspace_tpu.index.constants import IndexConstants
+from hyperspace_tpu.robustness import fault_names as FN
+from hyperspace_tpu.robustness import faults
+from hyperspace_tpu.robustness.faults import FaultRegistry
+from hyperspace_tpu.serving.constants import ServingConstants
+from hyperspace_tpu.serving.frontend import ServingFrontend
+
+SOAK_QUERIES = ["tpch_q1", "tpch_q3", "tpch_q6", "tpch_q12",
+                "tpcds_q1_like", "tpcds_q3_like", "tpcds_q42_like",
+                "tpch_q17"]
+
+# Every query-path fault point, armed probabilistically (seeded RNG —
+# the run replays deterministically up to thread scheduling). The
+# action-path points (log.*, action.op) are armed too but never hit:
+# the soak runs no index mutations.
+CHAOS_SPECS = {
+    FN.IO_POOLED_READ: "transient:p=0.05",
+    FN.IO_PREFETCH_PRODUCE: "error:p=0.01",
+    FN.SCAN_PARQUET_DECODE: "error:p=0.02",
+    FN.SPMD_DISPATCH: "error:p=0.1",
+    FN.SPMD_COMPILE: "error:p=0.05",
+    FN.BANK_COMPILE: "error:p=0.03",
+    FN.RESULT_CACHE_DEVICE_PUT: "error:p=0.2",
+    FN.RESULT_CACHE_SPILL_READ: "error:p=0.3",
+    FN.SERVING_WORKER: "error:p=0.08",
+    FN.LOG_WRITE: "error:p=0.5",
+    FN.LOG_STABLE: "error:p=0.5",
+    FN.ACTION_OP: "error:p=0.5",
+}
+
+
+def _session(tmp_path, spill_dir):
+    session = hst.Session(system_path=str(tmp_path / "indexes"))
+    session.conf.set(IndexConstants.INDEX_NUM_BUCKETS, 4)
+    # Shared result cache with a spill tier in the blast radius.
+    session.conf.set(ServingConstants.RESULT_CACHE_ENABLED, "true")
+    session.conf.set(ServingConstants.RESULT_CACHE_MIN_COMPUTE_SECONDS,
+                     "0")
+    session.conf.set(ServingConstants.RESULT_CACHE_SPILL_DIR, spill_dir)
+    return session
+
+
+def test_chaos_soak_no_deadlock_no_strand_typed_or_identical(tmp_path):
+    from goldstandard import tpc
+    root = str(tmp_path / "tpc")
+    spill_dir = str(tmp_path / "spill")
+    ref_session = _session(tmp_path, spill_dir)
+    dfs = tpc.register_tables(ref_session, root)
+    serial = {name: tpc.queries(dfs)[name].to_arrow()
+              for name in SOAK_QUERIES}
+
+    sessions = [_session(tmp_path, spill_dir) for _ in range(2)]
+    plans = []
+    for s in sessions:
+        qdict = tpc.queries(tpc.register_tables(s, root))
+        plans.append({n: qdict[n] for n in SOAK_QUERIES})
+    fe = ServingFrontend(sessions[0])
+
+    reg = FaultRegistry.from_conf_specs(CHAOS_SPECS, seed=1234)
+    results = {}
+    typed_errors = {}
+    hard_errors = []
+
+    def client(tid):
+        try:
+            for rnd in range(2):
+                for j, name in enumerate(SOAK_QUERIES):
+                    if (j + tid + rnd) % 2 == 0:
+                        continue
+                    q = plans[tid % 2][name]
+                    deadline = 1 if (tid, j, rnd) in ((3, 2, 0),
+                                                      (5, 6, 1)) else None
+                    with faults.scope(reg):
+                        try:
+                            p = fe.submit(q, client=f"c{tid}",
+                                          deadline_ms=deadline)
+                        except HyperspaceException as e:
+                            typed_errors[(tid, name, rnd)] = e
+                            continue
+                    try:
+                        table = p.result(timeout=300)
+                    except HyperspaceException as e:
+                        typed_errors[(tid, name, rnd)] = e
+                        continue
+                    results[(tid, name, rnd)] = table.to_arrow()
+        except BaseException as e:  # pragma: no cover
+            hard_errors.append((tid, repr(e)))
+
+    threads = [threading.Thread(target=client, args=(t,))
+               for t in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=900)
+        assert not t.is_alive(), "chaos client hung (deadlock?)"
+
+    # Every failure was a TYPED framework error — a bare Exception (or
+    # a stranded future's TimeoutError) lands in hard_errors.
+    assert not hard_errors, hard_errors
+    assert all(isinstance(e, HyperspaceException)
+               for e in typed_errors.values())
+    # Submissions all terminated, and the fault mix actually bit AND
+    # was partly absorbed (results exist on both sides).
+    total = len(results) + len(typed_errors)
+    assert total == 8 * len(SOAK_QUERIES)  # 2 rounds x half the mix
+    assert results, "chaos killed every query — ladders absorbed nothing"
+
+    # Absorbed-or-typed is not enough: absorbed must mean IDENTICAL.
+    for (tid, name, rnd), table in results.items():
+        assert table.equals(serial[name]), \
+            f"thread {tid} round {rnd} query {name} diverged under chaos"
+
+    # No stranded slots or leaked admission budget.
+    fe.drain(timeout=120)
+    st = fe.stats()
+    assert st["queued"] == 0
+    assert st["active_workers"] == 0
+    assert st["inflight_bytes"] == 0
+    # The chaos actually exercised the machinery.
+    s = faults.stats()
+    assert s["injected"] > 0

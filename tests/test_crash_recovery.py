@@ -1,0 +1,234 @@
+"""Crash-recovery harness: kill -9 a child mid-action, recover, verify.
+
+THE robustness acceptance (ISSUE r11): a subprocess running a real
+create / refresh / optimize / vacuum is SIGKILL'd at an injected fault
+point inside the op-log protocol (``kill`` specs on the frozen fault
+registry — robustness/faults.py delivers a genuine unhandleable
+``kill -9`` at the exact boundary), then a fresh session must:
+
+- land its recovery scan on the latest STABLE log entry (the backward
+  scan survives a stale/missing latestStable cache);
+- roll orphaned transient states (CREATING/REFRESHING/OPTIMIZING/
+  VACUUMING) back via ``Hyperspace.recover()`` (the protocol's own
+  CancelAction underneath);
+- vacuum partial index data versions no committed entry references;
+- answer queries byte-identically to an uncrashed lake (index-enabled
+  answers == plain-scan ground truth over the same files);
+- complete the interrupted action successfully afterwards.
+
+Crash positions per action: ``log.write nth=1`` (die before ANY
+protocol write — lake untouched), ``action.op`` (transient state
+committed, no data), ``log.write nth=2`` (op done, final entry never
+written — the canonical mid-action wreck), ``log.stable`` (final entry
+committed, latestStable cache stale).
+"""
+
+import glob
+import os
+import signal
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+import hyperspace_tpu as hst
+from hyperspace_tpu.api import Hyperspace
+from hyperspace_tpu.index.constants import (IndexConstants, STABLE_STATES,
+                                            States)
+from hyperspace_tpu.index.log_manager import IndexLogManager
+from hyperspace_tpu.plan.expr import col
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# The child driver: builds the lake up to the target action with faults
+# DISARMED, then arms the kill spec and runs the action that dies.
+_CHILD = textwrap.dedent("""
+    import os, sys
+    import numpy as np
+    import pandas as pd
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    mode, point, spec, data_dir, sys_dir = sys.argv[1:6]
+
+    import hyperspace_tpu as hst
+    from hyperspace_tpu.api import Hyperspace, IndexConfig
+
+    session = hst.Session(system_path=sys_dir)
+    session.conf.set("hyperspace.index.numBuckets", 4)
+    session.conf.set("hyperspace.index.lineage.enabled", "true")
+    session.conf.set("hyperspace.tpu.distributed.enabled", "false")
+    hs = Hyperspace(session)
+
+    def arm():
+        session.conf.set(
+            "hyperspace.tpu.robustness.faults." + point, spec)
+
+    def append_file(tag):
+        rng = np.random.default_rng(5)
+        t = pa.table({
+            "k": pa.array(rng.integers(0, 40, 500).astype(np.int64)),
+            "v": pa.array(rng.integers(0, 9, 500).astype(np.int64))})
+        pq.write_table(t, os.path.join(data_dir, tag + ".parquet"))
+
+    t = session.read.parquet(data_dir)
+    cfg = IndexConfig("cx", ["k"], ["v"])
+    if mode == "create":
+        arm()
+        hs.create_index(t, cfg)
+    elif mode == "refresh":
+        hs.create_index(t, cfg)
+        append_file("extra")
+        arm()
+        hs.refresh_index("cx", "incremental")
+    elif mode == "optimize":
+        hs.create_index(t, cfg)
+        append_file("extra")
+        hs.refresh_index("cx", "incremental")
+        arm()
+        hs.optimize_index("cx", "full")
+    elif mode == "vacuum":
+        hs.create_index(t, cfg)
+        hs.delete_index("cx")
+        arm()
+        hs.vacuum_index("cx")
+    print("CHILD-SURVIVED")  # a kill spec must never let us get here
+""")
+
+# (action, fault point, kill spec, expected latest-log state right
+# after the crash; None = the protocol never wrote anything).
+CASES = [
+    ("create", "log.write", "kill:nth=1", None),
+    ("create", "action.op", "kill:nth=1", States.CREATING),
+    ("create", "log.write", "kill:nth=2", States.CREATING),
+    ("create", "log.stable", "kill:nth=1", States.ACTIVE),
+    ("refresh", "log.write", "kill:nth=2", States.REFRESHING),
+    ("refresh", "log.stable", "kill:nth=1", States.ACTIVE),
+    ("optimize", "log.write", "kill:nth=2", States.OPTIMIZING),
+    ("optimize", "log.stable", "kill:nth=1", States.ACTIVE),
+    ("vacuum", "log.write", "kill:nth=2", States.VACUUMING),
+    ("vacuum", "log.stable", "kill:nth=1", States.DOESNOTEXIST),
+]
+
+
+def _write_data(d):
+    rng = np.random.default_rng(17)
+    df = pd.DataFrame({
+        "k": rng.integers(0, 40, 2000).astype(np.int64),
+        "v": rng.integers(0, 9, 2000).astype(np.int64)})
+    os.makedirs(d, exist_ok=True)
+    pq.write_table(pa.Table.from_pandas(df), os.path.join(d, "p0.parquet"))
+
+
+def _run_child(tmp_path, mode, point, spec):
+    script = str(tmp_path / "child.py")
+    with open(script, "w") as f:
+        f.write(_CHILD)
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+    env["PYTHONPATH"] = ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    return subprocess.run(
+        [sys.executable, script, mode, point, spec,
+         str(tmp_path / "data"), str(tmp_path / "indexes")],
+        env=env, capture_output=True, text=True, timeout=420, cwd=ROOT)
+
+
+def _session(tmp_path):
+    session = hst.Session(system_path=str(tmp_path / "indexes"))
+    session.conf.set(IndexConstants.INDEX_NUM_BUCKETS, 4)
+    session.conf.set(IndexConstants.INDEX_LINEAGE_ENABLED, "true")
+    session.conf.set(IndexConstants.TPU_DISTRIBUTED_ENABLED, "false")
+    return session
+
+
+@pytest.mark.parametrize("mode,point,spec,crashed_state", CASES)
+def test_kill9_then_recover_then_serve(tmp_path, mode, point, spec,
+                                       crashed_state):
+    _write_data(str(tmp_path / "data"))
+    proc = _run_child(tmp_path, mode, point, spec)
+
+    # The child died by SIGKILL at the fault point — not by finishing,
+    # not by a python exception.
+    assert proc.returncode == -signal.SIGKILL, \
+        f"rc={proc.returncode}\nstdout:{proc.stdout}\nstderr:{proc.stderr}"
+    assert "CHILD-SURVIVED" not in proc.stdout
+
+    idx_path = os.path.join(str(tmp_path / "indexes"), "cx")
+    mgr = IndexLogManager(idx_path)
+    latest = mgr.get_latest_log()
+    if crashed_state is None:
+        assert latest is None  # the kill preceded every protocol write
+    else:
+        assert latest.state == crashed_state
+
+    # The recovery scan lands on the latest stable entry even when the
+    # crash tore the latestStable cache window.
+    stable = mgr.get_latest_stable_log()
+    if stable is not None:
+        assert stable.state in STABLE_STATES
+
+    vdirs_before = {int(os.path.basename(p).split("=")[1])
+                    for p in glob.glob(os.path.join(idx_path, "v__=*"))}
+
+    session = _session(tmp_path)
+    hs = Hyperspace(session)
+    summary = hs.recover()
+    assert not summary["errors"], summary
+
+    # Transient wrecks rolled back; stable crash points untouched.
+    if crashed_state is not None and crashed_state not in STABLE_STATES:
+        assert summary["cancelled"] == ["cx"]
+        recovered = IndexLogManager(idx_path).get_latest_log()
+        assert recovered.state in STABLE_STATES
+    else:
+        assert summary["cancelled"] == []
+
+    # Partial data versions vacuumed: exactly the unreferenced dirs are
+    # gone, and a second sweep is a no-op (the lake is clean).
+    vacuumed = set(summary["vacuumed"].get("cx", []))
+    vdirs_after = {int(os.path.basename(p).split("=")[1])
+                   for p in glob.glob(os.path.join(idx_path, "v__=*"))}
+    assert vdirs_after == vdirs_before - vacuumed
+    again = hs.recover()
+    assert not again["cancelled"] and not again["vacuumed"], again
+
+    # Byte-identical serving: index-enabled answers == plain-scan ground
+    # truth over the same files (what an uncrashed lake answers).
+    t = session.read.parquet(str(tmp_path / "data"))
+    q = t.filter(col("k") == 7).select("k", "v")
+    session.enable_hyperspace()
+    a = q.to_pandas().sort_values(["k", "v"]).reset_index(drop=True)
+    session.disable_hyperspace()
+    b = q.to_pandas().sort_values(["k", "v"]).reset_index(drop=True)
+    pd.testing.assert_frame_equal(a, b)
+
+    # The interrupted action completes on the recovered lake.
+    from hyperspace_tpu.api import IndexConfig
+    if mode == "create":
+        if IndexLogManager(idx_path).get_latest_stable_log() is None or \
+                IndexLogManager(idx_path).get_latest_stable_log().state \
+                != States.ACTIVE:
+            hs.create_index(t, IndexConfig("cx", ["k"], ["v"]))
+        assert IndexLogManager(idx_path).get_latest_stable_log().state \
+            == States.ACTIVE
+    elif mode == "refresh":
+        hs.refresh_index("cx", "incremental")
+        assert IndexLogManager(idx_path).get_latest_stable_log().state \
+            == States.ACTIVE
+    elif mode == "optimize":
+        hs.optimize_index("cx", "full")
+        assert IndexLogManager(idx_path).get_latest_stable_log().state \
+            == States.ACTIVE
+    elif mode == "vacuum":
+        state = IndexLogManager(idx_path).get_latest_stable_log().state
+        if state == States.DELETED:
+            hs.vacuum_index("cx")
+        assert IndexLogManager(idx_path).get_latest_stable_log().state \
+            == States.DOESNOTEXIST
+        assert not glob.glob(os.path.join(idx_path, "v__=*"))

@@ -1,0 +1,961 @@
+"""Robustness layer (robustness/{faults,retry,recovery}.py + the
+degradation ladders wired through io/executor/spmd/bank/cache/frontend).
+
+Covers: fault-spec parsing and registry semantics (nth/times/p, typed
+errors, latency), the disarmed-is-a-no-op contract (byte-identical
+results), retry with backoff for transient faults at pooled reads and
+op-log writes (RetryEvent, original-error surfacing), per-query
+deadlines + cooperative cancellation (conf and submit-time, queue
+fast-fail, freed slots, QueryCancelledEvent), and every
+graceful-degradation ladder proven under injection with byte-identical
+answers: SPMD dispatch/compile fault -> single-device, program-bank
+compile fault -> uncached eager, result-cache device_put fault -> host
+tier, corrupt spill read-back -> miss (never a wrong answer), sweep
+member fault -> per-member re-execution, worker death -> member
+release. Plus in-process crash recovery (rollback + orphan vacuum) and
+the new lint gates.
+"""
+
+import os
+import threading
+import time
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+import hyperspace_tpu as hst
+from hyperspace_tpu.api import Hyperspace, IndexConfig
+from hyperspace_tpu.exceptions import (HyperspaceException,
+                                       QueryDeadlineError)
+from hyperspace_tpu.index.constants import IndexConstants, States
+from hyperspace_tpu.plan.expr import col, sum_
+from hyperspace_tpu.robustness import fault_names as FN
+from hyperspace_tpu.robustness import faults, retry
+from hyperspace_tpu.robustness.constants import RobustnessConstants as RC
+from hyperspace_tpu.robustness.faults import (FaultRegistry, FaultSpec,
+                                              InjectedFaultError,
+                                              TransientInjectedFaultError)
+from hyperspace_tpu.serving.constants import ServingConstants
+from hyperspace_tpu.serving.frontend import ServingFrontend
+
+from conftest import capture_logger
+
+
+def _fkey(point: str) -> str:
+    return f"{RC.FAULTS_PREFIX}.{point}"
+
+
+def _write(d, n=4000, seed=7, files=3):
+    rng = np.random.default_rng(seed)
+    df = pd.DataFrame({
+        "k": rng.integers(0, 50, n).astype(np.int64),
+        "v": rng.integers(0, 9, n).astype(np.int64),
+    })
+    os.makedirs(str(d), exist_ok=True)
+    step = max(n // files, 1)
+    for i in range(files):
+        lo = i * step
+        hi = (i + 1) * step if i < files - 1 else n
+        pq.write_table(pa.Table.from_pandas(df.iloc[lo:hi]),
+                       os.path.join(str(d), f"p{i}.parquet"))
+    return df
+
+
+def _session(tmp_path, capture_events=False, **conf):
+    session = hst.Session(system_path=str(tmp_path / "indexes"))
+    session.conf.set(IndexConstants.INDEX_NUM_BUCKETS, 4)
+    if capture_events:
+        session.conf.set(IndexConstants.EVENT_LOGGER_CLASS,
+                         "tests.conftest.CaptureLogger")
+    for k, v in conf.items():
+        session.conf.set(k, v)
+    return session
+
+
+def _query(session, d):
+    return session.read.parquet(str(d)).filter(col("k") < 20) \
+        .group_by("k").agg(sum_(col("v")).alias("sv")).sort("k")
+
+
+# ---------------------------------------------------------------------------
+# Fault specs + registry semantics.
+# ---------------------------------------------------------------------------
+
+class TestFaultSpecs:
+    def test_frozen_registry_equality(self):
+        """The fault-point vocabulary, spelled out literally — THE
+        coverage reference the scripts/lint.py fault-discipline gate
+        checks registered names against (the span-registry precedent).
+        Growing the registry means growing this set AND injecting the
+        new point somewhere under tests/."""
+        assert FN.FAULT_NAMES == frozenset({
+            "io.pooled_read", "io.prefetch_produce",
+            "scan.parquet_decode", "spmd.dispatch", "spmd.compile",
+            "bank.compile", "result_cache.device_put",
+            "result_cache.spill_read", "log.write", "log.stable",
+            "action.op", "serving.worker",
+        })
+
+    def test_parse_kinds_and_options(self):
+        s = FaultSpec.parse(FN.SCAN_PARQUET_DECODE,
+                            "error:p=0.5,nth=3,times=2,exc=OSError")
+        assert (s.kind, s.p, s.nth, s.times, s.exc) == \
+            ("error", 0.5, 3, 2, OSError)
+        lat = FaultSpec.parse(FN.IO_POOLED_READ, "latency:ms=5")
+        assert lat.kind == "latency" and lat.ms == 5.0
+        assert FaultSpec.parse(FN.LOG_WRITE, "kill").kind == "kill"
+        assert FaultSpec.parse(FN.LOG_STABLE, "transient").kind \
+            == "transient"
+
+    def test_unknown_name_kind_option_raise(self):
+        with pytest.raises(HyperspaceException):
+            FaultSpec.parse("not.a.point", "error")
+        with pytest.raises(HyperspaceException):
+            FaultSpec.parse(FN.LOG_WRITE, "explode")
+        with pytest.raises(HyperspaceException):
+            FaultSpec.parse(FN.LOG_WRITE, "error:bogus=1")
+        with pytest.raises(HyperspaceException):
+            FaultSpec.parse(FN.LOG_WRITE, "error:exc=NoSuchError")
+
+    def test_registry_nth_and_times(self):
+        reg = FaultRegistry.from_conf_specs(
+            {FN.IO_POOLED_READ: "error:nth=2"})
+        reg.trigger(FN.IO_POOLED_READ)  # hit 1: silent
+        with pytest.raises(InjectedFaultError):
+            reg.trigger(FN.IO_POOLED_READ)  # hit 2: fires
+        reg.trigger(FN.IO_POOLED_READ)  # hit 3: silent again
+        reg = FaultRegistry.from_conf_specs(
+            {FN.IO_POOLED_READ: "transient:times=2"})
+        for _ in range(2):
+            with pytest.raises(TransientInjectedFaultError):
+                reg.trigger(FN.IO_POOLED_READ)
+        reg.trigger(FN.IO_POOLED_READ)  # budget exhausted: silent
+
+    def test_conf_armed_probability_varies_across_queries(self, tmp_path):
+        """p= specs must SAMPLE per query under conf arming, not replay
+        one RNG draw for every execute (which would make p=0.5 fire for
+        either all queries or none): each per-run scope derives its seed
+        from (conf seed, scope ordinal)."""
+        _write(tmp_path / "d", n=400, files=1)
+        session = _session(
+            tmp_path, **{_fkey(FN.SCAN_PARQUET_DECODE): "error:p=0.5"})
+        q = session.read.parquet(str(tmp_path / "d")).filter(col("k") < 5)
+        outcomes = []
+        for _ in range(20):
+            try:
+                q.to_arrow()
+                outcomes.append(True)
+            except InjectedFaultError:
+                outcomes.append(False)
+        assert any(outcomes) and not all(outcomes)
+
+    def test_registry_probability_deterministic_by_seed(self):
+        def fired(seed):
+            reg = FaultRegistry.from_conf_specs(
+                {FN.IO_POOLED_READ: "error:p=0.5"}, seed=seed)
+            out = []
+            for _ in range(20):
+                try:
+                    reg.trigger(FN.IO_POOLED_READ)
+                    out.append(False)
+                except InjectedFaultError:
+                    out.append(True)
+            return out
+
+        assert fired(7) == fired(7)
+        assert any(fired(7)) and not all(fired(7))
+
+    def test_unarmed_point_is_silent(self):
+        reg = FaultRegistry.from_conf_specs({FN.LOG_WRITE: "error"})
+        reg.trigger(FN.SPMD_DISPATCH)  # armed registry, different point
+        faults.fault_point(FN.SPMD_DISPATCH)  # no scope at all
+
+
+# ---------------------------------------------------------------------------
+# Injection through the engine + the disarmed no-op contract.
+# ---------------------------------------------------------------------------
+
+class TestFaultPoints:
+    def test_disarmed_byte_identical(self, tmp_path):
+        _write(tmp_path / "d")
+        session = _session(tmp_path)
+        q = _query(session, tmp_path / "d")
+        a = q.to_arrow()
+        assert faults.armed() is None
+        b = q.to_arrow()
+        assert a.equals(b)
+
+    def test_error_injection_is_typed(self, tmp_path):
+        _write(tmp_path / "d")
+        session = _session(
+            tmp_path, **{_fkey(FN.SCAN_PARQUET_DECODE): "error"})
+        q = _query(session, tmp_path / "d")
+        with pytest.raises(InjectedFaultError) as err:
+            q.to_arrow()
+        assert isinstance(err.value, HyperspaceException)
+        # Disarm: the same session recovers immediately (conf is live).
+        session.conf.unset(_fkey(FN.SCAN_PARQUET_DECODE))
+        assert q.to_arrow().num_rows > 0
+
+    def test_latency_injection_slows_not_breaks(self, tmp_path):
+        _write(tmp_path / "d", files=1)
+        session = _session(tmp_path)
+        q = _query(session, tmp_path / "d")
+        base = q.to_arrow()  # warm compiles
+        t0 = time.perf_counter()
+        base = q.to_arrow()
+        warm_s = time.perf_counter() - t0
+        session.conf.set(_fkey(FN.SCAN_PARQUET_DECODE), "latency:ms=120")
+        t0 = time.perf_counter()
+        slow = q.to_arrow()
+        slow_s = time.perf_counter() - t0
+        assert slow.equals(base)
+        assert slow_s >= warm_s + 0.1
+
+    def test_prefetch_producer_fault_surfaces_at_consumer(self):
+        from hyperspace_tpu.parallel import io as pio
+        reg = FaultRegistry.from_conf_specs(
+            {FN.IO_PREFETCH_PRODUCE: "error:nth=3"})
+        with faults.scope(reg):
+            it = pio.prefetch_iter(iter(range(10)), label="test")
+            got = []
+            with pytest.raises(InjectedFaultError):
+                for x in it:
+                    got.append(x)
+        assert got == [0, 1]  # items before the injected advance
+
+
+# ---------------------------------------------------------------------------
+# Retry with exponential backoff + jitter.
+# ---------------------------------------------------------------------------
+
+class TestRetry:
+    def test_transient_recovers_and_counts(self):
+        calls = {"n": 0}
+
+        def flaky():
+            calls["n"] += 1
+            if calls["n"] < 3:
+                raise OSError("flaky mount")
+            return "ok"
+
+        before = faults.stats()["retries"]
+        pol = retry.RetryPolicy(max_attempts=3, base_ms=0.1)
+        assert retry.call(flaky, where="unit", policy=pol) == "ok"
+        assert calls["n"] == 3
+        assert faults.stats()["retries"] == before + 2
+
+    def test_deterministic_oserrors_not_retried(self):
+        """FileNotFoundError/PermissionError-class OSErrors fail the
+        same way every attempt — they must surface immediately, not
+        after a backoff ladder that pollutes the retry telemetry."""
+        calls = {"n": 0}
+
+        def missing():
+            calls["n"] += 1
+            raise FileNotFoundError("gone for good")
+
+        with pytest.raises(FileNotFoundError):
+            retry.call(missing, where="unit",
+                       policy=retry.RetryPolicy(3, 0.1))
+        assert calls["n"] == 1
+
+    def test_non_transient_not_retried(self):
+        calls = {"n": 0}
+
+        def broken():
+            calls["n"] += 1
+            raise ValueError("deterministic")
+
+        with pytest.raises(ValueError):
+            retry.call(broken, where="unit",
+                       policy=retry.RetryPolicy(3, 0.1))
+        assert calls["n"] == 1
+
+    def test_exhaustion_surfaces_original_error(self):
+        errs = [OSError("first"), OSError("second"), OSError("third")]
+
+        def always():
+            raise errs.pop(0)
+
+        with pytest.raises(OSError) as err:
+            retry.call(always, where="unit",
+                       policy=retry.RetryPolicy(3, 0.1))
+        assert "first" in str(err.value)
+
+    def test_pooled_read_retry_end_to_end(self, tmp_path):
+        """Transient faults inside pooled reader tasks are absorbed by
+        the retry (ordered gather: results byte-identical), with a
+        RetryEvent per recovered sequence."""
+        _write(tmp_path / "d")
+        session = _session(tmp_path, capture_events=True)
+        q = _query(session, tmp_path / "d")
+        base = q.to_arrow()
+        sink = capture_logger()
+        n_before = len(sink.events)
+        session.conf.set(_fkey(FN.IO_POOLED_READ), "transient:times=2")
+        got = q.to_arrow()
+        assert got.equals(base)
+        evs = [e for e in sink.events[n_before:]
+               if type(e).__name__ == "RetryEvent"]
+        assert evs and all(e.succeeded for e in evs)
+        assert all(e.where == "io.pooled_read" for e in evs)
+        assert any("TransientInjectedFaultError" in e.error for e in evs)
+
+    def test_pooled_read_retry_exhaustion(self, tmp_path):
+        _write(tmp_path / "d")
+        session = _session(tmp_path)
+        session.conf.set(RC.RETRY_MAX_ATTEMPTS, "2")
+        session.conf.set(RC.RETRY_BASE_MS, "1")
+        session.conf.set(_fkey(FN.IO_POOLED_READ), "transient")
+        with pytest.raises(TransientInjectedFaultError):
+            _query(session, tmp_path / "d").to_arrow()
+
+    def test_oplog_store_write_retry(self, tmp_path):
+        """A flaky LogStore (OSError on the first two conditional puts)
+        is absorbed: write_log succeeds via retry, protocol unchanged."""
+        from hyperspace_tpu.index.log_manager import IndexLogManager
+        from hyperspace_tpu.index.log_store import InMemoryObjectStore
+        from test_log_entry import make_entry
+
+        class Flaky(InMemoryObjectStore):
+            def __init__(self):
+                super().__init__()
+                self.failures = 2
+
+            def put_if_absent(self, path, data):
+                if self.failures > 0:
+                    self.failures -= 1
+                    raise OSError("transient store error")
+                return super().put_if_absent(path, data)
+
+        store = Flaky()
+        mgr = IndexLogManager(str(tmp_path / "ix"), store=store)
+        assert mgr.write_log(0, make_entry(state=States.CREATING)) is True
+        assert store.failures == 0
+        assert mgr.get_latest_id() == 0
+
+    def test_oplog_write_self_win_after_transient(self, tmp_path):
+        """A put that COMMITS the entry and then raises transiently must
+        not read as losing the optimistic-concurrency race to itself:
+        write_log compares the stored bytes and reports the win."""
+        from hyperspace_tpu.index.log_manager import IndexLogManager
+        from hyperspace_tpu.index.log_store import InMemoryObjectStore
+        from test_log_entry import make_entry
+
+        class CommitThenRaise(InMemoryObjectStore):
+            def __init__(self):
+                super().__init__()
+                self.armed = 1
+
+            def put_if_absent(self, path, data):
+                won = super().put_if_absent(path, data)
+                if won and self.armed > 0:
+                    self.armed -= 1
+                    raise OSError("post-commit cleanup failure")
+                return won
+
+        mgr = IndexLogManager(str(tmp_path / "ix"),
+                              store=CommitThenRaise())
+        assert mgr.write_log(0, make_entry(state=States.CREATING)) is True
+        # A GENUINE loss (someone else's bytes) still reads as a loss.
+        other = CommitThenRaise()
+        other.armed = 0
+        mgr2 = IndexLogManager(str(tmp_path / "ix2"), store=other)
+        assert mgr2.write_log(0, make_entry(state=States.CREATING))
+        assert mgr2.write_log(0, make_entry(state=States.ACTIVE)) is False
+
+    def test_oplog_fault_point_transient_via_create(self, tmp_path):
+        """End to end: transient faults armed at log.write during a real
+        create_index retry to success — the index lands ACTIVE."""
+        _write(tmp_path / "d", files=1)
+        session = _session(tmp_path, capture_events=True)
+        session.conf.set(_fkey(FN.LOG_WRITE), "transient:times=2")
+        session.conf.set(RC.RETRY_BASE_MS, "1")
+        hs = Hyperspace(session)
+        t = session.read.parquet(str(tmp_path / "d"))
+        hs.create_index(t, IndexConfig("rix", ["k"], ["v"]))
+        from hyperspace_tpu.index.log_manager import IndexLogManager
+        mgr = IndexLogManager(
+            os.path.join(str(tmp_path / "indexes"), "rix"))
+        assert mgr.get_latest_stable_log().state == States.ACTIVE
+
+
+# ---------------------------------------------------------------------------
+# Deadlines + cooperative cancellation.
+# ---------------------------------------------------------------------------
+
+class TestDeadline:
+    def test_conf_deadline_cancels_with_typed_error(self, tmp_path):
+        _write(tmp_path / "d")
+        session = _session(tmp_path, capture_events=True)
+        session.conf.set(_fkey(FN.SCAN_PARQUET_DECODE), "latency:ms=80")
+        session.conf.set(RC.DEADLINE_MS, "25")
+        sink = capture_logger()
+        n_before = len(sink.events)
+        before = faults.stats()["deadline_cancellations"]
+        with pytest.raises(QueryDeadlineError):
+            _query(session, tmp_path / "d").to_arrow()
+        assert faults.stats()["deadline_cancellations"] == before + 1
+        evs = [e for e in sink.events[n_before:]
+               if type(e).__name__ == "QueryCancelledEvent"]
+        assert len(evs) == 1 and evs[0].elapsed_ms >= 25
+        # Deadline off again: the query runs fine.
+        session.conf.unset(RC.DEADLINE_MS)
+        session.conf.unset(_fkey(FN.SCAN_PARQUET_DECODE))
+        assert _query(session, tmp_path / "d").to_arrow().num_rows > 0
+
+    def test_submit_deadline_frees_slot(self, tmp_path):
+        """ServingFrontend.submit(deadline_ms=...) cancels a slow query
+        with the typed error, frees the worker slot, and leaves the
+        frontend fully serviceable."""
+        _write(tmp_path / "d")
+        session = _session(tmp_path)
+        session.conf.set(_fkey(FN.SCAN_PARQUET_DECODE), "latency:ms=100")
+        fe = ServingFrontend(session)
+        p = fe.submit(_query(session, tmp_path / "d"), deadline_ms=30)
+        with pytest.raises(QueryDeadlineError):
+            p.result(timeout=120)
+        fe.drain()
+        st = fe.stats()
+        assert st["active_workers"] == 0 and st["queued"] == 0
+        assert st["inflight_bytes"] == 0
+        session.conf.unset(_fkey(FN.SCAN_PARQUET_DECODE))
+        ok = fe.submit(_query(session, tmp_path / "d"))
+        assert ok.result(timeout=120).num_rows > 0
+        fe.drain()
+
+    def test_expired_in_queue_fast_fails(self, tmp_path):
+        """An entry whose deadline expires while QUEUED is cancelled
+        before paying any execution (the serving.queue fast path), with
+        a QueryCancelledEvent carrying the REAL submit-time query id."""
+        _write(tmp_path / "d")
+        gate = threading.Event()
+
+        class Gated(hst.Session):
+            def execute(self, plan, context=None):
+                assert gate.wait(timeout=60)
+                return super().execute(plan, context)
+
+        session = Gated(system_path=str(tmp_path / "indexes"))
+        session.conf.set(IndexConstants.EVENT_LOGGER_CLASS,
+                         "tests.conftest.CaptureLogger")
+        session.conf.set(ServingConstants.SERVING_MAX_CONCURRENCY, "1")
+        session.conf.set(ServingConstants.SERVING_BATCHING_ENABLED,
+                         "false")
+        fe = ServingFrontend(session)
+        q = _query(session, tmp_path / "d")
+        sink = capture_logger()
+        n_before = len(sink.events)
+        blocker = fe.submit(q)           # occupies the one worker
+        doomed = fe.submit(q, deadline_ms=20)
+        assert doomed.query_id > 0       # allocated at submit time
+        time.sleep(0.08)                 # let the deadline lapse queued
+        gate.set()
+        blocker.result(timeout=120)
+        with pytest.raises(QueryDeadlineError) as err:
+            doomed.result(timeout=120)
+        assert "serving.queue" in str(err.value)
+        evs = [e for e in sink.events[n_before:]
+               if type(e).__name__ == "QueryCancelledEvent"]
+        assert len(evs) == 1 and evs[0].query_id == doomed.query_id
+        fe.drain()
+        assert fe.stats()["active_workers"] == 0
+
+
+# ---------------------------------------------------------------------------
+# Graceful-degradation ladders (each proven under fault injection with
+# byte-identical answers).
+# ---------------------------------------------------------------------------
+
+class TestDegradationLadders:
+    def _spmd_session(self, tmp_path, **conf):
+        session = _session(tmp_path, capture_events=True, **conf)
+        session.conf.set(IndexConstants.TPU_DISTRIBUTED_MIN_STREAM_ROWS,
+                         "0")
+        return session
+
+    def test_spmd_dispatch_fault_falls_back_byte_identical(self, tmp_path):
+        _write(tmp_path / "d", seed=11)
+        session = self._spmd_session(tmp_path)
+        q = _query(session, tmp_path / "d")
+        baseline = q.to_arrow()
+        sink = capture_logger()
+        n_before = len(sink.events)
+        before = faults.stats()["degraded_spmd"]
+        session.conf.set(_fkey(FN.SPMD_DISPATCH), "error")
+        got = q.to_arrow()
+        assert got.equals(baseline)
+        assert faults.stats()["degraded_spmd"] == before + 1
+        falls = [e for e in sink.events[n_before:]
+                 if type(e).__name__ == "DistributedFallbackEvent"]
+        assert any(e.reason.startswith("fault:") for e in falls)
+
+    def test_spmd_compile_fault_falls_back_byte_identical(self, tmp_path):
+        from hyperspace_tpu.serving.program_bank import get_bank
+        _write(tmp_path / "d", n=2777, seed=13)
+        session = self._spmd_session(tmp_path)
+        q = session.read.parquet(str(tmp_path / "d")) \
+            .filter(col("v") >= 2).group_by("v") \
+            .agg(sum_(col("k")).alias("sk")).sort("v")
+        baseline = q.to_arrow()
+        get_bank().clear()  # force a fresh MeshProgram compile attempt
+        before = faults.stats()["degraded_spmd"]
+        session.conf.set(_fkey(FN.SPMD_COMPILE), "error")
+        got = q.to_arrow()
+        assert got.equals(baseline)
+        assert faults.stats()["degraded_spmd"] == before + 1
+
+    def test_spmd_degrade_off_fails_loud(self, tmp_path):
+        _write(tmp_path / "d", seed=17)
+        session = self._spmd_session(
+            tmp_path, **{RC.DEGRADE_ENABLED: "false",
+                         _fkey(FN.SPMD_DISPATCH): "error"})
+        with pytest.raises(InjectedFaultError):
+            _query(session, tmp_path / "d").to_arrow()
+
+    def test_device_put_degrade_off_fails_loud(self, tmp_path):
+        """Every ladder honors the one master switch: with degradation
+        off, a device_put fault propagates instead of silently landing
+        the entry in the host tier."""
+        _write(tmp_path / "d", seed=61)
+        session = _session(
+            tmp_path,
+            **{RC.DEGRADE_ENABLED: "false",
+               ServingConstants.RESULT_CACHE_ENABLED: "true",
+               ServingConstants.RESULT_CACHE_MIN_COMPUTE_SECONDS: "0",
+               _fkey(FN.RESULT_CACHE_DEVICE_PUT): "error"})
+        with pytest.raises(InjectedFaultError):
+            _query(session, tmp_path / "d").to_arrow()
+
+    def test_bank_compile_fault_runs_uncached_eager(self, tmp_path):
+        from hyperspace_tpu.serving.program_bank import get_bank
+        _write(tmp_path / "d", seed=19)
+        session = _session(tmp_path)
+        q = _query(session, tmp_path / "d")
+        baseline = q.to_arrow()
+        get_bank().clear()  # next lookup is a miss -> factory runs
+        before = faults.stats()["degraded_bank_compile"]
+        session.conf.set(_fkey(FN.BANK_COMPILE), "error:nth=1")
+        got = q.to_arrow()
+        assert got.equals(baseline)
+        assert faults.stats()["degraded_bank_compile"] == before + 1
+
+    def test_device_put_fault_degrades_to_host_tier(self, tmp_path):
+        _write(tmp_path / "d", seed=23)
+        session = _session(tmp_path, capture_events=True)
+        session.conf.set(ServingConstants.RESULT_CACHE_ENABLED, "true")
+        session.conf.set(ServingConstants.RESULT_CACHE_MIN_COMPUTE_SECONDS,
+                         "0")
+        session.conf.set(_fkey(FN.RESULT_CACHE_DEVICE_PUT), "error")
+        q = _query(session, tmp_path / "d")
+        before = faults.stats()["degraded_device_put"]
+        first = q.to_arrow()
+        assert faults.stats()["degraded_device_put"] == before + 1
+        cache = session.result_cache
+        st = cache.stats()
+        assert st["host_entries"] == 1 and st["device_entries"] == 0
+        again = q.to_arrow()  # served from the host tier
+        assert again.equals(first)
+        assert cache.stats()["host_hits"] >= 1
+
+
+class TestSpillTier:
+    def _host_table(self, tmp_path, seed=29):
+        _write(tmp_path / "d", seed=seed)
+        session = _session(tmp_path)
+        return session, _query(session, tmp_path / "d").execute().to_host()
+
+    def test_host_victims_spill_and_read_back(self, tmp_path):
+        from hyperspace_tpu.serving.result_cache import (ResultCache,
+                                                         table_nbytes)
+        session, t = self._host_table(tmp_path)
+        n = table_nbytes(t)
+        spill = tmp_path / "spill"
+        rc = ResultCache(device_bytes=0, host_bytes=n,
+                         spill_dir=str(spill), spill_bytes=10 * n)
+        assert rc.put("a", t) == "host"
+        assert rc.put("b", t) == "host"  # "a" demotes to disk
+        assert rc.peek("a") == "spill" and rc.peek("b") == "host"
+        got, tier = rc.get("a")
+        assert tier == "spill"
+        assert got.to_arrow().equals(t.to_arrow())
+        st = rc.stats()
+        assert st["spill_hits"] == 1 and st["spill_entries"] == 1
+        assert st["demotions"] >= 1
+        # The hit PROMOTED "a" back to the host tier (repeat hits must
+        # not pay disk + deserialize), displacing "b" to disk.
+        assert rc.peek("a") == "host" and rc.peek("b") == "spill"
+        got2, tier2 = rc.get("a")
+        assert tier2 == "host" and got2.to_arrow().equals(t.to_arrow())
+
+    def test_corrupt_spill_is_a_miss_never_an_error(self, tmp_path):
+        """THE satellite bugfix: garbage bytes in a spilled entry read
+        back as a MISS (entry evicted, file dropped) — no exception, no
+        wrong answer."""
+        from hyperspace_tpu.serving.result_cache import (ResultCache,
+                                                         table_nbytes)
+        session, t = self._host_table(tmp_path, seed=31)
+        n = table_nbytes(t)
+        rc = ResultCache(device_bytes=0, host_bytes=n,
+                         spill_dir=str(tmp_path / "spill"),
+                         spill_bytes=10 * n)
+        rc.put("a", t)
+        rc.put("b", t)
+        path = rc._spill["a"][0]
+        with open(path, "wb") as f:
+            f.write(b"garbage bytes, definitely not a spilled table")
+        assert rc.get("a") is None  # miss, not an exception
+        st = rc.stats()
+        assert st["spill_corruptions"] == 1
+        assert st["spill_entries"] == 0 and not os.path.exists(path)
+
+    def test_truncated_spill_is_a_miss(self, tmp_path):
+        from hyperspace_tpu.serving.result_cache import (ResultCache,
+                                                         table_nbytes)
+        session, t = self._host_table(tmp_path, seed=37)
+        n = table_nbytes(t)
+        rc = ResultCache(device_bytes=0, host_bytes=n,
+                         spill_dir=str(tmp_path / "spill"),
+                         spill_bytes=10 * n)
+        rc.put("a", t)
+        rc.put("b", t)
+        path = rc._spill["a"][0]
+        data = open(path, "rb").read()
+        with open(path, "wb") as f:
+            f.write(data[:len(data) // 2])  # torn tail: crash mid-spill
+        assert rc.get("a") is None
+        assert rc.stats()["spill_corruptions"] == 1
+
+    def test_spill_read_fault_point_is_a_miss(self, tmp_path):
+        from hyperspace_tpu.serving.result_cache import (ResultCache,
+                                                         table_nbytes)
+        session, t = self._host_table(tmp_path, seed=41)
+        n = table_nbytes(t)
+        rc = ResultCache(device_bytes=0, host_bytes=n,
+                         spill_dir=str(tmp_path / "spill"),
+                         spill_bytes=10 * n)
+        rc.put("a", t)
+        rc.put("b", t)
+        reg = FaultRegistry.from_conf_specs(
+            {FN.RESULT_CACHE_SPILL_READ: "error"})
+        with faults.scope(reg):
+            assert rc.get("a") is None
+        assert rc.stats()["spill_corruptions"] == 1
+
+    def test_end_to_end_corrupt_spill_recomputes_with_event(self, tmp_path):
+        """Through the session: a corrupted spill entry produces a
+        correct recomputed answer plus a ResultCacheMissEvent with
+        reason="spill-corrupt"."""
+        from hyperspace_tpu.serving.result_cache import table_nbytes
+        _write(tmp_path / "d", seed=43)
+        session = _session(tmp_path, capture_events=True)
+        qa = _query(session, tmp_path / "d")
+        qb = session.read.parquet(str(tmp_path / "d")) \
+            .filter(col("v") < 5).group_by("v") \
+            .agg(sum_(col("k")).alias("sk")).sort("v")
+        n = table_nbytes(qa.execute().to_host())
+        session.conf.set(ServingConstants.RESULT_CACHE_ENABLED, "true")
+        session.conf.set(ServingConstants.RESULT_CACHE_MIN_COMPUTE_SECONDS,
+                         "0")
+        session.conf.set(ServingConstants.RESULT_CACHE_DEVICE_BYTES, "1")
+        # Exactly one result fits the host tier: admitting the second
+        # overflows it and the first (LRU) spills to disk.
+        session.conf.set(ServingConstants.RESULT_CACHE_HOST_BYTES, str(n))
+        session.conf.set(ServingConstants.RESULT_CACHE_SPILL_DIR,
+                         str(tmp_path / "spill"))
+        a1 = qa.to_arrow()      # admitted to host
+        qb.to_arrow()           # admitted; qa's entry spills to disk
+        cache = session.result_cache
+        assert cache.stats()["spill_entries"] == 1
+        path = next(iter(cache._spill.values()))[0]
+        with open(path, "wb") as f:
+            f.write(b"\x00\x01garbage")
+        sink = capture_logger()
+        n_before = len(sink.events)
+        a2 = qa.to_arrow()      # corrupt read-back -> miss -> recompute
+        assert a2.equals(a1)    # never a wrong answer
+        evs = [e for e in sink.events[n_before:]
+               if type(e).__name__ == "ResultCacheMissEvent"
+               and e.reason == "spill-corrupt"]
+        assert len(evs) == 1
+        assert cache.stats()["spill_corruptions"] == 1
+
+
+class TestServingLadders:
+    def _variants(self, session, path, n):
+        r = session.read.parquet(str(path))
+        return [r.filter(col("k") < i + 3).group_by("k")
+                .agg(sum_(col("v")).alias("sv")).sort("k")
+                for i in range(n)]
+
+    def test_sweep_member_fault_falls_back_per_member(self, tmp_path):
+        """One member's injected fault inside the shared sweep re-runs
+        that member standalone: every member's answer is byte-identical
+        to serial, siblings never poisoned."""
+        _write(tmp_path / "d", n=5000, files=2, seed=47)
+        session = _session(
+            tmp_path,
+            **{ServingConstants.SERVING_MAX_CONCURRENCY: "1",
+               ServingConstants.SERVING_BATCHING_WINDOW: "0.5"})
+        qs = self._variants(session, tmp_path / "d", 4)
+        serial = [q.to_arrow() for q in qs]
+        fe = ServingFrontend(session)
+        before = faults.stats()["member_fallbacks"]
+        # One registry for the WHOLE wave (the submit-time snapshots
+        # carry it), so nth counts across members: the first scan decode
+        # — inside the first sweep member — fails, the fallback's rerun
+        # passes.
+        reg = FaultRegistry.from_conf_specs(
+            {FN.SCAN_PARQUET_DECODE: "error:nth=1"})
+        with faults.scope(reg):
+            pend = [fe.submit(q) for q in qs]
+        tables = [p.result(timeout=180) for p in pend]
+        for ref, got in zip(serial, tables):
+            assert ref.equals(got.to_arrow())
+        assert faults.stats()["member_fallbacks"] == before + 1
+        fe.drain()
+
+    def test_worker_death_releases_members(self, tmp_path):
+        """A worker dying while holding a batch window releases its
+        members to per-member execution — no stranded futures, no leaked
+        slots, correct answers."""
+        _write(tmp_path / "d", seed=53)
+        session = _session(
+            tmp_path,
+            **{ServingConstants.SERVING_MAX_CONCURRENCY: "1",
+               ServingConstants.SERVING_BATCHING_WINDOW: "0.3"})
+        qs = self._variants(session, tmp_path / "d", 3)
+        serial = [q.to_arrow() for q in qs]
+        fe = ServingFrontend(session)
+        before = faults.stats()["worker_releases"]
+        reg = FaultRegistry.from_conf_specs(
+            {FN.SERVING_WORKER: "error:nth=1"})
+        with faults.scope(reg):
+            pend = [fe.submit(q) for q in qs]
+        for ref, p in zip(serial, pend):
+            assert ref.equals(p.result(timeout=180).to_arrow())
+        assert faults.stats()["worker_releases"] >= before + 1
+        fe.drain()
+        st = fe.stats()
+        assert st["active_workers"] == 0 and st["inflight_bytes"] == 0
+
+
+# ---------------------------------------------------------------------------
+# In-process crash recovery (the subprocess kill -9 harness lives in
+# test_crash_recovery.py; this covers the recovery sweep's semantics).
+# ---------------------------------------------------------------------------
+
+class TestRecovery:
+    def _env(self, tmp_path):
+        rng = np.random.default_rng(33)
+        df = pd.DataFrame({
+            "k": rng.integers(0, 100, 6000).astype(np.int64),
+            "v": rng.random(6000)})
+        d = tmp_path / "data"
+        d.mkdir()
+        pq.write_table(pa.Table.from_pandas(df), d / "p0.parquet")
+        session = _session(tmp_path)
+        session.conf.set(IndexConstants.INDEX_LINEAGE_ENABLED, "true")
+        return session, Hyperspace(session), str(d)
+
+    def test_recover_rolls_back_crashed_create_and_vacuums(
+            self, tmp_path, monkeypatch):
+        from hyperspace_tpu.actions import create as create_mod
+        from hyperspace_tpu.index.log_manager import IndexLogManager
+        session, hs, d = self._env(tmp_path)
+        t = session.read.parquet(d)
+
+        orig_op = create_mod.CreateAction.op
+
+        def crash_after_data(self):
+            orig_op(self)  # write the index data, then die pre-commit
+            raise RuntimeError("crash after op")
+
+        monkeypatch.setattr(create_mod.CreateAction, "op",
+                            crash_after_data)
+        with pytest.raises(RuntimeError):
+            hs.create_index(t, IndexConfig("cx", ["k"], ["v"]))
+        monkeypatch.undo()
+        idx_path = os.path.join(str(tmp_path / "indexes"), "cx")
+        assert IndexLogManager(idx_path).get_latest_log().state \
+            == States.CREATING
+        import glob
+        assert glob.glob(os.path.join(idx_path, "v__=*"))  # partial data
+        summary = hs.recover()
+        assert summary["cancelled"] == ["cx"]
+        assert summary["vacuumed"]["cx"]  # the partial version is gone
+        assert not glob.glob(os.path.join(idx_path, "v__=*"))
+        latest = IndexLogManager(idx_path).get_latest_log()
+        assert latest.state == States.DOESNOTEXIST
+        # The lake is fully serviceable: re-create succeeds and queries
+        # answer identically to a scan.
+        hs.create_index(t, IndexConfig("cx", ["k"], ["v"]))
+        session.enable_hyperspace()
+        a = t.filter(col("k") == 3).select("k", "v").to_pandas()
+        session.disable_hyperspace()
+        b = t.filter(col("k") == 3).select("k", "v").to_pandas()
+        pd.testing.assert_frame_equal(
+            a.sort_values(["k", "v"]).reset_index(drop=True),
+            b.sort_values(["k", "v"]).reset_index(drop=True))
+
+    def test_recover_keeps_served_versions(self, tmp_path, monkeypatch):
+        """A refresh crash: recovery rolls back to ACTIVE, vacuums only
+        the crashed version, keeps the served one."""
+        from hyperspace_tpu.actions import refresh as refresh_mod
+        from hyperspace_tpu.index.log_manager import IndexLogManager
+        session, hs, d = self._env(tmp_path)
+        t = session.read.parquet(d)
+        hs.create_index(t, IndexConfig("rx", ["k"], ["v"]))
+        rng = np.random.default_rng(5)
+        pq.write_table(pa.table({
+            "k": pa.array(rng.integers(0, 100, 400).astype(np.int64)),
+            "v": pa.array(rng.random(400))}),
+            os.path.join(d, "extra.parquet"))
+
+        orig_op = refresh_mod.RefreshIncrementalAction.op
+
+        def crash_after_data(self):
+            orig_op(self)
+            raise RuntimeError("crash after refresh op")
+
+        monkeypatch.setattr(refresh_mod.RefreshIncrementalAction, "op",
+                            crash_after_data)
+        with pytest.raises(RuntimeError):
+            hs.refresh_index("rx", "incremental")
+        monkeypatch.undo()
+        summary = hs.recover()
+        assert summary["cancelled"] == ["rx"]
+        idx_path = os.path.join(str(tmp_path / "indexes"), "rx")
+        assert IndexLogManager(idx_path).get_latest_stable_log().state \
+            == States.ACTIVE
+        import glob
+        vdirs = glob.glob(os.path.join(idx_path, "v__=*"))
+        assert [os.path.basename(v) for v in vdirs] == ["v__=0"]
+        # Healthy lake: recovery again is a no-op.
+        again = hs.recover()
+        assert not again["cancelled"] and not again["vacuumed"]
+
+    def test_recover_removes_unreferenced_orphan_dir(self, tmp_path):
+        session, hs, d = self._env(tmp_path)
+        t = session.read.parquet(d)
+        hs.create_index(t, IndexConfig("ox", ["k"], ["v"]))
+        idx_path = os.path.join(str(tmp_path / "indexes"), "ox")
+        orphan = os.path.join(idx_path, "v__=9")
+        os.makedirs(orphan)
+        with open(os.path.join(orphan, "junk.parquet"), "wb") as f:
+            f.write(b"partial")
+        summary = hs.recover()
+        assert summary["vacuumed"]["ox"] == [9]
+        assert not os.path.isdir(orphan)
+        assert os.path.isdir(os.path.join(idx_path, "v__=0"))
+
+
+# ---------------------------------------------------------------------------
+# Observability surfaces + the metrics collector.
+# ---------------------------------------------------------------------------
+
+class TestRobustnessObservability:
+    def test_explain_section_gated_and_rendered(self, tmp_path):
+        _write(tmp_path / "d", seed=59)
+        session = _session(tmp_path)
+        hs = Hyperspace(session)
+        q = _query(session, tmp_path / "d")
+        saved = faults.stats()
+        faults.reset_stats()
+        try:
+            assert "Robustness:" not in hs.explain(q)
+            session.conf.set(_fkey(FN.IO_POOLED_READ), "transient:times=1")
+            q.to_arrow()
+            text = hs.explain(q)
+            assert "Robustness:" in text
+            assert "fault points armed: 1" in text
+            assert FN.IO_POOLED_READ in text
+            assert "retries=1" in text
+        finally:
+            faults.reset_stats()
+            faults.note(**{k: v for k, v in saved.items() if v})
+
+    def test_robustness_keys_excluded_from_cache_key(self, tmp_path):
+        """Toggling robustness knobs (a deadline, arming a fault) must
+        NOT orphan warm result-cache entries — the r13 telemetry-key
+        precedent: these knobs never change a computed answer."""
+        _write(tmp_path / "d", seed=67)
+        session = _session(tmp_path)
+        session.conf.set(ServingConstants.RESULT_CACHE_ENABLED, "true")
+        session.conf.set(ServingConstants.RESULT_CACHE_MIN_COMPUTE_SECONDS,
+                         "0")
+        q = _query(session, tmp_path / "d")
+        first = q.to_arrow()  # miss + admit
+        cache = session.result_cache
+        hits_before = cache.stats()["hits"]
+        session.conf.set(RC.DEADLINE_MS, "600000")
+        session.conf.set(RC.RETRY_MAX_ATTEMPTS, "5")
+        session.conf.set(_fkey(FN.IO_POOLED_READ), "error:p=0")
+        again = q.to_arrow()
+        assert again.equals(first)
+        assert cache.stats()["hits"] == hits_before + 1  # still warm
+
+    def test_metrics_registry_collector(self, tmp_path):
+        session = _session(tmp_path)
+        m = Hyperspace(session).metrics()
+        assert "robustness" in m["collectors"]
+        assert set(m["collectors"]["robustness"]) >= {
+            "injected", "retries", "deadline_cancellations",
+            "degraded_spmd", "spill_corruptions"}
+
+
+# ---------------------------------------------------------------------------
+# The lint gates (satellite: fault-name discipline + except-swallow ban).
+# ---------------------------------------------------------------------------
+
+class TestLintGates:
+    def _lint(self):
+        import importlib.util
+        spec = importlib.util.spec_from_file_location(
+            "hst_lint", os.path.join(
+                os.path.dirname(os.path.dirname(
+                    os.path.abspath(__file__))), "scripts", "lint.py"))
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod
+
+    def test_fault_site_gate(self):
+        import ast
+        lint = self._lint()
+        names = {"IO_POOLED_READ": "io.pooled_read"}
+        bad = ast.parse("_faults.fault_point('free.form')")
+        assert lint.fault_site_violations(bad, names)
+        ok = ast.parse("_faults.fault_point(_fn.IO_POOLED_READ)")
+        assert not lint.fault_site_violations(ok, names)
+        ok_lit = ast.parse("faults.fault_point('io.pooled_read')")
+        assert not lint.fault_site_violations(ok_lit, names)
+
+    def test_except_swallow_gate(self):
+        import ast
+        lint = self._lint()
+        bare = ast.parse("try:\n    x = 1\nexcept:\n    x = 2\n")
+        assert lint.except_swallow_sites(bare)
+        swallow = ast.parse(
+            "try:\n    x = 1\nexcept BaseException:\n    pass\n")
+        assert lint.except_swallow_sites(swallow)
+        ok = ast.parse(
+            "try:\n    x = 1\nexcept BaseException as e:\n    raise\n")
+        assert not lint.except_swallow_sites(ok)
+        ok2 = ast.parse(
+            "try:\n    x = 1\nexcept Exception:\n    pass\n")
+        assert not lint.except_swallow_sites(ok2)
+
+    def test_repo_is_clean(self):
+        """The real gates over the real tree: zero problems (same
+        invocation CI runs)."""
+        import subprocess
+        import sys
+        root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        proc = subprocess.run(
+            [sys.executable, os.path.join(root, "scripts", "lint.py")],
+            capture_output=True, text=True, cwd=root)
+        assert proc.returncode == 0, proc.stdout + proc.stderr

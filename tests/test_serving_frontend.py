@@ -287,23 +287,26 @@ class TestAdmission:
         fe.drain()
 
     def test_worker_survives_bad_conf(self, tmp_path):
-        """A mid-drain error (malformed batching.window) lands on the
-        query's future instead of killing the worker — no leaked
-        active_workers / inflight_bytes, and the frontend keeps serving
-        once the conf is fixed."""
+        """A mid-drain error (malformed batching.window) must not kill
+        the worker NOR fail the innocent query: the r14 robustness
+        release hands the un-started member to per-member execution —
+        the answer arrives despite the bad conf, and no active_workers
+        / inflight_bytes leak."""
+        from hyperspace_tpu.robustness import faults as _faults
         _write(tmp_path / "d", seed=97)
         session = _session(tmp_path)
         session.conf.set(ServingConstants.SERVING_BATCHING_WINDOW, "0.3s")
         fe = ServingFrontend(session)
         q = _variants(session, tmp_path / "d", 1)[0]
+        releases_before = _faults.stats()["worker_releases"]
         p = fe.submit(q)
-        with pytest.raises(ValueError):
-            p.result(timeout=60)
+        assert p.result(timeout=60).num_rows >= 0  # released, re-run solo
+        assert _faults.stats()["worker_releases"] == releases_before + 1
         fe.drain()
         st = fe.stats()
         assert st["active_workers"] == 0
         assert st["inflight_bytes"] == 0
-        assert st["failed"] == 1
+        assert st["completed"] >= 1 and st["failed"] == 0
         session.conf.set(ServingConstants.SERVING_BATCHING_WINDOW, "0.01")
         assert fe.submit(q).result(timeout=60).num_rows >= 0
 
