@@ -1714,6 +1714,108 @@ def _single_device_phases(args, root):
                         f"robustness: recovery sweep unexpected: "
                         f"{summary}")
 
+    # ---- whole-plan fusion: fused vs staged execution (r15) ----
+    # One banked XLA program per fusible region vs operator-at-a-time
+    # staged execution, on a fresh session with hyperspace disabled and
+    # the distributed tier off (the fusion tier only runs where the mesh
+    # declined; isolating it here makes the A/B deterministic). Emits
+    # q3/q17 dispatch counts (exec.stage + exec.fused span totals),
+    # fused-vs-staged latency (alternating best-of-two), identity flags,
+    # and the warm-path compile count (second run through the
+    # ProgramBank must compile 0). On this 1-core sandbox the LATENCY
+    # pair is parity-bound (r09/r12 precedent: the fused program does
+    # the same FLOPs on the same silicon; what fusion removes — per-stage
+    # dispatch + host-sync overhead — is a fixed cost that shrinks
+    # relative to compute as data grows, and the real win is on
+    # accelerators where each staged hop is a host↔device round trip);
+    # dispatch counts, span counts, and warm-compile counts are the
+    # signal.
+    if not _backend_dead():
+        with _phase("fusion"):
+            from hyperspace_tpu.execution import fusion as _fusion
+            from hyperspace_tpu.index.constants import \
+                IndexConstants as _IC
+            from hyperspace_tpu.telemetry.constants import \
+                TelemetryConstants as _FTC
+            fsession = hst.Session(
+                system_path=os.path.join(root, "fusion_indexes"))
+            fsession.conf.set("hyperspace.tpu.distributed.enabled",
+                              "false")
+            # Snapshot: fusion defaults on for the whole bench, so the
+            # process-global counters already hold earlier phases' fused
+            # executions — this phase reports its own DELTA.
+            _fst0 = _fusion.stats()
+            fqueries = {"q3": build_q3(fsession, li_dir, od_dir),
+                        "q17": build_q17(fsession, li_dir, pt_dir)}
+
+            def _fuse(on: bool):
+                fsession.conf.set(_IC.TPU_FUSION_ENABLED,
+                                  "true" if on else "false")
+
+            def _ftrace(on: bool):
+                fsession.conf.set(_FTC.TRACE_ENABLED,
+                                  "true" if on else "false")
+
+            def _span_counts(tr):
+                stage = sum(1 for s in tr.spans if s.name == "exec.stage")
+                fused = sum(1 for s in tr.spans if s.name == "exec.fused")
+                return stage, fused
+
+            speedups = []
+            for qn, tq in fqueries.items():
+                _fuse(True)
+                c0 = _compile_counter()
+                fused_tbl = tq.to_arrow()  # cold fused (compiles regions)
+                RESULT[f"{qn}_fusion_compiles_first_run"] = \
+                    _compile_counter() - c0
+                c0 = _compile_counter()
+                tq.to_arrow()
+                RESULT[f"{qn}_fusion_compiles_second_run"] = \
+                    _compile_counter() - c0
+                _ftrace(True)
+                tq.to_arrow()
+                stage_f, fused_f = _span_counts(fsession._last_trace)
+                _fuse(False)
+                staged_tbl = tq.to_arrow()
+                stage_s, fused_s = _span_counts(fsession._last_trace)
+                _ftrace(False)
+                RESULT[f"{qn}_dispatches_fused"] = stage_f + fused_f
+                RESULT[f"{qn}_dispatches_staged"] = stage_s + fused_s
+                RESULT[f"{qn}_exec_fused_spans"] = fused_f
+                RESULT[f"{qn}_fusion_identical"] = bool(
+                    fused_tbl.equals(staged_tbl))
+                if stage_f + fused_f >= stage_s:
+                    RESULT["errors"].append(
+                        f"fusion: {qn} fused dispatches not fewer "
+                        f"({stage_f}+{fused_f} vs {stage_s})")
+                # Alternating best-of-two latency pair (both warm).
+                _fuse(True)
+                tq.to_arrow()
+                on_best = off_best = float("inf")
+                for _ in range(2):
+                    _fuse(False)
+                    off_best = min(off_best,
+                                   timed_best(lambda: tq.to_arrow(), 1))
+                    _fuse(True)
+                    on_best = min(on_best,
+                                  timed_best(lambda: tq.to_arrow(), 1))
+                RESULT[f"{qn}_fused_s"] = round(on_best, 4)
+                RESULT[f"{qn}_staged_s"] = round(off_best, 4)
+                sp = off_best / on_best if on_best > 0 else float("inf")
+                RESULT[f"{qn}_fusion_speedup"] = round(sp, 3)
+                speedups.append(sp)
+            st = _fusion.stats()
+            RESULT["fusion_executions"] = (st["fused_executions"]
+                                           - _fst0["fused_executions"])
+            f0 = _fst0["fallbacks"]
+            RESULT["fusion_fallbacks"] = {
+                k: v - f0.get(k, 0)
+                for k, v in sorted(st["fallbacks"].items())
+                if v - f0.get(k, 0) > 0}
+            if speedups:
+                RESULT["fusion_speedup_mean"] = round(
+                    sum(speedups) / len(speedups), 3)
+
     # ---- BASELINE config #5: Hybrid Scan over appended source files ----
     # Runs LAST: the appends invalidate plain signatures, so every other
     # query pair must be timed first.

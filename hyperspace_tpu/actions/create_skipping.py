@@ -278,7 +278,11 @@ class RefreshDataSkippingIncrementalAction(RefreshDataSkippingAction):
         deleted_names = {f.name for f in self.deleted_files}
         _sf = _sketch_file(prev)
         _fs, _sfp = data_store.fs_and_path(_sf)
-        old = pq.read_table(_sfp, filesystem=_fs)
+        # partitioning=None: the sketch file lives under a "v__=<n>"
+        # version directory, and this image's pyarrow otherwise
+        # hive-infers a phantom "v__" partition column from the path,
+        # breaking the cast-to-sketch-schema below.
+        old = pq.read_table(_sfp, filesystem=_fs, partitioning=None)
         keep_mask = [name not in deleted_names
                      for name in old.column(FILE_COL).to_pylist()]
         kept = old.filter(pa.array(keep_mask))

@@ -278,6 +278,20 @@ class HyperspaceConf:
             IndexConstants.TPU_SHAPE_BUCKETING_EXACT_FALLBACK_ROWS,
             IndexConstants.TPU_SHAPE_BUCKETING_EXACT_FALLBACK_ROWS_DEFAULT))
 
+    def fusion_enabled(self) -> bool:
+        """Whole-plan fusion (execution/fusion.py): execute maximal
+        filter/project/join-probe/aggregate regions as ONE banked XLA
+        program. Off restores pure staged (operator-at-a-time)
+        execution with byte-identical answers."""
+        return self._get_bool(
+            IndexConstants.TPU_FUSION_ENABLED,
+            IndexConstants.TPU_FUSION_ENABLED_DEFAULT)
+
+    def fusion_min_stages(self) -> int:
+        return int(self._conf.get(
+            IndexConstants.TPU_FUSION_MIN_STAGES,
+            IndexConstants.TPU_FUSION_MIN_STAGES_DEFAULT))
+
     # ------------------------------------------------------------------
     # Parallel I/O (parallel/io.py): reader pool + prefetch pipelines.
     # ------------------------------------------------------------------

@@ -145,6 +145,18 @@ def _execute(plan: LogicalPlan, needed: Optional[Set[str]]) -> Table:
     cooperative cancellation point (robustness layer): deadline-less
     queries pay one contextvar read + one attribute test."""
     check_deadline("exec.stage")
+    if isinstance(plan, (Filter, Project, Join)):
+        # Whole-plan fusion (execution/fusion.py): a chain root opening a
+        # fusible region executes as ONE banked program — no exec.stage
+        # spans (and no host Tables) for its interior nodes. Aggregate
+        # roots attempt fusion inside _execute_node, AFTER the SPMD
+        # dispatch (the distributed tier keeps right of way; chains only
+        # reach here once execute()'s spmd.try_execute_plan declined).
+        from . import fusion
+        fused = fusion.try_execute(plan, needed)
+        if fused is not None:
+            check_deadline("exec.stage")
+            return fused
     if _trace.idle():
         table = _execute_node(plan, needed)
         # Checked on EXIT too: the recursion enters ancestors before
@@ -258,6 +270,10 @@ def _execute_node(plan: LogicalPlan, needed: Optional[Set[str]]) -> Table:
             _SESSION.get())
         if spmd_result is not None:
             return spmd_result
+        from . import fusion
+        fused = fusion.try_execute(plan, needed)
+        if fused is not None:
+            return fused
         child_needed = set(plan.group_cols)
         for a in plan.aggs:
             child_needed.update(a.references)

@@ -1,0 +1,62 @@
+"""Frozen registry of fusion-region boundary kinds.
+
+Every place the fusion planner/executor (execution/fusion.py) draws a
+region boundary or abandons a fused execution must name WHY with one of
+these constants — free-form strings are rejected by the scripts/lint.py
+boundary-discipline gate (the span_names/fault_names precedent), and
+every kind registered here must be referenced under tests/ (an
+unexercised boundary is an unverified fallback path).
+
+Two families share the registry:
+
+- *Barriers* — plan shapes the fused program does not absorb; the region
+  stops there and the barrier subtree executes staged (its own subchains
+  may fuse independently).
+- *Fallbacks* — runtime discoveries (duplicate probe keys, bucket-ordered
+  streams, chunked sources, trace failures) that abandon an otherwise
+  fusible region; the staged executor re-runs it byte-identically.
+
+Keep the vocabulary SMALL: the kinds key fusion.stats()["fallbacks"]
+and the bench/tests assert on them.
+"""
+
+from __future__ import annotations
+
+# ---- barriers: plan shapes that end a region ------------------------------
+
+# The region bottomed out at a source leaf (Scan/IndexScan) — the normal,
+# successful boundary, counted so stats distinguish it from bailouts.
+LEAF = "leaf"
+
+SORT = "sort"
+WINDOW = "window"
+LIMIT = "limit"
+UNION = "union"
+AGGREGATE = "aggregate"          # a nested (non-root) Aggregate subtree
+OUTER_JOIN = "outer-join"
+CROSS_JOIN = "cross-join"
+NON_EQUI_JOIN = "non-equi-join"
+MULTI_KEY_JOIN = "multi-key-join"
+COUNT_DISTINCT = "count-distinct"
+UNSUPPORTED_AGG = "unsupported-agg"
+UNSUPPORTED_EXPR = "unsupported-expr"
+
+# ---- fallbacks: runtime bailouts on an otherwise fusible region -----------
+
+DISABLED = "disabled"            # hyperspace.tpu.execution.fusion.enabled=false
+SWEEP = "sweep"                  # literal-sweep batches own the staged path
+REGION_TOO_SMALL = "region-too-small"
+CHUNKED_SOURCE = "chunked-source"
+BUCKET_ORDER = "bucket-order"    # stream carries covering-index layout
+DUPLICATE_PROBE_KEYS = "duplicate-probe-keys"
+KEY_DTYPE = "key-dtype"
+EMPTY_INPUT = "empty-input"
+FUSED_PROGRAM_ERROR = "fused-program-error"
+
+BOUNDARY_KINDS = frozenset({
+    LEAF, SORT, WINDOW, LIMIT, UNION, AGGREGATE, OUTER_JOIN, CROSS_JOIN,
+    NON_EQUI_JOIN, MULTI_KEY_JOIN, COUNT_DISTINCT, UNSUPPORTED_AGG,
+    UNSUPPORTED_EXPR, DISABLED, SWEEP, REGION_TOO_SMALL, CHUNKED_SOURCE,
+    BUCKET_ORDER, DUPLICATE_PROBE_KEYS, KEY_DTYPE, EMPTY_INPUT,
+    FUSED_PROGRAM_ERROR,
+})

@@ -196,6 +196,16 @@ class IndexConstants:
         "hyperspace.tpu.execution.shapeBucketing.exactFallbackRows"
     TPU_SHAPE_BUCKETING_EXACT_FALLBACK_ROWS_DEFAULT = str(4 * 1024 * 1024)
 
+    # Whole-plan fusion (execution/fusion.py): fuse maximal
+    # filter/project/join-probe/aggregate regions into ONE banked XLA
+    # program per (region fingerprint, shape-class vector). minStages is
+    # the smallest region worth a program (below it the staged per-stage
+    # fused kernels are already optimal); clamped to >= 2.
+    TPU_FUSION_ENABLED = "hyperspace.tpu.execution.fusion.enabled"
+    TPU_FUSION_ENABLED_DEFAULT = "true"
+    TPU_FUSION_MIN_STAGES = "hyperspace.tpu.execution.fusion.minStages"
+    TPU_FUSION_MIN_STAGES_DEFAULT = "2"
+
     # Parallel I/O (parallel/io.py): the process-wide bounded reader pool
     # and the producer/consumer prefetch pipelines behind every multi-file
     # read, chunk stream, sketch build, and spill merge. Ordered gather

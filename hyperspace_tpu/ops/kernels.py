@@ -444,6 +444,19 @@ def run_fused_predicate_sweep(key, builder, col_arrays, lit_matrix, n,
     return jitted(col_arrays, lit_matrix, n)
 
 
+def run_fused_region(key, shape_vec, factory, args):
+    """Run a whole-plan fused REGION program (execution/fusion.py): one
+    jitted program per (region fingerprint, shape-class vector) in the
+    process-wide ProgramBank. ``factory()`` must return a pure builder
+    fully determined by ``key`` (the bank contract); the jax.jit call
+    stays HERE, in the lint-sanctioned instrumented module, so the r07
+    compile counter attributes every region compile."""
+    from ..serving.program_bank import get_bank
+    jitted = get_bank().lookup(("fused-region", key), tuple(shape_vec),
+                               lambda: jax.jit(factory()))
+    return jitted(args)
+
+
 def nonzero_pad_indices(mask, size: int):
     """Class-padded indices of a mask's True entries (filler 0)."""
     return _nonzero_pad(mask, size=size)

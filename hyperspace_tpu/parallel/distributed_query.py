@@ -98,46 +98,54 @@ def distributed_range_agg(table: Table, filter_col: str, lo, hi,
     return int(count), {k: v for k, v in sums.items()}
 
 
+def _merge_join_agg_body(lk, lvalid, lval, rk, rvalid, rval):
+    """The per-device co-bucketed merge-join aggregate, shared by the
+    plain join-aggregate program and the fused join+filter+aggregate
+    region (which pre-folds its consumer filter into ``lvalid``).
+
+    Local re-sort of the right shard by pure key (device-local order
+    after the bucket exchange is (bucket, key); searchsorted needs key
+    order). Invalid rows get the max-value sentinel and, via the
+    valid-first tiebreak, sort strictly after every valid row — so
+    valid rows occupy [0, n_valid) and clamping the probe bounds to
+    n_valid keeps a legitimate sentinel-valued key from matching the
+    padding (no overcount even for key == iinfo.max)."""
+    from ..ops import kernels
+
+    if jnp.issubdtype(rk.dtype, jnp.floating):
+        sentinel = jnp.asarray(jnp.finfo(rk.dtype).max, rk.dtype)
+    else:
+        sentinel = jnp.asarray(jnp.iinfo(rk.dtype).max, rk.dtype)
+    rk_eff = jnp.where(rvalid, rk, sentinel)
+    order = kernels.lex_sort_indices(
+        [rk_eff, (~rvalid).astype(jnp.int32)])
+    n_valid = jnp.sum(rvalid.astype(jnp.int32))
+    rk_sorted = jnp.take(rk_eff, order)
+    rval_sorted = jnp.where(jnp.take(rvalid, order),
+                            jnp.take(rval, order), 0)
+    prefix = jnp.concatenate(
+        [jnp.zeros(1, rval_sorted.dtype), jnp.cumsum(rval_sorted)])
+
+    lo = jnp.minimum(jnp.searchsorted(rk_sorted, lk, side="left"),
+                     n_valid)
+    hi = jnp.minimum(jnp.searchsorted(rk_sorted, lk, side="right"),
+                     n_valid)
+    counts = jnp.where(lvalid, (hi - lo).astype(jnp.int64), 0)
+    pair_count = jax.lax.psum(jnp.sum(counts), DATA_AXIS)
+    # Sum of left values over all join pairs: multiplicity × value.
+    left_sum = jax.lax.psum(
+        jnp.sum(counts.astype(lval.dtype) * jnp.where(lvalid, lval, 0)),
+        DATA_AXIS)
+    # Sum of right values over all join pairs: per-left segment totals.
+    seg = jnp.take(prefix, hi) - jnp.take(prefix, lo)
+    right_sum = jax.lax.psum(jnp.sum(jnp.where(lvalid, seg, 0)),
+                             DATA_AXIS)
+    return pair_count, left_sum, right_sum
+
+
 def _join_agg_fn(mesh: Mesh):
     def per_device(lk, lvalid, lval, rk, rvalid, rval):
-        # Local re-sort of the right shard by pure key (device-local order
-        # after the bucket exchange is (bucket, key); searchsorted needs key
-        # order). Invalid rows get the max-value sentinel and, via the
-        # valid-first tiebreak, sort strictly after every valid row — so
-        # valid rows occupy [0, n_valid) and clamping the probe bounds to
-        # n_valid keeps a legitimate sentinel-valued key from matching the
-        # padding (no overcount even for key == iinfo.max).
-        from ..ops import kernels
-
-        if jnp.issubdtype(rk.dtype, jnp.floating):
-            sentinel = jnp.asarray(jnp.finfo(rk.dtype).max, rk.dtype)
-        else:
-            sentinel = jnp.asarray(jnp.iinfo(rk.dtype).max, rk.dtype)
-        rk_eff = jnp.where(rvalid, rk, sentinel)
-        order = kernels.lex_sort_indices(
-            [rk_eff, (~rvalid).astype(jnp.int32)])
-        n_valid = jnp.sum(rvalid.astype(jnp.int32))
-        rk_sorted = jnp.take(rk_eff, order)
-        rval_sorted = jnp.where(jnp.take(rvalid, order),
-                                jnp.take(rval, order), 0)
-        prefix = jnp.concatenate(
-            [jnp.zeros(1, rval_sorted.dtype), jnp.cumsum(rval_sorted)])
-
-        lo = jnp.minimum(jnp.searchsorted(rk_sorted, lk, side="left"),
-                         n_valid)
-        hi = jnp.minimum(jnp.searchsorted(rk_sorted, lk, side="right"),
-                         n_valid)
-        counts = jnp.where(lvalid, (hi - lo).astype(jnp.int64), 0)
-        pair_count = jax.lax.psum(jnp.sum(counts), DATA_AXIS)
-        # Sum of left values over all join pairs: multiplicity × value.
-        left_sum = jax.lax.psum(
-            jnp.sum(counts.astype(lval.dtype) * jnp.where(lvalid, lval, 0)),
-            DATA_AXIS)
-        # Sum of right values over all join pairs: per-left segment totals.
-        seg = jnp.take(prefix, hi) - jnp.take(prefix, lo)
-        right_sum = jax.lax.psum(jnp.sum(jnp.where(lvalid, seg, 0)),
-                                 DATA_AXIS)
-        return pair_count, left_sum, right_sum
+        return _merge_join_agg_body(lk, lvalid, lval, rk, rvalid, rval)
 
     def run(lk, lv_valid, lval, rk, rv_valid, rval):
         return device_view(
@@ -167,6 +175,98 @@ def join_agg_collectives(left: Table, left_valid, right: Table, right_valid,
             right.column(key).data, right_valid,
             right.column(right_value).data)
     return _join_agg_program(args, mesh).collectives(*args)
+
+
+def _join_region_fn(mesh: Mesh, lo_incl: bool, hi_incl: bool):
+    """The FUSED co-bucketed join REGION: the shuffle-free sort-merge
+    join composed with its consumers — a post-join range filter on a
+    stream column and the aggregate — in ONE partitioned executable (the
+    whole-plan-fusion contract of execution/fusion.py, extended to the
+    distributed tier). Staged execution would dispatch one program for
+    the filter and another for the join-aggregate; here the filter folds
+    into the stream mask BEFORE the local merge, so the composition
+    still moves zero rows between devices (zero all-to-all/all-gather —
+    asserted on compiled HLO by join_filter_agg_collectives) and
+    launches exactly one program."""
+
+    def per_device(lk, lvalid, lval, fd, flo, fhi, rk, rvalid, rval):
+        ml = (fd >= flo) if lo_incl else (fd > flo)
+        mh = (fd <= fhi) if hi_incl else (fd < fhi)
+        # The fused consumer filter folds into the stream validity BEFORE
+        # the shared merge body — everything else is the same program.
+        return _merge_join_agg_body(lk, lvalid & ml & mh, lval,
+                                    rk, rvalid, rval)
+
+    def run(lk, lv_valid, lval, fd, flo, fhi, rk, rv_valid, rval):
+        return device_view(
+            per_device, mesh,
+            in_specs=(P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS),
+                      P(DATA_AXIS), P(), P(),
+                      P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS)),
+            out_specs=(P(), P(), P()))(lk, lv_valid, lval, fd, flo, fhi,
+                                       rk, rv_valid, rval)
+
+    return run
+
+
+def _join_region_args(left: Table, left_valid, right: Table, right_valid,
+                      key: str, left_value: str, right_value: str,
+                      filter_col: str, lo, hi):
+    fd = left.column(filter_col).data
+    return (left.column(key).data, left_valid,
+            left.column(left_value).data, fd,
+            jnp.asarray(lo, fd.dtype), jnp.asarray(hi, fd.dtype),
+            right.column(key).data, right_valid,
+            right.column(right_value).data)
+
+
+def _join_region_program(args, mesh: Mesh, lo_incl: bool, hi_incl: bool):
+    return bank_program(
+        "join-filter-agg", mesh, (lo_incl, hi_incl), args,
+        lambda: _join_region_fn(mesh, lo_incl, hi_incl))
+
+
+def join_filter_agg_collectives(left: Table, left_valid, right: Table,
+                                right_valid, key: str, left_value: str,
+                                right_value: str, filter_col: str, lo, hi,
+                                mesh: Optional[Mesh] = None,
+                                lo_incl: bool = True,
+                                hi_incl: bool = True) -> Dict[str, int]:
+    """HLO collective counts of the fused join+filter+aggregate region.
+    The acceptance property: composing the consumer into the
+    co-bucketed join keeps zero all-to-all / all-gather /
+    collective-permute / reduce-scatter — only the final psums
+    all-reduce."""
+    mesh = mesh or make_mesh()
+    args = _join_region_args(left, left_valid, right, right_valid, key,
+                             left_value, right_value, filter_col, lo, hi)
+    return _join_region_program(args, mesh, lo_incl,
+                                hi_incl).collectives(*args)
+
+
+def distributed_join_filter_agg(left: Table, left_valid, right: Table,
+                                right_valid, key: str, left_value: str,
+                                right_value: str, filter_col: str, lo, hi,
+                                mesh: Optional[Mesh] = None,
+                                lo_incl: bool = True, hi_incl: bool = True):
+    """Inner-join aggregate over two bucket-co-partitioned sharded tables
+    with a FUSED post-join range filter on ``filter_col`` (a stream-side
+    column): one partitioned executable, zero inter-device row movement.
+    Returns (pair count, sum(left_value), sum(right_value)) over join
+    pairs whose stream row passes ``lo <(=) filter_col <(=) hi``."""
+    mesh = mesh or make_mesh()
+    for t, cols in ((left, (key, left_value, filter_col)),
+                    (right, (key, right_value))):
+        for c in cols:
+            if t.column(c).validity is not None:
+                raise HyperspaceException(
+                    f"distributed_join_filter_agg: nullable column '{c}' "
+                    "not supported yet (SQL null-key semantics)")
+    args = _join_region_args(left, left_valid, right, right_valid, key,
+                             left_value, right_value, filter_col, lo, hi)
+    count, lsum, rsum = _join_region_program(args, mesh, lo_incl,
+                                             hi_incl)(*args)
+    return int(count), np.asarray(lsum).item(), np.asarray(rsum).item()
 
 
 def distributed_join_agg(left: Table, left_valid, right: Table, right_valid,

@@ -55,6 +55,12 @@ COLLECTIVE_OPS = ("all-to-all", "all-reduce", "all-gather",
 # the r07 backend-compile counter; one MeshProgram compile == one entry).
 COMPILE_COUNT = 0
 
+# Mesh program DISPATCHES in this process (one per MeshProgram.__call__):
+# the fused-region assertions count executable launches — a composed
+# join+consumer region must dispatch ONE partitioned program where the
+# staged composition dispatched several.
+DISPATCH_COUNT = 0
+
 
 def mesh_signature(mesh: Mesh) -> tuple:
     """Hashable identity of a mesh for program keys and telemetry:
@@ -205,6 +211,8 @@ class MeshProgram:
         return entry
 
     def __call__(self, *args):
+        global DISPATCH_COUNT
+        DISPATCH_COUNT += 1
         return self._get(args)[0](*args)
 
     def signature(self, args) -> tuple:

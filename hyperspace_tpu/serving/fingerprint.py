@@ -148,21 +148,24 @@ def index_versions(session) -> Tuple[Tuple[str, int, str], ...]:
 
 
 def config_hash(session) -> str:
-    """Conf + enabled-flag hash. The serving, telemetry, and robustness
-    knobs themselves are excluded: they steer THIS cache (admission
-    floors, budgets), pure observability (tracing/metrics/profiler —
-    results are byte-identical by contract, asserted in
-    tests/test_tracing.py), or fault handling (deadlines/retry/
-    degradation ladders produce byte-identical answers or typed errors,
-    never a different answer — asserted in tests/test_robustness.py) —
-    hashing them would orphan every warm entry on an admission-threshold
-    tweak, a tracing toggle, or a deadline/fault (dis)arming, breaking
+    """Conf + enabled-flag hash. The serving, telemetry, robustness, and
+    fusion knobs themselves are excluded: they steer THIS cache
+    (admission floors, budgets), pure observability (tracing/metrics/
+    profiler — results are byte-identical by contract, asserted in
+    tests/test_tracing.py), fault handling (deadlines/retry/degradation
+    ladders produce byte-identical answers or typed errors, never a
+    different answer — asserted in tests/test_robustness.py), or pure
+    execution strategy (whole-plan fusion answers byte-identical to
+    staged execution — asserted in tests/test_fusion.py) — hashing them
+    would orphan every warm entry on an admission-threshold tweak, a
+    tracing toggle, a fault (dis)arming, or a fusion toggle, breaking
     config.py's live-tuning contract."""
     items = [(k, v) for k, v in sorted(session.conf.as_dict().items())
              if not k.startswith("serving.")
              and not k.startswith("hyperspace.tpu.serving.")
              and not k.startswith("hyperspace.tpu.telemetry.")
-             and not k.startswith("hyperspace.tpu.robustness.")]
+             and not k.startswith("hyperspace.tpu.robustness.")
+             and not k.startswith("hyperspace.tpu.execution.fusion.")]
     return hashing.md5_hex((items, session.is_hyperspace_enabled()))
 
 

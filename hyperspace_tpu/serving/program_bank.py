@@ -154,8 +154,17 @@ class ProgramBank:
         """Counters follow the registry-wide ``hits``/``misses``/
         ``evictions`` spelling (telemetry/metrics.py naming convention);
         ``stage_evictions`` is the pre-r13 spelling kept as a DEPRECATED
-        alias for existing readers."""
+        alias for existing readers. ``stages_by_kind`` breaks the
+        resident stages down by their key's kind tag ("fused-predicate",
+        "fused-predicate-sweep", "fused-region", "spmd", ...) so the
+        fusion bench/metrics can see how much of the bank is whole-plan
+        regions vs per-stage programs."""
         with self._lock:
+            kinds: dict = {}
+            for k in self._stages:
+                tag = k[0] if isinstance(k, tuple) and k \
+                    and isinstance(k[0], str) else "other"
+                kinds[tag] = kinds.get(tag, 0) + 1
             return {
                 "stages": len(self._stages),
                 "programs": self.program_count,
@@ -163,6 +172,7 @@ class ProgramBank:
                 "misses": self.misses,
                 "evictions": self.stage_evictions,
                 "stage_evictions": self.stage_evictions,
+                "stages_by_kind": kinds,
             }
 
     def clear(self) -> None:

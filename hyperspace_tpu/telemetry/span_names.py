@@ -39,6 +39,11 @@ BANK_COMPILE = "bank.compile"
 # One span per executed plan node (execution/executor._execute).
 EXEC_STAGE = "exec.stage"
 
+# One span per fused-region dispatch (execution/fusion.py): the whole
+# filter/project/join-probe/aggregate region runs as ONE banked program;
+# attrs carry ``fused_nodes`` (plan nodes collapsed) and output rows.
+EXEC_FUSED = "exec.fused"
+
 # Pooled multi-file read fan-out / prefetch stream (parallel/io.py),
 # recorded on the consumer side of the r11 per-query io attribution.
 IO_READ = "io.read"
@@ -55,6 +60,6 @@ SERVING_SWEEP = "serving.sweep"
 
 SPAN_NAMES = frozenset({
     QUERY, PLAN_NORMALIZE, JOIN_REORDER, INDEX_REWRITE, CACHE_LOOKUP,
-    BANK_LOOKUP, BANK_COMPILE, EXEC_STAGE, IO_READ, IO_PREFETCH,
-    SPMD_DISPATCH, SPMD_COMPILE, SERVING_SWEEP,
+    BANK_LOOKUP, BANK_COMPILE, EXEC_STAGE, EXEC_FUSED, IO_READ,
+    IO_PREFETCH, SPMD_DISPATCH, SPMD_COMPILE, SERVING_SWEEP,
 })

@@ -403,6 +403,52 @@ def fault_site_violations(tree: ast.AST, names: dict) -> list:
     return out
 
 
+# Fusion-boundary discipline (the whole-plan-fusion layer's ratchet,
+# mirroring the span/fault gates): every region boundary or fallback the
+# fusion planner/executor draws — ``note_boundary(...)`` sites and
+# ``_FuseFallback(...)`` raises in execution/fusion.py — must name its
+# kind via a constant from the frozen execution/fusion_boundaries.py
+# registry (or a string literal registered there), AND every registered
+# kind must be referenced under tests/ — an unexercised boundary is an
+# unverified fallback path. The fused programs themselves compile ONLY
+# through the ProgramBank (ops/kernels.run_fused_region): fusion.py is
+# deliberately NOT in JIT_SITE_ALLOWLIST, so a direct jax.jit there
+# trips the jit-site gate above.
+FUSION_BOUNDARIES_FILE = "hyperspace_tpu/execution/fusion_boundaries.py"
+FUSION_BOUNDARY_ALIASES = ("fusion_boundaries", "FB", "_fb")
+FUSION_BOUNDARY_CALLS = ("note_boundary", "_FuseFallback", "FuseFallback")
+
+
+def fusion_boundary_violations(tree: ast.AST, names: dict) -> list:
+    """(line, detail) of note_boundary()/_FuseFallback() call sites whose
+    kind argument is neither a fusion_boundaries constant nor a
+    registered literal."""
+    values = set(names.values())
+    out = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        callee = f.attr if isinstance(f, ast.Attribute) else (
+            f.id if isinstance(f, ast.Name) else "")
+        if callee not in FUSION_BOUNDARY_CALLS:
+            continue
+        if not node.args:
+            out.append((node.lineno, "no boundary-kind argument"))
+            continue
+        arg = node.args[0]
+        if isinstance(arg, ast.Attribute) \
+                and isinstance(arg.value, ast.Name) \
+                and arg.value.id in FUSION_BOUNDARY_ALIASES \
+                and arg.attr in names:
+            continue
+        if isinstance(arg, ast.Constant) and arg.value in values:
+            continue
+        out.append((node.lineno, "boundary kind must come from "
+                    "execution/fusion_boundaries.py"))
+    return out
+
+
 # Exception-swallowing discipline (robustness ratchet): a bare
 # ``except:`` anywhere, or an ``except BaseException: pass`` that
 # swallows silently, hides crashes the robustness layer exists to
@@ -500,6 +546,9 @@ def main() -> int:
         span_names = span_name_constants(ast.parse(f.read()))
     with open(os.path.join(ROOT, FAULT_NAMES_FILE), encoding="utf-8") as f:
         fault_names = span_name_constants(ast.parse(f.read()))
+    with open(os.path.join(ROOT, FUSION_BOUNDARIES_FILE),
+              encoding="utf-8") as f:
+        fusion_kinds = span_name_constants(ast.parse(f.read()))
     event_classes: list = []
     tests_text_parts: list = []
     for path in iter_sources():
@@ -573,6 +622,12 @@ def main() -> int:
                 problems.append(
                     f"{rel}:{line}: {detail} (frozen registry; free-form "
                     "fault-point strings are forbidden)")
+        if any(rel.startswith(d + os.sep) for d in PACKAGE_DIRS):
+            for line, detail in fusion_boundary_violations(tree,
+                                                           fusion_kinds):
+                problems.append(
+                    f"{rel}:{line}: {detail} (frozen registry; free-form "
+                    "fusion-boundary kinds are forbidden)")
         if any(rel.startswith(d + os.sep) for d in PACKAGE_DIRS) \
                 and rel.replace(os.sep, "/") not in \
                 EXCEPT_SWALLOW_ALLOWLIST:
@@ -606,6 +661,14 @@ def main() -> int:
             problems.append(
                 f"{FAULT_NAMES_FILE}: fault point '{value}' ({const}) is "
                 "never referenced under tests/; add a test injecting it")
+    for const, value in sorted(fusion_kinds.items()):
+        if const == "BOUNDARY_KINDS":
+            continue
+        if value not in tests_text:
+            problems.append(
+                f"{FUSION_BOUNDARIES_FILE}: boundary kind '{value}' "
+                f"({const}) is never referenced under tests/; add a test "
+                "exercising it")
     for p in problems:
         print(p)
     print(f"lint: {len(problems)} problem(s) across "

@@ -181,7 +181,8 @@ class TestProgramBank:
         # "evictions" is the r13 canonical spelling; "stage_evictions"
         # stays as the deprecated alias (telemetry/metrics.py naming).
         assert s == {"stages": 1, "programs": 2, "hits": 1, "misses": 2,
-                     "evictions": 0, "stage_evictions": 0}
+                     "evictions": 0, "stage_evictions": 0,
+                     "stages_by_kind": {"s1": 1}}
 
     def test_lru_stage_eviction(self):
         bank = ProgramBank(max_stages=2)

@@ -131,10 +131,16 @@ class TestTraceShape:
                    if s.name == sn.EXEC_STAGE)
         assert any(s.parent_id in exec_ids for s in tr.spans
                    if s.name == sn.IO_READ)
-        # Node attributes ride the stage spans.
+        # Node attributes ride the stage spans. The Join (and the filter
+        # chain under it) executes inside the whole-plan FUSED region by
+        # default — its exec.fused span hangs off the Aggregate stage and
+        # reports how many plan nodes it collapsed.
         stage_nodes = {s.attrs.get("node") for s in tr.spans
                        if s.name == sn.EXEC_STAGE}
-        assert {"Join", "Aggregate", "Sort"} <= stage_nodes
+        assert {"Aggregate", "Sort"} <= stage_nodes
+        fused = tr.find(sn.EXEC_FUSED)
+        assert fused and max(s.attrs["fused_nodes"] for s in fused) >= 2
+        assert any(s.parent_id in exec_ids for s in fused)
 
     def test_cold_vs_hit_traces_differ_at_cache_lookup(self, q3ish):
         session, li_dir, od_dir = q3ish
@@ -378,8 +384,8 @@ class TestSpanRegistry:
         assert sn.SPAN_NAMES == frozenset({
             "query", "plan.normalize", "optimize.join_reorder",
             "rewrite.index_rules", "serving.cache_lookup",
-            "bank.lookup", "bank.compile", "exec.stage", "io.read",
-            "io.prefetch", "spmd.dispatch", "spmd.compile",
+            "bank.lookup", "bank.compile", "exec.stage", "exec.fused",
+            "io.read", "io.prefetch", "spmd.dispatch", "spmd.compile",
             "serving.sweep",
         })
 
