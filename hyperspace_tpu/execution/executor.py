@@ -13,6 +13,7 @@ itself ... is the part the new framework replaces with XLA/Pallas kernels").
 from __future__ import annotations
 
 import contextvars
+import threading
 from typing import Dict, List, Optional, Sequence, Set, Tuple
 
 import jax
@@ -358,8 +359,19 @@ def _filter_table(table: Table, condition) -> Table:
 
 
 # Chunked-scan observability (mirrors ops.index_build.CHUNK_STATS): tests
-# pin the scan-side device footprint with max_device_rows.
+# pin the scan-side device footprint with max_device_rows. Serving
+# workers stream chunks concurrently, so every write goes through
+# _note_chunk_scan under the lock — an unguarded max()+assign or += here
+# loses updates under contention (HS301/HS302, scripts/analysis).
 CHUNK_SCAN_STATS = {"max_device_rows": 0, "chunks": 0}
+_CHUNK_STATS_LOCK = threading.Lock()
+
+
+def _note_chunk_scan(rows: int) -> None:
+    with _CHUNK_STATS_LOCK:
+        CHUNK_SCAN_STATS["max_device_rows"] = max(
+            CHUNK_SCAN_STATS["max_device_rows"], rows)
+        CHUNK_SCAN_STATS["chunks"] += 1
 
 
 def _chunked_filtered_scan(plan: Scan, needed: Optional[Set[str]],
@@ -409,9 +421,7 @@ def _chunked_filtered_scan(plan: Scan, needed: Optional[Set[str]],
         return None
     parts: List[Table] = []
     for chunk in iter_dataset_chunks(files, cols, chunk_rows, pa_filter):
-        CHUNK_SCAN_STATS["max_device_rows"] = max(
-            CHUNK_SCAN_STATS["max_device_rows"], chunk.num_rows)
-        CHUNK_SCAN_STATS["chunks"] += 1
+        _note_chunk_scan(chunk.num_rows)
         mask = eval_predicate_mask(chunk, condition)
         parts.append(chunk.filter(mask))
     if not parts:
@@ -582,9 +592,7 @@ def _chunked_filtered_index_scan(plan: IndexScan, needed: Optional[Set[str]],
     app_parts: List[Table] = []
     for chunk in iter_dataset_chunks(index_files, cols, chunk_rows,
                                      pa_filter):
-        CHUNK_SCAN_STATS["max_device_rows"] = max(
-            CHUNK_SCAN_STATS["max_device_rows"], chunk.num_rows)
-        CHUNK_SCAN_STATS["chunks"] += 1
+        _note_chunk_scan(chunk.num_rows)
         mask = eval_predicate_mask(chunk, condition)
         if deleted is not None:
             lc = chunk.column(lineage)
@@ -645,9 +653,7 @@ def _chunked_filtered_index_scan(plan: IndexScan, needed: Optional[Set[str]],
                 _app_chunks(), nbytes=_table_nbytes_estimate,
                 label="hybrid_appended_chunks")
         for chunk in app_iter:
-            CHUNK_SCAN_STATS["max_device_rows"] = max(
-                CHUNK_SCAN_STATS["max_device_rows"], chunk.num_rows)
-            CHUNK_SCAN_STATS["chunks"] += 1
+            _note_chunk_scan(chunk.num_rows)
             mask = eval_predicate_mask(chunk, condition)
             appended = chunk.filter(mask)
             if lineage in cols:

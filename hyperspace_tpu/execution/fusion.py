@@ -137,7 +137,7 @@ def reset_stats() -> None:
     with _STATE.lock:
         _STATE.boundaries.clear()
         _STATE.fused_nodes_total = 0
-    DISPATCH_COUNT = 0
+        DISPATCH_COUNT = 0
 
 
 # ---------------------------------------------------------------------------
@@ -927,8 +927,11 @@ def _run_program(region: _Region, spec: _RegionSpec, key, shape_vec, args,
             with _STATE.lock:
                 _STATE.poisoned.add(key)
         raise
-    DISPATCH_COUNT += 1
     with _STATE.lock:
+        # Under the state lock with the other fusion counters: fused
+        # regions dispatch from concurrent serving workers, and an
+        # unguarded += loses updates (HS302).
+        DISPATCH_COUNT += 1
         _STATE.fused_nodes_total += region.node_count
     _record_actuals(region, out, session)
     if region.agg is None:

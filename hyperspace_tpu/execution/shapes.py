@@ -221,16 +221,21 @@ def _on_compile_event(event: str, duration_secs: float, **_kw) -> None:
 
 
 def install_compile_counter() -> None:
-    """Register the monitoring listener once per process (idempotent)."""
+    """Register the monitoring listener once per process (idempotent).
+    The claim-then-register dance runs under the counter lock: two
+    threads racing the unguarded flag would BOTH register the listener
+    and double-count every compile from then on (HS301)."""
     global _listener_installed
-    if _listener_installed:
-        return
-    _listener_installed = True
+    with _counter_lock:
+        if _listener_installed:
+            return
+        _listener_installed = True
     try:
         jax.monitoring.register_event_duration_secs_listener(
             _on_compile_event)
     except Exception:  # very old jax without monitoring: counter stays 0
-        _listener_installed = False
+        with _counter_lock:
+            _listener_installed = False
 
 
 def compile_count() -> int:

@@ -76,6 +76,7 @@ path uses — executor._null_aware_keys).
 from __future__ import annotations
 
 import os
+import threading
 from typing import Callable, Dict, List, Optional, Set, Tuple
 
 import jax
@@ -102,11 +103,16 @@ from .evaluator import eval_expr, eval_predicate_mask
 MAX_LOCAL_GROUPS = 1 << 16
 
 # Successful SPMD executions in this process (tests / dryrun assert the
-# path is actually taken).
+# path is actually taken). These tallies (and LAST_CAP_ATTEMPTS below)
+# are bumped by concurrent serving workers and asserted exact by tests,
+# so every write happens under the lock — an unguarded += loses updates
+# (HS301/HS302, scripts/analysis lock-discipline registry).
 DISPATCH_COUNT = 0
 
 # Distributed ORDER BY executions (range-partitioned sample sort).
 SORT_DISPATCH_COUNT = 0
+
+_COUNT_LOCK = threading.Lock()
 
 # Per-device sample count for the distributed sort's splitter estimation.
 _SORT_SAMPLES = 64
@@ -1095,7 +1101,9 @@ def _emit_spmd_events(session, mode: str, prep: "_Prepared", caps,
 _MAX_CAP_RETRIES = 2
 
 # Capacity attempts of the most recent _run/_run_stream (1 = first program
-# fit). Tests pin the one-recompile contract with this.
+# fit). Tests pin the one-recompile contract with this. LAST-DISPATCH
+# semantics only: concurrent queries overwrite each other here, so the
+# per-query spans/events carry their own local attempt counts instead.
 LAST_CAP_ATTEMPTS = 0
 
 
@@ -1140,16 +1148,22 @@ def _run(plan: Aggregate, executor, session=None) -> Table:
     check_deadline("spmd.dispatch")
     _faults.fault_point(_fltn.SPMD_DISPATCH)
     with _trace.span(SN.SPMD_DISPATCH, mode="agg") as sp:
-        table = _run_impl(plan, executor, session)
+        table, attempts = _run_impl(plan, executor, session)
         if sp is not None:
             sp.attrs["rows"] = int(table.num_rows)
-            sp.attrs["cap_attempts"] = LAST_CAP_ATTEMPTS
+            # The QUERY-LOCAL attempt count: the LAST_CAP_ATTEMPTS
+            # module global is last-dispatch observability for
+            # single-threaded tests/bench — a concurrent query may
+            # overwrite it before this span closes.
+            sp.attrs["cap_attempts"] = attempts
         return table
 
 
-def _run_impl(plan: Aggregate, executor, session=None) -> Table:
+def _run_impl(plan: Aggregate, executor, session=None
+              ) -> Tuple[Table, int]:
     global DISPATCH_COUNT, LAST_CAP_ATTEMPTS
-    LAST_CAP_ATTEMPTS = 1
+    with _COUNT_LOCK:
+        LAST_CAP_ATTEMPTS = 1
     caps: Dict[int, Tuple[int, int]] = {}
     # Prepared ONCE: leaf IO, join-side materialization, and sharding don't
     # depend on caps — only the jitted program (static shapes) does, so
@@ -1196,7 +1210,8 @@ def _run_impl(plan: Aggregate, executor, session=None) -> Table:
             if cap_attempts > _MAX_CAP_RETRIES * max(n_xch, 1):
                 raise _Unsupported(
                     "exchange join capacity escalation exhausted")
-            LAST_CAP_ATTEMPTS = cap_attempts + 1
+            with _COUNT_LOCK:
+                LAST_CAP_ATTEMPTS = cap_attempts + 1
             # New caps → new partial-group distribution; the one-shot
             # owner-capacity retry becomes available again.
             gmof_retried = False
@@ -1229,12 +1244,15 @@ def _run_impl(plan: Aggregate, executor, session=None) -> Table:
                                    prep.final_meta)
         else:
             table = _merge_global(out, agg_specs, prep.final_meta)
-        DISPATCH_COUNT += 1
+        with _COUNT_LOCK:
+            DISPATCH_COUNT += 1
         _record_join_actuals(session, prep, out)
+        # Emit the query-local attempt count, not the module global: a
+        # concurrent dispatch may have reset LAST_CAP_ATTEMPTS already.
         _emit_spmd_events(session,
                           "grouped-agg" if grouped else "global-agg",
-                          prep, caps, LAST_CAP_ATTEMPTS)
-        return table
+                          prep, caps, cap_attempts + 1)
+        return table, cap_attempts + 1
 
 
 def _run_stream(root, executor, sort_orders=(), session=None) -> Table:
@@ -1246,14 +1264,16 @@ def _run_stream(root, executor, sort_orders=(), session=None) -> Table:
     _faults.fault_point(_fltn.SPMD_DISPATCH)
     mode = "sort" if sort_orders else "stream"
     with _trace.span(SN.SPMD_DISPATCH, mode=mode) as sp:
-        table = _run_stream_impl(root, executor, sort_orders, session)
+        table, attempts = _run_stream_impl(root, executor, sort_orders,
+                                           session)
         if sp is not None:
             sp.attrs["rows"] = int(table.num_rows)
-            sp.attrs["cap_attempts"] = LAST_CAP_ATTEMPTS
+            sp.attrs["cap_attempts"] = attempts  # query-local; see _run
         return table
 
 
-def _run_stream_impl(root, executor, sort_orders=(), session=None) -> Table:
+def _run_stream_impl(root, executor, sort_orders=(), session=None
+                     ) -> Tuple[Table, int]:
     """Row-returning SPMD execution of a {Filter, Project, Join}* chain:
     every device runs the stages on its shard, the host gathers each
     device's valid rows and concatenates (VERDICT r3 #3a). With
@@ -1261,7 +1281,8 @@ def _run_stream_impl(root, executor, sort_orders=(), session=None) -> Table:
     on device (sample sort), so the gathered rows arrive globally sorted
     and the host does NO sort work."""
     global DISPATCH_COUNT, SORT_DISPATCH_COUNT, LAST_CAP_ATTEMPTS
-    LAST_CAP_ATTEMPTS = 1
+    with _COUNT_LOCK:
+        LAST_CAP_ATTEMPTS = 1
     caps: Dict[int, Tuple[int, int]] = {}
     prep = _prepare(root, executor, caps, session)  # once; see _run
     out_names = [n for n in root.schema.names if n in prep.final_meta]
@@ -1281,7 +1302,8 @@ def _run_stream_impl(root, executor, sort_orders=(), session=None) -> Table:
     out_pairs = tuple((n, prep.final_meta[n][2]) for n in out_names)
     n_xch = sum(1 for j in prep.joins.values() if j[0] == "x")
     for attempt in range(_MAX_CAP_RETRIES * (n_xch + 1) + 1):
-        LAST_CAP_ATTEMPTS = attempt + 1
+        with _COUNT_LOCK:
+            LAST_CAP_ATTEMPTS = attempt + 1
         descr = _StageDescr(prep.stages, prep.joins, prep.col_meta,
                             (), out_pairs, dict(caps), prep.project_live,
                             sort_orders=tuple(sort_orders))
@@ -1300,12 +1322,15 @@ def _run_stream_impl(root, executor, sort_orders=(), session=None) -> Table:
                 validity = jnp.asarray(
                     np.asarray(jax.device_get(out[f"ov:{n}"]))[mask])
             cols[n] = Column(dt, jnp.asarray(data), validity, dic)
-        DISPATCH_COUNT += 1
-        if mode == "sort":
-            SORT_DISPATCH_COUNT += 1
+        with _COUNT_LOCK:
+            DISPATCH_COUNT += 1
+            if mode == "sort":
+                SORT_DISPATCH_COUNT += 1
         _record_join_actuals(session, prep, out)
-        _emit_spmd_events(session, mode, prep, caps, LAST_CAP_ATTEMPTS)
-        return Table(cols)
+        # Query-local attempt count (see _run): the module global is
+        # last-dispatch observability only.
+        _emit_spmd_events(session, mode, prep, caps, attempt + 1)
+        return Table(cols), attempt + 1
     raise _Unsupported("exchange join capacity escalation exhausted")
 
 

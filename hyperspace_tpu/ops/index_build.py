@@ -15,6 +15,7 @@ sorted array into per-bucket parquet files at the host DMA boundary.
 
 from __future__ import annotations
 
+import threading
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -87,10 +88,22 @@ def build_sorted_buckets(table: Table, indexed_cols: Sequence[str],
 # asserting max_device_rows never exceeded the configured chunk budget
 # (SURVEY §7 hard-part #1: the build must stream, not materialize).
 CHUNK_STATS = {"max_device_rows": 0, "chunks": 0, "spill_bytes": 0}
+# Concurrent actions can build indexes in parallel (serving-path
+# refresh/optimize); every write goes through the helpers under the
+# lock — an unguarded max()+assign or += loses updates under contention
+# (HS301/HS302, scripts/analysis).
+_CHUNK_STATS_LOCK = threading.Lock()
 
 
 def _note_device_rows(n: int) -> None:
-    CHUNK_STATS["max_device_rows"] = max(CHUNK_STATS["max_device_rows"], n)
+    with _CHUNK_STATS_LOCK:
+        CHUNK_STATS["max_device_rows"] = max(
+            CHUNK_STATS["max_device_rows"], n)
+
+
+def _bump_chunk_stat(key: str, delta: int) -> None:
+    with _CHUNK_STATS_LOCK:
+        CHUNK_STATS[key] += delta
 
 
 def build_sorted_buckets_chunked(
@@ -154,7 +167,7 @@ def _chunked_spill_and_merge(files, columns, indexed_cols, num_buckets,
                 chunk = chunk.with_column(lineage_col,
                                           Column(INT64, jnp.asarray(ids)))
             _note_device_rows(chunk.num_rows)
-            CHUNK_STATS["chunks"] += 1
+            _bump_chunk_stat("chunks", 1)
             sorted_chunk, bounds = build_sorted_buckets(
                 chunk, indexed_cols, num_buckets)
             at = sorted_chunk.to_arrow()
@@ -163,7 +176,7 @@ def _chunked_spill_and_merge(files, columns, indexed_cols, num_buckets,
                 if hi <= lo:
                     continue
                 run = at.slice(lo, hi - lo)
-                CHUNK_STATS["spill_bytes"] += run.nbytes
+                _bump_chunk_stat("spill_bytes", run.nbytes)
                 w = writers.get(b)
                 if w is None:
                     w = pq.ParquetWriter(

@@ -610,14 +610,17 @@ def merge_join_indices(left_keys: jax.Array, right_keys_sorted: jax.Array,
     a padded expansion repeats in-bounds indices).
     """
     if shapes._is_tracer(left_keys):
-        lo = jnp.searchsorted(right_keys_sorted, left_keys, side="left")
-        hi = jnp.searchsorted(right_keys_sorted, left_keys, side="right")
-        counts = (hi - lo).astype(jnp.int32)
-        total = int(jnp.sum(counts))  # HOST SYNC (single scalar).
-        li, ri = _expand_matches(counts, lo, total)
-        if return_counts:
-            return li, ri, counts
-        return li, ri
+        # The expansion length is data-dependent: under tracing the
+        # host sync it requires is impossible (int() of a tracer is a
+        # ConcretizationTypeError — the HS311 bug class). Trace-side
+        # join programs precompute static capacities instead
+        # (parallel/sharding.py); fail typed rather than deep inside
+        # jax internals.
+        raise HyperspaceException(
+            "merge_join_indices cannot run under tracing: the join "
+            "expansion length is data-dependent and would need a "
+            "device->host sync. Traced callers must precompute a "
+            "static match capacity (see parallel/sharding.py).")
     n_l = int(left_keys.shape[0]) if left_valid is None else int(left_valid)
     n_r = int(right_keys_sorted.shape[0]) if right_valid is None \
         else int(right_valid)

@@ -22,6 +22,7 @@ sharding constraints) and registers in the serving ProgramBank keyed on
 
 from __future__ import annotations
 
+import threading
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -153,8 +154,11 @@ def last_collectives() -> Dict[str, int]:
 
 
 # Successful mesh builds in this process (bench/tests assert the
-# distributed path actually ran).
+# distributed path actually ran). Bumped only under the lock: builds
+# can run concurrently from serving-path actions, and an unguarded +=
+# loses updates (HS302, scripts/analysis lock-discipline registry).
 DISPATCH_COUNT = 0
+_COUNT_LOCK = threading.Lock()
 
 # Cross-process dictionary unions performed (the multihost dryrun asserts
 # the string path actually exercised it).
@@ -295,7 +299,8 @@ def distributed_build_sorted_buckets(
             key_dtypes=tuple(key_dtypes), mesh=mesh)
         if not bool(overflow):
             global DISPATCH_COUNT
-            DISPATCH_COUNT += 1
+            with _COUNT_LOCK:
+                DISPATCH_COUNT += 1
             out_cols = {}
             for name in table.names:
                 src = table.column(name)

@@ -61,6 +61,12 @@ COMPILE_COUNT = 0
 # staged composition dispatched several.
 DISPATCH_COUNT = 0
 
+# Tests assert these tallies EXACTLY, and concurrent serving workers
+# bump them; a per-instance lock (or none) loses increments under
+# contention, so both counters move only under this module lock
+# (HS302, scripts/analysis lock-discipline registry).
+_COUNT_LOCK = threading.Lock()
+
 
 def mesh_signature(mesh: Mesh) -> tuple:
     """Hashable identity of a mesh for program keys and telemetry:
@@ -207,12 +213,14 @@ class MeshProgram:
                     compiled = jax.jit(self._fn).lower(*args).compile()
                 entry = [compiled, None]
                 self._compiled[sig] = entry
-                COMPILE_COUNT += 1
+                with _COUNT_LOCK:
+                    COMPILE_COUNT += 1
         return entry
 
     def __call__(self, *args):
         global DISPATCH_COUNT
-        DISPATCH_COUNT += 1
+        with _COUNT_LOCK:
+            DISPATCH_COUNT += 1
         return self._get(args)[0](*args)
 
     def signature(self, args) -> tuple:

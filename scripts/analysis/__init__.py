@@ -1,0 +1,15 @@
+"""Multi-pass static-analysis framework behind ``scripts/lint.py``.
+
+One shared pipeline (engine.py: parse once, one AST walk per file)
+feeding three layers of passes:
+
+- ported.py — the retired monolith's ~12 gates, byte-identical output;
+- lock_pass.py / hostsync_pass.py / handoff_pass.py — the HS3xx
+  dataflow passes (lock discipline, jit host-sync accounting, thread
+  handoff);
+- engine-level hygiene — suppressions (``# hst: disable=HS###``),
+  baseline, HS-code doc drift, unused frozen-registry entries.
+
+``python scripts/lint.py`` is the single entrypoint (see cli.py for
+flags); docs/static_analysis.md is the user-facing catalog.
+"""

@@ -1,0 +1,75 @@
+"""Command line for the static-analysis framework.
+
+``python scripts/lint.py`` (the thin shim over this module) keeps the
+monolith's contract: print one line per problem, a trailing
+``lint: N problem(s) across M files`` summary, exit 1 on any active
+problem. Flags:
+
+- ``--json``            machine-readable findings (codes, anchors,
+                        related sites, suppression/baseline state);
+- ``--no-cache``        ignore and do not write the findings cache;
+- ``--ported-only``     run only the ported monolith gates (the parity
+                        surface the tests compare against
+                        legacy_reference);
+- ``--exemptions``      print every frozen-allowlist entry with its
+                        one-line justification, then exit 0;
+- ``--write-baseline``  grandfather all current findings into
+                        scripts/analysis/baseline.json;
+- ``--baseline PATH``   use a different baseline file.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Optional
+
+from . import engine
+
+
+def _print_exemptions() -> None:
+    from . import handoff_pass, hostsync_pass, lock_pass
+    lines = (lock_pass.describe_exemptions()
+             + hostsync_pass.describe_exemptions()
+             + handoff_pass.describe_exemptions())
+    print("frozen exemptions (each carries its justification; unused "
+          "entries fail lint as HS004):")
+    for ln in lines:
+        print("  " + ln)
+
+
+def main(argv: Optional[list] = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="lint.py",
+        description="hyperspace_tpu static analysis "
+                    "(docs/static_analysis.md)")
+    p.add_argument("--json", action="store_true", dest="as_json")
+    p.add_argument("--no-cache", action="store_true")
+    p.add_argument("--ported-only", action="store_true")
+    p.add_argument("--exemptions", action="store_true")
+    p.add_argument("--write-baseline", action="store_true")
+    p.add_argument("--baseline", default=None)
+    p.add_argument("--root", default=None, help=argparse.SUPPRESS)
+    args = p.parse_args(argv)
+
+    if args.exemptions:
+        _print_exemptions()
+        return 0
+    if args.write_baseline:
+        path = engine.write_baseline(args.root, args.baseline)
+        print(f"baseline written: {path}")
+        return 0
+
+    result = engine.run(args.root, ported_only=args.ported_only,
+                        use_cache=not args.no_cache,
+                        baseline_path=args.baseline)
+    if args.as_json:
+        print(json.dumps(result.to_json(), indent=2))
+    else:
+        print(result.render_text())
+    return 1 if result.active() else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

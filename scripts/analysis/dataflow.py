@@ -1,0 +1,301 @@
+"""Shared intraprocedural-dataflow machinery for the HS3xx passes.
+
+Deliberately modest scope — everything here is *intra*procedural and
+syntax-directed:
+
+- function/method maps with qualnames and lexical parent chains
+  (:func:`function_map`), so passes resolve a called name to its local
+  definition (nested defs shadow module-level ones, like the runtime);
+- lexical ``with``-guard sets (:func:`guarded_node_ids`): the node ids
+  inside any ``with`` statement whose items include a given lock
+  expression — the lock-discipline pass's "lexically inside
+  ``with self._lock``" check;
+- a conservative taint lattice (:class:`Taint`): names derived from
+  device computations (``jnp.*``/``jax.*`` calls, known jitted
+  callables, declared device parameters) are tainted; shape/dtype/len
+  accesses launder the taint. No fixpoint — statements are scanned
+  twice in order, which converges for the straight-line + simple-loop
+  bodies kernel code actually has. False NEGATIVES are possible by
+  design (a device value smuggled through an unregistered helper);
+  false positives should be treated as pass bugs.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+FUNC_TYPES = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+MUTATOR_METHODS = {"append", "appendleft", "add", "update", "setdefault",
+                   "pop", "popitem", "popleft", "clear", "extend",
+                   "insert", "remove", "discard", "move_to_end"}
+
+
+class FuncInfo:
+    __slots__ = ("node", "qualname", "parent", "cls")
+
+    def __init__(self, node, qualname: str, parent, cls: Optional[str]):
+        self.node = node
+        self.qualname = qualname
+        self.parent = parent  # enclosing FuncInfo or None
+        self.cls = cls        # name of the enclosing class, if a method
+
+
+class FuncMap(dict):
+    """id(FunctionDef) -> FuncInfo, plus resolution indexes built ONCE
+    per file (the transitive handoff scan resolves one call per edge —
+    rebuilding the indexes per call would be quadratic)."""
+
+    def __init__(self, items):
+        super().__init__(items)
+        self.by_parent: Dict[Optional[int], Dict[str, FuncInfo]] = {}
+        self.by_method: Dict[Tuple[str, str], FuncInfo] = {}
+        for info in self.values():
+            key = id(info.parent) if info.parent is not None else None
+            self.by_parent.setdefault(key, {})[info.node.name] = info
+            if info.cls is not None:
+                self.by_method[(info.cls, info.node.name)] = info
+
+
+def function_map(tree: ast.AST) -> FuncMap:
+    """FuncMap for every def in the module, with dotted qualnames
+    (``outer.inner``, ``Class.method``)."""
+    out: Dict[int, FuncInfo] = {}
+
+    def visit(node, prefix: str, parent, cls):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, FUNC_TYPES):
+                q = f"{prefix}{child.name}"
+                info = FuncInfo(child, q, parent, cls)
+                out[id(child)] = info
+                visit(child, q + ".", info, None)
+            elif isinstance(child, ast.ClassDef):
+                visit(child, f"{prefix}{child.name}.", parent, child.name)
+            else:
+                visit(child, prefix, parent, cls)
+
+    visit(tree, "", None, None)
+    return FuncMap(out)
+
+
+def resolve_callable(name: str, site_fn: Optional[FuncInfo],
+                     funcs: FuncMap) -> Optional[FuncInfo]:
+    """The FuncInfo a bare name refers to from inside ``site_fn``:
+    nested defs of the enclosing chain first, then module level."""
+    fn = site_fn
+    while fn is not None:
+        hit = funcs.by_parent.get(id(fn), {}).get(name)
+        if hit is not None:
+            return hit
+        fn = fn.parent
+    info = funcs.by_parent.get(None, {}).get(name)
+    if info is not None and info.cls is None:
+        return info
+    return None
+
+
+def resolve_method(cls_name: str, meth: str,
+                   funcs: FuncMap) -> Optional[FuncInfo]:
+    return funcs.by_method.get((cls_name, meth))
+
+
+def dotted_name(node) -> str:
+    """'a.b.c' for Name/Attribute chains, '' otherwise."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def _lock_item_matches(expr, spec: str) -> bool:
+    """``spec`` forms: "self._lock" / "_LOCK_NAME" / "_STATE.lock"."""
+    return dotted_name(expr) == spec
+
+
+def guarded_node_ids(scope: ast.AST, lock_specs) -> Set[int]:
+    """ids of every node lexically inside a ``with`` whose items include
+    one of ``lock_specs`` (dotted-name strings), searched under
+    ``scope``."""
+    specs = tuple(lock_specs)
+    out: Set[int] = set()
+    for node in ast.walk(scope):
+        if not isinstance(node, (ast.With, ast.AsyncWith)):
+            continue
+        if not any(_lock_item_matches(item.context_expr, s)
+                   for item in node.items for s in specs):
+            continue
+        for stmt in node.body:
+            for sub in ast.walk(stmt):
+                out.add(id(sub))
+            out.add(id(stmt))
+    return out
+
+
+def self_attr_of_target(t) -> Optional[str]:
+    """The base ``self.<attr>`` an assignment target mutates, digging
+    through subscripts (``self._stats[k]`` mutates ``_stats``)."""
+    while isinstance(t, ast.Subscript):
+        t = t.value
+    if isinstance(t, ast.Attribute) and isinstance(t.value, ast.Name) \
+            and t.value.id == "self":
+        return t.attr
+    return None
+
+
+def global_name_of_target(t) -> Optional[str]:
+    while isinstance(t, ast.Subscript):
+        t = t.value
+    if isinstance(t, ast.Name):
+        return t.id
+    return None
+
+
+def reads_attr(expr, attr: str) -> bool:
+    """Does ``expr`` read ``self.<attr>`` anywhere? (RMW detection.)"""
+    for node in ast.walk(expr):
+        if isinstance(node, ast.Attribute) and node.attr == attr \
+                and isinstance(node.value, ast.Name) \
+                and node.value.id == "self":
+            return True
+    return False
+
+
+def reads_name(expr, name: str) -> bool:
+    for node in ast.walk(expr):
+        if isinstance(node, ast.Name) and node.id == name:
+            return True
+    return False
+
+
+# ---------------------------------------------------------------------------
+# Taint.
+# ---------------------------------------------------------------------------
+
+_DEVICE_PREFIXES = ("jnp.", "jax.", "lax.")
+# Cross-module calls whose results are device values wherever they are
+# used (the ProgramBank dispatch helpers).
+DEVICE_PRODUCER_CALLS = frozenset({
+    "run_fused_region", "run_fused_predicate",
+    "run_fused_predicate_sweep",
+})
+# Attribute accesses that LAUNDER taint: static metadata of an array,
+# not its payload (``int(x.shape[0])`` is host arithmetic).
+_STATIC_ATTRS = ("shape", "ndim", "dtype", "size")
+_HOST_CALLS = ("int", "float", "bool", "len", "str", "repr", "range",
+               "max", "min", "isinstance")
+
+
+class Taint:
+    """Conservative device-value taint over one function body."""
+
+    def __init__(self, func: ast.AST, seed_params: Set[str],
+                 jitted_names: Set[str]):
+        self.jitted = jitted_names
+        self.tainted: Set[str] = set(seed_params)
+        body = getattr(func, "body", [])
+        for _ in range(2):  # simple loops converge on the second scan
+            for stmt in body:
+                self._scan(stmt)
+
+    def _scan(self, stmt) -> None:
+        for node in ast.walk(stmt):
+            if isinstance(node, ast.Assign):
+                if self.expr_tainted(node.value):
+                    for t in node.targets:
+                        self._taint_target(t)
+            elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                if self.expr_tainted(node.value):
+                    self._taint_target(node.target)
+            elif isinstance(node, ast.AugAssign):
+                if self.expr_tainted(node.value):
+                    self._taint_target(node.target)
+            elif isinstance(node, (ast.For, ast.comprehension)):
+                if self.expr_tainted(node.iter):
+                    self._taint_target(node.target)
+
+    def _taint_target(self, t) -> None:
+        if isinstance(t, ast.Name):
+            self.tainted.add(t.id)
+        elif isinstance(t, (ast.Tuple, ast.List)):
+            for e in t.elts:
+                self._taint_target(e)
+        elif isinstance(t, ast.Starred):
+            self._taint_target(t.value)
+
+    def call_produces_device(self, node: ast.Call) -> bool:
+        name = dotted_name(node.func)
+        if not name:
+            return False
+        leaf = name.split(".")[-1]
+        if name.startswith(_DEVICE_PREFIXES) and leaf not in (
+                "issubdtype", "iinfo", "finfo", "promote_types",
+                "monitoring", "dtype"):
+            return True
+        if leaf in DEVICE_PRODUCER_CALLS or name in self.jitted \
+                or leaf in self.jitted:
+            return True
+        return False
+
+    def expr_tainted(self, e) -> bool:
+        if e is None:
+            return False
+        if isinstance(e, ast.Name):
+            return e.id in self.tainted
+        if isinstance(e, ast.Attribute):
+            if e.attr in _STATIC_ATTRS:
+                return False
+            return self.expr_tainted(e.value)
+        if isinstance(e, ast.Subscript):
+            return self.expr_tainted(e.value)
+        if isinstance(e, ast.Call):
+            name = dotted_name(e.func)
+            if name in _HOST_CALLS:
+                return False
+            if self.call_produces_device(e):
+                return True
+            # A method on a tainted receiver stays tainted
+            # (``codes.astype(...)``, ``mask.sum()``).
+            if isinstance(e.func, ast.Attribute) \
+                    and self.expr_tainted(e.func.value):
+                return True
+            return False
+        if isinstance(e, (ast.BinOp,)):
+            return self.expr_tainted(e.left) or self.expr_tainted(e.right)
+        if isinstance(e, ast.UnaryOp):
+            return self.expr_tainted(e.operand)
+        if isinstance(e, ast.BoolOp):
+            return any(self.expr_tainted(v) for v in e.values)
+        if isinstance(e, ast.Compare):
+            return self.expr_tainted(e.left) \
+                or any(self.expr_tainted(c) for c in e.comparators)
+        if isinstance(e, ast.IfExp):
+            return self.expr_tainted(e.body) or self.expr_tainted(e.orelse)
+        if isinstance(e, (ast.Tuple, ast.List, ast.Set)):
+            return any(self.expr_tainted(v) for v in e.elts)
+        if isinstance(e, ast.Starred):
+            return self.expr_tainted(e.value)
+        return False
+
+
+def call_args_of(node: ast.Call) -> Tuple[list, dict]:
+    return node.args, {k.arg: k.value for k in node.keywords}
+
+
+def walk_own(func: ast.AST):
+    """Walk a function's own statements WITHOUT descending into nested
+    function definitions (those are visited through their own FuncInfo;
+    and a def lexically under a ``with`` does not RUN under it).
+    Breadth-first like ``ast.walk``, so site ordering is stable."""
+    queue = list(ast.iter_child_nodes(func))
+    i = 0
+    while i < len(queue):
+        node = queue[i]
+        i += 1
+        yield node
+        if not isinstance(node, FUNC_TYPES + (ast.Lambda,)):
+            queue.extend(ast.iter_child_nodes(node))

@@ -1,0 +1,155 @@
+"""Typed diagnostic model of the static-analysis framework.
+
+Every finding any pass emits is one :class:`Diagnostic` — a stable
+``HS###`` code, a ``file:line:col`` anchor, a human message, and an
+optional *related* site (the second location a dataflow finding points
+at: the lock that should have been held, the contextvar read a thread
+handoff loses, the jit entry a traced sync sits under).
+
+Code space (frozen; docs/static_analysis.md carries the same table and
+the HS003 drift pass keeps the two in lockstep):
+
+- ``HS0xx`` — the framework itself (syntax, suppressions, baselines,
+  registry hygiene);
+- ``HS1xx`` — style gates ported from the retired monolith;
+- ``HS2xx`` — discipline gates ported from the retired monolith;
+- ``HS3xx`` — the dataflow passes (lock discipline, host-sync
+  accounting, thread handoff).
+
+Ported gates keep their pre-framework message text byte-identical (the
+parity contract with ``legacy_reference.collect``), so their rendered
+line omits the code; ``--json`` carries codes for every finding.
+
+Suppression: a source line may carry ``# hst: disable=HS###`` (comma-
+separated for several codes) to silence findings anchored on that line.
+A directive that silences nothing is itself a finding (``HS002``).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+# code -> one-line title. Keys are unique by construction (dict); the
+# uniqueness TEST (tests/test_static_analysis.py) guards against a
+# duplicate literal silently overwriting an entry, mirroring the
+# span/fault-names frozen-registry precedent.
+CODES = {
+    "HS001": "syntax error",
+    "HS002": "unused suppression directive",
+    "HS003": "HS-code documentation drift",
+    "HS004": "unused frozen-registry exemption",
+    "HS005": "stale baseline entry",
+    "HS101": "tab character",
+    "HS102": "trailing whitespace",
+    "HS103": "line longer than the cap",
+    "HS104": "unused import",
+    "HS201": "ad-hoc environment read",
+    "HS202": "undocumented config key",
+    "HS203": "jax.jit outside the instrumented modules",
+    "HS204": "shard_map/pmap is banned repo-wide",
+    "HS205": "unstated sharding on a distributed jit",
+    "HS206": "module-level mutable state",
+    "HS207": "free-form span name",
+    "HS208": "free-form fault-point name",
+    "HS209": "free-form fusion-boundary kind",
+    "HS210": "exception swallowing",
+    "HS211": "thread construction outside parallel/io.py",
+    "HS212": "event class never observed by tests",
+    "HS213": "span name never observed by tests",
+    "HS214": "fault point never injected by tests",
+    "HS215": "fusion boundary never exercised by tests",
+    "HS301": "unguarded shared-state mutation",
+    "HS302": "unguarded read-modify-write",
+    "HS311": "host sync inside traced code",
+    "HS312": "unallowlisted host sync at a jit-adjacent site",
+    "HS321": "raw thread handoff of context-dependent work",
+}
+
+# Raw source text of a suppression directive (engine.py owns parsing).
+SUPPRESS_DIRECTIVE = "hst: disable="
+
+
+class Related:
+    """The second site a two-point finding references."""
+
+    __slots__ = ("path", "line", "note")
+
+    def __init__(self, path: str, line: int, note: str = ""):
+        self.path = path
+        self.line = line
+        self.note = note
+
+    def to_json(self) -> dict:
+        out = {"path": self.path, "line": self.line}
+        if self.note:
+            out["note"] = self.note
+        return out
+
+
+class Diagnostic:
+    __slots__ = ("code", "path", "line", "col", "message", "related",
+                 "legacy_text", "suppressed", "baselined")
+
+    def __init__(self, code: str, path: str, line: int, message: str,
+                 col: int = 0, related: Optional[Related] = None,
+                 legacy_text: Optional[str] = None):
+        if code not in CODES:
+            raise ValueError(f"unknown diagnostic code {code!r}")
+        self.code = code
+        self.path = path
+        self.line = line
+        self.col = col
+        self.message = message
+        self.related = related
+        # Ported gates carry the monolith's exact output line here; the
+        # text renderer prints it verbatim (the parity contract).
+        self.legacy_text = legacy_text
+        self.suppressed = False
+        self.baselined = False
+
+    def text(self) -> str:
+        if self.legacy_text is not None:
+            return self.legacy_text
+        out = f"{self.path}:{self.line}:{self.col}: {self.code}: " \
+              f"{self.message}"
+        if self.related is not None:
+            out += f" (related: {self.related.path}:{self.related.line}"
+            if self.related.note:
+                out += f" — {self.related.note}"
+            out += ")"
+        return out
+
+    def to_json(self) -> dict:
+        out = {
+            "code": self.code,
+            "title": CODES[self.code],
+            "path": self.path,
+            "line": self.line,
+            "col": self.col,
+            "message": self.message,
+            "suppressed": self.suppressed,
+            "baselined": self.baselined,
+        }
+        if self.related is not None:
+            out["related"] = self.related.to_json()
+        return out
+
+    @classmethod
+    def from_json(cls, d: dict) -> "Diagnostic":
+        rel = d.get("related")
+        out = cls(d["code"], d["path"], d["line"], d["message"],
+                  col=d.get("col", 0),
+                  related=Related(rel["path"], rel["line"],
+                                  rel.get("note", ""))
+                  if rel else None,
+                  legacy_text=d.get("legacy_text"))
+        return out
+
+    def to_cache(self) -> dict:
+        """Cache serialization: like to_json plus the verbatim legacy
+        line (suppressed/baselined are re-derived per run)."""
+        out = self.to_json()
+        del out["suppressed"], out["baselined"], out["title"]
+        if self.legacy_text is not None:
+            out["legacy_text"] = self.legacy_text
+        return out

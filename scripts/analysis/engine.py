@@ -1,0 +1,497 @@
+"""Pass manager: parse every source exactly once, share one AST walk.
+
+The retired monolith (legacy_reference.py) ran ~a dozen independent
+``ast.walk`` traversals per file per run — one per gate — plus three
+extra parses of the frozen-name registry files. The manager here:
+
+- loads the file list once (the monolith's own ``iter_sources`` order,
+  so finding order is byte-identical);
+- parses each file exactly ONCE (``Result.parse_count`` asserts it);
+- builds ONE :class:`NodeIndex` per tree (a single ``ast.walk``) that
+  every pass consumes — a ported gate that used to re-walk the whole
+  tree now iterates just its node types;
+- runs the ported gates in the monolith's exact order (per file, then
+  the four coverage finalizers), then the dataflow passes, then the
+  framework's own hygiene checks;
+- applies ``# hst: disable=HS###`` line suppressions (flagging unused
+  directives, HS002) and the optional checked-in baseline
+  (``scripts/analysis/baseline.json``; stale entries are HS005);
+- memoizes per-file findings in a content-hash cache
+  (``scripts/analysis/.lint_cache.json``, git-ignored) keyed by the
+  file's sha AND an environment fingerprint covering the analyzer's own
+  sources, the docs the doc-drift gates read, and the frozen-name
+  registries — so a warm run re-analyzes only what changed.
+"""
+
+from __future__ import annotations
+
+import ast
+import hashlib
+import json
+import os
+import re
+from typing import Dict, List, Optional
+
+from . import legacy_reference as legacy
+from .diagnostics import CODES, Diagnostic
+
+DEFAULT_ROOT = legacy.ROOT
+BASELINE_REL = os.path.join("scripts", "analysis", "baseline.json")
+CACHE_REL = os.path.join("scripts", "analysis", ".lint_cache.json")
+STATIC_ANALYSIS_DOC = os.path.join("docs", "static_analysis.md")
+_CACHE_VERSION = 1
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*hst:\s*disable=([A-Z]{2}\d{3}(?:\s*,\s*[A-Z]{2}\d{3})*)")
+
+
+class NodeIndex:
+    """All nodes of a tree grouped by type, from ONE ``ast.walk``.
+
+    ``ast.walk`` is breadth-first; each per-type list preserves that
+    order, so a gate iterating ``index.of(ast.Call)`` sees call nodes in
+    exactly the order its ``ast.walk`` loop used to — the property the
+    byte-identical-output parity contract rides on.
+    """
+
+    def __init__(self, tree: ast.AST):
+        by_type: Dict[type, list] = {}
+        order: Dict[int, int] = {}
+        for i, node in enumerate(ast.walk(tree)):
+            by_type.setdefault(type(node), []).append(node)
+            order[id(node)] = i
+        self._by_type = by_type
+        self._order = order
+
+    def of(self, *types) -> list:
+        if len(types) == 1:
+            return self._by_type.get(types[0], [])
+        out: list = []
+        for t in types:
+            out.extend(self._by_type.get(t, []))
+        # Multi-type queries re-merge into walk order, so gates that
+        # fold several node types into one stateful scan (e.g. the
+        # unused-import dict, where a later import shadows an earlier
+        # one) behave exactly like their ast.walk originals.
+        out.sort(key=lambda n: self._order[id(n)])
+        return out
+
+
+class SourceFile:
+    """One loaded source: text always; tree/index only when analyzed
+    this run (a cache hit never parses)."""
+
+    def __init__(self, root: str, path: str):
+        self.path = path
+        self.rel = os.path.relpath(path, root)
+        self.slash_rel = self.rel.replace(os.sep, "/")
+        with open(path, encoding="utf-8") as f:
+            self.text = f.read()
+        self.sha = hashlib.sha256(self.text.encode("utf-8")).hexdigest()
+        self.lines = self.text.splitlines()
+        self.tree: Optional[ast.AST] = None
+        self.syntax_error: Optional[SyntaxError] = None
+        self._index: Optional[NodeIndex] = None
+        self.parsed = False
+
+    def parse(self) -> None:
+        if self.parsed:
+            return
+        self.parsed = True
+        try:
+            self.tree = ast.parse(self.text, filename=self.rel)
+        except SyntaxError as e:
+            self.syntax_error = e
+
+    @property
+    def index(self) -> NodeIndex:
+        if self._index is None:
+            if self.tree is None:
+                raise RuntimeError(f"{self.rel}: no tree to index")
+            self._index = NodeIndex(self.tree)
+        return self._index
+
+    def in_dirs(self, dirs) -> bool:
+        return any(self.rel.startswith(d + os.sep) for d in dirs)
+
+    @property
+    def is_package(self) -> bool:
+        return self.in_dirs(legacy.PACKAGE_DIRS)
+
+    @property
+    def is_test(self) -> bool:
+        return self.rel.startswith("tests" + os.sep)
+
+    def suppressions(self) -> Dict[int, set]:
+        """line number -> set of codes a directive on that line names.
+        Only real COMMENT tokens count — a directive spelled inside a
+        string literal (fixture snippets, docs) is not a directive.
+        The tokenize pass runs only for files whose raw text mentions
+        the marker at all, so the common case stays one substring
+        check."""
+        if "hst: disable=" not in self.text:
+            return {}
+        import io
+        import tokenize
+        out: Dict[int, set] = {}
+        try:
+            tokens = tokenize.generate_tokens(
+                io.StringIO(self.text).readline)
+            for tok in tokens:
+                if tok.type != tokenize.COMMENT:
+                    continue
+                m = _SUPPRESS_RE.search(tok.string)
+                if m:
+                    out.setdefault(tok.start[0], set()).update(
+                        c.strip() for c in m.group(1).split(","))
+        except (tokenize.TokenError, IndentationError, SyntaxError):
+            # Unparsable file: fall back to the line scan (the syntax
+            # gate already owns the real failure).
+            for i, line in enumerate(self.lines, 1):
+                m = _SUPPRESS_RE.search(line)
+                if m:
+                    out[i] = {c.strip() for c in m.group(1).split(",")}
+        return out
+
+
+class Context:
+    """Shared run state every pass reads (built once per run)."""
+
+    def __init__(self, root: str, sources: List[SourceFile]):
+        self.root = root
+        self.sources = sources
+        self.by_rel = {s.slash_rel: s for s in sources}
+        with open(os.path.join(root, legacy.CONFIG_DOC),
+                  encoding="utf-8") as f:
+            self.config_doc_text = f.read()
+        self.span_names = self._registry(legacy.SPAN_NAMES_FILE)
+        self.fault_names = self._registry(legacy.FAULT_NAMES_FILE)
+        self.fusion_kinds = self._registry(legacy.FUSION_BOUNDARIES_FILE)
+        # Facts the finalizers consume; per-file passes (or the cache)
+        # fill them in file order.
+        self.event_classes: list = []
+        self.registry_hits: Dict[str, set] = {
+            "span": set(), "fault": set(), "fusion": set(),
+            "event": set()}
+        self.used_exemptions: set = set()
+        # Exemption ids the CURRENT file's dataflow passes consumed —
+        # drained into the per-file cache entry by the engine.
+        self._file_exemptions: set = set()
+
+    def note_exemption(self, eid: str) -> None:
+        self._file_exemptions.add(eid)
+
+    def pop_file_exemptions(self) -> set:
+        out = self._file_exemptions
+        self._file_exemptions = set()
+        return out
+
+    def _registry(self, rel: str) -> dict:
+        with open(os.path.join(self.root, rel), encoding="utf-8") as f:
+            return legacy.span_name_constants(ast.parse(f.read()))
+
+    def note_test_text(self, src: SourceFile) -> dict:
+        """Which registered names this test file's text mentions — the
+        coverage gates' substring-containment check, made per-file so it
+        caches. The events file precedes tests/ in source order
+        (hyperspace_tpu walks first), so ``event_classes`` is always
+        populated by the time a test file lands here; a change to the
+        events file invalidates the whole cache via the env
+        fingerprint."""
+        return {
+            "span": [v for v in self.span_names.values()
+                     if v in src.text],
+            "fault": [v for v in self.fault_names.values()
+                      if v in src.text],
+            "fusion": [v for v in self.fusion_kinds.values()
+                       if v in src.text],
+            "event": [n for n in self.event_classes if n in src.text],
+        }
+
+    def absorb_test_hits(self, hits: dict) -> None:
+        for k in ("span", "fault", "fusion", "event"):
+            self.registry_hits[k].update(hits.get(k, []))
+
+
+class Result:
+    def __init__(self, problems: List[Diagnostic], file_count: int,
+                 parse_count: int):
+        self.problems = problems
+        self.file_count = file_count
+        self.parse_count = parse_count
+
+    def active(self) -> List[Diagnostic]:
+        return [d for d in self.problems
+                if not d.suppressed and not d.baselined]
+
+    def render_text(self) -> str:
+        lines = [d.text() for d in self.active()]
+        # Exactly the monolith's summary wording.
+        lines.append(f"lint: {len(self.active())} problem(s) across "
+                     f"{self.file_count} files")
+        return "\n".join(lines)
+
+    def to_json(self) -> dict:
+        return {
+            "version": 1,
+            "files": self.file_count,
+            "problems": [d.to_json() for d in self.problems],
+            "count": len(self.active()),
+        }
+
+
+# ---------------------------------------------------------------------------
+# Environment fingerprint + cache.
+# ---------------------------------------------------------------------------
+
+def _env_fingerprint(root: str) -> str:
+    """sha over everything that can change a finding besides the file
+    itself: the analyzer's own sources, the doc files the drift gates
+    compare against, the frozen-name registries, the events taxonomy,
+    and the baseline."""
+    h = hashlib.sha256()
+    here = os.path.dirname(os.path.abspath(__file__))
+    for name in sorted(os.listdir(here)):
+        if name.endswith(".py"):
+            with open(os.path.join(here, name), "rb") as f:
+                h.update(name.encode())
+                h.update(f.read())
+    for rel in (legacy.CONFIG_DOC, STATIC_ANALYSIS_DOC,
+                legacy.SPAN_NAMES_FILE, legacy.FAULT_NAMES_FILE,
+                legacy.FUSION_BOUNDARIES_FILE, legacy.EVENTS_FILE,
+                BASELINE_REL):
+        p = os.path.join(root, rel)
+        h.update(rel.encode())
+        if os.path.exists(p):
+            with open(p, "rb") as f:
+                h.update(f.read())
+    return h.hexdigest()
+
+
+def _load_cache(root: str, env: str) -> dict:
+    try:
+        with open(os.path.join(root, CACHE_REL), encoding="utf-8") as f:
+            cache = json.load(f)
+        if cache.get("version") == _CACHE_VERSION \
+                and cache.get("env") == env:
+            return cache.get("files", {})
+    except Exception:
+        pass
+    return {}
+
+
+def _save_cache(root: str, env: str, files: dict) -> None:
+    try:
+        path = os.path.join(root, CACHE_REL)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        tmp = path + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump({"version": _CACHE_VERSION, "env": env,
+                       "files": files}, f)
+        os.replace(tmp, path)
+    except Exception:
+        pass  # the cache is an optimization, never a failure
+
+
+# ---------------------------------------------------------------------------
+# The run.
+# ---------------------------------------------------------------------------
+
+def run(root: Optional[str] = None, *, ported_only: bool = False,
+        use_cache: bool = True,
+        baseline_path: Optional[str] = None) -> Result:
+    from . import handoff_pass, hostsync_pass, lock_pass, ported
+    root = DEFAULT_ROOT if root is None else root
+    env = _env_fingerprint(root)
+    cache = _load_cache(root, env) if use_cache else {}
+    new_cache: dict = {}
+
+    sources = [SourceFile(root, p) for p in legacy.iter_sources(root)]
+    ctx = Context(root, sources)
+
+    parse_count = 0
+    per_file_ported: List[List[Diagnostic]] = []
+    per_file_dataflow: List[List[Diagnostic]] = []
+    for src in sources:
+        entry = cache.get(src.slash_rel)
+        if entry is not None and entry.get("sha") == src.sha:
+            ported_d = [_diag_from_cache(d) for d in entry["ported"]]
+            dataflow_d = [_diag_from_cache(d) for d in entry["dataflow"]]
+            facts = entry.get("facts", {})
+            new_cache[src.slash_rel] = entry
+        else:
+            src.parse()
+            parse_count += 1
+            ported_d = ported.check_file(src, ctx)
+            facts = {}
+            if src.slash_rel == legacy.EVENTS_FILE \
+                    and src.tree is not None:
+                facts["event_classes"] = \
+                    legacy.event_class_names(src.tree)
+            if src.is_test:
+                facts["test_hits"] = ctx.note_test_text(src)
+            dataflow_d = []
+            if src.syntax_error is None:
+                dataflow_d += lock_pass.check_file(src, ctx)
+                dataflow_d += hostsync_pass.check_file(src, ctx)
+                dataflow_d += handoff_pass.check_file(src, ctx)
+            facts["used_exemptions"] = sorted(ctx.pop_file_exemptions())
+            new_cache[src.slash_rel] = {
+                "sha": src.sha,
+                "ported": [d.to_cache() for d in ported_d],
+                "dataflow": [d.to_cache() for d in dataflow_d],
+                "facts": facts,
+            }
+        # Re-absorb facts (cached or fresh) into the run context.
+        if "event_classes" in facts:
+            ctx.event_classes = facts["event_classes"]
+        if "test_hits" in facts:
+            ctx.absorb_test_hits(facts["test_hits"])
+        ctx.used_exemptions.update(facts.get("used_exemptions", []))
+        per_file_ported.append(ported_d)
+        per_file_dataflow.append(dataflow_d)
+
+    problems: List[Diagnostic] = []
+    for d in per_file_ported:
+        problems.extend(d)
+    problems.extend(ported.finalize(ctx))
+    if not ported_only:
+        for d in per_file_dataflow:
+            problems.extend(d)
+        problems.extend(_unused_exemptions(ctx))
+        problems.extend(_doc_drift(ctx))
+
+    _apply_suppressions(sources, problems, ported_only)
+    _apply_baseline(root, problems, baseline_path)
+
+    if use_cache:
+        _save_cache(root, env, new_cache)
+    return Result(problems, len(sources), parse_count)
+
+
+def _diag_from_cache(d: dict) -> Diagnostic:
+    out = Diagnostic.from_json(d)
+    return out
+
+
+def _unused_exemptions(ctx: Context) -> List[Diagnostic]:
+    from . import handoff_pass, hostsync_pass, lock_pass
+    out = []
+    registered = {}
+    registered.update(lock_pass.exemption_ids())
+    registered.update(hostsync_pass.exemption_ids())
+    registered.update(handoff_pass.exemption_ids())
+    for eid in sorted(registered):
+        if eid not in ctx.used_exemptions:
+            out.append(Diagnostic(
+                "HS004", eid.split("#", 1)[0], 1,
+                f"frozen-allowlist entry '{eid}' matches no site; drop "
+                f"it (justification was: {registered[eid]})"))
+    return out
+
+
+def _doc_drift(ctx: Context) -> List[Diagnostic]:
+    """HS003: every diagnostic code must appear in the
+    docs/static_analysis.md table, and every HS### the table lists must
+    exist in the analyzer — the configuration.md-keys pattern."""
+    out = []
+    path = os.path.join(ctx.root, STATIC_ANALYSIS_DOC)
+    doc_rel = STATIC_ANALYSIS_DOC.replace(os.sep, "/")
+    if not os.path.exists(path):
+        out.append(Diagnostic(
+            "HS003", doc_rel, 1,
+            "docs/static_analysis.md is missing; it must carry the "
+            "HS### code table"))
+        return out
+    with open(path, encoding="utf-8") as f:
+        doc = f.read()
+    documented = set(re.findall(r"\bHS\d{3}\b", doc))
+    for code in sorted(CODES):
+        if code not in documented:
+            out.append(Diagnostic(
+                "HS003", doc_rel, 1,
+                f"diagnostic code {code} ({CODES[code]}) is not "
+                f"documented in {doc_rel}"))
+    for code in sorted(documented - set(CODES)):
+        out.append(Diagnostic(
+            "HS003", doc_rel, 1,
+            f"{doc_rel} documents {code}, which no pass emits; "
+            "drop it from the table"))
+    return out
+
+
+def _apply_suppressions(sources: List[SourceFile],
+                        problems: List[Diagnostic],
+                        ported_only: bool) -> None:
+    by_rel = {}
+    for src in sources:
+        sups = src.suppressions()
+        if sups:
+            by_rel[src.rel] = (src, sups)
+    if not by_rel:
+        return
+    used = set()  # (rel, line, code) triples a directive consumed
+    for d in problems:
+        entry = by_rel.get(d.path)
+        if entry is None:
+            continue
+        codes = entry[1].get(d.line)
+        if codes and d.code in codes:
+            d.suppressed = True
+            used.add((d.path, d.line, d.code))
+    if ported_only:
+        return  # parity runs must not append framework findings
+    for rel, (src, sups) in sorted(by_rel.items()):
+        for line, codes in sorted(sups.items()):
+            for code in sorted(codes):
+                if (rel, line, code) not in used:
+                    problems.append(Diagnostic(
+                        "HS002", rel, line,
+                        f"suppression of {code} matches no finding on "
+                        "this line; remove the directive"))
+
+
+def _apply_baseline(root: str, problems: List[Diagnostic],
+                    baseline_path: Optional[str]) -> None:
+    path = baseline_path or os.path.join(root, BASELINE_REL)
+    if not os.path.exists(path):
+        return
+    try:
+        with open(path, encoding="utf-8") as f:
+            entries = json.load(f).get("findings", [])
+    except Exception:
+        problems.append(Diagnostic(
+            "HS005", os.path.relpath(path, root), 1,
+            "baseline file is unreadable; regenerate it with "
+            "--write-baseline"))
+        return
+    keys = {(e.get("code"), e.get("path"), e.get("message"))
+            for e in entries}
+    matched = set()
+    for d in problems:
+        k = (d.code, d.path, d.message)
+        if k in keys:
+            d.baselined = True
+            matched.add(k)
+    for code, p, message in sorted(k for k in keys if k not in matched):
+        problems.append(Diagnostic(
+            "HS005", os.path.relpath(path, root), 1,
+            f"stale baseline entry ({code} {p}: {message!r}) matches "
+            "no current finding; regenerate the baseline"))
+
+
+def write_baseline(root: Optional[str] = None,
+                   path: Optional[str] = None) -> str:
+    """Grandfather every current active finding into the baseline."""
+    root = DEFAULT_ROOT if root is None else root
+    result = run(root, use_cache=False)
+    out = {"findings": [
+        {"code": d.code, "path": d.path, "message": d.message}
+        for d in result.problems if not d.suppressed
+        and d.code not in ("HS005",)]}
+    path = path or os.path.join(root, BASELINE_REL)
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(out, f, indent=2, sort_keys=True)
+        f.write("\n")
+    return path

@@ -1,0 +1,274 @@
+"""HS321 — thread-handoff checker (the r14 worker-fault bug class).
+
+Pool workers and raw threads never inherit the submitter's contextvars:
+a callable that reads ambient per-query state inside the worker — the
+armed fault registry, the active QueryContext, the io session scope,
+the trace — silently gets defaults there. The r14 fix pattern is
+explicit: either snapshot ``contextvars.copy_context()`` and run the
+callable inside it, or capture the state consumer-side and pass it as
+an explicit argument (``fault_point(name, reg=...)``).
+
+This pass checks every handoff site in package code:
+
+- ``threading.Thread(target=...)`` construction,
+- ``submit_serving(fn, ...)`` (the sanctioned serving-pool entry),
+- ``<executor>.submit(fn, ...)`` where the first argument resolves to a
+  local function/method (a non-callable first argument — e.g. a
+  DataFrame handed to ``ServingFrontend.submit`` — is not a thread
+  handoff and is skipped).
+
+A handoff is clean when the callable is a ``Context.run`` bound from
+``contextvars.copy_context()``, or when its transitive local body
+(module-level functions, ``self`` methods, nested defs; depth-bounded)
+performs no ambient context read. Ambient reads: ``active_context`` /
+``active_params`` / ``active_session`` / ``armed`` /
+``check_deadline`` / ``deadline_remaining_s`` calls,
+``<ContextVar>.get()`` on a module-level ContextVar,
+``fault_point(name)`` WITHOUT an explicit ``reg=``, and
+``trace.span``/``trace.add_span``. Reads delegated through an explicit
+``<ctx>.run(...)`` (the r14 idiom inside the serving drain loop) do not
+count — the context is handed over, which is the point.
+
+Deliberate exceptions go in :data:`HANDOFF_ALLOWLIST` with a one-line
+justification (printed by ``--exemptions``); unused entries are HS004.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Set
+
+from . import dataflow as df
+from .diagnostics import Diagnostic, Related
+
+CONTEXT_READERS = frozenset({
+    "active_context", "active_params", "active_session", "armed",
+    "check_deadline", "deadline_remaining_s",
+})
+_TRACE_RECEIVERS = ("trace", "_trace", "_tr")
+_MAX_DEPTH = 5
+
+# (slash rel, qualname of the function containing the handoff site)
+# -> justification.
+HANDOFF_ALLOWLIST: dict = {
+    # (empty: the tree is clean — r14 fixed the last of this class.
+    #  Entries added here must explain how the callable gets its
+    #  context state without the ambient contextvars.)
+}
+
+
+def exemption_ids() -> dict:
+    return {f"{rel}#handoff:{fn}": why
+            for (rel, fn), why in HANDOFF_ALLOWLIST.items()}
+
+
+def describe_exemptions() -> List[str]:
+    return [f"handoff[{rel}::{fn}]: {why}"
+            for (rel, fn), why in sorted(HANDOFF_ALLOWLIST.items())]
+
+
+def _contextvar_names(src) -> Set[str]:
+    out: Set[str] = set()
+    for node in src.tree.body:
+        if isinstance(node, ast.Assign) \
+                and isinstance(node.value, ast.Call):
+            name = df.dotted_name(node.value.func)
+            if name.split(".")[-1] == "ContextVar":
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        out.add(t.id)
+        elif isinstance(node, ast.AnnAssign) and node.value is not None \
+                and isinstance(node.value, ast.Call):
+            name = df.dotted_name(node.value.func)
+            if name.split(".")[-1] == "ContextVar" \
+                    and isinstance(node.target, ast.Name):
+                out.add(node.target.id)
+    return out
+
+
+def _ambient_reads(fn_node, cv_names: Set[str]) -> list:
+    """(node, what) ambient context reads performed directly in this
+    function's own body."""
+    out = []
+    for node in df.walk_own(fn_node):
+        if not isinstance(node, ast.Call):
+            continue
+        name = df.dotted_name(node.func)
+        leaf = name.split(".")[-1] if name else ""
+        if leaf in CONTEXT_READERS:
+            out.append((node, f"{leaf}()"))
+        elif leaf == "fault_point":
+            kws = {k.arg for k in node.keywords}
+            if "reg" not in kws and len(node.args) < 2:
+                out.append((node, "fault_point() without explicit reg="))
+        elif leaf == "get" and isinstance(node.func, ast.Attribute) \
+                and isinstance(node.func.value, ast.Name) \
+                and node.func.value.id in cv_names:
+            out.append((node, f"{node.func.value.id}.get()"))
+        elif leaf in ("span", "add_span") \
+                and isinstance(node.func, ast.Attribute) \
+                and isinstance(node.func.value, ast.Name) \
+                and node.func.value.id in _TRACE_RECEIVERS:
+            out.append((node, f"trace.{leaf}()"))
+    return out
+
+
+def _local_calls(fn_node) -> list:
+    """(kind, name) of calls resolvable locally: ('name', f) for bare
+    names, ('self', m) for self.m(...)."""
+    out = []
+    for node in df.walk_own(fn_node):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        if isinstance(f, ast.Name):
+            out.append(("name", f.id))
+        elif isinstance(f, ast.Attribute) \
+                and isinstance(f.value, ast.Name) and f.value.id == "self":
+            out.append(("self", f.attr))
+    return out
+
+
+def _is_copied_context_run(expr, site_fn, funcs) -> bool:
+    """``ctx.run`` where ``ctx`` was bound from
+    ``contextvars.copy_context()`` in an enclosing function."""
+    if not (isinstance(expr, ast.Attribute) and expr.attr == "run"
+            and isinstance(expr.value, ast.Name)):
+        return False
+    var = expr.value.id
+    fn = site_fn
+    while fn is not None:
+        for node in df.walk_own(fn.node):
+            if isinstance(node, ast.Assign) \
+                    and isinstance(node.value, ast.Call) \
+                    and df.dotted_name(node.value.func).split(".")[-1] \
+                    == "copy_context":
+                if any(isinstance(t, ast.Name) and t.id == var
+                       for t in node.targets):
+                    return True
+        fn = fn.parent
+    return False
+
+
+def _resolve_target(expr, site_fn, funcs, cls_of_site: Optional[str]):
+    """FuncInfo for the submitted callable, or None when opaque."""
+    if isinstance(expr, ast.Lambda):
+        return df.FuncInfo(expr, "<lambda>", site_fn, None)
+    if isinstance(expr, ast.Name):
+        return df.resolve_callable(expr.id, site_fn, funcs)
+    if isinstance(expr, ast.Attribute) \
+            and isinstance(expr.value, ast.Name) \
+            and expr.value.id == "self" and cls_of_site:
+        return df.resolve_method(cls_of_site, expr.attr, funcs)
+    return None
+
+
+def _scan_transitive(start, funcs, cv_names, cls: Optional[str]):
+    """First ambient read reachable from ``start`` through local calls
+    (depth-bounded, cycle-safe), or None."""
+    seen: Set[int] = set()
+    frontier = [(start, 0)]
+    while frontier:
+        info, depth = frontier.pop(0)
+        if id(info.node) in seen or depth > _MAX_DEPTH:
+            continue
+        seen.add(id(info.node))
+        reads = _ambient_reads(info.node, cv_names)
+        if reads:
+            return reads[0]
+        for kind, name in _local_calls(info.node):
+            nxt = None
+            if kind == "name":
+                nxt = df.resolve_callable(name, info, funcs)
+            elif kind == "self":
+                c = info.cls if info.cls else cls
+                if c:
+                    nxt = df.resolve_method(c, name, funcs)
+            if nxt is not None:
+                frontier.append((nxt, depth + 1))
+    return None
+
+
+def _handoff_sites(src) -> list:
+    """(call node, callable expr) for every thread-handoff site."""
+    out = []
+    for node in src.index.of(ast.Call):
+        name = df.dotted_name(node.func)
+        leaf = name.split(".")[-1] if name else ""
+        if leaf == "Thread" and (name == "threading.Thread"
+                                 or name == "Thread"):
+            target = None
+            for kw in node.keywords:
+                if kw.arg == "target":
+                    target = kw.value
+            if target is None and len(node.args) >= 2:
+                target = node.args[1]
+            if target is not None:
+                out.append((node, target))
+        elif leaf == "submit_serving" and node.args:
+            out.append((node, node.args[0]))
+        elif leaf == "submit" and isinstance(node.func, ast.Attribute) \
+                and node.args:
+            # Non-callable first args (ServingFrontend.submit takes a
+            # DataFrame/plan) fail resolution below and are skipped.
+            out.append((node, node.args[0]))
+    return out
+
+
+def check_file(src, ctx) -> List[Diagnostic]:
+    if not src.is_package:
+        return []
+    sites = _handoff_sites(src)
+    if not sites:
+        return []
+    out: List[Diagnostic] = []
+    rel = src.rel
+    funcs = df.function_map(src.tree)
+    cv_names = _contextvar_names(src)
+
+    # Which function each site sits in (for resolution scope).
+    def enclosing(node) -> Optional[df.FuncInfo]:
+        best = None
+        for info in funcs.values():
+            f = info.node
+            if f.lineno <= node.lineno <= max(
+                    getattr(f, "end_lineno", f.lineno), f.lineno):
+                if best is None or f.lineno > best.node.lineno:
+                    best = info
+        return best
+
+    for call, target in sites:
+        site_fn = enclosing(call)
+        cls = site_fn.cls if site_fn is not None else None
+        if cls is None and site_fn is not None:
+            p = site_fn
+            while p is not None and cls is None:
+                cls = p.cls
+                p = p.parent
+        if _is_copied_context_run(target, site_fn, funcs):
+            continue
+        resolved = _resolve_target(target, site_fn, funcs, cls)
+        if resolved is None:
+            # Opaque callable: a parameter-passed fn (submit_serving's
+            # own body) or a bound method of another object. The
+            # CALLER's handoff site is where the check applies.
+            continue
+        qual = site_fn.qualname if site_fn is not None else "<module>"
+        read = _scan_transitive(resolved, funcs, cv_names, cls)
+        if read is None:
+            continue
+        entry = HANDOFF_ALLOWLIST.get((src.slash_rel, qual))
+        if entry is not None:
+            ctx.note_exemption(f"{src.slash_rel}#handoff:{qual}")
+            continue
+        rnode, what = read
+        out.append(Diagnostic(
+            "HS321", rel, call.lineno,
+            f"callable '{resolved.qualname}' handed to a worker thread "
+            f"in {qual} reads ambient context ({what} at line "
+            f"{rnode.lineno}) that pool threads never inherit; wrap "
+            "the submission in contextvars.copy_context().run or pass "
+            "the state as an explicit argument (r14 contract)",
+            col=call.col_offset,
+            related=Related(rel, rnode.lineno, what)))
+    return out

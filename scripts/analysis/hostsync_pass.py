@@ -1,0 +1,350 @@
+"""HS311/HS312 — device→host sync detector for jit-adjacent code.
+
+Scope: the modules the jit-site gate (HS203) sanctions for ``jax.jit``
+plus the whole-plan fusion module whose region builders compile through
+the ProgramBank — the code that defines every traced program body in
+the tree.
+
+Two regions, two codes:
+
+- **traced code** (HS311): bodies of jitted functions (``@jax.jit`` /
+  ``partial(jax.jit, ...)`` decorators, functions passed to
+  ``jax.jit``/``jax.vmap``/``device_view``/``MeshProgram``, the
+  registered extra roots — fusion's builder — and the TRUE branch of
+  ``if shapes._is_tracer(x):`` guards, the repo's own "this code runs
+  under tracing" idiom). A ``.item()``/``.tolist()``/
+  ``jax.device_get``/``int()/float()/bool()/np.asarray`` on a traced
+  value here is at best a ConcretizationTypeError at trace time and at
+  worst a purity break — there is NO allowlist for it.
+- **host dispatch code** (HS312): the wrappers around program dispatch
+  may sync — that is the r15 contract: exactly the declared scalars per
+  site. Every sync on a device-derived value must match a frozen
+  :data:`HOST_SYNC_ALLOWLIST` entry ((module, function) → allowed sync
+  count + justification); extra or unlisted syncs are findings, and
+  entries that stop matching surface as HS004.
+
+Static arguments (``static_argnames``) are host values and never seed
+taint; ``.shape``/``.ndim``/``.dtype``/``len()`` launder it
+(``int(x.shape[0])`` is host arithmetic). :data:`TAINTED_PARAMS` names
+host functions whose parameters carry device values in from a caller
+(fusion's ``out`` program-output dicts) so their contract syncs are
+counted too.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Set
+
+from . import dataflow as df
+from . import legacy_reference as legacy
+from .diagnostics import Diagnostic, Related
+
+SCOPE_MODULES = frozenset(legacy.JIT_SITE_ALLOWLIST) | frozenset({
+    "hyperspace_tpu/execution/fusion.py",
+})
+
+# (slash rel, function qualname) -> (max allowed syncs, justification).
+HOST_SYNC_ALLOWLIST = {
+    ("hyperspace_tpu/ops/kernels.py", "mask_count_nonzero"): (
+        2, "fused filter front-end: ONE survivor-count scalar per call "
+           "(two exclusive branches, one sync each)"),
+    ("hyperspace_tpu/ops/kernels.py", "merge_join_indices"): (
+        1, "join output length is data-dependent: ONE total-matches "
+           "scalar per join"),
+    ("hyperspace_tpu/ops/kernels.py", "group_ids_from_sorted"): (
+        1, "group count is data-dependent: ONE last-group-id scalar "
+           "per aggregate"),
+    ("hyperspace_tpu/execution/fusion.py", "_prepare_side"): (
+        1, "inner-join side prep checks key uniqueness once per side "
+           "build (bool scalar); the fused region itself never syncs"),
+    ("hyperspace_tpu/execution/fusion.py", "_record_actuals"): (
+        1, "per-join observed-rows scalar feeding the q-error loop "
+           "(one per join stage, after the region program returned)"),
+    ("hyperspace_tpu/execution/fusion.py", "_finish_chain"): (
+        1, "THE one-scalar-per-region sync: the survivor count that "
+           "sizes the compaction gather"),
+    ("hyperspace_tpu/execution/fusion.py", "_finish_grouped"): (
+        1, "THE one-scalar-per-region sync: the group count that sizes "
+           "the output class"),
+    # pallas self_check is a diagnostic harness: it compares whole
+    # kernel outputs against jnp references host-side, by design. It
+    # never runs on a query path (Hyperspace.pallas_self_check only).
+    ("hyperspace_tpu/ops/pallas_kernels.py",
+     "self_check.chk_range_mask"): (
+        1, "self-check harness: full-array comparison vs reference"),
+    ("hyperspace_tpu/ops/pallas_kernels.py",
+     "self_check.chk_compare_mask"): (
+        1, "self-check harness: full-array comparison vs reference"),
+    ("hyperspace_tpu/ops/pallas_kernels.py", "self_check.chk_minmax"): (
+        4, "self-check harness: four scalar comparisons vs reference"),
+    ("hyperspace_tpu/ops/pallas_kernels.py",
+     "self_check.chk_histogram"): (
+        1, "self-check harness: full-array comparison vs reference"),
+}
+
+# Host functions whose listed PARAMETERS are device values handed in by
+# a caller (intraprocedural taint cannot see across the call).
+TAINTED_PARAMS = {
+    ("hyperspace_tpu/execution/fusion.py", "_record_actuals"): {"out"},
+    ("hyperspace_tpu/execution/fusion.py", "_finish_chain"): {"out"},
+    ("hyperspace_tpu/execution/fusion.py", "_finish_grouped"): {"out"},
+    ("hyperspace_tpu/execution/fusion.py", "_finish_global"): {"out"},
+}
+
+# Traced roots syntactic detection misses: functions compiled through a
+# factory indirection (fusion's builder) or called only from traced
+# bodies.
+EXTRA_TRACED_ROOTS = {
+    # (_pred_eval is imported from execution/evaluator.py — out of this
+    #  pass's module scope; the expression builders there are a known
+    #  coverage gap, see docs/static_analysis.md.)
+    "hyperspace_tpu/execution/fusion.py": frozenset({
+        "_make_builder", "_traced_agg", "_null_aware", "_sentinel"}),
+    "hyperspace_tpu/parallel/sharding.py": frozenset({
+        "device_view.run"}),
+}
+
+_SYNC_RECEIVER_CALLS = ("item", "tolist")
+_SYNC_FUNCS = ("int", "float", "bool")
+_SYNC_NP = ("np.asarray", "np.array", "numpy.asarray", "numpy.array")
+
+
+def exemption_ids() -> dict:
+    out = {}
+    for (rel, fn), (_n, why) in HOST_SYNC_ALLOWLIST.items():
+        out[f"{rel}#hostsync:{fn}"] = why
+    return out
+
+
+def describe_exemptions() -> List[str]:
+    out = []
+    for (rel, fn), (n, why) in sorted(HOST_SYNC_ALLOWLIST.items()):
+        out.append(f"hostsync[{rel}::{fn} <= {n} sync(s)]: {why}")
+    return out
+
+
+def _static_argnames(dec: ast.Call) -> Set[str]:
+    for kw in dec.keywords:
+        if kw.arg == "static_argnames":
+            v = kw.value
+            if isinstance(v, ast.Constant) and isinstance(v.value, str):
+                return {v.value}
+            if isinstance(v, (ast.Tuple, ast.List)):
+                return {e.value for e in v.elts
+                        if isinstance(e, ast.Constant)}
+    return set()
+
+
+def _jit_decorated(func) -> "tuple":
+    """(is_jitted, static names) from the decorator list."""
+    for dec in func.decorator_list:
+        name = df.dotted_name(dec if not isinstance(dec, ast.Call)
+                              else dec.func)
+        if name in ("jax.jit", "jit", "jax.pjit", "pjit"):
+            return True, (_static_argnames(dec)
+                          if isinstance(dec, ast.Call) else set())
+        if isinstance(dec, ast.Call) and name in ("partial",
+                                                  "functools.partial"):
+            if dec.args and df.dotted_name(dec.args[0]) in (
+                    "jax.jit", "jax.pjit"):
+                return True, _static_argnames(dec)
+    return False, set()
+
+
+def _collect_traced(src, funcs):
+    """(id(FunctionDef) -> static param names for every traced root,
+    registered extra roots that resolved to nothing)."""
+    traced: Dict[int, Set[str]] = {}
+    by_qual = {i.qualname: i for i in funcs.values()}
+    by_name: Dict[str, list] = {}
+    for i in funcs.values():
+        by_name.setdefault(i.node.name, []).append(i)
+
+    def mark(name: str, static: Set[str]) -> bool:
+        info = by_qual.get(name)
+        if info is None:
+            cands = by_name.get(name.split(".")[-1], [])
+            info = cands[0] if len(cands) == 1 else None
+        if info is None:
+            return False
+        traced.setdefault(id(info.node), set()).update(static)
+        return True
+
+    for info in funcs.values():
+        jitted, static = _jit_decorated(info.node)
+        if jitted:
+            traced.setdefault(id(info.node), set()).update(static)
+    for call in src.index.of(ast.Call):
+        name = df.dotted_name(call.func)
+        if name in ("jax.jit", "jax.pjit", "jax.vmap", "device_view",
+                    "MeshProgram", "sharding.MeshProgram"):
+            if call.args:
+                inner = call.args[0]
+                # jax.jit(jax.vmap(builder, ...)) and friends: mark any
+                # bare Name inside the first argument expression.
+                for sub in ast.walk(inner):
+                    if isinstance(sub, ast.Name):
+                        mark(sub.id, _static_argnames(call))
+    unresolved = [qual for qual in
+                  sorted(EXTRA_TRACED_ROOTS.get(src.slash_rel, ()))
+                  if not mark(qual, set())]
+    return traced, unresolved
+
+
+def _tracer_branches(func) -> List[ast.If]:
+    out = []
+    for node in ast.walk(func):
+        if isinstance(node, ast.If):
+            for sub in ast.walk(node.test):
+                if isinstance(sub, ast.Call) and df.dotted_name(
+                        sub.func).split(".")[-1] == "_is_tracer":
+                    out.append(node)
+                    break
+    return out
+
+
+def _sync_calls(scope_nodes, taint: df.Taint) -> list:
+    """(node, kind) for device→host syncs among ``scope_nodes``."""
+    out = []
+    for node in scope_nodes:
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        if isinstance(f, ast.Attribute) \
+                and f.attr in _SYNC_RECEIVER_CALLS:
+            if taint.expr_tainted(f.value):
+                out.append((node, f".{f.attr}()"))
+            continue
+        name = df.dotted_name(f)
+        if name in ("jax.device_get",):
+            out.append((node, "jax.device_get"))
+        elif name in _SYNC_NP and node.args \
+                and taint.expr_tainted(node.args[0]):
+            out.append((node, name))
+        elif name in _SYNC_FUNCS and node.args \
+                and taint.expr_tainted(node.args[0]):
+            out.append((node, f"{name}()"))
+    return out
+
+
+def check_file(src, ctx) -> List[Diagnostic]:
+    if src.slash_rel not in SCOPE_MODULES:
+        return []
+    out: List[Diagnostic] = []
+    rel = src.rel
+    funcs = df.function_map(src.tree)
+    traced, unresolved_roots = _collect_traced(src, funcs)
+    jitted_names = {i.node.name for i in funcs.values()
+                    if id(i.node) in traced}
+    for qual in unresolved_roots:
+        # A stale EXTRA_TRACED_ROOTS entry silently dropping HS311
+        # coverage would be the one frozen registry that rots without
+        # a signal — surface it like every other unused entry.
+        out.append(Diagnostic(
+            "HS004", rel, 1,
+            f"EXTRA_TRACED_ROOTS entry '{qual}' matches no function in "
+            f"{src.slash_rel}; the traced body it should cover is no "
+            "longer checked — fix or drop the entry"))
+
+    for info in funcs.values():
+        fn = info.node
+        in_traced = id(fn) in traced
+        if not in_traced and info.parent is not None \
+                and id(info.parent.node) in traced:
+            continue  # nested def inside a traced root: covered there
+        static = traced.get(id(fn), set())
+        params = {a.arg for a in fn.args.args + fn.args.kwonlyargs
+                  + fn.args.posonlyargs}
+        if in_traced:
+            # Nested defs run under the same trace: their params are
+            # traced values too (closures over the root's tracers).
+            for sub in ast.walk(fn):
+                if isinstance(sub, df.FUNC_TYPES) and sub is not fn:
+                    params |= {a.arg for a in sub.args.args
+                               + sub.args.kwonlyargs
+                               + sub.args.posonlyargs}
+            seed = params - static - {"self"}
+        else:
+            seed = set(TAINTED_PARAMS.get((src.slash_rel, info.qualname),
+                                          set())) & params
+        taint = df.Taint(fn, seed, jitted_names)
+        # _is_tracer(x) guards: x (and anything derived) is a tracer in
+        # the TRUE branch; the branch itself is traced region.
+        branches = [] if in_traced else _tracer_branches(fn)
+        branch_ids: Set[int] = set()
+        branch_taint = df.Taint(fn, seed | _branch_args(branches),
+                                jitted_names)
+        for br in branches:
+            for stmt in br.body:
+                for sub in ast.walk(stmt):
+                    branch_ids.add(id(sub))
+                branch_ids.add(id(stmt))
+
+        if in_traced:
+            syncs = _sync_calls(list(ast.walk(fn)), taint)
+            for node, kind in syncs:
+                out.append(Diagnostic(
+                    "HS311", rel, node.lineno,
+                    f"{kind} inside the traced body of "
+                    f"{info.qualname}: a device→host sync under "
+                    "tracing breaks the jit purity contract "
+                    "(ConcretizationTypeError at best)",
+                    col=node.col_offset,
+                    related=Related(rel, fn.lineno, "traced root")))
+            continue
+        # Host function: split syncs into traced-branch (HS311) and
+        # host-contract (HS312) sites.
+        own = list(df.walk_own(fn))
+        branch_syncs = _sync_calls(
+            [n for n in own if id(n) in branch_ids], branch_taint)
+        for node, kind in branch_syncs:
+            out.append(Diagnostic(
+                "HS311", rel, node.lineno,
+                f"{kind} inside the _is_tracer branch of "
+                f"{info.qualname}: this branch runs under tracing, "
+                "where a data-dependent sync cannot work",
+                col=node.col_offset,
+                related=Related(rel, fn.lineno, "tracer-guard branch")))
+        host_syncs = _sync_calls(
+            [n for n in own if id(n) not in branch_ids], taint)
+        if not host_syncs:
+            continue
+        entry = HOST_SYNC_ALLOWLIST.get((src.slash_rel, info.qualname))
+        if entry is not None:
+            ctx.note_exemption(
+                f"{src.slash_rel}#hostsync:{info.qualname}")
+            allowed, why = entry
+            if len(host_syncs) <= allowed:
+                continue
+            for node, kind in host_syncs[allowed:]:
+                out.append(Diagnostic(
+                    "HS312", rel, node.lineno,
+                    f"{kind} in {info.qualname} exceeds its frozen "
+                    f"sync budget ({allowed} allowed: {why})",
+                    col=node.col_offset,
+                    related=Related(rel, fn.lineno,
+                                    "HOST_SYNC_ALLOWLIST entry")))
+            continue
+        for node, kind in host_syncs:
+            out.append(Diagnostic(
+                "HS312", rel, node.lineno,
+                f"{kind} on a device value in {info.qualname}, which "
+                "has no HOST_SYNC_ALLOWLIST entry; every sanctioned "
+                "sync site is frozen with a justification "
+                "(one-scalar-per-region contract, r15)",
+                col=node.col_offset))
+    return out
+
+
+def _branch_args(branches) -> Set[str]:
+    out: Set[str] = set()
+    for br in branches:
+        for sub in ast.walk(br.test):
+            if isinstance(sub, ast.Call) and df.dotted_name(
+                    sub.func).split(".")[-1] == "_is_tracer":
+                for a in sub.args:
+                    for n in ast.walk(a):
+                        if isinstance(n, ast.Name):
+                            out.add(n.id)
+    return out

@@ -1,0 +1,699 @@
+#!/usr/bin/env python
+"""The pre-framework monolithic lint gate, kept verbatim as a REFERENCE.
+
+This module is the single-pass implementation `scripts/lint.py` shipped
+before the `scripts/analysis` framework replaced it. It exists for two
+jobs only:
+
+- **parity**: tests/test_static_analysis.py runs :func:`collect` beside
+  the framework's ported passes and asserts a byte-identical finding
+  set (every gate, every ordering quirk);
+- **perf baseline**: the same tests time it — each gate here re-walks
+  the full AST (~a dozen `ast.walk` traversals per file per run), the
+  inefficiency the framework's shared one-walk node index removes.
+
+It also remains the home of the frozen allowlists and the pure helper
+functions (`mutable_state_sites`, `fault_site_violations`, ...) that
+existing tests import via `scripts/lint.py` (which re-exports them).
+Do not "optimize" this module — its cost IS the baseline.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+MAX_LINE = 100
+PACKAGE_DIRS = ("hyperspace_tpu",)
+ALL_DIRS = ("hyperspace_tpu", "tests", "scripts")
+TOP_FILES = ("bench.py", "__graft_entry__.py")
+
+# Config/env-knob discipline: package code reads knobs through config.py
+# accessors, never ad-hoc os.environ — otherwise knobs are undocumented,
+# unhashable into cache keys, and invisible to the conf system. This list
+# is FROZEN: config.py is the sanctioned reader, the rest are pre-gate
+# legacy (executor-side switches documented in their module docstrings).
+# New modules (e.g. serving/) must not be added here.
+ENV_READ_ALLOWLIST = frozenset({
+    "hyperspace_tpu/config.py",
+    "hyperspace_tpu/execution/__init__.py",
+    "hyperspace_tpu/execution/index_cache.py",
+    "hyperspace_tpu/execution/spmd.py",
+    "hyperspace_tpu/native/__init__.py",
+    "hyperspace_tpu/ops/pallas_kernels.py",
+    "hyperspace_tpu/parallel/multihost.py",
+})
+
+# Compile-observability discipline: every jax.jit stays inside the
+# instrumented kernel modules, where the shape-class layer
+# (execution/shapes.py) can see and count its compiles. A jit in an
+# arbitrary module is invisible to the compile counter's attribution and
+# bypasses the padding contract. This list is FROZEN — new jitted stages
+# go into ops/kernels.py (or pallas_kernels.py for Mosaic), not new
+# files. (The r12 SPMD port removed the distributed modules' direct jits:
+# they launch through parallel/sharding.py, the one sanctioned mesh-jit
+# site.)
+JIT_SITE_ALLOWLIST = frozenset({
+    "hyperspace_tpu/ops/kernels.py",
+    "hyperspace_tpu/ops/pallas_kernels.py",
+    "hyperspace_tpu/execution/shapes.py",
+    "hyperspace_tpu/parallel/sharding.py",
+})
+
+# SPMD-idiom ratchet (the r12 port must be total and stay total):
+# 1. shard_map / pmap are forbidden REPO-WIDE, no allowlist — the
+#    distributed tier is built on NamedSharding + jit (GSPMD), the idiom
+#    that works on this image AND scales to multi-process pods. A
+#    per-device mapping primitive creeping back in would silently fork
+#    the two worlds again.
+# 2. In the distributed modules, every jax.jit must either pass explicit
+#    in_shardings/out_shardings or carry a documented sharding marker
+#    (a "# shardings:" or "# replicated" comment on the call line or the
+#    two lines above) — partitioning must be stated, never implied.
+SPMD_BANNED_NAMES = ("shard_map", "pmap")
+SPMD_JIT_SHARDING_MODULES = frozenset({
+    "hyperspace_tpu/parallel/sharding.py",
+    "hyperspace_tpu/parallel/mesh.py",
+    "hyperspace_tpu/parallel/multihost.py",
+    "hyperspace_tpu/parallel/distributed_build.py",
+    "hyperspace_tpu/parallel/distributed_query.py",
+    "hyperspace_tpu/execution/spmd.py",
+})
+
+
+def spmd_banned_sites(tree: ast.AST) -> list:
+    """(line, name) of shard_map/pmap references: attribute access
+    (jax.shard_map / jax.pmap), bare names, and imports. AST-based, so
+    prose in docstrings/comments never trips it."""
+    out = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Attribute) \
+                and node.attr in SPMD_BANNED_NAMES:
+            out.append((node.lineno, node.attr))
+        elif isinstance(node, ast.Name) and node.id in SPMD_BANNED_NAMES:
+            out.append((node.lineno, node.id))
+        elif isinstance(node, ast.ImportFrom) and node.module and any(
+                part in SPMD_BANNED_NAMES
+                for part in node.module.split(".")):
+            out.append((node.lineno, node.module))
+        elif isinstance(node, (ast.Import, ast.ImportFrom)):
+            for a in node.names:
+                if a.name and any(part in SPMD_BANNED_NAMES
+                                  for part in a.name.split(".")):
+                    out.append((node.lineno, a.name))
+    return sorted(set(out))
+
+
+def jit_sharding_violations(tree: ast.AST, lines: list) -> list:
+    """Lines of jax.jit/pjit CALLS in the distributed modules that
+    neither pass in_shardings/out_shardings nor carry a sharding marker
+    comment nearby."""
+    out = []
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in ("jit", "pjit")
+                and isinstance(node.func.value, ast.Name)
+                and node.func.value.id == "jax"):
+            continue
+        kw = {k.arg for k in node.keywords}
+        if {"in_shardings", "out_shardings"} & kw:
+            continue
+        lo = max(node.lineno - 5, 0)
+        nearby = "\n".join(lines[lo:node.lineno])
+        if "# shardings:" in nearby or "# replicated" in nearby:
+            continue
+        out.append(node.lineno)
+    return sorted(set(out))
+
+
+def iter_sources(root=None):
+    root = ROOT if root is None else root
+    for d in ALL_DIRS:
+        for r, _dirs, files in os.walk(os.path.join(root, d)):
+            for f in sorted(files):
+                if f.endswith(".py"):
+                    yield os.path.join(r, f)
+    for f in TOP_FILES:
+        yield os.path.join(root, f)
+
+
+def unused_imports(tree: ast.AST) -> list:
+    imported = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                name = (a.asname or a.name).split(".")[0]
+                imported[name] = node.lineno
+        elif isinstance(node, ast.ImportFrom):
+            if node.module == "__future__":
+                continue
+            for a in node.names:
+                if a.name == "*":
+                    continue
+                imported[a.asname or a.name] = node.lineno
+    used = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Name):
+            used.add(node.id)
+        elif isinstance(node, ast.Attribute):
+            n = node
+            while isinstance(n, ast.Attribute):
+                n = n.value
+            if isinstance(n, ast.Name):
+                used.add(n.id)
+        elif isinstance(node, ast.Constant) and isinstance(node.value, str) \
+                and len(node.value) < 200:
+            # Forward-reference annotations ('"HyperspaceConf"') count.
+            import re
+            used.update(re.findall(r"[A-Za-z_][A-Za-z0-9_]*", node.value))
+    # Strings can reference names (docstrings citing symbols don't count,
+    # but __all__ / annotations-as-strings do); be conservative.
+    return sorted((line, name) for name, line in imported.items()
+                  if name not in used and not name.startswith("_"))
+
+
+def jit_sites(tree: ast.AST) -> list:
+    """Line numbers of jax.jit / jax.pjit references (attribute access
+    covers bare calls, partial(jax.jit, ...) and decorators alike)."""
+    out = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Attribute) \
+                and node.attr in ("jit", "pjit") \
+                and isinstance(node.value, ast.Name) \
+                and node.value.id == "jax":
+            out.append(node.lineno)
+        elif isinstance(node, ast.ImportFrom) and node.module \
+                and node.module.split(".")[0] == "jax":
+            if any(a.name in ("jit", "pjit") for a in node.names):
+                out.append(node.lineno)
+    return sorted(set(out))
+
+
+# I/O-parallelism discipline: every thread/pool construction stays inside
+# parallel/io.py, whose shared reader pool enforces the ordered-gather
+# determinism contract and the hyperspace.tpu.io.maxInflightBytes budget.
+# An ad-hoc ThreadPoolExecutor/threading.Thread elsewhere would read
+# outside the byte budget and invisibly to the pool stats. This list is
+# FROZEN — new parallel stages go through parallel/io.py primitives
+# (map_ordered / prefetch_iter), not new pools.
+THREAD_SITE_ALLOWLIST = frozenset({
+    "hyperspace_tpu/parallel/io.py",
+})
+
+
+def thread_sites(tree: ast.AST) -> list:
+    """Line numbers of ThreadPoolExecutor / threading.Thread construction
+    references (attribute access covers bare calls and aliases; plain
+    Lock/Condition/local stay allowed everywhere)."""
+    out = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Attribute) \
+                and node.attr == "Thread" \
+                and isinstance(node.value, ast.Name) \
+                and node.value.id == "threading":
+            out.append(node.lineno)
+        elif isinstance(node, ast.Attribute) \
+                and node.attr == "ThreadPoolExecutor":
+            out.append(node.lineno)
+        elif isinstance(node, ast.Name) and node.id == "ThreadPoolExecutor":
+            out.append(node.lineno)
+        elif isinstance(node, ast.ImportFrom) and node.module \
+                and node.module.split(".")[0] in ("threading",
+                                                  "concurrent"):
+            if any(a.name in ("Thread", "ThreadPoolExecutor")
+                   for a in node.names):
+                out.append(node.lineno)
+    return sorted(set(out))
+
+
+# Shared-state discipline (the serving refactor's ratchet): module-level
+# MUTABLE containers (dict/list/set literals or constructor calls) are
+# process-global shared state — invisible to the per-query accounting,
+# unguarded against the multi-threaded serving path, and unclearable by
+# construction. New cross-query state must live in QueryContext
+# (serving/context.py) or one of the sanctioned frontend registries
+# (program bank, frontend queue, io pools). This list is FROZEN: it
+# names the files that already held module-level mutable state when the
+# gate landed (pre-serving legacy caches and the sanctioned registries);
+# nothing gets added.
+MUTABLE_STATE_ALLOWLIST = frozenset({
+    "hyperspace_tpu/execution/executor.py",       # CHUNK_SCAN_STATS
+    "hyperspace_tpu/execution/shapes.py",         # compile counters
+    "hyperspace_tpu/index/data_store.py",         # scheme registry+cache
+    "hyperspace_tpu/index/log_store.py",          # scheme registry
+    "hyperspace_tpu/ops/index_build.py",          # CHUNK_STATS
+    "hyperspace_tpu/parallel/io.py",              # pool stats (sanctioned)
+    "hyperspace_tpu/rules/data_skipping_rule.py",  # sketch-table cache
+    "hyperspace_tpu/serving/program_bank.py",     # THE program registry
+    "hyperspace_tpu/sources/default.py",          # format-suffix registry
+    "hyperspace_tpu/telemetry/logging.py",        # logger instance memo
+})
+
+_MUTABLE_CALLS = {"dict", "list", "set", "OrderedDict", "defaultdict",
+                  "deque", "Counter"}
+_MUTATOR_METHODS = {"append", "appendleft", "add", "update", "setdefault",
+                    "pop", "popitem", "clear", "extend", "insert",
+                    "remove", "discard", "move_to_end"}
+
+
+def _mutated_names(tree: ast.AST) -> set:
+    """Names the module writes THROUGH (``x[k] = ...``, ``x.append(...)``,
+    ``del x[k]``, ``x += ...``) — the signature of a container used as
+    state rather than as a constant lookup table."""
+    out = set()
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.Assign, ast.AugAssign)):
+            targets = node.targets if isinstance(node, ast.Assign) \
+                else [node.target]
+            for t in targets:
+                if isinstance(t, ast.Subscript) \
+                        and isinstance(t.value, ast.Name):
+                    out.add(t.value.id)
+        elif isinstance(node, ast.Delete):
+            for t in node.targets:
+                if isinstance(t, ast.Subscript) \
+                        and isinstance(t.value, ast.Name):
+                    out.add(t.value.id)
+        elif isinstance(node, ast.Call) \
+                and isinstance(node.func, ast.Attribute) \
+                and node.func.attr in _MUTATOR_METHODS \
+                and isinstance(node.func.value, ast.Name):
+            out.add(node.func.value.id)
+    return out
+
+
+def mutable_state_sites(tree: ast.AST) -> list:
+    """(line, name) of module-level mutable containers the module also
+    MUTATES — process-global shared state. Constant lookup tables
+    (dicts/sets never written through) and ContextVar/Lock plumbing stay
+    allowed everywhere."""
+    mutated = _mutated_names(tree)
+    out = []
+    for node in tree.body:
+        if isinstance(node, ast.Assign):
+            targets, value = node.targets, node.value
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            targets, value = [node.target], node.value
+        else:
+            continue
+        names = [t.id for t in targets if isinstance(t, ast.Name)]
+        if not names or names == ["__all__"]:
+            continue
+        mutable = isinstance(value, (ast.List, ast.Dict, ast.Set,
+                                     ast.ListComp, ast.DictComp,
+                                     ast.SetComp))
+        if not mutable and isinstance(value, ast.Call):
+            f = value.func
+            callee = f.id if isinstance(f, ast.Name) else (
+                f.attr if isinstance(f, ast.Attribute) else "")
+            mutable = callee in _MUTABLE_CALLS
+        if mutable and any(n in mutated for n in names):
+            out.append((node.lineno, names[0]))
+    return out
+
+
+# Span-naming discipline (the r13 tracing layer's ratchet): every
+# trace.span(...) / trace.add_span(...) site in package code must name
+# its span via a constant from the frozen telemetry/span_names.py
+# registry (or a string literal registered there) — free-form strings
+# would fragment the vocabulary dashboards and the Chrome exporter key
+# on. And like the event-taxonomy gate below, every REGISTERED span
+# name must be referenced under tests/: an unobserved span is
+# unverified observability.
+SPAN_NAMES_FILE = "hyperspace_tpu/telemetry/span_names.py"
+SPAN_MODULE_ALIASES = ("span_names", "SN", "_sn")
+
+
+def span_name_constants(tree: ast.AST) -> dict:
+    """Module-level UPPERCASE string constants of span_names.py:
+    constant name -> span name string."""
+    out = {}
+    for node in tree.body:
+        if not (isinstance(node, ast.Assign)
+                and isinstance(node.value, ast.Constant)
+                and isinstance(node.value.value, str)):
+            continue
+        for t in node.targets:
+            if isinstance(t, ast.Name) and t.id.isupper():
+                out[t.id] = node.value.value
+    return out
+
+
+def span_site_violations(tree: ast.AST, names: dict) -> list:
+    """(line, detail) of trace.span()/trace.add_span() calls whose name
+    argument is neither a span_names constant nor a registered literal."""
+    values = set(names.values())
+    out = []
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in ("span", "add_span")
+                and isinstance(node.func.value, ast.Name)
+                and node.func.value.id in ("trace", "_trace", "_tr")):
+            continue
+        if not node.args:
+            out.append((node.lineno, "no span name argument"))
+            continue
+        arg = node.args[0]
+        if isinstance(arg, ast.Attribute) \
+                and isinstance(arg.value, ast.Name) \
+                and arg.value.id in SPAN_MODULE_ALIASES \
+                and arg.attr in names:
+            continue
+        if isinstance(arg, ast.Constant) and arg.value in values:
+            continue
+        out.append((node.lineno,
+                    "span name must come from telemetry/span_names.py"))
+    return out
+
+
+# Fault-point discipline (the robustness layer's ratchet, mirroring the
+# span gate): every ``faults.fault_point(...)`` site in package code
+# must name its point via a constant from the frozen
+# robustness/fault_names.py registry (or a string literal registered
+# there), AND every registered name must be referenced under tests/ —
+# an uninjected fault point is unverified robustness.
+FAULT_NAMES_FILE = "hyperspace_tpu/robustness/fault_names.py"
+FAULT_MODULE_ALIASES = ("faults", "_faults")
+FAULT_NAME_ALIASES = ("fault_names", "_fn", "_fltn", "FN")
+
+
+def fault_site_violations(tree: ast.AST, names: dict) -> list:
+    """(line, detail) of fault_point() calls whose name argument is
+    neither a fault_names constant nor a registered literal."""
+    values = set(names.values())
+    out = []
+    for node in ast.walk(tree):
+        is_attr_call = (isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Attribute)
+                        and node.func.attr == "fault_point"
+                        and isinstance(node.func.value, ast.Name)
+                        and node.func.value.id in FAULT_MODULE_ALIASES)
+        is_name_call = (isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Name)
+                        and node.func.id == "fault_point")
+        if not (is_attr_call or is_name_call):
+            continue
+        if not node.args:
+            out.append((node.lineno, "no fault-point name argument"))
+            continue
+        arg = node.args[0]
+        if isinstance(arg, ast.Attribute) \
+                and isinstance(arg.value, ast.Name) \
+                and arg.value.id in FAULT_NAME_ALIASES \
+                and arg.attr in names:
+            continue
+        if isinstance(arg, ast.Constant) and arg.value in values:
+            continue
+        out.append((node.lineno, "fault-point name must come from "
+                    "robustness/fault_names.py"))
+    return out
+
+
+# Fusion-boundary discipline (the whole-plan-fusion layer's ratchet,
+# mirroring the span/fault gates): every region boundary or fallback the
+# fusion planner/executor draws — ``note_boundary(...)`` sites and
+# ``_FuseFallback(...)`` raises in execution/fusion.py — must name its
+# kind via a constant from the frozen execution/fusion_boundaries.py
+# registry (or a string literal registered there), AND every registered
+# kind must be referenced under tests/ — an unexercised boundary is an
+# unverified fallback path. The fused programs themselves compile ONLY
+# through the ProgramBank (ops/kernels.run_fused_region): fusion.py is
+# deliberately NOT in JIT_SITE_ALLOWLIST, so a direct jax.jit there
+# trips the jit-site gate above.
+FUSION_BOUNDARIES_FILE = "hyperspace_tpu/execution/fusion_boundaries.py"
+FUSION_BOUNDARY_ALIASES = ("fusion_boundaries", "FB", "_fb")
+FUSION_BOUNDARY_CALLS = ("note_boundary", "_FuseFallback", "FuseFallback")
+
+
+def fusion_boundary_violations(tree: ast.AST, names: dict) -> list:
+    """(line, detail) of note_boundary()/_FuseFallback() call sites whose
+    kind argument is neither a fusion_boundaries constant nor a
+    registered literal."""
+    values = set(names.values())
+    out = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        callee = f.attr if isinstance(f, ast.Attribute) else (
+            f.id if isinstance(f, ast.Name) else "")
+        if callee not in FUSION_BOUNDARY_CALLS:
+            continue
+        if not node.args:
+            out.append((node.lineno, "no boundary-kind argument"))
+            continue
+        arg = node.args[0]
+        if isinstance(arg, ast.Attribute) \
+                and isinstance(arg.value, ast.Name) \
+                and arg.value.id in FUSION_BOUNDARY_ALIASES \
+                and arg.attr in names:
+            continue
+        if isinstance(arg, ast.Constant) and arg.value in values:
+            continue
+        out.append((node.lineno, "boundary kind must come from "
+                    "execution/fusion_boundaries.py"))
+    return out
+
+
+# Exception-swallowing discipline (robustness ratchet): a bare
+# ``except:`` anywhere, or an ``except BaseException: pass`` that
+# swallows silently, hides crashes the robustness layer exists to
+# surface (cancellation, injected faults, worker death). The allowlist
+# is FROZEN and EMPTY — the tree was clean when the gate landed;
+# narrow the handler or handle the error instead.
+EXCEPT_SWALLOW_ALLOWLIST = frozenset()
+
+
+def _names_in_except_type(node) -> set:
+    if node is None:
+        return set()
+    types = node.elts if isinstance(node, ast.Tuple) else [node]
+    out = set()
+    for t in types:
+        if isinstance(t, ast.Name):
+            out.add(t.id)
+        elif isinstance(t, ast.Attribute):
+            out.add(t.attr)
+    return out
+
+
+def except_swallow_sites(tree: ast.AST) -> list:
+    """(line, detail) of forbidden handlers: bare ``except:`` (any
+    body), and ``except BaseException`` whose body is only ``pass``."""
+    out = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.ExceptHandler):
+            continue
+        if node.type is None:
+            out.append((node.lineno,
+                        "bare 'except:'; name the exception classes"))
+            continue
+        body_is_pass = all(isinstance(s, ast.Pass) for s in node.body)
+        if body_is_pass and "BaseException" in _names_in_except_type(
+                node.type):
+            out.append((node.lineno,
+                        "'except BaseException: pass' swallows "
+                        "cancellation and crashes silently"))
+    return out
+
+
+# Telemetry-coverage discipline: every event class defined in
+# telemetry/events.py must be referenced somewhere under tests/ — an
+# event no test ever observes is unverified observability (the
+# IndexTableCache counters were counted-but-unreported for three rounds
+# before r06 made them visible; this gate would have caught it).
+EVENTS_FILE = "hyperspace_tpu/telemetry/events.py"
+
+
+def event_class_names(tree: ast.AST) -> list:
+    return sorted(node.name for node in ast.walk(tree)
+                  if isinstance(node, ast.ClassDef))
+
+
+# Doc-drift discipline: every `hyperspace.tpu.*` config key the package
+# defines must be documented in docs/configuration.md — a key literal
+# that exists only in code is an undocumented knob. Full-string match
+# only, so prose mentioning the prefix never trips it.
+CONFIG_KEY_PATTERN = re.compile(
+    r"^hyperspace\.tpu(\.[A-Za-z][A-Za-z0-9_]*)+$")
+CONFIG_DOC = "docs/configuration.md"
+
+
+def config_key_literals(tree: ast.AST) -> list:
+    """(line, key) for every full-string hyperspace.tpu.* literal."""
+    out = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Constant) and isinstance(node.value, str) \
+                and CONFIG_KEY_PATTERN.match(node.value):
+            out.append((node.lineno, node.value))
+    return out
+
+
+def env_reads(tree: ast.AST) -> list:
+    """Line numbers of os.environ / os.getenv style env accesses."""
+    out = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Attribute) \
+                and isinstance(node.value, ast.Name) \
+                and node.value.id == "os" \
+                and node.attr in ("environ", "getenv"):
+            out.append(node.lineno)
+        elif isinstance(node, ast.ImportFrom) and node.module == "os":
+            if any(a.name in ("environ", "getenv") for a in node.names):
+                out.append(node.lineno)
+    return sorted(set(out))
+
+
+def collect(root=None) -> tuple:
+    """(problems, file count) over ``root`` — the verbatim body of the
+    retired monolith's ``main()``, parameterized for the parity tests."""
+    root = ROOT if root is None else root
+    problems = []
+    with open(os.path.join(root, CONFIG_DOC), encoding="utf-8") as f:
+        config_doc_text = f.read()
+    with open(os.path.join(root, SPAN_NAMES_FILE), encoding="utf-8") as f:
+        span_names = span_name_constants(ast.parse(f.read()))
+    with open(os.path.join(root, FAULT_NAMES_FILE), encoding="utf-8") as f:
+        fault_names = span_name_constants(ast.parse(f.read()))
+    with open(os.path.join(root, FUSION_BOUNDARIES_FILE),
+              encoding="utf-8") as f:
+        fusion_kinds = span_name_constants(ast.parse(f.read()))
+    event_classes: list = []
+    tests_text_parts: list = []
+    for path in iter_sources(root):
+        rel = os.path.relpath(path, root)
+        with open(path, encoding="utf-8") as f:
+            text = f.read()
+        if rel.startswith("tests" + os.sep):
+            tests_text_parts.append(text)
+        try:
+            tree = ast.parse(text, filename=rel)
+        except SyntaxError as e:
+            problems.append(f"{rel}:{e.lineno}: syntax error: {e.msg}")
+            continue
+        if rel.replace(os.sep, "/") == EVENTS_FILE:
+            event_classes = event_class_names(tree)
+        for i, line in enumerate(text.splitlines(), 1):
+            if "\t" in line:
+                problems.append(f"{rel}:{i}: tab character")
+            if line != line.rstrip():
+                problems.append(f"{rel}:{i}: trailing whitespace")
+            if len(line) > MAX_LINE:
+                problems.append(f"{rel}:{i}: line longer than {MAX_LINE}")
+        if any(rel.startswith(d + os.sep) for d in PACKAGE_DIRS) \
+                and os.path.basename(path) != "__init__.py":  # re-exports
+            for line, name in unused_imports(tree):
+                problems.append(f"{rel}:{line}: unused import '{name}'")
+        if any(rel.startswith(d + os.sep) for d in PACKAGE_DIRS) \
+                and rel.replace(os.sep, "/") not in ENV_READ_ALLOWLIST:
+            for line in env_reads(tree):
+                problems.append(
+                    f"{rel}:{line}: ad-hoc env read (os.environ/getenv); "
+                    "knobs must go through config.py accessors")
+        if any(rel.startswith(d + os.sep) for d in PACKAGE_DIRS):
+            for line, key in config_key_literals(tree):
+                if key not in config_doc_text:
+                    problems.append(
+                        f"{rel}:{line}: config key '{key}' is not "
+                        f"documented in {CONFIG_DOC}")
+        if any(rel.startswith(d + os.sep) for d in PACKAGE_DIRS) \
+                and rel.replace(os.sep, "/") not in JIT_SITE_ALLOWLIST:
+            for line in jit_sites(tree):
+                problems.append(
+                    f"{rel}:{line}: jax.jit outside the instrumented "
+                    "kernel modules; add the jitted stage to ops/kernels.py "
+                    "so the compile counter sees it")
+        for line, name in spmd_banned_sites(tree):
+            problems.append(
+                f"{rel}:{line}: '{name}' is forbidden repo-wide; the SPMD "
+                "tier is NamedSharding+jit only (parallel/sharding.py)")
+        if rel.replace(os.sep, "/") in SPMD_JIT_SHARDING_MODULES:
+            for line in jit_sharding_violations(tree, text.splitlines()):
+                problems.append(
+                    f"{rel}:{line}: jax.jit in a distributed module must "
+                    "pass explicit in_shardings/out_shardings or carry a "
+                    "'# shardings:'/'# replicated' marker comment")
+        if any(rel.startswith(d + os.sep) for d in PACKAGE_DIRS) \
+                and rel.replace(os.sep, "/") not in MUTABLE_STATE_ALLOWLIST:
+            for line, name in mutable_state_sites(tree):
+                problems.append(
+                    f"{rel}:{line}: module-level mutable state '{name}'; "
+                    "cross-query state belongs in QueryContext "
+                    "(serving/context.py) or a sanctioned frontend "
+                    "registry (see MUTABLE_STATE_ALLOWLIST)")
+        if any(rel.startswith(d + os.sep) for d in PACKAGE_DIRS):
+            for line, detail in span_site_violations(tree, span_names):
+                problems.append(
+                    f"{rel}:{line}: {detail} (frozen registry; free-form "
+                    "span strings are forbidden)")
+        if any(rel.startswith(d + os.sep) for d in PACKAGE_DIRS):
+            for line, detail in fault_site_violations(tree, fault_names):
+                problems.append(
+                    f"{rel}:{line}: {detail} (frozen registry; free-form "
+                    "fault-point strings are forbidden)")
+        if any(rel.startswith(d + os.sep) for d in PACKAGE_DIRS):
+            for line, detail in fusion_boundary_violations(tree,
+                                                           fusion_kinds):
+                problems.append(
+                    f"{rel}:{line}: {detail} (frozen registry; free-form "
+                    "fusion-boundary kinds are forbidden)")
+        if any(rel.startswith(d + os.sep) for d in PACKAGE_DIRS) \
+                and rel.replace(os.sep, "/") not in \
+                EXCEPT_SWALLOW_ALLOWLIST:
+            for line, detail in except_swallow_sites(tree):
+                problems.append(f"{rel}:{line}: {detail}")
+        if any(rel.startswith(d + os.sep) for d in PACKAGE_DIRS) \
+                and rel.replace(os.sep, "/") not in THREAD_SITE_ALLOWLIST:
+            for line in thread_sites(tree):
+                problems.append(
+                    f"{rel}:{line}: thread/pool construction outside "
+                    "parallel/io.py; route the work through its "
+                    "map_ordered/prefetch_iter so the in-flight byte "
+                    "budget and ordered-gather contract hold")
+    tests_text = "\n".join(tests_text_parts)
+    for name in event_classes:
+        if name not in tests_text:
+            problems.append(
+                f"{EVENTS_FILE}: event class '{name}' is never referenced "
+                "under tests/; add a test observing (or at least naming) it")
+    for const, value in sorted(span_names.items()):
+        if const == "SPAN_NAMES":
+            continue
+        if value not in tests_text:
+            problems.append(
+                f"{SPAN_NAMES_FILE}: span name '{value}' ({const}) is "
+                "never referenced under tests/; add a test observing it")
+    for const, value in sorted(fault_names.items()):
+        if const == "FAULT_NAMES":
+            continue
+        if value not in tests_text:
+            problems.append(
+                f"{FAULT_NAMES_FILE}: fault point '{value}' ({const}) is "
+                "never referenced under tests/; add a test injecting it")
+    for const, value in sorted(fusion_kinds.items()):
+        if const == "BOUNDARY_KINDS":
+            continue
+        if value not in tests_text:
+            problems.append(
+                f"{FUSION_BOUNDARIES_FILE}: boundary kind '{value}' "
+                f"({const}) is never referenced under tests/; add a test "
+                "exercising it")
+    return problems, sum(1 for _ in iter_sources(root))
+
+
+def main(root=None) -> int:
+    problems, file_count = collect(root)
+    for p in problems:
+        print(p)
+    print(f"lint: {len(problems)} problem(s) across {file_count} files")
+    return 1 if problems else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

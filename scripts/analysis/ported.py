@@ -1,0 +1,451 @@
+"""The monolith's ~12 gates, ported onto the shared AST pipeline.
+
+Every gate here is a line-for-line port of a `legacy_reference.py`
+function with its ``ast.walk`` traversals replaced by lookups in the
+file's one shared :class:`~.engine.NodeIndex` — same logic, same
+message text, same ordering, ONE tree walk per file instead of one per
+gate. tests/test_static_analysis.py asserts the output is byte-
+identical to the monolith's on the live tree and on seeded fixture
+trees; treat any behavior drift here as a bug even when the new
+behavior looks "more correct".
+
+The frozen allowlists stay in legacy_reference.py (their historical
+home, still imported by existing tests through the scripts/lint.py
+shim); this module reads them from there.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import List
+
+from . import legacy_reference as legacy
+from .diagnostics import Diagnostic
+
+# Gate order is the monolith's main() order; codes are the framework's
+# stable ids for suppression/--json (rendered text stays legacy).
+_pkg = legacy.PACKAGE_DIRS
+
+
+def _legacy_diag(code: str, rel: str, line, text: str) -> Diagnostic:
+    try:
+        anchor = int(line)
+    except (TypeError, ValueError):
+        anchor = 1
+    return Diagnostic(code, rel, anchor, text, legacy_text=text)
+
+
+# ---------------------------------------------------------------------------
+# Index-driven ports of the per-file gate helpers.
+# ---------------------------------------------------------------------------
+
+def unused_imports(idx) -> list:
+    imported = {}
+    for node in idx.of(ast.Import, ast.ImportFrom):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                name = (a.asname or a.name).split(".")[0]
+                imported[name] = node.lineno
+        else:
+            if node.module == "__future__":
+                continue
+            for a in node.names:
+                if a.name == "*":
+                    continue
+                imported[a.asname or a.name] = node.lineno
+    used = set()
+    for node in idx.of(ast.Name):
+        used.add(node.id)
+    for node in idx.of(ast.Attribute):
+        n = node
+        while isinstance(n, ast.Attribute):
+            n = n.value
+        if isinstance(n, ast.Name):
+            used.add(n.id)
+    for node in idx.of(ast.Constant):
+        if isinstance(node.value, str) and len(node.value) < 200:
+            used.update(re.findall(r"[A-Za-z_][A-Za-z0-9_]*", node.value))
+    return sorted((line, name) for name, line in imported.items()
+                  if name not in used and not name.startswith("_"))
+
+
+def env_reads(idx) -> list:
+    out = []
+    for node in idx.of(ast.Attribute):
+        if isinstance(node.value, ast.Name) and node.value.id == "os" \
+                and node.attr in ("environ", "getenv"):
+            out.append(node.lineno)
+    for node in idx.of(ast.ImportFrom):
+        if node.module == "os" and any(
+                a.name in ("environ", "getenv") for a in node.names):
+            out.append(node.lineno)
+    return sorted(set(out))
+
+
+def config_key_literals(idx) -> list:
+    out = []
+    for node in idx.of(ast.Constant):
+        if isinstance(node.value, str) \
+                and legacy.CONFIG_KEY_PATTERN.match(node.value):
+            out.append((node.lineno, node.value))
+    return out
+
+
+def jit_sites(idx) -> list:
+    out = []
+    for node in idx.of(ast.Attribute):
+        if node.attr in ("jit", "pjit") \
+                and isinstance(node.value, ast.Name) \
+                and node.value.id == "jax":
+            out.append(node.lineno)
+    for node in idx.of(ast.ImportFrom):
+        if node.module and node.module.split(".")[0] == "jax" \
+                and any(a.name in ("jit", "pjit") for a in node.names):
+            out.append(node.lineno)
+    return sorted(set(out))
+
+
+def spmd_banned_sites(idx) -> list:
+    out = []
+    for node in idx.of(ast.Attribute):
+        if node.attr in legacy.SPMD_BANNED_NAMES:
+            out.append((node.lineno, node.attr))
+    for node in idx.of(ast.Name):
+        if node.id in legacy.SPMD_BANNED_NAMES:
+            out.append((node.lineno, node.id))
+    for node in idx.of(ast.ImportFrom):
+        if node.module and any(part in legacy.SPMD_BANNED_NAMES
+                               for part in node.module.split(".")):
+            out.append((node.lineno, node.module))
+    for node in idx.of(ast.Import, ast.ImportFrom):
+        for a in node.names:
+            if a.name and any(part in legacy.SPMD_BANNED_NAMES
+                              for part in a.name.split(".")):
+                out.append((node.lineno, a.name))
+    return sorted(set(out))
+
+
+def jit_sharding_violations(idx, lines: list) -> list:
+    out = []
+    for node in idx.of(ast.Call):
+        if not (isinstance(node.func, ast.Attribute)
+                and node.func.attr in ("jit", "pjit")
+                and isinstance(node.func.value, ast.Name)
+                and node.func.value.id == "jax"):
+            continue
+        kw = {k.arg for k in node.keywords}
+        if {"in_shardings", "out_shardings"} & kw:
+            continue
+        lo = max(node.lineno - 5, 0)
+        nearby = "\n".join(lines[lo:node.lineno])
+        if "# shardings:" in nearby or "# replicated" in nearby:
+            continue
+        out.append(node.lineno)
+    return sorted(set(out))
+
+
+def thread_sites(idx) -> list:
+    out = []
+    for node in idx.of(ast.Attribute):
+        if node.attr == "Thread" and isinstance(node.value, ast.Name) \
+                and node.value.id == "threading":
+            out.append(node.lineno)
+        elif node.attr == "ThreadPoolExecutor":
+            out.append(node.lineno)
+    for node in idx.of(ast.Name):
+        if node.id == "ThreadPoolExecutor":
+            out.append(node.lineno)
+    for node in idx.of(ast.ImportFrom):
+        if node.module and node.module.split(".")[0] in (
+                "threading", "concurrent") and any(
+                a.name in ("Thread", "ThreadPoolExecutor")
+                for a in node.names):
+            out.append(node.lineno)
+    return sorted(set(out))
+
+
+def _mutated_names(idx) -> set:
+    out = set()
+    for node in idx.of(ast.Assign, ast.AugAssign):
+        targets = node.targets if isinstance(node, ast.Assign) \
+            else [node.target]
+        for t in targets:
+            if isinstance(t, ast.Subscript) \
+                    and isinstance(t.value, ast.Name):
+                out.add(t.value.id)
+    for node in idx.of(ast.Delete):
+        for t in node.targets:
+            if isinstance(t, ast.Subscript) \
+                    and isinstance(t.value, ast.Name):
+                out.add(t.value.id)
+    for node in idx.of(ast.Call):
+        if isinstance(node.func, ast.Attribute) \
+                and node.func.attr in legacy._MUTATOR_METHODS \
+                and isinstance(node.func.value, ast.Name):
+            out.add(node.func.value.id)
+    return out
+
+
+def mutable_state_sites(tree: ast.AST, idx) -> list:
+    mutated = _mutated_names(idx)
+    out = []
+    for node in tree.body:
+        if isinstance(node, ast.Assign):
+            targets, value = node.targets, node.value
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            targets, value = [node.target], node.value
+        else:
+            continue
+        names = [t.id for t in targets if isinstance(t, ast.Name)]
+        if not names or names == ["__all__"]:
+            continue
+        mutable = isinstance(value, (ast.List, ast.Dict, ast.Set,
+                                     ast.ListComp, ast.DictComp,
+                                     ast.SetComp))
+        if not mutable and isinstance(value, ast.Call):
+            f = value.func
+            callee = f.id if isinstance(f, ast.Name) else (
+                f.attr if isinstance(f, ast.Attribute) else "")
+            mutable = callee in legacy._MUTABLE_CALLS
+        if mutable and any(n in mutated for n in names):
+            out.append((node.lineno, names[0]))
+    return out
+
+
+def _registry_site_violations(idx, names: dict, *, call_attrs,
+                              recv_names, const_aliases,
+                              missing_msg: str, bad_msg: str,
+                              name_calls=()) -> list:
+    """Shared body of the span/fault/fusion site gates: call sites whose
+    first argument is neither an aliased registry constant nor a
+    registered literal."""
+    values = set(names.values())
+    out = []
+    for node in idx.of(ast.Call):
+        f = node.func
+        is_attr_call = (isinstance(f, ast.Attribute)
+                        and f.attr in call_attrs
+                        and isinstance(f.value, ast.Name)
+                        and f.value.id in recv_names)
+        is_name_call = (isinstance(f, ast.Name) and f.id in name_calls)
+        if not (is_attr_call or is_name_call):
+            continue
+        if not node.args:
+            out.append((node.lineno, missing_msg))
+            continue
+        arg = node.args[0]
+        if isinstance(arg, ast.Attribute) \
+                and isinstance(arg.value, ast.Name) \
+                and arg.value.id in const_aliases and arg.attr in names:
+            continue
+        if isinstance(arg, ast.Constant) and arg.value in values:
+            continue
+        out.append((node.lineno, bad_msg))
+    return out
+
+
+def span_site_violations(idx, names: dict) -> list:
+    return _registry_site_violations(
+        idx, names, call_attrs=("span", "add_span"),
+        recv_names=("trace", "_trace", "_tr"),
+        const_aliases=legacy.SPAN_MODULE_ALIASES,
+        missing_msg="no span name argument",
+        bad_msg="span name must come from telemetry/span_names.py")
+
+
+def fault_site_violations(idx, names: dict) -> list:
+    return _registry_site_violations(
+        idx, names, call_attrs=("fault_point",),
+        recv_names=legacy.FAULT_MODULE_ALIASES,
+        const_aliases=legacy.FAULT_NAME_ALIASES,
+        missing_msg="no fault-point name argument",
+        bad_msg="fault-point name must come from "
+                "robustness/fault_names.py",
+        name_calls=("fault_point",))
+
+
+def fusion_boundary_violations(idx, names: dict) -> list:
+    values = set(names.values())
+    out = []
+    for node in idx.of(ast.Call):
+        f = node.func
+        callee = f.attr if isinstance(f, ast.Attribute) else (
+            f.id if isinstance(f, ast.Name) else "")
+        if callee not in legacy.FUSION_BOUNDARY_CALLS:
+            continue
+        if not node.args:
+            out.append((node.lineno, "no boundary-kind argument"))
+            continue
+        arg = node.args[0]
+        if isinstance(arg, ast.Attribute) \
+                and isinstance(arg.value, ast.Name) \
+                and arg.value.id in legacy.FUSION_BOUNDARY_ALIASES \
+                and arg.attr in names:
+            continue
+        if isinstance(arg, ast.Constant) and arg.value in values:
+            continue
+        out.append((node.lineno, "boundary kind must come from "
+                    "execution/fusion_boundaries.py"))
+    return out
+
+
+def except_swallow_sites(idx) -> list:
+    out = []
+    for node in idx.of(ast.ExceptHandler):
+        if node.type is None:
+            out.append((node.lineno,
+                        "bare 'except:'; name the exception classes"))
+            continue
+        body_is_pass = all(isinstance(s, ast.Pass) for s in node.body)
+        if body_is_pass and "BaseException" in \
+                legacy._names_in_except_type(node.type):
+            out.append((node.lineno,
+                        "'except BaseException: pass' swallows "
+                        "cancellation and crashes silently"))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# The per-file runner (the monolith's main-loop body, gate by gate).
+# ---------------------------------------------------------------------------
+
+def check_file(src, ctx) -> List[Diagnostic]:
+    out: List[Diagnostic] = []
+    rel = src.rel
+    if src.syntax_error is not None:
+        e = src.syntax_error
+        out.append(_legacy_diag(
+            "HS001", rel, e.lineno,
+            f"{rel}:{e.lineno}: syntax error: {e.msg}"))
+        return out
+    idx = src.index
+    slash = src.slash_rel
+    in_pkg = src.is_package
+    for i, line in enumerate(src.lines, 1):
+        if "\t" in line:
+            out.append(_legacy_diag("HS101", rel, i,
+                                    f"{rel}:{i}: tab character"))
+        if line != line.rstrip():
+            out.append(_legacy_diag("HS102", rel, i,
+                                    f"{rel}:{i}: trailing whitespace"))
+        if len(line) > legacy.MAX_LINE:
+            out.append(_legacy_diag(
+                "HS103", rel, i,
+                f"{rel}:{i}: line longer than {legacy.MAX_LINE}"))
+    if in_pkg and os.path.basename(src.path) != "__init__.py":
+        for line, name in unused_imports(idx):
+            out.append(_legacy_diag(
+                "HS104", rel, line,
+                f"{rel}:{line}: unused import '{name}'"))
+    if in_pkg and slash not in legacy.ENV_READ_ALLOWLIST:
+        for line in env_reads(idx):
+            out.append(_legacy_diag(
+                "HS201", rel, line,
+                f"{rel}:{line}: ad-hoc env read (os.environ/getenv); "
+                "knobs must go through config.py accessors"))
+    if in_pkg:
+        for line, key in config_key_literals(idx):
+            if key not in ctx.config_doc_text:
+                out.append(_legacy_diag(
+                    "HS202", rel, line,
+                    f"{rel}:{line}: config key '{key}' is not "
+                    f"documented in {legacy.CONFIG_DOC}"))
+    if in_pkg and slash not in legacy.JIT_SITE_ALLOWLIST:
+        for line in jit_sites(idx):
+            out.append(_legacy_diag(
+                "HS203", rel, line,
+                f"{rel}:{line}: jax.jit outside the instrumented "
+                "kernel modules; add the jitted stage to ops/kernels.py "
+                "so the compile counter sees it"))
+    for line, name in spmd_banned_sites(idx):
+        out.append(_legacy_diag(
+            "HS204", rel, line,
+            f"{rel}:{line}: '{name}' is forbidden repo-wide; the SPMD "
+            "tier is NamedSharding+jit only (parallel/sharding.py)"))
+    if slash in legacy.SPMD_JIT_SHARDING_MODULES:
+        for line in jit_sharding_violations(idx, src.lines):
+            out.append(_legacy_diag(
+                "HS205", rel, line,
+                f"{rel}:{line}: jax.jit in a distributed module must "
+                "pass explicit in_shardings/out_shardings or carry a "
+                "'# shardings:'/'# replicated' marker comment"))
+    if in_pkg and slash not in legacy.MUTABLE_STATE_ALLOWLIST:
+        for line, name in mutable_state_sites(src.tree, idx):
+            out.append(_legacy_diag(
+                "HS206", rel, line,
+                f"{rel}:{line}: module-level mutable state '{name}'; "
+                "cross-query state belongs in QueryContext "
+                "(serving/context.py) or a sanctioned frontend "
+                "registry (see MUTABLE_STATE_ALLOWLIST)"))
+    if in_pkg:
+        for line, detail in span_site_violations(idx, ctx.span_names):
+            out.append(_legacy_diag(
+                "HS207", rel, line,
+                f"{rel}:{line}: {detail} (frozen registry; free-form "
+                "span strings are forbidden)"))
+        for line, detail in fault_site_violations(idx, ctx.fault_names):
+            out.append(_legacy_diag(
+                "HS208", rel, line,
+                f"{rel}:{line}: {detail} (frozen registry; free-form "
+                "fault-point strings are forbidden)"))
+        for line, detail in fusion_boundary_violations(idx,
+                                                       ctx.fusion_kinds):
+            out.append(_legacy_diag(
+                "HS209", rel, line,
+                f"{rel}:{line}: {detail} (frozen registry; free-form "
+                "fusion-boundary kinds are forbidden)"))
+    if in_pkg and slash not in legacy.EXCEPT_SWALLOW_ALLOWLIST:
+        for line, detail in except_swallow_sites(idx):
+            out.append(_legacy_diag("HS210", rel, line,
+                                    f"{rel}:{line}: {detail}"))
+    if in_pkg and slash not in legacy.THREAD_SITE_ALLOWLIST:
+        for line in thread_sites(idx):
+            out.append(_legacy_diag(
+                "HS211", rel, line,
+                f"{rel}:{line}: thread/pool construction outside "
+                "parallel/io.py; route the work through its "
+                "map_ordered/prefetch_iter so the in-flight byte "
+                "budget and ordered-gather contract hold"))
+    return out
+
+
+def finalize(ctx) -> List[Diagnostic]:
+    """The monolith's four trailing coverage checks, in its order."""
+    out: List[Diagnostic] = []
+    for name in ctx.event_classes:
+        if name not in ctx.registry_hits["event"]:
+            out.append(_legacy_diag(
+                "HS212", legacy.EVENTS_FILE, 1,
+                f"{legacy.EVENTS_FILE}: event class '{name}' is never "
+                "referenced under tests/; add a test observing (or at "
+                "least naming) it"))
+    for const, value in sorted(ctx.span_names.items()):
+        if const == "SPAN_NAMES":
+            continue
+        if value not in ctx.registry_hits["span"]:
+            out.append(_legacy_diag(
+                "HS213", legacy.SPAN_NAMES_FILE, 1,
+                f"{legacy.SPAN_NAMES_FILE}: span name '{value}' "
+                f"({const}) is never referenced under tests/; add a "
+                "test observing it"))
+    for const, value in sorted(ctx.fault_names.items()):
+        if const == "FAULT_NAMES":
+            continue
+        if value not in ctx.registry_hits["fault"]:
+            out.append(_legacy_diag(
+                "HS214", legacy.FAULT_NAMES_FILE, 1,
+                f"{legacy.FAULT_NAMES_FILE}: fault point '{value}' "
+                f"({const}) is never referenced under tests/; add a "
+                "test injecting it"))
+    for const, value in sorted(ctx.fusion_kinds.items()):
+        if const == "BOUNDARY_KINDS":
+            continue
+        if value not in ctx.registry_hits["fusion"]:
+            out.append(_legacy_diag(
+                "HS215", legacy.FUSION_BOUNDARIES_FILE, 1,
+                f"{legacy.FUSION_BOUNDARIES_FILE}: boundary kind "
+                f"'{value}' ({const}) is never referenced under tests/; "
+                "add a test exercising it"))
+    return out

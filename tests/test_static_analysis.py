@@ -1,0 +1,833 @@
+"""The static-analysis framework (scripts/analysis/) end to end.
+
+Four contracts:
+
+1. **Parity** — the ported gates emit a byte-identical finding set to
+   the retired monolith (legacy_reference.collect), on the live tree
+   AND on a fixture tree seeded with a violation of every gate.
+2. **Pipeline** — every source parses exactly once per run; the warm
+   (cached) run completes in well under half the monolith's wall-clock.
+3. **Dataflow passes** — lock discipline (HS301/302), host-sync
+   accounting (HS311/312), and thread handoff (HS321) each catch seeded
+   violations (positive), stay silent on the sanctioned idioms
+   (negative), honor `# hst: disable=` suppressions, and flag unused
+   suppressions/exemptions.
+4. **Convicted fixes** — the product races the lock pass surfaced
+   (chunk-stats watermarks, compile-listener double-registration,
+   dispatch tallies) stay fixed, and merge_join_indices under tracing
+   raises the typed error instead of a ConcretizationTypeError.
+
+Plus the CI gate: `python scripts/lint.py --json` over the real tree
+must report zero non-baselined findings (tier-1; analyzer regressions
+fail pytest).
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+import re
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SCRIPTS = os.path.join(ROOT, "scripts")
+if SCRIPTS not in sys.path:
+    sys.path.insert(0, SCRIPTS)
+
+from analysis import diagnostics, engine  # noqa: E402
+from analysis import handoff_pass, hostsync_pass, lock_pass  # noqa: E402
+from analysis import legacy_reference as legacy  # noqa: E402
+
+
+# ---------------------------------------------------------------------------
+# Fixture-tree scaffolding.
+# ---------------------------------------------------------------------------
+
+_MINIMAL = {
+    "docs/configuration.md": "hyperspace.tpu.documented.key\n",
+    "hyperspace_tpu/telemetry/span_names.py":
+        'QUERY = "query"\n',
+    "hyperspace_tpu/robustness/fault_names.py":
+        'IO_POOLED_READ = "io.pooled_read"\n',
+    "hyperspace_tpu/execution/fusion_boundaries.py":
+        'SORT = "sort"\n',
+    "tests/test_cover.py":
+        '_ = ["query", "io.pooled_read", "sort"]\n',
+    "bench.py": "",
+    "__graft_entry__.py": "",
+}
+
+
+def scaffold(tmp_path, files=None) -> str:
+    """A minimal lintable tree; ``files`` overlay/extend the base."""
+    merged = dict(_MINIMAL)
+    merged.update(files or {})
+    for rel, text in merged.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(text)
+    (tmp_path / "scripts").mkdir(exist_ok=True)
+    return str(tmp_path)
+
+
+def run_codes(root, **kw):
+    res = engine.run(root, use_cache=False, **kw)
+    return res, [d.code for d in res.problems
+                 if not d.suppressed and not d.baselined]
+
+
+# ---------------------------------------------------------------------------
+# 1. Parity with the monolith.
+# ---------------------------------------------------------------------------
+
+# One violation per ported gate (plus clean control lines).
+_SEEDED = {
+    "hyperspace_tpu/style_victim.py": (
+        "import os\n"
+        "import json\n"                      # unused import
+        "x = 1\t\n"                          # tab + trailing whitespace
+        "y = '" + "a" * 120 + "'\n"          # long line
+        "z = os.environ.get('HST_X')\n"      # env read
+        "k = 'hyperspace.tpu.mystery.key'\n"  # undocumented config key
+    ),
+    "hyperspace_tpu/jit_victim.py": (
+        "import jax\n"
+        "f = jax.jit(lambda v: v)\n"          # jit outside allowlist
+        "g = jax.pmap\n"                      # banned name
+    ),
+    "hyperspace_tpu/parallel/mesh.py": (
+        "import jax\n"
+        "h = jax.jit(lambda v: v)\n"          # no sharding marker
+    ),
+    "hyperspace_tpu/state_victim.py": (
+        "_CACHE = {}\n"
+        "def put(k, v):\n"
+        "    _CACHE[k] = v\n"                 # mutated module state
+    ),
+    "hyperspace_tpu/span_victim.py": (
+        "def f(trace):\n"
+        "    with trace.span('freeform'):\n"  # unregistered span
+        "        pass\n"
+        "def g(faults):\n"
+        "    fault_point = faults.fault_point\n"
+        "    fault_point('free.fault')\n"     # unregistered fault
+        "def h():\n"
+        "    note_boundary('free.kind')\n"    # unregistered boundary
+    ),
+    "hyperspace_tpu/except_victim.py": (
+        "def f():\n"
+        "    try:\n"
+        "        return 1\n"
+        "    except:\n"                       # bare except
+        "        pass\n"
+    ),
+    "hyperspace_tpu/thread_victim.py": (
+        "from concurrent.futures import ThreadPoolExecutor\n"
+        "def f(work):\n"
+        "    with ThreadPoolExecutor(2) as ex:\n"
+        "        return ex.map(work, [1])\n"
+    ),
+    "hyperspace_tpu/broken_victim.py": "def f(:\n",  # syntax error
+    "hyperspace_tpu/telemetry/events.py": (
+        "class OrphanEvent:\n"
+        "    pass\n"                          # never referenced in tests
+    ),
+    # registry values never referenced under tests/ (coverage gates)
+    "hyperspace_tpu/telemetry/span_names.py":
+        'QUERY = "query"\nORPHAN_SPAN = "orphan.span"\n',
+    "hyperspace_tpu/robustness/fault_names.py":
+        'IO_POOLED_READ = "io.pooled_read"\n'
+        'ORPHAN_FAULT = "orphan.fault"\n',
+    "hyperspace_tpu/execution/fusion_boundaries.py":
+        'SORT = "sort"\nORPHAN_KIND = "orphan.kind"\n',
+}
+
+
+class TestParity:
+    def _both(self, root):
+        problems, files = legacy.collect(root)
+        res = engine.run(root, ported_only=True, use_cache=False)
+        mine = [d.text() for d in res.problems
+                if not d.suppressed and not d.baselined]
+        return problems, files, mine, res.file_count
+
+    def test_live_tree_byte_identical(self):
+        problems, files, mine, my_files = self._both(ROOT)
+        assert mine == problems
+        assert my_files == files
+
+    def test_seeded_fixture_byte_identical(self, tmp_path):
+        root = scaffold(tmp_path, _SEEDED)
+        problems, files, mine, my_files = self._both(root)
+        assert problems, "fixture must actually trip the gates"
+        assert mine == problems
+        assert my_files == files
+        # Every ported gate fired at least once on the fixture.
+        text = "\n".join(problems)
+        for token in ("tab character", "trailing whitespace",
+                      "line longer than", "unused import",
+                      "ad-hoc env read", "is not documented",
+                      "jax.jit outside", "forbidden repo-wide",
+                      "distributed module", "module-level mutable state",
+                      "span name must", "fault-point name must",
+                      "boundary kind must", "bare 'except:'",
+                      "thread/pool construction", "syntax error",
+                      "never referenced under tests/"):
+            assert token in text, f"gate output missing: {token}"
+
+
+class TestPipeline:
+    def test_parses_each_file_exactly_once(self):
+        res = engine.run(ROOT, use_cache=False)
+        assert res.parse_count == res.file_count
+
+    def test_warm_run_well_under_half_the_monolith(self, tmp_path):
+        t0 = time.perf_counter()
+        legacy.collect(ROOT)
+        legacy_s = time.perf_counter() - t0
+        # Prime, then time the warm cached run (the steady state a
+        # developer/CI loop pays). The monolith re-walked every tree
+        # ~12x per run and had no cache at all.
+        engine.run(ROOT, use_cache=True)
+        t0 = time.perf_counter()
+        res = engine.run(ROOT, use_cache=True)
+        warm_s = time.perf_counter() - t0
+        assert res.parse_count == 0, "warm run must not re-parse"
+        assert warm_s < 0.5 * legacy_s, \
+            f"warm {warm_s:.3f}s vs monolith {legacy_s:.3f}s"
+
+    def test_cache_tracks_edits(self, tmp_path):
+        root = scaffold(tmp_path)
+        victim = tmp_path / "hyperspace_tpu" / "v.py"
+        victim.write_text("x = 1\t\n")
+        r1 = engine.run(root, use_cache=True)
+        assert any(d.code == "HS101" for d in r1.problems)
+        r2 = engine.run(root, use_cache=True)
+        assert [d.text() for d in r2.problems] == \
+            [d.text() for d in r1.problems]
+        assert r2.parse_count == 0
+        victim.write_text("x = 1\n")
+        r3 = engine.run(root, use_cache=True)
+        assert not any(d.code == "HS101" for d in r3.problems)
+        assert r3.parse_count == 1  # only the edited file re-parsed
+
+
+# ---------------------------------------------------------------------------
+# 2. Framework: codes, docs, suppressions, baseline, json, CLI.
+# ---------------------------------------------------------------------------
+
+class TestFramework:
+    def test_code_registry_frozen(self):
+        codes = set(diagnostics.CODES)
+        assert all(re.fullmatch(r"HS\d{3}", c) for c in codes)
+        assert codes == {
+            "HS001", "HS002", "HS003", "HS004", "HS005",
+            "HS101", "HS102", "HS103", "HS104",
+            "HS201", "HS202", "HS203", "HS204", "HS205", "HS206",
+            "HS207", "HS208", "HS209", "HS210", "HS211", "HS212",
+            "HS213", "HS214", "HS215",
+            "HS301", "HS302", "HS311", "HS312", "HS321",
+        }
+
+    def test_doc_table_in_lockstep(self):
+        with open(os.path.join(ROOT, "docs", "static_analysis.md")) as f:
+            doc = f.read()
+        documented = set(re.findall(r"\bHS\d{3}\b", doc))
+        assert documented == set(diagnostics.CODES)
+
+    def test_exemption_justifications_printed(self):
+        out = subprocess.run(
+            [sys.executable, os.path.join(SCRIPTS, "lint.py"),
+             "--exemptions"],
+            capture_output=True, text=True, cwd=ROOT)
+        assert out.returncode == 0
+        assert "justification" in out.stdout
+        assert "one-scalar" in out.stdout or "scalar" in out.stdout
+        assert "self-check harness" in out.stdout
+
+    def test_suppression_and_unused_directive(self, tmp_path):
+        root = scaffold(tmp_path, {
+            "hyperspace_tpu/v.py": (
+                "y = '" + "a" * 110 + "'  # hst: disable=HS103\n"
+                "z = 2  # hst: disable=HS104\n"),
+        })
+        res, codes = run_codes(root)
+        assert "HS103" not in codes          # suppressed
+        assert codes.count("HS002") == 1     # unused directive flagged
+        sup = [d for d in res.problems if d.suppressed]
+        assert [d.code for d in sup] == ["HS103"]
+
+    def test_baseline_grandfathers_and_goes_stale(self, tmp_path):
+        root = scaffold(tmp_path, {"hyperspace_tpu/v.py": "x = 1\t\n"})
+        engine.write_baseline(root)
+        res = engine.run(root, use_cache=False)
+        tabs = [d for d in res.problems if d.code == "HS101"]
+        assert tabs and all(d.baselined for d in tabs)
+        assert not [d for d in res.active() if d.code == "HS101"]
+        (tmp_path / "hyperspace_tpu" / "v.py").write_text("x = 1\n")
+        res2 = engine.run(root, use_cache=False)
+        assert any(d.code == "HS005" for d in res2.problems)
+
+    def test_cli_json_on_fixture(self, tmp_path):
+        root = scaffold(tmp_path, {"hyperspace_tpu/v.py": "x = 1\t\n"})
+        out = subprocess.run(
+            [sys.executable, os.path.join(SCRIPTS, "lint.py"),
+             "--json", "--no-cache", "--root", root],
+            capture_output=True, text=True, cwd=ROOT)
+        assert out.returncode == 1
+        payload = json.loads(out.stdout)
+        assert payload["count"] >= 1
+        tab = [p for p in payload["problems"] if p["code"] == "HS101"][0]
+        assert tab["path"].endswith("v.py") and tab["line"] == 1
+        assert tab["title"] == "tab character"
+
+    def test_legacy_helper_reexports_for_old_tests(self):
+        import importlib.util
+        spec = importlib.util.spec_from_file_location(
+            "hst_lint_shim", os.path.join(SCRIPTS, "lint.py"))
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        assert mod.mutable_state_sites(ast.parse(
+            "_C = {}\ndef f(k):\n    _C[k] = 1\n"))
+        assert mod.except_swallow_sites(ast.parse(
+            "try:\n    x = 1\nexcept:\n    pass\n"))
+
+
+# ---------------------------------------------------------------------------
+# 3a. Lock-discipline pass.
+# ---------------------------------------------------------------------------
+
+_BANK_BAD = """\
+import threading
+from collections import OrderedDict
+
+
+class ProgramBank:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._stages = OrderedDict()
+        self.hits = 0
+
+    def lookup(self, key):
+        self.hits += 1
+        self._stages[key] = 1
+        return self._stages.get(key)
+"""
+
+_BANK_OK = """\
+import threading
+from collections import OrderedDict
+
+
+class ProgramBank:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._stages = OrderedDict()
+        self.hits = 0
+
+    def lookup(self, key):
+        with self._lock:
+            self.hits += 1
+            self._stages[key] = 1
+            return self._stages.get(key)
+"""
+
+_SHARDING_BAD = """\
+import threading
+
+COMPILE_COUNT = 0
+DISPATCH_COUNT = 0
+_COUNT_LOCK = threading.Lock()
+
+
+def dispatch():
+    global DISPATCH_COUNT
+    DISPATCH_COUNT += 1
+"""
+
+
+class TestLockPass:
+    def test_unguarded_class_mutation_flagged(self, tmp_path):
+        root = scaffold(tmp_path, {
+            "hyperspace_tpu/serving/program_bank.py": _BANK_BAD})
+        _res, codes = run_codes(root)
+        assert "HS302" in codes  # self.hits += 1
+        assert "HS301" in codes  # self._stages[key] = 1
+
+    def test_guarded_class_clean(self, tmp_path):
+        root = scaffold(tmp_path, {
+            "hyperspace_tpu/serving/program_bank.py": _BANK_OK})
+        _res, codes = run_codes(root)
+        assert "HS301" not in codes and "HS302" not in codes
+
+    def test_init_exempt_and_unregistered_class_ignored(self, tmp_path):
+        root = scaffold(tmp_path, {
+            "hyperspace_tpu/serving/program_bank.py": (
+                "class SomethingElse:\n"
+                "    def bump(self):\n"
+                "        self.n = 1\n")})
+        _res, codes = run_codes(root)
+        assert "HS301" not in codes and "HS302" not in codes
+
+    def test_delegate_method_is_exempt_and_counted_used(self, tmp_path):
+        root = scaffold(tmp_path, {
+            "hyperspace_tpu/serving/result_cache.py": (
+                "import threading\n\n\n"
+                "class ResultCache:\n"
+                "    def __init__(self):\n"
+                "        self._lock = threading.Lock()\n"
+                "        self._device = {}\n"
+                "    def _drop(self, key):\n"
+                "        self._device.pop(key, None)\n")})
+        _res, codes = run_codes(root)
+        assert "HS301" not in codes
+        # The used delegate exemption must not be reported as unused.
+        stale = [d for d in _res.problems if d.code == "HS004"
+                 and "ResultCache._drop" in d.message]
+        assert not stale
+
+    def test_unguarded_global_rmw_flagged_then_fixed(self, tmp_path):
+        root = scaffold(tmp_path, {
+            "hyperspace_tpu/parallel/sharding.py": _SHARDING_BAD})
+        _res, codes = run_codes(root)
+        assert "HS302" in codes
+        fixed = _SHARDING_BAD.replace(
+            "    global DISPATCH_COUNT\n    DISPATCH_COUNT += 1\n",
+            "    global DISPATCH_COUNT\n    with _COUNT_LOCK:\n"
+            "        DISPATCH_COUNT += 1\n")
+        root2 = scaffold(tmp_path / "b", {
+            "hyperspace_tpu/parallel/sharding.py": fixed})
+        _res2, codes2 = run_codes(root2)
+        assert "HS302" not in codes2 and "HS301" not in codes2
+
+    def test_suppression_applies(self, tmp_path):
+        bad = _SHARDING_BAD.replace(
+            "    DISPATCH_COUNT += 1",
+            "    DISPATCH_COUNT += 1  # hst: disable=HS302")
+        root = scaffold(tmp_path, {
+            "hyperspace_tpu/parallel/sharding.py": bad})
+        _res, codes = run_codes(root)
+        assert "HS302" not in codes
+
+    def test_deferred_callable_under_lock_is_not_guarded(self, tmp_path):
+        """A nested def/lambda defined INSIDE `with self._lock` runs
+        later, unlocked — its mutations must still be flagged."""
+        root = scaffold(tmp_path, {
+            "hyperspace_tpu/serving/program_bank.py": (
+                "import threading\n\n\n"
+                "class ProgramBank:\n"
+                "    def __init__(self):\n"
+                "        self._lock = threading.Lock()\n"
+                "        self.hits = 0\n"
+                "    def lookup(self, pool):\n"
+                "        with self._lock:\n"
+                "            def cb():\n"
+                "                self.hits += 1\n"
+                "            pool(cb)\n")})
+        _res, codes = run_codes(root)
+        assert "HS302" in codes
+
+    def test_nested_def_with_its_own_lock_is_clean(self, tmp_path):
+        root = scaffold(tmp_path, {
+            "hyperspace_tpu/serving/program_bank.py": (
+                "import threading\n\n\n"
+                "class ProgramBank:\n"
+                "    def __init__(self):\n"
+                "        self._lock = threading.Lock()\n"
+                "        self.hits = 0\n"
+                "    def lookup(self, pool):\n"
+                "        def cb():\n"
+                "            with self._lock:\n"
+                "                self.hits += 1\n"
+                "        pool(cb)\n")})
+        _res, codes = run_codes(root)
+        assert "HS301" not in codes and "HS302" not in codes
+
+    def test_live_registry_matches_real_tree(self):
+        """Stripping one real lock reintroduces the race AND the pass
+        catches it — the regression guard for the r16 counter fixes."""
+        with open(os.path.join(
+                ROOT, "hyperspace_tpu", "parallel", "sharding.py")) as f:
+            real = f.read()
+        broken = real.replace(
+            "        with _COUNT_LOCK:\n            DISPATCH_COUNT += 1",
+            "        DISPATCH_COUNT += 1")
+        assert broken != real
+        src = _FakeSource("hyperspace_tpu/parallel/sharding.py", broken)
+        diags = lock_pass.check_file(src, _FakeCtx())
+        assert any(d.code == "HS302" and "DISPATCH_COUNT" in d.message
+                   for d in diags)
+        clean = lock_pass.check_file(
+            _FakeSource("hyperspace_tpu/parallel/sharding.py", real),
+            _FakeCtx())
+        assert not clean
+
+
+class _FakeSource:
+    """SourceFile stand-in for direct pass-level checks."""
+
+    def __init__(self, slash_rel, text):
+        self.rel = slash_rel
+        self.slash_rel = slash_rel
+        self.text = text
+        self.lines = text.splitlines()
+        self.tree = ast.parse(text)
+        self.is_package = slash_rel.startswith("hyperspace_tpu/")
+        self._index = None
+
+    @property
+    def index(self):
+        if self._index is None:
+            self._index = engine.NodeIndex(self.tree)
+        return self._index
+
+
+class _FakeCtx:
+    def __init__(self):
+        self.used = set()
+
+    def note_exemption(self, eid):
+        self.used.add(eid)
+
+
+# ---------------------------------------------------------------------------
+# 3b. Host-sync pass.
+# ---------------------------------------------------------------------------
+
+class TestHostSyncPass:
+    def _codes(self, tmp_path, kernels_text, sub="a"):
+        root = scaffold(tmp_path / sub, {
+            "hyperspace_tpu/ops/kernels.py": kernels_text})
+        return run_codes(root)
+
+    def test_item_inside_jitted_body_flagged(self, tmp_path):
+        _res, codes = self._codes(tmp_path, (
+            "import jax\n"
+            "import jax.numpy as jnp\n\n\n"
+            "@jax.jit\n"
+            "def bad(x):\n"
+            "    return x.sum().item()\n"))
+        assert "HS311" in codes
+
+    def test_tracer_branch_sync_flagged(self, tmp_path):
+        _res, codes = self._codes(tmp_path, (
+            "import jax.numpy as jnp\n"
+            "from ..execution import shapes\n\n\n"
+            "def join(keys):\n"
+            "    if shapes._is_tracer(keys):\n"
+            "        return int(jnp.sum(keys))\n"
+            "    return 0\n"))
+        assert "HS311" in codes
+
+    def test_static_args_and_shapes_are_not_syncs(self, tmp_path):
+        _res, codes = self._codes(tmp_path, (
+            "from functools import partial\n\n"
+            "import jax\n"
+            "import jax.numpy as jnp\n\n\n"
+            "@partial(jax.jit, static_argnames=('n',))\n"
+            "def ok(x, n):\n"
+            "    m = int(n) + int(x.shape[0])\n"
+            "    return jnp.zeros(m)\n"))
+        assert "HS311" not in codes and "HS312" not in codes
+
+    def test_unallowlisted_host_sync_flagged(self, tmp_path):
+        _res, codes = self._codes(tmp_path, (
+            "import jax.numpy as jnp\n\n\n"
+            "def rogue(mask):\n"
+            "    return int(jnp.sum(mask))\n"))
+        assert "HS312" in codes
+
+    def test_allowlisted_site_within_budget_clean(self, tmp_path):
+        _res, codes = self._codes(tmp_path, (
+            "import jax.numpy as jnp\n\n\n"
+            "def mask_count_nonzero(mask, valid_rows, padded):\n"
+            "    m = int(jnp.sum(mask))\n"
+            "    return m\n"))
+        assert "HS312" not in codes
+
+    def test_allowlisted_site_over_budget_flagged(self, tmp_path):
+        _res, codes = self._codes(tmp_path, (
+            "import jax.numpy as jnp\n\n\n"
+            "def mask_count_nonzero(mask, valid_rows, padded):\n"
+            "    a = int(jnp.sum(mask))\n"
+            "    b = int(jnp.max(mask))\n"
+            "    c = int(jnp.min(mask))\n"
+            "    return a + b + c\n"))
+        assert "HS312" in codes
+
+    def test_suppression_applies(self, tmp_path):
+        _res, codes = self._codes(tmp_path, (
+            "import jax.numpy as jnp\n\n\n"
+            "def rogue(mask):\n"
+            "    return int(jnp.sum(mask))  # hst: disable=HS312\n"))
+        assert "HS312" not in codes
+
+    def test_device_get_flagged_everywhere_in_scope(self, tmp_path):
+        _res, codes = self._codes(tmp_path, (
+            "import jax\n\n\n"
+            "def fetch(x):\n"
+            "    return jax.device_get(x)\n"))
+        assert "HS312" in codes
+
+    def test_unused_allowlist_entry_is_hs004(self, tmp_path):
+        # A scaffold tree has no kernels.py sync sites at all, so every
+        # kernels.py hostsync exemption goes unused.
+        root = scaffold(tmp_path / "u")
+        _res, codes = run_codes(root)
+        assert "HS004" in codes
+        msgs = [d.message for d in _res.problems if d.code == "HS004"]
+        assert any("mask_count_nonzero" in m for m in msgs)
+
+    def test_stale_extra_traced_root_is_flagged(self, tmp_path,
+                                                monkeypatch):
+        """A registered traced root that no longer resolves must not
+        silently drop HS311 coverage — it surfaces as HS004."""
+        monkeypatch.setattr(
+            hostsync_pass, "EXTRA_TRACED_ROOTS",
+            {"hyperspace_tpu/ops/kernels.py": frozenset({"vanished"})})
+        src = _FakeSource("hyperspace_tpu/ops/kernels.py", "x = 1\n")
+        diags = hostsync_pass.check_file(src, _FakeCtx())
+        assert [d.code for d in diags] == ["HS004"]
+        assert "vanished" in diags[0].message
+
+    def test_real_tree_one_scalar_contract_holds(self):
+        """The live kernels.py/fusion.py sync sites exactly match the
+        frozen budgets (and adding one more sync would fail: proven by
+        the over-budget fixture above)."""
+        for rel in ("hyperspace_tpu/ops/kernels.py",
+                    "hyperspace_tpu/execution/fusion.py"):
+            with open(os.path.join(ROOT, *rel.split("/"))) as f:
+                src = _FakeSource(rel, f.read())
+            diags = hostsync_pass.check_file(src, _FakeCtx())
+            assert diags == [], [d.text() for d in diags]
+
+
+# ---------------------------------------------------------------------------
+# 3c. Thread-handoff pass.
+# ---------------------------------------------------------------------------
+
+_HANDOFF_BAD = """\
+import threading
+
+
+def active_context():
+    return None
+
+
+def worker():
+    ctx = active_context()
+    return ctx
+
+
+def launch():
+    t = threading.Thread(target=worker)
+    t.start()
+"""
+
+_HANDOFF_WRAPPED = """\
+import contextvars
+import threading
+
+
+def active_context():
+    return None
+
+
+def worker():
+    ctx = active_context()
+    return ctx
+
+
+def launch():
+    snap = contextvars.copy_context()
+    t = threading.Thread(target=snap.run, args=(worker,))
+    t.start()
+"""
+
+_HANDOFF_TRANSITIVE = """\
+import contextvars
+import threading
+
+_CV = contextvars.ContextVar("x", default=None)
+
+
+def helper():
+    return _CV.get()
+
+
+def worker():
+    return helper()
+
+
+def launch(pool):
+    pool.submit(worker)
+"""
+
+_HANDOFF_EXPLICIT = """\
+import threading
+
+
+def fault_point(name, reg=None):
+    return reg
+
+
+def launch(reg):
+    def worker():
+        return fault_point("io.pooled_read", reg=reg)
+    t = threading.Thread(target=worker)
+    t.start()
+"""
+
+
+class TestHandoffPass:
+    def _codes(self, tmp_path, text, sub="a"):
+        root = scaffold(tmp_path / sub, {
+            "hyperspace_tpu/parallel/io.py": text})
+        return run_codes(root)
+
+    def test_raw_thread_handoff_flagged(self, tmp_path):
+        res, codes = self._codes(tmp_path, _HANDOFF_BAD)
+        assert "HS321" in codes
+        d = [d for d in res.problems if d.code == "HS321"][0]
+        assert "active_context()" in d.message
+        assert d.related is not None  # points at the ambient read
+
+    def test_copy_context_wrap_is_clean(self, tmp_path):
+        _res, codes = self._codes(tmp_path, _HANDOFF_WRAPPED)
+        assert "HS321" not in codes
+
+    def test_transitive_contextvar_get_flagged(self, tmp_path):
+        _res, codes = self._codes(tmp_path, _HANDOFF_TRANSITIVE)
+        assert "HS321" in codes
+
+    def test_explicit_state_argument_is_clean(self, tmp_path):
+        _res, codes = self._codes(tmp_path, _HANDOFF_EXPLICIT)
+        assert "HS321" not in codes
+
+    def test_suppression_applies(self, tmp_path):
+        bad = _HANDOFF_BAD.replace(
+            "    t = threading.Thread(target=worker)",
+            "    t = threading.Thread(target=worker)"
+            "  # hst: disable=HS321")
+        _res, codes = self._codes(tmp_path, bad)
+        assert "HS321" not in codes
+
+    def test_live_io_and_frontend_are_clean(self):
+        for rel in ("hyperspace_tpu/parallel/io.py",
+                    "hyperspace_tpu/serving/frontend.py"):
+            with open(os.path.join(ROOT, *rel.split("/"))) as f:
+                src = _FakeSource(rel, f.read())
+            diags = handoff_pass.check_file(src, _FakeCtx())
+            assert diags == [], [d.text() for d in diags]
+
+
+# ---------------------------------------------------------------------------
+# 4. Convicted product fixes stay fixed.
+# ---------------------------------------------------------------------------
+
+class TestConvictedFixes:
+    def test_chunk_scan_stats_exact_under_threads(self):
+        from hyperspace_tpu.execution import executor
+        before = executor.CHUNK_SCAN_STATS["chunks"]
+
+        def bump():
+            for _ in range(500):
+                executor._note_chunk_scan(1)
+
+        threads = [threading.Thread(target=bump) for _ in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert executor.CHUNK_SCAN_STATS["chunks"] == before + 4000
+
+    def test_index_build_stats_exact_under_threads(self):
+        from hyperspace_tpu.ops import index_build
+        before = index_build.CHUNK_STATS["spill_bytes"]
+
+        def bump():
+            for _ in range(500):
+                index_build._bump_chunk_stat("spill_bytes", 2)
+                index_build._note_device_rows(7)
+
+        threads = [threading.Thread(target=bump) for _ in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert index_build.CHUNK_STATS["spill_bytes"] == before + 8000
+        assert index_build.CHUNK_STATS["max_device_rows"] >= 7
+
+    def test_compile_listener_registers_exactly_once(self, monkeypatch):
+        from hyperspace_tpu.execution import shapes
+        calls = []
+        monkeypatch.setattr(
+            shapes.jax.monitoring,
+            "register_event_duration_secs_listener",
+            lambda fn: calls.append(fn))
+        monkeypatch.setattr(shapes, "_listener_installed", False)
+        barrier = threading.Barrier(8)
+
+        def install():
+            barrier.wait()
+            shapes.install_compile_counter()
+
+        threads = [threading.Thread(target=install) for _ in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert len(calls) == 1
+
+    def test_merge_join_under_tracing_raises_typed(self):
+        import jax
+        import jax.numpy as jnp
+
+        from hyperspace_tpu.exceptions import HyperspaceException
+        from hyperspace_tpu.ops import kernels
+
+        def traced(lk, rk):
+            return kernels.merge_join_indices(lk, rk)
+
+        with pytest.raises(HyperspaceException, match="under tracing"):
+            jax.jit(traced)(jnp.arange(4), jnp.arange(4))
+
+    def test_spmd_counters_move_under_lock_on_live_tree(self):
+        """Static regression: stripping any counter lock in the spmd /
+        fusion / distributed_build modules trips HS302 (see
+        TestLockPass.test_live_registry_matches_real_tree for the
+        sharding variant)."""
+        for rel in ("hyperspace_tpu/execution/spmd.py",
+                    "hyperspace_tpu/execution/fusion.py",
+                    "hyperspace_tpu/parallel/distributed_build.py",
+                    "hyperspace_tpu/execution/executor.py",
+                    "hyperspace_tpu/ops/index_build.py",
+                    "hyperspace_tpu/execution/shapes.py"):
+            with open(os.path.join(ROOT, *rel.split("/"))) as f:
+                src = _FakeSource(rel, f.read())
+            diags = lock_pass.check_file(src, _FakeCtx())
+            assert diags == [], [d.text() for d in diags]
+
+
+# ---------------------------------------------------------------------------
+# 5. CI gate: the real tree is clean through the real entrypoint.
+# ---------------------------------------------------------------------------
+
+class TestLintCI:
+    def test_repo_reports_zero_nonbaselined_findings(self):
+        out = subprocess.run(
+            [sys.executable, os.path.join(SCRIPTS, "lint.py"),
+             "--json", "--no-cache"],
+            capture_output=True, text=True, cwd=ROOT)
+        assert out.returncode == 0, out.stdout + out.stderr
+        payload = json.loads(out.stdout)
+        assert payload["count"] == 0
+        bad = [p for p in payload["problems"]
+               if not p["suppressed"] and not p["baselined"]]
+        assert bad == []
