@@ -2008,6 +2008,125 @@ def _run_lake_phase(args, root: str) -> None:
             scan_s / skip_s if skip_s > 0 else float("inf"), 3)
 
 
+def _run_streaming_phase(args, root: str) -> None:
+    """Streaming ingestion (ISSUE r17): sustained append throughput with
+    indexes kept fresh at load time vs append-then-full-refresh, query
+    latency staying flat across many commits, and op-log compaction's
+    entry folding. Emits streaming_append_qps, streaming_latency_flat,
+    compaction_entries_folded (+ supporting detail)."""
+    import numpy as np
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    import hyperspace_tpu as hst
+    from hyperspace_tpu.api import Hyperspace, IndexConfig
+    from hyperspace_tpu.plan.expr import col
+
+    n_commits = 50 if args.scale >= 0.5 else 16
+    rows = 2000
+    rng = np.random.default_rng(7)
+
+    def frame(n):
+        return pa.table({
+            "k": pa.array(rng.integers(0, 400, n).astype(np.int64)),
+            "v": pa.array(rng.integers(0, 97, n).astype(np.int64))})
+
+    def make_lake(tag):
+        d = os.path.join(root, f"stream_{tag}")
+        os.makedirs(d)
+        pq.write_table(frame(2 * rows), os.path.join(d, "p0.parquet"))
+        session = hst.Session(
+            system_path=os.path.join(root, f"stream_{tag}_idx"))
+        session.conf.set("hyperspace.index.numBuckets", 8)
+        session.conf.set("hyperspace.tpu.distributed.enabled", "false")
+        hs = Hyperspace(session)
+        hs.create_index(session.read.parquet(d),
+                        IndexConfig(f"s_{tag}", ["k"], ["v"]))
+        session.enable_hyperspace()
+        return session, hs, d
+
+    def probe_ms(session, d):
+        q = session.read.parquet(d).filter(col("k") == 7).select("k", "v")
+        q.to_pandas()  # warm (compile/caches)
+        t0 = time.perf_counter()
+        q.to_pandas()
+        return (time.perf_counter() - t0) * 1000.0
+
+    def ratio(latencies):
+        third = max(len(latencies) // 3, 1)
+        first = sum(latencies[:third]) / third
+        last = sum(latencies[-third:]) / third
+        return first, last, (last / first if first > 0 else None)
+
+    # --- load-time indexing, NO maintenance: append+commit only. Each
+    # commit adds one delta version of small bucket files, so the
+    # IndexScan's file count — and with it latency — grows: the control
+    # arm showing why compaction exists.
+    probe_every = max(n_commits // 10, 1)
+    session, hs, d = make_lake("nomaint")
+    lat_nomaint = []
+    # elapsed covers ONLY append+commit: the probe queries (2 runs
+    # each, incl. a compile) would otherwise inflate the per-commit
+    # cost the full-refresh baseline below is compared against.
+    elapsed = 0.0
+    for i in range(n_commits):
+        t0 = time.perf_counter()
+        hs.append(d, frame(rows))
+        hs.commit(d)
+        elapsed += time.perf_counter() - t0
+        if (i + 1) % probe_every == 0:
+            lat_nomaint.append(probe_ms(session, d))
+    RESULT["streaming_commits"] = n_commits
+    RESULT["streaming_append_qps"] = round(n_commits / elapsed, 3)
+    RESULT["streaming_rows_per_s"] = round(n_commits * rows / elapsed, 1)
+    _f, _l, nomaint_ratio = ratio(lat_nomaint)
+    RESULT["streaming_latency_nomaint_ratio"] = round(nomaint_ratio, 3) \
+        if nomaint_ratio is not None else None
+
+    # --- WITH compaction riding along: optimize_index (index-data
+    # compaction, merges the per-commit delta files) every probe window
+    # + compact() (op-log folding) at the same cadence. Latency stays
+    # flat across the whole commit history.
+    session2, hs2, d2 = make_lake("maint")
+    lat_maint = []
+    folded_total = 0
+    for i in range(n_commits):
+        hs2.append(d2, frame(rows))
+        hs2.commit(d2)
+        if (i + 1) % probe_every == 0:
+            hs2.optimize_index("s_maint", "quick")
+            out = hs2.compact(None)
+            folded_total += sum(v["entries_folded"]
+                                for v in out["compacted"].values())
+            lat_maint.append(probe_ms(session2, d2))
+    first, last, flat = ratio(lat_maint)
+    RESULT["streaming_latency_first_ms"] = round(first, 2)
+    RESULT["streaming_latency_last_ms"] = round(last, 2)
+    # ~1.0 = flat across 50 commits (fresh indexes, merged delta files,
+    # folded op logs, and the op-log lookup cache keep per-query cost
+    # O(1) in commit count).
+    RESULT["streaming_latency_flat"] = round(flat, 3) \
+        if flat is not None else None
+    RESULT["compaction_entries_folded"] = folded_total
+
+    # --- baseline: the same ingestion as append-then-FULL-refresh.
+    b_commits = min(6, n_commits)
+    session_b, hs_b, d_b = make_lake("refresh")
+    t0 = time.perf_counter()
+    for i in range(b_commits):
+        pq.write_table(frame(rows),
+                       os.path.join(d_b, f"extra{i:03d}.parquet"))
+        hs_b.refresh_index("s_refresh", "full")
+    refresh_per_commit = (time.perf_counter() - t0) / b_commits
+    fresh_per_commit = elapsed / n_commits
+    RESULT["streaming_full_refresh_s_per_commit"] = round(
+        refresh_per_commit, 4)
+    RESULT["streaming_fresh_s_per_commit"] = round(fresh_per_commit, 4)
+    RESULT["streaming_vs_full_refresh_speedup"] = round(
+        refresh_per_commit / fresh_per_commit, 3) \
+        if fresh_per_commit > 0 else None
+
+
 def _gil_free_scaling() -> float:
     """2-thread vs serial throughput of GIL-free zlib decompression —
     the host's REAL parallel capacity (vCPU count lies on time-shared
@@ -2236,6 +2355,13 @@ def main():
                 except Exception as e:
                     RESULT["errors"].append(
                         f"io phase: {type(e).__name__}: {e}")
+        if not _backend_dead():
+            with _phase("streaming"):
+                try:
+                    _run_streaming_phase(args, root)
+                except Exception as e:
+                    RESULT["errors"].append(
+                        f"streaming phase: {type(e).__name__}: {e}")
         with _phase("mesh"):
             # Multi-device numbers ride along at a bounded scale (the
             # virtual CPU mesh measures path health + collective overhead,

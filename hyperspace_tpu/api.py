@@ -172,6 +172,53 @@ class Hyperspace:
         return summary
 
     # ------------------------------------------------------------------
+    # Streaming ingestion (streaming/): append/commit + compaction.
+    # ------------------------------------------------------------------
+
+    def append(self, table_path: str, batch) -> dict:
+        """Stage one record batch (pyarrow Table/RecordBatch, pandas
+        DataFrame, or dict of columns) for the parquet table directory
+        ``table_path``. The batch is written to a hidden staging file
+        (invisible to every scan) and — while its rows are hot on
+        device — sketched and bucket-routed into a prebuilt delta for
+        each ACTIVE index over the table, so ``commit()`` is pure
+        metadata + renames. Returns a summary dict."""
+        from .streaming.ingest import append as _append
+        return _append(self.session, table_path, batch)
+
+    def commit(self, table_path: str) -> dict:
+        """Publish every staged batch for ``table_path`` atomically
+        through the op-log protocol (put-if-absent decides races,
+        crash-safe via ``recover()``'s undo/redo sweep), landing the
+        prebuilt index deltas in the same commit — covering indexes and
+        skipping sketches are fresh with no refresh pass. Standing
+        queries (``serving_frontend().subscribe``) re-fire. Returns a
+        summary dict."""
+        from .streaming.ingest import commit as _commit
+        return _commit(self.session, table_path)
+
+    def compact(self, names=None) -> dict:
+        """Fold each op-log's superseded entries into one checkpoint
+        entry and vacuum unreferenced index data versions
+        (streaming/compaction.py) — the maintenance action that keeps a
+        long-lived append workload's logs (and query-time log reads)
+        bounded. Queries planned after the compaction answer
+        byte-identically, and ``recover()`` behavior is unchanged.
+        OPERATOR ACTION like ``recover``/``vacuumIndex``: the version
+        vacuum deletes bytes a reader mid-scan on a stale entry could
+        still need — run it in a quiet window. Returns a summary
+        dict."""
+        from .streaming.compaction import compact as _compact
+        return _compact(self.session, names)
+
+    def streaming_stats(self) -> dict:
+        """Ingestion-tier observability: the process commit queue's
+        counters (appends/commits/rows/deltas/subscription fires) plus
+        the op-log lookup cache's hit rates."""
+        from .streaming.ingest import get_queue
+        return get_queue().stats()
+
+    # ------------------------------------------------------------------
     # Introspection.
     # ------------------------------------------------------------------
 

@@ -18,6 +18,7 @@ from .index.constants import IndexConstants
 from .optimizer.constants import OptimizerConstants
 from .robustness.constants import RobustnessConstants
 from .serving.constants import ServingConstants
+from .streaming.constants import StreamingConstants
 from .telemetry.constants import TelemetryConstants
 
 T = TypeVar("T")
@@ -525,6 +526,41 @@ class HyperspaceConf:
         return self._conf.get(
             TelemetryConstants.PROFILER_DIR,
             TelemetryConstants.PROFILER_DIR_DEFAULT) or ""
+
+    # ------------------------------------------------------------------
+    # Streaming ingestion (streaming/constants.py): append/commit,
+    # load-time indexing, compaction, standing queries.
+    # ------------------------------------------------------------------
+
+    def streaming_enabled(self) -> bool:
+        return self._get_bool(
+            StreamingConstants.ENABLED,
+            StreamingConstants.ENABLED_DEFAULT)
+
+    def streaming_max_staged_batches(self) -> int:
+        return max(int(self._conf.get(
+            StreamingConstants.MAX_STAGED_BATCHES,
+            StreamingConstants.MAX_STAGED_BATCHES_DEFAULT)), 1)
+
+    def streaming_load_time_indexing(self) -> bool:
+        return self._get_bool(
+            StreamingConstants.LOAD_TIME_INDEXING,
+            StreamingConstants.LOAD_TIME_INDEXING_DEFAULT)
+
+    def streaming_compaction_min_entries(self) -> int:
+        return max(int(self._conf.get(
+            StreamingConstants.COMPACTION_MIN_ENTRIES,
+            StreamingConstants.COMPACTION_MIN_ENTRIES_DEFAULT)), 1)
+
+    def streaming_subscriptions_max(self) -> int:
+        return max(int(self._conf.get(
+            StreamingConstants.SUBSCRIPTIONS_MAX,
+            StreamingConstants.SUBSCRIPTIONS_MAX_DEFAULT)), 1)
+
+    def streaming_subscription_history(self) -> int:
+        return max(int(self._conf.get(
+            StreamingConstants.SUBSCRIPTION_HISTORY,
+            StreamingConstants.SUBSCRIPTION_HISTORY_DEFAULT)), 1)
 
     # ------------------------------------------------------------------
     # Robustness (robustness/constants.py): fault injection, deadlines,

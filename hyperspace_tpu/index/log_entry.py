@@ -319,12 +319,49 @@ class DataSkippingIndex:
             dict(p.get("properties", {})))
 
 
+@dataclass
+class IngestedTable:
+    """Streaming-table descriptor: the derived dataset of a per-table
+    ingestion op-log entry (streaming/ingest.py). There is no derived
+    DATA — the table's own files are the payload; the entry's content
+    tree records which ingested batch files each commit published, so
+    crash recovery can tell a committed batch from a torn one."""
+
+    schema: Schema
+    properties: Dict[str, str] = dc_field(default_factory=dict)
+
+    kind = "IngestedTable"
+    kind_abbr = "IT"
+
+    # Lifecycle-action compatibility (CancelAction round-trips entries).
+    num_buckets = 1
+    indexed_columns: List[str] = dc_field(default_factory=list)
+    included_columns: List[str] = dc_field(default_factory=list)
+
+    def to_json_dict(self) -> Dict:
+        return {
+            "kind": self.kind,
+            "properties": {
+                "schema": self.schema.to_json_dict(),
+                "properties": dict(self.properties),
+            },
+        }
+
+    @staticmethod
+    def from_json_dict(d: Dict) -> "IngestedTable":
+        p = d["properties"]
+        return IngestedTable(Schema.from_json_dict(p["schema"]),
+                             dict(p.get("properties", {})))
+
+
 def derived_dataset_from_json(d: Dict):
     kind = d.get("kind")
     if kind == "CoveringIndex":
         return CoveringIndex.from_json_dict(d)
     if kind == "DataSkippingIndex":
         return DataSkippingIndex.from_json_dict(d)
+    if kind == "IngestedTable":
+        return IngestedTable.from_json_dict(d)
     raise HyperspaceException(f"Unknown derived dataset kind: {kind}")
 
 

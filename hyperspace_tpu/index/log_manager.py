@@ -18,6 +18,8 @@ the lifecycle against the object-store double).
 from __future__ import annotations
 
 import os
+import threading
+import time
 from typing import List, Optional
 
 from ..robustness import fault_names as _fn
@@ -28,6 +30,111 @@ from .constants import IndexConstants, STABLE_STATES, States
 from .log_entry import IndexLogEntry
 from .log_store import (LocalFsLogStore, LogStore, store_for_path,
                         strip_file_scheme)
+
+
+class LogLookupCache:
+    """Process-wide memo of the hot per-query op-log lookups, keyed on
+    the log DIRECTORY's identity token (mtime_ns/ctime_ns).
+
+    Every query recomputes the result-cache key, which re-lists each
+    index's ``_hyperspace_log`` and re-reads its latest entry
+    (``latest_entry_fingerprint``) — an O(n-entries) directory scan per
+    index per query. Under a long-lived append workload the log grows
+    with every commit, putting that scan squarely on the serving hot
+    path. Any protocol mutation creates or deletes a file in the log
+    dir (entry put-if-absent, latestStable tmp+replace), so the dir
+    mtime is a sound change token for cross-process writers; same-
+    process writers additionally invalidate explicitly (belt and
+    braces against coarse filesystem timestamps). Parsed entries are
+    cached as their JSON text and re-parsed per hit — callers mutate
+    returned entries (e.g. quick refresh sets ``relation.data.update``)
+    so handing out a shared object would tear.
+
+    Only :class:`LocalFsLogStore` logs are cacheable (object stores
+    have no directory mtime); everything else bypasses the cache.
+    """
+
+    _MAX_DIRS = 256  # bound: one slot per live index/table log
+    # Racy-token guard (the git index's racy-mtime rule): a dir whose
+    # mtime is within this window of NOW may still receive same-stamp
+    # writes on coarse-granularity filesystems, so its token is not yet
+    # a sound change detector — serve the computed value, don't pin it.
+    # Costs nothing on the satellite's target shape (queries vastly
+    # outnumber commits; a log quiet for 2 s caches on the next probe).
+    _RACY_WINDOW_NS = 2_000_000_000
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        # log_path -> (token, {kind: value})
+        self._dirs = {}
+        self.hits = 0
+        self.misses = 0
+        self.invalidations = 0
+
+    @staticmethod
+    def _token(log_path: str):
+        try:
+            st = os.stat(log_path)
+        except OSError:
+            return ("missing",)
+        return (st.st_mtime_ns, st.st_ctime_ns)
+
+    @classmethod
+    def _racy(cls, token) -> bool:
+        if token == ("missing",):
+            return False
+        return time.time_ns() - token[0] < cls._RACY_WINDOW_NS
+
+    def get(self, log_path: str, kind: str, compute):
+        """Cached value for ``kind`` under ``log_path``; ``compute()``
+        runs on a miss and its result is stored under the token observed
+        BEFORE the compute (a token that moved during the compute skips
+        the store, so a racing write can never pin a stale value)."""
+        token = self._token(log_path)
+        with self._lock:
+            cached = self._dirs.get(log_path)
+            if cached is not None and cached[0] == token \
+                    and kind in cached[1]:
+                self.hits += 1
+                return cached[1][kind]
+            self.misses += 1
+        value = compute()
+        if self._racy(token):
+            return value  # token too fresh to trust: serve, don't pin
+        with self._lock:
+            if self._token(log_path) != token:
+                return value  # a write landed mid-compute: serve, don't pin
+            cached = self._dirs.get(log_path)
+            if cached is None or cached[0] != token:
+                if len(self._dirs) >= self._MAX_DIRS:
+                    self._dirs.pop(next(iter(self._dirs)))
+                cached = (token, {})
+                self._dirs[log_path] = cached
+            cached[1][kind] = value
+        return value
+
+    def invalidate(self, log_path: str) -> None:
+        with self._lock:
+            if self._dirs.pop(log_path, None) is not None:
+                self.invalidations += 1
+
+    def clear(self) -> None:
+        with self._lock:
+            self._dirs.clear()
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"hits": self.hits, "misses": self.misses,
+                    "invalidations": self.invalidations,
+                    "dirs": len(self._dirs)}
+
+
+_LOOKUP_CACHE = LogLookupCache()
+
+
+def get_lookup_cache() -> LogLookupCache:
+    """The process-wide op-log lookup cache (observability + tests)."""
+    return _LOOKUP_CACHE
 
 
 class IndexLogManager:
@@ -41,6 +148,9 @@ class IndexLogManager:
         self._log_path = os.path.join(index_path, IndexConstants.HYPERSPACE_LOG)
         self._latest_stable_path = os.path.join(
             self._log_path, IndexConstants.LATEST_STABLE_LOG_NAME)
+        # Only local-FS logs carry the directory-mtime change token the
+        # lookup cache validates against.
+        self._cacheable = isinstance(self._store, LocalFsLogStore)
 
     @property
     def index_path(self) -> str:
@@ -59,8 +169,23 @@ class IndexLogManager:
         return self._get_log_at(self._path_from_id(log_id))
 
     def get_latest_id(self) -> Optional[int]:
+        if self._cacheable:
+            return _LOOKUP_CACHE.get(self._log_path, "latest_id",
+                                     self._compute_latest_id)
+        return self._compute_latest_id()
+
+    def _compute_latest_id(self) -> Optional[int]:
         ids = self._store.list_numeric_ids(self._log_path)
         return max(ids) if ids else None
+
+    def get_all_ids(self) -> List[int]:
+        """Every existing entry id, newest first. Scans iterate THIS —
+        never a dense range(latest, -1, -1): compaction leaves the id
+        space sparse (one checkpoint entry, ids keep growing), so a
+        per-id probe loop would cost O(lifetime commits), not O(live
+        entries)."""
+        return sorted(self._store.list_numeric_ids(self._log_path),
+                      reverse=True)
 
     def get_latest_log(self) -> Optional[IndexLogEntry]:
         latest = self.get_latest_id()
@@ -78,7 +203,20 @@ class IndexLogManager:
     def get_latest_stable_log(self) -> Optional[IndexLogEntry]:
         """Latest entry in a STABLE state; falls back to a backward scan past a
         broken tail — including an unparseable (torn) tail entry
-        (reference: IndexLogManager.scala:93-117)."""
+        (reference: IndexLogManager.scala:93-117). The resolved entry is
+        memoized as JSON text per (log path, dir mtime) and re-parsed
+        per call — callers mutate returned entries, so a shared object
+        would tear across threads."""
+        if self._cacheable:
+            text = _LOOKUP_CACHE.get(
+                self._log_path, "stable_json",
+                lambda: (lambda e: e.to_json() if e is not None else None)(
+                    self._compute_latest_stable_log()))
+            return IndexLogEntry.from_json(text) if text is not None \
+                else None
+        return self._compute_latest_stable_log()
+
+    def _compute_latest_stable_log(self) -> Optional[IndexLogEntry]:
         try:
             log = self._get_log_at(self._latest_stable_path)
         except (ValueError, KeyError, TypeError):
@@ -88,27 +226,26 @@ class IndexLogManager:
             # create_latest_stable_log); fall back to the backward scan.
             log = None
         if log is None:
-            latest = self.get_latest_id()
-            if latest is not None:
-                for log_id in range(latest, -1, -1):
-                    entry = self._get_log_lenient(log_id)
-                    if entry is not None and entry.state in STABLE_STATES:
-                        return entry
-                    if entry is not None and entry.state in (
-                            States.CREATING, States.VACUUMING):
-                        # Logs before a CREATING/VACUUMING entry are unrelated.
-                        return None
+            for log_id in self.get_all_ids():
+                entry = self._get_log_lenient(log_id)
+                if entry is not None and entry.state in STABLE_STATES:
+                    return entry
+                if entry is not None and entry.state in (
+                        States.CREATING, States.VACUUMING):
+                    # Logs before a CREATING/VACUUMING entry are unrelated.
+                    return None
             return None
         return log
 
     def get_index_versions(self, states: List[str]) -> List[int]:
         """Index log versions whose state is in ``states``, newest first,
         stopping at the most recent CREATING/VACUUMING boundary."""
-        latest = self.get_latest_id()
-        if latest is None:
+        ids = self.get_all_ids()
+        if not ids:
             return []
+        latest = ids[0]
         versions: List[int] = []
-        for log_id in range(latest, -1, -1):
+        for log_id in ids:
             entry = self.get_log(log_id)
             if entry is None:
                 continue
@@ -123,8 +260,17 @@ class IndexLogManager:
         the log is empty. Cheap change detector for the serving result
         cache: a full refresh restarts the log at the SAME ids (fresh
         create cycle), so the id alone cannot pin the index state — the
-        entry bytes can, without parsing JSON."""
-        latest = self.get_latest_id()
+        entry bytes can, without parsing JSON. Memoized per (log path,
+        dir mtime): this runs once per index per QUERY (result-cache key
+        derivation), and under an append workload the backing directory
+        scan grows with every commit."""
+        if self._cacheable:
+            return _LOOKUP_CACHE.get(self._log_path, "fingerprint",
+                                     self._compute_fingerprint)
+        return self._compute_fingerprint()
+
+    def _compute_fingerprint(self) -> Optional[tuple]:
+        latest = self._compute_latest_id()
         if latest is None:
             return None
         data = self._store.read(self._path_from_id(latest))
@@ -148,10 +294,13 @@ class IndexLogManager:
             self._store.put_overwrite(self._latest_stable_path, data)
 
         _retry.call(_put, where="log.stable")
+        _LOOKUP_CACHE.invalidate(self._log_path)
         return True
 
     def delete_latest_stable_log(self) -> bool:
-        return self._store.delete(self._latest_stable_path)
+        out = self._store.delete(self._latest_stable_path)
+        _LOOKUP_CACHE.invalidate(self._log_path)
+        return out
 
     def write_log(self, log_id: int, entry: IndexLogEntry) -> bool:
         """Write entry at ``log_id`` iff that id doesn't exist yet.
@@ -181,4 +330,15 @@ class IndexLogManager:
         won = _retry.call(_put, where="log.write")
         if not won and state["transient"]:
             won = self._store.read(path) == data  # lost to OURSELVES?
+        # Invalidate even on loss: the other writer's entry is just as
+        # new to this process's memo as our own would have been.
+        _LOOKUP_CACHE.invalidate(self._log_path)
         return won
+
+    def delete_log(self, log_id: int) -> bool:
+        """Physically remove one entry file — ONLY compaction
+        (streaming/compaction.py) may do this, after the checkpoint
+        entry superseding it is durably committed."""
+        out = self._store.delete(self._path_from_id(log_id))
+        _LOOKUP_CACHE.invalidate(self._log_path)
+        return out

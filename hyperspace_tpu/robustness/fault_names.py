@@ -64,9 +64,20 @@ ACTION_OP = "action.op"
 # arming happens after this point and cannot reach it.
 SERVING_WORKER = "serving.worker"
 
+# Streaming ingestion (streaming/ingest.py). INGEST_STAGE fires inside
+# append() before the staged batch parquet is written (a crash here
+# leaves only an invisible staging orphan the recovery sweep deletes);
+# INGEST_PUBLISH fires inside the commit action's op() after the
+# transient table-log entry landed but before any batch file moves —
+# the canonical mid-commit wreck the kill -9 harness strikes, proving
+# recover() rolls the staged batch back.
+INGEST_STAGE = "ingest.stage"
+INGEST_PUBLISH = "ingest.publish"
+
 FAULT_NAMES = frozenset({
     IO_POOLED_READ, IO_PREFETCH_PRODUCE, SCAN_PARQUET_DECODE,
     SPMD_DISPATCH, SPMD_COMPILE, BANK_COMPILE,
     RESULT_CACHE_DEVICE_PUT, RESULT_CACHE_SPILL_READ,
     LOG_WRITE, LOG_STABLE, ACTION_OP, SERVING_WORKER,
+    INGEST_STAGE, INGEST_PUBLISH,
 })

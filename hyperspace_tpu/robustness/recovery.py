@@ -71,6 +71,15 @@ def recover_indexes(session, names: Optional[List[str]] = None) -> Dict:
             recover_index(session, index_path, name, summary)
         except Exception as e:
             summary["errors"][name] = f"{type(e).__name__}: {e}"
+    if names is None:
+        # Streaming-tier sweep (streaming/ingest.py): undo/redo torn
+        # commits recorded in the per-table logs under _streaming/ and
+        # clear staging leftovers (the dead appender's invisible files).
+        try:
+            from ..streaming.ingest import recover_streaming
+            recover_streaming(session, summary)
+        except Exception as e:
+            summary["errors"]["_streaming"] = f"{type(e).__name__}: {e}"
     return summary
 
 
@@ -97,12 +106,14 @@ def recover_index(session, index_path: str, name: str,
     return summary
 
 
-def _referenced_versions(mgr: IndexLogManager, latest_id: int) -> set:
+def _referenced_versions(mgr: IndexLogManager) -> set:
     """Data versions any parseable ACTIVE/DELETED entry commits to.
     DOESNOTEXIST and transient entries reference nothing servable — a
-    crashed action's entry must not protect its own partial output."""
+    crashed action's entry must not protect its own partial output.
+    Iterates the EXISTING ids (sparse after compaction), not a dense
+    range — see IndexLogManager.get_all_ids."""
     referenced: set = set()
-    for log_id in range(latest_id, -1, -1):
+    for log_id in mgr.get_all_ids():
         entry = mgr._get_log_lenient(log_id)
         if entry is None or entry.state not in (States.ACTIVE,
                                                 States.DELETED):
@@ -119,10 +130,9 @@ def _referenced_versions(mgr: IndexLogManager, latest_id: int) -> set:
 
 def _vacuum_orphan_versions(mgr: IndexLogManager,
                             index_path: str) -> List[int]:
-    latest_id = mgr.get_latest_id()
-    if latest_id is None:
+    if mgr.get_latest_id() is None:
         return []
-    referenced = _referenced_versions(mgr, latest_id)
+    referenced = _referenced_versions(mgr)
     dm = IndexDataManager(index_path)
     orphans = [v for v in dm.get_all_version_ids() if v not in referenced]
     for v in orphans:

@@ -40,6 +40,7 @@ from __future__ import annotations
 import contextvars
 import threading
 import time
+import weakref
 from collections import deque
 from typing import List, Optional
 
@@ -64,9 +65,27 @@ class PendingQuery:
         self._event = threading.Event()
         self._result = None
         self._error: Optional[BaseException] = None
+        self._done_cb = None
+        self._cb_lock = threading.Lock()
 
     def done(self) -> bool:
         return self._event.is_set()
+
+    def on_done(self, cb) -> None:
+        """Invoke ``cb(self)`` exactly once when the query completes —
+        on the worker thread that finishes it, or immediately if it
+        already did. The standing-query delivery hook
+        (streaming/subscriptions.py); callbacks must be quick and must
+        not raise. The lock makes the register/finish handoff
+        exactly-once under the 8-thread pool."""
+        with self._cb_lock:
+            if not self._event.is_set():
+                self._done_cb = cb
+                return
+        try:
+            cb(self)
+        except Exception:
+            pass
 
     def result(self, timeout: Optional[float] = None):
         """The executed Table; blocks until completion. Raises the
@@ -89,7 +108,15 @@ class PendingQuery:
         self.completed_s = time.perf_counter()
         self._result = result
         self._error = error
-        self._event.set()
+        with self._cb_lock:
+            self._event.set()
+            cb = self._done_cb
+            self._done_cb = None
+        if cb is not None:
+            try:
+                cb(self)
+            except Exception:
+                pass  # a delivery hook must never fail the query
 
 
 class _Entry:
@@ -136,6 +163,10 @@ class ServingFrontend:
             "sweep_invocations": 0, "shared_scans": 0,
             "shared_scan_hits": 0,
         }
+        # Standing queries (streaming/subscriptions.py): plans that
+        # re-fire through this frontend on every streaming commit.
+        from ..streaming.subscriptions import SubscriptionRegistry
+        self._subscriptions = SubscriptionRegistry()
         # Construction is the opt-in (README/bench construct directly):
         # the first live frontend becomes the process default so
         # serving_stats()/explain's "Serving:" section observe it
@@ -144,6 +175,7 @@ class ServingFrontend:
         # metrics registry (telemetry/metrics.py).
         global _DEFAULT
         with _DEFAULT_LOCK:
+            _ALL_FRONTENDS.add(self)
             if _DEFAULT is None:
                 _DEFAULT = self
                 from ..telemetry import metrics as _metrics
@@ -248,6 +280,36 @@ class ServingFrontend:
                         self._stats["admitted"] -= 1
                 raise
         return pending
+
+    # ------------------------------------------------------------------
+    # Standing queries (streaming tier).
+    # ------------------------------------------------------------------
+
+    def subscribe(self, query, session=None, client: str = "",
+                  deadline_ms: Optional[float] = None):
+        """Register a standing query: the plan re-fires through this
+        frontend's worker pool on every streaming commit (a standing
+        query is a cached plan + the result-cache invalidation hook —
+        between commits a re-fire is a cache hit by construction).
+        Returns a :class:`~..streaming.subscriptions.Subscription`;
+        ``deadline_ms`` bounds each fire like a submit() deadline."""
+        session = session if session is not None \
+            else getattr(query, "session", None)
+        if session is None:
+            raise HyperspaceException(
+                "subscribe() needs a DataFrame or an explicit session=")
+        return self._subscriptions.subscribe(
+            self, query, session, client, deadline_ms,
+            self._hs_conf.streaming_subscriptions_max(),
+            self._hs_conf.streaming_subscription_history())
+
+    def unsubscribe(self, subscription) -> bool:
+        return self._subscriptions.unsubscribe(subscription)
+
+    def notify_commit(self, session, table: str = "") -> int:
+        """Re-fire every live standing query (called by the streaming
+        tier after a commit publishes). Returns fires admitted."""
+        return self._subscriptions.fire(self, session, table)
 
     # ------------------------------------------------------------------
     # Worker loop.
@@ -515,6 +577,7 @@ class ServingFrontend:
             if cache is not None else None
         from .program_bank import get_bank
         out["program_bank"] = get_bank().stats()
+        out["subscriptions"] = self._subscriptions.stats()
         return out
 
     def drain(self, timeout: float = 60.0) -> None:
@@ -572,6 +635,16 @@ _DEFAULT: Optional[ServingFrontend] = None
 # Reentrant: get_frontend constructs under this lock and __init__
 # re-acquires it to self-register.
 _DEFAULT_LOCK = threading.RLock()
+# EVERY live frontend (weak: a dropped frontend must not be kept alive
+# by the registry) — the streaming commit hook notifies all of them, so
+# a subscription on a non-default frontend still fires.
+_ALL_FRONTENDS: "weakref.WeakSet[ServingFrontend]" = weakref.WeakSet()
+
+
+def all_frontends() -> List[ServingFrontend]:
+    """Every live frontend in the process (the commit hook fan-out)."""
+    with _DEFAULT_LOCK:
+        return list(_ALL_FRONTENDS)
 
 
 def get_frontend(session) -> ServingFrontend:

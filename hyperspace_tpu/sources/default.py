@@ -133,6 +133,19 @@ class DefaultFileBasedRelation(FileBasedRelation):
             else (self.partition_fields() or [])
         return pruned
 
+    @classmethod
+    def pinned(cls, root_paths, fmt: str, options, files,
+               schema: Schema) -> "DefaultFileBasedRelation":
+        """A relation pinned to an explicit listing AND schema: unlike
+        ``with_files`` on a freshly built relation, touches the
+        filesystem for neither the schema (footer read) nor partition
+        inference (directory walk) — the streaming commit path builds
+        one of these per index per commit and already knows both."""
+        rel = cls(list(root_paths), fmt, dict(options or {}), schema=schema)
+        rel._files = sorted(os.path.abspath(f) for f in files)
+        rel._partition_fields = []
+        return rel
+
 
 class DefaultFileBasedSourceBuilder(FileBasedSourceProvider):
     """The provider the conf points at by default."""

@@ -1,0 +1,21 @@
+"""Streaming ingestion tier: append/commit with load-time indexing.
+
+Three pillars (ROADMAP item 3, "Only Aggressive Elephants are Fast
+Elephants" — indexes built during upload cost near nothing):
+
+- **append/commit** (ingest.py): ``Hyperspace.append(table, batch)``
+  stages record batches invisibly (hidden staging dir) and sketches +
+  bucket-routes them on-device as they land; ``commit()`` publishes the
+  batch files and the prebuilt index deltas atomically through the
+  existing op-log protocol, so covering indexes and skipping sketches
+  are fresh at commit time with no separate refresh pass.
+- **compaction** (compaction.py): ``compact()`` folds superseded op-log
+  entries into a checkpoint entry and vacuums unreferenced data
+  versions, bounding what a long-lived append workload accumulates.
+- **standing queries** (subscriptions.py): ``ServingFrontend.subscribe``
+  registers a plan that re-fires per commit through the serving worker
+  pool — a standing query is a cached plan plus the r06 invalidation
+  hook.
+"""
+
+from .constants import StreamingConstants  # noqa: F401

@@ -1,0 +1,160 @@
+"""Op-log compaction + vacuum: bound what a long-lived lake accumulates.
+
+Every action appends two entries to its op log, and every streaming
+commit appends two more per table plus two per landed index delta — a
+sustained append workload grows every log without bound, and the
+serving hot path pays for it (each query's result-cache key re-lists
+each log; see index/log_manager.LogLookupCache for the read-side
+mitigation). ``compact()`` is the write-side fix: for every log whose
+tip is STABLE, it folds all superseded entries into one CHECKPOINT
+entry — a copy of the tip carrying ``compactionGeneration``/
+``compactedThrough`` properties — then deletes the folded entry files
+and vacuums index data versions no remaining entry references
+(``robustness/recovery._vacuum_orphan_versions``, the same sweep crash
+recovery runs).
+
+Aliasing safety: the checkpoint lands at a NEW log id with NEW bytes,
+so the result cache's ``(latest id, entry-bytes md5)`` component flips
+by construction, and the generation property keeps every later entry's
+bytes distinct from any pre-compaction history. Caches keyed on
+``(index name, entry id)`` (the sketch-table memo) can never alias
+because ids only grow; the optimizer stats provider keys on SOURCE
+file signatures, which compaction leaves untouched.
+
+Crash safety (no new protocol): the checkpoint is an ordinary
+put-if-absent entry write. A crash before it leaves the log unchanged;
+a crash after it but mid-delete leaves extra superseded entries the
+next compact() folds — every intermediate state is a valid log, and
+``recover()`` has nothing to do.
+
+Concurrency: the checkpoint's put-if-absent decides races against live
+actions — a concurrent action that claimed the id first wins and the
+log is skipped this round. The VACUUM half, however, inherits the
+recover()/vacuumIndex OPERATOR CONTRACT: superseded entries are what
+protected historical data versions, so deleting a version only they
+referenced can fail a reader that planned against a stale (TTL-cached
+or cross-process) entry mid-scan. Run compact() in a quiet window —
+log folding alone is always safe, but the version vacuum is not
+concurrent-reader-proof (nothing in this lake is, once bytes are
+deleted; same contract as VacuumAction).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional
+
+from ..index.constants import IndexConstants, STABLE_STATES
+from ..index.log_entry import IndexLogEntry
+from ..index.log_manager import IndexLogManager
+from ..telemetry import span_names as SN
+from ..telemetry import trace as _trace
+from .constants import StreamingConstants as SC
+
+
+def compact(session, names: Optional[List[str]] = None) -> Dict:
+    """Compact every op-log under the session's system path (indexes
+    AND per-table streaming logs), or just ``names``. Returns a summary
+    dict ({compacted, skipped, errors}); per-log failures are collected
+    so one wrecked log cannot block the sweep."""
+    summary: Dict = {"compacted": {}, "skipped": {}, "errors": {}}
+    root = session.hs_conf.system_path()
+    min_entries = session.hs_conf.streaming_compaction_min_entries()
+    for name, path in _log_dirs(root):
+        if names is not None and name not in names \
+                and name.replace("streaming:", "", 1) not in names:
+            continue
+        try:
+            with _trace.maintenance_trace(session, "compact"), \
+                    _trace.span(SN.INGEST_COMPACT) as sp:
+                _compact_one(session, name, path, min_entries, summary)
+                if sp is not None and name in summary["compacted"]:
+                    sp.attrs["folded"] = \
+                        summary["compacted"][name]["entries_folded"]
+        except Exception as e:
+            summary["errors"][name] = f"{type(e).__name__}: {e}"
+    # Checkpoints changed entries under the caching metadata manager.
+    session.index_collection_manager.clear_cache()
+    return summary
+
+
+def _log_dirs(root: str) -> List[tuple]:
+    """(display name, dir) of every op-log under the system path."""
+    out: List[tuple] = []
+    if not os.path.isdir(root):
+        return out
+    for name in sorted(os.listdir(root)):
+        if name == SC.STREAMING_DIR:
+            continue
+        path = os.path.join(root, name)
+        if os.path.isdir(os.path.join(path,
+                                      IndexConstants.HYPERSPACE_LOG)):
+            out.append((name, path))
+    sroot = os.path.join(root, SC.STREAMING_DIR)
+    if os.path.isdir(sroot):
+        for name in sorted(os.listdir(sroot)):
+            path = os.path.join(sroot, name)
+            if os.path.isdir(os.path.join(
+                    path, IndexConstants.HYPERSPACE_LOG)):
+                out.append((f"streaming:{name}", path))
+    return out
+
+
+def _compact_one(session, name: str, path: str, min_entries: int,
+                 summary: Dict) -> None:
+    mgr = IndexLogManager(path)
+    latest_id = mgr.get_latest_id()
+    if latest_id is None:
+        summary["skipped"][name] = "empty log"
+        return
+    tip = mgr._get_log_lenient(latest_id)
+    if tip is None or tip.state not in STABLE_STATES:
+        summary["skipped"][name] = ("tip is transient (live action or "
+                                    "wreck); recover() first")
+        return
+    superseded = sorted(i for i in mgr.get_all_ids() if i < latest_id)
+    if len(superseded) < min_entries:
+        summary["skipped"][name] = (
+            f"{len(superseded)} superseded entries below "
+            "streaming.compaction.minEntries")
+        return
+    generation = int(tip.properties.get(
+        SC.COMPACTION_GENERATION_PROPERTY, "0")) + 1
+    checkpoint = IndexLogEntry.from_json(tip.to_json())
+    checkpoint.properties[SC.COMPACTION_GENERATION_PROPERTY] = \
+        str(generation)
+    checkpoint.properties[SC.COMPACTED_THROUGH_PROPERTY] = str(latest_id)
+    import time as _time
+    checkpoint.timestamp = int(_time.time() * 1000)
+    if not mgr.write_log(latest_id + 1, checkpoint):
+        summary["skipped"][name] = ("lost the log id race to a "
+                                    "concurrent action")
+        return
+    mgr.create_latest_stable_log(latest_id + 1)
+    folded = 0
+    for i in superseded + [latest_id]:
+        if mgr.delete_log(i):
+            folded += 1
+    from ..robustness.recovery import _vacuum_orphan_versions
+    orphans = _vacuum_orphan_versions(mgr, path)
+    summary["compacted"][name] = {
+        "entries_folded": folded,
+        "generation": generation,
+        "versions_vacuumed": len(orphans),
+    }
+    _emit(session, name, folded, generation, len(orphans))
+
+
+def _emit(session, name: str, folded: int, generation: int,
+          vacuumed: int) -> None:
+    try:
+        from ..telemetry.events import StreamingCompactionEvent
+        from ..telemetry.logging import get_logger
+        get_logger(session.hs_conf.event_logger_class()).log_event(
+            StreamingCompactionEvent(
+                message=(f"folded {folded} op-log entries into "
+                         f"checkpoint generation {generation}"),
+                subject=name, entries_folded=folded,
+                generation=generation, versions_vacuumed=vacuumed))
+    except Exception:
+        pass

@@ -1,0 +1,1243 @@
+"""Append/commit ingestion with aggressive load-time indexing.
+
+The write path of the lake ("Only Aggressive Elephants are Fast
+Elephants", arxiv 1208.0287 — index work rides the upload for near-zero
+marginal cost):
+
+- ``append(session, table, batch)`` writes the batch as a parquet file
+  into the table's hidden staging dir (invisible to every scan: the
+  data-path filter skips ``_``-prefixed names) and, while the rows are
+  hot on device, prebuilds one delta per ACTIVE index over the table —
+  bucket-routed + sorted part files for covering indexes (the previous
+  entry's bucket count keeps them bucket-aligned), MinMax/Bloom/
+  ValueList sketch rows for skipping indexes.
+- ``commit(session, table)`` publishes everything atomically through
+  the existing op-log protocol: one per-table streaming log entry
+  (put-if-absent decides concurrent-commit races) brackets the batch
+  file renames and the per-index delta landings, each of which is
+  itself a 2-phase op-log action. The hybrid-scan path would pick the
+  files up anyway; with load-time indexing the indexes' own entries
+  already cover them, so queries serve from fresh indexes with no
+  refresh pass, and the r06 result-cache log-version keys invalidate by
+  construction.
+
+Crash safety (undo/redo over the table log, proven by the kill -9
+harness in tests/test_streaming.py): a commit that died before all its
+batch files landed is UNDONE by ``recover()`` (landed files deleted,
+log cancelled, staged files swept — the pre-commit lake, byte for
+byte); one that died after every batch file landed is REDONE (the final
+entry is written; index deltas that missed the crash window are simply
+absent and hybrid scan covers their files until the next commit or
+refresh). Index-delta wrecks recover through the ordinary index sweep.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+import uuid
+from typing import Dict, List, Optional
+
+from ..actions.action import Action
+from ..exceptions import HyperspaceException
+from ..index.constants import STABLE_STATES, States
+from ..index.data_manager import IndexDataManager
+from ..index.log_entry import (Content, FileIdTracker, FileInfo, Hdfs,
+                               IndexLogEntry, IngestedTable,
+                               LogicalPlanFingerprint, Relation, Signature,
+                               Source, SourcePlan)
+from ..index.log_manager import IndexLogManager
+from ..index.path_resolver import PathResolver
+from ..robustness import fault_names as _fn
+from ..robustness import faults as _faults
+from ..schema import Schema
+from ..telemetry import span_names as SN
+from ..telemetry import trace as _trace
+from ..util import file_utils, hashing
+from .constants import StreamingConstants as SC
+
+
+# ---------------------------------------------------------------------------
+# Staged-batch model.
+# ---------------------------------------------------------------------------
+
+class _CoveringDelta:
+    """Prebuilt bucket-aligned part files for one covering index,
+    written to the index's staging dir at append() time. ``layout``
+    pins the (num_buckets, indexed, included) the delta was routed
+    with: a full refresh/recreate between append and commit can change
+    any of them, and landing 8-bucket files into a 16-bucket index
+    would silently break query-time bucket pruning."""
+
+    __slots__ = ("index_name", "index_path", "staged_dir", "lineage_id",
+                 "layout")
+
+    def __init__(self, index_name: str, index_path: str, staged_dir: str,
+                 lineage_id: Optional[int], layout: tuple):
+        self.index_name = index_name
+        self.index_path = index_path
+        self.staged_dir = staged_dir
+        self.lineage_id = lineage_id
+        self.layout = layout
+
+
+def _covering_layout(entry: IndexLogEntry) -> tuple:
+    # The lineage flag is part of the layout: a delta prebuilt without
+    # the _data_file_id column must not land in a lineage index (and
+    # vice versa).
+    return (entry.num_buckets, tuple(entry.indexed_columns),
+            tuple(entry.included_columns), entry.has_lineage_column())
+
+
+class _SketchDelta:
+    """One precomputed sketch row (per batch file) for a skipping
+    index; the row's file id is assigned at commit time. ``layout``
+    pins the sketch set the row was computed for (see _CoveringDelta:
+    a recreated index's sketch table must not take rows shaped for the
+    old one)."""
+
+    __slots__ = ("index_name", "index_path", "values", "layout")
+
+    def __init__(self, index_name: str, index_path: str, values: Dict,
+                 layout: tuple):
+        self.index_name = index_name
+        self.index_path = index_path
+        self.values = values  # sketch column -> value (FILE_COL included)
+        self.layout = layout
+
+
+def _sketch_layout(entry: IndexLogEntry) -> tuple:
+    return tuple(sorted(
+        (s.kind, s.column, tuple(sorted(s.properties.items())))
+        for s in entry.derivedDataset.sketches))
+
+
+class StagedBatch:
+    __slots__ = ("batch_id", "table_path", "staged_path", "final_path",
+                 "rows", "nbytes", "mtime_ms", "schema", "covering",
+                 "sketches")
+
+    def __init__(self, batch_id: str, table_path: str, staged_path: str,
+                 final_path: str, rows: int, nbytes: int, mtime_ms: int,
+                 schema: Schema):
+        self.batch_id = batch_id
+        self.table_path = table_path
+        self.staged_path = staged_path
+        self.final_path = final_path
+        self.rows = rows
+        self.nbytes = nbytes
+        self.mtime_ms = mtime_ms
+        self.schema = schema
+        self.covering: List[_CoveringDelta] = []
+        self.sketches: List[_SketchDelta] = []
+
+
+class CommitQueue:
+    """Process-wide staging state of the ingestion tier: staged batches
+    per table, per-table append serialization (lineage-id assignment
+    must see a stable staged count), and the tier's counters. One
+    instance per process (``get_queue``), shared by every session —
+    appends from the 8-thread serving path land here concurrently, so
+    every mutation holds ``_lock`` (HS301-registered)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._staged: Dict[str, List[StagedBatch]] = {}
+        # Batches popped by an in-flight commit still count toward the
+        # lineage base of concurrent appends until they land or requeue.
+        self._inflight: Dict[str, List[StagedBatch]] = {}
+        self._table_locks: Dict[str, threading.Lock] = {}
+        self._commit_locks: Dict[str, threading.Lock] = {}
+        # Table schema memo: the schema check must not re-walk a
+        # 10k-file table per append (schemas are append-invariant by
+        # this very check; recovery drops the memo with drop_table).
+        self._schemas: Dict[str, object] = {}
+        self._stats = {
+            "appends": 0, "commits": 0, "batches_committed": 0,
+            "rows_staged": 0, "rows_committed": 0,
+            "covering_deltas": 0, "sketch_deltas": 0,
+            "commit_conflicts": 0, "subscription_fires": 0,
+        }
+
+    def table_lock(self, table: str) -> threading.Lock:
+        with self._lock:
+            return self._table_locks.setdefault(table, threading.Lock())
+
+    def commit_lock(self, table: str) -> threading.Lock:
+        with self._lock:
+            return self._commit_locks.setdefault(table, threading.Lock())
+
+    def push(self, batch: StagedBatch, max_staged: int) -> None:
+        with self._lock:
+            staged = self._staged.setdefault(batch.table_path, [])
+            pending = len(staged) + \
+                len(self._inflight.get(batch.table_path, []))
+            if pending >= max_staged:
+                # Unreachable from append() (it pre-checks under the
+                # per-table lock) — kept so the queue enforces its own
+                # invariant for any future caller.
+                raise HyperspaceException(
+                    f"{batch.table_path}: {pending} staged/in-flight "
+                    f"batches reach "
+                    "hyperspace.tpu.streaming.maxStagedBatches; "
+                    "commit() before appending more")
+            staged.append(batch)
+            self._stats["appends"] += 1
+            self._stats["rows_staged"] += batch.rows
+            self._stats["covering_deltas"] += len(batch.covering)
+            self._stats["sketch_deltas"] += len(batch.sketches)
+
+    def pop_all(self, table: str) -> List[StagedBatch]:
+        with self._lock:
+            batches = self._staged.pop(table, [])
+            if batches:
+                self._inflight.setdefault(table, []).extend(batches)
+            return batches
+
+    def land(self, table: str, batches: List[StagedBatch]) -> None:
+        with self._lock:
+            flight = self._inflight.get(table, [])
+            for b in batches:
+                if b in flight:
+                    flight.remove(b)
+            self._stats["commits"] += 1
+            self._stats["batches_committed"] += len(batches)
+            self._stats["rows_committed"] += sum(b.rows for b in batches)
+
+    def requeue(self, table: str, batches: List[StagedBatch]) -> None:
+        """Put batches a conflicted commit never started back at the
+        FRONT of the queue (order preserved for lineage determinism)."""
+        with self._lock:
+            flight = self._inflight.get(table, [])
+            for b in batches:
+                if b in flight:
+                    flight.remove(b)
+            self._staged[table] = batches + self._staged.get(table, [])
+            self._stats["commit_conflicts"] += 1
+
+    def abandon(self, table: str, batches: List[StagedBatch]) -> None:
+        """Forget batches a commit failed MID-PROTOCOL (op started:
+        some files may be published, the table log is a wreck only
+        recover() can resolve). Leaving them in-flight would poison the
+        backpressure count and lineage offsets for the process
+        lifetime; their staged files stay on disk for the recovery
+        sweep."""
+        with self._lock:
+            flight = self._inflight.get(table, [])
+            for b in batches:
+                if b in flight:
+                    flight.remove(b)
+
+    def drop_table(self, table: str) -> List[StagedBatch]:
+        """Forget a table's staged state (recovery swept its staging
+        dir out from under us)."""
+        with self._lock:
+            dropped = self._staged.pop(table, [])
+            dropped += self._inflight.pop(table, [])
+            self._schemas.pop(table, None)
+            return dropped
+
+    def table_schema(self, table: str, loader):
+        """Memoized table schema; ``loader()`` runs once per table and
+        provides the authoritative schema (the first batch's own schema
+        bootstraps a still-empty table — see ``forget_schema_if_unused``
+        for the discarded-bootstrap case)."""
+        with self._lock:
+            sch = self._schemas.get(table)
+        if sch is not None:
+            return sch
+        sch = loader()
+        if sch is not None:
+            with self._lock:
+                sch = self._schemas.setdefault(table, sch)
+        return sch
+
+    def has_pending(self, table: str) -> bool:
+        """Any staged or in-flight batches for ``table``? (The cheap
+        gate in front of forget_schema_if_unused's directory walk.)"""
+        with self._lock:
+            return bool(self._staged.get(table)
+                        or self._inflight.get(table))
+
+    def forget_schema_if_unused(self, table: str) -> None:
+        """Drop the schema memo when NOTHING backs it anymore: the
+        bootstrap batch that seeded it was discarded before any other
+        batch staged, so a fresh first batch may define a different
+        schema (a memo backed by on-disk files or live staged batches
+        stays)."""
+        with self._lock:
+            if not self._staged.get(table) and \
+                    not self._inflight.get(table):
+                self._schemas.pop(table, None)
+
+    def staged_delta_count(self, table: str, index_name: str) -> int:
+        """How many staged/in-flight batches already carry a delta for
+        ``index_name`` — the lineage-id offset of the next append."""
+        with self._lock:
+            n = 0
+            for b in self._staged.get(table, []) + \
+                    self._inflight.get(table, []):
+                if any(d.index_name == index_name for d in b.covering):
+                    n += 1
+            return n
+
+    def staged_count(self, table: str) -> int:
+        with self._lock:
+            return len(self._staged.get(table, [])) + \
+                len(self._inflight.get(table, []))
+
+    def note(self, **deltas) -> None:
+        with self._lock:
+            for k, v in deltas.items():
+                self._stats[k] += v
+
+    def stats(self) -> dict:
+        from ..index.log_manager import get_lookup_cache
+        with self._lock:
+            out = dict(self._stats)
+            out["tables_staged"] = sum(
+                1 for v in self._staged.values() if v)
+            out["batches_staged"] = sum(
+                len(v) for v in self._staged.values())
+        out["oplog_cache"] = get_lookup_cache().stats()
+        return out
+
+
+_QUEUE: Optional[CommitQueue] = None
+_QUEUE_LOCK = threading.Lock()
+
+
+def get_queue() -> CommitQueue:
+    """The process-wide commit queue; first use registers the
+    "streaming" collector in the metrics registry."""
+    global _QUEUE
+    with _QUEUE_LOCK:
+        if _QUEUE is None:
+            _QUEUE = CommitQueue()
+            from ..telemetry.metrics import get_registry
+            get_registry().register_collector("streaming", _QUEUE.stats)
+        return _QUEUE
+
+
+# ---------------------------------------------------------------------------
+# Table plumbing.
+# ---------------------------------------------------------------------------
+
+def table_key(table_path: str) -> str:
+    """Stable directory-safe identity of a table path (the streaming
+    log's directory name under <systemPath>/_streaming/)."""
+    table_path = os.path.abspath(table_path)
+    return (os.path.basename(table_path.rstrip(os.sep)) + "-"
+            + hashing.md5_hex(table_path)[:10])
+
+
+def table_log_dir(session, table_path: str) -> str:
+    return os.path.join(session.hs_conf.system_path(), SC.STREAMING_DIR,
+                        table_key(table_path))
+
+
+def _staged_marker_dir(session) -> str:
+    return os.path.join(session.hs_conf.system_path(), SC.STREAMING_DIR,
+                        "_staged")
+
+
+def _note_staged_table(session, table_path: str) -> None:
+    """Record WHERE a table with staged batches lives, so the recovery
+    sweep can find staging leftovers even for a table no commit ever
+    gave a streaming log (the dead-before-first-commit appender)."""
+    marker = os.path.join(_staged_marker_dir(session),
+                          table_key(table_path))
+    if not os.path.exists(marker):
+        file_utils.makedirs(_staged_marker_dir(session))
+        tmp = marker + ".tmp"
+        with open(tmp, "w") as f:
+            f.write(table_path)
+        os.replace(tmp, marker)
+
+
+def _to_arrow(batch):
+    """Accept a pyarrow Table/RecordBatch, a pandas DataFrame, or a
+    dict of columns; return a pyarrow Table."""
+    import pyarrow as pa
+    if isinstance(batch, pa.Table):
+        return batch
+    if isinstance(batch, pa.RecordBatch):
+        return pa.Table.from_batches([batch])
+    if isinstance(batch, dict):
+        return pa.table(batch)
+    try:
+        import pandas as pd
+        if isinstance(batch, pd.DataFrame):
+            return pa.Table.from_pandas(batch, preserve_index=False)
+    except ImportError:  # pandas is ubiquitous here, but stay honest
+        pass
+    raise HyperspaceException(
+        f"append() cannot convert {type(batch).__name__} to a record "
+        "batch (pass a pyarrow Table/RecordBatch, pandas DataFrame, or "
+        "dict of columns)")
+
+
+def _indexes_for_table(session, table_path: str) -> List[IndexLogEntry]:
+    """ACTIVE indexes whose single source relation is exactly this
+    parquet table directory."""
+    out = []
+    for entry in session.index_collection_manager.get_indexes(
+            [States.ACTIVE]):
+        try:
+            rel = entry.relation
+        except (AssertionError, AttributeError, IndexError):
+            continue
+        if rel.fileFormat == "parquet" and \
+                [os.path.abspath(p) for p in rel.rootPaths] == [table_path]:
+            out.append(entry)
+    return out
+
+
+def _prev_source_max_id(entry: IndexLogEntry) -> int:
+    return max((f.id for f in entry.source_file_info_set), default=-1)
+
+
+def _staging_dir(base: str) -> str:
+    path = os.path.join(base, SC.STAGING_DIR)
+    file_utils.makedirs(path)
+    return path
+
+
+# ---------------------------------------------------------------------------
+# append().
+# ---------------------------------------------------------------------------
+
+def append(session, table_path: str, batch) -> dict:
+    """Stage one record batch for ``table_path`` and prebuild its index
+    deltas on device. Returns a summary dict; nothing is visible to
+    queries until ``commit()``."""
+    if not session.hs_conf.streaming_enabled():
+        raise HyperspaceException(
+            "hyperspace.tpu.streaming.enabled is false; enable it to use "
+            "the append/commit ingestion tier")
+    table_path = os.path.abspath(table_path)
+    queue = get_queue()
+    with queue.table_lock(table_path), \
+            _faults.scope_for(session.hs_conf), \
+            _trace.maintenance_trace(session, "ingest"), \
+            _trace.span(SN.INGEST_APPEND) as sp:
+        t0 = time.perf_counter()
+        # Backpressure FIRST: a rejected append must not pay the parquet
+        # write and the on-device delta builds (push() re-checks under
+        # the lock for race-tightness).
+        max_staged = session.hs_conf.streaming_max_staged_batches()
+        if queue.staged_count(table_path) >= max_staged:
+            raise HyperspaceException(
+                f"{table_path}: staged batches reach "
+                "hyperspace.tpu.streaming.maxStagedBatches; commit() "
+                "before appending more")
+        at = _to_arrow(batch)
+        if at.num_rows == 0:
+            raise HyperspaceException("append() got an empty batch")
+        file_utils.makedirs(table_path)
+        _check_schema(queue, table_path, at)
+        batch_id = uuid.uuid4().hex[:12]
+        staging = _staging_dir(table_path)
+        _note_staged_table(session, table_path)
+        staged_path = os.path.join(
+            staging, f"{SC.INGEST_FILE_PREFIX}{batch_id}.parquet")
+        final_path = os.path.join(
+            table_path, f"{SC.INGEST_FILE_PREFIX}{batch_id}.parquet")
+        import pyarrow.parquet as pq
+        staged = None
+        try:
+            _faults.fault_point(_fn.INGEST_STAGE)
+            pq.write_table(at, staged_path)
+            _, nbytes, mtime_ms = file_utils.file_info_triple(staged_path)
+            staged = StagedBatch(batch_id, table_path, staged_path,
+                                 final_path, at.num_rows, nbytes, mtime_ms,
+                                 Schema.from_arrow(at.schema))
+            if session.hs_conf.streaming_load_time_indexing():
+                # Same kernel/io scoping as Action.run: the bucket
+                # sorts and sketch reductions read this session's
+                # shapeBucketing conf and attribute their reads to it.
+                from ..execution import shapes
+                from ..parallel import io as pio
+                with shapes.use_conf(session.hs_conf), \
+                        pio.use_session(session):
+                    _prebuild_deltas(session, queue, staged, at)
+            queue.push(staged, max_staged)
+        except BaseException:
+            # A failed append must not leak invisible staging files —
+            # including the partial parquet of a failed write — until
+            # the next recover() sweep, nor pin a schema memo its own
+            # (discarded) batch bootstrapped on an empty table.
+            # Queue state first: while other batches back the memo the
+            # directory walk (O(table files)) is never paid.
+            if staged is not None:
+                _discard_staged(staged)
+            else:
+                try:
+                    os.unlink(staged_path)
+                except OSError:
+                    pass
+            if not queue.has_pending(table_path) and \
+                    not any(f.endswith(".parquet")
+                            for f in file_utils.list_leaf_files(table_path)):
+                queue.forget_schema_if_unused(table_path)
+            raise
+        seconds = time.perf_counter() - t0
+        if sp is not None:
+            sp.attrs["rows"] = staged.rows
+            sp.attrs["covering_deltas"] = len(staged.covering)
+            sp.attrs["sketch_deltas"] = len(staged.sketches)
+        _emit_append(session, staged, seconds)
+        return {"batch_id": batch_id, "rows": staged.rows,
+                "staged_batches": queue.staged_count(table_path),
+                "covering_deltas": len(staged.covering),
+                "sketch_deltas": len(staged.sketches)}
+
+
+def _check_schema(queue: CommitQueue, table_path: str, at) -> None:
+    """An appended batch must carry the table's columns AND types —
+    extra columns or a type fork are refused loudly rather than
+    silently forked across files (a scan over mixed-type parquet fails
+    at read time, far from the append that caused it). The table schema
+    is memoized per table: the check is append-invariant, and a
+    directory walk per append would grow with every commit."""
+    import pyarrow.parquet as pq
+
+    def load():
+        existing = [f for f in file_utils.list_leaf_files(table_path)
+                    if f.endswith(".parquet")]
+        return pq.read_schema(existing[0]) if existing else at.schema
+
+    have = queue.table_schema(table_path, load)
+    names = set(have.names)
+    got = set(at.schema.names)
+    if got != names:
+        raise HyperspaceException(
+            f"append() schema mismatch for {table_path}: table has "
+            f"{sorted(names)}, batch has {sorted(got)}")
+    forked = [(n, str(have.field(n).type), str(at.schema.field(n).type))
+              for n in sorted(names)
+              if have.field(n).type != at.schema.field(n).type]
+    if forked:
+        raise HyperspaceException(
+            f"append() schema mismatch for {table_path}: column type "
+            f"fork {forked} (table type vs batch type)")
+
+
+def _discard_staged(staged: StagedBatch) -> None:
+    """Best-effort removal of one staged batch's files (the failed-
+    append path; crashes still rely on the recovery sweep)."""
+    import shutil
+    try:
+        os.unlink(staged.staged_path)
+    except OSError:
+        pass
+    for delta in staged.covering:
+        shutil.rmtree(delta.staged_dir, ignore_errors=True)
+
+
+def _prebuild_deltas(session, queue: CommitQueue, staged: StagedBatch,
+                     at) -> None:
+    """The aggressive-elephants step: while the batch is in memory,
+    bucket-route it for every covering index and sketch it for every
+    skipping index over this table. Indexes whose columns the batch
+    cannot serve are skipped (hybrid scan covers their files)."""
+    from ..execution.columnar import Table as ExecTable
+    entries = _indexes_for_table(session, staged.table_path)
+    if not entries:
+        return
+    resolver = PathResolver(session.hs_conf)
+    exec_table = ExecTable.from_arrow(at)
+    for entry in entries:
+        index_path = resolver.get_index_path(entry.name)
+        kind = getattr(entry.derivedDataset, "kind", "")
+        if kind == "CoveringIndex":
+            delta = _prebuild_covering(session, queue, staged, exec_table,
+                                       entry, index_path)
+        elif kind == "DataSkippingIndex":
+            delta = _prebuild_sketch(staged, exec_table, entry, index_path)
+        else:
+            delta = None
+        if delta is not None:
+            if isinstance(delta, _CoveringDelta):
+                staged.covering.append(delta)
+            else:
+                staged.sketches.append(delta)
+
+
+def _prebuild_covering(session, queue: CommitQueue, staged: StagedBatch,
+                       exec_table, entry: IndexLogEntry,
+                       index_path: str) -> Optional[_CoveringDelta]:
+    import jax.numpy as jnp
+
+    from ..actions.create import _write_bucket_files
+    from ..execution.columnar import Column
+    from ..index.constants import IndexConstants
+    from ..ops import index_build
+    from ..schema import INT64
+    cols = list(entry.indexed_columns) + list(entry.included_columns)
+    if any(c not in exec_table.names for c in cols):
+        return None
+    table = exec_table.select(cols)
+    lineage_id = None
+    if entry.has_lineage_column():
+        # Deterministic id prediction: the seeded tracker at commit time
+        # assigns prev_max+1, +2, ... in batch order; staged/in-flight
+        # batches ahead of us occupy the earlier slots (appends are
+        # serialized per table, so the count cannot move under us).
+        lineage_id = _prev_source_max_id(entry) + 1 + \
+            queue.staged_delta_count(staged.table_path, entry.name)
+        table = table.with_column(
+            IndexConstants.DATA_FILE_NAME_ID,
+            Column(INT64, jnp.full((table.num_rows,), lineage_id,
+                                   dtype=jnp.int64)))
+    sorted_table, bounds = index_build.build_sorted_buckets(
+        table, list(entry.indexed_columns), entry.num_buckets)
+    staged_dir = os.path.join(_staging_dir(index_path), staged.batch_id)
+    file_utils.makedirs(staged_dir)
+    suffix = staged.batch_id[:8]
+
+    def name_for(bucket: int) -> str:
+        return index_build.bucket_file_name(bucket).replace(
+            ".parquet", f"-{suffix}.parquet")
+
+    try:
+        _write_bucket_files(sorted_table.to_host(), bounds, 0,
+                            entry.num_buckets, staged_dir,
+                            session.hs_conf.index_row_group_size(),
+                            file_name=name_for)
+    except BaseException:
+        # The delta never reaches staged.covering, so append()'s
+        # cleanup can't see it — remove the partial dir here or it
+        # leaks until an operator-run recover().
+        import shutil
+        shutil.rmtree(staged_dir, ignore_errors=True)
+        raise
+    return _CoveringDelta(entry.name, index_path, staged_dir, lineage_id,
+                          _covering_layout(entry))
+
+
+def _prebuild_sketch(staged: StagedBatch, exec_table,
+                     entry: IndexLogEntry,
+                     index_path: str) -> Optional[_SketchDelta]:
+    from ..actions import create_skipping as cs
+    from ..ops import sketches as sk
+    sketch_list = entry.derivedDataset.sketches
+    if any(s.column not in exec_table.names for s in sketch_list):
+        return None
+    values: Dict = {cs.FILE_COL: staged.final_path}
+    for s in sketch_list:
+        col = exec_table.column(s.column)
+        if s.kind == "MinMax":
+            lo, hi = cs.minmax_cols(s.column)
+            mn, mx = sk.minmax_values(col)
+            values[lo] = mn
+            values[hi] = mx
+        elif s.kind == "ValueList":
+            values[cs.valuelist_col(s.column)] = sk.value_list(
+                col, int(s.properties["maxValues"]))
+        elif s.kind == "BloomFilter":
+            values[cs.bloom_col(s.column)] = sk.bloom_build(
+                col, int(s.properties["numBits"]),
+                int(s.properties["numHashes"])).tobytes()
+        else:
+            return None  # unknown sketch kind: leave it to hybrid scan
+    return _SketchDelta(entry.name, index_path, values,
+                        _sketch_layout(entry))
+
+
+# ---------------------------------------------------------------------------
+# commit(): the op-log protocol around publish + delta landing.
+# ---------------------------------------------------------------------------
+
+def _pinned_source(session, table_path: str, prev: IndexLogEntry,
+                   batch_infos: List[FileInfo]) -> Source:
+    """Source descriptor over EXACTLY the previous entry's files plus
+    this commit's batch files — not a live re-listing, so a foreign file
+    landing concurrently can never be claimed as covered (it stays a
+    hybrid-scan append). The fingerprint is computed by the standard
+    provider over a relation pinned to that file set, so a fresh query
+    whose listing matches applies the index with a plain exact-match
+    IndexScan."""
+    from ..index.signatures import IndexSignatureProvider
+    from ..plan.nodes import Scan
+    from ..sources.default import DefaultFileBasedRelation
+    prev_infos = sorted(prev.source_file_info_set, key=lambda f: f.name)
+    paths = sorted([f.name for f in prev_infos]
+                   + [f.name for f in batch_infos])
+    # Schema pinned from the prev entry (footer-derived when the index
+    # was built): a live build_relation().with_files() would re-walk the
+    # whole table dir and re-read a footer per index per commit, on the
+    # write path that must stay O(batch).
+    relation = DefaultFileBasedRelation.pinned(
+        [table_path], "parquet", {}, paths, prev.relation.dataSchema)
+    content = _content_over(prev_infos + list(batch_infos))
+    rel_meta = Relation(rootPaths=[table_path], data=Hdfs(content),
+                        dataSchema=relation.schema, fileFormat="parquet",
+                        options={})
+    provider = IndexSignatureProvider()
+    fingerprint = LogicalPlanFingerprint(
+        [Signature(provider.name(), provider.signature(Scan(relation)))])
+    return Source(SourcePlan([rel_meta], fingerprint))
+
+
+def _content_over(infos: List[FileInfo]) -> Content:
+    from ..actions.refresh import content_from_file_infos
+    content = content_from_file_infos(list(infos))
+    if content is None:
+        raise HyperspaceException("cannot build content over zero files")
+    return content
+
+
+def _rewrite_lineage(staged_dir: str, fid: int,
+                     row_group_size: int) -> None:
+    """Repair a drifted lineage prediction: rewrite each staged bucket
+    file's constant ``_data_file_id`` column to the committed id. Rare
+    (only when another writer moved the index's id base between append
+    and commit) and cheap (per-batch files are small). Rewritten with
+    the configured index row-group size so a repaired file keeps the
+    same row-group layout as its untouched siblings."""
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    from ..index.constants import IndexConstants
+    col_name = IndexConstants.DATA_FILE_NAME_ID
+    for fname in sorted(os.listdir(staged_dir)):
+        path = os.path.join(staged_dir, fname)
+        table = pq.read_table(path, partitioning=None)
+        if col_name not in table.schema.names:
+            continue
+        idx = table.schema.get_field_index(col_name)
+        fixed = pa.array([fid] * table.num_rows,
+                         type=table.schema.field(idx).type)
+        pq.write_table(table.set_column(idx, col_name, fixed), path,
+                       row_group_size=row_group_size)
+
+
+def _carry_props(prev: IndexLogEntry) -> Dict[str, str]:
+    """Entry properties a streaming delta carries forward — currently
+    the compaction generation, so post-compaction entries keep pinning
+    it into their bytes (no key aliasing across a compaction)."""
+    gen = prev.properties.get(SC.COMPACTION_GENERATION_PROPERTY)
+    return {SC.COMPACTION_GENERATION_PROPERTY: gen} \
+        if gen is not None else {}
+
+
+class _LandDeltasBase(Action):
+    """Shared frame of the per-index delta-landing actions: both kinds
+    run inside one streaming commit, re-anchor on the index's latest
+    ACTIVE entry, and 2-phase through the index's own op log, so a
+    crash here recovers through the ordinary index sweep."""
+
+    transient_state = States.REFRESHING
+    final_state = States.ACTIVE
+
+    def __init__(self, session, log_manager: IndexLogManager,
+                 data_manager: IndexDataManager, table_path: str,
+                 pairs: List[tuple]):
+        super().__init__(session, log_manager)
+        self.data_manager = data_manager
+        self.table_path = table_path
+        self.pairs = pairs  # [(StagedBatch, delta)] in batch order
+        self.index_name = pairs[0][1].index_name
+        self._prev: Optional[IndexLogEntry] = None
+        self._entry: Optional[IndexLogEntry] = None
+
+    @property
+    def prev_entry(self) -> IndexLogEntry:
+        if self._prev is None:
+            entry = self.log_manager.get_latest_stable_log()
+            if entry is None or entry.state != States.ACTIVE:
+                raise HyperspaceException(
+                    f"cannot land a streaming delta on {self.index_name}:"
+                    " index is not ACTIVE (deleted or mutated between "
+                    "append and commit)")
+            self._prev = entry
+        return self._prev
+
+    def validate(self) -> None:
+        """Pre-begin checks: the index must still be ACTIVE, and its
+        layout must still match what the deltas were built against — a
+        full refresh or delete/recreate between append and commit may
+        have changed it, and landing old-layout files would silently
+        corrupt the index (e.g. bucket pruning reading the wrong
+        files). Raising here (before begin writes anything) routes the
+        index to indexes_skipped — hybrid scan covers the committed
+        files until the next refresh catches the index up."""
+        prev = self.prev_entry
+        want = self._entry_layout(prev)
+        for _batch, delta in self.pairs:
+            if delta.layout != want:
+                raise HyperspaceException(
+                    f"{self.index_name}: index layout changed between "
+                    f"append and commit ({delta.layout} -> {want}); "
+                    "skipping the staged delta")
+        # A refresh racing into the publish->land window may have
+        # already indexed this commit's batch files (they were visible
+        # in the table dir); landing their deltas again would put the
+        # same rows in the index twice. Drop covered batches (their
+        # staged files are dead weight) and skip entirely when the
+        # racing refresh covered them all.
+        import shutil
+        covered = {f.name for f in prev.source_file_info_set}
+        fresh = [(b, d) for (b, d) in self.pairs
+                 if b.final_path not in covered]
+        for b, d in self.pairs:
+            if b.final_path in covered:
+                staged_dir = getattr(d, "staged_dir", None)
+                if staged_dir:
+                    shutil.rmtree(staged_dir, ignore_errors=True)
+        if not fresh:
+            raise HyperspaceException(
+                f"{self.index_name}: a concurrent refresh already "
+                "covers every batch of this commit; nothing to land")
+        self.pairs = fresh
+
+    @staticmethod
+    def _entry_layout(prev: IndexLogEntry) -> tuple:
+        raise NotImplementedError
+
+    @property
+    def log_entry(self) -> IndexLogEntry:
+        if self._entry is not None:
+            return self._entry
+        return self.prev_entry  # begin() placeholder, like refresh
+
+    def event(self, message: str):
+        from ..telemetry.events import StreamingIndexDeltaEvent
+        return StreamingIndexDeltaEvent(
+            message=message, index_name=self.index_name)
+
+
+class _LandCoveringDeltas(_LandDeltasBase):
+    """Land the prebuilt bucket-aligned part files of one covering index
+    for every batch of one commit: rename the staged files into a new
+    immutable data version and commit an entry whose content is the old
+    files ∪ the delta files — RefreshIncrementalAction's append-only
+    layout, minus the build (it already ran at append time)."""
+
+    _entry_layout = staticmethod(_covering_layout)
+
+    def op(self) -> None:
+        prev = self.prev_entry
+        latest = self.data_manager.get_latest_version_id()
+        version = 0 if latest is None else latest + 1
+        out_dir = self.data_manager.get_path(version)
+        file_utils.makedirs(out_dir)
+        # Commit-time file ids FIRST (the batch files were published by
+        # the outer commit before this action runs): the append-time
+        # lineage prediction is only a fast path — a refresh/commit
+        # racing between append and commit moves the id base, and a
+        # drifted delta is REPAIRED in place (its lineage column is a
+        # per-batch constant) rather than wrecking the commit.
+        tracker = FileIdTracker()
+        tracker.add_file_info(prev.source_file_info_set)
+        batch_infos = []
+        for batch, delta in self.pairs:
+            full, size, mtime = file_utils.file_info_triple(
+                batch.final_path)
+            fid = tracker.add_file(full, size, mtime)
+            if delta.lineage_id is not None and fid != delta.lineage_id:
+                _rewrite_lineage(
+                    delta.staged_dir, fid,
+                    self.session.hs_conf.index_row_group_size())
+            batch_infos.append(FileInfo(full, size, mtime, fid))
+        for _batch, delta in self.pairs:
+            for fname in sorted(os.listdir(delta.staged_dir)):
+                os.replace(os.path.join(delta.staged_dir, fname),
+                           os.path.join(out_dir, fname))
+            try:
+                os.rmdir(delta.staged_dir)
+            except OSError:
+                pass
+        index_content = prev.content.merge(
+            Content.from_directory(out_dir, tracker))
+        source = _pinned_source(self.session, self.table_path, prev,
+                                batch_infos)
+        entry = IndexLogEntry.create(prev.name, prev.derivedDataset,
+                                     index_content, source,
+                                     _carry_props(prev))
+        self._entry = entry.with_log_version(version)
+
+
+class _LandSketchDeltas(_LandDeltasBase):
+    """Merge precomputed sketch rows for one skipping index into a new
+    sketch-table version (kept rows + one appended row per batch file)
+    — RefreshDataSkippingIncrementalAction's shape with the device
+    reductions already paid at append time."""
+
+    _entry_layout = staticmethod(_sketch_layout)
+
+    def op(self) -> None:
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+
+        from ..actions import create_skipping as cs
+        from ..index import data_store
+        prev = self.prev_entry
+        _fs, old_path = data_store.fs_and_path(cs._sketch_file(prev))
+        # partitioning=None: the v__=<n> path component must not be
+        # hive-inferred as a phantom column (same guard as the
+        # incremental skipping refresh).
+        old = pq.read_table(old_path, filesystem=_fs, partitioning=None)
+        tracker = FileIdTracker()
+        tracker.add_file_info(prev.source_file_info_set)
+        batch_infos = []
+        rows: Dict[str, list] = {f.name: [] for f in old.schema}
+        for batch, delta in self.pairs:
+            full, size, mtime = file_utils.file_info_triple(
+                batch.final_path)
+            fid = tracker.add_file(full, size, mtime)
+            batch_infos.append(FileInfo(full, size, mtime, fid))
+            values = dict(delta.values)
+            values[cs.FILE_ID_COL] = fid
+            for f in old.schema:
+                rows[f.name].append(values.get(f.name))
+        appended = pa.table(
+            {f.name: pa.array(rows[f.name], type=f.type)
+             for f in old.schema}, schema=old.schema)
+        merged = pa.concat_tables([old, appended])
+        latest = self.data_manager.get_latest_version_id()
+        version = 0 if latest is None else latest + 1
+        out_dir = self.data_manager.get_path(version)
+        file_utils.makedirs(out_dir)
+        _fs2, merged_path = data_store.fs_and_path(
+            os.path.join(out_dir, cs.SKETCH_FILE_NAME))
+        pq.write_table(merged, merged_path, filesystem=_fs2)
+        index_content = Content.from_directory(out_dir, tracker)
+        source = _pinned_source(self.session, self.table_path, prev,
+                                batch_infos)
+        entry = IndexLogEntry.create(prev.name, prev.derivedDataset,
+                                     index_content, source,
+                                     _carry_props(prev))
+        self._entry = entry.with_log_version(version)
+
+
+class _StreamingCommitAction(Action):
+    """One atomic commit of every staged batch for one table, bracketed
+    by the table's streaming op log: begin writes a transient entry
+    listing the files about to publish (put-if-absent decides
+    concurrent-commit races), op renames the batch files into the table
+    dir and lands the per-index deltas, end commits the ACTIVE entry.
+    recover() resolves any crash in between: undo while batch files are
+    partially published, redo once all of them landed (see
+    ``recover_streaming``)."""
+
+    transient_state = States.REFRESHING
+    final_state = States.ACTIVE
+
+    def __init__(self, session, log_manager: IndexLogManager,
+                 table_path: str, batches: List[StagedBatch]):
+        super().__init__(session, log_manager)
+        self.table_path = table_path
+        self.batches = batches
+        self.op_started = False
+        self.indexes_updated: List[str] = []
+        self.indexes_skipped: List[str] = []
+
+    def validate(self) -> None:
+        latest_id = self.log_manager.get_latest_id()
+        if latest_id is None:
+            return
+        # Lenient: a torn (unparseable) tip is a wreck to recover, not
+        # a parse error to crash commit() with forever.
+        latest = self.log_manager._get_log_lenient(latest_id)
+        if latest is None or latest.state not in STABLE_STATES:
+            raise HyperspaceException(
+                f"streaming log for {self.table_path} is mid-commit or "
+                "wrecked; run Hyperspace.recover() first")
+
+    def _stable(self) -> Optional[IndexLogEntry]:
+        entry = self.log_manager.get_latest_stable_log()
+        if entry is not None and entry.state != States.ACTIVE:
+            return None  # DOESNOTEXIST after a cancelled first commit
+        return entry
+
+    @property
+    def log_entry(self) -> IndexLogEntry:
+        prev = self._stable()
+        infos = [FileInfo(b.final_path, b.nbytes, b.mtime_ms)
+                 for b in self.batches]
+        new_content = _content_over(infos)
+        if prev is not None and prev.content is not None \
+                and prev.content.files:
+            content = prev.content.merge(new_content)
+        else:
+            content = new_content
+        props = _carry_props(prev) if prev is not None else {}
+        derived = IngestedTable(schema=self.batches[0].schema)
+        rel = Relation(rootPaths=[self.table_path], data=Hdfs(content),
+                       dataSchema=self.batches[0].schema,
+                       fileFormat="parquet", options={})
+        fingerprint = LogicalPlanFingerprint(
+            [Signature("streaming.ingest", table_key(self.table_path))])
+        return IndexLogEntry.create(
+            table_key(self.table_path), derived, content,
+            Source(SourcePlan([rel], fingerprint)), props)
+
+    def op(self) -> None:
+        self.op_started = True
+        _faults.fault_point(_fn.INGEST_PUBLISH)
+        for b in self.batches:
+            os.replace(b.staged_path, b.final_path)
+        resolver = PathResolver(self.session.hs_conf)
+        cov: Dict[str, List[tuple]] = {}
+        sk: Dict[str, List[tuple]] = {}
+        for b in self.batches:
+            for d in b.covering:
+                cov.setdefault(d.index_name, []).append((b, d))
+            for d in b.sketches:
+                sk.setdefault(d.index_name, []).append((b, d))
+        for name in sorted(cov):
+            path = resolver.get_index_path(name)
+            self._land(name, _LandCoveringDeltas(
+                self.session, IndexLogManager(path),
+                IndexDataManager(path), self.table_path, cov[name]))
+        for name in sorted(sk):
+            path = resolver.get_index_path(name)
+            self._land(name, _LandSketchDeltas(
+                self.session, IndexLogManager(path),
+                IndexDataManager(path), self.table_path, sk[name]))
+
+    def _land(self, name: str, action: Action) -> None:
+        """One index's delta landing must not fail the COMMIT: the
+        batch files are already published, and an index that lost its
+        delta (deleted between append and commit, a log-id race with a
+        concurrent refresh/compact) just doesn't cover them — hybrid
+        scan does, and the next commit or refresh catches it up. A
+        wreck the failure left in the INDEX's own log recovers through
+        the ordinary index sweep. Kills/cancellation still propagate."""
+        try:
+            action.run()
+        except Exception:
+            self.indexes_skipped.append(name)
+        else:
+            self.indexes_updated.append(name)
+
+    def event(self, message: str):
+        from ..telemetry.events import StreamingCommitEvent
+        return StreamingCommitEvent(
+            message=message, table=self.table_path,
+            batches=len(self.batches), files=len(self.batches),
+            rows=sum(b.rows for b in self.batches),
+            indexes_updated=list(self.indexes_updated))
+
+
+def commit(session, table_path: str) -> dict:
+    """Publish every staged batch for ``table_path`` atomically. Returns
+    a summary dict ({committed_batches, rows, files, indexes_updated});
+    a commit that lost the put-if-absent race (another process committed
+    concurrently) re-queues its batches and raises — retry after the
+    winner finishes."""
+    if not session.hs_conf.streaming_enabled():
+        raise HyperspaceException(
+            "hyperspace.tpu.streaming.enabled is false; enable it to use "
+            "the append/commit ingestion tier")
+    table_path = os.path.abspath(table_path)
+    queue = get_queue()
+    with queue.commit_lock(table_path):
+        batches = queue.pop_all(table_path)
+        if not batches:
+            # Same shape as a non-empty commit: callers read these keys
+            # unconditionally (retry loops, timer-driven committers).
+            return {"committed_batches": 0, "rows": 0, "files": [],
+                    "indexes_updated": [], "indexes_skipped": [],
+                    "subscriptions_fired": 0, "seconds": 0.0}
+        t0 = time.perf_counter()
+        log_mgr = IndexLogManager(table_log_dir(session, table_path))
+        action = _StreamingCommitAction(session, log_mgr, table_path,
+                                        batches)
+        try:
+            with _trace.maintenance_trace(session, "ingest"), \
+                    _trace.span(SN.INGEST_COMMIT) as sp:
+                action.run()
+                if sp is not None:
+                    sp.attrs["batches"] = len(batches)
+                    sp.attrs["indexes"] = len(action.indexes_updated)
+        except BaseException:
+            if not action.op_started:
+                # Nothing landed (validation / begin conflict): the
+                # staged batches are intact — retryable.
+                queue.requeue(table_path, batches)
+            else:
+                # Mid-protocol failure: only recover() can resolve the
+                # wreck; drop the batches from the in-flight accounting
+                # so backpressure and lineage offsets stay honest.
+                queue.abandon(table_path, batches)
+            raise
+        queue.land(table_path, batches)
+        # Landed entries changed index state under the caching manager.
+        session.index_collection_manager.clear_cache()
+        seconds = time.perf_counter() - t0
+    fired = _fire_subscriptions(session, table_path)
+    return {"committed_batches": len(batches),
+            "rows": sum(b.rows for b in batches),
+            "files": [b.final_path for b in batches],
+            "indexes_updated": list(action.indexes_updated),
+            "indexes_skipped": list(action.indexes_skipped),
+            "subscriptions_fired": fired,
+            "seconds": seconds}
+
+
+def _fire_subscriptions(session, table_path: str) -> int:
+    from ..serving import frontend as fe
+    fired = 0
+    for front in fe.all_frontends():
+        try:
+            fired += front.notify_commit(session, table_path)
+        except Exception:
+            # The commit already published durably; a notification
+            # failure must not make the committer believe it failed
+            # (per-fire errors are delivered on the subscriptions).
+            continue
+    if fired:
+        get_queue().note(subscription_fires=fired)
+    return fired
+
+
+# ---------------------------------------------------------------------------
+# Crash recovery (driven by robustness/recovery.recover_indexes).
+# ---------------------------------------------------------------------------
+
+def recover_streaming(session, summary: Dict) -> None:
+    """Sweep the per-table streaming logs: UNDO commits that died with
+    batch files partially published (delete what landed, cancel the
+    log), REDO commits that died after every batch file landed (write
+    the final entry — the data is durably on disk and the transient
+    entry records the intent), and clear staging leftovers everywhere.
+    Runs under recover()'s operator contract: no live writer."""
+    s = summary.setdefault("streaming", {
+        "tables": [], "rolled_back": {}, "completed": [],
+        "torn_entries": 0, "staging_swept": 0})
+    root = os.path.join(session.hs_conf.system_path(), SC.STREAMING_DIR)
+    from ..index.constants import IndexConstants
+    if os.path.isdir(root):
+        for name in sorted(os.listdir(root)):
+            path = os.path.join(root, name)
+            if not os.path.isdir(os.path.join(
+                    path, IndexConstants.HYPERSPACE_LOG)):
+                continue
+            s["tables"].append(name)
+            try:
+                _recover_table_log(session, path, name, s)
+            except Exception as e:
+                summary.setdefault("errors", {})[
+                    f"streaming:{name}"] = f"{type(e).__name__}: {e}"
+    # Tables that staged batches but never earned a streaming log (the
+    # appender died before its first commit): the staged-table markers
+    # name them, so their invisible staging files still get swept.
+    marker_dir = _staged_marker_dir(session)
+    if os.path.isdir(marker_dir):
+        for name in sorted(os.listdir(marker_dir)):
+            marker = os.path.join(marker_dir, name)
+            try:
+                with open(marker) as f:
+                    table_path = f.read().strip()
+            except OSError:
+                continue
+            if table_path:
+                stage = os.path.join(table_path, SC.STAGING_DIR)
+                if os.path.isdir(stage):
+                    s["staging_swept"] += _sweep_staging(stage)
+                get_queue().drop_table(os.path.abspath(table_path))
+            try:
+                os.unlink(marker)
+            except OSError:
+                pass
+    # Index-side staging leftovers (prebuilt deltas of batches that will
+    # never commit — their table staging was swept with them).
+    sys_root = session.hs_conf.system_path()
+    if os.path.isdir(sys_root):
+        for name in sorted(os.listdir(sys_root)):
+            if name == SC.STREAMING_DIR:
+                continue
+            stage = os.path.join(sys_root, name, SC.STAGING_DIR)
+            if os.path.isdir(stage):
+                s["staging_swept"] += _sweep_staging(stage)
+
+
+def _recover_table_log(session, path: str, name: str, s: Dict) -> None:
+    mgr = IndexLogManager(path)
+    latest_id = mgr.get_latest_id()
+    if latest_id is None:
+        return
+    latest = mgr._get_log_lenient(latest_id)
+    stable = mgr.get_latest_stable_log()
+    stable_files = set(stable.content.files) \
+        if stable is not None and stable.content is not None else set()
+    if latest is None:
+        # Torn (unparseable) tip: the crash struck mid entry upload —
+        # either the begin write (nothing published yet) or the END
+        # write (transient entry beneath it, files already landed).
+        # Delete the torn file, then RE-EXAMINE the new tip in this
+        # same pass: a torn end must fall through to the redo branch,
+        # not force the operator to run recover() twice.
+        mgr.delete_log(latest_id)
+        s["torn_entries"] += 1
+        _recover_table_log(session, path, name, s)
+        return
+    if latest.state not in STABLE_STATES:
+        torn = [f for f in (latest.content.files
+                            if latest.content is not None else [])
+                if f not in stable_files and os.path.basename(f)
+                .startswith(SC.INGEST_FILE_PREFIX)]
+        landed = [f for f in torn if os.path.isfile(f)]
+        if torn and len(landed) == len(torn):
+            # REDO: publication finished before the crash; finalize.
+            entry = IndexLogEntry.from_json(latest.to_json())
+            entry.state = States.ACTIVE
+            mgr.delete_latest_stable_log()
+            if mgr.write_log(latest_id + 1, entry):
+                mgr.create_latest_stable_log(latest_id + 1)
+            s["completed"].append(name)
+        else:
+            # UNDO: roll the staged batch back out of the table.
+            for f in landed:
+                try:
+                    os.unlink(f)
+                except OSError:
+                    pass
+            from ..actions.lifecycle import CancelAction
+            CancelAction(session, mgr, IndexDataManager(path)).run()
+            s["rolled_back"][name] = len(landed)
+    table_path = None
+    for e in (latest, stable):
+        if e is None:
+            continue
+        try:
+            table_path = os.path.abspath(e.relation.rootPaths[0])
+            break
+        except (AssertionError, AttributeError, IndexError):
+            continue
+    if table_path is not None:
+        stage = os.path.join(table_path, SC.STAGING_DIR)
+        if os.path.isdir(stage):
+            s["staging_swept"] += _sweep_staging(stage)
+        get_queue().drop_table(table_path)
+
+
+def _sweep_staging(path: str) -> int:
+    import shutil
+    n = 0
+    for _root, _dirs, files in os.walk(path):
+        n += len(files)
+    shutil.rmtree(path, ignore_errors=True)
+    return n
+
+
+def _emit_append(session, staged: StagedBatch, seconds: float) -> None:
+    try:
+        from ..telemetry.events import StreamingAppendEvent
+        from ..telemetry.logging import get_logger
+        get_logger(session.hs_conf.event_logger_class()).log_event(
+            StreamingAppendEvent(
+                message=(f"staged {staged.rows} rows "
+                         f"({len(staged.covering)} covering, "
+                         f"{len(staged.sketches)} sketch deltas)"),
+                table=staged.table_path, rows=staged.rows,
+                nbytes=staged.nbytes,
+                covering_deltas=len(staged.covering),
+                sketch_deltas=len(staged.sketches),
+                seconds=seconds))
+    except Exception:
+        pass

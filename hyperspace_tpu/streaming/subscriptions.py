@@ -1,0 +1,310 @@
+"""Standing queries: cached plans re-fired per streaming commit.
+
+A standing query is nothing new in the engine's terms — it is a cached
+plan plus the r06 invalidation hook. ``ServingFrontend.subscribe(df)``
+registers the plan; every ``commit()`` re-submits it through the
+serving worker pool (admission control, deadlines, and the degradation
+ladders apply exactly as for ad-hoc queries), and the result-cache
+log-version keys guarantee the re-fire recomputes iff the commit could
+have changed the answer. Deliveries land asynchronously on the
+subscription's bounded buffer; consumers block on ``wait_for``/
+``latest`` or snapshot ``deliveries()``.
+
+Shedding: a re-fire the frontend rejects (queue depth / byte budget)
+is delivered as that fire's ERROR — a standing query observes overload
+instead of silently skipping a commit.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional
+
+from ..exceptions import HyperspaceException, ServingRejectedError
+
+
+class Delivery:
+    """One fire's outcome: ``result`` (an executed Table) or ``error``."""
+
+    __slots__ = ("seq", "table", "result", "error", "at_s")
+
+    def __init__(self, seq: int, table: str, result=None, error=None):
+        self.seq = seq
+        self.table = table
+        self.result = result
+        self.error = error
+        self.at_s = time.perf_counter()
+
+    @property
+    def ok(self) -> bool:
+        return self.error is None
+
+
+class Subscription:
+    """Handle returned by ``ServingFrontend.subscribe``. Deliveries are
+    appended from serving worker threads (the PendingQuery completion
+    callback), so every mutable field is guarded by ``_cv``."""
+
+    def __init__(self, registry: "SubscriptionRegistry", sub_id: int,
+                 plan, session, client: str,
+                 deadline_ms: Optional[float], history: int):
+        self._registry = registry
+        self.sub_id = sub_id
+        self.plan = plan
+        self.session = session
+        self.client = client or f"standing:{sub_id}"
+        self.deadline_ms = deadline_ms
+        # Source tables this plan reads (absolute root paths): a commit
+        # to an unrelated table never burns a worker slot on this
+        # subscription.
+        self.tables = _source_roots(plan)
+        self._cv = threading.Condition()
+        self._deliveries: "deque[Delivery]" = deque(maxlen=history)
+        self._delivered_total = 0
+        self._fired_total = 0
+        self._active = True
+
+    def fresh_plan(self, relation_memo: Optional[dict] = None):
+        """The subscribed plan with every file-based relation re-listed
+        NOW: a standing query must observe the rows each commit
+        published, not its subscribe-time file snapshot (relations pin
+        their listing for consistency — correct for ad-hoc queries,
+        wrong for a query whose point is to follow the stream). Falls
+        back to the original plan when a leaf cannot refresh.
+        ``relation_memo`` shares one refreshed listing per root-path
+        set across a fire wave — the pin is per COMMIT, so N
+        subscriptions on one table need one directory walk, not N."""
+        from ..plan.nodes import Scan
+
+        def refresh(node):
+            if isinstance(node, Scan) and \
+                    getattr(node, "relation", None) is not None:
+                try:
+                    key = (tuple(node.relation.root_paths),
+                           node.relation.file_format)
+                    fresh = None if relation_memo is None \
+                        else relation_memo.get(key)
+                    if fresh is None:
+                        fresh = node.relation.refresh()
+                        # Pin the listing AT FIRE TIME: the delivery
+                        # answers the table as of the commit that fired
+                        # it, not as of whenever a queued worker gets
+                        # to execute.
+                        fresh.all_files()
+                        if relation_memo is not None:
+                            relation_memo[key] = fresh
+                    return Scan(fresh, skipping_note=node.skipping_note)
+                except Exception:
+                    return node
+            return node
+
+        try:
+            return self.plan.transform_up(refresh)
+        except Exception:
+            return self.plan
+
+    @property
+    def active(self) -> bool:
+        with self._cv:
+            return self._active
+
+    def _close(self) -> None:
+        with self._cv:
+            self._active = False
+            self._cv.notify_all()
+
+    def _next_seq(self) -> int:
+        with self._cv:
+            self._fired_total += 1
+            return self._fired_total
+
+    def _deliver(self, seq: int, table: str, result=None,
+                 error=None) -> None:
+        with self._cv:
+            self._deliveries.append(Delivery(seq, table, result, error))
+            self._delivered_total += 1
+            self._cv.notify_all()
+
+    def deliveries(self) -> List[Delivery]:
+        with self._cv:
+            return list(self._deliveries)
+
+    @property
+    def delivered_total(self) -> int:
+        with self._cv:
+            return self._delivered_total
+
+    def wait_for(self, n: int, timeout: float = 30.0) -> List[Delivery]:
+        """Block until ``n`` TOTAL deliveries have arrived; returns the
+        buffered (most recent) deliveries. TimeoutError past timeout."""
+        deadline = time.monotonic() + timeout
+        with self._cv:
+            while self._delivered_total < n:
+                if not self._active:
+                    # unsubscribe() wakes waiters (_close notifies);
+                    # a delivery already in flight from an earlier fire
+                    # may still land after this raises.
+                    raise HyperspaceException(
+                        f"subscription {self.sub_id} closed after "
+                        f"{self._delivered_total}/{n} deliveries")
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise TimeoutError(
+                        f"subscription {self.sub_id}: "
+                        f"{self._delivered_total}/{n} deliveries after "
+                        f"{timeout}s")
+                self._cv.wait(remaining)
+            return list(self._deliveries)
+
+    def latest(self, timeout: float = 30.0) -> Delivery:
+        """The most recent FIRE's delivery, waiting for the first if
+        none yet. Max-by-seq, not last-appended: deliveries land in
+        completion order, and a slow earlier fire may finish after a
+        later one — its answer must not shadow the newer commit's."""
+        with self._cv:
+            have = self._delivered_total
+        if have == 0:
+            self.wait_for(1, timeout)
+        with self._cv:
+            return max(self._deliveries, key=lambda d: d.seq)
+
+    def unsubscribe(self) -> bool:
+        return self._registry.unsubscribe(self)
+
+
+class SubscriptionRegistry:
+    """The frontend's standing-query registry: subscriptions are
+    registered from client threads and fired from whichever thread runs
+    a commit, so the table is lock-guarded (HS301-registered)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._subs: Dict[int, Subscription] = {}
+        self._next_id = 0
+        self._stats = {
+            "subscribed": 0, "unsubscribed": 0, "fires": 0,
+            "fired_queries": 0, "rejected_queries": 0,
+        }
+
+    def subscribe(self, frontend, query, session, client: str,
+                  deadline_ms: Optional[float], max_subs: int,
+                  history: int) -> Subscription:
+        plan = getattr(query, "plan", query)
+        with self._lock:
+            # Everything in _subs is live: unsubscribe() pops before it
+            # closes (and probing s.active here would nest each sub's
+            # _cv under the registry lock).
+            live = len(self._subs)
+            if live >= max_subs:
+                raise HyperspaceException(
+                    f"{live} standing queries reach "
+                    "hyperspace.tpu.streaming.subscriptions.max")
+            self._next_id += 1
+            sub = Subscription(self, self._next_id, plan, session, client,
+                               deadline_ms, history)
+            self._subs[sub.sub_id] = sub
+            self._stats["subscribed"] += 1
+        return sub
+
+    def unsubscribe(self, sub: Subscription) -> bool:
+        with self._lock:
+            dropped = self._subs.pop(sub.sub_id, None) is not None
+            if dropped:
+                self._stats["unsubscribed"] += 1
+        if dropped:
+            sub._close()
+        return dropped
+
+    def fire(self, frontend, session, table: str) -> int:
+        """Re-submit every live subscription's plan — re-listed fresh,
+        so deliveries carry the committed rows — through the serving
+        pool. Subscriptions whose source tables don't include the
+        committed one are skipped (their answer cannot have changed).
+        Returns how many fires were admitted; rejected fires are
+        delivered as errors (observable shedding)."""
+        with self._lock:
+            subs = [s for s in self._subs.values()]
+        subs = [s for s in subs if s.active
+                and (not table or not s.tables or table in s.tables)]
+        fired = rejected = 0
+        relation_memo: dict = {}  # one listing per root set this wave
+        for sub in subs:
+            seq = sub._next_seq()
+            try:
+                pending = frontend.submit(
+                    sub.fresh_plan(relation_memo), session=sub.session,
+                    client=sub.client, deadline_ms=sub.deadline_ms)
+            except Exception as e:
+                # ANY submit-time failure — shedding (the typed
+                # rejection) or otherwise — is delivered as this fire's
+                # error: it must never escape into the committer (the
+                # commit already published durably) nor starve the
+                # remaining subscriptions of their fires.
+                sub._deliver(seq, table, error=e)
+                if isinstance(e, ServingRejectedError):
+                    rejected += 1
+                continue
+            pending.on_done(_delivery_callback(sub, seq, table))
+            fired += 1
+        with self._lock:
+            self._stats["fires"] += 1 if subs else 0
+            self._stats["fired_queries"] += fired
+            self._stats["rejected_queries"] += rejected
+        if subs:
+            self._emit(session, table, fired, rejected)
+        return fired
+
+    def _emit(self, session, table: str, fired: int,
+              rejected: int) -> None:
+        try:
+            from ..telemetry.events import StandingQueryEvent
+            from ..telemetry.logging import get_logger
+            get_logger(session.hs_conf.event_logger_class()).log_event(
+                StandingQueryEvent(
+                    message=(f"commit re-fired {fired} standing "
+                             f"quer{'y' if fired == 1 else 'ies'}"
+                             + (f", shed {rejected}" if rejected else "")),
+                    table=table, fired=fired, rejected=rejected))
+        except Exception:
+            pass
+
+    def live_count(self) -> int:
+        with self._lock:
+            return len(self._subs)
+
+    def stats(self) -> dict:
+        with self._lock:
+            out = dict(self._stats)
+            out["live"] = len(self._subs)
+        return out
+
+
+def _source_roots(plan) -> frozenset:
+    """Absolute root paths of every file-based relation leaf (empty
+    when any leaf is opaque — such plans fire on every commit)."""
+    import os
+    roots = set()
+    try:
+        for leaf in plan.collect_leaves():
+            relation = getattr(leaf, "relation", None)
+            if relation is None or not hasattr(relation, "root_paths"):
+                return frozenset()
+            for p in relation.root_paths:
+                roots.add(os.path.abspath(p))
+    except Exception:
+        return frozenset()
+    return frozenset(roots)
+
+
+def _delivery_callback(sub: Subscription, seq: int, table: str):
+    """Completion hook run on the serving worker at query finish; the
+    subscription state rides in as explicit arguments (never ambient
+    context — pool threads inherit none, the r14 contract)."""
+
+    def _on_done(pending) -> None:
+        sub._deliver(seq, table, result=pending._result,
+                     error=pending._error)
+
+    return _on_done

@@ -368,6 +368,70 @@ class QueryCancelledEvent(HyperspaceEvent):
 
 
 @dataclass
+class StreamingAppendEvent(HyperspaceEvent):
+    """Emitted per staged batch (streaming/ingest.py append): how many
+    rows landed in staging, the batch's parquet size, and how many
+    covering/skipping index deltas were prebuilt on-device at load time
+    (the aggressive-elephants contract: index work rides the upload)."""
+
+    table: str = ""
+    rows: int = 0
+    nbytes: int = 0
+    covering_deltas: int = 0
+    sketch_deltas: int = 0
+    seconds: float = 0.0
+
+
+@dataclass
+class StreamingCommitEvent(HyperspaceEvent):
+    """Emitted per commit() publishing staged batches through the
+    op-log protocol: batches/files/rows landed, which indexes received
+    prebuilt deltas, and the commit's wall-clock (metadata + renames —
+    the index build already happened at append time)."""
+
+    table: str = ""
+    batches: int = 0
+    files: int = 0
+    rows: int = 0
+    indexes_updated: List[str] = field(default_factory=list)
+    seconds: float = 0.0
+
+
+@dataclass
+class StreamingIndexDeltaEvent(HyperspaceIndexCRUDEvent):
+    """One prebuilt index delta landed by a streaming commit (the
+    load-time analogue of RefreshIncrementalActionEvent — its presence
+    with ZERO RefreshActionEvents is the 'fresh with no refresh pass'
+    telemetry assertion)."""
+
+
+@dataclass
+class StreamingCompactionEvent(HyperspaceEvent):
+    """Emitted per op-log compacted by compact() (streaming/
+    compaction.py): how many superseded entries folded into the
+    checkpoint, the new compaction generation (pinned into the
+    checkpoint entry bytes so result-cache keys can never alias across
+    a compaction), and data versions vacuumed."""
+
+    subject: str = ""
+    entries_folded: int = 0
+    generation: int = 0
+    versions_vacuumed: int = 0
+
+
+@dataclass
+class StandingQueryEvent(HyperspaceEvent):
+    """Emitted per standing-query fire wave (streaming/
+    subscriptions.py): a commit re-fired ``fired`` subscribed plans
+    through the serving worker pool (``rejected`` were shed by
+    admission control and delivered as errors)."""
+
+    table: str = ""
+    fired: int = 0
+    rejected: int = 0
+
+
+@dataclass
 class IndexCacheProbeEvent(HyperspaceEvent):
     """Base of the HBM index-table-cache probe events: the executor emits
     one per IndexScan cache lookup (execution/index_cache.py counts were

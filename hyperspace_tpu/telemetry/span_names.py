@@ -58,8 +58,17 @@ SPMD_COMPILE = "spmd.compile"
 # member queries' QUERY spans are its children.
 SERVING_SWEEP = "serving.sweep"
 
+# Streaming ingestion tier (streaming/). One INGEST_APPEND per staged
+# batch (attrs carry rows + per-index prebuild counts), one
+# INGEST_COMMIT per commit() publishing staged batches through the
+# op-log protocol, one INGEST_COMPACT per compacted log.
+INGEST_APPEND = "ingest.append"
+INGEST_COMMIT = "ingest.commit"
+INGEST_COMPACT = "ingest.compact"
+
 SPAN_NAMES = frozenset({
     QUERY, PLAN_NORMALIZE, JOIN_REORDER, INDEX_REWRITE, CACHE_LOOKUP,
     BANK_LOOKUP, BANK_COMPILE, EXEC_STAGE, EXEC_FUSED, IO_READ,
     IO_PREFETCH, SPMD_DISPATCH, SPMD_COMPILE, SERVING_SWEEP,
+    INGEST_APPEND, INGEST_COMMIT, INGEST_COMPACT,
 })

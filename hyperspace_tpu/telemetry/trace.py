@@ -262,6 +262,27 @@ def active_ids() -> Tuple[str, str]:
 
 
 @contextlib.contextmanager
+def maintenance_trace(session, label: str = ""):
+    """Root trace for a non-query operation (streaming append/commit/
+    compact): when ``telemetry.trace.enabled`` is set and no trace is
+    already active, opens a fresh Trace so the operation's spans
+    (``ingest.*``) record, landing on ``session._last_trace`` like a
+    query trace. Ambient-trace and tracing-off paths are no-ops — the
+    operation's spans then nest under the caller's trace or vanish."""
+    if _ACTIVE.get() is not None or session is None or \
+            not session.hs_conf.telemetry_trace_enabled():
+        yield None
+        return
+    tr = Trace(session.hs_conf.telemetry_trace_max_spans(), label=label)
+    token = _ACTIVE.set((tr, None))
+    try:
+        yield tr
+    finally:
+        _ACTIVE.reset(token)
+        session._last_trace = tr
+
+
+@contextlib.contextmanager
 def query_trace(session, ctx=None):
     """The root scope ``Session.execute`` opens around one query.
 

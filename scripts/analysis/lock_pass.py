@@ -93,6 +93,29 @@ LOCK_CLASSES = {
         "why": "AOT program map; two sessions can race the same stage's "
                "first compile",
     },
+    ("hyperspace_tpu/streaming/ingest.py", "CommitQueue"): {
+        "locks": {"_lock": None},
+        "delegates": frozenset(),
+        "why": "process-wide staged-batch registry of the ingestion "
+               "tier; appends/commits race from serving workers",
+    },
+    ("hyperspace_tpu/streaming/subscriptions.py", "SubscriptionRegistry"): {
+        "locks": {"_lock": None},
+        "delegates": frozenset(),
+        "why": "standing-query table; subscribes race commit-time fires",
+    },
+    ("hyperspace_tpu/streaming/subscriptions.py", "Subscription"): {
+        "locks": {"_cv": None},
+        "delegates": frozenset(),
+        "why": "deliveries append from serving worker completion "
+               "callbacks while consumers poll",
+    },
+    ("hyperspace_tpu/index/log_manager.py", "LogLookupCache"): {
+        "locks": {"_lock": None},
+        "delegates": frozenset(),
+        "why": "process-wide op-log lookup memo probed per query per "
+               "index on the serving hot path",
+    },
     ("hyperspace_tpu/session.py", "Session"): {
         "locks": {"_views_lock": {"_temp_views", "_temp_views_version"},
                   "_join_actuals_lock": {"_join_actuals"},
@@ -126,6 +149,10 @@ LOCK_GLOBALS = {
     ],
     "hyperspace_tpu/serving/program_bank.py": [
         {"lock": "_BANK_LOCK", "names": {"_BANK"},
+         "why": "double-checked singleton construction"},
+    ],
+    "hyperspace_tpu/streaming/ingest.py": [
+        {"lock": "_QUEUE_LOCK", "names": {"_QUEUE"},
          "why": "double-checked singleton construction"},
     ],
     "hyperspace_tpu/telemetry/metrics.py": [

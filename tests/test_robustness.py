@@ -96,7 +96,8 @@ class TestFaultSpecs:
             "scan.parquet_decode", "spmd.dispatch", "spmd.compile",
             "bank.compile", "result_cache.device_put",
             "result_cache.spill_read", "log.write", "log.stable",
-            "action.op", "serving.worker",
+            "action.op", "serving.worker", "ingest.stage",
+            "ingest.publish",
         })
 
     def test_parse_kinds_and_options(self):

@@ -386,7 +386,8 @@ class TestSpanRegistry:
             "rewrite.index_rules", "serving.cache_lookup",
             "bank.lookup", "bank.compile", "exec.stage", "exec.fused",
             "io.read", "io.prefetch", "spmd.dispatch", "spmd.compile",
-            "serving.sweep",
+            "serving.sweep", "ingest.append", "ingest.commit",
+            "ingest.compact",
         })
 
     def test_join_reorder_span_appears_when_enabled(self, q3ish):
