@@ -329,6 +329,79 @@ class Hyperspace:
             cols["serving"] = self.serving_stats()
         return snap
 
+    def metrics_delta(self, before: dict, after: Optional[dict] = None
+                      ) -> dict:
+        """Numeric leaves that CHANGED between two ``metrics()``
+        snapshots, as one flat ``{dotted.path: delta}`` dict —
+        ``after=None`` snapshots now. The snapshot-vs-snapshot diff
+        bench phases and tests used to hand-roll::
+
+            before = hs.metrics()
+            ...work...
+            assert hs.metrics_delta(before)["counters.trace.sampled"] == 2
+        """
+        from .telemetry.exposition import delta
+        return delta(before, after if after is not None else self.metrics())
+
+    def metrics_text(self) -> str:
+        """The whole ``metrics()`` surface as OpenMetrics text
+        exposition (telemetry/exposition.py) — counters, gauges,
+        histogram quantiles, and every collector's numeric leaves — so
+        an external scraper (or a future multi-process router) can read
+        every counter without importing the process. Round-trips
+        through the strict OpenMetrics parser."""
+        from .telemetry.exposition import render_text
+        return render_text(self.metrics())
+
+    def serve_metrics(self, port: Optional[int] = None) -> int:
+        """Start the opt-in localhost HTTP scrape endpoint
+        (``GET 127.0.0.1:<port>/metrics`` serves ``metrics_text()``).
+        ``port=None`` reads ``hyperspace.tpu.telemetry.export.httpPort``
+        (raising while that conf is 0 — off, the default); an EXPLICIT
+        ``port=0`` binds an ephemeral port. Returns the bound port;
+        idempotent while a server is up. Stop with
+        :meth:`stop_serving_metrics`."""
+        from .telemetry.exposition import start_http_exporter
+        return start_http_exporter(self.session, port)
+
+    def stop_serving_metrics(self) -> None:
+        from .telemetry.exposition import stop_http_exporter
+        stop_http_exporter()
+
+    def health(self) -> dict:
+        """Evaluate this session's SLO objectives
+        (``hyperspace.tpu.telemetry.slo.*``) over the sliding window of
+        completed queries RIGHT NOW and return the verdict dict
+        (``healthy``, per-objective observed/threshold/breached).
+        Healthy→breached transitions emit SloBreachEvent — the sensor
+        half of SLO-driven admission (ROADMAP 2c); nothing is shed yet."""
+        from .telemetry.slo import health
+        return health(self.session)
+
+    def dump_flight_recorder(self, path: Optional[str] = None) -> str:
+        """The flight recorder's rings — recently retained traces,
+        recent events, anomalies, metrics snapshots — as ONE
+        Perfetto/chrome://tracing-loadable JSON document
+        (telemetry/flight_recorder.py). Writes to ``path`` when given;
+        returns the JSON text either way."""
+        import json as _json
+        from .telemetry.flight_recorder import get_recorder
+        text = _json.dumps(get_recorder().dump(), default=str)
+        if path:
+            with open(path, "w", encoding="utf-8") as f:
+                f.write(text)
+        return text
+
+    def explain_analyze(self, df) -> str:
+        """EXECUTE the query with its trace forced on (the sample coin
+        is pinned — the caller asked for this one) and return one
+        post-execution report fusing the span timeline (wall + self
+        times), estimated-vs-actual join rows with per-step q-error,
+        and the query's io/cache/bank/robustness tallies
+        (plananalysis/analyze.py)."""
+        from .plananalysis.analyze import explain_analyze_string
+        return explain_analyze_string(self.session, df.plan)
+
     def last_trace(self):
         """The span-tree :class:`~.telemetry.trace.Trace` of this
         session's most recent traced query — None until a query runs

@@ -507,6 +507,63 @@ class HyperspaceConf:
             TelemetryConstants.TRACE_MAX_SPANS,
             TelemetryConstants.TRACE_MAX_SPANS_DEFAULT))
 
+    def telemetry_trace_sample_rate(self) -> float:
+        """Head-sampled trace RETENTION probability in [0, 1]; see
+        telemetry/constants.py for the provisional-recording contract."""
+        return min(max(float(self._conf.get(
+            TelemetryConstants.TRACE_SAMPLE_RATE,
+            TelemetryConstants.TRACE_SAMPLE_RATE_DEFAULT)), 0.0), 1.0)
+
+    def telemetry_trace_tail_slow_ms(self) -> float:
+        return max(float(self._conf.get(
+            TelemetryConstants.TRACE_TAIL_SLOW_MS,
+            TelemetryConstants.TRACE_TAIL_SLOW_MS_DEFAULT)), 0.0)
+
+    def telemetry_flight_enabled(self) -> bool:
+        return self._get_bool(
+            TelemetryConstants.FLIGHT_ENABLED,
+            TelemetryConstants.FLIGHT_ENABLED_DEFAULT)
+
+    def telemetry_flight_max_traces(self) -> int:
+        return max(int(self._conf.get(
+            TelemetryConstants.FLIGHT_MAX_TRACES,
+            TelemetryConstants.FLIGHT_MAX_TRACES_DEFAULT)), 1)
+
+    def telemetry_slo_enabled(self) -> bool:
+        return self._get_bool(
+            TelemetryConstants.SLO_ENABLED,
+            TelemetryConstants.SLO_ENABLED_DEFAULT)
+
+    def telemetry_slo_p99_ms(self) -> float:
+        return max(float(self._conf.get(
+            TelemetryConstants.SLO_P99_MS,
+            TelemetryConstants.SLO_P99_MS_DEFAULT)), 0.0)
+
+    def telemetry_slo_error_rate(self) -> float:
+        return max(float(self._conf.get(
+            TelemetryConstants.SLO_ERROR_RATE,
+            TelemetryConstants.SLO_ERROR_RATE_DEFAULT)), 0.0)
+
+    def telemetry_slo_degrade_rate(self) -> float:
+        return max(float(self._conf.get(
+            TelemetryConstants.SLO_DEGRADE_RATE,
+            TelemetryConstants.SLO_DEGRADE_RATE_DEFAULT)), 0.0)
+
+    def telemetry_slo_window_s(self) -> float:
+        return max(float(self._conf.get(
+            TelemetryConstants.SLO_WINDOW_S,
+            TelemetryConstants.SLO_WINDOW_S_DEFAULT)), 0.001)
+
+    def telemetry_slo_min_count(self) -> int:
+        return max(int(self._conf.get(
+            TelemetryConstants.SLO_MIN_COUNT,
+            TelemetryConstants.SLO_MIN_COUNT_DEFAULT)), 1)
+
+    def telemetry_export_http_port(self) -> int:
+        return max(int(self._conf.get(
+            TelemetryConstants.EXPORT_HTTP_PORT,
+            TelemetryConstants.EXPORT_HTTP_PORT_DEFAULT)), 0)
+
     def telemetry_metrics_enabled(self) -> bool:
         return self._get_bool(
             TelemetryConstants.METRICS_ENABLED,

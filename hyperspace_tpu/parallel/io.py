@@ -195,6 +195,20 @@ def submit_serving(fn: Callable, threads: int = 4):
                 pool = _serving_pool
 
 
+def spawn_daemon(name: str, fn: Callable) -> threading.Thread:
+    """Start ``fn()`` on a named daemon thread and return it. The ONE
+    sanctioned long-lived-service spawner (this module is the lint
+    gate's only thread-construction site): today it carries the
+    telemetry HTTP exporter's accept loop (telemetry/exposition.py) —
+    a blocking server loop must not occupy a reader or serving slot,
+    and a daemon thread dies with the process, which is exactly the
+    lifecycle an observability sidecar wants. ``fn`` must not depend on
+    ambient contextvars (nothing propagates here by design)."""
+    t = threading.Thread(target=fn, name=name, daemon=True)
+    t.start()
+    return t
+
+
 # ---------------------------------------------------------------------------
 # Stats (process-wide; explain's "I/O:" section and Hyperspace.io_stats).
 # ---------------------------------------------------------------------------

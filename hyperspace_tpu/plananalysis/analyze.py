@@ -1,0 +1,131 @@
+"""explain_analyze: one post-execution report for one query.
+
+``Hyperspace.explain_analyze(df)`` EXECUTES the plan under a dedicated
+QueryContext with its trace forced on (``trace_force`` pins the sample
+coin — the caller asked for THIS query's trace), then fuses every
+observability surface the execution touched into one text report:
+
+- the span-tree timeline with per-span wall + self times
+  (telemetry/trace.py render_timeline);
+- estimated-vs-actual rows for every reordered join step, with the
+  per-step q-error (optimizer/join_order.py records + the executor's
+  observed actuals — the feedback signal ROADMAP item 2a will close the
+  loop on);
+- per-query tallies: the context's io attribution, the result-cache
+  lookup outcome (from the trace), and the process-delta of program-bank
+  and robustness counters across exactly this execution.
+
+Deltas are process-wide counters diffed around the execution, so a
+CONCURRENT query's traffic can leak into them — explain_analyze is a
+diagnostic for a quiet session, not a per-query accounting system (the
+io numbers, from the context, ARE exact).
+"""
+
+from __future__ import annotations
+
+import time
+
+from ..telemetry import span_names as SN
+from ..telemetry.trace import render_timeline
+
+
+def _q_error(est: float, actual: int) -> float:
+    est = max(float(est), 1.0)
+    actual = max(float(actual), 1.0)
+    return max(est / actual, actual / est)
+
+
+def _join_lines(session) -> list:
+    records = getattr(session, "_last_join_order", None) or []
+    actuals = getattr(session, "_join_actuals", {})
+    lines = []
+    for r in records:
+        order = r["order"] if r.get("reordered") else r.get("labels", [])
+        head = "reordered" if r.get("reordered") else "kept"
+        lines.append(f"chain [{', '.join(r.get('labels', []))}] {head}"
+                     + (f" -> [{', '.join(order)}]"
+                        if r.get("reordered") else ""))
+        for s in r.get("steps", []):
+            actual = actuals.get(s["key"])
+            if actual is None:
+                lines.append(f"  join +{s['right']}: est "
+                             f"{s['est_rows']:.0f} rows, actual n/a")
+            else:
+                lines.append(
+                    f"  join +{s['right']}: est {s['est_rows']:.0f} "
+                    f"rows, actual {actual} "
+                    f"(q-error {_q_error(s['est_rows'], actual):.2f})")
+    return lines
+
+
+def _delta(before: dict, after: dict) -> dict:
+    # ONE diff implementation in the package: the exposition layer's
+    # (nested dicts flattened, vanished keys handled).
+    from ..telemetry.exposition import delta
+    return delta(before, after)
+
+
+def explain_analyze_string(session, plan) -> str:
+    from ..robustness import faults as _faults
+    from ..serving.context import QueryContext
+    from ..serving.program_bank import get_bank
+
+    ctx = QueryContext.for_session(session)
+    ctx.trace_force = True
+    # Reset the reorder records so the "Joins" section is attributable
+    # to THIS execution — a result-cache hit runs no reorder pass and
+    # must report no joins, not the previous query's (the same hazard
+    # Session.execute resets _last_reason_collector for).
+    session._last_join_order = None
+    bank0 = get_bank().stats()
+    rob0 = _faults.stats()
+    t0 = time.perf_counter()
+    table = session.execute(plan, context=ctx)
+    elapsed_ms = (time.perf_counter() - t0) * 1000.0
+    bank1 = get_bank().stats()
+    rob1 = _faults.stats()
+    tr = ctx.trace
+
+    lines = ["== Explain Analyze =="]
+    lines.append(f"query {ctx.query_id}: {elapsed_ms:.2f} ms, "
+                 f"{table.num_rows} row(s)")
+
+    lines.append("")
+    lines.append("Trace:")
+    if tr is not None:
+        lines.extend(render_timeline(tr))
+    else:
+        lines.append("(no trace recorded)")
+
+    join_lines = _join_lines(session)
+    if join_lines:
+        lines.append("")
+        lines.append("Joins (estimated vs actual):")
+        lines.extend(join_lines)
+
+    lines.append("")
+    lines.append("Tallies:")
+    io = ctx.io_stats()
+    lines.append(
+        f"io: tasks={io['read_tasks']} bytes={io['read_bytes']} "
+        f"read={io['read_seconds']:.3f}s wait={io['wait_seconds']:.3f}s "
+        f"prefetch_items={io['prefetch_items']}")
+    cache_line = "cache: no lookup (result cache off)"
+    if tr is not None:
+        lookups = tr.find(SN.CACHE_LOOKUP)
+        if lookups:
+            a = lookups[-1].attrs
+            cache_line = (f"cache: {'hit' if a.get('hit') else 'miss'}"
+                          + (f" tier={a['tier']}" if a.get("tier") else ""))
+    lines.append(cache_line)
+    bank_d = _delta(bank0, bank1)
+    lines.append("bank: " + (" ".join(
+        f"{k}={v:+g}" for k, v in sorted(bank_d.items()))
+        if bank_d else "no traffic"))
+    rob_d = _delta(rob0, rob1)
+    lines.append("robustness: " + (" ".join(
+        f"{k}={v:+g}" for k, v in sorted(rob_d.items()))
+        if rob_d else "clean"))
+    if tr is not None and tr.keep_reasons:
+        lines.append(f"tail-keep marks: {', '.join(tr.keep_reasons)}")
+    return "\n".join(lines)

@@ -298,7 +298,7 @@ def _write_serving_section(buf: BufferStream, session) -> None:
     buf.write_line(
         f"program bank: stages={b['stages']} programs={b['programs']} "
         f"hits={b['hits']} misses={b['misses']} "
-        f"evictions={b['stage_evictions']}")
+        f"evictions={b['evictions']}")
 
 
 def _write_robustness_section(buf: BufferStream, session) -> None:

@@ -304,9 +304,38 @@ class _Stats:
 
 _STATS = _Stats()
 
+# Incidents that make the in-flight query's trace worth keeping under
+# head sampling (telemetry/trace.py tail-keep): anything that means the
+# query was one of the unlucky ones. Deliberately NOT the recovery
+# counters — a maintenance sweep is not a query anomaly.
+_TAIL_KEEP_KEYS = frozenset({
+    "injected", "retries", "retry_failures", "deadline_cancellations",
+    "degraded_spmd", "degraded_bank_compile", "degraded_device_put",
+    "spill_corruptions", "member_fallbacks", "worker_releases",
+})
+# The subset that flips the active QueryContext's ``degraded`` flag
+# (the SLO degrade-rate objective's per-query signal).
+_DEGRADE_KEYS = frozenset({
+    "degraded_spmd", "degraded_bank_compile", "degraded_device_put",
+    "spill_corruptions", "member_fallbacks",
+})
+
 
 def note(**deltas) -> None:
     _STATS.note(**deltas)
+    fired = {k for k, v in deltas.items() if v}
+    if not (fired & _TAIL_KEEP_KEYS):
+        return
+    try:
+        from ..telemetry import trace as _trace
+        _trace.keep_active("robustness")
+        if fired & _DEGRADE_KEYS:
+            from ..serving.context import active_context
+            ctx = active_context()
+            if ctx is not None:
+                ctx.degraded = True
+    except Exception:
+        pass  # observability must never mask the incident being noted
 
 
 def stats() -> dict:

@@ -80,6 +80,18 @@ def recover_indexes(session, names: Optional[List[str]] = None) -> Dict:
             recover_streaming(session, summary)
         except Exception as e:
             summary["errors"]["_streaming"] = f"{type(e).__name__}: {e}"
+    if summary["cancelled"] or summary["vacuumed"]:
+        # A sweep that actually found wrecks IS the incident record:
+        # another process died mid-action. Flight-recorder anomaly so
+        # the post-mortem dump carries it.
+        try:
+            from ..telemetry.flight_recorder import note_anomaly
+            note_anomaly(
+                "crash.recovery",
+                f"cancelled={summary['cancelled']} "
+                f"vacuumed={sorted(summary['vacuumed'])}")
+        except Exception:
+            pass
     return summary
 
 

@@ -87,6 +87,19 @@ class QueryContext:
         # shared sweep span instead of opening their own roots.
         self.trace = None
         self.trace_parent = None
+        # ``trace_force`` (explain_analyze): open and RETAIN this
+        # query's trace regardless of telemetry.trace.{enabled,
+        # sampleRate}. ``degraded``: a robustness degradation ladder
+        # fired during this query (faults.note sets it; the SLO
+        # monitor's degrade-rate objective reads it).
+        self.trace_force = False
+        self.degraded = False
+        # A SWEEP-member attempt whose failure the frontend's member
+        # ladder will rescue with a standalone rerun: its error must
+        # not land in the SLO window (the rerun records the query's
+        # REAL outcome — counting both would show errors for queries
+        # every client saw succeed).
+        self.slo_suppress_error = False
         # Per-query io counters; the lock is for cross-thread writers
         # (prefetch producers run in a copied context on another thread).
         self._io_lock = threading.Lock()

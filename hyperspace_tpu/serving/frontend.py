@@ -390,7 +390,11 @@ class ServingFrontend:
                     b.session.hs_conf.robustness_degrade_enabled():
                 try:
                     _faults.note(worker_releases=1)
-                    self._run_single(b)  # own try/except per member
+                    # own try/except per member; degraded=True marks
+                    # the rerun's QueryContext for the SLO degrade-rate
+                    # objective (note() runs on the batch thread where
+                    # no query context is active, so it cannot).
+                    self._run_single(b, degraded=True)
                 except BaseException:
                     _fail(b)
                 continue
@@ -415,11 +419,12 @@ class ServingFrontend:
         self._queue.clear()
         self._queue.extend(keep)
 
-    def _run_single(self, entry: _Entry) -> None:
+    def _run_single(self, entry: _Entry, degraded: bool = False) -> None:
         entry.pending.started_s = time.perf_counter()
         try:
             self._check_entry_deadline(entry, "serving.queue")
-            result = entry.ctx.run(self._execute_entry, entry, None, 0)
+            result = entry.ctx.run(self._execute_entry, entry, None, 0,
+                                   None, degraded)
             entry.pending._finish(result=result)
             self._note(completed=1)
         except BaseException as e:  # the submitter gets the error
@@ -439,6 +444,13 @@ class ServingFrontend:
             return
         from .context import deadline_cancel
         waited_s = time.perf_counter() - entry.pending.submitted_s
+        # Queue sheds never reach Session.execute's SLO feed, yet they
+        # are exactly the client-visible failures an error storm is
+        # made of — record them here so the errorRate objective can
+        # breach under queue overload (mid-query trips are fed by
+        # execute's own finally, not this path).
+        from ..telemetry import slo as _slo
+        _slo.observe_query(entry.session, waited_s * 1000.0, error=True)
         deadline_cancel(entry.session, entry.pending.query_id, where,
                         waited_s * 1000.0)
 
@@ -452,8 +464,12 @@ class ServingFrontend:
             return None
         from ..telemetry import span_names as SN
         from ..telemetry import trace as _trace
+        # The whole sweep shares ONE retention coin (governing conf):
+        # members record into the shared trace either way; tail-keep
+        # marks from any member rescue it for all of them.
         tr = _trace.Trace(self._hs_conf.telemetry_trace_max_spans(),
-                          label="sweep")
+                          label="sweep",
+                          sampled=_trace.sample_coin(self._session))
         span = tr.new_span(SN.SERVING_SWEEP, None,
                            {"size": len(batch)})
         return (tr, span)
@@ -494,9 +510,13 @@ class ServingFrontend:
                             e.session.hs_conf.robustness_degrade_enabled():
                         raise
                     from ..robustness import faults as _faults
+                    # note() runs on the batch thread (no active query
+                    # context), so the rerun's QueryContext is marked
+                    # degraded explicitly — the SLO degrade-rate signal
+                    # for a sweep that rode the member ladder.
                     _faults.note(member_fallbacks=1)
                     result = e.ctx.run(self._execute_entry, e, None, 0,
-                                       trace_parent)
+                                       trace_parent, True)
                 e.pending._finish(result=result)
                 self._note(completed=1)
             except BaseException as err:
@@ -507,11 +527,15 @@ class ServingFrontend:
                 self._observe_latency(e.pending)
         s = sweep.stats()
         if trace_parent is not None:
-            _, sweep_span = trace_parent
+            sweep_tr, sweep_span = trace_parent
             if sweep_span is not None:
                 sweep_span.attrs["positions"] = s["positions"]
                 sweep_span.attrs["members"] = len(batch)
                 sweep_span.finish()
+            # The frontend owns the shared sweep trace's retention
+            # (members only surface it): coin / tail-keep / counters.
+            from ..telemetry import trace as _trace
+            _trace.finish_root(self._session, sweep_tr)
         self._note(batches=1, batched_queries=len(batch),
                    sweep_invocations=s["sweep_invocations"],
                    shared_scans=s["shared_scans"],
@@ -520,12 +544,20 @@ class ServingFrontend:
 
     def _execute_entry(self, entry: _Entry,
                        sweep: Optional[batcher.SweepContext],
-                       member: int, trace_parent=None):
+                       member: int, trace_parent=None,
+                       degraded: bool = False):
         qc = QueryContext.for_session(
             entry.session, shared_cache=self.result_cache(),
             client=entry.pending.client, deadline_s=entry.deadline_s,
             query_id=entry.pending.query_id)
         qc.trace_parent = trace_parent
+        qc.degraded = degraded
+        # Sweep attempts with the member ladder armed get rescued by a
+        # standalone rerun on failure — the rerun's sample is the
+        # query's real SLO outcome (deadline cancellations skip the
+        # rerun and are never suppressed; see Session.execute).
+        qc.slo_suppress_error = sweep is not None and \
+            entry.session.hs_conf.robustness_degrade_enabled()
         entry.pending.context = qc
         with batcher.use_sweep(sweep, member):
             return entry.session.execute(entry.plan, context=qc)

@@ -46,7 +46,7 @@ class ProgramBank:
             OrderedDict()
         self.hits = 0
         self.misses = 0
-        self.stage_evictions = 0
+        self.evictions = 0
         self.program_count = 0
 
     def lookup(self, stage_key: tuple, shape_vec: tuple,
@@ -63,7 +63,7 @@ class ProgramBank:
             if entry is None:
                 while len(self._stages) >= self.max_stages:
                     _, (_, shapes_seen) = self._stages.popitem(last=False)
-                    self.stage_evictions += 1
+                    self.evictions += 1
                     self.program_count -= len(shapes_seen)
                 with _trace.span(SN.BANK_COMPILE):
                     fn, degraded = self._build(factory)
@@ -152,13 +152,14 @@ class ProgramBank:
 
     def stats(self) -> dict:
         """Counters follow the registry-wide ``hits``/``misses``/
-        ``evictions`` spelling (telemetry/metrics.py naming convention);
-        ``stage_evictions`` is the pre-r13 spelling kept as a DEPRECATED
-        alias for existing readers. ``stages_by_kind`` breaks the
-        resident stages down by their key's kind tag ("fused-predicate",
-        "fused-predicate-sweep", "fused-region", "spmd", ...) so the
-        fusion bench/metrics can see how much of the bank is whole-plan
-        regions vs per-stage programs."""
+        ``evictions`` spelling (telemetry/metrics.py naming convention;
+        the pre-r13 ``stage_evictions`` alias was retired in the
+        observability round — ``evictions`` is the one name).
+        ``stages_by_kind`` breaks the resident stages down by their
+        key's kind tag ("fused-predicate", "fused-predicate-sweep",
+        "fused-region", "spmd", ...) so the fusion bench/metrics can see
+        how much of the bank is whole-plan regions vs per-stage
+        programs."""
         with self._lock:
             kinds: dict = {}
             for k in self._stages:
@@ -170,8 +171,7 @@ class ProgramBank:
                 "programs": self.program_count,
                 "hits": self.hits,
                 "misses": self.misses,
-                "evictions": self.stage_evictions,
-                "stage_evictions": self.stage_evictions,
+                "evictions": self.evictions,
                 "stages_by_kind": kinds,
             }
 

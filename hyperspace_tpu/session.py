@@ -282,20 +282,48 @@ class Session:
         # entirely (no contextvar write) while nothing is armed.
         with ctx.activate(), _faults.scope_for(self.hs_conf), \
                 _trace.maybe_profile(self), _trace.query_trace(self, ctx):
-            if not ctx.capture:
-                return self._execute_uncaptured(plan, ctx)
-            # Advisor workload capture (advisor/workload.py): time
-            # whatever path actually runs and record the canonical plan
-            # + shapes + applied indexes. Resetting the reason collector
-            # first makes ``applied`` attributable to THIS execution (a
-            # result-cache hit runs no rewrite pass and records an empty
-            # applied set).
-            self._last_reason_collector = None
             t0 = time.perf_counter()
-            table = self._execute_uncaptured(plan, ctx)
-            from .advisor.workload import capture_execution
-            capture_execution(self, plan, time.perf_counter() - t0)
-            return table
+            error = False
+            suppress = False
+            try:
+                if not ctx.capture:
+                    return self._execute_uncaptured(plan, ctx)
+                # Advisor workload capture (advisor/workload.py): time
+                # whatever path actually runs and record the canonical
+                # plan + shapes + applied indexes. Resetting the reason
+                # collector first makes ``applied`` attributable to THIS
+                # execution (a result-cache hit runs no rewrite pass and
+                # records an empty applied set).
+                self._last_reason_collector = None
+                table = self._execute_uncaptured(plan, ctx)
+                from .advisor.workload import capture_execution
+                capture_execution(self, plan, time.perf_counter() - t0)
+                return table
+            except BaseException as exc:
+                error = True
+                # A failed query is tail-keep-worthy by definition —
+                # and this is where worker-thread failures (whose emit
+                # sites never see the query's contextvars) surface on
+                # the query's own context.
+                _trace.keep_active("error")
+                # A sweep-member failure the frontend's ladder will
+                # rescue must not count as a completed failed query
+                # (the standalone rerun records the real outcome);
+                # deadline cancellations skip the rerun, so they stay.
+                from .exceptions import QueryDeadlineError
+                suppress = ctx.slo_suppress_error and \
+                    not isinstance(exc, QueryDeadlineError)
+                raise
+            finally:
+                # SLO sensor feed (telemetry/slo.py): every query's
+                # (latency, error, degraded) lands in the sliding window
+                # + the live query-latency histogram — inside the trace
+                # scope, so a breach event correlates with its query.
+                if not suppress:
+                    from .telemetry import slo as _slo
+                    _slo.observe_query(
+                        self, (time.perf_counter() - t0) * 1000.0,
+                        error=error, degraded=ctx.degraded)
 
     def _execute_uncaptured(self, plan: LogicalPlan, ctx=None):
         cache = ctx.result_cache if ctx is not None else self.result_cache

@@ -10,11 +10,66 @@ from __future__ import annotations
 
 
 class TelemetryConstants:
-    # Per-query span-tree tracing (telemetry/trace.py). Default off:
-    # tracing-off is a hard no-op fast path (bench `observability` phase
-    # pins the traced overhead <= ~3% and ~0 when off).
+    # Per-query span-tree tracing (telemetry/trace.py). Default ON since
+    # the observability round: recording costs ~the r13 traced bar
+    # (bench `observability` pins it <= ~2-3%) and the sampleRate knob
+    # below bounds retention; `false` restores the hard no-op fast path
+    # (byte-identical results, ~0 overhead).
     TRACE_ENABLED = "hyperspace.tpu.telemetry.trace.enabled"
-    TRACE_ENABLED_DEFAULT = "false"
+    TRACE_ENABLED_DEFAULT = "true"
+
+    # Head-sampled trace RETENTION (telemetry/trace.py): the coin is
+    # flipped once per query at Session.execute; a coin-negative query
+    # still records into a provisional trace (so the tail-keep override
+    # can rescue exactly the unlucky ones — deadline breaches, retries,
+    # degradations, anomalies, live-latency outliers) but the trace is
+    # DISCARDED at completion unless kept. 1.0 (default) retains every
+    # trace; serving deployments drop to ~0.1 (the bench
+    # `trace_sampled_overhead_pct` arm proves <= ~2% there); 0 retains
+    # only tail-kept traces.
+    TRACE_SAMPLE_RATE = "hyperspace.tpu.telemetry.trace.sampleRate"
+    TRACE_SAMPLE_RATE_DEFAULT = "1.0"
+
+    # Tail-keep latency override: a coin-negative query whose wall-clock
+    # exceeds this many milliseconds is retained anyway. 0 (default) =
+    # adaptive — 2x the live `query.latency_ms` p99 once the window
+    # holds >= 64 samples (telemetry/slo.py caches the threshold).
+    TRACE_TAIL_SLOW_MS = "hyperspace.tpu.telemetry.trace.tailSlowMs"
+    TRACE_TAIL_SLOW_MS_DEFAULT = "0"
+
+    # Anomaly flight recorder (telemetry/flight_recorder.py): bounded
+    # process-wide rings of retained traces + recent events + metrics
+    # snapshots; `enabled=false` stops the trace ring only (the event /
+    # anomaly rings are always-on and bounded).
+    FLIGHT_ENABLED = "hyperspace.tpu.telemetry.flightRecorder.enabled"
+    FLIGHT_ENABLED_DEFAULT = "true"
+    FLIGHT_MAX_TRACES = "hyperspace.tpu.telemetry.flightRecorder.maxTraces"
+    FLIGHT_MAX_TRACES_DEFAULT = "32"
+
+    # SLO monitors (telemetry/slo.py): named objectives evaluated over a
+    # sliding window of completed queries — p99 latency (ms), error
+    # rate, degrade rate (each 0 = objective disarmed). Breaches emit
+    # SloBreachEvent and flip Hyperspace.health(); deliberately NOT
+    # wired to admission control yet (ROADMAP item 2c's sensor half).
+    SLO_ENABLED = "hyperspace.tpu.telemetry.slo.enabled"
+    SLO_ENABLED_DEFAULT = "true"
+    SLO_P99_MS = "hyperspace.tpu.telemetry.slo.p99Ms"
+    SLO_P99_MS_DEFAULT = "0"
+    SLO_ERROR_RATE = "hyperspace.tpu.telemetry.slo.errorRate"
+    SLO_ERROR_RATE_DEFAULT = "0"
+    SLO_DEGRADE_RATE = "hyperspace.tpu.telemetry.slo.degradeRate"
+    SLO_DEGRADE_RATE_DEFAULT = "0"
+    SLO_WINDOW_S = "hyperspace.tpu.telemetry.slo.windowS"
+    SLO_WINDOW_S_DEFAULT = "60"
+    SLO_MIN_COUNT = "hyperspace.tpu.telemetry.slo.minCount"
+    SLO_MIN_COUNT_DEFAULT = "5"
+
+    # OpenMetrics HTTP exposition (telemetry/exposition.py): a localhost
+    # scrape endpoint serving Hyperspace.metrics_text(). 0 (default) =
+    # off; a port (or 0 passed explicitly to serve_metrics for an
+    # ephemeral bind) starts the listener on 127.0.0.1 only.
+    EXPORT_HTTP_PORT = "hyperspace.tpu.telemetry.export.httpPort"
+    EXPORT_HTTP_PORT_DEFAULT = "0"
 
     # Span cap per trace: past it new spans are dropped (counted on
     # Trace.dropped) instead of growing without bound — a pathological

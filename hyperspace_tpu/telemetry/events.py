@@ -34,6 +34,15 @@ class HyperspaceEvent:
         if not self.trace_id:
             from .trace import active_ids
             self.trace_id, self.span_id = active_ids()
+        # Flight-recorder feed (telemetry/flight_recorder.py): every
+        # event construction — which IS emission — rings the recorder
+        # and runs its anomaly/tail-keep classifier. Bounded, lock +
+        # append; failures must never reach the emit site.
+        try:
+            from .flight_recorder import note_event
+            note_event(self)
+        except Exception:
+            pass
 
     @property
     def event_name(self) -> str:
@@ -365,6 +374,21 @@ class QueryCancelledEvent(HyperspaceEvent):
     query_id: int = 0
     where: str = ""
     elapsed_ms: float = 0.0
+
+
+@dataclass
+class SloBreachEvent(HyperspaceEvent):
+    """Emitted per healthy->breached transition of one named SLO
+    objective (telemetry/slo.py): which objective, the configured
+    threshold, the observed value, and the sliding window it was
+    evaluated over. Recoveries re-arm silently; Hyperspace.health()
+    carries the live verdict."""
+
+    objective: str = ""
+    threshold: float = 0.0
+    observed: float = 0.0
+    window_s: float = 0.0
+    count: int = 0
 
 
 @dataclass

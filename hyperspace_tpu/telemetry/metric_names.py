@@ -1,0 +1,68 @@
+"""Frozen registry of metric instrument + collector names.
+
+Every push-side instrument ask (``counter_add`` / ``gauge_set`` /
+``histogram``) and every ``register_collector`` site in the package must
+name its metric with one of these constants (or a string literal
+registered here) — free-form strings are rejected by the scripts/lint.py
+metric-discipline gate, and every name registered here must be
+referenced under tests/ (an unobserved metric is unverified
+observability — the same contract the span-names / fault-names /
+event-taxonomy gates enforce).
+
+Keep the vocabulary SMALL and stable: the OpenMetrics exposition
+(telemetry/exposition.py), ``Hyperspace.metrics_delta()``, dashboards,
+and external scrapers all key on these strings. Variable detail belongs
+in the collectors' dict payloads, never in new ad-hoc names.
+"""
+
+from __future__ import annotations
+
+# -- push-side counters -----------------------------------------------------
+
+# Retention outcome of each completed root trace (telemetry/trace.py):
+# the head coin said keep / the tail-keep override rescued it (anomaly
+# or live-latency threshold) / it was recorded provisionally and
+# discarded at completion.
+TRACE_SAMPLED = "trace.sampled"
+TRACE_TAIL_KEPT = "trace.tail_kept"
+TRACE_DISCARDED = "trace.discarded"
+
+# Anomalies the flight recorder captured (telemetry/flight_recorder.py):
+# deadline cancellations, fault-driven fallbacks, retry exhaustions,
+# spill corruption, crash recovery, SLO breaches.
+FLIGHT_ANOMALIES = "flight_recorder.anomalies"
+
+# SLO objective transitions into breach (telemetry/slo.py).
+SLO_BREACHES = "slo.breaches"
+
+# Literal-sweep batched invocations (serving/batcher.py).
+SERVING_SWEEP_INVOCATIONS = "serving.sweep_invocations"
+
+# -- live histograms --------------------------------------------------------
+
+# Per-completed-query latency through the serving frontend
+# (serving/frontend.py; window: telemetry.serving.latencyWindow).
+SERVING_LATENCY_MS = "serving.latency_ms"
+
+# Per-query latency of EVERY Session.execute (telemetry/slo.py feeds
+# it), frontend or not — the SLO monitors' p99 source and the adaptive
+# tail-keep threshold's baseline.
+QUERY_LATENCY_MS = "query.latency_ms"
+
+# -- pull-side collectors ---------------------------------------------------
+
+COLLECTOR_IO = "io"
+COLLECTOR_PROGRAM_BANK = "program_bank"
+COLLECTOR_SERVING = "serving"
+COLLECTOR_ROBUSTNESS = "robustness"
+COLLECTOR_STREAMING = "streaming"
+COLLECTOR_FUSION = "fusion"
+COLLECTOR_FLIGHT_RECORDER = "flight_recorder"
+
+METRIC_NAMES = frozenset({
+    TRACE_SAMPLED, TRACE_TAIL_KEPT, TRACE_DISCARDED, FLIGHT_ANOMALIES,
+    SLO_BREACHES, SERVING_SWEEP_INVOCATIONS, SERVING_LATENCY_MS,
+    QUERY_LATENCY_MS, COLLECTOR_IO, COLLECTOR_PROGRAM_BANK,
+    COLLECTOR_SERVING, COLLECTOR_ROBUSTNESS, COLLECTOR_STREAMING,
+    COLLECTOR_FUSION, COLLECTOR_FLIGHT_RECORDER,
+})

@@ -17,9 +17,11 @@ accessor and spelling. The registry unifies them:
   (``Hyperspace.io_stats()`` etc.) now delegate here.
 
 Naming convention (the r13 unification): cache-shaped collectors spell
-their counters ``hits`` / ``misses`` / ``evictions``; legacy spellings
-(``stage_evictions``) remain as deprecated aliases so existing readers
-keep working.
+their counters ``hits`` / ``misses`` / ``evictions`` — the canonical
+names, with no legacy aliases (the last one, the program bank's
+``stage_evictions``, was retired in the observability round). Push-side
+instrument names come from the frozen telemetry/metric_names.py registry
+(lint-enforced, like span and fault names).
 
 ``hyperspace.tpu.telemetry.metrics.enabled`` gates the push-side feeds
 (histogram records); collectors are pull-only snapshots and stay
@@ -36,6 +38,13 @@ from typing import Callable, Dict, List, Optional
 
 _DEFAULT_WINDOW_S = 60.0
 _MAX_SAMPLES = 32768
+
+
+def percentile(ordered: List[float], frac: float) -> float:
+    """Upper-index percentile over an ASCENDING-sorted list (the one
+    convention every surface shares: the live histograms, the SLO
+    monitors, bench's _pct)."""
+    return ordered[min(int(len(ordered) * frac), len(ordered) - 1)]
 
 
 class SlidingHistogram:
@@ -69,9 +78,7 @@ class SlidingHistogram:
                 if old_t >= t - self.window_s:
                     self._cap_dropped += 1
 
-    @staticmethod
-    def _pct(ordered: List[float], frac: float) -> float:
-        return ordered[min(int(len(ordered) * frac), len(ordered) - 1)]
+    _pct = staticmethod(percentile)
 
     def snapshot(self, now: Optional[float] = None) -> dict:
         t = now if now is not None else time.monotonic()

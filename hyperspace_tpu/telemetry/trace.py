@@ -20,10 +20,17 @@ parallel/io.py credits the per-query io counters.
 
 Tracing OFF is a hard no-op fast path: ``span(...)`` returns a shared
 no-op context manager after one contextvar read, and ``Session.execute``
-opens no trace at all unless ``hyperspace.tpu.telemetry.trace.enabled``
-is set (conf via config.py only). Span NAMES come from the frozen
-registry in span_names.py — the scripts/lint.py span-discipline gate
-rejects free-form strings.
+opens no trace at all while ``hyperspace.tpu.telemetry.trace.enabled``
+is false (conf via config.py only). Since the observability round the
+flag defaults ON with head-sampled RETENTION: the per-query coin
+(``telemetry.trace.sampleRate``) is flipped at ``Session.execute``; a
+coin-negative query still records into a provisional trace — so the
+tail-keep override (:func:`keep_active`, driven by deadline breaches,
+retries, degradation ladders, flight-recorder anomalies, and the
+live-latency threshold) can rescue exactly the unlucky queries — but
+the trace is DISCARDED at completion unless kept (:func:`finish_root`).
+Span NAMES come from the frozen registry in span_names.py — the
+scripts/lint.py span-discipline gate rejects free-form strings.
 """
 
 from __future__ import annotations
@@ -32,11 +39,13 @@ import contextlib
 import contextvars
 import json
 import os
+import random
 import threading
 import time
 import uuid
 from typing import Dict, List, Optional, Tuple
 
+from . import metric_names as MN
 from . import span_names
 
 # The (Trace, Span) pair of the in-flight traced execution, if any.
@@ -83,7 +92,8 @@ class Trace:
     write from several threads — in completion-independent creation
     order; parent links carry the tree."""
 
-    def __init__(self, max_spans: int = 4096, label: str = ""):
+    def __init__(self, max_spans: int = 4096, label: str = "",
+                 sampled: bool = True):
         self.trace_id = uuid.uuid4().hex[:16]
         self.label = label
         self.max_spans = max(int(max_spans), 1)
@@ -93,6 +103,13 @@ class Trace:
         self.spans: List[Span] = []
         self.dropped = 0
         self._ids = 0
+        # Retention state (the head-sampling layer): ``sampled`` is the
+        # coin flipped at creation; ``keep_reasons`` collects tail-keep
+        # marks (deadline breach, retry, degradation, anomaly, slow);
+        # ``retained`` flips once finish_root decides to keep it.
+        self.sampled = bool(sampled)
+        self.keep_reasons: List[str] = []
+        self.retained = False
 
     def new_span(self, name: str, parent_id: Optional[str],
                  attrs: Optional[dict] = None) -> Optional[Span]:
@@ -127,27 +144,39 @@ class Trace:
     # Export: Chrome trace-event JSON (chrome://tracing, Perfetto).
     # ------------------------------------------------------------------
 
-    def to_chrome_json(self) -> str:
-        """Complete ("X") trace events, ts/dur in microseconds relative
-        to the trace's start; span/parent ids ride in ``args`` so the
-        tree survives the flat format."""
+    def span_events(self, base_us: float = 0.0,
+                    with_trace_id: bool = False) -> List[dict]:
+        """Complete ("X") trace events for every span, ts/dur in
+        microseconds offset by ``base_us``; span/parent ids ride in
+        ``args`` so the tree survives the flat format (and, for
+        multi-trace bundles like the flight-recorder dump, the
+        trace_id)."""
         pid = os.getpid()
         events = []
-        for s in self.spans:
+        for s in list(self.spans):
             args: Dict[str, object] = {"span_id": s.span_id}
             if s.parent_id is not None:
                 args["parent_id"] = s.parent_id
+            if with_trace_id:
+                args["trace_id"] = self.trace_id
             args.update(s.attrs)
             events.append({
                 "name": s.name,
                 "cat": "hyperspace",
                 "ph": "X",
-                "ts": round((s.start_perf - self._anchor_perf) * 1e6, 3),
+                "ts": round(base_us
+                            + (s.start_perf - self._anchor_perf) * 1e6, 3),
                 "dur": round(s.duration_s * 1e6, 3),
                 "pid": pid,
                 "tid": s.tid,
                 "args": args,
             })
+        return events
+
+    def to_chrome_json(self) -> str:
+        """One-trace Chrome trace-event JSON (chrome://tracing,
+        Perfetto)."""
+        events = self.span_events()
         return json.dumps({
             "traceEvents": events,
             "displayTimeUnit": "ms",
@@ -251,6 +280,78 @@ def idle() -> bool:
     return _ACTIVE.get() is None
 
 
+def keep_active(reason: str = "") -> None:
+    """Mark the ACTIVE trace tail-keep: it survives a negative sample
+    coin at completion. Called by the anomaly sites (deadline
+    cancellation, retry, degradation ladders, flight-recorder anomalies)
+    — a no-op outside a traced execution."""
+    pair = _ACTIVE.get()
+    if pair is None:
+        return
+    tr = pair[0]
+    with tr._lock:
+        if reason not in tr.keep_reasons:
+            tr.keep_reasons.append(reason or "anomaly")
+
+
+def sample_coin(session) -> bool:
+    """One retention coin flip per root trace (``sampleRate`` conf)."""
+    rate = session.hs_conf.telemetry_trace_sample_rate()
+    if rate >= 1.0:
+        return True
+    if rate <= 0.0:
+        return False
+    return random.random() < rate
+
+
+def _tail_slow_threshold_ms(session) -> Optional[float]:
+    """The latency above which a coin-negative trace is kept anyway:
+    the explicit ``tailSlowMs`` conf, else (0 = auto) 2x the live
+    query-latency p99 (telemetry/slo.py caches it), else None."""
+    ms = session.hs_conf.telemetry_trace_tail_slow_ms()
+    if ms > 0:
+        return ms
+    from . import slo as _slo
+    return _slo.adaptive_slow_threshold_ms()
+
+
+def finish_root(session, tr: Trace) -> None:
+    """Retention decision for one completed root-owned trace: keep it
+    (``session._last_trace`` + the flight-recorder ring) when the head
+    coin said yes, a tail-keep mark landed, or the query breached the
+    live-latency threshold; discard it otherwise. Counted on the
+    ``trace.sampled`` / ``trace.tail_kept`` / ``trace.discarded``
+    process counters."""
+    if tr.retained:
+        return
+    keep = tr.sampled
+    kind = MN.TRACE_SAMPLED
+    if not keep and tr.keep_reasons:
+        keep, kind = True, MN.TRACE_TAIL_KEPT
+    if not keep:
+        thr = _tail_slow_threshold_ms(session)
+        if thr is not None and tr.duration_s() * 1000.0 > thr:
+            keep, kind = True, MN.TRACE_TAIL_KEPT
+            tr.keep_reasons.append("slow")
+    hs_conf = session.hs_conf
+    if keep:
+        tr.retained = True
+        session._last_trace = tr
+        if hs_conf.telemetry_flight_enabled():
+            from . import flight_recorder as _fr
+            _fr.get_recorder().note_trace(
+                tr, cap=hs_conf.telemetry_flight_max_traces())
+    if hs_conf.telemetry_metrics_enabled():
+        from .metrics import get_registry
+        reg = get_registry()
+        if not keep:
+            reg.counter_add(MN.TRACE_DISCARDED)
+        elif kind == MN.TRACE_SAMPLED:
+            reg.counter_add(MN.TRACE_SAMPLED)
+        else:
+            reg.counter_add(MN.TRACE_TAIL_KEPT)
+
+
 def active_ids() -> Tuple[str, str]:
     """(trace_id, span_id) of the active span, ("", "") when idle — the
     stamp HyperspaceEvent picks up at construction/emission time."""
@@ -273,13 +374,14 @@ def maintenance_trace(session, label: str = ""):
             not session.hs_conf.telemetry_trace_enabled():
         yield None
         return
-    tr = Trace(session.hs_conf.telemetry_trace_max_spans(), label=label)
+    tr = Trace(session.hs_conf.telemetry_trace_max_spans(), label=label,
+               sampled=sample_coin(session))
     token = _ACTIVE.set((tr, None))
     try:
         yield tr
     finally:
         _ACTIVE.reset(token)
-        session._last_trace = tr
+        finish_root(session, tr)
 
 
 @contextlib.contextmanager
@@ -301,7 +403,9 @@ def query_trace(session, ctx=None):
     section."""
     parent = getattr(ctx, "trace_parent", None) if ctx is not None else None
     ambient = _ACTIVE.get()
-    if parent is None and ambient is None:
+    forced = bool(getattr(ctx, "trace_force", False)) \
+        if ctx is not None else False
+    if parent is None and ambient is None and not forced:
         if session is None or \
                 not session.hs_conf.telemetry_trace_enabled():
             yield None
@@ -311,6 +415,7 @@ def query_trace(session, ctx=None):
         attrs["query_id"] = ctx.query_id
         if ctx.client:
             attrs["client"] = ctx.client
+    fresh = False
     if parent is not None:
         tr, parent_span = parent
         parent_id = parent_span.span_id if parent_span is not None else None
@@ -318,9 +423,13 @@ def query_trace(session, ctx=None):
         tr, parent_span = ambient
         parent_id = parent_span.span_id if parent_span is not None else None
     else:
+        # ``trace_force`` (explain_analyze) pins the coin: the caller
+        # asked for THIS query's trace, sampling must not drop it.
         tr = Trace(session.hs_conf.telemetry_trace_max_spans(),
-                   label=ctx.client if ctx is not None else "")
+                   label=ctx.client if ctx is not None else "",
+                   sampled=forced or sample_coin(session))
         parent_id = None
+        fresh = True
     root = tr.new_span(span_names.QUERY, parent_id, attrs)
     if ctx is not None:
         ctx.trace = tr
@@ -332,7 +441,13 @@ def query_trace(session, ctx=None):
             root.finish()
             _ACTIVE.reset(token)
         if session is not None:
-            session._last_trace = tr
+            if fresh:
+                finish_root(session, tr)
+            elif tr.sampled or tr.keep_reasons:
+                # Shared sweep / nested traces: the owner (the serving
+                # frontend / the outer query) runs the full retention;
+                # members only surface an already-keep-worthy trace.
+                session._last_trace = tr
 
 
 # ---------------------------------------------------------------------------

@@ -58,6 +58,8 @@ CODES = {
     "HS213": "span name never observed by tests",
     "HS214": "fault point never injected by tests",
     "HS215": "fusion boundary never exercised by tests",
+    "HS216": "free-form metric name",
+    "HS217": "metric name never observed by tests",
     "HS301": "unguarded shared-state mutation",
     "HS302": "unguarded read-modify-write",
     "HS311": "host sync inside traced code",

@@ -167,12 +167,13 @@ class Context:
         self.span_names = self._registry(legacy.SPAN_NAMES_FILE)
         self.fault_names = self._registry(legacy.FAULT_NAMES_FILE)
         self.fusion_kinds = self._registry(legacy.FUSION_BOUNDARIES_FILE)
+        self.metric_names = self._registry(legacy.METRIC_NAMES_FILE)
         # Facts the finalizers consume; per-file passes (or the cache)
         # fill them in file order.
         self.event_classes: list = []
         self.registry_hits: Dict[str, set] = {
             "span": set(), "fault": set(), "fusion": set(),
-            "event": set()}
+            "metric": set(), "event": set()}
         self.used_exemptions: set = set()
         # Exemption ids the CURRENT file's dataflow passes consumed —
         # drained into the per-file cache entry by the engine.
@@ -205,11 +206,13 @@ class Context:
                       if v in src.text],
             "fusion": [v for v in self.fusion_kinds.values()
                        if v in src.text],
+            "metric": [v for v in self.metric_names.values()
+                       if v in src.text],
             "event": [n for n in self.event_classes if n in src.text],
         }
 
     def absorb_test_hits(self, hits: dict) -> None:
-        for k in ("span", "fault", "fusion", "event"):
+        for k in ("span", "fault", "fusion", "metric", "event"):
             self.registry_hits[k].update(hits.get(k, []))
 
 
@@ -258,8 +261,8 @@ def _env_fingerprint(root: str) -> str:
                 h.update(f.read())
     for rel in (legacy.CONFIG_DOC, STATIC_ANALYSIS_DOC,
                 legacy.SPAN_NAMES_FILE, legacy.FAULT_NAMES_FILE,
-                legacy.FUSION_BOUNDARIES_FILE, legacy.EVENTS_FILE,
-                BASELINE_REL):
+                legacy.FUSION_BOUNDARIES_FILE, legacy.METRIC_NAMES_FILE,
+                legacy.EVENTS_FILE, BASELINE_REL):
         p = os.path.join(root, rel)
         h.update(rel.encode())
         if os.path.exists(p):

@@ -461,6 +461,50 @@ def fusion_boundary_violations(tree: ast.AST, names: dict) -> list:
     return out
 
 
+# Metric-naming discipline (the observability round's ratchet,
+# mirroring the span/fault/fusion gates): every push-side instrument ask
+# (``counter_add`` / ``gauge_set`` / ``histogram``) and every
+# ``register_collector`` site in package code must name its metric via a
+# constant from the frozen telemetry/metric_names.py registry (or a
+# string literal registered there), AND every registered name must be
+# referenced under tests/ — an unobserved metric is unverified
+# observability, and free-form names would fragment the OpenMetrics
+# exposition external scrapers key on.
+METRIC_NAMES_FILE = "hyperspace_tpu/telemetry/metric_names.py"
+METRIC_NAME_ALIASES = ("metric_names", "MN", "_mn")
+METRIC_CALLS = ("counter_add", "gauge_set", "histogram",
+                "register_collector")
+
+
+def metric_site_violations(tree: ast.AST, names: dict) -> list:
+    """(line, detail) of instrument/collector call sites whose name
+    argument is neither a metric_names constant nor a registered
+    literal. Method-attribute calls only — the registry object is
+    reached many ways (``get_registry().counter_add``, a local ``reg``),
+    so the callee NAME is the signature, like the fusion gate."""
+    values = set(names.values())
+    out = []
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in METRIC_CALLS):
+            continue
+        if not node.args:
+            out.append((node.lineno, "no metric name argument"))
+            continue
+        arg = node.args[0]
+        if isinstance(arg, ast.Attribute) \
+                and isinstance(arg.value, ast.Name) \
+                and arg.value.id in METRIC_NAME_ALIASES \
+                and arg.attr in names:
+            continue
+        if isinstance(arg, ast.Constant) and arg.value in values:
+            continue
+        out.append((node.lineno, "metric name must come from "
+                    "telemetry/metric_names.py"))
+    return out
+
+
 # Exception-swallowing discipline (robustness ratchet): a bare
 # ``except:`` anywhere, or an ``except BaseException: pass`` that
 # swallows silently, hides crashes the robustness layer exists to
@@ -564,6 +608,9 @@ def collect(root=None) -> tuple:
     with open(os.path.join(root, FUSION_BOUNDARIES_FILE),
               encoding="utf-8") as f:
         fusion_kinds = span_name_constants(ast.parse(f.read()))
+    with open(os.path.join(root, METRIC_NAMES_FILE),
+              encoding="utf-8") as f:
+        metric_names = span_name_constants(ast.parse(f.read()))
     event_classes: list = []
     tests_text_parts: list = []
     for path in iter_sources(root):
@@ -643,6 +690,12 @@ def collect(root=None) -> tuple:
                 problems.append(
                     f"{rel}:{line}: {detail} (frozen registry; free-form "
                     "fusion-boundary kinds are forbidden)")
+        if any(rel.startswith(d + os.sep) for d in PACKAGE_DIRS):
+            for line, detail in metric_site_violations(tree,
+                                                       metric_names):
+                problems.append(
+                    f"{rel}:{line}: {detail} (frozen registry; free-form "
+                    "metric names are forbidden)")
         if any(rel.startswith(d + os.sep) for d in PACKAGE_DIRS) \
                 and rel.replace(os.sep, "/") not in \
                 EXCEPT_SWALLOW_ALLOWLIST:
@@ -684,6 +737,14 @@ def collect(root=None) -> tuple:
                 f"{FUSION_BOUNDARIES_FILE}: boundary kind '{value}' "
                 f"({const}) is never referenced under tests/; add a test "
                 "exercising it")
+    for const, value in sorted(metric_names.items()):
+        if const == "METRIC_NAMES":
+            continue
+        if value not in tests_text:
+            problems.append(
+                f"{METRIC_NAMES_FILE}: metric name '{value}' ({const}) "
+                "is never referenced under tests/; add a test "
+                "observing it")
     return problems, sum(1 for _ in iter_sources(root))
 
 

@@ -110,6 +110,18 @@ LOCK_CLASSES = {
         "why": "deliveries append from serving worker completion "
                "callbacks while consumers poll",
     },
+    ("hyperspace_tpu/telemetry/flight_recorder.py", "FlightRecorder"): {
+        "locks": {"_lock": None},
+        "delegates": frozenset(),
+        "why": "process-wide anomaly rings fed by every event "
+               "construction and trace retention across worker threads",
+    },
+    ("hyperspace_tpu/telemetry/slo.py", "SloMonitor"): {
+        "locks": {"_lock": None},
+        "delegates": frozenset(),
+        "why": "sliding SLO window fed per completed query from "
+               "serving workers; breach edge state must not tear",
+    },
     ("hyperspace_tpu/index/log_manager.py", "LogLookupCache"): {
         "locks": {"_lock": None},
         "delegates": frozenset(),

@@ -291,6 +291,30 @@ def fusion_boundary_violations(idx, names: dict) -> list:
     return out
 
 
+def metric_site_violations(idx, names: dict) -> list:
+    values = set(names.values())
+    out = []
+    for node in idx.of(ast.Call):
+        f = node.func
+        if not (isinstance(f, ast.Attribute)
+                and f.attr in legacy.METRIC_CALLS):
+            continue
+        if not node.args:
+            out.append((node.lineno, "no metric name argument"))
+            continue
+        arg = node.args[0]
+        if isinstance(arg, ast.Attribute) \
+                and isinstance(arg.value, ast.Name) \
+                and arg.value.id in legacy.METRIC_NAME_ALIASES \
+                and arg.attr in names:
+            continue
+        if isinstance(arg, ast.Constant) and arg.value in values:
+            continue
+        out.append((node.lineno, "metric name must come from "
+                    "telemetry/metric_names.py"))
+    return out
+
+
 def except_swallow_sites(idx) -> list:
     out = []
     for node in idx.of(ast.ExceptHandler):
@@ -396,6 +420,12 @@ def check_file(src, ctx) -> List[Diagnostic]:
                 "HS209", rel, line,
                 f"{rel}:{line}: {detail} (frozen registry; free-form "
                 "fusion-boundary kinds are forbidden)"))
+        for line, detail in metric_site_violations(idx,
+                                                   ctx.metric_names):
+            out.append(_legacy_diag(
+                "HS216", rel, line,
+                f"{rel}:{line}: {detail} (frozen registry; free-form "
+                "metric names are forbidden)"))
     if in_pkg and slash not in legacy.EXCEPT_SWALLOW_ALLOWLIST:
         for line, detail in except_swallow_sites(idx):
             out.append(_legacy_diag("HS210", rel, line,
@@ -412,7 +442,7 @@ def check_file(src, ctx) -> List[Diagnostic]:
 
 
 def finalize(ctx) -> List[Diagnostic]:
-    """The monolith's four trailing coverage checks, in its order."""
+    """The monolith's five trailing coverage checks, in its order."""
     out: List[Diagnostic] = []
     for name in ctx.event_classes:
         if name not in ctx.registry_hits["event"]:
@@ -448,4 +478,13 @@ def finalize(ctx) -> List[Diagnostic]:
                 f"{legacy.FUSION_BOUNDARIES_FILE}: boundary kind "
                 f"'{value}' ({const}) is never referenced under tests/; "
                 "add a test exercising it"))
+    for const, value in sorted(ctx.metric_names.items()):
+        if const == "METRIC_NAMES":
+            continue
+        if value not in ctx.registry_hits["metric"]:
+            out.append(_legacy_diag(
+                "HS217", legacy.METRIC_NAMES_FILE, 1,
+                f"{legacy.METRIC_NAMES_FILE}: metric name '{value}' "
+                f"({const}) is never referenced under tests/; add a "
+                "test observing it"))
     return out

@@ -34,6 +34,7 @@ from analysis.legacy_reference import (  # noqa: F401,E402  (re-exports)
     FUSION_BOUNDARIES_FILE,
     JIT_SITE_ALLOWLIST,
     MAX_LINE,
+    METRIC_NAMES_FILE,
     MUTABLE_STATE_ALLOWLIST,
     PACKAGE_DIRS,
     SPAN_NAMES_FILE,
@@ -50,6 +51,7 @@ from analysis.legacy_reference import (  # noqa: F401,E402  (re-exports)
     iter_sources,
     jit_sharding_violations,
     jit_sites,
+    metric_site_violations,
     mutable_state_sites,
     span_name_constants,
     span_site_violations,

@@ -1,0 +1,574 @@
+"""Always-on production observability (the r18 layer): head-sampled
+trace retention + tail-keep, the anomaly flight recorder, SLO monitors,
+OpenMetrics exposition, metrics_delta, and explain_analyze.
+
+Acceptance set:
+- tracing defaults ON; untraced-configuration results stay
+  byte-identical; sampleRate=0 leaves NO trace for a healthy query but
+  a deadline-breached (or faulted, or slow) query's trace is tail-kept;
+- under an injected r14 fault the flight recorder auto-captures the
+  offending query's full trace and ``dump_flight_recorder()`` emits
+  schema-valid Perfetto JSON containing it;
+- ``metrics_text()`` round-trips through the STRICT OpenMetrics parser
+  and ``health()`` flips on a forced SLO breach with a matching
+  SloBreachEvent;
+- the frozen telemetry/metric_names.py vocabulary (this file is also
+  the scripts/lint.py metric-coverage witness).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+import hyperspace_tpu as hst
+from hyperspace_tpu.api import Hyperspace
+from hyperspace_tpu.exceptions import QueryDeadlineError
+from hyperspace_tpu.index.constants import IndexConstants
+from hyperspace_tpu.plan.expr import col, sum_
+from hyperspace_tpu.robustness import fault_names as fn
+from hyperspace_tpu.robustness.constants import RobustnessConstants as RC
+from hyperspace_tpu.telemetry import metric_names as mn
+from hyperspace_tpu.telemetry.constants import TelemetryConstants as TC
+
+from conftest import capture_logger  # noqa: E402
+
+N_ROWS = 800
+N_FILES = 4
+
+
+@pytest.fixture()
+def data_dir(tmp_path):
+    rng = np.random.default_rng(21)
+    d = tmp_path / "data"
+    os.makedirs(d)
+    for i in range(N_FILES):
+        t = pa.table({
+            "k": pa.array(rng.integers(0, 40, N_ROWS).astype(np.int64)),
+            "g": pa.array(rng.integers(0, 5, N_ROWS).astype(np.int64)),
+            "v": pa.array(rng.uniform(0, 100, N_ROWS).round(3)),
+        })
+        pq.write_table(t, os.path.join(d, f"p{i}.parquet"))
+    return str(d)
+
+
+def _session(tmp_path, tag, **conf):
+    s = hst.Session(system_path=str(tmp_path / f"idx_{tag}"))
+    s.conf.set(IndexConstants.INDEX_NUM_BUCKETS, 4)
+    for k, v in conf.items():
+        s.conf.set(k, v)
+    return s
+
+
+def _query(session, data_dir):
+    return session.read.parquet(data_dir).filter(
+        col("k") == 3).select("k", "v")
+
+
+# ---------------------------------------------------------------------------
+# Head-sampled retention + tail-keep.
+# ---------------------------------------------------------------------------
+
+class TestTraceSampling:
+    def test_tracing_defaults_on_and_retains(self, tmp_path, data_dir):
+        session = _session(tmp_path, "on")
+        assert session.hs_conf.telemetry_trace_enabled()
+        assert session.hs_conf.telemetry_trace_sample_rate() == 1.0
+        hs = Hyperspace(session)
+        before = hs.metrics()
+        _query(session, data_dir).to_arrow()
+        tr = hs.last_trace()
+        assert tr is not None and tr.sampled and tr.retained
+        d = hs.metrics_delta(before)
+        assert d.get("counters.trace.sampled") == 1
+
+    def test_rate_zero_healthy_query_leaves_none(self, tmp_path,
+                                                 data_dir):
+        session = _session(
+            tmp_path, "r0",
+            **{TC.TRACE_SAMPLE_RATE: "0", TC.TRACE_TAIL_SLOW_MS: "1e9"})
+        hs = Hyperspace(session)
+        before = hs.metrics()
+        a = _query(session, data_dir).to_arrow()
+        assert hs.last_trace() is None
+        assert hs.metrics_delta(before).get(
+            "counters.trace.discarded") == 1
+        # Byte identity: the sampled-off-retention result equals the
+        # tracing-disabled result (the always-on-at-production-cost
+        # contract).
+        off = _session(tmp_path, "off", **{TC.TRACE_ENABLED: "false"})
+        b = _query(off, data_dir).to_arrow()
+        assert a.equals(b)
+        assert Hyperspace(off).last_trace() is None
+
+    def test_deadline_breach_is_tail_kept_at_rate_zero(self, tmp_path,
+                                                       data_dir):
+        """THE acceptance pair: the coin said no, the deadline breach
+        keeps the trace anyway — and a healthy same-shape query
+        (previous test) left none."""
+        session = _session(
+            tmp_path, "dl",
+            **{TC.TRACE_SAMPLE_RATE: "0", TC.TRACE_TAIL_SLOW_MS: "1e9",
+               RC.DEADLINE_MS: "0.0001"})
+        hs = Hyperspace(session)
+        before = hs.metrics()
+        with pytest.raises(QueryDeadlineError):
+            _query(session, data_dir).to_arrow()
+        tr = hs.last_trace()
+        assert tr is not None and not tr.sampled and tr.retained
+        assert "query.cancelled" in tr.keep_reasons
+        d = hs.metrics_delta(before)
+        assert d.get("counters.trace.tail_kept") == 1
+        assert "counters.trace.sampled" not in d
+
+    def test_slow_query_is_tail_kept_by_threshold(self, tmp_path,
+                                                  data_dir):
+        session = _session(
+            tmp_path, "slow",
+            **{TC.TRACE_SAMPLE_RATE: "0", TC.TRACE_TAIL_SLOW_MS: "0.001"})
+        hs = Hyperspace(session)
+        _query(session, data_dir).to_arrow()  # any real query is slower
+        tr = hs.last_trace()
+        assert tr is not None and "slow" in tr.keep_reasons
+
+    def test_sample_rate_clamped_and_coin_extremes(self, tmp_path):
+        from hyperspace_tpu.telemetry import trace as trace_mod
+        s = _session(tmp_path, "coin", **{TC.TRACE_SAMPLE_RATE: "7"})
+        assert s.hs_conf.telemetry_trace_sample_rate() == 1.0
+        assert trace_mod.sample_coin(s) is True
+        s.conf.set(TC.TRACE_SAMPLE_RATE, "-3")
+        assert s.hs_conf.telemetry_trace_sample_rate() == 0.0
+        assert trace_mod.sample_coin(s) is False
+
+
+# ---------------------------------------------------------------------------
+# Flight recorder.
+# ---------------------------------------------------------------------------
+
+def _assert_perfetto_schema(doc: dict) -> None:
+    assert {"traceEvents", "displayTimeUnit", "otherData"} <= set(doc)
+    for ev in doc["traceEvents"]:
+        assert {"name", "ph", "ts", "pid", "tid"} <= set(ev)
+        assert ev["ph"] in ("X", "i")
+        assert isinstance(ev["ts"], (int, float)) and ev["ts"] >= 0
+        if ev["ph"] == "X":
+            assert ev["dur"] >= 0
+        else:
+            assert ev["s"] == "p"
+
+
+class TestFlightRecorder:
+    def test_injected_fault_auto_captures_the_query_trace(
+            self, tmp_path, data_dir):
+        """r14-harness acceptance: an armed fault point fires, the
+        offending query's FULL trace is auto-kept (sample coin said
+        no), and the Perfetto dump contains it plus the anomaly."""
+        session = _session(
+            tmp_path, "fault",
+            **{TC.TRACE_SAMPLE_RATE: "0", TC.TRACE_TAIL_SLOW_MS: "1e9",
+               RC.RETRY_BASE_MS: "0",
+               f"{RC.FAULTS_PREFIX}.{fn.IO_POOLED_READ}": "transient"})
+        hs = Hyperspace(session)
+        from hyperspace_tpu.robustness.faults import InjectedFaultError
+        with pytest.raises(InjectedFaultError):
+            _query(session, data_dir).to_arrow()
+        tr = hs.last_trace()
+        assert tr is not None and not tr.sampled and tr.retained
+        assert tr.find("query")  # the full span tree, not a stub
+        from hyperspace_tpu.telemetry.flight_recorder import get_recorder
+        kinds = [a["kind"] for a in get_recorder().anomalies()]
+        assert "retry.exhausted" in kinds
+        out = str(tmp_path / "dump.json")
+        doc = json.loads(hs.dump_flight_recorder(out))
+        _assert_perfetto_schema(doc)
+        assert tr.trace_id in doc["otherData"]["trace_ids"]
+        span_ev = [e for e in doc["traceEvents"] if e["ph"] == "X"
+                   and e["args"].get("trace_id") == tr.trace_id]
+        assert span_ev, "the offending query's spans must be in the dump"
+        anoms = [e for e in doc["traceEvents"]
+                 if e["name"] == "anomaly:retry.exhausted"]
+        assert anoms
+        # dump(path) wrote the same document.
+        with open(out, encoding="utf-8") as f:
+            assert json.load(f)["otherData"]["trace_ids"] == \
+                doc["otherData"]["trace_ids"]
+
+    def test_anomaly_forces_metrics_snapshot_and_counter(self, tmp_path):
+        from hyperspace_tpu.telemetry.flight_recorder import (
+            get_recorder, note_anomaly)
+        from hyperspace_tpu.telemetry.metrics import get_registry
+        rec = get_recorder()
+        before = get_registry().snapshot()["counters"].get(
+            "flight_recorder.anomalies", 0)
+        snaps_before = rec.stats()["snapshots"]
+        note_anomaly("test.anomaly", "synthetic")
+        after = get_registry().snapshot()["counters"][
+            "flight_recorder.anomalies"]
+        assert after == before + 1
+        assert rec.stats()["snapshots"] >= min(snaps_before + 0, 1)
+        assert any(a["kind"] == "test.anomaly"
+                   for a in rec.anomalies())
+
+    def test_rings_are_bounded(self):
+        from hyperspace_tpu.telemetry.flight_recorder import FlightRecorder
+        rec = FlightRecorder(max_traces=2)
+        for i in range(10):
+            rec.note_event(f"E{i}", "m", "", "")
+            rec.note_anomaly(f"k{i}", "d")
+        s = rec.stats()
+        assert s["events"] == 10 and s["event_total"] == 10
+        assert s["anomalies"] == 10 and s["anomaly_total"] == 10
+        # Trace ring: deque maxlen honored + conf re-cap applies.
+        class _T:
+            def __init__(self, i):
+                self.trace_id = f"t{i}"
+                self.created_wall_ms = 0
+                self.spans = []
+            def span_events(self, base_us=0.0, with_trace_id=False):
+                return []
+        for i in range(5):
+            rec.note_trace(_T(i))
+        assert rec.stats()["traces"] == 2
+        rec.note_trace(_T(99), cap=4)
+        assert rec.stats()["traces"] == 3
+
+    def test_recorder_is_a_metrics_collector(self, tmp_path, data_dir):
+        session = _session(tmp_path, "coll")
+        hs = Hyperspace(session)
+        _query(session, data_dir).to_arrow()
+        stats = hs.metrics()["collectors"]["flight_recorder"]
+        assert stats["trace_total"] >= 1
+        assert stats["event_total"] >= 1
+
+
+# ---------------------------------------------------------------------------
+# SLO monitors.
+# ---------------------------------------------------------------------------
+
+def _breach_events():
+    return [e for e in capture_logger().events
+            if type(e).__name__ == "SloBreachEvent"]
+
+
+class TestSloMonitors:
+    def test_monitor_unit_objectives(self):
+        from hyperspace_tpu.telemetry.slo import SloMonitor
+        mon = SloMonitor()
+        for i in range(10):
+            mon.record(10.0 + i, error=(i == 0), degraded=(i < 2),
+                       now=100.0 + i)
+
+        class _Conf:
+            def telemetry_slo_window_s(self):
+                return 60.0
+            def telemetry_slo_min_count(self):
+                return 1
+            def telemetry_slo_p99_ms(self):
+                return 5.0
+            def telemetry_slo_error_rate(self):
+                return 0.5
+            def telemetry_slo_degrade_rate(self):
+                return 0.0
+
+        class _S:
+            hs_conf = _Conf()
+
+        v = mon.evaluate(_S(), now=110.0, emit=False)
+        assert v["healthy"] is False
+        obj = v["objectives"]
+        assert obj["p99_latency_ms"]["breached"] is True
+        assert obj["p99_latency_ms"]["observed"] == 19.0
+        assert obj["error_rate"]["breached"] is False  # 0.1 <= 0.5
+        assert obj["degrade_rate"]["armed"] is False
+        # Window slides: far future -> empty window, nothing breaches.
+        v2 = mon.evaluate(_S(), now=1000.0, emit=False)
+        assert v2["count"] == 0 and v2["healthy"] is True
+
+    def test_short_window_does_not_destroy_longer_window_history(self):
+        """The monitor is a process singleton but windowS is
+        per-session conf: one session's 60s evaluation must not pop
+        samples a 600s evaluation still needs."""
+        from hyperspace_tpu.telemetry.slo import SloMonitor
+        mon = SloMonitor()
+        mon.record(5.0, False, False, now=100.0)
+        mon.record(7.0, False, False, now=400.0)
+
+        class _Conf:
+            window = 60.0
+            def telemetry_slo_window_s(self):
+                return self.window
+            def telemetry_slo_min_count(self):
+                return 1
+            def telemetry_slo_p99_ms(self):
+                return 0.0
+            def telemetry_slo_error_rate(self):
+                return 0.0
+            def telemetry_slo_degrade_rate(self):
+                return 0.0
+
+        class _S:
+            hs_conf = _Conf()
+
+        assert mon.evaluate(_S(), now=430.0, emit=False)["count"] == 1
+        _S.hs_conf.window = 600.0  # the longer window still sees both
+        assert mon.evaluate(_S(), now=430.0, emit=False)["count"] == 2
+
+    def test_window_feeds_even_with_slo_disabled(self, tmp_path,
+                                                 data_dir):
+        """slo.enabled=false gates objective evaluation only — the
+        window keeps recording, so the trace sampler's ADAPTIVE
+        tail-keep threshold stays alive."""
+        from hyperspace_tpu.telemetry.slo import get_monitor
+        session = _session(tmp_path, "slooff",
+                           **{TC.SLO_ENABLED: "false"})
+        t0 = get_monitor().total
+        _query(session, data_dir).to_arrow()
+        assert get_monitor().total == t0 + 1
+
+    def test_forced_breach_flips_health_with_matching_event(
+            self, tmp_path, data_dir):
+        """Acceptance: health() flips on a forced SLO breach and a
+        SloBreachEvent with the same objective lands in the log —
+        edge-triggered, so holding the breach emits no duplicate."""
+        session = _session(
+            tmp_path, "slo",
+            **{TC.SLO_MIN_COUNT: "1", TC.SLO_P99_MS: "1000000",
+               IndexConstants.EVENT_LOGGER_CLASS:
+                   "tests.conftest.CaptureLogger"})
+        hs = Hyperspace(session)
+        _query(session, data_dir).to_arrow()
+        assert hs.health()["healthy"] is True  # huge objective: fine
+        n0 = len(_breach_events())
+        before = hs.metrics()
+        session.conf.set(TC.SLO_P99_MS, "0.000001")  # unmeetable
+        h = hs.health()
+        assert h["healthy"] is False
+        assert h["objectives"]["p99_latency_ms"]["breached"] is True
+        new = _breach_events()[n0:]
+        assert len(new) == 1
+        assert new[0].objective == "p99_latency_ms"
+        assert new[0].observed > new[0].threshold
+        assert hs.metrics_delta(before).get(
+            "counters.slo.breaches") == 1
+        # Still breached: edge-triggered, no second event.
+        assert hs.health()["healthy"] is False
+        assert len(_breach_events()) == n0 + 1
+        # The edge is per (objective, threshold): an evaluation under a
+        # DIFFERENT (here: disarming-ly huge) threshold is healthy but
+        # does not reset the breach edge...
+        session.conf.set(TC.SLO_P99_MS, "1000000")
+        assert hs.health()["healthy"] is True
+        session.conf.set(TC.SLO_P99_MS, "0.000001")
+        assert hs.health()["healthy"] is False
+        assert len(_breach_events()) == n0 + 1  # continuation, no storm
+        # ...while a breach of a NEW armed threshold emits its own
+        # transition event.
+        session.conf.set(TC.SLO_P99_MS, "0.000002")
+        assert hs.health()["healthy"] is False
+        assert len(_breach_events()) == n0 + 2
+
+    def test_error_and_degrade_rates_feed_the_window(self, tmp_path,
+                                                     data_dir):
+        """A query that fails counts toward error rate; a query that
+        rode a degradation ladder counts toward degrade rate (the
+        QueryContext.degraded flag robustness/faults.note sets)."""
+        session = _session(
+            tmp_path, "rates",
+            **{TC.SLO_MIN_COUNT: "1", TC.SLO_ERROR_RATE: "1e9",
+               RC.RETRY_BASE_MS: "0"})
+        hs = Hyperspace(session)
+        from hyperspace_tpu.telemetry.slo import get_monitor
+        mon = get_monitor()
+        e0, d0 = mon.error_total, mon.degraded_total
+        # Error: an armed non-transient fault fails the query.
+        session.conf.set(f"{RC.FAULTS_PREFIX}.{fn.SCAN_PARQUET_DECODE}",
+                         "error")
+        with pytest.raises(Exception):
+            _query(session, data_dir).to_arrow()
+        assert mon.error_total == e0 + 1
+        # Degrade: a bank-compile fault absorbed by the ladder.
+        session.conf.unset(f"{RC.FAULTS_PREFIX}.{fn.SCAN_PARQUET_DECODE}")
+        session.conf.set(f"{RC.FAULTS_PREFIX}.{fn.BANK_COMPILE}",
+                         "error:times=1")
+        session.read.parquet(data_dir).filter(
+            col("g") == 1).select("g", "v").to_arrow()
+        assert mon.degraded_total >= d0 + 1
+
+
+# ---------------------------------------------------------------------------
+# OpenMetrics exposition + HTTP endpoint + metrics_delta.
+# ---------------------------------------------------------------------------
+
+class TestOpenMetrics:
+    def test_text_round_trips_through_strict_openmetrics_parser(
+            self, tmp_path, data_dir):
+        from prometheus_client.openmetrics.parser import \
+            text_string_to_metric_families
+        session = _session(tmp_path, "om")
+        hs = Hyperspace(session)
+        _query(session, data_dir).to_arrow()
+        snap = hs.metrics()
+        text = hs.metrics_text()
+        assert text.endswith("# EOF\n")
+        families = {f.name: f for f in
+                    text_string_to_metric_families(text)}
+        assert families, "exposition must parse into metric families"
+        # Counters: registry values survive the round trip exactly.
+        tr_sampled = snap["counters"]["trace.sampled"]
+        fam = families["hst_trace_sampled"]
+        assert fam.type == "counter"
+        assert fam.samples[0].value == tr_sampled
+        # Histograms: per-quantile gauges.
+        assert "hst_query_latency_ms_p99" in families
+        # Collectors: io pool counters are scrapeable.
+        io_fam = families["hst_io_read_tasks"]
+        assert io_fam.type == "gauge"
+        assert io_fam.samples[0].value == \
+            snap["collectors"]["io"]["read_tasks"]
+
+    def test_name_collisions_prefer_the_registry_instrument(self):
+        """When a collector leaf sanitizes to the same family name as a
+        registry counter, the counter is exported (first-wins, pinned)
+        and the family appears exactly once — double emission would be
+        invalid OpenMetrics."""
+        from hyperspace_tpu.telemetry.exposition import render_text
+        text = render_text({
+            "counters": {"serving.sweep_invocations": 7},
+            "gauges": {}, "histograms": {},
+            "collectors": {"serving": {"sweep_invocations": 3}},
+        })
+        assert text.count("# TYPE hst_serving_sweep_invocations ") == 1
+        assert "hst_serving_sweep_invocations_total 7" in text
+        assert "hst_serving_sweep_invocations 3" not in text
+
+    def test_http_endpoint_serves_and_404s(self, tmp_path, data_dir):
+        import urllib.error
+        import urllib.request
+        session = _session(tmp_path, "http")
+        hs = Hyperspace(session)
+        _query(session, data_dir).to_arrow()
+        port = hs.serve_metrics(port=0)  # ephemeral localhost bind
+        try:
+            assert port > 0
+            # Idempotent while up.
+            assert hs.serve_metrics(port=0) == port
+            url = f"http://127.0.0.1:{port}/metrics"
+            with urllib.request.urlopen(url, timeout=10) as resp:
+                assert resp.status == 200
+                assert "openmetrics-text" in resp.headers["Content-Type"]
+                body = resp.read().decode("utf-8")
+            from prometheus_client.openmetrics.parser import \
+                text_string_to_metric_families
+            names = {f.name for f in text_string_to_metric_families(body)}
+            assert "hst_trace_sampled" in names
+            with pytest.raises(urllib.error.HTTPError):
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/other", timeout=10)
+        finally:
+            hs.stop_serving_metrics()
+
+    def test_conf_port_default_is_off(self, tmp_path):
+        from hyperspace_tpu.exceptions import HyperspaceException
+        session = _session(tmp_path, "port")
+        assert session.hs_conf.telemetry_export_http_port() == 0
+        # Conf 0 means OFF: serve_metrics() without an explicit port
+        # must refuse, not silently bind an ephemeral listener.
+        with pytest.raises(HyperspaceException):
+            Hyperspace(session).serve_metrics()
+
+    def test_metrics_delta_shapes(self, tmp_path, data_dir):
+        session = _session(tmp_path, "delta")
+        hs = Hyperspace(session)
+        _query(session, data_dir).to_arrow()
+        before = hs.metrics()
+        assert hs.metrics_delta(before, before) == {}
+        _query(session, data_dir).to_arrow()
+        d = hs.metrics_delta(before)
+        assert d["counters.trace.sampled"] == 1
+        assert all(isinstance(v, float) for v in d.values())
+        # Flattening skips labels, keeps booleans as 0/1.
+        from hyperspace_tpu.telemetry.exposition import flatten
+        flat = flatten({"a": {"b": 2, "s": "label", "t": True,
+                              "l": [1, 2], "n": None}})
+        assert flat == {"a.b": 2.0, "a.t": 1.0}
+
+
+# ---------------------------------------------------------------------------
+# explain_analyze.
+# ---------------------------------------------------------------------------
+
+class TestExplainAnalyze:
+    def test_report_fuses_trace_joins_and_tallies(self, tmp_path,
+                                                  data_dir):
+        from hyperspace_tpu.optimizer.constants import OptimizerConstants
+        session = _session(
+            tmp_path, "ea",
+            **{OptimizerConstants.JOIN_REORDER_ENABLED: "true",
+               # The coin must not matter: explain_analyze pins it.
+               TC.TRACE_SAMPLE_RATE: "0",
+               TC.TRACE_TAIL_SLOW_MS: "1e9"})
+        hs = Hyperspace(session)
+        left = session.read.parquet(data_dir).filter(col("k") < 30)
+        dim_dir = str(tmp_path / "dim")
+        os.makedirs(dim_dir)
+        pq.write_table(pa.table({
+            "g2": pa.array(np.arange(5, dtype=np.int64)),
+            "w": pa.array(np.arange(5, dtype=np.float64)),
+        }), os.path.join(dim_dir, "d0.parquet"))
+        dim = session.read.parquet(dim_dir)
+        q = (left.join(dim, on=col("g") == col("g2"))
+             .group_by("g").agg(sum_(col("v") * col("w")).alias("s"))
+             .sort("g"))
+        report = hs.explain_analyze(q)
+        assert "== Explain Analyze ==" in report
+        assert "Trace:" in report and "query" in report
+        assert "Tallies:" in report
+        assert "io: tasks=" in report
+        assert "bank:" in report and "robustness:" in report
+        assert "row(s)" in report
+        if session._last_join_order:
+            assert "Joins (estimated vs actual):" in report
+            assert "join +" in report
+        # The forced trace was retained despite sampleRate=0.
+        assert hs.last_trace() is not None
+
+    def test_q_error_math(self):
+        from hyperspace_tpu.plananalysis.analyze import _q_error
+        assert _q_error(100, 100) == 1.0
+        assert _q_error(10, 1000) == 100.0
+        assert _q_error(1000, 10) == 100.0
+        assert _q_error(0, 0) == 1.0  # clamped, never div-by-zero
+
+
+# ---------------------------------------------------------------------------
+# The frozen metric-name registry (also the lint coverage witness).
+# ---------------------------------------------------------------------------
+
+class TestMetricNameRegistry:
+    def test_registry_is_the_expected_frozen_vocabulary(self):
+        # Referencing every value here is also what satisfies the
+        # scripts/lint.py metric-coverage gate — like the span-names
+        # list, this registry only changes deliberately.
+        assert mn.METRIC_NAMES == frozenset({
+            "trace.sampled", "trace.tail_kept", "trace.discarded",
+            "flight_recorder.anomalies", "slo.breaches",
+            "serving.sweep_invocations", "serving.latency_ms",
+            "query.latency_ms", "io", "program_bank", "serving",
+            "robustness", "streaming", "fusion", "flight_recorder",
+        })
+
+    def test_sweep_invocations_counter_still_feeds(self, tmp_path,
+                                                   data_dir):
+        """The pre-r18 push counter kept its registered name."""
+        from hyperspace_tpu.telemetry.metrics import get_registry
+        reg = get_registry()
+        before = reg.snapshot()["counters"].get(
+            mn.SERVING_SWEEP_INVOCATIONS, 0)
+        reg.counter_add(mn.SERVING_SWEEP_INVOCATIONS, 2)
+        assert reg.snapshot()["counters"][
+            mn.SERVING_SWEEP_INVOCATIONS] == before + 2

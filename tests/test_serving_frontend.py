@@ -178,18 +178,19 @@ class TestProgramBank:
         bank.lookup(("s1",), (256,), lambda: made.append(3))
         assert made == [1]
         s = bank.stats()
-        # "evictions" is the r13 canonical spelling; "stage_evictions"
-        # stays as the deprecated alias (telemetry/metrics.py naming).
+        # "evictions" is THE canonical spelling (telemetry/metrics.py
+        # naming); the deprecated "stage_evictions" alias is GONE — the
+        # exact-dict assert pins both facts.
         assert s == {"stages": 1, "programs": 2, "hits": 1, "misses": 2,
-                     "evictions": 0, "stage_evictions": 0,
-                     "stages_by_kind": {"s1": 1}}
+                     "evictions": 0, "stages_by_kind": {"s1": 1}}
+        assert "stage_evictions" not in s
 
     def test_lru_stage_eviction(self):
         bank = ProgramBank(max_stages=2)
         for i in range(3):
             bank.lookup((f"s{i}",), (1,), lambda: object())
         s = bank.stats()
-        assert s["stages"] == 2 and s["stage_evictions"] == 1
+        assert s["stages"] == 2 and s["evictions"] == 1
 
     def test_two_sessions_share_warm_programs(self, tmp_path):
         """THE multi-tenant acceptance: total compiles for two sessions

@@ -57,8 +57,10 @@ _MINIMAL = {
         'IO_POOLED_READ = "io.pooled_read"\n',
     "hyperspace_tpu/execution/fusion_boundaries.py":
         'SORT = "sort"\n',
+    "hyperspace_tpu/telemetry/metric_names.py":
+        'SERVING_LATENCY_MS = "serving.latency_ms"\n',
     "tests/test_cover.py":
-        '_ = ["query", "io.pooled_read", "sort"]\n',
+        '_ = ["query", "io.pooled_read", "sort", "serving.latency_ms"]\n',
     "bench.py": "",
     "__graft_entry__.py": "",
 }
@@ -119,6 +121,8 @@ _SEEDED = {
         "    fault_point('free.fault')\n"     # unregistered fault
         "def h():\n"
         "    note_boundary('free.kind')\n"    # unregistered boundary
+        "def m(reg):\n"
+        "    reg.counter_add('free.metric')\n"  # unregistered metric
     ),
     "hyperspace_tpu/except_victim.py": (
         "def f():\n"
@@ -146,6 +150,9 @@ _SEEDED = {
         'ORPHAN_FAULT = "orphan.fault"\n',
     "hyperspace_tpu/execution/fusion_boundaries.py":
         'SORT = "sort"\nORPHAN_KIND = "orphan.kind"\n',
+    "hyperspace_tpu/telemetry/metric_names.py":
+        'SERVING_LATENCY_MS = "serving.latency_ms"\n'
+        'ORPHAN_METRIC = "orphan.metric"\n',
 }
 
 
@@ -176,7 +183,8 @@ class TestParity:
                       "jax.jit outside", "forbidden repo-wide",
                       "distributed module", "module-level mutable state",
                       "span name must", "fault-point name must",
-                      "boundary kind must", "bare 'except:'",
+                      "boundary kind must", "metric name must",
+                      "bare 'except:'",
                       "thread/pool construction", "syntax error",
                       "never referenced under tests/"):
             assert token in text, f"gate output missing: {token}"
@@ -231,7 +239,7 @@ class TestFramework:
             "HS101", "HS102", "HS103", "HS104",
             "HS201", "HS202", "HS203", "HS204", "HS205", "HS206",
             "HS207", "HS208", "HS209", "HS210", "HS211", "HS212",
-            "HS213", "HS214", "HS215",
+            "HS213", "HS214", "HS215", "HS216", "HS217",
             "HS301", "HS302", "HS311", "HS312", "HS321",
         }
 

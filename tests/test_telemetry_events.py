@@ -335,3 +335,87 @@ class TestEventTaxonomy:
         hs.cancel("cxIdx")
         evs, _ = take_new(mark)
         assert names_of(evs).count("CancelActionEvent") == 2
+
+
+class TestEventLogging:
+    """telemetry/logging.py itself: the conf-pluggable logger plumbing
+    (class loading, per-class instance memoization, the mixin, the
+    shared fallback-emission helper) and trace-id correlation in the
+    records a logger receives."""
+
+    def test_default_and_empty_are_noop(self):
+        from hyperspace_tpu.telemetry.logging import (NoOpEventLogger,
+                                                      get_logger)
+        assert isinstance(get_logger(None), NoOpEventLogger)
+        assert isinstance(get_logger(""), NoOpEventLogger)
+        # The no-op sink accepts any event silently.
+        from hyperspace_tpu.telemetry.events import HyperspaceEvent
+        get_logger(None).log_event(HyperspaceEvent(message="x"))
+
+    def test_logger_instances_memoized_per_class_name(self):
+        from hyperspace_tpu.telemetry.logging import get_logger
+        a = get_logger("tests.conftest.CaptureLogger")
+        b = get_logger("tests.conftest.CaptureLogger")
+        assert a is b
+
+    def test_unloadable_class_raises_typed(self):
+        from hyperspace_tpu.telemetry.logging import get_logger
+        with pytest.raises(HyperspaceException):
+            get_logger("tests.conftest.NoSuchLogger")
+        with pytest.raises(HyperspaceException):
+            get_logger("no.such.module.Logger")
+
+    def test_base_logger_is_abstract(self):
+        from hyperspace_tpu.telemetry.events import HyperspaceEvent
+        from hyperspace_tpu.telemetry.logging import EventLogger
+        with pytest.raises(NotImplementedError):
+            EventLogger().log_event(HyperspaceEvent())
+
+    def test_mixin_routes_through_conf_selected_logger(self, env):
+        from hyperspace_tpu.telemetry.events import HyperspaceEvent
+        from hyperspace_tpu.telemetry.logging import HyperspaceEventLogging
+
+        class Emitter(HyperspaceEventLogging):
+            pass
+
+        mark = len(sink().events)
+        Emitter().log_event(env["session"],
+                            HyperspaceEvent(message="via mixin"))
+        evs, _ = take_new(mark)
+        assert [e.message for e in evs] == ["via mixin"]
+
+    def test_emit_distributed_fallback_shared_helper(self, env):
+        from hyperspace_tpu.telemetry.logging import \
+            emit_distributed_fallback
+        mark = len(sink().events)
+        emit_distributed_fallback(env["session"], "spmd_query",
+                                  "capacity exceeded")
+        evs, _ = take_new(mark)
+        assert names_of(evs) == ["DistributedFallbackEvent"]
+        assert evs[0].where == "spmd_query"
+        assert evs[0].reason == "capacity exceeded"
+
+    def test_log_records_correlate_with_the_active_trace(self, env):
+        """Events logged inside a traced execution carry the trace/span
+        stamp of the query that emitted them; outside, both stamps are
+        empty — the correlation contract log consumers join on."""
+        from hyperspace_tpu.serving.context import QueryContext
+        from hyperspace_tpu.telemetry import trace as trace_mod
+        from hyperspace_tpu.telemetry.events import HyperspaceEvent
+        from hyperspace_tpu.telemetry.logging import get_logger
+
+        session = env["session"]
+        logger = get_logger("tests.conftest.CaptureLogger")
+        mark = len(sink().events)
+        ctx = QueryContext.for_session(session)
+        with trace_mod.query_trace(session, ctx) as root:
+            assert root is not None
+            logger.log_event(HyperspaceEvent(message="inside"))
+            tid, sid = trace_mod.active_ids()
+        logger.log_event(HyperspaceEvent(message="outside"))
+        evs, _ = take_new(mark)
+        by_msg = {e.message: e for e in evs}
+        assert by_msg["inside"].trace_id == tid == ctx.trace.trace_id
+        assert by_msg["inside"].span_id == sid != ""
+        assert by_msg["outside"].trace_id == ""
+        assert by_msg["outside"].span_id == ""

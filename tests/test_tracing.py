@@ -188,7 +188,11 @@ class TestTraceShape:
 
 class TestTracingOff:
     def test_off_is_byte_identical_and_traceless(self, q3ish):
+        # Tracing defaults ON since the observability round; the off
+        # CONTRACT (hard no-op, byte identity) is now an explicit
+        # opt-out.
         session, li_dir, od_dir = q3ish
+        _tracing(session, False)
         hs = Hyperspace(session)
         q = _build_q3(session, li_dir, od_dir)
         off = q.to_arrow()
@@ -208,6 +212,7 @@ class TestTracingOff:
 
     def test_off_events_carry_no_stamp(self, q3ish):
         session, li_dir, od_dir = q3ish
+        _tracing(session, False)
         session.conf.set(IndexConstants.EVENT_LOGGER_CLASS,
                          "tests.conftest.CaptureLogger")
         sink = capture_logger()
@@ -422,9 +427,9 @@ class TestMetricsRegistry:
         bank = cols["program_bank"]
         for key in ("stages", "programs", "hits", "misses", "evictions"):
             assert key in bank
-        # r13 naming unification: canonical `evictions` + the deprecated
-        # `stage_evictions` alias agree.
-        assert bank["evictions"] == bank["stage_evictions"]
+        # Naming unification complete: canonical `evictions` only — the
+        # deprecated pre-r13 `stage_evictions` alias was removed.
+        assert "stage_evictions" not in bank
         rc = cols["result_cache"]
         assert set(rc["result_cache"]) >= {"hits", "misses", "evictions"}
         assert "sql_plan_cache" in rc
