@@ -2176,6 +2176,196 @@ def _run_streaming_phase(args, root: str) -> None:
         if fresh_per_commit > 0 else None
 
 
+def _run_adaptive_phase(args, root: str) -> None:
+    """Adaptive control plane (ISSUE r19): the three closed loops,
+    measured. Emits adaptive_qerror_first_half/_second_half (feedback-
+    corrected estimation over a replayed workload), adaptive_p99_
+    overload_on_ms/_off_ms (SLO-degrade admission under an armed,
+    breached objective), and adaptive_builder_built/_retired."""
+    import numpy as np
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    import hyperspace_tpu as hst
+    from hyperspace_tpu.adaptive.admission import get_controller
+    from hyperspace_tpu.adaptive.builder import (AdaptiveBuilder,
+                                                 BuilderLedger)
+    from hyperspace_tpu.adaptive.constants import AdaptiveConstants as AC
+    from hyperspace_tpu.adaptive.feedback import get_store
+    from hyperspace_tpu.advisor.constants import AdvisorConstants
+    from hyperspace_tpu.api import Hyperspace, IndexConfig
+    from hyperspace_tpu.optimizer.constants import OptimizerConstants
+    from hyperspace_tpu.plan.expr import col, count, sum_
+    from hyperspace_tpu.serving.frontend import ServingFrontend
+    from hyperspace_tpu.telemetry.constants import TelemetryConstants
+
+    rng = np.random.default_rng(23)
+
+    def session_for(tag, adaptive=True):
+        s = hst.Session(system_path=os.path.join(root, f"adp_{tag}_idx"))
+        s.conf.set("hyperspace.index.numBuckets", 4)
+        s.conf.set("hyperspace.tpu.distributed.enabled", "false")
+        s.conf.set(OptimizerConstants.JOIN_REORDER_ENABLED, "true")
+        if adaptive:
+            s.conf.set(AC.ENABLED, "true")
+        return s
+
+    # --- loop 1: feedback-corrected estimation over a replayed
+    # workload. A skewed star (95% of fact rows hit ONE dim key, and
+    # the selective dim category selects exactly that key) makes the
+    # uniform-NDV estimate miss by ~10x; the correction store must
+    # close that gap over the replay. Re-planning is off so the halves
+    # isolate the learning effect.
+    n_f, n_d1, n_d2 = 4000, 50, 20
+    f_d1 = np.zeros(n_f, dtype=np.int64)
+    f_d1[:200] = np.arange(200) % (n_d1 - 1) + 1
+    rng.shuffle(f_d1)
+    star = os.path.join(root, "adp_star")
+    for name, t in (
+            ("fact", pa.table({
+                "f_d1": pa.array(f_d1),
+                "f_d2": pa.array(rng.integers(0, n_d2, n_f)
+                                 .astype(np.int64)),
+                "f_val": pa.array(np.round(rng.uniform(0, 100, n_f), 3)),
+            })),
+            ("dim1", pa.table({
+                "d1_key": pa.array(np.arange(n_d1, dtype=np.int64)),
+                "d1_cat": pa.array(
+                    ["b" if i == 0 else f"c{i % 9}"
+                     for i in range(n_d1)]),
+            })),
+            ("dim2", pa.table({
+                "d2_key": pa.array(np.arange(n_d2, dtype=np.int64)),
+                "d2_cat": pa.array(rng.choice(["x", "y"], n_d2)),
+            }))):
+        os.makedirs(os.path.join(star, name))
+        pq.write_table(t, os.path.join(star, name, "p0.parquet"))
+
+    def star_query(s):
+        fact = s.read.parquet(os.path.join(star, "fact"))
+        d1 = s.read.parquet(os.path.join(star, "dim1")) \
+            .filter(col("d1_cat") == "b")
+        d2 = s.read.parquet(os.path.join(star, "dim2"))
+        return (fact.join(d2, on=col("f_d2") == col("d2_key"))
+                .join(d1, on=col("f_d1") == col("d1_key"))
+                .select("d1_cat", "d2_cat", "f_val"))
+
+    def worst_q_error(s):
+        star_query(s).to_arrow()
+        qs = [1.0]
+        for rec in (s._last_join_order or []):
+            for st in rec["steps"]:
+                actual = s._join_actuals.get(st["key"])
+                if actual is None:
+                    continue
+                est = max(float(st["est_rows"]), 1.0)
+                act = max(float(actual), 1.0)
+                qs.append(max(est / act, act / est))
+        return max(qs)
+
+    session = session_for("star")
+    session.conf.set(AC.REPLAN_ENABLED, "false")
+    get_store().clear()
+    runs = 8
+    qerrs = [worst_q_error(session) for _ in range(runs)]
+    half = runs // 2
+    RESULT["adaptive_qerror_first_half"] = round(
+        sum(qerrs[:half]) / half, 3)
+    RESULT["adaptive_qerror_second_half"] = round(
+        sum(qerrs[half:]) / half, 3)
+    if RESULT["adaptive_qerror_second_half"] >= \
+            RESULT["adaptive_qerror_first_half"]:
+        RESULT["errors"].append(
+            "adaptive: feedback did not shrink q-error over the replay")
+    get_store().clear()
+
+    # --- loop 3 (admission): p99 under an armed objective nothing can
+    # meet, controller off (exact answers) vs on (eligible aggregates
+    # degrade to the sampled tier with a stated bound).
+    wide = os.path.join(root, "adp_wide")
+    os.makedirs(wide)
+    wt = pa.table({
+        "k": pa.array(np.arange(16000, dtype=np.int64)),
+        "v": pa.array(rng.integers(0, 1000, 16000).astype(np.int64)),
+    })
+    for i in range(4):
+        pq.write_table(wt.slice(i * 4000, 4000),
+                       os.path.join(wide, f"p{i}.parquet"))
+
+    def overload_p99_ms(adaptive_on):
+        s = session_for("adm_on" if adaptive_on else "adm_off",
+                        adaptive=adaptive_on)
+        s.conf.set(TelemetryConstants.SLO_P99_MS, "0.001")
+        s.conf.set(TelemetryConstants.SLO_MIN_COUNT, "1")
+        agg = s.read.parquet(wide).agg(sum_(col("v")).alias("sv"),
+                                       count().alias("n"))
+        fe = ServingFrontend(s)
+        get_controller().reset()
+        fe.submit(agg).result(timeout=300)  # warm + seed the window
+        lat = []
+        for _ in range(12):
+            t0 = time.perf_counter()
+            fe.submit(agg).result(timeout=300)
+            lat.append((time.perf_counter() - t0) * 1000.0)
+        get_controller().reset()
+        lat.sort()
+        return lat[min(len(lat) - 1, int(0.99 * len(lat)))]
+
+    RESULT["adaptive_p99_overload_off_ms"] = round(
+        overload_p99_ms(False), 3)
+    RESULT["adaptive_p99_overload_on_ms"] = round(
+        overload_p99_ms(True), 3)
+
+    # --- loop 2 (the advisor acts): captured workload -> builder
+    # materializes the top recommendation in a forced idle window; a
+    # cold index with zero measured usage is retired after its
+    # observation window.
+    fact2 = os.path.join(root, "adp_fact")
+    os.makedirs(fact2)
+    ks = np.sort(rng.integers(0, 100, 4000)).astype(np.int64)
+    ft = pa.table({
+        "k": pa.array(ks),
+        "v": pa.array(rng.integers(0, 9, 4000).astype(np.int64)),
+        "w": pa.array(np.round(rng.uniform(0, 1, 4000), 3)),
+    })
+    pq.write_table(ft.slice(0, 2000), os.path.join(fact2, "p0.parquet"))
+    pq.write_table(ft.slice(2000, 2000),
+                   os.path.join(fact2, "p1.parquet"))
+    dim = os.path.join(root, "adp_dim")
+    os.makedirs(dim)
+    pq.write_table(pa.table({
+        "dk": pa.array(np.arange(100, dtype=np.int64)),
+        "dv": pa.array(rng.integers(0, 5, 100).astype(np.int64)),
+    }), os.path.join(dim, "p0.parquet"))
+
+    s = session_for("builder")
+    s.enable_hyperspace()
+    hs = Hyperspace(s)
+    q = s.read.parquet(fact2).filter(col("k") > 50).select("k", "v")
+    s.conf.set(AdvisorConstants.CAPTURE_ENABLED, "true")
+    q.to_arrow()
+    s.conf.set(AdvisorConstants.CAPTURE_ENABLED, "false")
+    builder = AdaptiveBuilder(hs, ledger=BuilderLedger())
+    built = builder.run_once(force=True)["built"]
+    q.to_arrow()  # the workload query now rides the built index
+    used = sum(s._index_usage_counts.get(n, 0) for n in built)
+    if built and not used:
+        RESULT["errors"].append(
+            "adaptive: built index never used by its workload query")
+    hs.create_index(s.read.parquet(dim),
+                    IndexConfig("adp_cold", ["dk"], ["dv"]))
+    s.conf.set(AC.BUILDER_RETIRE_MIN_QUERIES, "1")
+    s.conf.set(AC.BUILDER_MAX_BYTES, "1")  # budget spent: no new builds
+    retired = list(builder.run_once(force=True)["retired"])
+    q.to_arrow()  # one completed query inside the observation window
+    retired += builder.run_once(force=True)["retired"]
+    RESULT["adaptive_builder_built"] = len(built)
+    RESULT["adaptive_builder_retired"] = len(retired)
+    if "adp_cold" not in retired:
+        RESULT["errors"].append(
+            "adaptive: unused index was not retired")
+
+
 def _gil_free_scaling() -> float:
     """2-thread vs serial throughput of GIL-free zlib decompression —
     the host's REAL parallel capacity (vCPU count lies on time-shared
@@ -2411,6 +2601,13 @@ def main():
                 except Exception as e:
                     RESULT["errors"].append(
                         f"streaming phase: {type(e).__name__}: {e}")
+        if not _backend_dead():
+            with _phase("adaptive"):
+                try:
+                    _run_adaptive_phase(args, root)
+                except Exception as e:
+                    RESULT["errors"].append(
+                        f"adaptive phase: {type(e).__name__}: {e}")
         with _phase("mesh"):
             # Multi-device numbers ride along at a bounded scale (the
             # virtual CPU mesh measures path health + collective overhead,

@@ -1,0 +1,42 @@
+"""adaptive/ — the self-driving control plane.
+
+Closes the three feedback loops every sensor below it already feeds
+(ROADMAP item 1: "Every sensor now exists; nothing acts on them yet"):
+
+- feedback.py — a process-wide correction store pairs the optimizer's
+  per-join cardinality estimates with the actual row counts the staged,
+  fused, and SPMD executors record, feeds the learned correction
+  factors back into join reordering, and triggers mid-query re-planning
+  at stage boundaries when an observed actual blows past its estimate.
+- builder.py — a budgeted background builder rides the serving pool's
+  idle windows: materializes the advisor's top recommendations, retires
+  indexes whose measured usageCount stays zero, and schedules streaming
+  maintenance (optimize/compact) off the same idle-window ledger.
+- admission.py — wires SloMonitor breach verdicts into the serving
+  frontend: on breach, shed at submit or degrade eligible aggregate
+  queries to a sampled approximate answer with a stated error bound,
+  recovering to exact answers when health() clears.
+
+Everything is off-able via ``hyperspace.tpu.adaptive.*`` conf (master
+switch ``hyperspace.tpu.adaptive.enabled``, default false) read through
+config.py only.
+"""
+
+from .constants import AdaptiveConstants  # noqa: F401
+
+
+def emit_action(session, action: str, subject: str = "",
+                detail: str = "") -> None:
+    """One AdaptiveActionEvent per control-plane decision (builder
+    build/retire/maintain, admission engage/recover). Best-effort —
+    observability must never fail the control plane."""
+    try:
+        from ..telemetry.events import AdaptiveActionEvent
+        from ..telemetry.logging import get_logger
+        get_logger(session.hs_conf.event_logger_class()).log_event(
+            AdaptiveActionEvent(
+                message=f"adaptive action: {action}"
+                        + (f" ({subject})" if subject else ""),
+                action=action, subject=subject, detail=detail))
+    except Exception:
+        pass

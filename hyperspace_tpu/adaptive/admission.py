@@ -1,0 +1,250 @@
+"""SLO-driven admission: breach → shed or degrade, recover on health.
+
+r18 shipped the sensors (telemetry/slo.py: sliding-window monitors,
+edge-triggered SloBreachEvent, ``Hyperspace.health()``) explicitly "not
+yet wired to admission control". This wires them: the serving frontend
+asks :class:`AdmissionController` at submit time, and while any armed
+objective is breached the controller answers the configured
+``adaptive.admission.mode``:
+
+- ``shed`` — the submit raises the typed ServingRejectedError (clients
+  see the same error queue-depth rejection raises today);
+- ``degrade`` — the query is admitted, but if its plan is an eligible
+  aggregation the worker runs it over a deterministic sampled subset of
+  each scan's files and the result carries a stated error bound
+  (``Table.approx_error_bound``) — an approximate answer beats an
+  error under overload (PAPERS.md: arxiv 1805.05874). Ineligible plans
+  run exact.
+
+The controller re-evaluates the monitor at most once per second (the
+verdict is cached between refreshes) and recovery is automatic: the
+first healthy verdict flips back to exact answers and emits an
+AdaptiveActionEvent("recover").
+"""
+
+from __future__ import annotations
+
+import math
+import os
+import threading
+import time
+from typing import List, Optional, Tuple
+
+from ..plan import expr as E
+from ..plan.nodes import (Aggregate, Limit, LogicalPlan, Project, Scan,
+                          Sort)
+
+# Seconds between SloMonitor re-evaluations (between them, decide()
+# answers from the cached verdict).
+_REFRESH_S = 1.0
+
+
+class AdmissionController:
+    """Process-wide admission verdict, fed by the SLO monitor. All
+    mutable state behind ``_lock`` (submits race from client threads;
+    HS301)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._overloaded = False
+        self._last_refresh = 0.0
+        self._stats = {"breaches": 0, "recoveries": 0,
+                       "sheds": 0, "degrades": 0}
+
+    def refresh(self, session, force: bool = False) -> bool:
+        """Re-evaluate the SLO monitor (rate-limited unless ``force``)
+        and return the current overload verdict."""
+        now = time.monotonic()
+        with self._lock:
+            if not force and now - self._last_refresh < _REFRESH_S:
+                return self._overloaded
+            self._last_refresh = now
+        from ..telemetry.slo import get_monitor
+        verdict = get_monitor().evaluate(session, now=now)
+        breached = any(
+            o.get("breached")
+            for o in (verdict.get("objectives") or {}).values())
+        action = None
+        with self._lock:
+            was = self._overloaded
+            self._overloaded = bool(breached)
+            if breached and not was:
+                self._stats["breaches"] += 1
+                action = "admission.engage"
+            elif was and not breached:
+                self._stats["recoveries"] += 1
+                action = "admission.recover"
+        if action is not None:
+            from . import emit_action
+            mode = session.hs_conf.adaptive_admission_mode()
+            emit_action(session, action, subject=mode,
+                        detail=("SLO breach: new submissions will "
+                                f"{mode}" if action.endswith("engage")
+                                else "health() clear: exact answers "
+                                     "resume"))
+        return bool(breached)
+
+    def decide(self, session, force_refresh: bool = False) -> str:
+        """'admit' | 'shed' | 'degrade' for one submission."""
+        if not session.hs_conf.adaptive_admission_enabled():
+            return "admit"
+        if not self.refresh(session, force=force_refresh):
+            return "admit"
+        mode = session.hs_conf.adaptive_admission_mode()
+        with self._lock:
+            self._stats["sheds" if mode == "shed" else "degrades"] += 1
+        return mode
+
+    def overloaded(self) -> bool:
+        with self._lock:
+            return self._overloaded
+
+    def stats(self) -> dict:
+        with self._lock:
+            out = dict(self._stats)
+            out["overloaded"] = self._overloaded
+        return out
+
+    def reset(self) -> None:
+        with self._lock:
+            self._overloaded = False
+            self._last_refresh = 0.0
+            for k in self._stats:
+                self._stats[k] = 0
+
+
+_CONTROLLER: Optional[AdmissionController] = None
+_CONTROLLER_LOCK = threading.Lock()
+
+
+def get_controller() -> AdmissionController:
+    """The process singleton (double-checked, like slo.get_monitor)."""
+    global _CONTROLLER
+    if _CONTROLLER is None:
+        with _CONTROLLER_LOCK:
+            if _CONTROLLER is None:
+                _CONTROLLER = AdmissionController()
+    return _CONTROLLER
+
+
+# ---------------------------------------------------------------------------
+# The approximate tier: sampled scans + scaled aggregates + stated bound.
+# ---------------------------------------------------------------------------
+
+_SCALED = (E.Count, E.Sum)
+_UNSCALED = (E.Min, E.Max, E.Avg)
+
+
+def _agg_kind(a) -> Optional[type]:
+    inner = a.child if isinstance(a, E.Alias) else a
+    for kind in _SCALED + _UNSCALED:
+        if type(inner) is kind:
+            return kind
+    return None
+
+
+def _sample_relation(rel, fraction: float):
+    """(sampled relation, kept bytes, total bytes, kept files) or None
+    when the relation has nothing to drop. The kept prefix of the
+    sorted listing is deterministic: the same plan degrades to the same
+    approximate answer every time."""
+    try:
+        files = sorted(rel.all_files())
+    except Exception:
+        return None
+    if len(files) < 2:
+        return None
+    keep_n = max(1, int(math.ceil(len(files) * fraction)))
+    if keep_n >= len(files):
+        return None
+
+    def _size(f: str) -> int:
+        try:
+            return os.path.getsize(f)
+        except OSError:
+            return 0
+
+    total = sum(_size(f) for f in files)
+    kept_files = files[:keep_n]
+    kept = sum(_size(f) for f in kept_files)
+    if total <= 0 or kept <= 0:
+        return None
+    return rel.with_files(kept_files), kept, total, keep_n
+
+
+def approximate_plan(session, plan: LogicalPlan
+                     ) -> Optional[Tuple[LogicalPlan, dict]]:
+    """The degraded rewrite, or None when ``plan`` is not an eligible
+    aggregation (ineligible queries run exact even under breach).
+    Eligible: optional Sort/Limit/Project wrappers over ONE Aggregate
+    whose aggregates are Count/Sum/Min/Max/Avg and whose subtree scans
+    at least one multi-file source. The rewrite samples a deterministic
+    file prefix per scan, scales Count/Sum outputs by the inverse
+    sampled-byte fraction (Avg is self-normalizing; Min/Max stay raw),
+    and returns the stated error bound to attach to the result."""
+    wrappers: List[LogicalPlan] = []
+    node = plan
+    while isinstance(node, (Sort, Limit)) or (
+            isinstance(node, Project)
+            and all(isinstance(e, E.Col) for e in node.exprs)):
+        wrappers.append(node)
+        node = node.children[0]
+    if not isinstance(node, Aggregate):
+        return None
+    kinds = [_agg_kind(a) for a in node.aggs]
+    if not kinds or any(k is None for k in kinds):
+        return None
+
+    fraction = session.hs_conf.adaptive_admission_sample_fraction()
+    scale = 1.0
+    kept_files_total = 0
+    sampled = [0]
+
+    def _swap(n: LogicalPlan) -> LogicalPlan:
+        nonlocal scale, kept_files_total
+        if not isinstance(n, Scan):
+            return n
+        rel = getattr(n, "relation", None)
+        if rel is None:
+            return n
+        hit = _sample_relation(rel, fraction)
+        if hit is None:
+            return n
+        new_rel, kept, total, keep_n = hit
+        scale *= total / kept
+        kept_files_total += keep_n
+        sampled[0] += 1
+        return Scan(new_rel)
+
+    approx_child = node.child.transform_up(_swap)
+    if sampled[0] == 0:
+        return None  # nothing to sample — run exact
+    agg = Aggregate(node.group_cols, node.aggs, approx_child)
+
+    exprs: List[E.Expr] = [E.Col(g) for g in node.group_cols]
+    for a, kind in zip(node.aggs, kinds):
+        if kind in _SCALED and scale != 1.0:
+            exprs.append(E.Alias(
+                E.Multiply(E.Col(a.name), E.Lit(scale)), a.name))
+        else:
+            exprs.append(E.Col(a.name))
+    out: LogicalPlan = Project(exprs, agg)
+    for w in reversed(wrappers):
+        out = w.with_children([out])
+
+    effective = 1.0 / scale
+    bound = {
+        "kind": "relative",
+        "confidence": 0.95,
+        "sample_fraction": round(effective, 4),
+        # CLT-flavored heuristic over the kept file count: wide enough
+        # to be honest for sums/counts over roughly size-balanced
+        # files, and explicitly a heuristic — the point is a STATED
+        # bound on an answer that would otherwise be an error.
+        "bound": round(min(1.0, 2.0 * math.sqrt(
+            max(0.0, 1.0 - effective)
+            / max(1, kept_files_total))), 4),
+        "scaled": [a.name for a, k in zip(node.aggs, kinds)
+                   if k in _SCALED],
+    }
+    return out, bound

@@ -1,0 +1,350 @@
+"""The budgeted background builder: the advisor finally acts.
+
+r08's advisor ranks index recommendations and r11's serving pool knows
+when it is idle; until now a human had to connect them. This module
+closes that loop with one ledger and one actor:
+
+- :class:`BuilderLedger` — process-wide accounting: what was built,
+  what was retired, bytes spent against ``adaptive.builder.maxBytes``,
+  which build is in flight, and how long the serving tier has been
+  idle. The ledger is the crash-visibility surface the chaos soak
+  asserts on: ``in_progress`` must drain to empty.
+- :class:`AdaptiveBuilder` — one maintenance pass per idle window
+  (``run_once``), optionally self-scheduling on a daemon thread
+  (``start``/``stop``; thread via the sanctioned
+  :func:`parallel.io.spawn_daemon`). A pass only fires after every
+  live serving frontend has been empty for ``adaptive.builder.idleMs``
+  — in-flight queries never share the machine with a build. Each pass,
+  in order: materialize the advisor's current top recommendation
+  (within the byte budget, through the normal create path so op-log
+  crash recovery covers it), retire ACTIVE indexes whose measured
+  usageCount is still zero after ``retireMinQueries`` completed
+  queries of observation, and run r17 streaming maintenance
+  (op-log compaction) off the same idle window — compaction is
+  documented "run it in a quiet window", and the ledger is precisely
+  the thing that knows when the window is quiet.
+
+Every action emits an AdaptiveActionEvent; everything is off-able via
+``hyperspace.tpu.adaptive.builder.enabled``.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional
+
+__all__ = ["BuilderLedger", "AdaptiveBuilder", "get_ledger",
+           "get_builder"]
+
+
+class BuilderLedger:
+    """Process-wide builder accounting. All mutable state behind
+    ``_lock`` (the daemon loop, explicit run_once callers, and stats
+    readers race; HS301)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._built: List[str] = []
+        self._retired: List[str] = []
+        self._maintained: List[str] = []
+        self._bytes_spent = 0
+        self._in_progress: set = set()
+        # index name -> SLO-monitor cumulative query total when the
+        # builder first saw it ACTIVE with zero usage (retirement clock).
+        self._first_seen: Dict[str, int] = {}
+        self._idle_since: Optional[float] = None
+
+    # -- idle-window tracking -------------------------------------------
+
+    def note_activity(self, now: Optional[float] = None) -> None:
+        """The serving tier is busy: restart the idle clock."""
+        with self._lock:
+            self._idle_since = None
+
+    def idle_for(self, now: Optional[float] = None) -> float:
+        """Seconds the serving tier has been continuously idle (starts
+        the clock on the first idle observation)."""
+        t = now if now is not None else time.monotonic()
+        with self._lock:
+            if self._idle_since is None:
+                self._idle_since = t
+            return t - self._idle_since
+
+    # -- build accounting ------------------------------------------------
+
+    def begin(self, names) -> None:
+        with self._lock:
+            self._in_progress.update(names)
+
+    def finish(self, names, ok: bool, bytes_added: int = 0) -> None:
+        with self._lock:
+            self._in_progress.difference_update(names)
+            if ok:
+                self._built.extend(names)
+                self._bytes_spent += max(int(bytes_added), 0)
+
+    def bytes_spent(self) -> int:
+        with self._lock:
+            return self._bytes_spent
+
+    # -- retirement clock ------------------------------------------------
+
+    def observed_since(self, name: str, total_now: int) -> int:
+        """Completed queries since the builder first saw ``name`` idle
+        (first call starts the clock and returns 0)."""
+        with self._lock:
+            first = self._first_seen.setdefault(name, int(total_now))
+            return int(total_now) - first
+
+    def reset_observation(self, name: str) -> None:
+        """``name`` was used (or removed): forget its retirement clock."""
+        with self._lock:
+            self._first_seen.pop(name, None)
+
+    def note_retired(self, name: str) -> None:
+        with self._lock:
+            self._retired.append(name)
+            self._first_seen.pop(name, None)
+
+    def note_maintenance(self, action: str) -> None:
+        with self._lock:
+            self._maintained.append(action)
+
+    # -- observability ---------------------------------------------------
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "built": list(self._built),
+                "retired": list(self._retired),
+                "maintained": list(self._maintained),
+                "bytes_spent": self._bytes_spent,
+                "in_progress": sorted(self._in_progress),
+            }
+
+    def clear(self) -> None:
+        with self._lock:
+            self._built.clear()
+            self._retired.clear()
+            self._maintained.clear()
+            self._bytes_spent = 0
+            self._in_progress.clear()
+            self._first_seen.clear()
+            self._idle_since = None
+
+
+_LEDGER: Optional[BuilderLedger] = None
+_LEDGER_LOCK = threading.Lock()
+
+
+def get_ledger() -> BuilderLedger:
+    """THE process builder ledger (double-checked singleton)."""
+    global _LEDGER
+    if _LEDGER is None:
+        with _LEDGER_LOCK:
+            if _LEDGER is None:
+                _LEDGER = BuilderLedger()
+    return _LEDGER
+
+
+class AdaptiveBuilder:
+    """One background maintenance actor over one Hyperspace handle."""
+
+    def __init__(self, hyperspace, ledger: Optional[BuilderLedger] = None):
+        self._hs = hyperspace
+        self._ledger = ledger if ledger is not None else get_ledger()
+        self._stop_event = threading.Event()
+        # The daemon thread handle (spawned via parallel.io.spawn_daemon,
+        # the package's one sanctioned thread-construction site).
+        self._thread = None
+
+    # -- idle detection --------------------------------------------------
+
+    def _serving_busy(self) -> bool:
+        """Any live frontend with queued or executing work."""
+        from ..serving.frontend import all_frontends
+        for front in all_frontends():
+            try:
+                st = front.stats()
+            except Exception:
+                continue
+            if st.get("queued", 0) or st.get("active_workers", 0):
+                return True
+        return False
+
+    # -- the pass --------------------------------------------------------
+
+    def run_once(self, force: bool = False) -> dict:
+        """One maintenance pass. ``force`` skips the idle-window wait
+        (tests and operators); the busy check still applies — a build
+        never overlaps in-flight serving work. Returns a summary dict
+        ({ran, built, retired, maintained} + a reason when skipped)."""
+        session = self._hs.session
+        conf = session.hs_conf
+        out: dict = {"ran": False, "built": [], "retired": [],
+                     "maintained": []}
+        if not conf.adaptive_builder_enabled():
+            out["reason"] = "disabled"
+            return out
+        now = time.monotonic()
+        led = self._ledger
+        if self._serving_busy():
+            led.note_activity(now)
+            out["reason"] = "serving busy"
+            return out
+        if not force and \
+                led.idle_for(now) * 1000.0 < conf.adaptive_builder_idle_ms():
+            out["reason"] = "idle window still warming"
+            return out
+        out["ran"] = True
+        out["built"] = self._build_top_recommendation(session, conf)
+        out["retired"] = self._retire_unused(session, conf)
+        out["maintained"] = self._streaming_maintenance(session)
+        return out
+
+    def _build_top_recommendation(self, session, conf) -> List[str]:
+        """Materialize the advisor's current top recommendation whose
+        indexes don't exist yet, within the byte budget."""
+        led = self._ledger
+        max_bytes = conf.adaptive_builder_max_bytes()
+        if max_bytes and led.bytes_spent() >= max_bytes:
+            return []
+        try:
+            report = self._hs.recommend(top_k=1)
+            recos = list(report.recommendations)
+        except Exception:
+            recos = []
+        if not recos:
+            return []
+        rec = recos[0]
+        manager = session.index_collection_manager
+        missing = [n for n in rec.names
+                   if manager.get_index(n) is None]
+        if not missing:
+            return []
+        led.begin(rec.names)
+        ok = False
+        try:
+            self._hs.build_recommendation(rec)
+            ok = True
+        except Exception:
+            pass
+        finally:
+            built_bytes = 0
+            if ok:
+                for name in missing:
+                    try:
+                        entry = manager.get_index(name)
+                        if entry is not None:
+                            built_bytes += entry.index_files_size_in_bytes
+                    except Exception:
+                        pass
+            led.finish(rec.names, ok, built_bytes)
+        if not ok:
+            return []
+        from . import emit_action
+        for name in missing:
+            emit_action(session, "builder.build", subject=name,
+                        detail=(f"advisor top recommendation; "
+                                f"{built_bytes} bytes against budget "
+                                f"{max_bytes}"))
+        return missing
+
+    def _retire_unused(self, session, conf) -> List[str]:
+        """Delete ACTIVE indexes whose measured usageCount is still zero
+        after ``retireMinQueries`` completed queries of observation.
+        Soft delete (``delete_index``) — ``restore_index`` undoes a
+        wrong call; bytes go back only when an operator vacuums."""
+        from ..index.constants import States
+        from ..telemetry.slo import get_monitor
+        led = self._ledger
+        min_queries = conf.adaptive_builder_retire_min_queries()
+        total = get_monitor().total
+        manager = session.index_collection_manager
+        with session._usage_counts_lock:
+            usage = dict(session._index_usage_counts)
+        retired: List[str] = []
+        for entry in manager.get_indexes([States.ACTIVE]):
+            if usage.get(entry.name, 0) > 0:
+                led.reset_observation(entry.name)
+                continue
+            if led.observed_since(entry.name, total) < min_queries:
+                continue
+            try:
+                self._hs.delete_index(entry.name)
+            except Exception:
+                continue
+            led.note_retired(entry.name)
+            retired.append(entry.name)
+            from . import emit_action
+            emit_action(session, "builder.retire", subject=entry.name,
+                        detail=(f"usageCount 0 after "
+                                f"{min_queries}+ completed queries"))
+        return retired
+
+    def _streaming_maintenance(self, session) -> List[str]:
+        """r17 op-log compaction in the same quiet window. The
+        compaction module's own min-entries threshold decides what is
+        actually foldable, so an already-tight lake is a no-op."""
+        led = self._ledger
+        try:
+            summary = self._hs.compact(None)
+        except Exception:
+            return []
+        done = sorted((summary.get("compacted") or {}).keys())
+        for name in done:
+            led.note_maintenance(f"compact:{name}")
+            from . import emit_action
+            emit_action(session, "builder.maintain", subject=name,
+                        detail="op-log compaction in idle window")
+        return done
+
+    # -- optional self-scheduling ---------------------------------------
+
+    def start(self) -> None:
+        """Run ``run_once`` every ``adaptive.builder.intervalMs`` on a
+        daemon thread (idempotent while running)."""
+        if self._thread is not None and self._thread.is_alive():
+            return
+        self._stop_event.clear()
+        from ..parallel import io as pio
+        self._thread = pio.spawn_daemon("hst-adaptive-builder",
+                                        self._loop)
+
+    def stop(self, timeout: float = 5.0) -> None:
+        self._stop_event.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=timeout)
+        self._thread = None
+
+    def _loop(self) -> None:
+        while not self._stop_event.is_set():
+            try:
+                interval_ms = self._hs.session.hs_conf \
+                    .adaptive_builder_interval_ms()
+            except Exception:
+                interval_ms = 1000
+            if self._stop_event.wait(interval_ms / 1000.0):
+                return
+            try:
+                self.run_once()
+            except Exception:
+                pass  # the maintenance loop must outlive one bad pass
+
+
+_BUILDER: Optional[AdaptiveBuilder] = None
+_BUILDER_LOCK = threading.Lock()
+
+
+def get_builder(hyperspace) -> AdaptiveBuilder:
+    """The process-default builder, created on first use with
+    ``hyperspace`` as its governing handle (later calls return the
+    existing builder regardless of the handle, like get_frontend)."""
+    global _BUILDER
+    if _BUILDER is None:
+        with _BUILDER_LOCK:
+            if _BUILDER is None:
+                _BUILDER = AdaptiveBuilder(hyperspace)
+    return _BUILDER

@@ -1,0 +1,99 @@
+"""Adaptive control-plane config keys.
+
+No reference analogue: the original project's advisor recommends but
+never acts, and its planner never learns from execution. The design
+here follows the adaptive-execution literature (PAPERS.md: approximate
+answers under overload, "Approximate Distributed Joins", arxiv
+1805.05874; autonomous index/sketch materialization, "Extensible Data
+Skipping", arxiv 2009.08150).
+
+Keys live under ``hyperspace.tpu.adaptive.*`` and are read exclusively
+through config.py accessors (the scripts/lint.py env-read gate) and must
+each appear in docs/configuration.md (the scripts/lint.py doc-drift
+gate).
+"""
+
+from __future__ import annotations
+
+
+class AdaptiveConstants:
+    # Master switch for the whole control plane. Off (the default)
+    # means feedback, re-planning, the background builder, and
+    # SLO-driven admission are all inert and behavior is byte-identical
+    # to a build without adaptive/.
+    ENABLED = "hyperspace.tpu.adaptive.enabled"
+    ENABLED_DEFAULT = "false"
+
+    # Feedback-corrected optimization: accumulate per-join correction
+    # factors from observed actual rows and apply them inside the join
+    # reorderer's cardinality estimates.
+    FEEDBACK_ENABLED = "hyperspace.tpu.adaptive.feedback.enabled"
+    FEEDBACK_ENABLED_DEFAULT = "true"
+
+    # Bound on distinct correction entries kept in the process-wide
+    # store (exact join keys + coarse table-pair keys, counted
+    # together); oldest entries drop first.
+    FEEDBACK_MAX_ENTRIES = "hyperspace.tpu.adaptive.feedback.maxEntries"
+    FEEDBACK_MAX_ENTRIES_DEFAULT = "4096"
+
+    # EMA weight given to the newest observed est/actual ratio when a
+    # correction entry already exists (1.0 = always replace).
+    FEEDBACK_ALPHA = "hyperspace.tpu.adaptive.feedback.alpha"
+    FEEDBACK_ALPHA_DEFAULT = "0.5"
+
+    # Mid-query re-planning at stage boundaries.
+    REPLAN_ENABLED = "hyperspace.tpu.adaptive.replan.enabled"
+    REPLAN_ENABLED_DEFAULT = "true"
+
+    # Trigger threshold: a stage whose observed actual rows diverge
+    # from the optimizer's estimate by more than this factor (either
+    # direction) aborts staged execution and re-plans with the fresh
+    # correction applied.
+    REPLAN_ERROR_THRESHOLD = "hyperspace.tpu.adaptive.replan.errorThreshold"
+    REPLAN_ERROR_THRESHOLD_DEFAULT = "8.0"
+
+    # Background builder: materialize top advisor recommendations and
+    # run streaming maintenance during serving-pool idle windows.
+    BUILDER_ENABLED = "hyperspace.tpu.adaptive.builder.enabled"
+    BUILDER_ENABLED_DEFAULT = "true"
+
+    # Byte budget for index data the builder may materialize over its
+    # lifetime; a build whose predicted size would exceed the remaining
+    # budget is skipped.
+    BUILDER_MAX_BYTES = "hyperspace.tpu.adaptive.builder.maxBytes"
+    BUILDER_MAX_BYTES_DEFAULT = "1073741824"
+
+    # The serving frontend must have been idle (no queued entries, no
+    # active workers) for at least this long before the builder spends
+    # its budget.
+    BUILDER_IDLE_MS = "hyperspace.tpu.adaptive.builder.idleMs"
+    BUILDER_IDLE_MS_DEFAULT = "200"
+
+    # Retirement guard: an ACTIVE index is only retired as a loser once
+    # at least this many queries ran since the builder first saw it,
+    # and its measured usageCount is still zero.
+    BUILDER_RETIRE_MIN_QUERIES = \
+        "hyperspace.tpu.adaptive.builder.retireMinQueries"
+    BUILDER_RETIRE_MIN_QUERIES_DEFAULT = "32"
+
+    # Poll interval of the optional background daemon loop.
+    BUILDER_INTERVAL_MS = "hyperspace.tpu.adaptive.builder.intervalMs"
+    BUILDER_INTERVAL_MS_DEFAULT = "1000"
+
+    # SLO-driven admission: act on SloMonitor breach verdicts at the
+    # serving frontend.
+    ADMISSION_ENABLED = "hyperspace.tpu.adaptive.admission.enabled"
+    ADMISSION_ENABLED_DEFAULT = "true"
+
+    # What a breach does to new submissions: "shed" rejects at submit
+    # with a typed ServingRejectedError; "degrade" admits but runs
+    # eligible aggregate plans on a sampled file subset, attaching a
+    # stated error bound to the (approximate) result.
+    ADMISSION_MODE = "hyperspace.tpu.adaptive.admission.mode"
+    ADMISSION_MODE_DEFAULT = "degrade"
+
+    # Fraction of source files the approximate tier scans (per leaf,
+    # deterministic prefix after sorting; at least one file).
+    ADMISSION_SAMPLE_FRACTION = \
+        "hyperspace.tpu.adaptive.admission.sampleFraction"
+    ADMISSION_SAMPLE_FRACTION_DEFAULT = "0.25"

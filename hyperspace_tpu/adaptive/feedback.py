@@ -1,0 +1,271 @@
+"""Feedback-corrected cardinality estimation + mid-query re-planning.
+
+The sensors already exist: the join reorderer (optimizer/join_order.py)
+leaves per-step ``est_rows`` records keyed by the composite
+``join_actual_key`` on ``session._last_join_order``, and the staged,
+fused, and SPMD executors write every executed inner join's actual
+output rows to the same keys (serving/context.record_join_actual). This
+module closes the loop:
+
+- :class:`CorrectionStore` — a process-wide store (one per process,
+  like telemetry/slo.get_monitor) accumulating what execution taught
+  us. Two tiers: an EXACT tier keyed by the full composite join key
+  (condition repr + both side signatures) holding an EMA of observed
+  output rows, and a COARSE tier keyed by the unordered pair of side
+  signatures holding a clamped EMA of the actual/estimate ratio. The
+  exact tier answers "this very join ran before — reuse its observed
+  cardinality"; the coarse tier generalizes a learned mis-estimate to
+  other enumeration candidates over the same table pair.
+- ``observe()`` — called from record_join_actual while
+  ``adaptive.feedback.enabled`` is on; pairs the actual with the
+  recorded estimate (when the reorderer left one) and updates both
+  tiers under the store lock.
+- ``maybe_replan()`` — the staged executor calls this at its join
+  stage boundary (executor._record_join_actual): when the observed
+  actual diverges from the recorded estimate past
+  ``adaptive.replan.errorThreshold`` and downstream join stages remain,
+  it raises :class:`ReplanRequested`. Session._execute_uncaptured
+  catches it and re-executes — the re-optimize pass sees the fresh
+  correction (observe ran first), so the replanned order reflects the
+  measured cardinality. One replan per query (contextvar guard);
+  literal-sweep batches never replan (members share scans mid-flight).
+
+No jax imports here — the store must be importable from
+serving/context.py, which sessions import without the execution stack.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import threading
+from collections import OrderedDict
+from typing import Optional, Tuple
+
+from ..exceptions import HyperspaceException
+
+# Clamp on the coarse-tier ratio: one wild observation must not swing
+# every future estimate for the pair by more than this factor.
+_RATIO_CLAMP = 64.0
+
+_SUPPRESS: contextvars.ContextVar = contextvars.ContextVar(
+    "hst_adaptive_replan_suppress", default=False)
+
+
+class ReplanRequested(HyperspaceException):
+    """Control-flow signal, not a failure: a stage boundary observed an
+    actual cardinality far enough from its estimate that re-planning
+    beats finishing the current plan. Raised only while
+    ``adaptive.replan.enabled`` is on; always caught by
+    Session._execute_uncaptured (typed as a HyperspaceException so an
+    escape through an unexpected path still honors the serving tier's
+    typed-error contract)."""
+
+    def __init__(self, key: str, est_rows: float, actual_rows: int):
+        super().__init__(
+            f"re-plan requested: join {key!r} estimated ~{est_rows:.0f} "
+            f"rows, observed {actual_rows}")
+        self.key = key
+        self.est_rows = float(est_rows)
+        self.actual_rows = int(actual_rows)
+
+
+def parse_key(key: str) -> Optional[Tuple[str, str, str]]:
+    """Split one composite join key back into (condition repr,
+    left signature, right signature); None for legacy/foreign keys."""
+    try:
+        head, right_sig = key.rsplit(" >< ", 1)
+        cond, left_sig = head.rsplit(" @ ", 1)
+    except ValueError:
+        return None
+    return cond, left_sig, right_sig
+
+
+def pair_key(left_sig: str, right_sig: str) -> str:
+    """Orientation-insensitive table-pair key: the same two inputs
+    joined either way around have the same true cardinality."""
+    a, b = sorted((left_sig, right_sig))
+    return f"{a} || {b}"
+
+
+class CorrectionStore:
+    """Process-wide feedback accumulator. Every mutation and read holds
+    ``_lock`` — the store is written from serving worker threads and
+    read from whatever thread runs the optimizer (HS301)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        # exact composite key -> EMA of observed output rows
+        self._rows: "OrderedDict[str, float]" = OrderedDict()
+        # pair key -> clamped EMA of actual/estimate ratio
+        self._ratios: "OrderedDict[str, float]" = OrderedDict()
+        self._observed = 0
+        self._paired = 0
+        self._replans = 0
+
+    # -- writes ---------------------------------------------------------
+
+    def observe(self, session, key: str, rows: int) -> None:
+        parsed = parse_key(key)
+        if parsed is None:
+            return
+        _, left_sig, right_sig = parsed
+        conf = session.hs_conf
+        alpha = conf.adaptive_feedback_alpha()
+        cap = conf.adaptive_feedback_max_entries()
+        est = lookup_estimate(session, key)
+        with self._lock:
+            self._observed += 1
+            prev = self._rows.get(key)
+            val = float(rows) if prev is None else \
+                (1.0 - alpha) * prev + alpha * float(rows)
+            self._rows[key] = val
+            self._rows.move_to_end(key)
+            while len(self._rows) > cap:
+                self._rows.popitem(last=False)
+            if est is not None and est > 0:
+                self._paired += 1
+                pk = pair_key(left_sig, right_sig)
+                ratio = max(float(rows), 1.0) / max(est, 1.0)
+                ratio = min(max(ratio, 1.0 / _RATIO_CLAMP), _RATIO_CLAMP)
+                prev_r = self._ratios.get(pk)
+                r = ratio if prev_r is None else \
+                    (1.0 - alpha) * prev_r + alpha * ratio
+                self._ratios[pk] = r
+                self._ratios.move_to_end(pk)
+                while len(self._ratios) > cap:
+                    self._ratios.popitem(last=False)
+
+    def note_replan(self) -> None:
+        with self._lock:
+            self._replans += 1
+
+    # -- reads ----------------------------------------------------------
+
+    def exact_rows(self, key: str) -> Optional[float]:
+        with self._lock:
+            v = self._rows.get(key)
+        return None if v is None else max(1.0, v)
+
+    def pair_ratio(self, left_sig: str, right_sig: str) -> Optional[float]:
+        pk = pair_key(left_sig, right_sig)
+        with self._lock:
+            return self._ratios.get(pk)
+
+    def corrected_rows(self, left_sig: str, right_sig: str,
+                       est: float) -> float:
+        """The coarse-tier correction the enumeration applies: the raw
+        estimate scaled by the learned ratio for this table pair (the
+        exact tier needs the rebuilt condition, so it applies at rebuild
+        time in _reorder_chain instead)."""
+        ratio = self.pair_ratio(left_sig, right_sig)
+        if ratio is None:
+            return est
+        return max(1.0, est * ratio)
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"exact_entries": len(self._rows),
+                    "ratio_entries": len(self._ratios),
+                    "observed": self._observed,
+                    "paired": self._paired,
+                    "replans": self._replans}
+
+    def clear(self) -> None:
+        with self._lock:
+            self._rows.clear()
+            self._ratios.clear()
+            self._observed = self._paired = self._replans = 0
+
+
+_STORE: Optional[CorrectionStore] = None
+_STORE_LOCK = threading.Lock()
+
+
+def get_store() -> CorrectionStore:
+    """The process singleton (double-checked, like slo.get_monitor)."""
+    global _STORE
+    if _STORE is None:
+        with _STORE_LOCK:
+            if _STORE is None:
+                _STORE = CorrectionStore()
+    return _STORE
+
+
+def lookup_estimate(session, key: str) -> Optional[float]:
+    """The reorderer's recorded estimate for one executed join, if the
+    most recent reorder pass left one (reordered chains only — a chain
+    kept in text order records no steps)."""
+    records = getattr(session, "_last_join_order", None) or []
+    for r in records:
+        for s in (r.get("steps") or []):
+            if s.get("key") == key:
+                return float(s["est_rows"])
+    return None
+
+
+# ---------------------------------------------------------------------------
+# Mid-query re-planning.
+# ---------------------------------------------------------------------------
+
+@contextlib.contextmanager
+def suppress_replans():
+    """Scope guard for the re-executed attempt (and anything else that
+    must run to completion): maybe_replan becomes a no-op inside."""
+    token = _SUPPRESS.set(True)
+    try:
+        yield
+    finally:
+        _SUPPRESS.reset(token)
+
+
+def maybe_replan(session, key: str, actual_rows: int) -> None:
+    """The stage-boundary trigger (called by the staged executor right
+    after the actual-rows write-back, which already fed the store): when
+    the observed actual diverges from the recorded estimate past the
+    threshold AND downstream join stages remain in the same chain, raise
+    ReplanRequested so Session._execute_uncaptured re-optimizes with the
+    fresh correction applied."""
+    if _SUPPRESS.get():
+        return
+    from ..serving import batcher
+    if batcher.active_sweep() is not None:
+        # Sweep members share scans and a single vmapped program;
+        # aborting one member mid-batch would strand the others.
+        return
+    records = getattr(session, "_last_join_order", None) or []
+    est = None
+    is_last = True
+    for r in records:
+        steps = r.get("steps") or []
+        for i, s in enumerate(steps):
+            if s.get("key") == key:
+                est = float(s["est_rows"])
+                is_last = i == len(steps) - 1
+    if est is None or est <= 0 or is_last:
+        # No estimate to diverge from, or no downstream join stage that
+        # a corrected order could improve.
+        return
+    actual = max(float(actual_rows), 1.0)
+    q = max(actual / est, est / actual)
+    if q <= session.hs_conf.adaptive_replan_error_threshold():
+        return
+    get_store().note_replan()
+    raise ReplanRequested(key, est, actual_rows)
+
+
+def emit_replan_event(session, rr: ReplanRequested) -> None:
+    try:
+        from ..telemetry.events import ReplanEvent
+        from ..telemetry.logging import get_logger
+        get_logger(session.hs_conf.event_logger_class()).log_event(
+            ReplanEvent(
+                message=(f"mid-query re-plan: estimated "
+                         f"~{rr.est_rows:.0f} rows, observed "
+                         f"{rr.actual_rows}"),
+                key=rr.key, est_rows=round(rr.est_rows, 3),
+                actual_rows=rr.actual_rows,
+                threshold=session.hs_conf
+                .adaptive_replan_error_threshold()))
+    except Exception:
+        pass  # observability must never fail a query

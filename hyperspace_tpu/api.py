@@ -373,10 +373,33 @@ class Hyperspace:
         (``hyperspace.tpu.telemetry.slo.*``) over the sliding window of
         completed queries RIGHT NOW and return the verdict dict
         (``healthy``, per-objective observed/threshold/breached).
-        Healthy→breached transitions emit SloBreachEvent — the sensor
-        half of SLO-driven admission (ROADMAP 2c); nothing is shed yet."""
+        Healthy→breached transitions emit SloBreachEvent — and, with
+        ``hyperspace.tpu.adaptive.admission.enabled``, drive the serving
+        frontend's shed/degrade admission (adaptive/admission.py)."""
         from .telemetry.slo import health
         return health(self.session)
+
+    def adaptive_builder(self):
+        """The process-default budgeted background builder
+        (adaptive/builder.py): ``run_once()`` for one explicit
+        maintenance pass, ``start()``/``stop()`` for the self-scheduling
+        daemon loop. Passes only act inside serving idle windows and
+        only while ``hyperspace.tpu.adaptive.builder.enabled`` holds."""
+        from .adaptive.builder import get_builder
+        return get_builder(self)
+
+    def adaptive_stats(self) -> dict:
+        """One dict over the adaptive control plane: the feedback
+        correction store's counters, the admission controller's
+        breach/shed/degrade tallies, and the background builder's
+        ledger (built / retired / maintained / bytes_spent /
+        in_progress)."""
+        from .adaptive.admission import get_controller
+        from .adaptive.builder import get_ledger
+        from .adaptive.feedback import get_store
+        return {"feedback": get_store().stats(),
+                "admission": get_controller().stats(),
+                "builder": get_ledger().stats()}
 
     def dump_flight_recorder(self, path: Optional[str] = None) -> str:
         """The flight recorder's rings — recently retained traces,

@@ -13,6 +13,7 @@ from __future__ import annotations
 import os
 from typing import Any, Callable, Dict, Generic, Optional, TypeVar
 
+from .adaptive.constants import AdaptiveConstants
 from .advisor.constants import AdvisorConstants
 from .index.constants import IndexConstants
 from .optimizer.constants import OptimizerConstants
@@ -659,6 +660,83 @@ class HyperspaceConf:
         return self._get_bool(
             RobustnessConstants.DEGRADE_ENABLED,
             RobustnessConstants.DEGRADE_ENABLED_DEFAULT)
+
+    # ------------------------------------------------------------------
+    # Adaptive control plane (adaptive/constants.py): feedback-corrected
+    # planning, mid-query re-planning, background builder, SLO-driven
+    # admission.
+    # ------------------------------------------------------------------
+
+    def adaptive_enabled(self) -> bool:
+        return self._get_bool(
+            AdaptiveConstants.ENABLED, AdaptiveConstants.ENABLED_DEFAULT)
+
+    def adaptive_feedback_enabled(self) -> bool:
+        return self.adaptive_enabled() and self._get_bool(
+            AdaptiveConstants.FEEDBACK_ENABLED,
+            AdaptiveConstants.FEEDBACK_ENABLED_DEFAULT)
+
+    def adaptive_feedback_max_entries(self) -> int:
+        return max(int(self._conf.get(
+            AdaptiveConstants.FEEDBACK_MAX_ENTRIES,
+            AdaptiveConstants.FEEDBACK_MAX_ENTRIES_DEFAULT)), 1)
+
+    def adaptive_feedback_alpha(self) -> float:
+        return min(max(float(self._conf.get(
+            AdaptiveConstants.FEEDBACK_ALPHA,
+            AdaptiveConstants.FEEDBACK_ALPHA_DEFAULT)), 0.01), 1.0)
+
+    def adaptive_replan_enabled(self) -> bool:
+        return self.adaptive_enabled() and self._get_bool(
+            AdaptiveConstants.REPLAN_ENABLED,
+            AdaptiveConstants.REPLAN_ENABLED_DEFAULT)
+
+    def adaptive_replan_error_threshold(self) -> float:
+        return max(float(self._conf.get(
+            AdaptiveConstants.REPLAN_ERROR_THRESHOLD,
+            AdaptiveConstants.REPLAN_ERROR_THRESHOLD_DEFAULT)), 1.0)
+
+    def adaptive_builder_enabled(self) -> bool:
+        return self.adaptive_enabled() and self._get_bool(
+            AdaptiveConstants.BUILDER_ENABLED,
+            AdaptiveConstants.BUILDER_ENABLED_DEFAULT)
+
+    def adaptive_builder_max_bytes(self) -> int:
+        return max(int(self._conf.get(
+            AdaptiveConstants.BUILDER_MAX_BYTES,
+            AdaptiveConstants.BUILDER_MAX_BYTES_DEFAULT)), 0)
+
+    def adaptive_builder_idle_ms(self) -> float:
+        return max(float(self._conf.get(
+            AdaptiveConstants.BUILDER_IDLE_MS,
+            AdaptiveConstants.BUILDER_IDLE_MS_DEFAULT)), 0.0)
+
+    def adaptive_builder_retire_min_queries(self) -> int:
+        return max(int(self._conf.get(
+            AdaptiveConstants.BUILDER_RETIRE_MIN_QUERIES,
+            AdaptiveConstants.BUILDER_RETIRE_MIN_QUERIES_DEFAULT)), 1)
+
+    def adaptive_builder_interval_ms(self) -> float:
+        return max(float(self._conf.get(
+            AdaptiveConstants.BUILDER_INTERVAL_MS,
+            AdaptiveConstants.BUILDER_INTERVAL_MS_DEFAULT)), 10.0)
+
+    def adaptive_admission_enabled(self) -> bool:
+        return self.adaptive_enabled() and self._get_bool(
+            AdaptiveConstants.ADMISSION_ENABLED,
+            AdaptiveConstants.ADMISSION_ENABLED_DEFAULT)
+
+    def adaptive_admission_mode(self) -> str:
+        mode = (self._conf.get(
+            AdaptiveConstants.ADMISSION_MODE,
+            AdaptiveConstants.ADMISSION_MODE_DEFAULT) or "").strip().lower()
+        return mode if mode in ("shed", "degrade") else "degrade"
+
+    def adaptive_admission_sample_fraction(self) -> float:
+        return min(max(float(self._conf.get(
+            AdaptiveConstants.ADMISSION_SAMPLE_FRACTION,
+            AdaptiveConstants.ADMISSION_SAMPLE_FRACTION_DEFAULT)),
+            0.01), 1.0)
 
     def _get_bool(self, key: str, default: str) -> bool:
         return (self._conf.get(key, default) or "").strip().lower() == "true"

@@ -102,6 +102,11 @@ def _spmd_with_fault_fallback(run, session) -> Optional[Table]:
     except QueryDeadlineError:
         raise
     except Exception as e:
+        from ..adaptive.feedback import ReplanRequested
+        if isinstance(e, ReplanRequested):
+            # A re-plan request is a CONTROL transfer to
+            # Session._execute_uncaptured, never a fault to degrade.
+            raise
         if session is None or \
                 not session.hs_conf.robustness_degrade_enabled():
             raise
@@ -319,23 +324,35 @@ def _execute_node(plan: LogicalPlan, needed: Optional[Set[str]]) -> Table:
 
 def _record_join_actual(plan: Join, table: Table) -> None:
     """Observed output cardinality of executed inner joins, kept on the
-    session keyed by condition repr (LRU-bounded) so explain's "Join
-    order:" section and bench's join_reorder phase can report estimated
-    vs actual rows (q-error) for the cost-based reorderer's steps."""
+    session keyed by the composite join_actual_key (condition repr +
+    both side signatures, LRU-bounded) so explain's "Join order:"
+    section and bench's join_reorder phase can report estimated vs
+    actual rows (q-error) for the cost-based reorderer's steps — and,
+    with the adaptive loop on, so corrections never cross table pairs.
+
+    This is also the mid-query re-plan trigger (adaptive/feedback.py):
+    the staged executor owns stage boundaries, so after the write-back
+    the adaptive layer may raise ReplanRequested here when the actual
+    blew past the estimate — Session._execute_uncaptured catches it and
+    re-optimizes with the fresh correction applied."""
     if plan.join_type != "inner" or plan.condition is None:
         return
     from ..serving import context as qctx
+    key = qctx.join_actual_key(plan.condition, plan.left, plan.right)
     ctx = qctx.active_context()
     if ctx is not None:
         # Serving path: the QueryContext routes the write to its owning
         # session's locked store.
-        ctx.record_join_actual(repr(plan.condition), int(table.num_rows))
-        return
-    session = _SESSION.get()
-    if session is None:
-        return
-    qctx.record_join_actual(session, repr(plan.condition),
-                            int(table.num_rows))
+        session = ctx.session
+        ctx.record_join_actual(key, int(table.num_rows))
+    else:
+        session = _SESSION.get()
+        if session is None:
+            return
+        qctx.record_join_actual(session, key, int(table.num_rows))
+    if session.hs_conf.adaptive_replan_enabled():
+        from ..adaptive import feedback as _feedback
+        _feedback.maybe_replan(session, key, int(table.num_rows))
 
 
 def _filter_table(table: Table, condition) -> Table:

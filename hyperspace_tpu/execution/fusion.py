@@ -759,7 +759,14 @@ def try_execute(plan: LogicalPlan, needed: Optional[Set[str]]
         return None
     except QueryDeadlineError:
         raise
-    except Exception:
+    except Exception as e:
+        from ..adaptive.feedback import ReplanRequested
+        if isinstance(e, ReplanRequested):
+            # Adaptive re-plan (staged joins can execute UNDER a fused
+            # region via _execute_region's staged-bottom descent): a
+            # control transfer to Session._execute_uncaptured, never a
+            # fused-program failure to absorb.
+            raise
         # A fused trace/compile failure must never fail the query: the
         # staged path re-runs the region byte-identically, and the region
         # key is poisoned so the failure is paid once, not per query —
@@ -956,11 +963,12 @@ def _record_actuals(region: _Region, out, session) -> None:
                 or rows_key not in out:
             continue
         rows = int(out[rows_key])  # HOST SYNC (single scalar)
+        key = qctx.join_actual_key(node.condition, node.left, node.right)
         ctx = qctx.active_context()
         if ctx is not None:
-            ctx.record_join_actual(repr(node.condition), rows)
+            ctx.record_join_actual(key, rows)
         elif session is not None:
-            qctx.record_join_actual(session, repr(node.condition), rows)
+            qctx.record_join_actual(session, key, rows)
 
 
 def _finish_chain(spec: _RegionSpec, out, final_meta) -> Table:

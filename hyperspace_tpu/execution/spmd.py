@@ -1129,10 +1129,11 @@ def _record_join_actuals(session, prep: "_Prepared", out) -> None:
         if kind != "join" or key not in out:
             continue
         rows = int(np.asarray(jax.device_get(out[key])))
+        akey = qctx.join_actual_key(node.condition, node.left, node.right)
         if ctx is not None:
-            ctx.record_join_actual(repr(node.condition), rows)
+            ctx.record_join_actual(akey, rows)
         elif session is not None:
-            qctx.record_join_actual(session, repr(node.condition), rows)
+            qctx.record_join_actual(session, akey, rows)
 
 
 def _run(plan: Aggregate, executor, session=None) -> Table:

@@ -213,6 +213,50 @@ def _cap_ndv(ndv: Dict[str, Optional[float]],
 # Order enumeration.
 # ---------------------------------------------------------------------------
 
+class _Corrector:
+    """Adaptive-feedback hook (adaptive/feedback.py): scales each
+    enumeration step's estimate by the learned actual/estimate ratio of
+    its table pair, and substitutes the EMA'd observed cardinality for a
+    rebuilt join that executed before. Built only while
+    ``adaptive.feedback.enabled`` is on — absent, the cost model is
+    byte-for-byte the uncorrected one. Side signatures use the same
+    rewrite-stable leaf identities the executors key actuals by
+    (serving/context.join_actual_key), so estimate-time and
+    execution-time keys pair even though this pass runs BEFORE index
+    substitution and partition pruning."""
+
+    def __init__(self, session, items: List[LogicalPlan]):
+        from ..adaptive.feedback import get_store
+        from ..serving.context import _leaf_identity
+        self._store = get_store()
+        self._ids: List[List[str]] = []
+        for it in items:
+            try:
+                self._ids.append(
+                    [_leaf_identity(leaf) for leaf in it.collect_leaves()])
+            except Exception:
+                self._ids.append([])
+        self._sig_cache: Dict[frozenset, str] = {}
+
+    def _sig(self, idxs) -> str:
+        key = frozenset(idxs)
+        s = self._sig_cache.get(key)
+        if s is None:
+            parts: List[str] = []
+            for i in key:
+                parts.extend(self._ids[i])
+            s = "+".join(sorted(parts))
+            self._sig_cache[key] = s
+        return s
+
+    def adjust(self, joined, t: int, est: float) -> float:
+        return self._store.corrected_rows(
+            self._sig(joined), self._sig([t]), est)
+
+    def exact(self, key: str) -> Optional[float]:
+        return self._store.exact_rows(key)
+
+
 def _step(rows: float, ndv: Dict[str, Optional[float]], item: _Est,
           conds: List[Tuple[str, str]]) -> Tuple[float, Dict]:
     """One left-deep join step: current intermediate x ``item`` over the
@@ -239,7 +283,8 @@ def _edge_conds(edges, joined: frozenset, t: int) -> List[Tuple[str, str]]:
     return out
 
 
-def _enumerate_greedy(ests: List[_Est], edges) -> List[int]:
+def _enumerate_greedy(ests: List[_Est], edges,
+                      corr: Optional[_Corrector] = None) -> List[int]:
     n = len(ests)
     best_pair = None
     for i in range(n):
@@ -248,6 +293,8 @@ def _enumerate_greedy(ests: List[_Est], edges) -> List[int]:
             if not conds:
                 continue
             rows, _ = _step(ests[i].rows, ests[i].ndv, ests[j], conds)
+            if corr is not None:
+                rows = corr.adjust([i], j, rows)
             if best_pair is None or rows < best_pair[0]:
                 best_pair = (rows, i, j)
     if best_pair is None:
@@ -257,6 +304,8 @@ def _enumerate_greedy(ests: List[_Est], edges) -> List[int]:
     joined = frozenset(order)
     rows, ndv = _step(ests[i].rows, ests[i].ndv, ests[j],
                       _edge_conds(edges, frozenset([i]), j))
+    if corr is not None:
+        rows = corr.adjust([i], j, rows)
     while len(order) < n:
         best = None
         for t in range(n):
@@ -266,6 +315,8 @@ def _enumerate_greedy(ests: List[_Est], edges) -> List[int]:
             if not conds:
                 continue
             out, nd = _step(rows, ndv, ests[t], conds)
+            if corr is not None:
+                out = corr.adjust(joined, t, out)
             if best is None or out < best[0]:
                 best = (out, t, nd)
         if best is None:
@@ -278,7 +329,8 @@ def _enumerate_greedy(ests: List[_Est], edges) -> List[int]:
     return order
 
 
-def _enumerate_dp(ests: List[_Est], edges) -> List[int]:
+def _enumerate_dp(ests: List[_Est], edges,
+                  corr: Optional[_Corrector] = None) -> List[int]:
     """Exhaustive left-deep search over connected subsets (Selinger-style
     DP): state per subset keeps the cheapest cumulative intermediate-row
     total. Falls back to greedy on any gap (disconnected subsets)."""
@@ -293,6 +345,8 @@ def _enumerate_dp(ests: List[_Est], edges) -> List[int]:
             if not conds:
                 continue
             rows, ndv = _step(ests[i].rows, ests[i].ndv, ests[j], conds)
+            if corr is not None:
+                rows = corr.adjust([i], j, rows)
             key = frozenset((i, j))
             if key not in states or rows < states[key][0]:
                 states[key] = (rows, rows, ndv, [i, j])
@@ -308,6 +362,8 @@ def _enumerate_dp(ests: List[_Est], edges) -> List[int]:
                 if not conds:
                     continue
                 out, nd = _step(rows, ndv, ests[t], conds)
+                if corr is not None:
+                    out = corr.adjust(subset, t, out)
                 key = subset | {t}
                 cand = (cost + out, out, nd, order + [t])
                 prev = additions.get(key) or states.get(key)
@@ -316,7 +372,7 @@ def _enumerate_dp(ests: List[_Est], edges) -> List[int]:
         states.update(additions)
     full = states.get(frozenset(range(n)))
     if full is None:
-        return _enumerate_greedy(ests, edges)
+        return _enumerate_greedy(ests, edges, corr)
     return full[3]
 
 
@@ -424,11 +480,13 @@ def _reorder_chain(session, node: Join, items: List[LogicalPlan],
         {"label": labels[i], "est_rows": ests[i].rows}
         for i in range(len(items))]
 
+    corr = _Corrector(session, items) \
+        if session.hs_conf.adaptive_feedback_enabled() else None
     threshold = session.hs_conf.join_reorder_dp_threshold()
     if len(items) <= threshold:
-        order = _enumerate_dp(ests, edges)
+        order = _enumerate_dp(ests, edges, corr)
     else:
-        order = _enumerate_greedy(ests, edges)
+        order = _enumerate_greedy(ests, edges, corr)
     if order == list(range(len(items))):
         record["note"] = "original order already cheapest"
         return _rebuild_same(node, mapping)
@@ -437,6 +495,11 @@ def _reorder_chain(session, node: Join, items: List[LogicalPlan],
     # original equality conjunct both of whose sides are now present.
     # Any constructor rejection (e.g. an ambiguity an interposed pruning
     # Project used to resolve) falls back to the original order.
+    # Step keys are the composite join_actual_key strings — the very
+    # keys the executors will record actuals under for the Join nodes
+    # built here, so explain/bench q-error pairing (and the adaptive
+    # feedback/replan loops) never cross table pairs.
+    from ..serving.context import join_actual_key
     joined = frozenset([order[0]])
     cur = items[order[0]]
     rows, ndv = ests[order[0]].rows, ests[order[0]].ndv
@@ -452,9 +515,15 @@ def _reorder_chain(session, node: Join, items: List[LogicalPlan],
             rows, ndv = _step(rows, ndv, ests[t],
                               _edge_conds(edges, joined, t))
             condition = E.conjoin(conds)
+            key = join_actual_key(condition, cur, items[t])
+            if corr is not None:
+                rows = corr.adjust(joined, t, rows)
+                exact = corr.exact(key)
+                if exact is not None:
+                    rows = exact
             cur = Join(cur, items[t], condition, "inner",
                        reorder_note=f"reordered, est~{rows:.0f} rows")
-            steps.append({"right": labels[t], "key": repr(condition),
+            steps.append({"right": labels[t], "key": key,
                           "est_rows": rows})
             joined = joined | {t}
 

@@ -113,6 +113,7 @@ def explain_string(session, plan: LogicalPlan, verbose: bool = False,
         _write_spmd_section(buf, session)
         _write_serving_section(buf, session)
         _write_robustness_section(buf, session)
+        _write_slo_section(buf, session)
         _write_trace_section(buf, session)
     _write_advisor_section(buf, session, with_index)
     _write_join_order_section(buf, session)
@@ -337,6 +338,41 @@ def _write_robustness_section(buf: BufferStream, session) -> None:
         f"spill_corrupt={s['spill_corruptions']} "
         f"sweep_member={s['member_fallbacks']} "
         f"worker_release={s['worker_releases']}")
+
+
+def _write_slo_section(buf: BufferStream, session) -> None:
+    """``Hyperspace.health()`` in the explain report: the current SLO
+    verdict per armed objective plus the adaptive admission controller's
+    stance when it is enabled. Rendered only once the monitor's window
+    holds completed-query traffic, so explain goldens of sessions that
+    never executed anything are untouched."""
+    from ..telemetry.slo import get_monitor
+    verdict = get_monitor().evaluate(session, emit=False)
+    if not verdict.get("count"):
+        return
+    buf.write_line()
+    _header(buf, "SLO:")
+    buf.write_line(
+        f"{'healthy' if verdict['healthy'] else 'BREACHED'} over "
+        f"{verdict['window_s']:g}s window ({verdict['count']} queries, "
+        f"{verdict['errors']} errors, {verdict['degraded']} degraded)")
+    for name, obj in verdict["objectives"].items():
+        if not obj["armed"]:
+            continue
+        observed = obj["observed"]
+        buf.write_line(
+            f"{name}: observed "
+            f"{'n/a' if observed is None else f'{observed:.4g}'} "
+            f"objective {obj['threshold']:g}"
+            + (" BREACHED" if obj["breached"] else ""))
+    if session.hs_conf.adaptive_admission_enabled():
+        from ..adaptive.admission import get_controller
+        s = get_controller().stats()
+        buf.write_line(
+            f"admission ({session.hs_conf.adaptive_admission_mode()}): "
+            f"{'overloaded' if s['overloaded'] else 'admitting'} "
+            f"breaches={s['breaches']} recoveries={s['recoveries']} "
+            f"sheds={s['sheds']} degrades={s['degrades']}")
 
 
 def _write_trace_section(buf: BufferStream, session) -> None:

@@ -166,11 +166,64 @@ class QueryContext:
 _JOIN_ACTUALS_MAX = 256
 
 
+def _leaf_identity(leaf) -> str:
+    """A leaf identity STABLE across the optimizer's own rewrites: the
+    join reorderer records estimate keys BEFORE index substitution and
+    partition pruning, the executors record actuals AFTER, and the two
+    must pair. A Scan's ``partition_base_path`` survives ``with_files``
+    (pruning replaces root_paths with the kept file list but copies the
+    partition base); an IndexScan's log-entry source rootPaths are the
+    original Scan's directories, abspath'd at create time. Both reduce
+    a rewritten leaf to the source directory the pre-rewrite Scan
+    carried."""
+    rel = getattr(leaf, "relation", None)
+    if rel is not None:  # Scan
+        base = getattr(rel, "partition_base_path", None)
+        if base:
+            return str(base)
+        paths = getattr(rel, "root_paths", None) or []
+        return str(paths[0]) if paths else leaf.node_name
+    entry = getattr(leaf, "index_entry", None)
+    if entry is not None:  # IndexScan
+        try:
+            paths = entry.relations[0].rootPaths
+            if paths:
+                return str(paths[0])
+        except Exception:
+            pass
+        return f"index:{getattr(entry, 'name', '?')}"
+    return leaf.node_name
+
+
+def join_side_signature(plan) -> str:
+    """Order-insensitive signature of one join input: the sorted,
+    rewrite-stable identities of its scan leaves."""
+    try:
+        leaves = plan.collect_leaves()
+    except Exception:
+        return getattr(plan, "node_name", "?")
+    return "+".join(sorted(_leaf_identity(leaf) for leaf in leaves))
+
+
+def join_actual_key(condition, left, right) -> str:
+    """THE estimate/actual pairing key for one executed inner join:
+    condition repr qualified by both input-side signatures, so two
+    table pairs sharing a condition TEXT (``a.k = b.k`` joined from
+    different sources) never collide in the bounded actuals store or in
+    the adaptive correction store. Written identically by the join
+    reorderer (estimates) and the staged/fused/SPMD executors
+    (actuals)."""
+    return (f"{condition!r} @ {join_side_signature(left)} >< "
+            f"{join_side_signature(right)}")
+
+
 def record_join_actual(session, condition_repr: str, rows: int) -> None:
     """Locked LRU write-back of an executed inner join's observed output
     rows onto the owning session (the ONE copy of the bound/eviction
     policy — shared by the serving QueryContext and the executor's
-    contextless fallback)."""
+    contextless fallback). Keys are the composite
+    :func:`join_actual_key` strings. When the adaptive feedback loop is
+    on, the observation also feeds the process-wide correction store."""
     actuals = getattr(session, "_join_actuals", None)
     lock = getattr(session, "_join_actuals_lock", None)
     if actuals is None or lock is None:
@@ -180,6 +233,13 @@ def record_join_actual(session, condition_repr: str, rows: int) -> None:
         actuals.move_to_end(condition_repr)
         while len(actuals) > _JOIN_ACTUALS_MAX:
             actuals.popitem(last=False)
+    try:
+        if session.hs_conf.adaptive_feedback_enabled():
+            from ..adaptive import feedback as _feedback
+            _feedback.get_store().observe(session, condition_repr,
+                                          int(rows))
+    except Exception:
+        pass  # feedback accounting must never fail a query
 
 
 def next_query_id() -> int:

@@ -121,10 +121,10 @@ class PendingQuery:
 
 class _Entry:
     __slots__ = ("plan", "norm", "session", "ctx", "pending", "batch_key",
-                 "deadline_s")
+                 "deadline_s", "approx")
 
     def __init__(self, plan, norm, session, ctx, pending, batch_key,
-                 deadline_s=None):
+                 deadline_s=None, approx=False):
         self.plan = plan
         self.norm = norm
         self.session = session
@@ -132,6 +132,7 @@ class _Entry:
         self.pending = pending
         self.batch_key = batch_key    # None = never batchable
         self.deadline_s = deadline_s  # absolute perf_counter, or None
+        self.approx = approx          # SLO degrade: try approximate tier
 
 
 class ServingFrontend:
@@ -222,6 +223,28 @@ class ServingFrontend:
         est = estimate_recompute_bytes(norm)
         batch_key = batcher.template_key(session, norm) \
             if self._hs_conf.serving_batching_enabled() else None
+        # SLO-driven admission (adaptive/admission.py): while an armed
+        # objective is breached, new submissions shed (typed rejection,
+        # same contract as queue-depth sheds) or degrade (the worker
+        # tries the sampled approximate tier; ineligible plans run
+        # exact). Recovery is automatic on the first healthy verdict.
+        approx = False
+        if session.hs_conf.adaptive_admission_enabled():
+            from ..adaptive.admission import get_controller
+            verdict = get_controller().decide(session)
+            if verdict == "shed":
+                with self._lock:
+                    self._stats["submitted"] += 1
+                    self._stats["rejected"] += 1
+                reason = "slo breach: shedding load"
+                self._emit_reject(session, client, est, reason)
+                raise ServingRejectedError(
+                    f"serving admission rejected query: {reason}")
+            if verdict == "degrade":
+                # Approximate members must never join a literal sweep
+                # (the sweep shares exact scans across members).
+                approx = True
+                batch_key = None
         from .context import next_query_id
         pending = PendingQuery(query_id=next_query_id(), client=client,
                                estimated_bytes=est)
@@ -245,7 +268,7 @@ class ServingFrontend:
             self._stats["admitted"] += 1
             entry = _Entry(plan, norm, session,
                            contextvars.copy_context(), pending, batch_key,
-                           deadline_s=deadline_s)
+                           deadline_s=deadline_s, approx=approx)
             self._queue.append(entry)
             self._inflight_bytes += est
             spawn = self._active_workers < \
@@ -559,6 +582,21 @@ class ServingFrontend:
         qc.slo_suppress_error = sweep is not None and \
             entry.session.hs_conf.robustness_degrade_enabled()
         entry.pending.context = qc
+        if entry.approx and sweep is None:
+            # SLO degrade tier: run the sampled rewrite when the plan is
+            # eligible; the result carries its stated error bound and
+            # counts as degraded for the SLO degrade-rate objective.
+            from ..adaptive.admission import approximate_plan
+            hit = approximate_plan(entry.session, entry.plan)
+            if hit is not None:
+                approx_plan, bound = hit
+                qc.degraded = True
+                result = entry.session.execute(approx_plan, context=qc)
+                try:
+                    result.approx_error_bound = dict(bound)
+                except Exception:
+                    pass
+                return result
         with batcher.use_sweep(sweep, member):
             return entry.session.execute(entry.plan, context=qc)
 

@@ -85,7 +85,8 @@ class Session:
         # use), the chain records of the most recent join-reorder pass
         # (explain's "Join order:" section + bench's q-error read them),
         # and the observed output rows of recently executed inner joins
-        # (executor-recorded; keyed by condition repr, LRU-bounded).
+        # (executor-recorded; keyed by the composite join_actual_key —
+        # condition repr + both side signatures — LRU-bounded).
         self._stats_provider = None
         self._last_join_order: Optional[list] = None
         self._join_actuals: "OrderedDict[str, int]" = OrderedDict()
@@ -326,6 +327,24 @@ class Session:
                         error=error, degraded=ctx.degraded)
 
     def _execute_uncaptured(self, plan: LogicalPlan, ctx=None):
+        if not self.hs_conf.adaptive_replan_enabled():
+            return self._execute_once(plan, ctx)
+        # Mid-query re-planning (adaptive/feedback.py): the staged
+        # executor raises ReplanRequested at a join stage boundary whose
+        # observed actual blew past its estimate. The observation
+        # already landed in the correction store, so the re-optimize
+        # pass below plans with the measured cardinality; the suppress
+        # guard makes the retry run to completion (one re-plan per
+        # query).
+        from .adaptive import feedback as _feedback
+        try:
+            return self._execute_once(plan, ctx)
+        except _feedback.ReplanRequested as rr:
+            _feedback.emit_replan_event(self, rr)
+            with _feedback.suppress_replans():
+                return self._execute_once(plan, ctx)
+
+    def _execute_once(self, plan: LogicalPlan, ctx=None):
         cache = ctx.result_cache if ctx is not None else self.result_cache
         if cache is not None:
             # Serving path: probe the result cache first — a hit skips

@@ -472,3 +472,30 @@ class IndexCacheHitEvent(IndexCacheProbeEvent):
 @dataclass
 class IndexCacheMissEvent(IndexCacheProbeEvent):
     pass
+
+
+@dataclass
+class ReplanEvent(HyperspaceEvent):
+    """Emitted per mid-query re-plan (adaptive/feedback.py): a staged
+    join boundary observed ``actual_rows`` against the reorderer's
+    ``est_rows`` for the composite join key, past the configured
+    ``adaptive.replan.errorThreshold`` — the query re-optimized with
+    the fresh correction and re-executed (one re-plan per query)."""
+
+    key: str = ""
+    est_rows: float = 0.0
+    actual_rows: int = 0
+    threshold: float = 0.0
+
+
+@dataclass
+class AdaptiveActionEvent(HyperspaceEvent):
+    """One autonomous control-plane decision (adaptive/): ``action`` is
+    the namespaced verb — ``builder.build`` / ``builder.retire`` /
+    ``builder.maintain`` from the background builder,
+    ``admission.engage`` / ``admission.recover`` from SLO-driven
+    admission — ``subject`` the index/table/mode acted on."""
+
+    action: str = ""
+    subject: str = ""
+    detail: str = ""

@@ -15,9 +15,10 @@ Breaches are EDGE-TRIGGERED per objective: the healthy→breached
 transition emits one :class:`~.events.SloBreachEvent`, bumps the
 ``slo.breaches`` counter, and lands a flight-recorder anomaly; the
 recovery transition re-arms silently. ``Hyperspace.health()`` returns
-the verdict dict. Deliberately NOT wired to admission control — the
-actuator half (shed/defer/AQP-degrade, arxiv 1805.05874) is item 2c's
-next move and will consume exactly these signals.
+the verdict dict. The actuator half (shed/defer/AQP-degrade, arxiv
+1805.05874) lives in adaptive/admission.py: with
+``hyperspace.tpu.adaptive.admission.enabled`` the serving frontend
+consumes exactly these verdicts at submit time.
 
 The monitor also owns the cached live-p99 the trace sampler's adaptive
 tail-keep threshold reads (:func:`adaptive_slow_threshold_ms`).

@@ -122,6 +122,24 @@ LOCK_CLASSES = {
         "why": "sliding SLO window fed per completed query from "
                "serving workers; breach edge state must not tear",
     },
+    ("hyperspace_tpu/adaptive/feedback.py", "CorrectionStore"): {
+        "locks": {"_lock": None},
+        "delegates": frozenset(),
+        "why": "process-wide cardinality correction store; executors "
+               "observe() from serving workers while reorders read",
+    },
+    ("hyperspace_tpu/adaptive/admission.py", "AdmissionController"): {
+        "locks": {"_lock": None},
+        "delegates": frozenset(),
+        "why": "process-wide overload verdict + tallies; submits race "
+               "from client threads against the rate-limited refresh",
+    },
+    ("hyperspace_tpu/adaptive/builder.py", "BuilderLedger"): {
+        "locks": {"_lock": None},
+        "delegates": frozenset(),
+        "why": "builder accounting shared by the daemon loop, explicit "
+               "run_once callers, and stats readers",
+    },
     ("hyperspace_tpu/index/log_manager.py", "LogLookupCache"): {
         "locks": {"_lock": None},
         "delegates": frozenset(),

@@ -1,0 +1,544 @@
+"""adaptive/: the self-driving control plane (feedback-corrected
+planning, mid-query re-planning, the budgeted background builder, and
+SLO-driven admission).
+
+The closed-loop acceptance evidence lives here:
+
+  1. q-error over a replayed workload SHRINKS with feedback on (second
+     half of the replay beats the first half) and stays flat with the
+     master switch off;
+  2. a seeded mis-estimate triggers ONE mid-query re-plan
+     (ReplanEvent) and the answer is identical to the non-adaptive
+     plan's;
+  3. the builder materializes the advisor's top recommendation in an
+     idle window, a later query actually uses it (usageCount > 0), and
+     a never-used index is retired after the observation window;
+  4. an armed-and-breached SLO sheds or degrades at submit — the
+     degraded answer carries its stated error bound — and the first
+     healthy verdict recovers to exact answers;
+  5. ``adaptive.enabled=false`` (the default) is inert end to end.
+
+Plus the satellite regression: join actuals are keyed on (condition
+repr, left/right relation signatures), so the same condition text over
+two different table pairs no longer collides in the correction store.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+import hyperspace_tpu as hst
+from hyperspace_tpu.adaptive import feedback
+from hyperspace_tpu.adaptive.admission import get_controller
+from hyperspace_tpu.adaptive.builder import AdaptiveBuilder, BuilderLedger
+from hyperspace_tpu.adaptive.constants import AdaptiveConstants
+from hyperspace_tpu.adaptive.feedback import get_store
+from hyperspace_tpu.advisor.constants import AdvisorConstants
+from hyperspace_tpu.api import Hyperspace, IndexConfig
+from hyperspace_tpu.exceptions import ServingRejectedError
+from hyperspace_tpu.index.constants import IndexConstants
+from hyperspace_tpu.optimizer.constants import OptimizerConstants
+from hyperspace_tpu.plan.expr import col, count, sum_
+from hyperspace_tpu.serving.frontend import ServingFrontend
+from hyperspace_tpu.telemetry.constants import TelemetryConstants
+from hyperspace_tpu.telemetry.events import (AdaptiveActionEvent,
+                                             ReplanEvent)
+
+from conftest import capture_logger as sink  # noqa: E402
+
+
+def _session(tmp_path, **conf):
+    session = hst.Session(system_path=str(tmp_path / "indexes"))
+    session.conf.set(IndexConstants.INDEX_NUM_BUCKETS, 4)
+    for k, v in conf.items():
+        session.conf.set(k, v)
+    return session
+
+
+def _write(dirpath, table, parts=1):
+    os.makedirs(dirpath, exist_ok=True)
+    n = table.num_rows // parts
+    for i in range(parts):
+        length = n if i < parts - 1 else table.num_rows - i * n
+        pq.write_table(table.slice(i * n, length),
+                       os.path.join(dirpath, f"p{i}.parquet"))
+    return str(dirpath)
+
+
+def _sorted_rows(df):
+    out = df.to_pandas()
+    return out.sort_values(list(out.columns)).reset_index(drop=True)
+
+
+ADAPTIVE_ON = {AdaptiveConstants.ENABLED: "true",
+               OptimizerConstants.JOIN_REORDER_ENABLED: "true"}
+
+
+# ---------------------------------------------------------------------------
+# A star schema with a planner-hostile skew: ~95% of fact rows hit ONE
+# dim1 key, and the selective dim1 category selects exactly that key.
+# The uniform-NDV estimate for (fact x dim1-filtered) lands near 400
+# rows while the actual is ~3800 — a q-error of ~9.5, past the default
+# re-plan threshold of 8.
+# ---------------------------------------------------------------------------
+
+@pytest.fixture()
+def skew_star(tmp_path):
+    rng = np.random.default_rng(11)
+    n_f, n_d1, n_d2 = 4000, 50, 20
+    f_d1 = np.zeros(n_f, dtype=np.int64)
+    f_d1[:200] = np.arange(200) % (n_d1 - 1) + 1  # stragglers span 1..49
+    rng.shuffle(f_d1)
+    fact = pa.table({
+        "f_d1": pa.array(f_d1),
+        "f_d2": pa.array(rng.integers(0, n_d2, n_f).astype(np.int64)),
+        "f_val": pa.array(np.round(rng.uniform(0, 100, n_f), 3)),
+    })
+    d1_cat = np.array([f"c{i % 9}" for i in range(n_d1)], dtype=object)
+    d1_cat[0] = "b"  # the selective category IS the skewed key
+    dim1 = pa.table({
+        "d1_key": pa.array(np.arange(n_d1, dtype=np.int64)),
+        "d1_cat": pa.array(d1_cat),
+    })
+    dim2 = pa.table({
+        "d2_key": pa.array(np.arange(n_d2, dtype=np.int64)),
+        "d2_cat": pa.array(rng.choice(["x", "y"], n_d2)),
+    })
+    return {
+        "fact": _write(tmp_path / "fact", fact),
+        "dim1": _write(tmp_path / "dim1", dim1),
+        "dim2": _write(tmp_path / "dim2", dim2),
+    }
+
+
+def _three_way(session, paths):
+    fact = session.read.parquet(paths["fact"])
+    d1 = session.read.parquet(paths["dim1"]).filter(col("d1_cat") == "b")
+    d2 = session.read.parquet(paths["dim2"])
+    return (fact.join(d2, on=col("f_d2") == col("d2_key"))
+            .join(d1, on=col("f_d1") == col("d1_key"))
+            .select("d1_cat", "d2_cat", "f_val"))
+
+
+def _run_q_error(session, paths):
+    """Execute the 3-way once; return the worst per-step q-error of the
+    reordered chain. A run where the optimizer kept the text order
+    (no reorder steps) counts as converged (1.0)."""
+    _three_way(session, paths).to_arrow()
+    steps = [s for r in (session._last_join_order or [])
+             for s in r["steps"]]
+    qs = []
+    for s in steps:
+        actual = session._join_actuals.get(s["key"])
+        if actual is None:
+            continue
+        est = max(float(s["est_rows"]), 1.0)
+        act = max(float(actual), 1.0)
+        qs.append(max(est / act, act / est))
+    return max(qs) if qs else 1.0
+
+
+# ---------------------------------------------------------------------------
+# Satellite regression: composite join-actual keys.
+# ---------------------------------------------------------------------------
+
+class TestJoinActualKeying:
+    def test_same_condition_text_two_table_pairs_no_collision(
+            self, tmp_path):
+        """col("k") == col("k2") has ONE repr; over two different table
+        pairs the recorded actuals must land under TWO keys (the old
+        bare-condition keying folded them into one entry and poisoned
+        the correction store across pairs)."""
+        a1 = _write(tmp_path / "a1", pa.table(
+            {"k": pa.array([0, 0, 0, 1, 2], type=pa.int64())}))
+        a2 = _write(tmp_path / "a2", pa.table(
+            {"k2": pa.array([0], type=pa.int64())}))
+        b1 = _write(tmp_path / "b1", pa.table(
+            {"k": pa.array([5, 5, 5, 5, 6, 7], type=pa.int64())}))
+        b2 = _write(tmp_path / "b2", pa.table(
+            {"k2": pa.array([5, 5], type=pa.int64())}))
+        session = _session(tmp_path)
+        session.read.parquet(a1).join(
+            session.read.parquet(a2),
+            on=col("k") == col("k2")).to_arrow()
+        session.read.parquet(b1).join(
+            session.read.parquet(b2),
+            on=col("k") == col("k2")).to_arrow()
+
+        parsed = {}
+        for key, rows in session._join_actuals.items():
+            hit = feedback.parse_key(key)
+            assert hit is not None, key
+            cond, lsig, rsig = hit
+            parsed.setdefault(cond, []).append((lsig, rsig, rows))
+        cond = repr(col("k") == col("k2"))
+        entries = parsed[cond]
+        assert len(entries) == 2, entries
+        assert entries[0][:2] != entries[1][:2]  # distinct side sigs
+        assert sorted(e[2] for e in entries) == [3, 8]
+
+
+# ---------------------------------------------------------------------------
+# Acceptance 1: q-error shrinks over a replayed workload.
+# ---------------------------------------------------------------------------
+
+class TestFeedbackQError:
+    RUNS = 8
+
+    def test_qerror_second_half_beats_first_half(self, tmp_path,
+                                                 skew_star):
+        session = _session(tmp_path, **ADAPTIVE_ON)
+        # Isolate the feedback loop: re-planning would fix run 1
+        # mid-flight and contaminate the halves comparison.
+        session.conf.set(AdaptiveConstants.REPLAN_ENABLED, "false")
+        get_store().clear()
+        qs = [_run_q_error(session, skew_star) for _ in range(self.RUNS)]
+        first = sum(qs[:self.RUNS // 2]) / (self.RUNS // 2)
+        second = sum(qs[self.RUNS // 2:]) / (self.RUNS // 2)
+        assert first > 2.0, qs      # the seeded skew actually mis-estimated
+        assert second < first * 0.5, qs
+        assert second < 2.0, qs     # converged, not merely improved
+        stats = get_store().stats()
+        assert stats["observed"] > 0
+        assert stats["paired"] > 0
+
+    def test_qerror_flat_with_adaptive_off(self, tmp_path, skew_star):
+        session = _session(
+            tmp_path, **{OptimizerConstants.JOIN_REORDER_ENABLED: "true"})
+        get_store().clear()
+        qs = [_run_q_error(session, skew_star) for _ in range(self.RUNS)]
+        assert max(qs) - min(qs) < 1e-9, qs  # nothing learned, by design
+        assert qs[0] > 2.0, qs               # same mis-estimate every run
+        assert get_store().stats()["observed"] == 0
+
+    def test_feedback_changes_no_answers(self, tmp_path, skew_star):
+        baseline = _sorted_rows(_three_way(
+            _session(tmp_path), skew_star))
+        session = _session(tmp_path, **ADAPTIVE_ON)
+        get_store().clear()
+        for _ in range(3):
+            out = _sorted_rows(_three_way(session, skew_star))
+            assert out.equals(baseline)
+
+
+# ---------------------------------------------------------------------------
+# Acceptance 2: mid-query re-planning.
+# ---------------------------------------------------------------------------
+
+class TestReplan:
+    def _wired(self, tmp_path):
+        session = _session(tmp_path, **ADAPTIVE_ON)
+        # Pin the staged executor: it owns the stage boundaries where
+        # ReplanRequested can fire (fused regions record actuals only
+        # after the whole region ran).
+        session.conf.set(IndexConstants.TPU_FUSION_ENABLED, "false")
+        session.conf.set(IndexConstants.EVENT_LOGGER_CLASS,
+                         "tests.conftest.CaptureLogger")
+        sink().events.clear()
+        return session
+
+    def test_misestimate_triggers_one_replan_same_answer(
+            self, tmp_path, skew_star):
+        baseline = _sorted_rows(_three_way(
+            _session(tmp_path), skew_star))
+        session = self._wired(tmp_path)
+        get_store().clear()
+
+        out = _sorted_rows(_three_way(session, skew_star))
+        assert out.equals(baseline)  # byte-identical despite the abort
+        assert get_store().stats()["replans"] == 1
+        replans = [e for e in sink().events
+                   if isinstance(e, ReplanEvent)]
+        assert len(replans) == 1
+        ev = replans[0]
+        assert ev.threshold == pytest.approx(8.0)
+        assert ev.actual_rows > ev.est_rows * 8
+        assert " @ " in ev.key and " >< " in ev.key  # composite key
+
+        # The retry ran under suppress_replans and the store now holds
+        # the correction: the NEXT run must not re-plan again.
+        out = _sorted_rows(_three_way(session, skew_star))
+        assert out.equals(baseline)
+        assert get_store().stats()["replans"] == 1
+
+    def test_replan_disabled_no_trigger(self, tmp_path, skew_star):
+        session = self._wired(tmp_path)
+        session.conf.set(AdaptiveConstants.REPLAN_ENABLED, "false")
+        get_store().clear()
+        _three_way(session, skew_star).to_arrow()
+        assert get_store().stats()["replans"] == 0
+        assert not [e for e in sink().events
+                    if isinstance(e, ReplanEvent)]
+
+
+# ---------------------------------------------------------------------------
+# Acceptance 3: the budgeted background builder.
+# ---------------------------------------------------------------------------
+
+@pytest.fixture()
+def served(tmp_path):
+    """The advisor-test shape: a 2-part fact (MinMax-prunable), a dim,
+    a captured workload the advisor can rank, and an armed adaptive
+    session with a capture sink."""
+    rng = np.random.default_rng(3)
+    ks = np.sort(rng.integers(0, 100, 4000)).astype(np.int64)
+    fact = pa.table({
+        "k": pa.array(ks),
+        "v": pa.array(rng.integers(0, 9, 4000).astype(np.int64)),
+        "w": pa.array(np.round(rng.uniform(0, 1, 4000), 3)),
+        "pad": pa.array(rng.integers(0, 5, 4000).astype(np.int64)),
+    })
+    dim = pa.table({
+        "dk": pa.array(np.arange(100, dtype=np.int64)),
+        "dv": pa.array(rng.integers(0, 5, 100).astype(np.int64)),
+    })
+    session = _session(tmp_path, **ADAPTIVE_ON)
+    session.conf.set(IndexConstants.EVENT_LOGGER_CLASS,
+                     "tests.conftest.CaptureLogger")
+    session.enable_hyperspace()
+    sink().events.clear()
+    env = dict(session=session, hs=Hyperspace(session),
+               fact=_write(tmp_path / "fact", fact, parts=2),
+               dim=_write(tmp_path / "dim", dim))
+
+    session.conf.set(AdvisorConstants.CAPTURE_ENABLED, "true")
+    fdf = session.read.parquet(env["fact"])
+    env["q_filter"] = fdf.filter(col("k") > 50).select("k", "v")
+    env["q_filter"].to_arrow()
+    session.conf.set(AdvisorConstants.CAPTURE_ENABLED, "false")
+    return env
+
+
+class TestBuilder:
+    def test_builds_top_recommendation_and_query_uses_it(self, served):
+        session, hs = served["session"], served["hs"]
+        ledger = BuilderLedger()
+        builder = AdaptiveBuilder(hs, ledger=ledger)
+
+        out = builder.run_once(force=True)
+        assert out["ran"]
+        assert out["built"], out
+        listed = set(hs.indexes()["name"])
+        assert set(out["built"]) <= listed
+        assert ledger.stats()["bytes_spent"] > 0
+        assert ledger.stats()["in_progress"] == []
+        builds = [e for e in sink().events
+                  if isinstance(e, AdaptiveActionEvent)
+                  and e.action == "builder.build"]
+        assert {e.subject for e in builds} == set(out["built"])
+
+        # The closed loop: a workload query now rides the built index.
+        served["q_filter"].to_arrow()
+        usage = sum(session._index_usage_counts.get(n, 0)
+                    for n in out["built"])
+        assert usage > 0
+
+        # A later pass moves DOWN the ranking (or builds nothing) —
+        # it never re-builds what already exists.
+        again = builder.run_once(force=True)
+        assert not set(again["built"]) & set(out["built"])
+
+    def test_budget_retire_and_gating(self, served):
+        session, hs = served["session"], served["hs"]
+        ledger = BuilderLedger()
+        builder = AdaptiveBuilder(hs, ledger=ledger)
+        first = builder.run_once(force=True)
+        assert first["built"]
+        served["q_filter"].to_arrow()  # mark the built index used
+
+        # Budget: bytes already spent >= maxBytes stops further builds.
+        session.conf.set(AdaptiveConstants.BUILDER_MAX_BYTES, "1")
+        # A never-used index enters the retirement observation window.
+        hs.create_index(session.read.parquet(served["dim"]),
+                        IndexConfig("cold_dim", ["dk"], ["dv"]))
+        session.conf.set(AdaptiveConstants.BUILDER_RETIRE_MIN_QUERIES,
+                         "1")
+        pass_a = builder.run_once(force=True)
+        assert pass_a["built"] == []          # budget exhausted
+        assert "cold_dim" not in pass_a["retired"]  # clock just started
+
+        served["q_filter"].to_arrow()  # >=1 completed query since seen
+        pass_b = builder.run_once(force=True)
+        assert "cold_dim" in pass_b["retired"]
+        listed = hs.indexes()
+        by_name = dict(zip(listed["name"], listed["state"]))
+        assert by_name["cold_dim"] == "DELETED"       # soft delete
+        assert by_name[first["built"][0]] == "ACTIVE"  # survivor
+        retires = [e for e in sink().events
+                   if isinstance(e, AdaptiveActionEvent)
+                   and e.action == "builder.retire"]
+        assert [e.subject for e in retires] == ["cold_dim"]
+
+        # Idle-window gating: fresh activity restarts the clock.
+        session.conf.set(AdaptiveConstants.BUILDER_IDLE_MS, "60000")
+        ledger.note_activity()
+        warming = builder.run_once(force=False)
+        assert not warming["ran"]
+        assert warming["reason"] == "idle window still warming"
+
+        # Busy serving pool: never share the machine with a build.
+        builder._serving_busy = lambda: True
+        busy = builder.run_once(force=True)
+        assert not busy["ran"]
+        assert busy["reason"] == "serving busy"
+        del builder._serving_busy
+
+        session.conf.set(AdaptiveConstants.BUILDER_ENABLED, "false")
+        assert builder.run_once(force=True)["reason"] == "disabled"
+
+
+# ---------------------------------------------------------------------------
+# Acceptance 4: SLO-driven admission (shed / degrade / recover).
+# ---------------------------------------------------------------------------
+
+class TestAdmission:
+    @pytest.fixture()
+    def overload(self, tmp_path):
+        """A 4-part table (approx-eligible), an armed p99 objective no
+        query can meet, and a clean controller."""
+        rng = np.random.default_rng(5)
+        v = rng.integers(0, 1000, 4000).astype(np.int64)
+        table = pa.table({
+            "k": pa.array(np.arange(4000, dtype=np.int64)),
+            "v": pa.array(v),
+        })
+        path = _write(tmp_path / "wide", table, parts=4)
+        session = _session(tmp_path, **ADAPTIVE_ON)
+        session.conf.set(IndexConstants.EVENT_LOGGER_CLASS,
+                         "tests.conftest.CaptureLogger")
+        session.conf.set(TelemetryConstants.SLO_P99_MS, "0.001")
+        session.conf.set(TelemetryConstants.SLO_MIN_COUNT, "1")
+        sink().events.clear()
+        controller = get_controller()
+        controller.reset()
+        # Guarantee the monitor window holds at least one sample.
+        session.read.parquet(path).filter(col("k") < 10).to_arrow()
+        yield dict(session=session, path=path, v=v,
+                   controller=controller)
+        controller.reset()
+
+    def test_degrade_to_approximate_with_stated_bound(self, overload):
+        session, path = overload["session"], overload["path"]
+        df = session.read.parquet(path)
+        agg = df.agg(sum_(col("v")).alias("sv"), count().alias("n"))
+        fe = ServingFrontend(session)
+
+        table = fe.submit(agg).result(timeout=300)
+        bound = getattr(table, "approx_error_bound", None)
+        assert bound is not None, "breached SLO did not degrade"
+        assert bound["kind"] == "relative"
+        assert bound["confidence"] == 0.95
+        assert 0.0 < bound["sample_fraction"] < 1.0
+        assert 0.0 <= bound["bound"] <= 1.0
+        assert set(bound["scaled"]) == {"sv", "n"}
+
+        # The sampled answer is deterministic: the kept prefix of the
+        # sorted listing, scaled by the inverse kept-byte fraction.
+        files = sorted(os.path.join(path, f) for f in os.listdir(path))
+        scale = sum(os.path.getsize(f) for f in files) \
+            / os.path.getsize(files[0])
+        row = table.to_pandas().iloc[0]
+        v = overload["v"]
+        assert row["n"] == pytest.approx(1000 * scale)
+        assert row["sv"] == pytest.approx(float(v[:1000].sum()) * scale)
+        assert overload["controller"].stats()["degrades"] >= 1
+        engaged = [e for e in sink().events
+                   if isinstance(e, AdaptiveActionEvent)
+                   and e.action == "admission.engage"]
+        assert engaged and engaged[0].subject == "degrade"
+
+    def test_ineligible_plan_runs_exact_under_breach(self, overload):
+        session, path = overload["session"], overload["path"]
+        df = session.read.parquet(path)
+        q = df.filter(col("k") < 100).select("k", "v")
+        exact = _sorted_rows(q)
+        fe = ServingFrontend(session)
+        table = fe.submit(q).result(timeout=300)
+        assert getattr(table, "approx_error_bound", None) is None
+        out = table.to_pandas()
+        assert out.sort_values(list(out.columns)) \
+            .reset_index(drop=True).equals(exact)
+
+    def test_shed_mode_rejects_typed(self, overload):
+        session, path = overload["session"], overload["path"]
+        session.conf.set(AdaptiveConstants.ADMISSION_MODE, "shed")
+        fe = ServingFrontend(session)
+        df = session.read.parquet(path)
+        with pytest.raises(ServingRejectedError, match="slo breach"):
+            fe.submit(df.agg(count().alias("n")))
+        assert overload["controller"].stats()["sheds"] >= 1
+
+    def test_recovery_restores_exact_answers(self, overload):
+        session, path = overload["session"], overload["path"]
+        controller = overload["controller"]
+        df = session.read.parquet(path)
+        agg = df.agg(sum_(col("v")).alias("sv"), count().alias("n"))
+        fe = ServingFrontend(session)
+        degraded = fe.submit(agg).result(timeout=300)
+        assert getattr(degraded, "approx_error_bound", None) is not None
+
+        # health() clears: disarm the objective and force a refresh
+        # (decide() would otherwise serve the cached verdict for 1s).
+        session.conf.set(TelemetryConstants.SLO_P99_MS, "0")
+        assert controller.refresh(session, force=True) is False
+        table = fe.submit(agg).result(timeout=300)
+        assert getattr(table, "approx_error_bound", None) is None
+        row = table.to_pandas().iloc[0]
+        assert row["n"] == 4000
+        assert row["sv"] == overload["v"].sum()
+        stats = controller.stats()
+        assert stats["recoveries"] >= 1
+        assert not stats["overloaded"]
+        recovered = [e for e in sink().events
+                     if isinstance(e, AdaptiveActionEvent)
+                     and e.action == "admission.recover"]
+        assert recovered
+
+
+# ---------------------------------------------------------------------------
+# Acceptance 5: the master switch really is a master switch.
+# ---------------------------------------------------------------------------
+
+class TestMasterSwitchOff:
+    def test_everything_inert_by_default(self, tmp_path, skew_star):
+        session = _session(
+            tmp_path, **{OptimizerConstants.JOIN_REORDER_ENABLED: "true"})
+        # Sub-features all true (their defaults) — the master switch
+        # alone must keep the whole plane inert.
+        assert not session.hs_conf.adaptive_enabled()
+        assert not session.hs_conf.adaptive_feedback_enabled()
+        assert not session.hs_conf.adaptive_replan_enabled()
+        assert not session.hs_conf.adaptive_builder_enabled()
+        assert not session.hs_conf.adaptive_admission_enabled()
+
+        get_store().clear()
+        a = _three_way(session, skew_star).to_arrow()
+        b = _three_way(session, skew_star).to_arrow()
+        assert a.equals(b)
+        stats = get_store().stats()
+        assert stats["observed"] == 0
+        assert stats["replans"] == 0
+
+        session.enable_hyperspace()
+        hs = Hyperspace(session)
+        out = AdaptiveBuilder(hs, ledger=BuilderLedger()) \
+            .run_once(force=True)
+        assert out == {"ran": False, "built": [], "retired": [],
+                       "maintained": [], "reason": "disabled"}
+
+        controller = get_controller()
+        controller.reset()
+        assert controller.decide(session) == "admit"
+        # Nothing routes to the approximate tier: submit-side admission
+        # is gated on the master switch.
+        fe = ServingFrontend(session)
+        table = fe.submit(session.read.parquet(skew_star["fact"])
+                          .agg(count().alias("n"))).result(timeout=300)
+        assert getattr(table, "approx_error_bound", None) is None
+        assert table.to_pandas().iloc[0]["n"] == 4000
+        controller.reset()

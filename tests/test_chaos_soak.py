@@ -141,3 +141,156 @@ def test_chaos_soak_no_deadlock_no_strand_typed_or_identical(tmp_path):
     # The chaos actually exercised the machinery.
     s = faults.stats()
     assert s["injected"] > 0
+
+
+def _canon(arrow_table):
+    """Row-order-insensitive canonical form: the adaptive soak's
+    builder materializes indexes MID-soak, and a covering-index scan
+    may legally permute row order for order-free queries — same
+    multiset of rows is the invariant (strict byte-order is pinned by
+    the controller-off soak above)."""
+    return arrow_table.sort_by(
+        [(c, "ascending") for c in arrow_table.column_names])
+
+
+def test_chaos_soak_with_adaptive_controller_armed(tmp_path):
+    """The same chaos mix with the adaptive control plane ON: the
+    feedback store recording every join actual, the budgeted builder
+    attempting passes mid-flight (with the action-path faults armed
+    against it), and SLO-driven admission armed with a p99 objective no
+    real query can meet — once the window fills, every submission sheds
+    or degrades. Invariants: every submission ends row-identical, as a
+    TYPED HyperspaceException, or as an approximate answer carrying its
+    stated error bound; no stranded builder work after drain."""
+    from goldstandard import tpc
+
+    from hyperspace_tpu.adaptive.admission import get_controller
+    from hyperspace_tpu.adaptive.builder import (AdaptiveBuilder,
+                                                 BuilderLedger)
+    from hyperspace_tpu.adaptive.constants import AdaptiveConstants
+    from hyperspace_tpu.advisor.constants import AdvisorConstants
+    from hyperspace_tpu.telemetry.constants import TelemetryConstants
+
+    root = str(tmp_path / "tpc")
+    spill_dir = str(tmp_path / "spill")
+
+    def _arm(s):
+        s.conf.set(AdaptiveConstants.ENABLED, "true")
+        s.conf.set(AdvisorConstants.CAPTURE_ENABLED, "true")
+        # A p99 objective nothing can meet: admission trips as soon as
+        # the window holds minCount completed queries.
+        s.conf.set(TelemetryConstants.SLO_P99_MS, "0.01")
+        s.conf.set(TelemetryConstants.SLO_MIN_COUNT, "3")
+        return s
+
+    # Exact reference computed WITHOUT the controller.
+    ref_session = _session(tmp_path, spill_dir)
+    dfs = tpc.register_tables(ref_session, root)
+    serial = {name: _canon(tpc.queries(dfs)[name].to_arrow())
+              for name in SOAK_QUERIES}
+
+    sessions = [_arm(_session(tmp_path, spill_dir)) for _ in range(2)]
+    plans = []
+    for s in sessions:
+        qdict = tpc.queries(tpc.register_tables(s, root))
+        plans.append({n: qdict[n] for n in SOAK_QUERIES})
+    fe = ServingFrontend(sessions[0])
+    hs = hst.Hyperspace(sessions[0])
+    ledger = BuilderLedger()
+    builder = AdaptiveBuilder(hs, ledger=ledger)
+    controller = get_controller()
+    controller.reset()
+
+    reg = FaultRegistry.from_conf_specs(CHAOS_SPECS, seed=4321)
+    results = {}
+    typed_errors = {}
+    hard_errors = []
+    stop_ops = threading.Event()
+
+    def client(tid):
+        try:
+            for rnd in range(2):
+                for j, name in enumerate(SOAK_QUERIES):
+                    if (j + tid + rnd) % 2 == 0:
+                        continue
+                    q = plans[tid % 2][name]
+                    with faults.scope(reg):
+                        try:
+                            p = fe.submit(q, client=f"c{tid}")
+                        except HyperspaceException as e:
+                            typed_errors[(tid, name, rnd)] = e
+                            continue
+                    try:
+                        table = p.result(timeout=300)
+                    except HyperspaceException as e:
+                        typed_errors[(tid, name, rnd)] = e
+                        continue
+                    bound = getattr(table, "approx_error_bound", None)
+                    results[(tid, name, rnd)] = (table.to_arrow(), bound)
+        except BaseException as e:  # pragma: no cover
+            hard_errors.append((tid, repr(e)))
+
+    def ops():
+        # The builder rides the soak: the busy check keeps it off the
+        # serving path (zero impact on in-flight queries); the armed
+        # action-path faults bite any build attempt that does fire.
+        try:
+            while not stop_ops.is_set():
+                with faults.scope(reg):
+                    builder.run_once(force=True)
+                stop_ops.wait(0.05)
+        except BaseException as e:  # pragma: no cover
+            hard_errors.append(("ops", repr(e)))
+
+    threads = [threading.Thread(target=client, args=(t,))
+               for t in range(8)]
+    ops_thread = threading.Thread(target=ops)
+    ops_thread.start()
+    try:
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=900)
+            assert not t.is_alive(), "chaos client hung (deadlock?)"
+    finally:
+        stop_ops.set()
+        ops_thread.join(timeout=60)
+    assert not ops_thread.is_alive(), "builder ops thread hung"
+
+    assert not hard_errors, hard_errors
+    assert all(isinstance(e, HyperspaceException)
+               for e in typed_errors.values())
+    total = len(results) + len(typed_errors)
+    assert total == 8 * len(SOAK_QUERIES)
+    assert results, "controller + chaos killed every query"
+
+    # The armed objective actually tripped and the controller acted.
+    cstats = controller.stats()
+    assert cstats["breaches"] >= 1
+    assert cstats["degrades"] + cstats["sheds"] >= 1
+
+    # Row-identical, or approximate WITH the stated bound — never a
+    # silent wrong answer.
+    for (tid, name, rnd), (arrow, bound) in results.items():
+        if bound is not None:
+            assert bound["kind"] == "relative"
+            assert 0.0 < bound["sample_fraction"] < 1.0
+            assert 0.0 <= bound["bound"] <= 1.0
+            assert bound["confidence"] == 0.95
+            continue
+        assert _canon(arrow).equals(serial[name]), \
+            f"thread {tid} round {rnd} query {name} diverged (exact path)"
+
+    # Builder after the storm: forced passes with faults still armed,
+    # then clean — either way NO stranded in-progress work.
+    for _ in range(3):
+        with faults.scope(reg):
+            builder.run_once(force=True)
+    builder.run_once(force=True)
+    fe.drain(timeout=120)
+    st = fe.stats()
+    assert st["queued"] == 0
+    assert st["active_workers"] == 0
+    assert st["inflight_bytes"] == 0
+    assert ledger.stats()["in_progress"] == []
+    controller.reset()
