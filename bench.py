@@ -2396,6 +2396,105 @@ def _gil_free_scaling() -> float:
     return serial / par if par > 0 else 1.0
 
 
+_ARTIFACTS_CHILD = r"""
+import json, sys, time
+data_dir, sys_dir, arts = sys.argv[1:4]
+
+t_boot = time.perf_counter()
+import hyperspace_tpu as hst
+from hyperspace_tpu.api import Hyperspace
+from hyperspace_tpu.execution import shapes
+from hyperspace_tpu.plan.expr import col, sum_
+
+conf = {"hyperspace.index.numBuckets": "4"}
+if arts == "on":
+    conf["hyperspace.tpu.artifacts.enabled"] = "true"
+    conf["hyperspace.tpu.artifacts.preload.enabled"] = "true"
+session = hst.Session(conf=conf, system_path=sys_dir)
+t = session.read.parquet(data_dir)
+q = (t.filter(col("k") > 10)
+     .group_by("g").agg(sum_(col("v")).alias("sv")).sort("g"))
+out = q.to_arrow()
+ttfq = time.perf_counter() - t_boot
+stats = Hyperspace(session).artifact_stats()
+if arts == "on":
+    from hyperspace_tpu.artifacts.manager import flush_all
+    flush_all()
+print("ARTJSON " + json.dumps({
+    "ttfq_s": round(ttfq, 4), "compiles": shapes.compile_count(),
+    "rows": out.num_rows,
+    "hits": stats.get("hits", 0),
+    "persists": stats.get("persists", 0),
+    "persist_bytes": stats.get("persist_bytes", 0),
+    "preloaded": stats.get("preloaded", 0),
+    "preload_bytes": stats.get("preload_bytes", 0)}))
+"""
+
+
+def _run_artifacts_phase(args, root: str) -> None:
+    """Persistent artifact store (ISSUE r20): the cold-start compile
+    storm, measured. Three SUBPROCESS cold boots over one lake — the
+    bench process is warm, so time-to-first-query needs real fresh
+    processes: artifacts off (the storm), process A with artifacts on
+    (pays the storm once, persists), process B over the same lake
+    (imports + boot preload). Emits coldboot_ttfq_off_s /
+    coldboot_ttfq_on_s / coldboot_speedup, second_process_compiles,
+    and the store's hit/persist byte counters."""
+    import json
+    import subprocess
+    import sys as _sys
+
+    import numpy as np
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    data = os.path.join(root, "arts_data")
+    os.makedirs(data)
+    rng = np.random.default_rng(11)
+    rows = 1500
+    pq.write_table(pa.table({
+        "k": pa.array(rng.integers(0, 50, rows).astype(np.int64)),
+        "g": pa.array(rng.integers(0, 7, rows).astype(np.int64)),
+        "v": pa.array(rng.integers(0, 1000, rows).astype(np.int64)),
+    }), os.path.join(data, "p0.parquet"))
+    script = os.path.join(root, "arts_child.py")
+    with open(script, "w") as f:
+        f.write(_ARTIFACTS_CHILD)
+
+    def boot(sys_dir, arts):
+        env = dict(os.environ)
+        env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+        proc = subprocess.run(
+            [_sys.executable, script, data, sys_dir, arts], env=env,
+            capture_output=True, text=True, timeout=600, cwd=repo)
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"artifacts child rc={proc.returncode}: "
+                f"{proc.stderr[-1500:]}")
+        line = [ln for ln in proc.stdout.splitlines()
+                if ln.startswith("ARTJSON ")][0]
+        return json.loads(line[len("ARTJSON "):])
+
+    off = boot(os.path.join(root, "arts_idx_off"), "off")
+    lake = os.path.join(root, "arts_idx_on")
+    a = boot(lake, "on")
+    b = boot(lake, "on")
+    RESULT["coldboot_ttfq_off_s"] = off["ttfq_s"]
+    RESULT["coldboot_ttfq_on_s"] = b["ttfq_s"]
+    RESULT["coldboot_speedup"] = round(
+        off["ttfq_s"] / b["ttfq_s"], 3) if b["ttfq_s"] > 0 else None
+    RESULT["coldboot_off_compiles"] = off["compiles"]
+    RESULT["first_process_compiles"] = a["compiles"]
+    # THE acceptance number: a warm lake's second process re-compiles
+    # (almost) nothing — measured 0 on the CPU harness.
+    RESULT["second_process_compiles"] = b["compiles"]
+    RESULT["artifacts_persist_bytes"] = a["persist_bytes"]
+    RESULT["artifacts_second_process_hits"] = b["hits"]
+    RESULT["artifacts_preloaded"] = b["preloaded"]
+    RESULT["artifacts_preload_bytes"] = b["preload_bytes"]
+
+
 def _run_io_phase(args, root: str) -> None:
     """Parallel-I/O A/B (parallel/io.py): cold multi-file scan and
     per-file sketch-build wall clock at `io.threads=1` (the sequential
@@ -2608,6 +2707,13 @@ def main():
                 except Exception as e:
                     RESULT["errors"].append(
                         f"adaptive phase: {type(e).__name__}: {e}")
+        if not _backend_dead():
+            with _phase("artifacts"):
+                try:
+                    _run_artifacts_phase(args, root)
+                except Exception as e:
+                    RESULT["errors"].append(
+                        f"artifacts phase: {type(e).__name__}: {e}")
         with _phase("mesh"):
             # Multi-device numbers ride along at a bounded scale (the
             # virtual CPU mesh measures path health + collective overhead,

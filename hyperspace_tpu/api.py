@@ -169,6 +169,11 @@ class Hyperspace:
         # Recovered indexes changed state out from under the caching
         # manager: drop its entry cache so listings see the rollback.
         self.index_manager.clear_cache()
+        # Artifact store sweep (r20): a process killed mid-publication
+        # leaves only a .tmp- file (never a torn blob — publication is
+        # tmp+link); the vacuum clears those plus stale-runtime and
+        # corrupt-header blobs. No-op dict when artifacts are off.
+        summary["artifacts"] = self._artifact_vacuum()
         return summary
 
     # ------------------------------------------------------------------
@@ -209,7 +214,20 @@ class Hyperspace:
         still need — run it in a quiet window. Returns a summary
         dict."""
         from .streaming.compaction import compact as _compact
-        return _compact(self.session, names)
+        summary = _compact(self.session, names)
+        # The artifact store rides the same maintenance action: vacuum
+        # unreferenced/stale blobs and re-apply the byte budget.
+        summary["artifacts"] = self._artifact_vacuum()
+        return summary
+
+    def _artifact_vacuum(self) -> dict:
+        """Shared recover()/compact() seam into the artifact store's
+        vacuum — maintenance must survive an artifacts-layer failure."""
+        try:
+            from .artifacts.manager import vacuum as _artifact_vacuum
+            return _artifact_vacuum(self.session)
+        except Exception:
+            return {"enabled": False}
 
     def streaming_stats(self) -> dict:
         """Ingestion-tier observability: the process commit queue's
@@ -217,6 +235,41 @@ class Hyperspace:
         the op-log lookup cache's hit rates."""
         from .streaming.ingest import get_queue
         return get_queue().stats()
+
+    # ------------------------------------------------------------------
+    # Compiled-program artifact store (artifacts/).
+    # ------------------------------------------------------------------
+
+    def warmup(self) -> dict:
+        """Preload persisted AOT executables from the lake's artifact
+        store into this process's program caches, hottest first (by the
+        persisted usage tallies), within the ``artifacts.preload.maxMs``
+        / ``maxBytes`` budgets — so the first query after a cold boot
+        dispatches instead of compiling. Explicit counterpart of the
+        opt-in automatic preload at session init
+        (``artifacts.preload.enabled``). Returns a summary dict
+        ({enabled, loaded, skipped, bytes, ms, budget_hit})."""
+        try:
+            from .artifacts.manager import preload as _preload
+            return _preload(self.session)
+        except Exception:
+            return {"enabled": False, "loaded": 0}
+
+    def artifact_stats(self) -> dict:
+        """Artifact-store observability: persistent-store counters
+        (hits/misses/corruptions/persists/evictions + resident bytes)
+        merged with the manager's warm-cache and preload numbers. The
+        same dict backs the ``artifacts`` metrics collector."""
+        try:
+            from .artifacts.manager import manager_for
+            mgr = manager_for(self.session)
+            if mgr is None:
+                return {"enabled": False}
+            out = {"enabled": True}
+            out.update(mgr.stats())
+            return out
+        except Exception:
+            return {"enabled": False}
 
     # ------------------------------------------------------------------
     # Introspection.

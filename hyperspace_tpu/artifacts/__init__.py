@@ -1,0 +1,13 @@
+"""Persistent compiled-program artifact store (r20).
+
+Serialized XLA executables live on the lake beside the indexes they
+serve, keyed (stage fingerprint, shape-class vector, mesh signature,
+jax/jaxlib version, backend); a warm boot preloads them usage-ordered
+and reaches first-query with compile count ~ 0. See store.py for the
+blob protocol and manager.py for the dispatch seams.
+
+Import-light on purpose: config.py reads the constants; jax loads only
+when a dispatch seam or preload actually runs.
+"""
+
+from .constants import ArtifactConstants  # noqa: F401

@@ -1,0 +1,60 @@
+"""Config keys of the persistent compiled-program artifact store.
+
+Key literals live here (not inline) because the static-analysis env/
+config gates treat config.py as the one sanctioned reader and require
+every ``hyperspace.tpu.*`` literal to appear in docs/configuration.md
+(scripts/analysis: HS202 / doc-drift) — see §Artifacts there for
+semantics and defaults.
+
+No jax imports: config.py pulls this in at import time.
+"""
+
+from __future__ import annotations
+
+
+# Directory name under the index system path holding the store (kept
+# out of compaction/recovery's op-log walks: it contains no
+# _hyperspace_log subdirectory, so the log sweeps skip it naturally).
+ARTIFACT_DIR_NAME = "_hst_artifacts"
+
+# Blob format version: part of every artifact key, so a layout change
+# invalidates (silently misses) every existing blob instead of
+# misparsing it.
+ARTIFACT_FORMAT_VERSION = 1
+
+
+class ArtifactConstants:
+    # Master switch. Default OFF and byte-identical off: nothing is
+    # wrapped, written, or read when false (tests assert the no-op).
+    ENABLED = "hyperspace.tpu.artifacts.enabled"
+    ENABLED_DEFAULT = "false"
+
+    # Store directory override; empty means
+    # ``<index system path>/_hst_artifacts`` (the lake-resident
+    # default — artifacts live beside the indexes they serve).
+    DIR = "hyperspace.tpu.artifacts.dir"
+    DIR_DEFAULT = ""
+
+    # Byte budget for resident blobs; publication past the budget
+    # evicts least-used blobs first (usage sidecar order).
+    MAX_BYTES = "hyperspace.tpu.artifacts.maxBytes"
+    MAX_BYTES_DEFAULT = str(1 << 30)
+
+    # Opt-in automatic preload at Session creation (warmup() is always
+    # available explicitly).
+    PRELOAD_ENABLED = "hyperspace.tpu.artifacts.preload.enabled"
+    PRELOAD_ENABLED_DEFAULT = "false"
+
+    # Preload budgets: stop loading once either is exhausted. Ordering
+    # is by persisted usage tallies, so the budget is spent on the
+    # hottest programs first.
+    PRELOAD_MAX_MS = "hyperspace.tpu.artifacts.preload.maxMs"
+    PRELOAD_MAX_MS_DEFAULT = "5000"
+    PRELOAD_MAX_BYTES = "hyperspace.tpu.artifacts.preload.maxBytes"
+    PRELOAD_MAX_BYTES_DEFAULT = str(256 << 20)
+
+    # Min milliseconds between usage-sidecar flushes (rate limit on the
+    # serving path; shutdown-less processes still persist tallies at
+    # most this stale).
+    USAGE_FLUSH_MS = "hyperspace.tpu.artifacts.usage.flushMs"
+    USAGE_FLUSH_MS_DEFAULT = "500"

@@ -1,0 +1,444 @@
+"""Process-wide artifact managers + the AOT dispatch wrapper.
+
+The seam between the banked interfaces and the lake store (store.py):
+
+- :func:`maybe_wrap_stage` — ProgramBank registration hook. When the
+  active query's session enables artifacts, newly registered jit-
+  wrapper stages are wrapped in an :class:`AotStage`, which AOT-
+  compiles per argument signature (``lower().compile()``), imports/
+  exports through the store, and falls back to the inner jit wrapper on
+  ANY trouble. When artifacts are off nothing is wrapped — the off
+  path is byte-identical by construction (tests assert it).
+- :class:`ArtifactManager` — one per store root: the load-through
+  cache of deserialized executables (what preload populates, what the
+  dispatch seams probe before compiling) plus the preload driver.
+- ``MeshProgram`` (parallel/sharding.py) talks to the SAME manager from
+  its ``_get`` compile seam; the artifact key travels from
+  ``bank_program``.
+
+Importable without jax (config.py reaches the constants package; the
+bank imports this module on the serving path): jax only loads inside
+the dispatch/compile functions.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Dict, Optional, Tuple
+
+from .constants import ARTIFACT_DIR_NAME
+from .store import ArtifactStore, key_digest, key_fields
+
+# Sentinel for signatures whose AOT path failed (un-lowerable args, a
+# rejected loaded executable): dispatch goes to the inner jit wrapper,
+# permanently for that signature.
+_FALLBACK = ("__aot_fallback__",)
+
+
+def _signature(args) -> tuple:
+    """(treedef, per-leaf (shape, dtype, weak_type)) — the same
+    signature MeshProgram keys executables on; its repr feeds the
+    artifact key's ``sig`` digest."""
+    import jax
+
+    def leaf(x):
+        aval = jax.api_util.shaped_abstractify(x)
+        return (aval.shape, str(aval.dtype),
+                bool(getattr(aval, "weak_type", False)))
+    leaves, treedef = jax.tree_util.tree_flatten(args)
+    return (treedef, tuple(leaf(x) for x in leaves))
+
+
+class ArtifactManager:
+    """Load-through executable cache over one :class:`ArtifactStore`.
+    ``_loaded`` (digest -> compiled) is shared by every dispatch seam
+    and the boot preloader — all access under ``_lock`` (HS301)."""
+
+    def __init__(self, store: ArtifactStore):
+        self.store = store
+        self._lock = threading.Lock()
+        self._loaded: Dict[str, object] = {}
+        self.warm_hits = 0
+        self.preloaded = 0
+        self.preload_ms = 0.0
+        self.preload_bytes = 0
+        # Utility-kernel executables ((label, statics, signature) ->
+        # (compiled, digest) | _FALLBACK) under their own lock:
+        # _acquire_kernel holds it across a fetch/put, which takes
+        # ``_lock`` — the ordering is always _util_lock -> _lock.
+        self._util_lock = threading.Lock()
+        self._util: Dict[tuple, Tuple] = {}
+
+    def fetch(self, fields: Dict[str, str]):
+        """The compiled executable for this key — from the in-memory
+        cache (preloaded or previously loaded) or the lake — else None
+        (the caller compiles)."""
+        digest = key_digest(fields)
+        with self._lock:
+            compiled = self._loaded.get(digest)
+            if compiled is not None:
+                self.warm_hits += 1
+                return compiled
+        compiled = self.store.load(fields)
+        if compiled is not None:
+            with self._lock:
+                self._loaded[digest] = compiled
+        return compiled
+
+    def put(self, fields: Dict[str, str], compiled) -> None:
+        """Publish a freshly compiled executable (best-effort; losing a
+        publication race or failing to serialize costs persistence
+        only) and retain it for sibling stages in this process."""
+        self.store.publish(fields, compiled)
+        with self._lock:
+            self._loaded[key_digest(fields)] = compiled
+
+    def note_use(self, digest: str) -> None:
+        self.store.record_use(digest)
+
+    def discard(self, digest: str) -> None:
+        """Last rung of the corrupt ladder: a loaded executable failed
+        at dispatch — drop it from memory and the lake so no process
+        loads it again."""
+        with self._lock:
+            self._loaded.pop(digest, None)
+        try:
+            os.unlink(self.store.blob_path(digest))
+        except OSError:
+            pass
+
+    def preload(self, max_ms: float, max_bytes: int) -> dict:
+        """Load resident blobs hottest-first (persisted usage order)
+        until either budget is spent — the boot warm-up that makes a
+        second process reach first-query with compile count ~ 0."""
+        from ..telemetry import span_names as SN
+        from ..telemetry import trace as _trace
+        t0 = time.perf_counter()
+        loaded = skipped = 0
+        nbytes_total = 0
+        budget_hit = ""
+        with _trace.span(SN.ARTIFACT_WARMUP) as sp:
+            for digest in self.store.usage_order():
+                if (time.perf_counter() - t0) * 1000.0 >= max_ms:
+                    budget_hit = "maxMs"
+                    break
+                if nbytes_total >= max_bytes:
+                    budget_hit = "maxBytes"
+                    break
+                with self._lock:
+                    if digest in self._loaded:
+                        continue
+                res = self.store.load_by_digest(digest)
+                if res is None:
+                    skipped += 1
+                    continue
+                compiled, nbytes = res
+                with self._lock:
+                    self._loaded[digest] = compiled
+                loaded += 1
+                nbytes_total += nbytes
+            if sp is not None:
+                sp.attrs["loaded"] = loaded
+                sp.attrs["nbytes"] = nbytes_total
+        elapsed_ms = (time.perf_counter() - t0) * 1000.0
+        with self._lock:
+            self.preloaded += loaded
+            self.preload_ms += elapsed_ms
+            self.preload_bytes += nbytes_total
+        return {"enabled": True, "loaded": loaded, "skipped": skipped,
+                "bytes": nbytes_total, "ms": round(elapsed_ms, 3),
+                "budget_hit": budget_hit}
+
+    def kernel_call(self, label: str, jitted, args, kwargs):
+        """Dispatch one module-level jitted utility kernel through the
+        artifact seam (see :class:`AotKernel` for the calling
+        convention). The jitted original stays the correctness anchor:
+        any signature whose AOT path misbehaves drops to it, permanently
+        for that signature."""
+        try:
+            statics = tuple(sorted(kwargs.items()))
+            skey = (label, statics, _signature(args))
+        except Exception:
+            return jitted(*args, **kwargs)
+        with self._util_lock:
+            entry = self._util.get(skey)
+        if entry is None:
+            entry = self._acquire_kernel(skey, jitted, args, kwargs)
+        if entry is _FALLBACK:
+            return jitted(*args, **kwargs)
+        compiled, digest = entry
+        try:
+            out = compiled(*args)
+        except Exception:
+            # Dispatch rejection: the corrupt ladder's last rung.
+            with self._util_lock:
+                self._util[skey] = _FALLBACK
+            self.discard(digest)
+            return jitted(*args, **kwargs)
+        self.note_use(digest)
+        return out
+
+    def _acquire_kernel(self, skey: tuple, jitted, args, kwargs):
+        with self._util_lock:
+            entry = self._util.get(skey)
+            if entry is not None:
+                return entry
+            fields = key_fields("util", repr(skey[:2]), repr(skey[2]))
+            compiled = self.fetch(fields)
+            if compiled is None:
+                try:
+                    compiled = jitted.lower(*args, **kwargs).compile()
+                except Exception:
+                    self._util[skey] = _FALLBACK
+                    return _FALLBACK
+                self.put(fields, compiled)
+            entry = (compiled, key_digest(fields))
+            self._util[skey] = entry
+            return entry
+
+    def stats(self) -> dict:
+        out = self.store.stats()
+        with self._lock:
+            out["warm_hits"] = self.warm_hits
+            out["loaded_in_memory"] = len(self._loaded)
+            out["preloaded"] = self.preloaded
+            out["preload_ms"] = round(self.preload_ms, 3)
+            out["preload_bytes"] = self.preload_bytes
+        return out
+
+
+class AotStage:
+    """Bank-stage dispatch wrapper: per argument signature, try the
+    artifact manager, else AOT-compile the inner jit wrapper
+    (``lower().compile()`` — the same single compile jit would pay) and
+    publish. The inner wrapper remains the correctness anchor: any
+    signature whose AOT path misbehaves — un-lowerable arguments, a
+    loaded executable rejecting the call — drops to it permanently,
+    so the wrapped stage can never answer differently than the
+    unwrapped one."""
+
+    def __init__(self, inner, stage_key: tuple,
+                 manager: ArtifactManager):
+        self._inner = inner
+        self._stage_repr = repr(stage_key)
+        self._manager = manager
+        self._lock = threading.Lock()
+        # signature -> (compiled, artifact digest) | _FALLBACK.
+        self._compiled: Dict[tuple, Tuple] = {}
+
+    def __call__(self, *args, **kwargs):
+        if kwargs:
+            return self._inner(*args, **kwargs)
+        try:
+            sig = _signature(args)
+        except Exception:
+            return self._inner(*args)
+        entry = self._compiled.get(sig)
+        if entry is None:
+            entry = self._acquire(sig, args)
+        if entry is _FALLBACK:
+            return self._inner(*args)
+        compiled, digest = entry
+        try:
+            out = compiled(*args)
+        except Exception:
+            # Dispatch rejection (the ladder's last rung): evict the
+            # artifact everywhere and answer from the jit wrapper.
+            with self._lock:
+                self._compiled[sig] = _FALLBACK
+            self._manager.discard(digest)
+            return self._inner(*args)
+        self._manager.note_use(digest)
+        return out
+
+    def _acquire(self, sig: tuple, args):
+        with self._lock:
+            entry = self._compiled.get(sig)
+            if entry is not None:
+                return entry
+            fields = key_fields("bank", self._stage_repr, repr(sig))
+            compiled = self._manager.fetch(fields)
+            if compiled is None:
+                try:
+                    compiled = self._inner.lower(*args).compile()
+                except Exception:
+                    self._compiled[sig] = _FALLBACK
+                    return _FALLBACK
+                self._manager.put(fields, compiled)
+            entry = (compiled, key_digest(fields))
+            self._compiled[sig] = entry
+            return entry
+
+
+# ---------------------------------------------------------------------------
+# The per-root manager registry + the conf-driven entry points.
+# ---------------------------------------------------------------------------
+
+
+class _ManagerRegistry:
+    """root dir -> manager; process-wide like the ProgramBank (two
+    sessions over one lake share every loaded executable)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._by_root: Dict[str, ArtifactManager] = {}
+
+    def get(self, root: str, max_bytes: int,
+            usage_flush_ms: float) -> ArtifactManager:
+        with self._lock:
+            mgr = self._by_root.get(root)
+            if mgr is None:
+                mgr = ArtifactManager(ArtifactStore(
+                    root, max_bytes, usage_flush_ms))
+                self._by_root[root] = mgr
+            else:
+                # Budgets follow the most recent conf read (plain
+                # attribute writes; racing sessions just disagree
+                # briefly about a threshold).
+                mgr.store.max_bytes = max_bytes
+                mgr.store.usage_flush_ms = usage_flush_ms
+        return mgr
+
+    def all(self) -> list:
+        with self._lock:
+            return list(self._by_root.values())
+
+
+_REGISTRY: Optional[_ManagerRegistry] = None
+_REGISTRY_LOCK = threading.Lock()
+
+
+def get_registry() -> _ManagerRegistry:
+    """The process singleton; first use registers the "artifacts"
+    metrics collector (the streaming get_queue idiom)."""
+    global _REGISTRY
+    with _REGISTRY_LOCK:
+        if _REGISTRY is None:
+            _REGISTRY = _ManagerRegistry()
+            from ..telemetry import metric_names as MN
+            from ..telemetry.metrics import get_registry as _metrics
+            _metrics().register_collector(
+                MN.COLLECTOR_ARTIFACTS, _collector_stats)
+        return _REGISTRY
+
+
+def _collector_stats() -> dict:
+    """Aggregate store counters across every root this process has
+    opened (usually one lake)."""
+    managers = get_registry().all()
+    out = {"stores": len(managers)}
+    for mgr in managers:
+        for k, v in mgr.stats().items():
+            if isinstance(v, (int, float)):
+                out[k] = out.get(k, 0) + v
+    return out
+
+
+def manager_for(session) -> Optional[ArtifactManager]:
+    """The session's artifact manager, or None when the store is
+    disabled (the ONE cheap check every off-path pays) or no root can
+    be resolved."""
+    hs_conf = session.hs_conf
+    if not hs_conf.artifacts_enabled():
+        return None
+    root = hs_conf.artifacts_dir()
+    if not root:
+        try:
+            root = os.path.join(hs_conf.system_path(), ARTIFACT_DIR_NAME)
+        except Exception:
+            return None  # no system path configured: nowhere to persist
+    return get_registry().get(root, hs_conf.artifacts_max_bytes(),
+                              hs_conf.artifacts_usage_flush_ms())
+
+
+def active_manager() -> Optional[ArtifactManager]:
+    """The manager of the ACTIVE query's session (the dispatch seams'
+    entry point — bank registration and MeshProgram compiles happen
+    under an activated QueryContext)."""
+    from ..serving.context import active_context
+    ctx = active_context()
+    if ctx is None or ctx.session is None:
+        return None
+    try:
+        return manager_for(ctx.session)
+    except Exception:
+        return None
+
+
+def maybe_wrap_stage(stage_key: tuple, fn):
+    """ProgramBank registration hook: wrap a newly built jit-wrapper
+    stage for AOT export/import iff the active session enables
+    artifacts. SPMD stages are excluded — MeshProgram owns its own
+    compile seam."""
+    if not isinstance(stage_key, tuple) or not stage_key \
+            or stage_key[0] == "spmd":
+        return fn
+    mgr = active_manager()
+    if mgr is None:
+        return fn
+    return AotStage(fn, stage_key, mgr)
+
+
+class AotKernel:
+    """Module-level jitted utility kernel behind the artifact seam
+    (ops/kernels.py wraps its serving-path helpers with this at import
+    time — the op-by-op compile tail a cold boot would otherwise pay).
+
+    Calling convention, enforced by the wrap sites: POSITIONAL arguments
+    are dynamic (traced) and KEYWORD arguments are static — the
+    AOT-compiled executable is invoked with the positionals only, the
+    statics being baked into it. Stateless by design: the per-signature
+    executable cache lives on the session's manager, so two lakes never
+    share a wrongly keyed executable and the artifacts-off path is one
+    ``active_manager()`` probe away from the raw jitted call."""
+
+    __slots__ = ("_label", "_jitted")
+
+    def __init__(self, label: str, jitted):
+        self._label = label
+        self._jitted = jitted
+
+    def __call__(self, *args, **kwargs):
+        try:
+            mgr = active_manager()
+        except Exception:
+            mgr = None
+        if mgr is None:
+            return self._jitted(*args, **kwargs)
+        return mgr.kernel_call(self._label, self._jitted, args, kwargs)
+
+
+def wrap_kernel(label: str, jitted) -> AotKernel:
+    """ops/kernels.py entry point (import-time)."""
+    return AotKernel(label, jitted)
+
+
+def preload(session) -> dict:
+    """Boot preload within the session's budgets; the body behind
+    ``Hyperspace.warmup()`` and the opt-in Session-init hook."""
+    mgr = manager_for(session)
+    if mgr is None:
+        return {"enabled": False, "loaded": 0}
+    return mgr.preload(session.hs_conf.artifacts_preload_max_ms(),
+                       session.hs_conf.artifacts_preload_max_bytes())
+
+
+def vacuum(session) -> dict:
+    """Store maintenance riding ``Hyperspace.compact()``/``recover()``:
+    crashed publication temps, unloadable (stale-runtime / corrupt)
+    blobs, orphaned usage tallies, byte budget."""
+    mgr = manager_for(session)
+    if mgr is None:
+        return {"enabled": False}
+    out = mgr.store.vacuum()
+    out["enabled"] = True
+    return out
+
+
+def flush_all() -> None:
+    """Force every open store's usage sidecar to disk (tests and
+    orderly shutdown; the serving path flushes on its own cadence)."""
+    for mgr in get_registry().all():
+        mgr.store.flush_usage(force=True)

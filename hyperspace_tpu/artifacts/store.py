@@ -1,0 +1,547 @@
+"""Lake-resident store of serialized compiled executables.
+
+THE serialization boundary: every ``jax.experimental
+.serialize_executable`` call (and every pickle of a compiled object) in
+the tree lives in this file — scripts/analysis HS331 pins executable
+serialization to this module, the way the jit gate pins ``jax.jit`` to
+the kernel modules. Everything above (manager.py, the bank/MeshProgram
+seams) moves opaque compiled handles only.
+
+Layout (under ``<root>`` — by default ``<system path>/_hst_artifacts``):
+
+    v1/<digest>.hsa     one blob per compiled program
+    v1/usage.json       persisted per-artifact usage tallies
+    v1/.tmp-*           in-flight publications (vacuumed)
+
+A blob is one utf-8 JSON header line carrying the FULL key (format
+version, kind, stage fingerprint, signature digest, mesh signature,
+jax/jaxlib versions, backend) plus the payload's length and md5,
+followed by the binary payload. The filename digest is computed from
+the same key fields, so a key mismatch (new jax version, different
+mesh, different backend) addresses a file that does not exist — a
+silent MISS that falls back to a normal compile, never an error. The
+header is pure defense in depth: any mismatch or checksum failure on
+read is the r14 spill-corrupt ladder — miss + evict + typed event
+(``ArtifactMissEvent(reason="corrupt")``), never a wrong answer.
+
+Publication is the op-log idiom: fsync'd temp + link-into-place
+put-if-absent (losing a cross-process race is success — the winner's
+bytes are the same program). The ``artifacts.write`` fault point sits
+BETWEEN the temp write and the rename, so an injected kill -9 dies
+mid-publication with the store still containing only whole blobs; the
+crashed temp is swept by :meth:`ArtifactStore.vacuum` (riding
+``Hyperspace.compact()``/``recover()``).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from ..util import hashing
+from ..util.file_utils import atomic_write_bytes
+from .constants import ARTIFACT_FORMAT_VERSION
+
+BLOB_SUFFIX = ".hsa"
+TMP_PREFIX = ".tmp-"
+USAGE_FILE = "usage.json"
+
+# Key fields serialized into every header, in this order. "stage" is the
+# md5 of the bank stage key repr; "sig" the md5 of the argument shape
+# signature repr; "mesh" the mesh-signature repr ("" for single-device
+# bank stages).
+_KEY_FIELDS = ("format", "kind", "stage", "sig", "mesh",
+               "jax", "jaxlib", "backend")
+
+
+def runtime_env() -> Dict[str, str]:
+    """The environment half of every artifact key: compiled executables
+    are only loadable under the exact jax/jaxlib pair and backend that
+    produced them — anything else must be a silent MISS."""
+    import jax
+    try:
+        import jaxlib
+        jaxlib_version = str(jaxlib.__version__)
+    except Exception:
+        jaxlib_version = "unknown"
+    return {"jax": str(jax.__version__), "jaxlib": jaxlib_version,
+            "backend": str(jax.default_backend())}
+
+
+def key_fields(kind: str, stage_repr: str, sig_repr: str,
+               mesh_repr: str = "",
+               env: Optional[Dict[str, str]] = None) -> Dict[str, str]:
+    env = env or runtime_env()
+    return {
+        "format": str(ARTIFACT_FORMAT_VERSION),
+        "kind": kind,
+        "stage": hashing.md5_hex(stage_repr),
+        "sig": hashing.md5_hex(sig_repr),
+        "mesh": mesh_repr,
+        "jax": env["jax"], "jaxlib": env["jaxlib"],
+        "backend": env["backend"],
+    }
+
+
+def key_digest(fields: Dict[str, str]) -> str:
+    return hashing.md5_hex(
+        repr(tuple(fields.get(k, "") for k in _KEY_FIELDS)))[:24]
+
+
+# ---------------------------------------------------------------------------
+# The serialization codec (the HS331-pinned calls).
+# ---------------------------------------------------------------------------
+
+
+def _serialize_compiled(compiled) -> bytes:
+    """Compiled executable -> payload bytes. serialize() returns the
+    xla-serialized blob plus the in/out treedefs the loader needs;
+    treedefs pickle (probed on this jaxlib), so one pickle carries all
+    three."""
+    import pickle
+
+    from jax.experimental import serialize_executable as _se
+    blob, in_tree, out_tree = _se.serialize(compiled)
+    return pickle.dumps((blob, in_tree, out_tree),
+                        protocol=pickle.HIGHEST_PROTOCOL)
+
+
+def _deserialize_compiled(payload: bytes):
+    """Payload bytes -> loaded compiled executable. ZERO backend
+    compiles (the whole point: the r07 counter stays flat on a warm
+    boot); any failure here is the caller's corrupt ladder."""
+    import pickle
+
+    from jax.experimental import serialize_executable as _se
+    blob, in_tree, out_tree = pickle.loads(payload)
+    return _se.deserialize_and_load(blob, in_tree, out_tree)
+
+
+class ArtifactStore:
+    """One process-wide store per root directory (manager.py owns the
+    registry). All shared mutable state — counters and usage tallies —
+    moves under ``_lock`` (HS301 registry); file operations are atomic
+    renames and need no lock."""
+
+    def __init__(self, root: str, max_bytes: int,
+                 usage_flush_ms: float = 500.0):
+        self.root = root
+        self.version_dir = os.path.join(
+            root, f"v{ARTIFACT_FORMAT_VERSION}")
+        self.max_bytes = max_bytes
+        self.usage_flush_ms = usage_flush_ms
+        self._lock = threading.Lock()
+        # digest -> [use count, last-use sequence stamp]; merged with
+        # the on-disk sidecar at init and on every flush (another
+        # process's tallies survive ours).
+        self._usage: Dict[str, List[int]] = {}
+        self._usage_seq = 0
+        self._dirty = False
+        self._last_flush = 0.0
+        self.hits = 0
+        self.misses = 0
+        self.corrupt = 0
+        self.persists = 0
+        self.persist_bytes = 0
+        self.evictions = 0
+        self._load_usage_locked()
+
+    # ------------------------------------------------------------------
+    # Publication (put-if-absent) + load (miss/corrupt ladder).
+    # ------------------------------------------------------------------
+
+    def blob_path(self, digest: str) -> str:
+        return os.path.join(self.version_dir, digest + BLOB_SUFFIX)
+
+    def publish(self, fields: Dict[str, str], compiled) -> bool:
+        """Serialize + publish one compiled executable; True when this
+        call's bytes landed. NEVER raises on the serving path: a
+        publication failure (injected, out of disk, unserializable
+        executable) costs only persistence, not the query."""
+        from ..robustness import fault_names as _fltn
+        from ..robustness import faults as _faults
+        from ..telemetry import span_names as SN
+        from ..telemetry import trace as _trace
+        digest = key_digest(fields)
+        path = self.blob_path(digest)
+        if os.path.exists(path):
+            return False
+        tmp = None
+        try:
+            with _trace.span(SN.ARTIFACT_EXPORT) as sp:
+                payload = _serialize_compiled(compiled)
+                header = dict(fields)
+                header["nbytes"] = len(payload)
+                header["md5"] = hashing.md5_hex(payload)
+                data = (json.dumps(header, sort_keys=True) + "\n")\
+                    .encode("utf-8") + payload
+                os.makedirs(self.version_dir, exist_ok=True)
+                tmp = os.path.join(
+                    self.version_dir,
+                    f"{TMP_PREFIX}{os.getpid()}-{digest}")
+                with open(tmp, "wb") as f:
+                    f.write(data)
+                    f.flush()
+                    os.fsync(f.fileno())
+                # The kill -9 window the crash harness aims at: the temp
+                # is fully written, the blob not yet linked — dying here
+                # must leave nothing loadable (vacuum sweeps the temp).
+                _faults.fault_point(_fltn.ARTIFACTS_WRITE)
+                try:
+                    os.link(tmp, path)
+                    won = True
+                except FileExistsError:
+                    won = False  # concurrent publisher won; same bytes
+                if sp is not None:
+                    sp.attrs["nbytes"] = len(payload)
+                    sp.attrs["published"] = won
+            if won:
+                with self._lock:
+                    self.persists += 1
+                    self.persist_bytes += len(payload)
+                self._emit_event(
+                    "persist", digest, fields, nbytes=len(payload))
+                self._evict_over_budget()
+            return won
+        except Exception:
+            return False
+        finally:
+            if tmp is not None:
+                try:
+                    os.unlink(tmp)
+                except OSError:
+                    pass
+
+    def load(self, fields: Dict[str, str]):
+        """The compiled executable for this key, or None (silent MISS).
+        A corrupt/truncated/mismatched blob is the r14 spill-corrupt
+        ladder: miss + evict + typed event — never an error, never a
+        wrong answer."""
+        from ..robustness import fault_names as _fltn
+        from ..robustness import faults as _faults
+        from ..telemetry import span_names as SN
+        from ..telemetry import trace as _trace
+        digest = key_digest(fields)
+        path = self.blob_path(digest)
+        with _trace.span(SN.ARTIFACT_LOAD) as sp:
+            try:
+                _faults.fault_point(_fltn.ARTIFACTS_READ)
+                with open(path, "rb") as f:
+                    data = f.read()
+            except Exception:
+                # Absent (the common cold miss) or an injected/transient
+                # read failure: plain miss, nothing to evict.
+                self._miss(sp, digest, fields, reason="absent")
+                return None
+            try:
+                head, sep, payload = data.partition(b"\n")
+                if not sep:
+                    raise ValueError("truncated header")
+                header = json.loads(head.decode("utf-8"))
+                for k in _KEY_FIELDS:
+                    if str(header.get(k)) != str(fields.get(k, "")):
+                        raise ValueError(f"key field {k!r} mismatch")
+                if header.get("nbytes") != len(payload) \
+                        or header.get("md5") != hashing.md5_hex(payload):
+                    raise ValueError("payload checksum mismatch")
+                compiled = _deserialize_compiled(payload)
+            except Exception:
+                self._quarantine(path)
+                _faults.note(artifact_corruptions=1)
+                self._miss(sp, digest, fields, reason="corrupt")
+                return None
+            with self._lock:
+                self.hits += 1
+            if sp is not None:
+                sp.attrs["hit"] = True
+                sp.attrs["nbytes"] = len(payload)
+            self._emit_event("hit", digest, fields, nbytes=len(payload))
+            return compiled
+
+    def load_by_digest(self, digest: str):
+        """Preload-path load: the key comes from the blob's own header
+        (verified against the filename digest), not from a live compile
+        site. Returns (compiled, payload bytes) or None — a header
+        whose runtime env differs from ours is skipped silently (vacuum
+        removes it); anything inconsistent is the corrupt ladder."""
+        path = self.blob_path(digest)
+        try:
+            with open(path, "rb") as f:
+                data = f.read()
+        except OSError:
+            return None
+        try:
+            head, sep, payload = data.partition(b"\n")
+            if not sep:
+                raise ValueError("truncated header")
+            header = json.loads(head.decode("utf-8"))
+            fields = {k: str(header.get(k, "")) for k in _KEY_FIELDS}
+            if key_digest(fields) != digest:
+                raise ValueError("header does not match filename digest")
+            env = runtime_env()
+            if (fields["jax"], fields["jaxlib"], fields["backend"]) != \
+                    (env["jax"], env["jaxlib"], env["backend"]):
+                return None  # loadable only by the runtime that made it
+            if header.get("nbytes") != len(payload) \
+                    or header.get("md5") != hashing.md5_hex(payload):
+                raise ValueError("payload checksum mismatch")
+            compiled = _deserialize_compiled(payload)
+        except Exception:
+            from ..robustness import faults as _faults
+            self._quarantine(path)
+            _faults.note(artifact_corruptions=1)
+            self._miss(None, digest, {}, reason="corrupt")
+            return None
+        with self._lock:
+            self.hits += 1
+        return compiled, len(payload)
+
+    def _miss(self, sp, digest: str, fields: Dict[str, str],
+              reason: str) -> None:
+        with self._lock:
+            self.misses += 1
+            if reason == "corrupt":
+                self.corrupt += 1
+        if sp is not None:
+            sp.attrs["hit"] = False
+            sp.attrs["reason"] = reason
+        self._emit_event("miss", digest, fields, reason=reason)
+
+    @staticmethod
+    def _quarantine(path: str) -> None:
+        try:
+            os.unlink(path)
+        except OSError:
+            pass  # already evicted by a concurrent loader
+
+    # ------------------------------------------------------------------
+    # Usage tallies (the preload ordering input, persisted).
+    # ------------------------------------------------------------------
+
+    def record_use(self, digest: str) -> None:
+        """Bump one artifact's tally; flushed to the sidecar at most
+        every ``usage.flushMs`` (the r20 bugfix: bank hit tallies used
+        to die with the process, so a restart had no preload order)."""
+        with self._lock:
+            self._usage_seq += 1
+            entry = self._usage.setdefault(digest, [0, 0])
+            entry[0] += 1
+            entry[1] = self._usage_seq
+            self._dirty = True
+            due = (time.monotonic() - self._last_flush) * 1000.0 \
+                >= self.usage_flush_ms
+        if due:
+            self.flush_usage()
+
+    def flush_usage(self, force: bool = False) -> None:
+        """Merge in-memory tallies with the on-disk sidecar and replace
+        it atomically. Counts merge by max (same-process restarts and
+        sibling processes both re-count from their own loads; max keeps
+        the hottest observed tally without double-adding)."""
+        with self._lock:
+            if not self._dirty and not force:
+                return
+            mine = {k: list(v) for k, v in self._usage.items()}
+            self._dirty = False
+            self._last_flush = time.monotonic()
+        disk = self._read_usage_file()
+        for k, v in disk.items():
+            cur = mine.get(k)
+            if cur is None:
+                mine[k] = list(v)
+            else:
+                mine[k] = [max(cur[0], v[0]), max(cur[1], v[1])]
+        try:
+            atomic_write_bytes(
+                os.path.join(self.version_dir, USAGE_FILE),
+                json.dumps({"version": 1, "tallies": mine},
+                           sort_keys=True).encode("utf-8"),
+                tmp_prefix=TMP_PREFIX)
+        except OSError:
+            pass  # tallies are advisory; never fail the serving path
+
+    def _read_usage_file(self) -> Dict[str, List[int]]:
+        try:
+            with open(os.path.join(self.version_dir, USAGE_FILE),
+                      "rb") as f:
+                raw = json.loads(f.read().decode("utf-8"))
+            return {str(k): [int(v[0]), int(v[1])]
+                    for k, v in dict(raw.get("tallies", {})).items()}
+        except Exception:
+            return {}  # absent or corrupt sidecar: start cold
+
+    def _load_usage_locked(self) -> None:
+        self._usage = self._read_usage_file()
+        self._usage_seq = max(
+            [v[1] for v in self._usage.values()], default=0)
+
+    def usage_order(self) -> List[str]:
+        """Resident blob digests, hottest first (count, then recency) —
+        the preload order."""
+        with self._lock:
+            tallies = {k: tuple(v) for k, v in self._usage.items()}
+        out = []
+        for digest, _nbytes in self._list_blobs():
+            out.append((tallies.get(digest, (0, 0)), digest))
+        out.sort(key=lambda t: (t[0][0], t[0][1]), reverse=True)
+        return [d for _t, d in out]
+
+    # ------------------------------------------------------------------
+    # Budget eviction + vacuum.
+    # ------------------------------------------------------------------
+
+    def _list_blobs(self) -> List[Tuple[str, int]]:
+        out: List[Tuple[str, int]] = []
+        try:
+            names = os.listdir(self.version_dir)
+        except OSError:
+            return out
+        for name in sorted(names):
+            if not name.endswith(BLOB_SUFFIX):
+                continue
+            try:
+                nbytes = os.path.getsize(
+                    os.path.join(self.version_dir, name))
+            except OSError:
+                continue  # concurrently evicted
+            out.append((name[:-len(BLOB_SUFFIX)], nbytes))
+        return out
+
+    def total_bytes(self) -> int:
+        return sum(n for _d, n in self._list_blobs())
+
+    def _evict_over_budget(self) -> List[str]:
+        """Delete coldest-first until resident bytes fit the budget.
+        Safe against concurrent loaders: a loader that opened the file
+        before the unlink keeps its bytes (POSIX), one that comes after
+        sees a plain miss."""
+        blobs = self._list_blobs()
+        total = sum(n for _d, n in blobs)
+        if total <= self.max_bytes:
+            return []
+        with self._lock:
+            tallies = {k: tuple(v) for k, v in self._usage.items()}
+        order = sorted(blobs,
+                       key=lambda t: tallies.get(t[0], (0, 0)))
+        evicted = []
+        for digest, nbytes in order:
+            if total <= self.max_bytes:
+                break
+            try:
+                os.unlink(self.blob_path(digest))
+            except OSError:
+                continue
+            total -= nbytes
+            evicted.append(digest)
+            with self._lock:
+                self.evictions += 1
+                self._usage.pop(digest, None)
+                self._dirty = True
+            self._emit_event("evict", digest, None, nbytes=nbytes)
+        if evicted:
+            self.flush_usage(force=True)
+        return evicted
+
+    def vacuum(self) -> Dict:
+        """The maintenance sweep riding ``Hyperspace.compact()`` /
+        ``recover()``: crashed publication temps, blobs no current
+        runtime can ever load (other format/jax/jaxlib/backend —
+        unreferenced by construction), unparseable blobs, sidecar
+        entries with no blob, then the byte budget."""
+        summary: Dict = {"tmp_removed": 0, "stale_removed": 0,
+                         "corrupt_removed": 0, "evicted": 0}
+        env = runtime_env()
+        try:
+            names = os.listdir(self.version_dir)
+        except OSError:
+            return summary
+        for name in sorted(names):
+            path = os.path.join(self.version_dir, name)
+            if name.startswith(TMP_PREFIX):
+                self._quarantine(path)
+                summary["tmp_removed"] += 1
+                continue
+            if not name.endswith(BLOB_SUFFIX):
+                continue
+            header = self._read_header(path)
+            if header is None:
+                self._quarantine(path)
+                summary["corrupt_removed"] += 1
+            elif (str(header.get("format"))
+                    != str(ARTIFACT_FORMAT_VERSION)
+                    or header.get("jax") != env["jax"]
+                    or header.get("jaxlib") != env["jaxlib"]
+                    or header.get("backend") != env["backend"]):
+                self._quarantine(path)
+                summary["stale_removed"] += 1
+        live = {d for d, _n in self._list_blobs()}
+        with self._lock:
+            for digest in list(self._usage):
+                if digest not in live:
+                    self._usage.pop(digest, None)
+                    self._dirty = True
+        summary["evicted"] = len(self._evict_over_budget())
+        self.flush_usage(force=True)
+        return summary
+
+    @staticmethod
+    def _read_header(path: str) -> Optional[dict]:
+        try:
+            with open(path, "rb") as f:
+                head = f.readline(1 << 16)
+            return json.loads(head.decode("utf-8"))
+        except Exception:
+            return None
+
+    # ------------------------------------------------------------------
+    # Observability.
+    # ------------------------------------------------------------------
+
+    def _emit_event(self, what: str, digest: str,
+                    fields: Optional[Dict[str, str]], nbytes: int = 0,
+                    reason: str = "") -> None:
+        """One typed event per store decision, through the active query
+        context's logger (the ProgramBank._emit pattern); store work
+        outside any query — warmup, vacuum — stays silent and is
+        summarized by its caller instead."""
+        from ..serving.context import active_context
+        ctx = active_context()
+        if ctx is None or ctx.session is None:
+            return
+        try:
+            from ..telemetry.events import (ArtifactEvictEvent,
+                                            ArtifactHitEvent,
+                                            ArtifactMissEvent,
+                                            ArtifactPersistEvent)
+            from ..telemetry.logging import get_logger
+            cls = {"hit": ArtifactHitEvent, "miss": ArtifactMissEvent,
+                   "persist": ArtifactPersistEvent,
+                   "evict": ArtifactEvictEvent}[what]
+            kw = dict(message=f"artifact {what} {digest}",
+                      key_digest=digest, nbytes=nbytes,
+                      kind=(fields or {}).get("kind", ""))
+            if what == "miss":
+                kw["reason"] = reason
+            get_logger(ctx.session.hs_conf.event_logger_class())\
+                .log_event(cls(**kw))
+        except Exception:
+            pass  # observability must never fail an execution
+
+    def stats(self) -> dict:
+        with self._lock:
+            out = {
+                "hits": self.hits,
+                "misses": self.misses,
+                "corrupt": self.corrupt,
+                "persists": self.persists,
+                "persist_bytes": self.persist_bytes,
+                "evictions": self.evictions,
+                "tallies": len(self._usage),
+            }
+        blobs = self._list_blobs()
+        out["blobs"] = len(blobs)
+        out["resident_bytes"] = sum(n for _d, n in blobs)
+        return out

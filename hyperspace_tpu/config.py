@@ -15,6 +15,7 @@ from typing import Any, Callable, Dict, Generic, Optional, TypeVar
 
 from .adaptive.constants import AdaptiveConstants
 from .advisor.constants import AdvisorConstants
+from .artifacts.constants import ArtifactConstants
 from .index.constants import IndexConstants
 from .optimizer.constants import OptimizerConstants
 from .robustness.constants import RobustnessConstants
@@ -737,6 +738,40 @@ class HyperspaceConf:
             AdaptiveConstants.ADMISSION_SAMPLE_FRACTION,
             AdaptiveConstants.ADMISSION_SAMPLE_FRACTION_DEFAULT)),
             0.01), 1.0)
+
+    def artifacts_enabled(self) -> bool:
+        return self._get_bool(
+            ArtifactConstants.ENABLED, ArtifactConstants.ENABLED_DEFAULT)
+
+    def artifacts_dir(self) -> str:
+        return (self._conf.get(
+            ArtifactConstants.DIR, ArtifactConstants.DIR_DEFAULT)
+            or "").strip()
+
+    def artifacts_max_bytes(self) -> int:
+        return max(int(self._conf.get(
+            ArtifactConstants.MAX_BYTES,
+            ArtifactConstants.MAX_BYTES_DEFAULT)), 0)
+
+    def artifacts_preload_enabled(self) -> bool:
+        return self.artifacts_enabled() and self._get_bool(
+            ArtifactConstants.PRELOAD_ENABLED,
+            ArtifactConstants.PRELOAD_ENABLED_DEFAULT)
+
+    def artifacts_preload_max_ms(self) -> float:
+        return max(float(self._conf.get(
+            ArtifactConstants.PRELOAD_MAX_MS,
+            ArtifactConstants.PRELOAD_MAX_MS_DEFAULT)), 0.0)
+
+    def artifacts_preload_max_bytes(self) -> int:
+        return max(int(self._conf.get(
+            ArtifactConstants.PRELOAD_MAX_BYTES,
+            ArtifactConstants.PRELOAD_MAX_BYTES_DEFAULT)), 0)
+
+    def artifacts_usage_flush_ms(self) -> float:
+        return max(float(self._conf.get(
+            ArtifactConstants.USAGE_FLUSH_MS,
+            ArtifactConstants.USAGE_FLUSH_MS_DEFAULT)), 0.0)
 
     def _get_bool(self, key: str, default: str) -> bool:
         return (self._conf.get(key, default) or "").strip().lower() == "true"

@@ -52,6 +52,7 @@ from __future__ import annotations
 import threading
 from typing import Dict, List, Optional, Set, Tuple
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -381,12 +382,19 @@ def _dict_fp(dic: Optional[np.ndarray]):
     return tuple(dic.tolist())
 
 
+def _empty_device(dtype) -> jax.Array:
+    """Zero-length device array WITHOUT a compile: jnp.zeros lowers a
+    one-off broadcast_in_dim/convert program per dtype (the first thing a
+    cold boot would pay for), device_put of a host array is a transfer."""
+    return jax.device_put(np.zeros(0, dtype))
+
+
 def _tiny(meta: Dict[str, Tuple[str, Optional[np.ndarray], bool]]
           ) -> Dict[str, Column]:
     """Zero-length columns carrying (dtype, dictionary, nullability) —
     the metadata-propagation trick the SPMD prep walk uses."""
-    return {n: Column(dt, jnp.zeros(0, _DEVICE_DTYPE[dt]),
-                      jnp.zeros(0, jnp.bool_) if nul else None, dic)
+    return {n: Column(dt, _empty_device(_DEVICE_DTYPE[dt]),
+                      _empty_device(np.bool_) if nul else None, dic)
             for n, (dt, dic, nul) in meta.items()}
 
 
@@ -422,6 +430,7 @@ class _SidePrep:
 def _prepare_side(node: Join, pair, tiny: Dict[str, Column],
                   right_needed: Set[str], ex) -> Tuple[_SidePrep, tuple]:
     """Execute + key-sort one join side; returns (prep, descriptor)."""
+    from ..ops import kernels
     lname, rname = pair
     jt = node.join_type
     keys_only = jt in ("semi", "anti")
@@ -452,13 +461,13 @@ def _prepare_side(node: Join, pair, tiny: Dict[str, Column],
         if not (jnp.issubdtype(promo, jnp.integer)
                 or jnp.issubdtype(promo, jnp.floating)):
             raise _FuseFallback(FB.KEY_DTYPE, node)
-        codes = rk.data.astype(promo)
-    from ..ops import kernels
+        codes = rk.data if rk.data.dtype == promo \
+            else kernels.cast_array(rk.data, promo)
     order = kernels.lex_sort_indices([codes], pad=False)
-    codes = jnp.take(codes, order)
+    codes = kernels.gather_arrays(order, (codes,))[0]
     n_side = int(codes.shape[0])
     if jt == "inner" and n_side > 1 \
-            and bool(jnp.any(codes[1:] == codes[:-1])):  # HOST SYNC (bool)
+            and bool(kernels.has_adjacent_duplicates(codes)):  # HOST SYNC
         # m:n join: the mask-streaming program cannot expand matches —
         # the staged merge join owns it.
         raise _FuseFallback(FB.DUPLICATE_PROBE_KEYS, node)
@@ -853,8 +862,8 @@ def _execute_region(region: _Region, needed: Optional[Set[str]],
                 for n in prep.col_order:
                     c = prep.cols[n]
                     tiny[n] = Column(
-                        c.dtype, jnp.zeros(0, _DEVICE_DTYPE[c.dtype]),
-                        jnp.zeros(0, jnp.bool_)
+                        c.dtype, _empty_device(_DEVICE_DTYPE[c.dtype]),
+                        _empty_device(np.bool_)
                         if c.validity is not None else None,
                         c.dictionary)
         if region.agg is not None:
@@ -1018,14 +1027,17 @@ def _finish_grouped(agg: Aggregate, spec: _RegionSpec, out,
             if f.name in final_meta and final_meta[f.name][0] == STRING:
                 dic = final_meta[f.name][1]
             cols[f.name] = Column(
-                dt, jnp.zeros(0, _DEVICE_DTYPE[dt]), None, dic)
+                dt, _empty_device(_DEVICE_DTYPE[dt]), None, dic)
         return Table(cols)
     cls = shapes.padded_length(ng)
     out_valid = ng if cls != ng else None
+    from ..ops import kernels
 
     def fit(arr):
-        if int(arr.shape[0]) >= cls:
-            return arr[:cls]
+        if int(arr.shape[0]) == cls:
+            return arr
+        if int(arr.shape[0]) > cls:
+            return kernels.slice_arrays((arr,), 0, cls)[0]
         return shapes.pad_to(arr, cls)
 
     cols = {}

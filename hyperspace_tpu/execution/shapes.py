@@ -145,8 +145,10 @@ def _is_tracer(x) -> bool:
 
 def pad_to(arr, target: int, fill=0):
     """Pad a 1-D array to ``target`` with ``fill``. Host numpy pads on host
-    (no compile); device arrays use one lax.pad (one tiny program per
-    (length, class, dtype) — vs one per op in the downstream chain)."""
+    (no compile); device arrays go through the banked pad kernel (one
+    program per (length, class, dtype) — vs one per op in the downstream
+    chain — that the artifact store can persist across boots); tracers
+    (SPMD prep walks) stay on the in-trace lax.pad."""
     n = int(arr.shape[0])
     if target <= n:
         return arr
@@ -155,8 +157,11 @@ def pad_to(arr, target: int, fill=0):
         out[:n] = arr
         out[n:] = fill
         return out
-    pad_scalar = jnp.asarray(fill, arr.dtype)
-    return jax.lax.pad(arr, pad_scalar, [(0, target - n, 0)])
+    if _is_tracer(arr):
+        pad_scalar = jnp.asarray(fill, arr.dtype)
+        return jax.lax.pad(arr, pad_scalar, [(0, target - n, 0)])
+    from ..ops import kernels
+    return kernels.pad_array(arr, fill, target)
 
 
 def pad_class(arr, fill=0, params: Optional[ShapeParams] = None):
@@ -171,7 +176,10 @@ def unpad(arr, n: int):
     """First ``n`` entries (the valid prefix) of a padded array."""
     if int(arr.shape[0]) == int(n):
         return arr
-    return arr[:n]
+    if isinstance(arr, np.ndarray) or _is_tracer(arr):
+        return arr[:n]
+    from ..ops import kernels
+    return kernels.slice_arrays((arr,), 0, int(n))[0]
 
 
 def valid_mask(target: int, n: int):

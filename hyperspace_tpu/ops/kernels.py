@@ -46,6 +46,22 @@ from ..schema import BOOL, DATE, FLOAT32, FLOAT64, INT32, INT64, STRING
 _M32 = np.uint32(0xFFFFFFFF)  # numpy scalar: no device alloc at import time
 
 
+def _aot_kernel(label: str, jitted):
+    """Route a module-level jitted utility kernel through the artifact
+    store's AOT seam (artifacts/manager.py AotKernel): sessions that
+    enable ``hyperspace.tpu.artifacts.enabled`` import/export these
+    executables through the lake like banked stages, so a cold boot's
+    op-by-op compile tail (gather, mask count, slice...) preloads too.
+    Off sessions pay one manager probe and run the jitted original.
+    CONVENTION at every wrapped call site: positional arguments are
+    dynamic, keyword arguments are static."""
+    try:
+        from ..artifacts.manager import wrap_kernel
+        return wrap_kernel(label, jitted)
+    except Exception:
+        return jitted
+
+
 def _dtype_max(dtype):
     """Largest finite-orderable value of ``dtype`` (searchsorted sentinel:
     pads must not sort below any real key; ties are neutralized by
@@ -78,6 +94,9 @@ def _sort_perm(operands: Tuple[jax.Array, ...], n,
         num_keys += 1
     out = jax.lax.sort(ops + [iota], num_keys=num_keys, is_stable=True)
     return out[-1]
+
+
+_sort_perm = _aot_kernel("sort_perm", _sort_perm)
 
 
 @jax.jit
@@ -342,9 +361,15 @@ def _masked_count(mask: jax.Array, n) -> Tuple[jax.Array, jax.Array]:
     return mask, jnp.sum(mask)
 
 
+_masked_count = _aot_kernel("masked_count", _masked_count)
+
+
 @partial(jax.jit, static_argnames=("size",))
 def _nonzero_pad(mask: jax.Array, size: int) -> jax.Array:
     return jnp.flatnonzero(mask, size=size, fill_value=0)
+
+
+_nonzero_pad = _aot_kernel("nonzero_pad", _nonzero_pad)
 
 
 def mask_count_nonzero(mask, valid_rows: Optional[int], padded: bool):
@@ -479,6 +504,9 @@ def _kleene_jit(ld, lv, rd, rv, is_and: bool):
     return true, true | false
 
 
+_kleene_jit = _aot_kernel("kleene", _kleene_jit)
+
+
 def kleene_and_or(ld, lv, rd, rv, is_and: bool):
     if shapes._is_tracer(ld):  # SPMD evaluates expressions inside its jit
         n = ld.shape[0]
@@ -509,9 +537,59 @@ def _gather_jit(indices, arrays: Tuple[jax.Array, ...]):
     return tuple(jnp.take(a, indices, axis=0, mode="clip") for a in arrays)
 
 
+_gather_jit = _aot_kernel("gather", _gather_jit)
+
+
 @partial(jax.jit, static_argnames=("start", "stop"))
 def _slice_jit(arrays: Tuple[jax.Array, ...], start: int, stop: int):
     return tuple(a[start:stop] for a in arrays)
+
+
+_slice_jit = _aot_kernel("slice", _slice_jit)
+
+
+@partial(jax.jit, static_argnames=("target",))
+def _pad_jit(arr, fill, target: int):
+    return jax.lax.pad(arr, jnp.asarray(fill, arr.dtype),
+                       [(0, target - arr.shape[0], 0)])
+
+
+_pad_jit = _aot_kernel("pad", _pad_jit)
+
+
+def pad_array(arr, fill, target: int):
+    """shapes.pad_to device back-end: ONE program per (class, dtype,
+    fill signature) — the eager spelling paid a convert + a pad program
+    and neither survived a process restart."""
+    return _pad_jit(arr, fill, target=target)
+
+
+@jax.jit
+def _adjacent_dup_jit(codes: jax.Array) -> jax.Array:
+    return jnp.any(codes[1:] == codes[:-1])
+
+
+_adjacent_dup_jit = _aot_kernel("adjacent_dup", _adjacent_dup_jit)
+
+
+def has_adjacent_duplicates(codes) -> jax.Array:
+    """True iff a SORTED key vector has equal neighbors (the fused-join
+    m:n probe-side check): two slices + eq + any fused in one program."""
+    return _adjacent_dup_jit(codes)
+
+
+@partial(jax.jit, static_argnames=("dtype",))
+def _cast_jit(arr, dtype: str):
+    return arr.astype(jnp.dtype(dtype))
+
+
+_cast_jit = _aot_kernel("cast", _cast_jit)
+
+
+def cast_array(arr, dtype):
+    """Dtype cast as one banked program (callers should skip the call
+    entirely when the dtype already matches)."""
+    return _cast_jit(arr, dtype=jnp.dtype(dtype).name)
 
 
 def slice_arrays(arrays, start: int, stop: int):
@@ -524,7 +602,7 @@ def slice_arrays(arrays, start: int, stop: int):
     arrays = tuple(arrays)
     if any(shapes._is_tracer(a) for a in arrays):
         return tuple(a[start:stop] for a in arrays)
-    return _slice_jit(arrays, start, stop)
+    return _slice_jit(arrays, start=start, stop=stop)
 
 
 # ---------------------------------------------------------------------------

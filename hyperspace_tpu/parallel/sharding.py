@@ -169,11 +169,18 @@ class MeshProgram:
     the compiled executable and its HLO collective counts are retained.
     """
 
-    def __init__(self, fn: Callable, name: str = "spmd"):
+    def __init__(self, fn: Callable, name: str = "spmd",
+                 artifact_key: tuple = None):
         self._fn = fn
         self._name = name
+        # The bank key ("spmd", name, static fingerprint, mesh
+        # signature) when registered via bank_program — the identity
+        # the artifact store persists executables under. None = no
+        # persistence (ad-hoc MeshPrograms in tests).
+        self._artifact_key = artifact_key
         self._lock = threading.Lock()
-        # shape signature -> [compiled, collective counts or None].
+        # shape signature -> [compiled, collective counts or None,
+        # artifact digest or None, loaded-from-artifact flag].
         # Counts are computed LAZILY on the first collectives() ask:
         # compiled.as_text() renders multi-MB HLO for wide meshes, and
         # paying that on the dispatch path would tax every cold query
@@ -189,6 +196,27 @@ class MeshProgram:
         leaves, treedef = jax.tree_util.tree_flatten(args)
         return (treedef, tuple(leaf(x) for x in leaves))
 
+    def _artifact_seam(self, sig):
+        """(manager, key fields, digest) when the ACTIVE session
+        persists artifacts and this program carries a bank key;
+        (None, None, None) otherwise — including on any artifacts-layer
+        trouble, which must never cost an SPMD dispatch."""
+        if self._artifact_key is None:
+            return None, None, None
+        try:
+            from ..artifacts.manager import active_manager
+            from ..artifacts.store import key_digest, key_fields
+            mgr = active_manager()
+            if mgr is None:
+                return None, None, None
+            mesh_sig = self._artifact_key[3] \
+                if len(self._artifact_key) > 3 else ""
+            fields = key_fields("spmd", repr(self._artifact_key),
+                                repr(sig), mesh_repr=repr(mesh_sig))
+            return mgr, fields, key_digest(fields)
+        except Exception:
+            return None, None, None
+
     def _get(self, args) -> list:
         sig = self._sig(args)
         entry = self._compiled.get(sig)
@@ -202,6 +230,16 @@ class MeshProgram:
                 from ..robustness import faults as _faults
                 from ..telemetry import span_names as _sn
                 from ..telemetry import trace as _tr
+                # Artifact store probe (r20): a lake hit skips the
+                # compile entirely — COMPILE_COUNT stays flat, which is
+                # the cold-boot acceptance signal.
+                mgr, fields, digest = self._artifact_seam(sig)
+                if mgr is not None:
+                    compiled = mgr.fetch(fields)
+                    if compiled is not None:
+                        entry = [compiled, None, digest, True]
+                        self._compiled[sig] = entry
+                        return entry
                 # Robustness fault point: an injected compile failure
                 # propagates to the dispatch site, where the executor's
                 # SPMD->single-device degradation ladder absorbs it.
@@ -211,17 +249,56 @@ class MeshProgram:
                     # inputs; device_view pins every internal layout with
                     # with_sharding_constraint (see module docstring).
                     compiled = jax.jit(self._fn).lower(*args).compile()
-                entry = [compiled, None]
+                entry = [compiled, None, digest, False]
                 self._compiled[sig] = entry
                 with _COUNT_LOCK:
                     COMPILE_COUNT += 1
+                if mgr is not None:
+                    mgr.put(fields, compiled)
         return entry
 
     def __call__(self, *args):
         global DISPATCH_COUNT
         with _COUNT_LOCK:
             DISPATCH_COUNT += 1
-        return self._get(args)[0](*args)
+        entry = self._get(args)
+        try:
+            out = entry[0](*args)
+        except Exception:
+            if not entry[3]:
+                raise
+            # A lake-loaded executable failed at dispatch — the corrupt
+            # ladder's last rung: evict it everywhere, compile fresh,
+            # answer exactly.
+            self._evict_artifact(args, entry[2])
+            entry = self._get(args)
+            out = entry[0](*args)
+        if entry[2] is not None:
+            self._note_use(entry[2])
+        return out
+
+    def _evict_artifact(self, args, digest) -> None:
+        sig = self._sig(args)
+        with self._lock:
+            self._compiled.pop(sig, None)
+        try:
+            from ..artifacts.manager import active_manager
+            mgr = active_manager()
+            if mgr is not None and digest is not None:
+                mgr.discard(digest)
+        except Exception:
+            pass  # eviction is best-effort; the recompile is the fix
+
+    @staticmethod
+    def _note_use(digest: str) -> None:
+        """Per-dispatch usage tally (the preload ordering input)."""
+        try:
+            from ..artifacts.manager import active_manager
+            mgr = active_manager()
+            if mgr is not None:
+                mgr.note_use(digest)
+        except Exception:
+            pass  # tallies are advisory
 
     def signature(self, args) -> tuple:
         """The shape signature of an argument tuple — retain THIS (not
@@ -277,4 +354,5 @@ def bank_program(name: str, mesh: Mesh, static_key: tuple, args: tuple,
     from ..serving.program_bank import get_bank
     key = ("spmd", name, static_key, mesh_signature(mesh))
     return get_bank().lookup(key, shape_vector(args),
-                             lambda: MeshProgram(build(), name))
+                             lambda: MeshProgram(build(), name,
+                                                 artifact_key=key))

@@ -74,10 +74,19 @@ SERVING_WORKER = "serving.worker"
 INGEST_STAGE = "ingest.stage"
 INGEST_PUBLISH = "ingest.publish"
 
+# Artifact-store boundaries (artifacts/store.py). ARTIFACTS_WRITE fires
+# between the publication temp write and the link-into-place — the
+# kill -9 harness strikes here to prove no torn blob is ever loadable;
+# an injected error costs only persistence. ARTIFACTS_READ fires before
+# the blob read: injected errors must be silent misses (a normal
+# compile follows), never query failures.
+ARTIFACTS_WRITE = "artifacts.write"
+ARTIFACTS_READ = "artifacts.read"
+
 FAULT_NAMES = frozenset({
     IO_POOLED_READ, IO_PREFETCH_PRODUCE, SCAN_PARQUET_DECODE,
     SPMD_DISPATCH, SPMD_COMPILE, BANK_COMPILE,
     RESULT_CACHE_DEVICE_PUT, RESULT_CACHE_SPILL_READ,
     LOG_WRITE, LOG_STABLE, ACTION_OP, SERVING_WORKER,
-    INGEST_STAGE, INGEST_PUBLISH,
+    INGEST_STAGE, INGEST_PUBLISH, ARTIFACTS_WRITE, ARTIFACTS_READ,
 })

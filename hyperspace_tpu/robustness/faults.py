@@ -275,6 +275,7 @@ _COUNTER_KEYS = (
     "degraded_bank_compile",   # bank-compile faults -> uncached eager
     "degraded_device_put",     # device-tier put faults -> host tier
     "spill_corruptions",       # corrupt spill files served as misses
+    "artifact_corruptions",    # corrupt artifact blobs served as misses
     "member_fallbacks",        # sweep members re-run standalone
     "worker_releases",         # entries released from a dying worker
     "recovered_indexes",       # transient op-log states rolled back
@@ -311,7 +312,8 @@ _STATS = _Stats()
 _TAIL_KEEP_KEYS = frozenset({
     "injected", "retries", "retry_failures", "deadline_cancellations",
     "degraded_spmd", "degraded_bank_compile", "degraded_device_put",
-    "spill_corruptions", "member_fallbacks", "worker_releases",
+    "spill_corruptions", "artifact_corruptions", "member_fallbacks",
+    "worker_releases",
 })
 # The subset that flips the active QueryContext's ``degraded`` flag
 # (the SLO degrade-rate objective's per-query signal).

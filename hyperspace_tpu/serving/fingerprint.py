@@ -156,16 +156,20 @@ def config_hash(session) -> str:
     ladders produce byte-identical answers or typed errors, never a
     different answer — asserted in tests/test_robustness.py), or pure
     execution strategy (whole-plan fusion answers byte-identical to
-    staged execution — asserted in tests/test_fusion.py) — hashing them
-    would orphan every warm entry on an admission-threshold tweak, a
-    tracing toggle, a fault (dis)arming, or a fusion toggle, breaking
-    config.py's live-tuning contract."""
+    staged execution — asserted in tests/test_fusion.py; the artifact
+    store serves the same compiled programs from the lake instead of
+    recompiling, byte-identical by the AOT contract — asserted in
+    tests/test_artifacts.py) — hashing them would orphan every warm
+    entry on an admission-threshold tweak, a tracing toggle, a fault
+    (dis)arming, or a fusion/artifacts toggle, breaking config.py's
+    live-tuning contract."""
     items = [(k, v) for k, v in sorted(session.conf.as_dict().items())
              if not k.startswith("serving.")
              and not k.startswith("hyperspace.tpu.serving.")
              and not k.startswith("hyperspace.tpu.telemetry.")
              and not k.startswith("hyperspace.tpu.robustness.")
-             and not k.startswith("hyperspace.tpu.execution.fusion.")]
+             and not k.startswith("hyperspace.tpu.execution.fusion.")
+             and not k.startswith("hyperspace.tpu.artifacts.")]
     return hashing.md5_hex((items, session.is_hyperspace_enabled()))
 
 

@@ -78,6 +78,13 @@ class ProgramBank:
                         sp.attrs["hit"] = False
                         sp.attrs["degraded"] = True
                     return fn
+                # Artifact seam (r20): when the active session enables
+                # the persistent store, the freshly built jit wrapper
+                # registers wrapped for AOT export/import; off = the
+                # wrapper registers untouched (byte-identical, asserted
+                # in tests/test_artifacts.py). SPMD stages pass through
+                # — MeshProgram owns its own compile seam.
+                fn = self._maybe_aot(stage_key, fn)
                 # shape vector -> times this program was looked up again
                 # after registration (0 = registered, never reused yet).
                 self._stages[stage_key] = (fn, {shape_vec: 0})
@@ -100,6 +107,17 @@ class ProgramBank:
                 sp.attrs["hit"] = hit
         self._emit(stage_key, shape_vec, hit=hit, first_reuse=first_reuse)
         return fn
+
+    @staticmethod
+    def _maybe_aot(stage_key: tuple, fn: Callable) -> Callable:
+        """The artifact store's registration hook, failure-proofed: the
+        bank must keep serving (unwrapped) even if the artifacts
+        package cannot (mis-configured store root, import trouble)."""
+        try:
+            from ..artifacts.manager import maybe_wrap_stage
+            return maybe_wrap_stage(stage_key, fn)
+        except Exception:
+            return fn
 
     @staticmethod
     def _build(factory: Callable[[], Callable]):

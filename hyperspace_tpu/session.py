@@ -103,6 +103,17 @@ class Session:
         # query). Read by Hyperspace.last_trace() and explain's
         # "Trace:" section.
         self._last_trace = None
+        # Artifact boot preload (r20, opt-in): warm the compiled-program
+        # caches from the lake's AOT store, usage-ordered, within the
+        # preload.maxMs/maxBytes budgets — so THIS process reaches its
+        # first query with compile count ~ 0. Strictly best-effort: a
+        # session must come up even with an unreadable artifact dir.
+        if self.hs_conf.artifacts_preload_enabled():
+            try:
+                from .artifacts.manager import preload as _artifact_preload
+                _artifact_preload(self)
+            except Exception:
+                pass
 
     # The reason collector of the calling thread's most recent rewrite
     # pass. Plain attribute syntax everywhere (apply_hyperspace writes,

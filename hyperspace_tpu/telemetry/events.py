@@ -499,3 +499,43 @@ class AdaptiveActionEvent(HyperspaceEvent):
     action: str = ""
     subject: str = ""
     detail: str = ""
+
+
+@dataclass
+class ArtifactEvent(HyperspaceEvent):
+    """Base of the compiled-program artifact store events
+    (artifacts/store.py). ``key_digest`` is the blob filename digest
+    (the full key's stable short form); ``kind`` is "bank" | "spmd";
+    ``nbytes`` the serialized payload size where the store knows it."""
+
+    key_digest: str = ""
+    kind: str = ""
+    nbytes: int = 0
+
+
+@dataclass
+class ArtifactHitEvent(ArtifactEvent):
+    """A lake blob deserialized into a live executable — a backend
+    compile that did NOT happen."""
+
+
+@dataclass
+class ArtifactMissEvent(ArtifactEvent):
+    """``reason`` is "absent" (cold/stale key, the silent-fallback
+    contract) or "corrupt" (checksum/header/deserialize failure: the
+    blob was evicted and served as a miss — the r14 spill-corrupt
+    ladder applied to programs)."""
+
+    reason: str = ""
+
+
+@dataclass
+class ArtifactPersistEvent(ArtifactEvent):
+    """One executable serialized and published put-if-absent (this
+    process won the publication race)."""
+
+
+@dataclass
+class ArtifactEvictEvent(ArtifactEvent):
+    """A blob deleted to fit ``artifacts.maxBytes`` (coldest first by
+    persisted usage order)."""

@@ -58,11 +58,12 @@ COLLECTOR_ROBUSTNESS = "robustness"
 COLLECTOR_STREAMING = "streaming"
 COLLECTOR_FUSION = "fusion"
 COLLECTOR_FLIGHT_RECORDER = "flight_recorder"
+COLLECTOR_ARTIFACTS = "artifacts"
 
 METRIC_NAMES = frozenset({
     TRACE_SAMPLED, TRACE_TAIL_KEPT, TRACE_DISCARDED, FLIGHT_ANOMALIES,
     SLO_BREACHES, SERVING_SWEEP_INVOCATIONS, SERVING_LATENCY_MS,
     QUERY_LATENCY_MS, COLLECTOR_IO, COLLECTOR_PROGRAM_BANK,
     COLLECTOR_SERVING, COLLECTOR_ROBUSTNESS, COLLECTOR_STREAMING,
-    COLLECTOR_FUSION, COLLECTOR_FLIGHT_RECORDER,
+    COLLECTOR_FUSION, COLLECTOR_FLIGHT_RECORDER, COLLECTOR_ARTIFACTS,
 })

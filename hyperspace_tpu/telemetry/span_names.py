@@ -66,9 +66,18 @@ INGEST_APPEND = "ingest.append"
 INGEST_COMMIT = "ingest.commit"
 INGEST_COMPACT = "ingest.compact"
 
+# Artifact store (artifacts/): one ARTIFACT_LOAD per lake probe (attrs
+# carry hit/reason/nbytes), one ARTIFACT_EXPORT per serialize+publish,
+# one ARTIFACT_WARMUP per boot preload pass (attrs carry loaded count
+# and bytes).
+ARTIFACT_LOAD = "artifact.load"
+ARTIFACT_EXPORT = "artifact.export"
+ARTIFACT_WARMUP = "artifact.warmup"
+
 SPAN_NAMES = frozenset({
     QUERY, PLAN_NORMALIZE, JOIN_REORDER, INDEX_REWRITE, CACHE_LOOKUP,
     BANK_LOOKUP, BANK_COMPILE, EXEC_STAGE, EXEC_FUSED, IO_READ,
     IO_PREFETCH, SPMD_DISPATCH, SPMD_COMPILE, SERVING_SWEEP,
     INGEST_APPEND, INGEST_COMMIT, INGEST_COMPACT,
+    ARTIFACT_LOAD, ARTIFACT_EXPORT, ARTIFACT_WARMUP,
 })

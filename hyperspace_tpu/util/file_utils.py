@@ -45,6 +45,22 @@ def atomic_create(path: str, contents: str) -> bool:
         os.unlink(tmp)
 
 
+def atomic_write_bytes(path: str, contents: bytes,
+                       tmp_prefix: str = ".tmp-") -> None:
+    """Binary :func:`atomic_overwrite` (artifact usage sidecar):
+    atomically replace ``path`` with ``contents`` via fsync'd temp +
+    rename. ``tmp_prefix`` names the temp so a crashed writer's
+    leftover is recognizable to the owning store's vacuum."""
+    directory = os.path.dirname(path)
+    os.makedirs(directory, exist_ok=True)
+    tmp = os.path.join(directory, f"{tmp_prefix}{uuid.uuid4().hex}")
+    with open(tmp, "wb") as f:
+        f.write(contents)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
 def atomic_overwrite(path: str, contents: str) -> None:
     """Atomically replace ``path`` with ``contents`` (for latestStable)."""
     directory = os.path.dirname(path)

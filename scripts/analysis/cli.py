@@ -29,10 +29,12 @@ from . import engine
 
 
 def _print_exemptions() -> None:
-    from . import handoff_pass, hostsync_pass, lock_pass
+    from . import (handoff_pass, hostsync_pass, lock_pass,
+                   serialization_pass)
     lines = (lock_pass.describe_exemptions()
              + hostsync_pass.describe_exemptions()
-             + handoff_pass.describe_exemptions())
+             + handoff_pass.describe_exemptions()
+             + serialization_pass.describe_exemptions())
     print("frozen exemptions (each carries its justification; unused "
           "entries fail lint as HS004):")
     for ln in lines:

@@ -65,6 +65,7 @@ CODES = {
     "HS311": "host sync inside traced code",
     "HS312": "unallowlisted host sync at a jit-adjacent site",
     "HS321": "raw thread handoff of context-dependent work",
+    "HS331": "executable serialization outside the artifact store",
 }
 
 # Raw source text of a suppression directive (engine.py owns parsing).

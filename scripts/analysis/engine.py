@@ -303,7 +303,8 @@ def _save_cache(root: str, env: str, files: dict) -> None:
 def run(root: Optional[str] = None, *, ported_only: bool = False,
         use_cache: bool = True,
         baseline_path: Optional[str] = None) -> Result:
-    from . import handoff_pass, hostsync_pass, lock_pass, ported
+    from . import (handoff_pass, hostsync_pass, lock_pass, ported,
+                   serialization_pass)
     root = DEFAULT_ROOT if root is None else root
     env = _env_fingerprint(root)
     cache = _load_cache(root, env) if use_cache else {}
@@ -338,6 +339,7 @@ def run(root: Optional[str] = None, *, ported_only: bool = False,
                 dataflow_d += lock_pass.check_file(src, ctx)
                 dataflow_d += hostsync_pass.check_file(src, ctx)
                 dataflow_d += handoff_pass.check_file(src, ctx)
+                dataflow_d += serialization_pass.check_file(src, ctx)
             facts["used_exemptions"] = sorted(ctx.pop_file_exemptions())
             new_cache[src.slash_rel] = {
                 "sha": src.sha,
@@ -378,12 +380,14 @@ def _diag_from_cache(d: dict) -> Diagnostic:
 
 
 def _unused_exemptions(ctx: Context) -> List[Diagnostic]:
-    from . import handoff_pass, hostsync_pass, lock_pass
+    from . import (handoff_pass, hostsync_pass, lock_pass,
+                   serialization_pass)
     out = []
     registered = {}
     registered.update(lock_pass.exemption_ids())
     registered.update(hostsync_pass.exemption_ids())
     registered.update(handoff_pass.exemption_ids())
+    registered.update(serialization_pass.exemption_ids())
     for eid in sorted(registered):
         if eid not in ctx.used_exemptions:
             out.append(Diagnostic(

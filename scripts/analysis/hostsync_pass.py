@@ -55,9 +55,10 @@ HOST_SYNC_ALLOWLIST = {
     ("hyperspace_tpu/ops/kernels.py", "group_ids_from_sorted"): (
         1, "group count is data-dependent: ONE last-group-id scalar "
            "per aggregate"),
-    ("hyperspace_tpu/execution/fusion.py", "_prepare_side"): (
-        1, "inner-join side prep checks key uniqueness once per side "
-           "build (bool scalar); the fused region itself never syncs"),
+    # (_prepare_side's key-uniqueness check is ONE bool-scalar sync per
+    #  side build, but it flows through kernels.has_adjacent_duplicates
+    #  — an r20 banked kernel — which intraprocedural taint cannot see;
+    #  the call site carries a HOST SYNC comment instead.)
     ("hyperspace_tpu/execution/fusion.py", "_record_actuals"): (
         1, "per-join observed-rows scalar feeding the q-error loop "
            "(one per join stage, after the region program returned)"),

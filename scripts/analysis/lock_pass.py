@@ -140,6 +140,36 @@ LOCK_CLASSES = {
         "why": "builder accounting shared by the daemon loop, explicit "
                "run_once callers, and stats readers",
     },
+    ("hyperspace_tpu/artifacts/store.py", "ArtifactStore"): {
+        "locks": {"_lock": None},
+        "delegates": frozenset({"_load_usage_locked"}),
+        "why": "one store per lake root shared by every session over "
+               "it; hit/miss/persist counters and the usage tallies are "
+               "bumped from concurrent serving workers; "
+               "_load_usage_locked runs at construction, before the "
+               "store escapes __init__",
+    },
+    ("hyperspace_tpu/artifacts/manager.py", "ArtifactManager"): {
+        "locks": {"_lock": {"_loaded", "warm_hits", "preloaded",
+                            "preload_ms", "preload_bytes"},
+                  "_util_lock": {"_util"}},
+        "delegates": frozenset(),
+        "why": "per-root executable cache probed by every dispatch "
+               "seam while the boot preloader populates it; the "
+               "utility-kernel map has its own lock (ordering: "
+               "_util_lock -> _lock, never reversed)",
+    },
+    ("hyperspace_tpu/artifacts/manager.py", "AotStage"): {
+        "locks": {"_lock": {"_compiled"}},
+        "delegates": frozenset(),
+        "why": "bank stages are process-shared; two serving workers "
+               "can race one signature's first AOT acquire",
+    },
+    ("hyperspace_tpu/artifacts/manager.py", "_ManagerRegistry"): {
+        "locks": {"_lock": {"_by_root"}},
+        "delegates": frozenset(),
+        "why": "double-checked per-root manager construction",
+    },
     ("hyperspace_tpu/index/log_manager.py", "LogLookupCache"): {
         "locks": {"_lock": None},
         "delegates": frozenset(),
@@ -186,6 +216,10 @@ LOCK_GLOBALS = {
          "why": "double-checked singleton construction"},
     ],
     "hyperspace_tpu/telemetry/metrics.py": [
+        {"lock": "_REGISTRY_LOCK", "names": {"_REGISTRY"},
+         "why": "double-checked singleton construction"},
+    ],
+    "hyperspace_tpu/artifacts/manager.py": [
         {"lock": "_REGISTRY_LOCK", "names": {"_REGISTRY"},
          "why": "double-checked singleton construction"},
     ],

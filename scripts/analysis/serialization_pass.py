@@ -1,0 +1,144 @@
+"""HS331 — executable serialization pinned to the artifact store.
+
+The artifact store's correctness story leans on ONE fact: every
+serialized compiled executable in the lake was written by store.py's
+codec, under store.py's key discipline (format version, stage/sig
+digests, mesh signature, jax/jaxlib/backend) and its checksum header.
+A second serialization site would mint blobs the corrupt/stale ladders
+have never seen — so, exactly like the jit-site gate (HS203) pins
+``jax.jit`` to the instrumented kernel modules, this pass pins the
+serialization machinery to :data:`SERIALIZATION_ALLOWLIST`:
+
+- any import of ``jax.experimental.serialize_executable`` or
+  ``jax.export`` (the two executable-serialization entry points this
+  jax ships) outside the allowlist is a finding;
+- so is a dotted use of either without an import (defense in depth);
+- so is a ``pickle``/``cloudpickle`` dump/load whose payload expression
+  names a compiled executable (``compiled``/``executable``/``lowered``
+  identifiers) — the raw-pickle side door around the codec.
+
+The allowlist is FROZEN the way every other registry here is: entries
+carry a justification (printed by ``scripts/lint.py --exemptions``) and
+an entry that stops matching any site surfaces as HS004.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from . import dataflow as df
+from .diagnostics import Diagnostic
+
+# slash rel -> justification. The ONE sanctioned serialization module.
+SERIALIZATION_ALLOWLIST = {
+    "hyperspace_tpu/artifacts/store.py":
+        "THE serialization boundary: the blob codec with the full-key "
+        "header, checksum, and corrupt/stale miss ladders lives here",
+}
+
+_SERIALIZE_MODULES = ("jax.experimental.serialize_executable",
+                      "jax.export")
+_PICKLE_ROOTS = ("pickle", "cloudpickle")
+_PICKLE_CALLS = ("dumps", "dump", "loads", "load")
+_EXECUTABLE_MARKERS = ("compiled", "executable", "lowered")
+
+
+def exemption_ids() -> dict:
+    return {f"{rel}#serialization": why
+            for rel, why in SERIALIZATION_ALLOWLIST.items()}
+
+
+def describe_exemptions() -> List[str]:
+    return [f"serialization[{rel}]: {why}"
+            for rel, why in sorted(SERIALIZATION_ALLOWLIST.items())]
+
+
+def _imported_serializer(node) -> str:
+    """The serialization module an import node pulls in, or ''."""
+    if isinstance(node, ast.Import):
+        for alias in node.names:
+            for mod in _SERIALIZE_MODULES:
+                if alias.name == mod or alias.name.startswith(mod + "."):
+                    return mod
+    elif isinstance(node, ast.ImportFrom):
+        mod = node.module or ""
+        for target in _SERIALIZE_MODULES:
+            if mod == target or mod.startswith(target + "."):
+                return target
+            # ``from jax.experimental import serialize_executable`` /
+            # ``from jax import export``.
+            parent, _, leaf = target.rpartition(".")
+            if mod == parent and any(a.name == leaf
+                                     for a in node.names):
+                return target
+    return ""
+
+
+def _names_executable(expr) -> bool:
+    for sub in ast.walk(expr):
+        ident = ""
+        if isinstance(sub, ast.Name):
+            ident = sub.id
+        elif isinstance(sub, ast.Attribute):
+            ident = sub.attr
+        ident = ident.lower()
+        if any(m in ident for m in _EXECUTABLE_MARKERS):
+            return True
+    return False
+
+
+def check_file(src, ctx) -> List[Diagnostic]:
+    if not (src.is_package or src.rel.startswith("scripts")):
+        return []
+    out: List[Diagnostic] = []
+    allowed = src.slash_rel in SERIALIZATION_ALLOWLIST
+    used_exemption = False
+    rel = src.rel
+    idx = src.index
+
+    for node in idx.of(ast.Import, ast.ImportFrom):
+        mod = _imported_serializer(node)
+        if not mod:
+            continue
+        if allowed:
+            used_exemption = True
+            continue
+        out.append(Diagnostic(
+            "HS331", rel, node.lineno,
+            f"import of {mod} outside the artifact store; executable "
+            "serialization is pinned to artifacts/store.py (its codec "
+            "owns the key header, checksum, and corrupt ladders)",
+            col=node.col_offset))
+
+    for call in idx.of(ast.Call):
+        name = df.dotted_name(call.func)
+        if any(name == mod or name.startswith(mod + ".")
+               for mod in _SERIALIZE_MODULES):
+            if allowed:
+                used_exemption = True
+                continue
+            out.append(Diagnostic(
+                "HS331", rel, call.lineno,
+                f"call through {name} outside the artifact store; "
+                "executable serialization is pinned to "
+                "artifacts/store.py",
+                col=call.col_offset))
+            continue
+        root, _, leaf = name.rpartition(".")
+        if root in _PICKLE_ROOTS and leaf in _PICKLE_CALLS \
+                and call.args and _names_executable(call.args[0]):
+            if allowed:
+                used_exemption = True
+                continue
+            out.append(Diagnostic(
+                "HS331", rel, call.lineno,
+                f"{name} of a compiled-executable value outside the "
+                "artifact store; raw pickle skips the store's key "
+                "header and checksum — route it through "
+                "artifacts/store.py",
+                col=call.col_offset))
+
+    if used_exemption:
+        ctx.note_exemption(f"{src.slash_rel}#serialization")
+    return out

@@ -19,6 +19,7 @@ invariants under fire:
 import threading
 
 import hyperspace_tpu as hst
+from hyperspace_tpu.artifacts.constants import ArtifactConstants
 from hyperspace_tpu.exceptions import HyperspaceException
 from hyperspace_tpu.index.constants import IndexConstants
 from hyperspace_tpu.robustness import fault_names as FN
@@ -45,6 +46,8 @@ CHAOS_SPECS = {
     FN.RESULT_CACHE_DEVICE_PUT: "error:p=0.2",
     FN.RESULT_CACHE_SPILL_READ: "error:p=0.3",
     FN.SERVING_WORKER: "error:p=0.08",
+    FN.ARTIFACTS_WRITE: "error:p=0.3",
+    FN.ARTIFACTS_READ: "error:p=0.3",
     FN.LOG_WRITE: "error:p=0.5",
     FN.LOG_STABLE: "error:p=0.5",
     FN.ACTION_OP: "error:p=0.5",
@@ -59,6 +62,9 @@ def _session(tmp_path, spill_dir):
     session.conf.set(ServingConstants.RESULT_CACHE_MIN_COMPUTE_SECONDS,
                      "0")
     session.conf.set(ServingConstants.RESULT_CACHE_SPILL_DIR, spill_dir)
+    # Artifact store in the blast radius: failed exports/imports must
+    # degrade to plain compiles, never corrupt a result.
+    session.conf.set(ArtifactConstants.ENABLED, "true")
     return session
 
 

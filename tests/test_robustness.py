@@ -97,7 +97,7 @@ class TestFaultSpecs:
             "bank.compile", "result_cache.device_put",
             "result_cache.spill_read", "log.write", "log.stable",
             "action.op", "serving.worker", "ingest.stage",
-            "ingest.publish",
+            "ingest.publish", "artifacts.write", "artifacts.read",
         })
 
     def test_parse_kinds_and_options(self):

@@ -42,6 +42,7 @@ if SCRIPTS not in sys.path:
 
 from analysis import diagnostics, engine  # noqa: E402
 from analysis import handoff_pass, hostsync_pass, lock_pass  # noqa: E402
+from analysis import serialization_pass  # noqa: E402
 from analysis import legacy_reference as legacy  # noqa: E402
 
 
@@ -240,7 +241,7 @@ class TestFramework:
             "HS201", "HS202", "HS203", "HS204", "HS205", "HS206",
             "HS207", "HS208", "HS209", "HS210", "HS211", "HS212",
             "HS213", "HS214", "HS215", "HS216", "HS217",
-            "HS301", "HS302", "HS311", "HS312", "HS321",
+            "HS301", "HS302", "HS311", "HS312", "HS321", "HS331",
         }
 
     def test_doc_table_in_lockstep(self):
@@ -732,6 +733,94 @@ class TestHandoffPass:
             with open(os.path.join(ROOT, *rel.split("/"))) as f:
                 src = _FakeSource(rel, f.read())
             diags = handoff_pass.check_file(src, _FakeCtx())
+            assert diags == [], [d.text() for d in diags]
+
+
+# ---------------------------------------------------------------------------
+# 3d. Serialization-boundary pass.
+# ---------------------------------------------------------------------------
+
+_SER_IMPORT = """\
+from jax.experimental import serialize_executable as _se
+
+
+def export(compiled):
+    return _se.serialize(compiled)
+"""
+
+_SER_EXPORT_IMPORT = """\
+from jax import export
+
+
+def f(fn):
+    return export.export(fn)
+"""
+
+_SER_PICKLE = """\
+import pickle
+
+
+def stash(compiled):
+    return pickle.dumps(compiled)
+"""
+
+_SER_CLEAN = """\
+import pickle
+
+
+def stash(rows):
+    return pickle.dumps(rows)
+"""
+
+
+class TestSerializationPass:
+    def _codes(self, tmp_path, text, sub="a",
+               rel="hyperspace_tpu/serving/victim.py"):
+        root = scaffold(tmp_path / sub, {rel: text})
+        return run_codes(root)
+
+    def test_serialize_executable_import_flagged(self, tmp_path):
+        res, codes = self._codes(tmp_path, _SER_IMPORT)
+        assert "HS331" in codes
+        d = [d for d in res.problems if d.code == "HS331"][0]
+        assert "artifacts/store.py" in d.message
+
+    def test_jax_export_import_flagged(self, tmp_path):
+        _res, codes = self._codes(tmp_path, _SER_EXPORT_IMPORT)
+        assert "HS331" in codes
+
+    def test_pickle_of_compiled_flagged(self, tmp_path):
+        _res, codes = self._codes(tmp_path, _SER_PICKLE)
+        assert "HS331" in codes
+
+    def test_pickle_of_plain_data_is_clean(self, tmp_path):
+        _res, codes = self._codes(tmp_path, _SER_CLEAN)
+        assert "HS331" not in codes
+
+    def test_store_module_is_exempt(self, tmp_path):
+        _res, codes = self._codes(
+            tmp_path, _SER_IMPORT,
+            rel="hyperspace_tpu/artifacts/store.py")
+        assert "HS331" not in codes
+
+    def test_suppression_applies(self, tmp_path):
+        bad = _SER_IMPORT.replace(
+            "from jax.experimental import serialize_executable as _se",
+            "from jax.experimental import serialize_executable as _se"
+            "  # hst: disable=HS331")
+        _res, codes = self._codes(tmp_path, bad)
+        assert "HS331" not in codes
+
+    def test_live_store_and_manager_are_clean(self):
+        # store.py consumes the allowlist entry; manager.py (opaque
+        # handles only) and the result cache (pickles row payloads,
+        # not executables) must not trip the gate.
+        for rel in ("hyperspace_tpu/artifacts/store.py",
+                    "hyperspace_tpu/artifacts/manager.py",
+                    "hyperspace_tpu/serving/result_cache.py"):
+            with open(os.path.join(ROOT, *rel.split("/"))) as f:
+                src = _FakeSource(rel, f.read())
+            diags = serialization_pass.check_file(src, _FakeCtx())
             assert diags == [], [d.text() for d in diags]
 
 

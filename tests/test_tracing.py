@@ -392,7 +392,8 @@ class TestSpanRegistry:
             "bank.lookup", "bank.compile", "exec.stage", "exec.fused",
             "io.read", "io.prefetch", "spmd.dispatch", "spmd.compile",
             "serving.sweep", "ingest.append", "ingest.commit",
-            "ingest.compact",
+            "ingest.compact", "artifact.load", "artifact.export",
+            "artifact.warmup",
         })
 
     def test_join_reorder_span_appears_when_enabled(self, q3ish):
