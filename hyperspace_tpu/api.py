@@ -402,9 +402,52 @@ class Hyperspace:
         histogram quantiles, and every collector's numeric leaves — so
         an external scraper (or a future multi-process router) can read
         every counter without importing the process. Round-trips
-        through the strict OpenMetrics parser."""
+        through the strict OpenMetrics parser.
+
+        With a live cluster node every sample carries a
+        ``worker="<id>"`` label so two workers' scrapes stay
+        distinguishable; single-process output is byte-identical to
+        the unlabeled format (``maybe_node`` never STARTS a node — the
+        exposition is read-only)."""
+        from .cluster.worker import maybe_node
         from .telemetry.exposition import render_text
-        return render_text(self.metrics())
+        node = maybe_node()
+        return render_text(self.metrics(),
+                           worker=node.worker_id if node else "")
+
+    def fleet_metrics(self) -> dict:
+        """Every live cluster worker's metrics snapshot, keyed by
+        worker id, plus an ``aggregate`` dict summing the numeric
+        leaves fleet-wide — this process reads its own surface
+        directly, peers answer over the cluster transport (unreachable
+        peers are skipped; their staleness expiry will drop them from
+        the roster). With the cluster disabled the result is just this
+        process under its default identity."""
+        from .cluster import transport
+        from .cluster.worker import get_node
+        from .telemetry.exposition import flatten
+        workers: dict = {}
+        node = get_node(self.session)
+        if node is None:
+            workers["local"] = self.metrics()
+        else:
+            workers[node.worker_id] = self.metrics()
+            timeout_s = \
+                self.session.hs_conf.cluster_forward_timeout_ms() / 1000.0
+            for peer in node.membership.peers():
+                try:
+                    response = transport.send_request(
+                        peer.host, peer.port, {"op": "metrics"},
+                        timeout_s=timeout_s, session=self.session)
+                    if response.get("ok"):
+                        workers[peer.worker_id] = response["metrics"]
+                except Exception:
+                    continue  # dead peer: staleness will route around it
+        aggregate: dict = {}
+        for snap in workers.values():
+            for key, value in flatten(snap).items():
+                aggregate[key] = aggregate.get(key, 0.0) + value
+        return {"workers": workers, "aggregate": aggregate}
 
     def serve_metrics(self, port: Optional[int] = None) -> int:
         """Start the opt-in localhost HTTP scrape endpoint

@@ -1,0 +1,25 @@
+"""Shared-nothing serving cluster: N processes over one lake, one
+serving system.
+
+Tiers (each its own module, bottom up):
+
+- :mod:`.transport` — length-framed TCP request/response, the repo's
+  first owned communication backend (the one sanctioned socket site,
+  HS341, beside telemetry/exposition.py's HTTP exporter).
+- :mod:`.membership` — lake-resident ``_hst_cluster/`` roster:
+  register put-if-absent, heartbeat by refresh, expire by staleness.
+- :mod:`.hashring` — consistent-hash sharding of the result cache by
+  plan-fingerprint digest (~1/N keys move per membership change).
+- :mod:`.gather` — the host-side allgather seam every
+  ``process_allgather`` call site routes through (native collectives
+  keep right of way; the owned host-TCP star revives multiprocess CPU
+  backends without them).
+- :mod:`.worker` — the node: server dispatch, router, commit
+  broadcast, fleet surfaces.
+
+Everything is governed by the ``hyperspace.tpu.cluster.*`` conf family
+(docs/configuration.md §Cluster); disabled — the default — is a hard
+no-op asserted byte-identical by tests.
+"""
+
+from .constants import ClusterConstants  # noqa: F401

@@ -1,0 +1,90 @@
+"""Config keys of the shared-nothing serving cluster.
+
+Key literals live here (not inline) because the static-analysis env/
+config gates treat config.py as the one sanctioned reader and require
+every ``hyperspace.tpu.*`` literal to appear in docs/configuration.md
+(scripts/analysis: HS202 / doc-drift) — see §Cluster there for
+semantics and defaults.
+
+No jax imports: config.py pulls this in at import time.
+"""
+
+from __future__ import annotations
+
+
+# Directory name under the index system path holding the membership
+# records (kept out of compaction/recovery's op-log walks: it contains
+# no _hyperspace_log subdirectory, so the log sweeps skip it naturally).
+CLUSTER_DIR_NAME = "_hst_cluster"
+
+
+class ClusterConstants:
+    # Master switch. Default OFF and a hard no-op: no sockets, no
+    # membership records, no routing — byte-identical execution (tests
+    # assert it).
+    ENABLED = "hyperspace.tpu.cluster.enabled"
+    ENABLED_DEFAULT = "false"
+
+    # Stable worker identity; empty means an auto-generated
+    # ``<host>-<pid>`` label. Shows up in membership records, forward/
+    # broadcast events, and the OpenMetrics ``worker`` label.
+    WORKER_ID = "hyperspace.tpu.cluster.worker.id"
+    WORKER_ID_DEFAULT = ""
+
+    # Transport bind address and port ("0" picks an ephemeral port; the
+    # bound port is what membership publishes).
+    BIND = "hyperspace.tpu.cluster.bind"
+    BIND_DEFAULT = "127.0.0.1"
+    PORT = "hyperspace.tpu.cluster.port"
+    PORT_DEFAULT = "0"
+
+    # Membership directory override; empty means
+    # ``<index system path>/_hst_cluster`` (lake-resident — every
+    # worker over the lake sees one roster).
+    DIR = "hyperspace.tpu.cluster.dir"
+    DIR_DEFAULT = ""
+
+    # Heartbeat refresh cadence and the staleness horizon past which a
+    # member is treated as dead and routed around.
+    HEARTBEAT_MS = "hyperspace.tpu.cluster.heartbeat.ms"
+    HEARTBEAT_MS_DEFAULT = "2000"
+    STALENESS_MS = "hyperspace.tpu.cluster.staleness.ms"
+    STALENESS_MS_DEFAULT = "10000"
+
+    # Consistent-hash router on the serving frontend: forward a
+    # submission to the result-cache shard owner. Effective only when
+    # the cluster itself is enabled.
+    ROUTING_ENABLED = "hyperspace.tpu.cluster.routing.enabled"
+    ROUTING_ENABLED_DEFAULT = "true"
+
+    # Forward deadline; an unreachable or slow owner degrades to local
+    # execution (byte-identical) inside this bound.
+    FORWARD_TIMEOUT_MS = "hyperspace.tpu.cluster.forward.timeoutMs"
+    FORWARD_TIMEOUT_MS_DEFAULT = "2000"
+
+    # Transport retry budget (r14 semantics: transient errors retry
+    # with backoff, non-transient surface immediately).
+    RETRY_MAX_ATTEMPTS = "hyperspace.tpu.cluster.retry.maxAttempts"
+    RETRY_MAX_ATTEMPTS_DEFAULT = "2"
+
+    # Commit-notification broadcast so standing queries fire on every
+    # worker, not just the committer's process.
+    BROADCAST_ENABLED = "hyperspace.tpu.cluster.broadcast.enabled"
+    BROADCAST_ENABLED_DEFAULT = "true"
+
+    # Virtual nodes per member on the hash ring (more vnodes = smoother
+    # key spread, slightly larger ring).
+    VNODES = "hyperspace.tpu.cluster.vnodes"
+    VNODES_DEFAULT = "64"
+
+    # Host-side allgather seam: "auto" tries the backend's native
+    # collective once and falls back to the host-TCP path when the
+    # backend lacks multiprocess collectives; "native"/"host" force a
+    # path (tests pin "host" to exercise the shim).
+    GATHER = "hyperspace.tpu.cluster.gather"
+    GATHER_DEFAULT = "auto"
+
+    # Host-TCP gather rendezvous deadline (seconds a rank waits for the
+    # full stack before surfacing a timeout).
+    GATHER_TIMEOUT_MS = "hyperspace.tpu.cluster.gather.timeoutMs"
+    GATHER_TIMEOUT_MS_DEFAULT = "60000"

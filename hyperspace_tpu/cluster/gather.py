@@ -1,0 +1,234 @@
+"""Host-side allgather seam: the one transport decision point.
+
+Every host-side ``process_allgather`` in the engine (mesh assembly's
+row-stats gather, distributed build's dictionary-union gathers) routes
+through :func:`allgather`. Single process returns the array untouched —
+byte-identical to ``multihost_utils.process_allgather`` (asserted by
+tests). Multi-process picks a path per ``cluster.gather``:
+
+- ``auto`` — try the backend's native collective once; when the backend
+  lacks multiprocess collectives (this image's CPU jax without gloo),
+  fall back to the host-TCP path below and remember the verdict.
+- ``native`` — always ``multihost_utils.process_allgather`` (real
+  ``jax.distributed`` keeps right of way).
+- ``host`` — always the owned path: a star over the cluster transport.
+  Rank 0 runs a gather hub (one blocking slot per sequence number);
+  every rank — rank 0 included, via loopback — sends its array and
+  blocks until the hub answers with all ``n`` parts stacked in rank
+  order. Sequence numbers are per-process monotonic, and SPMD program
+  order keeps them aligned across ranks. Rendezvous is a port file
+  under the system temp dir keyed by the coordinator address.
+
+The result always matches ``process_allgather``'s contract at N>1:
+shape ``(nproc, *x.shape)``, parts stacked in process order.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import tempfile
+import threading
+import time
+from typing import List, Optional
+
+import numpy as np
+
+from ..parallel import io as pio
+from ..telemetry import span_names as SN
+from ..telemetry import trace as _trace
+from . import transport
+
+_HUB_LOCK = threading.Lock()
+_HUB = None          # rank 0's running (_GatherHub, Server) pair
+_SEQ = 0             # per-process monotonic gather sequence number
+_NATIVE_OK = None    # auto mode's cached native-collective verdict
+_FORCED = None       # test seam: force "native"/"host" below the conf
+
+
+def force_mode(mode: Optional[str]) -> None:
+    """Pin the gather path ("native"/"host"), or None to un-pin; the
+    test seam for exercising the owned path without conf plumbing."""
+    global _FORCED
+    with _HUB_LOCK:
+        _FORCED = mode
+
+
+def reset_for_tests() -> None:
+    """Tear down the hub + caches so each test gets a fresh star."""
+    global _HUB, _SEQ, _NATIVE_OK, _FORCED
+    with _HUB_LOCK:
+        if _HUB is not None:
+            _HUB[1].stop()
+        _HUB = None
+        _SEQ = 0
+        _NATIVE_OK = None
+        _FORCED = None
+
+
+def _mode() -> str:
+    with _HUB_LOCK:
+        if _FORCED is not None:
+            return _FORCED
+    session = pio.active_session()
+    if session is not None:
+        try:
+            return session.hs_conf.cluster_gather_mode()
+        except Exception:
+            return "auto"
+    return "auto"
+
+
+def _gather_timeout_s() -> float:
+    session = pio.active_session()
+    if session is not None:
+        try:
+            return session.hs_conf.cluster_gather_timeout_ms() / 1000.0
+        except Exception:
+            return 60.0
+    return 60.0
+
+
+def allgather(x: np.ndarray) -> np.ndarray:
+    """Stack ``x`` across every process: the engine's one allgather."""
+    import jax
+    n = jax.process_count()
+    x = np.asarray(x)
+    if n <= 1:
+        return x  # process_allgather's own single-process identity
+    mode = _mode()
+    if mode == "native":
+        return _native_allgather(x)
+    if mode == "host":
+        return _host_path(x, jax.process_index(), n)
+    # auto: native keeps right of way; remember a backend that can't.
+    global _NATIVE_OK
+    with _HUB_LOCK:
+        verdict = _NATIVE_OK
+    if verdict is not False:
+        try:
+            out = _native_allgather(x)
+            if verdict is None:
+                with _HUB_LOCK:
+                    _NATIVE_OK = True
+            return out
+        except Exception:
+            if verdict is True:
+                raise  # native worked before: this failure is real
+            with _HUB_LOCK:
+                _NATIVE_OK = False
+    return _host_path(x, jax.process_index(), n)
+
+
+def _native_allgather(x: np.ndarray) -> np.ndarray:
+    from jax.experimental import multihost_utils as mhu
+    return np.asarray(mhu.process_allgather(x))
+
+
+def _host_path(x: np.ndarray, rank: int, n: int) -> np.ndarray:
+    global _SEQ
+    with _HUB_LOCK:
+        _SEQ += 1
+        seq = _SEQ
+    with _trace.span(SN.CLUSTER_GATHER):
+        return host_allgather(x, rank=rank, n=n, seq=seq,
+                              rendezvous_dir=_rendezvous_dir(),
+                              timeout_s=_gather_timeout_s())
+
+
+def _rendezvous_dir() -> str:
+    """One rendezvous dir per cluster, keyed by the coordinator address
+    recorded at ``initialize_multihost`` time."""
+    from ..parallel import multihost
+    coord = multihost.last_coordinator_address() or "local"
+    digest = hashlib.md5(coord.encode("utf-8")).hexdigest()[:12]
+    return os.path.join(tempfile.gettempdir(), f"hst-gather-{digest}")
+
+
+class _GatherHub:
+    """Rank 0's accumulator: one slot per sequence number, each
+    collecting ``n`` parts then answering every blocked rank."""
+
+    def __init__(self, n: int):
+        self._n = n
+        self._cond = threading.Condition()
+        self._slots = {}  # seq -> {"parts": {rank: array}, "served": int}
+
+    def handle(self, request: dict) -> dict:
+        if request.get("op") != "gather":
+            return {"ok": False, "error": "gather hub: unknown op"}
+        seq = int(request["seq"])
+        rank = int(request["rank"])
+        deadline = time.monotonic() + float(request.get("timeout_s", 60.0))
+        with self._cond:
+            slot = self._slots.setdefault(seq, {"parts": {}, "served": 0})
+            slot["parts"][rank] = request["payload"]
+            if len(slot["parts"]) >= self._n:
+                self._cond.notify_all()
+            while len(slot["parts"]) < self._n:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return {"ok": False,
+                            "error": f"gather hub: seq {seq} timed out at "
+                                     f"{len(slot['parts'])}/{self._n} parts"}
+                self._cond.wait(remaining)
+            parts = [slot["parts"][r] for r in range(self._n)]
+            slot["served"] += 1
+            if slot["served"] >= self._n:
+                del self._slots[seq]  # every rank answered: slot drained
+        return {"ok": True, "parts": parts}
+
+
+def host_allgather(x: np.ndarray, *, rank: int, n: int, seq: int,
+                   rendezvous_dir: str,
+                   timeout_s: float = 60.0) -> np.ndarray:
+    """The owned star allgather. Explicit rank/n/seq so tests can run
+    every rank as a thread of one process."""
+    host, port = _hub_address(rank, n, rendezvous_dir, timeout_s)
+    response = transport.send_request(
+        host, port,
+        {"op": "gather", "seq": seq, "rank": rank, "n": n,
+         "payload": np.asarray(x), "timeout_s": timeout_s},
+        timeout_s=timeout_s, attempts=3)
+    if not response.get("ok"):
+        raise RuntimeError(f"cluster gather failed: "
+                           f"{response.get('error', 'unknown')}")
+    parts: List[np.ndarray] = [np.asarray(p) for p in response["parts"]]
+    return np.stack(parts)
+
+
+def _hub_address(rank: int, n: int, rendezvous_dir: str,
+                 timeout_s: float) -> tuple:
+    """Rank 0 starts the hub (idempotently) and publishes its port;
+    everyone reads the port file, polling until rank 0 shows up."""
+    global _HUB
+    portfile = os.path.join(rendezvous_dir, "hub-port")
+    if rank == 0:
+        with _HUB_LOCK:
+            if _HUB is None:
+                hub = _GatherHub(n)
+                server = transport.Server("127.0.0.1", 0, hub.handle,
+                                          name="cluster-gather")
+                os.makedirs(rendezvous_dir, exist_ok=True)
+                tmp = portfile + f".tmp.{os.getpid()}"
+                with open(tmp, "w", encoding="utf-8") as f:
+                    f.write(f"{server.host} {server.port}")
+                os.replace(tmp, portfile)
+                _HUB = (hub, server)
+            hub, server = _HUB
+        return server.host, server.port
+    deadline = time.monotonic() + timeout_s
+    while True:
+        try:
+            with open(portfile, "r", encoding="utf-8") as f:
+                text = f.read().strip()
+            if text:
+                host, port = text.split()
+                return host, int(port)
+        except OSError:
+            pass  # rank 0 not up yet
+        if time.monotonic() > deadline:
+            raise TimeoutError(
+                f"cluster gather: no hub port file at {portfile} "
+                f"within {timeout_s}s")
+        time.sleep(0.02)

@@ -1,0 +1,71 @@
+"""Consistent-hash ring sharding the result cache across the fleet.
+
+Each member contributes ``vnodes`` virtual points (md5 of
+``"<worker_id>#<i>"``) on a 2**128 ring; a key's owner is the first
+point clockwise from the key's own md5 position. Membership churn
+moves only the keys whose clockwise arcs changed — ~1/N of them per
+joined/left member (the unit tests assert the bound) — so a worker
+death invalidates one shard's routing, not the whole cache placement.
+
+The ring is immutable: the router rebuilds one from the current live
+roster per decision, which keeps routing a pure function of membership
+(no locked mutable ring to keep coherent across threads).
+"""
+
+from __future__ import annotations
+
+import bisect
+import hashlib
+from typing import List, Optional, Sequence
+
+
+def _point(token: str) -> int:
+    return int(hashlib.md5(token.encode("utf-8")).hexdigest(), 16)
+
+
+class HashRing:
+    """Immutable consistent-hash ring over worker ids."""
+
+    __slots__ = ("_points", "_owners", "_members")
+
+    def __init__(self, member_ids: Sequence[str], vnodes: int = 64):
+        pairs = []
+        for wid in sorted(set(member_ids)):
+            for i in range(max(int(vnodes), 1)):
+                pairs.append((_point(f"{wid}#{i}"), wid))
+        pairs.sort()
+        self._points = [p for p, _ in pairs]
+        self._owners = [w for _, w in pairs]
+        self._members = tuple(sorted(set(member_ids)))
+
+    @property
+    def members(self) -> tuple:
+        return self._members
+
+    def __len__(self) -> int:
+        return len(self._members)
+
+    def owner(self, key: str) -> Optional[str]:
+        """Worker id owning ``key`` (a digest string); None on an
+        empty ring."""
+        if not self._points:
+            return None
+        idx = bisect.bisect_right(self._points, _point(key))
+        if idx == len(self._points):
+            idx = 0  # wrap: first point clockwise from the top
+        return self._owners[idx]
+
+    def owners(self, key: str, n: int) -> List[str]:
+        """First ``n`` DISTINCT owners clockwise from ``key`` — the
+        replica set a future replication tier would write through."""
+        if not self._points:
+            return []
+        out: List[str] = []
+        idx = bisect.bisect_right(self._points, _point(key))
+        for step in range(len(self._points)):
+            wid = self._owners[(idx + step) % len(self._points)]
+            if wid not in out:
+                out.append(wid)
+                if len(out) >= n:
+                    break
+        return out

@@ -1,0 +1,163 @@
+"""Lake-resident cluster membership: register, heartbeat, expire.
+
+One JSON record per worker under ``<system path>/_hst_cluster/``,
+following the op-log store's put-if-absent idiom: registration is an
+O_EXCL create (a second claimant of the same id loses the race and must
+pick another identity), heartbeat is an atomic refresh (tmp +
+``os.replace``) of the record with a fresh timestamp, and expiry is
+read-side staleness — a record whose heartbeat is older than
+``cluster.staleness.ms`` is a dead worker and gets routed around (the
+r14 degradation-ladder contract: death never needs a cleanup writer).
+
+Readers tolerate torn or half-written records by skipping them; the
+next heartbeat rewrite repairs the file.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import threading
+import time
+from dataclasses import dataclass
+from typing import List, Optional
+
+from ..parallel import io as pio
+from .constants import CLUSTER_DIR_NAME
+
+
+@dataclass(frozen=True)
+class MemberInfo:
+    worker_id: str
+    host: str
+    port: int
+    pid: int
+    started_ms: float
+    heartbeat_ms: float
+
+
+def membership_dir(session) -> str:
+    """The roster directory of the session's lake (conf override, else
+    ``<index system path>/_hst_cluster``)."""
+    override = session.hs_conf.cluster_dir()
+    if override:
+        return override
+    return os.path.join(session.hs_conf.system_path(), CLUSTER_DIR_NAME)
+
+
+def _record_path(root: str, worker_id: str) -> str:
+    safe = re.sub(r"[^A-Za-z0-9_.-]", "_", worker_id)
+    return os.path.join(root, f"member-{safe}.json")
+
+
+def _now_ms() -> float:
+    return time.time() * 1000.0
+
+
+class Membership:
+    """One worker's view of the roster: its own record plus reads of
+    everyone else's, expiring by staleness."""
+
+    def __init__(self, session, worker_id: str, host: str, port: int):
+        self._session = session
+        self._root = membership_dir(session)
+        self.worker_id = worker_id
+        self._host = host
+        self._port = port
+        self._started_ms = _now_ms()
+        self._stop = threading.Event()
+
+    # -- registration / heartbeat -------------------------------------
+
+    def register(self) -> None:
+        """Put-if-absent claim of this worker's identity. Raises
+        FileExistsError when a LIVE record already holds the id; a
+        stale corpse under the same id is reclaimed in place."""
+        os.makedirs(self._root, exist_ok=True)
+        path = _record_path(self._root, self.worker_id)
+        record = self._record()
+        try:
+            with open(path, "x", encoding="utf-8") as f:
+                f.write(record)
+        except FileExistsError:
+            existing = _read_record(path)
+            if existing is not None and not self._is_stale(existing):
+                raise
+            _atomic_write(path, record)  # reclaim the corpse
+
+    def start_heartbeat(self) -> None:
+        interval_s = max(
+            self._session.hs_conf.cluster_heartbeat_ms() / 1000.0, 0.05)
+
+        def _loop() -> None:
+            while not self._stop.wait(interval_s):
+                try:
+                    self.heartbeat()
+                except OSError:
+                    pass  # lake hiccup; the next beat retries
+
+        pio.spawn_daemon("hst-cluster-heartbeat", _loop)
+
+    def heartbeat(self) -> None:
+        _atomic_write(_record_path(self._root, self.worker_id),
+                      self._record())
+
+    def leave(self) -> None:
+        self._stop.set()
+        try:
+            os.remove(_record_path(self._root, self.worker_id))
+        except OSError:
+            pass  # already gone, or the lake will expire us by staleness
+
+    def _record(self) -> str:
+        return json.dumps({
+            "worker_id": self.worker_id, "host": self._host,
+            "port": self._port, "pid": os.getpid(),
+            "started_ms": self._started_ms, "heartbeat_ms": _now_ms()})
+
+    # -- roster reads -------------------------------------------------
+
+    def _is_stale(self, info: MemberInfo) -> bool:
+        horizon = self._session.hs_conf.cluster_staleness_ms()
+        return _now_ms() - info.heartbeat_ms > horizon
+
+    def live_members(self) -> List[MemberInfo]:
+        """Every non-stale record, this worker's included, sorted by
+        worker id (a stable roster order for the ring and the tests)."""
+        out: List[MemberInfo] = []
+        try:
+            names = sorted(os.listdir(self._root))
+        except OSError:
+            return out
+        for name in names:
+            if not (name.startswith("member-") and name.endswith(".json")):
+                continue
+            info = _read_record(os.path.join(self._root, name))
+            if info is not None and not self._is_stale(info):
+                out.append(info)
+        return sorted(out, key=lambda m: m.worker_id)
+
+    def peers(self) -> List[MemberInfo]:
+        return [m for m in self.live_members()
+                if m.worker_id != self.worker_id]
+
+
+def _read_record(path: str) -> Optional[MemberInfo]:
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            d = json.loads(f.read())
+        return MemberInfo(
+            worker_id=str(d["worker_id"]), host=str(d["host"]),
+            port=int(d["port"]), pid=int(d["pid"]),
+            started_ms=float(d["started_ms"]),
+            heartbeat_ms=float(d["heartbeat_ms"]))
+    except (OSError, ValueError, KeyError, TypeError):
+        return None  # torn write or foreign file: skip, don't crash
+
+
+def _atomic_write(path: str, text: str) -> None:
+    tmp = path + f".tmp.{os.getpid()}"
+    with open(tmp, "w", encoding="utf-8") as f:
+        f.write(text)
+    os.replace(tmp, path)

@@ -1,0 +1,151 @@
+"""Length-framed TCP transport of the serving cluster.
+
+The repo's first owned communication backend: 8-byte big-endian length
+header + a pickled payload (the result-cache spill codec, pointed at a
+socket instead of a file), one request/response per connection. The
+server accept loop and each accepted connection run on
+``parallel/io.spawn_daemon`` threads — the one sanctioned thread
+spawner (HS211) — and this module plus telemetry/exposition.py's HTTP
+exporter are the only sanctioned socket sites in the package (HS341):
+every other module rides this transport, so framing, deadlines, and
+r14 retry semantics live in exactly one place.
+
+Request objects are plain dicts with an ``op`` key; the server's
+handler returns the response object (any picklable). A handler error
+becomes ``{"ok": False, "error": ...}`` so a sick worker degrades the
+caller instead of wedging it.
+"""
+
+from __future__ import annotations
+
+import pickle
+import socket
+import struct
+import threading
+from typing import Any, Callable, Tuple
+
+from ..parallel import io as pio
+from ..robustness import retry
+
+_HEADER = struct.Struct(">Q")
+# Frames past this are protocol corruption, not data (forwarded host
+# tables are far smaller; a garbage header must not drive a huge read).
+MAX_FRAME_BYTES = 1 << 31
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(min(n - len(buf), 1 << 20))
+        if not chunk:
+            raise ConnectionError("cluster transport: peer closed mid-frame")
+        buf += chunk
+    return bytes(buf)
+
+
+def send_obj(sock: socket.socket, obj: Any) -> None:
+    payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+    sock.sendall(_HEADER.pack(len(payload)) + payload)
+
+
+def recv_obj(sock: socket.socket) -> Any:
+    (length,) = _HEADER.unpack(_recv_exact(sock, _HEADER.size))
+    if length > MAX_FRAME_BYTES:
+        raise ConnectionError(
+            f"cluster transport: frame of {length} bytes over the cap")
+    return pickle.loads(_recv_exact(sock, length))
+
+
+def send_request(host: str, port: int, obj: Any, *,
+                 timeout_s: float = 2.0, attempts: int = 1,
+                 session=None) -> Any:
+    """One framed request/response round trip. ``timeout_s`` bounds
+    every socket operation of each attempt (the deadline contract);
+    with ``attempts`` > 1 transient socket errors retry with r14
+    backoff and the ORIGINAL error surfaces on exhaustion."""
+
+    def _once() -> Any:
+        with socket.create_connection((host, port),
+                                      timeout=timeout_s) as sock:
+            sock.settimeout(timeout_s)
+            send_obj(sock, obj)
+            return recv_obj(sock)
+
+    if attempts <= 1:
+        return _once()
+    policy = retry.RetryPolicy(max_attempts=attempts)
+    return retry.call(_once, where="cluster.transport", policy=policy,
+                      session=session)
+
+
+class Server:
+    """Accept loop + per-connection daemon threads over one handler.
+
+    ``handler(request) -> response`` runs on the connection's thread,
+    so a blocking op (the gather hub waiting for every rank) stalls
+    only its own connection. Start binds and returns immediately; the
+    bound port is ``self.port`` (ephemeral bind publishes the real
+    one). ``stop()`` closes the listener; in-flight connections finish
+    on their own threads.
+    """
+
+    def __init__(self, bind: str, port: int,
+                 handler: Callable[[Any], Any], *, name: str = "cluster"):
+        self._handler = handler
+        self._name = name
+        self._stopped = threading.Event()
+        self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        try:
+            self._listener.setsockopt(socket.SOL_SOCKET,
+                                      socket.SO_REUSEADDR, 1)
+            self._listener.bind((bind, port))
+            self._listener.listen(64)
+        except BaseException:
+            self._listener.close()
+            raise
+        self.host, self.port = self._listener.getsockname()[:2]
+        pio.spawn_daemon(f"hst-{name}-accept", self._accept_loop)
+
+    def _accept_loop(self) -> None:
+        while not self._stopped.is_set():
+            try:
+                conn, _addr = self._listener.accept()
+            except OSError:
+                return  # listener closed by stop()
+            pio.spawn_daemon(f"hst-{self._name}-conn",
+                             lambda c=conn: self._serve_one(c))
+
+    def _serve_one(self, conn: socket.socket) -> None:
+        try:
+            with conn:
+                conn.settimeout(300.0)
+                request = recv_obj(conn)
+                try:
+                    response = self._handler(request)
+                except Exception as e:
+                    response = {"ok": False,
+                                "error": f"{type(e).__name__}: {e}"}
+                send_obj(conn, response)
+        except Exception:
+            pass  # a torn connection is the peer's problem, not ours
+
+    def stop(self) -> None:
+        self._stopped.set()
+        # shutdown() first: close() alone does not wake a thread blocked
+        # in accept(), and the kernel keeps the port listening until
+        # that syscall returns — a "stopped" server would still accept.
+        try:
+            self._listener.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+
+
+def address_of(member) -> Tuple[str, int]:
+    """(host, port) of a membership record (dict or MemberInfo)."""
+    if isinstance(member, dict):
+        return str(member["host"]), int(member["port"])
+    return str(member.host), int(member.port)

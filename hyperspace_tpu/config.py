@@ -16,6 +16,7 @@ from typing import Any, Callable, Dict, Generic, Optional, TypeVar
 from .adaptive.constants import AdaptiveConstants
 from .advisor.constants import AdvisorConstants
 from .artifacts.constants import ArtifactConstants
+from .cluster.constants import ClusterConstants
 from .index.constants import IndexConstants
 from .optimizer.constants import OptimizerConstants
 from .robustness.constants import RobustnessConstants
@@ -772,6 +773,74 @@ class HyperspaceConf:
         return max(float(self._conf.get(
             ArtifactConstants.USAGE_FLUSH_MS,
             ArtifactConstants.USAGE_FLUSH_MS_DEFAULT)), 0.0)
+
+    def cluster_enabled(self) -> bool:
+        return self._get_bool(
+            ClusterConstants.ENABLED, ClusterConstants.ENABLED_DEFAULT)
+
+    def cluster_worker_id(self) -> str:
+        return (self._conf.get(
+            ClusterConstants.WORKER_ID,
+            ClusterConstants.WORKER_ID_DEFAULT) or "").strip()
+
+    def cluster_bind(self) -> str:
+        return (self._conf.get(
+            ClusterConstants.BIND, ClusterConstants.BIND_DEFAULT)
+            or "127.0.0.1").strip()
+
+    def cluster_port(self) -> int:
+        return max(int(self._conf.get(
+            ClusterConstants.PORT, ClusterConstants.PORT_DEFAULT)), 0)
+
+    def cluster_dir(self) -> str:
+        return (self._conf.get(
+            ClusterConstants.DIR, ClusterConstants.DIR_DEFAULT)
+            or "").strip()
+
+    def cluster_heartbeat_ms(self) -> float:
+        return max(float(self._conf.get(
+            ClusterConstants.HEARTBEAT_MS,
+            ClusterConstants.HEARTBEAT_MS_DEFAULT)), 50.0)
+
+    def cluster_staleness_ms(self) -> float:
+        return max(float(self._conf.get(
+            ClusterConstants.STALENESS_MS,
+            ClusterConstants.STALENESS_MS_DEFAULT)), 100.0)
+
+    def cluster_routing_enabled(self) -> bool:
+        return self.cluster_enabled() and self._get_bool(
+            ClusterConstants.ROUTING_ENABLED,
+            ClusterConstants.ROUTING_ENABLED_DEFAULT)
+
+    def cluster_forward_timeout_ms(self) -> float:
+        return max(float(self._conf.get(
+            ClusterConstants.FORWARD_TIMEOUT_MS,
+            ClusterConstants.FORWARD_TIMEOUT_MS_DEFAULT)), 10.0)
+
+    def cluster_retry_max_attempts(self) -> int:
+        return max(int(self._conf.get(
+            ClusterConstants.RETRY_MAX_ATTEMPTS,
+            ClusterConstants.RETRY_MAX_ATTEMPTS_DEFAULT)), 1)
+
+    def cluster_broadcast_enabled(self) -> bool:
+        return self.cluster_enabled() and self._get_bool(
+            ClusterConstants.BROADCAST_ENABLED,
+            ClusterConstants.BROADCAST_ENABLED_DEFAULT)
+
+    def cluster_vnodes(self) -> int:
+        return max(int(self._conf.get(
+            ClusterConstants.VNODES, ClusterConstants.VNODES_DEFAULT)), 1)
+
+    def cluster_gather_mode(self) -> str:
+        mode = (self._conf.get(
+            ClusterConstants.GATHER,
+            ClusterConstants.GATHER_DEFAULT) or "").strip().lower()
+        return mode if mode in ("auto", "native", "host") else "auto"
+
+    def cluster_gather_timeout_ms(self) -> float:
+        return max(float(self._conf.get(
+            ClusterConstants.GATHER_TIMEOUT_MS,
+            ClusterConstants.GATHER_TIMEOUT_MS_DEFAULT)), 100.0)
 
     def _get_bool(self, key: str, default: str) -> bool:
         return (self._conf.get(key, default) or "").strip().lower() == "true"

@@ -182,7 +182,7 @@ def _union_string_dictionaries(table: Table) -> Table:
     if not any(table.column(n).dtype == STRING for n in table.names):
         return table
     global DICT_UNION_COUNT
-    from jax.experimental import multihost_utils as mhu
+    from ..cluster import gather as _gather
 
     new_cols = {}
     for name in table.names:
@@ -198,7 +198,7 @@ def _union_string_dictionaries(table: Table) -> Table:
         lengths = np.array([len(b) for b in encoded], np.int64)
         blob = np.frombuffer(b"".join(encoded), np.uint8) \
             if encoded else np.zeros(0, np.uint8)
-        dims = np.asarray(mhu.process_allgather(
+        dims = np.asarray(_gather.allgather(
             np.array([len(words), blob.size], np.int64)))
         dims = dims.reshape(-1, 2)
         max_words = max(int(dims[:, 0].max()), 1)
@@ -207,8 +207,8 @@ def _union_string_dictionaries(table: Table) -> Table:
         lengths_p[:lengths.size] = lengths
         blob_p = np.zeros(max_bytes, np.uint8)
         blob_p[:blob.size] = blob
-        all_lengths = np.asarray(mhu.process_allgather(lengths_p))
-        all_blobs = np.asarray(mhu.process_allgather(blob_p))
+        all_lengths = np.asarray(_gather.allgather(lengths_p))
+        all_blobs = np.asarray(_gather.allgather(blob_p))
         union = set()
         for i in range(dims.shape[0]):
             nw = int(dims[i, 0])

@@ -146,14 +146,16 @@ def _pad_and_shard_multihost(mesh: Mesh, arrays: dict, rows: int) -> tuple:
     The per-device shard is sized to the LARGEST process block so every
     device shard is equal (static shapes worldwide); short processes pad
     with invalid rows."""
-    from jax.experimental import multihost_utils
+    from ..cluster import gather as _gather
 
     n_total = mesh.devices.size
     n_local = len(mesh.local_devices)
     # One allgather carries (rows, n_local): asymmetric device counts
     # would compile different collectives per process — the gloo
-    # size-mismatch abort — so fail loudly up front instead.
-    stats = np.asarray(multihost_utils.process_allgather(
+    # size-mismatch abort — so fail loudly up front instead. The
+    # cluster gather seam picks the transport (native collective when
+    # the backend has one, the owned host-TCP star when it doesn't).
+    stats = np.asarray(_gather.allgather(
         np.array([rows, n_local], np.int64)))
     if n_local == 0 or not (stats[..., 1] == n_local).all():
         raise NotImplementedError(

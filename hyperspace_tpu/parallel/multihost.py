@@ -43,6 +43,17 @@ from typing import Optional
 
 from .mesh import make_mesh
 
+# Coordinator address of the cluster this process joined (or ""), kept
+# for cluster/gather.py's host-TCP rendezvous key — the one place the
+# fleet already shares an identity, so no extra env contract is needed.
+_COORDINATOR = ""
+
+
+def last_coordinator_address() -> str:
+    """The coordinator address ``initialize_multihost`` joined with, ""
+    for single-process runs."""
+    return _COORDINATOR
+
 
 def initialize_multihost(coordinator_address: Optional[str] = None,
                          num_processes: Optional[int] = None,
@@ -74,6 +85,16 @@ def initialize_multihost(coordinator_address: Optional[str] = None,
             os.environ.get("JAX_PROCESS_ID", "0") or 0)
         already = getattr(jax.distributed, "is_initialized", lambda: False)()
         if not already:
+            # The CPU backend refuses multiprocess collectives unless the
+            # gloo implementation is selected BEFORE initialize; on
+            # builds without the knob (or non-CPU platforms) the failure
+            # is harmless and cluster/gather.py's host-TCP path still
+            # covers the host-side allgathers.
+            try:
+                jax.config.update(
+                    "jax_cpu_collectives_implementation", "gloo")
+            except Exception:
+                pass
             try:
                 jax.distributed.initialize(
                     coordinator_address=coordinator,
@@ -84,6 +105,8 @@ def initialize_multihost(coordinator_address: Optional[str] = None,
                 # must be a no-op, per the idempotency contract.
                 if "already initialized" not in str(e):
                     raise
+        global _COORDINATOR
+        _COORDINATOR = str(coordinator)
         initialized = True
     return {
         "initialized": initialized,

@@ -83,10 +83,20 @@ INGEST_PUBLISH = "ingest.publish"
 ARTIFACTS_WRITE = "artifacts.write"
 ARTIFACTS_READ = "artifacts.read"
 
+# Serving cluster (cluster/worker.py). CLUSTER_FORWARD fires on the
+# sender side before a routed submission ships to its shard owner — an
+# injected error must degrade to local execution (byte-identical), the
+# r14 ladder applied to the network. CLUSTER_BROADCAST fires before
+# each peer's commit notice — an injected error costs only that peer's
+# standing-query firing, never the commit itself.
+CLUSTER_FORWARD = "cluster.forward"
+CLUSTER_BROADCAST = "cluster.broadcast"
+
 FAULT_NAMES = frozenset({
     IO_POOLED_READ, IO_PREFETCH_PRODUCE, SCAN_PARQUET_DECODE,
     SPMD_DISPATCH, SPMD_COMPILE, BANK_COMPILE,
     RESULT_CACHE_DEVICE_PUT, RESULT_CACHE_SPILL_READ,
     LOG_WRITE, LOG_STABLE, ACTION_OP, SERVING_WORKER,
     INGEST_STAGE, INGEST_PUBLISH, ARTIFACTS_WRITE, ARTIFACTS_READ,
+    CLUSTER_FORWARD, CLUSTER_BROADCAST,
 })

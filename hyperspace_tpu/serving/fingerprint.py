@@ -162,14 +162,20 @@ def config_hash(session) -> str:
     tests/test_artifacts.py) — hashing them would orphan every warm
     entry on an admission-threshold tweak, a tracing toggle, a fault
     (dis)arming, or a fusion/artifacts toggle, breaking config.py's
-    live-tuning contract."""
+    live-tuning contract. Cluster knobs are excluded for the same
+    reason PLUS a sharper one: fleet workers differ exactly in their
+    cluster.* values (worker id, port), and the router refuses a
+    forward whenever sender and owner disagree on the key — hashing
+    them would make every cross-worker digest mismatch by
+    construction (asserted in tests/test_cluster.py)."""
     items = [(k, v) for k, v in sorted(session.conf.as_dict().items())
              if not k.startswith("serving.")
              and not k.startswith("hyperspace.tpu.serving.")
              and not k.startswith("hyperspace.tpu.telemetry.")
              and not k.startswith("hyperspace.tpu.robustness.")
              and not k.startswith("hyperspace.tpu.execution.fusion.")
-             and not k.startswith("hyperspace.tpu.artifacts.")]
+             and not k.startswith("hyperspace.tpu.artifacts.")
+             and not k.startswith("hyperspace.tpu.cluster.")]
     return hashing.md5_hex((items, session.is_hyperspace_enabled()))
 
 

@@ -221,6 +221,22 @@ class ServingFrontend:
         from .fingerprint import estimate_recompute_bytes, normalize
         norm = normalize(plan)
         est = estimate_recompute_bytes(norm)
+        # Cluster router (cluster/worker.py): when another worker owns
+        # this plan's result-cache shard, ship the submission there and
+        # return its finished PendingQuery; any failure falls through
+        # to the local path below, byte-identical (the r14 ladder).
+        # Disabled clusters pay exactly this one conf read.
+        if self._hs_conf.cluster_routing_enabled():
+            from ..cluster import worker as _cluster
+            forwarded = _cluster.try_forward(
+                session, plan, norm, client=client,
+                deadline_ms=deadline_ms, est=est)
+            if forwarded is not None:
+                with self._lock:
+                    self._stats["submitted"] += 1
+                    self._stats["admitted"] += 1
+                self._observe_latency(forwarded)
+                return forwarded
         batch_key = batcher.template_key(session, norm) \
             if self._hs_conf.serving_batching_enabled() else None
         # SLO-driven admission (adaptive/admission.py): while an armed

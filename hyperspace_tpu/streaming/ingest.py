@@ -1092,6 +1092,18 @@ def _fire_subscriptions(session, table_path: str) -> int:
             continue
     if fired:
         get_queue().note(subscription_fires=fired)
+    # Cluster broadcast (cluster/worker.py): the registries above are
+    # process-local, so ship the notice to every live peer too —
+    # standing queries fire on EVERY worker from this one commit. A
+    # delivery failure degrades (that peer misses a firing), never
+    # fails the already-durable commit. Disabled clusters pay one conf
+    # read.
+    if session.hs_conf.cluster_broadcast_enabled():
+        from ..cluster import worker as _cluster
+        try:
+            _cluster.broadcast_commit(session, table_path)
+        except Exception:
+            pass  # the commit is durable; fan-out is best-effort
     return fired
 
 

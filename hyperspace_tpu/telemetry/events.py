@@ -539,3 +539,52 @@ class ArtifactPersistEvent(ArtifactEvent):
 class ArtifactEvictEvent(ArtifactEvent):
     """A blob deleted to fit ``artifacts.maxBytes`` (coldest first by
     persisted usage order)."""
+
+
+@dataclass
+class ClusterEvent(HyperspaceEvent):
+    """Base of the serving-cluster events (cluster/worker.py).
+    ``worker_id`` is the emitting worker's identity — the same label
+    the OpenMetrics exposition stamps on its samples."""
+
+    worker_id: str = ""
+
+
+@dataclass
+class ClusterJoinEvent(ClusterEvent):
+    """This worker registered its membership record and started
+    heartbeating (``host``/``port`` are its transport address)."""
+
+    host: str = ""
+    port: int = 0
+
+
+@dataclass
+class ClusterLeaveEvent(ClusterEvent):
+    """This worker removed its membership record (clean shutdown; a
+    crashed worker leaves by staleness expiry instead)."""
+
+
+@dataclass
+class ClusterForwardEvent(ClusterEvent):
+    """One routed submission shipped to its shard ``owner``. ``ok``
+    False means the owner was unreachable or refused (fingerprint
+    mismatch) and the query degraded to local execution; ``hit`` True
+    means the owner served it from its result-cache shard without
+    executing."""
+
+    owner: str = ""
+    key_digest: str = ""
+    ok: bool = False
+    hit: bool = False
+    millis: float = 0.0
+
+
+@dataclass
+class ClusterBroadcastEvent(ClusterEvent):
+    """One commit notice fanned out to the live peers so standing
+    queries fire on every worker (``delivered`` of ``peers`` acked)."""
+
+    table: str = ""
+    peers: int = 0
+    delivered: int = 0

@@ -80,7 +80,12 @@ def _fmt(value: float) -> str:
     return repr(f)
 
 
-def render_text(snapshot: dict) -> str:
+def _escape_label(value: str) -> str:
+    return value.replace("\\", "\\\\").replace('"', '\\"') \
+        .replace("\n", "\\n")
+
+
+def render_text(snapshot: dict, worker: str = "") -> str:
     """OpenMetrics text exposition of one registry/metrics() snapshot.
 
     Family names are first-wins in emission order — registry
@@ -90,9 +95,16 @@ def render_text(snapshot: dict) -> str:
     collector's ``sweep_invocations`` vs the ``serving.
     sweep_invocations`` counter), the REGISTRY instrument is the one
     exported; a family is never emitted twice (the OpenMetrics grammar
-    forbids it)."""
+    forbids it).
+
+    A non-empty ``worker`` stamps every sample with a
+    ``worker="<id>"`` label — the cluster identity that keeps two
+    workers' scrapes from colliding on identical series names. Empty
+    (the single-process default) emits byte-identical text to the
+    pre-label format."""
     lines = []
     seen = set()
+    labels = f'{{worker="{_escape_label(worker)}"}}' if worker else ""
 
     def emit(name: str, mtype: str, value: float,
              help_text: str = "") -> None:
@@ -103,7 +115,7 @@ def render_text(snapshot: dict) -> str:
             lines.append(f"# HELP {name} {help_text}")
         lines.append(f"# TYPE {name} {mtype}")
         sample = name + ("_total" if mtype == "counter" else "")
-        lines.append(f"{sample} {_fmt(value)}")
+        lines.append(f"{sample}{labels} {_fmt(value)}")
 
     for name in sorted(snapshot.get("counters", {})):
         emit(_sanitize(name), "counter",

@@ -59,6 +59,7 @@ COLLECTOR_STREAMING = "streaming"
 COLLECTOR_FUSION = "fusion"
 COLLECTOR_FLIGHT_RECORDER = "flight_recorder"
 COLLECTOR_ARTIFACTS = "artifacts"
+COLLECTOR_CLUSTER = "cluster"
 
 METRIC_NAMES = frozenset({
     TRACE_SAMPLED, TRACE_TAIL_KEPT, TRACE_DISCARDED, FLIGHT_ANOMALIES,
@@ -66,4 +67,5 @@ METRIC_NAMES = frozenset({
     QUERY_LATENCY_MS, COLLECTOR_IO, COLLECTOR_PROGRAM_BANK,
     COLLECTOR_SERVING, COLLECTOR_ROBUSTNESS, COLLECTOR_STREAMING,
     COLLECTOR_FUSION, COLLECTOR_FLIGHT_RECORDER, COLLECTOR_ARTIFACTS,
+    COLLECTOR_CLUSTER,
 })

@@ -74,10 +74,19 @@ ARTIFACT_LOAD = "artifact.load"
 ARTIFACT_EXPORT = "artifact.export"
 ARTIFACT_WARMUP = "artifact.warmup"
 
+# Serving cluster (cluster/): one CLUSTER_FORWARD per routed submission
+# shipped to its shard owner (attrs carry owner/hit/ok), one
+# CLUSTER_BROADCAST per commit fan-out to the live peers, one
+# CLUSTER_GATHER per host-TCP allgather round on the owned path.
+CLUSTER_FORWARD = "cluster.forward"
+CLUSTER_BROADCAST = "cluster.broadcast"
+CLUSTER_GATHER = "cluster.gather"
+
 SPAN_NAMES = frozenset({
     QUERY, PLAN_NORMALIZE, JOIN_REORDER, INDEX_REWRITE, CACHE_LOOKUP,
     BANK_LOOKUP, BANK_COMPILE, EXEC_STAGE, EXEC_FUSED, IO_READ,
     IO_PREFETCH, SPMD_DISPATCH, SPMD_COMPILE, SERVING_SWEEP,
     INGEST_APPEND, INGEST_COMMIT, INGEST_COMPACT,
     ARTIFACT_LOAD, ARTIFACT_EXPORT, ARTIFACT_WARMUP,
+    CLUSTER_FORWARD, CLUSTER_BROADCAST, CLUSTER_GATHER,
 })

@@ -66,6 +66,7 @@ CODES = {
     "HS312": "unallowlisted host sync at a jit-adjacent site",
     "HS321": "raw thread handoff of context-dependent work",
     "HS331": "executable serialization outside the artifact store",
+    "HS341": "socket creation outside the sanctioned modules",
 }
 
 # Raw source text of a suppression directive (engine.py owns parsing).

@@ -206,6 +206,50 @@ THREAD_SITE_ALLOWLIST = frozenset({
 })
 
 
+# Communication discipline (the cluster tier's ratchet): socket
+# creation/bind stays inside cluster/transport.py — the one owned
+# backend carrying framing, deadlines, and r14 retry semantics — plus
+# telemetry/exposition.py's localhost HTTP exporter (a listener that
+# predates the transport and stays read-only). An ad-hoc socket
+# elsewhere would invent a second wire protocol outside the deadline/
+# retry contract and invisibly to the cluster counters. This list is
+# FROZEN — new communication rides cluster/transport.py.
+SOCKET_SITE_ALLOWLIST = frozenset({
+    "hyperspace_tpu/cluster/transport.py",
+    "hyperspace_tpu/telemetry/exposition.py",
+})
+
+
+def socket_sites(tree: ast.AST) -> list:
+    """Line numbers of socket/socketserver imports, ``socket.*``
+    construction helpers, and HTTP-server construction references (the
+    listener classes wrap a bind)."""
+    out = []
+    server_names = ("HTTPServer", "ThreadingHTTPServer", "TCPServer",
+                    "UDPServer")
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            if any(a.name.split(".")[0] in ("socket", "socketserver")
+                   for a in node.names):
+                out.append(node.lineno)
+        elif isinstance(node, ast.ImportFrom) and node.module:
+            root = node.module.split(".")[0]
+            if root in ("socket", "socketserver"):
+                out.append(node.lineno)
+            elif root == "http" and any(a.name in server_names
+                                        for a in node.names):
+                out.append(node.lineno)
+        elif isinstance(node, ast.Attribute) \
+                and node.attr in ("socket", "create_connection",
+                                  "create_server") \
+                and isinstance(node.value, ast.Name) \
+                and node.value.id == "socket":
+            out.append(node.lineno)
+        elif isinstance(node, ast.Name) and node.id in server_names:
+            out.append(node.lineno)
+    return sorted(set(out))
+
+
 def thread_sites(tree: ast.AST) -> list:
     """Line numbers of ThreadPoolExecutor / threading.Thread construction
     references (attribute access covers bare calls and aliases; plain
@@ -709,6 +753,15 @@ def collect(root=None) -> tuple:
                     "parallel/io.py; route the work through its "
                     "map_ordered/prefetch_iter so the in-flight byte "
                     "budget and ordered-gather contract hold")
+        if any(rel.startswith(d + os.sep) for d in PACKAGE_DIRS) \
+                and rel.replace(os.sep, "/") not in SOCKET_SITE_ALLOWLIST:
+            for line in socket_sites(tree):
+                problems.append(
+                    f"{rel}:{line}: socket creation outside "
+                    "cluster/transport.py; ride the cluster transport "
+                    "so framing, deadlines, and retry semantics hold "
+                    "(telemetry/exposition.py's HTTP exporter is the "
+                    "one other sanctioned listener)")
     tests_text = "\n".join(tests_text_parts)
     for name in event_classes:
         if name not in tests_text:

@@ -75,6 +75,20 @@ LOCK_CLASSES = {
         "why": "per-query io counters are written by prefetch producers "
                "on other threads (copied contexts)",
     },
+    ("hyperspace_tpu/cluster/worker.py", "ClusterNode"): {
+        "locks": {"_lock": None},
+        "delegates": frozenset(),
+        "why": "forward/broadcast stats are bumped by the submit path, "
+               "the server's connection threads, and the heartbeat — "
+               "three thread families over one counter dict",
+    },
+    ("hyperspace_tpu/cluster/gather.py", "_GatherHub"): {
+        "locks": {"_cond": None},
+        "delegates": frozenset(),
+        "why": "rendezvous slots are filled by one connection thread "
+               "per rank; the condition is both the mutex and the "
+               "all-parts-arrived wakeup",
+    },
     ("hyperspace_tpu/robustness/faults.py", "FaultRegistry"): {
         "locks": {"_lock": {"_hits", "_fired"}},
         "delegates": frozenset(),
@@ -222,6 +236,17 @@ LOCK_GLOBALS = {
     "hyperspace_tpu/artifacts/manager.py": [
         {"lock": "_REGISTRY_LOCK", "names": {"_REGISTRY"},
          "why": "double-checked singleton construction"},
+    ],
+    "hyperspace_tpu/cluster/worker.py": [
+        {"lock": "_NODE_LOCK", "names": {"_NODE"},
+         "why": "double-checked singleton construction"},
+    ],
+    "hyperspace_tpu/cluster/gather.py": [
+        {"lock": "_HUB_LOCK",
+         "names": {"_HUB", "_SEQ", "_NATIVE_OK", "_FORCED"},
+         "why": "rank-0 hub construction, the gather sequence counter, "
+                "and the cached native-collectives verdict are all "
+                "touched from concurrent gather callers"},
     ],
     "hyperspace_tpu/parallel/sharding.py": [
         {"lock": "_COUNT_LOCK",

@@ -166,6 +166,35 @@ def thread_sites(idx) -> list:
     return sorted(set(out))
 
 
+def socket_sites(idx) -> list:
+    out = []
+    server_names = ("HTTPServer", "ThreadingHTTPServer", "TCPServer",
+                    "UDPServer")
+    for node in idx.of(ast.Import):
+        if any(a.name.split(".")[0] in ("socket", "socketserver")
+               for a in node.names):
+            out.append(node.lineno)
+    for node in idx.of(ast.ImportFrom):
+        if not node.module:
+            continue
+        root = node.module.split(".")[0]
+        if root in ("socket", "socketserver"):
+            out.append(node.lineno)
+        elif root == "http" and any(a.name in server_names
+                                    for a in node.names):
+            out.append(node.lineno)
+    for node in idx.of(ast.Attribute):
+        if node.attr in ("socket", "create_connection",
+                         "create_server") \
+                and isinstance(node.value, ast.Name) \
+                and node.value.id == "socket":
+            out.append(node.lineno)
+    for node in idx.of(ast.Name):
+        if node.id in server_names:
+            out.append(node.lineno)
+    return sorted(set(out))
+
+
 def _mutated_names(idx) -> set:
     out = set()
     for node in idx.of(ast.Assign, ast.AugAssign):
@@ -438,6 +467,15 @@ def check_file(src, ctx) -> List[Diagnostic]:
                 "parallel/io.py; route the work through its "
                 "map_ordered/prefetch_iter so the in-flight byte "
                 "budget and ordered-gather contract hold"))
+    if in_pkg and slash not in legacy.SOCKET_SITE_ALLOWLIST:
+        for line in socket_sites(idx):
+            out.append(_legacy_diag(
+                "HS341", rel, line,
+                f"{rel}:{line}: socket creation outside "
+                "cluster/transport.py; ride the cluster transport "
+                "so framing, deadlines, and retry semantics hold "
+                "(telemetry/exposition.py's HTTP exporter is the "
+                "one other sanctioned listener)"))
     return out
 
 

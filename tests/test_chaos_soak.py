@@ -51,6 +51,11 @@ CHAOS_SPECS = {
     FN.LOG_WRITE: "error:p=0.5",
     FN.LOG_STABLE: "error:p=0.5",
     FN.ACTION_OP: "error:p=0.5",
+    # Cluster points armed like the action-path ones: the soak runs a
+    # single process (no fleet), so they never fire here — the
+    # dedicated injection tests live in tests/test_cluster.py.
+    FN.CLUSTER_FORWARD: "error:p=0.1",
+    FN.CLUSTER_BROADCAST: "error:p=0.1",
 }
 
 

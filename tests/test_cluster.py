@@ -1,0 +1,658 @@
+"""Shared-nothing serving cluster (cluster/).
+
+Acceptance contracts of the fleet tier:
+
+- **Disabled is a hard no-op**: with ``cluster.enabled`` unset nothing
+  binds a socket, no membership record is written, the router hook is
+  one conf read, and results + metrics text are byte-identical to a
+  build without the tier.
+- **Routing degrades, never breaks**: an unreachable shard owner, a
+  refused forward, or an injected ``cluster.forward`` fault falls back
+  to local execution with identical bytes; an injected/failed
+  ``cluster.broadcast`` costs one peer's standing-query firing, never
+  the commit.
+- **A real two-process fleet works**: two workers over one lake route
+  submissions to the consistent-hash owner (byte-identical), a second
+  submission is served from the OWNER's result cache across the wire,
+  ONE commit fires standing queries on BOTH workers, and kill -9 of
+  the owner mid-fleet degrades the next forward to local execution.
+- **The ring moves ~1/N keys per membership change** (the consistent-
+  hash contract that makes worker death invalidate one shard, not the
+  whole placement).
+"""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+import threading
+import time
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+import hyperspace_tpu as hst
+from hyperspace_tpu.api import Hyperspace
+from hyperspace_tpu.cluster import gather, membership, transport, worker
+from hyperspace_tpu.cluster.constants import ClusterConstants as CC
+from hyperspace_tpu.cluster.hashring import HashRing
+from hyperspace_tpu.index.constants import IndexConstants
+from hyperspace_tpu.plan.expr import col
+from hyperspace_tpu.robustness import fault_names as FN
+from hyperspace_tpu.robustness import faults
+from hyperspace_tpu.robustness.faults import FaultRegistry
+from hyperspace_tpu.serving.frontend import ServingFrontend
+from hyperspace_tpu.telemetry import metric_names as MN
+from hyperspace_tpu.telemetry import span_names as SN
+
+from conftest import capture_logger as sink  # noqa: E402
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _fresh_cluster():
+    yield
+    worker.shutdown_for_tests()
+    gather.reset_for_tests()
+    from hyperspace_tpu.serving import frontend as fe_mod
+    with fe_mod._DEFAULT_LOCK:
+        fe_mod._DEFAULT = None
+
+
+def _rng(seed=17):
+    return np.random.default_rng(seed)
+
+
+def _frame(rng, n):
+    return pd.DataFrame({
+        "k": rng.integers(0, 40, n).astype(np.int64),
+        "v": rng.integers(0, 9, n).astype(np.int64)})
+
+
+def _write_base(d, rng, n=2000):
+    os.makedirs(d, exist_ok=True)
+    pq.write_table(pa.Table.from_pandas(_frame(rng, n)),
+                   os.path.join(d, "p0.parquet"))
+
+
+def _session(tmp_path, capture=False, **conf):
+    session = hst.Session(system_path=str(tmp_path / "indexes"))
+    session.conf.set(IndexConstants.INDEX_NUM_BUCKETS, 4)
+    if capture:
+        session.conf.set(IndexConstants.EVENT_LOGGER_CLASS,
+                         "tests.conftest.CaptureLogger")
+        sink().events.clear()
+    for key, value in conf.items():
+        session.conf.set(key, value)
+    return session
+
+
+def _lake(tmp_path, capture=False, **conf):
+    data = str(tmp_path / "tbl")
+    _write_base(data, _rng())
+    return _session(tmp_path, capture=capture, **conf), data
+
+
+def _table_pd(table):
+    host = table.to_host()
+    return pd.DataFrame(
+        {n: np.asarray(c.data) for n, c in host.columns.items()}
+    ).sort_values(["k", "v"]).reset_index(drop=True)
+
+
+def _plant_peer(session, wid, port):
+    """A fresh-looking membership record for an unreachable worker."""
+    root = membership.membership_dir(session)
+    os.makedirs(root, exist_ok=True)
+    now = time.time() * 1000.0
+    with open(os.path.join(root, f"member-{wid}.json"), "w",
+              encoding="utf-8") as f:
+        f.write(json.dumps({
+            "worker_id": wid, "host": "127.0.0.1", "port": port,
+            "pid": 999999, "started_ms": now, "heartbeat_ms": now}))
+
+
+def _variant_owned_by(session, data, node, owner_wid):
+    """A plan variant whose cache-key digest the ring assigns to
+    ``owner_wid`` under the current roster."""
+    from hyperspace_tpu.serving.fingerprint import compute_key
+    ids = [m.worker_id for m in node.membership.live_members()]
+    t = session.read.parquet(data)
+    for i in range(60):
+        q = t.filter(col("k") < 3 + i).select("k", "v")
+        key = compute_key(session, q.plan)
+        if key is None:
+            continue
+        ring = HashRing(ids, vnodes=session.hs_conf.cluster_vnodes())
+        if ring.owner(key.digest()) == owner_wid:
+            return q
+    raise AssertionError(f"no variant owned by {owner_wid}")
+
+
+# ---------------------------------------------------------------------------
+# Registries: names, events, ring.
+# ---------------------------------------------------------------------------
+
+class TestRegistries:
+    def test_names_are_the_frozen_literals(self):
+        assert SN.CLUSTER_FORWARD == "cluster.forward"
+        assert SN.CLUSTER_BROADCAST == "cluster.broadcast"
+        assert SN.CLUSTER_GATHER == "cluster.gather"
+        assert FN.CLUSTER_FORWARD == "cluster.forward"
+        assert FN.CLUSTER_BROADCAST == "cluster.broadcast"
+        assert MN.COLLECTOR_CLUSTER == "cluster"
+
+    def test_event_hierarchy(self):
+        from hyperspace_tpu.telemetry.events import (
+            ClusterBroadcastEvent, ClusterEvent, ClusterForwardEvent,
+            ClusterJoinEvent, ClusterLeaveEvent, HyperspaceEvent)
+        assert issubclass(ClusterEvent, HyperspaceEvent)
+        for cls in (ClusterJoinEvent, ClusterLeaveEvent,
+                    ClusterForwardEvent, ClusterBroadcastEvent):
+            assert issubclass(cls, ClusterEvent)
+
+
+class TestHashRing:
+    def test_deterministic_and_total(self):
+        ring = HashRing(["a", "b", "c"], vnodes=32)
+        again = HashRing(["c", "b", "a"], vnodes=32)
+        keys = [f"digest-{i}" for i in range(500)]
+        owners = [ring.owner(k) for k in keys]
+        assert owners == [again.owner(k) for k in keys]
+        assert set(owners) == {"a", "b", "c"}
+
+    def test_join_moves_about_one_over_n(self):
+        keys = [f"digest-{i}" for i in range(2000)]
+        before = HashRing(["w0", "w1", "w2", "w3"])
+        after = HashRing(["w0", "w1", "w2", "w3", "w4"])
+        moved = sum(1 for k in keys if before.owner(k) != after.owner(k))
+        frac = moved / len(keys)
+        # Expected 1/5; consistent hashing's whole point is that it is
+        # nowhere near the naive (N-1)/N reshuffle.
+        assert 0.08 <= frac <= 0.35, frac
+        # Every moved key moved TO the joiner, never between survivors.
+        assert all(after.owner(k) == "w4" for k in keys
+                   if before.owner(k) != after.owner(k))
+
+    def test_empty_ring_and_replica_walk(self):
+        assert HashRing([]).owner("x") is None
+        assert HashRing([]).owners("x", 2) == []
+        ring = HashRing(["a", "b", "c"], vnodes=16)
+        replicas = ring.owners("some-digest", 2)
+        assert len(replicas) == 2 and len(set(replicas)) == 2
+        assert replicas[0] == ring.owner("some-digest")
+        assert set(ring.owners("some-digest", 99)) == {"a", "b", "c"}
+
+
+# ---------------------------------------------------------------------------
+# Transport.
+# ---------------------------------------------------------------------------
+
+class TestTransport:
+    def test_round_trip_and_error_envelope(self):
+        def handler(request):
+            if request.get("boom"):
+                raise ValueError("boom")
+            return {"ok": True, "echo": request["x"]}
+
+        srv = transport.Server("127.0.0.1", 0, handler, name="t")
+        try:
+            resp = transport.send_request(
+                srv.host, srv.port, {"x": [1, "a", (2, 3)]})
+            assert resp == {"ok": True, "echo": [1, "a", (2, 3)]}
+            resp = transport.send_request(srv.host, srv.port,
+                                          {"boom": True})
+            assert resp["ok"] is False
+            assert "ValueError: boom" in resp["error"]
+        finally:
+            srv.stop()
+
+    def test_dead_port_raises_after_retries(self):
+        srv = transport.Server("127.0.0.1", 0, lambda r: r, name="t")
+        host, port = srv.host, srv.port
+        srv.stop()
+        time.sleep(0.05)
+        with pytest.raises(OSError):
+            transport.send_request(host, port, {"op": "ping"},
+                                   timeout_s=0.5, attempts=2)
+
+    def test_numpy_payload_survives_framing(self):
+        srv = transport.Server(
+            "127.0.0.1", 0,
+            lambda r: {"ok": True, "twice": r["arr"] * 2}, name="t")
+        try:
+            arr = np.arange(12, dtype=np.int64).reshape(3, 4)
+            resp = transport.send_request(srv.host, srv.port,
+                                          {"arr": arr})
+            np.testing.assert_array_equal(resp["twice"], arr * 2)
+        finally:
+            srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# Membership.
+# ---------------------------------------------------------------------------
+
+class TestMembership:
+    def test_register_heartbeat_expire_reclaim(self, tmp_path):
+        session = _session(tmp_path)
+        session.conf.set(CC.STALENESS_MS, "150")
+        a = membership.Membership(session, "w-a", "127.0.0.1", 1111)
+        a.register()
+        # A second LIVE claimant of the same identity loses the race.
+        dup = membership.Membership(session, "w-a", "127.0.0.1", 2222)
+        with pytest.raises(FileExistsError):
+            dup.register()
+        b = membership.Membership(session, "w-b", "127.0.0.1", 3333)
+        b.register()
+        assert [m.worker_id for m in a.live_members()] == ["w-a", "w-b"]
+        assert [m.worker_id for m in a.peers()] == ["w-b"]
+        # b goes silent past the staleness horizon: routed around.
+        time.sleep(0.2)
+        a.heartbeat()
+        assert [m.worker_id for m in a.live_members()] == ["w-a"]
+        # ... and its corpse is reclaimable in place, not an error.
+        b2 = membership.Membership(session, "w-b", "127.0.0.1", 4444)
+        b2.register()
+        assert [m.worker_id for m in b2.live_members()] == ["w-a", "w-b"]
+        a.leave()
+        assert [m.worker_id for m in b2.live_members()] == ["w-b"]
+
+    def test_torn_record_skipped_not_fatal(self, tmp_path):
+        session = _session(tmp_path)
+        a = membership.Membership(session, "w-a", "127.0.0.1", 1111)
+        a.register()
+        root = membership.membership_dir(session)
+        with open(os.path.join(root, "member-torn.json"), "w") as f:
+            f.write('{"worker_id": "torn", "ho')  # torn mid-write
+        assert [m.worker_id for m in a.live_members()] == ["w-a"]
+
+    def test_heartbeat_daemon_refreshes(self, tmp_path):
+        session = _session(tmp_path)
+        session.conf.set(CC.HEARTBEAT_MS, "50")
+        a = membership.Membership(session, "w-a", "127.0.0.1", 1111)
+        a.register()
+        first = a.live_members()[0].heartbeat_ms
+        a.start_heartbeat()
+        deadline = time.time() + 5.0
+        while time.time() < deadline:
+            members = a.live_members()
+            if members and members[0].heartbeat_ms > first:
+                break
+            time.sleep(0.02)
+        else:
+            raise AssertionError("heartbeat never refreshed the record")
+        a.leave()
+
+
+# ---------------------------------------------------------------------------
+# Gather shim.
+# ---------------------------------------------------------------------------
+
+class TestGather:
+    def test_single_process_byte_identical_to_native(self):
+        from jax.experimental import multihost_utils as mhu
+        for x in (np.arange(6, dtype=np.int64),
+                  np.arange(12, dtype=np.float32).reshape(3, 4),
+                  np.array([b"ab", b"c"], dtype=object)):
+            ours = gather.allgather(x)
+            native = np.asarray(mhu.process_allgather(x))
+            assert ours.shape == native.shape
+            assert ours.dtype == native.dtype
+            assert np.array_equal(ours, native)
+
+    def test_threaded_three_rank_star(self, tmp_path):
+        """Every rank of the owned host path gets the full rank-ordered
+        stack — ranks run as threads so one process plays the fleet."""
+        rdv = str(tmp_path / "rdv")
+        parts = [np.full((4,), r, dtype=np.int64) for r in range(3)]
+        out = [None] * 3
+        errors = []
+
+        def rank(r):
+            try:
+                out[r] = gather.host_allgather(
+                    parts[r], rank=r, n=3, seq=1, rendezvous_dir=rdv,
+                    timeout_s=30.0)
+            except Exception as e:  # noqa: BLE001 — collected
+                errors.append(f"rank {r}: {type(e).__name__}: {e}")
+
+        threads = [threading.Thread(target=rank, args=(r,))
+                   for r in range(3)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        assert not errors, errors
+        expected = np.stack(parts)
+        for r in range(3):
+            np.testing.assert_array_equal(out[r], expected)
+
+    def test_forced_mode_seam(self):
+        gather.force_mode("host")
+        try:
+            assert gather._mode() == "host"
+        finally:
+            gather.force_mode(None)
+        assert gather._mode() in ("auto", "native", "host")
+
+
+# ---------------------------------------------------------------------------
+# Disabled = hard no-op; fingerprint indifference.
+# ---------------------------------------------------------------------------
+
+class TestDisabledNoOp:
+    def test_disabled_runs_local_and_writes_nothing(self, tmp_path):
+        session, data = _lake(tmp_path)
+        assert worker.get_node(session) is None
+        assert worker.maybe_node() is None
+        front = ServingFrontend(session)
+        q = session.read.parquet(data).filter(col("k") == 7) \
+            .select("k", "v")
+        base = q.to_pandas().sort_values(["k", "v"]) \
+            .reset_index(drop=True)
+        table = front.submit(q).result(timeout=120.0)
+        pd.testing.assert_frame_equal(_table_pd(table), base)
+        # No membership dir, no worker label, no broadcast.
+        assert not os.path.exists(
+            os.path.join(session.hs_conf.system_path(), "_hst_cluster"))
+        text = Hyperspace(session).metrics_text()
+        assert "worker=" not in text
+        assert worker.broadcast_commit(session, "tbl") == 0
+        fleet = Hyperspace(session).fleet_metrics()
+        assert set(fleet["workers"]) == {"local"}
+
+    def test_config_hash_ignores_cluster_keys(self, tmp_path):
+        from hyperspace_tpu.serving.fingerprint import config_hash
+        plain = _session(tmp_path)
+        tuned = _session(tmp_path)
+        tuned.conf.set(CC.ENABLED, "true")
+        tuned.conf.set(CC.WORKER_ID, "w-elsewhere")
+        tuned.conf.set(CC.PORT, "12345")
+        tuned.conf.set(CC.VNODES, "8")
+        assert config_hash(plain) == config_hash(tuned)
+
+
+# ---------------------------------------------------------------------------
+# One enabled worker: lifecycle, metrics surfaces, degradation.
+# ---------------------------------------------------------------------------
+
+class TestSingleWorker:
+    def _node(self, tmp_path, capture=False, **conf):
+        session, data = _lake(tmp_path, capture=capture, **conf)
+        session.conf.set(CC.ENABLED, "true")
+        session.conf.set(CC.WORKER_ID, "w-solo")
+        node = worker.get_node(session)
+        assert node is not None
+        return session, data, node
+
+    def test_lifecycle_ping_and_metrics_surfaces(self, tmp_path):
+        session, data, node = self._node(tmp_path, capture=True)
+        assert node.worker_id == "w-solo"
+        me = node.membership.live_members()[0]
+        resp = transport.send_request(me.host, me.port, {"op": "ping"})
+        assert resp == {"ok": True, "worker": "w-solo"}
+        hs = Hyperspace(session)
+        text = hs.metrics_text()
+        assert 'worker="w-solo"' in text
+        assert text.rstrip().endswith("# EOF")
+        fleet = hs.fleet_metrics()
+        assert set(fleet["workers"]) == {"w-solo"}
+        assert fleet["aggregate"]
+        snap = hs.metrics()
+        assert snap["collectors"]["cluster"]["members"] == 1
+        worker.shutdown_for_tests()
+        names = [type(e).__name__ for e in sink().events]
+        assert "ClusterJoinEvent" in names
+        assert "ClusterLeaveEvent" in names
+
+    def test_lonely_worker_serves_locally(self, tmp_path):
+        session, data, node = self._node(tmp_path)
+        front = ServingFrontend(session)
+        q = session.read.parquet(data).filter(col("k") == 5) \
+            .select("k", "v")
+        base = q.to_pandas().sort_values(["k", "v"]) \
+            .reset_index(drop=True)
+        table = front.submit(q).result(timeout=120.0)
+        pd.testing.assert_frame_equal(_table_pd(table), base)
+        stats = node.stats()
+        assert stats["forwarded"] == 0 and stats["forward_fallbacks"] == 0
+
+    def test_unreachable_owner_falls_back_byte_identical(self, tmp_path):
+        session, data, node = self._node(tmp_path, capture=True)
+        session.conf.set(CC.FORWARD_TIMEOUT_MS, "300")
+        _plant_peer(session, "w-gone", port=1)  # nothing listens on 1
+        q = _variant_owned_by(session, data, node, "w-gone")
+        base = q.to_pandas().sort_values(["k", "v"]) \
+            .reset_index(drop=True)
+        front = ServingFrontend(session)
+        table = front.submit(q).result(timeout=120.0)
+        pd.testing.assert_frame_equal(_table_pd(table), base)
+        assert node.stats()["forward_fallbacks"] >= 1
+        fwd = [e for e in sink().events
+               if type(e).__name__ == "ClusterForwardEvent"]
+        assert fwd and not fwd[0].ok
+
+    def test_injected_forward_fault_falls_back(self, tmp_path):
+        session, data, node = self._node(tmp_path)
+        _plant_peer(session, "w-gone", port=1)
+        q = _variant_owned_by(session, data, node, "w-gone")
+        base = q.to_pandas().sort_values(["k", "v"]) \
+            .reset_index(drop=True)
+        front = ServingFrontend(session)
+        before = node.stats()["forward_fallbacks"]
+        reg = FaultRegistry.from_conf_specs(
+            {FN.CLUSTER_FORWARD: "error:p=1"}, seed=7)
+        with faults.scope(reg):
+            table = front.submit(q).result(timeout=120.0)
+        pd.testing.assert_frame_equal(_table_pd(table), base)
+        assert node.stats()["forward_fallbacks"] == before + 1
+        assert reg.hit_count(FN.CLUSTER_FORWARD) >= 1
+
+    def test_broadcast_failure_and_fault_degrade(self, tmp_path):
+        session, data, node = self._node(tmp_path, capture=True)
+        session.conf.set(CC.FORWARD_TIMEOUT_MS, "300")
+        _plant_peer(session, "w-gone", port=1)
+        assert node.broadcast_commit("tbl") == 0  # unreachable peer
+        assert node.stats()["broadcast_failures"] >= 1
+        reg = FaultRegistry.from_conf_specs(
+            {FN.CLUSTER_BROADCAST: "error:p=1"}, seed=7)
+        before = node.stats()["broadcast_failures"]
+        with faults.scope(reg):
+            assert node.broadcast_commit("tbl") == 0
+        assert node.stats()["broadcast_failures"] == before + 1
+        assert reg.hit_count(FN.CLUSTER_BROADCAST) >= 1
+        names = [type(e).__name__ for e in sink().events]
+        assert "ClusterBroadcastEvent" in names
+
+
+# ---------------------------------------------------------------------------
+# The real thing: two worker processes over one lake.
+# ---------------------------------------------------------------------------
+
+_CHILD_SETUP = textwrap.dedent("""
+    import json, os, sys, time
+    import numpy as np
+    import pandas as pd
+    import hyperspace_tpu as hst
+    from hyperspace_tpu.cluster import worker as cw
+    from hyperspace_tpu.cluster.constants import ClusterConstants as CC
+    from hyperspace_tpu.index.constants import IndexConstants
+    from hyperspace_tpu.plan.expr import col
+    from hyperspace_tpu.serving.constants import ServingConstants
+    from hyperspace_tpu.serving.frontend import get_frontend
+
+    LAKE, RUN, WID = sys.argv[1], sys.argv[2], sys.argv[3]
+    DATA = os.path.join(LAKE, "tbl")
+    session = hst.Session(system_path=os.path.join(LAKE, "indexes"))
+    session.conf.set(IndexConstants.INDEX_NUM_BUCKETS, 4)
+    session.conf.set(ServingConstants.SERVING_ENABLED, "true")
+    session.conf.set(ServingConstants.RESULT_CACHE_ENABLED, "true")
+    session.conf.set(ServingConstants.RESULT_CACHE_MIN_COMPUTE_SECONDS,
+                     "0")
+    session.conf.set(CC.ENABLED, "true")
+    session.conf.set(CC.WORKER_ID, WID)
+    session.conf.set(CC.HEARTBEAT_MS, "200")
+    session.conf.set(CC.FORWARD_TIMEOUT_MS, "60000")
+
+    def table_pd(table):
+        host = table.to_host()
+        return pd.DataFrame(
+            {n: np.asarray(c.data) for n, c in host.columns.items()}
+        ).sort_values(["k", "v"]).reset_index(drop=True)
+""")
+
+_OWNER_BODY = textwrap.dedent("""
+    node = cw.get_node(session)
+    fe = get_frontend(session)
+    sub = fe.subscribe(session.read.parquet(DATA)
+                       .filter(col("k") == 7).select("k", "v"))
+    with open(os.path.join(RUN, "owner-ready"), "w") as f:
+        f.write(json.dumps({"pid": os.getpid(),
+                            "worker": node.worker_id}))
+    deliveries = sub.wait_for(1, timeout=180.0)
+    with open(os.path.join(RUN, "owner-fired"), "w") as f:
+        f.write(str(len(deliveries)))
+    while True:  # stay up to serve forwards until the client kills us
+        time.sleep(0.2)
+""")
+
+_CLIENT_BODY = textwrap.dedent("""
+    from hyperspace_tpu.api import Hyperspace
+    from hyperspace_tpu.cluster.hashring import HashRing
+    from hyperspace_tpu.serving.fingerprint import compute_key
+
+    node = cw.get_node(session)
+    fe = get_frontend(session)
+    hs = Hyperspace(session)
+    deadline = time.time() + 120
+    while len(node.membership.live_members()) < 2:
+        assert time.time() < deadline, "owner never joined the roster"
+        time.sleep(0.05)
+
+    def owned_variant(owner_wid):
+        ids = [m.worker_id for m in node.membership.live_members()]
+        t = session.read.parquet(DATA)
+        for i in range(60):
+            q = t.filter(col("k") < 3 + i).select("k", "v")
+            key = compute_key(session, q.plan)
+            if key is None:
+                continue
+            ring = HashRing(ids,
+                            vnodes=session.hs_conf.cluster_vnodes())
+            if ring.owner(key.digest()) == owner_wid:
+                return q
+        raise AssertionError("no variant owned by " + owner_wid)
+
+    summary = {}
+    q = owned_variant("w-owner")
+    base = q.to_pandas().sort_values(["k", "v"]).reset_index(drop=True)
+    t1 = fe.submit(q).result(timeout=180.0)
+    s1 = node.stats()
+    summary["first_forwarded"] = s1["forwarded"] >= 1
+    summary["first_was_execution"] = s1["forward_hits"] == 0
+    summary["first_identical"] = table_pd(t1).equals(base)
+
+    t2 = fe.submit(q).result(timeout=180.0)
+    s2 = node.stats()
+    summary["second_was_owner_cache_hit"] = s2["forward_hits"] >= 1
+    summary["second_identical"] = table_pd(t2).equals(base)
+
+    fleet = hs.fleet_metrics()
+    summary["fleet_workers"] = sorted(fleet["workers"])
+    owner_cl = (fleet["workers"].get("w-owner", {})
+                .get("collectors", {}) or {}).get("cluster", {}) or {}
+    summary["owner_counted_cache_hit"] = \\
+        owner_cl.get("forward_cache_hits", 0) >= 1
+    summary["worker_label"] = 'worker="w-client"' in hs.metrics_text()
+
+    sub = fe.subscribe(session.read.parquet(DATA)
+                       .filter(col("k") == 7).select("k", "v"))
+    rng = np.random.default_rng(4)
+    frame = pd.DataFrame(
+        {"k": rng.integers(0, 40, 80).astype(np.int64),
+         "v": rng.integers(0, 9, 80).astype(np.int64)})
+    hs.append(DATA, frame)
+    out = hs.commit(DATA)
+    summary["local_fired"] = out.get("subscriptions_fired", 0) >= 1
+    summary["local_delivered"] = len(sub.wait_for(1, timeout=120.0)) >= 1
+    fired_path = os.path.join(RUN, "owner-fired")
+    deadline = time.time() + 120
+    while not os.path.exists(fired_path) and time.time() < deadline:
+        time.sleep(0.1)
+    summary["owner_fired"] = (
+        os.path.exists(fired_path)
+        and open(fired_path).read().strip() == "1")
+
+    ready = json.loads(open(os.path.join(RUN, "owner-ready")).read())
+    os.kill(ready["pid"], 9)
+    time.sleep(0.3)
+    q3 = owned_variant("w-owner")
+    base3 = q3.to_pandas().sort_values(["k", "v"]).reset_index(drop=True)
+    t3 = fe.submit(q3).result(timeout=180.0)
+    summary["fallback_counted"] = \\
+        node.stats()["forward_fallbacks"] >= 1
+    summary["fallback_identical"] = table_pd(t3).equals(base3)
+
+    with open(os.path.join(RUN, "summary.json"), "w") as f:
+        f.write(json.dumps(summary))
+""")
+
+
+class TestTwoWorkerFleet:
+    def test_fleet_end_to_end(self, tmp_path):
+        """Forwarded execution, cross-worker cache hit, fleet-wide
+        standing-query firing from one commit, and kill -9 degradation
+        — all over two REAL worker processes sharing one lake."""
+        _write_base(str(tmp_path / "tbl"), _rng())
+        run = str(tmp_path / "run")
+        os.makedirs(run)
+        owner_py = os.path.join(run, "owner_child.py")
+        client_py = os.path.join(run, "client_child.py")
+        with open(owner_py, "w") as f:
+            f.write(_CHILD_SETUP + _OWNER_BODY)
+        with open(client_py, "w") as f:
+            f.write(_CHILD_SETUP + _CLIENT_BODY)
+        env = dict(os.environ)
+        env["PYTHONPATH"] = ROOT + os.pathsep + env.get("PYTHONPATH", "")
+        env["JAX_PLATFORMS"] = "cpu"
+        owner = subprocess.Popen(
+            [sys.executable, owner_py, str(tmp_path), run, "w-owner"],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True)
+        try:
+            ready = os.path.join(run, "owner-ready")
+            deadline = time.time() + 180
+            while not os.path.exists(ready):
+                if owner.poll() is not None:
+                    raise AssertionError(
+                        f"owner died early:\n{owner.stdout.read()}")
+                assert time.time() < deadline, "owner never came up"
+                time.sleep(0.1)
+            client = subprocess.run(
+                [sys.executable, client_py, str(tmp_path), run,
+                 "w-client"],
+                env=env, capture_output=True, text=True, timeout=600)
+            assert client.returncode == 0, \
+                f"client failed:\n{client.stdout}\n{client.stderr}"
+            with open(os.path.join(run, "summary.json")) as f:
+                summary = json.load(f)
+            expected_true = [
+                "first_forwarded", "first_was_execution",
+                "first_identical", "second_was_owner_cache_hit",
+                "second_identical", "owner_counted_cache_hit",
+                "worker_label", "local_fired", "local_delivered",
+                "owner_fired", "fallback_counted",
+                "fallback_identical"]
+            failed = [k for k in expected_true if summary.get(k) is not True]
+            assert not failed, f"{failed}; summary={summary}"
+            assert summary["fleet_workers"] == ["w-client", "w-owner"]
+        finally:
+            if owner.poll() is None:
+                owner.kill()
+            owner.wait(timeout=30)

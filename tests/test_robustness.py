@@ -98,6 +98,7 @@ class TestFaultSpecs:
             "result_cache.spill_read", "log.write", "log.stable",
             "action.op", "serving.worker", "ingest.stage",
             "ingest.publish", "artifacts.write", "artifacts.read",
+            "cluster.forward", "cluster.broadcast",
         })
 
     def test_parse_kinds_and_options(self):

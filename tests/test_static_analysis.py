@@ -138,6 +138,11 @@ _SEEDED = {
         "    with ThreadPoolExecutor(2) as ex:\n"
         "        return ex.map(work, [1])\n"
     ),
+    "hyperspace_tpu/socket_victim.py": (
+        "import socket\n"
+        "def f():\n"
+        "    return socket.create_connection(('h', 1))\n"
+    ),
     "hyperspace_tpu/broken_victim.py": "def f(:\n",  # syntax error
     "hyperspace_tpu/telemetry/events.py": (
         "class OrphanEvent:\n"
@@ -186,7 +191,8 @@ class TestParity:
                       "span name must", "fault-point name must",
                       "boundary kind must", "metric name must",
                       "bare 'except:'",
-                      "thread/pool construction", "syntax error",
+                      "thread/pool construction",
+                      "socket creation outside", "syntax error",
                       "never referenced under tests/"):
             assert token in text, f"gate output missing: {token}"
 
@@ -242,6 +248,7 @@ class TestFramework:
             "HS207", "HS208", "HS209", "HS210", "HS211", "HS212",
             "HS213", "HS214", "HS215", "HS216", "HS217",
             "HS301", "HS302", "HS311", "HS312", "HS321", "HS331",
+            "HS341",
         }
 
     def test_doc_table_in_lockstep(self):

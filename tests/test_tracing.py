@@ -393,7 +393,8 @@ class TestSpanRegistry:
             "io.read", "io.prefetch", "spmd.dispatch", "spmd.compile",
             "serving.sweep", "ingest.append", "ingest.commit",
             "ingest.compact", "artifact.load", "artifact.export",
-            "artifact.warmup",
+            "artifact.warmup", "cluster.forward", "cluster.broadcast",
+            "cluster.gather",
         })
 
     def test_join_reorder_span_appears_when_enabled(self, q3ish):
