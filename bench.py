@@ -2176,6 +2176,137 @@ def _run_streaming_phase(args, root: str) -> None:
         if fresh_per_commit > 0 else None
 
 
+def _run_streaming_scale_phase(args, root: str) -> None:
+    """Streaming at traffic scale (ISSUE r22): group-commit QPS vs
+    wave width, concurrent-committer coalescing (waves vs commit
+    calls), and standing-query fan-out latency at 10/100/1000
+    subscriptions riding one shared scan per template group. Emits
+    streaming_append_qps_w{1,4,16}, streaming_waves_vs_commits,
+    streaming_fanout_p99_ms_{10,100,1000}. 1-core parity bound: the
+    publication wave is host-I/O + identity work, so the width-16 win
+    comes from amortizing op-log entries and delta landings, not from
+    parallelism — wave/op-log and batcher counters are the honest
+    signal on a 1-core sandbox (same reading as the r09/r12 phases)."""
+    import threading as _threading
+
+    import numpy as np
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    import hyperspace_tpu as hst
+    from hyperspace_tpu.api import Hyperspace, IndexConfig
+    from hyperspace_tpu.plan.expr import col, sum_
+    from hyperspace_tpu.streaming.ingest import get_coordinator
+
+    rows = 500
+    total_batches = 32 if args.scale < 0.5 else 64
+    rng = np.random.default_rng(11)
+
+    def frame(n):
+        return pa.table({
+            "k": pa.array(rng.integers(0, 400, n).astype(np.int64)),
+            "v": pa.array(rng.integers(0, 97, n).astype(np.int64))})
+
+    def make_lake(tag, enable=True):
+        d = os.path.join(root, f"sscale_{tag}")
+        os.makedirs(d)
+        pq.write_table(frame(2 * rows), os.path.join(d, "p0.parquet"))
+        session = hst.Session(
+            system_path=os.path.join(root, f"sscale_{tag}_idx"))
+        session.conf.set("hyperspace.index.numBuckets", 4)
+        session.conf.set("hyperspace.tpu.distributed.enabled", "false")
+        hs = Hyperspace(session)
+        hs.create_index(session.read.parquet(d),
+                        IndexConfig(f"ss_{tag}", ["k"], ["v"]))
+        if enable:
+            session.enable_hyperspace()
+        return session, hs, d
+
+    # --- append QPS vs wave width: W appends per commit. Width 1 pays
+    # a full publication (op-log entry + delta landing per index) per
+    # batch; width 16 amortizes it 16 ways.
+    qps = {}
+    for width in (1, 4, 16):
+        session, hs, d = make_lake(f"w{width}")
+        done = 0
+        t0 = time.perf_counter()
+        while done < total_batches:
+            take = min(width, total_batches - done)
+            for _ in range(take):
+                hs.append(d, frame(rows))
+            hs.commit(d)
+            done += take
+        elapsed = time.perf_counter() - t0
+        qps[width] = done / elapsed
+        RESULT[f"streaming_append_qps_w{width}"] = round(qps[width], 3)
+    RESULT["streaming_scale_w16_vs_w1"] = round(qps[16] / qps[1], 3) \
+        if qps[1] > 0 else None
+
+    # --- concurrent committers coalescing into waves: 8 threads each
+    # stage and commit; the coordinator ledger says how many actual
+    # publication waves the 8 commit calls became.
+    session, hs, d = make_lake("waves")
+    coord0 = get_coordinator().stats()
+    n_threads = 8
+
+    def committer(i):
+        hs.append(d, frame(rows))
+        hs.commit(d)
+
+    threads = [_threading.Thread(target=committer, args=(i,))
+               for i in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    coord1 = get_coordinator().stats()
+    calls = coord1["commit_calls"] - coord0["commit_calls"]
+    waves = coord1["waves"] - coord0["waves"]
+    RESULT["streaming_commit_calls"] = calls
+    RESULT["streaming_waves"] = waves
+    RESULT["streaming_waves_vs_commits"] = round(calls / waves, 3) \
+        if waves else None
+
+    # --- standing-query fan-out: N same-template subscriptions, one
+    # commit, one shared scan + one vmapped sweep per template group.
+    # p99 is commit-start -> delivery. Hyperspace stays DISABLED on
+    # this lake so the fires execute raw literal-sweepable scans (a
+    # covering-index rewrite would serve each member from IndexScan
+    # and never exercise the shared-scan seam being measured).
+    from hyperspace_tpu.serving.frontend import ServingFrontend
+    session, hs, d = make_lake("fanout", enable=False)
+    session.conf.set("hyperspace.tpu.streaming.subscriptions.max",
+                     "1200")
+    fe = ServingFrontend(session)
+    sizes = (10, 100, 1000) if args.scale >= 0.05 else (10, 100)
+    for n_subs in sizes:
+        subs = []
+        for i in range(n_subs):
+            q = session.read.parquet(d) \
+                .filter(col("k") < (i % 37) + 2).group_by("k") \
+                .agg(sum_(col("v")).alias("sv")).sort("k")
+            subs.append(fe.subscribe(q, session=session,
+                                     client=f"fan{i}"))
+        base = {s.sub_id: s.delivered_total for s in subs}
+        hs.append(d, frame(rows))
+        t0 = time.perf_counter()
+        hs.commit(d)
+        lat = []
+        for s in subs:
+            s.wait_for(base[s.sub_id] + 1, timeout=600.0)
+            d_last = max(s.deliveries(), key=lambda x: x.seq)
+            lat.append((d_last.at_s - t0) * 1000.0)
+        lat.sort()
+        p99 = lat[min(len(lat) - 1, int(len(lat) * 0.99))]
+        RESULT[f"streaming_fanout_p99_ms_{n_subs}"] = round(p99, 2)
+        for s in subs:
+            s.unsubscribe()
+    fe.drain(timeout=120)
+    st = fe.stats()
+    RESULT["streaming_fanout_shared_scans"] = st["shared_scans"]
+    RESULT["streaming_fanout_batched_queries"] = st["batched_queries"]
+
+
 def _run_adaptive_phase(args, root: str) -> None:
     """Adaptive control plane (ISSUE r19): the three closed loops,
     measured. Emits adaptive_qerror_first_half/_second_half (feedback-
@@ -2936,6 +3067,13 @@ def main():
                 except Exception as e:
                     RESULT["errors"].append(
                         f"streaming phase: {type(e).__name__}: {e}")
+        if not _backend_dead():
+            with _phase("streaming_scale"):
+                try:
+                    _run_streaming_scale_phase(args, root)
+                except Exception as e:
+                    RESULT["errors"].append(
+                        f"streaming_scale phase: {type(e).__name__}: {e}")
         if not _backend_dead():
             with _phase("adaptive"):
                 try:

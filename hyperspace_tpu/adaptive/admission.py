@@ -49,7 +49,7 @@ class AdmissionController:
         self._overloaded = False
         self._last_refresh = 0.0
         self._stats = {"breaches": 0, "recoveries": 0,
-                       "sheds": 0, "degrades": 0}
+                       "sheds": 0, "degrades": 0, "ingest_pauses": 0}
 
     def refresh(self, session, force: bool = False) -> bool:
         """Re-evaluate the SLO monitor (rate-limited unless ``force``)
@@ -98,6 +98,20 @@ class AdmissionController:
     def overloaded(self) -> bool:
         with self._lock:
             return self._overloaded
+
+    def should_pause_ingest(self, session) -> bool:
+        """Continuous-source backpressure (streaming/sources.py): while
+        any armed objective is breached, tailers stop pulling new input
+        so serving drains first — ingest is the deferrable work. Counts
+        one ``ingest_pauses`` per answered pause; admission disabled
+        means never pause."""
+        if not session.hs_conf.adaptive_admission_enabled():
+            return False
+        if not self.refresh(session):
+            return False
+        with self._lock:
+            self._stats["ingest_pauses"] += 1
+        return True
 
     def stats(self) -> dict:
         with self._lock:

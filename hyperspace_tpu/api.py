@@ -180,16 +180,20 @@ class Hyperspace:
     # Streaming ingestion (streaming/): append/commit + compaction.
     # ------------------------------------------------------------------
 
-    def append(self, table_path: str, batch) -> dict:
+    def append(self, table_path: str, batch,
+               block: bool = False) -> dict:
         """Stage one record batch (pyarrow Table/RecordBatch, pandas
         DataFrame, or dict of columns) for the parquet table directory
         ``table_path``. The batch is written to a hidden staging file
         (invisible to every scan) and — while its rows are hot on
         device — sketched and bucket-routed into a prebuilt delta for
         each ACTIVE index over the table, so ``commit()`` is pure
-        metadata + renames. Returns a summary dict."""
+        metadata + renames. ``block=True`` parks on a full staging
+        budget (bounded by ``backpressure.timeoutMs``) instead of
+        raising — the continuous-source posture. Returns a summary
+        dict."""
         from .streaming.ingest import append as _append
-        return _append(self.session, table_path, batch)
+        return _append(self.session, table_path, batch, block=block)
 
     def commit(self, table_path: str) -> dict:
         """Publish every staged batch for ``table_path`` atomically
@@ -229,10 +233,30 @@ class Hyperspace:
         except Exception:
             return {"enabled": False}
 
+    def tail_directory(self, watch_dir: str, table_path: str,
+                       name=None):
+        """Start a continuous source (streaming/sources.py): a daemon
+        tailing ``watch_dir`` for new ``*.parquet`` drops (atomic
+        renames by the producer) and appending/committing them into
+        ``table_path`` itself, with blocking backpressure and
+        admission-aware pausing. Returns the running source — call
+        ``.stop()`` to drain and halt it."""
+        from .streaming.sources import tail_directory as _tail
+        return _tail(self.session, watch_dir, table_path, name=name)
+
+    def tail_log(self, log_path: str, table_path: str, name=None):
+        """Start a continuous source tailing the JSONL log at
+        ``log_path`` by byte offset (complete lines only), appending
+        each poll's records as one batch into ``table_path``. Returns
+        the running source — call ``.stop()`` to drain and halt it."""
+        from .streaming.sources import tail_log as _tail
+        return _tail(self.session, log_path, table_path, name=name)
+
     def streaming_stats(self) -> dict:
         """Ingestion-tier observability: the process commit queue's
-        counters (appends/commits/rows/deltas/subscription fires) plus
-        the op-log lookup cache's hit rates."""
+        counters (appends/commits/rows/deltas/subscription fires), the
+        group-commit coordinator's wave ledger, plus the op-log lookup
+        cache's hit rates."""
         from .streaming.ingest import get_queue
         return get_queue().stats()
 

@@ -254,10 +254,13 @@ class ClusterNode:
 
     # -- commit broadcast ---------------------------------------------
 
-    def broadcast_commit(self, table: str) -> int:
+    def broadcast_commit(self, table: str, batches: int = 0) -> int:
         """Send one commit notice to every live peer; delivered count.
-        Per-peer failures degrade (that peer misses one firing) and are
-        tallied, never raised."""
+        ``batches`` is the wave width — group commit coalesces a whole
+        publication wave into this ONE notice, so a lost peer costs
+        that peer one firing regardless of how many appends the wave
+        carried. Per-peer failures degrade (that peer misses one
+        firing) and are tallied, never raised."""
         from ..telemetry.events import ClusterBroadcastEvent
         conf = self._session.hs_conf
         if not conf.cluster_broadcast_enabled():
@@ -274,7 +277,8 @@ class ClusterNode:
                     response = transport.send_request(
                         peer.host, peer.port,
                         {"op": "commit", "table": table,
-                         "origin": self.worker_id},
+                         "origin": self.worker_id,
+                         "batches": batches},
                         timeout_s=timeout_s,
                         attempts=conf.cluster_retry_max_attempts(),
                         session=self._session)
@@ -289,10 +293,11 @@ class ClusterNode:
                 sp.attrs["delivered"] = delivered
         self._note(broadcasts_sent=delivered)
         self._emit(ClusterBroadcastEvent(
-            message=f"commit notice for {table!r} delivered to "
-                    f"{delivered}/{len(peers)} peers",
+            message=f"commit notice for {table!r} "
+                    + (f"({batches} batches) " if batches else "")
+                    + f"delivered to {delivered}/{len(peers)} peers",
             worker_id=self.worker_id, table=table, peers=len(peers),
-            delivered=delivered))
+            delivered=delivered, batches=batches))
         return delivered
 
     # -- surfaces -----------------------------------------------------
@@ -343,10 +348,11 @@ def try_forward(session, plan, norm, *, client: str = "",
                         deadline_ms=deadline_ms, est=est)
 
 
-def broadcast_commit(session, table: str) -> int:
+def broadcast_commit(session, table: str, batches: int = 0) -> int:
     """The ingest hook: fan a commit notice out to the fleet (no-op
-    when the cluster is disabled)."""
+    when the cluster is disabled). One call per publication WAVE —
+    ``batches`` says how many appends it carried."""
     node = get_node(session)
     if node is None:
         return 0
-    return node.broadcast_commit(table)
+    return node.broadcast_commit(table, batches=batches)

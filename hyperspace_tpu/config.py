@@ -612,6 +612,36 @@ class HyperspaceConf:
             StreamingConstants.COMPACTION_MIN_ENTRIES,
             StreamingConstants.COMPACTION_MIN_ENTRIES_DEFAULT)), 1)
 
+    def streaming_group_commit_enabled(self) -> bool:
+        return self._get_bool(
+            StreamingConstants.GROUP_COMMIT_ENABLED,
+            StreamingConstants.GROUP_COMMIT_ENABLED_DEFAULT)
+
+    def streaming_group_commit_window_ms(self) -> float:
+        return max(float(self._conf.get(
+            StreamingConstants.GROUP_COMMIT_WINDOW_MS,
+            StreamingConstants.GROUP_COMMIT_WINDOW_MS_DEFAULT)), 0.0)
+
+    def streaming_group_commit_max_wave(self) -> int:
+        return max(int(self._conf.get(
+            StreamingConstants.GROUP_COMMIT_MAX_WAVE,
+            StreamingConstants.GROUP_COMMIT_MAX_WAVE_DEFAULT)), 1)
+
+    def streaming_source_poll_ms(self) -> float:
+        return max(float(self._conf.get(
+            StreamingConstants.SOURCE_POLL_MS,
+            StreamingConstants.SOURCE_POLL_MS_DEFAULT)), 1.0)
+
+    def streaming_source_commit_batches(self) -> int:
+        return max(int(self._conf.get(
+            StreamingConstants.SOURCE_COMMIT_BATCHES,
+            StreamingConstants.SOURCE_COMMIT_BATCHES_DEFAULT)), 1)
+
+    def streaming_backpressure_timeout_ms(self) -> float:
+        return max(float(self._conf.get(
+            StreamingConstants.BACKPRESSURE_TIMEOUT_MS,
+            StreamingConstants.BACKPRESSURE_TIMEOUT_MS_DEFAULT)), 0.0)
+
     def streaming_subscriptions_max(self) -> int:
         return max(int(self._conf.get(
             StreamingConstants.SUBSCRIPTIONS_MAX,

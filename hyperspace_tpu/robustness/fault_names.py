@@ -83,6 +83,12 @@ INGEST_PUBLISH = "ingest.publish"
 ARTIFACTS_WRITE = "artifacts.write"
 ARTIFACTS_READ = "artifacts.read"
 
+# Continuous-source poll body (streaming/sources.py _poll_once): fires
+# before the source scans for new input — an injected error must cost
+# only that poll (counted, backed off, retried next tick), never kill
+# the tailer daemon or tear staged state.
+STREAMING_SOURCE = "streaming.source"
+
 # Serving cluster (cluster/worker.py). CLUSTER_FORWARD fires on the
 # sender side before a routed submission ships to its shard owner — an
 # injected error must degrade to local execution (byte-identical), the
@@ -97,6 +103,7 @@ FAULT_NAMES = frozenset({
     SPMD_DISPATCH, SPMD_COMPILE, BANK_COMPILE,
     RESULT_CACHE_DEVICE_PUT, RESULT_CACHE_SPILL_READ,
     LOG_WRITE, LOG_STABLE, ACTION_OP, SERVING_WORKER,
-    INGEST_STAGE, INGEST_PUBLISH, ARTIFACTS_WRITE, ARTIFACTS_READ,
+    INGEST_STAGE, INGEST_PUBLISH, STREAMING_SOURCE,
+    ARTIFACTS_WRITE, ARTIFACTS_READ,
     CLUSTER_FORWARD, CLUSTER_BROADCAST,
 })

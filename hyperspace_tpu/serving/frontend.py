@@ -320,6 +320,125 @@ class ServingFrontend:
                 raise
         return pending
 
+    def batching_enabled(self) -> bool:
+        """Whether this frontend's governing conf batches literal
+        variants (the standing-query fan-out asks before grouping)."""
+        return self._hs_conf.serving_batching_enabled()
+
+    def submit_wave(self, requests: List[tuple]) -> List:
+        """Admit a PREFORMED literal-sweep group — the standing-query
+        fan-out path (streaming/subscriptions.py): N same-template
+        fires enter as ONE wave that executes as one shared-scan sweep,
+        bypassing the queue's window/collect machinery (the group is
+        already assembled; re-queueing N entries would let concurrent
+        workers split it and the ``batching.maxBatch`` collector cap
+        fragment it). Each request is ``(plan, session, client,
+        deadline_ms)``; the returned list is aligned with ``requests``
+        and carries a :class:`PendingQuery` per admitted member or the
+        exception submit() would have raised (SLO shed, byte budget, a
+        FULL QUEUE — wave members never occupy queue slots, but a
+        backed-up queue sheds fires exactly as it does single ones).
+        One member's rejection never aborts the wave."""
+        from .context import next_query_id
+        from .fingerprint import estimate_recompute_bytes, normalize
+        out: List = []
+        entries: List[_Entry] = []
+        depth = self._hs_conf.serving_queue_depth()
+        max_bytes = self._hs_conf.serving_admission_max_bytes()
+        for plan, session, client, deadline_ms in requests:
+            try:
+                norm = normalize(plan)
+                est = estimate_recompute_bytes(norm)
+                approx = False
+                if session.hs_conf.adaptive_admission_enabled():
+                    from ..adaptive.admission import get_controller
+                    verdict = get_controller().decide(session)
+                    if verdict == "shed":
+                        with self._lock:
+                            self._stats["submitted"] += 1
+                            self._stats["rejected"] += 1
+                        reason = "slo breach: shedding load"
+                        self._emit_reject(session, client, est, reason)
+                        raise ServingRejectedError(
+                            f"serving admission rejected query: {reason}")
+                    if verdict == "degrade":
+                        # Approximate members never join the sweep —
+                        # _drain_wave runs them standalone.
+                        approx = True
+                pending = PendingQuery(query_id=next_query_id(),
+                                       client=client,
+                                       estimated_bytes=est)
+                deadline_s = time.perf_counter() + deadline_ms / 1000.0 \
+                    if deadline_ms is not None and deadline_ms > 0 \
+                    else None
+                with self._lock:
+                    self._stats["submitted"] += 1
+                    queued = len(self._queue)
+                    inflight = self._inflight_bytes
+                    if queued >= depth or \
+                            (inflight > 0 and inflight + est > max_bytes):
+                        self._stats["rejected"] += 1
+                        reason = (f"queue full ({queued}/{depth})"
+                                  if queued >= depth else
+                                  f"byte budget ({inflight + est} > "
+                                  f"{max_bytes})")
+                    else:
+                        reason = None
+                        self._stats["admitted"] += 1
+                        self._inflight_bytes += est
+                if reason is not None:
+                    self._emit_reject(session, client, est, reason)
+                    raise ServingRejectedError(
+                        f"serving admission rejected query: {reason}")
+                entries.append(_Entry(
+                    plan, norm, session, contextvars.copy_context(),
+                    pending, None, deadline_s=deadline_s, approx=approx))
+                out.append(pending)
+                self._emit_admit(session, client, est, queued + 1)
+            except Exception as e:
+                out.append(e)
+        if entries:
+            with self._lock:
+                self._active_workers += 1
+            from ..parallel import io as pio
+            try:
+                pio.submit_serving(
+                    lambda: self._drain_wave(entries),
+                    self._hs_conf.serving_max_concurrency())
+            except BaseException as e:
+                # No worker will ever run these members: fail their
+                # futures (deliveries observe the error) and release
+                # their admission so budgets stay honest.
+                with self._lock:
+                    self._active_workers -= 1
+                for entry in entries:
+                    entry.pending._finish(error=e)
+                    self._note(failed=1)
+                    self._release(entry)
+        return out
+
+    def _drain_wave(self, entries: List[_Entry]) -> None:
+        """Execute one preformed wave: the sweep-eligible members as a
+        single literal-sweep batch (one shared scan per source, one
+        vmapped invocation per swept position — however many members),
+        SLO-degraded members standalone. Same death guarantees as
+        _drain: any escape releases unstarted members to per-member
+        execution and the worker slot is always returned."""
+        try:
+            singles = [e for e in entries if e.approx]
+            sweepers = [e for e in entries if not e.approx]
+            for e in singles:
+                self._run_single(e)
+            if len(sweepers) == 1:
+                self._run_single(sweepers[0])
+            elif sweepers:
+                self._run_batch(sweepers)
+        except BaseException as e:
+            self._release_batch(entries, e)
+        finally:
+            with self._lock:
+                self._active_workers -= 1
+
     # ------------------------------------------------------------------
     # Standing queries (streaming tier).
     # ------------------------------------------------------------------

@@ -35,6 +35,38 @@ class StreamingConstants:
     COMPACTION_MIN_ENTRIES = "hyperspace.tpu.streaming.compaction.minEntries"
     COMPACTION_MIN_ENTRIES_DEFAULT = "2"
 
+    # Group commit (streaming/ingest.CommitCoordinator): concurrent
+    # commit() callers coalesce into one publication wave — one op-log
+    # entry per table and one delta build per index per wave. Off,
+    # every commit() publishes its own staged batches exactly as before
+    # this tier (byte-identical results, just more op-log entries).
+    GROUP_COMMIT_ENABLED = "hyperspace.tpu.streaming.groupCommit.enabled"
+    GROUP_COMMIT_ENABLED_DEFAULT = "true"
+    # Linger before the wave leader pops the queue, letting more appends
+    # and committers pile into the same wave. 0 = publish immediately.
+    GROUP_COMMIT_WINDOW_MS = "hyperspace.tpu.streaming.groupCommit.windowMs"
+    GROUP_COMMIT_WINDOW_MS_DEFAULT = "0"
+    # Most staged batches one publication wave may carry; a deeper queue
+    # is drained as consecutive sub-waves so undo/redo stays bounded.
+    GROUP_COMMIT_MAX_WAVE = "hyperspace.tpu.streaming.groupCommit.maxWave"
+    GROUP_COMMIT_MAX_WAVE_DEFAULT = "256"
+
+    # Continuous sources (streaming/sources.py): poll cadence for the
+    # directory/log tailers and how many appends they buffer before
+    # driving a commit themselves.
+    SOURCE_POLL_MS = "hyperspace.tpu.streaming.source.pollMs"
+    SOURCE_POLL_MS_DEFAULT = "50"
+    SOURCE_COMMIT_BATCHES = "hyperspace.tpu.streaming.source.commitBatches"
+    SOURCE_COMMIT_BATCHES_DEFAULT = "8"
+
+    # Blocking backpressure: how long a blocking append (continuous
+    # sources; CommitQueue.push(block=True)) waits for staged-batch
+    # budget before giving up. The plain append() API keeps its
+    # raise-on-full default and never waits.
+    BACKPRESSURE_TIMEOUT_MS = \
+        "hyperspace.tpu.streaming.backpressure.timeoutMs"
+    BACKPRESSURE_TIMEOUT_MS_DEFAULT = "30000"
+
     # Standing-query subscriptions (serving/frontend.subscribe).
     SUBSCRIPTIONS_MAX = "hyperspace.tpu.streaming.subscriptions.max"
     SUBSCRIPTIONS_MAX_DEFAULT = "64"

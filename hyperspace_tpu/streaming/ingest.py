@@ -20,6 +20,11 @@ marginal cost):
   already cover them, so queries serve from fresh indexes with no
   refresh pass, and the r06 result-cache log-version keys invalidate by
   construction.
+- Group commit (``CommitCoordinator``, on by default): concurrent
+  ``commit()`` callers coalesce into one publication WAVE, so N
+  coalesced appends cost one op-log entry, one delta landing per
+  index, one standing-query fire, and one cluster broadcast — append
+  QPS scales with batch width instead of being flat per commit.
 
 Crash safety (undo/redo over the table log, proven by the kill -9
 harness in tests/test_streaming.py): a commit that died before all its
@@ -143,6 +148,11 @@ class CommitQueue:
 
     def __init__(self):
         self._lock = threading.Lock()
+        # Blocking-backpressure waiters (push(block=True) /
+        # wait_for_space) park here; every pending-reducing mutation
+        # (land / abandon / drop_table) notifies. Shares ``_lock`` so a
+        # wait releases the same mutex the mutations hold.
+        self._space = threading.Condition(self._lock)
         self._staged: Dict[str, List[StagedBatch]] = {}
         # Batches popped by an in-flight commit still count toward the
         # lineage base of concurrent appends until they land or requeue.
@@ -168,8 +178,17 @@ class CommitQueue:
         with self._lock:
             return self._commit_locks.setdefault(table, threading.Lock())
 
-    def push(self, batch: StagedBatch, max_staged: int) -> None:
+    def push(self, batch: StagedBatch, max_staged: int,
+             block: bool = False,
+             timeout_s: Optional[float] = None) -> None:
+        """Stage one batch. The API DEFAULT on a full table
+        (``staged + in-flight >= max_staged``) is raise-on-full;
+        ``block=True`` (continuous sources) parks until a commit frees
+        budget or ``timeout_s`` elapses (then the same exception)."""
         with self._lock:
+            if block:
+                self._await_space(batch.table_path, max_staged,
+                                  timeout_s)
             staged = self._staged.setdefault(batch.table_path, [])
             pending = len(staged) + \
                 len(self._inflight.get(batch.table_path, []))
@@ -188,12 +207,53 @@ class CommitQueue:
             self._stats["covering_deltas"] += len(batch.covering)
             self._stats["sketch_deltas"] += len(batch.sketches)
 
-    def pop_all(self, table: str) -> List[StagedBatch]:
+    def wait_for_space(self, table: str, max_staged: int,
+                       timeout_s: Optional[float] = None) -> None:
+        """Park until ``table`` has staged-batch budget (the blocking
+        analogue of append()'s raise-on-full pre-check)."""
         with self._lock:
-            batches = self._staged.pop(table, [])
+            self._await_space(table, max_staged, timeout_s)
+
+    def _await_space(self, table: str, max_staged: int,
+                     timeout_s: Optional[float]) -> None:
+        # Caller holds _lock; the wait releases it so land/abandon/
+        # drop_table can drain the table under us.
+        deadline = None if timeout_s is None \
+            else time.monotonic() + timeout_s
+        while len(self._staged.get(table, [])) + \
+                len(self._inflight.get(table, [])) >= max_staged:
+            remaining = None if deadline is None \
+                else deadline - time.monotonic()
+            if remaining is not None and remaining <= 0:
+                raise HyperspaceException(
+                    f"{table}: blocked append timed out after "
+                    f"{timeout_s:.1f}s waiting for staged-batch budget "
+                    "(hyperspace.tpu.streaming.maxStagedBatches; "
+                    "is anything committing?)")
+            self._space.wait(remaining)
+
+    def pop_wave(self, table: str, limit: Optional[int] = None):
+        """Move up to ``limit`` staged batches (all of them when None)
+        into the in-flight set, FIFO order preserved. Returns
+        ``(batches, truncated)`` — truncated means more batches stayed
+        staged, and the group-commit leader drains them as another
+        bounded sub-wave."""
+        with self._lock:
+            staged = self._staged.get(table, [])
+            if limit is None or limit >= len(staged):
+                batches = self._staged.pop(table, [])
+                truncated = False
+            else:
+                batches = staged[:limit]
+                self._staged[table] = staged[limit:]
+                truncated = True
             if batches:
                 self._inflight.setdefault(table, []).extend(batches)
-            return batches
+            return batches, truncated
+
+    def pop_all(self, table: str) -> List[StagedBatch]:
+        batches, _ = self.pop_wave(table)
+        return batches
 
     def land(self, table: str, batches: List[StagedBatch]) -> None:
         with self._lock:
@@ -204,6 +264,7 @@ class CommitQueue:
             self._stats["commits"] += 1
             self._stats["batches_committed"] += len(batches)
             self._stats["rows_committed"] += sum(b.rows for b in batches)
+            self._space.notify_all()
 
     def requeue(self, table: str, batches: List[StagedBatch]) -> None:
         """Put batches a conflicted commit never started back at the
@@ -228,6 +289,7 @@ class CommitQueue:
             for b in batches:
                 if b in flight:
                     flight.remove(b)
+            self._space.notify_all()
 
     def drop_table(self, table: str) -> List[StagedBatch]:
         """Forget a table's staged state (recovery swept its staging
@@ -236,7 +298,15 @@ class CommitQueue:
             dropped = self._staged.pop(table, [])
             dropped += self._inflight.pop(table, [])
             self._schemas.pop(table, None)
+            self._space.notify_all()
             return dropped
+
+    def has_staged(self, table: str) -> bool:
+        """Any batches still STAGED (not in-flight) for ``table``? The
+        group-commit leader election consults this so batches pushed
+        outside append() (no coordinator note) still get a wave."""
+        with self._lock:
+            return bool(self._staged.get(table))
 
     def table_schema(self, table: str, loader):
         """Memoized table schema; ``loader()`` runs once per table and
@@ -301,6 +371,7 @@ class CommitQueue:
             out["batches_staged"] = sum(
                 len(v) for v in self._staged.values())
         out["oplog_cache"] = get_lookup_cache().stats()
+        out["group_commit"] = get_coordinator().stats()
         return out
 
 
@@ -318,6 +389,240 @@ def get_queue() -> CommitQueue:
             from ..telemetry.metrics import get_registry
             get_registry().register_collector("streaming", _QUEUE.stats)
         return _QUEUE
+
+
+# ---------------------------------------------------------------------------
+# Group commit.
+# ---------------------------------------------------------------------------
+
+class _WaveState:
+    """One table's group-commit ledger (every field guarded by
+    CommitCoordinator._cv). Sequence numbers count successful pushes:
+    ``push_seq`` is the head, ``pop_mark`` the head snapshot the
+    in-flight wave popped at, ``done_seq`` the head published through
+    by landed waves. A commit() call targeting ``push_seq <= pop_mark``
+    rides the in-flight wave; one targeting ``<= done_seq`` is already
+    published."""
+
+    __slots__ = ("push_seq", "pop_mark", "done_seq", "leader",
+                 "generation", "riders", "outcomes")
+
+    def __init__(self):
+        self.push_seq = 0
+        self.pop_mark = 0
+        self.done_seq = 0
+        self.leader = False
+        self.generation = 0
+        self.riders = 0
+        self.outcomes: Dict[int, tuple] = {}
+
+
+class CommitCoordinator:
+    """Per-table group commit: concurrent ``commit()`` callers coalesce
+    into publication WAVES. One caller leads — pops the queue (bounded
+    sub-waves of ``groupCommit.maxWave``) and runs the op-log protocol —
+    while every caller whose staged batches the wave covers parks on
+    the ledger and returns the wave's outcome when it lands. However
+    many appends joined, a wave costs ONE op-log entry per table, one
+    delta landing per index, ONE standing-query fire, and ONE cluster
+    broadcast (the r21 per-commit broadcast, coalesced). Only ledger
+    flips hold ``_cv`` (HS301-registered); the op-log work runs outside
+    it. A failed wave raises in the leader AND every rider — their
+    batches are requeued (pre-op conflict) or abandoned for recover()
+    (mid-protocol wreck), exactly the r17 contract."""
+
+    def __init__(self):
+        self._cv = threading.Condition()
+        self._tables: Dict[str, _WaveState] = {}
+        self._stats = {
+            "commit_calls": 0, "waves": 0, "sub_waves": 0,
+            "led": 0, "joined": 0, "wave_batches": 0,
+        }
+
+    def note_push(self, table: str) -> None:
+        """One batch staged for ``table`` (append() calls this after a
+        successful push, group commit enabled or not — the ledger must
+        not miss pushes made while the flag was off)."""
+        with self._cv:
+            self._tables.setdefault(table, _WaveState()).push_seq += 1
+
+    def forget(self, table: str) -> None:
+        """Drop a table's wave ledger (recovery swept its staged state
+        out from under us); parked committers are released with an
+        empty outcome."""
+        with self._cv:
+            self._tables.pop(table, None)
+            self._cv.notify_all()
+
+    def stats(self) -> dict:
+        with self._cv:
+            return dict(self._stats)
+
+    def commit_grouped(self, session, table_path: str) -> dict:
+        """The group-commit entry: returns when every batch staged for
+        ``table_path`` BEFORE this call is published (or the wave that
+        carried them failed — the failure propagates to every rider).
+        Exactly one caller per wave runs the op-log protocol."""
+        with self._cv:
+            self._stats["commit_calls"] += 1
+            st = self._tables.setdefault(table_path, _WaveState())
+            target = st.push_seq
+            while True:
+                if st.leader:
+                    if st.pop_mark < target:
+                        # The in-flight wave popped before our batches
+                        # staged: wait it out, then lead (or ride) the
+                        # next one.
+                        self._cv.wait()
+                        continue
+                    # Ride the in-flight wave — it covers everything
+                    # this caller staged.
+                    gen = st.generation
+                    st.riders += 1
+                    self._stats["joined"] += 1
+                    while st.generation == gen and \
+                            self._tables.get(table_path) is st:
+                        self._cv.wait()
+                    res, err = st.outcomes.get(gen, (None, None))
+                    if err is not None:
+                        raise err
+                    if res is None:
+                        # forget() reset the ledger mid-wave (recovery
+                        # swept the table): nothing left to publish.
+                        return _empty_commit_summary()
+                    out = dict(res)
+                    out["files"] = list(res["files"])
+                    out["indexes_updated"] = list(res["indexes_updated"])
+                    out["indexes_skipped"] = list(res["indexes_skipped"])
+                    out["joined_wave"] = True
+                    return out
+                if st.done_seq >= target and \
+                        not get_queue().has_staged(table_path):
+                    # Published by a wave that landed before we got
+                    # here — same shape as an empty-queue commit.
+                    return _empty_commit_summary()
+                st.leader = True
+                st.riders = 0
+                self._stats["led"] += 1
+                break
+        return self._lead(session, st, table_path)
+
+    def _lead(self, session, st: _WaveState, table_path: str) -> dict:
+        # Leader path — NO _cv held except at the marked flips. Any
+        # outcome (return or raise) MUST finalize the generation, or
+        # riders park forever: everything sits inside try/finally.
+        t0 = time.perf_counter()
+        agg: Optional[dict] = None
+        error: Optional[BaseException] = None
+        sub_waves = 0
+        try:
+            window_s = \
+                session.hs_conf.streaming_group_commit_window_ms() / 1000.0
+            max_wave = session.hs_conf.streaming_group_commit_max_wave()
+            if window_s > 0:
+                # Linger: let appends (and the committers carrying
+                # them) pile into this wave before the single
+                # publication.
+                time.sleep(window_s)
+            with _trace.maintenance_trace(session, "ingest"), \
+                    _trace.span(SN.INGEST_WAVE) as sp:
+                while True:
+                    with self._cv:
+                        st.pop_mark = st.push_seq
+                        self._cv.notify_all()
+                    res, truncated = _commit_once(session, table_path,
+                                                  limit=max_wave)
+                    sub_waves += 1
+                    agg = res if agg is None \
+                        else _merge_commit_summary(agg, res)
+                    if not truncated:
+                        break
+                if agg["committed_batches"]:
+                    agg["subscriptions_fired"] = _fire_subscriptions(
+                        session, table_path,
+                        batches=agg["committed_batches"])
+                agg["sub_waves"] = sub_waves
+                agg["seconds"] = time.perf_counter() - t0
+                if sp is not None:
+                    sp.attrs["batches"] = agg["committed_batches"]
+                    sp.attrs["sub_waves"] = sub_waves
+                    sp.attrs["joined"] = st.riders
+            return agg
+        except BaseException as e:
+            error = e
+            raise
+        finally:
+            with self._cv:
+                gen = st.generation
+                st.generation = gen + 1
+                st.leader = False
+                riders = st.riders
+                st.outcomes[gen] = \
+                    (agg if error is None else None, error)
+                # Outcomes are read once per rider; keep only a short
+                # tail so the ledger never grows with wave count.
+                for old in [g for g in st.outcomes if g < gen - 3]:
+                    del st.outcomes[old]
+                if error is None:
+                    st.done_seq = max(st.done_seq, st.pop_mark)
+                    self._stats["waves"] += 1
+                    self._stats["sub_waves"] += sub_waves
+                    self._stats["wave_batches"] += \
+                        agg["committed_batches"] if agg else 0
+                self._cv.notify_all()
+            if error is None and agg is not None \
+                    and agg["committed_batches"]:
+                _emit_wave(session, table_path, agg, riders, sub_waves)
+
+
+def _empty_commit_summary() -> dict:
+    # Same shape as a non-empty commit: callers read these keys
+    # unconditionally (retry loops, timer-driven committers).
+    return {"committed_batches": 0, "rows": 0, "files": [],
+            "indexes_updated": [], "indexes_skipped": [],
+            "subscriptions_fired": 0, "seconds": 0.0}
+
+
+def _merge_commit_summary(agg: dict, res: dict) -> dict:
+    agg["committed_batches"] += res["committed_batches"]
+    agg["rows"] += res["rows"]
+    agg["files"].extend(res["files"])
+    for key in ("indexes_updated", "indexes_skipped"):
+        for name in res[key]:
+            if name not in agg[key]:
+                agg[key].append(name)
+    agg["seconds"] += res["seconds"]
+    return agg
+
+
+def _emit_wave(session, table_path: str, agg: dict, riders: int,
+               sub_waves: int) -> None:
+    try:
+        from ..telemetry.events import StreamingWaveEvent
+        from ..telemetry.logging import get_logger
+        get_logger(session.hs_conf.event_logger_class()).log_event(
+            StreamingWaveEvent(
+                message=(f"wave of {agg['committed_batches']} batches "
+                         f"({riders} committers rode it)"),
+                table=table_path, batches=agg["committed_batches"],
+                rows=agg["rows"], joined=riders, sub_waves=sub_waves,
+                seconds=agg["seconds"]))
+    except Exception:
+        pass
+
+
+_COORD: Optional[CommitCoordinator] = None
+_COORD_LOCK = threading.Lock()
+
+
+def get_coordinator() -> CommitCoordinator:
+    """The process-wide group-commit coordinator (one ledger per
+    table, lazily created)."""
+    global _COORD
+    with _COORD_LOCK:
+        if _COORD is None:
+            _COORD = CommitCoordinator()
+        return _COORD
 
 
 # ---------------------------------------------------------------------------
@@ -408,26 +713,34 @@ def _staging_dir(base: str) -> str:
 # append().
 # ---------------------------------------------------------------------------
 
-def append(session, table_path: str, batch) -> dict:
+def append(session, table_path: str, batch, block: bool = False) -> dict:
     """Stage one record batch for ``table_path`` and prebuild its index
     deltas on device. Returns a summary dict; nothing is visible to
-    queries until ``commit()``."""
+    queries until ``commit()``. The API default on a full staging
+    budget is raise-on-full; ``block=True`` (continuous sources) parks
+    until a commit frees budget or ``backpressure.timeoutMs`` elapses."""
     if not session.hs_conf.streaming_enabled():
         raise HyperspaceException(
             "hyperspace.tpu.streaming.enabled is false; enable it to use "
             "the append/commit ingestion tier")
     table_path = os.path.abspath(table_path)
     queue = get_queue()
+    timeout_s = \
+        session.hs_conf.streaming_backpressure_timeout_ms() / 1000.0
     with queue.table_lock(table_path), \
             _faults.scope_for(session.hs_conf), \
             _trace.maintenance_trace(session, "ingest"), \
             _trace.span(SN.INGEST_APPEND) as sp:
         t0 = time.perf_counter()
-        # Backpressure FIRST: a rejected append must not pay the parquet
-        # write and the on-device delta builds (push() re-checks under
-        # the lock for race-tightness).
+        # Backpressure FIRST: a rejected (or parked) append must not pay
+        # the parquet write and the on-device delta builds (push()
+        # re-checks under the lock for race-tightness). The blocking
+        # wait holds only the per-table append lock — commits take the
+        # commit lock, so they drain the table under us and wake us.
         max_staged = session.hs_conf.streaming_max_staged_batches()
-        if queue.staged_count(table_path) >= max_staged:
+        if block:
+            queue.wait_for_space(table_path, max_staged, timeout_s)
+        elif queue.staged_count(table_path) >= max_staged:
             raise HyperspaceException(
                 f"{table_path}: staged batches reach "
                 "hyperspace.tpu.streaming.maxStagedBatches; commit() "
@@ -462,7 +775,9 @@ def append(session, table_path: str, batch) -> dict:
                 with shapes.use_conf(session.hs_conf), \
                         pio.use_session(session):
                     _prebuild_deltas(session, queue, staged, at)
-            queue.push(staged, max_staged)
+            queue.push(staged, max_staged, block=block,
+                       timeout_s=timeout_s if block else None)
+            get_coordinator().note_push(table_path)
         except BaseException:
             # A failed append must not leak invisible staging files —
             # including the partial parquet of a failed write — until
@@ -1028,21 +1343,37 @@ def commit(session, table_path: str) -> dict:
     a summary dict ({committed_batches, rows, files, indexes_updated});
     a commit that lost the put-if-absent race (another process committed
     concurrently) re-queues its batches and raises — retry after the
-    winner finishes."""
+    winner finishes. With ``groupCommit.enabled`` (the default)
+    concurrent callers coalesce into one publication wave — one op-log
+    entry, one delta landing per index, one subscription fire, one
+    cluster broadcast — and riders' summaries carry ``joined_wave``.
+    Off, every call publishes its own batches exactly as before."""
     if not session.hs_conf.streaming_enabled():
         raise HyperspaceException(
             "hyperspace.tpu.streaming.enabled is false; enable it to use "
             "the append/commit ingestion tier")
     table_path = os.path.abspath(table_path)
+    if session.hs_conf.streaming_group_commit_enabled():
+        return get_coordinator().commit_grouped(session, table_path)
+    res, _ = _commit_once(session, table_path)
+    if res["committed_batches"]:
+        res["subscriptions_fired"] = _fire_subscriptions(
+            session, table_path, batches=res["committed_batches"])
+    return res
+
+
+def _commit_once(session, table_path: str,
+                 limit: Optional[int] = None):
+    """One publication through the op-log protocol: pop (up to
+    ``limit``) staged batches and land them as ONE table-log entry plus
+    one delta landing per index. Does NOT fire subscriptions — the
+    callers (legacy per-commit path, group-commit wave leader) fire
+    once per publication wave. Returns ``(summary, truncated)``."""
     queue = get_queue()
     with queue.commit_lock(table_path):
-        batches = queue.pop_all(table_path)
+        batches, truncated = queue.pop_wave(table_path, limit)
         if not batches:
-            # Same shape as a non-empty commit: callers read these keys
-            # unconditionally (retry loops, timer-driven committers).
-            return {"committed_batches": 0, "rows": 0, "files": [],
-                    "indexes_updated": [], "indexes_skipped": [],
-                    "subscriptions_fired": 0, "seconds": 0.0}
+            return _empty_commit_summary(), False
         t0 = time.perf_counter()
         log_mgr = IndexLogManager(table_log_dir(session, table_path))
         action = _StreamingCommitAction(session, log_mgr, table_path,
@@ -1069,17 +1400,17 @@ def commit(session, table_path: str) -> dict:
         # Landed entries changed index state under the caching manager.
         session.index_collection_manager.clear_cache()
         seconds = time.perf_counter() - t0
-    fired = _fire_subscriptions(session, table_path)
-    return {"committed_batches": len(batches),
-            "rows": sum(b.rows for b in batches),
-            "files": [b.final_path for b in batches],
-            "indexes_updated": list(action.indexes_updated),
-            "indexes_skipped": list(action.indexes_skipped),
-            "subscriptions_fired": fired,
-            "seconds": seconds}
+    return ({"committed_batches": len(batches),
+             "rows": sum(b.rows for b in batches),
+             "files": [b.final_path for b in batches],
+             "indexes_updated": list(action.indexes_updated),
+             "indexes_skipped": list(action.indexes_skipped),
+             "subscriptions_fired": 0,
+             "seconds": seconds}, truncated)
 
 
-def _fire_subscriptions(session, table_path: str) -> int:
+def _fire_subscriptions(session, table_path: str,
+                        batches: int = 0) -> int:
     from ..serving import frontend as fe
     fired = 0
     for front in fe.all_frontends():
@@ -1101,7 +1432,8 @@ def _fire_subscriptions(session, table_path: str) -> int:
     if session.hs_conf.cluster_broadcast_enabled():
         from ..cluster import worker as _cluster
         try:
-            _cluster.broadcast_commit(session, table_path)
+            _cluster.broadcast_commit(session, table_path,
+                                      batches=batches)
         except Exception:
             pass  # the commit is durable; fan-out is best-effort
     return fired
@@ -1152,6 +1484,7 @@ def recover_streaming(session, summary: Dict) -> None:
                 if os.path.isdir(stage):
                     s["staging_swept"] += _sweep_staging(stage)
                 get_queue().drop_table(os.path.abspath(table_path))
+                get_coordinator().forget(os.path.abspath(table_path))
             try:
                 os.unlink(marker)
             except OSError:
@@ -1226,6 +1559,7 @@ def _recover_table_log(session, path: str, name: str, s: Dict) -> None:
         if os.path.isdir(stage):
             s["staging_swept"] += _sweep_staging(stage)
         get_queue().drop_table(table_path)
+        get_coordinator().forget(table_path)
 
 
 def _sweep_staging(path: str) -> int:

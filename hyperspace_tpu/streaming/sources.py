@@ -1,0 +1,374 @@
+"""Continuous ingestion sources: tailing daemons that drive append/commit.
+
+ROADMAP item 5(b): ingestion at traffic scale needs a writer that is
+not a caller invoking ``append()`` in a loop. A :class:`ContinuousSource`
+is a small tailing daemon (on the sanctioned ``parallel/io.spawn_daemon``
+seam — the lint gate's one thread-construction site) that discovers new
+input, stages it through the ordinary ``append()`` path (load-time
+indexing and all), and drives group commits itself every
+``source.commitBatches`` appends, plus a trailing commit when input
+goes idle. Backpressure is BLOCKING, not raise-on-full: a full
+staged-batch budget parks the tailer inside ``append(block=True)``
+(bounded by ``backpressure.timeoutMs``), and an overloaded admission
+verdict (adaptive/admission.should_pause_ingest) pauses input pulls
+entirely — under load, serving drains first and ingest waits, never
+the reverse.
+
+Fault posture: each poll body fires the ``streaming.source`` fault
+point; ANY poll failure — injected, a torn input file, a backpressure
+timeout — is counted, backed off one poll interval, and retried. Work
+items are acknowledged only AFTER their append succeeds, so a failed
+poll re-discovers exactly the unconsumed input; the daemon itself
+never dies to a poll error.
+
+Two concrete tailers:
+
+- :class:`DirectoryTailSource` — watches a drop directory for new
+  ``*.parquet`` files (producers must land them atomically, e.g. write
+  to ``*.tmp`` then rename; ``*.tmp`` names are skipped) and appends
+  each file as one batch.
+- :class:`LogTailSource` — byte-offset tail of a JSONL log; each poll
+  appends the complete new lines as one dict-of-columns batch.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from typing import Dict, List, Optional
+
+from ..exceptions import HyperspaceException
+from ..parallel import io as pio
+from ..robustness import fault_names as _fn
+from ..robustness import faults as _faults
+from ..telemetry import span_names as SN
+from ..telemetry import trace as _trace
+from . import ingest
+
+
+class ContinuousSource:
+    """Base tailing daemon. Subclasses implement ``_discover()`` (new
+    opaque work items, oldest first), ``_load(item)`` (item -> record
+    batch append() accepts), and ``_ack(item)`` (mark consumed — called
+    only after the append landed in staging). All mutable state behind
+    ``_lock`` (HS301): the poll loop mutates from the daemon thread
+    while ``stats()``/``stop()`` read from callers."""
+
+    def __init__(self, session, table_path: str,
+                 name: Optional[str] = None):
+        self._session = session
+        self._table_path = os.path.abspath(table_path)
+        self._name = name or type(self).__name__
+        self._lock = threading.Lock()
+        self._stop_flag = threading.Event()
+        # Daemon handle from pio.spawn_daemon (the one sanctioned
+        # thread-construction seam).
+        self._thread = None
+        self._pending = 0  # appends not yet covered by a commit
+        self._stats = {"polls": 0, "batches": 0, "rows": 0,
+                       "commits": 0, "errors": 0, "waits": 0,
+                       "pauses": 0}
+
+    # -- subclass surface -------------------------------------------------
+
+    def _discover(self) -> List:
+        raise NotImplementedError
+
+    def _load(self, item):
+        raise NotImplementedError
+
+    def _ack(self, item) -> None:
+        raise NotImplementedError
+
+    # -- lifecycle --------------------------------------------------------
+
+    @property
+    def name(self) -> str:
+        return self._name
+
+    @property
+    def table_path(self) -> str:
+        return self._table_path
+
+    def start(self) -> "ContinuousSource":
+        if not self._session.hs_conf.streaming_enabled():
+            raise HyperspaceException(
+                "hyperspace.tpu.streaming.enabled is false; enable it "
+                "to run continuous sources")
+        with self._lock:
+            if self._thread is not None and self._thread.is_alive():
+                return self
+            self._stop_flag.clear()
+            self._thread = pio.spawn_daemon(
+                f"hs-source-{self._name}", self._run)
+        return self
+
+    def running(self) -> bool:
+        with self._lock:
+            return self._thread is not None and self._thread.is_alive()
+
+    def stop(self, drain: bool = True, timeout_s: float = 60.0) -> dict:
+        """Signal the daemon, join it, and (``drain``, the default)
+        commit whatever it staged but had not committed yet — a stopped
+        source must not leave invisible staged batches behind. Returns
+        the source's stats."""
+        self._stop_flag.set()
+        with self._lock:
+            t = self._thread
+        if t is not None:
+            t.join(timeout_s)
+        if drain:
+            with self._lock:
+                pending = self._pending
+                self._pending = 0
+            if pending:
+                try:
+                    ingest.commit(self._session, self._table_path)
+                except BaseException:
+                    with self._lock:
+                        self._pending += pending
+                    raise
+                with self._lock:
+                    self._stats["commits"] += 1
+        return self.stats()
+
+    def stats(self) -> dict:
+        with self._lock:
+            out = dict(self._stats)
+            out["pending"] = self._pending
+        out["running"] = self.running()
+        out["name"] = self._name
+        out["table"] = self._table_path
+        return out
+
+    # -- the poll loop ----------------------------------------------------
+
+    def _run(self) -> None:
+        poll_s = \
+            self._session.hs_conf.streaming_source_poll_ms() / 1000.0
+        # ONE fault scope for the daemon's lifetime: ``nth=``/``times=``
+        # counters span polls (a per-poll scope would reset them and
+        # turn "times=2" into every-poll), matching the per-run arming
+        # of queries and actions.
+        with _faults.scope_for(self._session.hs_conf):
+            while not self._stop_flag.is_set():
+                try:
+                    productive = self._poll_once()
+                except Exception:
+                    # Injected or real poll failure: count it, back off
+                    # one interval, retry. Unacked input is
+                    # re-discovered.
+                    with self._lock:
+                        self._stats["errors"] += 1
+                    self._stop_flag.wait(poll_s)
+                    continue
+                if not productive:
+                    self._idle_commit()
+                    self._stop_flag.wait(poll_s)
+
+    def _idle_commit(self) -> None:
+        # A trickle must not sit staged (invisible to queries) until
+        # commitBatches fills: idle polls flush the remainder.
+        with self._lock:
+            pending = self._pending
+            self._pending = 0
+        if pending:
+            try:
+                ingest.commit(self._session, self._table_path)
+                with self._lock:
+                    self._stats["commits"] += 1
+            except Exception:
+                # Restore the count: the batches are still staged and a
+                # later flush must know to commit them.
+                with self._lock:
+                    self._pending += pending
+                    self._stats["errors"] += 1
+
+    def _poll_once(self) -> bool:
+        with self._lock:
+            self._stats["polls"] += 1
+        _faults.fault_point(_fn.STREAMING_SOURCE)
+        # Overload pause: while the SLO monitor reports breach, pull no
+        # new input at all — staged work still commits, serving drains.
+        from ..adaptive.admission import get_controller
+        if get_controller().should_pause_ingest(self._session):
+            with self._lock:
+                self._stats["pauses"] += 1
+            return False
+        items = self._discover()
+        if not items:
+            return False
+        session = self._session
+        commit_every = session.hs_conf.streaming_source_commit_batches()
+        max_staged = session.hs_conf.streaming_max_staged_batches()
+        queue = ingest.get_queue()
+        appended = rows = commits = waits = 0
+        with _trace.maintenance_trace(session, "source"), \
+                _trace.span(SN.INGEST_SOURCE) as sp:
+            for item in items:
+                if self._stop_flag.is_set():
+                    break
+                payload = self._load(item)
+                if payload is None:
+                    self._ack(item)
+                    continue
+                if queue.staged_count(self._table_path) >= max_staged:
+                    waits += 1  # the blocking append will park
+                res = ingest.append(session, self._table_path, payload,
+                                    block=True)
+                self._ack(item)
+                appended += 1
+                rows += res["rows"]
+                with self._lock:
+                    self._pending += 1
+                    flushed = self._pending
+                    flush = flushed >= commit_every
+                    if flush:
+                        self._pending = 0
+                if flush:
+                    try:
+                        ingest.commit(session, self._table_path)
+                    except BaseException:
+                        # Still staged: restore the count so the next
+                        # flush/idle commit covers these batches.
+                        with self._lock:
+                            self._pending += flushed
+                        raise
+                    commits += 1
+            if sp is not None:
+                sp.attrs["batches"] = appended
+                sp.attrs["rows"] = rows
+                sp.attrs["commits"] = commits
+        if appended or commits:
+            with self._lock:
+                self._stats["batches"] += appended
+                self._stats["rows"] += rows
+                self._stats["commits"] += commits
+                self._stats["waits"] += waits
+            self._emit(appended, rows, commits, waits)
+        return bool(appended)
+
+    def _emit(self, batches: int, rows: int, commits: int,
+              waits: int) -> None:
+        try:
+            from ..telemetry.events import StreamingSourceEvent
+            from ..telemetry.logging import get_logger
+            get_logger(
+                self._session.hs_conf.event_logger_class()).log_event(
+                StreamingSourceEvent(
+                    message=(f"{self._name}: appended {batches} "
+                             f"batches ({rows} rows), "
+                             f"drove {commits} commits"),
+                    source=self._name, table=self._table_path,
+                    batches=batches, rows=rows, commits=commits,
+                    waits=waits))
+        except Exception:
+            pass
+
+
+class DirectoryTailSource(ContinuousSource):
+    """Tail a drop directory: every new ``*.parquet`` file (atomic
+    rename by the producer; ``*.tmp`` skipped) becomes one appended
+    batch, oldest mtime first. Consumed names are remembered for the
+    daemon's lifetime — producers must not reuse file names."""
+
+    def __init__(self, session, watch_dir: str, table_path: str,
+                 name: Optional[str] = None):
+        super().__init__(session, table_path,
+                         name=name or "dir-tail")
+        self._watch_dir = os.path.abspath(watch_dir)
+        self._seen: Dict[str, bool] = {}
+
+    def _discover(self) -> List[str]:
+        try:
+            names = os.listdir(self._watch_dir)
+        except OSError:
+            return []
+        with self._lock:
+            fresh = [n for n in names
+                     if n.endswith(".parquet") and n not in self._seen]
+        paths = [os.path.join(self._watch_dir, n) for n in sorted(fresh)]
+        paths.sort(key=lambda p: (os.path.getmtime(p)
+                                  if os.path.isfile(p) else 0.0, p))
+        return paths
+
+    def _load(self, item: str):
+        import pyarrow.parquet as pq
+        try:
+            table = pq.read_table(item)
+        except OSError:
+            return None  # vanished between listing and read: skip
+        if table.num_rows == 0:
+            return None
+        return table
+
+    def _ack(self, item: str) -> None:
+        with self._lock:
+            self._seen[os.path.basename(item)] = True
+
+
+class LogTailSource(ContinuousSource):
+    """Byte-offset tail of a JSONL log: each poll reads the COMPLETE
+    new lines past the consumed offset and appends them as one
+    dict-of-columns batch (every record must carry the table's exact
+    column set — the append-side schema check refuses forks). The
+    offset advances only after the append lands, so a failed poll
+    replays the same lines."""
+
+    def __init__(self, session, log_path: str, table_path: str,
+                 name: Optional[str] = None):
+        super().__init__(session, table_path,
+                         name=name or "log-tail")
+        self._log_path = os.path.abspath(log_path)
+        self._offset = 0
+
+    def _discover(self) -> List[tuple]:
+        with self._lock:
+            offset = self._offset
+        try:
+            with open(self._log_path, "rb") as f:
+                f.seek(offset)
+                chunk = f.read()
+        except OSError:
+            return []
+        # Only complete (newline-terminated) lines are consumable; a
+        # partial tail line is the producer mid-write.
+        end = chunk.rfind(b"\n")
+        if end < 0:
+            return []
+        complete = chunk[:end + 1]
+        records = []
+        for line in complete.splitlines():
+            line = line.strip()
+            if line:
+                records.append(json.loads(line.decode("utf-8")))
+        if not records:
+            # Blank lines only: consume the offset without appending.
+            with self._lock:
+                self._offset = offset + end + 1
+            return []
+        columns = sorted(records[0])
+        payload = {c: [r.get(c) for r in records] for c in columns}
+        return [(offset + end + 1, payload)]
+
+    def _load(self, item: tuple):
+        return item[1]
+
+    def _ack(self, item: tuple) -> None:
+        with self._lock:
+            self._offset = max(self._offset, item[0])
+
+
+def tail_directory(session, watch_dir: str, table_path: str,
+                   name: Optional[str] = None) -> DirectoryTailSource:
+    """Construct AND start a directory tailer."""
+    return DirectoryTailSource(session, watch_dir, table_path,
+                               name=name).start()
+
+
+def tail_log(session, log_path: str, table_path: str,
+             name: Optional[str] = None) -> LogTailSource:
+    """Construct AND start a JSONL log tailer."""
+    return LogTailSource(session, log_path, table_path,
+                         name=name).start()

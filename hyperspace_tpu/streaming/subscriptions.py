@@ -186,6 +186,7 @@ class SubscriptionRegistry:
         self._stats = {
             "subscribed": 0, "unsubscribed": 0, "fires": 0,
             "fired_queries": 0, "rejected_queries": 0,
+            "wave_groups": 0, "wave_members": 0,
         }
 
     def subscribe(self, frontend, query, session, client: str,
@@ -222,19 +223,73 @@ class SubscriptionRegistry:
         so deliveries carry the committed rows — through the serving
         pool. Subscriptions whose source tables don't include the
         committed one are skipped (their answer cannot have changed).
-        Returns how many fires were admitted; rejected fires are
-        delivered as errors (observable shedding)."""
+
+        Fan-out shape (ROADMAP item 5(c)): subscriptions whose fresh
+        plans share an r11 batching template (same shape, different
+        Filter literals) fire as ONE preformed wave through
+        ``frontend.submit_wave`` — one shared scan and one vmapped
+        sweep per template group per commit, instead of N independent
+        submissions racing for workers. Unique-template and unbatchable
+        subscriptions keep the per-sub submit path. Returns how many
+        fires were admitted; rejected fires are delivered as errors
+        (observable shedding), per member — one shed never starves the
+        rest of the wave."""
         with self._lock:
             subs = [s for s in self._subs.values()]
         subs = [s for s in subs if s.active
                 and (not table or not s.tables or table in s.tables)]
         fired = rejected = 0
         relation_memo: dict = {}  # one listing per root set this wave
+        batching = frontend.batching_enabled()
+        # Group by batching template. key=None (batching off, template
+        # fingerprint failed, or unbatchable plan) never groups.
+        plans: List[tuple] = []  # (sub, seq, plan, key)
         for sub in subs:
             seq = sub._next_seq()
+            plan = sub.fresh_plan(relation_memo)
+            key = None
+            if batching:
+                try:
+                    from ..serving import batcher
+                    from ..serving.fingerprint import normalize
+                    key = batcher.template_key(sub.session,
+                                               normalize(plan))
+                except Exception:
+                    key = None
+            plans.append((sub, seq, plan, key))
+        buckets: Dict[object, List[tuple]] = {}
+        for item in plans:
+            buckets.setdefault(item[3], []).append(item)
+        waves = 0
+        for key, group in buckets.items():
+            if key is not None and len(group) >= 2:
+                waves += 1
+                f, r = self._fire_wave(frontend, table, group)
+            else:
+                f, r = self._fire_singles(frontend, table, group)
+            fired += f
+            rejected += r
+        with self._lock:
+            self._stats["fires"] += 1 if subs else 0
+            self._stats["fired_queries"] += fired
+            self._stats["rejected_queries"] += rejected
+            self._stats["wave_groups"] += waves
+            if waves:
+                self._stats["wave_members"] += sum(
+                    len(g) for k, g in buckets.items()
+                    if k is not None and len(g) >= 2)
+        if subs:
+            self._emit(session, table, fired, rejected, waves)
+        return fired
+
+    def _fire_singles(self, frontend, table: str,
+                      group: List[tuple]) -> tuple:
+        """The per-subscription path: one frontend.submit each."""
+        fired = rejected = 0
+        for sub, seq, plan, _key in group:
             try:
                 pending = frontend.submit(
-                    sub.fresh_plan(relation_memo), session=sub.session,
+                    plan, session=sub.session,
                     client=sub.client, deadline_ms=sub.deadline_ms)
             except Exception as e:
                 # ANY submit-time failure — shedding (the typed
@@ -248,16 +303,34 @@ class SubscriptionRegistry:
                 continue
             pending.on_done(_delivery_callback(sub, seq, table))
             fired += 1
-        with self._lock:
-            self._stats["fires"] += 1 if subs else 0
-            self._stats["fired_queries"] += fired
-            self._stats["rejected_queries"] += rejected
-        if subs:
-            self._emit(session, table, fired, rejected)
-        return fired
+        return fired, rejected
+
+    def _fire_wave(self, frontend, table: str,
+                   group: List[tuple]) -> tuple:
+        """One same-template group through submit_wave: the returned
+        slots align with the group — a PendingQuery per admitted member
+        or the exception its solo submit would have raised."""
+        fired = rejected = 0
+        try:
+            results = frontend.submit_wave(
+                [(plan, sub.session, sub.client, sub.deadline_ms)
+                 for sub, _seq, plan, _key in group])
+        except Exception as e:
+            # submit_wave itself must not raise, but if it ever does,
+            # every member observes the failure — exactly-once still.
+            results = [e] * len(group)
+        for (sub, seq, _plan, _key), res in zip(group, results):
+            if isinstance(res, Exception):
+                sub._deliver(seq, table, error=res)
+                if isinstance(res, ServingRejectedError):
+                    rejected += 1
+                continue
+            res.on_done(_delivery_callback(sub, seq, table))
+            fired += 1
+        return fired, rejected
 
     def _emit(self, session, table: str, fired: int,
-              rejected: int) -> None:
+              rejected: int, groups: int = 0) -> None:
         try:
             from ..telemetry.events import StandingQueryEvent
             from ..telemetry.logging import get_logger
@@ -265,8 +338,12 @@ class SubscriptionRegistry:
                 StandingQueryEvent(
                     message=(f"commit re-fired {fired} standing "
                              f"quer{'y' if fired == 1 else 'ies'}"
+                             + (f" in {groups} shared-scan "
+                                f"group{'s' if groups != 1 else ''}"
+                                if groups else "")
                              + (f", shed {rejected}" if rejected else "")),
-                    table=table, fired=fired, rejected=rejected))
+                    table=table, fired=fired, rejected=rejected,
+                    groups=groups))
         except Exception:
             pass
 

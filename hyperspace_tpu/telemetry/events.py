@@ -444,15 +444,49 @@ class StreamingCompactionEvent(HyperspaceEvent):
 
 
 @dataclass
+class StreamingWaveEvent(HyperspaceEvent):
+    """Emitted per group-commit publication wave (streaming/ingest.py
+    CommitCoordinator): how many staged batches the wave coalesced into
+    one op-log entry per table, how many concurrent ``commit()``
+    callers rode the wave instead of publishing themselves, and how
+    many bounded sub-waves drained a deeper queue."""
+
+    table: str = ""
+    batches: int = 0
+    rows: int = 0
+    joined: int = 0
+    sub_waves: int = 0
+    seconds: float = 0.0
+
+
+@dataclass
+class StreamingSourceEvent(HyperspaceEvent):
+    """Emitted per productive continuous-source poll (streaming/
+    sources.py): the tailer appended ``batches`` new input batches
+    (``rows`` rows) and drove ``commits`` group commits itself;
+    ``waits`` counts blocking-backpressure stalls this poll."""
+
+    source: str = ""
+    table: str = ""
+    batches: int = 0
+    rows: int = 0
+    commits: int = 0
+    waits: int = 0
+
+
+@dataclass
 class StandingQueryEvent(HyperspaceEvent):
     """Emitted per standing-query fire wave (streaming/
     subscriptions.py): a commit re-fired ``fired`` subscribed plans
     through the serving worker pool (``rejected`` were shed by
-    admission control and delivered as errors)."""
+    admission control and delivered as errors). ``groups`` counts the
+    same-template groups routed through the literal batcher as shared
+    scans (0 = every fire ran as its own submission)."""
 
     table: str = ""
     fired: int = 0
     rejected: int = 0
+    groups: int = 0
 
 
 @dataclass
@@ -588,3 +622,6 @@ class ClusterBroadcastEvent(ClusterEvent):
     table: str = ""
     peers: int = 0
     delivered: int = 0
+    # Wave width: how many staged batches the notice covers (group
+    # commit sends ONE notice per publication wave, not per batch).
+    batches: int = 0

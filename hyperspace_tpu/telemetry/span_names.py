@@ -66,6 +66,14 @@ INGEST_APPEND = "ingest.append"
 INGEST_COMMIT = "ingest.commit"
 INGEST_COMPACT = "ingest.compact"
 
+# Group-commit publication wave (streaming/ingest.CommitCoordinator):
+# one INGEST_WAVE per wave the leader publishes, wrapping its
+# INGEST_COMMIT sub-waves (attrs carry batches, joined committers,
+# sub-waves). One INGEST_SOURCE per productive continuous-source poll
+# (streaming/sources.py; attrs carry appended batches / committed rows).
+INGEST_WAVE = "ingest.wave"
+INGEST_SOURCE = "ingest.source"
+
 # Artifact store (artifacts/): one ARTIFACT_LOAD per lake probe (attrs
 # carry hit/reason/nbytes), one ARTIFACT_EXPORT per serialize+publish,
 # one ARTIFACT_WARMUP per boot preload pass (attrs carry loaded count
@@ -87,6 +95,7 @@ SPAN_NAMES = frozenset({
     BANK_LOOKUP, BANK_COMPILE, EXEC_STAGE, EXEC_FUSED, IO_READ,
     IO_PREFETCH, SPMD_DISPATCH, SPMD_COMPILE, SERVING_SWEEP,
     INGEST_APPEND, INGEST_COMMIT, INGEST_COMPACT,
+    INGEST_WAVE, INGEST_SOURCE,
     ARTIFACT_LOAD, ARTIFACT_EXPORT, ARTIFACT_WARMUP,
     CLUSTER_FORWARD, CLUSTER_BROADCAST, CLUSTER_GATHER,
 })

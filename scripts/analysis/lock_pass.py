@@ -113,6 +113,30 @@ LOCK_CLASSES = {
         "why": "process-wide staged-batch registry of the ingestion "
                "tier; appends/commits race from serving workers",
     },
+    ("hyperspace_tpu/streaming/ingest.py", "CommitCoordinator"): {
+        "locks": {"_cv": None},
+        "delegates": frozenset(),
+        "why": "group-commit wave ledger; concurrent committers elect "
+               "a leader and park as riders on the one condition",
+    },
+    ("hyperspace_tpu/streaming/sources.py", "ContinuousSource"): {
+        "locks": {"_lock": None},
+        "delegates": frozenset(),
+        "why": "tailer daemon mutates pending/stats while stop()/"
+               "stats() read from caller threads",
+    },
+    ("hyperspace_tpu/streaming/sources.py", "DirectoryTailSource"): {
+        "locks": {"_lock": None},
+        "delegates": frozenset(),
+        "why": "consumed-name set shared between the poll loop and "
+               "discovery",
+    },
+    ("hyperspace_tpu/streaming/sources.py", "LogTailSource"): {
+        "locks": {"_lock": None},
+        "delegates": frozenset(),
+        "why": "consumed byte offset advances on the daemon while "
+               "stats() reads",
+    },
     ("hyperspace_tpu/streaming/subscriptions.py", "SubscriptionRegistry"): {
         "locks": {"_lock": None},
         "delegates": frozenset(),
@@ -227,6 +251,8 @@ LOCK_GLOBALS = {
     ],
     "hyperspace_tpu/streaming/ingest.py": [
         {"lock": "_QUEUE_LOCK", "names": {"_QUEUE"},
+         "why": "double-checked singleton construction"},
+        {"lock": "_COORD_LOCK", "names": {"_COORD"},
          "why": "double-checked singleton construction"},
     ],
     "hyperspace_tpu/telemetry/metrics.py": [

@@ -56,6 +56,11 @@ CHAOS_SPECS = {
     # dedicated injection tests live in tests/test_cluster.py.
     FN.CLUSTER_FORWARD: "error:p=0.1",
     FN.CLUSTER_BROADCAST: "error:p=0.1",
+    # Continuous-source point (streaming.source), same posture: armed
+    # for completeness, never hit by the query-only soak — the
+    # dedicated tailer-survives-injection tests live in
+    # tests/test_streaming_scale.py.
+    FN.STREAMING_SOURCE: "error:p=0.2",
 }
 
 

@@ -1,0 +1,885 @@
+"""Streaming at traffic scale (streaming/ r22): group commit,
+continuous sources with backpressure, and subscription fan-out.
+
+Acceptance contracts:
+
+- **Group commit**: 16 concurrent ``commit()`` callers coalesce into
+  ONE publication wave — one op-log entry per table (and one delta
+  landing per index) for the whole wave, riders observe the leader's
+  summary (``joined_wave``), and a deeper queue drains in bounded
+  sub-waves of ``groupCommit.maxWave``. Answers are byte-identical to
+  serial per-batch commits, and ``groupCommit.enabled=false`` restores
+  the per-commit behavior exactly.
+- **Backpressure**: ``append(block=True)`` parks on a full staged
+  budget until a commit frees it (or raises the same full-table error
+  after ``backpressure.timeoutMs``); the API default stays
+  raise-on-full.
+- **Crash safety**: kill -9 mid-wave (armed ``ingest.publish``) rolls
+  the WHOLE wave back on ``recover()`` — no partial wave is ever
+  visible.
+- **Fan-out**: N same-template standing queries fire from one commit
+  as ONE literal-sweep wave — one shared scan and one vmapped sweep
+  invocation per template group at 10/100/1000 subscriptions, each
+  subscription delivered exactly once with its own literal's answer.
+- **Cluster coalescing**: one wave sends ONE commit broadcast carrying
+  the wave width; a lost peer costs only that peer's firing, never the
+  commit.
+- **Continuous sources**: directory/JSONL tailers drive append/commit
+  themselves, survive injected ``streaming.source`` faults, pause
+  while admission reports overload, and drain cleanly on stop.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import textwrap
+import threading
+import time
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+import hyperspace_tpu as hst
+from hyperspace_tpu.api import Hyperspace, IndexConfig
+from hyperspace_tpu.exceptions import HyperspaceException
+from hyperspace_tpu.index.constants import (IndexConstants, STABLE_STATES,
+                                            States)
+from hyperspace_tpu.index.log_manager import IndexLogManager
+from hyperspace_tpu.plan.expr import col, sum_
+from hyperspace_tpu.robustness import fault_names as FN
+from hyperspace_tpu.robustness import faults
+from hyperspace_tpu.robustness.faults import FaultRegistry
+from hyperspace_tpu.streaming import ingest
+from hyperspace_tpu.streaming.constants import StreamingConstants as SC
+from hyperspace_tpu.streaming.ingest import (get_coordinator, table_key,
+                                             table_log_dir)
+from hyperspace_tpu.streaming.sources import (DirectoryTailSource,
+                                              LogTailSource)
+from hyperspace_tpu.telemetry import span_names as SN
+from hyperspace_tpu.telemetry.events import (ClusterBroadcastEvent,
+                                             StandingQueryEvent,
+                                             StreamingSourceEvent,
+                                             StreamingWaveEvent)
+
+from conftest import capture_logger as sink  # noqa: E402
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _rng(seed=17):
+    return np.random.default_rng(seed)
+
+
+def _frame(rng, n):
+    return pd.DataFrame({
+        "k": rng.integers(0, 40, n).astype(np.int64),
+        "v": rng.integers(0, 9, n).astype(np.int64)})
+
+
+def _write_base(d, rng, n=2000):
+    os.makedirs(d, exist_ok=True)
+    pq.write_table(pa.Table.from_pandas(_frame(rng, n)),
+                   os.path.join(d, "p0.parquet"))
+
+
+def _mk_session(root, capture=False, **conf):
+    session = hst.Session(system_path=str(root / "indexes"))
+    session.conf.set(IndexConstants.INDEX_NUM_BUCKETS, 4)
+    session.conf.set(IndexConstants.INDEX_LINEAGE_ENABLED, "true")
+    session.conf.set(IndexConstants.TPU_DISTRIBUTED_ENABLED, "false")
+    if capture:
+        session.conf.set(IndexConstants.EVENT_LOGGER_CLASS,
+                         "tests.conftest.CaptureLogger")
+        sink().events.clear()
+    for key, value in conf.items():
+        session.conf.set(key, value)
+    return session
+
+
+def _mk_lake(root, capture=False, index=True, **conf):
+    """Base table (+ covering index cx so waves land index deltas)."""
+    root.mkdir(exist_ok=True)
+    data = str(root / "tbl")
+    _write_base(data, _rng())
+    session = _mk_session(root, capture=capture, **conf)
+    hs = Hyperspace(session)
+    if index:
+        hs.create_index(session.read.parquet(data),
+                        IndexConfig("cx", ["k"], ["v"]))
+    return session, hs, data
+
+
+def _answers(session, data):
+    t = session.read.parquet(data)
+    q = t.filter(col("k") == 7).select("k", "v")
+    session.enable_hyperspace()
+    a = q.to_pandas().sort_values(["k", "v"]).reset_index(drop=True)
+    session.disable_hyperspace()
+    b = q.to_pandas().sort_values(["k", "v"]).reset_index(drop=True)
+    return a, b
+
+
+def _count_log_files(log_dir):
+    """Digit-named op-log entries under an index/table log root."""
+    sub = os.path.join(log_dir, IndexConstants.HYPERSPACE_LOG)
+    if os.path.isdir(sub):
+        log_dir = sub
+    return len([n for n in os.listdir(log_dir) if n.isdigit()])
+
+
+def _fresh_frontend(session, **conf):
+    from hyperspace_tpu.serving import frontend as fe_mod
+    # Commits notify the PROCESS-DEFAULT frontend; make this test's
+    # frontend the default (first-constructed-wins otherwise).
+    with fe_mod._DEFAULT_LOCK:
+        fe_mod._DEFAULT = None
+    session.conf.set("hyperspace.tpu.serving.maxConcurrency", "8")
+    session.conf.set("hyperspace.tpu.serving.queueDepth", "64")
+    for key, value in conf.items():
+        session.conf.set(key, value)
+    return fe_mod.ServingFrontend(session)
+
+
+def _concurrent_commits(hs, data, n, timeout=180.0):
+    """n commit() callers released together; (results, errors)."""
+    results = [None] * n
+    errors = []
+    barrier = threading.Barrier(n)
+
+    def run(i):
+        try:
+            barrier.wait(30)
+            results[i] = hs.commit(data)
+        except Exception as e:  # surfaced to the asserting test
+            errors.append(e)
+
+    threads = [threading.Thread(target=run, args=(i,))
+               for i in range(n)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout)
+    assert not any(t.is_alive() for t in threads), "commit hung"
+    return results, errors
+
+
+def _wait_until(pred, timeout=60.0, interval=0.02, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return
+        time.sleep(interval)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+def _delivery_pd(result):
+    host = result.to_host()
+    return pd.DataFrame(
+        {n: np.asarray(c.data) for n, c in host.columns.items()}
+    ).reset_index(drop=True)
+
+
+# ---------------------------------------------------------------------------
+# Group commit: concurrent committers coalesce into one wave.
+# ---------------------------------------------------------------------------
+
+class TestGroupCommit:
+    def test_sixteen_committers_one_wave_one_log_entry(self, tmp_path):
+        """Width 16: every concurrent commit() rides ONE publication —
+        the table log and the index log each grow by exactly one
+        commit's worth of entries for the whole wave."""
+        session, hs, data = _mk_lake(tmp_path, capture=True)
+        rng = _rng(31)
+        # Calibrate: what one serial commit costs in log entries.
+        hs.append(data, _frame(rng, 60))
+        hs.commit(data)  # creates the table log
+        tbl_log = table_log_dir(session, data)
+        idx_log = os.path.join(str(tmp_path / "indexes"), "cx")
+        hs.append(data, _frame(rng, 60))
+        t0, i0 = _count_log_files(tbl_log), _count_log_files(idx_log)
+        hs.commit(data)
+        per_commit_tbl = _count_log_files(tbl_log) - t0
+        per_commit_idx = _count_log_files(idx_log) - i0
+        assert per_commit_tbl >= 1 and per_commit_idx >= 1
+
+        frames = [_frame(rng, 60) for _ in range(16)]
+        for f in frames:
+            hs.append(data, f)
+        before = get_coordinator().stats()
+        t1, i1 = _count_log_files(tbl_log), _count_log_files(idx_log)
+        sink().events.clear()
+
+        results, errors = _concurrent_commits(hs, data, 16)
+        assert not errors, errors
+
+        # ONE wave, ONE sub-wave: all 16 batches staged before any
+        # caller arrived, so the first leader pops them all and every
+        # other caller rides (or observes the landed wave).
+        after = get_coordinator().stats()
+        assert after["commit_calls"] - before["commit_calls"] == 16
+        assert after["waves"] - before["waves"] == 1
+        assert after["sub_waves"] - before["sub_waves"] == 1
+        assert after["joined"] - before["joined"] >= 1
+        assert after["wave_batches"] - before["wave_batches"] == 16
+
+        # One commit's worth of log entries for the WHOLE wave — the
+        # amortization the tier exists for.
+        assert _count_log_files(tbl_log) - t1 == per_commit_tbl
+        assert _count_log_files(idx_log) - i1 == per_commit_idx
+
+        # Every caller observed the same full-wave outcome; riders are
+        # marked as such.
+        full = [r for r in results if r["committed_batches"] == 16]
+        assert full, results
+        assert sum(r["committed_batches"] for r in results
+                   if not r.get("joined_wave")) <= 16
+        assert any(r.get("joined_wave") for r in results)
+
+        # The wave was observable: one StreamingWaveEvent carrying the
+        # width and the rider count.
+        waves = [e for e in sink().events
+                 if isinstance(e, StreamingWaveEvent)]
+        assert len(waves) == 1
+        assert waves[0].batches == 16 and waves[0].joined >= 1
+
+        # Nothing was lost: the wave's rows answer queries.
+        a, b = _answers(session, data)
+        pd.testing.assert_frame_equal(a, b)
+        expect = sum(int((f["k"] == 7).sum()) for f in frames)
+        assert len(a) >= expect
+
+    def test_deep_queue_drains_in_bounded_sub_waves(self, tmp_path):
+        """maxWave bounds one publication's width: 8 staged batches at
+        maxWave=4 land as one WAVE of two SUB-WAVES (two op-log
+        entries), and the leader's summary still covers all 8."""
+        session, hs, data = _mk_lake(
+            tmp_path, **{SC.GROUP_COMMIT_MAX_WAVE: "4"})
+        rng = _rng(37)
+        hs.append(data, _frame(rng, 40))
+        hs.commit(data)
+        tbl_log = table_log_dir(session, data)
+        hs.append(data, _frame(rng, 40))
+        t0 = _count_log_files(tbl_log)
+        hs.commit(data)
+        per_commit = _count_log_files(tbl_log) - t0
+
+        for _ in range(8):
+            hs.append(data, _frame(rng, 40))
+        before = get_coordinator().stats()
+        t1 = _count_log_files(tbl_log)
+        out = hs.commit(data)
+        after = get_coordinator().stats()
+
+        assert out["committed_batches"] == 8
+        assert after["waves"] - before["waves"] == 1
+        assert after["sub_waves"] - before["sub_waves"] == 2
+        assert _count_log_files(tbl_log) - t1 == 2 * per_commit
+
+    def test_byte_identical_with_group_commit_off(self, tmp_path):
+        """The SAME batch sequence committed as one 8-wide wave and as
+        8 serial per-batch commits (groupCommit.enabled=false) answers
+        queries byte-identically."""
+        frames = [_frame(_rng(100 + i), 60) for i in range(8)]
+
+        s_on, hs_on, d_on = _mk_lake(tmp_path / "on")
+        for f in frames:
+            hs_on.append(d_on, f)
+        results, errors = _concurrent_commits(hs_on, d_on, 8)
+        assert not errors, errors
+        assert max(r["committed_batches"] for r in results) == 8
+
+        s_off, hs_off, d_off = _mk_lake(
+            tmp_path / "off", **{SC.GROUP_COMMIT_ENABLED: "false"})
+        for f in frames:
+            hs_off.append(d_off, f)
+            out = hs_off.commit(d_off)
+            assert out["committed_batches"] == 1
+            assert "joined_wave" not in out
+
+        a_on, b_on = _answers(s_on, d_on)
+        a_off, b_off = _answers(s_off, d_off)
+        pd.testing.assert_frame_equal(a_on, b_on)
+        pd.testing.assert_frame_equal(a_off, b_off)
+        pd.testing.assert_frame_equal(a_on, a_off)
+
+    def test_off_switch_restores_per_commit_behavior(self, tmp_path):
+        """groupCommit.enabled=false: the coordinator is never
+        consulted, every commit pays its own op-log entry, and no
+        StreamingWaveEvent is emitted."""
+        session, hs, data = _mk_lake(
+            tmp_path, capture=True,
+            **{SC.GROUP_COMMIT_ENABLED: "false"})
+        rng = _rng(41)
+        hs.append(data, _frame(rng, 40))
+        hs.commit(data)
+        tbl_log = table_log_dir(session, data)
+        hs.append(data, _frame(rng, 40))
+        t0 = _count_log_files(tbl_log)
+        hs.commit(data)
+        per_commit = _count_log_files(tbl_log) - t0
+
+        before = get_coordinator().stats()
+        t1 = _count_log_files(tbl_log)
+        sink().events.clear()
+        for _ in range(3):
+            hs.append(data, _frame(rng, 40))
+            hs.commit(data)
+        after = get_coordinator().stats()
+        assert after["commit_calls"] == before["commit_calls"]
+        assert _count_log_files(tbl_log) - t1 == 3 * per_commit
+        assert not [e for e in sink().events
+                    if isinstance(e, StreamingWaveEvent)]
+
+
+# ---------------------------------------------------------------------------
+# Blocking backpressure on the staged-batch budget.
+# ---------------------------------------------------------------------------
+
+class TestBlockingBackpressure:
+    def test_blocked_append_parks_until_commit_frees(self, tmp_path):
+        session, hs, data = _mk_lake(
+            tmp_path, index=False, **{SC.MAX_STAGED_BATCHES: "1"})
+        hs.append(data, _frame(_rng(51), 40))  # budget now full
+        done = threading.Event()
+        caught = []
+
+        def blocked():
+            try:
+                ingest.append(session, data, _frame(_rng(52), 40),
+                              block=True)
+            except Exception as e:
+                caught.append(e)
+            finally:
+                done.set()
+
+        t = threading.Thread(target=blocked)
+        start = time.monotonic()
+        t.start()
+        time.sleep(0.3)
+        assert not done.is_set(), "append did not block on full budget"
+        hs.commit(data)  # frees the budget; the waiter lands
+        t.join(60)
+        assert done.is_set() and not caught, caught
+        assert time.monotonic() - start >= 0.25
+        out = hs.commit(data)
+        assert out["committed_batches"] == 1
+
+    def test_blocked_append_times_out(self, tmp_path):
+        session, hs, data = _mk_lake(
+            tmp_path, index=False,
+            **{SC.MAX_STAGED_BATCHES: "1",
+               SC.BACKPRESSURE_TIMEOUT_MS: "200"})
+        hs.append(data, _frame(_rng(53), 40))
+        with pytest.raises(HyperspaceException, match="timed out"):
+            ingest.append(session, data, _frame(_rng(54), 40),
+                          block=True)
+        # The staged batch survived the stranger's timeout.
+        assert hs.commit(data)["committed_batches"] == 1
+
+    def test_default_stays_raise_on_full(self, tmp_path):
+        session, hs, data = _mk_lake(
+            tmp_path, index=False, **{SC.MAX_STAGED_BATCHES: "1"})
+        hs.append(data, _frame(_rng(55), 40))
+        t0 = time.monotonic()
+        with pytest.raises(HyperspaceException,
+                           match="maxStagedBatches"):
+            hs.append(data, _frame(_rng(56), 40))
+        assert time.monotonic() - t0 < 5.0  # immediate, no park
+
+
+# ---------------------------------------------------------------------------
+# Kill -9 mid-wave: whole-wave atomicity under crash.
+# ---------------------------------------------------------------------------
+
+_WAVE_CHILD = textwrap.dedent("""
+    import os, sys
+    import numpy as np
+    import pandas as pd
+
+    spec, data_dir, sys_dir = sys.argv[1:4]
+
+    import hyperspace_tpu as hst
+    from hyperspace_tpu.api import Hyperspace, IndexConfig
+
+    session = hst.Session(system_path=sys_dir)
+    session.conf.set("hyperspace.index.numBuckets", 4)
+    session.conf.set("hyperspace.index.lineage.enabled", "true")
+    session.conf.set("hyperspace.tpu.distributed.enabled", "false")
+    hs = Hyperspace(session)
+
+    rng = np.random.default_rng(41)
+    def frame(n):
+        return pd.DataFrame({
+            "k": rng.integers(0, 40, n).astype(np.int64),
+            "v": rng.integers(0, 9, n).astype(np.int64)})
+
+    # A healthy first commit establishes the table log.
+    hs.append(data_dir, frame(150))
+    hs.commit(data_dir)
+
+    # Stage a 4-wide wave, then die publishing it.
+    for _ in range(4):
+        hs.append(data_dir, frame(200))
+    session.conf.set(
+        "hyperspace.tpu.robustness.faults.ingest.publish", spec)
+    hs.commit(data_dir)
+    print("CHILD-SURVIVED")
+""")
+
+
+class TestKill9MidWave:
+    def test_kill9_rolls_back_the_whole_wave(self, tmp_path):
+        """A SIGKILL during a 4-wide wave's publication leaves nothing
+        behind after recover(): not one of the wave's batches is
+        visible — per-wave atomicity, not per-batch."""
+        data = str(tmp_path / "tbl")
+        _write_base(data, _rng())
+        (tmp_path / "indexes").mkdir(exist_ok=True)
+        session = _mk_session(tmp_path)
+        hs = Hyperspace(session)
+        hs.create_index(session.read.parquet(data),
+                        IndexConfig("cx", ["k"], ["v"]))
+
+        script = str(tmp_path / "wave_child.py")
+        with open(script, "w") as f:
+            f.write(_WAVE_CHILD)
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+        env["PYTHONPATH"] = ROOT + os.pathsep + env.get(
+            "PYTHONPATH", "")
+        proc = subprocess.run(
+            [sys.executable, script, "kill:nth=1", data,
+             str(tmp_path / "indexes")],
+            env=env, capture_output=True, text=True, timeout=420,
+            cwd=ROOT)
+        assert proc.returncode == -signal.SIGKILL, \
+            f"rc={proc.returncode}\n{proc.stdout}\n{proc.stderr}"
+        assert "CHILD-SURVIVED" not in proc.stdout
+
+        mgr = IndexLogManager(table_log_dir(session, data))
+        assert mgr.get_latest_log().state == States.REFRESHING
+
+        summary = hs.recover()
+        assert not summary["errors"], summary
+        stream = summary["streaming"]
+        key = table_key(data)
+        assert key in stream["rolled_back"]
+        assert stream["staging_swept"] >= 1
+
+        # Exactly the pre-crash committed state: base + the first
+        # healthy batch. None of the 4-wide wave survived.
+        files = session.read.parquet(data).plan.relation.all_files()
+        assert len(files) == 2
+        assert mgr.get_latest_log().state in STABLE_STATES
+        a, b = _answers(session, data)
+        pd.testing.assert_frame_equal(a, b)
+
+        # The recovered lake ingests again — as a wave.
+        for _ in range(4):
+            hs.append(data, _frame(_rng(77), 120))
+        out = hs.commit(data)
+        assert out["committed_batches"] == 4
+        a2, b2 = _answers(session, data)
+        pd.testing.assert_frame_equal(a2, b2)
+
+
+# ---------------------------------------------------------------------------
+# Subscription fan-out: one shared scan per template group per wave.
+# ---------------------------------------------------------------------------
+
+class TestSubscriptionFanout:
+    def _lake(self, tmp_path, capture=True, **conf):
+        """Plain table, NO covering index and hyperspace disabled: the
+        standing plans must stay Filter-over-Scan so the literal
+        batcher's shared-scan hook engages (an IndexScan rewrite would
+        bypass it — test_serving_frontend pins that contract)."""
+        root = tmp_path
+        root.mkdir(exist_ok=True)
+        data = str(root / "tbl")
+        _write_base(data, _rng())
+        session = _mk_session(root, capture=capture, **conf)
+        return session, Hyperspace(session), data
+
+    def _variant(self, session, data, i):
+        return (session.read.parquet(data)
+                .filter(col("k") < (i % 37) + 2).group_by("k")
+                .agg(sum_(col("v")).alias("sv")).sort("k"))
+
+    @pytest.mark.parametrize("n", [10, 100, 1000])
+    def test_fanout_one_shared_scan_exactly_once(self, tmp_path, n):
+        session, hs, data = self._lake(
+            tmp_path, **{SC.SUBSCRIPTIONS_MAX: "1200"})
+        front = _fresh_frontend(session)
+        subs = [front.subscribe(self._variant(session, data, i))
+                for i in range(n)]
+        before = front.stats()
+        sink().events.clear()
+
+        hs.append(data, _frame(_rng(61), 300))
+        out = hs.commit(data)
+        assert out["subscriptions_fired"] == n
+
+        for sub in subs:
+            ds = sub.wait_for(1, timeout=180.0)
+            assert len(ds) == 1 and ds[0].ok, getattr(
+                ds[0], "error", None)
+
+        after = front.stats()
+        # ONE wave for the whole fan-out: every same-template fire
+        # shares one scan and one vmapped sweep invocation.
+        assert after["batches"] - before["batches"] == 1
+        assert after["batched_queries"] - before["batched_queries"] == n
+        assert after["sweep_invocations"] - \
+            before["sweep_invocations"] == 1
+        assert after["shared_scans"] - before["shared_scans"] == 1
+        assert after["shared_scan_hits"] - \
+            before["shared_scan_hits"] == n - 1
+
+        regs = after["subscriptions"]
+        regs_before = before["subscriptions"]
+        assert regs["wave_groups"] - regs_before["wave_groups"] == 1
+        assert regs["wave_members"] - regs_before["wave_members"] == n
+        assert regs["fired_queries"] - \
+            regs_before["fired_queries"] == n
+        assert regs["rejected_queries"] == regs_before[
+            "rejected_queries"]
+
+        # Exactly once: one delivery per subscription, no more arrive.
+        assert all(s.delivered_total == 1 for s in subs)
+
+        fired = [e for e in sink().events
+                 if isinstance(e, StandingQueryEvent)]
+        assert len(fired) == 1
+        assert fired[0].fired == n and fired[0].groups == 1
+
+        # Spot-check answers: each subscription got ITS literal's rows,
+        # byte-identical to submitting the same plan ad hoc.
+        for i in (0, n // 2, n - 1):
+            want = _delivery_pd(front.submit(
+                self._variant(session, data, i)).result(timeout=120.0))
+            got = _delivery_pd(subs[i].latest(timeout=10.0).result)
+            pd.testing.assert_frame_equal(got, want)
+
+    def test_batching_off_falls_back_to_singles(self, tmp_path):
+        """serving.batching.enabled=false: fires run as N independent
+        submissions (no wave groups), same deliveries."""
+        session, hs, data = self._lake(tmp_path)
+        front = _fresh_frontend(
+            session, **{"hyperspace.tpu.serving.batching.enabled":
+                        "false"})
+        subs = [front.subscribe(self._variant(session, data, i))
+                for i in range(6)]
+        before = front.stats()
+        hs.append(data, _frame(_rng(62), 100))
+        assert hs.commit(data)["subscriptions_fired"] == 6
+        for sub in subs:
+            assert sub.wait_for(1, timeout=120.0)[0].ok
+        after = front.stats()
+        assert after["subscriptions"]["wave_groups"] == \
+            before["subscriptions"]["wave_groups"]
+        assert after["batches"] == before["batches"]
+
+    def test_mixed_templates_one_group_per_template(self, tmp_path):
+        """Two distinct templates on one table: one commit fires one
+        wave PER template group; the lone odd-one-out runs single."""
+        session, hs, data = self._lake(
+            tmp_path, **{SC.SUBSCRIPTIONS_MAX: "64"})
+        front = _fresh_frontend(session)
+        agg = [front.subscribe(self._variant(session, data, i))
+               for i in range(4)]
+        sel = [front.subscribe(
+            session.read.parquet(data).filter(col("k") == i)
+            .select("k", "v")) for i in range(3)]
+        lone = front.subscribe(
+            session.read.parquet(data).group_by("v")
+            .agg(sum_(col("k")).alias("sk")))
+        before = front.stats()
+        hs.append(data, _frame(_rng(63), 100))
+        assert hs.commit(data)["subscriptions_fired"] == 8
+        for sub in agg + sel + [lone]:
+            assert sub.wait_for(1, timeout=120.0)[0].ok
+        after = front.stats()
+        regs, regs0 = after["subscriptions"], before["subscriptions"]
+        assert regs["wave_groups"] - regs0["wave_groups"] == 2
+        assert regs["wave_members"] - regs0["wave_members"] == 7
+        assert regs["fired_queries"] - regs0["fired_queries"] == 8
+
+
+# ---------------------------------------------------------------------------
+# Cluster coalescing: one broadcast per wave, lost peers degrade.
+# ---------------------------------------------------------------------------
+
+class TestBroadcastCoalescing:
+    @pytest.fixture(autouse=True)
+    def _fresh_cluster(self):
+        yield
+        from hyperspace_tpu.cluster import worker
+        worker.shutdown_for_tests()
+
+    def _node(self, tmp_path):
+        from hyperspace_tpu.cluster import membership, worker
+        from hyperspace_tpu.cluster.constants import (
+            ClusterConstants as CC)
+        session, hs, data = _mk_lake(
+            tmp_path, capture=True,
+            **{CC.ENABLED: "true", CC.WORKER_ID: "w-solo",
+               CC.FORWARD_TIMEOUT_MS: "300"})
+        node = worker.get_node(session)
+        assert node is not None
+        # An unreachable peer: every notice to it fails.
+        root = membership.membership_dir(session)
+        os.makedirs(root, exist_ok=True)
+        now = time.time() * 1000.0
+        with open(os.path.join(root, "member-w-gone.json"), "w",
+                  encoding="utf-8") as f:
+            f.write(json.dumps({
+                "worker_id": "w-gone", "host": "127.0.0.1", "port": 1,
+                "pid": 999999, "started_ms": now,
+                "heartbeat_ms": now}))
+        return session, hs, data, node
+
+    def test_one_broadcast_per_wave_carries_width(self, tmp_path):
+        session, hs, data, node = self._node(tmp_path)
+        rng = _rng(71)
+        for _ in range(16):
+            hs.append(data, _frame(rng, 40))
+        sink().events.clear()
+        failures_before = node.stats()["broadcast_failures"]
+        results, errors = _concurrent_commits(hs, data, 16)
+        assert not errors, errors
+        assert max(r["committed_batches"] for r in results) == 16
+
+        # ONE notice for the whole wave, stamped with its width — not
+        # 16 per-batch notices.
+        notices = [e for e in sink().events
+                   if isinstance(e, ClusterBroadcastEvent)]
+        assert len(notices) == 1
+        assert notices[0].batches == 16
+        assert notices[0].peers == 1 and notices[0].delivered == 0
+
+        # The dead peer cost its own firing only: the commit landed.
+        assert node.stats()["broadcast_failures"] > failures_before
+        a, b = _answers(session, data)
+        pd.testing.assert_frame_equal(a, b)
+
+    def test_injected_broadcast_fault_never_fails_commit(self,
+                                                         tmp_path):
+        session, hs, data, node = self._node(tmp_path)
+        for _ in range(4):
+            hs.append(data, _frame(_rng(72), 40))
+        reg = FaultRegistry.from_conf_specs(
+            {FN.CLUSTER_BROADCAST: "error:p=1"}, seed=7)
+        failures_before = node.stats()["broadcast_failures"]
+        with faults.scope(reg):
+            out = hs.commit(data)
+        assert out["committed_batches"] == 4
+        assert reg.hit_count(FN.CLUSTER_BROADCAST) >= 1
+        assert node.stats()["broadcast_failures"] > failures_before
+        a, b = _answers(session, data)
+        pd.testing.assert_frame_equal(a, b)
+
+
+# ---------------------------------------------------------------------------
+# Continuous sources: tailing daemons drive append/commit themselves.
+# ---------------------------------------------------------------------------
+
+class TestContinuousSources:
+    def _lake(self, tmp_path, **conf):
+        conf.setdefault(SC.SOURCE_POLL_MS, "20")
+        return _mk_lake(tmp_path, index=False, **conf)
+
+    def _drop(self, watch, name, frame):
+        tmp = os.path.join(watch, name + ".tmp")
+        pq.write_table(pa.Table.from_pandas(frame), tmp)
+        os.replace(tmp, os.path.join(watch, name))
+
+    def test_directory_tail_ingests_and_commits(self, tmp_path):
+        session, hs, data = self._lake(
+            tmp_path, capture=True, **{SC.SOURCE_COMMIT_BATCHES: "2"})
+        watch = str(tmp_path / "drop")
+        os.makedirs(watch)
+        rows_before = len(session.read.parquet(data).to_pandas())
+        frames = [_frame(_rng(80 + i), 30) for i in range(5)]
+        for i, f in enumerate(frames):
+            self._drop(watch, f"b{i}.parquet", f)
+        src = DirectoryTailSource(session, watch, data).start()
+        try:
+            _wait_until(lambda: src.stats()["batches"] == 5,
+                        msg="5 batches tailed")
+            assert src.running()
+        finally:
+            out = src.stop(drain=True)
+        assert out["commits"] >= 3  # 2 flushes of 2 + the drain
+        assert out["errors"] == 0 and out["pending"] == 0
+        assert not src.running()
+        rows = len(session.read.parquet(data).to_pandas())
+        assert rows == rows_before + sum(len(f) for f in frames)
+        assert any(isinstance(e, StreamingSourceEvent)
+                   for e in sink().events)
+
+    def test_log_tail_consumes_only_complete_lines(self, tmp_path):
+        session, hs, data = self._lake(tmp_path)
+        log = str(tmp_path / "events.jsonl")
+        lines = [json.dumps({"k": int(i % 40), "v": int(i % 9)})
+                 for i in range(6)]
+        with open(log, "w") as f:
+            f.write("\n".join(lines) + "\n")
+            f.write('{"k": 3, "v"')  # producer mid-write
+        rows_before = len(session.read.parquet(data).to_pandas())
+        src = LogTailSource(session, log, data).start()
+        try:
+            _wait_until(lambda: src.stats()["rows"] == 6,
+                        msg="complete lines tailed")
+            # The partial line is never consumed...
+            time.sleep(0.2)
+            assert src.stats()["rows"] == 6
+            # ...until the producer finishes it.
+            with open(log, "a") as f:
+                f.write(': 5}\n')
+            _wait_until(lambda: src.stats()["rows"] == 7,
+                        msg="completed line tailed")
+        finally:
+            src.stop(drain=True)
+        rows = len(session.read.parquet(data).to_pandas())
+        assert rows == rows_before + 7
+
+    def test_source_survives_injected_faults(self, tmp_path):
+        """An armed streaming.source fault (error:times=2) costs two
+        polls, after which the daemon keeps tailing — counters span
+        polls because the source arms ONE fault scope for its life."""
+        session, hs, data = self._lake(tmp_path)
+        session.conf.set(
+            "hyperspace.tpu.robustness.faults."
+            + FN.STREAMING_SOURCE, "error:times=2")
+        watch = str(tmp_path / "drop")
+        os.makedirs(watch)
+        self._drop(watch, "b0.parquet", _frame(_rng(85), 30))
+        src = DirectoryTailSource(session, watch, data).start()
+        try:
+            _wait_until(lambda: src.stats()["batches"] == 1,
+                        msg="batch landed despite faults")
+            stats = src.stats()
+            assert stats["errors"] == 2
+            assert src.running()
+        finally:
+            out = src.stop(drain=True)
+        assert out["errors"] == 2
+
+    def test_admission_pause_stops_pulling_input(self, tmp_path):
+        """While admission reports overload the tailer pulls NOTHING;
+        when the breach clears it resumes where it left off."""
+        from hyperspace_tpu.adaptive.admission import get_controller
+        session, hs, data = self._lake(
+            tmp_path, **{"hyperspace.tpu.adaptive.enabled": "true"})
+        watch = str(tmp_path / "drop")
+        os.makedirs(watch)
+        self._drop(watch, "b0.parquet", _frame(_rng(86), 30))
+        controller = get_controller()
+        controller.reset()
+        try:
+            controller._overloaded = True
+            controller._last_refresh = time.monotonic()
+            src = DirectoryTailSource(session, watch, data).start()
+            try:
+                deadline = time.monotonic() + 30.0
+                while src.stats()["pauses"] < 3:
+                    # Keep the cached verdict fresh past the 1s
+                    # re-evaluation window.
+                    controller._overloaded = True
+                    controller._last_refresh = time.monotonic()
+                    assert time.monotonic() < deadline, "never paused"
+                    time.sleep(0.02)
+                assert src.stats()["batches"] == 0  # nothing pulled
+                controller._overloaded = False
+                controller._last_refresh = time.monotonic()
+                _wait_until(lambda: src.stats()["batches"] == 1,
+                            msg="resumed after breach cleared")
+            finally:
+                src.stop(drain=True)
+        finally:
+            controller.reset()
+
+    def test_blocked_source_frees_on_external_commit(self, tmp_path):
+        """A tailer that outruns the staged budget parks in blocking
+        append (counted in waits) and resumes when ANY committer frees
+        the table."""
+        session, hs, data = self._lake(
+            tmp_path, **{SC.MAX_STAGED_BATCHES: "2",
+                         SC.SOURCE_COMMIT_BATCHES: "100"})
+        watch = str(tmp_path / "drop")
+        os.makedirs(watch)
+        for i in range(3):
+            self._drop(watch, f"b{i}.parquet", _frame(_rng(87 + i), 30))
+        src = DirectoryTailSource(session, watch, data).start()
+        try:
+            _wait_until(
+                lambda: ingest.get_queue().staged_count(data) >= 2,
+                msg="budget filled")
+            hs.commit(data)  # an external commit frees the waiter
+            _wait_until(lambda: src.stats()["batches"] == 3,
+                        msg="tail resumed after commit")
+        finally:
+            out = src.stop(drain=True)
+        assert out["waits"] >= 1
+        rows = len(session.read.parquet(data).to_pandas())
+        assert rows == 2000 + 3 * 30
+
+
+# ---------------------------------------------------------------------------
+# Registries: the r22 names exist, and tracing records the spans.
+# ---------------------------------------------------------------------------
+
+class TestScaleRegistries:
+    def test_names_registered(self):
+        assert SN.INGEST_WAVE == "ingest.wave"
+        assert SN.INGEST_SOURCE == "ingest.source"
+        assert {SN.INGEST_WAVE, SN.INGEST_SOURCE} <= SN.SPAN_NAMES
+        assert FN.STREAMING_SOURCE == "streaming.source"
+        assert FN.STREAMING_SOURCE in FN.FAULT_NAMES
+
+    def _span_names_of(self, trace):
+        return [s.name for s in trace.spans] \
+            if hasattr(trace, "spans") else \
+            [s.name for s in trace._spans]
+
+    def test_wave_span_recorded_under_tracing(self, tmp_path):
+        session, hs, data = _mk_lake(tmp_path, index=False)
+        session.conf.set("hyperspace.tpu.telemetry.trace.enabled",
+                         "true")
+        hs.append(data, _frame(_rng(91), 40))
+        hs.append(data, _frame(_rng(92), 40))
+        out = hs.commit(data)
+        assert out["committed_batches"] == 2
+        assert SN.INGEST_WAVE in self._span_names_of(
+            session._last_trace)
+
+    def test_source_span_recorded_under_tracing(self, tmp_path):
+        # commitBatches=1: the commit lands INSIDE the source's poll
+        # trace (maintenance_trace is reentrancy-aware), so the fully
+        # drained stop() below never opens a later trace that would
+        # shadow ``_last_trace``.
+        session, hs, data = _mk_lake(
+            tmp_path, index=False,
+            **{SC.SOURCE_POLL_MS: "20", SC.SOURCE_COMMIT_BATCHES: "1"})
+        session.conf.set("hyperspace.tpu.telemetry.trace.enabled",
+                         "true")
+        watch = str(tmp_path / "drop")
+        os.makedirs(watch)
+        tmp = os.path.join(watch, "b0.parquet.tmp")
+        pq.write_table(pa.Table.from_pandas(_frame(_rng(93), 30)), tmp)
+        os.replace(tmp, os.path.join(watch, "b0.parquet"))
+        src = DirectoryTailSource(session, watch, data).start()
+        try:
+            _wait_until(lambda: src.stats()["commits"] == 1,
+                        msg="source poll traced")
+        finally:
+            src.stop(drain=True)
+        names = self._span_names_of(session._last_trace)
+        assert SN.INGEST_SOURCE in names
+        assert SN.INGEST_WAVE in names  # the commit nested in the poll
