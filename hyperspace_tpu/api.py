@@ -347,6 +347,22 @@ class Hyperspace:
             out["index_table_cache"] = None
         return out
 
+    def buffer_pool_stats(self) -> dict:
+        """Tiered columnar buffer-pool counters
+        (execution/buffer_pool.py): per-tier hits, misses, admissions,
+        host→device ``transfers`` (loads + promotions — the warm-path
+        signal: 0 new transfers on a fully warm repeat), the eviction
+        ladder tallies, ``decode_bytes_saved``, and per-namespace probe
+        splits. Delegates to the process metrics registry's
+        "buffer_pool" collector — every worker's OpenMetrics scrape
+        carries the same dict (fleet awareness without cross-process
+        byte shipping)."""
+        from .execution import buffer_pool
+        from .telemetry.metrics import get_registry
+        out = get_registry().collect(
+            buffer_pool._mn.COLLECTOR_BUFFER_POOL)
+        return out if out is not None else buffer_pool.pool_stats()
+
     def io_stats(self) -> dict:
         """Process-wide parallel-I/O pool counters (parallel/io.py):
         pooled read fan-outs, file tasks, byte estimates, in-worker
@@ -398,8 +414,10 @@ class Hyperspace:
         from .telemetry.metrics import get_registry
         snap = get_registry().snapshot()
         cols = snap["collectors"]
+        from .execution import buffer_pool
         cols.setdefault("io", pio.pool_stats())
         cols.setdefault("program_bank", get_bank().stats())
+        cols.setdefault("buffer_pool", buffer_pool.pool_stats())
         cols["result_cache"] = self.result_cache_stats()
         cols["spmd"] = self.spmd_stats()
         if "serving" not in cols:

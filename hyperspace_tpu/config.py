@@ -322,6 +322,30 @@ class HyperspaceConf:
             IndexConstants.TPU_IO_MAX_INFLIGHT_BYTES,
             IndexConstants.TPU_IO_MAX_INFLIGHT_BYTES_DEFAULT))
 
+    # ------------------------------------------------------------------
+    # Tiered columnar buffer pool (execution/buffer_pool.py).
+    # ------------------------------------------------------------------
+
+    def buffer_pool_enabled(self) -> bool:
+        return self._get_bool(
+            IndexConstants.TPU_BUFFER_POOL_ENABLED,
+            IndexConstants.TPU_BUFFER_POOL_ENABLED_DEFAULT)
+
+    def buffer_pool_device_bytes(self) -> int:
+        return int(self._conf.get(
+            IndexConstants.TPU_BUFFER_POOL_DEVICE_BYTES,
+            IndexConstants.TPU_BUFFER_POOL_DEVICE_BYTES_DEFAULT))
+
+    def buffer_pool_host_bytes(self) -> int:
+        return int(self._conf.get(
+            IndexConstants.TPU_BUFFER_POOL_HOST_BYTES,
+            IndexConstants.TPU_BUFFER_POOL_HOST_BYTES_DEFAULT))
+
+    def buffer_pool_stream_admit_bytes(self) -> int:
+        return int(self._conf.get(
+            IndexConstants.TPU_BUFFER_POOL_STREAM_ADMIT_BYTES,
+            IndexConstants.TPU_BUFFER_POOL_STREAM_ADMIT_BYTES_DEFAULT))
+
     def max_chunk_rows(self) -> int:
         return int(
             self._conf.get(

@@ -1,0 +1,646 @@
+"""Tiered columnar buffer pool: decoded scans shared across queries.
+
+The r06 result cache short-circuits *identical* plans; everything else —
+a literal variant, a different projection, a standing-query fire — used
+to re-read parquet, re-decode Arrow→numpy, re-pad to shape classes, and
+re-ship host→device even when the underlying (file, columns) bytes were
+unchanged. This module is the missing cache tier underneath all of that:
+a process-wide, byte-budgeted, two-tier (device HBM → host) pool of
+decoded, shape-class-padded column buffers, keyed by source file
+signature (path, size, mtime) + column set + row-group pruning selection
++ padding/dtype profile, so any two queries touching the same columns of
+the same files share ONE decode and ONE host→device transfer.
+
+All three scan paths route through it:
+
+- ``columnar.read_parquet(pad_to_class=True)`` — the executor's bulk
+  scan (the r09 pooled fan-out readers are the *producers* into the
+  pool: a miss decodes through them, the admit makes every later probe
+  skip them entirely);
+- ``columnar.iter_dataset_chunks`` — the chunked filtered scan admits
+  its full chunk sequence (bounded by ``streamAdmitBytes``) and replays
+  it byte-identically;
+- the SPMD file-aligned scan (execution/spmd.py) — per-device sharded
+  blocks cached keyed by mesh signature (device-only entries: they drop
+  on eviction, never demote).
+
+``execution/index_cache.py``'s IndexTableCache is a thin view over this
+pool (namespace "index"), so index and source scans obey ONE budget.
+
+Correctness is by construction: keys embed the (size, mtime, path) file
+signature, so append/refresh/optimize/compact produce new signatures and
+stale entries simply age out of the LRU — the same invalidation story as
+the result cache. Eviction ladders device → host → drop. The
+``buffer.load`` fault point fires at every probe: under the r14 degrade
+contract an injected (or real) load failure is a SILENT MISS — the entry
+is dropped and the caller re-reads — never a wrong answer; with
+``robustness.degrade.enabled=false`` it fails loud.
+
+The pool is purely process-local (no recovery surface, nothing on disk);
+in a cluster each worker warms its own pool and the per-worker
+OpenMetrics scrape carries the ``buffer_pool`` collector.
+
+Thread safety: one lock around both tiers and every counter, the
+result-cache pattern — device→host demotions and host→device promotions
+(the batched ``jax.device_put``) run OUTSIDE the lock.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from collections import OrderedDict
+from typing import Dict, NamedTuple, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..robustness import fault_names as _fn
+from ..robustness import faults as _faults
+from ..telemetry import metric_names as _mn
+from ..telemetry import metrics as _metrics
+
+TIER_DEVICE = "device"
+TIER_HOST = "host"
+
+# Fallback budgets when no session conf is active (the executor is
+# session-free by design; within an execution the parallel-io session
+# scope provides the conf and get_pool() refreshes the budgets live).
+_DEVICE_BYTES_DEFAULT = 4 << 30
+_HOST_BYTES_DEFAULT = 4 << 30
+_STREAM_ADMIT_BYTES_DEFAULT = 256 << 20
+
+
+class PoolKey(NamedTuple):
+    """One pool entry's identity: namespace ("scan" | "stream" | "index"
+    | "blocks"), the hashable key tuple (file signature + column set +
+    pruning selection + profile), and the summed source bytes the key's
+    files hold (credited to ``decode_bytes_saved`` on every hit)."""
+
+    ns: str
+    key: tuple
+    source_bytes: int
+
+
+class _Entry:
+    __slots__ = ("payload", "nbytes", "source_bytes", "device_only")
+
+    def __init__(self, payload, nbytes: int, source_bytes: int,
+                 device_only: bool):
+        self.payload = payload
+        self.nbytes = nbytes
+        self.source_bytes = source_bytes
+        self.device_only = device_only
+
+
+def table_nbytes(table) -> int:
+    """Approximate residency cost of a Table (device or host): column
+    data + validity bitmaps + dictionary slots. The single byte
+    accounting shared by this pool, the index-cache view, and the
+    serving result cache (serving/result_cache.py)."""
+    total = 0
+    for col in table.columns.values():
+        total += col.data.size * col.data.dtype.itemsize
+        if col.validity is not None:
+            total += col.validity.size
+        if col.dictionary is not None:
+            total += col.dictionary.size * 8
+    return total
+
+
+def _table_to_host(table):
+    """Demote a Table to host numpy with ONE batched device_get, KEEPING
+    class padding and ``valid_rows`` (unlike Table.to_host, which trims)
+    — a later promotion must restore the exact device layout so the
+    shape-class pipeline sees the same compiled programs."""
+    import jax
+
+    from .columnar import Column, Table
+    arrays = {}
+    for n, c in table.columns.items():
+        if not isinstance(c.data, np.ndarray):
+            arrays[(n, "d")] = c.data
+        if c.validity is not None and not isinstance(c.validity,
+                                                     np.ndarray):
+            arrays[(n, "v")] = c.validity
+    host = jax.device_get(arrays) if arrays else {}
+
+    def pick(a, key):
+        return np.asarray(host[key]) if key in host else a
+
+    return Table({n: Column(c.dtype, pick(c.data, (n, "d")),
+                            pick(c.validity, (n, "v"))
+                            if c.validity is not None else None,
+                            c.dictionary)
+                  for n, c in table.columns.items()},
+                 bucket_order=table.bucket_order,
+                 valid_rows=table.valid_rows)
+
+
+def _table_to_device(table):
+    """Promote a host-tier Table back into HBM with ONE batched
+    jax.device_put, preserving ``valid_rows`` (the demotion kept the
+    padded physical length)."""
+    import jax
+
+    from .columnar import Column, Table
+    if not any(isinstance(c.data, np.ndarray)
+               for c in table.columns.values()):
+        return table
+    arrays = {}
+    for n, c in table.columns.items():
+        arrays[(n, "d")] = c.data
+        if c.validity is not None:
+            arrays[(n, "v")] = c.validity
+    dev = jax.device_put(arrays)
+    return Table({n: Column(c.dtype, dev[(n, "d")],
+                            dev[(n, "v")] if c.validity is not None
+                            else None, c.dictionary)
+                  for n, c in table.columns.items()},
+                 bucket_order=table.bucket_order,
+                 valid_rows=table.valid_rows)
+
+
+class BufferPool:
+    """Two-tier (device → host) byte-budgeted LRU of decoded buffers.
+
+    Entries are Tables (demotable) or opaque device objects (SPMD block
+    dicts, chunk-stream lists — ``device_only``: evicted by dropping).
+    Counters: ``device_hits``/``host_hits``/``misses`` per probe,
+    ``admissions``/``rejections`` per put, ``loads`` (pool-filling
+    decode+transfer), ``promotions`` (host→device re-uploads — together
+    with loads these are the pool's host→device TRANSFER count),
+    ``demotions``/``evictions`` down the ladder, ``invalidations``
+    (fault-dropped entries) and ``degraded_loads`` (probes the
+    ``buffer.load`` fault degraded to silent misses).
+    """
+
+    def __init__(self, device_bytes: int, host_bytes: int):
+        self.device_bytes = int(device_bytes)
+        self.host_bytes = int(host_bytes)
+        self._lock = threading.Lock()
+        self._device: "OrderedDict[tuple, _Entry]" = OrderedDict()
+        self._host: "OrderedDict[tuple, _Entry]" = OrderedDict()
+        self._device_nbytes = 0
+        self._host_nbytes = 0
+        # Per-namespace probe counters (the index-cache view's legacy
+        # hits/misses aliases read the "index" slice).
+        self._ns: Dict[str, Dict[str, int]] = {}
+        self.device_hits = 0
+        self.host_hits = 0
+        self.misses = 0
+        self.admissions = 0
+        self.rejections = 0
+        self.loads = 0
+        self.promotions = 0
+        self.demotions = 0
+        self.evictions = 0
+        self.invalidations = 0
+        self.degraded_loads = 0
+        self.decode_bytes_saved = 0
+
+    # ------------------------------------------------------------------
+    # Lock-held helpers (delegates in the HS301 registry).
+    # ------------------------------------------------------------------
+
+    def _bump_ns(self, ns: str, field: str) -> None:
+        """Under the lock: bump one per-namespace probe counter."""
+        slot = self._ns.get(ns)
+        if slot is None:
+            slot = {"hits": 0, "misses": 0}
+            self._ns[ns] = slot
+        slot[field] += 1
+
+    def _drop(self, full: tuple) -> int:
+        """Under the lock: remove ``full`` from both tiers; returns the
+        dropped byte count (0 if absent)."""
+        e = self._device.pop(full, None)
+        if e is not None:
+            self._device_nbytes -= e.nbytes
+            return e.nbytes
+        e = self._host.pop(full, None)
+        if e is not None:
+            self._host_nbytes -= e.nbytes
+            return e.nbytes
+        return 0
+
+    def _pop_device_victims(self) -> list:
+        """Under the lock: pop LRU device entries until the device tier
+        fits its budget; returns the (key, entry) victims for the caller
+        to demote or drop OUTSIDE the lock."""
+        victims = []
+        while self._device_nbytes > self.device_bytes \
+                and len(self._device) > 1:
+            full, e = self._device.popitem(last=False)
+            self._device_nbytes -= e.nbytes
+            victims.append((full, e))
+        return victims
+
+    def _pop_host_victims(self) -> list:
+        victims = []
+        while self._host_nbytes > self.host_bytes and len(self._host) > 1:
+            full, e = self._host.popitem(last=False)
+            self._host_nbytes -= e.nbytes
+            victims.append((full, e))
+        return victims
+
+    # ------------------------------------------------------------------
+    # Probe / admit.
+    # ------------------------------------------------------------------
+
+    def get(self, pk: PoolKey):
+        """The cached payload, or None (a miss — caller re-reads). The
+        ``buffer.load`` fault point fires here: an injected (or real)
+        load failure drops the entry and reports a silent miss under the
+        degrade contract, never a wrong answer."""
+        full = (pk.ns,) + tuple(pk.key)
+        try:
+            _faults.fault_point(_fn.BUFFER_LOAD)
+        except Exception:
+            if not _faults.degrade_enabled():
+                raise
+            _faults.note(degraded_buffer_loads=1)
+            with self._lock:
+                if self._drop(full):
+                    self.invalidations += 1
+                self.degraded_loads += 1
+                self.misses += 1
+                self._bump_ns(pk.ns, "misses")
+            _note_query(pool_misses=1)
+            _emit_event(_miss_event, pk.ns, "fault")
+            return None
+        promote = None
+        with self._lock:
+            e = self._device.get(full)
+            if e is not None:
+                self._device.move_to_end(full)
+                self.device_hits += 1
+                self.decode_bytes_saved += e.source_bytes
+                self._bump_ns(pk.ns, "hits")
+                payload, saved, tier = e.payload, e.source_bytes, \
+                    TIER_DEVICE
+            else:
+                e = self._host.get(full)
+                if e is None:
+                    self.misses += 1
+                    self._bump_ns(pk.ns, "misses")
+                else:
+                    self._host.move_to_end(full)
+                    self.host_hits += 1
+                    self.decode_bytes_saved += e.source_bytes
+                    self._bump_ns(pk.ns, "hits")
+                    payload, saved, tier = e.payload, e.source_bytes, \
+                        TIER_HOST
+                    promote = (full, e)
+        if e is None:
+            _note_query(pool_misses=1)
+            _emit_event(_miss_event, pk.ns, "")
+            return None
+        if promote is not None:
+            payload = self._promote(promote[0], promote[1])
+        _note_query(pool_hits=1, pool_bytes_saved=saved)
+        _emit_event(_hit_event, pk.ns, tier, e.nbytes)
+        return payload
+
+    def _promote(self, full: tuple, e: _Entry):
+        """Host-tier hit: re-upload into HBM (ONE batched device_put,
+        outside the lock) and move the entry back to the device tier. A
+        real upload failure serves the host copy instead — residency is
+        an optimization and must never fail the query."""
+        try:
+            dev_payload = _table_to_device(e.payload)
+        except Exception:
+            if not _faults.degrade_enabled():
+                raise
+            _faults.note(degraded_buffer_loads=1)
+            with self._lock:
+                self.degraded_loads += 1
+            return e.payload
+        with self._lock:
+            cur = self._host.pop(full, None)
+            if cur is None:
+                # A concurrent clear/evict raced us: serve the promoted
+                # table, don't re-admit.
+                return dev_payload
+            self._host_nbytes -= cur.nbytes
+            cur.payload = dev_payload
+            self._device[full] = cur
+            self._device_nbytes += cur.nbytes
+            self.promotions += 1
+            victims = self._pop_device_victims()
+        self._settle_victims(victims)
+        return dev_payload
+
+    def put(self, pk: PoolKey, payload, nbytes: Optional[int] = None,
+            device_only: bool = False) -> None:
+        """Admit a freshly decoded payload to the device tier (one
+        ``load`` = the decode + host→device transfer the admit paid;
+        every later hit skips both). Oversized payloads (> device
+        budget) are rejected rather than thrashing the LRU."""
+        if nbytes is None:
+            nbytes = table_nbytes(payload)
+        full = (pk.ns,) + tuple(pk.key)
+        with self._lock:
+            if nbytes > self.device_bytes:
+                self.rejections += 1
+                return
+            self._drop(full)
+            self._device[full] = _Entry(payload, nbytes, pk.source_bytes,
+                                        device_only)
+            self._device_nbytes += nbytes
+            self.admissions += 1
+            self.loads += 1
+            victims = self._pop_device_victims()
+        self._settle_victims(victims)
+
+    def _settle_victims(self, victims: list) -> None:
+        """Demote device victims to the host tier (drop device-only
+        payloads and everything once the host tier is full) — the
+        device→host→drop eviction ladder, conversions outside the lock."""
+        if not victims:
+            return
+        dropped = []
+        for full, e in victims:
+            if e.device_only or self.host_bytes <= 0:
+                dropped.append((TIER_DEVICE, e.nbytes, False))
+                continue
+            try:
+                host_payload = _table_to_host(e.payload)
+            except Exception:
+                if not _faults.degrade_enabled():
+                    raise
+                dropped.append((TIER_DEVICE, e.nbytes, False))
+                continue
+            e.payload = host_payload
+            with self._lock:
+                self._host[full] = e
+                self._host_nbytes += e.nbytes
+                self.demotions += 1
+                host_victims = self._pop_host_victims()
+            dropped.append((TIER_DEVICE, e.nbytes, True))
+            for _, he in host_victims:
+                dropped.append((TIER_HOST, he.nbytes, False))
+        with self._lock:
+            self.evictions += sum(1 for _, _, dem in dropped if not dem)
+        for tier, nb, demoted in dropped:
+            _emit_event(_evict_event, tier, nb, demoted)
+
+    # ------------------------------------------------------------------
+    # Maintenance / observability.
+    # ------------------------------------------------------------------
+
+    def set_budgets(self, device_bytes: int, host_bytes: int) -> None:
+        with self._lock:
+            self.device_bytes = int(device_bytes)
+            self.host_bytes = int(host_bytes)
+
+    def clear(self, ns: Optional[str] = None) -> None:
+        """Drop every entry (or one namespace's). Counters survive — a
+        clear is maintenance, not history rewriting."""
+        with self._lock:
+            if ns is None:
+                self._device.clear()
+                self._host.clear()
+                self._device_nbytes = 0
+                self._host_nbytes = 0
+                return
+            for tier, attr in ((self._device, "_device_nbytes"),
+                               (self._host, "_host_nbytes")):
+                for full in [k for k in tier if k[0] == ns]:
+                    e = tier.pop(full)
+                    setattr(self, attr, getattr(self, attr) - e.nbytes)
+
+    def ns_counts(self, ns: str) -> Tuple[int, int]:
+        """(hits, misses) of one namespace — the index-cache view's
+        legacy counter aliases."""
+        with self._lock:
+            slot = self._ns.get(ns, None)
+            if slot is None:
+                return 0, 0
+            return slot["hits"], slot["misses"]
+
+    def ns_nbytes(self, ns: str) -> int:
+        with self._lock:
+            return sum(e.nbytes for k, e in self._device.items()
+                       if k[0] == ns) + \
+                sum(e.nbytes for k, e in self._host.items()
+                    if k[0] == ns)
+
+    def stats(self) -> dict:
+        with self._lock:
+            out = {
+                "device_hits": self.device_hits,
+                "host_hits": self.host_hits,
+                "hits": self.device_hits + self.host_hits,
+                "misses": self.misses,
+                "admissions": self.admissions,
+                "rejections": self.rejections,
+                "loads": self.loads,
+                "promotions": self.promotions,
+                "transfers": self.loads + self.promotions,
+                "demotions": self.demotions,
+                "evictions": self.evictions,
+                "invalidations": self.invalidations,
+                "degraded_loads": self.degraded_loads,
+                "decode_bytes_saved": self.decode_bytes_saved,
+                "device_entries": len(self._device),
+                "host_entries": len(self._host),
+                "device_nbytes": self._device_nbytes,
+                "host_nbytes": self._host_nbytes,
+                "device_bytes": self.device_bytes,
+                "host_bytes": self.host_bytes,
+                "namespaces": {ns: dict(slot)
+                               for ns, slot in self._ns.items()},
+            }
+        return out
+
+    def reset_stats(self) -> None:
+        """Zero the counters (bench A/B phases; entries stay resident)."""
+        with self._lock:
+            self.device_hits = self.host_hits = self.misses = 0
+            self.admissions = self.rejections = 0
+            self.loads = self.promotions = self.demotions = 0
+            self.evictions = self.invalidations = 0
+            self.degraded_loads = self.decode_bytes_saved = 0
+            self._ns.clear()
+
+
+# ---------------------------------------------------------------------------
+# Process-wide singleton + conf resolution (config.py only; the executor
+# is session-free, so the conf rides the parallel-io session scope).
+# ---------------------------------------------------------------------------
+
+_POOL: Optional[BufferPool] = None
+_POOL_LOCK = threading.Lock()
+
+
+def _conf():
+    from ..parallel import io as pio
+    session = pio.active_session()
+    return session.hs_conf if session is not None else None
+
+
+def enabled() -> bool:
+    c = _conf()
+    if c is None:
+        return True
+    return c.buffer_pool_enabled()
+
+
+def stream_admit_bytes() -> int:
+    c = _conf()
+    if c is None:
+        return _STREAM_ADMIT_BYTES_DEFAULT
+    return c.buffer_pool_stream_admit_bytes()
+
+
+def get_pool() -> BufferPool:
+    """THE process pool. Budgets refresh live from the active session's
+    conf on every resolution (config.py's live-tuning contract)."""
+    global _POOL
+    with _POOL_LOCK:
+        if _POOL is None:
+            _POOL = BufferPool(_DEVICE_BYTES_DEFAULT, _HOST_BYTES_DEFAULT)
+        pool = _POOL
+    c = _conf()
+    if c is not None:
+        pool.set_budgets(c.buffer_pool_device_bytes(),
+                         c.buffer_pool_host_bytes())
+    return pool
+
+
+def pool_stats() -> dict:
+    """Snapshot for the ``buffer_pool`` metrics collector and
+    ``Hyperspace.buffer_pool_stats()``."""
+    return get_pool().stats()
+
+
+# The pool counters are a named collector in the process metrics
+# registry (telemetry/metrics.py): every worker's OpenMetrics scrape
+# (and Hyperspace.metrics()) carries them — the fleet-visibility story,
+# no cross-process byte shipping.
+_metrics.get_registry().register_collector(_mn.COLLECTOR_BUFFER_POOL,
+                                           pool_stats)
+
+
+# ---------------------------------------------------------------------------
+# Keys.
+# ---------------------------------------------------------------------------
+
+def file_signature(files: Sequence[str]) -> Optional[tuple]:
+    """((path, size, mtime), ...) — THE invalidation carrier: any
+    append/refresh/optimize/compact changes size/mtime/path, so stale
+    entries become unreachable by construction (the result-cache
+    source-signature story applied per file). None when any file cannot
+    be stat'd — the caller simply skips the pool."""
+    from ..index import data_store
+    sig = []
+    for f in files:
+        try:
+            store = data_store.store_for_path(f)
+            if store is None:
+                st = os.stat(f)
+                sig.append((str(f), int(st.st_size), int(st.st_mtime_ns)))
+            else:
+                path, size, mtime = store.file_info(f)
+                sig.append((str(path), int(size), int(mtime)))
+        except Exception:
+            return None
+    return tuple(sig)
+
+
+def _sig_bytes(sig: tuple) -> int:
+    return sum(size for _, size, _ in sig)
+
+
+def scan_key(files: Sequence[str], columns, filters) -> Optional[PoolKey]:
+    """Key for one bulk scan read: file signature + column set +
+    row-group pruning selection (the pyarrow filter expression IS the
+    pruning choice) + the padded-read profile."""
+    sig = file_signature(files)
+    if sig is None:
+        return None
+    cols = tuple(columns) if columns is not None else None
+    return PoolKey("scan", (sig, cols, repr(filters), "padded"),
+                   _sig_bytes(sig))
+
+
+def stream_key(files: Sequence[str], columns, filters,
+               chunk_rows: int) -> Optional[PoolKey]:
+    """Key for one chunked filtered scan (iter_dataset_chunks): the
+    chunk size participates because the REPLAY must be byte-identical
+    chunk-for-chunk, not just row-for-row."""
+    sig = file_signature(files)
+    if sig is None:
+        return None
+    cols = tuple(columns) if columns is not None else None
+    return PoolKey("stream", (sig, cols, repr(filters), int(chunk_rows)),
+                   _sig_bytes(sig))
+
+
+def index_key(legacy_key: tuple) -> PoolKey:
+    """The IndexTableCache view's namespace: index data versions are
+    immutable on disk, so the legacy (entry id, name, files, columns)
+    tuple stays sufficient — rebuilds produce new file paths."""
+    return PoolKey("index", tuple(legacy_key), 0)
+
+
+def blocks_key(files: Sequence[str], names: Sequence[str], bounds,
+               shard_rows: int, mesh_sig) -> Optional[PoolKey]:
+    """Key for the SPMD file-aligned scan's per-device sharded blocks:
+    file signature + stream array names + file-aligned bounds + padded
+    shard rows + mesh signature (a different mesh lays buffers out on
+    different devices — never share across meshes)."""
+    sig = file_signature(files)
+    if sig is None:
+        return None
+    return PoolKey("blocks", (sig, tuple(names), tuple(bounds),
+                              int(shard_rows), tuple(mesh_sig)),
+                   _sig_bytes(sig))
+
+
+# ---------------------------------------------------------------------------
+# Attribution + telemetry.
+# ---------------------------------------------------------------------------
+
+def _note_query(**deltas) -> None:
+    """Per-query attribution: the active QueryContext gets pool probe
+    counters (pool_hits / pool_misses / pool_bytes_saved), mirroring the
+    parallel-io read attribution — explain's I/O section credits them."""
+    from ..serving.context import active_context
+    ctx = active_context()
+    if ctx is not None:
+        ctx.note_io(**deltas)
+
+
+def _hit_event(ns: str, tier: str, nbytes: int):
+    from ..telemetry.events import BufferPoolHitEvent
+    return BufferPoolHitEvent(
+        message=f"buffer pool hit ({ns}, {tier} tier)",
+        namespace=ns, tier=tier, nbytes=nbytes)
+
+
+def _miss_event(ns: str, reason: str):
+    from ..telemetry.events import BufferPoolMissEvent
+    return BufferPoolMissEvent(
+        message=f"buffer pool miss ({ns})", namespace=ns, reason=reason)
+
+
+def _evict_event(tier: str, nbytes: int, demoted: bool):
+    from ..telemetry.events import BufferPoolEvictEvent
+    return BufferPoolEvictEvent(
+        message=f"buffer pool {'demotion' if demoted else 'eviction'} "
+                f"({tier} tier)",
+        tier=tier, nbytes=nbytes, demoted=demoted)
+
+
+def _emit_event(make, *args) -> None:
+    from ..parallel import io as pio
+    session = pio.active_session()
+    if session is None:
+        return
+    try:
+        from ..telemetry.logging import get_logger
+        get_logger(session.hs_conf.event_logger_class()).log_event(
+            make(*args))
+    except Exception:
+        return  # observability must never fail a read

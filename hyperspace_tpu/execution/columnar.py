@@ -595,21 +595,39 @@ def _read_parquet_pooled(files, read_cols, filters, fs) -> pa.Table:
 
 def read_parquet(files: Sequence[str], columns: Optional[Sequence[str]] = None,
                  fmt: str = "parquet", filters=None,
-                 pad_to_class: bool = False) -> Table:
+                 pad_to_class: bool = False, pool: bool = True) -> Table:
     """``pad_to_class`` class-pads the result host-side (free) for the
     executor's shape-class pipeline; leave False for callers that read
     ``.data`` directly (builds, sketches, spmd leaves). Multi-file reads
     of every format fan out per file over the shared reader pool
     (parallel/io.py) with order-preserving gather; device encoding stays
-    on the calling thread."""
+    on the calling thread.
+
+    Class-padded parquet reads route through the tiered buffer pool
+    (execution/buffer_pool.py) keyed by file signature + column set +
+    pruning filter: a warm probe serves the decoded padded table with
+    ZERO file reads and ZERO host→device transfers; a miss decodes here
+    (the pooled fan-out readers are the pool's producers) and admits the
+    result. ``pool=False`` opts a caller out (the index-scan path has
+    its own pool view and must not double-store)."""
     from ..parallel import io as pio
     from ..robustness import fault_names as _fn
     from ..robustness import faults as _faults
+    from . import buffer_pool as _bp
     if not files:
         raise HyperspaceException("read_parquet: no files")
     # Robustness fault point: the scan-decode boundary every format
     # funnels through (hard no-op disarmed; see robustness/faults.py).
+    # Fires BEFORE the pool probe so fault semantics are identical
+    # pool-on vs pool-off.
     _faults.fault_point(_fn.SCAN_PARQUET_DECODE)
+    pool_key = None
+    if fmt == "parquet" and pad_to_class and pool and _bp.enabled():
+        pool_key = _bp.scan_key(files, columns, filters)
+        if pool_key is not None:
+            cached = _bp.get_pool().get(pool_key)
+            if cached is not None:
+                return cached
     if fmt == "parquet":
         fs, files = _resolve_files(files)
         read_cols = list(columns) if columns else None
@@ -703,7 +721,10 @@ def read_parquet(files: Sequence[str], columns: Optional[Sequence[str]] = None,
             at = at.select(list(columns))
     else:
         raise HyperspaceException(f"Unsupported format: {fmt}")
-    return Table.from_arrow(at, pad_to_class=pad_to_class)
+    table = Table.from_arrow(at, pad_to_class=pad_to_class)
+    if pool_key is not None:
+        _bp.get_pool().put(pool_key, table)
+    return table
 
 
 @functools.lru_cache(maxsize=65536)
@@ -807,11 +828,52 @@ def iter_dataset_chunks(files: Sequence[str],
     never decoded (the scan-side counterpart of iter_parquet_chunks, which
     the build uses for its lineage provenance). Depth-N prefetching
     (parallel/io.py): chunk k+1 decodes to device while the consumer
-    executes chunk k."""
+    executes chunk k.
+
+    Streams up to ``bufferPool.streamAdmitBytes`` route through the
+    tiered buffer pool: a warm probe replays the exact chunk sequence
+    (byte-identical, chunk-for-chunk) with zero file reads; a miss
+    streams normally while collecting chunks, admitting the sequence
+    only after NORMAL exhaustion (abandoned iterations never admit a
+    truncated stream). Chunk payloads are device-resident — the entries
+    are device-only: evicted by dropping, never demoted."""
     from ..parallel import io as pio
-    return pio.prefetch_iter(
-        _iter_dataset_chunks(files, columns, chunk_rows, filters),
-        nbytes=_table_nbytes_estimate, label="dataset_chunks")
+    from . import buffer_pool as _bp
+    pool_key = None
+    if _bp.enabled():
+        pool_key = _bp.stream_key(files, columns, filters, chunk_rows)
+        if pool_key is not None:
+            cached = _bp.get_pool().get(pool_key)
+            if cached is not None:
+                return iter(list(cached))
+    source = _iter_dataset_chunks(files, columns, chunk_rows, filters)
+    if pool_key is not None:
+        source = _collect_stream(pool_key, source,
+                                 _bp.stream_admit_bytes())
+    return pio.prefetch_iter(source, nbytes=_table_nbytes_estimate,
+                             label="dataset_chunks")
+
+
+def _collect_stream(pool_key, source, admit_bytes: int):
+    """Pass chunks through while accumulating them for pool admission;
+    over-budget streams stop collecting (too big to replay), and only a
+    NORMALLY exhausted stream admits — a consumer that abandons the
+    iterator early (GeneratorExit) must never poison the pool with a
+    truncated sequence."""
+    from . import buffer_pool as _bp
+    chunks: List[Table] = []
+    total = 0
+    for chunk in source:
+        if chunks is not None:
+            total += _table_nbytes_estimate(chunk)
+            if total > admit_bytes:
+                chunks = None
+            else:
+                chunks.append(chunk)
+        yield chunk
+    if chunks is not None:
+        _bp.get_pool().put(pool_key, chunks, nbytes=total,
+                           device_only=True)
 
 
 def _iter_dataset_chunks(files: Sequence[str],

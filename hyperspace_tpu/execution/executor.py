@@ -763,8 +763,12 @@ def _execute_index_scan(plan: IndexScan, needed: Optional[Set[str]],
             _emit_index_cache_probe(entry.name, hit=table is not None)
             if table is None:
                 # Padded host-side at read: the cache's only consumer is
-                # this (padded-aware) scan path.
-                table = read_parquet(index_files, cols, pad_to_class=True)
+                # this (padded-aware) scan path. pool=False: the cache
+                # view admits under its own "index" namespace below —
+                # routing the inner read through the scan namespace too
+                # would double-store every index table.
+                table = read_parquet(index_files, cols, pad_to_class=True,
+                                     pool=False)
                 cache.put(key, table)
         else:
             table = read_parquet(index_files, cols, filters=pa_filter,

@@ -1,11 +1,17 @@
-"""HBM-resident index table cache.
+"""HBM-resident index table cache — a view over the tiered buffer pool.
 
-The covering index's value on TPU is being *resident*: once a query touches
-an index version, its columns stay on device and every later query probes
-HBM directly instead of re-reading bucket parquet files from the lake (the
-design target: filter pushdown and shuffle-free joins probe an HBM-resident
-columnar index). Source scans are deliberately NOT cached — the index is
-the derived, optimized structure; the lake is the cold path.
+The covering index's value on TPU is being *resident*: once a query
+touches an index version, its columns stay on device and every later
+query probes HBM directly instead of re-reading bucket parquet files
+from the lake. Since the buffer-pool PR this module no longer owns
+storage: :class:`IndexTableCache` is a thin view over
+``execution/buffer_pool.py``'s process pool (namespace ``"index"``), so
+index and source scans obey ONE device/host byte budget and one
+eviction ladder. The legacy surface is preserved exactly — same
+constructor, same ``get``/``put``/``clear``, and the 4 legacy counters
+(``hits``/``misses``/``nbytes``/``max_bytes``) keep reporting via
+aliases over the pool's per-namespace counters, so IndexCacheHit/
+MissEvent consumers and existing tests stay green.
 
 Keys are (entry id, file tuple, column tuple): index data versions are
 immutable on disk (index/IndexDataManager versioned dirs), so a key can
@@ -14,70 +20,59 @@ entries age out of the LRU.
 
 Knobs (env, not session conf — the executor is session-free by design):
   HST_INDEX_CACHE=off         disable
-  HST_INDEX_CACHE_BYTES=N     capacity (default 4 GiB; TPU v5e has 16 GiB)
+  HST_INDEX_CACHE_BYTES=N     standalone-view capacity (default 4 GiB)
 """
 
 from __future__ import annotations
 
 import os
-from collections import OrderedDict
 from typing import Optional, Tuple
 
+from .buffer_pool import BufferPool, index_key, table_nbytes
 from .columnar import Table
 
-
-def table_nbytes(table: Table) -> int:
-    """Approximate residency cost of a Table (device or host): column
-    data + validity bitmaps + dictionary slots. The single byte
-    accounting shared by this cache and the serving result cache
-    (serving/result_cache.py)."""
-    total = 0
-    for col in table.columns.values():
-        total += col.data.size * col.data.dtype.itemsize
-        if col.validity is not None:
-            total += col.validity.size
-        if col.dictionary is not None:
-            total += col.dictionary.size * 8
-    return total
+# Re-export: table_nbytes moved to buffer_pool.py (the pool owns the
+# shared byte accounting) but serving/result_cache.py and external
+# callers import it from here.
+__all__ = ["table_nbytes", "IndexTableCache", "enabled", "get_cache"]
 
 
 class IndexTableCache:
-    def __init__(self, max_bytes: int):
+    """The legacy index-cache API over a buffer pool.
+
+    Standalone construction (``IndexTableCache(max_bytes)``) wraps a
+    PRIVATE single-tier pool (host budget 0: evicted entries drop, the
+    legacy semantics). The process singleton from :func:`get_cache`
+    instead views the SHARED process pool, so index tables compete with
+    source-scan buffers under one budget and may demote to the host
+    tier before dropping.
+    """
+
+    def __init__(self, max_bytes: int, pool: Optional[BufferPool] = None):
         self.max_bytes = max_bytes
-        self._entries: "OrderedDict[Tuple, Tuple[Table, int]]" = OrderedDict()
-        self._bytes = 0
-        self.hits = 0
-        self.misses = 0
+        self._pool = pool if pool is not None \
+            else BufferPool(device_bytes=max_bytes, host_bytes=0)
 
     def get(self, key: Tuple) -> Optional[Table]:
-        hit = self._entries.get(key)
-        if hit is None:
-            self.misses += 1
-            return None
-        self._entries.move_to_end(key)
-        self.hits += 1
-        return hit[0]
+        return self._pool.get(index_key(key))
 
     def put(self, key: Tuple, table: Table) -> None:
-        nbytes = table_nbytes(table)
-        if nbytes > self.max_bytes:
-            return  # larger than the whole cache: don't thrash.
-        old = self._entries.pop(key, None)
-        if old is not None:
-            self._bytes -= old[1]
-        self._entries[key] = (table, nbytes)
-        self._bytes += nbytes
-        while self._bytes > self.max_bytes and len(self._entries) > 1:
-            _, (_, evicted) = self._entries.popitem(last=False)
-            self._bytes -= evicted
+        self._pool.put(index_key(key), table)
 
     def clear(self) -> None:
-        self._entries.clear()
-        self._bytes = 0
+        self._pool.clear("index")
+
+    @property
+    def hits(self) -> int:
+        return self._pool.ns_counts("index")[0]
+
+    @property
+    def misses(self) -> int:
+        return self._pool.ns_counts("index")[1]
 
     @property
     def nbytes(self) -> int:
-        return self._bytes
+        return self._pool.ns_nbytes("index")
 
 
 _cache: Optional[IndexTableCache] = None
@@ -90,6 +85,7 @@ def enabled() -> bool:
 def get_cache() -> IndexTableCache:
     global _cache
     if _cache is None:
+        from .buffer_pool import get_pool
         _cache = IndexTableCache(int(os.environ.get(
-            "HST_INDEX_CACHE_BYTES", str(4 << 30))))
+            "HST_INDEX_CACHE_BYTES", str(4 << 30))), pool=get_pool())
     return _cache

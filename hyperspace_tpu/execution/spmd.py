@@ -846,6 +846,40 @@ def _file_aligned_bounds(leaf, leaf_table, n_dev: int):
     return bounds
 
 
+def _sharded_blocks(mesh, leaf, stream_arrays, bounds, shard_rows):
+    """File-aligned device sharding through the tiered buffer pool: the
+    per-device sharded blocks are cached keyed by (leaf file signature,
+    array names, block bounds, padded shard rows, mesh signature) so a
+    repeat scan of unchanged files re-serves the SAME device buffers
+    with zero host→device transfers. Entries are device-only (opaque
+    sharded layouts never demote — evicted by dropping), and a
+    different mesh never shares (its buffers live on other devices)."""
+    from ..parallel.sharding import mesh_signature
+    from . import buffer_pool as _bp
+
+    key = None
+    if _bp.enabled():
+        try:
+            files = list(leaf.relation.all_files())
+        except Exception:
+            files = None
+        if files:
+            key = _bp.blocks_key(files, sorted(stream_arrays), bounds,
+                                 shard_rows, mesh_signature(mesh))
+        if key is not None:
+            cached = _bp.get_pool().get(key)
+            if cached is not None:
+                return cached
+    sharded, valid = pad_and_shard_blocks(mesh, stream_arrays, bounds,
+                                          shard_rows=shard_rows)
+    if key is not None:
+        nbytes = sum(int(a.nbytes) for a in sharded.values()) \
+            + int(valid.nbytes)
+        _bp.get_pool().put(key, (sharded, valid), nbytes=nbytes,
+                           device_only=True)
+    return sharded, valid
+
+
 def _prepare(root, executor, caps: Dict[int, Tuple[int, int]],
              session=None) -> _Prepared:
     """Walk the stage chain preparing each join side. The walk runs over
@@ -893,9 +927,9 @@ def _prepare(root, executor, caps: Dict[int, Tuple[int, int]],
     if bounds is not None:
         max_block = max(bounds[i + 1] - bounds[i]
                         for i in range(len(bounds) - 1))
-        sharded, valid = pad_and_shard_blocks(
-            mesh, stream_arrays, bounds,
-            shard_rows=padded_length(max_block))
+        sharded, valid = _sharded_blocks(
+            mesh, leaf, stream_arrays, bounds,
+            padded_length(max_block))
     else:
         sharded, valid = pad_and_shard(
             mesh, stream_arrays, leaf_table.num_rows,

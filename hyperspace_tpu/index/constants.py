@@ -220,3 +220,23 @@ class IndexConstants:
     TPU_IO_PREFETCH_DEPTH_DEFAULT = "2"
     TPU_IO_MAX_INFLIGHT_BYTES = "hyperspace.tpu.io.maxInflightBytes"
     TPU_IO_MAX_INFLIGHT_BYTES_DEFAULT = str(256 * 1024 * 1024)
+
+    # Tiered columnar buffer pool (execution/buffer_pool.py): the
+    # process-wide device→host cache of decoded, shape-class-padded scan
+    # buffers shared across queries and sessions. deviceBytes/hostBytes
+    # budget the two tiers; streamAdmitBytes caps how large a chunked
+    # scan (iter_dataset_chunks) may be before the pool declines to
+    # materialize its chunk sequence. All keys are EXCLUDED from the
+    # result-cache config hash (serving/fingerprint.py) — the pool is a
+    # residency choice, not a semantic one.
+    TPU_BUFFER_POOL_ENABLED = "hyperspace.tpu.execution.bufferPool.enabled"
+    TPU_BUFFER_POOL_ENABLED_DEFAULT = "true"
+    TPU_BUFFER_POOL_DEVICE_BYTES = \
+        "hyperspace.tpu.execution.bufferPool.deviceBytes"
+    TPU_BUFFER_POOL_DEVICE_BYTES_DEFAULT = str(4 * 1024 * 1024 * 1024)
+    TPU_BUFFER_POOL_HOST_BYTES = \
+        "hyperspace.tpu.execution.bufferPool.hostBytes"
+    TPU_BUFFER_POOL_HOST_BYTES_DEFAULT = str(4 * 1024 * 1024 * 1024)
+    TPU_BUFFER_POOL_STREAM_ADMIT_BYTES = \
+        "hyperspace.tpu.execution.bufferPool.streamAdmitBytes"
+    TPU_BUFFER_POOL_STREAM_ADMIT_BYTES_DEFAULT = str(256 * 1024 * 1024)

@@ -224,6 +224,14 @@ def _write_io_section(buf: BufferStream, session) -> None:
         f"time split: read+decode={s['read_seconds']:.2f}s "
         f"consumer wait={s['wait_seconds']:.2f}s "
         f"(~{overlap:.2f}s of read hidden behind compute)")
+    from ..execution import buffer_pool
+    bp = buffer_pool.pool_stats()
+    if bp["hits"] + bp["misses"] > 0:
+        buf.write_line(
+            f"buffer pool: hits={bp['hits']} misses={bp['misses']} "
+            f"transfers={bp['transfers']} "
+            f"decode_bytes_saved={bp['decode_bytes_saved']} "
+            f"resident={bp['device_nbytes']}+{bp['host_nbytes']}")
 
 
 def _write_spmd_section(buf: BufferStream, session) -> None:

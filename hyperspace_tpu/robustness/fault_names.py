@@ -98,6 +98,13 @@ STREAMING_SOURCE = "streaming.source"
 CLUSTER_FORWARD = "cluster.forward"
 CLUSTER_BROADCAST = "cluster.broadcast"
 
+# Buffer-pool probe/load boundary (execution/buffer_pool.py get()):
+# fires before a cached decoded buffer is served. An injected (or real)
+# load failure under the degrade contract is a SILENT MISS — the entry
+# is dropped and the caller re-reads from parquet, never a wrong
+# answer; with degrade disabled it fails loud.
+BUFFER_LOAD = "buffer.load"
+
 FAULT_NAMES = frozenset({
     IO_POOLED_READ, IO_PREFETCH_PRODUCE, SCAN_PARQUET_DECODE,
     SPMD_DISPATCH, SPMD_COMPILE, BANK_COMPILE,
@@ -105,5 +112,5 @@ FAULT_NAMES = frozenset({
     LOG_WRITE, LOG_STABLE, ACTION_OP, SERVING_WORKER,
     INGEST_STAGE, INGEST_PUBLISH, STREAMING_SOURCE,
     ARTIFACTS_WRITE, ARTIFACTS_READ,
-    CLUSTER_FORWARD, CLUSTER_BROADCAST,
+    CLUSTER_FORWARD, CLUSTER_BROADCAST, BUFFER_LOAD,
 })

@@ -56,7 +56,8 @@ _CONTEXT: contextvars.ContextVar = contextvars.ContextVar(
     "hst_query_context", default=None)
 
 _IO_COUNTER_KEYS = ("read_tasks", "read_bytes", "read_seconds",
-                    "wait_seconds", "prefetch_items")
+                    "wait_seconds", "prefetch_items",
+                    "pool_hits", "pool_misses", "pool_bytes_saved")
 
 
 class QueryContext:

@@ -167,13 +167,19 @@ def config_hash(session) -> str:
     cluster.* values (worker id, port), and the router refuses a
     forward whenever sender and owner disagree on the key — hashing
     them would make every cross-worker digest mismatch by
-    construction (asserted in tests/test_cluster.py)."""
+    construction (asserted in tests/test_cluster.py). Buffer-pool knobs
+    (execution.bufferPool.*) are excluded because the pool is pure
+    residency strategy: pool-on and pool-off answers are byte-identical
+    by the file-signature invalidation contract (asserted in
+    tests/test_buffer_pool.py), so toggling or resizing it must not
+    orphan warm result-cache entries."""
     items = [(k, v) for k, v in sorted(session.conf.as_dict().items())
              if not k.startswith("serving.")
              and not k.startswith("hyperspace.tpu.serving.")
              and not k.startswith("hyperspace.tpu.telemetry.")
              and not k.startswith("hyperspace.tpu.robustness.")
              and not k.startswith("hyperspace.tpu.execution.fusion.")
+             and not k.startswith("hyperspace.tpu.execution.bufferPool.")
              and not k.startswith("hyperspace.tpu.artifacts.")
              and not k.startswith("hyperspace.tpu.cluster.")]
     return hashing.md5_hex((items, session.is_hyperspace_enabled()))

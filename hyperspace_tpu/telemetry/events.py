@@ -509,6 +509,42 @@ class IndexCacheMissEvent(IndexCacheProbeEvent):
 
 
 @dataclass
+class BufferPoolEvent(HyperspaceEvent):
+    """Base of the tiered columnar buffer-pool events
+    (execution/buffer_pool.py): ``namespace`` is the key family
+    ("scan" | "stream" | "index" | "blocks"), ``tier`` where the probe
+    landed ("device" | "host"), ``nbytes`` the entry's residency cost."""
+
+    namespace: str = ""
+    tier: str = ""
+    nbytes: int = 0
+
+
+@dataclass
+class BufferPoolHitEvent(BufferPoolEvent):
+    """A decoded, padded buffer served from the pool — a parquet decode
+    and (on the device tier) a host→device transfer that did NOT
+    happen."""
+
+
+@dataclass
+class BufferPoolMissEvent(BufferPoolEvent):
+    """``reason`` is "" (cold/evicted key — the caller re-reads) or
+    "fault" (the ``buffer.load`` point struck and the degrade contract
+    dropped the entry: a silent miss, never a wrong answer)."""
+
+    reason: str = ""
+
+
+@dataclass
+class BufferPoolEvictEvent(BufferPoolEvent):
+    """One entry moved down the device→host→drop ladder: ``demoted``
+    means it survived to the host tier; otherwise it was dropped."""
+
+    demoted: bool = False
+
+
+@dataclass
 class ReplanEvent(HyperspaceEvent):
     """Emitted per mid-query re-plan (adaptive/feedback.py): a staged
     join boundary observed ``actual_rows`` against the reorderer's

@@ -60,6 +60,7 @@ COLLECTOR_FUSION = "fusion"
 COLLECTOR_FLIGHT_RECORDER = "flight_recorder"
 COLLECTOR_ARTIFACTS = "artifacts"
 COLLECTOR_CLUSTER = "cluster"
+COLLECTOR_BUFFER_POOL = "buffer_pool"
 
 METRIC_NAMES = frozenset({
     TRACE_SAMPLED, TRACE_TAIL_KEPT, TRACE_DISCARDED, FLIGHT_ANOMALIES,
@@ -67,5 +68,5 @@ METRIC_NAMES = frozenset({
     QUERY_LATENCY_MS, COLLECTOR_IO, COLLECTOR_PROGRAM_BANK,
     COLLECTOR_SERVING, COLLECTOR_ROBUSTNESS, COLLECTOR_STREAMING,
     COLLECTOR_FUSION, COLLECTOR_FLIGHT_RECORDER, COLLECTOR_ARTIFACTS,
-    COLLECTOR_CLUSTER,
+    COLLECTOR_CLUSTER, COLLECTOR_BUFFER_POOL,
 })

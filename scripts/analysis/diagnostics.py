@@ -67,6 +67,8 @@ CODES = {
     "HS321": "raw thread handoff of context-dependent work",
     "HS331": "executable serialization outside the artifact store",
     "HS341": "socket creation outside the sanctioned modules",
+    "HS342": "parquet decode or device transfer outside the buffer-pool "
+             "modules",
 }
 
 # Raw source text of a suppression directive (engine.py owns parsing).

@@ -250,6 +250,57 @@ def socket_sites(tree: ast.AST) -> list:
     return sorted(set(out))
 
 
+# Decode/transfer discipline (the buffer-pool ratchet): parquet decode
+# (pq.read_table / pq.ParquetFile) and host→device transfer
+# (jax.device_put) call sites stay inside the routed scan paths —
+# buffer_pool.py + columnar.py — plus the frozen legacy list below
+# (ingest/maintenance writers reading their own staged files, metadata-
+# only footer readers, and the pre-pool device-residency seams). A new
+# decode or transfer elsewhere would bypass the pool: re-paying decode
+# + transfer invisibly to the hit/transfer counters and outside the
+# file-signature invalidation contract. This list is FROZEN — new scan
+# paths route through execution/buffer_pool.py or columnar.py.
+DECODE_SITE_ALLOWLIST = frozenset({
+    "hyperspace_tpu/actions/create_skipping.py",
+    "hyperspace_tpu/execution/buffer_pool.py",
+    "hyperspace_tpu/execution/columnar.py",
+    "hyperspace_tpu/execution/executor.py",
+    "hyperspace_tpu/execution/fusion.py",
+    "hyperspace_tpu/optimizer/stats.py",
+    "hyperspace_tpu/parallel/mesh.py",
+    "hyperspace_tpu/rules/data_skipping_rule.py",
+    "hyperspace_tpu/serving/result_cache.py",
+    "hyperspace_tpu/streaming/ingest.py",
+    "hyperspace_tpu/streaming/sources.py",
+})
+
+
+def decode_sites(tree: ast.AST) -> list:
+    """Line numbers of parquet decode (``pq.read_table`` /
+    ``pq.ParquetFile`` attribute references, any ``pq``-style alias) and
+    host→device transfer (``jax.device_put``) call sites, plus direct
+    imports of those names (which would dodge the attribute pattern)."""
+    out = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Attribute) \
+                and isinstance(node.value, ast.Name):
+            if node.attr in ("read_table", "ParquetFile") \
+                    and node.value.id.lstrip("_") in ("pq", "parquet"):
+                out.append(node.lineno)
+            elif node.attr == "device_put" and node.value.id == "jax":
+                out.append(node.lineno)
+        elif isinstance(node, ast.ImportFrom) and node.module:
+            root = node.module.split(".")[0]
+            if root == "jax" and any(a.name == "device_put"
+                                     for a in node.names):
+                out.append(node.lineno)
+            elif root == "pyarrow" and node.module.endswith("parquet") \
+                    and any(a.name in ("read_table", "ParquetFile")
+                            for a in node.names):
+                out.append(node.lineno)
+    return sorted(set(out))
+
+
 def thread_sites(tree: ast.AST) -> list:
     """Line numbers of ThreadPoolExecutor / threading.Thread construction
     references (attribute access covers bare calls and aliases; plain
@@ -762,6 +813,15 @@ def collect(root=None) -> tuple:
                     "so framing, deadlines, and retry semantics hold "
                     "(telemetry/exposition.py's HTTP exporter is the "
                     "one other sanctioned listener)")
+        if any(rel.startswith(d + os.sep) for d in PACKAGE_DIRS) \
+                and rel.replace(os.sep, "/") not in DECODE_SITE_ALLOWLIST:
+            for line in decode_sites(tree):
+                problems.append(
+                    f"{rel}:{line}: parquet decode or device transfer "
+                    "outside the buffer-pool modules; route the read "
+                    "through execution/buffer_pool.py or columnar.py so "
+                    "the tiered pool's hit/transfer counters and "
+                    "file-signature invalidation contract hold")
     tests_text = "\n".join(tests_text_parts)
     for name in event_classes:
         if name not in tests_text:

@@ -208,6 +208,17 @@ LOCK_CLASSES = {
         "delegates": frozenset(),
         "why": "double-checked per-root manager construction",
     },
+    ("hyperspace_tpu/execution/buffer_pool.py", "BufferPool"): {
+        "locks": {"_lock": None},
+        "delegates": frozenset({"_bump_ns", "_drop",
+                                "_pop_device_victims",
+                                "_pop_host_victims"}),
+        "why": "THE process-wide tiered scan-buffer cache; every query "
+               "thread's probe mutates two LRU tiers + counters, and "
+               "the delegates are under-lock helpers (their docstrings "
+               "say 'Under the lock') whose demote/promote conversions "
+               "the callers run outside it",
+    },
     ("hyperspace_tpu/index/log_manager.py", "LogLookupCache"): {
         "locks": {"_lock": None},
         "delegates": frozenset(),
@@ -247,6 +258,10 @@ LOCK_GLOBALS = {
     ],
     "hyperspace_tpu/serving/program_bank.py": [
         {"lock": "_BANK_LOCK", "names": {"_BANK"},
+         "why": "double-checked singleton construction"},
+    ],
+    "hyperspace_tpu/execution/buffer_pool.py": [
+        {"lock": "_POOL_LOCK", "names": {"_POOL"},
          "why": "double-checked singleton construction"},
     ],
     "hyperspace_tpu/streaming/ingest.py": [

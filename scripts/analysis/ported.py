@@ -195,6 +195,30 @@ def socket_sites(idx) -> list:
     return sorted(set(out))
 
 
+def decode_sites(idx) -> list:
+    out = []
+    for node in idx.of(ast.Attribute):
+        if not isinstance(node.value, ast.Name):
+            continue
+        if node.attr in ("read_table", "ParquetFile") \
+                and node.value.id.lstrip("_") in ("pq", "parquet"):
+            out.append(node.lineno)
+        elif node.attr == "device_put" and node.value.id == "jax":
+            out.append(node.lineno)
+    for node in idx.of(ast.ImportFrom):
+        if not node.module:
+            continue
+        root = node.module.split(".")[0]
+        if root == "jax" and any(a.name == "device_put"
+                                 for a in node.names):
+            out.append(node.lineno)
+        elif root == "pyarrow" and node.module.endswith("parquet") \
+                and any(a.name in ("read_table", "ParquetFile")
+                        for a in node.names):
+            out.append(node.lineno)
+    return sorted(set(out))
+
+
 def _mutated_names(idx) -> set:
     out = set()
     for node in idx.of(ast.Assign, ast.AugAssign):
@@ -476,6 +500,15 @@ def check_file(src, ctx) -> List[Diagnostic]:
                 "so framing, deadlines, and retry semantics hold "
                 "(telemetry/exposition.py's HTTP exporter is the "
                 "one other sanctioned listener)"))
+    if in_pkg and slash not in legacy.DECODE_SITE_ALLOWLIST:
+        for line in decode_sites(idx):
+            out.append(_legacy_diag(
+                "HS342", rel, line,
+                f"{rel}:{line}: parquet decode or device transfer "
+                "outside the buffer-pool modules; route the read "
+                "through execution/buffer_pool.py or columnar.py so "
+                "the tiered pool's hit/transfer counters and "
+                "file-signature invalidation contract hold"))
     return out
 
 

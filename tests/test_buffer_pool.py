@@ -1,0 +1,453 @@
+"""Tiered columnar buffer pool (execution/buffer_pool.py).
+
+The cache layer UNDER the result cache: decoded, shape-class-padded
+column buffers shared across queries and sessions, keyed by file
+signature + column set + pruning selection. The acceptance surface:
+
+- warm path: a literal-variant repeat of TPC-H q3 (result-cache miss by
+  construction) executes with ZERO parquet reads and ZERO host→device
+  scan transfers — counter-asserted, not timed;
+- pool-on vs pool-off byte-identical across TPC-H + sampled TPC-DS;
+- eviction ladders device→host→drop, padding preserved through the
+  round trip;
+- the "buffer.load" fault point degrades to a silent miss + re-read
+  (never a wrong answer) and fails loud with degrade disabled;
+- bufferPool.* conf keys stay OUT of the result-cache config hash;
+- kill -9 proves the pool is purely process-local (no recovery
+  surface, nothing on disk);
+- telemetry: BufferPoolEvent family (BufferPoolHitEvent /
+  BufferPoolMissEvent / BufferPoolEvictEvent), the "buffer_pool"
+  metrics collector, Hyperspace.buffer_pool_stats(), and explain's
+  I/O section line.
+"""
+
+import datetime
+import os
+import signal
+import subprocess
+import sys
+
+import jax.numpy as jnp
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+import hyperspace_tpu as hst
+from conftest import capture_logger as sink
+from hyperspace_tpu.api import Hyperspace
+from hyperspace_tpu.execution import buffer_pool
+from hyperspace_tpu.execution.buffer_pool import (BufferPool, PoolKey,
+                                                  scan_key, table_nbytes)
+from hyperspace_tpu.execution.columnar import (Column, Table,
+                                               iter_dataset_chunks,
+                                               read_parquet)
+from hyperspace_tpu.index.constants import IndexConstants
+from hyperspace_tpu.parallel import io as pio
+from hyperspace_tpu.plan.expr import col, sum_
+from hyperspace_tpu.robustness.constants import RobustnessConstants
+from hyperspace_tpu.robustness.faults import (FaultRegistry,
+                                              InjectedFaultError, scope)
+from hyperspace_tpu.telemetry.events import (BufferPoolEvent,
+                                             BufferPoolEvictEvent,
+                                             BufferPoolHitEvent,
+                                             BufferPoolMissEvent)
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def fresh_pool():
+    # Entries AND budgets reset around every test: the pool is a process
+    # singleton and conf-driven budget refreshes outlive their session.
+    pool = buffer_pool.get_pool()
+    pool.clear()
+    pool.set_budgets(4 << 30, 4 << 30)
+    yield
+    pool.clear()
+    pool.set_budgets(4 << 30, 4 << 30)
+
+
+def _table(n, valid_rows=None):
+    return Table({"x": Column("int64", jnp.arange(n)),
+                  "y": Column("float64", jnp.linspace(0.0, 1.0, n))},
+                 valid_rows=valid_rows)
+
+
+def _pk(i, nb=0):
+    return PoolKey("scan", ("unit", i), nb)
+
+
+def _write(d, n=300, seed=5):
+    rng = np.random.default_rng(seed)
+    os.makedirs(d, exist_ok=True)
+    f = os.path.join(str(d), "p0.parquet")
+    pq.write_table(pa.table({
+        "k": pa.array(rng.integers(0, 40, n).astype(np.int64)),
+        "v": pa.array(rng.uniform(0, 1, n))}), f)
+    return f
+
+
+class TestLadder:
+    def test_demote_promote_drop_preserves_padding(self):
+        t = _table(256, valid_rows=200)
+        nb = table_nbytes(t)
+        pool = BufferPool(device_bytes=2 * nb, host_bytes=2 * nb)
+        pool.put(_pk(1), t)
+        pool.put(_pk(2), _table(256))
+        pool.put(_pk(3), _table(256))  # demotes LRU pk1 to host
+        s = pool.stats()
+        assert s["demotions"] == 1
+        assert s["device_nbytes"] <= pool.device_bytes
+        got = pool.get(_pk(1))  # host hit → promoted back into HBM
+        assert got is not None
+        s = pool.stats()
+        assert s["host_hits"] == 1 and s["promotions"] == 1
+        assert s["transfers"] == s["loads"] + 1
+        # The demote/promote round trip kept the padded physical length
+        # AND the logical row count (Table.to_host would have trimmed).
+        assert got.column("x").data.shape[0] == 256
+        assert got.valid_rows == 200
+        np.testing.assert_array_equal(np.asarray(got.column("x").data),
+                                      np.arange(256))
+        # Overflow both tiers: the ladder ends in drops.
+        for i in range(4, 10):
+            pool.put(_pk(i), _table(256))
+        s = pool.stats()
+        assert s["evictions"] >= 1
+        assert s["device_nbytes"] <= pool.device_bytes
+        assert s["host_nbytes"] <= pool.host_bytes
+
+    def test_oversize_rejected(self):
+        t = _table(256)
+        pool = BufferPool(device_bytes=table_nbytes(t) - 1, host_bytes=0)
+        pool.put(_pk(1), t)
+        s = pool.stats()
+        assert s["rejections"] == 1 and s["admissions"] == 0
+        assert pool.get(_pk(1)) is None
+
+    def test_device_only_entries_drop_instead_of_demoting(self):
+        t = _table(256)
+        nb = table_nbytes(t)
+        pool = BufferPool(device_bytes=nb, host_bytes=10 * nb)
+        pool.put(_pk(1), t, nbytes=nb, device_only=True)
+        pool.put(_pk(2), t, nbytes=nb, device_only=True)
+        s = pool.stats()
+        assert s["host_entries"] == 0 and s["demotions"] == 0
+        assert s["evictions"] == 1
+        assert pool.get(_pk(1)) is None and pool.get(_pk(2)) is not None
+
+
+class TestInvalidation:
+    def test_file_signature_flips_key_and_serves_new_bytes(self, tmp_path):
+        f = _write(tmp_path / "d", n=300, seed=5)
+        k1 = scan_key([f], ("k",), None)
+        t1 = read_parquet([f], ["k"], pad_to_class=True)
+        assert read_parquet([f], ["k"], pad_to_class=True) is t1
+        # In-place rewrite (different row count ⇒ different size): the
+        # signature embedded in the key changes, the stale entry is
+        # simply unreachable — no explicit invalidation call anywhere.
+        _write(tmp_path / "d", n=500, seed=6)
+        k2 = scan_key([f], ("k",), None)
+        assert k1 != k2
+        t2 = read_parquet([f], ["k"], pad_to_class=True)
+        assert t2 is not t1
+        assert (t2.valid_rows or t2.num_rows) == 500
+
+    def test_unpadded_and_optout_reads_bypass_the_pool(self, tmp_path):
+        f = _write(tmp_path / "d")
+        before = buffer_pool.pool_stats()
+        read_parquet([f], ["k"])                         # exact read
+        read_parquet([f], ["k"], pad_to_class=True, pool=False)
+        after = buffer_pool.pool_stats()
+        assert after["hits"] == before["hits"]
+        assert after["misses"] == before["misses"]
+        assert after["admissions"] == before["admissions"]
+
+
+class TestStreamReplay:
+    def test_chunk_for_chunk_byte_identical_replay(self, tmp_path):
+        files = []
+        for i in range(3):
+            d = tmp_path / f"f{i}"
+            files.append(_write(d, n=120, seed=i))
+        first = list(iter_dataset_chunks(files, ["k", "v"], 100))
+        ns0 = buffer_pool.get_pool().ns_counts("stream")
+        second = list(iter_dataset_chunks(files, ["k", "v"], 100))
+        assert buffer_pool.get_pool().ns_counts("stream")[0] == ns0[0] + 1
+        assert len(second) == len(first) and len(first) >= 3
+        for a, b in zip(first, second):
+            assert a.to_arrow().equals(b.to_arrow())
+        # An abandoned COLD iteration (fresh key: different chunk size)
+        # must never poison the pool with a truncated sequence: later
+        # full passes see the complete stream, and they match each
+        # other chunk-for-chunk.
+        it = iter_dataset_chunks(files, ["k", "v"], 50)
+        next(it)
+        it.close()
+        third = list(iter_dataset_chunks(files, ["k", "v"], 50))
+        fourth = list(iter_dataset_chunks(files, ["k", "v"], 50))
+        assert sum(c.num_rows for c in third) == 360
+        assert len(fourth) == len(third)
+        for a, b in zip(third, fourth):
+            assert a.to_arrow().equals(b.to_arrow())
+
+
+class TestDegrade:
+    def test_buffer_load_fault_is_a_silent_miss(self, tmp_path):
+        f = _write(tmp_path / "d")
+        t1 = read_parquet([f], ["k"], pad_to_class=True)
+        before = buffer_pool.pool_stats()
+        reg = FaultRegistry.from_conf_specs({"buffer.load": "error"},
+                                            seed=7)
+        with scope(reg):
+            t2 = read_parquet([f], ["k"], pad_to_class=True)
+        # Degrade contract (default on): the injected load failure
+        # dropped the entry and reported a miss; the caller re-read.
+        # Same bytes, never a wrong answer.
+        assert t2 is not t1
+        assert t2.to_arrow().equals(t1.to_arrow())
+        after = buffer_pool.pool_stats()
+        assert after["degraded_loads"] > before["degraded_loads"]
+        assert after["invalidations"] > before["invalidations"]
+
+    def test_fail_loud_with_degrade_disabled(self, tmp_path):
+        f = _write(tmp_path / "d")
+        read_parquet([f], ["k"], pad_to_class=True)
+        session = hst.Session(system_path=str(tmp_path / "indexes"))
+        session.conf.set(RobustnessConstants.DEGRADE_ENABLED, "false")
+        reg = FaultRegistry.from_conf_specs({"buffer.load": "error"},
+                                            seed=9)
+        with pio.use_session(session), scope(reg):
+            with pytest.raises(InjectedFaultError):
+                buffer_pool.get_pool().get(scan_key([f], ("k",), None))
+
+
+class TestConfigHash:
+    def test_result_cache_hit_survives_buffer_pool_toggle(self, tmp_path):
+        from hyperspace_tpu.serving.constants import ServingConstants
+        from hyperspace_tpu.serving.fingerprint import config_hash
+        _write(tmp_path / "d")
+        session = hst.Session(system_path=str(tmp_path / "indexes"))
+        session.conf.set(ServingConstants.RESULT_CACHE_ENABLED, "true")
+        session.conf.set(ServingConstants.RESULT_CACHE_MIN_COMPUTE_SECONDS,
+                         "0")
+        df = session.read.parquet(str(tmp_path / "d"))
+        q = df.group_by("k").agg(sum_(col("v")).alias("sv"))
+        h0 = config_hash(session)
+        r1 = q.to_arrow()
+        cache = session.result_cache
+        s0 = cache.stats()
+        # Flipping ANY bufferPool.* key is residency tuning, not result
+        # identity: the config hash — and therefore the result-cache
+        # entry — must survive the toggle.
+        session.conf.set(IndexConstants.TPU_BUFFER_POOL_ENABLED, "false")
+        session.conf.set(IndexConstants.TPU_BUFFER_POOL_DEVICE_BYTES,
+                         str(1 << 20))
+        assert config_hash(session) == h0
+        assert session.result_cache is cache
+        r2 = q.to_arrow()
+        s1 = cache.stats()
+        assert s1["hits"] == s0["hits"] + 1
+        assert s1["misses"] == s0["misses"]
+        assert r1.equals(r2)
+
+
+@pytest.fixture(scope="module")
+def tpc_env(tmp_path_factory):
+    from goldstandard import tpc
+    base = tmp_path_factory.mktemp("bp_tpc")
+    session = hst.Session(system_path=str(base / "indexes"))
+    session.conf.set(IndexConstants.INDEX_NUM_BUCKETS, 4)
+    root = str(base / "tpc")
+    dfs = tpc.register_tables(session, root)
+    return dict(session=session, dfs=dfs, root=root)
+
+
+class TestWarmPath:
+    def test_literal_variant_q3_repeat_zero_reads_zero_transfers(
+            self, tpc_env, monkeypatch):
+        """THE acceptance: q3, then a literal-variant q3 (different
+        aggregate literal → result-cache fingerprint differs, scans
+        identical). The second execution must do ZERO parquet reads and
+        ZERO host→device scan transfers — every scan served from the
+        device tier."""
+        from goldstandard import tpc
+        from hyperspace_tpu.execution import columnar
+        dfs = tpc_env["dfs"]
+        decodes = {"n": 0}
+        real_read, real_pf = pq.read_table, pq.ParquetFile
+
+        def counting_read(*a, **kw):
+            decodes["n"] += 1
+            return real_read(*a, **kw)
+
+        def counting_pf(*a, **kw):
+            decodes["n"] += 1
+            return real_pf(*a, **kw)
+
+        monkeypatch.setattr(columnar.pq, "read_table", counting_read)
+        monkeypatch.setattr(columnar.pq, "ParquetFile", counting_pf)
+
+        r1 = tpc.queries(dfs)["tpch_q3"].to_arrow()
+        assert decodes["n"] > 0  # the cold run really decoded parquet
+
+        li, od = dfs["lineitem"], dfs["orders"]
+        cutoff = datetime.date(1995, 3, 15)
+        variant = (
+            li.filter(col("l_shipdate") > cutoff)
+            .join(od.filter(col("o_orderdate") < cutoff),
+                  on=col("l_orderkey") == col("o_orderkey"))
+            .group_by("l_orderkey", "o_orderdate", "o_shippriority")
+            .agg(sum_(col("l_extendedprice") * (0.9 - col("l_discount")))
+                 .alias("revenue"))
+            .sort(("revenue", False), "o_orderdate").limit(10))
+        before = buffer_pool.pool_stats()
+        decodes["n"] = 0
+        r2 = variant.to_arrow()
+        after = buffer_pool.pool_stats()
+        assert decodes["n"] == 0                       # 0 parquet reads
+        assert after["transfers"] == before["transfers"]  # 0 h→d transfers
+        assert after["misses"] == before["misses"]
+        assert after["hits"] > before["hits"]
+        assert after["decode_bytes_saved"] > before["decode_bytes_saved"]
+        assert r1.num_rows > 0 and r2.num_rows > 0
+
+
+class TestParity:
+    def test_pool_on_vs_pool_off_byte_identical(self, tpc_env):
+        """Full TPC-H set + sampled TPC-DS: a pool-off session (fresh
+        plans, pool disabled by conf) must produce byte-identical
+        results to the pool-on session's WARM executions — and must
+        never touch the pool."""
+        from goldstandard import tpc
+        names = ["tpch_q1", "tpch_q3", "tpch_q6", "tpch_q12", "tpch_q14",
+                 "tpch_q17", "self_join", "tpcds_q1_like",
+                 "tpcds_q42_like"]
+        qs_on = tpc.queries(tpc_env["dfs"])
+        warm = {}
+        for name in names:
+            qs_on[name].to_arrow()          # cold: admit
+            warm[name] = qs_on[name].to_arrow()   # warm: pool-served
+
+        off = hst.Session(system_path=tpc_env["root"] + "_off_idx")
+        off.conf.set(IndexConstants.TPU_BUFFER_POOL_ENABLED, "false")
+        qs_off = tpc.queries(tpc.register_tables(off, tpc_env["root"]))
+        probes0 = buffer_pool.pool_stats()
+        for name in names:
+            assert qs_off[name].to_arrow().equals(warm[name]), name
+        probes1 = buffer_pool.pool_stats()
+        assert probes1["hits"] == probes0["hits"]
+        assert probes1["misses"] == probes0["misses"]
+
+
+class TestObservability:
+    def test_events_metrics_stats_and_explain(self, tmp_path):
+        f1 = _write(tmp_path / "d1", seed=1)
+        _write(tmp_path / "d2", seed=2)
+        session = hst.Session(system_path=str(tmp_path / "indexes"))
+        session.conf.set(IndexConstants.EVENT_LOGGER_CLASS,
+                         "tests.conftest.CaptureLogger")
+        hs = Hyperspace(session)
+        nb = table_nbytes(read_parquet([f1], None, pad_to_class=True,
+                                       pool=False))
+        buffer_pool.get_pool().clear()
+        # Budget fits one scan + slack but not two: the second admit
+        # demotes the first — miss, hit, and demotion events in one run.
+        session.conf.set(IndexConstants.TPU_BUFFER_POOL_DEVICE_BYTES,
+                         str(int(1.5 * nb)))
+        session.conf.set(IndexConstants.TPU_BUFFER_POOL_HOST_BYTES,
+                         str(4 * nb))
+        mark = len(sink().events)
+        with pio.use_session(session):
+            read_parquet([f1], None, pad_to_class=True)   # miss + admit
+            read_parquet([f1], None, pad_to_class=True)   # device hit
+            read_parquet([str(tmp_path / "d2" / "p0.parquet")], None,
+                         pad_to_class=True)               # evicts f1
+        evs = [e for e in sink().events[mark:]
+               if isinstance(e, BufferPoolEvent)]
+        kinds = [type(e).__name__ for e in evs]
+        assert "BufferPoolMissEvent" in kinds
+        assert "BufferPoolHitEvent" in kinds
+        assert "BufferPoolEvictEvent" in kinds
+        hit = next(e for e in evs if isinstance(e, BufferPoolHitEvent))
+        assert hit.namespace == "scan" and hit.tier == "device"
+        assert hit.nbytes > 0
+        evict = next(e for e in evs
+                     if isinstance(e, BufferPoolEvictEvent))
+        assert evict.demoted  # host tier had room: demotion, not drop
+        assert not any(isinstance(e, BufferPoolMissEvent) and e.reason
+                       for e in evs)  # no fault-degraded probes here
+
+        stats = hs.buffer_pool_stats()
+        assert stats["hits"] >= 1 and stats["transfers"] >= 2
+        # The collector every worker's OpenMetrics scrape carries
+        # fleet-wide (no cross-process byte shipping — stats only).
+        assert "buffer_pool" in hs.metrics()["collectors"]
+
+        # A prefetch stream makes explain's I/O section render
+        # deterministically (it gates on the process-wide io counters).
+        with pio.use_session(session):
+            list(iter_dataset_chunks([f1], ["k"], 100))
+        df = session.read.parquet(str(tmp_path / "d1"))
+        df.filter(col("k") >= 0).select("k", "v").to_pandas()
+        text = hs.explain(df.filter(col("k") >= 0).select("k", "v"))
+        assert "buffer pool: hits=" in text
+        assert "decode_bytes_saved=" in text
+
+
+_CHILD_WARM = """\
+import os, signal, sys
+from hyperspace_tpu.execution import buffer_pool
+from hyperspace_tpu.execution.columnar import read_parquet
+f = sys.argv[1]
+t1 = read_parquet([f], None, pad_to_class=True)
+t2 = read_parquet([f], None, pad_to_class=True)
+assert t2 is t1
+s = buffer_pool.pool_stats()
+assert s["hits"] == 1 and s["admissions"] == 1, s
+print("WARM", flush=True)
+os.kill(os.getpid(), signal.SIGKILL)
+"""
+
+_CHILD_COLD = """\
+import sys
+from hyperspace_tpu.execution import buffer_pool
+from hyperspace_tpu.execution.buffer_pool import scan_key
+f = sys.argv[1]
+s = buffer_pool.pool_stats()
+assert s["hits"] == 0 and s["admissions"] == 0, s
+assert buffer_pool.get_pool().get(scan_key([f], None, None)) is None
+print("COLD-MISS", flush=True)
+"""
+
+
+class TestProcessLocal:
+    def test_kill9_leaves_nothing_behind_and_next_process_starts_cold(
+            self, tmp_path):
+        """kill -9 a process with a warm pool: nothing to recover,
+        nothing recovered. The pool has NO disk presence — the data
+        directory is untouched and a fresh process probes cold."""
+        f = _write(tmp_path / "d")
+        listing0 = sorted(os.listdir(tmp_path / "d"))
+
+        def run(body):
+            script = str(tmp_path / "child.py")
+            with open(script, "w") as fh:
+                fh.write(body)
+            env = dict(os.environ)
+            env["JAX_PLATFORMS"] = "cpu"
+            env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+            env["PYTHONPATH"] = ROOT + os.pathsep + env.get(
+                "PYTHONPATH", "")
+            return subprocess.run([sys.executable, script, f], env=env,
+                                  capture_output=True, text=True,
+                                  timeout=300, cwd=ROOT)
+
+        warm = run(_CHILD_WARM)
+        assert warm.returncode == -signal.SIGKILL, warm.stderr
+        assert "WARM" in warm.stdout
+        assert sorted(os.listdir(tmp_path / "d")) == listing0
+        cold = run(_CHILD_COLD)
+        assert cold.returncode == 0, cold.stderr
+        assert "COLD-MISS" in cold.stdout

@@ -40,6 +40,10 @@ CHAOS_SPECS = {
     FN.IO_POOLED_READ: "transient:p=0.05",
     FN.IO_PREFETCH_PRODUCE: "error:p=0.01",
     FN.SCAN_PARQUET_DECODE: "error:p=0.02",
+    # Buffer-pool probe in the blast radius: a struck load degrades to
+    # a silent miss + re-read (execution/buffer_pool.py), so results
+    # stay byte-identical under fire.
+    FN.BUFFER_LOAD: "error:p=0.3",
     FN.SPMD_DISPATCH: "error:p=0.1",
     FN.SPMD_COMPILE: "error:p=0.05",
     FN.BANK_COMPILE: "error:p=0.03",
@@ -75,6 +79,14 @@ def _session(tmp_path, spill_dir):
     # Artifact store in the blast radius: failed exports/imports must
     # degrade to plain compiles, never corrupt a result.
     session.conf.set(ArtifactConstants.ENABLED, "true")
+    # Starve the buffer pool so the 8-thread mix drives constant
+    # eviction storms down the device→host→drop ladder while the
+    # buffer.load fault fires — residency churn must never change a
+    # result, only counters.
+    session.conf.set(IndexConstants.TPU_BUFFER_POOL_DEVICE_BYTES,
+                     str(256 * 1024))
+    session.conf.set(IndexConstants.TPU_BUFFER_POOL_HOST_BYTES,
+                     str(64 * 1024))
     return session
 
 

@@ -560,7 +560,7 @@ class TestMetricNameRegistry:
             "serving.sweep_invocations", "serving.latency_ms",
             "query.latency_ms", "io", "program_bank", "serving",
             "robustness", "streaming", "fusion", "flight_recorder",
-            "artifacts", "cluster",
+            "artifacts", "cluster", "buffer_pool",
         })
 
     def test_sweep_invocations_counter_still_feeds(self, tmp_path,

@@ -99,6 +99,7 @@ class TestFaultSpecs:
             "action.op", "serving.worker", "ingest.stage",
             "ingest.publish", "artifacts.write", "artifacts.read",
             "cluster.forward", "cluster.broadcast",
+            "streaming.source", "buffer.load",
         })
 
     def test_parse_kinds_and_options(self):
@@ -296,6 +297,11 @@ class TestRetry:
         session = _session(tmp_path, capture_events=True)
         q = _query(session, tmp_path / "d")
         base = q.to_arrow()
+        # Drop the base read's buffers from the process buffer pool —
+        # a warm repeat would be served from HBM without any pooled
+        # reader tasks, and the injected fault would never fire.
+        from hyperspace_tpu.execution import buffer_pool
+        buffer_pool.get_pool().clear()
         sink = capture_logger()
         n_before = len(sink.events)
         session.conf.set(_fkey(FN.IO_POOLED_READ), "transient:times=2")

@@ -248,7 +248,7 @@ class TestFramework:
             "HS207", "HS208", "HS209", "HS210", "HS211", "HS212",
             "HS213", "HS214", "HS215", "HS216", "HS217",
             "HS301", "HS302", "HS311", "HS312", "HS321", "HS331",
-            "HS341",
+            "HS341", "HS342",
         }
 
     def test_doc_table_in_lockstep(self):

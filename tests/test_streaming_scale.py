@@ -530,6 +530,14 @@ class TestSubscriptionFanout:
             assert len(ds) == 1 and ds[0].ok, getattr(
                 ds[0], "error", None)
 
+        # Deliveries land member-by-member INSIDE the batch loop while
+        # the wave's counters are noted once AFTER it (plus sweep-trace
+        # retention) — so wait_for can return a beat before the batch
+        # thread publishes stats. Give the counters a moment to settle.
+        deadline = time.monotonic() + 30.0
+        while (front.stats()["batches"] == before["batches"]
+               and time.monotonic() < deadline):
+            time.sleep(0.05)
         after = front.stats()
         # ONE wave for the whole fan-out: every same-template fire
         # shares one scan and one vmapped sweep invocation.

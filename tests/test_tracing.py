@@ -103,6 +103,10 @@ class TestTraceShape:
         hs = Hyperspace(session)
         q = _build_q3(session, li_dir, od_dir)
         q.to_arrow()  # warm (compiles) untraced
+        # Drop the warm-up read's buffers so the traced run performs
+        # real pooled I/O — a buffer-pool hit would skip io.read spans.
+        from hyperspace_tpu.execution import buffer_pool
+        buffer_pool.get_pool().clear()
         _tracing(session, True)
         q.to_arrow()
         tr = hs.last_trace()
@@ -394,7 +398,7 @@ class TestSpanRegistry:
             "serving.sweep", "ingest.append", "ingest.commit",
             "ingest.compact", "artifact.load", "artifact.export",
             "artifact.warmup", "cluster.forward", "cluster.broadcast",
-            "cluster.gather",
+            "cluster.gather", "ingest.source", "ingest.wave",
         })
 
     def test_join_reorder_span_appears_when_enabled(self, q3ish):
